@@ -1,0 +1,29 @@
+"""elasticsearch_tpu — a TPU-native distributed search & analytics engine.
+
+A ground-up rebuild of the capabilities of Elasticsearch 2.0 (reference:
+/root/reference, surveyed in SURVEY.md) designed for TPUs: per-shard inverted
+indexes and columnar fielddata live as dense device tensors, BM25 scoring and
+aggregations are batched XLA/Pallas programs, and cross-shard reduces are mesh
+collectives (jax.lax.top_k / psum) instead of coordinator-side merge loops.
+
+Layer map (mirrors SURVEY.md §1):
+  common/    — settings, circuit breakers, wire/json helpers       (ref L0)
+  analysis/  — tokenizers, token filters, analyzers                (ref index/analysis)
+  mapping/   — schema: field types, dynamic mapping                (ref index/mapper)
+  index/     — tensor segments, engine, translog, shards           (ref index/engine, translog, shard)
+  ops/       — device kernels: BM25 scoring, top-k, segment ops    (replaces Lucene's hot loops)
+  search/    — query DSL compilation, query/fetch phases, aggs     (ref index/query, search/)
+  parallel/  — mesh, doc routing, cross-shard collective reduce    (ref cluster/routing, SearchPhaseController)
+  cluster/   — cluster state, routing table, allocation, service   (ref cluster/)
+  models/    — similarity/scoring models (BM25, TF-IDF, dense)     (ref index/similarity)
+  rest/      — HTTP REST API surface                               (ref rest/, http/)
+"""
+
+# Exact integer semantics for longs/dates (epoch millis) require 64-bit device
+# types; we enable x64 globally and pass explicit dtypes everywhere hot
+# (scores are always float32/bfloat16, ids int32).
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

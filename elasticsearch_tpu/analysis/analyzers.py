@@ -1,0 +1,358 @@
+"""Text analysis: tokenizers → token filters → analyzers.
+
+CPU-side analog of the reference analysis registry
+(/root/reference/src/main/java/org/elasticsearch/index/analysis/AnalysisModule.java,
+AnalysisService.java; SURVEY.md §2.4 "Analysis"): a registry of named
+tokenizers/filters/analyzers plus per-index custom chains built from settings.
+Analysis runs on host (it is string processing, not tensor work); its output
+feeds the tensor segment builder in index/segment.py.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+Token = str
+Tokenizer = Callable[[str], list[Token]]
+TokenFilter = Callable[[list[Token]], list[Token]]
+
+# ---------------------------------------------------------------------------
+# Tokenizers (ref: index/analysis/StandardTokenizerFactory.java etc.)
+# ---------------------------------------------------------------------------
+
+_WORD_RE = re.compile(r"[\w][\w'’]*", re.UNICODE)
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def standard_tokenizer(text: str) -> list[Token]:
+    """Unicode word-boundary tokenizer (approximation of Lucene's
+    StandardTokenizer / UAX#29): splits on non-word chars, keeps interior
+    apostrophes, strips possessive 's."""
+    toks = []
+    for m in _WORD_RE.finditer(text):
+        t = m.group(0).replace("’", "'")
+        if t.endswith("'s") or t.endswith("'S"):
+            t = t[:-2]
+        t = t.strip("'")
+        if t:
+            toks.append(t)
+    return toks
+
+
+def whitespace_tokenizer(text: str) -> list[Token]:
+    return text.split()
+
+
+def letter_tokenizer(text: str) -> list[Token]:
+    return _LETTER_RE.findall(text)
+
+
+def keyword_tokenizer(text: str) -> list[Token]:
+    return [text] if text else []
+
+
+def _ngram(text: str, lo: int, hi: int, edge: bool) -> list[Token]:
+    out = []
+    n = len(text)
+    if edge:
+        for g in range(lo, min(hi, n) + 1):
+            out.append(text[:g])
+    else:
+        for g in range(lo, hi + 1):
+            for i in range(0, n - g + 1):
+                out.append(text[i:i + g])
+    return out
+
+
+def ngram_tokenizer(text: str, min_gram: int = 1, max_gram: int = 2) -> list[Token]:
+    return _ngram(text, min_gram, max_gram, edge=False)
+
+
+def edge_ngram_tokenizer(text: str, min_gram: int = 1, max_gram: int = 8) -> list[Token]:
+    return _ngram(text, min_gram, max_gram, edge=True)
+
+
+# ---------------------------------------------------------------------------
+# Token filters
+# ---------------------------------------------------------------------------
+
+# Lucene's default English stopword set (StandardAnalyzer.STOP_WORDS_SET).
+ENGLISH_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+
+def lowercase_filter(tokens: list[Token]) -> list[Token]:
+    return [t.lower() for t in tokens]
+
+
+def uppercase_filter(tokens: list[Token]) -> list[Token]:
+    return [t.upper() for t in tokens]
+
+
+def stop_filter(tokens: list[Token], stopwords: frozenset[str] = ENGLISH_STOPWORDS) -> list[Token]:
+    return [t for t in tokens if t not in stopwords]
+
+
+def asciifolding_filter(tokens: list[Token]) -> list[Token]:
+    out = []
+    for t in tokens:
+        folded = unicodedata.normalize("NFKD", t).encode("ascii", "ignore").decode("ascii")
+        out.append(folded if folded else t)
+    return out
+
+
+def trim_filter(tokens: list[Token]) -> list[Token]:
+    return [t.strip() for t in tokens]
+
+
+def unique_filter(tokens: list[Token]) -> list[Token]:
+    seen, out = set(), []
+    for t in tokens:
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
+
+
+def length_filter(tokens: list[Token], min_len: int = 0, max_len: int = 1 << 30) -> list[Token]:
+    return [t for t in tokens if min_len <= len(t) <= max_len]
+
+
+def shingle_filter(tokens: list[Token], min_size: int = 2, max_size: int = 2,
+                   output_unigrams: bool = True, sep: str = " ") -> list[Token]:
+    out = list(tokens) if output_unigrams else []
+    for size in range(min_size, max_size + 1):
+        for i in range(len(tokens) - size + 1):
+            out.append(sep.join(tokens[i:i + size]))
+    return out
+
+
+# --- Porter stemmer (english analyzer; ref index/analysis/StemmerTokenFilterFactory.java)
+
+_VOWELS = "aeiou"
+
+
+def _is_cons(word: str, i: int) -> bool:
+    c = word[i]
+    if c in _VOWELS:
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(word, i - 1)
+    return True
+
+
+def _measure(stem: str) -> int:
+    m, prev_c = 0, True
+    started = False
+    for i in range(len(stem)):
+        c = _is_cons(stem, i)
+        if not c:
+            started = True
+        elif started and not prev_c:
+            m += 1
+        prev_c = c
+    return m
+
+
+def _has_vowel(stem: str) -> bool:
+    return any(not _is_cons(stem, i) for i in range(len(stem)))
+
+
+def _ends_double_cons(w: str) -> bool:
+    return len(w) >= 2 and w[-1] == w[-2] and _is_cons(w, len(w) - 1)
+
+
+def _cvc(w: str) -> bool:
+    if len(w) < 3:
+        return False
+    return (_is_cons(w, len(w) - 3) and not _is_cons(w, len(w) - 2)
+            and _is_cons(w, len(w) - 1) and w[-1] not in "wxy")
+
+
+def porter_stem(w: str) -> str:
+    """Porter stemming algorithm (Porter, 1980) — classic 5-step rules."""
+    if len(w) <= 2:
+        return w
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    flag = False
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and _has_vowel(w[:-2]):
+        w, flag = w[:-2], True
+    elif w.endswith("ing") and _has_vowel(w[:-3]):
+        w, flag = w[:-3], True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif _ends_double_cons(w) and w[-1] not in "lsz":
+            w = w[:-1]
+        elif _measure(w) == 1 and _cvc(w):
+            w += "e"
+    # step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # step 2
+    for suf, rep in (("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+                     ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+                     ("alli", "al"), ("entli", "ent"), ("eli", "e"),
+                     ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+                     ("ator", "ate"), ("alism", "al"), ("iveness", "ive"),
+                     ("fulness", "ful"), ("ousness", "ous"), ("aliti", "al"),
+                     ("iviti", "ive"), ("biliti", "ble")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # step 3
+    for suf, rep in (("icate", "ic"), ("ative", ""), ("alize", "al"),
+                     ("iciti", "ic"), ("ical", "ic"), ("ful", ""), ("ness", "")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+    # step 4
+    for suf in ("al", "ance", "ence", "er", "ic", "able", "ible", "ant",
+                "ement", "ment", "ent", "ou", "ism", "ate", "iti", "ous",
+                "ive", "ize"):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 1:
+                w = w[:-len(suf)]
+            break
+    else:
+        if w.endswith("ion") and len(w) > 3 and w[-4] in "st" and _measure(w[:-3]) > 1:
+            w = w[:-3]
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        if _measure(stem) > 1 or (_measure(stem) == 1 and not _cvc(stem)):
+            w = stem
+    # step 5b
+    if _measure(w) > 1 and _ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+def porter_stem_filter(tokens: list[Token]) -> list[Token]:
+    return [porter_stem(t) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Analyzers and the registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Analyzer:
+    name: str
+    tokenizer: Tokenizer
+    filters: list[TokenFilter] = field(default_factory=list)
+
+    def analyze(self, text: str) -> list[Token]:
+        if text is None:
+            return []
+        tokens = self.tokenizer(str(text))
+        for f in self.filters:
+            tokens = f(tokens)
+        return tokens
+
+    __call__ = analyze
+
+
+def _std(name: str, *filters: TokenFilter) -> Analyzer:
+    return Analyzer(name, standard_tokenizer, list(filters))
+
+
+BUILTIN_ANALYZERS: dict[str, Analyzer] = {
+    "standard": _std("standard", lowercase_filter),
+    "simple": Analyzer("simple", letter_tokenizer, [lowercase_filter]),
+    "whitespace": Analyzer("whitespace", whitespace_tokenizer),
+    "keyword": Analyzer("keyword", keyword_tokenizer),
+    "stop": Analyzer("stop", letter_tokenizer, [lowercase_filter, stop_filter]),
+    "english": _std("english", lowercase_filter, stop_filter, porter_stem_filter),
+}
+
+_TOKENIZERS: dict[str, Tokenizer] = {
+    "standard": standard_tokenizer,
+    "whitespace": whitespace_tokenizer,
+    "letter": letter_tokenizer,
+    "keyword": keyword_tokenizer,
+    "ngram": ngram_tokenizer,
+    "nGram": ngram_tokenizer,
+    "edge_ngram": edge_ngram_tokenizer,
+    "edgeNGram": edge_ngram_tokenizer,
+}
+
+_FILTERS: dict[str, TokenFilter] = {
+    "lowercase": lowercase_filter,
+    "uppercase": uppercase_filter,
+    "stop": stop_filter,
+    "asciifolding": asciifolding_filter,
+    "trim": trim_filter,
+    "unique": unique_filter,
+    "porter_stem": porter_stem_filter,
+    "stemmer": porter_stem_filter,
+    "shingle": shingle_filter,
+}
+
+
+class AnalysisService:
+    """Per-index analyzer registry: builtins + custom chains from settings.
+
+    Custom analyzers follow the reference settings schema
+    (index.analysis.analyzer.<name>.{type,tokenizer,filter}), see
+    /root/reference/src/main/java/org/elasticsearch/index/analysis/AnalysisService.java.
+    """
+
+    def __init__(self, index_settings=None):
+        self._analyzers = dict(BUILTIN_ANALYZERS)
+        if index_settings is not None:
+            self._build_custom(index_settings)
+
+    def _build_custom(self, settings) -> None:
+        from ..common.settings import Settings
+
+        if not isinstance(settings, Settings):
+            settings = Settings(settings)
+        custom = settings.by_prefix("index.analysis.analyzer.")
+        names = {k.split(".")[0] for k in custom}
+        for name in names:
+            sub = custom.by_prefix(name + ".")
+            atype = sub.get_str("type", "custom")
+            if atype != "custom" and atype in BUILTIN_ANALYZERS:
+                self._analyzers[name] = BUILTIN_ANALYZERS[atype]
+                continue
+            tok_name = sub.get_str("tokenizer", "standard")
+            tokenizer = _TOKENIZERS.get(tok_name)
+            if tokenizer is None:
+                raise ValueError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+            filters = []
+            for fname in sub.get_list("filter", []) or []:
+                f = _FILTERS.get(fname)
+                if f is None:
+                    raise ValueError(f"unknown token filter [{fname}] for analyzer [{name}]")
+                filters.append(f)
+            self._analyzers[name] = Analyzer(name, tokenizer, filters)
+
+    def analyzer(self, name: str) -> Analyzer:
+        a = self._analyzers.get(name)
+        if a is None:
+            raise ValueError(f"unknown analyzer [{name}]")
+        return a
+
+    def default_analyzer(self) -> Analyzer:
+        return self._analyzers.get("default", self._analyzers["standard"])
+
+    def names(self) -> Iterable[str]:
+        return self._analyzers.keys()

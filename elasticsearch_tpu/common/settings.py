@@ -1,0 +1,193 @@
+"""Immutable layered settings.
+
+TPU-native analog of the reference settings system
+(/root/reference/src/main/java/org/elasticsearch/common/settings/ImmutableSettings.java,
+node/internal/InternalSettingsPreparer.java): flat dot-path keys over nested
+dicts, typed getters with units (bytes, time), env/sysprop-style overlays, and
+a builder for merging layers (file < env < API), per SURVEY.md §5.6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Iterator, Mapping
+
+_TIME_UNITS = {
+    "nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0,
+    "m": 60.0, "h": 3600.0, "d": 86400.0, "w": 604800.0,
+}
+_BYTE_UNITS = {
+    "b": 1, "kb": 1 << 10, "k": 1 << 10, "mb": 1 << 20, "m": 1 << 20,
+    "gb": 1 << 30, "g": 1 << 30, "tb": 1 << 40, "t": 1 << 40,
+    "pb": 1 << 50, "p": 1 << 50,
+}
+_UNIT_RE = re.compile(r"^\s*(-?\d+(?:\.\d+)?)\s*([a-zA-Z%]*)\s*$")
+
+
+def _flatten(prefix: str, obj: Any, out: dict[str, Any]) -> None:
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, Mapping):
+                _flatten(key + ".", v, out)
+            else:
+                out[key] = v
+    else:
+        out[prefix.rstrip(".")] = obj
+
+
+class Settings(Mapping[str, Any]):
+    """Immutable flat-key settings map with typed accessors."""
+
+    def __init__(self, data: Mapping[str, Any] | None = None):
+        flat: dict[str, Any] = {}
+        if data:
+            _flatten("", dict(data), flat)
+        self._map: dict[str, Any] = flat
+
+    # -- Mapping protocol -------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._map[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._map)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __repr__(self) -> str:
+        return f"Settings({self._map!r})"
+
+    # -- typed getters ----------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._map.get(key, default)
+
+    def get_str(self, key: str, default: str | None = None) -> str | None:
+        v = self._map.get(key)
+        return default if v is None else str(v)
+
+    def get_int(self, key: str, default: int | None = None) -> int | None:
+        v = self._map.get(key)
+        return default if v is None else int(v)
+
+    def get_float(self, key: str, default: float | None = None) -> float | None:
+        v = self._map.get(key)
+        return default if v is None else float(v)
+
+    def get_bool(self, key: str, default: bool | None = None) -> bool | None:
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        return str(v).lower() in ("true", "1", "on", "yes")
+
+    def get_time(self, key: str, default: float | None = None) -> float | None:
+        """Parse a time value ('30s', '5m', '100ms') into seconds."""
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, (int, float)):
+            return float(v) / 1000.0  # bare numbers are millis, like the reference
+        m = _UNIT_RE.match(str(v))
+        if not m:
+            raise ValueError(f"cannot parse time value [{v}] for [{key}]")
+        num, unit = float(m.group(1)), m.group(2).lower()
+        if unit not in _TIME_UNITS:
+            raise ValueError(f"unknown time unit [{unit}] for [{key}]")
+        return num * _TIME_UNITS[unit]
+
+    def get_bytes(self, key: str, default: int | None = None) -> int | None:
+        """Parse a byte-size value ('512mb', '10%s of nothing' not supported)."""
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, (int, float)):
+            return int(v)
+        m = _UNIT_RE.match(str(v))
+        if not m:
+            raise ValueError(f"cannot parse byte size [{v}] for [{key}]")
+        num, unit = float(m.group(1)), m.group(2).lower()
+        if unit == "":
+            return int(num)
+        if unit not in _BYTE_UNITS:
+            raise ValueError(f"unknown byte unit [{unit}] for [{key}]")
+        return int(num * _BYTE_UNITS[unit])
+
+    def get_list(self, key: str, default: list | None = None) -> list | None:
+        v = self._map.get(key)
+        if v is None:
+            # array-style flat keys: key.0, key.1, ...
+            idx = []
+            for k, val in self._map.items():
+                m = re.match(re.escape(key) + r"\.(\d+)$", k)
+                if m:
+                    idx.append((int(m.group(1)), val))
+            if idx:
+                return [val for _, val in sorted(idx)]
+            return default
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    def by_prefix(self, prefix: str) -> "Settings":
+        """Sub-settings with `prefix` stripped (reference getByPrefix)."""
+        s = Settings()
+        s._map = {k[len(prefix):]: v for k, v in self._map.items() if k.startswith(prefix)}
+        return s
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._map)
+
+    def as_nested(self) -> dict[str, Any]:
+        """Re-nest flat keys into a tree (for JSON rendering)."""
+        root: dict[str, Any] = {}
+        for k, v in sorted(self._map.items()):
+            parts = k.split(".")
+            node = root
+            ok = True
+            for p in parts[:-1]:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                node = nxt
+            if ok:
+                node[parts[-1]] = v
+            else:
+                root[k] = v
+        return root
+
+    # -- builder ----------------------------------------------------------
+    def merged(self, *overlays: "Settings | Mapping[str, Any] | None") -> "Settings":
+        out = dict(self._map)
+        for o in overlays:
+            if o is None:
+                continue
+            o = o if isinstance(o, Settings) else Settings(o)
+            out.update(o._map)
+        s = Settings()
+        s._map = out
+        return s
+
+    @staticmethod
+    def from_env(env: Mapping[str, str] | None = None, prefix: str = "ES_TPU_") -> "Settings":
+        """Overlay from environment variables: ES_TPU_FOO_BAR -> foo.bar
+        (analog of the reference's -Des.* sysprop merge)."""
+        env = os.environ if env is None else env
+        out = {}
+        for k, v in env.items():
+            if k.startswith(prefix):
+                out[k[len(prefix):].lower().replace("__", "-").replace("_", ".")] = v
+        s = Settings()
+        s._map = out
+        return s
+
+    @staticmethod
+    def from_json(text: str) -> "Settings":
+        return Settings(json.loads(text))
+
+
+EMPTY = Settings()

@@ -1,0 +1,432 @@
+"""Schema layer: field types, document parsing, dynamic mapping.
+
+TPU-native analog of the reference mapper package
+(/root/reference/src/main/java/org/elasticsearch/index/mapper/DocumentMapper.java:786,
+MapperService.java:993, core/*FieldMapper.java; SURVEY.md §2.4 "Mapper"):
+a JSON document is parsed against a (possibly dynamically growing) schema into
+typed channels that the tensor segment builder consumes:
+
+  text fields    -> analyzed token lists  -> CSR postings tensors
+  keyword fields -> raw strings           -> ordinal columns (global-ords analog)
+  numeric fields -> float64/int64         -> dense columns (fielddata analog)
+  date fields    -> epoch millis int64    -> dense columns
+  boolean fields -> 0/1                   -> dense columns
+  dense_vector   -> float list            -> [N, dim] matrix for kNN
+
+Differences from the reference, by design:
+  * Object fields flatten to dot-paths (same as reference); `nested` is not
+    yet supported.
+  * `string` fields are mapped to text (analyzed) unless
+    `"index": "not_analyzed"` (ES 2.x) — and modern `text`/`keyword` types are
+    accepted directly.
+  * Every text field also records its first 256 chars as a keyword ordinal so
+    sorting/aggregating on an analyzed field degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+from ..analysis.analyzers import AnalysisService, Analyzer
+
+# ---------------------------------------------------------------------------
+# Field types
+# ---------------------------------------------------------------------------
+
+TEXT = "text"
+KEYWORD = "keyword"
+LONG = "long"
+INTEGER = "integer"
+SHORT = "short"
+BYTE = "byte"
+DOUBLE = "double"
+FLOAT = "float"
+DATE = "date"
+BOOLEAN = "boolean"
+IP = "ip"
+DENSE_VECTOR = "dense_vector"
+GEO_POINT = "geo_point"
+OBJECT = "object"
+
+_INT_TYPES = {LONG, INTEGER, SHORT, BYTE}
+_FLOAT_TYPES = {DOUBLE, FLOAT}
+NUMERIC_TYPES = _INT_TYPES | _FLOAT_TYPES
+
+
+@dataclass
+class FieldType:
+    name: str                      # full dot path
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: str | None = None
+    index: bool = True             # indexed (searchable)
+    doc_values: bool = True        # columnar fielddata
+    store: bool = False
+    dims: int = 0                  # dense_vector dimension
+    format: str | None = None      # date format
+    boost: float = 1.0
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"type": self.type}
+        if self.type == TEXT and self.analyzer != "standard":
+            out["analyzer"] = self.analyzer
+        if self.type == DENSE_VECTOR:
+            out["dims"] = self.dims
+        if not self.index:
+            out["index"] = False
+        return out
+
+
+class MapperParsingException(Exception):
+    pass
+
+
+class MergeMappingException(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Date parsing (ref: common/joda + core/DateFieldMapper)
+# ---------------------------------------------------------------------------
+
+_DATE_PATTERNS = [
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%dT%H:%M", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y/%m/%d",
+    "%Y-%m", "%Y",
+]
+_ISO_DATE_RE = re.compile(r"^\d{4}(-\d{2}(-\d{2}([T ]\d{2}:\d{2}(:\d{2}(\.\d+)?)?(Z|[+-]\d{2}:?\d{2})?)?)?)?$")
+
+
+def parse_date_millis(value: Any) -> int:
+    """Parse a date value into epoch millis (UTC)."""
+    if isinstance(value, bool):
+        raise MapperParsingException(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value)  # epoch_millis
+    s = str(value).strip()
+    if re.fullmatch(r"-?\d{10,}", s):
+        return int(s)
+    z = s.replace("Z", "+0000").replace("z", "+0000")
+    # normalize +hh:mm to +hhmm for strptime
+    z = re.sub(r"([+-]\d{2}):(\d{2})$", r"\1\2", z)
+    for pat in _DATE_PATTERNS:
+        try:
+            dt = _dt.datetime.strptime(z, pat)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise MapperParsingException(f"failed to parse date field [{value}]")
+
+
+def looks_like_date(s: str) -> bool:
+    return bool(_ISO_DATE_RE.match(s.strip()))
+
+
+def format_date_millis(millis: int) -> str:
+    dt = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def parse_ip(value: Any) -> int:
+    """IPv4 dotted-quad -> uint32 (ref: index/mapper/ip/IpFieldMapper.java)."""
+    parts = str(value).split(".")
+    if len(parts) != 4:
+        raise MapperParsingException(f"failed to parse ip [{value}]")
+    n = 0
+    for p in parts:
+        b = int(p)
+        if not 0 <= b <= 255:
+            raise MapperParsingException(f"failed to parse ip [{value}]")
+        n = (n << 8) | b
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Parsed document — the "Lucene Document" analog
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    routing: str | None
+    source: dict
+    # channel -> field -> values
+    tokens: dict[str, list[str]] = dc_field(default_factory=dict)     # text: analyzed tokens
+    keywords: dict[str, list[str]] = dc_field(default_factory=dict)   # keyword: raw values
+    numerics: dict[str, list[float]] = dc_field(default_factory=dict)  # double/float
+    longs: dict[str, list[int]] = dc_field(default_factory=dict)       # long/int/date/ip/bool
+    vectors: dict[str, list[float]] = dc_field(default_factory=dict)   # dense_vector
+    geo: dict[str, tuple[float, float]] = dc_field(default_factory=dict)  # (lat, lon)
+
+
+# ---------------------------------------------------------------------------
+# DocumentMapper
+# ---------------------------------------------------------------------------
+
+_TYPE_ALIASES = {"string": TEXT, "half_float": FLOAT, "scaled_float": DOUBLE}
+
+
+class DocumentMapper:
+    """Parses source documents against a schema; grows it dynamically.
+
+    ref: index/mapper/DocumentMapper.java (parse),
+         index/mapper/object/ObjectMapper.java (dot-path flattening),
+         index/mapper/DocumentMapperParser.java (mapping JSON).
+    """
+
+    def __init__(self, type_name: str, analysis: AnalysisService,
+                 mapping: dict | None = None, dynamic: bool = True,
+                 date_detection: bool = True):
+        self.type_name = type_name
+        self.analysis = analysis
+        self.fields: dict[str, FieldType] = {}
+        self.dynamic = dynamic
+        self.date_detection = date_detection
+        self._mapping_version = 0
+        if mapping:
+            self.merge_mapping(mapping)
+
+    # -- mapping management ------------------------------------------------
+
+    def merge_mapping(self, mapping: dict) -> bool:
+        """Merge a mapping dict ({"properties": {...}}). Returns True if the
+        schema changed. Raises MergeMappingException on type conflicts
+        (ref: MapperService.merge / DocumentMapper.merge)."""
+        props = mapping.get("properties", mapping)
+        if "dynamic" in mapping:
+            dyn = mapping["dynamic"]
+            self.dynamic = dyn is True or str(dyn).lower() == "true"
+        changed = self._merge_props("", props)
+        if changed:
+            self._mapping_version += 1
+        return changed
+
+    def _merge_props(self, prefix: str, props: dict) -> bool:
+        changed = False
+        for name, spec in props.items():
+            if not isinstance(spec, dict):
+                raise MapperParsingException(f"invalid mapping for field [{name}]")
+            path = f"{prefix}{name}"
+            if "properties" in spec and "type" not in spec:
+                changed |= self._merge_props(path + ".", spec["properties"])
+                continue
+            ftype = _TYPE_ALIASES.get(spec.get("type", OBJECT), spec.get("type", OBJECT))
+            if ftype == OBJECT:
+                changed |= self._merge_props(path + ".", spec.get("properties", {}))
+                continue
+            # ES 2.x: {"type": "string", "index": "not_analyzed"} == keyword
+            if ftype == TEXT and spec.get("index") == "not_analyzed":
+                ftype = KEYWORD
+            ft = FieldType(
+                name=path, type=ftype,
+                analyzer=spec.get("analyzer", "standard"),
+                search_analyzer=spec.get("search_analyzer"),
+                index=spec.get("index", True) not in (False, "no", "false"),
+                doc_values=spec.get("doc_values", True),
+                store=spec.get("store", False),
+                dims=int(spec.get("dims", 0)),
+                format=spec.get("format"),
+                boost=float(spec.get("boost", 1.0)),
+            )
+            existing = self.fields.get(path)
+            if existing is None:
+                self.fields[path] = ft
+                changed = True
+            elif existing.type != ft.type:
+                raise MergeMappingException(
+                    f"mapper [{path}] of different type, current_type [{existing.type}], "
+                    f"merged_type [{ft.type}]")
+            # sub-fields ("fields": {"raw": {...}})
+            for sub, subspec in spec.get("fields", {}).items():
+                subpath = f"{path}.{sub}"
+                stype = _TYPE_ALIASES.get(subspec.get("type", KEYWORD), subspec.get("type", KEYWORD))
+                if stype == TEXT and subspec.get("index") == "not_analyzed":
+                    stype = KEYWORD
+                if subpath not in self.fields:
+                    self.fields[subpath] = FieldType(name=subpath, type=stype,
+                                                    analyzer=subspec.get("analyzer", "standard"))
+                    changed = True
+        return changed
+
+    def mapping_dict(self) -> dict:
+        """Render the schema back as a nested mapping dict (GET _mapping)."""
+        root: dict[str, Any] = {}
+        for path, ft in sorted(self.fields.items()):
+            parts = path.split(".")
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = ft.to_dict()
+        return {"properties": root}
+
+    # -- document parsing --------------------------------------------------
+
+    def parse(self, source: dict, doc_id: str, routing: str | None = None) -> ParsedDocument:
+        doc = ParsedDocument(doc_id=doc_id, routing=routing, source=source)
+        new_fields: dict[str, FieldType] = {}
+        self._parse_obj("", source, doc, new_fields)
+        if new_fields:
+            if not self.dynamic:
+                # dynamic=false: unmapped fields are ignored (not indexed)
+                pass
+            else:
+                self.fields.update(new_fields)
+                self._mapping_version += 1
+        # _uid term for realtime get / versioning handled by the engine
+        return doc
+
+    def dynamic_new_fields(self) -> int:
+        return self._mapping_version
+
+    def _parse_obj(self, prefix: str, obj: dict, doc: ParsedDocument,
+                   new_fields: dict[str, FieldType]) -> None:
+        for name, value in obj.items():
+            if value is None:
+                continue
+            path = f"{prefix}{name}"
+            if isinstance(value, dict):
+                ft = self.fields.get(path)
+                if ft is not None and ft.type == GEO_POINT:
+                    self._index_value(ft, value, doc)
+                else:
+                    self._parse_obj(path + ".", value, doc, new_fields)
+                continue
+            ft = self.fields.get(path) or new_fields.get(path)
+            # a list IS the value for vectors and [lon, lat] geo points
+            if isinstance(value, list) and ft is not None and ft.type in (DENSE_VECTOR, GEO_POINT):
+                self._index_value(ft, value, doc)
+                continue
+            values = value if isinstance(value, list) else [value]
+            if not values:
+                continue
+            if ft is None:
+                if not self.dynamic:
+                    continue
+                ft = self._infer_type(path, values[0])
+                if ft is None:
+                    continue
+                new_fields[path] = ft
+                # text fields get a raw keyword sub-field for aggs/sort
+                if ft.type == TEXT:
+                    new_fields[path + ".keyword"] = FieldType(name=path + ".keyword", type=KEYWORD)
+            for v in values:
+                self._index_value(ft, v, doc)
+            if ft.type == TEXT:
+                kw = self.fields.get(path + ".keyword") or new_fields.get(path + ".keyword")
+                if kw is not None:
+                    for v in values:
+                        doc.keywords.setdefault(kw.name, []).append(str(v)[:256])
+
+    def _infer_type(self, path: str, v: Any) -> FieldType | None:
+        """Dynamic type inference (ref: index/mapper/DocumentParser dynamic
+        templates & type guessing)."""
+        if isinstance(v, bool):
+            return FieldType(name=path, type=BOOLEAN)
+        if isinstance(v, int):
+            return FieldType(name=path, type=LONG)
+        if isinstance(v, float):
+            return FieldType(name=path, type=DOUBLE)
+        if isinstance(v, str):
+            if self.date_detection and looks_like_date(v):
+                try:
+                    parse_date_millis(v)
+                    return FieldType(name=path, type=DATE)
+                except MapperParsingException:
+                    pass
+            return FieldType(name=path, type=TEXT)
+        return None
+
+    def _analyzer_for(self, ft: FieldType) -> Analyzer:
+        return self.analysis.analyzer(ft.analyzer)
+
+    def search_analyzer_for(self, field_name: str) -> Analyzer:
+        ft = self.fields.get(field_name)
+        if ft is None or ft.type != TEXT:
+            return self.analysis.analyzer("keyword")
+        return self.analysis.analyzer(ft.search_analyzer or ft.analyzer)
+
+    def _index_value(self, ft: FieldType, v: Any, doc: ParsedDocument) -> None:
+        t = ft.type
+        try:
+            if t == TEXT:
+                doc.tokens.setdefault(ft.name, []).extend(self._analyzer_for(ft)(str(v)))
+            elif t == KEYWORD:
+                doc.keywords.setdefault(ft.name, []).append(str(v))
+            elif t in _INT_TYPES:
+                doc.longs.setdefault(ft.name, []).append(int(v))
+            elif t in _FLOAT_TYPES:
+                doc.numerics.setdefault(ft.name, []).append(float(v))
+            elif t == DATE:
+                doc.longs.setdefault(ft.name, []).append(parse_date_millis(v))
+            elif t == BOOLEAN:
+                b = v if isinstance(v, bool) else str(v).lower() in ("true", "1", "on")
+                doc.longs.setdefault(ft.name, []).append(1 if b else 0)
+            elif t == IP:
+                doc.longs.setdefault(ft.name, []).append(parse_ip(v))
+            elif t == DENSE_VECTOR:
+                vec = [float(x) for x in (v if isinstance(v, list) else [v])]
+                if ft.dims and len(vec) != ft.dims:
+                    raise MapperParsingException(
+                        f"vector length {len(vec)} != dims {ft.dims} for [{ft.name}]")
+                doc.vectors[ft.name] = vec
+            elif t == GEO_POINT:
+                if isinstance(v, dict):
+                    doc.geo[ft.name] = (float(v["lat"]), float(v["lon"]))
+                elif isinstance(v, str):
+                    lat, lon = v.split(",")
+                    doc.geo[ft.name] = (float(lat), float(lon))
+                elif isinstance(v, list) and len(v) == 2:  # [lon, lat] GeoJSON order
+                    doc.geo[ft.name] = (float(v[1]), float(v[0]))
+        except (ValueError, TypeError) as e:
+            raise MapperParsingException(f"failed to parse [{ft.name}]: {e}") from e
+
+    def field_type(self, name: str) -> FieldType | None:
+        return self.fields.get(name)
+
+
+class MapperService:
+    """Per-index registry of DocumentMappers by type name
+    (ref: index/mapper/MapperService.java:993)."""
+
+    def __init__(self, analysis: AnalysisService | None = None,
+                 mappings: dict | None = None, dynamic: bool = True):
+        self.analysis = analysis or AnalysisService()
+        self._mappers: dict[str, DocumentMapper] = {}
+        self.dynamic = dynamic
+        for type_name, mapping in (mappings or {}).items():
+            self._mappers[type_name] = DocumentMapper(
+                type_name, self.analysis, mapping, dynamic=dynamic)
+
+    def document_mapper(self, type_name: str, create: bool = True) -> DocumentMapper | None:
+        m = self._mappers.get(type_name)
+        if m is None and create:
+            m = DocumentMapper(type_name, self.analysis, dynamic=self.dynamic)
+            self._mappers[type_name] = m
+        return m
+
+    def merge(self, type_name: str, mapping: dict) -> bool:
+        return self.document_mapper(type_name).merge_mapping(mapping)
+
+    def types(self) -> list[str]:
+        return list(self._mappers)
+
+    def mappings_dict(self) -> dict:
+        return {t: m.mapping_dict() for t, m in self._mappers.items()}
+
+    def field_type(self, name: str) -> FieldType | None:
+        """Resolve a field across types (types share a field namespace in the
+        reference too)."""
+        for m in self._mappers.values():
+            ft = m.fields.get(name)
+            if ft is not None:
+                return ft
+        return None
+
+    def mapping_version(self) -> int:
+        return sum(m._mapping_version for m in self._mappers.values())

@@ -1,0 +1,22 @@
+"""Test harness: force a virtual 8-device CPU mesh before JAX initializes.
+
+Mirrors the reference's in-JVM multi-node test model (InternalTestCluster,
+/root/reference/src/test/java/org/elasticsearch/test/InternalTestCluster.java:135):
+many "nodes"/devices inside one process, no real cluster needed.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()

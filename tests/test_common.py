@@ -1,0 +1,140 @@
+"""Tests for common/settings, analysis, and mapping layers."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.analysis.analyzers import (
+    AnalysisService, BUILTIN_ANALYZERS, porter_stem, standard_tokenizer,
+)
+from elasticsearch_tpu.mapping.mapper import (
+    DocumentMapper, MapperService, MergeMappingException,
+    parse_date_millis, parse_ip,
+)
+
+
+# --- settings ---------------------------------------------------------------
+
+class TestSettings:
+    def test_flatten_and_get(self):
+        s = Settings({"index": {"number_of_shards": 5, "refresh_interval": "1s"}})
+        assert s.get_int("index.number_of_shards") == 5
+        assert s.get_time("index.refresh_interval") == 1.0
+
+    def test_units(self):
+        s = Settings({"a": "512mb", "b": "30m", "c": "100ms", "d": "2gb"})
+        assert s.get_bytes("a") == 512 * 1024 * 1024
+        assert s.get_time("b") == 1800.0
+        assert s.get_time("c") == pytest.approx(0.1)
+        assert s.get_bytes("d") == 2 << 30
+
+    def test_merge_layers(self):
+        base = Settings({"a": 1, "b": 2})
+        merged = base.merged({"b": 3, "c": 4})
+        assert merged.get_int("a") == 1
+        assert merged.get_int("b") == 3
+        assert merged.get_int("c") == 4
+
+    def test_prefix_and_list(self):
+        s = Settings({"index.analysis.analyzer.my.filter": "lowercase,stop"})
+        sub = s.by_prefix("index.analysis.analyzer.")
+        assert sub.get_list("my.filter") == ["lowercase", "stop"]
+
+    def test_nested_roundtrip(self):
+        s = Settings({"x.y.z": 1, "x.y.w": 2})
+        assert s.as_nested() == {"x": {"y": {"z": 1, "w": 2}}}
+
+
+# --- analysis ---------------------------------------------------------------
+
+class TestAnalysis:
+    def test_standard(self):
+        a = BUILTIN_ANALYZERS["standard"]
+        assert a("The Quick-Brown Fox's fur.") == ["the", "quick", "brown", "fox", "fur"]
+
+    def test_english_stems_and_stops(self):
+        a = BUILTIN_ANALYZERS["english"]
+        assert a("the running dogs") == ["run", "dog"]
+
+    def test_porter(self):
+        assert porter_stem("caresses") == "caress"
+        assert porter_stem("ponies") == "poni"
+        assert porter_stem("relational") == "relat"
+        assert porter_stem("sky") == "sky"
+
+    def test_keyword_whitespace(self):
+        assert BUILTIN_ANALYZERS["keyword"]("Foo Bar") == ["Foo Bar"]
+        assert BUILTIN_ANALYZERS["whitespace"]("Foo  Bar") == ["Foo", "Bar"]
+
+    def test_custom_chain_from_settings(self):
+        svc = AnalysisService({
+            "index.analysis.analyzer.my_html.tokenizer": "whitespace",
+            "index.analysis.analyzer.my_html.filter": "lowercase,unique",
+        })
+        assert svc.analyzer("my_html")("B B a") == ["b", "a"]
+
+    def test_unicode(self):
+        assert standard_tokenizer("café naïve") == ["café", "naïve"]
+
+
+# --- mapping ----------------------------------------------------------------
+
+class TestMapping:
+    def _mapper(self, mapping=None):
+        return DocumentMapper("doc", AnalysisService(), mapping)
+
+    def test_dynamic_inference(self):
+        m = self._mapper()
+        d = m.parse({"title": "Hello World", "count": 3, "score": 1.5,
+                     "ok": True, "ts": "2024-05-01T10:00:00Z"}, doc_id="1")
+        assert d.tokens["title"] == ["hello", "world"]
+        assert d.keywords["title.keyword"] == ["Hello World"]
+        assert d.longs["count"] == [3]
+        assert d.numerics["score"] == [1.5]
+        assert d.longs["ok"] == [1]
+        assert m.fields["ts"].type == "date"
+        assert d.longs["ts"] == [parse_date_millis("2024-05-01T10:00:00Z")]
+
+    def test_explicit_mapping(self):
+        m = self._mapper({"properties": {
+            "tag": {"type": "keyword"},
+            "name": {"type": "string", "index": "not_analyzed"},
+            "body": {"type": "text", "analyzer": "english"},
+            "ip": {"type": "ip"},
+            "emb": {"type": "dense_vector", "dims": 3},
+        }})
+        d = m.parse({"tag": "x", "name": "A B", "body": "running",
+                     "ip": "10.0.0.1", "emb": [1.0, 2.0, 3.0]}, doc_id="1")
+        assert d.keywords["tag"] == ["x"]
+        assert d.keywords["name"] == ["A B"]
+        assert d.tokens["body"] == ["run"]
+        assert d.longs["ip"] == [parse_ip("10.0.0.1")]
+        assert d.vectors["emb"] == [1.0, 2.0, 3.0]
+
+    def test_object_flattening(self):
+        m = self._mapper()
+        d = m.parse({"user": {"name": "kimchy", "age": 3}}, doc_id="1")
+        assert "user.name" in d.tokens
+        assert d.longs["user.age"] == [3]
+
+    def test_merge_conflict(self):
+        m = self._mapper({"properties": {"a": {"type": "long"}}})
+        with pytest.raises(MergeMappingException):
+            m.merge_mapping({"properties": {"a": {"type": "keyword"}}})
+
+    def test_mapping_roundtrip(self):
+        svc = MapperService()
+        svc.merge("doc", {"properties": {"user": {"properties": {"name": {"type": "keyword"}}}}})
+        out = svc.mappings_dict()
+        assert out["doc"]["properties"]["user"]["properties"]["name"]["type"] == "keyword"
+
+    def test_date_parsing(self):
+        assert parse_date_millis("1970-01-01T00:00:00Z") == 0
+        assert parse_date_millis(1234) == 1234
+        assert parse_date_millis("2024-01-01") == parse_date_millis("2024-01-01T00:00:00Z")
+
+    def test_multivalue(self):
+        m = self._mapper()
+        d = m.parse({"tags_kw": ["a", "b"], "n": [1, 2, 3]}, doc_id="1")
+        # dynamic strings analyze; raw values land in .keyword
+        assert d.keywords["tags_kw.keyword"] == ["a", "b"]
+        assert d.longs["n"] == [1, 2, 3]

@@ -1,0 +1,342 @@
+"""Immutable tensor segments — the Lucene-segment analog, resident on device.
+
+A segment is an immutable batch of documents (SURVEY.md §7 core bet):
+  * text field   -> CSR postings tensors (term_offsets host-side, doc_ids/tf
+                    on device) + per-doc field length (norms analog)
+  * keyword      -> ordinal column i32[N] (+ host ord<->value tables) — the
+                    global-ordinals analog (ref index/fielddata/ordinals/)
+  * long/date/ip -> i64 column + missing mask (doc-values analog,
+                    ref index/fielddata/plain/)
+  * double/float -> f64 column + missing mask
+  * dense_vector -> f32[N, dims] matrix for kNN / function_score
+  * _source      -> host-side stored documents (fetch phase is host IO,
+                    like the reference's stored-fields reads)
+  * live         -> tombstone bitmap for deletes (Lucene liveDocs analog)
+
+All device arrays are padded to size buckets (next power of two) so XLA
+compile caches stay small while segments grow (SURVEY.md §7 hard part (e)).
+
+Mutability model mirrors Lucene: segments are write-once; deletes only flip
+the tombstone bitmap; updates are delete+reinsert into a newer segment; merges
+rebuild (index/engine.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Iterable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..mapping.mapper import (
+    ParsedDocument, FieldType, TEXT, KEYWORD, DATE, BOOLEAN, IP,
+    NUMERIC_TYPES, _INT_TYPES, DENSE_VECTOR,
+)
+
+
+def next_pow2(n: int, floor: int = 8) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+def pad_to(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    if arr.shape[0] >= size:
+        return arr
+    pad_shape = (size - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, dtype=arr.dtype)], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Per-field device structures
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TextFieldIndex:
+    """CSR postings for one text field (ref: Lucene postings lists, consumed
+    by ops/bm25.py instead of BulkScorer)."""
+    terms: dict[str, int]            # term -> term id (lexicographic)
+    term_starts: np.ndarray          # i32[V] host: CSR starts
+    term_lens: np.ndarray            # i32[V] host: postings length == df
+    doc_ids: jax.Array               # i32[P_pad] device
+    tf: jax.Array                    # f32[P_pad] device
+    doc_len: jax.Array               # f32[N_pad] device
+    sum_dl: float                    # Σ field length (for avgdl)
+    n_postings: int                  # un-padded P
+
+    def lookup(self, term: str) -> tuple[int, int, int]:
+        """-> (start, length==df, term_id) or (0, 0, -1) if absent."""
+        tid = self.terms.get(term, -1)
+        if tid < 0:
+            return 0, 0, -1
+        return int(self.term_starts[tid]), int(self.term_lens[tid]), tid
+
+    def term_range(self, lo: str | None, hi: str | None,
+                   include_lo=True, include_hi=True, prefix: str | None = None,
+                   limit: int = 1024) -> list[str]:
+        """Terms in lexicographic range / with prefix (wildcard & range-on-text
+        support). Host-side over the sorted term dict."""
+        out = []
+        for t in self.terms:  # insertion order == lexicographic (built sorted)
+            if prefix is not None:
+                if t.startswith(prefix):
+                    out.append(t)
+                elif out:
+                    break
+                continue
+            if lo is not None and (t < lo or (not include_lo and t == lo)):
+                continue
+            if hi is not None and (t > hi or (not include_hi and t == hi)):
+                break
+            out.append(t)
+            if len(out) >= limit:
+                break
+        return out
+
+
+@dataclass
+class KeywordColumn:
+    """Ordinal-encoded keyword column (ref: index/fielddata ordinals)."""
+    ord_map: dict[str, int]          # value -> ordinal (lexicographic)
+    values: list[str]                # ordinal -> value
+    ords: jax.Array                  # i32[N_pad], -1 = missing
+
+    def ord_of(self, value: str) -> int:
+        return self.ord_map.get(value, -1)
+
+
+@dataclass
+class NumericColumn:
+    """Dense numeric doc-values column. i64 for long/date/ip/bool, f64 for
+    double/float (x64 enabled in package __init__; TPU-hot paths cast to f32)."""
+    vals: jax.Array                  # [N_pad]
+    missing: jax.Array               # bool[N_pad]
+    dtype: str                       # "i64" | "f64"
+
+
+@dataclass
+class VectorColumn:
+    vecs: jax.Array                  # f32[N_pad, dims]
+    dims: int
+
+
+# ---------------------------------------------------------------------------
+# Segment
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Segment:
+    seg_id: int
+    n_docs: int                      # real docs (un-padded)
+    n_pad: int
+    text: dict[str, TextFieldIndex]
+    keywords: dict[str, KeywordColumn]
+    numerics: dict[str, NumericColumn]
+    vectors: dict[str, VectorColumn]
+    stored: list[dict]               # host _source per local doc
+    ids: list[str]                   # host _id per local doc
+    types: list[str]                 # host _type per local doc
+    id_to_local: dict[str, int]
+    live_host: np.ndarray            # bool[N_pad] host mirror
+    live: jax.Array = None           # bool[N_pad] device
+    live_count: int = 0
+
+    def __post_init__(self):
+        if self.live is None:
+            self.live = jnp.asarray(self.live_host)
+        if not self.live_count:
+            self.live_count = int(self.live_host[: self.n_docs].sum())
+
+    def delete_local(self, local: int) -> bool:
+        """Flip the tombstone bit. Returns True if the doc was live."""
+        if not self.live_host[local]:
+            return False
+        self.live_host[local] = False
+        self.live = jnp.asarray(self.live_host)
+        self.live_count -= 1
+        return True
+
+    def doc_freq(self, field: str, term: str) -> int:
+        fx = self.text.get(field)
+        if fx is None:
+            return 0
+        return fx.lookup(term)[1]
+
+    def field_stats(self, field: str) -> tuple[float, int]:
+        """(sum_dl, doc_count) for avgdl computation across segments."""
+        fx = self.text.get(field)
+        if fx is None:
+            return 0.0, 0
+        return fx.sum_dl, self.n_docs
+
+    def memory_bytes(self) -> int:
+        total = 0
+        for fx in self.text.values():
+            total += fx.doc_ids.size * 4 + fx.tf.size * 4 + fx.doc_len.size * 4
+        for kc in self.keywords.values():
+            total += kc.ords.size * 4
+        for nc in self.numerics.values():
+            total += nc.vals.size * 8 + nc.missing.size
+        for vc in self.vectors.values():
+            total += vc.vecs.size * 4
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Builder (host-side, numpy)
+# ---------------------------------------------------------------------------
+
+class SegmentBuilder:
+    """Accumulates parsed documents, then freezes them into a Segment.
+
+    The analog of Lucene's IndexWriter in-memory buffer + flush
+    (ref index/engine/InternalEngine.java — IndexWriter.updateDocument), but
+    the "flush" produces dense tensors instead of an on-disk segment.
+    """
+
+    def __init__(self, seg_id: int = 0):
+        self.seg_id = seg_id
+        self._postings: dict[str, dict[str, list]] = {}   # field -> term -> [(doc, tf)]
+        self._doc_len: dict[str, dict[int, float]] = {}   # field -> doc -> len
+        self._keywords: dict[str, dict[int, str]] = {}    # field -> doc -> value (first)
+        self._longs: dict[str, dict[int, int]] = {}
+        self._doubles: dict[str, dict[int, float]] = {}
+        self._vectors: dict[str, dict[int, list[float]]] = {}
+        self._vector_dims: dict[str, int] = {}
+        self.stored: list[dict] = []
+        self.ids: list[str] = []
+        self.types: list[str] = []
+        self.id_to_local: dict[str, int] = {}
+        self.n_docs = 0
+
+    def add(self, doc: ParsedDocument, type_name: str = "_doc") -> int:
+        local = self.n_docs
+        self.n_docs += 1
+        self.stored.append(doc.source)
+        self.ids.append(doc.doc_id)
+        self.types.append(type_name)
+        self.id_to_local[doc.doc_id] = local
+
+        for field, tokens in doc.tokens.items():
+            fld = self._postings.setdefault(field, {})
+            counts: dict[str, int] = {}
+            for t in tokens:
+                counts[t] = counts.get(t, 0) + 1
+            for t, c in counts.items():
+                fld.setdefault(t, []).append((local, c))
+            self._doc_len.setdefault(field, {})[local] = float(len(tokens))
+        for field, vals in doc.keywords.items():
+            if vals:
+                self._keywords.setdefault(field, {})[local] = vals[0]
+        for field, vals in doc.longs.items():
+            if vals:
+                self._longs.setdefault(field, {})[local] = vals[0]
+        for field, vals in doc.numerics.items():
+            if vals:
+                self._doubles.setdefault(field, {})[local] = vals[0]
+        for field, vec in doc.vectors.items():
+            self._vectors.setdefault(field, {})[local] = vec
+            self._vector_dims[field] = len(vec)
+        return local
+
+    def build(self) -> Segment:
+        n = self.n_docs
+        n_pad = next_pow2(n, floor=8)
+
+        text: dict[str, TextFieldIndex] = {}
+        for field, term_map in self._postings.items():
+            terms_sorted = sorted(term_map)
+            term_ids = {t: i for i, t in enumerate(terms_sorted)}
+            lens = np.array([len(term_map[t]) for t in terms_sorted], np.int32)
+            starts = np.zeros(len(terms_sorted), np.int32)
+            if len(lens):
+                starts[1:] = np.cumsum(lens)[:-1]
+            P = int(lens.sum())
+            p_pad = next_pow2(P, floor=8)
+            doc_ids = np.zeros(p_pad, np.int32)
+            tf = np.zeros(p_pad, np.float32)
+            pos = 0
+            for t in terms_sorted:
+                for d, c in term_map[t]:
+                    doc_ids[pos] = d
+                    tf[pos] = c
+                    pos += 1
+            dl_map = self._doc_len.get(field, {})
+            doc_len = np.ones(n_pad, np.float32)  # pad with 1 to avoid div-by-0
+            for d, L in dl_map.items():
+                doc_len[d] = max(L, 1.0)
+            text[field] = TextFieldIndex(
+                terms=term_ids, term_starts=starts, term_lens=lens,
+                doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
+                doc_len=jnp.asarray(doc_len),
+                sum_dl=float(sum(dl_map.values())), n_postings=P)
+
+        keywords: dict[str, KeywordColumn] = {}
+        for field, val_map in self._keywords.items():
+            uniq = sorted(set(val_map.values()))
+            ord_map = {v: i for i, v in enumerate(uniq)}
+            ords = np.full(n_pad, -1, np.int32)
+            for d, v in val_map.items():
+                ords[d] = ord_map[v]
+            keywords[field] = KeywordColumn(ord_map=ord_map, values=uniq,
+                                            ords=jnp.asarray(ords))
+
+        numerics: dict[str, NumericColumn] = {}
+        for field, val_map in self._longs.items():
+            vals = np.zeros(n_pad, np.int64)
+            missing = np.ones(n_pad, bool)
+            for d, v in val_map.items():
+                vals[d] = v
+                missing[d] = False
+            numerics[field] = NumericColumn(jnp.asarray(vals), jnp.asarray(missing), "i64")
+        for field, val_map in self._doubles.items():
+            vals = np.zeros(n_pad, np.float64)
+            missing = np.ones(n_pad, bool)
+            for d, v in val_map.items():
+                vals[d] = v
+                missing[d] = False
+            numerics[field] = NumericColumn(jnp.asarray(vals), jnp.asarray(missing), "f64")
+
+        vectors: dict[str, VectorColumn] = {}
+        for field, vec_map in self._vectors.items():
+            dims = self._vector_dims[field]
+            mat = np.zeros((n_pad, dims), np.float32)
+            for d, v in vec_map.items():
+                mat[d] = v
+            vectors[field] = VectorColumn(jnp.asarray(mat), dims)
+
+        live = np.zeros(n_pad, bool)
+        live[:n] = True
+        return Segment(
+            seg_id=self.seg_id, n_docs=n, n_pad=n_pad, text=text,
+            keywords=keywords, numerics=numerics, vectors=vectors,
+            stored=self.stored, ids=self.ids, types=self.types,
+            id_to_local=dict(self.id_to_local), live_host=live)
+
+
+def merge_segments(segments: list[Segment], new_seg_id: int,
+                   mapper=None) -> Segment:
+    """Merge segments, dropping tombstoned docs — the TieredMergePolicy analog
+    (ref index/merge/; SURVEY.md §7 M1 'background merge = concat/re-sort').
+
+    v1 strategy: replay stored sources through a rebuild. Exact and simple;
+    a device-side concat+re-sort fast path can come later since postings are
+    already sorted tensors.
+    """
+    from ..mapping.mapper import DocumentMapper
+    from ..analysis.analyzers import AnalysisService
+
+    builder = SegmentBuilder(new_seg_id)
+    for seg in segments:
+        for local in range(seg.n_docs):
+            if not seg.live_host[local]:
+                continue
+            src = seg.stored[local]
+            if mapper is not None:
+                parsed = mapper.parse(src, doc_id=seg.ids[local])
+            else:
+                dm = DocumentMapper("_doc", AnalysisService())
+                parsed = dm.parse(src, doc_id=seg.ids[local])
+            builder.add(parsed, seg.types[local])
+    return builder.build()

@@ -1,0 +1,117 @@
+"""Batched BM25 scoring over CSR postings tensors — the device hot loop.
+
+This replaces the reference's per-segment Lucene scoring loop
+(/root/reference/src/main/java/org/elasticsearch/search/query/QueryPhase.java:144-154
+— IndexSearcher.search driving BulkScorer + priority-queue top-k, one doc at a
+time, one query at a time) with a *batched* dense-tensor program: Q queries ×
+one segment's postings are scored in a single XLA computation.
+
+Layout (per text field per segment, built in index/segment.py):
+    doc_ids : i32[P]  postings doc ids, CSR-concatenated per term, sorted per term
+    tf      : f32[P]  term frequency per posting
+    doc_len : f32[N]  field length per doc (Lucene norm analog)
+
+Query batch (host-prepared per segment, see search/query phase):
+    term_starts : i32[Q, T]  CSR start of each query term's postings
+    term_lens   : i32[Q, T]  postings length per term (0 = absent/padding)
+    weights     : f32[Q, T]  idf * boost per term (idf computed host-side from
+                             df like Lucene's TermStatistics; DFS mode feeds
+                             cross-shard stats here, ref search/dfs/DfsPhase.java:57)
+
+The variable-length postings problem (SURVEY.md §7 hard part (a)) is solved by
+flattening each query's postings work into a fixed budget W of gather slots:
+slot p maps to (term t, offset within t) via a row-wise searchsorted over the
+cumulative term lengths — all static shapes, fully vectorized, no host loop.
+
+BM25: score(q,d) = Σ_t w(t) * tf/(tf + k1*(1-b + b*dl/avgdl))
+with w(t) = idf(t) * (k1+1) * boost, matching Lucene's BM25Similarity
+(ref index/similarity/BM25SimilarityProvider.java; defaults k1=1.2, b=0.75).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def postings_slots(term_starts: jax.Array, term_lens: jax.Array, W: int):
+    """Map a flat work budget [0, W) to per-(query, slot) postings indices.
+
+    Returns (idx i32[Q,W] into the postings arrays, t_idx i32[Q,W] which query
+    term each slot belongs to, valid bool[Q,W]).
+    """
+    Q, T = term_starts.shape
+    cum = jnp.cumsum(term_lens, axis=1)                      # [Q,T]
+    total = cum[:, -1:]                                      # [Q,1]
+    p = jnp.arange(W, dtype=jnp.int32)
+    t_idx = jax.vmap(lambda c: jnp.searchsorted(c, p, side="right"))(cum)  # [Q,W]
+    t_idx = jnp.minimum(t_idx, T - 1).astype(jnp.int32)
+    prev = jnp.where(t_idx > 0,
+                     jnp.take_along_axis(cum, jnp.maximum(t_idx - 1, 0), axis=1), 0)
+    starts = jnp.take_along_axis(term_starts, t_idx, axis=1)
+    idx = starts + (p[None, :] - prev)
+    valid = p[None, :] < total
+    return idx, t_idx, valid
+
+
+def bm25_impact(tf: jax.Array, dl: jax.Array, k1: float, b: float, avgdl) -> jax.Array:
+    """Per-posting BM25 impact (everything except idf*(k1+1))."""
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    return tf / (tf + norm)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def bm25_score_batch(doc_ids: jax.Array, tf: jax.Array, doc_len: jax.Array,
+                     term_starts: jax.Array, term_lens: jax.Array,
+                     weights: jax.Array, k1: jax.Array, b: jax.Array,
+                     avgdl: jax.Array, *, W: int, n_pad: int) -> jax.Array:
+    """Score Q queries against one segment: returns scores f32[Q, n_pad].
+
+    Unmatched docs score exactly 0; callers derive the match mask as
+    scores > 0 (valid because BM25 weights and impacts are strictly positive
+    for any present term).
+    """
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]                                       # [Q,W]
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    impact = bm25_impact(tfv, dl, k1, b, avgdl)
+    w = jnp.take_along_axis(weights, t_idx, axis=1)
+    contrib = jnp.where(valid, w * impact, 0.0).astype(jnp.float32)
+    doc = jnp.where(valid, doc, n_pad - 1)                   # park padding on last slot
+    scores = jnp.zeros((Q, n_pad), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], doc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("W",))
+def term_match_mask(doc_ids: jax.Array, term_starts: jax.Array,
+                    term_lens: jax.Array, W: int, n_pad: int) -> jax.Array:
+    """Boolean [Q, n_pad]: does doc contain ANY of the given terms.
+
+    Used for pure-filter term matching on text fields (no scoring).
+    """
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, _, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = jnp.where(valid, doc_ids[idx], n_pad - 1)
+    hits = jnp.zeros((Q, n_pad), jnp.float32)
+    hits = hits.at[jnp.arange(Q, dtype=jnp.int32)[:, None], doc].add(
+        jnp.where(valid, 1.0, 0.0), mode="drop")
+    return hits > 0
+
+
+def idf(doc_freq, doc_count) -> jax.Array:
+    """Lucene BM25 idf: log(1 + (N - df + 0.5) / (df + 0.5))."""
+    df = jnp.asarray(doc_freq, jnp.float32)
+    n = jnp.asarray(doc_count, jnp.float32)
+    return jnp.log(1.0 + (n - df + 0.5) / (df + 0.5))

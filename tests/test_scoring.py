@@ -1,0 +1,142 @@
+"""BM25 kernel + segment tests against a pure-numpy oracle
+(golden-file scoring parity strategy per SURVEY.md §7)."""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisService
+from elasticsearch_tpu.mapping.mapper import DocumentMapper
+from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+from elasticsearch_tpu.ops import bm25, topk
+
+K1, B = 1.2, 0.75
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "a quick brown cat",
+    "the lazy dog sleeps",
+    "brown foxes are quick and brown",
+    "nothing to see here",
+]
+
+
+def oracle_bm25(docs_tokens, query_terms, k1=K1, b=B):
+    """Reference BM25 (Lucene formula) computed doc-at-a-time in python."""
+    n = len(docs_tokens)
+    dls = [max(len(d), 1) for d in docs_tokens]
+    avgdl = sum(len(d) for d in docs_tokens) / n
+    scores = []
+    for toks, dl in zip(docs_tokens, dls):
+        s = 0.0
+        for t in query_terms:
+            tf = toks.count(t)
+            if tf == 0:
+                continue
+            df = sum(1 for d in docs_tokens if t in d)
+            idf = math.log(1 + (n - df + 0.5) / (df + 0.5))
+            s += idf * (k1 + 1) * tf / (tf + k1 * (1 - b + b * dl / avgdl))
+        scores.append(s)
+    return scores
+
+
+@pytest.fixture()
+def segment():
+    mapper = DocumentMapper("doc", AnalysisService())
+    b = SegmentBuilder()
+    for i, text in enumerate(DOCS):
+        b.add(mapper.parse({"body": text, "n": i, "tag": "even" if i % 2 == 0 else "odd"},
+                           doc_id=str(i)))
+    return b.build()
+
+
+def _query_arrays(seg, field, terms_per_query):
+    """Host-side prep: per-query term CSR pointers + BM25 weights."""
+    fx = seg.text[field]
+    T = max(len(t) for t in terms_per_query)
+    Q = len(terms_per_query)
+    starts = np.zeros((Q, T), np.int32)
+    lens = np.zeros((Q, T), np.int32)
+    weights = np.zeros((Q, T), np.float32)
+    n = seg.n_docs
+    for qi, terms in enumerate(terms_per_query):
+        for ti, t in enumerate(terms):
+            s, ln, _ = fx.lookup(t)
+            starts[qi, ti] = s
+            lens[qi, ti] = ln
+            weights[qi, ti] = float(bm25.idf(ln, n)) * (K1 + 1)
+    W = int(max(8, 1 << int(np.ceil(np.log2(max(1, lens.sum(1).max()))))))
+    return jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights), W
+
+
+class TestBM25Kernel:
+    def test_matches_oracle(self, segment):
+        docs_tokens = [d.split() for d in DOCS]
+        queries = [["quick", "brown"], ["lazy"], ["missingterm"], ["the", "dog"]]
+        starts, lens, weights, W = _query_arrays(segment, "body", queries)
+        fx = segment.text["body"]
+        avgdl = fx.sum_dl / segment.n_docs
+        scores = bm25.bm25_score_batch(
+            fx.doc_ids, fx.tf, fx.doc_len, starts, lens, weights,
+            jnp.float32(K1), jnp.float32(B), jnp.float32(avgdl),
+            W=W, n_pad=segment.n_pad)
+        scores = np.asarray(scores)[:, : segment.n_docs]
+        for qi, terms in enumerate(queries):
+            expected = oracle_bm25(docs_tokens, terms)
+            np.testing.assert_allclose(scores[qi], expected, rtol=2e-4, atol=1e-6)
+
+    def test_topk_and_count(self, segment):
+        queries = [["brown"]]
+        starts, lens, weights, W = _query_arrays(segment, "body", queries)
+        fx = segment.text["body"]
+        avgdl = fx.sum_dl / segment.n_docs
+        scores = bm25.bm25_score_batch(
+            fx.doc_ids, fx.tf, fx.doc_len, starts, lens, weights,
+            jnp.float32(K1), jnp.float32(B), jnp.float32(avgdl),
+            W=W, n_pad=segment.n_pad)
+        mask = (scores > 0) & jnp.asarray(segment.live_host)[None, :]
+        assert int(topk.count_matches(mask)[0]) == 3  # docs 0, 1, 3
+        top, idx = topk.topk_scores(scores, mask, k=2)
+        # doc 3 has brown twice -> highest
+        assert int(idx[0, 0]) == 3
+
+    def test_padding_never_matches(self, segment):
+        # padded doc slots must not appear in results
+        queries = [["the"]]
+        starts, lens, weights, W = _query_arrays(segment, "body", queries)
+        fx = segment.text["body"]
+        scores = bm25.bm25_score_batch(
+            fx.doc_ids, fx.tf, fx.doc_len, starts, lens, weights,
+            jnp.float32(K1), jnp.float32(B), jnp.float32(3.0),
+            W=W, n_pad=segment.n_pad)
+        assert np.asarray(scores)[0, segment.n_docs:].sum() == 0
+
+
+class TestSegment:
+    def test_columns(self, segment):
+        assert segment.n_docs == 5
+        nc = segment.numerics["n"]
+        assert np.asarray(nc.vals)[:5].tolist() == [0, 1, 2, 3, 4]
+        kc = segment.keywords["tag.keyword"]
+        assert kc.values == ["even", "odd"]
+        assert np.asarray(kc.ords)[:5].tolist() == [0, 1, 0, 1, 0]
+
+    def test_delete_and_merge(self, segment):
+        assert segment.delete_local(0)
+        assert not segment.delete_local(0)
+        assert segment.live_count == 4
+        merged = merge_segments([segment], new_seg_id=1)
+        assert merged.n_docs == 4
+        assert "0" not in merged.id_to_local
+
+    def test_term_range(self, segment):
+        fx = segment.text["body"]
+        assert fx.term_range(None, None, prefix="qu") == ["quick"]
+        terms = fx.term_range("brown", "dog")
+        assert "brown" in terms and "cat" in terms and "dog" in terms
+
+    def test_doc_freq(self, segment):
+        assert segment.doc_freq("body", "brown") == 3
+        assert segment.doc_freq("body", "zzz") == 0

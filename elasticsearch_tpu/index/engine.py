@@ -1,0 +1,321 @@
+"""Per-shard storage engine: versioned CRUD over immutable tensor segments.
+
+The analog of the reference InternalEngine
+(/root/reference/src/main/java/org/elasticsearch/index/engine/InternalEngine.java:65):
+  * in-memory write buffer (SegmentBuilder) plays IndexWriter's RAM buffer
+  * refresh() freezes the buffer into a device segment — NRT searcher analog
+    (InternalEngine.java:80-83 SearcherManager; default 1s in the reference)
+  * LiveVersionMap for realtime get + optimistic versioning
+    (InternalEngine.java:94,107; version checks :255-270)
+  * every op appended to the translog before ack (InternalEngine.java:331)
+  * flush() = commit: persist segment state + roll/trim translog
+  * tiered-ish merge: many small segments collapse into one (index/merge/)
+
+Single-writer discipline per shard (the reference serializes writes per uid
+via uid-locks; here a shard-level lock since ops are host-side builder
+mutations — device state is only produced at refresh)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..mapping.mapper import MapperService
+from .segment import Segment, SegmentBuilder, merge_segments
+from .translog import Translog
+
+
+class VersionConflictException(Exception):
+    def __init__(self, doc_id: str, current: int, expected: int):
+        super().__init__(
+            f"version conflict for [{doc_id}]: current [{current}], provided [{expected}]")
+        self.current = current
+        self.expected = expected
+
+
+class DocumentMissingException(Exception):
+    pass
+
+
+@dataclass
+class EngineResult:
+    doc_id: str
+    version: int
+    created: bool
+    found: bool = True
+
+
+@dataclass
+class GetResult:
+    found: bool
+    doc_id: str
+    version: int = -1
+    source: dict | None = None
+    type_name: str = "_doc"
+
+
+class Engine:
+    """Versioned, durable per-shard engine over tensor segments."""
+
+    MERGE_SEGMENT_COUNT = 8          # merge trigger (TieredMergePolicy-ish)
+    MAX_BUFFER_DOCS = 65536          # refresh trigger (indexing buffer analog)
+
+    def __init__(self, shard_path: str, mappers: MapperService,
+                 type_name_default: str = "_doc", durability: str = "request"):
+        self.path = shard_path
+        self.mappers = mappers
+        os.makedirs(shard_path, exist_ok=True)
+        self.translog = Translog(os.path.join(shard_path, "translog"), durability)
+        self._lock = threading.RLock()
+        self.segments: list[Segment] = []
+        self._buffer = SegmentBuilder(seg_id=0)
+        self._buffer_docs: dict[str, tuple[dict, str]] = {}   # id -> (source, type)
+        self._next_seg_id = 1
+        # LiveVersionMap: id -> (version, deleted)
+        self.versions: dict[str, tuple[int, bool]] = {}
+        self._dirty = False
+        self.refresh_count = 0
+        self.flush_count = 0
+        self.merge_count = 0
+        self._recover()
+
+    # -- recovery (translog replay, ref InternalEngine recoverFromTranslog) --
+
+    def _recover(self) -> None:
+        n = 0
+        for op in self.translog.snapshot():
+            kind = op["op"]
+            if kind == "index":
+                self._apply_index(op["id"], op["source"], op.get("type", "_doc"),
+                                  version=op["version"])
+            elif kind == "delete":
+                self._apply_delete(op["id"], version=op["version"])
+            n += 1
+        if n:
+            self.refresh()
+
+    # -- version resolution ------------------------------------------------
+
+    def current_version(self, doc_id: str) -> int:
+        """-1 = not found; otherwise the live version."""
+        v = self.versions.get(doc_id)
+        if v is None or v[1]:
+            return -1
+        return v[0]
+
+    def _check_version(self, doc_id: str, version: int | None,
+                       version_type: str, op_type: str) -> int:
+        """Returns the new version; raises VersionConflictException
+        (ref InternalEngine.java:233-339 create/index/delete w/ conflicts)."""
+        cur = self.current_version(doc_id)
+        if op_type == "create" and cur != -1:
+            raise VersionConflictException(doc_id, cur, -1)
+        if version is None or version in (-1, -3):  # MATCH_ANY / internal
+            return cur + 1 if cur > 0 else 1
+        if version_type == "external":
+            if cur != -1 and version <= cur:
+                raise VersionConflictException(doc_id, cur, version)
+            return version
+        # internal: provided version must equal current
+        if cur != version:
+            raise VersionConflictException(doc_id, cur, version)
+        return cur + 1
+
+    # -- write ops ---------------------------------------------------------
+
+    def index(self, doc_id: str, source: dict, type_name: str = "_doc",
+              version: int | None = None, version_type: str = "internal",
+              op_type: str = "index") -> EngineResult:
+        with self._lock:
+            new_version = self._check_version(doc_id, version, version_type, op_type)
+            created = self.current_version(doc_id) == -1
+            self._apply_index(doc_id, source, type_name, new_version)
+            self.translog.add({"op": "index", "id": doc_id, "type": type_name,
+                               "source": source, "version": new_version})
+            self._maybe_refresh_on_size()
+            return EngineResult(doc_id=doc_id, version=new_version, created=created)
+
+    def _apply_index(self, doc_id: str, source: dict, type_name: str,
+                     version: int) -> None:
+        self._delete_everywhere(doc_id)
+        self._buffer_docs[doc_id] = (source, type_name)
+        self.versions[doc_id] = (version, False)
+        self._dirty = True
+
+    def delete(self, doc_id: str, version: int | None = None,
+               version_type: str = "internal") -> EngineResult:
+        with self._lock:
+            cur = self.current_version(doc_id)
+            found = cur != -1
+            new_version = self._check_version(doc_id, version, version_type, "delete") \
+                if found or version is not None else 1
+            self._apply_delete(doc_id, new_version)
+            self.translog.add({"op": "delete", "id": doc_id, "version": new_version})
+            return EngineResult(doc_id=doc_id, version=new_version,
+                                created=False, found=found)
+
+    def _apply_delete(self, doc_id: str, version: int) -> None:
+        self._delete_everywhere(doc_id)
+        self.versions[doc_id] = (version, True)
+        self._dirty = True
+
+    def _delete_everywhere(self, doc_id: str) -> None:
+        self._buffer_docs.pop(doc_id, None)
+        for seg in self.segments:
+            local = seg.id_to_local.get(doc_id)
+            if local is not None:
+                seg.delete_local(local)
+
+    # -- read ops ----------------------------------------------------------
+
+    def get(self, doc_id: str, realtime: bool = True) -> GetResult:
+        """Realtime get: buffer first (translog-analog read,
+        ref index/get/ShardGetService.java:66-99), then segments."""
+        with self._lock:
+            v = self.versions.get(doc_id)
+            if v is None or v[1]:
+                return GetResult(found=False, doc_id=doc_id)
+            version = v[0]
+            if realtime and doc_id in self._buffer_docs:
+                src, tname = self._buffer_docs[doc_id]
+                return GetResult(found=True, doc_id=doc_id, version=version,
+                                 source=src, type_name=tname)
+            for seg in self.segments:
+                local = seg.id_to_local.get(doc_id)
+                if local is not None and seg.live_host[local]:
+                    return GetResult(found=True, doc_id=doc_id, version=version,
+                                     source=seg.stored[local],
+                                     type_name=seg.types[local])
+            if doc_id in self._buffer_docs:   # not yet refreshed, non-realtime miss
+                src, tname = self._buffer_docs[doc_id]
+                return GetResult(found=True, doc_id=doc_id, version=version,
+                                 source=src, type_name=tname)
+            return GetResult(found=False, doc_id=doc_id)
+
+    # -- refresh / flush / merge ------------------------------------------
+
+    def _maybe_refresh_on_size(self) -> None:
+        if len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
+            self.refresh()
+
+    def refresh(self) -> None:
+        """Freeze the write buffer into a new device segment — the NRT
+        'new searcher' event (ref InternalEngine refresh, default 1s)."""
+        with self._lock:
+            if not self._buffer_docs:
+                return
+            builder = SegmentBuilder(seg_id=self._next_seg_id)
+            for doc_id, (source, tname) in self._buffer_docs.items():
+                mapper = self.mappers.document_mapper(tname)
+                parsed = mapper.parse(source, doc_id=doc_id)
+                builder.add(parsed, tname)
+            seg = builder.build()
+            self._next_seg_id += 1
+            self.segments.append(seg)
+            self._buffer_docs.clear()
+            self.refresh_count += 1
+            self._maybe_merge()
+
+    def _maybe_merge(self) -> None:
+        if len(self.segments) < self.MERGE_SEGMENT_COUNT:
+            return
+        self.force_merge(max_num_segments=1)
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        """Merge segments (ref index/merge/ TieredMergePolicy + optimize API)."""
+        with self._lock:
+            if len(self.segments) <= max_num_segments:
+                # may still want to purge deletes
+                if not any(s.live_count < s.n_docs for s in self.segments):
+                    return
+            mapper = self.mappers.document_mapper("_doc")
+            merged = merge_segments(self.segments, self._next_seg_id, mapper)
+            self._next_seg_id += 1
+            self.segments = [merged] if merged.n_docs else []
+            self.merge_count += 1
+
+    def flush(self) -> None:
+        """Commit: make segment state durable, roll + trim translog
+        (ref InternalEngine.flush -> Lucene commit + translog roll)."""
+        with self._lock:
+            self.refresh()
+            gen = self.translog.roll()
+            self._persist_commit()
+            self.translog.trim(gen)
+            self.flush_count += 1
+
+    def _persist_commit(self) -> None:
+        """Persist segments to disk (gateway analog, SURVEY.md §5.4(b)).
+        v1 stores the raw sources + versions; tensors rebuild on recovery —
+        recovery cost traded for simplicity; binary tensor snapshots come with
+        the snapshot/restore subsystem."""
+        import json
+        commit = {
+            "versions": {k: list(v) for k, v in self.versions.items()},
+            "docs": [],
+        }
+        for seg in self.segments:
+            for local in range(seg.n_docs):
+                if seg.live_host[local]:
+                    commit["docs"].append({"id": seg.ids[local],
+                                           "type": seg.types[local],
+                                           "source": seg.stored[local]})
+        tmp = os.path.join(self.path, "commit.json.tmp")
+        final = os.path.join(self.path, "commit.json")
+        with open(tmp, "w") as f:
+            json.dump(commit, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    @staticmethod
+    def open_committed(shard_path: str, mappers: MapperService, **kw) -> "Engine":
+        """Recover an engine: committed state + translog replay on top."""
+        import json
+        eng = Engine.__new__(Engine)
+        eng.path = shard_path
+        eng.mappers = mappers
+        os.makedirs(shard_path, exist_ok=True)
+        eng._lock = threading.RLock()
+        eng.segments = []
+        eng._buffer = SegmentBuilder(seg_id=0)
+        eng._buffer_docs = {}
+        eng._next_seg_id = 1
+        eng.versions = {}
+        eng._dirty = False
+        eng.refresh_count = 0
+        eng.flush_count = 0
+        eng.merge_count = 0
+        commit_path = os.path.join(shard_path, "commit.json")
+        if os.path.exists(commit_path):
+            with open(commit_path) as f:
+                commit = json.load(f)
+            for d in commit["docs"]:
+                eng._buffer_docs[d["id"]] = (d["source"], d["type"])
+            eng.versions = {k: (v[0], v[1]) for k, v in commit["versions"].items()}
+        eng.translog = Translog(os.path.join(shard_path, "translog"),
+                                kw.get("durability", "request"))
+        eng._recover()
+        eng.refresh()
+        return eng
+
+    # -- stats / introspection --------------------------------------------
+
+    def doc_count(self) -> int:
+        with self._lock:
+            return sum(s.live_count for s in self.segments) + len(self._buffer_docs)
+
+    def segment_stats(self) -> dict:
+        return {"count": len(self.segments),
+                "docs": sum(s.live_count for s in self.segments),
+                "deleted": sum(s.n_docs - s.live_count for s in self.segments),
+                "memory_in_bytes": sum(s.memory_bytes() for s in self.segments),
+                "buffered_docs": len(self._buffer_docs)}
+
+    def close(self) -> None:
+        self.translog.close()

@@ -1,0 +1,123 @@
+"""Per-shard write-ahead log with checksummed records.
+
+The analog of the reference translog
+(/root/reference/src/main/java/org/elasticsearch/index/translog/Translog.java:106,
+fs/FsTranslog.java, ChecksummedTranslogStream.java): every engine operation is
+appended (and optionally fsynced) before it is acknowledged; a crash replays
+the log into a fresh engine (SURVEY.md §5.4(a)).
+
+Record format (binary, little-endian):
+    u32 length | u32 crc32(payload) | payload (JSON utf-8)
+
+Generations: `translog-N.log`. A commit ("flush" in ES terms) rolls to a new
+generation and deletes the old ones once segment state is durable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Iterator
+
+_HEADER = struct.Struct("<II")
+
+
+class TranslogCorruptedException(Exception):
+    pass
+
+
+class Translog:
+    def __init__(self, directory: str, durability: str = "request"):
+        """durability: 'request' = fsync every op (ES 'request'),
+        'async' = fsync on flush/interval only."""
+        self.dir = directory
+        self.durability = durability
+        os.makedirs(directory, exist_ok=True)
+        self.generation = self._latest_generation()
+        self._file = open(self._path(self.generation), "ab")
+        self.ops_since_commit = 0
+        self.size_bytes = self._file.tell()
+
+    def _path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"translog-{gen}.log")
+
+    def _latest_generation(self) -> int:
+        gens = [int(f.split("-")[1].split(".")[0])
+                for f in os.listdir(self.dir)
+                if f.startswith("translog-") and f.endswith(".log")]
+        return max(gens, default=0)
+
+    # -- write path --------------------------------------------------------
+
+    def add(self, op: dict[str, Any]) -> int:
+        """Append one operation; returns its location offset
+        (ref Translog.java add -> Location)."""
+        payload = json.dumps(op, separators=(",", ":")).encode("utf-8")
+        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        loc = self._file.tell()
+        self._file.write(rec)
+        if self.durability == "request":
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        self.ops_since_commit += 1
+        self.size_bytes = loc + len(rec)
+        return loc
+
+    def sync(self) -> None:
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
+    # -- recovery / commit -------------------------------------------------
+
+    def snapshot(self, from_generation: int = 0) -> Iterator[dict]:
+        """Replay all ops from all live generations (ref Translog.snapshot)."""
+        self._file.flush()
+        for gen in sorted(self._generations()):
+            if gen < from_generation:
+                continue
+            with open(self._path(gen), "rb") as f:
+                while True:
+                    head = f.read(_HEADER.size)
+                    if not head:
+                        break
+                    if len(head) < _HEADER.size:
+                        raise TranslogCorruptedException("truncated record header")
+                    length, crc = _HEADER.unpack(head)
+                    payload = f.read(length)
+                    if len(payload) < length:
+                        raise TranslogCorruptedException("truncated record payload")
+                    if zlib.crc32(payload) != crc:
+                        raise TranslogCorruptedException("checksum mismatch")
+                    yield json.loads(payload.decode("utf-8"))
+
+    def _generations(self) -> list[int]:
+        return [int(f.split("-")[1].split(".")[0])
+                for f in os.listdir(self.dir)
+                if f.startswith("translog-") and f.endswith(".log")]
+
+    def roll(self) -> int:
+        """Start a new generation (called at commit start); old generations
+        stay until `trim` confirms the commit is durable."""
+        self.sync()
+        self._file.close()
+        self.generation += 1
+        self._file = open(self._path(self.generation), "ab")
+        self.ops_since_commit = 0
+        return self.generation
+
+    def trim(self, below_generation: int) -> None:
+        """Delete generations < below_generation after a durable commit."""
+        for gen in self._generations():
+            if gen < below_generation:
+                os.remove(self._path(gen))
+
+    def close(self) -> None:
+        self.sync()
+        self._file.close()
+
+    def stats(self) -> dict:
+        return {"operations": self.ops_since_commit,
+                "size_in_bytes": self.size_bytes,
+                "generation": self.generation}

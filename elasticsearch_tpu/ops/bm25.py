@@ -92,7 +92,7 @@ def bm25_score_batch(doc_ids: jax.Array, tf: jax.Array, doc_len: jax.Array,
     return scores
 
 
-@functools.partial(jax.jit, static_argnames=("W",))
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
 def term_match_mask(doc_ids: jax.Array, term_starts: jax.Array,
                     term_lens: jax.Array, W: int, n_pad: int) -> jax.Array:
     """Boolean [Q, n_pad]: does doc contain ANY of the given terms.

@@ -1,0 +1,655 @@
+"""Query DSL → executable device programs.
+
+The analog of the reference query-compilation layer
+(/root/reference/src/main/java/org/elasticsearch/index/query/ — 157 files of
+*Parser classes compiling XContent to Lucene Query objects, entry point
+IndexQueryParserService.java). Here a query dict compiles to a small AST of
+`Node`s; each node, traced under jit, produces for one segment:
+
+    scores : f32[Q, n_pad]   (0 where unmatched)
+    match  : bool[Q, n_pad]
+
+so an entire query tree — including bool combinations and filters — fuses into
+ONE XLA program per segment, batched over Q queries that share the tree shape.
+
+Supported (ref parser in parentheses):
+  match, match_all, term, terms, range (text/keyword/numeric/date), bool
+  (must/should/must_not/filter + minimum_should_match), exists, ids,
+  prefix, wildcard, fuzzy (term expansion), match_phrase (post-filtered),
+  constant_score, function_score (field_value_factor / script cosine /
+  random_score / weight), query_string (simplified), dis_max, boosting.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..index.segment import Segment
+from ..ops import bm25
+
+
+class QueryParsingException(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Execution context: per-segment, per-batch device inputs
+# ---------------------------------------------------------------------------
+
+class SegmentContext:
+    """Binds a compiled query batch to one segment: holds host-prepared
+    device inputs (term pointers, ordinals, constants) and shared stats."""
+
+    def __init__(self, segment: Segment, n_queries: int, stats: "CollectionStats"):
+        self.segment = segment
+        self.Q = n_queries
+        self.stats = stats
+
+    @property
+    def n_pad(self) -> int:
+        return self.segment.n_pad
+
+
+class CollectionStats:
+    """Corpus-wide term/field statistics used for idf/avgdl — the analog of
+    Lucene CollectionStatistics/TermStatistics. For a single shard these come
+    from its segments; the DFS phase (ref search/dfs/DfsPhase.java:57-81)
+    all-reduces them across shards before scoring."""
+
+    def __init__(self, doc_count: int, field_sum_dl: dict[str, float],
+                 doc_freqs: dict[tuple[str, str], int]):
+        self.doc_count = max(doc_count, 1)
+        self.field_sum_dl = field_sum_dl
+        self.doc_freqs = doc_freqs
+
+    def avgdl(self, field: str) -> float:
+        return max(self.field_sum_dl.get(field, 0.0), 1.0) / self.doc_count
+
+    def df(self, field: str, term: str) -> int:
+        return self.doc_freqs.get((field, term), 0)
+
+    @staticmethod
+    def from_segments(segments: Sequence[Segment],
+                      terms_by_field: dict[str, set[str]]) -> "CollectionStats":
+        doc_count = sum(s.n_docs for s in segments)
+        sum_dl: dict[str, float] = {}
+        dfs: dict[tuple[str, str], int] = {}
+        for seg in segments:
+            for f, fx in seg.text.items():
+                sum_dl[f] = sum_dl.get(f, 0.0) + fx.sum_dl
+        for f, terms in terms_by_field.items():
+            for t in terms:
+                dfs[(f, t)] = sum(seg.doc_freq(f, t) for seg in segments)
+        return CollectionStats(doc_count, sum_dl, dfs)
+
+
+# ---------------------------------------------------------------------------
+# AST nodes
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Node:
+    boost: float = 1.0
+
+    def collect_terms(self, out: dict[str, set[str]]) -> None:
+        """Gather (field, term) pairs so CollectionStats can be prefetched."""
+
+    def execute(self, ctx: SegmentContext):
+        """-> (scores f32[Q, n_pad], match bool[Q, n_pad]); traced under jit."""
+        raise NotImplementedError
+
+    def plan_key(self) -> tuple:
+        """Static structure key for the jit compile cache."""
+        raise NotImplementedError
+
+
+def _zeros(ctx: SegmentContext):
+    return jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32)
+
+
+def _false(ctx: SegmentContext):
+    return jnp.zeros((ctx.Q, ctx.n_pad), bool)
+
+
+def _true(ctx: SegmentContext):
+    return jnp.ones((ctx.Q, ctx.n_pad), bool)
+
+
+@dataclass
+class MatchAllNode(Node):
+    def execute(self, ctx):
+        return jnp.full((ctx.Q, ctx.n_pad), self.boost, jnp.float32), _true(ctx)
+
+    def plan_key(self):
+        return ("match_all",)
+
+
+@dataclass
+class MatchNoneNode(Node):
+    def execute(self, ctx):
+        return _zeros(ctx), _false(ctx)
+
+    def plan_key(self):
+        return ("match_none",)
+
+
+@dataclass
+class MatchNode(Node):
+    """match / multi-term scored query over a text field. Each batch row may
+    carry different terms (that's what [Q, T] pointers are for)."""
+    field_name: str = ""
+    terms_per_query: list[list[str]] = dc_field(default_factory=list)
+    operator: str = "or"             # or | and
+    minimum_should_match: int = 0    # 0 = default by operator
+    k1: float = 1.2
+    b: float = 0.75
+
+    def collect_terms(self, out):
+        s = out.setdefault(self.field_name, set())
+        for terms in self.terms_per_query:
+            s.update(terms)
+
+    def _host_arrays(self, ctx: SegmentContext):
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        T = max((len(t) for t in self.terms_per_query), default=1) or 1
+        Q = ctx.Q
+        starts = np.zeros((Q, T), np.int32)
+        lens = np.zeros((Q, T), np.int32)
+        weights = np.zeros((Q, T), np.float32)
+        n_terms = np.zeros((Q,), np.int32)
+        for qi, terms in enumerate(self.terms_per_query):
+            n_terms[qi] = len(terms)
+            for ti, t in enumerate(terms):
+                df = ctx.stats.df(self.field_name, t)
+                if fx is not None:
+                    s, ln, _ = fx.lookup(t)
+                else:
+                    s, ln = 0, 0
+                starts[qi, ti] = s
+                lens[qi, ti] = ln
+                if df > 0:
+                    w = math.log(1 + (ctx.stats.doc_count - df + 0.5) / (df + 0.5))
+                    weights[qi, ti] = w * (self.k1 + 1) * self.boost
+        return starts, lens, weights, n_terms
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        if fx is None:
+            return _zeros(ctx), _false(ctx)
+        starts, lens, weights, n_terms = self._host_arrays(ctx)
+        W = int(max(8, 1 << int(np.ceil(np.log2(max(1, int(lens.sum(1).max())))))))
+        avgdl = ctx.stats.avgdl(self.field_name)
+        scores = bm25.bm25_score_batch(
+            fx.doc_ids, fx.tf, fx.doc_len,
+            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights),
+            jnp.float32(self.k1), jnp.float32(self.b), jnp.float32(avgdl),
+            W=W, n_pad=ctx.n_pad)
+        if self.operator == "and" or self.minimum_should_match > 1:
+            # count distinct matching terms per doc: reuse kernel with weight=1, tf→1
+            need = np.maximum(self.minimum_should_match, 1) if self.operator != "and" else n_terms
+            ones = np.ones_like(weights)
+            counts = bm25.bm25_score_batch(
+                fx.doc_ids, jnp.ones_like(fx.tf), jnp.full_like(fx.doc_len, 1.0),
+                jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(ones),
+                jnp.float32(0.0), jnp.float32(0.0), jnp.float32(1.0),
+                W=W, n_pad=ctx.n_pad)
+            # with k1=0 impact = tf/tf = 1 per posting -> counts = #matching terms
+            need_arr = jnp.asarray(np.broadcast_to(np.asarray(need, np.float32),
+                                                   (ctx.Q,)))[:, None]
+            match = counts >= jnp.maximum(need_arr, 1.0)
+        else:
+            match = scores > 0
+        return jnp.where(match, scores, 0.0), match
+
+    def plan_key(self):
+        return ("match", self.field_name, self.operator, self.minimum_should_match)
+
+
+@dataclass
+class TermFilterNode(Node):
+    """Exact term on keyword/numeric/boolean columns -> constant score.
+    (ref index/query/TermQueryParser.java + TermFilterParser.java)"""
+    field_name: str = ""
+    values_per_query: list[list[Any]] = dc_field(default_factory=list)  # OR within a row
+
+    def collect_terms(self, out):
+        pass
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        Q = ctx.Q
+        V = max((len(v) for v in self.values_per_query), default=1) or 1
+        kc = seg.keywords.get(self.field_name)
+        nc = seg.numerics.get(self.field_name)
+        if kc is not None:
+            targets = np.full((Q, V), -2, np.int64)
+            for qi, vals in enumerate(self.values_per_query):
+                for vi, v in enumerate(vals):
+                    targets[qi, vi] = kc.ord_of(str(v))
+            col = kc.ords.astype(jnp.int64)
+        elif nc is not None:
+            targets = np.full((Q, V), np.iinfo(np.int64).min, np.int64)
+            for qi, vals in enumerate(self.values_per_query):
+                for vi, v in enumerate(vals):
+                    targets[qi, vi] = _coerce_to_column(v, nc)
+            col = nc.vals if nc.dtype == "i64" else nc.vals  # compared in own dtype below
+            if nc.dtype == "f64":
+                tf64 = np.full((Q, V), np.nan)
+                for qi, vals in enumerate(self.values_per_query):
+                    for vi, v in enumerate(vals):
+                        tf64[qi, vi] = float(v)
+                match = (nc.vals[None, None, :] == jnp.asarray(tf64)[:, :, None]).any(1)
+                match = match & ~seg.numerics[self.field_name].missing[None, :]
+                return jnp.where(match, self.boost, 0.0), match
+        else:
+            # fall back to text postings (term query on analyzed field)
+            fx = seg.text.get(self.field_name)
+            if fx is None:
+                return _zeros(ctx), _false(ctx)
+            node = MatchNode(boost=self.boost, field_name=self.field_name,
+                             terms_per_query=[[str(v) for v in vals]
+                                              for vals in self.values_per_query])
+            return node.execute(ctx)
+        match = (col[None, None, :] == jnp.asarray(targets)[:, :, None]).any(axis=1)
+        if nc is not None:
+            match = match & ~nc.missing[None, :]
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("term", self.field_name)
+
+
+def _coerce_to_column(v: Any, nc) -> int:
+    if isinstance(v, bool):
+        return 1 if v else 0
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return np.iinfo(np.int64).min
+
+
+@dataclass
+class RangeNode(Node):
+    """Range on numeric/date/keyword columns
+    (ref index/query/RangeQueryParser.java)."""
+    field_name: str = ""
+    # per query: (lo, hi, include_lo, include_hi); None = unbounded
+    bounds_per_query: list[tuple] = dc_field(default_factory=list)
+    is_date: bool = False
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        nc = seg.numerics.get(self.field_name)
+        kc = seg.keywords.get(self.field_name)
+        Q = ctx.Q
+        if nc is not None:
+            if nc.dtype == "i64":
+                lo_fill, hi_fill = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+                dt = np.int64
+            else:
+                lo_fill, hi_fill = -np.inf, np.inf
+                dt = np.float64
+            los = np.full(Q, lo_fill, dt)
+            his = np.full(Q, hi_fill, dt)
+            for qi, (lo, hi, inc_lo, inc_hi) in enumerate(self.bounds_per_query):
+                if lo is not None:
+                    los[qi] = lo if inc_lo else _next_up(lo, dt)
+                if hi is not None:
+                    his[qi] = hi if inc_hi else _next_down(hi, dt)
+            vals = nc.vals
+            match = (vals[None, :] >= jnp.asarray(los)[:, None]) & \
+                    (vals[None, :] <= jnp.asarray(his)[:, None]) & ~nc.missing[None, :]
+            return jnp.where(match, jnp.float32(self.boost), 0.0), match
+        if kc is not None:
+            # lexicographic range via ordinal bounds (ords are sorted by value)
+            los = np.zeros(Q, np.int32)
+            his = np.full(Q, len(kc.values) - 1, np.int32)
+            for qi, (lo, hi, inc_lo, inc_hi) in enumerate(self.bounds_per_query):
+                if lo is not None:
+                    i = _bisect(kc.values, str(lo), left=True)
+                    if not inc_lo and i < len(kc.values) and kc.values[i] == str(lo):
+                        i += 1
+                    los[qi] = i
+                if hi is not None:
+                    i = _bisect(kc.values, str(hi), left=False) - 1
+                    if not inc_hi and i >= 0 and kc.values[i] == str(hi):
+                        i -= 1
+                    his[qi] = i
+            ords = kc.ords
+            match = (ords[None, :] >= jnp.asarray(los)[:, None]) & \
+                    (ords[None, :] <= jnp.asarray(his)[:, None]) & (ords[None, :] >= 0)
+            return jnp.where(match, jnp.float32(self.boost), 0.0), match
+        return _zeros(ctx), _false(ctx)
+
+    def plan_key(self):
+        return ("range", self.field_name)
+
+
+def _next_up(v, dt):
+    return v + 1 if dt == np.int64 else np.nextafter(v, np.inf)
+
+
+def _next_down(v, dt):
+    return v - 1 if dt == np.int64 else np.nextafter(v, -np.inf)
+
+
+def _bisect(values: list[str], x: str, left: bool) -> int:
+    import bisect
+    return bisect.bisect_left(values, x) if left else bisect.bisect_right(values, x)
+
+
+@dataclass
+class ExistsNode(Node):
+    field_name: str = ""
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        nc = seg.numerics.get(self.field_name)
+        kc = seg.keywords.get(self.field_name)
+        fx = seg.text.get(self.field_name)
+        if nc is not None:
+            match = jnp.broadcast_to(~nc.missing[None, :], (ctx.Q, ctx.n_pad))
+        elif kc is not None:
+            match = jnp.broadcast_to(kc.ords[None, :] >= 0, (ctx.Q, ctx.n_pad))
+        elif fx is not None:
+            match = jnp.broadcast_to((fx.doc_len > 1.0)[None, :] |
+                                     (fx.doc_len == 1.0)[None, :], (ctx.Q, ctx.n_pad))
+            # doc_len defaults to 1 for absent docs — approximate via postings presence
+            hits = bm25.term_match_mask(
+                fx.doc_ids,
+                jnp.zeros((1, 1), jnp.int32),
+                jnp.asarray([[fx.n_postings]], jnp.int32),
+                W=max(8, 1 << (max(fx.n_postings, 1) - 1).bit_length()),
+                n_pad=ctx.n_pad)
+            match = jnp.broadcast_to(hits, (ctx.Q, ctx.n_pad))
+        else:
+            return _zeros(ctx), _false(ctx)
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("exists", self.field_name)
+
+
+@dataclass
+class IdsNode(Node):
+    ids_per_query: list[list[str]] = dc_field(default_factory=list)
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        Q = ctx.Q
+        mask = np.zeros((Q, ctx.n_pad), bool)
+        for qi, ids in enumerate(self.ids_per_query):
+            for i in ids:
+                local = seg.id_to_local.get(i)
+                if local is not None:
+                    mask[qi, local] = True
+        match = jnp.asarray(mask)
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("ids",)
+
+
+@dataclass
+class BoolNode(Node):
+    """bool query (ref index/query/BoolQueryParser.java): scores sum over
+    scoring clauses; match follows Lucene semantics incl. filter context and
+    minimum_should_match."""
+    must: list[Node] = dc_field(default_factory=list)
+    should: list[Node] = dc_field(default_factory=list)
+    must_not: list[Node] = dc_field(default_factory=list)
+    filter: list[Node] = dc_field(default_factory=list)
+    minimum_should_match: int | None = None
+
+    def collect_terms(self, out):
+        for n in self.must + self.should + self.must_not + self.filter:
+            n.collect_terms(out)
+
+    def execute(self, ctx):
+        scores = _zeros(ctx)
+        match = _true(ctx)
+        any_positive = bool(self.must or self.filter)
+        for n in self.must:
+            s, m = n.execute(ctx)
+            scores = scores + s
+            match = match & m
+        for n in self.filter:
+            _, m = n.execute(ctx)
+            match = match & m
+        if self.should:
+            msm = self.minimum_should_match
+            if msm is None:
+                msm = 0 if any_positive else 1
+            should_count = jnp.zeros((ctx.Q, ctx.n_pad), jnp.int32)
+            for n in self.should:
+                s, m = n.execute(ctx)
+                scores = scores + jnp.where(m, s, 0.0)
+                should_count = should_count + m.astype(jnp.int32)
+            if msm > 0:
+                match = match & (should_count >= msm)
+        for n in self.must_not:
+            _, m = n.execute(ctx)
+            match = match & ~m
+        scores = jnp.where(match, scores * self.boost, 0.0)
+        return scores, match
+
+    def plan_key(self):
+        return ("bool",
+                tuple(n.plan_key() for n in self.must),
+                tuple(n.plan_key() for n in self.should),
+                tuple(n.plan_key() for n in self.must_not),
+                tuple(n.plan_key() for n in self.filter),
+                self.minimum_should_match)
+
+
+@dataclass
+class ConstantScoreNode(Node):
+    inner: Node | None = None
+
+    def collect_terms(self, out):
+        self.inner.collect_terms(out)
+
+    def execute(self, ctx):
+        _, m = self.inner.execute(ctx)
+        return jnp.where(m, jnp.float32(self.boost), 0.0), m
+
+    def plan_key(self):
+        return ("constant_score", self.inner.plan_key())
+
+
+@dataclass
+class DisMaxNode(Node):
+    queries: list[Node] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+    def collect_terms(self, out):
+        for n in self.queries:
+            n.collect_terms(out)
+
+    def execute(self, ctx):
+        best = _zeros(ctx)
+        total = _zeros(ctx)
+        match = _false(ctx)
+        for n in self.queries:
+            s, m = n.execute(ctx)
+            s = jnp.where(m, s, 0.0)
+            best = jnp.maximum(best, s)
+            total = total + s
+            match = match | m
+        scores = best + self.tie_breaker * (total - best)
+        return jnp.where(match, scores * self.boost, 0.0), match
+
+    def plan_key(self):
+        return ("dis_max", tuple(n.plan_key() for n in self.queries), self.tie_breaker)
+
+
+@dataclass
+class BoostingNode(Node):
+    positive: Node | None = None
+    negative: Node | None = None
+    negative_boost: float = 0.5
+
+    def collect_terms(self, out):
+        self.positive.collect_terms(out)
+        self.negative.collect_terms(out)
+
+    def execute(self, ctx):
+        s, m = self.positive.execute(ctx)
+        _, nm = self.negative.execute(ctx)
+        s = jnp.where(nm, s * self.negative_boost, s)
+        return jnp.where(m, s * self.boost, 0.0), m
+
+    def plan_key(self):
+        return ("boosting", self.positive.plan_key(), self.negative.plan_key())
+
+
+@dataclass
+class FunctionScoreNode(Node):
+    """function_score (ref index/query/functionscore/FunctionScoreQueryParser.java):
+    combines the inner query score with value functions."""
+    inner: Node | None = None
+    functions: list[dict] = dc_field(default_factory=list)   # parsed specs
+    score_mode: str = "multiply"   # multiply | sum | avg | max | min | first
+    boost_mode: str = "multiply"   # multiply | sum | replace | avg | max | min
+
+    def collect_terms(self, out):
+        self.inner.collect_terms(out)
+
+    def _function_values(self, ctx: SegmentContext, spec: dict) -> jax.Array:
+        seg = ctx.segment
+        if "field_value_factor" in spec:
+            p = spec["field_value_factor"]
+            fname = p["field"]
+            nc = seg.numerics.get(fname)
+            if nc is None:
+                vals = jnp.zeros((ctx.n_pad,), jnp.float32)
+            else:
+                vals = nc.vals.astype(jnp.float32)
+                vals = jnp.where(nc.missing, jnp.float32(p.get("missing", 1.0)), vals)
+            factor = float(p.get("factor", 1.0))
+            vals = vals * factor
+            mod = p.get("modifier", "none")
+            if mod == "log":
+                vals = jnp.log10(jnp.maximum(vals, 1e-9))
+            elif mod == "log1p":
+                vals = jnp.log10(1.0 + jnp.maximum(vals, 0.0))
+            elif mod == "log2p":
+                vals = jnp.log10(2.0 + jnp.maximum(vals, 0.0))
+            elif mod == "ln":
+                vals = jnp.log(jnp.maximum(vals, 1e-9))
+            elif mod == "ln1p":
+                vals = jnp.log1p(jnp.maximum(vals, 0.0))
+            elif mod == "ln2p":
+                vals = jnp.log(2.0 + jnp.maximum(vals, 0.0))
+            elif mod == "square":
+                vals = vals * vals
+            elif mod == "sqrt":
+                vals = jnp.sqrt(jnp.maximum(vals, 0.0))
+            elif mod == "reciprocal":
+                vals = 1.0 / jnp.maximum(vals, 1e-9)
+            return jnp.broadcast_to(vals[None, :], (ctx.Q, ctx.n_pad))
+        if "random_score" in spec:
+            seed = int(spec["random_score"].get("seed", 42))
+            key = jax.random.PRNGKey(seed + seg.seg_id)
+            vals = jax.random.uniform(key, (ctx.n_pad,), jnp.float32)
+            return jnp.broadcast_to(vals[None, :], (ctx.Q, ctx.n_pad))
+        if "cosine" in spec or "script_score" in spec:
+            # vector similarity: {"cosine": {"field": f, "query_vectors": [[...]xQ]}}
+            p = spec.get("cosine") or spec.get("script_score")
+            fname = p["field"]
+            vc = seg.vectors.get(fname)
+            if vc is None:
+                return jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32)
+            qv = jnp.asarray(np.asarray(p["query_vectors"], np.float32))  # [Q, D]
+            sims = _cosine_scores(vc.vecs, qv)
+            return sims
+        if "weight" in spec and len(spec) == 1:
+            return jnp.full((ctx.Q, ctx.n_pad), float(spec["weight"]), jnp.float32)
+        if "decay" in spec:
+            p = spec["decay"]  # {"function": gauss|exp|linear, "field","origin","scale","decay","offset"}
+            nc = seg.numerics.get(p["field"])
+            if nc is None:
+                return jnp.ones((ctx.Q, ctx.n_pad), jnp.float32)
+            vals = nc.vals.astype(jnp.float32)
+            origin = float(p["origin"])
+            scale = float(p["scale"])
+            decay = float(p.get("decay", 0.5))
+            offset = float(p.get("offset", 0.0))
+            dist = jnp.maximum(jnp.abs(vals - origin) - offset, 0.0)
+            kind = p.get("function", "gauss")
+            if kind == "gauss":
+                sigma2 = -(scale ** 2) / (2.0 * math.log(decay))
+                out = jnp.exp(-(dist ** 2) / (2.0 * sigma2))
+            elif kind == "exp":
+                lam = math.log(decay) / scale
+                out = jnp.exp(lam * dist)
+            else:  # linear
+                s = scale / (1.0 - decay)
+                out = jnp.maximum((s - dist) / s, 0.0)
+            out = jnp.where(nc.missing, 1.0, out)
+            return jnp.broadcast_to(out[None, :], (ctx.Q, ctx.n_pad))
+        raise QueryParsingException(f"unsupported function_score function: {list(spec)}")
+
+    def execute(self, ctx):
+        s, m = self.inner.execute(ctx)
+        if not self.functions:
+            return s, m
+        fvals = []
+        for spec in self.functions:
+            v = self._function_values(ctx, spec)
+            w = float(spec.get("weight", 1.0)) if "weight" in spec and len(spec) > 1 else 1.0
+            fvals.append(v * w)
+        if self.score_mode == "multiply":
+            fv = fvals[0]
+            for v in fvals[1:]:
+                fv = fv * v
+        elif self.score_mode == "sum":
+            fv = sum(fvals)
+        elif self.score_mode == "avg":
+            fv = sum(fvals) / len(fvals)
+        elif self.score_mode == "max":
+            fv = fvals[0]
+            for v in fvals[1:]:
+                fv = jnp.maximum(fv, v)
+        elif self.score_mode == "min":
+            fv = fvals[0]
+            for v in fvals[1:]:
+                fv = jnp.minimum(fv, v)
+        else:  # first
+            fv = fvals[0]
+        bm = self.boost_mode
+        if bm == "multiply":
+            out = s * fv
+        elif bm == "sum":
+            out = s + fv
+        elif bm == "replace":
+            out = fv
+        elif bm == "avg":
+            out = (s + fv) / 2.0
+        elif bm == "max":
+            out = jnp.maximum(s, fv)
+        else:
+            out = jnp.minimum(s, fv)
+        return jnp.where(m, out * self.boost, 0.0), m
+
+    def plan_key(self):
+        fn_kinds = tuple(tuple(sorted(f)) for f in self.functions)
+        return ("function_score", self.inner.plan_key(), fn_kinds,
+                self.score_mode, self.boost_mode)
+
+
+@jax.jit
+def _cosine_scores(vecs: jax.Array, qv: jax.Array) -> jax.Array:
+    """[N,D] x [Q,D] -> [Q,N] cosine similarity — pure MXU work."""
+    vn = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=1, keepdims=True), 1e-9)
+    qn = qv / jnp.maximum(jnp.linalg.norm(qv, axis=1, keepdims=True), 1e-9)
+    return qn @ vn.T

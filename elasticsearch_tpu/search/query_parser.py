@@ -1,0 +1,558 @@
+"""Query DSL JSON → Node AST, plus batch merging of same-shape queries.
+
+The analog of the reference's *QueryParser classes + IndexQueryParserService
+(/root/reference/src/main/java/org/elasticsearch/index/query/IndexQueryParserService.java).
+Each query body parses to a Q=1 tree; `merge_query_batch` fuses trees with an
+identical plan shape into one tree with Q rows so the whole batch compiles to
+a single device program (this batching is where the TPU QPS win comes from,
+SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import re
+from typing import Any
+
+from ..mapping.mapper import MapperService, DATE, KEYWORD, TEXT, parse_date_millis
+from .query_dsl import (
+    BoolNode, BoostingNode, ConstantScoreNode, DisMaxNode, ExistsNode,
+    FunctionScoreNode, IdsNode, MatchAllNode, MatchNode, MatchNoneNode, Node,
+    QueryParsingException, RangeNode, TermFilterNode,
+)
+
+_DATE_MATH_RE = re.compile(
+    r"^now(?P<ops>([+-]\d+[yMwdhHms])*)(?:/(?P<round>[yMwdhHms]))?$")
+_UNIT_MILLIS = {"s": 1000, "m": 60_000, "h": 3_600_000, "H": 3_600_000,
+                "d": 86_400_000, "w": 604_800_000}
+
+
+def eval_date_math(expr: str, now_millis: int | None = None) -> int:
+    """'now-7d/d' style date math (ref common/joda DateMathParser)."""
+    if now_millis is None:
+        now_millis = int(_dt.datetime.now(_dt.timezone.utc).timestamp() * 1000)
+    m = _DATE_MATH_RE.match(expr.strip())
+    if not m:
+        return parse_date_millis(expr)
+    t = now_millis
+    ops = m.group("ops") or ""
+    for om in re.finditer(r"([+-])(\d+)([yMwdhHms])", ops):
+        sign = 1 if om.group(1) == "+" else -1
+        n = int(om.group(2))
+        unit = om.group(3)
+        if unit == "y":
+            delta = n * 365 * 86_400_000
+        elif unit == "M":
+            delta = n * 30 * 86_400_000
+        else:
+            delta = n * _UNIT_MILLIS[unit]
+        t += sign * delta
+    rnd = m.group("round")
+    if rnd:
+        dt = _dt.datetime.fromtimestamp(t / 1000.0, tz=_dt.timezone.utc)
+        if rnd == "y":
+            dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif rnd == "M":
+            dt = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif rnd in ("d", "w"):
+            dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+        elif rnd in ("h", "H"):
+            dt = dt.replace(minute=0, second=0, microsecond=0)
+        elif rnd == "m":
+            dt = dt.replace(second=0, microsecond=0)
+        elif rnd == "s":
+            dt = dt.replace(microsecond=0)
+        t = int(dt.timestamp() * 1000)
+    return t
+
+
+class QueryParser:
+    """Parses one query body dict into a Node tree (Q=1)."""
+
+    def __init__(self, mappers: MapperService):
+        self.mappers = mappers
+
+    def parse(self, body: dict | None) -> Node:
+        if body is None or body == {}:
+            return MatchAllNode()
+        if not isinstance(body, dict) or len(body) != 1:
+            raise QueryParsingException(f"query must have a single root key, got {body!r}")
+        (kind, spec), = body.items()
+        handler = getattr(self, f"_parse_{kind}", None)
+        if handler is None:
+            raise QueryParsingException(f"unsupported query type [{kind}]")
+        return handler(spec)
+
+    # -- leaf parsers ------------------------------------------------------
+
+    def _analyze(self, field: str, text: Any) -> list[str]:
+        ft = self.mappers.field_type(field)
+        if ft is not None and ft.type != TEXT:
+            return [str(text)]
+        # use the field's search analyzer; unmapped fields use standard
+        for m in self.mappers._mappers.values():
+            if field in m.fields:
+                return m.search_analyzer_for(field)(str(text))
+        from ..analysis.analyzers import BUILTIN_ANALYZERS
+        return BUILTIN_ANALYZERS["standard"](str(text))
+
+    def _parse_match_all(self, spec) -> Node:
+        return MatchAllNode(boost=float((spec or {}).get("boost", 1.0)))
+
+    def _parse_match_none(self, spec) -> Node:
+        return MatchNoneNode()
+
+    def _parse_match(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        if not isinstance(params, dict):
+            params = {"query": params}
+        terms = self._analyze(field, params["query"])
+        if not terms:
+            return MatchNoneNode()
+        msm = _parse_msm(params.get("minimum_should_match"), len(terms))
+        return MatchNode(
+            boost=float(params.get("boost", 1.0)), field_name=field,
+            terms_per_query=[terms],
+            operator=str(params.get("operator", "or")).lower(),
+            minimum_should_match=msm)
+
+    def _parse_match_phrase(self, spec: dict) -> Node:
+        # positions are not indexed yet: phrase ≈ conjunctive match, verified
+        # against _source in the fetch phase (documented divergence).
+        (field, params), = spec.items()
+        if not isinstance(params, dict):
+            params = {"query": params}
+        terms = self._analyze(field, params["query"])
+        node = MatchNode(field_name=field, terms_per_query=[terms], operator="and",
+                         boost=float(params.get("boost", 1.0)))
+        node.phrase_text = str(params["query"])  # used by fetch-phase verifier
+        return node
+
+    def _parse_multi_match(self, spec: dict) -> Node:
+        fields = spec.get("fields", [])
+        text = spec["query"]
+        mm_type = spec.get("type", "best_fields")
+        subs: list[Node] = []
+        for f in fields:
+            boost = 1.0
+            if "^" in f:
+                f, b = f.split("^", 1)
+                boost = float(b)
+            terms = self._analyze(f, text)
+            if terms:
+                subs.append(MatchNode(field_name=f, terms_per_query=[terms], boost=boost))
+        if not subs:
+            return MatchNoneNode()
+        if mm_type == "most_fields":
+            return BoolNode(should=subs)
+        return DisMaxNode(queries=subs, tie_breaker=float(spec.get("tie_breaker", 0.0)))
+
+    def _parse_term(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        value = params.get("value") if isinstance(params, dict) else params
+        boost = float(params.get("boost", 1.0)) if isinstance(params, dict) else 1.0
+        return self._term_node(field, [value], boost)
+
+    def _parse_terms(self, spec: dict) -> Node:
+        spec = dict(spec)
+        spec.pop("minimum_should_match", None)
+        spec.pop("boost", None)
+        (field, values), = spec.items()
+        return self._term_node(field, list(values), 1.0)
+
+    def _term_node(self, field: str, values: list, boost: float) -> Node:
+        ft = self.mappers.field_type(field)
+        if ft is not None and ft.type == DATE:
+            values = [eval_date_math(str(v)) if isinstance(v, str) else v for v in values]
+        if ft is not None and ft.type == TEXT:
+            # term query on an analyzed field matches the exact token
+            return ConstantScoreNode(
+                boost=boost,
+                inner=MatchNode(field_name=field,
+                                terms_per_query=[[str(v) for v in values]]))
+        return TermFilterNode(field_name=field, values_per_query=[values], boost=boost)
+
+    def _parse_range(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        lo = params.get("gte", params.get("from"))
+        hi = params.get("lte", params.get("to"))
+        inc_lo, inc_hi = True, True
+        if "gt" in params:
+            lo, inc_lo = params["gt"], False
+        if "lt" in params:
+            hi, inc_hi = params["lt"], False
+        ft = self.mappers.field_type(field)
+        is_date = ft is not None and ft.type == DATE
+        if is_date:
+            lo = eval_date_math(str(lo)) if lo is not None else None
+            hi = eval_date_math(str(hi)) if hi is not None else None
+        return RangeNode(field_name=field, bounds_per_query=[(lo, hi, inc_lo, inc_hi)],
+                         is_date=is_date, boost=float(params.get("boost", 1.0)))
+
+    def _parse_exists(self, spec: dict) -> Node:
+        return ExistsNode(field_name=spec["field"])
+
+    def _parse_missing(self, spec: dict) -> Node:
+        return BoolNode(must_not=[ExistsNode(field_name=spec["field"])])
+
+    def _parse_ids(self, spec: dict) -> Node:
+        return IdsNode(ids_per_query=[[str(v) for v in spec.get("values", [])]])
+
+    def _parse_prefix(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        value = params.get("value", params.get("prefix")) if isinstance(params, dict) else params
+        from .query_dsl import Node as _N
+        return MultiTermExpandNode(field_name=field, kind="prefix", pattern=str(value))
+
+    def _parse_wildcard(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        value = params.get("value", params.get("wildcard")) if isinstance(params, dict) else params
+        return MultiTermExpandNode(field_name=field, kind="wildcard", pattern=str(value))
+
+    def _parse_regexp(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        value = params.get("value") if isinstance(params, dict) else params
+        return MultiTermExpandNode(field_name=field, kind="regexp", pattern=str(value))
+
+    def _parse_fuzzy(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        value = params.get("value") if isinstance(params, dict) else params
+        fuzz = params.get("fuzziness", "AUTO") if isinstance(params, dict) else "AUTO"
+        return MultiTermExpandNode(field_name=field, kind="fuzzy", pattern=str(value),
+                                   fuzziness=str(fuzz))
+
+    def _parse_bool(self, spec: dict) -> Node:
+        def as_list(x):
+            if x is None:
+                return []
+            return x if isinstance(x, list) else [x]
+
+        msm = spec.get("minimum_should_match")
+        n_should = len(as_list(spec.get("should")))
+        return BoolNode(
+            must=[self.parse(q) for q in as_list(spec.get("must"))],
+            should=[self.parse(q) for q in as_list(spec.get("should"))],
+            must_not=[self.parse(q) for q in as_list(spec.get("must_not"))],
+            filter=[self.parse(q) for q in as_list(spec.get("filter"))],
+            minimum_should_match=_parse_msm(msm, n_should) if msm is not None else None,
+            boost=float(spec.get("boost", 1.0)))
+
+    def _parse_constant_score(self, spec: dict) -> Node:
+        inner = spec.get("filter", spec.get("query"))
+        return ConstantScoreNode(inner=self.parse(inner),
+                                 boost=float(spec.get("boost", 1.0)))
+
+    def _parse_filtered(self, spec: dict) -> Node:
+        # ES 2.x `filtered` query (ref index/query/FilteredQueryParser.java)
+        return BoolNode(must=[self.parse(spec.get("query", {}))],
+                        filter=[self.parse(spec.get("filter", {}))])
+
+    def _parse_dis_max(self, spec: dict) -> Node:
+        return DisMaxNode(queries=[self.parse(q) for q in spec.get("queries", [])],
+                          tie_breaker=float(spec.get("tie_breaker", 0.0)),
+                          boost=float(spec.get("boost", 1.0)))
+
+    def _parse_boosting(self, spec: dict) -> Node:
+        return BoostingNode(positive=self.parse(spec["positive"]),
+                            negative=self.parse(spec["negative"]),
+                            negative_boost=float(spec.get("negative_boost", 0.5)))
+
+    def _parse_function_score(self, spec: dict) -> Node:
+        inner = self.parse(spec.get("query", {"match_all": {}}))
+        functions = []
+        if "functions" in spec:
+            for f in spec["functions"]:
+                functions.append(self._parse_function(f))
+        else:
+            single = {k: v for k, v in spec.items()
+                      if k in ("field_value_factor", "script_score", "random_score",
+                               "gauss", "exp", "linear", "weight")}
+            if single:
+                functions.append(self._parse_function(single))
+        return FunctionScoreNode(
+            inner=inner, functions=functions,
+            score_mode=spec.get("score_mode", "multiply"),
+            boost_mode=spec.get("boost_mode", "multiply"),
+            boost=float(spec.get("boost", 1.0)))
+
+    def _parse_function(self, f: dict) -> dict:
+        out: dict[str, Any] = {}
+        if "weight" in f:
+            out["weight"] = float(f["weight"])
+        for decay_kind in ("gauss", "exp", "linear"):
+            if decay_kind in f:
+                (field, p), = f[decay_kind].items()
+                ft = self.mappers.field_type(field)
+                origin = p["origin"]
+                scale = p["scale"]
+                offset = p.get("offset", 0)
+                if ft is not None and ft.type == DATE:
+                    origin = eval_date_math(str(origin))
+                    scale = _duration_millis(str(scale))
+                    offset = _duration_millis(str(offset)) if offset else 0
+                out["decay"] = {"function": decay_kind, "field": field,
+                                "origin": origin, "scale": scale,
+                                "decay": p.get("decay", 0.5), "offset": offset}
+                return out
+        if "field_value_factor" in f:
+            out["field_value_factor"] = f["field_value_factor"]
+        elif "random_score" in f:
+            out["random_score"] = f.get("random_score") or {}
+        elif "script_score" in f:
+            # restricted script: only cosine/dot-product vector scripts compile
+            # to device programs (no Groovy sandbox — SURVEY.md §7 M6)
+            out["script_score"] = f["script_score"]
+        elif "cosine" in f:
+            out["cosine"] = f["cosine"]
+        elif "weight" in f and len(f) == 1:
+            pass
+        return out
+
+    def _parse_query_string(self, spec: dict) -> Node:
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        qs = str(spec.get("query", "*"))
+        default_field = spec.get("default_field", spec.get("df", "_all"))
+        return self._query_string_node(qs, default_field,
+                                       spec.get("default_operator", "or").lower())
+
+    def _parse_simple_query_string(self, spec: dict) -> Node:
+        fields = spec.get("fields", ["_all"])
+        return self._query_string_node(str(spec.get("query", "")), fields[0],
+                                       spec.get("default_operator", "or").lower())
+
+    def _query_string_node(self, qs: str, default_field: str, default_op: str) -> Node:
+        """Simplified Lucene query-string syntax: field:term, quoted phrases,
+        AND/OR/NOT, +/- prefixes, * wildcard-in-term."""
+        if qs.strip() in ("*", "*:*", ""):
+            return MatchAllNode()
+        tokens = re.findall(r'"[^"]*"|\S+', qs)
+        must: list[Node] = []
+        should: list[Node] = []
+        must_not: list[Node] = []
+        op_and = default_op == "and"
+        pending_not = False
+        pending_and = False
+        for tok in tokens:
+            if tok.upper() == "AND":
+                pending_and = True
+                continue
+            if tok.upper() == "OR":
+                continue
+            if tok.upper() == "NOT":
+                pending_not = True
+                continue
+            neg = pending_not
+            req = pending_and or op_and
+            pending_not = pending_and = False
+            if tok.startswith("-"):
+                neg, tok = True, tok[1:]
+            elif tok.startswith("+"):
+                req, tok = True, tok[1:]
+            if ":" in tok and not tok.startswith('"'):
+                field, val = tok.split(":", 1)
+            else:
+                field, val = default_field, tok
+            val = val.strip('"')
+            ft = self.mappers.field_type(field)
+            if "*" in val or "?" in val:
+                node: Node = MultiTermExpandNode(field_name=field, kind="wildcard",
+                                                 pattern=val)
+            elif ft is not None and ft.type != TEXT:
+                node = self._term_node(field, [val], 1.0)
+            else:
+                terms = self._analyze(field, val)
+                node = MatchNode(field_name=field, terms_per_query=[terms]) if terms \
+                    else MatchNoneNode()
+            (must_not if neg else (must if req else should)).append(node)
+        if not should and not must and not must_not:
+            return MatchAllNode()
+        return BoolNode(must=must, should=should, must_not=must_not)
+
+
+def _parse_msm(msm, n_clauses: int) -> int:
+    if msm is None:
+        return 0
+    s = str(msm)
+    if s.endswith("%"):
+        pct = float(s[:-1])
+        if pct < 0:
+            return max(n_clauses - int(n_clauses * -pct / 100.0), 0)
+        return int(n_clauses * pct / 100.0)
+    v = int(s)
+    return v if v >= 0 else max(n_clauses + v, 0)
+
+
+def _duration_millis(s: str) -> float:
+    m = re.match(r"^(\d+(?:\.\d+)?)([yMwdhms]|ms)$", s.strip())
+    if not m:
+        return float(s)
+    n = float(m.group(1))
+    unit = m.group(2)
+    table = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000, "w": 604_800_000, "M": 2_592_000_000,
+             "y": 31_536_000_000}
+    return n * table[unit]
+
+
+# ---------------------------------------------------------------------------
+# Multi-term expansion node (prefix/wildcard/regexp/fuzzy)
+# ---------------------------------------------------------------------------
+
+import dataclasses as _dc
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bm25 as _bm25
+from .query_dsl import SegmentContext, _false, _zeros
+
+
+@_dc.dataclass
+class MultiTermExpandNode(Node):
+    """Constant-score multi-term query: expands the pattern against each
+    segment's term dictionary at execute time — mirroring Lucene's per-segment
+    MultiTermQuery rewrite (ref org.apache.lucene.search.MultiTermQuery used
+    by index/query/{Prefix,Wildcard,Regexp,Fuzzy}QueryParser.java)."""
+    field_name: str = ""
+    kind: str = "prefix"            # prefix | wildcard | regexp | fuzzy
+    pattern: str = ""
+    fuzziness: str = "AUTO"
+    max_expansions: int = 1024
+
+    def _expand(self, ctx: SegmentContext) -> list[str]:
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        kc = seg.keywords.get(self.field_name)
+        vocab: list[str]
+        if fx is not None:
+            vocab = list(fx.terms)
+        elif kc is not None:
+            vocab = kc.values
+        else:
+            return []
+        pat = self.pattern
+        if self.kind == "prefix":
+            return [t for t in vocab if t.startswith(pat)][: self.max_expansions]
+        if self.kind == "wildcard":
+            rx = re.compile("^" + re.escape(pat).replace(r"\*", ".*").replace(r"\?", ".") + "$")
+            return [t for t in vocab if rx.match(t)][: self.max_expansions]
+        if self.kind == "regexp":
+            rx = re.compile("^" + pat + "$")
+            return [t for t in vocab if rx.match(t)][: self.max_expansions]
+        # fuzzy: Damerau-Levenshtein within edit distance
+        max_ed = _auto_fuzz(pat, self.fuzziness)
+        return [t for t in vocab if abs(len(t) - len(pat)) <= max_ed
+                and _edit_distance_le(pat, t, max_ed)][: self.max_expansions]
+
+    def execute(self, ctx: SegmentContext):
+        seg = ctx.segment
+        terms = self._expand(ctx)
+        if not terms:
+            return _zeros(ctx), _false(ctx)
+        fx = seg.text.get(self.field_name)
+        if fx is not None:
+            starts = np.zeros((1, len(terms)), np.int32)
+            lens = np.zeros((1, len(terms)), np.int32)
+            for ti, t in enumerate(terms):
+                s, ln, _ = fx.lookup(t)
+                starts[0, ti] = s
+                lens[0, ti] = ln
+            W = int(max(8, 1 << int(np.ceil(np.log2(max(1, int(lens.sum())))))))
+            hits = _bm25.term_match_mask(fx.doc_ids, jnp.asarray(starts),
+                                         jnp.asarray(lens), W=W, n_pad=ctx.n_pad)
+            match = jnp.broadcast_to(hits, (ctx.Q, ctx.n_pad))
+        else:
+            kc = seg.keywords[self.field_name]
+            ord_targets = np.asarray([kc.ord_of(t) for t in terms], np.int32)
+            match = jnp.isin(kc.ords, jnp.asarray(ord_targets))[None, :]
+            match = jnp.broadcast_to(match, (ctx.Q, ctx.n_pad))
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("multi_term", self.field_name, self.kind, self.pattern)
+
+
+def _auto_fuzz(term: str, fuzz: str) -> int:
+    if fuzz.upper() == "AUTO":
+        if len(term) <= 2:
+            return 0
+        if len(term) <= 5:
+            return 1
+        return 2
+    return int(float(fuzz))
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    if k == 0:
+        return a == b
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            row_min = min(row_min, cur[j])
+        if row_min > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+# ---------------------------------------------------------------------------
+# Batch merging
+# ---------------------------------------------------------------------------
+
+_PER_QUERY_FIELDS = ("terms_per_query", "values_per_query", "bounds_per_query",
+                     "ids_per_query")
+
+
+def merge_query_batch(nodes: list[Node]) -> Node:
+    """Fuse same-shape Q=1 trees into one tree with Q rows. All trees must
+    share plan_key(); leaves concatenate their per-query rows."""
+    if len(nodes) == 1:
+        return nodes[0]
+    first = nodes[0]
+    key = first.plan_key()
+    for n in nodes[1:]:
+        if n.plan_key() != key:
+            raise QueryParsingException("cannot batch queries with different shapes")
+    return _merge(nodes)
+
+
+def _merge(nodes: list[Node]) -> Node:
+    first = nodes[0]
+    kwargs = {}
+    for f in dataclasses.fields(first):
+        vals = [getattr(n, f.name) for n in nodes]
+        v0 = vals[0]
+        if f.name in _PER_QUERY_FIELDS:
+            merged: list = []
+            for v in vals:
+                merged.extend(v)
+            kwargs[f.name] = merged
+        elif isinstance(v0, Node):
+            kwargs[f.name] = _merge(vals)
+        elif isinstance(v0, list) and v0 and isinstance(v0[0], Node):
+            kwargs[f.name] = [_merge([v[i] for v in vals]) for i in range(len(v0))]
+        elif f.name == "functions":
+            kwargs[f.name] = _merge_functions(vals)
+        else:
+            kwargs[f.name] = v0
+    return type(first)(**kwargs)
+
+
+def _merge_functions(fn_lists: list[list[dict]]) -> list[dict]:
+    """function_score specs may carry per-query vectors (query_vectors)."""
+    out = []
+    for i in range(len(fn_lists[0])):
+        spec = dict(fn_lists[0][i])
+        for key in ("cosine", "script_score"):
+            if key in spec and "query_vectors" in spec[key]:
+                merged_vecs = []
+                for fns in fn_lists:
+                    merged_vecs.extend(fns[i][key]["query_vectors"])
+                spec[key] = dict(spec[key], query_vectors=merged_vecs)
+        out.append(spec)
+    return out

@@ -1,0 +1,253 @@
+"""Per-shard search execution: query phase + fetch phase.
+
+The analog of the reference's per-shard search runtime
+(/root/reference/src/main/java/org/elasticsearch/search/SearchService.java:285
+executeQueryPhase, search/query/QueryPhase.java:91-168, search/fetch/FetchPhase.java:79):
+
+  query phase : compile query → run over every tensor segment → per-segment
+                top-k (ops/topk) → running merge → QuerySearchResult with doc
+                *keys* only (no sources) — exactly the reference's 2-phase
+                contract (ids first, payload later).
+  fetch phase : resolve doc keys to host-side stored _source.
+
+Doc keys are i64: (segment_index << 32) | local_doc — the tensor analog of
+Lucene's (segment, docid) addressing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..index.segment import Segment
+from ..mapping.mapper import MapperService
+from ..ops import topk as topk_ops
+from .query_dsl import CollectionStats, Node, SegmentContext
+from .query_parser import QueryParser, merge_query_batch
+
+SEG_SHIFT = 32
+LOCAL_MASK = (1 << 32) - 1
+
+
+@dataclasses.dataclass
+class QuerySearchResult:
+    """Per-shard query-phase result (ref search/query/QuerySearchResult.java)."""
+    shard_id: int
+    doc_keys: np.ndarray          # i64 [Q, k]  (-1 = empty slot)
+    scores: np.ndarray            # f32 [Q, k]
+    sort_values: np.ndarray | None  # f64 [Q, k] when sorting by field
+    total_hits: np.ndarray        # i64 [Q]
+    max_score: np.ndarray         # f32 [Q]
+    aggs: list | None = None      # per-shard partial aggregations (search/aggs)
+
+
+@dataclasses.dataclass
+class FetchedHit:
+    doc_key: int
+    score: float
+    sort_value: float | None
+    doc_id: str
+    type_name: str
+    source: dict
+
+
+class ShardSearcher:
+    """Executes search phases over one shard's live segment set."""
+
+    def __init__(self, shard_id: int, segments: Sequence[Segment],
+                 mappers: MapperService):
+        self.shard_id = shard_id
+        self.segments = list(segments)
+        self.mappers = mappers
+        self.parser = QueryParser(mappers)
+
+    # -- statistics (DFS support, ref search/dfs/DfsPhase.java:57-81) ------
+
+    def term_statistics(self, node: Node) -> tuple[dict, dict, int]:
+        """(doc_freqs {(field,term): df}, field_sum_dl, doc_count) for this
+        shard — the payload a DFS phase all-reduces across shards."""
+        terms_by_field: dict[str, set[str]] = {}
+        node.collect_terms(terms_by_field)
+        stats = CollectionStats.from_segments(self.segments, terms_by_field)
+        return stats.doc_freqs, stats.field_sum_dl, stats.doc_count
+
+    def build_stats(self, node: Node,
+                    global_stats: CollectionStats | None = None) -> CollectionStats:
+        if global_stats is not None:
+            return global_stats
+        terms_by_field: dict[str, set[str]] = {}
+        node.collect_terms(terms_by_field)
+        return CollectionStats.from_segments(self.segments, terms_by_field)
+
+    # -- query phase -------------------------------------------------------
+
+    def parse(self, bodies: list[dict | None]) -> Node:
+        nodes = [self.parser.parse(b) for b in bodies]
+        return merge_query_batch(nodes)
+
+    def execute_query_phase(self, node: Node, *, size: int = 10,
+                            from_: int = 0, n_queries: int = 1,
+                            sort: dict | None = None,
+                            global_stats: CollectionStats | None = None,
+                            track_scores: bool = True) -> QuerySearchResult:
+        """Run the batched query tree over all segments of this shard."""
+        k = max(size + from_, 1)
+        Q = n_queries
+        stats = self.build_stats(node, global_stats)
+
+        best_scores = np.full((Q, k), -np.inf, np.float32)
+        best_keys = np.full((Q, k), -1, np.int64)
+        best_sort = np.full((Q, k), np.inf, np.float64) if sort else None
+        total = np.zeros((Q,), np.int64)
+        max_score = np.full((Q,), -np.inf, np.float32)
+
+        for seg_idx, seg in enumerate(self.segments):
+            if seg.n_docs == 0:
+                continue
+            ctx = SegmentContext(seg, Q, stats)
+            scores, match = node.execute(ctx)
+            match = match & seg.live[None, :]
+            kk = min(k, seg.n_pad)
+            total += np.asarray(topk_ops.count_matches(match))
+            if sort is None:
+                top, idx = topk_ops.topk_scores(scores, match, k=kk)
+                top = np.asarray(top)
+                idx = np.asarray(idx)
+                seg_keys = np.where(top > -np.inf,
+                                    (np.int64(seg_idx) << SEG_SHIFT) | idx.astype(np.int64),
+                                    np.int64(-1))
+                merged = np.concatenate([best_scores, top], axis=1)
+                merged_keys = np.concatenate([best_keys, seg_keys], axis=1)
+                order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
+                best_scores = np.take_along_axis(merged, order, axis=1)
+                best_keys = np.take_along_axis(merged_keys, order, axis=1)
+                seg_max = np.asarray(scores).max(axis=1) if track_scores else None
+                if seg_max is not None:
+                    max_score = np.maximum(max_score, seg_max)
+            else:
+                key_arr = self._sort_keys(seg, sort, Q)     # f64 [Q, N], asc-ready
+                masked = jnp.where(match, key_arr, jnp.inf)
+                # top_k of -key selects the smallest (ascending) sort keys
+                neg, idx = topk_ops.topk_scores(-masked, match, k=kk)
+                vals = -np.asarray(neg)
+                idx = np.asarray(idx)
+                sc = np.take_along_axis(np.asarray(scores), idx, axis=1)
+                seg_keys = np.where(np.isfinite(vals),
+                                    (np.int64(seg_idx) << SEG_SHIFT) | idx.astype(np.int64),
+                                    np.int64(-1))
+                merged_v = np.concatenate([best_sort, vals], axis=1)
+                merged_k = np.concatenate([best_keys, seg_keys], axis=1)
+                merged_s = np.concatenate([best_scores, sc.astype(np.float32)], axis=1)
+                order = np.argsort(merged_v, axis=1, kind="stable")[:, :k]
+                best_sort = np.take_along_axis(merged_v, order, axis=1)
+                best_keys = np.take_along_axis(merged_k, order, axis=1)
+                best_scores = np.take_along_axis(merged_s, order, axis=1)
+
+        if sort is not None and sort.get("order", "asc") == "desc":
+            # keys were negated in _sort_keys; undo for reporting
+            best_sort = -best_sort
+        max_score = np.where(np.isfinite(max_score), max_score, np.nan)
+        best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+        return QuerySearchResult(
+            shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
+            sort_values=best_sort, total_hits=total, max_score=max_score)
+
+    def _sort_keys(self, seg: Segment, sort: dict, Q: int):
+        """Build an ascending-comparable f64 key per doc for field sort
+        (ref search/sort/SortParseElement.java + fielddata comparators)."""
+        field = sort["field"]
+        order = sort.get("order", "asc")
+        missing = sort.get("missing", "_last")
+        nc = seg.numerics.get(field)
+        kc = seg.keywords.get(field)
+        if nc is not None:
+            vals = nc.vals.astype(jnp.float64)
+            miss = nc.missing
+        elif kc is not None:
+            vals = kc.ords.astype(jnp.float64)
+            miss = kc.ords < 0
+        else:
+            vals = jnp.zeros((seg.n_pad,), jnp.float64)
+            miss = jnp.ones((seg.n_pad,), bool)
+        if order == "desc":
+            vals = -vals
+        fill = jnp.float64(np.finfo(np.float64).max if missing == "_last"
+                           else -np.finfo(np.float64).max)
+        vals = jnp.where(miss, fill, vals)
+        return jnp.broadcast_to(vals[None, :], (Q, seg.n_pad))
+
+    # -- fetch phase -------------------------------------------------------
+
+    def execute_fetch_phase(self, doc_keys: Sequence[int],
+                            scores: Sequence[float] | None = None,
+                            sort_values: Sequence[float] | None = None,
+                            source_filter=None) -> list[FetchedHit]:
+        """Load stored fields for the reduced winners
+        (ref search/fetch/FetchPhase.java:79)."""
+        hits = []
+        for i, key in enumerate(doc_keys):
+            key = int(key)
+            if key < 0:
+                continue
+            seg_idx = key >> SEG_SHIFT
+            local = key & LOCAL_MASK
+            seg = self.segments[seg_idx]
+            src = seg.stored[local]
+            if source_filter:
+                src = _filter_source(src, source_filter)
+            hits.append(FetchedHit(
+                doc_key=key,
+                score=float(scores[i]) if scores is not None else float("nan"),
+                sort_value=float(sort_values[i]) if sort_values is not None else None,
+                doc_id=seg.ids[local], type_name=seg.types[local], source=src))
+        return hits
+
+
+def _filter_source(src: dict, spec) -> dict:
+    """_source filtering: include/exclude path lists
+    (ref search/fetch/source/FetchSourceSubPhase)."""
+    import fnmatch
+
+    if spec is True or spec is None:
+        return src
+    if spec is False:
+        return {}
+    includes = spec if isinstance(spec, list) else None
+    excludes = None
+    if isinstance(spec, dict):
+        includes = spec.get("includes", spec.get("include"))
+        excludes = spec.get("excludes", spec.get("exclude"))
+    if isinstance(spec, str):
+        includes = [spec]
+
+    def flatten(obj, prefix=""):
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(flatten(v, path + "."))
+            else:
+                out[path] = v
+        return out
+
+    flat = flatten(src)
+    keep = {}
+    for path, v in flat.items():
+        ok = True
+        if includes:
+            ok = any(fnmatch.fnmatch(path, pat) for pat in includes)
+        if ok and excludes:
+            ok = not any(fnmatch.fnmatch(path, pat) for pat in excludes)
+        if ok:
+            keep[path] = v
+    out: dict = {}
+    for path, v in keep.items():
+        parts = path.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out

@@ -1,0 +1,118 @@
+"""Engine tests: versioned CRUD, translog durability, refresh/merge, recovery
+(mirrors reference engine tests in src/test/java/org/elasticsearch/index/engine/)."""
+
+import pytest
+
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.index.engine import Engine, VersionConflictException
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    eng = Engine(str(tmp_path / "shard0"), MapperService())
+    yield eng
+    eng.close()
+
+
+class TestEngineCrud:
+    def test_index_and_get_realtime(self, engine):
+        r = engine.index("1", {"title": "hello"})
+        assert r.created and r.version == 1
+        g = engine.get("1")   # realtime: no refresh yet
+        assert g.found and g.source == {"title": "hello"} and g.version == 1
+
+    def test_update_bumps_version(self, engine):
+        engine.index("1", {"v": 1})
+        r = engine.index("1", {"v": 2})
+        assert not r.created and r.version == 2
+        assert engine.get("1").source == {"v": 2}
+
+    def test_internal_version_conflict(self, engine):
+        engine.index("1", {"v": 1})
+        with pytest.raises(VersionConflictException):
+            engine.index("1", {"v": 2}, version=5)
+        engine.index("1", {"v": 2}, version=1)  # correct current version
+
+    def test_external_version(self, engine):
+        engine.index("1", {"v": 1}, version=10, version_type="external")
+        with pytest.raises(VersionConflictException):
+            engine.index("1", {"v": 2}, version=10, version_type="external")
+        r = engine.index("1", {"v": 2}, version=42, version_type="external")
+        assert r.version == 42
+
+    def test_create_op_type(self, engine):
+        engine.index("1", {"v": 1}, op_type="create")
+        with pytest.raises(VersionConflictException):
+            engine.index("1", {"v": 2}, op_type="create")
+
+    def test_delete(self, engine):
+        engine.index("1", {"v": 1})
+        r = engine.delete("1")
+        assert r.found and r.version == 2
+        assert not engine.get("1").found
+        assert engine.delete("missing").found is False
+
+    def test_delete_after_refresh_tombstones(self, engine):
+        engine.index("1", {"v": 1})
+        engine.index("2", {"v": 2})
+        engine.refresh()
+        assert engine.doc_count() == 2
+        engine.delete("1")
+        assert engine.doc_count() == 1
+        assert engine.segments[0].live_count == 1
+
+    def test_refresh_and_merge(self, engine):
+        for i in range(20):
+            engine.index(str(i), {"n": i})
+            if i % 3 == 0:
+                engine.refresh()
+        engine.force_merge()
+        assert len(engine.segments) == 1
+        assert engine.doc_count() == 20
+
+    def test_auto_merge_at_threshold(self, engine):
+        for i in range(Engine.MERGE_SEGMENT_COUNT + 1):
+            engine.index(str(i), {"n": i})
+            engine.refresh()
+        assert len(engine.segments) < Engine.MERGE_SEGMENT_COUNT
+
+
+class TestDurability:
+    def test_translog_replay_after_crash(self, tmp_path):
+        path = str(tmp_path / "s")
+        eng = Engine(path, MapperService())
+        eng.index("1", {"a": 1})
+        eng.index("2", {"a": 2})
+        eng.delete("1")
+        # simulate crash: no flush, no close
+        eng.translog.sync()
+        eng2 = Engine(path, MapperService())
+        assert eng2.doc_count() == 1
+        assert eng2.get("2").found
+        assert not eng2.get("1").found
+        assert eng2.get("2").version == 1
+        eng2.close()
+
+    def test_flush_and_reopen(self, tmp_path):
+        path = str(tmp_path / "s")
+        eng = Engine(path, MapperService())
+        for i in range(5):
+            eng.index(str(i), {"n": i})
+        eng.flush()
+        eng.index("99", {"n": 99})  # post-flush op lives in translog only
+        eng.translog.sync()
+        eng.close()
+        eng2 = Engine.open_committed(path, MapperService())
+        assert eng2.doc_count() == 6
+        assert eng2.get("99").found
+        eng2.close()
+
+    def test_translog_trimmed_after_flush(self, tmp_path):
+        path = str(tmp_path / "s")
+        eng = Engine(path, MapperService())
+        eng.index("1", {"a": 1})
+        eng.flush()
+        assert eng.translog.ops_since_commit == 0
+        stats = eng.translog.stats()
+        assert stats["generation"] >= 1
+        eng.close()

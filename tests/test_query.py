@@ -1,0 +1,202 @@
+"""Query DSL + shard search tests — behavioral parity with the reference query
+parsers (src/main/java/org/elasticsearch/index/query/) on a live shard."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+
+DOCS = [
+    {"title": "The quick brown fox", "body": "jumps over the lazy dog",
+     "price": 10, "tag_kw": "animal", "stock": 5.5, "ts": "2024-01-01T00:00:00Z"},
+    {"title": "Quick brown cats", "body": "sleep all day",
+     "price": 25, "tag_kw": "animal", "stock": 1.0, "ts": "2024-02-01T00:00:00Z"},
+    {"title": "Lazy dogs", "body": "sleep at night quick",
+     "price": 50, "tag_kw": "animal", "stock": 0.0, "ts": "2024-03-01T00:00:00Z"},
+    {"title": "Python programming", "body": "the quick guide to code",
+     "price": 30, "tag_kw": "book", "stock": 3.0, "ts": "2024-04-01T00:00:00Z"},
+    {"title": "Rust programming", "body": "systems code guide",
+     "price": 45, "tag_kw": "book", "stock": 2.0, "ts": "2024-05-15T00:00:00Z"},
+]
+
+MAPPING = {"_doc": {"properties": {
+    "title": {"type": "text"}, "body": {"type": "text"},
+    "price": {"type": "long"}, "tag_kw": {"type": "keyword"},
+    "stock": {"type": "double"}, "ts": {"type": "date"},
+}}}
+
+
+@pytest.fixture(scope="module")
+def searcher(tmp_path_factory):
+    mappers = MapperService(mappings=MAPPING)
+    eng = Engine(str(tmp_path_factory.mktemp("shard")), mappers)
+    for i, d in enumerate(DOCS):
+        eng.index(str(i), d)
+        if i == 2:
+            eng.refresh()   # force multiple segments
+    eng.refresh()
+    return ShardSearcher(0, eng.segments, mappers)
+
+
+def run(searcher, body, size=10, sort=None):
+    node = searcher.parse([body])
+    res = searcher.execute_query_phase(node, size=size, sort=sort)
+    keys = [int(k) for k in res.doc_keys[0] if k >= 0]
+    hits = searcher.execute_fetch_phase(keys, res.scores[0],
+                                        res.sort_values[0] if sort else None)
+    return res, hits
+
+
+def ids(hits):
+    return [h.doc_id for h in hits]
+
+
+class TestQueries:
+    def test_match(self, searcher):
+        res, hits = run(searcher, {"match": {"title": "quick"}})
+        assert sorted(ids(hits)) == ["0", "1"]
+        assert int(res.total_hits[0]) == 2
+
+    def test_match_multiple_segments_scoring(self, searcher):
+        # 'sleep' appears in docs 1 (seg0) and 2 (seg1): idf must be computed
+        # from cross-segment stats
+        res, hits = run(searcher, {"match": {"body": "sleep"}})
+        assert sorted(ids(hits)) == ["1", "2"]
+        assert all(h.score > 0 for h in hits)
+        # same idf from cross-segment stats; only dl norm differs (3 vs 4 tokens)
+        assert abs(hits[0].score - hits[1].score) < 0.3
+
+    def test_match_operator_and(self, searcher):
+        _, hits = run(searcher, {"match": {"body": {"query": "sleep quick", "operator": "and"}}})
+        assert ids(hits) == ["2"]
+
+    def test_match_all(self, searcher):
+        res, hits = run(searcher, {"match_all": {}})
+        assert int(res.total_hits[0]) == 5
+
+    def test_term_keyword(self, searcher):
+        res, hits = run(searcher, {"term": {"tag_kw": "book"}})
+        assert sorted(ids(hits)) == ["3", "4"]
+
+    def test_terms(self, searcher):
+        res, _ = run(searcher, {"terms": {"tag_kw": ["book", "animal"]}})
+        assert int(res.total_hits[0]) == 5
+
+    def test_term_numeric(self, searcher):
+        _, hits = run(searcher, {"term": {"price": 30}})
+        assert ids(hits) == ["3"]
+
+    def test_range_numeric(self, searcher):
+        res, hits = run(searcher, {"range": {"price": {"gte": 25, "lt": 50}}})
+        assert sorted(ids(hits)) == ["1", "3", "4"]
+
+    def test_range_double(self, searcher):
+        res, _ = run(searcher, {"range": {"stock": {"gt": 1.0}}})
+        assert int(res.total_hits[0]) == 3
+
+    def test_range_date(self, searcher):
+        res, hits = run(searcher, {"range": {"ts": {"gte": "2024-03-01", "lte": "2024-05-01"}}})
+        assert sorted(ids(hits)) == ["2", "3"]
+
+    def test_bool_must_filter(self, searcher):
+        _, hits = run(searcher, {"bool": {
+            "must": [{"match": {"title": "programming"}}],
+            "filter": [{"range": {"price": {"lte": 30}}}]}})
+        assert ids(hits) == ["3"]
+
+    def test_bool_must_not(self, searcher):
+        res, _ = run(searcher, {"bool": {
+            "must": [{"match_all": {}}],
+            "must_not": [{"term": {"tag_kw": "book"}}]}})
+        assert int(res.total_hits[0]) == 3
+
+    def test_bool_should_msm(self, searcher):
+        res, _ = run(searcher, {"bool": {
+            "should": [{"match": {"title": "quick"}},
+                       {"match": {"body": "sleep"}},
+                       {"term": {"tag_kw": "animal"}}],
+            "minimum_should_match": 2}})
+        # docs 0(quick+animal) 1(quick+sleep+animal) 2(sleep+animal)
+        assert int(res.total_hits[0]) == 3
+
+    def test_filtered_legacy(self, searcher):
+        _, hits = run(searcher, {"filtered": {
+            "query": {"match": {"title": "quick"}},
+            "filter": {"term": {"tag_kw": "animal"}}}})
+        assert sorted(ids(hits)) == ["0", "1"]
+
+    def test_exists_missing(self, searcher):
+        res, _ = run(searcher, {"exists": {"field": "price"}})
+        assert int(res.total_hits[0]) == 5
+        res, _ = run(searcher, {"exists": {"field": "nope"}})
+        assert int(res.total_hits[0]) == 0
+
+    def test_ids(self, searcher):
+        _, hits = run(searcher, {"ids": {"values": ["1", "3"]}})
+        assert sorted(ids(hits)) == ["1", "3"]
+
+    def test_prefix_wildcard_fuzzy(self, searcher):
+        res, _ = run(searcher, {"prefix": {"title": "program"}})
+        assert int(res.total_hits[0]) == 2
+        res, _ = run(searcher, {"wildcard": {"title": "p*thon"}})
+        assert int(res.total_hits[0]) == 1
+        res, _ = run(searcher, {"fuzzy": {"title": "quikc"}})
+        assert int(res.total_hits[0]) == 2
+
+    def test_constant_score(self, searcher):
+        _, hits = run(searcher, {"constant_score": {
+            "filter": {"term": {"tag_kw": "book"}}, "boost": 3.0}})
+        assert all(abs(h.score - 3.0) < 1e-6 for h in hits)
+
+    def test_dis_max(self, searcher):
+        res, _ = run(searcher, {"dis_max": {"queries": [
+            {"match": {"title": "quick"}}, {"match": {"body": "quick"}}]}})
+        assert int(res.total_hits[0]) == 4
+
+    def test_multi_match(self, searcher):
+        res, _ = run(searcher, {"multi_match": {
+            "query": "quick", "fields": ["title", "body"]}})
+        assert int(res.total_hits[0]) == 4
+
+    def test_query_string(self, searcher):
+        res, _ = run(searcher, {"query_string": {
+            "query": "title:programming AND tag_kw:book"}})
+        assert int(res.total_hits[0]) == 2
+
+    def test_function_score_fvf(self, searcher):
+        _, hits = run(searcher, {"function_score": {
+            "query": {"term": {"tag_kw": "book"}},
+            "field_value_factor": {"field": "price", "factor": 1.0},
+            "boost_mode": "replace"}})
+        assert ids(hits) == ["4", "3"]  # price 45 > 30
+        assert abs(hits[0].score - 45.0) < 1e-3
+
+    def test_function_score_decay(self, searcher):
+        _, hits = run(searcher, {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"gauss": {"price": {"origin": 10, "scale": 20}}}],
+            "boost_mode": "replace"}})
+        assert hits[0].doc_id == "0"  # price exactly at origin
+
+    def test_sort_by_field(self, searcher):
+        _, hits = run(searcher, {"match_all": {}}, sort={"field": "price", "order": "desc"})
+        assert ids(hits) == ["2", "4", "3", "1", "0"]
+        _, hits = run(searcher, {"match_all": {}}, sort={"field": "price", "order": "asc"})
+        assert ids(hits) == ["0", "1", "3", "4", "2"]
+
+    def test_batched_queries(self, searcher):
+        """Same-shape queries fuse into one device program (the QPS path)."""
+        node = searcher.parse([{"match": {"title": "quick"}},
+                               {"match": {"title": "programming"}},
+                               {"match": {"title": "lazy"}}])
+        res = searcher.execute_query_phase(node, size=5, n_queries=3)
+        assert [int(t) for t in res.total_hits] == [2, 2, 1]
+
+    def test_source_filtering(self, searcher):
+        node = searcher.parse([{"ids": {"values": ["0"]}}])
+        res = searcher.execute_query_phase(node)
+        hits = searcher.execute_fetch_phase(
+            [int(res.doc_keys[0][0])], source_filter=["title", "price"])
+        assert set(hits[0].source) == {"title", "price"}

@@ -82,9 +82,27 @@ class Engine:
         self.refresh_count = 0
         self.flush_count = 0
         self.merge_count = 0
+        self._load_commit()
         self._recover()
 
     # -- recovery (translog replay, ref InternalEngine recoverFromTranslog) --
+
+    def _load_commit(self) -> None:
+        """Load the last commit point if one exists (gateway recovery analog,
+        ref index/gateway/ — committed segments must survive reopen; replaying
+        only the translog on top of an ignored commit would lose every doc
+        older than the last flush)."""
+        import json
+        commit_path = os.path.join(self.path, "commit.json")
+        if not os.path.exists(commit_path):
+            return
+        with open(commit_path) as f:
+            commit = json.load(f)
+        for d in commit["docs"]:
+            self._buffer_docs[d["id"]] = (d["source"], d["type"])
+        self.versions = {k: (v[0], v[1]) for k, v in commit["versions"].items()}
+        if self._buffer_docs:
+            self.refresh()
 
     def _recover(self) -> None:
         n = 0
@@ -191,10 +209,8 @@ class Engine:
                     return GetResult(found=True, doc_id=doc_id, version=version,
                                      source=seg.stored[local],
                                      type_name=seg.types[local])
-            if doc_id in self._buffer_docs:   # not yet refreshed, non-realtime miss
-                src, tname = self._buffer_docs[doc_id]
-                return GetResult(found=True, doc_id=doc_id, version=version,
-                                 source=src, type_name=tname)
+            # non-realtime get sees only refreshed (searchable) state — an
+            # unrefreshed buffer doc is a miss (ref ShardGetService contract)
             return GetResult(found=False, doc_id=doc_id)
 
     # -- refresh / flush / merge ------------------------------------------
@@ -233,8 +249,8 @@ class Engine:
                 # may still want to purge deletes
                 if not any(s.live_count < s.n_docs for s in self.segments):
                     return
-            mapper = self.mappers.document_mapper("_doc")
-            merged = merge_segments(self.segments, self._next_seg_id, mapper)
+            merged = merge_segments(self.segments, self._next_seg_id,
+                                    self.mappers.document_mapper)
             self._next_seg_id += 1
             self.segments = [merged] if merged.n_docs else []
             self.merge_count += 1
@@ -275,32 +291,11 @@ class Engine:
 
     @staticmethod
     def open_committed(shard_path: str, mappers: MapperService, **kw) -> "Engine":
-        """Recover an engine: committed state + translog replay on top."""
-        import json
-        eng = Engine.__new__(Engine)
-        eng.path = shard_path
-        eng.mappers = mappers
-        os.makedirs(shard_path, exist_ok=True)
-        eng._lock = threading.RLock()
-        eng.segments = []
-        eng._buffer = SegmentBuilder(seg_id=0)
-        eng._buffer_docs = {}
-        eng._next_seg_id = 1
-        eng.versions = {}
-        eng._dirty = False
-        eng.refresh_count = 0
-        eng.flush_count = 0
-        eng.merge_count = 0
-        commit_path = os.path.join(shard_path, "commit.json")
-        if os.path.exists(commit_path):
-            with open(commit_path) as f:
-                commit = json.load(f)
-            for d in commit["docs"]:
-                eng._buffer_docs[d["id"]] = (d["source"], d["type"])
-            eng.versions = {k: (v[0], v[1]) for k, v in commit["versions"].items()}
-        eng.translog = Translog(os.path.join(shard_path, "translog"),
-                                kw.get("durability", "request"))
-        eng._recover()
+        """Recover an engine: committed state + translog replay on top.
+        (The plain constructor performs the same recovery; kept as the
+        explicit-recovery entry point.)"""
+        eng = Engine(shard_path, mappers,
+                     durability=kw.get("durability", "request"))
         eng.refresh()
         return eng
 

@@ -316,9 +316,14 @@ class SegmentBuilder:
 
 
 def merge_segments(segments: list[Segment], new_seg_id: int,
-                   mapper=None) -> Segment:
+                   mapper_for_type=None) -> Segment:
     """Merge segments, dropping tombstoned docs — the TieredMergePolicy analog
     (ref index/merge/; SURVEY.md §7 M1 'background merge = concat/re-sort').
+
+    `mapper_for_type`: callable type_name -> DocumentMapper so each doc is
+    re-parsed under its own type's mapping (the reference preserves per-type
+    schema across merges; a fixed mapper would silently re-tokenize keyword
+    fields as dynamic text).
 
     v1 strategy: replay stored sources through a rebuild. Exact and simple;
     a device-side concat+re-sort fast path can come later since postings are
@@ -327,16 +332,16 @@ def merge_segments(segments: list[Segment], new_seg_id: int,
     from ..mapping.mapper import DocumentMapper
     from ..analysis.analyzers import AnalysisService
 
+    if mapper_for_type is None:
+        _default = DocumentMapper("_doc", AnalysisService())
+        mapper_for_type = lambda tname: _default  # noqa: E731
+
     builder = SegmentBuilder(new_seg_id)
     for seg in segments:
         for local in range(seg.n_docs):
             if not seg.live_host[local]:
                 continue
             src = seg.stored[local]
-            if mapper is not None:
-                parsed = mapper.parse(src, doc_id=seg.ids[local])
-            else:
-                dm = DocumentMapper("_doc", AnalysisService())
-                parsed = dm.parse(src, doc_id=seg.ids[local])
+            parsed = mapper_for_type(seg.types[local]).parse(src, doc_id=seg.ids[local])
             builder.add(parsed, seg.types[local])
     return builder.build()

@@ -361,9 +361,7 @@ class ExistsNode(Node):
         elif kc is not None:
             match = jnp.broadcast_to(kc.ords[None, :] >= 0, (ctx.Q, ctx.n_pad))
         elif fx is not None:
-            match = jnp.broadcast_to((fx.doc_len > 1.0)[None, :] |
-                                     (fx.doc_len == 1.0)[None, :], (ctx.Q, ctx.n_pad))
-            # doc_len defaults to 1 for absent docs — approximate via postings presence
+            # a doc "has" a text field iff any posting references it
             hits = bm25.term_match_mask(
                 fx.doc_ids,
                 jnp.zeros((1, 1), jnp.int32),

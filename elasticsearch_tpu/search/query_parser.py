@@ -157,9 +157,9 @@ class QueryParser:
     def _parse_terms(self, spec: dict) -> Node:
         spec = dict(spec)
         spec.pop("minimum_should_match", None)
-        spec.pop("boost", None)
+        boost = float(spec.pop("boost", 1.0))
         (field, values), = spec.items()
-        return self._term_node(field, list(values), 1.0)
+        return self._term_node(field, list(values), boost)
 
     def _term_node(self, field: str, values: list, boost: float) -> Node:
         ft = self.mappers.field_type(field)
@@ -202,7 +202,6 @@ class QueryParser:
     def _parse_prefix(self, spec: dict) -> Node:
         (field, params), = spec.items()
         value = params.get("value", params.get("prefix")) if isinstance(params, dict) else params
-        from .query_dsl import Node as _N
         return MultiTermExpandNode(field_name=field, kind="prefix", pattern=str(value))
 
     def _parse_wildcard(self, spec: dict) -> Node:
@@ -328,15 +327,18 @@ class QueryParser:
         if qs.strip() in ("*", "*:*", ""):
             return MatchAllNode()
         tokens = re.findall(r'"[^"]*"|\S+', qs)
-        must: list[Node] = []
-        should: list[Node] = []
-        must_not: list[Node] = []
+        # clauses as (node, neg, req); AND is binary — it requires BOTH its
+        # operands (Lucene parses 'a AND b' as +a +b), so it retroactively
+        # promotes the previous clause too.
+        clauses: list[list] = []
         op_and = default_op == "and"
         pending_not = False
         pending_and = False
         for tok in tokens:
             if tok.upper() == "AND":
                 pending_and = True
+                if clauses and not clauses[-1][1]:
+                    clauses[-1][2] = True
                 continue
             if tok.upper() == "OR":
                 continue
@@ -365,7 +367,10 @@ class QueryParser:
                 terms = self._analyze(field, val)
                 node = MatchNode(field_name=field, terms_per_query=[terms]) if terms \
                     else MatchNoneNode()
-            (must_not if neg else (must if req else should)).append(node)
+            clauses.append([node, neg, req])
+        must = [n for n, neg, req in clauses if not neg and req]
+        should = [n for n, neg, req in clauses if not neg and not req]
+        must_not = [n for n, neg, _ in clauses if neg]
         if not should and not must and not must_not:
             return MatchAllNode()
         return BoolNode(must=must, should=should, must_not=must_not)
@@ -485,18 +490,24 @@ def _auto_fuzz(term: str, fuzz: str) -> int:
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Damerau-Levenshtein (with transpositions, Lucene's fuzzy default —
+    ref index/query/FuzzyQueryParser.java transpositions=true) <= k."""
     if k == 0:
         return a == b
+    prev2: list[int] | None = None
     prev = list(range(len(b) + 1))
     for i, ca in enumerate(a, 1):
         cur = [i] + [0] * len(b)
         row_min = i
         for j, cb in enumerate(b, 1):
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            if prev2 is not None and i > 1 and j > 1 \
+                    and ca == b[j - 2] and a[i - 2] == cb:
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
             row_min = min(row_min, cur[j])
         if row_min > k:
             return False
-        prev = cur
+        prev2, prev = prev, cur
     return prev[-1] <= k
 
 

@@ -124,9 +124,11 @@ class ShardSearcher:
                 order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
                 best_scores = np.take_along_axis(merged, order, axis=1)
                 best_keys = np.take_along_axis(merged_keys, order, axis=1)
-                seg_max = np.asarray(scores).max(axis=1) if track_scores else None
-                if seg_max is not None:
-                    max_score = np.maximum(max_score, seg_max)
+                if track_scores:
+                    # mask out non-matching / tombstoned docs before the max —
+                    # a deleted top doc must not leak its score into max_score
+                    masked_sc = np.where(np.asarray(match), np.asarray(scores), -np.inf)
+                    max_score = np.maximum(max_score, masked_sc.max(axis=1))
             else:
                 key_arr = self._sort_keys(seg, sort, Q)     # f64 [Q, N], asc-ready
                 masked = jnp.where(match, key_arr, jnp.inf)

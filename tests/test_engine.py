@@ -107,6 +107,49 @@ class TestDurability:
         assert eng2.get("99").found
         eng2.close()
 
+    def test_plain_constructor_recovers_commit(self, tmp_path):
+        """Reopening via the plain constructor must see flushed docs — the
+        commit point, not just the translog, is part of recovery."""
+        path = str(tmp_path / "s")
+        eng = Engine(path, MapperService())
+        for i in range(5):
+            eng.index(str(i), {"n": i})
+        eng.flush()   # docs now only in commit.json; translog trimmed
+        eng.close()
+        eng2 = Engine(path, MapperService())
+        assert eng2.doc_count() == 5
+        assert eng2.get("3").found
+        eng2.flush()  # a second flush must not wipe the recovered state
+        eng3 = Engine(path, MapperService())
+        assert eng3.doc_count() == 5
+        eng2.close()
+        eng3.close()
+
+    def test_non_realtime_get_sees_only_refreshed(self, tmp_path):
+        eng = Engine(str(tmp_path / "s"), MapperService())
+        eng.index("1", {"a": 1})
+        assert eng.get("1", realtime=True).found
+        assert not eng.get("1", realtime=False).found
+        eng.refresh()
+        assert eng.get("1", realtime=False).found
+        eng.close()
+
+    def test_merge_preserves_keyword_mapping(self, tmp_path):
+        """force_merge must re-parse docs under their own type's mapping, not
+        the dynamic '_doc' mapping (explicit keyword field stays keyword)."""
+        ms = MapperService()
+        ms.merge("blog", {"properties": {"tag": {"type": "keyword"}}})
+        eng = Engine(str(tmp_path / "s"), ms)
+        eng.index("1", {"tag": "Big Data"}, type_name="blog")
+        eng.refresh()
+        eng.index("2", {"tag": "other"}, type_name="blog")
+        eng.refresh()
+        eng.force_merge(max_num_segments=1)
+        seg = eng.segments[0]
+        kc = seg.keywords.get("tag")
+        assert kc is not None and "Big Data" in kc.values
+        eng.close()
+
     def test_translog_trimmed_after_flush(self, tmp_path):
         path = str(tmp_path / "s")
         eng = Engine(path, MapperService())

@@ -165,6 +165,18 @@ class TestQueries:
             "query": "title:programming AND tag_kw:book"}})
         assert int(res.total_hits[0]) == 2
 
+    def test_query_string_and_requires_both_operands(self, searcher):
+        # title:quick -> docs {0,1}; tag_kw:animal -> {0,1,2}; AND = {0,1}.
+        # A doc matching only the right operand (doc 2) must be excluded —
+        # Lucene parses 'a AND b' as +a +b.
+        res, hits = run(searcher, {"query_string": {
+            "query": "title:quick AND tag_kw:animal"}})
+        assert sorted(ids(hits)) == ["0", "1"]
+
+    def test_terms_boost(self, searcher):
+        _, hits = run(searcher, {"terms": {"tag_kw": ["book"], "boost": 2.0}})
+        assert all(abs(h.score - 2.0) < 1e-6 for h in hits)
+
     def test_function_score_fvf(self, searcher):
         _, hits = run(searcher, {"function_score": {
             "query": {"term": {"tag_kw": "book"}},

@@ -1,0 +1,113 @@
+"""Headline benchmark: batched BM25 top-1000 QPS (BASELINE.json config #1/#5
+workload shape: match-query scoring over a ~1M-doc corpus, k=1000) using the
+sort-reduce sparse kernel (ops/bm25_sparse.py).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Timing method: NB query batches are chained inside ONE jitted lax.scan and
+synchronized by fetching the result to host — device-queue semantics under
+the hosted TPU tunnel make per-step block_until_ready unreliable, and the
+host fetch also amortizes the ~100ms tunnel round-trip across all NB steps.
+
+vs_baseline is measured in-process: the identical XLA program on the host CPU
+backend (the stand-in for the reference's CPU scoring path until a stock-ES
+side-by-side exists; BASELINE.md documents the ladder). >1.0 = faster than
+CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+# make the CPU backend available alongside the accelerator for the baseline leg
+_plat = os.environ.get("JAX_PLATFORMS", "")
+if _plat and "cpu" not in _plat.split(","):
+    os.environ["JAX_PLATFORMS"] = _plat + ",cpu"
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from __graft_entry__ import _synthetic_segment  # noqa: E402
+from elasticsearch_tpu.ops.bm25_sparse import bm25_topk_sparse  # noqa: E402
+
+N_DOCS = 1 << 20          # ~1M docs
+VOCAB = 1 << 17
+AVG_DL = 64
+Q = 64                    # query batch per step
+K = 1000                  # top-1000 (headline metric)
+T = 4                     # terms per query
+NB = 8                    # steps chained per timed call
+REPS = 3
+
+
+def build_chained(Wt: int):
+    kern = partial(bm25_topk_sparse, Wt=Wt, k=K, n_docs=N_DOCS)
+
+    @jax.jit
+    def chained(doc_ids, tf, dl, qs, ql, w):
+        def body(carry, batch):
+            s, ln, ww = batch
+            top, docs, hits = kern(doc_ids, tf, dl, s, ln, ww,
+                                   jnp.float32(1.2), jnp.float32(0.75),
+                                   jnp.float32(AVG_DL))
+            # fold outputs into a tiny carry so nothing is dead-code-eliminated
+            return carry + top[:, 0].sum() + docs[:, 0].sum() + hits.sum(), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), (qs, ql, w))
+        return acc
+    return chained
+
+
+def run_on(device, postings, batches, Wt):
+    args = [jax.device_put(a, device) for a in postings + batches]
+    chained = build_chained(Wt)
+    float(chained(*args))                      # compile + first exec
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        float(chained(*args))                  # host fetch = true sync
+    dt = (time.perf_counter() - t0) / REPS
+    return NB * Q / dt
+
+
+def main():
+    doc_ids, tf, doc_len, term_starts, term_lens = _synthetic_segment(
+        N_DOCS, VOCAB, AVG_DL, seed=7)
+    dl = doc_len[doc_ids].astype(np.float32)   # per-posting doc length
+
+    rng = np.random.default_rng(42)
+    tids = rng.integers(64, 8192, size=(NB, Q, T))
+    qs = term_starts[tids].astype(np.int32)
+    ql = term_lens[tids].astype(np.int32)
+    w = np.abs(rng.normal(2.0, 0.5, (NB, Q, T))).astype(np.float32)
+    Wt = 1 << int(np.ceil(np.log2(max(8, ql.max()))))
+
+    pad = lambda a, fill: np.concatenate(   # noqa: E731
+        [a, np.full(Wt, fill, a.dtype)])
+    postings = [pad(doc_ids, N_DOCS), pad(tf, 0), pad(dl, 1)]
+    batches = [qs, ql, w]
+
+    main_dev = jax.devices()[0]
+    qps = run_on(main_dev, postings, batches, Wt)
+
+    vs = 1.0
+    if main_dev.platform != "cpu":
+        try:
+            cpu = jax.devices("cpu")[0]
+            cpu_qps = run_on(cpu, postings, batches, Wt)
+            vs = qps / cpu_qps
+        except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
+            print(f"cpu baseline unavailable: {e}", file=sys.stderr)
+
+    print(json.dumps({"metric": "bm25_top1000_qps_1M_docs",
+                      "value": round(qps, 2), "unit": "qps",
+                      "vs_baseline": round(vs, 3)}))
+
+
+if __name__ == "__main__":
+    main()

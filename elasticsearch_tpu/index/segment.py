@@ -34,6 +34,7 @@ from ..mapping.mapper import (
     ParsedDocument, FieldType, TEXT, KEYWORD, DATE, BOOLEAN, IP,
     NUMERIC_TYPES, _INT_TYPES, DENSE_VECTOR,
 )
+from ..ops.bm25_sparse import required_padding
 
 
 def next_pow2(n: int, floor: int = 8) -> int:
@@ -55,15 +56,20 @@ def pad_to(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
 @dataclass
 class TextFieldIndex:
     """CSR postings for one text field (ref: Lucene postings lists, consumed
-    by ops/bm25.py instead of BulkScorer)."""
+    by ops/bm25.py (dense) and ops/bm25_sparse.py (sort-reduce hot path)
+    instead of BulkScorer)."""
     terms: dict[str, int]            # term -> term id (lexicographic)
     term_starts: np.ndarray          # i32[V] host: CSR starts
     term_lens: np.ndarray            # i32[V] host: postings length == df
     doc_ids: jax.Array               # i32[P_pad] device
     tf: jax.Array                    # f32[P_pad] device
     doc_len: jax.Array               # f32[N_pad] device
+    dl: jax.Array                    # f32[P_pad] device: per-POSTING doc len
+                                     # (denormalized so the sparse kernel
+                                     # needs no doc_len[doc] gather)
     sum_dl: float                    # Σ field length (for avgdl)
     n_postings: int                  # un-padded P
+    max_df: int = 0                  # largest postings list (slot budgeting)
 
     def lookup(self, term: str) -> tuple[int, int, int]:
         """-> (start, length==df, term_id) or (0, 0, -1) if absent."""
@@ -173,7 +179,8 @@ class Segment:
     def memory_bytes(self) -> int:
         total = 0
         for fx in self.text.values():
-            total += fx.doc_ids.size * 4 + fx.tf.size * 4 + fx.doc_len.size * 4
+            total += fx.doc_ids.size * 4 + fx.tf.size * 4 + fx.doc_len.size * 4 \
+                + fx.dl.size * 4
         for kc in self.keywords.values():
             total += kc.ords.size * 4
         for nc in self.numerics.values():
@@ -253,8 +260,9 @@ class SegmentBuilder:
             if len(lens):
                 starts[1:] = np.cumsum(lens)[:-1]
             P = int(lens.sum())
-            p_pad = next_pow2(P, floor=8)
-            doc_ids = np.zeros(p_pad, np.int32)
+            max_df = int(lens.max()) if len(lens) else 0
+            p_pad = required_padding(P, max_df)
+            doc_ids = np.full(p_pad, n_pad, np.int32)   # PAD sentinel
             tf = np.zeros(p_pad, np.float32)
             pos = 0
             for t in terms_sorted:
@@ -266,11 +274,14 @@ class SegmentBuilder:
             doc_len = np.ones(n_pad, np.float32)  # pad with 1 to avoid div-by-0
             for d, L in dl_map.items():
                 doc_len[d] = max(L, 1.0)
+            dl = np.ones(p_pad, np.float32)
+            dl[:P] = doc_len[np.minimum(doc_ids[:P], n_pad - 1)]
             text[field] = TextFieldIndex(
                 terms=term_ids, term_starts=starts, term_lens=lens,
                 doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
-                doc_len=jnp.asarray(doc_len),
-                sum_dl=float(sum(dl_map.values())), n_postings=P)
+                doc_len=jnp.asarray(doc_len), dl=jnp.asarray(dl),
+                sum_dl=float(sum(dl_map.values())), n_postings=P,
+                max_df=max_df)
 
         keywords: dict[str, KeywordColumn] = {}
         for field, val_map in self._keywords.items():

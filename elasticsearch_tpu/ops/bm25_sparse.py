@@ -1,0 +1,109 @@
+"""Sort-reduce BM25 top-k: the gather/scatter-free TPU hot kernel.
+
+Why not the dense formulation (ops/bm25.py)? On TPU, arbitrary gathers
+(doc_ids[idx]) and scatter-adds into a [Q, N] score matrix serialize into
+dynamic-slice loops — measured ~25x slower than this kernel at 1M docs.
+This kernel touches postings ONLY through contiguous `dynamic_slice` DMAs
+and never materializes per-doc state:
+
+  1. slice    — each (query, term) loads its postings block [Wt] with three
+                contiguous slices (doc ids, tf, per-posting dl). Per-posting
+                dl (denormalized at segment build) kills the doc_len[doc]
+                gather entirely.
+  2. score    — elementwise BM25 impact × per-term weight (idf*(k1+1)*boost),
+                matching Lucene's BM25Similarity term-at-a-time contribution
+                (ref /root/reference/src/main/java/org/elasticsearch/index/
+                similarity/BM25SimilarityProvider.java; QueryPhase hot loop
+                search/query/QueryPhase.java:144-154).
+  3. sort     — lax.sort the (doc, contrib) pairs per query: same-doc
+                contributions become adjacent runs. Postings are doc-sorted
+                per term, so a run's length is at most T (one entry per
+                query term).
+  4. reduce   — windowed segment-sum: run length <= T means per-doc totals
+                need only T-1 shifted compare-adds — no segment_sum scatter.
+  5. top-k    — lax.top_k over the W = T*Wt slots (slots, not the N-doc
+                space): the "never materialize the full score vector" move
+                (SURVEY.md §5.7), with doc-id-ascending tie-break like
+                Lucene's priority queue.
+
+The per-term slot budget Wt is a static pow2 bucket >= the largest df among
+the query batch's terms; compile cache stays small, padding is masked.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def required_padding(n_postings: int, max_df: int) -> int:
+    """Physical postings padding so any term slice start+Wt stays in bounds
+    (dynamic_slice clamps out-of-range starts, which would silently read a
+    neighboring term's postings). THE single source of this invariant —
+    segment build and shard packing must both use it, together with
+    `slot_budget` for Wt, or slices can clamp."""
+    from ..index.segment import next_pow2
+    return next_pow2(n_postings + next_pow2(max_df, floor=8), floor=8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("Wt", "k", "n_docs", "with_positions"))
+def bm25_topk_sparse(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
+                     term_starts: jax.Array, term_lens: jax.Array,
+                     weights: jax.Array, k1, b, avgdl, *,
+                     Wt: int, k: int, n_docs: int,
+                     with_positions: bool = False):
+    """Batched BM25 top-k over one postings block.
+
+    doc_ids i32[P], tf f32[P], dl f32[P]: postings (P >= max start + Wt —
+    use `required_padding`). term_starts/term_lens i32[Q,T]; weights f32[Q,T].
+    Returns (top_scores f32[Q,k], top_docs i32[Q,k], total_hits i32[Q]).
+    Empty slots: score -inf, doc == n_docs.
+    """
+    Q, T = term_starts.shape
+    PAD = jnp.int32(n_docs)
+
+    def slice_term(s, ln):
+        d = jax.lax.dynamic_slice(doc_ids, (s,), (Wt,))
+        t = jax.lax.dynamic_slice(tf, (s,), (Wt,))
+        l = jax.lax.dynamic_slice(dl, (s,), (Wt,))
+        valid = jnp.arange(Wt, dtype=jnp.int32) < ln
+        return jnp.where(valid, d, PAD), t, l, valid
+
+    d, t, l, valid = jax.vmap(jax.vmap(slice_term))(term_starts, term_lens)
+
+    norm = k1 * (1.0 - b + b * l / avgdl)
+    impact = t / (t + norm)
+    contrib = jnp.where(valid, weights[:, :, None] * impact, 0.0)
+
+    W = T * Wt
+    d = d.reshape(Q, W)
+    contrib = contrib.reshape(Q, W).astype(jnp.float32)
+    d, contrib = jax.lax.sort((d, contrib), dimension=1, num_keys=1)
+
+    # windowed segment-sum: totals land on each run's last slot
+    total = contrib
+    for j in range(1, T):
+        same = d == jnp.roll(d, j, axis=1)
+        same = same.at[:, :j].set(False)
+        total = total + jnp.where(same, jnp.roll(contrib, j, axis=1), 0.0)
+
+    is_real = d < PAD
+    ends = jnp.concatenate([d[:, :-1] != d[:, 1:], jnp.ones((Q, 1), bool)],
+                           axis=1) & is_real
+    masked = jnp.where(ends, total, -jnp.inf)
+
+    top, pos = jax.lax.top_k(masked, min(k, W))
+    top_docs = jnp.where(top > -jnp.inf,
+                         jnp.take_along_axis(d, pos, axis=1), PAD)
+    total_hits = jnp.sum(ends, axis=1, dtype=jnp.int32)
+    return top, top_docs, total_hits
+
+
+def slot_budget(term_lens) -> int:
+    """Static per-term slot budget for a query batch: pow2 >= max df."""
+    import numpy as np
+    from ..index.segment import next_pow2
+    return next_pow2(int(np.asarray(term_lens).max()), floor=8)

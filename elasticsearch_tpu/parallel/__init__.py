@@ -1,0 +1,19 @@
+"""Mesh parallelism: doc routing, packed shard tensors, SPMD search.
+
+The data-plane replacement for the reference's scatter-gather RPC protocol
+(SURVEY.md §2.10, §5.8): shards and replicas are mesh axes, reduces are XLA
+collectives over ICI instead of coordinator merge loops.
+"""
+
+from .routing import djb_hash, shard_id, select_copy
+from .mesh import make_mesh, index_sharding, query_sharding, replicated, \
+    SHARD_AXIS, REPLICA_AXIS
+from .packed import PackedIndex, PackedTextField
+from .distributed_search import DistributedSearcher
+
+__all__ = [
+    "djb_hash", "shard_id", "select_copy",
+    "make_mesh", "index_sharding", "query_sharding", "replicated",
+    "SHARD_AXIS", "REPLICA_AXIS",
+    "PackedIndex", "PackedTextField", "DistributedSearcher",
+]

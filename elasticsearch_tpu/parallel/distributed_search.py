@@ -1,0 +1,165 @@
+"""The SPMD distributed query phase: one compiled program replaces the
+reference's scatter-gather network protocol.
+
+Reference flow (SURVEY.md §3.2): coordinator fans per-shard RPCs
+("indices:data/read/search[phase/query]"), each data node runs Lucene top-k,
+coordinator merges via TopDocs.merge (SearchPhaseController.java:147,233).
+
+TPU-native flow (this module): the whole fan-out/gather is ONE jitted
+shard_map over a ("replica", "shard") mesh:
+
+  1. DFS stats all-reduce — psum of per-shard df / doc_count / sum_dl over
+     the "shard" axis gives exact global IDF (the reference's optional
+     DFS_QUERY_THEN_FETCH phase, search/dfs/DfsPhase.java:57-81, made free:
+     it's a tiny psum riding ICI, not an extra network round-trip).
+  2. Per-shard batched BM25 via the sort-reduce kernel (ops/bm25_sparse —
+     contiguous postings DMAs, no gather/scatter, no [Q, N] score matrix).
+  3. Per-shard top-k keys tagged (shard << 32 | local).
+  4. Cross-shard reduce — all_gather over "shard" + top_k, the collective
+     analog of SearchPhaseController.sortDocs.
+
+total_hits is a psum; max_score a pmax. Queries are sharded over "replica"
+so R replica groups serve disjoint slices of the query batch concurrently —
+the reference's replica load-balancing (§2.10.2) as an SPMD axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import bm25 as bm25_ops
+from ..ops.bm25_sparse import bm25_topk_sparse
+from .mesh import SHARD_AXIS, REPLICA_AXIS
+from .packed import PackedIndex
+
+K1_DEFAULT = 1.2
+B_DEFAULT = 0.75
+
+
+def _query_step(doc_ids, tf, dl, sum_dl, doc_counts,
+                term_starts, term_lens, boosts, *, Wt: int, n_pad: int,
+                k: int, k1: float, b: float):
+    """Per-device block of the distributed query phase (runs under shard_map;
+    leading shard axis of every block is 1 and squeezed here)."""
+    doc_ids = doc_ids[0]          # i32[P]
+    tf = tf[0]                    # f32[P]
+    dl = dl[0]                    # f32[P]
+    term_starts = term_starts[0]  # i32[Qb, T]
+    term_lens = term_lens[0]      # i32[Qb, T]
+    boosts = boosts[0]            # f32[Qb, T]
+
+    # (1) DFS stats all-reduce: exact global IDF via psum over the shard axis
+    df_global = lax.psum(term_lens, SHARD_AXIS)                 # i32[Qb, T]
+    doc_count_g = lax.psum(doc_counts[0], SHARD_AXIS)           # i32
+    sum_dl_g = lax.psum(sum_dl[0], SHARD_AXIS)                  # f32
+    avgdl = sum_dl_g / jnp.maximum(doc_count_g.astype(jnp.float32), 1.0)
+    weights = (bm25_ops.idf(df_global, doc_count_g) * (k1 + 1.0) * boosts
+               ).astype(jnp.float32)
+
+    # (2) per-shard sort-reduce BM25 top-k
+    top, docs, hits = bm25_topk_sparse(
+        doc_ids, tf, dl, term_starts, term_lens, weights,
+        jnp.float32(k1), jnp.float32(b), avgdl,
+        Wt=Wt, k=k, n_docs=n_pad)
+
+    # (3) globally-addressable keys
+    my_shard = lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+    keys = jnp.where(top > -jnp.inf,
+                     (my_shard << 32) | docs.astype(jnp.int64),
+                     jnp.int64(-1))
+
+    # (4) cross-shard top-k reduce (SearchPhaseController.sortDocs as a
+    # collective): all_gather candidate sets, reduce to global top-k
+    g_scores = lax.all_gather(top, SHARD_AXIS)                  # [S, Qb, kk]
+    g_keys = lax.all_gather(keys, SHARD_AXIS)
+    S, Qb, kk = g_scores.shape
+    g_scores = jnp.transpose(g_scores, (1, 0, 2)).reshape(Qb, S * kk)
+    g_keys = jnp.transpose(g_keys, (1, 0, 2)).reshape(Qb, S * kk)
+    out_scores, pos = lax.top_k(g_scores, min(k, S * kk))
+    out_keys = jnp.take_along_axis(g_keys, pos, axis=-1)
+
+    total = lax.psum(hits.astype(jnp.int64), SHARD_AXIS)
+    max_score = lax.pmax(top[:, 0], SHARD_AXIS)
+    return out_scores, out_keys, total, max_score
+
+
+@dataclass
+class DistributedSearcher:
+    """Compiled distributed query phase over a packed index + mesh."""
+    index: PackedIndex
+    mesh: jax.sharding.Mesh
+
+    def __post_init__(self):
+        # jit caches by function identity — memoize compiled steps per
+        # static config or every search would retrace + recompile
+        self._steps: dict[tuple, object] = {}
+
+    def place(self):
+        """Shard the packed index onto the mesh (one device_put per array;
+        after this, queries run with zero host→device index traffic)."""
+        from .mesh import index_sharding
+        sh = index_sharding(self.mesh)
+        self.index.live = jax.device_put(self.index.live, sh)
+        self.index.doc_counts = jax.device_put(self.index.doc_counts, sh)
+        for f in self.index.text.values():
+            f.doc_ids = jax.device_put(f.doc_ids, sh)
+            f.tf = jax.device_put(f.tf, sh)
+            f.dl = jax.device_put(f.dl, sh)
+            f.sum_dl = jax.device_put(f.sum_dl, sh)
+        return self
+
+    def build_step(self, *, Wt: int, k: int,
+                   k1: float = K1_DEFAULT, b: float = B_DEFAULT):
+        """jit(shard_map) of the query step, memoized per static config."""
+        key = (Wt, k, k1, b)
+        cached = self._steps.get(key)
+        if cached is not None:
+            return cached
+        n_pad = self.index.n_pad
+        fn = functools.partial(_query_step, Wt=Wt, n_pad=n_pad, k=k,
+                               k1=k1, b=b)
+        shard_specs = P(SHARD_AXIS)
+        query_specs = P(SHARD_AXIS, REPLICA_AXIS)
+        out_specs = (P(REPLICA_AXIS), P(REPLICA_AXIS),
+                     P(REPLICA_AXIS), P(REPLICA_AXIS))
+        mapped = jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(shard_specs,) * 5 + (query_specs,) * 3,
+            out_specs=out_specs, check_vma=False)
+        step = jax.jit(mapped)
+        self._steps[key] = step
+        return step
+
+    def search_terms(self, field: str, queries: list[list[str]], *,
+                     k: int = 10, boosts: np.ndarray | None = None,
+                     k1: float = K1_DEFAULT, b: float = B_DEFAULT):
+        """End-to-end: host query prep -> device SPMD step -> host results.
+
+        Returns (scores f32[Q,k], keys i64[Q,k], total i64[Q], max f32[Q]).
+        """
+        fx = self.index.text[field]
+        n_rep = self.mesh.shape[REPLICA_AXIS]
+        Q = len(queries)
+        q_pad = -(-Q // n_rep) * n_rep
+        queries = queries + [[] for _ in range(q_pad - Q)]
+        ts, tl = self.index.prepare_term_queries(field, queries)
+        Wt = self.index.slot_budget(tl)
+        if boosts is None:
+            bsts = jnp.ones(ts.shape, jnp.float32)
+        else:
+            b_arr = np.ones((q_pad,) + boosts.shape[1:], np.float32)
+            b_arr[:Q] = boosts
+            bsts = jnp.broadcast_to(jnp.asarray(b_arr)[None], ts.shape)
+        step = self.build_step(Wt=Wt, k=k, k1=k1, b=b)
+        scores, keys, total, mx = step(
+            fx.doc_ids, fx.tf, fx.dl, fx.sum_dl, self.index.doc_counts,
+            ts, tl, bsts)
+        return (np.asarray(scores)[:Q], np.asarray(keys)[:Q],
+                np.asarray(total)[:Q], np.asarray(mx)[:Q])

@@ -1,0 +1,53 @@
+"""Device mesh construction for the search data plane.
+
+Axes (the search-engine analog of an ML parallelism layout, SURVEY.md §2.10):
+  * "shard"   — document partitions (data parallelism over the corpus);
+                the index's stacked shard axis is sharded here.
+  * "replica" — query-batch parallelism (replica groups serving QPS);
+                the query batch is sharded here, the index is REPLICATED
+                here — exactly the reference's "R copies per shard serve
+                reads in parallel" (§2.10.2), but as a mesh axis instead
+                of copied JVMs.
+
+Cross-shard reduces (df psum, top-k all_gather) ride the "shard" axis —
+on hardware these become ICI collectives; across pods XLA lowers them to
+DCN automatically. The control plane (cluster state, doc transport) stays
+host-side RPC, mirroring the reference's split (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+REPLICA_AXIS = "replica"
+
+
+def make_mesh(n_shards: int | None = None, n_replicas: int = 1,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if n_shards is None:
+        n_shards = len(devices) // n_replicas
+    need = n_shards * n_replicas
+    if need > len(devices):
+        raise ValueError(f"mesh {n_replicas}x{n_shards} needs {need} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_replicas, n_shards)
+    return Mesh(arr, (REPLICA_AXIS, SHARD_AXIS))
+
+
+def index_sharding(mesh: Mesh) -> NamedSharding:
+    """Index tensors: leading shard axis split over "shard", replicated over
+    "replica" (every replica group holds a full copy — the R-copies model)."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def query_sharding(mesh: Mesh) -> NamedSharding:
+    """Per-shard query tensors [S, Q, ...]: S over "shard", Q over "replica"."""
+    return NamedSharding(mesh, P(SHARD_AXIS, REPLICA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,150 @@
+"""Packed multi-shard index: every shard's hot tensors stacked on a leading
+shard axis so the whole index is ONE pytree shardable over a device mesh.
+
+This is the TPU-native replacement for the reference's "N independent shard
+JVMs" layout (SURVEY.md §2.10.1): shard i of the reference becomes slice i of
+each stacked array, `jax.sharding` places slices on devices, and the query
+fan-out (ref action/search/type/TransportSearchTypeAction.java:124 per-shard
+network sends) becomes a single SPMD program over the mesh — no per-shard RPC
+on the data plane at all.
+
+Uniform shapes across shards (padding to the max, pow2-bucketed) are the price
+of SPMD; BASELINE's hash routing keeps shard sizes balanced so the padding
+waste is bounded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..index.segment import Segment, next_pow2, pad_to
+from ..ops.bm25_sparse import required_padding, slot_budget as _slot_budget
+
+
+@dataclass
+class PackedTextField:
+    """One text field across S shards (device arrays lead with shard axis).
+    No per-doc doc_len column here: the sparse kernel reads the denormalized
+    per-posting `dl` instead, so a [S, N_pad] doc_len would be dead HBM."""
+    field: str
+    doc_ids: jax.Array        # i32[S, P_pad]
+    tf: jax.Array             # f32[S, P_pad]
+    dl: jax.Array             # f32[S, P_pad] per-posting doc length
+    sum_dl: jax.Array         # f32[S]
+    max_df: int               # largest postings list across shards
+    # host-side per-shard term dicts for query preparation
+    terms: list[dict[str, int]]
+    term_starts: list[np.ndarray]
+    term_lens: list[np.ndarray]
+
+
+@dataclass
+class PackedIndex:
+    """S shards of one index, packed for SPMD execution."""
+    n_shards: int
+    n_pad: int                # uniform padded doc capacity per shard
+    live: jax.Array           # bool[S, N_pad]
+    doc_counts: jax.Array     # i32[S] live doc count per shard
+    text: dict[str, PackedTextField]
+    # fetch-phase host state: per-shard stored sources + ids
+    ids: list[list[str]]
+    stored: list[list[dict]]
+
+    @staticmethod
+    def from_segments(shard_segments: list[Segment]) -> "PackedIndex":
+        """Pack one merged segment per shard. (Engines force_merge to 1
+        segment before packing — the merged-tensor analog of an fsynced
+        Lucene commit.)"""
+        S = len(shard_segments)
+        for seg in shard_segments:
+            if seg.live_count < seg.n_docs:
+                raise ValueError(
+                    f"segment {seg.seg_id} has tombstones; force_merge the "
+                    "shard before packing (the sparse scoring kernel assumes "
+                    "all packed docs are live)")
+        n_pad = max(next_pow2(s.n_docs) for s in shard_segments)
+
+        live = np.zeros((S, n_pad), bool)
+        counts = np.zeros((S,), np.int32)
+        fields: set[str] = set()
+        for si, seg in enumerate(shard_segments):
+            live[si, :seg.n_docs] = seg.live_host[:seg.n_docs]
+            counts[si] = seg.live_count
+            fields.update(seg.text.keys())
+
+        text: dict[str, PackedTextField] = {}
+        for f in sorted(fields):
+            max_df = max((seg.text[f].max_df for seg in shard_segments
+                          if f in seg.text), default=0)
+            # shared sparse-kernel invariant (ops/bm25_sparse.required_padding)
+            p_pad = max(required_padding(seg.text[f].n_postings, max_df)
+                        if f in seg.text else 8 for seg in shard_segments)
+            doc_ids = np.full((S, p_pad), n_pad, np.int32)  # PAD sentinel
+            tf = np.zeros((S, p_pad), np.float32)
+            dl = np.ones((S, p_pad), np.float32)
+            sum_dl = np.zeros((S,), np.float32)
+            terms, t_starts, t_lens = [], [], []
+            for si, seg in enumerate(shard_segments):
+                fx = seg.text.get(f)
+                if fx is None:
+                    terms.append({})
+                    t_starts.append(np.zeros(0, np.int32))
+                    t_lens.append(np.zeros(0, np.int32))
+                    continue
+                np_doc_ids = np.asarray(fx.doc_ids)[:fx.n_postings]
+                doc_ids[si, :fx.n_postings] = np_doc_ids
+                tf[si, :fx.n_postings] = np.asarray(fx.tf)[:fx.n_postings]
+                dl[si, :fx.n_postings] = np.asarray(fx.dl)[:fx.n_postings]
+                sum_dl[si] = fx.sum_dl
+                terms.append(fx.terms)
+                t_starts.append(fx.term_starts)
+                t_lens.append(fx.term_lens)
+            text[f] = PackedTextField(
+                field=f, doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
+                dl=jnp.asarray(dl), sum_dl=jnp.asarray(sum_dl), max_df=max_df,
+                terms=terms, term_starts=t_starts, term_lens=t_lens)
+
+        ids = [list(seg.ids) for seg in shard_segments]
+        stored = [list(seg.stored) for seg in shard_segments]
+        return PackedIndex(n_shards=S, n_pad=n_pad, live=jnp.asarray(live),
+                           doc_counts=jnp.asarray(counts), text=text,
+                           ids=ids, stored=stored)
+
+    def prepare_term_queries(self, field: str, queries: list[list[str]],
+                             t_pad: int | None = None):
+        """Host-side query prep: per-shard CSR starts/lens for each query's
+        terms -> (term_starts i32[S,Q,T], term_lens i32[S,Q,T]).
+
+        Per-shard lookups differ because each shard has its own term dict
+        (exactly like per-shard Lucene term dictionaries); the device program
+        psums df across shards for global IDF (the DFS phase, SURVEY §2.10.4).
+        """
+        S, Q = self.n_shards, len(queries)
+        T = t_pad or max(1, max(len(q) for q in queries))
+        fx = self.text[field]
+        starts = np.zeros((S, Q, T), np.int32)
+        lens = np.zeros((S, Q, T), np.int32)
+        for si in range(S):
+            tdict = fx.terms[si]
+            ts, tl = fx.term_starts[si], fx.term_lens[si]
+            for qi, q in enumerate(queries):
+                for ti, term in enumerate(q[:T]):
+                    tid = tdict.get(term, -1)
+                    if tid >= 0:
+                        starts[si, qi, ti] = ts[tid]
+                        lens[si, qi, ti] = tl[tid]
+        return jnp.asarray(starts), jnp.asarray(lens)
+
+    def slot_budget(self, term_lens) -> int:
+        """Static per-term slot budget Wt (shared rule: ops/bm25_sparse)."""
+        return _slot_budget(term_lens)
+
+    def fetch(self, global_key: int) -> tuple[str, dict]:
+        """Resolve (shard << 32 | local) to (doc_id, source)."""
+        shard = global_key >> 32
+        local = global_key & 0xFFFFFFFF
+        return self.ids[shard][local], self.stored[shard][local]

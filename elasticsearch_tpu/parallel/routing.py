@@ -1,0 +1,43 @@
+"""Document→shard routing — the OperationRouting analog.
+
+ref /root/reference/src/main/java/org/elasticsearch/cluster/routing/OperationRouting.java:48,60
+(shard = hash(routing ?: id) % numShards) with the default DJB hash
+(cluster/routing/DjbHashFunction.java:28). We keep the exact partition
+function so a doc corpus routed by the reference lands on the same shard
+numbers here — routing parity matters for cross-validating shard contents.
+"""
+
+from __future__ import annotations
+
+
+def djb_hash(value: str) -> int:
+    """DJB2 over UTF-16 code units, as the reference's DjbHashFunction:
+    hash = 5381; hash = 33*hash + char; truncated to signed int32.
+    Java's charAt iterates UTF-16 units (surrogate pairs count as two), so we
+    hash utf-16 code units, not Python code points — non-BMP ids route
+    identically to the reference."""
+    h = 5381
+    data = value.encode("utf-16-le")
+    for i in range(0, len(data), 2):
+        unit = data[i] | (data[i + 1] << 8)
+        h = ((h * 33) + unit) & 0xFFFFFFFF
+    # Java ints are signed 32-bit
+    if h >= 0x80000000:
+        h -= 0x100000000
+    return h
+
+
+def shard_id(doc_id: str, num_shards: int, routing: str | None = None) -> int:
+    """MathUtils.mod(hash, numShards) — floor mod, NOT abs
+    (ref OperationRouting.java shardId → common/math/MathUtils.java:28)."""
+    h = djb_hash(routing if routing is not None else doc_id)
+    return h % num_shards  # Python % is floor-mod, matching MathUtils.mod
+
+
+def select_copy(shard: int, n_copies: int, preference: str | None = None,
+                session_seed: int = 0) -> int:
+    """Pick a shard copy for reads (ref OperationRouting.java:144-154 —
+    round-robin/preference across primary+replicas)."""
+    if preference == "_primary" or n_copies <= 1:
+        return 0
+    return (session_seed + shard) % n_copies

@@ -12,11 +12,15 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The hosted environment prepends its own TPU platform to jax_platforms even
+# when the env var says cpu; re-pin after import (before backend init).
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices():
-    import jax
-
     return jax.devices()

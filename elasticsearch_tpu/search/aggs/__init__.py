@@ -1,0 +1,15 @@
+"""Aggregations (ref search/aggregations/, SURVEY.md §2.6)."""
+
+from .aggregators import (
+    AggSpec, AggregationParsingException, parse_aggs, collect_shard,
+    merge_partial, merge_shard_partials, render,
+    BUCKET_TYPES, METRIC_TYPES,
+)
+from .hll import HyperLogLog
+from .tdigest import TDigest
+
+__all__ = [
+    "AggSpec", "AggregationParsingException", "parse_aggs", "collect_shard",
+    "merge_partial", "merge_shard_partials", "render",
+    "BUCKET_TYPES", "METRIC_TYPES", "HyperLogLog", "TDigest",
+]

@@ -1,0 +1,561 @@
+"""Aggregations: composable analytics tree over columnar fielddata.
+
+The analog of the reference aggregation framework
+(/root/reference/src/main/java/org/elasticsearch/search/aggregations/ —
+Aggregator collect-per-doc -> InternalAggregation reduce-across-shards,
+AggregationPhase.java:45,70-95). Execution model here is tensor-native
+instead of per-doc collectors:
+
+  collect  — per segment, the query's match mask (bool[n_pad], the same mask
+             the scoring pass produced) gates vectorized column reductions:
+             bucket assignment is one vectorized expression, counts/sums are
+             np.bincount / ufunc.at over the whole column at once.
+  partial  — a small, host-side, *mergeable* summary per shard, mirroring
+             InternalAggregation's wire objects (sum/count/min/max pairs,
+             HLL registers, t-digest centroids, bucket->count maps).
+  reduce   — partials merge associatively across segments and shards
+             (ref InternalAggregations.reduce via SearchPhaseController
+             .merge:282-399); in the mesh data plane these merges ride
+             collectives (counts psum) — host merge is the DCN fallback.
+  render   — ES 2.0 response JSON shapes (buckets / value / values).
+
+Bucket aggs: terms, histogram, date_histogram, range, date_range, filter,
+filters, global, missing. Metric aggs: min, max, sum, avg, value_count,
+stats, extended_stats, cardinality (HLL), percentiles (t-digest).
+Sub-aggregations nest arbitrarily under bucket aggs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+from datetime import datetime, timezone
+from typing import Any, Callable
+
+import numpy as np
+
+from ...index.segment import Segment
+from .hll import HyperLogLog, _hash64
+from .tdigest import TDigest
+
+BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
+                "filter", "filters", "global", "missing"}
+METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
+                "extended_stats", "cardinality", "percentiles"}
+
+
+class AggregationParsingException(Exception):
+    pass
+
+
+@dataclass
+class AggSpec:
+    name: str
+    type: str
+    params: dict
+    subs: list["AggSpec"] = dc_field(default_factory=list)
+
+
+def parse_aggs(spec: dict | None) -> list[AggSpec]:
+    """Parse the request's "aggs"/"aggregations" tree
+    (ref search/aggregations/AggregatorParsers.java)."""
+    if not spec:
+        return []
+    out = []
+    for name, body in spec.items():
+        subs = []
+        agg_type = None
+        params: dict = {}
+        for key, val in body.items():
+            if key in ("aggs", "aggregations"):
+                subs = parse_aggs(val)
+            elif key in BUCKET_TYPES or key in METRIC_TYPES:
+                agg_type, params = key, (val if isinstance(val, dict) else {})
+            else:
+                raise AggregationParsingException(
+                    f"unknown aggregation type [{key}] under [{name}]")
+        if agg_type is None:
+            raise AggregationParsingException(f"no type for aggregation [{name}]")
+        if subs and agg_type in METRIC_TYPES:
+            raise AggregationParsingException(
+                f"metric aggregation [{name}] cannot have sub-aggregations")
+        out.append(AggSpec(name=name, type=agg_type, params=params, subs=subs))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Column access
+# ---------------------------------------------------------------------------
+
+def _numeric_column(seg: Segment, field: str):
+    """-> (vals f64[N], valid bool[N]) or None."""
+    nc = seg.numerics.get(field)
+    if nc is None:
+        return None
+    return np.asarray(nc.vals).astype(np.float64), ~np.asarray(nc.missing)
+
+
+def _keyword_column(seg: Segment, field: str):
+    kc = seg.keywords.get(field)
+    if kc is None:
+        return None
+    return np.asarray(kc.ords), kc.values
+
+
+# ---------------------------------------------------------------------------
+# Collect: per-segment vectorized partials
+# ---------------------------------------------------------------------------
+
+def collect_shard(specs: list[AggSpec], segments: list[Segment],
+                  masks: list[np.ndarray],
+                  query_parser=None) -> dict:
+    """Collect the agg tree over one shard's segments.
+    masks[i]: bool[n_pad] — (match & live) for segment i from the query phase.
+    query_parser: compiles filter/filters sub-queries (avoids circular import).
+    """
+    partials = {}
+    for spec in specs:
+        segs_partials = [_collect_one(spec, seg, mask, query_parser)
+                         for seg, mask in zip(segments, masks)]
+        merged = segs_partials[0] if segs_partials else _empty_partial(spec)
+        for p in segs_partials[1:]:
+            merged = merge_partial(spec, merged, p)
+        partials[spec.name] = merged
+    return partials
+
+
+def _empty_partial(spec: AggSpec) -> dict:
+    if spec.type in BUCKET_TYPES:
+        return {"buckets": {}}
+    return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
+
+
+def _collect_one(spec: AggSpec, seg: Segment, mask: np.ndarray,
+                 qp=None) -> dict:
+    if spec.type in METRIC_TYPES:
+        return _metric_segment(spec, seg, mask)
+    return _bucket_segment(spec, seg, mask, qp)
+
+
+# -- metric aggs ------------------------------------------------------------
+
+def _metric_segment(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
+    field = spec.params.get("field")
+    if spec.type == "cardinality" and field:
+        kw = _keyword_column(seg, field)
+        if kw is not None:
+            ords, values = kw
+            sel = mask & (ords >= 0)
+            uniq = np.unique(ords[sel])
+            hll = HyperLogLog()
+            hll.add([values[o] for o in uniq])
+            return {"hll": hll}
+    col = _numeric_column(seg, field) if field else None
+    if col is None:
+        return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
+    vals, valid = col
+    n = min(len(mask), len(valid))
+    return _metric_collect(spec, vals[:n], valid[:n] & mask[:n])
+
+
+def _metric_collect(spec: AggSpec, vals: np.ndarray, sel: np.ndarray) -> dict:
+    v = vals[sel] if len(vals) else vals
+    if spec.type == "cardinality":
+        hll = HyperLogLog()
+        hll.add_hashes(_hash64(v))
+        return {"hll": hll}
+    if spec.type == "percentiles":
+        td = TDigest()
+        td.add(v)
+        return {"tdigest": td,
+                "percents": spec.params.get("percents",
+                                            [1, 5, 25, 50, 75, 95, 99])}
+    count = int(v.size)
+    s = float(v.sum()) if count else 0.0
+    return {"count": count, "sum": s,
+            "min": float(v.min()) if count else math.inf,
+            "max": float(v.max()) if count else -math.inf,
+            "sum_sq": float((v * v).sum()) if count else 0.0}
+
+
+# -- bucket aggs ------------------------------------------------------------
+
+def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
+                    qp=None) -> dict:
+    """Compute per-doc bucket keys, then vectorized counts + sub-collects."""
+    t = spec.type
+    p = spec.params
+    n = seg.n_pad
+
+    if t == "global":   # ignores the query: all live docs (ref bucket/global/)
+        live = np.asarray(seg.live)
+        return {"buckets": {"_global": _bucket_entry(
+            spec, seg, live, qp)}}
+
+    if t == "filter":
+        sub_mask = _filter_mask(p, seg, qp)
+        m = mask & sub_mask
+        return {"buckets": {"_filter": _bucket_entry(spec, seg, m, qp)}}
+
+    if t == "filters":
+        out = {}
+        flt = p.get("filters", {})
+        for fname, fspec in flt.items():
+            m = mask & _filter_mask_query(fspec, seg, qp)
+            out[fname] = _bucket_entry(spec, seg, m, qp)
+        return {"buckets": out}
+
+    if t == "missing":
+        field = p["field"]
+        col = _numeric_column(seg, field)
+        kw = _keyword_column(seg, field)
+        if col is not None:
+            miss = ~col[1]
+        elif kw is not None:
+            miss = kw[0] < 0
+        else:
+            miss = np.ones(n, bool)
+        m = mask & miss[:len(mask)]
+        return {"buckets": {"_missing": _bucket_entry(spec, seg, m, qp)}}
+
+    if t == "terms":
+        field = p["field"]
+        kw = _keyword_column(seg, field)
+        if kw is not None:
+            ords, values = kw
+            sel = mask & (ords >= 0)
+            counts = np.bincount(ords[sel], minlength=len(values))
+            out = {}
+            for o in np.nonzero(counts)[0]:
+                key = values[o]
+                m = sel & (ords == o)
+                out[key] = _bucket_entry(spec, seg, m, qp)
+            return {"buckets": out}
+        col = _numeric_column(seg, field)
+        if col is None:
+            return {"buckets": {}}
+        vals, valid = col
+        sel = mask & valid[:len(mask)]
+        uniq = np.unique(vals[sel])
+        out = {}
+        for u in uniq:
+            m = sel & (vals == u)
+            key = int(u) if float(u).is_integer() else float(u)
+            out[key] = _bucket_entry(spec, seg, m, qp)
+        return {"buckets": out}
+
+    if t in ("histogram", "date_histogram"):
+        field = p["field"]
+        col = _numeric_column(seg, field)
+        if col is None:
+            return {"buckets": {}}
+        vals, valid = col
+        sel = mask & valid[:len(mask)]
+        if t == "histogram":
+            interval = float(p["interval"])
+            keys = np.floor(vals / interval) * interval
+        else:
+            keys = _date_round(vals, str(p.get("interval", "1d")))
+        out = {}
+        for u in np.unique(keys[sel]):
+            m = sel & (keys == u)
+            out[float(u)] = _bucket_entry(spec, seg, m, qp)
+        return {"buckets": out}
+
+    if t in ("range", "date_range"):
+        field = p["field"]
+        col = _numeric_column(seg, field)
+        if col is None:
+            return {"buckets": {}}
+        vals, valid = col
+        sel = mask & valid[:len(mask)]
+        out = {}
+        for r in p.get("ranges", []):
+            key, lo, hi = _resolve_range(r, is_date=(t == "date_range"))
+            m = sel.copy()
+            if lo is not None:
+                m &= vals >= float(lo)
+            if hi is not None:
+                m &= vals < float(hi)
+            e = _bucket_entry(spec, seg, m, qp)
+            e["from"] = lo
+            e["to"] = hi
+            out[key] = e
+        return {"buckets": out}
+
+    raise AggregationParsingException(f"unsupported bucket agg [{t}]")
+
+
+def _bucket_entry(spec: AggSpec, seg: Segment, mask: np.ndarray, qp) -> dict:
+    entry = {"doc_count": int(mask.sum())}
+    if spec.subs:
+        entry["subs"] = {
+            s.name: _collect_one(s, seg, mask, qp) for s in spec.subs}
+    return entry
+
+
+def _filter_mask(params: dict, seg: Segment, qp) -> np.ndarray:
+    return _filter_mask_query(params, seg, qp)
+
+
+def _filter_mask_query(query_spec: dict, seg: Segment, qp) -> np.ndarray:
+    """Compile + run a filter query against one segment -> bool[n_pad]."""
+    if qp is None:
+        raise AggregationParsingException(
+            "filter aggregation requires a query parser")
+    from ..query_dsl import SegmentContext, CollectionStats
+    node = qp.parse(query_spec)
+    terms_by_field: dict[str, set] = {}
+    node.collect_terms(terms_by_field)
+    stats = CollectionStats.from_segments([seg], terms_by_field)
+    _, match = node.execute(SegmentContext(seg, 1, stats))
+    return np.asarray(match)[0] & np.asarray(seg.live)
+
+
+def _range_key(lo, hi) -> str:
+    fmt = lambda x: "*" if x is None else (  # noqa: E731
+        str(int(x)) if float(x).is_integer() else str(float(x)))
+    return f"{fmt(lo)}-{fmt(hi)}"
+
+
+def _resolve_range(r: dict, is_date: bool) -> tuple[str, float | None, float | None]:
+    """Resolve a range spec's bounds (date-math for date_range) and its
+    bucket key — the SINGLE place keys are derived, used by both collect and
+    render so they can never disagree."""
+    lo, hi = r.get("from"), r.get("to")
+    if is_date:
+        from ..query_parser import eval_date_math
+        lo = eval_date_math(str(lo)) if isinstance(lo, str) else lo
+        hi = eval_date_math(str(hi)) if isinstance(hi, str) else hi
+    return r.get("key", _range_key(lo, hi)), lo, hi
+
+
+# -- date rounding ----------------------------------------------------------
+
+_FIXED_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000}
+_DAY = 86_400_000
+
+
+def _date_round(ms: np.ndarray, interval: str) -> np.ndarray:
+    """Round epoch-millis to bucket starts. Fixed units on the value array;
+    calendar units (week/month/quarter/year) via exact calendar math
+    (ref common/rounding/TimeZoneRounding.java, UTC only)."""
+    iv = interval.strip()
+    m = re.match(r"^(\d+)?\s*(ms|s|m|h|d|w|M|q|y|minute|hour|day|week|month|"
+                 r"quarter|year|second)$", iv)
+    if not m:
+        raise AggregationParsingException(f"bad interval [{interval}]")
+    n = int(m.group(1) or 1)
+    unit = {"second": "s", "minute": "m", "hour": "h", "day": "d",
+            "week": "w", "month": "M", "quarter": "q", "year": "y"}.get(
+                m.group(2), m.group(2))
+    if unit in _FIXED_MS:
+        step = n * _FIXED_MS[unit]
+        return np.floor_divide(ms, step) * step
+    days = np.floor_divide(ms, _DAY).astype(np.int64)
+    if unit == "w":
+        # 1970-01-01 is a Thursday; ISO weeks start Monday
+        dow = (days + 3) % 7
+        start = (days - dow) * _DAY
+        return start.astype(np.float64)
+    d64 = days.astype("datetime64[D]")
+    if unit == "M":
+        mo = d64.astype("datetime64[M]")
+        if n > 1:
+            mo_i = mo.astype(np.int64)
+            mo = (np.floor_divide(mo_i, n) * n).astype("datetime64[M]")
+        return mo.astype("datetime64[ms]").astype(np.int64).astype(np.float64)
+    if unit == "q":
+        mo_i = d64.astype("datetime64[M]").astype(np.int64)
+        q = np.floor_divide(mo_i, 3) * 3
+        return q.astype("datetime64[M]").astype("datetime64[ms]") \
+            .astype(np.int64).astype(np.float64)
+    # year
+    y = d64.astype("datetime64[Y]")
+    if n > 1:
+        y_i = y.astype(np.int64)
+        y = (np.floor_divide(y_i, n) * n).astype("datetime64[Y]")
+    return y.astype("datetime64[ms]").astype(np.int64).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Reduce: merge partials (segments, then shards)
+# ---------------------------------------------------------------------------
+
+def merge_partial(spec: AggSpec, a: dict, b: dict) -> dict:
+    if spec.type in METRIC_TYPES:
+        return _merge_metric(spec, a, b)
+    out = dict(a)
+    buckets = dict(a.get("buckets", {}))
+    for key, eb in b.get("buckets", {}).items():
+        ea = buckets.get(key)
+        if ea is None:
+            buckets[key] = eb
+        else:
+            merged = {"doc_count": ea["doc_count"] + eb["doc_count"]}
+            for extra in ("from", "to"):
+                if extra in ea:
+                    merged[extra] = ea[extra]
+            if spec.subs:
+                merged["subs"] = {
+                    s.name: merge_partial(s, ea["subs"][s.name],
+                                          eb["subs"][s.name])
+                    for s in spec.subs}
+            buckets[key] = merged
+    out["buckets"] = buckets
+    return out
+
+
+def _merge_metric(spec: AggSpec, a: dict, b: dict) -> dict:
+    if spec.type == "cardinality":
+        return {"hll": a["hll"].merge(b["hll"])}
+    if spec.type == "percentiles":
+        return {"tdigest": a["tdigest"].merge(b["tdigest"]),
+                "percents": a.get("percents", b.get("percents"))}
+    return {"count": a["count"] + b["count"], "sum": a["sum"] + b["sum"],
+            "min": min(a["min"], b["min"]), "max": max(a["max"], b["max"]),
+            "sum_sq": a["sum_sq"] + b["sum_sq"]}
+
+
+def merge_shard_partials(specs: list[AggSpec], shard_partials: list[dict]) -> dict:
+    """The cross-shard aggregation reduce
+    (ref SearchPhaseController.merge:282-399 InternalAggregations.reduce)."""
+    out: dict = {}
+    for spec in specs:
+        parts = [sp[spec.name] for sp in shard_partials if spec.name in sp]
+        if not parts:
+            out[spec.name] = _empty_partial(spec)
+            continue
+        merged = parts[0]
+        for p in parts[1:]:
+            merged = merge_partial(spec, merged, p)
+        out[spec.name] = merged
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Render: ES 2.0 response shapes
+# ---------------------------------------------------------------------------
+
+def _iso(ms: float) -> str:
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc) \
+        .strftime("%Y-%m-%dT%H:%M:%S.") + f"{int(ms) % 1000:03d}Z"
+
+
+def render(specs: list[AggSpec], partials: dict) -> dict:
+    return {spec.name: _render_one(spec, partials[spec.name])
+            for spec in specs}
+
+
+def _render_one(spec: AggSpec, p: dict) -> dict:
+    t = spec.type
+    if t in METRIC_TYPES:
+        return _render_metric(spec, p)
+
+    buckets = p.get("buckets", {})
+
+    def rb(key, entry, key_field=True):
+        b: dict = {}
+        if key_field:
+            b["key"] = key
+        b["doc_count"] = entry["doc_count"]
+        for extra in ("from", "to"):
+            if extra in entry and entry[extra] is not None:
+                b[extra] = entry[extra]
+        for s in spec.subs:
+            b[s.name] = _render_one(s, entry.get("subs", {}).get(
+                s.name, _empty_partial(s)))
+        return b
+
+    if t == "terms":
+        size = int(spec.params.get("size", 10)) or len(buckets)
+        order = spec.params.get("order", {"_count": "desc"})
+        items = list(buckets.items())
+        (okey, odir), = order.items() if isinstance(order, dict) else \
+            [("_count", "desc")]
+        reverse = odir == "desc"
+        if okey == "_term":
+            items.sort(key=lambda kv: kv[0], reverse=reverse)
+        else:
+            items.sort(key=lambda kv: (kv[1]["doc_count"], ), reverse=reverse)
+        top = items[:size]
+        other = sum(e["doc_count"] for _, e in items[size:])
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": other,
+                "buckets": [rb(k, e) for k, e in top]}
+
+    if t == "histogram":
+        items = sorted(buckets.items(), key=lambda kv: kv[0])
+        min_count = int(spec.params.get("min_doc_count", 1))
+        return {"buckets": [rb(k, e) for k, e in items
+                            if e["doc_count"] >= min_count]}
+
+    if t == "date_histogram":
+        items = sorted(buckets.items(), key=lambda kv: kv[0])
+        min_count = int(spec.params.get("min_doc_count", 1))
+        out = []
+        for k, e in items:
+            if e["doc_count"] < min_count:
+                continue
+            b = rb(int(k), e)
+            b["key_as_string"] = _iso(k)
+            out.append(b)
+        return {"buckets": out}
+
+    if t in ("range", "date_range"):
+        ordered = []
+        for r in spec.params.get("ranges", []):
+            key, _, _ = _resolve_range(r, is_date=(t == "date_range"))
+            if key in buckets:
+                ordered.append((key, buckets[key]))
+        return {"buckets": [rb(k, e) for k, e in ordered]}
+
+    if t == "filters":
+        return {"buckets": {k: rb(k, e, key_field=False)
+                            for k, e in buckets.items()}}
+
+    # filter / global / missing: single anonymous bucket
+    entry = next(iter(buckets.values()), {"doc_count": 0})
+    out = {"doc_count": entry["doc_count"]}
+    for s in spec.subs:
+        out[s.name] = _render_one(s, entry.get("subs", {}).get(
+            s.name, _empty_partial(s)))
+    return out
+
+
+def _render_metric(spec: AggSpec, p: dict) -> dict:
+    t = spec.type
+    if t == "cardinality":
+        return {"value": p["hll"].cardinality()}
+    if t == "percentiles":
+        td = p["tdigest"]
+        percents = p.get("percents") or [1, 5, 25, 50, 75, 95, 99]
+        return {"values": {f"{float(pc)}": td.quantile(float(pc) / 100.0)
+                           for pc in percents}}
+    count, s = p["count"], p["sum"]
+    if t == "value_count":
+        return {"value": count}
+    if t == "sum":
+        return {"value": s}
+    if t == "min":
+        return {"value": p["min"] if count else None}
+    if t == "max":
+        return {"value": p["max"] if count else None}
+    if t == "avg":
+        return {"value": (s / count) if count else None}
+    avg = s / count if count else None
+    base = {"count": count, "min": p["min"] if count else None,
+            "max": p["max"] if count else None, "avg": avg, "sum": s}
+    if t == "stats":
+        return base
+    # extended_stats
+    if count:
+        var = max(p["sum_sq"] / count - (s / count) ** 2, 0.0)
+        base.update({"sum_of_squares": p["sum_sq"], "variance": var,
+                     "std_deviation": math.sqrt(var)})
+    else:
+        base.update({"sum_of_squares": 0.0, "variance": None,
+                     "std_deviation": None})
+    return base

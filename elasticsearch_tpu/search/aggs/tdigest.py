@@ -1,0 +1,92 @@
+"""Merging t-digest for the percentiles aggregation.
+
+The mergeable-sketch analog of the reference's T-Digest dependency
+(pom.xml:278, used by search/aggregations/metrics/percentiles/ —
+InternalPercentiles reduce merges per-shard digests). Implements the
+"merging digest" variant: buffer values, sort, and re-cluster into centroids
+whose sizes respect the k-scale function q(1-q), giving high resolution at
+the tails.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TDigest:
+    def __init__(self, compression: float = 100.0,
+                 means: np.ndarray | None = None,
+                 weights: np.ndarray | None = None):
+        self.compression = compression
+        self.means = means if means is not None else np.zeros(0)
+        self.weights = weights if weights is not None else np.zeros(0)
+        self._buf: list[np.ndarray] = []
+
+    def add(self, values: np.ndarray) -> None:
+        v = np.asarray(values, np.float64).ravel()
+        if v.size:
+            self._buf.append(v)
+        if sum(b.size for b in self._buf) > 8192:
+            self._compress()
+
+    def merge(self, other: "TDigest") -> "TDigest":
+        out = TDigest(self.compression)
+        self._compress()
+        other._compress()
+        out._buf = []
+        m = np.concatenate([self.means, other.means])
+        w = np.concatenate([self.weights, other.weights])
+        out.means, out.weights = m, w
+        out._compress()
+        return out
+
+    def _compress(self) -> None:
+        if self._buf:
+            vals = np.concatenate(self._buf)
+            self._buf = []
+            m = np.concatenate([self.means, vals])
+            w = np.concatenate([self.weights, np.ones(vals.size)])
+        else:
+            m, w = self.means, self.weights
+        if m.size == 0:
+            self.means, self.weights = m, w
+            return
+        order = np.argsort(m, kind="stable")
+        m, w = m[order], w[order]
+        total = w.sum()
+        # greedy left-to-right clustering under the k1 scale-function bound
+        out_m, out_w = [], []
+        cur_m, cur_w, seen = m[0], w[0], 0.0
+        for i in range(1, m.size):
+            q = (seen + cur_w / 2) / total
+            limit = 4 * total * q * (1 - q) / self.compression
+            if cur_w + w[i] <= max(limit, 1.0):
+                cur_m = (cur_m * cur_w + m[i] * w[i]) / (cur_w + w[i])
+                cur_w += w[i]
+            else:
+                out_m.append(cur_m)
+                out_w.append(cur_w)
+                seen += cur_w
+                cur_m, cur_w = m[i], w[i]
+        out_m.append(cur_m)
+        out_w.append(cur_w)
+        self.means = np.asarray(out_m)
+        self.weights = np.asarray(out_w)
+
+    def quantile(self, q: float) -> float:
+        self._compress()
+        if self.means.size == 0:
+            return float("nan")
+        if self.means.size == 1:
+            return float(self.means[0])
+        total = self.weights.sum()
+        target = q * total
+        # centroid cumulative midpoints, linear interpolation between them
+        cum = np.cumsum(self.weights) - self.weights / 2
+        if target <= cum[0]:
+            return float(self.means[0])
+        if target >= cum[-1]:
+            return float(self.means[-1])
+        i = int(np.searchsorted(cum, target) - 1)
+        frac = (target - cum[i]) / (cum[i + 1] - cum[i])
+        return float(self.means[i] + frac * (self.means[i + 1] - self.means[i]))

@@ -234,7 +234,9 @@ class TermFilterNode(Node):
             targets = np.full((Q, V), -2, np.int64)
             for qi, vals in enumerate(self.values_per_query):
                 for vi, v in enumerate(vals):
-                    targets[qi, vi] = kc.ord_of(str(v))
+                    o = kc.ord_of(str(v))
+                    if o >= 0:   # absent term stays -2: never collides with
+                        targets[qi, vi] = o   # the missing sentinel (-1)
             col = kc.ords.astype(jnp.int64)
         elif nc is not None:
             targets = np.full((Q, V), np.iinfo(np.int64).min, np.int64)

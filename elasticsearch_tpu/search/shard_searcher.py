@@ -92,8 +92,17 @@ class ShardSearcher:
                             from_: int = 0, n_queries: int = 1,
                             sort: dict | None = None,
                             global_stats: CollectionStats | None = None,
-                            track_scores: bool = True) -> QuerySearchResult:
-        """Run the batched query tree over all segments of this shard."""
+                            track_scores: bool = True,
+                            aggs: list | None = None) -> QuerySearchResult:
+        """Run the batched query tree over all segments of this shard.
+
+        aggs: parsed AggSpec list (search/aggs) — collected in the same pass
+        as scoring using each segment's match mask, exactly the reference's
+        AggregationPhase-collectors-inside-QueryPhase model
+        (ref search/query/QueryPhase.java:91-168, AggregationPhase.java:70-95).
+        Aggregations apply to query row 0 of the batch (one agg tree per
+        search request, like the reference).
+        """
         k = max(size + from_, 1)
         Q = n_queries
         stats = self.build_stats(node, global_stats)
@@ -103,6 +112,8 @@ class ShardSearcher:
         best_sort = np.full((Q, k), np.inf, np.float64) if sort else None
         total = np.zeros((Q,), np.int64)
         max_score = np.full((Q,), -np.inf, np.float32)
+        agg_segments: list = []
+        agg_masks: list[np.ndarray] = []
 
         for seg_idx, seg in enumerate(self.segments):
             if seg.n_docs == 0:
@@ -110,6 +121,9 @@ class ShardSearcher:
             ctx = SegmentContext(seg, Q, stats)
             scores, match = node.execute(ctx)
             match = match & seg.live[None, :]
+            if aggs is not None:
+                agg_segments.append(seg)
+                agg_masks.append(np.asarray(match)[0])
             kk = min(k, seg.n_pad)
             total += np.asarray(topk_ops.count_matches(match))
             if sort is None:
@@ -153,9 +167,15 @@ class ShardSearcher:
             best_sort = -best_sort
         max_score = np.where(np.isfinite(max_score), max_score, np.nan)
         best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+        agg_partials = None
+        if aggs is not None:
+            from .aggs.aggregators import collect_shard
+            agg_partials = collect_shard(aggs, agg_segments, agg_masks,
+                                         query_parser=self.parser)
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
-            sort_values=best_sort, total_hits=total, max_score=max_score)
+            sort_values=best_sort, total_hits=total, max_score=max_score,
+            aggs=agg_partials)
 
     def _sort_keys(self, seg: Segment, sort: dict, Q: int):
         """Build an ascending-comparable f64 key per doc for field sort

@@ -1,0 +1,253 @@
+"""Aggregation tests — behavioral parity with the reference framework
+(src/test/java/org/elasticsearch/search/aggregations/): bucket + metric aggs,
+nesting, cross-shard reduce, sketch accuracy."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+from elasticsearch_tpu.search.aggs import (
+    parse_aggs, merge_shard_partials, render, HyperLogLog, TDigest,
+)
+
+DOCS = [
+    {"cat": "a", "price": 10, "qty": 1.0, "ts": "2024-01-05T10:00:00Z"},
+    {"cat": "a", "price": 20, "qty": 2.0, "ts": "2024-01-20T10:00:00Z"},
+    {"cat": "b", "price": 30, "qty": 3.0, "ts": "2024-02-03T10:00:00Z"},
+    {"cat": "b", "price": 40, "qty": 4.0, "ts": "2024-02-14T10:00:00Z"},
+    {"cat": "b", "price": 50, "qty": 5.0, "ts": "2024-03-01T10:00:00Z"},
+    {"cat": "c", "price": 60, "qty": 6.0, "ts": "2024-03-30T10:00:00Z"},
+    {"price": 70, "qty": 7.0, "ts": "2024-04-02T10:00:00Z"},   # no cat
+]
+
+MAPPING = {"_doc": {"properties": {
+    "cat": {"type": "keyword"}, "price": {"type": "long"},
+    "qty": {"type": "double"}, "ts": {"type": "date"},
+}}}
+
+
+@pytest.fixture(scope="module")
+def searcher(tmp_path_factory):
+    mappers = MapperService(mappings=MAPPING)
+    eng = Engine(str(tmp_path_factory.mktemp("aggshard")), mappers)
+    for i, d in enumerate(DOCS):
+        eng.index(str(i), d)
+        if i == 3:
+            eng.refresh()   # multi-segment: exercises partial merging
+    eng.refresh()
+    return ShardSearcher(0, eng.segments, mappers)
+
+
+def run_aggs(searcher, agg_body, query=None):
+    specs = parse_aggs(agg_body)
+    node = searcher.parse([query or {"match_all": {}}])
+    res = searcher.execute_query_phase(node, size=0, aggs=specs)
+    merged = merge_shard_partials(specs, [res.aggs])
+    return render(specs, merged)
+
+
+class TestMetrics:
+    def test_min_max_sum_avg_count(self, searcher):
+        out = run_aggs(searcher, {
+            "mn": {"min": {"field": "price"}},
+            "mx": {"max": {"field": "price"}},
+            "sm": {"sum": {"field": "price"}},
+            "av": {"avg": {"field": "price"}},
+            "vc": {"value_count": {"field": "price"}}})
+        assert out["mn"]["value"] == 10 and out["mx"]["value"] == 70
+        assert out["sm"]["value"] == 280
+        assert abs(out["av"]["value"] - 40.0) < 1e-9
+        assert out["vc"]["value"] == 7
+
+    def test_stats_extended(self, searcher):
+        out = run_aggs(searcher, {"st": {"extended_stats": {"field": "qty"}}})
+        st = out["st"]
+        assert st["count"] == 7 and st["min"] == 1.0 and st["max"] == 7.0
+        assert abs(st["avg"] - 4.0) < 1e-9
+        assert abs(st["variance"] - 4.0) < 1e-9  # var of 1..7
+        assert abs(st["std_deviation"] - 2.0) < 1e-9
+
+    def test_cardinality(self, searcher):
+        out = run_aggs(searcher, {"c": {"cardinality": {"field": "cat"}}})
+        assert out["c"]["value"] == 3
+
+    def test_percentiles(self, searcher):
+        out = run_aggs(searcher, {"p": {"percentiles": {
+            "field": "price", "percents": [50]}}})
+        assert abs(out["p"]["values"]["50.0"] - 40.0) < 10.0
+
+    def test_metric_with_query_filter(self, searcher):
+        out = run_aggs(searcher, {"sm": {"sum": {"field": "price"}}},
+                       query={"term": {"cat": "b"}})
+        assert out["sm"]["value"] == 120  # 30+40+50
+
+
+class TestBuckets:
+    def test_terms(self, searcher):
+        out = run_aggs(searcher, {"cats": {"terms": {"field": "cat"}}})
+        buckets = out["cats"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in buckets] == \
+            [("b", 3), ("a", 2), ("c", 1)]
+        assert out["cats"]["sum_other_doc_count"] == 0
+
+    def test_terms_size_and_other(self, searcher):
+        out = run_aggs(searcher, {"cats": {"terms": {"field": "cat", "size": 1}}})
+        assert len(out["cats"]["buckets"]) == 1
+        assert out["cats"]["buckets"][0]["key"] == "b"
+        assert out["cats"]["sum_other_doc_count"] == 3
+
+    def test_terms_numeric_field(self, searcher):
+        out = run_aggs(searcher, {"p": {"terms": {"field": "price"}}})
+        assert {b["key"] for b in out["p"]["buckets"]} == \
+            {10, 20, 30, 40, 50, 60, 70}
+
+    def test_histogram(self, searcher):
+        out = run_aggs(searcher, {"h": {"histogram": {
+            "field": "price", "interval": 25}}})
+        got = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]}
+        # prices 10,20 -> 0; 30,40 -> 25; 50,60,70 -> 50
+        assert got == {0.0: 2, 25.0: 2, 50.0: 3}
+
+    def test_date_histogram_month(self, searcher):
+        out = run_aggs(searcher, {"m": {"date_histogram": {
+            "field": "ts", "interval": "month"}}})
+        buckets = out["m"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 2, 2, 1]
+        assert buckets[0]["key_as_string"].startswith("2024-01-01")
+        assert buckets[3]["key_as_string"].startswith("2024-04-01")
+
+    def test_date_histogram_fixed_days(self, searcher):
+        out = run_aggs(searcher, {"d": {"date_histogram": {
+            "field": "ts", "interval": "7d"}}})
+        assert sum(b["doc_count"] for b in out["d"]["buckets"]) == 7
+
+    def test_range(self, searcher):
+        out = run_aggs(searcher, {"r": {"range": {
+            "field": "price",
+            "ranges": [{"to": 30}, {"from": 30, "to": 60}, {"from": 60}]}}})
+        buckets = out["r"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 3, 2]
+        assert buckets[0]["key"] == "*-30"
+
+    def test_filter_and_filters(self, searcher):
+        out = run_aggs(searcher, {
+            "cheap": {"filter": {"range": {"price": {"lt": 35}}},
+                      "aggs": {"s": {"sum": {"field": "price"}}}},
+            "split": {"filters": {"filters": {
+                "ab": {"terms": {"cat": ["a", "b"]}},
+                "c": {"term": {"cat": "c"}}}}}})
+        assert out["cheap"]["doc_count"] == 3
+        assert out["cheap"]["s"]["value"] == 60
+        assert out["split"]["buckets"]["ab"]["doc_count"] == 5
+        assert out["split"]["buckets"]["c"]["doc_count"] == 1
+
+    def test_missing_and_global(self, searcher):
+        out = run_aggs(searcher, {
+            "nocat": {"missing": {"field": "cat"}},
+            "all": {"global": {}}},
+            query={"term": {"cat": "a"}})
+        assert out["nocat"]["doc_count"] == 0   # query limits to cat=a
+        assert out["all"]["doc_count"] == 7     # global escapes the query
+
+    def test_nested_terms_with_metrics(self, searcher):
+        out = run_aggs(searcher, {"cats": {
+            "terms": {"field": "cat"},
+            "aggs": {"avg_price": {"avg": {"field": "price"}},
+                     "monthly": {"date_histogram": {
+                         "field": "ts", "interval": "month"}}}}})
+        by_key = {b["key"]: b for b in out["cats"]["buckets"]}
+        assert abs(by_key["a"]["avg_price"]["value"] - 15.0) < 1e-9
+        assert abs(by_key["b"]["avg_price"]["value"] - 40.0) < 1e-9
+        assert [x["doc_count"] for x in by_key["b"]["monthly"]["buckets"]] == [2, 1]
+
+
+class TestRegressions:
+    def test_cardinality_float_values_not_truncated(self, searcher):
+        # floats must hash by bit pattern: qty has 7 distinct non-int-equal
+        # values after adding fractions would collapse under int truncation
+        out = run_aggs(searcher, {"c": {"cardinality": {"field": "qty"}}})
+        assert out["c"]["value"] == 7
+        h = HyperLogLog()
+        h.add(np.array([0.1, 0.2, 0.3, 1.5, 1.7]))
+        assert h.cardinality() == 5
+
+    def test_date_range_string_bounds(self, searcher):
+        out = run_aggs(searcher, {"dr": {"date_range": {
+            "field": "ts",
+            "ranges": [{"to": "2024-02-01T00:00:00Z"},
+                       {"from": "2024-02-01T00:00:00Z"}]}}})
+        buckets = out["dr"]["buckets"]
+        assert len(buckets) == 2
+        assert buckets[0]["doc_count"] == 2   # the two January docs
+        assert buckets[1]["doc_count"] == 5
+
+    def test_terms_count_asc_order(self, searcher):
+        out = run_aggs(searcher, {"cats": {"terms": {
+            "field": "cat", "order": {"_count": "asc"}}}})
+        assert [b["key"] for b in out["cats"]["buckets"]] == ["c", "a", "b"]
+
+    def test_hll_string_hash_process_stable(self):
+        # blake2b-based: same value always maps to the same registers
+        from elasticsearch_tpu.search.aggs.hll import _hash64
+        a = _hash64(["x", "y"])
+        b = _hash64(["x", "y"])
+        assert (a == b).all()
+
+
+class TestCrossShardReduce:
+    def test_two_shard_merge(self, tmp_path):
+        """Partials from independent shards reduce to the union answer
+        (the SearchPhaseController.merge contract)."""
+        mappers = MapperService(mappings=MAPPING)
+        outs = []
+        specs = parse_aggs({"cats": {"terms": {"field": "cat"},
+                                     "aggs": {"s": {"sum": {"field": "price"}}}},
+                            "card": {"cardinality": {"field": "cat"}}})
+        for si, docs in enumerate((DOCS[:4], DOCS[4:])):
+            eng = Engine(str(tmp_path / f"s{si}"), mappers)
+            for i, d in enumerate(docs):
+                eng.index(f"{si}-{i}", d)
+            eng.refresh()
+            sr = ShardSearcher(si, eng.segments, mappers)
+            node = sr.parse([{"match_all": {}}])
+            res = sr.execute_query_phase(node, size=0, aggs=specs)
+            outs.append(res.aggs)
+            eng.close()
+        merged = merge_shard_partials(specs, outs)
+        rendered = render(specs, merged)
+        by_key = {b["key"]: b for b in rendered["cats"]["buckets"]}
+        assert by_key["b"]["doc_count"] == 3 and by_key["b"]["s"]["value"] == 120
+        assert rendered["card"]["value"] == 3
+
+
+class TestSketches:
+    def test_hll_accuracy(self):
+        hll = HyperLogLog()
+        hll.add(np.arange(100_000, dtype=np.int64))
+        est = hll.cardinality()
+        assert abs(est - 100_000) / 100_000 < 0.03
+
+    def test_hll_merge(self):
+        a, b = HyperLogLog(), HyperLogLog()
+        a.add(np.arange(0, 5000, dtype=np.int64))
+        b.add(np.arange(2500, 7500, dtype=np.int64))
+        est = a.merge(b).cardinality()
+        assert abs(est - 7500) / 7500 < 0.05
+
+    def test_tdigest_quantiles(self):
+        td = TDigest()
+        rng = np.random.default_rng(0)
+        td.add(rng.normal(0, 1, 50_000))
+        assert abs(td.quantile(0.5)) < 0.03
+        assert abs(td.quantile(0.99) - 2.326) < 0.15
+
+    def test_tdigest_merge(self):
+        a, b = TDigest(), TDigest()
+        a.add(np.arange(0, 1000))
+        b.add(np.arange(1000, 2000))
+        m = a.merge(b)
+        assert abs(m.quantile(0.5) - 1000) < 30

@@ -1,0 +1,105 @@
+"""Per-index service: N shard engines + mappers + routing.
+
+Analog of the reference's IndexService (indices/IndicesService.java creates
+one per index, holding IndexShard instances; SURVEY.md §2.5). Shards here are
+independent Engines on disjoint doc partitions, routed by the reference's
+exact hash function (parallel/routing.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any
+
+from ..common.settings import Settings, EMPTY as EMPTY_SETTINGS
+from ..mapping.mapper import MapperService
+from ..parallel.routing import shard_id as route_shard
+from ..search.shard_searcher import ShardSearcher
+from .engine import Engine, EngineResult, GetResult
+
+
+class IndexService:
+    def __init__(self, name: str, path: str, settings: Settings | None = None,
+                 mappings: dict | None = None):
+        self.name = name
+        self.path = path
+        self.settings = settings if settings is not None else EMPTY_SETTINGS
+        get = lambda k, d: self.settings.get(  # noqa: E731 — "index." optional
+            f"index.{k}", self.settings.get(k, d))
+        self.n_shards = int(get("number_of_shards", 1) or 1)
+        self.n_replicas = int(get("number_of_replicas", 1) or 1)
+        self.aliases: set[str] = set()
+        self.mappers = MapperService(mappings=mappings or {})
+        self.shards: list[Engine] = [
+            Engine(os.path.join(path, str(s)), self.mappers)
+            for s in range(self.n_shards)]
+        self.creation_date = None
+
+    # -- routing -----------------------------------------------------------
+
+    def shard_for(self, doc_id: str, routing: str | None = None) -> Engine:
+        return self.shards[route_shard(doc_id, self.n_shards, routing)]
+
+    # -- document ops (ref index/shard/IndexShard.java:444-523) ------------
+
+    def index_doc(self, doc_id: str, source: dict, type_name: str = "_doc",
+                  routing: str | None = None, **kw) -> EngineResult:
+        return self.shard_for(doc_id, routing).index(
+            doc_id, source, type_name=type_name, **kw)
+
+    def get_doc(self, doc_id: str, routing: str | None = None,
+                realtime: bool = True) -> GetResult:
+        return self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
+
+    def delete_doc(self, doc_id: str, routing: str | None = None,
+                   **kw) -> EngineResult:
+        return self.shard_for(doc_id, routing).delete(doc_id, **kw)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def refresh(self) -> None:
+        for e in self.shards:
+            e.refresh()
+
+    def flush(self) -> None:
+        for e in self.shards:
+            e.flush()
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        for e in self.shards:
+            e.force_merge(max_num_segments)
+
+    def close(self) -> None:
+        for e in self.shards:
+            e.close()
+
+    def delete_files(self) -> None:
+        shutil.rmtree(self.path, ignore_errors=True)
+
+    # -- search ------------------------------------------------------------
+
+    def searchers(self) -> list[ShardSearcher]:
+        return [ShardSearcher(si, e.segments, self.mappers)
+                for si, e in enumerate(self.shards)]
+
+    # -- introspection -----------------------------------------------------
+
+    def doc_count(self) -> int:
+        return sum(e.doc_count() for e in self.shards)
+
+    def stats(self) -> dict:
+        seg = [e.segment_stats() for e in self.shards]
+        return {
+            "docs": {"count": self.doc_count(),
+                     "deleted": sum(s["deleted"] for s in seg)},
+            "segments": {"count": sum(s["count"] for s in seg),
+                         "memory_in_bytes": sum(s["memory_in_bytes"] for s in seg)},
+            "translog": {"operations": sum(e.translog.ops_since_commit
+                                           for e in self.shards)},
+            "shards": {"total": self.n_shards * (1 + self.n_replicas),
+                       "primaries": self.n_shards},
+        }
+
+    def mappings_dict(self) -> dict:
+        return self.mappers.mappings_dict()

@@ -1,0 +1,400 @@
+"""NodeService: the single-process coordinator over local indices.
+
+Plays the role of the reference's Node + action layer for the local case
+(/root/reference/src/main/java/org/elasticsearch/node/Node.java + action/ —
+SURVEY.md §2.7): create/delete index (master ops), document CRUD + bulk
+(replicated-write template collapses to the local primary), and the search
+scatter-gather driver (TransportSearchTypeAction QUERY_THEN_FETCH:
+§3.2 call stack — query phase on all shards, controller reduce, fetch from
+winners only, aggregation tree reduce).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+import time
+from typing import Any
+
+from .common.settings import Settings
+from .index.engine import (DocumentMissingException, EngineResult,
+                           VersionConflictException)
+from .index.index_service import IndexService
+from .search import controller
+from .search.aggs import parse_aggs, merge_shard_partials, render as render_aggs
+from .search.shard_searcher import ShardSearcher
+
+
+class IndexMissingException(Exception):
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]")
+        self.index = index
+
+
+class IndexAlreadyExistsException(Exception):
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists")
+        self.index = index
+
+
+class InvalidIndexNameException(Exception):
+    pass
+
+
+_VALID_INDEX = re.compile(r"^[a-z0-9][a-z0-9_\-+.]*$")
+
+
+class NodeService:
+    """One node holding every shard locally (multi-node arrives with the
+    cluster layer; the API surface is already the distributed one)."""
+
+    def __init__(self, data_path: str, settings: Settings | None = None,
+                 cluster_name: str = "elasticsearch-tpu"):
+        self.data_path = data_path
+        self.settings = settings or Settings()
+        self.cluster_name = cluster_name
+        self.indices: dict[str, IndexService] = {}
+        self.templates: dict[str, dict] = {}
+        os.makedirs(data_path, exist_ok=True)
+        self._recover_indices()
+
+    # -- index management (master ops, ref MetaDataCreateIndexService) ----
+
+    def _recover_indices(self) -> None:
+        """Reopen on-disk indices (gateway recovery, SURVEY.md §5.4(b))."""
+        import json
+        for name in sorted(os.listdir(self.data_path)):
+            meta_path = os.path.join(self.data_path, name, "_meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self.indices[name] = IndexService(
+                name, os.path.join(self.data_path, name),
+                Settings(meta.get("settings", {})), meta.get("mappings", {}))
+            self.indices[name].aliases = set(meta.get("aliases", []))
+
+    def _persist_index_meta(self, svc: IndexService) -> None:
+        import json
+        meta = {"settings": dict(svc.settings),
+                "mappings": svc.mappings_dict(),
+                "aliases": sorted(svc.aliases)}
+        path = os.path.join(svc.path, "_meta.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def create_index(self, name: str, settings: dict | None = None,
+                     mappings: dict | None = None,
+                     aliases: dict | None = None) -> IndexService:
+        if name in self.indices:
+            raise IndexAlreadyExistsException(name)
+        if not _VALID_INDEX.match(name) or name != name.lower():
+            raise InvalidIndexNameException(f"invalid index name [{name}]")
+        merged_settings = dict(settings or {})
+        merged_mappings = dict(mappings or {})
+        merged_aliases = set((aliases or {}).keys())
+        # index templates (ref MetaDataIndexTemplateService): apply by pattern
+        for tname, tpl in sorted(self.templates.items(),
+                                 key=lambda kv: kv[1].get("order", 0)):
+            if fnmatch.fnmatch(name, tpl.get("template", "*")):
+                for k, v in (tpl.get("settings") or {}).items():
+                    merged_settings.setdefault(k, v)
+                for t, m in (tpl.get("mappings") or {}).items():
+                    merged_mappings.setdefault(t, m)
+                merged_aliases |= set((tpl.get("aliases") or {}).keys())
+        svc = IndexService(name, os.path.join(self.data_path, name),
+                           Settings(merged_settings), merged_mappings)
+        svc.aliases = merged_aliases
+        self.indices[name] = svc
+        self._persist_index_meta(svc)
+        return svc
+
+    def delete_index(self, name: str) -> None:
+        for n in self._resolve(name):
+            svc = self.indices.pop(n)
+            svc.close()
+            svc.delete_files()
+
+    def _resolve(self, expr: str) -> list[str]:
+        """Index expression: name, alias, comma list, wildcards, _all."""
+        if expr in ("_all", "*", ""):
+            return list(self.indices)
+        out: list[str] = []
+        for part in expr.split(","):
+            if part in self.indices:
+                out.append(part)
+                continue
+            matched = [n for n, svc in self.indices.items()
+                       if part in svc.aliases or fnmatch.fnmatch(n, part)]
+            if not matched and "*" not in part:
+                raise IndexMissingException(part)
+            out.extend(m for m in matched if m not in out)
+        return out
+
+    def index_service(self, name: str) -> IndexService:
+        svcs = self._resolve(name)
+        if not svcs:
+            raise IndexMissingException(name)
+        return self.indices[svcs[0]]
+
+    # -- document ops ------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: str | None, source: dict,
+                  type_name: str = "_doc", auto_create: bool = True,
+                  **kw) -> tuple[str, EngineResult]:
+        """ref TransportIndexAction.java:63 — auto-creates the index like
+        the reference's create-index-on-first-doc behavior."""
+        if index not in self.indices:
+            if not auto_create:
+                raise IndexMissingException(index)
+            if not _VALID_INDEX.match(index):
+                raise InvalidIndexNameException(index)
+            self.create_index(index)
+        if doc_id is None:
+            import uuid
+            doc_id = uuid.uuid4().hex[:20]
+        svc = self.indices[index]
+        res = svc.index_doc(doc_id, source, type_name=type_name, **kw)
+        return index, res
+
+    def get_doc(self, index: str, doc_id: str, **kw):
+        return self.index_service(index).get_doc(doc_id, **kw)
+
+    def delete_doc(self, index: str, doc_id: str, **kw):
+        return self.index_service(index).delete_doc(doc_id, **kw)
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   type_name: str = "_doc") -> tuple[EngineResult, bool]:
+        """Scripted/partial update: get -> transform -> reindex
+        (ref action/update/UpdateHelper.java:61). Returns (result, noop)."""
+        svc = self.index_service(index)
+        cur = svc.get_doc(doc_id)
+        if not cur.found:
+            if "upsert" in body:
+                res = svc.index_doc(doc_id, body["upsert"], type_name=type_name)
+                return res, False
+            if body.get("doc_as_upsert") and "doc" in body:
+                res = svc.index_doc(doc_id, body["doc"], type_name=type_name)
+                return res, False
+            raise DocumentMissingException(f"[{type_name}][{doc_id}]: document missing")
+        src = dict(cur.source)
+        if "script" in body:
+            from .script.engine import run_update_script
+            src = run_update_script(body["script"], src,
+                                    params=body.get("params")
+                                    or (body["script"].get("params")
+                                        if isinstance(body["script"], dict)
+                                        else None))
+        elif "doc" in body:
+            merged = _deep_merge(src, body["doc"])
+            if body.get("detect_noop", True) and merged == src:
+                return EngineResult(doc_id=doc_id, version=cur.version,
+                                    created=False), True
+            src = merged
+        res = svc.index_doc(doc_id, src, type_name=cur.type_name,
+                            version=cur.version)
+        return res, False
+
+    def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
+        """ops: (action, meta, source). ref TransportBulkAction splits by
+        shard; locally we just apply in order per the bulk contract."""
+        items = []
+        for action, meta, source in operations:
+            index = meta.get("_index")
+            type_name = meta.get("_type", "_doc")
+            doc_id = meta.get("_id")
+            try:
+                if action in ("index", "create"):
+                    _, res = self.index_doc(
+                        index, doc_id, source, type_name=type_name,
+                        op_type="create" if action == "create" else "index",
+                        routing=meta.get("_routing") or meta.get("routing"))
+                    items.append({action: {
+                        "_index": index, "_type": type_name, "_id": res.doc_id,
+                        "_version": res.version,
+                        "status": 201 if res.created else 200}})
+                elif action == "delete":
+                    res = self.delete_doc(index, doc_id)
+                    items.append({"delete": {
+                        "_index": index, "_type": type_name, "_id": doc_id,
+                        "_version": res.version, "found": res.found,
+                        "status": 200 if res.found else 404}})
+                elif action == "update":
+                    res, noop = self.update_doc(index, doc_id, source,
+                                                type_name=type_name)
+                    items.append({"update": {
+                        "_index": index, "_type": type_name, "_id": doc_id,
+                        "_version": res.version, "status": 200}})
+                else:
+                    items.append({action: {"status": 400,
+                                           "error": f"unknown action [{action}]"}})
+            except VersionConflictException as e:
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 409, "error": str(e)}})
+            except Exception as e:  # noqa: BLE001 — per-item error contract
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 400, "error": str(e)}})
+        return items
+
+    # -- search (the QUERY_THEN_FETCH driver, SURVEY §3.2) -----------------
+
+    def search(self, index: str, body: dict | None = None,
+               size: int | None = None, from_: int | None = None) -> dict:
+        t0 = time.perf_counter()
+        body = body or {}
+        size = int(body.get("size", 10) if size is None else size)
+        from_ = int(body.get("from", 0) if from_ is None else from_)
+        sort = _parse_sort(body.get("sort"))
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+
+        searchers: list[ShardSearcher] = []
+        index_of: list[str] = []
+        for n in names:
+            for s in self.indices[n].searchers():
+                searchers.append(s)
+                index_of.append(n)
+
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        query = body.get("query", {"match_all": {}})
+
+        results = []
+        shard_failures = 0
+        for s in searchers:
+            node = s.parse([query])
+            results.append(s.execute_query_phase(
+                node, size=size, from_=from_, sort=sort,
+                aggs=agg_specs if agg_specs else None))
+
+        reduced = controller.sort_docs(results, from_=from_, size=size,
+                                       sort=sort)
+        src_filter = body.get("_source")
+        hits = controller.fetch_and_merge(
+            reduced, searchers,
+            source_filter=(lambda s: _source_filter(s, src_filter))
+            if src_filter is not None else None)
+        for slot, h in enumerate(hits):
+            h["_index"] = index_of[reduced.shard_order[slot]]
+
+        resp: dict[str, Any] = {
+            "took": int((time.perf_counter() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": len(searchers),
+                        "successful": len(searchers) - shard_failures,
+                        "failed": shard_failures},
+            "hits": {"total": reduced.total_hits,
+                     "max_score": None if reduced.max_score != reduced.max_score
+                     else reduced.max_score,
+                     "hits": hits},
+        }
+        if agg_specs:
+            merged = merge_shard_partials(
+                agg_specs, [r.aggs for r in results if r.aggs])
+            resp["aggregations"] = render_aggs(agg_specs, merged)
+        return resp
+
+    def count(self, index: str, body: dict | None = None) -> dict:
+        out = self.search(index, {**(body or {}), "size": 0})
+        return {"count": out["hits"]["total"], "_shards": out["_shards"]}
+
+    # -- admin -------------------------------------------------------------
+
+    def refresh(self, index: str = "_all") -> None:
+        for n in self._resolve(index):
+            self.indices[n].refresh()
+
+    def flush(self, index: str = "_all") -> None:
+        for n in self._resolve(index):
+            self.indices[n].flush()
+            self._persist_index_meta(self.indices[n])
+
+    def put_mapping(self, index: str, type_name: str, mapping: dict) -> None:
+        for n in self._resolve(index):
+            self.indices[n].mappers.merge(type_name, mapping)
+            self._persist_index_meta(self.indices[n])
+
+    def put_template(self, name: str, body: dict) -> None:
+        self.templates[name] = body
+
+    def cluster_health(self) -> dict:
+        shards = sum(s.n_shards for s in self.indices.values())
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": shards,
+            "active_shards": shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": sum(
+                s.n_shards * s.n_replicas for s in self.indices.values()),
+        }
+
+    def stats(self) -> dict:
+        return {"indices": {n: s.stats() for n, s in self.indices.items()}}
+
+    def close(self) -> None:
+        for svc in self.indices.values():
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _parse_sort(sort_spec) -> dict | None:
+    """Normalize the sort clause: "field", ["field"], [{"field": {"order":..}}].
+    _score sort (the default) -> None."""
+    if sort_spec is None:
+        return None
+    if isinstance(sort_spec, list):
+        if not sort_spec:
+            return None
+        sort_spec = sort_spec[0]   # primary key only (v1)
+    if isinstance(sort_spec, str):
+        if sort_spec == "_score":
+            return None
+        return {"field": sort_spec, "order": "asc"}
+    (field, params), = sort_spec.items()
+    if field == "_score":
+        return None
+    if isinstance(params, str):
+        return {"field": field, "order": params}
+    return {"field": field, **params}
+
+
+def _source_filter(src: dict, spec) -> dict | bool:
+    import fnmatch as fn
+    if spec is False:
+        return {}
+    if spec is True or spec is None:
+        return src
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        return {k: v for k, v in src.items()
+                if any(fn.fnmatch(k, p) for p in spec)}
+    includes = spec.get("includes", spec.get("include"))
+    excludes = spec.get("excludes", spec.get("exclude")) or []
+    out = {}
+    for k, v in src.items():
+        if includes is not None and not any(fn.fnmatch(k, p) for p in includes):
+            continue
+        if any(fn.fnmatch(k, p) for p in excludes):
+            continue
+        out[k] = v
+    return out

@@ -1,0 +1,5 @@
+"""REST API surface (ref rest/, http/, SURVEY.md §2.8)."""
+
+from .http_server import HttpServer, RestController, RestError
+
+__all__ = ["HttpServer", "RestController", "RestError"]

@@ -1,0 +1,439 @@
+"""REST API over HTTP: the reference's port-9200 surface.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/rest/ (RestController
+path-trie dispatch, rest/action/* 1:1 handlers) + http/netty/. The wire
+contract targets the machine-readable specs in
+/root/reference/rest-api-spec/api/*.json (ES 2.0 response shapes) so existing
+clients can point at this server unchanged.
+
+Implementation: stdlib ThreadingHTTPServer — the control plane is IO-bound
+host code; the data plane stays on device. (A C++ server lands with the
+native runtime milestone; the handler table below is transport-agnostic.)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from ..index.engine import VersionConflictException, DocumentMissingException
+from ..node import (IndexAlreadyExistsException, IndexMissingException,
+                    InvalidIndexNameException, NodeService)
+from ..search.aggs import AggregationParsingException
+from ..search.query_dsl import QueryParsingException
+
+
+class RestError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _status_of(e: Exception) -> int:
+    if isinstance(e, RestError):
+        return e.status
+    if isinstance(e, IndexMissingException):
+        return 404
+    if isinstance(e, DocumentMissingException):
+        return 404
+    if isinstance(e, IndexAlreadyExistsException):
+        return 400
+    if isinstance(e, VersionConflictException):
+        return 409
+    from ..script.engine import ScriptException
+    if isinstance(e, (InvalidIndexNameException, QueryParsingException,
+                      AggregationParsingException, ScriptException,
+                      json.JSONDecodeError, KeyError, ValueError)):
+        return 400
+    return 500
+
+
+class RestController:
+    """Method+path-pattern dispatch (ref rest/RestController.java:44,119,163
+    path trie; regex table is equivalent at this route count)."""
+
+    def __init__(self, node: NodeService):
+        self.node = node
+        self.routes: list[tuple[str, re.Pattern, Callable]] = []
+        _register_routes(self, node)
+
+    def register(self, method: str, pattern: str, handler: Callable) -> None:
+        # {name} -> named group; e.g. /{index}/_search
+        rx = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        # specificity: literal segments outrank parameters (the path-trie
+        # rule — /_mget must beat /{index})
+        segs = [s for s in pattern.split("/") if s]
+        literal = sum(1 for s in segs if "{" not in s)
+        self.routes.append((method, re.compile(f"^{rx}/?$"), handler,
+                            (literal, -len(segs))))
+
+    def dispatch(self, method: str, path: str, params: dict,
+                 body: bytes) -> tuple[int, dict | str]:
+        best = None
+        for m, rx, handler, spec in self.routes:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match and (best is None or spec > best[2]):
+                best = (handler, match, spec)
+        if best is None:
+            raise RestError(400, f"no handler for [{method} {path}]")
+        handler, match, _ = best
+        return handler(match.groupdict(), params, body)
+
+
+def _json_body(body: bytes) -> dict:
+    if not body:
+        return {}
+    return json.loads(body)
+
+
+def _register_routes(c: RestController, node: NodeService) -> None:
+    # -- cluster / node level ---------------------------------------------
+    def root(g, p, b):
+        return 200, {"status": 200, "name": "tpu-node-0",
+                     "cluster_name": node.cluster_name,
+                     "version": {"number": "2.0.0-tpu",
+                                 "lucene_version": "tensor-native"},
+                     "tagline": "You Know, for Search"}
+    c.register("GET", "/", root)
+    c.register("HEAD", "/", lambda g, p, b: (200, {}))
+
+    c.register("GET", "/_cluster/health",
+               lambda g, p, b: (200, node.cluster_health()))
+    c.register("GET", "/_stats", lambda g, p, b: (200, node.stats()))
+    c.register("GET", "/_cat/indices", _cat_indices(node))
+    c.register("GET", "/_cat/health", _cat_health(node))
+
+    def put_template(g, p, b):
+        node.put_template(g["name"], _json_body(b))
+        return 200, {"acknowledged": True}
+    c.register("PUT", "/_template/{name}", put_template)
+
+    # -- search (must register before the generic doc routes) -------------
+    def search(g, p, b):
+        body = _json_body(b)
+        if "q" in p:   # URI search (ref RestSearchAction query_string support)
+            body.setdefault("query", {"query_string": {"query": p["q"][0]}})
+        if "size" in p:
+            body["size"] = int(p["size"][0])
+        if "from" in p:
+            body["from"] = int(p["from"][0])
+        return 200, node.search(g.get("index", "_all"), body)
+    c.register("GET", "/{index}/_search", search)
+    c.register("POST", "/{index}/_search", search)
+    c.register("GET", "/_search", search)
+    c.register("POST", "/_search", search)
+    c.register("GET", "/{index}/{type}/_search",
+               lambda g, p, b: search(g, p, b))
+    c.register("POST", "/{index}/{type}/_search",
+               lambda g, p, b: search(g, p, b))
+
+    def count(g, p, b):
+        return 200, node.count(g.get("index", "_all"), _json_body(b))
+    c.register("GET", "/{index}/_count", count)
+    c.register("POST", "/{index}/_count", count)
+    c.register("GET", "/_count", count)
+
+    # -- bulk --------------------------------------------------------------
+    def bulk(g, p, b):
+        import time
+        t0 = time.perf_counter()
+        default_index = g.get("index")
+        ops = _parse_bulk(b, default_index)
+        items = node.bulk(ops)
+        errors = any(next(iter(i.values())).get("status", 200) >= 300
+                     for i in items)
+        if p.get("refresh", ["false"])[0] != "false":
+            node.refresh(default_index or "_all")
+        return 200, {"took": int((time.perf_counter() - t0) * 1000),
+                     "errors": errors, "items": items}
+    c.register("POST", "/_bulk", bulk)
+    c.register("PUT", "/_bulk", bulk)
+    c.register("POST", "/{index}/_bulk", bulk)
+    c.register("POST", "/{index}/{type}/_bulk", bulk)
+
+    # -- admin per index ---------------------------------------------------
+    def create_index(g, p, b):
+        body = _json_body(b)
+        node.create_index(g["index"], settings=body.get("settings"),
+                          mappings=body.get("mappings"),
+                          aliases=body.get("aliases"))
+        return 200, {"acknowledged": True}
+    c.register("PUT", "/{index}", create_index)
+    c.register("POST", "/{index}", create_index)
+
+    def delete_index(g, p, b):
+        node.delete_index(g["index"])
+        return 200, {"acknowledged": True}
+    c.register("DELETE", "/{index}", delete_index)
+
+    def index_exists(g, p, b):
+        try:
+            node._resolve(g["index"])
+            return 200, {}
+        except IndexMissingException:
+            return 404, {}
+    c.register("HEAD", "/{index}", index_exists)
+
+    def refresh(g, p, b):
+        node.refresh(g.get("index", "_all"))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("POST", "/{index}/_refresh", refresh)
+    c.register("POST", "/_refresh", refresh)
+
+    def flush(g, p, b):
+        node.flush(g.get("index", "_all"))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("POST", "/{index}/_flush", flush)
+    c.register("POST", "/_flush", flush)
+
+    def get_mapping(g, p, b):
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            out[n] = {"mappings": node.indices[n].mappings_dict()}
+        return 200, out
+    c.register("GET", "/{index}/_mapping", get_mapping)
+    c.register("GET", "/_mapping", get_mapping)
+
+    def put_mapping(g, p, b):
+        body = _json_body(b)
+        tname = g.get("type", "_doc")
+        mapping = body.get(tname, body)
+        node.put_mapping(g["index"], tname, mapping)
+        return 200, {"acknowledged": True}
+    c.register("PUT", "/{index}/_mapping/{type}", put_mapping)
+    c.register("PUT", "/{index}/{type}/_mapping", put_mapping)
+
+    def get_settings(g, p, b):
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            out[n] = {"settings": {"index": dict(node.indices[n].settings)}}
+        return 200, out
+    c.register("GET", "/{index}/_settings", get_settings)
+
+    def analyze(g, p, b):
+        body = _json_body(b)
+        text = body.get("text") or (p.get("text", [""])[0])
+        analyzer = body.get("analyzer", p.get("analyzer", ["standard"])[0])
+        svc = node.index_service(g["index"]) if g.get("index") else None
+        from ..analysis.analyzers import AnalysisService
+        an = (svc.mappers.analysis if svc else AnalysisService())
+        tokens = an.analyzer(analyzer).analyze(
+            text if isinstance(text, str) else " ".join(text))
+        return 200, {"tokens": [
+            {"token": t, "start_offset": 0, "end_offset": 0,
+             "type": "<ALPHANUM>", "position": i}
+            for i, t in enumerate(tokens)]}
+    c.register("GET", "/_analyze", analyze)
+    c.register("POST", "/_analyze", analyze)
+    c.register("GET", "/{index}/_analyze", analyze)
+    c.register("POST", "/{index}/_analyze", analyze)
+
+    def index_stats(g, p, b):
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            out[n] = node.indices[n].stats()
+        return 200, {"indices": out}
+    c.register("GET", "/{index}/_stats", index_stats)
+
+    # -- documents ---------------------------------------------------------
+    def put_doc(g, p, b):
+        kw = {}
+        if "version" in p:
+            kw["version"] = int(p["version"][0])
+            kw["version_type"] = p.get("version_type", ["internal"])[0]
+        if p.get("op_type", [None])[0] == "create":
+            kw["op_type"] = "create"
+        _, res = node.index_doc(g["index"], g.get("id"), _json_body(b),
+                                type_name=g.get("type", "_doc"),
+                                routing=p.get("routing", [None])[0], **kw)
+        if p.get("refresh", ["false"])[0] != "false":
+            node.refresh(g["index"])
+        status = 201 if res.created else 200
+        return status, {"_index": g["index"], "_type": g.get("type", "_doc"),
+                        "_id": res.doc_id, "_version": res.version,
+                        "created": res.created}
+    c.register("PUT", "/{index}/{type}/{id}", put_doc)
+    c.register("POST", "/{index}/{type}/{id}", put_doc)
+    c.register("POST", "/{index}/{type}", put_doc)
+
+    def create_doc(g, p, b):
+        p = {**p, "op_type": ["create"]}
+        return put_doc(g, p, b)
+    c.register("PUT", "/{index}/{type}/{id}/_create", create_doc)
+
+    def get_doc(g, p, b):
+        realtime = p.get("realtime", ["true"])[0] != "false"
+        res = node.get_doc(g["index"], g["id"],
+                           routing=p.get("routing", [None])[0],
+                           realtime=realtime)
+        out = {"_index": g["index"], "_type": res.type_name, "_id": g["id"],
+               "found": res.found}
+        if res.found:
+            out["_version"] = res.version
+            out["_source"] = res.source
+        return (200 if res.found else 404), out
+    c.register("GET", "/{index}/{type}/{id}", get_doc)
+
+    def get_source(g, p, b):
+        res = node.get_doc(g["index"], g["id"])
+        if not res.found:
+            return 404, {"error": "not found", "status": 404}
+        return 200, res.source
+    c.register("GET", "/{index}/{type}/{id}/_source", get_source)
+
+    def head_doc(g, p, b):
+        res = node.get_doc(g["index"], g["id"])
+        return (200 if res.found else 404), {}
+    c.register("HEAD", "/{index}/{type}/{id}", head_doc)
+
+    def delete_doc(g, p, b):
+        res = node.delete_doc(g["index"], g["id"],
+                              routing=p.get("routing", [None])[0])
+        return (200 if res.found else 404), {
+            "found": res.found, "_index": g["index"],
+            "_type": g.get("type", "_doc"), "_id": g["id"],
+            "_version": res.version}
+    c.register("DELETE", "/{index}/{type}/{id}", delete_doc)
+
+    def update_doc(g, p, b):
+        res, noop = node.update_doc(g["index"], g["id"], _json_body(b),
+                                    type_name=g.get("type", "_doc"))
+        if p.get("refresh", ["false"])[0] != "false":
+            node.refresh(g["index"])
+        return 200, {"_index": g["index"], "_type": g.get("type", "_doc"),
+                     "_id": g["id"], "_version": res.version}
+    c.register("POST", "/{index}/{type}/{id}/_update", update_doc)
+
+    def mget(g, p, b):
+        body = _json_body(b)
+        docs = []
+        for d in body.get("docs", []):
+            idx = d.get("_index", g.get("index"))
+            res = node.get_doc(idx, d["_id"])
+            entry = {"_index": idx, "_type": res.type_name,
+                     "_id": d["_id"], "found": res.found}
+            if res.found:
+                entry["_version"] = res.version
+                entry["_source"] = res.source
+            docs.append(entry)
+        return 200, {"docs": docs}
+    c.register("GET", "/_mget", mget)
+    c.register("POST", "/_mget", mget)
+    c.register("GET", "/{index}/_mget", mget)
+    c.register("POST", "/{index}/_mget", mget)
+
+
+def _parse_bulk(body: bytes, default_index: str | None) -> list:
+    """NDJSON bulk format (ref rest/action/bulk/RestBulkAction)."""
+    ops = []
+    lines = [ln for ln in body.decode("utf-8").split("\n") if ln.strip()]
+    i = 0
+    while i < len(lines):
+        action_line = json.loads(lines[i])
+        (action, meta), = action_line.items()
+        meta = dict(meta)
+        if default_index and "_index" not in meta:
+            meta["_index"] = default_index
+        i += 1
+        source = None
+        if action != "delete":
+            source = json.loads(lines[i])
+            i += 1
+        ops.append((action, meta, source))
+    return ops
+
+
+def _cat_indices(node: NodeService):
+    def handler(g, p, b):
+        rows = []
+        for n, svc in sorted(node.indices.items()):
+            rows.append(f"green open {n} {svc.n_shards} {svc.n_replicas} "
+                        f"{svc.doc_count()} 0")
+        return 200, "\n".join(rows) + "\n"
+    return handler
+
+
+def _cat_health(node: NodeService):
+    def handler(g, p, b):
+        h = node.cluster_health()
+        return 200, (f"{h['cluster_name']} {h['status']} "
+                     f"{h['number_of_nodes']} {h['number_of_data_nodes']}\n")
+    return handler
+
+
+# ---------------------------------------------------------------------------
+
+class HttpServer:
+    """Threaded HTTP front-end (ref http/HttpServer.java + netty transport)."""
+
+    def __init__(self, node: NodeService, host: str = "127.0.0.1",
+                 port: int = 9200):
+        self.controller = RestController(node)
+        controller = self.controller
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):   # silence per-request logs
+                pass
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                params = parse_qs(parsed.query)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    status, payload = controller.dispatch(
+                        method, parsed.path, params, body)
+                except Exception as e:  # noqa: BLE001 — REST error contract
+                    status = _status_of(e)
+                    payload = {"error": f"{type(e).__name__}: {e}",
+                               "status": status}
+                if isinstance(payload, str):
+                    data = payload.encode("utf-8")
+                    ctype = "text/plain; charset=UTF-8"
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    ctype = "application/json; charset=UTF-8"
+                if method == "HEAD":
+                    data = b""
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_HEAD(self):
+                self._handle("HEAD")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_port
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,5 @@
+"""Scripting (ref script/, SURVEY.md §2.9): restricted update scripts."""
+
+from .engine import run_update_script, ScriptException
+
+__all__ = ["run_update_script", "ScriptException"]

@@ -1,0 +1,185 @@
+"""Restricted update/script engine.
+
+The reference sandboxes Groovy for update scripts
+(/root/reference/src/main/java/org/elasticsearch/script/groovy/
+GroovySandboxExpressionChecker.java; update flow action/update/
+UpdateHelper.java:61). Groovy-on-JVM has no place here; instead a tiny
+AST-whitelisted expression language covers the overwhelmingly common update
+patterns (counter increments, field set/remove, list append) with NO access
+to anything outside `ctx` and `params` — the same capability boundary the
+reference's sandbox enforces.
+
+Supported: assignments and augmented assignments to ctx._source paths,
+arithmetic/comparison/boolean expressions, literals, list/dict displays,
+`del ctx._source.field` / ctx.op = "delete"-style deletes via `remove`,
+method calls append/extend/remove on lists, `if` statements.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any
+
+
+class ScriptException(Exception):
+    pass
+
+
+_ALLOWED_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+                   ast.Mod, ast.Pow)
+_ALLOWED_CMPOPS = (ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+                   ast.In, ast.NotIn)
+_ALLOWED_METHODS = {"append", "extend", "remove", "pop", "get", "keys",
+                    "values", "items", "upper", "lower", "strip", "split"}
+
+
+class _Env:
+    def __init__(self, ctx: dict, params: dict):
+        self.names = {"ctx": ctx, "params": params, "true": True,
+                      "false": False, "null": None}
+
+
+def run_update_script(script, source: dict, params: dict | None = None) -> dict:
+    """Execute an update script against a doc source; returns the new source.
+    Accepts the ES shapes: "inline string", {"inline": "..."} or
+    {"source"/"script": "..."} with optional {"params": {...}}."""
+    if isinstance(script, dict):
+        code = script.get("inline") or script.get("source") or \
+            script.get("script") or ""
+        params = params or script.get("params") or {}
+    else:
+        code = str(script)
+    params = params or {}
+    ctx = {"_source": source, "op": "index"}
+    try:
+        tree = ast.parse(code, mode="exec")
+    except SyntaxError as e:
+        raise ScriptException(f"script parse error: {e}") from e
+    env = _Env(ctx, params)
+    for stmt in tree.body:
+        _exec_stmt(stmt, env)
+    return ctx["_source"]
+
+
+def _exec_stmt(node: ast.stmt, env: _Env) -> None:
+    if isinstance(node, ast.Expr):
+        _eval(node.value, env)
+    elif isinstance(node, ast.Assign):
+        val = _eval(node.value, env)
+        for t in node.targets:
+            _assign(t, val, env)
+    elif isinstance(node, ast.AugAssign):
+        if not isinstance(node.op, _ALLOWED_BINOPS):
+            raise ScriptException("operator not allowed")
+        cur = _eval(node.target, env)
+        val = _apply_binop(node.op, cur, _eval(node.value, env))
+        _assign(node.target, val, env)
+    elif isinstance(node, ast.If):
+        branch = node.body if _eval(node.test, env) else node.orelse
+        for s in branch:
+            _exec_stmt(s, env)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            _delete(t, env)
+    else:
+        raise ScriptException(f"statement not allowed: {type(node).__name__}")
+
+
+def _assign(target: ast.expr, val: Any, env: _Env) -> None:
+    obj, key = _resolve_container(target, env)
+    obj[key] = val
+
+
+def _delete(target: ast.expr, env: _Env) -> None:
+    obj, key = _resolve_container(target, env)
+    obj.pop(key, None)
+
+
+def _resolve_container(target: ast.expr, env: _Env):
+    if isinstance(target, ast.Attribute):
+        obj = _eval(target.value, env)
+        if not isinstance(obj, dict):
+            raise ScriptException("can only assign into object fields")
+        return obj, target.attr
+    if isinstance(target, ast.Subscript):
+        obj = _eval(target.value, env)
+        key = _eval(target.slice, env)
+        return obj, key
+    raise ScriptException("invalid assignment target")
+
+
+def _apply_binop(op, a, b):
+    import operator
+    table = {ast.Add: operator.add, ast.Sub: operator.sub,
+             ast.Mult: operator.mul, ast.Div: operator.truediv,
+             ast.FloorDiv: operator.floordiv, ast.Mod: operator.mod,
+             ast.Pow: operator.pow}
+    return table[type(op)](a, b)
+
+
+def _eval(node: ast.expr, env: _Env) -> Any:
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id not in env.names:
+            raise ScriptException(f"unknown name [{node.id}]")
+        return env.names[node.id]
+    if isinstance(node, ast.Attribute):
+        obj = _eval(node.value, env)
+        if isinstance(obj, dict):
+            return obj.get(node.attr)
+        raise ScriptException(f"attribute access on non-object [{node.attr}]")
+    if isinstance(node, ast.Subscript):
+        obj = _eval(node.value, env)
+        key = _eval(node.slice, env)
+        if isinstance(obj, dict):
+            return obj.get(key)
+        return obj[key]
+    if isinstance(node, ast.BinOp):
+        if not isinstance(node.op, _ALLOWED_BINOPS):
+            raise ScriptException("operator not allowed")
+        return _apply_binop(node.op, _eval(node.left, env),
+                            _eval(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        v = _eval(node.operand, env)
+        if isinstance(node.op, ast.USub):
+            return -v
+        if isinstance(node.op, ast.Not):
+            return not v
+        raise ScriptException("unary operator not allowed")
+    if isinstance(node, ast.Compare):
+        left = _eval(node.left, env)
+        import operator
+        table = {ast.Eq: operator.eq, ast.NotEq: operator.ne,
+                 ast.Lt: operator.lt, ast.LtE: operator.le,
+                 ast.Gt: operator.gt, ast.GtE: operator.ge,
+                 ast.In: lambda a, b: a in b,
+                 ast.NotIn: lambda a, b: a not in b}
+        for op, comp in zip(node.ops, node.comparators):
+            if not isinstance(op, _ALLOWED_CMPOPS):
+                raise ScriptException("comparison not allowed")
+            right = _eval(comp, env)
+            if not table[type(op)](left, right):
+                return False
+            left = right
+        return True
+    if isinstance(node, ast.BoolOp):
+        vals = [_eval(v, env) for v in node.values]
+        return all(vals) if isinstance(node.op, ast.And) else any(vals)
+    if isinstance(node, ast.IfExp):
+        return _eval(node.body, env) if _eval(node.test, env) \
+            else _eval(node.orelse, env)
+    if isinstance(node, ast.List):
+        return [_eval(e, env) for e in node.elts]
+    if isinstance(node, ast.Dict):
+        return {_eval(k, env): _eval(v, env)
+                for k, v in zip(node.keys, node.values)}
+    if isinstance(node, ast.Call):
+        if not isinstance(node.func, ast.Attribute):
+            raise ScriptException("only method calls are allowed")
+        if node.func.attr not in _ALLOWED_METHODS:
+            raise ScriptException(f"method [{node.func.attr}] not allowed")
+        obj = _eval(node.func.value, env)
+        args = [_eval(a, env) for a in node.args]
+        return getattr(obj, node.func.attr)(*args)
+    raise ScriptException(f"expression not allowed: {type(node).__name__}")

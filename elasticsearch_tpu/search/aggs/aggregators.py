@@ -89,11 +89,23 @@ def parse_aggs(spec: dict | None) -> list[AggSpec]:
 # ---------------------------------------------------------------------------
 
 def _numeric_column(seg: Segment, field: str):
-    """-> (vals f64[N], valid bool[N]) or None."""
+    """-> (vals [N] in the column's NATIVE dtype, valid bool[N]) or None.
+    i64 stays i64: casting to float64 would collapse distinct longs > 2^53
+    (snowflake ids) in terms/cardinality buckets."""
     nc = seg.numerics.get(field)
     if nc is None:
         return None
-    return np.asarray(nc.vals).astype(np.float64), ~np.asarray(nc.missing)
+    return np.asarray(nc.vals), ~np.asarray(nc.missing)
+
+
+def _text_present_mask(seg: Segment, field: str) -> np.ndarray | None:
+    """bool[n_pad]: docs with at least one posting in an analyzed field."""
+    fx = seg.text.get(field)
+    if fx is None:
+        return None
+    present = np.zeros(seg.n_pad, bool)
+    present[np.asarray(fx.doc_ids)[:fx.n_postings]] = True
+    return present
 
 
 def _keyword_column(seg: Segment, field: str):
@@ -116,6 +128,10 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
     """
     partials = {}
     for spec in specs:
+        if spec.type == "terms":
+            partials[spec.name] = _collect_terms_shard(
+                spec, segments, masks, query_parser)
+            continue
         segs_partials = [_collect_one(spec, seg, mask, query_parser)
                          for seg, mask in zip(segments, masks)]
         merged = segs_partials[0] if segs_partials else _empty_partial(spec)
@@ -125,7 +141,108 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
     return partials
 
 
+def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
+                         masks: list[np.ndarray], qp) -> dict:
+    """Two-pass terms collection with correct shard_size semantics (ref
+    bucket/terms/TermsAggregator shard_size over-collection): pass 1 counts
+    every key across ALL segments (vectorized, cheap), the top shard_size
+    keys are chosen from the MERGED counts, and only for those keys — and
+    only if there are sub-aggs — does pass 2 build per-key doc masks.
+    Truncation is accounted: other_doc_count + error_bound travel in the
+    partial so the coordinator's reduce can report them."""
+    counts: dict = {}
+    for seg, mask in zip(segments, masks):
+        for key, c in _terms_counts(spec, seg, mask).items():
+            counts[key] = counts.get(key, 0) + c
+    size = int(spec.params.get("size", 10)) or len(counts) or 1
+    shard_size = int(spec.params.get("shard_size", size * 3 + 10))
+    items = sorted(counts.items(), key=lambda kv: (-kv[1], str(kv[0])))
+    top = items[:shard_size]
+    dropped = items[shard_size:]
+    buckets: dict = {}
+    for key, c in top:
+        entry: dict = {"doc_count": int(c)}
+        if spec.subs:
+            sub_parts: dict = {}
+            for seg, mask in zip(segments, masks):
+                m = _terms_key_mask(spec, seg, key)
+                if m is None:
+                    continue
+                m = m & mask
+                for s in spec.subs:
+                    part = _collect_one(s, seg, m, qp)
+                    prev = sub_parts.get(s.name)
+                    sub_parts[s.name] = part if prev is None \
+                        else merge_partial(s, prev, part)
+            entry["subs"] = {s.name: sub_parts.get(s.name, _empty_partial(s))
+                             for s in spec.subs}
+        buckets[key] = entry
+    return {"buckets": buckets,
+            "other_doc_count": int(sum(c for _, c in dropped)),
+            "error_bound": int(top[-1][1]) if dropped else 0}
+
+
+def _terms_counts(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
+    """Pass 1: key -> doc_count for one segment, fully vectorized."""
+    field = spec.params["field"]
+    kw = _keyword_column(seg, field)
+    if kw is not None:
+        ords, values = kw
+        sel = mask & (ords >= 0)
+        counts = np.bincount(ords[sel], minlength=len(values))
+        return {values[o]: int(counts[o]) for o in np.nonzero(counts)[0]}
+    col = _numeric_column(seg, field)
+    if col is not None:
+        vals, valid = col
+        sel = mask & valid[:len(mask)]
+        uniq, ucounts = np.unique(vals[sel], return_counts=True)
+        if vals.dtype.kind == "i":
+            return {int(u): int(c) for u, c in zip(uniq, ucounts)}
+        return {(int(u) if float(u).is_integer() else float(u)): int(c)
+                for u, c in zip(uniq, ucounts)}
+    # analyzed text: token counts via the postings lists (fielddata-on-
+    # analyzed-string behavior, ref index/fielddata/)
+    fx = seg.text.get(field)
+    if fx is None:
+        return {}
+    P = fx.n_postings
+    doc_of = np.asarray(fx.doc_ids)[:P]
+    term_of = np.repeat(np.arange(len(fx.term_lens)), fx.term_lens)
+    hit = mask[np.minimum(doc_of, len(mask) - 1)]
+    counts = np.bincount(term_of[hit], minlength=len(fx.term_lens))
+    terms_sorted = list(fx.terms)
+    return {terms_sorted[t]: int(counts[t]) for t in np.nonzero(counts)[0]}
+
+
+def _terms_key_mask(spec: AggSpec, seg: Segment, key) -> np.ndarray | None:
+    """Pass 2: bool[n_pad] of docs holding `key` (pre-query-mask)."""
+    field = spec.params["field"]
+    kw = _keyword_column(seg, field)
+    if kw is not None:
+        ords, _ = kw
+        kc = seg.keywords[field]
+        o = kc.ord_of(str(key))
+        if o < 0:
+            return None
+        return ords == o
+    col = _numeric_column(seg, field)
+    if col is not None:
+        vals, valid = col
+        return (vals == key) & valid
+    fx = seg.text.get(field)
+    if fx is None:
+        return None
+    s, ln, tid = fx.lookup(str(key))
+    if tid < 0:
+        return None
+    m = np.zeros(seg.n_pad, bool)
+    m[np.asarray(fx.doc_ids)[s:s + ln]] = True
+    return m
+
+
 def _empty_partial(spec: AggSpec) -> dict:
+    if spec.type == "terms":
+        return {"buckets": {}, "other_doc_count": 0, "error_bound": 0}
     if spec.type in BUCKET_TYPES:
         return {"buckets": {}}
     return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
@@ -151,6 +268,15 @@ def _metric_segment(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
             hll = HyperLogLog()
             hll.add([values[o] for o in uniq])
             return {"hll": hll}
+        if field in seg.text:   # distinct tokens among matched docs
+            fx = seg.text[field]
+            doc_of = np.asarray(fx.doc_ids)[:fx.n_postings]
+            term_of = np.repeat(np.arange(len(fx.term_lens)), fx.term_lens)
+            hit = mask[np.minimum(doc_of, len(mask) - 1)]
+            terms_sorted = list(fx.terms)
+            hll = HyperLogLog()
+            hll.add([terms_sorted[t] for t in np.unique(term_of[hit])])
+            return {"hll": hll}
     col = _numeric_column(seg, field) if field else None
     if col is None:
         return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
@@ -172,11 +298,11 @@ def _metric_collect(spec: AggSpec, vals: np.ndarray, sel: np.ndarray) -> dict:
                 "percents": spec.params.get("percents",
                                             [1, 5, 25, 50, 75, 95, 99])}
     count = int(v.size)
-    s = float(v.sum()) if count else 0.0
-    return {"count": count, "sum": s,
-            "min": float(v.min()) if count else math.inf,
-            "max": float(v.max()) if count else -math.inf,
-            "sum_sq": float((v * v).sum()) if count else 0.0}
+    vf = v.astype(np.float64, copy=False)   # stats in f64 (i64*i64 overflows)
+    return {"count": count, "sum": float(vf.sum()) if count else 0.0,
+            "min": float(vf.min()) if count else math.inf,
+            "max": float(vf.max()) if count else -math.inf,
+            "sum_sq": float((vf * vf).sum()) if count else 0.0}
 
 
 # -- bucket aggs ------------------------------------------------------------
@@ -210,40 +336,17 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
         field = p["field"]
         col = _numeric_column(seg, field)
         kw = _keyword_column(seg, field)
+        txt = _text_present_mask(seg, field)
         if col is not None:
             miss = ~col[1]
         elif kw is not None:
             miss = kw[0] < 0
+        elif txt is not None:
+            miss = ~txt   # analyzed field: "has it" == any posting
         else:
             miss = np.ones(n, bool)
         m = mask & miss[:len(mask)]
         return {"buckets": {"_missing": _bucket_entry(spec, seg, m, qp)}}
-
-    if t == "terms":
-        field = p["field"]
-        kw = _keyword_column(seg, field)
-        if kw is not None:
-            ords, values = kw
-            sel = mask & (ords >= 0)
-            counts = np.bincount(ords[sel], minlength=len(values))
-            out = {}
-            for o in np.nonzero(counts)[0]:
-                key = values[o]
-                m = sel & (ords == o)
-                out[key] = _bucket_entry(spec, seg, m, qp)
-            return {"buckets": out}
-        col = _numeric_column(seg, field)
-        if col is None:
-            return {"buckets": {}}
-        vals, valid = col
-        sel = mask & valid[:len(mask)]
-        uniq = np.unique(vals[sel])
-        out = {}
-        for u in uniq:
-            m = sel & (vals == u)
-            key = int(u) if float(u).is_integer() else float(u)
-            out[key] = _bucket_entry(spec, seg, m, qp)
-        return {"buckets": out}
 
     if t in ("histogram", "date_histogram"):
         field = p["field"]
@@ -254,7 +357,11 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
         sel = mask & valid[:len(mask)]
         if t == "histogram":
             interval = float(p["interval"])
-            keys = np.floor(vals / interval) * interval
+            if vals.dtype.kind == "i" and interval.is_integer():
+                step = int(interval)   # exact int bucketing for longs
+                keys = (vals // step) * step
+            else:
+                keys = np.floor(vals.astype(np.float64) / interval) * interval
         else:
             keys = _date_round(vals, str(p.get("interval", "1d")))
         out = {}
@@ -388,6 +495,10 @@ def merge_partial(spec: AggSpec, a: dict, b: dict) -> dict:
     if spec.type in METRIC_TYPES:
         return _merge_metric(spec, a, b)
     out = dict(a)
+    if spec.type == "terms":
+        out["other_doc_count"] = a.get("other_doc_count", 0) \
+            + b.get("other_doc_count", 0)
+        out["error_bound"] = a.get("error_bound", 0) + b.get("error_bound", 0)
     buckets = dict(a.get("buckets", {}))
     for key, eb in b.get("buckets", {}).items():
         ea = buckets.get(key)
@@ -472,17 +583,21 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
     if t == "terms":
         size = int(spec.params.get("size", 10)) or len(buckets)
         order = spec.params.get("order", {"_count": "desc"})
-        items = list(buckets.items())
-        (okey, odir), = order.items() if isinstance(order, dict) else \
-            [("_count", "desc")]
+        if isinstance(order, list):       # ES list form: primary key first
+            order = order[0] if order else {"_count": "desc"}
+        if not isinstance(order, dict) or not order:
+            order = {"_count": "desc"}
+        okey, odir = next(iter(order.items()))
         reverse = odir == "desc"
+        items = list(buckets.items())
         if okey == "_term":
-            items.sort(key=lambda kv: kv[0], reverse=reverse)
+            items.sort(key=lambda kv: str(kv[0]), reverse=reverse)
         else:
             items.sort(key=lambda kv: (kv[1]["doc_count"], ), reverse=reverse)
         top = items[:size]
-        other = sum(e["doc_count"] for _, e in items[size:])
-        return {"doc_count_error_upper_bound": 0,
+        other = sum(e["doc_count"] for _, e in items[size:]) \
+            + p.get("other_doc_count", 0)
+        return {"doc_count_error_upper_bound": p.get("error_bound", 0),
                 "sum_other_doc_count": other,
                 "buckets": [rb(k, e) for k, e in top]}
 

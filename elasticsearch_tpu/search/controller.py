@@ -1,0 +1,105 @@
+"""Search-phase controller: the cross-shard reduce for the 2-phase protocol.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/search/controller/
+SearchPhaseController.java — sortDocs (:147,233) merges per-shard top-k,
+merge (:282-399) combines hits + aggregation reduce into the final response.
+
+On a packed mesh the same reduce runs on-device as collectives
+(parallel/distributed_search.py); this host-side controller serves the
+engine-per-shard path (local multi-shard node, and later the DCN
+coordinator between pods).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .shard_searcher import QuerySearchResult, ShardSearcher, FetchedHit
+
+
+@dataclass
+class ReducedDocs:
+    """Winner list after the query-phase reduce: which docs to fetch where."""
+    shard_order: list[int]          # shard id per result slot (len <= size)
+    doc_keys: list[int]             # doc key per result slot
+    scores: list[float]
+    sort_values: list[float] | None
+    total_hits: int
+    max_score: float
+
+
+def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
+              sort: dict | None = None, query_row: int = 0) -> ReducedDocs:
+    """Merge per-shard top-k into the global winner list
+    (ref SearchPhaseController.sortDocs — TopDocs.merge semantics: score
+    desc / sort-key asc, shard index breaks ties like the reference's
+    shard-ordinal tie-break)."""
+    entries = []   # (primary_key, shard_idx, pos, doc_key, score, sort_val)
+    total = 0
+    max_score = float("-inf")
+    for si, r in enumerate(results):
+        total += int(r.total_hits[query_row])
+        ms = float(r.max_score[query_row])
+        if not np.isnan(ms):
+            max_score = max(max_score, ms)
+        keys = r.doc_keys[query_row]
+        for pos in range(keys.shape[0]):
+            key = int(keys[pos])
+            if key < 0:
+                continue
+            score = float(r.scores[query_row][pos])
+            if sort is None:
+                primary = -score if not np.isnan(score) else float("inf")
+                sv = None
+            else:
+                sv = float(r.sort_values[query_row][pos])
+                primary = sv if sort.get("order", "asc") == "asc" else -sv
+            entries.append((primary, si, pos, key, score, sv))
+    entries.sort(key=lambda e: (e[0], e[1], e[2]))
+    window = entries[from_: from_ + size]
+    return ReducedDocs(
+        shard_order=[e[1] for e in window],
+        doc_keys=[e[3] for e in window],
+        scores=[e[4] for e in window],
+        sort_values=[e[5] for e in window] if sort is not None else None,
+        total_hits=total,
+        max_score=max_score if max_score > float("-inf") else float("nan"))
+
+
+def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
+                    source_filter=None) -> list[dict]:
+    """Fetch phase fan-out to winning shards only + final hit assembly
+    (ref FetchPhase + SearchPhaseController.merge). `searchers` is aligned
+    with the results list passed to sort_docs."""
+    # group result slots by shard (the docIdsToLoad structure)
+    by_shard: dict[int, list[int]] = {}
+    for slot, si in enumerate(reduced.shard_order):
+        by_shard.setdefault(si, []).append(slot)
+    hits_by_slot: dict[int, FetchedHit] = {}
+    for si, slots in by_shard.items():
+        keys = [reduced.doc_keys[s] for s in slots]
+        scores = np.asarray([reduced.scores[s] for s in slots], np.float32)
+        svs = np.asarray([reduced.sort_values[s] for s in slots]) \
+            if reduced.sort_values is not None else None
+        fetched = searchers[si].execute_fetch_phase(keys, scores, svs)
+        for slot, hit in zip(slots, fetched):
+            hits_by_slot[slot] = hit
+    out = []
+    for slot in range(len(reduced.doc_keys)):
+        h = hits_by_slot[slot]
+        src = h.source
+        if source_filter is not None:
+            src = source_filter(src)
+        entry = {
+            "_index": None,   # filled by the caller
+            "_type": h.type_name,
+            "_id": h.doc_id,
+            "_score": None if np.isnan(h.score) else float(h.score),
+            "_source": src,
+        }
+        if reduced.sort_values is not None:
+            entry["sort"] = [h.sort_value]
+        out.append(entry)
+    return out

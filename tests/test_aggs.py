@@ -198,6 +198,93 @@ class TestRegressions:
         assert (a == b).all()
 
 
+class TestReviewRegressions:
+    def test_text_terms_no_per_segment_truncation(self, tmp_path):
+        """A term inside the cap in one segment but outside in another must
+        still count BOTH segments' docs (two-pass shard collection)."""
+        ms = MapperService()
+        eng = Engine(str(tmp_path / "s"), ms)
+        # segment 1: term 'common' in 5 docs; segment 2: 'common' in 3 more
+        for i in range(5):
+            eng.index(f"a{i}", {"t": "common " + f"filler{i} " * 3})
+        eng.refresh()
+        for i in range(3):
+            eng.index(f"b{i}", {"t": "common other"})
+        eng.refresh()
+        sr = ShardSearcher(0, eng.segments, ms)
+        specs = parse_aggs({"toks": {"terms": {"field": "t", "size": 5}}})
+        res = sr.execute_query_phase(sr.parse([{"match_all": {}}]),
+                                     size=0, aggs=specs)
+        out = render(specs, merge_shard_partials(specs, [res.aggs]))
+        by_key = {b["key"]: b["doc_count"] for b in out["toks"]["buckets"]}
+        assert by_key["common"] == 8
+        eng.close()
+
+    def test_terms_big_longs_stay_exact(self, tmp_path):
+        ms = MapperService(mappings={"_doc": {"properties": {
+            "sid": {"type": "long"}}}})
+        eng = Engine(str(tmp_path / "s"), ms)
+        a, b = 9007199254740993, 9007199254740995   # distinct, both > 2^53
+        eng.index("1", {"sid": a})
+        eng.index("2", {"sid": b})
+        eng.refresh()
+        sr = ShardSearcher(0, eng.segments, ms)
+        specs = parse_aggs({"ids": {"terms": {"field": "sid"}},
+                            "c": {"cardinality": {"field": "sid"}}})
+        res = sr.execute_query_phase(sr.parse([{"match_all": {}}]),
+                                     size=0, aggs=specs)
+        out = render(specs, merge_shard_partials(specs, [res.aggs]))
+        assert {bk["key"] for bk in out["ids"]["buckets"]} == {a, b}
+        assert out["c"]["value"] == 2
+        eng.close()
+
+    def test_missing_and_cardinality_on_text(self, tmp_path):
+        ms = MapperService()
+        eng = Engine(str(tmp_path / "s"), ms)
+        eng.index("1", {"t": "alpha beta"})
+        eng.index("2", {"t": "alpha gamma"})
+        eng.index("3", {"other": 1})
+        eng.refresh()
+        sr = ShardSearcher(0, eng.segments, ms)
+        specs = parse_aggs({"no_t": {"missing": {"field": "t"}},
+                            "toks": {"cardinality": {"field": "t"}}})
+        res = sr.execute_query_phase(sr.parse([{"match_all": {}}]),
+                                     size=0, aggs=specs)
+        out = render(specs, merge_shard_partials(specs, [res.aggs]))
+        assert out["no_t"]["doc_count"] == 1      # only doc 3 lacks 't'
+        assert out["toks"]["value"] == 3          # alpha, beta, gamma
+        eng.close()
+
+    def test_terms_order_list_and_multikey(self, searcher):
+        out = run_aggs(searcher, {"cats": {"terms": {
+            "field": "cat", "order": [{"_term": "desc"}]}}})
+        assert [b["key"] for b in out["cats"]["buckets"]] == ["c", "b", "a"]
+        out = run_aggs(searcher, {"cats": {"terms": {
+            "field": "cat", "order": {"_term": "asc", "_count": "desc"}}}})
+        assert [b["key"] for b in out["cats"]["buckets"]] == ["a", "b", "c"]
+
+    def test_terms_shard_size_truncation_reported(self, tmp_path):
+        ms = MapperService(mappings={"_doc": {"properties": {
+            "k": {"type": "keyword"}}}})
+        eng = Engine(str(tmp_path / "s"), ms)
+        n = 0
+        for v in range(30):          # 30 distinct keys, one doc each
+            eng.index(str(n), {"k": f"key{v:02d}"})
+            n += 1
+        eng.refresh()
+        sr = ShardSearcher(0, eng.segments, ms)
+        specs = parse_aggs({"ks": {"terms": {
+            "field": "k", "size": 3, "shard_size": 10}}})
+        res = sr.execute_query_phase(sr.parse([{"match_all": {}}]),
+                                     size=0, aggs=specs)
+        out = render(specs, merge_shard_partials(specs, [res.aggs]))
+        assert len(out["ks"]["buckets"]) == 3
+        # 30 total - 3 shown = 27 others (7 in-shard beyond size + 20 dropped)
+        assert out["ks"]["sum_other_doc_count"] == 27
+        assert out["ks"]["doc_count_error_upper_bound"] >= 1
+        eng.close()
+
+
 class TestCrossShardReduce:
     def test_two_shard_merge(self, tmp_path):
         """Partials from independent shards reduce to the union answer

@@ -1,0 +1,276 @@
+"""REST API contract tests over real HTTP — the analog of the reference's
+rest-api-spec YAML suites executed by ElasticsearchRestTests (SURVEY.md §4.4):
+index lifecycle, document CRUD, bulk, search with aggs/sort/_source, update
+scripts, analyze, cat."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    node = NodeService(str(tmp_path_factory.mktemp("node")))
+    srv = HttpServer(node, port=0).start()
+    yield srv
+    srv.stop()
+    node.close()
+
+
+def req(server, method, path, body=None, ndjson=None, expect_error=False):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    data = None
+    headers = {}
+    if ndjson is not None:
+        data = ndjson.encode()
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    r = urllib.request.Request(url, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw and raw[0:1] in (b"{", b"[") \
+                else raw.decode()
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        payload = json.loads(raw) if raw and raw[0:1] == b"{" else raw.decode()
+        if expect_error:
+            return e.code, payload
+        raise AssertionError(f"{method} {path} -> {e.code}: {payload}") from e
+
+
+class TestLifecycleAndCrud:
+    def test_root(self, server):
+        status, out = req(server, "GET", "/")
+        assert status == 200 and out["tagline"] == "You Know, for Search"
+
+    def test_create_index_and_doc_roundtrip(self, server):
+        status, out = req(server, "PUT", "/books", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"book": {"properties": {
+                "title": {"type": "text"}, "year": {"type": "long"},
+                "genre": {"type": "keyword"}}}}})
+        assert status == 200 and out["acknowledged"]
+        status, out = req(server, "PUT", "/books/book/1",
+                          {"title": "Dune", "year": 1965, "genre": "scifi"})
+        assert status == 201 and out["created"] and out["_version"] == 1
+        status, out = req(server, "GET", "/books/book/1")
+        assert status == 200 and out["found"]
+        assert out["_source"]["title"] == "Dune"
+        # reindex bumps version, created false -> 200
+        status, out = req(server, "PUT", "/books/book/1",
+                          {"title": "Dune", "year": 1965, "genre": "classic"})
+        assert status == 200 and not out["created"] and out["_version"] == 2
+
+    def test_create_conflict(self, server):
+        req(server, "PUT", "/books/book/c1", {"title": "X"})
+        status, out = req(server, "PUT", "/books/book/c1/_create",
+                          {"title": "Y"}, expect_error=True)
+        assert status == 409
+
+    def test_delete_doc(self, server):
+        req(server, "PUT", "/books/book/togo", {"title": "Temp"})
+        status, out = req(server, "DELETE", "/books/book/togo")
+        assert status == 200 and out["found"]
+        status, out = req(server, "GET", "/books/book/togo", expect_error=True)
+        assert status == 404
+
+    def test_missing_index_404(self, server):
+        status, out = req(server, "GET", "/nope/_search", expect_error=True)
+        assert status == 404
+
+    def test_invalid_index_name(self, server):
+        status, out = req(server, "PUT", "/Bad*Name", {}, expect_error=True)
+        assert status == 400
+
+
+class TestBulkAndSearch:
+    @pytest.fixture(scope="class", autouse=True)
+    def corpus(self, server):
+        lines = []
+        docs = [
+            ("1", "The quick brown fox", 1994, "fiction", 12.5),
+            ("2", "Quick snacks cookbook", 2001, "cooking", 25.0),
+            ("3", "Lazy dog training", 2010, "pets", 18.0),
+            ("4", "Brown bread baking", 2001, "cooking", 30.0),
+            ("5", "Fox hunting history", 1994, "history", 40.0),
+        ]
+        for i, title, year, genre, price in docs:
+            lines.append(json.dumps({"index": {"_index": "lib", "_type": "d",
+                                               "_id": i}}))
+            lines.append(json.dumps({"title": title, "year": year,
+                                     "genre": genre, "price": price}))
+        status, out = req(server, "POST", "/_bulk?refresh=true",
+                          ndjson="\n".join(lines) + "\n")
+        assert status == 200 and not out["errors"]
+        assert len(out["items"]) == 5
+
+    def test_match_search(self, server):
+        status, out = req(server, "POST", "/lib/_search",
+                          {"query": {"match": {"title": "quick"}}})
+        assert out["hits"]["total"] == 2
+        ids = {h["_id"] for h in out["hits"]["hits"]}
+        assert ids == {"1", "2"}
+        assert out["hits"]["hits"][0]["_score"] is not None
+
+    def test_uri_search(self, server):
+        status, out = req(server, "GET", "/lib/_search?q=title:fox&size=5")
+        assert out["hits"]["total"] == 2
+
+    def test_sort_and_from_size(self, server):
+        status, out = req(server, "POST", "/lib/_search", {
+            "query": {"match_all": {}},
+            "sort": [{"price": {"order": "desc"}}], "size": 2, "from": 1})
+        prices = [h["_source"]["price"] for h in out["hits"]["hits"]]
+        assert prices == [30.0, 25.0]
+        assert out["hits"]["hits"][0]["sort"] == [30.0]
+
+    def test_source_filtering(self, server):
+        status, out = req(server, "POST", "/lib/_search", {
+            "query": {"term": {"genre": "cooking"}},
+            "_source": ["title"]})
+        for h in out["hits"]["hits"]:
+            assert set(h["_source"].keys()) == {"title"}
+
+    def test_aggs_in_search(self, server):
+        status, out = req(server, "POST", "/lib/_search", {
+            "size": 0,
+            "aggs": {"genres": {"terms": {"field": "genre"},
+                                "aggs": {"avg_price": {
+                                    "avg": {"field": "price"}}}},
+                     "years": {"histogram": {"field": "year",
+                                             "interval": 10}}}})
+        genres = {b["key"]: b for b in out["aggregations"]["genres"]["buckets"]}
+        assert genres["cooking"]["doc_count"] == 2
+        assert abs(genres["cooking"]["avg_price"]["value"] - 27.5) < 1e-9
+        assert out["hits"]["hits"] == []
+
+    def test_count(self, server):
+        status, out = req(server, "POST", "/lib/_count",
+                          {"query": {"term": {"genre": "cooking"}}})
+        assert out["count"] == 2
+
+    def test_query_then_fetch_across_shards(self, server):
+        # 'lib' defaults to 1 shard; make a 3-shard index and check ranking
+        req(server, "PUT", "/sharded", {"settings": {"number_of_shards": 3}})
+        lines = []
+        for i in range(30):
+            lines.append(json.dumps({"index": {"_index": "sharded",
+                                               "_type": "d", "_id": str(i)}}))
+            lines.append(json.dumps({"t": "alpha " * (i % 3 + 1)}))
+        req(server, "POST", "/_bulk?refresh=true",
+            ndjson="\n".join(lines) + "\n")
+        status, out = req(server, "POST", "/sharded/_search",
+                          {"query": {"match": {"t": "alpha"}}, "size": 30})
+        assert out["hits"]["total"] == 30
+        assert out["_shards"]["total"] == 3
+        scores = [h["_score"] for h in out["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_mget(self, server):
+        status, out = req(server, "POST", "/_mget", {
+            "docs": [{"_index": "lib", "_id": "1"},
+                     {"_index": "lib", "_id": "99"}]})
+        assert out["docs"][0]["found"] and not out["docs"][1]["found"]
+
+
+class TestUpdateAndScripts:
+    def test_doc_merge_update(self, server):
+        req(server, "PUT", "/upd/d/1", {"count": 1, "tag": "a"})
+        status, out = req(server, "POST", "/upd/d/1/_update",
+                          {"doc": {"tag": "b"}})
+        assert out["_version"] == 2
+        _, got = req(server, "GET", "/upd/d/1")
+        assert got["_source"] == {"count": 1, "tag": "b"}
+
+    def test_scripted_counter(self, server):
+        req(server, "PUT", "/upd/d/2", {"views": 10})
+        status, out = req(server, "POST", "/upd/d/2/_update", {
+            "script": {"inline": "ctx._source.views += params.by",
+                       "params": {"by": 5}}})
+        _, got = req(server, "GET", "/upd/d/2")
+        assert got["_source"]["views"] == 15
+
+    def test_upsert(self, server):
+        status, out = req(server, "POST", "/upd/d/new1/_update", {
+            "doc": {"x": 1}, "upsert": {"x": 0, "created_by": "upsert"}})
+        _, got = req(server, "GET", "/upd/d/new1")
+        assert got["_source"]["created_by"] == "upsert"
+
+    def test_update_missing_doc_404(self, server):
+        status, out = req(server, "POST", "/upd/d/ghost/_update",
+                          {"doc": {"x": 1}}, expect_error=True)
+        assert status == 404
+
+    def test_script_sandbox(self, server):
+        req(server, "PUT", "/upd/d/3", {"v": 1})
+        status, out = req(server, "POST", "/upd/d/3/_update", {
+            "script": {"inline": "__import__('os').system('true')"}},
+            expect_error=True)
+        assert status == 400
+
+
+class TestAdmin:
+    def test_mapping_roundtrip(self, server):
+        status, out = req(server, "GET", "/books/_mapping")
+        props = out["books"]["mappings"]["book"]["properties"]
+        assert props["year"]["type"] == "long"
+        req(server, "PUT", "/books/_mapping/book",
+            {"properties": {"isbn": {"type": "keyword"}}})
+        status, out = req(server, "GET", "/books/_mapping")
+        assert out["books"]["mappings"]["book"]["properties"]["isbn"]["type"] \
+            == "keyword"
+
+    def test_analyze(self, server):
+        status, out = req(server, "POST", "/_analyze", {
+            "text": "The Quick-Brown FOXES", "analyzer": "standard"})
+        tokens = [t["token"] for t in out["tokens"]]
+        assert tokens == ["the", "quick", "brown", "foxes"]
+
+    def test_cluster_health(self, server):
+        status, out = req(server, "GET", "/_cluster/health")
+        assert out["status"] == "green" and out["number_of_nodes"] == 1
+
+    def test_cat_indices(self, server):
+        status, out = req(server, "GET", "/_cat/indices")
+        assert "books" in out
+
+    def test_index_template(self, server):
+        req(server, "PUT", "/_template/logs", {
+            "template": "logs-*",
+            "settings": {"number_of_shards": 2},
+            "mappings": {"event": {"properties": {
+                "level": {"type": "keyword"}}}}})
+        req(server, "PUT", "/logs-2024", {})
+        status, out = req(server, "GET", "/logs-2024/_mapping")
+        assert out["logs-2024"]["mappings"]["event"]["properties"]["level"][
+            "type"] == "keyword"
+
+    def test_delete_index(self, server):
+        req(server, "PUT", "/todelete", {})
+        status, _ = req(server, "HEAD", "/todelete")
+        assert status == 200
+        req(server, "DELETE", "/todelete")
+        status, _ = req(server, "HEAD", "/todelete", expect_error=True)
+        assert status == 404
+
+    def test_persistence_across_reopen(self, server, tmp_path):
+        node = NodeService(str(tmp_path / "n1"))
+        node.create_index("persist", mappings={
+            "d": {"properties": {"k": {"type": "keyword"}}}})
+        node.index_doc("persist", "1", {"k": "v"})
+        node.flush()
+        node.close()
+        node2 = NodeService(str(tmp_path / "n1"))
+        assert "persist" in node2.indices
+        res = node2.get_doc("persist", "1")
+        assert res.found and res.source == {"k": "v"}
+        assert node2.indices["persist"].mappers.field_type("k").type == "keyword"
+        node2.close()

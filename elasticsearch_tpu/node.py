@@ -23,6 +23,7 @@ from .index.engine import (DocumentMissingException, EngineResult,
 from .index.index_service import IndexService
 from .search import controller
 from .search.aggs import parse_aggs, merge_shard_partials, render as render_aggs
+from .search.query_dsl import QueryParsingException
 from .search.shard_searcher import ShardSearcher
 
 
@@ -56,6 +57,13 @@ class NodeService:
         self.cluster_name = cluster_name
         self.indices: dict[str, IndexService] = {}
         self.templates: dict[str, dict] = {}
+        # scroll contexts: id -> (index expr, body, cursor, expiry)
+        # (ref SearchService keep-alive reaper, SearchService.java:132,166);
+        # locked: the REST server is threaded
+        import threading
+        self._scrolls: dict[str, dict] = {}
+        self._scroll_seq = 0
+        self._scroll_lock = threading.Lock()
         os.makedirs(data_path, exist_ok=True)
         self._recover_indices()
 
@@ -242,11 +250,14 @@ class NodeService:
     # -- search (the QUERY_THEN_FETCH driver, SURVEY §3.2) -----------------
 
     def search(self, index: str, body: dict | None = None,
-               size: int | None = None, from_: int | None = None) -> dict:
+               size: int | None = None, from_: int | None = None,
+               scroll: str | None = None) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         size = int(body.get("size", 10) if size is None else size)
         from_ = int(body.get("from", 0) if from_ is None else from_)
+        if scroll is not None:
+            return self._scroll_start(index, body, size, scroll)
         sort = _parse_sort(body.get("sort"))
         names = self._resolve(index)
         if not names:
@@ -261,14 +272,59 @@ class NodeService:
 
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         query = body.get("query", {"match_all": {}})
+        knn = body.get("knn")
+        rescore_spec = body.get("rescore")
+        if isinstance(rescore_spec, list):
+            rescore_spec = rescore_spec[0] if rescore_spec else None
+        # rescore window must be collected in the query phase
+        window = int(rescore_spec.get("window_size", size)) \
+            if rescore_spec else 0
+
+        search_after = body.get("search_after")
+        if isinstance(search_after, list):
+            search_after = search_after[0] if search_after else None
+        if search_after is not None and sort is None:
+            raise QueryParsingException("search_after requires a sort")
+        if rescore_spec is not None and sort is not None:
+            # the reference's RescorePhase rejects rescore+sort outright
+            raise QueryParsingException("rescore cannot be used with a sort")
+        if knn is not None:
+            qv_single = knn.get("query_vector")
+            if qv_single is None:
+                qvs = knn.get("query_vectors")
+                if not qvs:
+                    raise QueryParsingException(
+                        "knn requires query_vector (or query_vectors with "
+                        "exactly one entry)")
+                if len(qvs) != 1:
+                    raise QueryParsingException(
+                        "knn search takes one query_vector per request; use "
+                        "ShardSearcher.execute_knn for batched vectors")
+                qv_single = qvs[0]
+            if "field" not in knn:
+                raise QueryParsingException("knn requires a field")
+            # k must cover pagination: the reduce skips `from_` docs
+            knn_k = int(knn.get("k", size + from_))
+            if knn_k < size + from_:
+                knn_k = size + from_
 
         results = []
         shard_failures = 0
         for s in searchers:
-            node = s.parse([query])
-            results.append(s.execute_query_phase(
-                node, size=size, from_=from_, sort=sort,
-                aggs=agg_specs if agg_specs else None))
+            if knn is not None:
+                fnode = s.parse([knn["filter"]]) if knn.get("filter") else None
+                r = s.execute_knn(knn["field"], [qv_single], k=knn_k,
+                                  metric=knn.get("metric", "cosine"),
+                                  filter_node=fnode)
+            else:
+                node = s.parse([query])
+                r = s.execute_query_phase(
+                    node, size=max(size, window), from_=from_, sort=sort,
+                    aggs=agg_specs if agg_specs else None,
+                    search_after=search_after)
+            if rescore_spec is not None:
+                r = s.rescore(r, rescore_spec)
+            results.append(r)
 
         reduced = controller.sort_docs(results, from_=from_, size=size,
                                        sort=sort)
@@ -300,6 +356,58 @@ class NodeService:
     def count(self, index: str, body: dict | None = None) -> dict:
         out = self.search(index, {**(body or {}), "size": 0})
         return {"count": out["hits"]["total"], "_shards": out["_shards"]}
+
+    # -- scroll (cursored reads, ref §3.5 scroll/scan call stack) ----------
+
+    def _scroll_start(self, index: str, body: dict, size: int,
+                      keep_alive: str) -> dict:
+        with self._scroll_lock:
+            self._reap_scrolls()
+            self._scroll_seq += 1
+            sid = f"scroll-{self._scroll_seq}"
+            # scroll iterates in sorted (or score) order with a moving cursor;
+            # the context server-side holds only (request, position) — segment
+            # immutability makes replaying with a deeper window exact
+            ctx = {"index": index, "body": dict(body), "cursor": 0,
+                   "expiry": time.monotonic() + _duration_secs(keep_alive),
+                   "keep_alive": keep_alive}
+            self._scrolls[sid] = ctx
+        out = self._scroll_batch(ctx, size)
+        out["_scroll_id"] = sid
+        return out
+
+    def scroll(self, scroll_id: str, keep_alive: str | None = None) -> dict:
+        with self._scroll_lock:
+            self._reap_scrolls()
+            ctx = self._scrolls.get(scroll_id)
+            if ctx is None:
+                raise IndexMissingException(
+                    f"scroll [{scroll_id}] expired or unknown")
+            if keep_alive:
+                ctx["keep_alive"] = keep_alive
+            ctx["expiry"] = time.monotonic() \
+                + _duration_secs(ctx["keep_alive"])
+        out = self._scroll_batch(ctx, int(ctx["body"].get("size", 10)))
+        out["_scroll_id"] = scroll_id
+        return out
+
+    def _scroll_batch(self, ctx: dict, size: int) -> dict:
+        body = dict(ctx["body"])
+        body.pop("from", None)
+        out = self.search(ctx["index"], body, size=size, from_=ctx["cursor"])
+        ctx["cursor"] += len(out["hits"]["hits"])
+        return out
+
+    def clear_scroll(self, scroll_ids: list[str]) -> int:
+        with self._scroll_lock:
+            return sum(1 for sid in scroll_ids
+                       if self._scrolls.pop(sid, None) is not None)
+
+    def _reap_scrolls(self) -> None:
+        # caller holds _scroll_lock
+        now = time.monotonic()
+        for sid in [s for s, c in self._scrolls.items() if c["expiry"] < now]:
+            del self._scrolls[sid]
 
     # -- admin -------------------------------------------------------------
 
@@ -345,6 +453,15 @@ class NodeService:
 
 
 # ---------------------------------------------------------------------------
+
+def _duration_secs(s: str) -> float:
+    m = re.match(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)?$", str(s).strip())
+    if not m:
+        return 60.0
+    n = float(m.group(1))
+    return n * {"ms": 0.001, "s": 1, "m": 60, "h": 3600,
+                "d": 86400, None: 1}[m.group(2)]
+
 
 def _deep_merge(base: dict, patch: dict) -> dict:
     out = dict(base)

@@ -113,6 +113,8 @@ class DistributedSearcher:
             f.tf = jax.device_put(f.tf, sh)
             f.dl = jax.device_put(f.dl, sh)
             f.sum_dl = jax.device_put(f.sum_dl, sh)
+        for v in (self.index.vectors or {}).values():
+            v.vecs = jax.device_put(v.vecs, sh)
         return self
 
     def build_step(self, *, Wt: int, k: int,
@@ -136,6 +138,56 @@ class DistributedSearcher:
         step = jax.jit(mapped)
         self._steps[key] = step
         return step
+
+    def build_knn_step(self, *, k: int, metric: str = "cosine"):
+        """Distributed exact kNN: per-shard MXU matmul top-k + the same
+        all_gather cross-shard reduce as text search. One compiled program
+        for the whole mesh."""
+        key = ("knn", k, metric)
+        cached = self._steps.get(key)
+        if cached is not None:
+            return cached
+
+        def knn_step(vecs, live, qv):
+            from ..ops import knn as knn_ops
+            vecs = vecs[0]            # [N, D]
+            live_b = live[0]          # [N]
+            sims = knn_ops._sim(qv, vecs, metric)
+            sims = jnp.where(live_b[None, :], sims, -jnp.inf)
+            top, idx = lax.top_k(sims, k)
+            my_shard = lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+            keys = jnp.where(top > -jnp.inf,
+                             (my_shard << 32) | idx.astype(jnp.int64),
+                             jnp.int64(-1))
+            g_s = lax.all_gather(top, SHARD_AXIS)
+            g_k = lax.all_gather(keys, SHARD_AXIS)
+            S, Qb, kk = g_s.shape
+            g_s = jnp.transpose(g_s, (1, 0, 2)).reshape(Qb, S * kk)
+            g_k = jnp.transpose(g_k, (1, 0, 2)).reshape(Qb, S * kk)
+            out_s, pos = lax.top_k(g_s, min(k, S * kk))
+            return out_s, jnp.take_along_axis(g_k, pos, axis=-1)
+
+        step = jax.jit(jax.shard_map(
+            knn_step, mesh=self.mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS)),
+            out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)), check_vma=False))
+        self._steps[key] = step
+        return step
+
+    def search_knn(self, field: str, query_vectors, *, k: int = 10,
+                   metric: str = "cosine"):
+        """-> (scores f32[Q,k], keys i64[Q,k])."""
+        vf = self.index.vectors[field]
+        n_rep = self.mesh.shape[REPLICA_AXIS]
+        qv = np.asarray(query_vectors, np.float32)
+        Q = qv.shape[0]
+        q_pad = -(-Q // n_rep) * n_rep
+        if q_pad != Q:
+            qv = np.concatenate([qv, np.zeros((q_pad - Q, qv.shape[1]),
+                                              np.float32)])
+        step = self.build_knn_step(k=k, metric=metric)
+        scores, keys = step(vf.vecs, self.index.live, jnp.asarray(qv))
+        return np.asarray(scores)[:Q], np.asarray(keys)[:Q]
 
     def search_terms(self, field: str, queries: list[list[str]], *,
                      k: int = 10, boosts: np.ndarray | None = None,

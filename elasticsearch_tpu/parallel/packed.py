@@ -43,6 +43,14 @@ class PackedTextField:
 
 
 @dataclass
+class PackedVectorField:
+    """Dense vectors across S shards: [S, N_pad, D] for mesh kNN."""
+    field: str
+    vecs: jax.Array
+    dims: int
+
+
+@dataclass
 class PackedIndex:
     """S shards of one index, packed for SPMD execution."""
     n_shards: int
@@ -53,6 +61,7 @@ class PackedIndex:
     # fetch-phase host state: per-shard stored sources + ids
     ids: list[list[str]]
     stored: list[list[dict]]
+    vectors: dict[str, "PackedVectorField"] = None  # set in from_segments
 
     @staticmethod
     def from_segments(shard_segments: list[Segment]) -> "PackedIndex":
@@ -108,11 +117,27 @@ class PackedIndex:
                 dl=jnp.asarray(dl), sum_dl=jnp.asarray(sum_dl), max_df=max_df,
                 terms=terms, term_starts=t_starts, term_lens=t_lens)
 
+        vec_fields: set[str] = set()
+        for seg in shard_segments:
+            vec_fields.update(seg.vectors.keys())
+        vectors: dict[str, PackedVectorField] = {}
+        for f in sorted(vec_fields):
+            dims = next(seg.vectors[f].dims for seg in shard_segments
+                        if f in seg.vectors)
+            mat = np.zeros((S, n_pad, dims), np.float32)
+            for si, seg in enumerate(shard_segments):
+                vc = seg.vectors.get(f)
+                if vc is not None:
+                    v = np.asarray(vc.vecs)
+                    mat[si, :v.shape[0]] = v
+            vectors[f] = PackedVectorField(field=f, vecs=jnp.asarray(mat),
+                                           dims=dims)
+
         ids = [list(seg.ids) for seg in shard_segments]
         stored = [list(seg.stored) for seg in shard_segments]
         return PackedIndex(n_shards=S, n_pad=n_pad, live=jnp.asarray(live),
                            doc_counts=jnp.asarray(counts), text=text,
-                           ids=ids, stored=stored)
+                           ids=ids, stored=stored, vectors=vectors)
 
     def prepare_term_queries(self, field: str, queries: list[list[str]],
                              t_pad: int | None = None):

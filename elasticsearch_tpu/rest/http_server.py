@@ -123,7 +123,27 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             body["size"] = int(p["size"][0])
         if "from" in p:
             body["from"] = int(p["from"][0])
-        return 200, node.search(g.get("index", "_all"), body)
+        scroll = p.get("scroll", [None])[0]
+        return 200, node.search(g.get("index", "_all"), body, scroll=scroll)
+
+    def scroll_next(g, p, b):
+        body = _json_body(b)
+        sid = body.get("scroll_id") or p.get("scroll_id", [None])[0]
+        if not sid:
+            raise RestError(400, "scroll_id is required")
+        keep = body.get("scroll") or p.get("scroll", [None])[0]
+        return 200, node.scroll(sid, keep)
+    c.register("GET", "/_search/scroll", scroll_next)
+    c.register("POST", "/_search/scroll", scroll_next)
+
+    def clear_scroll(g, p, b):
+        body = _json_body(b)
+        sids = body.get("scroll_id", [])
+        if isinstance(sids, str):
+            sids = [sids]
+        n = node.clear_scroll(sids)
+        return 200, {"succeeded": True, "num_freed": n}
+    c.register("DELETE", "/_search/scroll", clear_scroll)
     c.register("GET", "/{index}/_search", search)
     c.register("POST", "/{index}/_search", search)
     c.register("GET", "/_search", search)

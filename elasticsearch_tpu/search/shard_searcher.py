@@ -20,6 +20,7 @@ import dataclasses
 from typing import Any, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..index.segment import Segment
@@ -93,7 +94,8 @@ class ShardSearcher:
                             sort: dict | None = None,
                             global_stats: CollectionStats | None = None,
                             track_scores: bool = True,
-                            aggs: list | None = None) -> QuerySearchResult:
+                            aggs: list | None = None,
+                            search_after: float | None = None) -> QuerySearchResult:
         """Run the batched query tree over all segments of this shard.
 
         aggs: parsed AggSpec list (search/aggs) — collected in the same pass
@@ -145,6 +147,14 @@ class ShardSearcher:
                     max_score = np.maximum(max_score, masked_sc.max(axis=1))
             else:
                 key_arr = self._sort_keys(seg, sort, Q)     # f64 [Q, N], asc-ready
+                if search_after is not None:
+                    # cursor semantics (ref query/QueryPhase.java:117-131
+                    # searchAfter): only keys strictly after the cursor;
+                    # negate for desc to match _sort_keys' encoding
+                    sa = float(search_after)
+                    if sort.get("order", "asc") == "desc":
+                        sa = -sa
+                    match = match & (key_arr > sa)
                 masked = jnp.where(match, key_arr, jnp.inf)
                 # top_k of -key selects the smallest (ascending) sort keys
                 neg, idx = topk_ops.topk_scores(-masked, match, k=kk)
@@ -176,6 +186,116 @@ class ShardSearcher:
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=best_sort, total_hits=total, max_score=max_score,
             aggs=agg_partials)
+
+    # -- kNN (exact, MXU matmul — ops/knn.py) ------------------------------
+
+    def execute_knn(self, field: str, query_vectors, *, k: int = 10,
+                    metric: str = "cosine",
+                    filter_node: Node | None = None) -> QuerySearchResult:
+        """Exact kNN query phase over this shard's segments. Behaves like a
+        query phase whose scores are vector similarities, so the controller
+        reduce and fetch phase apply unchanged."""
+        from ..ops import knn as knn_ops
+
+        qv = jnp.asarray(np.asarray(query_vectors, np.float32))
+        Q = qv.shape[0]
+        best_scores = np.full((Q, k), -np.inf, np.float32)
+        best_keys = np.full((Q, k), -1, np.int64)
+        total = np.zeros((Q,), np.int64)
+
+        for seg_idx, seg in enumerate(self.segments):
+            vc = seg.vectors.get(field)
+            if vc is None or seg.n_docs == 0:
+                continue
+            live = seg.live
+            if filter_node is not None:
+                stats = self.build_stats(filter_node, None)
+                _, match = filter_node.execute(SegmentContext(seg, Q, stats))
+                live = live[None, :] & match
+            else:
+                live = jnp.broadcast_to(live[None, :], (Q, seg.n_pad))
+            sims = knn_ops._sim(qv, vc.vecs, metric)
+            sims = jnp.where(live, sims, -jnp.inf)
+            kk = min(k, seg.n_pad)
+            top, idx = jax.lax.top_k(sims, kk)
+            top = np.asarray(top)
+            idx = np.asarray(idx)
+            total += np.asarray((np.asarray(live).sum(axis=1)
+                                 if live.ndim == 2 else live.sum()))
+            seg_keys = np.where(np.isfinite(top),
+                                (np.int64(seg_idx) << SEG_SHIFT)
+                                | idx.astype(np.int64), np.int64(-1))
+            merged = np.concatenate([best_scores, top], axis=1)
+            merged_keys = np.concatenate([best_keys, seg_keys], axis=1)
+            order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
+            best_scores = np.take_along_axis(merged, order, axis=1)
+            best_keys = np.take_along_axis(merged_keys, order, axis=1)
+
+        mx = np.where(np.isfinite(best_scores[:, 0]), best_scores[:, 0], np.nan)
+        best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+        return QuerySearchResult(
+            shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
+            sort_values=None, total_hits=total, max_score=mx)
+
+    # -- rescore (ref search/rescore/RescorePhase.java) --------------------
+
+    def rescore(self, result: QuerySearchResult, rescore_spec: dict,
+                n_queries: int = 1) -> QuerySearchResult:
+        """Re-score the top window with a secondary query, per shard —
+        exactly the reference's QueryRescorer: secondary scores combined
+        with primaries under score_mode, only within window_size."""
+        spec = rescore_spec.get("query", rescore_spec)
+        window = int(rescore_spec.get("window_size",
+                                      result.doc_keys.shape[1]))
+        rq = spec.get("rescore_query")
+        if rq is None:
+            return result
+        q_weight = float(spec.get("query_weight", 1.0))
+        r_weight = float(spec.get("rescore_query_weight", 1.0))
+        mode = spec.get("score_mode", "total")
+        node = self.parser.parse(rq)
+        stats = self.build_stats(node, None)
+        Q, K = result.doc_keys.shape
+
+        # secondary dense scores per segment, gathered at candidate slots
+        sec = np.zeros((Q, K), np.float32)
+        seg_scores: dict[int, np.ndarray] = {}
+        for qi in range(Q):
+            for pos in range(min(window, K)):
+                key = int(result.doc_keys[qi, pos])
+                if key < 0:
+                    continue
+                seg_idx = key >> SEG_SHIFT
+                local = key & LOCAL_MASK
+                if seg_idx not in seg_scores:
+                    ctx = SegmentContext(self.segments[seg_idx], Q, stats)
+                    s, m = node.execute(ctx)
+                    seg_scores[seg_idx] = np.asarray(
+                        jnp.where(m, s, 0.0))
+                sec[qi, pos] = seg_scores[seg_idx][qi, local]
+
+        from ..ops.knn import combine_scores
+        prim = np.nan_to_num(result.scores, nan=0.0)
+        combined = np.asarray(combine_scores(
+            jnp.asarray(prim), jnp.asarray(sec), mode, q_weight, r_weight))
+        in_window = np.arange(K)[None, :] < window
+        new_scores = np.where(in_window & (result.doc_keys >= 0),
+                              combined, prim)
+        # re-sort only the window (docs below the window keep their order)
+        order = np.argsort(-np.where(in_window, new_scores, -np.inf),
+                           axis=1, kind="stable")
+        full_order = np.concatenate(
+            [order[:, :window], np.broadcast_to(np.arange(window, K), (Q, K - window))],
+            axis=1) if K > window else order
+        masked = np.where(result.doc_keys >= 0, new_scores, -np.inf)
+        mx = masked.max(axis=1)
+        return QuerySearchResult(
+            shard_id=result.shard_id,
+            doc_keys=np.take_along_axis(result.doc_keys, full_order, axis=1),
+            scores=np.take_along_axis(new_scores, full_order, axis=1),
+            sort_values=None, total_hits=result.total_hits,
+            max_score=np.where(np.isfinite(mx), mx, np.nan),
+            aggs=result.aggs)
 
     def _sort_keys(self, seg: Segment, sort: dict, Q: int):
         """Build an ascending-comparable f64 key per doc for field sort

@@ -174,6 +174,44 @@ class TestBulkAndSearch:
         scores = [h["_score"] for h in out["hits"]["hits"]]
         assert scores == sorted(scores, reverse=True)
 
+    def test_scroll(self, server):
+        status, out = req(server, "POST", "/sharded/_search?scroll=1m",
+                          {"query": {"match_all": {}}, "size": 12})
+        sid = out["_scroll_id"]
+        seen = {h["_id"] for h in out["hits"]["hits"]}
+        assert len(seen) == 12
+        total = out["hits"]["total"]
+        while True:
+            status, out = req(server, "POST", "/_search/scroll",
+                              {"scroll_id": sid, "scroll": "1m"})
+            batch = {h["_id"] for h in out["hits"]["hits"]}
+            if not batch:
+                break
+            assert not (batch & seen), "scroll returned duplicate docs"
+            seen |= batch
+        assert len(seen) == total == 30
+        status, out = req(server, "DELETE", "/_search/scroll",
+                          {"scroll_id": sid})
+        assert out["num_freed"] == 1
+        status, out = req(server, "POST", "/_search/scroll",
+                          {"scroll_id": sid}, expect_error=True)
+        assert status == 404
+
+    def test_search_after(self, server):
+        status, first = req(server, "POST", "/lib/_search", {
+            "query": {"match_all": {}},
+            "sort": [{"price": {"order": "asc"}}], "size": 2})
+        last_sort = first["hits"]["hits"][-1]["sort"][0]
+        status, nxt = req(server, "POST", "/lib/_search", {
+            "query": {"match_all": {}},
+            "sort": [{"price": {"order": "asc"}}], "size": 2,
+            "search_after": [last_sort]})
+        prices1 = [h["_source"]["price"] for h in first["hits"]["hits"]]
+        prices2 = [h["_source"]["price"] for h in nxt["hits"]["hits"]]
+        assert prices1 == [12.5, 18.0] and prices2 == [25.0, 30.0]
+        # total is unaffected by the cursor
+        assert nxt["hits"]["total"] == first["hits"]["total"]
+
     def test_mget(self, server):
         status, out = req(server, "POST", "/_mget", {
             "docs": [{"_index": "lib", "_id": "1"},

@@ -191,11 +191,20 @@ class NodeService:
         src = dict(cur.source)
         if "script" in body:
             from .script.engine import run_update_script
-            src = run_update_script(body["script"], src,
-                                    params=body.get("params")
-                                    or (body["script"].get("params")
-                                        if isinstance(body["script"], dict)
-                                        else None))
+            src, op = run_update_script(body["script"], src,
+                                        params=body.get("params")
+                                        or (body["script"].get("params")
+                                            if isinstance(body["script"], dict)
+                                            else None))
+            # honor ctx.op like the reference's UpdateHelper: delete deletes,
+            # anything other than index (none/create) is a noop
+            # (ref UpdateHelper.java:246-249 else-branch -> Operation.NONE)
+            if op == "delete":
+                res = svc.delete_doc(doc_id)
+                return res, False
+            if op != "index":
+                return EngineResult(doc_id=doc_id, version=cur.version,
+                                    created=False), True
         elif "doc" in body:
             merged = _deep_merge(src, body["doc"])
             if body.get("detect_noop", True) and merged == src:
@@ -289,6 +298,11 @@ class NodeService:
             # the reference's RescorePhase rejects rescore+sort outright
             raise QueryParsingException("rescore cannot be used with a sort")
         if knn is not None:
+            if agg_specs:
+                # the knn phase computes no agg partials; silently returning
+                # empty aggregations would be a lie (advisor r1 finding)
+                raise QueryParsingException(
+                    "aggregations are not supported with knn search")
             qv_single = knn.get("query_vector")
             if qv_single is None:
                 qvs = knn.get("query_vectors")
@@ -303,10 +317,11 @@ class NodeService:
                 qv_single = qvs[0]
             if "field" not in knn:
                 raise QueryParsingException("knn requires a field")
-            # k must cover pagination: the reduce skips `from_` docs
+            # k is the user's neighbor count contract: the response carries
+            # at most min(k, size) hits (never silently raised — the reduce
+            # below shrinks size instead; k defaults to covering pagination)
             knn_k = int(knn.get("k", size + from_))
-            if knn_k < size + from_:
-                knn_k = size + from_
+            size = min(size, max(knn_k - from_, 0))
 
         results = []
         shard_failures = 0
@@ -368,9 +383,10 @@ class NodeService:
             # scroll iterates in sorted (or score) order with a moving cursor;
             # the context server-side holds only (request, position) — segment
             # immutability makes replaying with a deeper window exact
+            import threading
             ctx = {"index": index, "body": dict(body), "cursor": 0,
                    "expiry": time.monotonic() + _duration_secs(keep_alive),
-                   "keep_alive": keep_alive}
+                   "keep_alive": keep_alive, "lock": threading.Lock()}
             self._scrolls[sid] = ctx
         out = self._scroll_batch(ctx, size)
         out["_scroll_id"] = sid
@@ -394,8 +410,12 @@ class NodeService:
     def _scroll_batch(self, ctx: dict, size: int) -> dict:
         body = dict(ctx["body"])
         body.pop("from", None)
-        out = self.search(ctx["index"], body, size=size, from_=ctx["cursor"])
-        ctx["cursor"] += len(out["hits"]["hits"])
+        # per-context lock: two concurrent scrolls on the same id must not
+        # read the same cursor and return duplicate batches
+        with ctx["lock"]:
+            out = self.search(ctx["index"], body, size=size,
+                              from_=ctx["cursor"])
+            ctx["cursor"] += len(out["hits"]["hits"])
         return out
 
     def clear_scroll(self, scroll_ids: list[str]) -> int:

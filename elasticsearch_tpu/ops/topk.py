@@ -4,7 +4,8 @@ and the coordinator's TopDocs.merge.
 ref: /root/reference/src/main/java/org/elasticsearch/search/controller/SearchPhaseController.java:147,233
 (coordinator-side merge of per-shard top-k) — here both the per-segment top-k
 and the cross-segment/cross-shard merge are `lax.top_k` programs so they can
-run on device and, across chips, over ICI collectives (see parallel/reduce.py).
+run on device and, across chips, over ICI collectives
+(see parallel/distributed_search.py).
 """
 
 from __future__ import annotations

@@ -39,8 +39,12 @@ class _Env:
                       "false": False, "null": None}
 
 
-def run_update_script(script, source: dict, params: dict | None = None) -> dict:
-    """Execute an update script against a doc source; returns the new source.
+def run_update_script(script, source: dict,
+                      params: dict | None = None) -> tuple[dict, str]:
+    """Execute an update script against a doc source; returns
+    (new_source, op) where op is "index" (default), "delete" or "none" —
+    the ctx.op contract the reference's UpdateHelper honors
+    (ref action/update/UpdateHelper.java:61).
     Accepts the ES shapes: "inline string", {"inline": "..."} or
     {"source"/"script": "..."} with optional {"params": {...}}."""
     if isinstance(script, dict):
@@ -58,7 +62,10 @@ def run_update_script(script, source: dict, params: dict | None = None) -> dict:
     env = _Env(ctx, params)
     for stmt in tree.body:
         _exec_stmt(stmt, env)
-    return ctx["_source"]
+    op = ctx.get("op", "index")
+    if op not in ("index", "create", "delete", "none", "noop"):
+        raise ScriptException(f"illegal ctx.op [{op}]")
+    return ctx["_source"], "none" if op == "noop" else op
 
 
 def _exec_stmt(node: ast.stmt, env: _Env) -> None:

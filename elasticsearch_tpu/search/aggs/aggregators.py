@@ -593,7 +593,13 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
         if okey == "_term":
             items.sort(key=lambda kv: str(kv[0]), reverse=reverse)
         else:
-            items.sort(key=lambda kv: (kv[1]["doc_count"], ), reverse=reverse)
+            # _count ties break by term ascending, like the reference's
+            # InternalTerms comparator — otherwise equal-count buckets come
+            # out in shard-merge order, nondeterministic across layouts
+            # tie-break is term ASCENDING in both directions (ref
+            # InternalOrder CompoundOrder always appends term(true))
+            items.sort(key=lambda kv: str(kv[0]))
+            items.sort(key=lambda kv: kv[1]["doc_count"], reverse=reverse)
         top = items[:size]
         other = sum(e["doc_count"] for _, e in items[size:]) \
             + p.get("other_doc_count", 0)

@@ -281,18 +281,23 @@ class ShardSearcher:
         in_window = np.arange(K)[None, :] < window
         new_scores = np.where(in_window & (result.doc_keys >= 0),
                               combined, prim)
-        # re-sort only the window (docs below the window keep their order)
-        order = np.argsort(-np.where(in_window, new_scores, -np.inf),
+        # re-sort only the window (docs below the window keep their order);
+        # empty slots (doc_keys < 0) sort at -inf so they can never outrank a
+        # real hit with a negative combined score
+        sort_key = np.where(result.doc_keys >= 0, new_scores, -np.inf)
+        order = np.argsort(-np.where(in_window, sort_key, -np.inf),
                            axis=1, kind="stable")
         full_order = np.concatenate(
             [order[:, :window], np.broadcast_to(np.arange(window, K), (Q, K - window))],
             axis=1) if K > window else order
-        masked = np.where(result.doc_keys >= 0, new_scores, -np.inf)
-        mx = masked.max(axis=1)
+        mx = sort_key.max(axis=1)
+        out_keys = np.take_along_axis(result.doc_keys, full_order, axis=1)
+        out_scores = np.take_along_axis(new_scores, full_order, axis=1)
+        out_scores = np.where(out_keys >= 0, out_scores, np.nan)
         return QuerySearchResult(
             shard_id=result.shard_id,
-            doc_keys=np.take_along_axis(result.doc_keys, full_order, axis=1),
-            scores=np.take_along_axis(new_scores, full_order, axis=1),
+            doc_keys=out_keys,
+            scores=out_scores,
             sort_values=None, total_hits=result.total_hits,
             max_score=np.where(np.isfinite(mx), mx, np.nan),
             aggs=result.aggs)

@@ -1,0 +1,85 @@
+"""Update-script ctx.op contract + terms-agg tie-break determinism.
+
+ref: /root/reference/src/main/java/org/elasticsearch/action/update/
+UpdateHelper.java:61 — scripts may set ctx.op to "delete"/"none" and the
+update action must honor it rather than reindexing the doc.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.script.engine import run_update_script, ScriptException
+
+
+class TestScriptOp:
+    def test_default_op_is_index(self):
+        src, op = run_update_script("ctx._source.n = 1", {})
+        assert op == "index" and src == {"n": 1}
+
+    def test_op_delete(self):
+        src, op = run_update_script('ctx.op = "delete"', {"a": 1})
+        assert op == "delete"
+
+    def test_op_none(self):
+        _, op = run_update_script('ctx.op = "none"', {"a": 1})
+        assert op == "none"
+
+    def test_op_noop_alias(self):
+        _, op = run_update_script('ctx.op = "noop"', {"a": 1})
+        assert op == "none"
+
+    def test_illegal_op_rejected(self):
+        with pytest.raises(ScriptException):
+            run_update_script('ctx.op = "explode"', {})
+
+    def test_conditional_delete(self):
+        _, op = run_update_script(
+            'ctx.op = "delete" if ctx._source.count < 0 else "none"',
+            {"count": -5})
+        assert op == "delete"
+
+
+class TestNodeUpdateOp:
+    def test_script_delete_removes_doc(self, tmp_path):
+        node = NodeService(str(tmp_path / "n"))
+        node.index_doc("idx", "1", {"tag": "old", "n": 1})
+        node.update_doc("idx", "1", {"script": 'ctx.op = "delete"'})
+        assert not node.get_doc("idx", "1").found
+        node.close()
+
+    def test_script_none_is_noop(self, tmp_path):
+        node = NodeService(str(tmp_path / "n"))
+        node.index_doc("idx", "1", {"n": 1})
+        v_before = node.get_doc("idx", "1").version
+        res, noop = node.update_doc(
+            "idx", "1", {"script": 'ctx._source.n = 99\nctx.op = "none"'})
+        assert noop and res.version == v_before
+        # the mutation was discarded: doc unchanged
+        assert node.get_doc("idx", "1").source["n"] == 1
+        node.close()
+
+    def test_knn_with_aggs_rejected(self, tmp_path):
+        from elasticsearch_tpu.search.query_dsl import QueryParsingException
+        node = NodeService(str(tmp_path / "n"))
+        node.index_doc("idx", "1", {"v": [1.0, 0.0]},
+                       auto_create=True)
+        node.refresh("idx")
+        with pytest.raises(QueryParsingException):
+            node.search("idx", {
+                "knn": {"field": "v", "query_vector": [1.0, 0.0], "k": 1},
+                "aggs": {"a": {"terms": {"field": "tag"}}}})
+        node.close()
+
+
+class TestTermsTieBreak:
+    def test_equal_counts_order_by_term(self, tmp_path):
+        node = NodeService(str(tmp_path / "n"))
+        # insert in an order that would leave dict-insertion order wrong
+        for i, tag in enumerate(["zebra", "apple", "mango", "kiwi"]):
+            node.index_doc("idx", str(i), {"tag": tag})
+        node.refresh("idx")
+        out = node.search("idx", {
+            "size": 0, "aggs": {"t": {"terms": {"field": "tag"}}}})
+        keys = [b["key"] for b in out["aggregations"]["t"]["buckets"]]
+        assert keys == sorted(keys)
+        node.close()

@@ -1,18 +1,22 @@
-"""Headline benchmark: batched BM25 top-1000 QPS (BASELINE.json config #1/#5
-workload shape: match-query scoring over a ~1M-doc corpus, k=1000) using the
-sort-reduce sparse kernel (ops/bm25_sparse.py).
+"""Headline benchmark: BM25 top-1000 QPS measured THROUGH THE PRODUCT —
+documents indexed via HTTP `_bulk` (full analysis + engine + segments),
+queries served via HTTP `_msearch` batches hitting the sort-reduce sparse
+kernel (the same scoring path every `_search` request takes).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Timing method: NB query batches are chained inside ONE jitted lax.scan and
-synchronized by fetching the result to host — device-queue semantics under
-the hosted TPU tunnel make per-step block_until_ready unreliable, and the
-host fetch also amortizes the ~100ms tunnel round-trip across all NB steps.
+vs_baseline: the identical engine+HTTP pipeline run in a subprocess pinned to
+the XLA-CPU backend — the documented proxy rung of the baseline ladder
+(BASELINE.md: XLA-CPU proxy -> stock ES same corpus -> 10M-doc Wiki).
+>1.0 = faster than CPU. Set BENCH_CPU=0 to skip the CPU leg.
 
-vs_baseline is measured in-process: the identical XLA program on the host CPU
-backend (the stand-in for the reference's CPU scoring path until a stock-ES
-side-by-side exists; BASELINE.md documents the ladder). >1.0 = faster than
-CPU.
+Workload shape: BASELINE.json config #1/#2 (match-query BM25 over an
+analyzed English-like corpus; default 100k docs, override with BENCH_DOCS),
+k=1000 like the north-star metric; solo `_search` p50/p99 (size=10) is
+reported alongside.
+
+Secondary leg: `python bench.py --kernel` runs the round-1 pure-kernel
+synthetic harness (1M docs, no engine) for kernel-regression tracking.
 """
 
 from __future__ import annotations
@@ -23,32 +27,183 @@ import sys
 import time
 from functools import partial
 
-# make the CPU backend available alongside the accelerator for the baseline leg
+# make the CPU backend available alongside the accelerator for --kernel
 _plat = os.environ.get("JAX_PLATFORMS", "")
 if _plat and "cpu" not in _plat.split(","):
     os.environ["JAX_PLATFORMS"] = _plat + ",cpu"
 
 import numpy as np  # noqa: E402
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from __graft_entry__ import _synthetic_segment  # noqa: E402
-from elasticsearch_tpu.ops.bm25_sparse import bm25_topk_sparse  # noqa: E402
-
-N_DOCS = 1 << 20          # ~1M docs
-VOCAB = 1 << 17
-AVG_DL = 64
-Q = 64                    # query batch per step
+N_DOCS = int(os.environ.get("BENCH_DOCS", str(100_000)))
+VOCAB = 30_000
+AVG_DL = 20
+Q_BATCH = 256             # queries per _msearch request (device batch)
+N_BATCHES = 4             # distinct msearch payloads
+REPS = 3
 K = 1000                  # top-1000 (headline metric)
 T = 4                     # terms per query
-NB = 8                    # steps chained per timed call
-REPS = 3
+LATENCY_N = 50            # solo _search latency probes
+
+
+def make_corpus(n_docs: int, seed: int = 7):
+    """Zipf-distributed synthetic English-like corpus, built as strings so
+    every doc passes the real analysis chain."""
+    rng = np.random.default_rng(seed)
+    words = np.array([f"term{i:05d}" for i in range(VOCAB)])
+    lens = np.maximum(rng.poisson(AVG_DL, n_docs), 3)
+    ranks = np.minimum(rng.zipf(1.3, size=int(lens.sum())), VOCAB) - 1
+    docs = []
+    pos = 0
+    for L in lens:
+        docs.append(" ".join(words[ranks[pos:pos + L]]))
+        pos += L
+    return docs
+
+
+def make_queries(n: int, seed: int = 42) -> list[str]:
+    rng = np.random.default_rng(seed)
+    tids = rng.integers(64, 8192, size=(n, T))
+    return [" ".join(f"term{t:05d}" for t in row) for row in tids]
+
+
+def http(port: int, method: str, path: str, body: bytes | str = b"",
+         timeout: float = 600.0) -> dict:
+    import urllib.request
+    if isinstance(body, str):
+        body = body.encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=body or None, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_engine_leg(tag: str) -> dict:
+    """Full product pipeline: index via _bulk, serve via _msearch/_search."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+
+    workdir = tempfile.mkdtemp(prefix=f"bench-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"))
+    server = HttpServer(node, port=0).start()
+    port = server.port
+    try:
+        docs = make_corpus(N_DOCS)
+        t0 = time.perf_counter()          # after corpus gen: index cost only
+        http(port, "PUT", "/bench", json.dumps(
+            {"settings": {"number_of_shards": 1},
+             "mappings": {"_doc": {"properties": {
+                 "body": {"type": "string"}}}}}))
+        batch = 2000
+        for i in range(0, len(docs), batch):
+            lines = []
+            for j, d in enumerate(docs[i:i + batch]):
+                lines.append(json.dumps({"index": {"_id": str(i + j)}}))
+                lines.append(json.dumps({"body": d}))
+            http(port, "POST", "/bench/_bulk", "\n".join(lines) + "\n")
+        http(port, "POST", "/bench/_refresh")
+        http(port, "POST", "/bench/_optimize")
+        index_secs = time.perf_counter() - t0
+
+        queries = make_queries(Q_BATCH * N_BATCHES)
+        payloads = []
+        for bi in range(N_BATCHES):
+            lines = []
+            for q in queries[bi * Q_BATCH:(bi + 1) * Q_BATCH]:
+                lines.append(json.dumps({"index": "bench"}))
+                lines.append(json.dumps(
+                    {"query": {"match": {"body": q}}, "size": K,
+                     "_source": False}))
+            payloads.append("\n".join(lines) + "\n")
+
+        # warmup (compile)
+        http(port, "POST", "/_msearch", payloads[0])
+        t0 = time.perf_counter()
+        n_queries = 0
+        for _ in range(REPS):
+            for pl in payloads:
+                out = http(port, "POST", "/_msearch", pl)
+                n_queries += len(out["responses"])
+        dt = time.perf_counter() - t0
+        qps = n_queries / dt
+
+        # solo _search latency, size=10 (BASELINE config #1 shape)
+        lat = []
+        solo = json.dumps({"query": {"match": {"body": queries[0]}},
+                           "size": 10, "_source": False})
+        http(port, "POST", "/bench/_search", solo)
+        for q in queries[:LATENCY_N]:
+            body = json.dumps({"query": {"match": {"body": q}},
+                               "size": 10, "_source": False})
+            t1 = time.perf_counter()
+            http(port, "POST", "/bench/_search", body)
+            lat.append((time.perf_counter() - t1) * 1000)
+        lat.sort()
+        return {"qps": qps,
+                "p50_ms": lat[len(lat) // 2],
+                "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+                "index_secs": index_secs}
+    finally:
+        server.stop()
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main_engine():
+    import subprocess
+    res = run_engine_leg("main")
+    vs = None                  # null = baseline leg didn't run / failed
+    import jax
+    plat = jax.devices()[0].platform
+    if plat == "cpu":
+        vs = 1.0
+    elif os.environ.get("BENCH_CPU", "1") != "0":
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_LEG"] = "cpu"
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=3600)
+            for ln in out.stdout.splitlines():
+                if ln.startswith("{"):
+                    cpu = json.loads(ln)
+                    vs = res["qps"] / max(cpu["value"], 1e-9)
+                    break
+            if vs is None:
+                print(f"cpu leg produced no result (rc={out.returncode}): "
+                      f"{out.stderr[-500:]}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
+            print(f"cpu leg failed: {e}", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"http_msearch_bm25_top{K}_qps_{N_DOCS // 1000}k_docs",
+        "value": round(res["qps"], 2), "unit": "qps",
+        "vs_baseline": round(vs, 3) if vs is not None else None,
+        "p50_ms": round(res["p50_ms"], 2),
+        "p99_ms": round(res["p99_ms"], 2),
+        "index_secs": round(res["index_secs"], 1),
+        "platform": plat}))
+
+
+# ---------------------------------------------------------------------------
+# --kernel: round-1 synthetic kernel harness (kernel regression tracking)
+# ---------------------------------------------------------------------------
+
+KN_DOCS = 1 << 20
+KVOCAB = 1 << 17
+KAVG_DL = 64
+KQ = 64
+KNB = 8
 
 
 def build_chained(Wt: int):
-    kern = partial(bm25_topk_sparse, Wt=Wt, k=K, n_docs=N_DOCS)
+    import jax
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops.bm25_sparse import bm25_topk_sparse
+    kern = partial(bm25_topk_sparse, Wt=Wt, k=K, n_docs=KN_DOCS)
 
     @jax.jit
     def chained(doc_ids, tf, dl, qs, ql, w):
@@ -56,8 +211,7 @@ def build_chained(Wt: int):
             s, ln, ww = batch
             top, docs, hits = kern(doc_ids, tf, dl, s, ln, ww,
                                    jnp.float32(1.2), jnp.float32(0.75),
-                                   jnp.float32(AVG_DL))
-            # fold outputs into a tiny carry so nothing is dead-code-eliminated
+                                   jnp.float32(KAVG_DL))
             return carry + top[:, 0].sum() + docs[:, 0].sum() + hits.sum(), None
         acc, _ = jax.lax.scan(body, jnp.float32(0.0), (qs, ql, w))
         return acc
@@ -65,6 +219,7 @@ def build_chained(Wt: int):
 
 
 def run_on(device, postings, batches, Wt):
+    import jax
     args = [jax.device_put(a, device) for a in postings + batches]
     chained = build_chained(Wt)
     float(chained(*args))                      # compile + first exec
@@ -72,42 +227,48 @@ def run_on(device, postings, batches, Wt):
     for _ in range(REPS):
         float(chained(*args))                  # host fetch = true sync
     dt = (time.perf_counter() - t0) / REPS
-    return NB * Q / dt
+    return KNB * KQ / dt
 
 
-def main():
+def main_kernel():
+    import jax
+    from __graft_entry__ import _synthetic_segment
     doc_ids, tf, doc_len, term_starts, term_lens = _synthetic_segment(
-        N_DOCS, VOCAB, AVG_DL, seed=7)
-    dl = doc_len[doc_ids].astype(np.float32)   # per-posting doc length
+        KN_DOCS, KVOCAB, KAVG_DL, seed=7)
+    dl = doc_len[doc_ids].astype(np.float32)
 
     rng = np.random.default_rng(42)
-    tids = rng.integers(64, 8192, size=(NB, Q, T))
+    tids = rng.integers(64, 8192, size=(KNB, KQ, T))
     qs = term_starts[tids].astype(np.int32)
     ql = term_lens[tids].astype(np.int32)
-    w = np.abs(rng.normal(2.0, 0.5, (NB, Q, T))).astype(np.float32)
+    w = np.abs(rng.normal(2.0, 0.5, (KNB, KQ, T))).astype(np.float32)
     Wt = 1 << int(np.ceil(np.log2(max(8, ql.max()))))
 
     pad = lambda a, fill: np.concatenate(   # noqa: E731
         [a, np.full(Wt, fill, a.dtype)])
-    postings = [pad(doc_ids, N_DOCS), pad(tf, 0), pad(dl, 1)]
+    postings = [pad(doc_ids, KN_DOCS), pad(tf, 0), pad(dl, 1)]
     batches = [qs, ql, w]
 
     main_dev = jax.devices()[0]
     qps = run_on(main_dev, postings, batches, Wt)
-
     vs = 1.0
     if main_dev.platform != "cpu":
         try:
             cpu = jax.devices("cpu")[0]
-            cpu_qps = run_on(cpu, postings, batches, Wt)
-            vs = qps / cpu_qps
-        except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
+            vs = qps / run_on(cpu, postings, batches, Wt)
+        except Exception as e:  # noqa: BLE001
             print(f"cpu baseline unavailable: {e}", file=sys.stderr)
-
-    print(json.dumps({"metric": "bm25_top1000_qps_1M_docs",
+    print(json.dumps({"metric": "kernel_bm25_top1000_qps_1M_docs",
                       "value": round(qps, 2), "unit": "qps",
                       "vs_baseline": round(vs, 3)}))
 
 
 if __name__ == "__main__":
-    main()
+    if "--kernel" in sys.argv:
+        main_kernel()
+    elif os.environ.get("BENCH_LEG") == "cpu":
+        res = run_engine_leg("cpu")
+        print(json.dumps({"metric": "cpu_leg", "value": round(res["qps"], 2),
+                          "unit": "qps"}))
+    else:
+        main_engine()

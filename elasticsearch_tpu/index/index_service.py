@@ -35,6 +35,11 @@ class IndexService:
             Engine(os.path.join(path, str(s)), self.mappers)
             for s in range(self.n_shards)]
         self.creation_date = None
+        # searcher cache: rebuilt per shard only when its segment set changes
+        # (the NRT "acquire searcher" analog — ref SearcherManager); device
+        # query-path counters live here so they survive across requests
+        self._searcher_cache: dict[int, tuple[tuple, ShardSearcher]] = {}
+        self.search_stats = {"sparse": 0, "dense": 0}
 
     # -- routing -----------------------------------------------------------
 
@@ -80,8 +85,16 @@ class IndexService:
     # -- search ------------------------------------------------------------
 
     def searchers(self) -> list[ShardSearcher]:
-        return [ShardSearcher(si, e.segments, self.mappers)
-                for si, e in enumerate(self.shards)]
+        out = []
+        for si, e in enumerate(self.shards):
+            key = tuple(s.seg_id for s in e.segments)
+            cached = self._searcher_cache.get(si)
+            if cached is None or cached[0] != key:
+                cached = (key, ShardSearcher(si, e.segments, self.mappers,
+                                             stats=self.search_stats))
+                self._searcher_cache[si] = cached
+            out.append(cached[1])
+        return out
 
     # -- introspection -----------------------------------------------------
 

@@ -161,7 +161,19 @@ class Segment:
         self.live_host[local] = False
         self.live = jnp.asarray(self.live_host)
         self.live_count -= 1
+        self._live_padded = None
         return True
+
+    def live_padded(self):
+        """bool[1, n_pad+1] liveness with a False PAD-sentinel column —
+        the doc_mask shape ops/bm25_sparse.bm25_topk_sparse_masked gathers
+        at candidate slots. Cached; invalidated on delete."""
+        cached = getattr(self, "_live_padded", None)
+        if cached is None:
+            cached = jnp.concatenate(
+                [self.live, jnp.zeros((1,), bool)])[None, :]
+            self._live_padded = cached
+        return cached
 
     def doc_freq(self, field: str, term: str) -> int:
         fx = self.text.get(field)

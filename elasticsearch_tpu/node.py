@@ -372,6 +372,109 @@ class NodeService:
         out = self.search(index, {**(body or {}), "size": 0})
         return {"count": out["hits"]["total"], "_shards": out["_shards"]}
 
+    # -- msearch: batched multi-search (ref action/search/MultiSearchRequest;
+    # rest/action/search/RestMultiSearchAction). The TPU twist: requests
+    # whose query trees share a plan shape merge into ONE batched device
+    # program (merge_query_batch) — the batching that the ≥10x QPS target
+    # comes from (SURVEY.md §7: the unit of device work is a batch of
+    # queries, not one query at a time). ----------------------------------
+
+    _BATCHABLE_KEYS = {"query", "size", "from", "_source"}
+
+    def msearch(self, requests: list[tuple[dict, dict]]) -> dict:
+        responses: list = [None] * len(requests)
+        groups: dict[Any, list[int]] = {}
+        metas: list[tuple[str, dict]] = []
+        for i, (header, body) in enumerate(requests):
+            index = (header or {}).get("index") or "_all"
+            body = body or {}
+            metas.append((index, body))
+            key = self._msearch_batch_key(index, body)
+            groups.setdefault(key if key is not None else ("solo", i),
+                              []).append(i)
+        for key, idxs in groups.items():
+            if (isinstance(key, tuple) and key and key[0] == "solo") \
+                    or len(idxs) == 1:
+                for i in idxs:
+                    responses[i] = self._msearch_one(*metas[i])
+                continue
+            try:
+                outs = self._search_batched([metas[i] for i in idxs])
+            except Exception:  # noqa: BLE001 — batch miss, serve solo
+                outs = [self._msearch_one(*metas[i]) for i in idxs]
+            for i, out in zip(idxs, outs):
+                responses[i] = out
+        return {"responses": responses}
+
+    def _msearch_one(self, index: str, body: dict) -> dict:
+        try:
+            return self.search(index, body)
+        except Exception as e:  # noqa: BLE001 — per-item error contract
+            from .rest.http_server import _status_of
+            return {"error": f"{type(e).__name__}: {e}",
+                    "status": _status_of(e)}
+
+    def _msearch_batch_key(self, index: str, body: dict):
+        """Group key for device batching, or None if the request needs the
+        general path (aggs/sort/knn/... or an unparseable query)."""
+        if any(k not in self._BATCHABLE_KEYS for k in body):
+            return None
+        try:
+            names = self._resolve(index)
+            if not names:
+                return None
+            from .search.query_parser import QueryParser
+            node = QueryParser(self.indices[names[0]].mappers).parse(
+                body.get("query") or {"match_all": {}})
+            return (index, int(body.get("size", 10)),
+                    int(body.get("from", 0)), node.plan_key())
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _search_batched(self, metas: list[tuple[str, dict]]) -> list[dict]:
+        """Execute same-shaped requests as one batched query phase per shard;
+        per-row reduce + fetch mirrors the single-search flow."""
+        t0 = time.perf_counter()
+        index, first_body = metas[0]
+        size = int(first_body.get("size", 10))
+        from_ = int(first_body.get("from", 0))
+        names = self._resolve(index)
+        searchers: list[ShardSearcher] = []
+        index_of: list[str] = []
+        for n in names:
+            for s in self.indices[n].searchers():
+                searchers.append(s)
+                index_of.append(n)
+        queries = [b.get("query") or {"match_all": {}} for _, b in metas]
+        results = [
+            s.execute_query_phase(s.parse(queries), size=size, from_=from_,
+                                  n_queries=len(queries))
+            for s in searchers]
+        took = int((time.perf_counter() - t0) * 1000)
+        outs = []
+        for qi, (_, body) in enumerate(metas):
+            reduced = controller.sort_docs(results, from_=from_, size=size,
+                                           query_row=qi)
+            src_filter = body.get("_source")
+            hits = controller.fetch_and_merge(
+                reduced, searchers,
+                source_filter=(lambda s: _source_filter(s, src_filter))
+                if src_filter is not None else None)
+            for slot, h in enumerate(hits):
+                h["_index"] = index_of[reduced.shard_order[slot]]
+            outs.append({
+                "took": took,
+                "timed_out": False,
+                "_shards": {"total": len(searchers),
+                            "successful": len(searchers), "failed": 0},
+                "hits": {"total": reduced.total_hits,
+                         "max_score": None
+                         if reduced.max_score != reduced.max_score
+                         else reduced.max_score,
+                         "hits": hits},
+            })
+        return outs
+
     # -- scroll (cursored reads, ref §3.5 scroll/scan call stack) ----------
 
     def _scroll_start(self, index: str, body: dict, size: int,
@@ -439,6 +542,12 @@ class NodeService:
         for n in self._resolve(index):
             self.indices[n].flush()
             self._persist_index_meta(self.indices[n])
+
+    def force_merge(self, index: str = "_all",
+                    max_num_segments: int = 1) -> None:
+        """ref the _optimize API (action/admin/indices/optimize)."""
+        for n in self._resolve(index):
+            self.indices[n].force_merge(max_num_segments)
 
     def put_mapping(self, index: str, type_name: str, mapping: dict) -> None:
         for n in self._resolve(index):

@@ -48,20 +48,12 @@ def required_padding(n_postings: int, max_df: int) -> int:
     return next_pow2(n_postings + next_pow2(max_df, floor=8), floor=8)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("Wt", "k", "n_docs", "with_positions"))
-def bm25_topk_sparse(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
-                     term_starts: jax.Array, term_lens: jax.Array,
-                     weights: jax.Array, k1, b, avgdl, *,
-                     Wt: int, k: int, n_docs: int,
-                     with_positions: bool = False):
-    """Batched BM25 top-k over one postings block.
-
-    doc_ids i32[P], tf f32[P], dl f32[P]: postings (P >= max start + Wt —
-    use `required_padding`). term_starts/term_lens i32[Q,T]; weights f32[Q,T].
-    Returns (top_scores f32[Q,k], top_docs i32[Q,k], total_hits i32[Q]).
-    Empty slots: score -inf, doc == n_docs.
-    """
+def _sorted_runs(doc_ids, tf, dl, term_starts, term_lens, weights,
+                 k1, b, avgdl, *, Wt: int, n_docs: int, with_count: bool):
+    """Stages 1-4 of the pipeline, shared by both kernels: slice postings,
+    score, sort, windowed segment-sum. Returns (d i32[Q,W] sorted doc ids,
+    total f32[Q,W] per-run score on each run's last slot, count f32[Q,W]
+    per-run distinct-term count or None, ends bool[Q,W] run-end markers)."""
     Q, T = term_starts.shape
     PAD = jnp.int32(n_docs)
 
@@ -81,20 +73,51 @@ def bm25_topk_sparse(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
     W = T * Wt
     d = d.reshape(Q, W)
     contrib = contrib.reshape(Q, W).astype(jnp.float32)
-    d, contrib = jax.lax.sort((d, contrib), dimension=1, num_keys=1)
+    if with_count:
+        cnt = valid.astype(jnp.float32).reshape(Q, W)
+        d, contrib, cnt = jax.lax.sort((d, contrib, cnt),
+                                       dimension=1, num_keys=1)
+    else:
+        cnt = None
+        d, contrib = jax.lax.sort((d, contrib), dimension=1, num_keys=1)
 
-    # windowed segment-sum: totals land on each run's last slot
+    # windowed segment-sum: totals land on each run's last slot (runs are at
+    # most T long: postings are doc-sorted per term, one entry per query term)
     total = contrib
+    count = cnt
     for j in range(1, T):
         same = d == jnp.roll(d, j, axis=1)
         same = same.at[:, :j].set(False)
         total = total + jnp.where(same, jnp.roll(contrib, j, axis=1), 0.0)
+        if with_count:
+            count = count + jnp.where(same, jnp.roll(cnt, j, axis=1), 0.0)
 
     is_real = d < PAD
     ends = jnp.concatenate([d[:, :-1] != d[:, 1:], jnp.ones((Q, 1), bool)],
                            axis=1) & is_real
-    masked = jnp.where(ends, total, -jnp.inf)
+    return d, total, count, ends
 
+
+@functools.partial(jax.jit,
+                   static_argnames=("Wt", "k", "n_docs", "with_positions"))
+def bm25_topk_sparse(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
+                     term_starts: jax.Array, term_lens: jax.Array,
+                     weights: jax.Array, k1, b, avgdl, *,
+                     Wt: int, k: int, n_docs: int,
+                     with_positions: bool = False):
+    """Batched BM25 top-k over one postings block.
+
+    doc_ids i32[P], tf f32[P], dl f32[P]: postings (P >= max start + Wt —
+    use `required_padding`). term_starts/term_lens i32[Q,T]; weights f32[Q,T].
+    Returns (top_scores f32[Q,k], top_docs i32[Q,k], total_hits i32[Q]).
+    Empty slots: score -inf, doc == n_docs.
+    """
+    PAD = jnp.int32(n_docs)
+    d, total, _, ends = _sorted_runs(
+        doc_ids, tf, dl, term_starts, term_lens, weights, k1, b, avgdl,
+        Wt=Wt, n_docs=n_docs, with_count=False)
+    W = d.shape[1]
+    masked = jnp.where(ends, total, -jnp.inf)
     top, pos = jax.lax.top_k(masked, min(k, W))
     top_docs = jnp.where(top > -jnp.inf,
                          jnp.take_along_axis(d, pos, axis=1), PAD)
@@ -107,3 +130,44 @@ def slot_budget(term_lens) -> int:
     import numpy as np
     from ..index.segment import next_pow2
     return next_pow2(int(np.asarray(term_lens).max()), floor=8)
+
+
+@functools.partial(jax.jit, static_argnames=("Wt", "k", "n_docs"))
+def bm25_topk_sparse_masked(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
+                            term_starts: jax.Array, term_lens: jax.Array,
+                            weights: jax.Array, min_match: jax.Array,
+                            doc_mask: jax.Array, k1, b, avgdl, *,
+                            Wt: int, k: int, n_docs: int):
+    """The served-search variant of `bm25_topk_sparse`: same sort-reduce
+    pipeline, plus the two things a real request needs —
+
+      * `min_match` i32[Q]: per-query minimum distinct matching terms
+        (1 = operator "or", T = operator "and", otherwise
+        minimum_should_match). Counted with a second windowed segment-sum
+        over the validity indicator — reuses the same rolls as the score
+        reduce, so "and" costs no extra sort.
+      * `doc_mask` bool[M, n_docs+1] with M in {1, Q}: per-doc acceptance
+        (tombstone liveness AND any filter/must_not context). Gathered only
+        at the W candidate slots — a [Q, W] gather, never a [Q, N] one —
+        so filters stay columnar and the scoring stays scatter-free.
+        Index n_docs is the PAD sentinel row and MUST be False.
+
+    Returns (top_scores f32[Q,k], top_docs i32[Q,k], total_hits i32[Q]).
+    ref: the reference applies filters as Lucene FilteredQuery inside the
+    same per-segment hot loop (search/query/QueryPhase.java:144-154).
+    """
+    PAD = jnp.int32(n_docs)
+    d, total, count, ends = _sorted_runs(
+        doc_ids, tf, dl, term_starts, term_lens, weights, k1, b, avgdl,
+        Wt=Wt, n_docs=n_docs, with_count=True)
+    W = d.shape[1]
+    accepted = (doc_mask[0].take(d) if doc_mask.shape[0] == 1
+                else jnp.take_along_axis(doc_mask, d, axis=1))
+    keep = ends & accepted & (count >= min_match[:, None].astype(jnp.float32))
+    masked = jnp.where(keep, total, -jnp.inf)
+
+    top, pos = jax.lax.top_k(masked, min(k, W))
+    top_docs = jnp.where(top > -jnp.inf,
+                         jnp.take_along_axis(d, pos, axis=1), PAD)
+    total_hits = jnp.sum(keep, axis=1, dtype=jnp.int32)
+    return top, top_docs, total_hits

@@ -159,6 +159,25 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("POST", "/{index}/_count", count)
     c.register("GET", "/_count", count)
 
+    def msearch(g, p, b):
+        # NDJSON: alternating header / body lines
+        # (ref rest/action/search/RestMultiSearchAction)
+        lines = [json.loads(ln) for ln in b.decode("utf-8").split("\n")
+                 if ln.strip()]
+        if len(lines) % 2:
+            raise RestError(400, "msearch body must be header/body pairs")
+        requests = []
+        for i in range(0, len(lines), 2):
+            header = dict(lines[i])
+            if g.get("index") and "index" not in header:
+                header["index"] = g["index"]
+            requests.append((header, lines[i + 1]))
+        return 200, node.msearch(requests)
+    c.register("GET", "/_msearch", msearch)
+    c.register("POST", "/_msearch", msearch)
+    c.register("GET", "/{index}/_msearch", msearch)
+    c.register("POST", "/{index}/_msearch", msearch)
+
     # -- bulk --------------------------------------------------------------
     def bulk(g, p, b):
         import time
@@ -211,6 +230,14 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         return 200, {"_shards": {"failed": 0}}
     c.register("POST", "/{index}/_flush", flush)
     c.register("POST", "/_flush", flush)
+
+    def optimize(g, p, b):
+        node.force_merge(g.get("index", "_all"),
+                         int(p.get("max_num_segments", [1])[0]))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("POST", "/{index}/_optimize", optimize)
+    c.register("POST", "/_optimize", optimize)
+    c.register("POST", "/{index}/_forcemerge", optimize)
 
     def get_mapping(g, p, b):
         out = {}

@@ -39,6 +39,14 @@ class QueryParsingException(Exception):
     pass
 
 
+def _pow2_window(lens: np.ndarray) -> int:
+    """Work-budget W for the dense postings kernels: pow2 >= the batch's
+    total postings (the ops/bm25.postings_slots invariant — one place)."""
+    from ..index.segment import next_pow2
+    total = int(lens.sum(axis=-1).max()) if lens.ndim > 1 else int(lens.sum())
+    return next_pow2(max(total, 1), floor=8)
+
+
 # ---------------------------------------------------------------------------
 # Execution context: per-segment, per-batch device inputs
 # ---------------------------------------------------------------------------
@@ -104,6 +112,12 @@ class Node:
     def execute(self, ctx: SegmentContext):
         """-> (scores f32[Q, n_pad], match bool[Q, n_pad]); traced under jit."""
         raise NotImplementedError
+
+    def match_mask(self, ctx: SegmentContext):
+        """Match-only evaluation (filter context, ref Lucene filters inside
+        QueryPhase). Overridden where the mask is computable cheaper than the
+        full scoring program."""
+        return self.execute(ctx)[1]
 
     def plan_key(self) -> tuple:
         """Static structure key for the jit compile cache."""
@@ -186,7 +200,7 @@ class MatchNode(Node):
         if fx is None:
             return _zeros(ctx), _false(ctx)
         starts, lens, weights, n_terms = self._host_arrays(ctx)
-        W = int(max(8, 1 << int(np.ceil(np.log2(max(1, int(lens.sum(1).max())))))))
+        W = _pow2_window(lens)
         avgdl = ctx.stats.avgdl(self.field_name)
         scores = bm25.bm25_score_batch(
             fx.doc_ids, fx.tf, fx.doc_len,
@@ -209,6 +223,21 @@ class MatchNode(Node):
         else:
             match = scores > 0
         return jnp.where(match, scores, 0.0), match
+
+    def match_mask(self, ctx):
+        """Filter-context match: presence only, no scoring scatter needed
+        for the common "or" case (term_match_mask is a df-sized scatter of
+        ones, not the full postings scoring program)."""
+        if self.operator == "and" or self.minimum_should_match > 1:
+            return self.execute(ctx)[1]
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        if fx is None:
+            return _false(ctx)
+        starts, lens, _, _ = self._host_arrays(ctx)
+        return bm25.term_match_mask(fx.doc_ids, jnp.asarray(starts),
+                                    jnp.asarray(lens), W=_pow2_window(lens),
+                                    n_pad=ctx.n_pad)
 
     def plan_key(self):
         return ("match", self.field_name, self.operator, self.minimum_should_match)
@@ -442,6 +471,28 @@ class BoolNode(Node):
         scores = jnp.where(match, scores * self.boost, 0.0)
         return scores, match
 
+    def match_mask(self, ctx):
+        match = _true(ctx)
+        for n in self.must + self.filter:
+            match = match & n.match_mask(ctx)
+        if self.should:
+            msm = self.minimum_should_match
+            if msm is None:
+                msm = 0 if (self.must or self.filter) else 1
+            if msm == 1:
+                any_should = _false(ctx)
+                for n in self.should:
+                    any_should = any_should | n.match_mask(ctx)
+                match = match & any_should
+            elif msm > 1:
+                cnt = jnp.zeros((ctx.Q, ctx.n_pad), jnp.int32)
+                for n in self.should:
+                    cnt = cnt + n.match_mask(ctx).astype(jnp.int32)
+                match = match & (cnt >= msm)
+        for n in self.must_not:
+            match = match & ~n.match_mask(ctx)
+        return match
+
     def plan_key(self):
         return ("bool",
                 tuple(n.plan_key() for n in self.must),
@@ -459,8 +510,11 @@ class ConstantScoreNode(Node):
         self.inner.collect_terms(out)
 
     def execute(self, ctx):
-        _, m = self.inner.execute(ctx)
+        m = self.inner.match_mask(ctx)
         return jnp.where(m, jnp.float32(self.boost), 0.0), m
+
+    def match_mask(self, ctx):
+        return self.inner.match_mask(ctx)
 
     def plan_key(self):
         return ("constant_score", self.inner.plan_key())

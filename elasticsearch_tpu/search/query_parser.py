@@ -464,9 +464,10 @@ class MultiTermExpandNode(Node):
                 s, ln, _ = fx.lookup(t)
                 starts[0, ti] = s
                 lens[0, ti] = ln
-            W = int(max(8, 1 << int(np.ceil(np.log2(max(1, int(lens.sum())))))))
+            from .query_dsl import _pow2_window
             hits = _bm25.term_match_mask(fx.doc_ids, jnp.asarray(starts),
-                                         jnp.asarray(lens), W=W, n_pad=ctx.n_pad)
+                                         jnp.asarray(lens),
+                                         W=_pow2_window(lens), n_pad=ctx.n_pad)
             match = jnp.broadcast_to(hits, (ctx.Q, ctx.n_pad))
         else:
             kc = seg.keywords[self.field_name]

@@ -59,11 +59,17 @@ class ShardSearcher:
     """Executes search phases over one shard's live segment set."""
 
     def __init__(self, shard_id: int, segments: Sequence[Segment],
-                 mappers: MapperService):
+                 mappers: MapperService, stats: dict | None = None):
         self.shard_id = shard_id
         self.segments = list(segments)
         self.mappers = mappers
         self.parser = QueryParser(mappers)
+        # which device program served the last query phase — tests assert the
+        # sparse sort-reduce kernel is the production scoring path
+        self.last_query_path: str | None = None
+        self.sparse_queries = 0
+        self.dense_queries = 0
+        self._path_stats = stats if stats is not None else {}
 
     # -- statistics (DFS support, ref search/dfs/DfsPhase.java:57-81) ------
 
@@ -107,6 +113,27 @@ class ShardSearcher:
         """
         k = max(size + from_, 1)
         Q = n_queries
+
+        if sort is None and aggs is None and search_after is None:
+            # the production fast path: sort-reduce sparse kernel
+            # (ops/bm25_sparse) for the plan shapes that dominate traffic
+            from .sparse_exec import execute_sparse, extract_sparse_plan
+            plan = extract_sparse_plan(node)
+            if plan is not None:
+                stats = self.build_stats(node, global_stats)
+                keys, scores, total, mx = execute_sparse(
+                    plan, self.segments, stats, k=k)
+                self.last_query_path = "sparse"
+                self.sparse_queries += 1
+                self._path_stats["sparse"] = \
+                    self._path_stats.get("sparse", 0) + 1
+                return QuerySearchResult(
+                    shard_id=self.shard_id, doc_keys=keys, scores=scores,
+                    sort_values=None, total_hits=total, max_score=mx)
+
+        self.last_query_path = "dense"
+        self.dense_queries += 1
+        self._path_stats["dense"] = self._path_stats.get("dense", 0) + 1
         stats = self.build_stats(node, global_stats)
 
         best_scores = np.full((Q, k), -np.inf, np.float32)

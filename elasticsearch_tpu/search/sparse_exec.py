@@ -1,0 +1,213 @@
+"""Sparse execution planning: route served queries onto the sort-reduce kernel.
+
+Round 1 shipped two BM25 formulations: the dense scatter-add
+(`ops/bm25.py`, measured ~0.5x CPU — a TPU anti-pattern) and the sort-reduce
+sparse kernel (`ops/bm25_sparse.py`, ~94x CPU). The served `_search` path ran
+the dense one. This module closes that gap: it recognizes the query shapes
+that dominate real traffic —
+
+    match                                  (BASELINE config #1)
+    bool { must: [match], filter: [...] }  (BASELINE config #2)
+    bool { must: [match, const-score...], must_not: [...] }
+
+— and compiles them to a SparsePlan executed via `bm25_topk_sparse_masked`:
+text scoring through contiguous postings DMAs, filters as columnar masks
+gathered only at the W candidate slots. Anything else (should-scoring,
+dis_max, function_score, multi-field, sort, aggs) falls back to the dense
+tree; those either genuinely need a full match mask or are not
+postings-scored at all.
+
+ref: the reference compiles every query to the same Lucene scorer stack
+(search/query/QueryPhase.java:91-168); here the *plan shape* decides which
+device program serves it — the TPU analog of Lucene's BulkScorer
+specialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..index.segment import Segment, next_pow2
+from ..ops.bm25_sparse import bm25_topk_sparse_masked, slot_budget
+from .query_dsl import (
+    BoolNode, CollectionStats, ConstantScoreNode, ExistsNode, IdsNode,
+    MatchAllNode, MatchNode, MatchNoneNode, Node, RangeNode, SegmentContext,
+    TermFilterNode,
+)
+
+
+@dataclass
+class SparsePlan:
+    """A query tree reduced to: one scored text match + columnar masks."""
+    field: str
+    terms_per_query: list[list[str]]
+    operator: str                    # or | and
+    msm: int                         # minimum_should_match of the match node
+    k1: float
+    b: float
+    match_boost: float               # the match node's own boost
+    scale: float                     # enclosing bool boost (multiplies total)
+    const_boost: float               # additive constant from const-score musts
+    mask_nodes: list[Node] = dc_field(default_factory=list)
+    neg_nodes: list[Node] = dc_field(default_factory=list)
+
+    @property
+    def n_queries(self) -> int:
+        return len(self.terms_per_query)
+
+
+def _mask_safe(n: Node) -> bool:
+    """True if the node's match_mask is computable without the dense BM25
+    scoring kernel (columnar compares / id lookups / term-dict expansion /
+    presence-only postings masks)."""
+    from .query_parser import MultiTermExpandNode
+    if isinstance(n, BoolNode):
+        return all(_mask_safe(c)
+                   for c in n.must + n.should + n.must_not + n.filter)
+    if isinstance(n, ConstantScoreNode):
+        return _mask_safe(n.inner)
+    if isinstance(n, MatchNode):
+        # presence-only text match (term_match_mask) — scoring not needed
+        # in filter context
+        return n.operator != "and" and n.minimum_should_match <= 1
+    return isinstance(n, (TermFilterNode, RangeNode, ExistsNode, IdsNode,
+                          MatchAllNode, MatchNoneNode, MultiTermExpandNode))
+
+
+def extract_sparse_plan(node: Node) -> SparsePlan | None:
+    """Recognize sparse-servable query shapes; None = use the dense tree."""
+    if isinstance(node, MatchNode):
+        return SparsePlan(
+            field=node.field_name, terms_per_query=node.terms_per_query,
+            operator=node.operator, msm=node.minimum_should_match,
+            k1=node.k1, b=node.b, match_boost=node.boost,
+            scale=1.0, const_boost=0.0)
+    if isinstance(node, BoolNode):
+        if node.should:          # should-scoring changes ranks: dense tree
+            return None
+        match: MatchNode | None = None
+        const_boost = 0.0
+        masks: list[Node] = []
+        for m in node.must:
+            if isinstance(m, MatchNode):
+                if match is not None:
+                    return None      # two scored text clauses: dense tree
+                match = m
+            elif _mask_safe(m):
+                # const-score must: adds its boost to every surviving doc
+                const_boost += m.boost
+                masks.append(m)
+            else:
+                return None
+        if match is None:
+            return None          # no text scoring: dense tree is columnar
+        if not all(_mask_safe(f) for f in node.filter):
+            return None
+        if not all(_mask_safe(f) for f in node.must_not):
+            return None
+        return SparsePlan(
+            field=match.field_name, terms_per_query=match.terms_per_query,
+            operator=match.operator, msm=match.minimum_should_match,
+            k1=match.k1, b=match.b, match_boost=match.boost,
+            scale=node.boost, const_boost=const_boost,
+            mask_nodes=masks + list(node.filter),
+            neg_nodes=list(node.must_not))
+    return None
+
+
+def _segment_mask(seg: Segment, plan: SparsePlan, Q: int,
+                  stats: CollectionStats):
+    """bool[M, n_pad+1] doc acceptance for one segment (M in {1, Q});
+    the last column is the PAD-sentinel row and is always False."""
+    if not plan.mask_nodes and not plan.neg_nodes:
+        return seg.live_padded()         # [1, n_pad+1], cached on the segment
+    ctx = SegmentContext(seg, Q, stats)
+    m = jnp.broadcast_to(seg.live[None, :], (Q, seg.n_pad))
+    for n in plan.mask_nodes:
+        m = m & n.match_mask(ctx)
+    for n in plan.neg_nodes:
+        m = m & ~n.match_mask(ctx)
+    return jnp.concatenate([m, jnp.zeros((Q, 1), bool)], axis=1)
+
+
+def execute_sparse(plan: SparsePlan, segments: list[Segment],
+                   stats: CollectionStats, *, k: int):
+    """Run the plan over a shard's segments; returns the same
+    (doc_keys i64[Q,k], scores f32[Q,k], total i64[Q], max f32[Q]) contract
+    as the dense query phase, with doc keys (segment << 32 | local)."""
+    import math
+
+    Q = plan.n_queries
+    T = next_pow2(max((len(t) for t in plan.terms_per_query), default=1),
+                  floor=2)
+    k_pad = next_pow2(k, floor=8)
+
+    best_scores = np.full((Q, k), -np.inf, np.float32)
+    best_keys = np.full((Q, k), -1, np.int64)
+    total = np.zeros((Q,), np.int64)
+    max_score = np.full((Q,), -np.inf, np.float32)
+
+    # IDF from shard-global stats so every segment scores identically
+    # (ref search/dfs/DfsPhase.java — stats precede scoring)
+    n_terms = np.array([len(t) for t in plan.terms_per_query], np.int32)
+    if plan.operator == "and":
+        min_match = np.maximum(n_terms, 1)
+    else:
+        min_match = np.full((Q,), max(plan.msm, 1), np.int32)
+
+    weights_np = np.zeros((Q, T), np.float32)
+    for qi, terms in enumerate(plan.terms_per_query):
+        for ti, term in enumerate(terms[:T]):
+            df = stats.df(plan.field, term)
+            if df > 0:
+                w = math.log(1 + (stats.doc_count - df + 0.5) / (df + 0.5))
+                weights_np[qi, ti] = (w * (plan.k1 + 1)
+                                      * plan.match_boost * plan.scale)
+    avgdl = stats.avgdl(plan.field)
+    const = np.float32(plan.const_boost * plan.scale)
+
+    for seg_idx, seg in enumerate(segments):
+        if seg.n_docs == 0:
+            continue
+        fx = seg.text.get(plan.field)
+        if fx is None:
+            continue
+        starts = np.zeros((Q, T), np.int32)
+        lens = np.zeros((Q, T), np.int32)
+        for qi, terms in enumerate(plan.terms_per_query):
+            for ti, term in enumerate(terms[:T]):
+                s, ln, _ = fx.lookup(term)
+                starts[qi, ti] = s
+                lens[qi, ti] = ln
+        if not lens.any():
+            continue
+        Wt = slot_budget(lens)
+        doc_mask = _segment_mask(seg, plan, Q, stats)
+        top, docs, hits = bm25_topk_sparse_masked(
+            fx.doc_ids, fx.tf, fx.dl,
+            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights_np),
+            jnp.asarray(min_match), doc_mask,
+            jnp.float32(plan.k1), jnp.float32(plan.b), jnp.float32(avgdl),
+            Wt=Wt, k=k_pad, n_docs=seg.n_pad)
+        top = np.asarray(top)[:, :k]
+        docs = np.asarray(docs)[:, :k]
+        finite = top > -np.inf
+        top = np.where(finite, top + const, -np.inf)
+        seg_keys = np.where(
+            finite,
+            (np.int64(seg_idx) << 32) | docs.astype(np.int64),
+            np.int64(-1))
+        total += np.asarray(hits, np.int64)
+        merged = np.concatenate([best_scores, top], axis=1)
+        merged_keys = np.concatenate([best_keys, seg_keys], axis=1)
+        order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
+        best_scores = np.take_along_axis(merged, order, axis=1)
+        best_keys = np.take_along_axis(merged_keys, order, axis=1)
+        max_score = np.maximum(max_score, top[:, 0])
+
+    max_score = np.where(np.isfinite(max_score), max_score, np.nan)
+    best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+    return best_keys, best_scores.astype(np.float32), total, max_score

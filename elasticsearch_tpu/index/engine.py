@@ -70,6 +70,8 @@ class Engine:
         self.path = shard_path
         self.mappers = mappers
         os.makedirs(shard_path, exist_ok=True)
+        from .store import SegmentStore
+        self.store = SegmentStore(shard_path)
         self.translog = Translog(os.path.join(shard_path, "translog"), durability)
         self._lock = threading.RLock()
         self.segments: list[Segment] = []
@@ -88,21 +90,22 @@ class Engine:
     # -- recovery (translog replay, ref InternalEngine recoverFromTranslog) --
 
     def _load_commit(self) -> None:
-        """Load the last commit point if one exists (gateway recovery analog,
-        ref index/gateway/ — committed segments must survive reopen; replaying
-        only the translog on top of an ignored commit would lose every doc
-        older than the last flush)."""
-        import json
-        commit_path = os.path.join(self.path, "commit.json")
-        if not os.path.exists(commit_path):
-            return
-        with open(commit_path) as f:
-            commit = json.load(f)
-        for d in commit["docs"]:
-            self._buffer_docs[d["id"]] = (d["source"], d["type"])
-        self.versions = {k: (v[0], v[1]) for k, v in commit["versions"].items()}
-        if self._buffer_docs:
-            self.refresh()
+        """Load the last commit point (gateway recovery analog, SURVEY §5.4b):
+        binary segment files load directly onto device — no re-analysis, no
+        re-tokenization; recovery cost is IO + device_put, not CPU parsing.
+        Raises store.CorruptIndexException if any segment file fails its
+        checksum (ref index/store/Store.java recovery verification)."""
+        segments, tombstones = self.store.load()
+        self.segments = segments
+        self._next_seg_id = max((s.seg_id for s in segments), default=0) + 1
+        # rebuild the LiveVersionMap: manifest order is chronological, so
+        # later segments override earlier ones for re-indexed docs
+        for seg in segments:
+            for local, doc_id in enumerate(seg.ids):
+                if seg.live_host[local]:
+                    self.versions[doc_id] = (seg.versions[local], False)
+        for doc_id, v in tombstones.items():
+            self.versions[doc_id] = (int(v), True)
 
     def _recover(self) -> None:
         n = 0
@@ -131,13 +134,16 @@ class Engine:
         """Returns the new version; raises VersionConflictException
         (ref InternalEngine.java:233-339 create/index/delete w/ conflicts)."""
         cur = self.current_version(doc_id)
+        raw = self.versions.get(doc_id)    # includes delete tombstones
         if op_type == "create" and cur != -1:
             raise VersionConflictException(doc_id, cur, -1)
         if version is None or version in (-1, -3):  # MATCH_ANY / internal
-            return cur + 1 if cur > 0 else 1
+            # version continues across delete tombstones, like the
+            # reference's LiveVersionMap (delete v2 -> reindex v3)
+            return raw[0] + 1 if raw is not None else 1
         if version_type == "external":
-            if cur != -1 and version <= cur:
-                raise VersionConflictException(doc_id, cur, version)
+            if raw is not None and version <= raw[0]:
+                raise VersionConflictException(doc_id, raw[0], version)
             return version
         # internal: provided version must equal current
         if cur != version:
@@ -229,7 +235,8 @@ class Engine:
             for doc_id, (source, tname) in self._buffer_docs.items():
                 mapper = self.mappers.document_mapper(tname)
                 parsed = mapper.parse(source, doc_id=doc_id)
-                builder.add(parsed, tname)
+                builder.add(parsed, tname,
+                            version=self.versions[doc_id][0])
             seg = builder.build()
             self._next_seg_id += 1
             self.segments.append(seg)
@@ -256,38 +263,17 @@ class Engine:
             self.merge_count += 1
 
     def flush(self) -> None:
-        """Commit: make segment state durable, roll + trim translog
-        (ref InternalEngine.flush -> Lucene commit + translog roll)."""
+        """Commit: write NEW segment files + the checksummed commit point,
+        roll + trim translog (ref InternalEngine.flush -> Lucene commit +
+        translog roll). Already-persisted segments are untouched — flush cost
+        is O(new docs + deletes), independent of corpus size."""
         with self._lock:
             self.refresh()
             gen = self.translog.roll()
-            self._persist_commit()
+            tombstones = {k: v[0] for k, v in self.versions.items() if v[1]}
+            self.store.commit(self.segments, tombstones)
             self.translog.trim(gen)
             self.flush_count += 1
-
-    def _persist_commit(self) -> None:
-        """Persist segments to disk (gateway analog, SURVEY.md §5.4(b)).
-        v1 stores the raw sources + versions; tensors rebuild on recovery —
-        recovery cost traded for simplicity; binary tensor snapshots come with
-        the snapshot/restore subsystem."""
-        import json
-        commit = {
-            "versions": {k: list(v) for k, v in self.versions.items()},
-            "docs": [],
-        }
-        for seg in self.segments:
-            for local in range(seg.n_docs):
-                if seg.live_host[local]:
-                    commit["docs"].append({"id": seg.ids[local],
-                                           "type": seg.types[local],
-                                           "source": seg.stored[local]})
-        tmp = os.path.join(self.path, "commit.json.tmp")
-        final = os.path.join(self.path, "commit.json")
-        with open(tmp, "w") as f:
-            json.dump(commit, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
 
     @staticmethod
     def open_committed(shard_path: str, mappers: MapperService, **kw) -> "Engine":

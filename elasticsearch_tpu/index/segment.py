@@ -145,35 +145,48 @@ class Segment:
     types: list[str]                 # host _type per local doc
     id_to_local: dict[str, int]
     live_host: np.ndarray            # bool[N_pad] host mirror
-    live: jax.Array = None           # bool[N_pad] device
     live_count: int = 0
+    versions: list[int] = dc_field(default_factory=list)  # per local doc
 
     def __post_init__(self):
-        if self.live is None:
-            self.live = jnp.asarray(self.live_host)
+        # device liveness is uploaded lazily: deletes only dirty the host
+        # mirror, so a burst of deletes costs ONE upload at the next search
+        # instead of an O(N) device_put per delete
+        self._live_dev: jax.Array | None = None
+        self._live_dirty = True
+        self._live_padded: jax.Array | None = None
         if not self.live_count:
             self.live_count = int(self.live_host[: self.n_docs].sum())
+        if not self.versions:
+            self.versions = [1] * self.n_docs
+
+    @property
+    def live(self) -> jax.Array:
+        """bool[N_pad] device tombstone bitmap (Lucene liveDocs analog)."""
+        if self._live_dirty or self._live_dev is None:
+            self._live_dev = jnp.asarray(self.live_host)
+            self._live_padded = None
+            self._live_dirty = False
+        return self._live_dev
 
     def delete_local(self, local: int) -> bool:
         """Flip the tombstone bit. Returns True if the doc was live."""
         if not self.live_host[local]:
             return False
         self.live_host[local] = False
-        self.live = jnp.asarray(self.live_host)
+        self._live_dirty = True
         self.live_count -= 1
-        self._live_padded = None
         return True
 
     def live_padded(self):
         """bool[1, n_pad+1] liveness with a False PAD-sentinel column —
         the doc_mask shape ops/bm25_sparse.bm25_topk_sparse_masked gathers
         at candidate slots. Cached; invalidated on delete."""
-        cached = getattr(self, "_live_padded", None)
-        if cached is None:
-            cached = jnp.concatenate(
-                [self.live, jnp.zeros((1,), bool)])[None, :]
-            self._live_padded = cached
-        return cached
+        live = self.live                 # refreshes the dirty device mirror
+        if self._live_padded is None:
+            self._live_padded = jnp.concatenate(
+                [live, jnp.zeros((1,), bool)])[None, :]
+        return self._live_padded
 
     def doc_freq(self, field: str, term: str) -> int:
         fx = self.text.get(field)
@@ -226,15 +239,18 @@ class SegmentBuilder:
         self.stored: list[dict] = []
         self.ids: list[str] = []
         self.types: list[str] = []
+        self.versions: list[int] = []
         self.id_to_local: dict[str, int] = {}
         self.n_docs = 0
 
-    def add(self, doc: ParsedDocument, type_name: str = "_doc") -> int:
+    def add(self, doc: ParsedDocument, type_name: str = "_doc",
+            version: int = 1) -> int:
         local = self.n_docs
         self.n_docs += 1
         self.stored.append(doc.source)
         self.ids.append(doc.doc_id)
         self.types.append(type_name)
+        self.versions.append(version)
         self.id_to_local[doc.doc_id] = local
 
         for field, tokens in doc.tokens.items():
@@ -335,7 +351,8 @@ class SegmentBuilder:
             seg_id=self.seg_id, n_docs=n, n_pad=n_pad, text=text,
             keywords=keywords, numerics=numerics, vectors=vectors,
             stored=self.stored, ids=self.ids, types=self.types,
-            id_to_local=dict(self.id_to_local), live_host=live)
+            id_to_local=dict(self.id_to_local), live_host=live,
+            versions=list(self.versions))
 
 
 def merge_segments(segments: list[Segment], new_seg_id: int,
@@ -366,5 +383,6 @@ def merge_segments(segments: list[Segment], new_seg_id: int,
                 continue
             src = seg.stored[local]
             parsed = mapper_for_type(seg.types[local]).parse(src, doc_id=seg.ids[local])
-            builder.add(parsed, seg.types[local])
+            builder.add(parsed, seg.types[local],
+                        version=seg.versions[local])
     return builder.build()

@@ -1,0 +1,250 @@
+"""Binary segment store: write-once segment files + checksummed commit point.
+
+The analog of the reference's Store/commit machinery
+(/root/reference/src/main/java/org/elasticsearch/index/store/Store.java —
+per-file checksums, VerifyingIndexOutput; gateway persistence SURVEY.md §5.4b).
+Round 1 persisted commits as an O(corpus) JSON rewrite of every live doc on
+every flush and re-tokenized the whole corpus on reopen; this store makes
+flush cost O(new segments):
+
+  seg_<id>.npz        CSR postings tensors, columns, vectors, ids/types/
+                      versions — written ONCE when a frozen segment is first
+                      committed, immutable after (Lucene segment-file model)
+  seg_<id>.docs.jsonl stored _source, one JSON per line (stored-fields file)
+  commit.json         the commit point: segment file list + crc32c-style
+                      checksums + per-segment tombstone ("dead") lists +
+                      deleted-doc versions; atomically replaced
+
+Recovery = verify checksums + np.load (no re-analysis). A flipped byte in any
+segment file fails the checksum and raises CorruptIndexException — the
+detection contract Store.java enforces on recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .segment import (KeywordColumn, NumericColumn, Segment, TextFieldIndex,
+                      VectorColumn)
+
+MANIFEST = "commit.json"
+FORMAT = 2
+
+
+class CorruptIndexException(Exception):
+    pass
+
+
+def _crc(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+class SegmentStore:
+    """Per-shard segment persistence with a single atomic commit point."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        # seg id -> (crc, docs_crc) for files already on disk; cached so
+        # commit never re-reads unchanged write-once files (flush must stay
+        # O(new segments), not O(index bytes))
+        self.persisted: dict[int, tuple[int, int]] = {}
+
+    # -- write -------------------------------------------------------------
+
+    def write_segment(self, seg: Segment) -> None:
+        """Write the immutable files for one frozen segment (idempotent)."""
+        if seg.seg_id in self.persisted:
+            return
+        npz_path = os.path.join(self.path, f"seg_{seg.seg_id}.npz")
+        docs_path = os.path.join(self.path, f"seg_{seg.seg_id}.docs.jsonl")
+
+        arrays: dict[str, np.ndarray] = {
+            "ids": np.asarray(seg.ids, dtype=np.str_),
+            "types": np.asarray(seg.types, dtype=np.str_),
+            "versions": np.asarray(seg.versions, np.int64),
+        }
+        schema: dict = {"n_docs": seg.n_docs, "n_pad": seg.n_pad,
+                        "text": {}, "keywords": [], "numerics": {},
+                        "vectors": {}}
+        for fi, (f, fx) in enumerate(sorted(seg.text.items())):
+            schema["text"][f] = {"i": fi, "sum_dl": fx.sum_dl,
+                                 "n_postings": fx.n_postings,
+                                 "max_df": fx.max_df}
+            arrays[f"t{fi}_terms"] = np.asarray(list(fx.terms), dtype=np.str_)
+            arrays[f"t{fi}_starts"] = np.asarray(fx.term_starts, np.int32)
+            arrays[f"t{fi}_lens"] = np.asarray(fx.term_lens, np.int32)
+            arrays[f"t{fi}_doc_ids"] = np.asarray(fx.doc_ids)
+            arrays[f"t{fi}_tf"] = np.asarray(fx.tf)
+            arrays[f"t{fi}_doc_len"] = np.asarray(fx.doc_len)
+            arrays[f"t{fi}_dl"] = np.asarray(fx.dl)
+        for fi, (f, kc) in enumerate(sorted(seg.keywords.items())):
+            schema["keywords"].append(f)
+            arrays[f"k{fi}_values"] = np.asarray(kc.values, dtype=np.str_)
+            arrays[f"k{fi}_ords"] = np.asarray(kc.ords)
+        for fi, (f, nc) in enumerate(sorted(seg.numerics.items())):
+            schema["numerics"][f] = {"i": fi, "dtype": nc.dtype}
+            arrays[f"n{fi}_vals"] = np.asarray(nc.vals)
+            arrays[f"n{fi}_missing"] = np.asarray(nc.missing)
+        for fi, (f, vc) in enumerate(sorted(seg.vectors.items())):
+            schema["vectors"][f] = {"i": fi, "dims": vc.dims}
+            arrays[f"v{fi}_vecs"] = np.asarray(vc.vecs)
+        arrays["schema"] = np.asarray(json.dumps(schema))
+
+        tmp = npz_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, npz_path)
+
+        tmp = docs_path + ".tmp"
+        with open(tmp, "w") as f:
+            for src in seg.stored:
+                f.write(json.dumps(src, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, docs_path)
+        self.persisted[seg.seg_id] = (_crc(npz_path), _crc(docs_path))
+
+    def commit(self, segments: list[Segment],
+               tombstones: dict[str, int]) -> None:
+        """Write new segment files, atomically replace the commit point,
+        GC segment files no longer referenced. Cost: O(new segments +
+        deletes), never O(corpus)."""
+        for seg in segments:
+            self.write_segment(seg)
+        manifest = {"format": FORMAT, "segments": [], "tombstones": tombstones}
+        for seg in segments:
+            crc, docs_crc = self.persisted[seg.seg_id]
+            dead = [int(i) for i in range(seg.n_docs)
+                    if not seg.live_host[i]]
+            manifest["segments"].append({
+                "seg_id": seg.seg_id,
+                "file": f"seg_{seg.seg_id}.npz",
+                "docs_file": f"seg_{seg.seg_id}.docs.jsonl",
+                "crc": crc, "docs_crc": docs_crc, "dead": dead})
+        tmp = os.path.join(self.path, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, MANIFEST))
+        self._gc({s.seg_id for s in segments})
+
+    def _gc(self, keep: set[int]) -> None:
+        import re
+        for fn in os.listdir(self.path):
+            m = re.match(r"^seg_(\d+)\.(npz|docs\.jsonl)$", fn)
+            if m and int(m.group(1)) not in keep:
+                try:
+                    os.remove(os.path.join(self.path, fn))
+                except OSError:
+                    pass
+                self.persisted.pop(int(m.group(1)), None)
+
+    # -- read --------------------------------------------------------------
+
+    def load(self) -> tuple[list[Segment], dict[str, int]]:
+        """Load the commit point: (segments, tombstone versions). Empty if
+        no commit exists. Raises CorruptIndexException on checksum mismatch."""
+        mpath = os.path.join(self.path, MANIFEST)
+        if not os.path.exists(mpath):
+            return [], {}
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != FORMAT:
+            # refusing loudly beats silently serving an empty index: the
+            # translog was trimmed at the old flush, so ignoring the commit
+            # would lose every doc older than it
+            raise CorruptIndexException(
+                f"unrecognized commit format "
+                f"[{manifest.get('format')!r}] in {mpath}")
+        segments = []
+        for entry in manifest["segments"]:
+            npz_path = os.path.join(self.path, entry["file"])
+            docs_path = os.path.join(self.path, entry["docs_file"])
+            for p, want in ((npz_path, entry["crc"]),
+                            (docs_path, entry["docs_crc"])):
+                if not os.path.exists(p):
+                    raise CorruptIndexException(f"missing segment file {p}")
+                got = _crc(p)
+                if got != want:
+                    raise CorruptIndexException(
+                        f"checksum mismatch for {p}: "
+                        f"expected {want:#010x}, got {got:#010x}")
+            segments.append(self._read_segment(entry, npz_path, docs_path))
+            self.persisted[entry["seg_id"]] = (entry["crc"],
+                                               entry["docs_crc"])
+        return segments, dict(manifest.get("tombstones", {}))
+
+    def _read_segment(self, entry: dict, npz_path: str,
+                      docs_path: str) -> Segment:
+        data = np.load(npz_path, allow_pickle=False)
+        schema = json.loads(str(data["schema"]))
+        n_docs = schema["n_docs"]
+        n_pad = schema["n_pad"]
+
+        text = {}
+        for f, meta in schema["text"].items():
+            fi = meta["i"]
+            terms = {t: i for i, t in enumerate(data[f"t{fi}_terms"])}
+            text[f] = TextFieldIndex(
+                terms=terms,
+                term_starts=data[f"t{fi}_starts"],
+                term_lens=data[f"t{fi}_lens"],
+                doc_ids=jnp.asarray(data[f"t{fi}_doc_ids"]),
+                tf=jnp.asarray(data[f"t{fi}_tf"]),
+                doc_len=jnp.asarray(data[f"t{fi}_doc_len"]),
+                dl=jnp.asarray(data[f"t{fi}_dl"]),
+                sum_dl=meta["sum_dl"], n_postings=meta["n_postings"],
+                max_df=meta["max_df"])
+        keywords = {}
+        for fi, f in enumerate(schema["keywords"]):
+            values = [str(v) for v in data[f"k{fi}_values"]]
+            keywords[f] = KeywordColumn(
+                ord_map={v: i for i, v in enumerate(values)}, values=values,
+                ords=jnp.asarray(data[f"k{fi}_ords"]))
+        numerics = {}
+        for f, meta in schema["numerics"].items():
+            fi = meta["i"]
+            numerics[f] = NumericColumn(
+                vals=jnp.asarray(data[f"n{fi}_vals"]),
+                missing=jnp.asarray(data[f"n{fi}_missing"]),
+                dtype=meta["dtype"])
+        vectors = {}
+        for f, meta in schema["vectors"].items():
+            vectors[f] = VectorColumn(
+                vecs=jnp.asarray(data[f"v{meta['i']}_vecs"]),
+                dims=meta["dims"])
+
+        ids = [str(i) for i in data["ids"]]
+        types = [str(t) for t in data["types"]]
+        versions = [int(v) for v in data["versions"]]
+        with open(docs_path) as f:
+            stored = [json.loads(ln) for ln in f if ln.strip()]
+        if len(stored) != n_docs:
+            raise CorruptIndexException(
+                f"{docs_path}: expected {n_docs} docs, got {len(stored)}")
+        live = np.zeros(n_pad, bool)
+        live[:n_docs] = True
+        for dead in entry.get("dead", []):
+            live[dead] = False
+        return Segment(
+            seg_id=entry["seg_id"], n_docs=n_docs, n_pad=n_pad, text=text,
+            keywords=keywords, numerics=numerics, vectors=vectors,
+            stored=stored, ids=ids, types=types,
+            id_to_local={d: i for i, d in enumerate(ids)},
+            live_host=live, versions=versions)

@@ -446,10 +446,17 @@ class NodeService:
                 searchers.append(s)
                 index_of.append(n)
         queries = [b.get("query") or {"match_all": {}} for _, b in metas]
+        # parse once per index (shards share a MapperService), not per shard
+        nodes_by_index = {}
+        for n in names:
+            from .search.query_parser import QueryParser, merge_query_batch
+            parser = QueryParser(self.indices[n].mappers)
+            nodes_by_index[n] = merge_query_batch(
+                [parser.parse(q) for q in queries])
         results = [
-            s.execute_query_phase(s.parse(queries), size=size, from_=from_,
-                                  n_queries=len(queries))
-            for s in searchers]
+            s.execute_query_phase(nodes_by_index[index_of[i]], size=size,
+                                  from_=from_, n_queries=len(queries))
+            for i, s in enumerate(searchers)]
         took = int((time.perf_counter() - t0) * 1000)
         outs = []
         for qi, (_, body) in enumerate(metas):

@@ -551,6 +551,12 @@ def _merge(nodes: list[Node]) -> Node:
         elif f.name == "functions":
             kwargs[f.name] = _merge_functions(vals)
         else:
+            # scalar params (boost, k1, b, ...) are tree-wide, not per-row:
+            # merging trees that differ would silently apply the first
+            # query's value to every row (wrong _score scaling)
+            if any(v != v0 for v in vals[1:]):
+                raise QueryParsingException(
+                    f"cannot batch queries differing in [{f.name}]")
             kwargs[f.name] = v0
     return type(first)(**kwargs)
 

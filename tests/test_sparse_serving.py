@@ -245,3 +245,21 @@ class TestMsearch:
         assert resp["responses"][1]["hits"]["total"] == 1
         server.stop()
         node.close()
+
+    def test_msearch_differing_boost_not_merged_wrong(self, tmp_path):
+        """Scalar params (boost) are tree-wide: a batch must not leak the
+        first query's boost into other rows (review finding r2)."""
+        node = NodeService(str(tmp_path / "n"))
+        for i, d in enumerate(DOCS):
+            node.index_doc("idx", str(i), d)
+        node.refresh("idx")
+        boosted = {"query": {"match": {"title": {"query": "fox",
+                                                 "boost": 10.0}}}}
+        plain = {"query": {"match": {"title": "dog"}}}
+        out = node.msearch([({"index": "idx"}, boosted),
+                            ({"index": "idx"}, plain)])
+        solo = node.search("idx", plain)
+        a = out["responses"][1]["hits"]["hits"][0]["_score"]
+        b = solo["hits"]["hits"][0]["_score"]
+        assert abs(a - b) < 1e-6, (a, b)
+        node.close()

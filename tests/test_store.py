@@ -1,0 +1,165 @@
+"""Binary segment store: incremental commits, checksums, recovery.
+
+ref contract: index/store/Store.java (per-file checksums, corruption raises
+on recovery) + the gateway commit-point model (SURVEY.md §5.4b). Round-1
+verdict item #4: flush must be O(new segments), recovery must not
+re-tokenize, one flipped byte must be detected.
+"""
+
+import json
+import os
+
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine, VersionConflictException
+from elasticsearch_tpu.index.store import CorruptIndexException, SegmentStore
+from elasticsearch_tpu.mapping.mapper import MapperService
+
+
+def make_engine(path) -> Engine:
+    return Engine(str(path), MapperService())
+
+
+class TestCommitRecover:
+    def test_flush_reopen_preserves_docs_and_versions(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        eng.index("1", {"title": "quick fox", "n": 1})
+        eng.index("2", {"title": "lazy dog", "n": 2})
+        eng.index("1", {"title": "quick fox v2", "n": 1})   # version 2
+        eng.flush()
+        eng.close()
+
+        eng2 = make_engine(tmp_path / "s")
+        assert eng2.doc_count() == 2
+        g = eng2.get("1")
+        assert g.source["title"] == "quick fox v2"
+        assert g.version == 2
+        # version conflicts still enforced after recovery
+        with pytest.raises(VersionConflictException):
+            eng2.index("1", {"title": "x"}, version=1)
+        eng2.close()
+
+    def test_recovery_does_not_reanalyze(self, tmp_path, monkeypatch):
+        eng = make_engine(tmp_path / "s")
+        for i in range(10):
+            eng.index(str(i), {"title": f"doc number {i}"})
+        eng.flush()
+        eng.close()
+
+        # a reopen must load binary tensors, never call the mapper
+        import elasticsearch_tpu.mapping.mapper as mapper_mod
+        calls = []
+        orig = mapper_mod.DocumentMapper.parse
+
+        def spy(self, *a, **kw):
+            calls.append(1)
+            return orig(self, *a, **kw)
+        monkeypatch.setattr(mapper_mod.DocumentMapper, "parse", spy)
+        eng2 = make_engine(tmp_path / "s")
+        assert eng2.doc_count() == 10
+        assert not calls, "recovery re-parsed documents"
+        eng2.close()
+
+    def test_flush_writes_only_new_segments(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        eng.index("1", {"t": "one"})
+        eng.flush()
+        seg_file = tmp_path / "s" / "seg_1.npz"
+        mtime = seg_file.stat().st_mtime_ns
+
+        eng.index("2", {"t": "two"})
+        eng.flush()
+        # first segment file untouched by the second flush
+        assert seg_file.stat().st_mtime_ns == mtime
+        assert (tmp_path / "s" / "seg_2.npz").exists()
+        eng.close()
+
+    def test_deletes_survive_reopen_via_dead_lists(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        for i in range(4):
+            eng.index(str(i), {"t": f"doc {i}"})
+        eng.flush()
+        eng.delete("2")
+        eng.flush()                        # dead list, tombstone version
+        eng.close()
+
+        eng2 = make_engine(tmp_path / "s")
+        assert eng2.doc_count() == 3
+        assert not eng2.get("2").found
+        # deleting again bumps from the tombstone version, not from scratch
+        res = eng2.index("2", {"t": "back"})
+        assert res.version == 3            # 1 (index) -> 2 (delete) -> 3
+        eng2.close()
+
+    def test_merge_gc_removes_old_segment_files(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        eng.index("1", {"t": "one"})
+        eng.flush()
+        eng.index("2", {"t": "two"})
+        eng.flush()
+        assert (tmp_path / "s" / "seg_1.npz").exists()
+        eng.force_merge(1)
+        eng.flush()
+        files = {f for f in os.listdir(tmp_path / "s") if f.endswith(".npz")}
+        assert len(files) == 1             # merged segment only
+        eng.close()
+
+
+class TestCorruption:
+    def _corrupt(self, path, offset=100):
+        data = bytearray(path.read_bytes())
+        data[min(offset, len(data) - 1)] ^= 0xFF
+        path.write_bytes(bytes(data))
+
+    def test_flipped_byte_in_segment_detected(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        for i in range(8):
+            eng.index(str(i), {"t": f"word{i} common"})
+        eng.flush()
+        eng.close()
+        self._corrupt(tmp_path / "s" / "seg_1.npz")
+        with pytest.raises(CorruptIndexException, match="checksum"):
+            make_engine(tmp_path / "s")
+
+    def test_flipped_byte_in_stored_fields_detected(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        eng.index("1", {"t": "hello world"})
+        eng.flush()
+        eng.close()
+        self._corrupt(tmp_path / "s" / "seg_1.docs.jsonl", offset=5)
+        with pytest.raises(CorruptIndexException, match="checksum"):
+            make_engine(tmp_path / "s")
+
+    def test_missing_segment_file_detected(self, tmp_path):
+        eng = make_engine(tmp_path / "s")
+        eng.index("1", {"t": "hello"})
+        eng.flush()
+        eng.close()
+        os.remove(tmp_path / "s" / "seg_1.npz")
+        with pytest.raises(CorruptIndexException, match="missing"):
+            make_engine(tmp_path / "s")
+
+
+class TestStoreRoundTrip:
+    def test_all_column_types_round_trip(self, tmp_path):
+        ms = MapperService(mappings={"_doc": {"properties": {
+            "kw": {"type": "keyword"},
+            "vec": {"type": "dense_vector", "dims": 3}}}})
+        eng = Engine(str(tmp_path / "s"), ms)
+        eng.index("a", {"title": "quick fox", "kw": "red", "n": 7,
+                        "f": 1.5, "flag": True, "vec": [1.0, 0.0, 0.5]})
+        eng.flush()
+        eng.close()
+
+        eng2 = Engine(str(tmp_path / "s"), ms)
+        seg = eng2.segments[0]
+        assert "title" in seg.text
+        assert seg.keywords["kw"].values == ["red"]
+        assert "n" in seg.numerics and "f" in seg.numerics
+        assert seg.vectors["vec"].dims == 3
+        # and it still searches
+        from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+        s = ShardSearcher(0, eng2.segments, ms)
+        res = s.execute_query_phase(s.parse([{"match": {"title": "fox"}}]))
+        assert int(res.total_hits[0]) == 1
+        eng2.close()

@@ -70,6 +70,14 @@ class TextFieldIndex:
     sum_dl: float                    # Σ field length (for avgdl)
     n_postings: int                  # un-padded P
     max_df: int = 0                  # largest postings list (slot budgeting)
+    # positions (Lucene .pos analog): per-posting slice into a flat
+    # occurrence array. Host-side — phrase verification runs over candidate
+    # postings slices, not the whole corpus. None when loaded from a commit
+    # written before positions existed (phrase degrades to AND).
+    doc_ids_host: np.ndarray | None = None   # i32[P] host mirror
+    pos_starts: np.ndarray | None = None     # i32[P] into positions[]
+    pos_lens: np.ndarray | None = None       # i32[P] == tf
+    positions: np.ndarray | None = None      # i32[O] token positions
 
     def lookup(self, term: str) -> tuple[int, int, int]:
         """-> (start, length==df, term_id) or (0, 0, -1) if absent."""
@@ -255,11 +263,11 @@ class SegmentBuilder:
 
         for field, tokens in doc.tokens.items():
             fld = self._postings.setdefault(field, {})
-            counts: dict[str, int] = {}
-            for t in tokens:
-                counts[t] = counts.get(t, 0) + 1
-            for t, c in counts.items():
-                fld.setdefault(t, []).append((local, c))
+            pos_map: dict[str, list[int]] = {}
+            for p, t in enumerate(tokens):
+                pos_map.setdefault(t, []).append(p)
+            for t, ps in pos_map.items():
+                fld.setdefault(t, []).append((local, len(ps), ps))
             self._doc_len.setdefault(field, {})[local] = float(len(tokens))
         for field, vals in doc.keywords.items():
             if vals:
@@ -292,11 +300,17 @@ class SegmentBuilder:
             p_pad = required_padding(P, max_df)
             doc_ids = np.full(p_pad, n_pad, np.int32)   # PAD sentinel
             tf = np.zeros(p_pad, np.float32)
+            pos_starts = np.zeros(P, np.int32)
+            pos_lens = np.zeros(P, np.int32)
+            flat_positions: list[int] = []
             pos = 0
             for t in terms_sorted:
-                for d, c in term_map[t]:
+                for d, c, ps in term_map[t]:
                     doc_ids[pos] = d
                     tf[pos] = c
+                    pos_starts[pos] = len(flat_positions)
+                    pos_lens[pos] = len(ps)
+                    flat_positions.extend(ps)
                     pos += 1
             dl_map = self._doc_len.get(field, {})
             doc_len = np.ones(n_pad, np.float32)  # pad with 1 to avoid div-by-0
@@ -309,7 +323,10 @@ class SegmentBuilder:
                 doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
                 doc_len=jnp.asarray(doc_len), dl=jnp.asarray(dl),
                 sum_dl=float(sum(dl_map.values())), n_postings=P,
-                max_df=max_df)
+                max_df=max_df,
+                doc_ids_host=doc_ids[:P].copy(),
+                pos_starts=pos_starts, pos_lens=pos_lens,
+                positions=np.asarray(flat_positions, np.int32))
 
         keywords: dict[str, KeywordColumn] = {}
         for field, val_map in self._keywords.items():

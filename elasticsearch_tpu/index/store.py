@@ -90,6 +90,10 @@ class SegmentStore:
             arrays[f"t{fi}_tf"] = np.asarray(fx.tf)
             arrays[f"t{fi}_doc_len"] = np.asarray(fx.doc_len)
             arrays[f"t{fi}_dl"] = np.asarray(fx.dl)
+            if fx.positions is not None:
+                arrays[f"t{fi}_pos_starts"] = fx.pos_starts
+                arrays[f"t{fi}_pos_lens"] = fx.pos_lens
+                arrays[f"t{fi}_positions"] = fx.positions
         for fi, (f, kc) in enumerate(sorted(seg.keywords.items())):
             schema["keywords"].append(f)
             arrays[f"k{fi}_values"] = np.asarray(kc.values, dtype=np.str_)
@@ -201,16 +205,22 @@ class SegmentStore:
         for f, meta in schema["text"].items():
             fi = meta["i"]
             terms = {t: i for i, t in enumerate(data[f"t{fi}_terms"])}
+            np_doc_ids = data[f"t{fi}_doc_ids"]
+            has_pos = f"t{fi}_positions" in data
             text[f] = TextFieldIndex(
                 terms=terms,
                 term_starts=data[f"t{fi}_starts"],
                 term_lens=data[f"t{fi}_lens"],
-                doc_ids=jnp.asarray(data[f"t{fi}_doc_ids"]),
+                doc_ids=jnp.asarray(np_doc_ids),
                 tf=jnp.asarray(data[f"t{fi}_tf"]),
                 doc_len=jnp.asarray(data[f"t{fi}_doc_len"]),
                 dl=jnp.asarray(data[f"t{fi}_dl"]),
                 sum_dl=meta["sum_dl"], n_postings=meta["n_postings"],
-                max_df=meta["max_df"])
+                max_df=meta["max_df"],
+                doc_ids_host=np_doc_ids[:meta["n_postings"]],
+                pos_starts=data[f"t{fi}_pos_starts"] if has_pos else None,
+                pos_lens=data[f"t{fi}_pos_lens"] if has_pos else None,
+                positions=data[f"t{fi}_positions"] if has_pos else None)
         keywords = {}
         for fi, f in enumerate(schema["keywords"]):
             values = [str(v) for v in data[f"k{fi}_values"]]

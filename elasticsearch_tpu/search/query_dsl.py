@@ -243,6 +243,156 @@ class MatchNode(Node):
         return ("match", self.field_name, self.operator, self.minimum_should_match)
 
 
+_POS_SHIFT = 1 << 21      # doc*SHIFT + position fits i64 for 1M-token docs
+
+
+@dataclass
+class PhraseNode(Node):
+    """match_phrase (+ slop): positions-verified phrase matching
+    (ref index/query/MatchQueryParser.java phrase mode; Lucene
+    ExactPhraseScorer / SloppyPhraseScorer).
+
+    Execution = conjunctive BM25 scoring (the dense kernel, phrase traffic
+    is rare enough) intersected with a position-verified mask built from the
+    segment's occurrence CSR: for term i at query offset i, the adjusted key
+    doc*SHIFT + (pos - i) must appear for every term (slop=0 is exact
+    adjacency); slop>0 accepts docs where some choice of one position per
+    term spans <= slop after offset adjustment (minimal-window check).
+
+    Scoring divergence (documented): Lucene scores phrases by phrase
+    frequency; here the score is the conjunctive sum of per-term BM25
+    contributions over phrase-matching docs.
+    """
+    field_name: str = ""
+    terms_per_query: list[list[str]] = dc_field(default_factory=list)
+    slop: int = 0
+    k1: float = 1.2
+    b: float = 0.75
+    last_prefix: bool = False   # phrase_prefix: last term is a prefix
+    max_expansions: int = 50
+
+    def collect_terms(self, out):
+        s = out.setdefault(self.field_name, set())
+        for terms in self.terms_per_query:
+            s.update(terms[:-1] if self.last_prefix else terms)
+
+    def _term_keys(self, fx, term: str, offset: int) -> np.ndarray | None:
+        """Sorted i64 keys doc*SHIFT + (pos - offset) for every occurrence
+        of `term`, or None if the term is absent."""
+        s, ln, _ = fx.lookup(term)
+        if ln == 0:
+            return None
+        docs = np.repeat(fx.doc_ids_host[s:s + ln].astype(np.int64),
+                         fx.pos_lens[s:s + ln])
+        o_start = fx.pos_starts[s]
+        o_end = fx.pos_starts[s + ln - 1] + fx.pos_lens[s + ln - 1]
+        pos = fx.positions[o_start:o_end].astype(np.int64)
+        keys = docs * _POS_SHIFT + (pos - offset)
+        keys.sort()
+        return keys
+
+    def _adjusted_keys(self, fx, term: str, offset: int,
+                       is_last: bool) -> np.ndarray | None:
+        if is_last and self.last_prefix:
+            # expand the prefix against this segment's term dict (Lucene
+            # MultiPhrasePrefixQuery: any expansion may fill the slot)
+            expansions = fx.term_range(None, None, prefix=term,
+                                       limit=self.max_expansions)
+            parts = [k for t in expansions
+                     if (k := self._term_keys(fx, t, offset)) is not None]
+            if not parts:
+                return None
+            keys = np.unique(np.concatenate(parts))
+            return keys
+        return self._term_keys(fx, term, offset)
+
+    def _phrase_mask(self, ctx: SegmentContext) -> np.ndarray:
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        mask = np.zeros((ctx.Q, ctx.n_pad), bool)
+        if fx is None or fx.positions is None:
+            # no positions (legacy commit): degrade to AND semantics
+            return None
+        for qi, terms in enumerate(self.terms_per_query):
+            if not terms:
+                continue
+            per_term = []
+            for i, t in enumerate(terms):
+                keys = self._adjusted_keys(fx, t, i,
+                                           is_last=i == len(terms) - 1)
+                if keys is None:
+                    per_term = None
+                    break
+                per_term.append(keys)
+            if per_term is None:
+                continue
+            if self.slop == 0:
+                matched = per_term[0]
+                for keys in per_term[1:]:
+                    matched = matched[np.isin(matched, keys,
+                                              assume_unique=False)]
+                    if not matched.size:
+                        break
+                docs = np.unique(matched >> np.int64(
+                    _POS_SHIFT.bit_length() - 1))
+                mask[qi, docs] = True
+            else:
+                docs = np.unique(per_term[0] // _POS_SHIFT)
+                for keys in per_term[1:]:
+                    docs = docs[np.isin(docs, np.unique(keys // _POS_SHIFT))]
+                for d in docs:
+                    lists = [keys[(keys // _POS_SHIFT) == d] % _POS_SHIFT
+                             for keys in per_term]
+                    if _min_window(lists) <= self.slop:
+                        mask[qi, int(d)] = True
+        return mask
+
+    def execute(self, ctx):
+        # scoring terms: with last_prefix the final slot is an expansion,
+        # so only the literal head terms contribute BM25 (documented
+        # approximation; the mask still requires an expansion in position)
+        score_terms = ([t[:-1] for t in self.terms_per_query]
+                       if self.last_prefix else self.terms_per_query)
+        pm = self._phrase_mask(ctx)
+        if not any(score_terms):
+            match = _true(ctx) if pm is None else jnp.asarray(pm)
+            return jnp.where(match, jnp.float32(self.boost), 0.0), match
+        base = MatchNode(boost=self.boost, field_name=self.field_name,
+                         terms_per_query=score_terms,
+                         operator="and", k1=self.k1, b=self.b)
+        scores, match = base.execute(ctx)
+        if pm is not None:
+            match = match & jnp.asarray(pm)
+        return jnp.where(match, scores, 0.0), match
+
+    def plan_key(self):
+        return ("phrase", self.field_name, self.slop, self.last_prefix)
+
+
+def _min_window(lists: list[np.ndarray]) -> int:
+    """Minimal span covering one element from each sorted list (the
+    sloppy-phrase window over offset-adjusted positions)."""
+    import heapq
+    iters = [iter(lst) for lst in lists]
+    heap = []
+    cur_max = -(1 << 62)
+    for li, it in enumerate(iters):
+        v = next(it, None)
+        if v is None:
+            return 1 << 30
+        heapq.heappush(heap, (int(v), li))
+        cur_max = max(cur_max, int(v))
+    best = 1 << 30
+    while True:
+        v, li = heapq.heappop(heap)
+        best = min(best, cur_max - v)
+        nxt = next(iters[li], None)
+        if nxt is None:
+            return best
+        heapq.heappush(heap, (int(nxt), li))
+        cur_max = max(cur_max, int(nxt))
+
+
 @dataclass
 class TermFilterNode(Node):
     """Exact term on keyword/numeric/boolean columns -> constant score.

@@ -107,6 +107,10 @@ class QueryParser:
         (field, params), = spec.items()
         if not isinstance(params, dict):
             params = {"query": params}
+        if params.get("type") in ("phrase", "phrase_prefix"):
+            # ES 2.x match { type: phrase } form (MatchQueryParser.java)
+            return self._phrase_node(field, params,
+                                     prefix=params["type"] == "phrase_prefix")
         terms = self._analyze(field, params["query"])
         if not terms:
             return MatchNoneNode()
@@ -118,16 +122,28 @@ class QueryParser:
             minimum_should_match=msm)
 
     def _parse_match_phrase(self, spec: dict) -> Node:
-        # positions are not indexed yet: phrase ≈ conjunctive match, verified
-        # against _source in the fetch phase (documented divergence).
         (field, params), = spec.items()
         if not isinstance(params, dict):
             params = {"query": params}
+        return self._phrase_node(field, params)
+
+    def _parse_match_phrase_prefix(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        if not isinstance(params, dict):
+            params = {"query": params}
+        return self._phrase_node(field, params, prefix=True)
+
+    def _phrase_node(self, field: str, params: dict, prefix: bool = False) -> Node:
         terms = self._analyze(field, params["query"])
-        node = MatchNode(field_name=field, terms_per_query=[terms], operator="and",
-                         boost=float(params.get("boost", 1.0)))
-        node.phrase_text = str(params["query"])  # used by fetch-phase verifier
-        return node
+        if not terms:
+            return MatchNoneNode()
+        from .query_dsl import PhraseNode
+        return PhraseNode(
+            field_name=field, terms_per_query=[terms],
+            slop=int(params.get("slop", 0)),
+            boost=float(params.get("boost", 1.0)),
+            last_prefix=prefix,
+            max_expansions=int(params.get("max_expansions", 50)))
 
     def _parse_multi_match(self, spec: dict) -> Node:
         fields = spec.get("fields", [])
@@ -326,7 +342,8 @@ class QueryParser:
         AND/OR/NOT, +/- prefixes, * wildcard-in-term."""
         if qs.strip() in ("*", "*:*", ""):
             return MatchAllNode()
-        tokens = re.findall(r'"[^"]*"|\S+', qs)
+        # field:"quoted phrase" must stay one token
+        tokens = re.findall(r'[^\s:]+:"[^"]*"|"[^"]*"|\S+', qs)
         # clauses as (node, neg, req); AND is binary — it requires BOTH its
         # operands (Lucene parses 'a AND b' as +a +b), so it retroactively
         # promotes the previous clause too.
@@ -356,6 +373,7 @@ class QueryParser:
                 field, val = tok.split(":", 1)
             else:
                 field, val = default_field, tok
+            quoted = val.startswith('"') and val.endswith('"') and len(val) > 1
             val = val.strip('"')
             ft = self.mappers.field_type(field)
             if "*" in val or "?" in val:
@@ -363,6 +381,9 @@ class QueryParser:
                                                  pattern=val)
             elif ft is not None and ft.type != TEXT:
                 node = self._term_node(field, [val], 1.0)
+            elif quoted:
+                # "quoted phrase" -> positions-verified phrase
+                node = self._phrase_node(field, {"query": val})
             else:
                 terms = self._analyze(field, val)
                 node = MatchNode(field_name=field, terms_per_query=[terms]) if terms \

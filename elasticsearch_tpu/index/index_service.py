@@ -39,7 +39,11 @@ class IndexService:
         # (the NRT "acquire searcher" analog — ref SearcherManager); device
         # query-path counters live here so they survive across requests
         self._searcher_cache: dict[int, tuple[tuple, ShardSearcher]] = {}
-        self.search_stats = {"sparse": 0, "dense": 0}
+        self.search_stats = {"sparse": 0, "dense": 0, "packed": 0}
+        # fused serving view over all shards' segments (serving/packed_view):
+        # rebuilt only when the segment set changes; tombstone-only changes
+        # refresh its liveness row in place
+        self._packed_cache: tuple[tuple, "object"] | None = None
 
     # -- routing -----------------------------------------------------------
 
@@ -95,6 +99,19 @@ class IndexService:
                 self._searcher_cache[si] = cached
             out.append(cached[1])
         return out
+
+    def packed_view(self):
+        """The one-device-program serving view for this index (all shards'
+        segments fused). None when the index is empty."""
+        from ..serving.packed_view import PackedIndexView
+        entries = [(si, seg) for si, e in enumerate(self.shards)
+                   for seg in e.segments]
+        if not entries:
+            return None
+        key = tuple((si, seg.seg_id) for si, seg in entries)
+        if self._packed_cache is None or self._packed_cache[0] != key:
+            self._packed_cache = (key, PackedIndexView(entries))
+        return self._packed_cache[1]
 
     # -- introspection -----------------------------------------------------
 

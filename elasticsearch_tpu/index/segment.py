@@ -37,6 +37,12 @@ from ..mapping.mapper import (
 from ..ops.bm25_sparse import required_padding
 
 
+# hard cap on token positions per doc: phrase verification packs positions
+# as doc * 2^21 + (pos - offset + 2^10), so pos + bias must stay < 2^21
+# (search/query_dsl.py _POS_SHIFT / _POS_BIAS)
+_MAX_DOC_POSITIONS = (1 << 21) - (1 << 11)
+
+
 def next_pow2(n: int, floor: int = 8) -> int:
     n = max(n, floor)
     return 1 << (n - 1).bit_length()
@@ -163,6 +169,10 @@ class Segment:
         self._live_dev: jax.Array | None = None
         self._live_dirty = True
         self._live_padded: jax.Array | None = None
+        # monotonic tombstone generation: serving views (serving/packed_view)
+        # cache packed liveness keyed on this, so delete-only changes refresh
+        # one device row instead of rebuilding the view
+        self.live_gen = 0
         if not self.live_count:
             self.live_count = int(self.live_host[: self.n_docs].sum())
         if not self.versions:
@@ -183,6 +193,7 @@ class Segment:
             return False
         self.live_host[local] = False
         self._live_dirty = True
+        self.live_gen += 1
         self.live_count -= 1
         return True
 
@@ -253,6 +264,16 @@ class SegmentBuilder:
 
     def add(self, doc: ParsedDocument, type_name: str = "_doc",
             version: int = 1) -> int:
+        # validate BEFORE mutating builder state: a mid-add raise must not
+        # leave a half-indexed ghost doc behind (code review r3)
+        for field, tokens in doc.tokens.items():
+            if len(tokens) > _MAX_DOC_POSITIONS:
+                # position keys pack as doc * 2^21 + (pos + bias); a longer
+                # doc would collide with its neighbor's key space
+                # (search/query_dsl.py _POS_SHIFT/_POS_BIAS; advisor r2)
+                raise ValueError(
+                    f"field [{field}] has {len(tokens)} tokens; the maximum "
+                    f"is {_MAX_DOC_POSITIONS} per document")
         local = self.n_docs
         self.n_docs += 1
         self.stored.append(doc.source)

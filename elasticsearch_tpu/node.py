@@ -25,6 +25,7 @@ from .search import controller
 from .search.aggs import parse_aggs, merge_shard_partials, render as render_aggs
 from .search.query_dsl import QueryParsingException
 from .search.shard_searcher import ShardSearcher
+from .serving.executor import PACKED_BODY_KEYS
 
 
 class IndexMissingException(Exception):
@@ -272,6 +273,17 @@ class NodeService:
         if not names:
             raise IndexMissingException(index)
 
+        # the packed fast path: one device program over every shard/segment
+        # of the index (serving/packed_view) — the production serving lane
+        if len(names) == 1:
+            try:
+                packed = self._packed_search(names[0], [body],
+                                             size=size, from_=from_, t0=t0)
+            except Exception:  # noqa: BLE001 — degrade to the general path
+                packed = None
+            if packed is not None:
+                return packed[0]
+
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
         for n in names:
@@ -368,6 +380,51 @@ class NodeService:
             resp["aggregations"] = render_aggs(agg_specs, merged)
         return resp
 
+    def _packed_search(self, name: str, bodies: list[dict], *, size: int,
+                       from_: int, t0: float, raw: bool = False,
+                       specs: list | None = None) -> list | None:
+        """Serve a batch of same-shaped requests through the packed view:
+        ONE device program across all shards/segments, one upload, one
+        download (serving/). Returns per-body responses (dicts, or raw JSON
+        strings when `raw` and `_source: false`), or None to fall back."""
+        from .serving.executor import (packed_spec_of, response_dict,
+                                       response_raw)
+        svc = self.indices[name]
+        view = svc.packed_view()
+        if view is None:
+            return None
+        if specs is None:
+            from .search.query_parser import QueryParser
+            parser = QueryParser(svc.mappers)
+            specs = [packed_spec_of(parser, body) for body in bodies]
+        if any(s is None for s in specs):
+            return None
+        field, k1, b = specs[0][1], specs[0][2], specs[0][3]
+        if any(s[1] != field or s[2] != k1 or s[3] != b for s in specs[1:]):
+            return None
+        queries = [s[0] for s in specs]
+        k = max(size + from_, 1)
+        scores, docs, hits = view.search(field, queries, k=k, k1=k1, b=b)
+        svc.search_stats["packed"] = \
+            svc.search_stats.get("packed", 0) + len(bodies)
+        took = int((time.perf_counter() - t0) * 1000)
+        out = []
+        for qi, body in enumerate(bodies):
+            src_spec = body.get("_source", True)
+            if raw and src_spec is False and view.ids_json_safe:
+                out.append(response_raw(
+                    view, name, scores[qi], docs[qi], hits[qi],
+                    n_shards=svc.n_shards, took=took,
+                    from_=from_, size=size))
+            else:
+                fn = (lambda s: _source_filter(s, src_spec)) \
+                    if src_spec not in (True, False) else None
+                out.append(response_dict(
+                    view, name, scores[qi], docs[qi], hits[qi],
+                    n_shards=svc.n_shards, took=took, from_=from_,
+                    size=size, src_spec=src_spec, src_filter_fn=fn))
+        return out
+
     def count(self, index: str, body: dict | None = None) -> dict:
         out = self.search(index, {**(body or {}), "size": 0})
         return {"count": out["hits"]["total"], "_shards": out["_shards"]}
@@ -379,17 +436,71 @@ class NodeService:
     # comes from (SURVEY.md §7: the unit of device work is a batch of
     # queries, not one query at a time). ----------------------------------
 
-    _BATCHABLE_KEYS = {"query", "size", "from", "_source"}
+    # single source of truth for which body keys the fast lanes understand
+    # (serving/executor.PACKED_BODY_KEYS) — the plan-shape batched lane and
+    # the packed lane must never diverge in eligibility
+    _BATCHABLE_KEYS = PACKED_BODY_KEYS
 
-    def msearch(self, requests: list[tuple[dict, dict]]) -> dict:
+    def msearch(self, requests: list[tuple[dict, dict]],
+                raw: bool = False) -> dict | bytes:
+        """Batched multi-search. With `raw=True` returns the response body
+        as pre-serialized bytes when possible (the packed path builds hit
+        JSON vectorized — see serving/executor.py)."""
+        import json
+        from .serving.executor import packed_spec_of
+        t0 = time.perf_counter()
         responses: list = [None] * len(requests)
-        groups: dict[Any, list[int]] = {}
         metas: list[tuple[str, dict]] = []
+        packed_groups: dict[Any, list[int]] = {}
+        packed_specs: dict[int, Any] = {}
+        parsers: dict[str, Any] = {}
+        leftovers: list[int] = []
         for i, (header, body) in enumerate(requests):
             index = (header or {}).get("index") or "_all"
             body = body or {}
             metas.append((index, body))
-            key = self._msearch_batch_key(index, body)
+            key = None
+            try:
+                names = self._resolve(index)
+                if len(names) == 1:
+                    name = names[0]
+                    if name not in parsers:
+                        from .search.query_parser import QueryParser
+                        parsers[name] = QueryParser(
+                            self.indices[name].mappers)
+                    spec = packed_spec_of(parsers[name], body)
+                    if spec is not None:
+                        packed_specs[i] = spec
+                        key = (name, int(body.get("size", 10)),
+                               int(body.get("from", 0)),
+                               repr(body.get("_source", True)))
+            except Exception:  # noqa: BLE001 — solo path reports the error
+                key = None
+            if key is not None:
+                packed_groups.setdefault(key, []).append(i)
+            else:
+                leftovers.append(i)
+
+        for key, idxs in packed_groups.items():
+            name, size, from_, _src = key
+            try:
+                outs = self._packed_search(
+                    name, [metas[i][1] for i in idxs], size=size,
+                    from_=from_, t0=t0, raw=raw,
+                    specs=[packed_specs[i] for i in idxs])
+            except Exception:  # noqa: BLE001 — per-item error contract:
+                outs = None    # a failing group degrades to the solo path
+            if outs is None:
+                leftovers.extend(idxs)
+            else:
+                for i, out in zip(idxs, outs):
+                    responses[i] = out
+
+        # general path for whatever the packed lane couldn't serve:
+        # plan-shape device batching, then solo
+        groups: dict[Any, list[int]] = {}
+        for i in leftovers:
+            key = self._msearch_batch_key(*metas[i])
             groups.setdefault(key if key is not None else ("solo", i),
                               []).append(i)
         for key, idxs in groups.items():
@@ -404,6 +515,12 @@ class NodeService:
                 outs = [self._msearch_one(*metas[i]) for i in idxs]
             for i, out in zip(idxs, outs):
                 responses[i] = out
+
+        if raw:
+            payload = '{"responses":[' + ",".join(
+                r if isinstance(r, str) else json.dumps(r)
+                for r in responses) + ']}'
+            return payload.encode()
         return {"responses": responses}
 
     def _msearch_one(self, index: str, body: dict) -> dict:

@@ -172,7 +172,9 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             if g.get("index") and "index" not in header:
                 header["index"] = g["index"]
             requests.append((header, lines[i + 1]))
-        return 200, node.msearch(requests)
+        # raw=True: the packed serving lane pre-serializes hit JSON with
+        # vectorized string ops; bytes pass straight through to the socket
+        return 200, node.msearch(requests, raw=True)
     c.register("GET", "/_msearch", msearch)
     c.register("POST", "/_msearch", msearch)
     c.register("GET", "/{index}/_msearch", msearch)
@@ -442,7 +444,10 @@ class HttpServer:
                     status = _status_of(e)
                     payload = {"error": f"{type(e).__name__}: {e}",
                                "status": status}
-                if isinstance(payload, str):
+                if isinstance(payload, bytes):
+                    data = payload           # pre-serialized JSON fast lane
+                    ctype = "application/json; charset=UTF-8"
+                elif isinstance(payload, str):
                     data = payload.encode("utf-8")
                     ctype = "text/plain; charset=UTF-8"
                 else:

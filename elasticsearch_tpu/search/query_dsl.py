@@ -244,6 +244,13 @@ class MatchNode(Node):
 
 
 _POS_SHIFT = 1 << 21      # doc*SHIFT + position fits i64 for 1M-token docs
+# Bias added to offset-adjusted positions before packing into doc*SHIFT+pos
+# keys: a term occurring at doc position < its query offset would otherwise
+# produce a NEGATIVE adjusted position, and floor-division would attribute
+# the occurrence to doc-1 — dropping transposed matches ("b a" never matched
+# "a b" at any slop; advisor r2 medium finding). Max query length is guarded
+# at parse; max doc position is guarded at segment build (segment.py).
+_POS_BIAS = 1 << 10
 
 
 @dataclass
@@ -287,7 +294,7 @@ class PhraseNode(Node):
         o_start = fx.pos_starts[s]
         o_end = fx.pos_starts[s + ln - 1] + fx.pos_lens[s + ln - 1]
         pos = fx.positions[o_start:o_end].astype(np.int64)
-        keys = docs * _POS_SHIFT + (pos - offset)
+        keys = docs * _POS_SHIFT + (pos - offset + _POS_BIAS)
         keys.sort()
         return keys
 
@@ -310,8 +317,19 @@ class PhraseNode(Node):
         seg = ctx.segment
         fx = seg.text.get(self.field_name)
         mask = np.zeros((ctx.Q, ctx.n_pad), bool)
-        if fx is None or fx.positions is None:
-            # no positions (legacy commit): degrade to AND semantics
+        if fx is None:
+            # the field doesn't exist in this segment: nothing can match.
+            # (returning None here made a single-term match_phrase_prefix
+            # match EVERY doc in field-less segments — advisor r2 medium)
+            return mask
+        if fx.positions is None:
+            # no positions (legacy commit): degrade to AND semantics over
+            # the scoring terms — unless there are none (single-term
+            # phrase_prefix), where AND-of-nothing must be no-match, not
+            # match-all
+            if not any(t[:-1] if self.last_prefix else t
+                       for t in self.terms_per_query):
+                return mask
             return None
         for qi, terms in enumerate(self.terms_per_query):
             if not terms:

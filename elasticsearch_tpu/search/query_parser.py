@@ -137,7 +137,10 @@ class QueryParser:
         terms = self._analyze(field, params["query"])
         if not terms:
             return MatchNoneNode()
-        from .query_dsl import PhraseNode
+        from .query_dsl import PhraseNode, _POS_BIAS
+        if len(terms) >= _POS_BIAS:
+            raise QueryParsingException(
+                f"match_phrase supports at most {_POS_BIAS - 1} terms")
         return PhraseNode(
             field_name=field, terms_per_query=[terms],
             slop=int(params.get("slop", 0)),

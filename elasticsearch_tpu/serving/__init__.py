@@ -1,0 +1,11 @@
+"""Serving layer: device-resident packed index views + batched execution.
+
+The round-3 answer to "the product is slower than its own CPU proxy": serve
+every eligible request through ONE device program over ALL shards/segments
+(serving/packed_view.py), with one packed upload and one packed download,
+instead of a per-segment kernel with multiple host round-trips.
+"""
+
+from .packed_view import PackedIndexView, PackedQuery
+
+__all__ = ["PackedIndexView", "PackedQuery"]

@@ -1,0 +1,95 @@
+"""Packed-path request planning + response building.
+
+Bridges NodeService and PackedIndexView: decides which request bodies are
+servable by the one-program packed kernel, extracts per-query knobs from the
+parsed query tree, and assembles responses — either as dicts (API parity with
+the general path) or as raw JSON text (the fast lane for `_source: false`
+top-k responses, where building 256k hit dicts per msearch would cost more
+host time than the device program itself).
+
+ref: the reference's QueryPhase + SearchPhaseController split; here the
+"controller reduce" already happened on device (global top-k over the packed
+doc space), so response building is the only host work left.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .packed_view import PackedIndexView, PackedQuery
+
+# body keys the packed path understands; anything else (sort, aggs, rescore,
+# knn, search_after, highlight, ...) falls back to the general path
+PACKED_BODY_KEYS = {"query", "size", "from", "_source"}
+
+
+def packed_spec_of(parser, body: dict):
+    """-> (PackedQuery, field, k1, b) if the body is packed-servable,
+    else None. Mirrors sparse_exec.extract_sparse_plan eligibility minus
+    filter/must_not contexts (those need columnar masks — general path)."""
+    from ..search.sparse_exec import extract_sparse_plan
+
+    if any(k not in PACKED_BODY_KEYS for k in body):
+        return None
+    try:
+        node = parser.parse(body.get("query") or {"match_all": {}})
+    except Exception:          # noqa: BLE001 — let the general path raise
+        return None
+    plan = extract_sparse_plan(node)
+    if plan is None or plan.mask_nodes or plan.neg_nodes:
+        return None
+    return (PackedQuery(terms=plan.terms_per_query[0],
+                        boost=plan.match_boost * plan.scale,
+                        operator=plan.operator, msm=plan.msm,
+                        const=plan.const_boost * plan.scale),
+            plan.field, plan.k1, plan.b)
+
+
+def response_dict(view: PackedIndexView, index_name: str, srow: np.ndarray,
+                  drow: np.ndarray, total: int, *, n_shards: int, took: int,
+                  from_: int, size: int, src_spec, src_filter_fn) -> dict:
+    """Assemble one search response (general dict form)."""
+    sl = srow[from_:from_ + size]
+    dl = drow[from_:from_ + size]
+    n = int((sl > -np.inf).sum())
+    hits = []
+    for i in range(n):
+        src, tname, doc_id = view.source_of(int(dl[i]))
+        if src_spec is False:
+            src = {}
+        elif src_filter_fn is not None:
+            src = src_filter_fn(src)
+        hits.append({"_index": index_name, "_type": tname, "_id": doc_id,
+                     "_score": float(sl[i]), "_source": src})
+    mx = float(srow[0]) if srow.size and srow[0] > -np.inf else None
+    return {
+        "took": took, "timed_out": False,
+        "_shards": {"total": n_shards, "successful": n_shards, "failed": 0},
+        "hits": {"total": int(total), "max_score": mx, "hits": hits},
+    }
+
+
+def response_raw(view: PackedIndexView, index_name: str, srow: np.ndarray,
+                 drow: np.ndarray, total: int, *, n_shards: int, took: int,
+                 from_: int, size: int) -> str:
+    """Assemble one `_source: false` response as raw JSON text with
+    vectorized numpy string ops — no per-hit Python objects."""
+    sl = srow[from_:from_ + size]
+    dl = drow[from_:from_ + size]
+    n = int((sl > -np.inf).sum())
+    if n:
+        ids = view.ids_packed[dl[:n]]
+        ss = np.char.mod("%.6g", sl[:n].astype(np.float64))
+        prefix = ('{"_index":"' + index_name + '","_type":"'
+                  + (view.single_type or "_doc") + '","_id":"')
+        parts = np.char.add(np.char.add(np.char.add(prefix, ids),
+                                        '","_score":'), ss)
+        hits_str = ',"_source":{}},'.join(parts.tolist()) + ',"_source":{}}'
+    else:
+        hits_str = ""
+    mx = "%.6g" % float(srow[0]) \
+        if srow.size and srow[0] > -np.inf else "null"
+    return ('{"took":%d,"timed_out":false,"_shards":{"total":%d,'
+            '"successful":%d,"failed":0},"hits":{"total":%d,"max_score":%s,'
+            '"hits":[%s]}}' % (took, n_shards, n_shards, int(total), mx,
+                               hits_str))

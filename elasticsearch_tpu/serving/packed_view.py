@@ -1,0 +1,360 @@
+"""PackedIndexView: all shards' segments of one index fused into a single
+device-resident postings structure, served by ops/bm25_sparse.bm25_serve_packed
+in ONE device program per request batch.
+
+Why (measured, see BASELINE.md): this TPU sits behind a tunnel with
+~20-115 ms round-trip latency per host<->device interaction. The round-2
+serving path ran one kernel per segment and fetched three result arrays per
+kernel — ~6+ round trips per request, so the product was slower than its own
+XLA-CPU proxy despite a 94x kernel. This view makes the whole request cost:
+
+    1 H2D (packed i32 slot table) + 1 program + 1 D2H (packed i32 results)
+
+It is the TPU analog of the reference's per-shard search fan-out collapsing
+into a single batched program: the scatter-gather of
+search/action/SearchServiceTransportAction.java becomes tensor concatenation,
+and SearchPhaseController.sortDocs's cross-shard merge becomes the kernel's
+global top-k (the doc space is packed across shards, so the top-k IS the
+reduce). Term statistics are naturally index-global — equivalent to running
+the DFS phase (search/dfs/DfsPhase.java:57-81) on every request, which is
+*better* scoring parity than per-shard IDF.
+
+The view is immutable w.r.t. the segment set; deletes only refresh the packed
+liveness row (Segment.live_gen tracks that). IndexService caches the view
+keyed by segment set and rebuilds liveness on tombstone changes.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..index.segment import Segment, next_pow2
+from ..ops.bm25_sparse import bm25_serve_packed
+
+# Fixed postings chunk: compile-cache keys depend on (Q, S) pow2 buckets only,
+# never on the corpus' df distribution.
+CHUNK = 512
+
+_JSON_UNSAFE = re.compile(r'["\\\x00-\x1f]')
+
+
+@dataclass
+class PackedQuery:
+    """One query row of a packed batch (per-query knobs the kernel supports
+    without recompiling: term set, boost, operator/minimum_should_match, and
+    an additive constant applied host-side)."""
+    terms: list[str]
+    boost: float = 1.0
+    operator: str = "or"
+    msm: int = 1
+    const: float = 0.0
+
+
+class PackedField:
+    """One text field's postings packed across every segment of the index."""
+
+    def __init__(self, doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
+                 terms: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+                 sum_dl: float):
+        self.doc_ids = doc_ids          # i32[P_pad] device, PAD-padded
+        self.tf = tf                    # f32[P_pad]
+        self.dl = dl                    # f32[P_pad]
+        self.terms = terms              # U[V] sorted unique terms (host)
+        self.starts = starts            # i32[V, NSEG] packed-space starts
+        self.lens = lens                # i32[V, NSEG] per-segment df
+        self.df = lens.sum(axis=1)      # i64[V] global df
+        self.sum_dl = sum_dl
+
+    def term_ids(self, terms: list[str]) -> np.ndarray:
+        """Vectorized term lookup; -1 for absent terms."""
+        if not len(self.terms):
+            return np.full(len(terms), -1, np.int64)
+        q = np.asarray(terms)    # own U width — casting to the index's dtype
+                                 # would truncate long query terms into
+                                 # false matches
+        idx = np.searchsorted(self.terms, q)
+        idx_c = np.minimum(idx, len(self.terms) - 1)
+        found = self.terms[idx_c] == q
+        return np.where(found, idx_c, -1)
+
+
+class PackedIndexView:
+    """The fused serving structure for one index (all shards, all segments)."""
+
+    def __init__(self, segments: list[tuple[int, Segment]]):
+        """segments: (shard_idx, segment) in stable (shard, seg) order."""
+        self.entries = segments
+        sizes = np.array([s.n_pad for _, s in segments], np.int64)
+        self.bases = np.zeros(len(segments) + 1, np.int64)
+        np.cumsum(sizes, out=self.bases[1:])
+        self.n_total = int(self.bases[-1])
+        self.n_pad_total = next_pow2(self.n_total + 1, floor=8)
+        self.doc_count = sum(s.n_docs for _, s in segments)
+        self.pad_doc = self.n_total      # global PAD sentinel (never live)
+
+        # host columns for vectorized fetch: _id / _type per global doc id
+        max_id = max((max((len(i) for i in s.ids), default=1)
+                      for _, s in segments), default=1)
+        self.ids_packed = np.full(self.n_pad_total, "", dtype=f"U{max_id}")
+        types: set[str] = set()
+        for ei, (_, seg) in enumerate(segments):
+            if seg.n_docs:
+                self.ids_packed[self.bases[ei]:self.bases[ei] + seg.n_docs] = \
+                    seg.ids
+                types.update(seg.types)
+        self.single_type = types.pop() if len(types) == 1 else None
+        # raw-JSON hits need no escaping only if every id/type is clean;
+        # the "," separator is itself JSON-safe, so an id containing any
+        # unsafe char (incl. newline) is always caught. Mixed-type indexes
+        # use the dict lane (per-doc _type).
+        joined = ",".join(",".join(s.ids) for _, s in segments)
+        self.ids_json_safe = (self.single_type is not None
+                              and _JSON_UNSAFE.search(joined) is None
+                              and _JSON_UNSAFE.search(self.single_type)
+                              is None)
+
+        self._fields: dict[str, PackedField | None] = {}
+        self._live_key: tuple | None = None
+        self._live_dev: jax.Array | None = None
+        self.device_calls = 0           # serving counters (observability)
+        self.memory_bytes = 0
+
+    # -- liveness (rebuilt on tombstone changes only) ----------------------
+
+    def _live_gen_key(self) -> tuple:
+        return tuple(s.live_gen for _, s in self.entries)
+
+    @property
+    def live_dev(self) -> jax.Array:
+        key = self._live_gen_key()
+        if self._live_dev is None or key != self._live_key:
+            live = np.zeros(self.n_pad_total, bool)
+            for ei, (_, seg) in enumerate(self.entries):
+                live[self.bases[ei]:self.bases[ei] + seg.n_pad] = seg.live_host
+            live[self.n_total:] = False
+            self._live_dev = jnp.asarray(live)
+            self._live_key = key
+        return self._live_dev
+
+    # -- field packing (lazy, cached) --------------------------------------
+
+    def field(self, name: str) -> PackedField | None:
+        if name not in self._fields:
+            self._fields[name] = self._pack_field(name)
+            if self._fields[name] is not None:
+                # precompile the solo-latency shapes for this field's
+                # postings buckets so cold p99 is one compile, not many
+                # (persistent XLA cache makes this ~free after first run)
+                self.warmup(field=name)
+        return self._fields[name]
+
+    def _pack_field(self, name: str) -> PackedField | None:
+        per_seg = []                    # (entry_idx, fx, host doc_ids)
+        for ei, (_, seg) in enumerate(self.entries):
+            fx = seg.text.get(name)
+            if fx is None or seg.n_docs == 0:
+                continue
+            host_ids = fx.doc_ids_host
+            if host_ids is None:        # segment loaded without host mirror
+                host_ids = np.asarray(fx.doc_ids[:fx.n_postings])
+            per_seg.append((ei, fx, host_ids[:fx.n_postings]))
+        if not per_seg:
+            return None
+
+        total_p = sum(len(h) for _, _, h in per_seg)
+        p_pad = next_pow2(total_p + CHUNK, floor=CHUNK * 2)
+        doc_ids = np.full(p_pad, self.pad_doc, np.int32)
+        tf = np.zeros(p_pad, np.float32)
+        dl = np.ones(p_pad, np.float32)
+
+        # merged sorted term dict via per-segment searchsorted alignment
+        seg_term_arrays = [np.asarray(list(fx.terms), dtype="U")
+                           for _, fx, _ in per_seg]
+        all_terms = (np.unique(np.concatenate(seg_term_arrays))
+                     if seg_term_arrays else np.array([], "U1"))
+        V = len(all_terms)
+        nseg = len(per_seg)
+        starts = np.zeros((V, nseg), np.int32)
+        lens = np.zeros((V, nseg), np.int64)
+
+        off = 0
+        sum_dl = 0.0
+        for si, (ei, fx, host_ids) in enumerate(per_seg):
+            P = len(host_ids)
+            doc_ids[off:off + P] = host_ids + int(self.bases[ei])
+            tf[off:off + P] = np.asarray(fx.tf[:P])
+            dl[off:off + P] = np.asarray(fx.dl[:P])
+            st = seg_term_arrays[si]
+            pos = np.searchsorted(all_terms, st)
+            starts[pos, si] = fx.term_starts[:len(st)] + off
+            lens[pos, si] = fx.term_lens[:len(st)]
+            sum_dl += fx.sum_dl
+            off += P
+
+        self.memory_bytes += p_pad * 12
+        return PackedField(
+            doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
+            dl=jnp.asarray(dl), terms=all_terms, starts=starts,
+            lens=lens.astype(np.int64), sum_dl=sum_dl)
+
+    # -- stats (parity with query_dsl.CollectionStats) ---------------------
+
+    def avgdl(self, field: str) -> float:
+        pf = self.field(field)
+        sum_dl = pf.sum_dl if pf is not None else 0.0
+        return max(sum_dl, 1.0) / max(self.doc_count, 1)
+
+    # -- batch execution ---------------------------------------------------
+
+    def search(self, field: str, queries: list[PackedQuery], *, k: int,
+               k1: float = 1.2, b: float = 0.75):
+        """Run the whole batch in one device program.
+
+        Returns (scores f32[Q,k] (-inf = empty), docs i64[Q,k] global packed
+        doc ids, hits i64[Q]). Q is the REAL query count (pad rows stripped).
+        """
+        Q = len(queries)
+        pf = self.field(field)
+        if pf is None or self.n_total == 0:
+            return (np.full((Q, k), -np.inf, np.float32),
+                    np.full((Q, k), -1, np.int64), np.zeros(Q, np.int64))
+
+        packed_q, S, R = self._build_slots(pf, queries, field, k1, b)
+        k_pad = next_pow2(k, floor=8)
+        out = bm25_serve_packed(
+            packed_q, pf.doc_ids, pf.tf, pf.dl, self.live_dev,
+            jnp.int32(self.pad_doc), jnp.float32(k1), jnp.float32(b),
+            jnp.float32(self.avgdl(field)), jnp.float32(0.0),
+            S=S, CHUNK=CHUNK, R=R, k=k_pad)
+        self.device_calls += 1
+        arr = np.asarray(out)            # the ONE D2H transfer
+        arr = arr[:Q]
+        scores = np.ascontiguousarray(arr[:, :k_pad]).view(np.float32)[:, :k]
+        docs = arr[:, k_pad:2 * k_pad][:, :k].astype(np.int64)
+        hits = arr[:, 2 * k_pad].astype(np.int64)
+        consts = np.array([q.const for q in queries], np.float32)
+        if consts.any():
+            scores = np.where(scores > -np.inf,
+                              scores + consts[:, None], scores)
+        docs = np.where(scores > -np.inf, docs, -1)
+        return scores, docs, hits
+
+    def _build_slots(self, pf: PackedField, queries: list[PackedQuery],
+                     field: str, k1: float, b: float):
+        """Vectorized slot-table construction: terms -> fixed-CHUNK postings
+        slots scattered into the packed i32[Q_pad, 3S+1] upload."""
+        Q = len(queries)
+        Q_pad = next_pow2(Q, floor=1)
+        nseg = pf.starts.shape[1]
+
+        qi_l: list[int] = []
+        tid_l: list[int] = []
+        w_l: list[float] = []
+        min_match = np.ones(Q_pad, np.int32)
+        max_terms = 1
+        N = max(self.doc_count, 1)
+        for qi, q in enumerate(queries):
+            tids = pf.term_ids(q.terms) if q.terms else np.empty(0, np.int64)
+            n_terms = len(q.terms)
+            max_terms = max(max_terms, n_terms)
+            if q.operator == "and":
+                min_match[qi] = max(n_terms, 1)
+            else:
+                min_match[qi] = max(q.msm, 1)
+            for t, tid in zip(q.terms, tids):
+                if tid < 0:
+                    continue
+                df = int(pf.df[tid])
+                idf = math.log(1 + (N - df + 0.5) / (df + 0.5))
+                qi_l.append(qi)
+                tid_l.append(int(tid))
+                w_l.append(idf * (k1 + 1) * q.boost)
+
+        # R floor matches warmup()'s shapes: two extra rolls cost ~nothing,
+        # one avoided compile shape saves seconds of cold p99
+        R = next_pow2(max_terms, floor=4)
+        if not qi_l:
+            S = 4
+            packed = np.zeros((Q_pad, 3 * S + 1), np.int32)
+            packed[:, 3 * S] = min_match
+            return jnp.asarray(packed), S, R
+
+        qi_a = np.asarray(qi_l, np.int64)
+        tid_a = np.asarray(tid_l, np.int64)
+        w_a = np.asarray(w_l, np.float32)
+
+        # expand (query, term) -> (query, term, segment), drop empty slices
+        lens_e = pf.lens[tid_a]                       # [E, NSEG]
+        starts_e = pf.starts[tid_a]                   # [E, NSEG]
+        qf = np.repeat(qi_a, nseg)
+        lf = lens_e.reshape(-1)
+        sf = starts_e.reshape(-1)
+        wf = np.repeat(w_a, nseg)
+        nz = lf > 0
+        qf, lf, sf, wf = qf[nz], lf[nz], sf[nz], wf[nz]
+
+        # expand each slice into ceil(len/CHUNK) fixed-size chunks
+        nch = -(-lf // CHUNK)
+        row = np.repeat(np.arange(len(lf)), nch)
+        within = np.arange(len(row)) - np.repeat(
+            np.concatenate([[0], np.cumsum(nch)[:-1]]), nch)
+        slot_q = qf[row]
+        slot_start = (sf[row] + within * CHUNK).astype(np.int32)
+        slot_len = np.minimum(CHUNK, lf[row] - within * CHUNK).astype(np.int32)
+        slot_w = wf[row]
+
+        # per-query slot positions (row-major scatter); input is built in
+        # ascending qi order, so a stable cumcount is just arange - group start
+        counts = np.bincount(slot_q, minlength=Q_pad)
+        group_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        pos = np.arange(len(slot_q)) - group_start[slot_q]
+
+        # small (latency-bound) batches get a high S floor so nearly every
+        # solo query lands on ONE warm compile shape; large (throughput-
+        # bound) batches size S tightly — their shape amortizes over the
+        # batch and the first msearch warms it
+        S = next_pow2(int(counts.max()), floor=32 if Q_pad <= 16 else 4)
+        packed = np.zeros((Q_pad, 3 * S + 1), np.int32)
+        packed[slot_q, pos] = slot_start
+        packed[slot_q, S + pos] = slot_len
+        packed[slot_q, 2 * S + pos] = slot_w.view(np.int32)
+        packed[:, 3 * S] = min_match
+        return jnp.asarray(packed), S, R
+
+    # -- host-side doc resolution ------------------------------------------
+
+    def resolve(self, docs: np.ndarray):
+        """global doc ids -> (entry_idx, local) via the base table."""
+        ei = np.searchsorted(self.bases, docs, side="right") - 1
+        ei = np.clip(ei, 0, len(self.entries) - 1)
+        local = docs - self.bases[ei]
+        return ei, local
+
+    def source_of(self, doc: int):
+        ei = int(np.searchsorted(self.bases, doc, side="right") - 1)
+        seg = self.entries[ei][1]
+        local = int(doc - self.bases[ei])
+        return seg.stored[local], seg.types[local], seg.ids[local]
+
+    def warmup(self, field: str, shapes=((1, 32, 16), (1, 64, 16))) -> None:
+        """Precompile the solo-latency (Q=1) shapes so first queries don't
+        eat a multi-second XLA compile (p99 guard; the S floor in
+        _build_slots steers solo queries onto exactly these buckets, and the
+        persistent cache makes this a one-time cost per machine)."""
+        pf = self._fields.get(field)
+        if pf is None:
+            return
+        for (q, s, k) in shapes:
+            packed = np.zeros((q, 3 * s + 1), np.int32)
+            packed[:, 3 * s] = 1
+            bm25_serve_packed(
+                jnp.asarray(packed), pf.doc_ids, pf.tf, pf.dl,
+                self.live_dev, jnp.int32(self.pad_doc), jnp.float32(1.2),
+                jnp.float32(0.75), jnp.float32(1.0), jnp.float32(0.0),
+                S=s, CHUNK=CHUNK, R=4, k=k)

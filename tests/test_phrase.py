@@ -149,3 +149,122 @@ class TestPhraseIntegration:
             ["grep", "-rn", "phrase_text", "elasticsearch_tpu/"],
             capture_output=True, text=True, cwd="/root/repo")
         assert out.stdout == ""
+
+
+class TestSloppyTransposition:
+    """Advisor r2 medium: negative adjusted positions floor-divided into
+    doc-1, so transposed matches ("b a" vs phrase "a b") never matched at
+    any slop. Lucene's SloppyPhraseScorer matches a transposition at
+    slop >= 2."""
+
+    def test_transposed_two_terms(self):
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=1)
+        b.add(mapper.parse({"body": "b a c"}, doc_id="1"), "_doc")
+        b.add(mapper.parse({"body": "a b c"}, doc_id="2"), "_doc")
+        s = ShardSearcher(0, [b.build()], ms)
+        q = lambda slop: hits_for(s, {"match_phrase": {
+            "body": {"query": "a b", "slop": slop}}})
+        assert q(0) == ["2"]
+        assert q(1) == ["2"]          # transposition costs 2
+        assert q(2) == ["1", "2"]
+
+    def test_first_position_occurrence(self):
+        """Term at doc position 0 with query offset 1 — the adjusted
+        position is negative; the doc must still match."""
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=1)
+        b.add(mapper.parse({"body": "bright apple pie"}, doc_id="1"), "_doc")
+        s = ShardSearcher(0, [b.build()], ms)
+        hits = hits_for(s, {"match_phrase": {
+            "body": {"query": "apple bright", "slop": 2}}})
+        assert hits == ["1"]
+
+    def test_randomized_parity_vs_bruteforce(self):
+        """Sloppy matching must agree with a brute-force minimal-window
+        check over raw positions (the semantics Lucene's SloppyPhraseScorer
+        approximates)."""
+        import itertools
+        import random
+
+        rng = random.Random(7)
+        vocab = list("abcdef")
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=1)
+        texts = {}
+        for i in range(40):
+            words = [rng.choice(vocab) for _ in range(rng.randint(2, 10))]
+            texts[str(i)] = words
+            b.add(mapper.parse({"body": " ".join(words)}, doc_id=str(i)),
+                  "_doc")
+        s = ShardSearcher(0, [b.build()], ms)
+
+        def brute(query, slop):
+            out = []
+            for doc_id, words in texts.items():
+                pos = {t: [p for p, w in enumerate(words) if w == t]
+                       for t in set(query)}
+                if any(not pos[t] for t in query):
+                    continue
+                best = None
+                for combo in itertools.product(
+                        *[pos[t] for t in query]):
+                    adj = [p - i for i, p in enumerate(combo)]
+                    span = max(adj) - min(adj)
+                    best = span if best is None else min(best, span)
+                if best is not None and best <= slop:
+                    out.append(doc_id)
+            return sorted(out)
+
+        def all_hits(body):
+            res = s.execute_query_phase(s.parse([body]), size=50)
+            keys = [int(k) for k in res.doc_keys[0] if k >= 0]
+            return sorted(h.doc_id
+                          for h in s.execute_fetch_phase(keys))
+
+        for _ in range(25):
+            q = [rng.choice(vocab) for _ in range(rng.randint(2, 3))]
+            slop = rng.randint(0, 4)
+            got = all_hits({"match_phrase": {
+                "body": {"query": " ".join(q), "slop": slop}}})
+            assert got == brute(q, slop), (q, slop)
+
+
+class TestPhrasePrefixAbsentField:
+    """Advisor r2 medium: single-term match_phrase_prefix on a segment
+    without the field matched ALL docs (None mask + no score terms)."""
+
+    def test_absent_field_matches_nothing(self):
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=1)
+        b.add(mapper.parse({"other": "hello world"}, doc_id="1"), "_doc")
+        b.add(mapper.parse({"body": "quick fox"}, doc_id="2"), "_doc")
+        s = ShardSearcher(0, [b.build()], ms)
+        assert hits_for(s, {"match_phrase_prefix": {"missing": "qui"}}) == []
+        assert hits_for(s, {"match_phrase_prefix": {"body": "qui"}}) == ["2"]
+
+    def test_mixed_segments(self):
+        """One segment has the field, one doesn't — only the real match."""
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b1 = SegmentBuilder(seg_id=1)
+        b1.add(mapper.parse({"body": "quick fox"}, doc_id="1"), "_doc")
+        b2 = SegmentBuilder(seg_id=2)
+        b2.add(mapper.parse({"other": "nothing here"}, doc_id="2"), "_doc")
+        s = ShardSearcher(0, [b1.build(), b2.build()], ms)
+        assert hits_for(s, {"match_phrase_prefix": {"body": "qui"}}) == ["1"]
+
+
+class TestPositionLimit:
+    def test_overlong_doc_rejected(self):
+        from elasticsearch_tpu.index.segment import _MAX_DOC_POSITIONS
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=1)
+        huge = " ".join("w" for _ in range(_MAX_DOC_POSITIONS + 1))
+        with pytest.raises(ValueError, match="tokens"):
+            b.add(mapper.parse({"body": huge}, doc_id="1"), "_doc")

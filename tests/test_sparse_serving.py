@@ -149,6 +149,13 @@ class TestNodeServesSparse:
         out = node.search("idx", {"query": {"match": {"title": "quick fox"}}})
         assert out["hits"]["total"] == 4
         stats = node.indices["idx"].search_stats
+        # round 3: plain match now takes the one-program packed lane;
+        # filtered shapes still take the per-segment sparse kernel — the
+        # dense scatter-add never serves either
+        assert stats["packed"] > 0 and stats.get("dense", 0) == 0
+        node.search("idx", {"query": {"bool": {
+            "must": [{"match": {"title": "fox"}}],
+            "filter": [{"term": {"tag": "b"}}]}}})
         assert stats["sparse"] > 0 and stats.get("dense", 0) == 0
         # scores descend and the best doc leads
         scores = [h["_score"] for h in out["hits"]["hits"]]

@@ -1,0 +1,19 @@
+"""Multi-node cluster layer: transport seam, versioned cluster state with a
+single-writer master, state publish, replicated writes, peer recovery, and
+the in-process multi-node test harness (SURVEY.md §2.2/§2.3 — L1/L2)."""
+
+from .harness import TestCluster
+from .node import (ClusterNode, NoMasterException,
+                   UnavailableShardsException)
+from .service import ClusterService
+from .state import ClusterState, allocate, new_index_routing, remove_node
+from .transport import (ConnectTransportException, LocalTransport,
+                        RemoteTransportException, TransportService)
+
+__all__ = [
+    "TestCluster", "ClusterNode", "ClusterService", "ClusterState",
+    "LocalTransport", "TransportService", "ConnectTransportException",
+    "RemoteTransportException", "NoMasterException",
+    "UnavailableShardsException", "allocate", "new_index_routing",
+    "remove_node",
+]

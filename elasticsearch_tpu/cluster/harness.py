@@ -1,0 +1,112 @@
+"""TestCluster: N full nodes in one process, on one LocalTransport.
+
+The analog of the reference's InternalTestCluster
+(/root/reference/src/test/java/org/elasticsearch/test/InternalTestCluster.java:135
+— multiple complete Node instances in one JVM, with helpers like
+ensureGreen(), node kill/restart, and transport-level fault injection).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .node import ClusterNode
+from .transport import LocalTransport
+
+
+class TestCluster:
+    __test__ = False        # not a pytest class, despite the name
+
+    def __init__(self, n_nodes: int, data_path: str,
+                 minimum_master_nodes: int | None = None):
+        if minimum_master_nodes is None:
+            minimum_master_nodes = n_nodes // 2 + 1
+        self.network = LocalTransport()
+        self.data_path = data_path
+        self.minimum_master_nodes = minimum_master_nodes
+        self.nodes: dict[str, ClusterNode] = {}
+        self._seq = 0
+        for _ in range(n_nodes):
+            self.add_node()
+        # min-id election (ref ElectMasterService sorted-node-id election)
+        ids = sorted(self.nodes)
+        master = self.nodes[ids[0]]
+        master.bootstrap_as_master()
+        for nid in ids[1:]:
+            self.nodes[nid].join(ids[0])
+
+    def add_node(self) -> ClusterNode:
+        self._seq += 1
+        node_id = f"node-{self._seq}"
+        node = ClusterNode(node_id, self.data_path, self.network,
+                           minimum_master_nodes=self.minimum_master_nodes)
+        self.nodes[node_id] = node
+        master = self.master_node()
+        if master is not None and master.node_id != node_id:
+            node.join(master.node_id)
+        return node
+
+    # -- membership helpers -------------------------------------------------
+
+    def master_node(self) -> ClusterNode | None:
+        for node in self.nodes.values():
+            st = node.cluster.current()
+            if st.master_node == node.node_id and not node.closed:
+                return node
+        return None
+
+    def client(self) -> ClusterNode:
+        """Any live node works as coordinator (ref node client)."""
+        for node in self.nodes.values():
+            if not node.closed:
+                return node
+        raise RuntimeError("no live nodes")
+
+    def node_holding_primary(self, index: str, shard: int) -> ClusterNode:
+        state = self.client().cluster.current()
+        primary = state.primary_of(index, shard)
+        return self.nodes[primary["node"]]
+
+    def kill_node(self, node_id: str) -> None:
+        """Abrupt process death: unregister from the network WITHOUT any
+        goodbye — peers discover via fault detection / failed sends."""
+        node = self.nodes[node_id]
+        node.closed = True
+        node.transport.close()
+        node.cluster.close()
+
+    def detect_once(self) -> None:
+        """One explicit fault-detection round on every live node."""
+        for node in list(self.nodes.values()):
+            if not node.closed:
+                node.fault_detection_round()
+
+    def ensure_green(self, timeout: float = 15.0) -> None:
+        self._ensure("green", timeout)
+
+    def ensure_yellow_or_green(self, timeout: float = 15.0) -> None:
+        self._ensure("yellow", timeout)
+
+    def _ensure(self, at_least: str, timeout: float) -> None:
+        ok = {"green"} if at_least == "green" else {"green", "yellow"}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            client = self.client()
+            h = client.health()
+            if h["status"] in ok and h["master_node"] is not None:
+                # every live node must have applied a state at this version
+                # or later with the same master
+                versions = [n.cluster.current().version
+                            for n in self.nodes.values() if not n.closed]
+                if min(versions) == max(versions):
+                    return
+            self.detect_once()
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"cluster not {at_least} within {timeout}s: "
+            f"{self.client().health()}")
+
+    def close(self) -> None:
+        for node in self.nodes.values():
+            if not node.closed:
+                node.close()

@@ -1,0 +1,855 @@
+"""ClusterNode: a full node — cluster membership, shard hosting, replicated
+writes, peer recovery, and the distributed search driver.
+
+Maps to several reference components at once (SURVEY.md §2.3/§2.5/§2.7):
+  * join/election/fault-report       — discovery/zen/ZenDiscovery.java:354,500
+  * reconciler (state → local shards) — indices/cluster/
+                                        IndicesClusterStateService.java:150
+  * replicated write                 — action/support/replication/
+                                        TransportShardReplicationOperationAction.java:67,118-120
+  * peer recovery (file phase)       — indices/recovery/RecoverySourceHandler.java:149-195
+  * search scatter-gather            — action/search/type/TransportSearchTypeAction.java:85-177
+
+Design notes (TPU-first deviations from the reference, on purpose):
+  * Replicas apply ops with external-version semantics: the primary assigns
+    the version, replicas accept any strictly-newer version and treat
+    version conflicts as "already applied" — this makes the
+    file-copy-then-forward recovery race idempotent without uid-locks.
+  * Recovery transfers the checksummed write-once segment files produced by
+    index/store.py (flush under the engine lock = the reference's brief
+    phase-3 write block), so a recovered replica loads tensors straight to
+    device with zero re-tokenization.
+  * Dynamic mappings derive deterministically on every copy (same doc ⇒ same
+    inferred mapping), so replicas don't block acks on a master mapping
+    round-trip; explicit put-mapping still flows through the master.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from ..index.engine import Engine, VersionConflictException
+from ..mapping.mapper import MapperService
+from ..parallel.routing import shard_id as route_shard
+from ..search.shard_searcher import ShardSearcher
+from .service import ClusterService
+from .state import (INITIALIZING, STARTED, UNASSIGNED, ClusterState, allocate,
+                    new_index_routing, remove_node)
+from .transport import (ConnectTransportException, LocalTransport,
+                        RemoteTransportException, TransportService)
+
+A_JOIN = "internal:discovery/zen/join"
+A_PING = "internal:discovery/zen/fd/ping"
+A_NODE_FAILED = "internal:discovery/zen/fd/node_failed"
+A_SHARD_STARTED = "internal:cluster/shard/started"
+A_SHARD_FAILED = "internal:cluster/shard/failed"
+A_CREATE_INDEX = "indices:admin/create"
+A_DELETE_INDEX = "indices:admin/delete"
+A_PUT_MAPPING = "indices:admin/mapping/put"
+A_REFRESH = "indices:admin/refresh"
+A_FLUSH = "indices:admin/flush"
+A_WRITE_P = "indices:data/write/op[p]"
+A_WRITE_R = "indices:data/write/op[r]"
+A_GET = "indices:data/read/get"
+A_QUERY = "indices:data/read/search[phase/query]"
+A_FETCH = "indices:data/read/search[phase/fetch/id]"
+A_RECOVERY = "internal:index/shard/recovery/files"
+
+
+class NoMasterException(Exception):
+    pass
+
+
+class UnavailableShardsException(Exception):
+    pass
+
+
+class _ShardHolder:
+    """One locally-hosted shard copy."""
+
+    def __init__(self):
+        self.engine: Engine | None = None
+        self.lock = threading.RLock()
+        self.recovering = False
+        self.pending: list[dict] = []     # ops buffered during recovery
+        self.searcher: tuple[tuple, ShardSearcher] | None = None
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, data_path: str, network: LocalTransport,
+                 minimum_master_nodes: int = 1):
+        self.node_id = node_id
+        self.data_path = os.path.join(data_path, node_id)
+        os.makedirs(self.data_path, exist_ok=True)
+        self.minimum_master_nodes = minimum_master_nodes
+        self.transport = TransportService(node_id, network)
+        self.cluster = ClusterService(node_id, self.transport,
+                                      self._apply_cluster_state)
+        self._shards: dict[tuple[str, int], _ShardHolder] = {}
+        self._mappers: dict[str, MapperService] = {}
+        self._shards_lock = threading.RLock()
+        self.closed = False
+        for action, handler in [
+                (A_JOIN, self._on_join), (A_PING, self._on_ping),
+                (A_NODE_FAILED, self._on_node_failed),
+                (A_SHARD_STARTED, self._on_shard_started),
+                (A_SHARD_FAILED, self._on_shard_failed),
+                (A_CREATE_INDEX, self._on_create_index),
+                (A_DELETE_INDEX, self._on_delete_index),
+                (A_PUT_MAPPING, self._on_put_mapping),
+                (A_REFRESH, self._on_refresh), (A_FLUSH, self._on_flush),
+                (A_WRITE_P, self._on_primary_write),
+                (A_WRITE_R, self._on_replica_write),
+                (A_GET, self._on_get), (A_QUERY, self._on_query),
+                (A_FETCH, self._on_fetch), (A_RECOVERY, self._on_recovery)]:
+            self.transport.register_handler(action, handler)
+
+    # ------------------------------------------------------------------
+    # membership / election (ref ZenDiscovery.java:354 innerJoinCluster)
+    # ------------------------------------------------------------------
+
+    def bootstrap_as_master(self) -> None:
+        """First node of a cluster: publish a state with self as master."""
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            st.data["master_node"] = self.node_id
+            st.nodes[self.node_id] = {"id": self.node_id,
+                                      "name": self.node_id}
+            return st
+        self.cluster.submit_task("bootstrap-master", task)
+
+    def join(self, master_id: str) -> None:
+        self.transport.send(master_id, A_JOIN, {"node": self.node_id})
+        # the publish that follows the join task delivers us the state
+        deadline = time.monotonic() + 10
+        while self.cluster.current().master_node is None:
+            if time.monotonic() > deadline:
+                raise NoMasterException(f"join to [{master_id}] not published")
+            time.sleep(0.01)
+
+    def _on_join(self, from_id: str, req: dict) -> dict:
+        joining = req["node"]
+
+        def task(cur: ClusterState) -> ClusterState | None:
+            if joining in cur.nodes:
+                return None
+            st = cur.mutate()
+            st.nodes[joining] = {"id": joining, "name": joining}
+            allocate(st)
+            return st
+        self.cluster.submit_task(f"node-join[{joining}]", task, wait=False)
+        return {"ok": True}
+
+    def _on_ping(self, from_id: str, req: Any) -> dict:
+        cur = self.cluster.current()
+        return {"node": self.node_id, "version": cur.version,
+                "master": cur.master_node}
+
+    # -- fault detection (ref discovery/zen/fd/, SURVEY §5.3) ----------
+
+    def fault_detection_round(self) -> None:
+        """On the master: ping everyone; below quorum STEP DOWN (the
+        ZenDiscovery.java:500-596 rejoin-on-quorum-loss guard), otherwise
+        drop the dead (NodesFaultDetection). On a non-master: ping the
+        master; if gone, elect (MasterFaultDetection + min-id election).
+        Masterless: discover a master via the seed list and rejoin, or
+        bootstrap an election if a quorum of seeds agrees there is none."""
+        state = self.cluster.current()
+        if state.master_node == self.node_id:
+            dead = []
+            for node_id in sorted(state.nodes):
+                if node_id == self.node_id:
+                    continue
+                try:
+                    self.transport.send(node_id, A_PING, {})
+                except (ConnectTransportException, RemoteTransportException):
+                    dead.append(node_id)
+            live_count = len(state.nodes) - len(dead)
+            if live_count < self.minimum_master_nodes:
+                self._step_down()
+                return
+            for node_id in dead:
+                self._remove_node(node_id)
+        elif state.master_node is not None:
+            try:
+                resp = self.transport.send(state.master_node, A_PING, {})
+                if resp.get("master") != state.master_node:
+                    # our master stepped down (quorum loss): detach and go
+                    # find whoever the majority elected
+                    self.cluster.reset()
+                    self._masterless_round()
+            except (ConnectTransportException, RemoteTransportException):
+                self._elect_after_master_loss(state)
+        else:
+            self._masterless_round()
+
+    def _step_down(self) -> None:
+        """Local-only demotion: no publish (we can't reach a quorum anyway).
+        The next masterless round rejoins whatever master the majority
+        elected — at which point the majority's state replaces ours and any
+        writes acked during our minority reign are discarded (the same
+        acked-write-loss window the reference documents for quorum loss)."""
+        def task(cur: ClusterState) -> None:
+            if cur.master_node != self.node_id:
+                return None
+            st = cur.mutate()
+            st.data["master_node"] = None
+            self.cluster.apply_local(st)
+            return None     # already applied; nothing to publish
+        self.cluster.submit_task("step-down[no quorum]", task, wait=False)
+
+    def _masterless_round(self) -> None:
+        """Find a live master through the seed list (the LocalTransport
+        registry doubles as the unicast ping seed list) and rejoin it; if
+        nobody has a master and we'd win a quorum election, take over."""
+        seeds = [n for n in self.transport.network.connected_nodes()
+                 if n != self.node_id]
+        live = [self.node_id]
+        masters: set[str] = set()
+        for node_id in seeds:
+            try:
+                resp = self.transport.send(node_id, A_PING, {})
+                live.append(node_id)
+                if resp.get("master"):
+                    masters.add(resp["master"])
+            except (ConnectTransportException, RemoteTransportException):
+                continue
+        for master_id in sorted(masters):
+            if master_id == self.node_id:
+                continue
+            try:
+                self.rejoin(master_id)
+                return
+            except (ConnectTransportException, RemoteTransportException,
+                    NoMasterException):
+                continue
+        if len(live) < self.minimum_master_nodes:
+            return
+        if min(live) == self.node_id:
+            def task(cur: ClusterState) -> ClusterState:
+                st = cur.mutate()
+                st.data["master_node"] = self.node_id
+                st.nodes[self.node_id] = {"id": self.node_id,
+                                          "name": self.node_id}
+                for node_id in list(st.nodes):
+                    if node_id not in live:
+                        remove_node(st, node_id)
+                return st
+            self.cluster.submit_task("become-master[bootstrap]", task)
+
+    def rejoin(self, master_id: str) -> None:
+        """Reset local cluster state and join `master_id` fresh — the path a
+        healed minority node takes back into the majority. The master's next
+        publish replaces our state wholesale; our reconciler then drops any
+        shards the majority no longer assigns to us."""
+        self.cluster.reset()
+        self.join(master_id)
+
+    def _elect_after_master_loss(self, state: ClusterState) -> None:
+        """Min-id election among reachable members, guarded by the
+        minimum_master_nodes quorum (ref ZenDiscovery.java:500-535 — losing
+        quorum means NO master, not a split brain)."""
+        dead_master = state.master_node
+        live = [self.node_id]
+        for node_id in sorted(state.nodes):
+            if node_id in (self.node_id, dead_master):
+                continue
+            try:
+                self.transport.send(node_id, A_PING, {})
+                live.append(node_id)
+            except (ConnectTransportException, RemoteTransportException):
+                pass
+        if len(live) < self.minimum_master_nodes:
+            return      # no quorum: stay masterless rather than split-brain
+        new_master = min(live)
+        if new_master != self.node_id:
+            return      # the winner will notice on its own round
+
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            st.data["master_node"] = self.node_id
+            if dead_master is not None:
+                remove_node(st, dead_master)
+            return st
+        self.cluster.submit_task("become-master", task)
+
+    def _remove_node(self, node_id: str) -> None:
+        def task(cur: ClusterState) -> ClusterState | None:
+            if node_id not in cur.nodes:
+                return None
+            st = cur.mutate()
+            remove_node(st, node_id)
+            return st
+        self.cluster.submit_task(f"node-left[{node_id}]", task, wait=False)
+
+    def _on_node_failed(self, from_id: str, req: dict) -> dict:
+        """A peer reports a node unreachable (the reference treats transport
+        disconnects as immediate failures, MasterFaultDetection.java:183-187).
+        Verify before acting — the reporter's link may be the broken one."""
+        node_id = req["node"]
+        try:
+            self.transport.send(node_id, A_PING, {})
+            return {"removed": False}
+        except (ConnectTransportException, RemoteTransportException):
+            self._remove_node(node_id)
+            return {"removed": True}
+
+    # ------------------------------------------------------------------
+    # master metadata ops (ref cluster/metadata/MetaData*Service)
+    # ------------------------------------------------------------------
+
+    def _master_call(self, action: str, payload: dict) -> Any:
+        state = self.cluster.current()
+        if state.master_node is None:
+            raise NoMasterException("no elected master")
+        if state.master_node == self.node_id:
+            return self.transport._handle(self.node_id, action, payload)
+        return self.transport.send(state.master_node, action, payload)
+
+    def create_index(self, name: str, settings: dict | None = None,
+                     mappings: dict | None = None) -> None:
+        self._master_call(A_CREATE_INDEX, {
+            "index": name, "settings": settings or {},
+            "mappings": mappings or {}})
+
+    def delete_index(self, name: str) -> None:
+        self._master_call(A_DELETE_INDEX, {"index": name})
+
+    def put_mapping(self, index: str, type_name: str, mapping: dict) -> None:
+        self._master_call(A_PUT_MAPPING, {
+            "index": index, "type": type_name, "mapping": mapping})
+
+    def _on_create_index(self, from_id: str, req: dict) -> dict:
+        name, settings = req["index"], req.get("settings") or {}
+        n_shards = int(settings.get("number_of_shards",
+                                    settings.get("index.number_of_shards", 1)))
+        n_replicas = int(settings.get(
+            "number_of_replicas", settings.get("index.number_of_replicas", 1)))
+
+        def task(cur: ClusterState) -> ClusterState:
+            if name in cur.indices:
+                raise ValueError(f"index [{name}] already exists")
+            st = cur.mutate()
+            st.indices[name] = {"settings": settings,
+                                "mappings": req.get("mappings") or {},
+                                "aliases": []}
+            st.routing[name] = new_index_routing(n_shards, n_replicas)
+            allocate(st)
+            return st
+        self.cluster.submit_task(f"create-index[{name}]", task)
+        return {"acknowledged": True}
+
+    def _on_delete_index(self, from_id: str, req: dict) -> dict:
+        name = req["index"]
+
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            st.indices.pop(name, None)
+            st.routing.pop(name, None)
+            return st
+        self.cluster.submit_task(f"delete-index[{name}]", task)
+        return {"acknowledged": True}
+
+    def _on_put_mapping(self, from_id: str, req: dict) -> dict:
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(req["index"])
+            if meta is None:
+                raise KeyError(f"no such index [{req['index']}]")
+            cur_map = meta.setdefault("mappings", {})
+            merged = MapperService(mappings=cur_map)
+            merged.merge(req["type"], req["mapping"])
+            meta["mappings"] = merged.mappings_dict()
+            return st
+        self.cluster.submit_task(f"put-mapping[{req['index']}]", task)
+        return {"acknowledged": True}
+
+    # ------------------------------------------------------------------
+    # reconciler (ref IndicesClusterStateService.clusterChanged :150)
+    # ------------------------------------------------------------------
+
+    def _apply_cluster_state(self, state: ClusterState) -> None:
+        with self._shards_lock:
+            # mappings from metadata
+            for index, meta in state.indices.items():
+                svc = self._mappers.get(index)
+                if svc is None:
+                    self._mappers[index] = MapperService(
+                        mappings=meta.get("mappings") or {})
+                else:
+                    for tname, m in (meta.get("mappings") or {}).items():
+                        svc.merge(tname, m)
+            # drop shards (and whole indices) no longer assigned here
+            # (ref indices/store/IndicesStore state-driven GC)
+            assigned = {(i, s) for i, s, _ in
+                        state.assigned_shards(self.node_id)}
+            for key in [k for k in self._shards
+                        if k not in assigned or k[0] not in state.indices]:
+                holder = self._shards.pop(key)
+                if holder.engine is not None:
+                    holder.engine.close()
+                import shutil
+                shutil.rmtree(self._shard_path(*key), ignore_errors=True)
+            for index in [i for i in self._mappers
+                          if i not in state.indices]:
+                del self._mappers[index]
+            todo = [(i, s, c) for i, s, c in
+                    state.assigned_shards(self.node_id)
+                    if c["state"] == INITIALIZING]
+        # recoveries run outside _shards_lock: they call into other nodes
+        for index, sid, copy_ in todo:
+            self._init_shard(state, index, sid, copy_)
+
+    def _shard_path(self, index: str, sid: int) -> str:
+        return os.path.join(self.data_path, "indices", index, str(sid))
+
+    def _init_shard(self, state: ClusterState, index: str, sid: int,
+                    copy_: dict) -> None:
+        key = (index, sid)
+        with self._shards_lock:
+            holder = self._shards.setdefault(key, _ShardHolder())
+        mappers = self._mappers[index]
+        if copy_["primary"]:
+            if holder.engine is None:
+                holder.engine = Engine(self._shard_path(index, sid), mappers)
+            # else: in-place promotion of a copy we already host
+            self._report_started(index, sid)
+            return
+        # replica: peer recovery from the started primary. An EXISTING local
+        # engine is stale by definition — this copy was unassigned (e.g.
+        # after a failed replication hop) and must re-sync from the primary,
+        # or it would come back STARTED while missing acked writes.
+        primary = state.primary_of(index, sid)
+        if primary is None or primary["state"] != STARTED:
+            return      # allocator shouldn't have scheduled this; wait
+        with holder.lock:
+            holder.recovering = True
+            if holder.engine is not None:
+                holder.engine.close()
+                holder.engine = None
+                holder.searcher = None
+        try:
+            files = self.transport.send(primary["node"], A_RECOVERY,
+                                        {"index": index, "shard": sid})
+        except (ConnectTransportException, RemoteTransportException):
+            with holder.lock:
+                holder.recovering = False
+            return      # primary vanished; a future state will retry
+        path = self._shard_path(index, sid)
+        # wipe any stale copy: leftover segment files are mere GC fodder,
+        # but a stale TRANSLOG would replay old ops over the recovered state
+        import shutil
+        shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+        for rel, blob in files["files"].items():
+            dst = os.path.join(path, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "wb") as f:
+                f.write(blob)
+        with holder.lock:
+            holder.engine = Engine(path, mappers)
+            for op in holder.pending:
+                self._apply_replica_op(holder, op)
+            holder.pending.clear()
+            holder.recovering = False
+        self._report_started(index, sid)
+
+    def _report_started(self, index: str, sid: int) -> None:
+        try:
+            self._master_call(A_SHARD_STARTED, {
+                "index": index, "shard": sid, "node": self.node_id})
+        except (NoMasterException, ConnectTransportException,
+                RemoteTransportException):
+            pass        # next publish/fault round sorts it out
+
+    def _on_shard_started(self, from_id: str, req: dict) -> dict:
+        index, sid, node_id = req["index"], req["shard"], req["node"]
+
+        def task(cur: ClusterState) -> ClusterState | None:
+            if index not in cur.routing:
+                return None
+            st = cur.mutate()
+            changed = False
+            for c in st.routing[index][sid]:
+                if c["node"] == node_id and c["state"] == INITIALIZING:
+                    c["state"] = STARTED
+                    c.pop("fresh", None)
+                    changed = True
+            if changed:
+                allocate(st)    # replicas may now be able to initialize
+                return st
+            return None
+        self.cluster.submit_task(
+            f"shard-started[{index}][{sid}]", task, wait=False)
+        return {"ok": True}
+
+    def _on_shard_failed(self, from_id: str, req: dict) -> dict:
+        index, sid, node_id = req["index"], req["shard"], req["node"]
+
+        def task(cur: ClusterState) -> ClusterState | None:
+            if index not in cur.routing:
+                return None
+            st = cur.mutate()
+            changed = False
+            for c in st.routing[index][sid]:
+                if c["node"] == node_id and not c["primary"]:
+                    c["node"] = None
+                    c["state"] = UNASSIGNED
+                    changed = True
+            if changed:
+                allocate(st)
+                return st
+            return None
+        self.cluster.submit_task(
+            f"shard-failed[{index}][{sid}][{node_id}]", task, wait=False)
+        return {"ok": True}
+
+    # -- recovery source (ref RecoverySourceHandler.java:149-195) -------
+
+    def _on_recovery(self, from_id: str, req: dict) -> dict:
+        """Phase 1+3 collapsed: flush under the engine write lock and ship
+        the store's checksummed files. The brief lock is the reference's
+        finalize-under-write-block; ops acked after the lock releases reach
+        the replica through normal forwarding (idempotent by version)."""
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None or holder.engine is None:
+            raise UnavailableShardsException(
+                f"not hosting [{req['index']}][{req['shard']}]")
+        eng = holder.engine
+        files: dict[str, bytes] = {}
+        with eng._lock:
+            eng.flush()
+            for fn in sorted(os.listdir(eng.path)):
+                fp = os.path.join(eng.path, fn)
+                if os.path.isfile(fp):
+                    with open(fp, "rb") as f:
+                        files[fn] = f.read()
+        return {"files": files}
+
+    # ------------------------------------------------------------------
+    # write path (ref TransportShardReplicationOperationAction.java:67)
+    # ------------------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: str | None, source: dict,
+                  type_name: str = "_doc", routing: str | None = None,
+                  **kw) -> dict:
+        if doc_id is None:
+            import uuid
+            doc_id = uuid.uuid4().hex[:20]
+        return self._write_op(index, {
+            "op": "index", "id": doc_id, "source": source, "type": type_name,
+            "routing": routing, **kw})
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: str | None = None, **kw) -> dict:
+        return self._write_op(index, {"op": "delete", "id": doc_id,
+                                      "routing": routing, **kw})
+
+    def _write_op(self, index: str, op: dict, timeout: float = 10.0) -> dict:
+        """Route to the primary, retrying on stale routing / primary
+        failover — the reference's retry-on-cluster-state-change loop."""
+        deadline = time.monotonic() + timeout
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            state = self.cluster.current()
+            meta = state.index_meta(index)
+            if meta is None:
+                self.create_index(index, {}, {})
+                continue
+            n_shards = len(state.routing[index])
+            sid = route_shard(op["id"], n_shards, op.get("routing"))
+            primary = state.primary_of(index, sid)
+            if primary is None or primary["state"] != STARTED:
+                time.sleep(0.02)
+                continue
+            payload = {**op, "index": index, "shard": sid}
+            try:
+                if primary["node"] == self.node_id:
+                    return self._on_primary_write(self.node_id, payload)
+                return self.transport.send(primary["node"], A_WRITE_P, payload)
+            except ConnectTransportException as e:
+                last_err = e
+                # transport disconnect == immediate failure report
+                try:
+                    self._master_call(A_NODE_FAILED,
+                                      {"node": primary["node"]})
+                except Exception:  # noqa: BLE001 — masterless interim
+                    pass
+                # the dead node may have BEEN the master: drive a detection
+                # round ourselves so an election can proceed (the reference
+                # couples this to transport disconnect events)
+                self.fault_detection_round()
+                time.sleep(0.02)
+            except RemoteTransportException as e:
+                if e.error_type == "VersionConflictException":
+                    raise VersionConflictException(op["id"], -1, -1) from e
+                raise
+        raise UnavailableShardsException(
+            f"[{index}] shard for [{op['id']}] not available: {last_err}")
+
+    def _on_primary_write(self, from_id: str, req: dict) -> dict:
+        index, sid = req["index"], req["shard"]
+        holder = self._shards.get((index, sid))
+        state = self.cluster.current()
+        primary = state.primary_of(index, sid)
+        if holder is None or holder.engine is None or primary is None \
+                or primary["node"] != self.node_id:
+            raise UnavailableShardsException(
+                f"[{index}][{sid}] primary not on [{self.node_id}]")
+        if req["op"] == "index":
+            res = holder.engine.index(
+                req["id"], req["source"], type_name=req.get("type", "_doc"),
+                version=req.get("version"),
+                version_type=req.get("version_type", "internal"),
+                op_type=req.get("op_type", "index"))
+        else:
+            res = holder.engine.delete(
+                req["id"], version=req.get("version"),
+                version_type=req.get("version_type", "internal"))
+        # sync replication fan-out (ref :118-120 — replicas ack before we do)
+        replica_req = {"index": index, "shard": sid, "op": req["op"],
+                       "id": req["id"], "source": req.get("source"),
+                       "type": req.get("type", "_doc"),
+                       "version": res.version}
+        for c in state.shard_copies(index, sid):
+            if c["primary"] or c["node"] in (None, self.node_id) \
+                    or c["state"] not in (STARTED, INITIALIZING):
+                continue
+            try:
+                self.transport.send(c["node"], A_WRITE_R, replica_req)
+            except (ConnectTransportException, RemoteTransportException):
+                # failed replica → master unassigns it (ref replica-failure
+                # notification); the write itself still succeeds
+                try:
+                    self._master_call(A_SHARD_FAILED, {
+                        "index": index, "shard": sid, "node": c["node"]})
+                except Exception:  # noqa: BLE001
+                    pass
+        return {"_index": index, "_id": res.doc_id, "_version": res.version,
+                "created": res.created, "found": res.found}
+
+    def _on_replica_write(self, from_id: str, req: dict) -> dict:
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None:
+            raise UnavailableShardsException(
+                f"replica [{req['index']}][{req['shard']}] not hosted")
+        with holder.lock:
+            if holder.recovering or holder.engine is None:
+                holder.pending.append(req)
+                return {"buffered": True}
+            self._apply_replica_op(holder, req)
+        return {"applied": True}
+
+    def _apply_replica_op(self, holder: _ShardHolder, req: dict) -> None:
+        """External-version apply: strictly-newer wins, equal/older is a
+        no-op (the op already arrived via recovery file copy)."""
+        try:
+            if req["op"] == "index":
+                holder.engine.index(req["id"], req["source"],
+                                    type_name=req.get("type", "_doc"),
+                                    version=req["version"],
+                                    version_type="external")
+            else:
+                holder.engine.delete(req["id"], version=req["version"],
+                                     version_type="external")
+        except VersionConflictException:
+            pass
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        state = self.cluster.current()
+        if index not in state.routing:
+            raise KeyError(f"no such index [{index}]")
+        sid = route_shard(doc_id, len(state.routing[index]), routing)
+        primary = state.primary_of(index, sid)
+        if primary is None or primary["state"] != STARTED:
+            raise UnavailableShardsException(f"[{index}][{sid}]")
+        payload = {"index": index, "shard": sid, "id": doc_id}
+        if primary["node"] == self.node_id:
+            return self._on_get(self.node_id, payload)
+        return self.transport.send(primary["node"], A_GET, payload)
+
+    def _on_get(self, from_id: str, req: dict) -> dict:
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None or holder.engine is None:
+            raise UnavailableShardsException(f"[{req['index']}]")
+        r = holder.engine.get(req["id"])
+        return {"found": r.found, "_id": req["id"],
+                "_version": r.version if r.found else None,
+                "_source": r.source if r.found else None}
+
+    # -- distributed search (QUERY_THEN_FETCH over the transport seam) --
+
+    def search(self, index: str, body: dict | None = None) -> dict:
+        t0 = time.perf_counter()
+        body = body or {}
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        state = self.cluster.current()
+        names = state.resolve_index(index)
+        if not names:
+            raise KeyError(f"no such index [{index}]")
+        # shard targets: prefer the local copy, else first started
+        targets: list[tuple[str, str, int]] = []   # (node, index, shard)
+        for name in names:
+            for sid in range(len(state.routing[name])):
+                copies = state.started_copies(name, sid)
+                if not copies:
+                    raise UnavailableShardsException(f"[{name}][{sid}]")
+                node = next((c["node"] for c in copies
+                             if c["node"] == self.node_id),
+                            copies[0]["node"])
+                targets.append((node, name, sid))
+        # phase 1: query — per-shard top-(from+size) ids and scores
+        per_shard: list[dict] = []
+        for node, name, sid in targets:
+            payload = {"index": name, "shard": sid, "body": body,
+                       "size": size + from_}
+            if node == self.node_id:
+                per_shard.append(self._on_query(self.node_id, payload))
+            else:
+                per_shard.append(self.transport.send(node, A_QUERY, payload))
+        # reduce (ref SearchPhaseController.sortDocs :147)
+        cands = []
+        total = 0
+        max_score = None
+        for ti, r in enumerate(per_shard):
+            total += r["total"]
+            if r["max_score"] is not None:
+                ms = float(r["max_score"])
+                if max_score is None or ms > max_score:
+                    max_score = ms
+            for h in r["hits"]:
+                cands.append((ti, h["id"], h["score"]))
+        cands.sort(key=lambda c: (-c[2], c[1]))
+        winners = cands[from_:from_ + size]
+        # phase 2: fetch — only from shards owning winners
+        by_target: dict[int, list[str]] = {}
+        for ti, doc_id, _ in winners:
+            by_target.setdefault(ti, []).append(doc_id)
+        sources: dict[tuple[int, str], dict | None] = {}
+        for ti, ids in by_target.items():
+            node, name, sid = targets[ti]
+            payload = {"index": name, "shard": sid, "ids": ids,
+                       "_source": body.get("_source", True)}
+            if node == self.node_id:
+                fr = self._on_fetch(self.node_id, payload)
+            else:
+                fr = self.transport.send(node, A_FETCH, payload)
+            for doc_id, src in zip(ids, fr["sources"]):
+                sources[(ti, doc_id)] = src
+        hits = [{"_index": targets[ti][1], "_id": doc_id,
+                 "_score": score, "_source": sources.get((ti, doc_id))}
+                for ti, doc_id, score in winners]
+        return {"took": int((time.perf_counter() - t0) * 1000),
+                "timed_out": False,
+                "_shards": {"total": len(targets),
+                            "successful": len(targets), "failed": 0},
+                "hits": {"total": total, "max_score": max_score,
+                         "hits": hits}}
+
+    def _searcher(self, index: str, sid: int,
+                  holder: _ShardHolder) -> ShardSearcher:
+        key = tuple(s.seg_id for s in holder.engine.segments)
+        if holder.searcher is None or holder.searcher[0] != key:
+            holder.searcher = (key, ShardSearcher(
+                sid, holder.engine.segments, self._mappers[index]))
+        return holder.searcher[1]
+
+    def _on_query(self, from_id: str, req: dict) -> dict:
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None or holder.engine is None:
+            raise UnavailableShardsException(
+                f"[{req['index']}][{req['shard']}]")
+        searcher = self._searcher(req["index"], req["shard"], holder)
+        body = req.get("body") or {}
+        node = searcher.parse([body.get("query") or {"match_all": {}}])
+        r = searcher.execute_query_phase(node, size=req["size"], from_=0)
+        hits = []
+        for pos in range(r.doc_keys.shape[1]):
+            key = int(r.doc_keys[0, pos])
+            if key < 0:
+                continue
+            seg = searcher.segments[key >> 32]
+            hits.append({"id": seg.ids[key & 0xFFFFFFFF],
+                         "score": float(r.scores[0, pos])})
+        mx = float(r.max_score[0])
+        return {"hits": hits, "total": int(r.total_hits[0]),
+                "max_score": None if mx != mx else mx}
+
+    def _on_fetch(self, from_id: str, req: dict) -> dict:
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None or holder.engine is None:
+            raise UnavailableShardsException(f"[{req['index']}]")
+        sources = []
+        for doc_id in req["ids"]:
+            r = holder.engine.get(doc_id, realtime=False)
+            src = r.source if r.found else None
+            if src is not None and req.get("_source") is False:
+                src = None
+            sources.append(src)
+        return {"sources": sources}
+
+    # ------------------------------------------------------------------
+    # broadcast admin (ref TransportBroadcastOperationAction)
+    # ------------------------------------------------------------------
+
+    def refresh(self, index: str = "_all") -> None:
+        self._broadcast(A_REFRESH, index)
+
+    def flush(self, index: str = "_all") -> None:
+        self._broadcast(A_FLUSH, index)
+
+    def _broadcast(self, action: str, index: str) -> None:
+        state = self.cluster.current()
+        nodes = {c["node"] for name in state.resolve_index(index)
+                 for copies in state.routing[name] for c in copies
+                 if c["node"] is not None and c["state"] != UNASSIGNED}
+        for node_id in sorted(nodes):
+            try:
+                if node_id == self.node_id:
+                    self.transport._handle(self.node_id, action,
+                                           {"index": index})
+                else:
+                    self.transport.send(node_id, action, {"index": index})
+            except (ConnectTransportException, RemoteTransportException):
+                continue
+
+    def _on_refresh(self, from_id: str, req: dict) -> dict:
+        names = self.cluster.current().resolve_index(req.get("index", "_all"))
+        for (index, sid), holder in list(self._shards.items()):
+            if index in names and holder.engine is not None:
+                holder.engine.refresh()
+        return {"ok": True}
+
+    def _on_flush(self, from_id: str, req: dict) -> dict:
+        names = self.cluster.current().resolve_index(req.get("index", "_all"))
+        for (index, sid), holder in list(self._shards.items()):
+            if index in names and holder.engine is not None:
+                holder.engine.flush()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        state = self.cluster.current()
+        return {"cluster_name": state.data["cluster_name"],
+                "master_node": state.master_node,
+                "version": state.version, **state.health()}
+
+    def close(self) -> None:
+        """Simulates process death when called abruptly (harness.kill)."""
+        self.closed = True
+        self.transport.close()
+        self.cluster.close()
+        with self._shards_lock:
+            for holder in self._shards.values():
+                if holder.engine is not None:
+                    holder.engine.close()

@@ -1,0 +1,140 @@
+"""ClusterService: the single-writer state machine + publish.
+
+Analog of the reference's InternalClusterService
+(/root/reference/src/main/java/org/elasticsearch/cluster/service/
+InternalClusterService.java:151 — ONE prioritized state thread serializes all
+mutations; submitStateUpdateTask :260-285; on master, publish-then-notify
+:463-464) and of the publish action
+(discovery/zen/publish/PublishClusterStateAction.java:86-98 — the full state
+goes to every node; receivers apply and ack).
+
+Tasks are plain functions `task(current: ClusterState) -> ClusterState|None`
+(None = no change). Publishing sends the whole serialized state over the
+transport seam to every other node; each node's apply callback runs its
+reconciler (node.py) before the publish returns — so a task's completion
+implies every reachable node has applied the state, the ack semantics of
+AckedClusterStateUpdateTask.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+from .state import ClusterState
+from .transport import ConnectTransportException, TransportService
+
+PUBLISH_ACTION = "internal:discovery/zen/publish"
+
+
+class ClusterService:
+    def __init__(self, node_id: str, transport: TransportService,
+                 apply_fn: Callable[[ClusterState], None]):
+        self.node_id = node_id
+        self.transport = transport
+        self._apply_fn = apply_fn
+        self.state = ClusterState.empty()
+        self._tasks: "queue.Queue[tuple]" = queue.Queue()
+        self._state_lock = threading.RLock()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"clusterState[{node_id}]", daemon=True)
+        self._thread.start()
+        transport.register_handler(PUBLISH_ACTION, self._on_publish)
+
+    # -- reads -------------------------------------------------------------
+
+    def current(self) -> ClusterState:
+        with self._state_lock:
+            return self.state
+
+    @property
+    def is_master(self) -> bool:
+        return self.current().master_node == self.node_id
+
+    # -- writes (master only) ----------------------------------------------
+
+    def submit_task(self, source: str,
+                    task: Callable[[ClusterState], ClusterState | None],
+                    wait: bool = True, timeout: float = 30.0) -> ClusterState:
+        """Enqueue a state-update task; with wait=True blocks until the task
+        ran AND the resulting state was published to every reachable node.
+        Must not be called with wait=True from the state thread itself."""
+        if wait and threading.current_thread() is self._thread:
+            raise RuntimeError("sync submit from the cluster-state thread")
+        done = threading.Event() if wait else None
+        box: dict[str, Any] = {}
+        self._tasks.put((source, task, done, box))
+        if not wait:
+            return self.current()
+        if not done.wait(timeout):
+            raise TimeoutError(f"cluster task [{source}] timed out")
+        if "error" in box:
+            raise box["error"]
+        return box["state"]
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            try:
+                source, task, done, box = self._tasks.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                new_state = task(self.current())
+                if new_state is not None:
+                    self._publish(new_state)
+                box["state"] = self.current()
+            except Exception as e:  # noqa: BLE001 — surface to submitter
+                box["error"] = e
+            finally:
+                if done is not None:
+                    done.set()
+
+    def _publish(self, new_state: ClusterState) -> None:
+        """Apply locally, then push the full state to every other node
+        (ref PublishClusterStateAction.java:86-98). Unreachable nodes are
+        skipped — fault detection removes them in a later task."""
+        self._apply_local(new_state)
+        for node_id in sorted(new_state.nodes):
+            if node_id == self.node_id:
+                continue
+            try:
+                self.transport.send(node_id, PUBLISH_ACTION, new_state.data)
+            except ConnectTransportException:
+                continue
+
+    def _apply_local(self, new_state: ClusterState) -> None:
+        with self._state_lock:
+            self.state = new_state
+        self._apply_fn(new_state)
+
+    def apply_local(self, new_state: ClusterState) -> None:
+        """Apply without publishing — the step-down path (we lost quorum and
+        can't reach anyone to publish to anyway)."""
+        self._apply_local(new_state)
+
+    def reset(self) -> None:
+        """Forget the applied state (rejoin path): with master_node back to
+        None, the next publish is accepted regardless of version — the
+        majority's history replaces ours wholesale."""
+        with self._state_lock:
+            self.state = ClusterState.empty()
+
+    # -- receive side ------------------------------------------------------
+
+    def _on_publish(self, from_id: str, data: dict) -> dict:
+        incoming = ClusterState(data)
+        with self._state_lock:
+            if incoming.version <= self.state.version and \
+                    self.state.master_node is not None:
+                # stale publish (e.g. a deposed master): reject, like
+                # ZenDiscovery.handleNewClusterStateFromMaster version guard
+                return {"applied": False, "version": self.state.version}
+            self.state = incoming
+        self._apply_fn(incoming)
+        return {"applied": True, "version": incoming.version}
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,232 @@
+"""Versioned cluster state: nodes, metadata, routing table + allocation.
+
+The analog of the reference's ClusterState/MetaData/RoutingTable
+(/root/reference/src/main/java/org/elasticsearch/cluster/ClusterState.java:61,
+119-131; cluster/metadata/MetaData.java; cluster/routing/RoutingTable.java with
+the ShardRouting state machine UNASSIGNED→INITIALIZING→STARTED) and of the
+allocator that places shards on nodes
+(cluster/routing/allocation/AllocationService.java +
+allocator/BalancedShardsAllocator.java — here a count-balanced assignment with
+the two invariant deciders that matter: never two copies of a shard on one
+node (SameShardAllocationDecider) and only live data nodes).
+
+The state is a plain JSON-safe dict wrapped in helpers — it crosses the
+transport seam on every publish, so it must serialize by construction. All
+mutation happens copy-on-write inside master state-update tasks (service.py);
+readers treat a ClusterState as immutable.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterator
+
+UNASSIGNED = "UNASSIGNED"
+INITIALIZING = "INITIALIZING"
+STARTED = "STARTED"
+
+
+class ClusterState:
+    """Immutable-by-convention snapshot. `data` layout:
+
+    {"version": int, "cluster_name": str, "master_node": str|None,
+     "nodes": {node_id: {"id", "name"}},
+     "metadata": {"indices": {name: {"settings", "mappings", "aliases"}},
+                  "templates": {...}},
+     "routing": {index: [[{"node": str|None, "primary": bool,
+                           "state": str}, ...copies], ...shards]}}
+    """
+
+    def __init__(self, data: dict):
+        self.data = data
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def empty(cluster_name: str = "elasticsearch-tpu") -> "ClusterState":
+        return ClusterState({
+            "version": 0, "cluster_name": cluster_name, "master_node": None,
+            "nodes": {}, "metadata": {"indices": {}, "templates": {}},
+            "routing": {}})
+
+    def mutate(self) -> "ClusterState":
+        """Deep-copied successor with version+1 — the only way new states are
+        born (ref ClusterState.Builder)."""
+        data = copy.deepcopy(self.data)
+        data["version"] = self.version + 1
+        return ClusterState(data)
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        return self.data["version"]
+
+    @property
+    def master_node(self) -> str | None:
+        return self.data["master_node"]
+
+    @property
+    def nodes(self) -> dict[str, dict]:
+        return self.data["nodes"]
+
+    @property
+    def indices(self) -> dict[str, dict]:
+        return self.data["metadata"]["indices"]
+
+    @property
+    def routing(self) -> dict[str, list[list[dict]]]:
+        return self.data["routing"]
+
+    def index_meta(self, index: str) -> dict | None:
+        return self.indices.get(index)
+
+    def resolve_index(self, expr: str) -> list[str]:
+        """name / alias / _all / comma list (wildcards via fnmatch)."""
+        import fnmatch
+        if expr in ("_all", "*", ""):
+            return sorted(self.indices)
+        out: list[str] = []
+        for part in expr.split(","):
+            if part in self.indices:
+                out.append(part)
+                continue
+            hit = [n for n, m in self.indices.items()
+                   if part in m.get("aliases", []) or fnmatch.fnmatch(n, part)]
+            out.extend(h for h in hit if h not in out)
+        return out
+
+    def shard_copies(self, index: str, shard: int) -> list[dict]:
+        return self.routing[index][shard]
+
+    def primary_of(self, index: str, shard: int) -> dict | None:
+        for copy_ in self.routing[index][shard]:
+            if copy_["primary"]:
+                return copy_
+        return None
+
+    def started_copies(self, index: str, shard: int) -> list[dict]:
+        return [c for c in self.routing[index][shard]
+                if c["state"] == STARTED and c["node"] is not None]
+
+    def assigned_shards(self, node_id: str) -> Iterator[tuple[str, int, dict]]:
+        for index, shards in self.routing.items():
+            for sid, copies in enumerate(shards):
+                for c in copies:
+                    if c["node"] == node_id:
+                        yield index, sid, c
+
+    def health(self) -> dict:
+        """green = all copies started; yellow = all primaries started;
+        red = some primary down (ref cluster/health/ClusterHealthStatus)."""
+        active_primary = active = init = unassigned = 0
+        red = yellow = False
+        for shards in self.routing.values():
+            for copies in shards:
+                primary_ok = False
+                for c in copies:
+                    if c["state"] == STARTED:
+                        active += 1
+                        if c["primary"]:
+                            primary_ok = True
+                            active_primary += 1
+                    elif c["state"] == INITIALIZING:
+                        init += 1
+                        yellow = True
+                    else:
+                        unassigned += 1
+                        yellow = True
+                if not primary_ok:
+                    red = True
+        return {
+            "status": "red" if red else ("yellow" if yellow else "green"),
+            "number_of_nodes": len(self.nodes),
+            "number_of_data_nodes": len(self.nodes),
+            "active_primary_shards": active_primary,
+            "active_shards": active,
+            "initializing_shards": init,
+            "unassigned_shards": unassigned,
+        }
+
+
+# -- allocation (ref AllocationService.reroute + BalancedShardsAllocator) ---
+
+def allocate(state: ClusterState) -> bool:
+    """Assign UNASSIGNED copies to live nodes, balancing by shard count.
+    Mutates `state` in place (call inside a mutate()d successor only).
+    Returns True if anything changed. Invariants: a node holds at most one
+    copy of a given shard (SameShardAllocationDecider analog); an unassigned
+    PRIMARY is only placed where it can recover (fresh index) — primaries of
+    lost shards stay unassigned (red) rather than silently reborn empty."""
+    live = set(state.nodes)
+    loads = {n: 0 for n in live}
+    for index, shards in state.routing.items():
+        for copies in shards:
+            for c in copies:
+                if c["node"] in loads and c["state"] != UNASSIGNED:
+                    loads[c["node"]] += 1
+    changed = False
+    for index, shards in state.routing.items():
+        for copies in shards:
+            holders = {c["node"] for c in copies
+                       if c["node"] is not None and c["state"] != UNASSIGNED}
+            has_started_primary = any(
+                c["primary"] and c["state"] == STARTED for c in copies)
+            for c in copies:
+                if c["state"] != UNASSIGNED:
+                    continue
+                # a replica can only initialize off a started primary
+                # (peer recovery needs a source); a fresh primary (never
+                # started anywhere, fresh==True) can start empty anywhere
+                if not c["primary"] and not has_started_primary:
+                    continue
+                if c["primary"] and not c.get("fresh", False):
+                    continue
+                candidates = sorted(
+                    (n for n in live if n not in holders),
+                    key=lambda n: (loads[n], n))
+                if not candidates:
+                    continue
+                node = candidates[0]
+                c["node"] = node
+                c["state"] = INITIALIZING
+                holders.add(node)
+                loads[node] += 1
+                changed = True
+    return changed
+
+
+def remove_node(state: ClusterState, node_id: str) -> None:
+    """Node-leave: drop it from nodes, promote replicas for its primaries,
+    unassign its replicas (ref AllocationService on node departure — the
+    elastic-recovery reaction in SURVEY.md §5.3)."""
+    state.nodes.pop(node_id, None)
+    for index, shards in state.routing.items():
+        for copies in shards:
+            lost_primary = False
+            for c in copies:
+                if c["node"] == node_id:
+                    if c["primary"]:
+                        lost_primary = True
+                    c["node"] = None
+                    c["state"] = UNASSIGNED
+                    c["primary"] = False
+                    c.pop("fresh", None)
+            if lost_primary:
+                # promote the first started replica (ref
+                # RoutingNodes.activePrimary promotion)
+                for c in copies:
+                    if c["state"] == STARTED:
+                        c["primary"] = True
+                        break
+    allocate(state)
+
+
+def new_index_routing(n_shards: int, n_replicas: int) -> list[list[dict]]:
+    """Fresh routing for a new index: primary marked `fresh` (may start
+    empty — there is nothing to recover), replicas recover from it."""
+    return [[{"node": None, "primary": True, "state": UNASSIGNED,
+              "fresh": True}]
+            + [{"node": None, "primary": False, "state": UNASSIGNED}
+               for _ in range(n_replicas)]
+            for _ in range(n_shards)]

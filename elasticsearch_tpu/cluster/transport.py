@@ -1,0 +1,203 @@
+"""Transport seam: named-action RPC between nodes.
+
+The analog of the reference's TransportService + LocalTransport
+(/root/reference/src/main/java/org/elasticsearch/transport/TransportService.java:60,
+252,317 — registerHandler(action, handler) / sendRequest(node, action, req);
+transport/local/LocalTransport.java — the in-process transport used by the
+test cluster, which still serializes every message so wire bugs surface).
+
+Every message crosses the seam as JSON bytes (bytes payloads wrapped in a
+base64 tag) — the AssertingLocalTransport discipline: a payload that cannot
+round-trip the wire format fails loudly in-process, exactly where a real
+DCN/gRPC transport would fail. Fault injection (disconnect/drop rules) lives
+here too, the MockTransportService analog
+(src/test/java/org/elasticsearch/test/transport/MockTransportService.java).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from typing import Any, Callable
+
+
+class TransportException(Exception):
+    pass
+
+
+class ConnectTransportException(TransportException):
+    """Target node unreachable (dead, disconnected, or rule-dropped)."""
+
+    def __init__(self, node_id: str, action: str = ""):
+        super().__init__(f"cannot connect to node [{node_id}]"
+                         + (f" for action [{action}]" if action else ""))
+        self.node_id = node_id
+
+
+class ActionNotFoundTransportException(TransportException):
+    pass
+
+
+class RemoteTransportException(TransportException):
+    """Handler on the remote node raised; carries the remote error type so
+    callers can branch on it (the reference serializes exceptions the same
+    way)."""
+
+    def __init__(self, node_id: str, action: str, error_type: str, message: str):
+        super().__init__(f"[{node_id}][{action}] {error_type}: {message}")
+        self.node_id = node_id
+        self.action = action
+        self.error_type = error_type
+        self.error_message = message
+
+
+_BYTES_TAG = "__b64__"
+_ESC_TAG = "__esc__"
+
+
+def _encode(obj: Any) -> Any:
+    """Make a payload JSON-safe; bytes become tagged base64 strings. User
+    dicts that happen to contain a tag key are escape-wrapped so document
+    content can never be mistaken for wire framing."""
+    if isinstance(obj, bytes):
+        return {_BYTES_TAG: base64.b64encode(obj).decode("ascii")}
+    if isinstance(obj, dict):
+        enc = {k: _encode(v) for k, v in obj.items()}
+        if _BYTES_TAG in obj or _ESC_TAG in obj:
+            return {_ESC_TAG: enc}
+        return enc
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if set(obj) == {_BYTES_TAG}:
+            return base64.b64decode(obj[_BYTES_TAG])
+        if set(obj) == {_ESC_TAG}:
+            return {k: _decode(v) for k, v in obj[_ESC_TAG].items()}
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def roundtrip(payload: Any) -> Any:
+    """Serialize + deserialize — the wire. Raises TypeError on content that
+    could never cross a real transport (live objects, arrays, ...)."""
+    return _decode(json.loads(json.dumps(_encode(payload))))
+
+
+class LocalTransport:
+    """The shared in-process 'network': a registry of node transports.
+
+    Doubles as the discovery seed list — `connected_nodes()` is what a zen
+    ping round would discover (ref discovery/zen/ping/unicast). Thread-safe;
+    handlers execute synchronously in the caller's thread (the reference's
+    LocalTransport hands off to a thread pool; synchronous execution keeps
+    tests deterministic and still exercises the full serialize boundary).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, "TransportService"] = {}
+        # fault-injection rules: (from_id|None, to_id) pairs that fail —
+        # None matches any sender (full isolation of to_id)
+        self._disconnected: set[tuple[str | None, str]] = set()
+        self.messages_sent = 0
+        self.bytes_sent = 0
+
+    def register(self, service: "TransportService") -> None:
+        with self._lock:
+            self._nodes[service.node_id] = service
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def connected_nodes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    # -- fault injection (MockTransportService analog) --------------------
+
+    def disconnect(self, node_id: str, from_id: str | None = None) -> None:
+        """Make node_id unreachable (from from_id, or from everyone)."""
+        with self._lock:
+            self._disconnected.add((from_id, node_id))
+
+    def reconnect(self, node_id: str, from_id: str | None = None) -> None:
+        with self._lock:
+            self._disconnected.discard((from_id, node_id))
+
+    def partition(self, side_a: list[str], side_b: list[str]) -> None:
+        """Two-way network partition between node groups
+        (ref test/disruption/NetworkPartition)."""
+        with self._lock:
+            for a in side_a:
+                for b in side_b:
+                    self._disconnected.add((a, b))
+                    self._disconnected.add((b, a))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._disconnected.clear()
+
+    # -- the wire ----------------------------------------------------------
+
+    def deliver(self, from_id: str, to_id: str, action: str,
+                payload: Any) -> Any:
+        with self._lock:
+            blocked = ((from_id, to_id) in self._disconnected
+                       or (None, to_id) in self._disconnected)
+            target = self._nodes.get(to_id)
+        if blocked or target is None:
+            raise ConnectTransportException(to_id, action)
+        wire = json.dumps(_encode(payload))
+        with self._lock:
+            self.messages_sent += 1
+            self.bytes_sent += len(wire)
+        request = _decode(json.loads(wire))
+        response = target._handle(from_id, action, request)
+        return roundtrip(response)
+
+
+class TransportService:
+    """Per-node RPC hub (ref TransportService.java:60). Actions are named
+    strings (e.g. "indices:data/write/index[p]"); local sends short-circuit
+    the registry but still round-trip the wire format."""
+
+    def __init__(self, node_id: str, network: LocalTransport):
+        self.node_id = node_id
+        self.network = network
+        self._handlers: dict[str, Callable[[str, Any], Any]] = {}
+        network.register(self)
+
+    def register_handler(self, action: str,
+                         handler: Callable[[str, Any], Any]) -> None:
+        """handler(from_node_id, request) -> response (JSON-safe)."""
+        self._handlers[action] = handler
+
+    def send(self, node_id: str, action: str, payload: Any = None) -> Any:
+        """Synchronous request/response. Raises ConnectTransportException if
+        the target is unreachable, RemoteTransportException if its handler
+        raised."""
+        return self.network.deliver(self.node_id, node_id, action, payload)
+
+    def _handle(self, from_id: str, action: str, request: Any) -> Any:
+        handler = self._handlers.get(action)
+        if handler is None:
+            raise ActionNotFoundTransportException(
+                f"no handler for [{action}] on [{self.node_id}]")
+        try:
+            return handler(from_id, request)
+        except TransportException:
+            raise
+        except Exception as e:  # noqa: BLE001 — serialize like a real wire
+            raise RemoteTransportException(
+                self.node_id, action, type(e).__name__, str(e)) from e
+
+    def close(self) -> None:
+        self.network.unregister(self.node_id)

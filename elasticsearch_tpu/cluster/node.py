@@ -557,7 +557,17 @@ class ClusterNode:
             state = self.cluster.current()
             meta = state.index_meta(index)
             if meta is None:
-                self.create_index(index, {}, {})
+                # auto-create may lose a race with a concurrent creator or
+                # hit a masterless interim — both just mean "retry the loop"
+                try:
+                    self.create_index(index, {}, {})
+                except NoMasterException as e:
+                    last_err = e
+                    time.sleep(0.02)
+                except Exception as e:  # noqa: BLE001
+                    if "already exists" not in str(e):
+                        raise
+                    last_err = e
                 continue
             n_shards = len(state.routing[index])
             sid = route_shard(op["id"], n_shards, op.get("routing"))
@@ -586,6 +596,13 @@ class ClusterNode:
             except RemoteTransportException as e:
                 if e.error_type == "VersionConflictException":
                     raise VersionConflictException(op["id"], -1, -1) from e
+                if e.error_type in ("UnavailableShardsException",
+                                    "NoMasterException"):
+                    # stale routing: the addressee no longer holds the
+                    # primary (demoted/relocated) — refresh state and retry
+                    last_err = e
+                    time.sleep(0.02)
+                    continue
                 raise
         raise UnavailableShardsException(
             f"[{index}] shard for [{op['id']}] not available: {last_err}")

@@ -268,10 +268,12 @@ class NodeService:
         from_ = int(body.get("from", 0) if from_ is None else from_)
         if scroll is not None:
             return self._scroll_start(index, body, size, scroll)
-        sort = _parse_sort(body.get("sort"))
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
+        from .search.sort import parse_sort
+        sort = parse_sort(body.get("sort"),
+                          [self.indices[n].mappers for n in names])
 
         # the packed fast path: one device program over every shard/segment
         # of the index (serving/packed_view) — the production serving lane
@@ -302,8 +304,8 @@ class NodeService:
             if rescore_spec else 0
 
         search_after = body.get("search_after")
-        if isinstance(search_after, list):
-            search_after = search_after[0] if search_after else None
+        if isinstance(search_after, list) and not search_after:
+            search_after = None
         if search_after is not None and sort is None:
             raise QueryParsingException("search_after requires a sort")
         if rescore_spec is not None and sort is not None:
@@ -348,7 +350,9 @@ class NodeService:
                 r = s.execute_query_phase(
                     node, size=max(size, window), from_=from_, sort=sort,
                     aggs=agg_specs if agg_specs else None,
-                    search_after=search_after)
+                    search_after=search_after,
+                    track_scores=bool(body.get("track_scores", False))
+                    if sort is not None else True)
             if rescore_spec is not None:
                 r = s.rescore(r, rescore_spec)
             results.append(r)
@@ -724,27 +728,6 @@ def _deep_merge(base: dict, patch: dict) -> dict:
         else:
             out[k] = v
     return out
-
-
-def _parse_sort(sort_spec) -> dict | None:
-    """Normalize the sort clause: "field", ["field"], [{"field": {"order":..}}].
-    _score sort (the default) -> None."""
-    if sort_spec is None:
-        return None
-    if isinstance(sort_spec, list):
-        if not sort_spec:
-            return None
-        sort_spec = sort_spec[0]   # primary key only (v1)
-    if isinstance(sort_spec, str):
-        if sort_spec == "_score":
-            return None
-        return {"field": sort_spec, "order": "asc"}
-    (field, params), = sort_spec.items()
-    if field == "_score":
-        return None
-    if isinstance(params, str):
-        return {"field": field, "order": params}
-    return {"field": field, **params}
 
 
 def _source_filter(src: dict, spec) -> dict | bool:

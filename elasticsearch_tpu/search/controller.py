@@ -16,6 +16,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from . import sort as sort_mod
 from .shard_searcher import QuerySearchResult, ShardSearcher, FetchedHit
 
 
@@ -25,17 +26,19 @@ class ReducedDocs:
     shard_order: list[int]          # shard id per result slot (len <= size)
     doc_keys: list[int]             # doc key per result slot
     scores: list[float]
-    sort_values: list[float] | None
+    sort_values: list[list] | None  # materialized per-key values per slot
     total_hits: int
     max_score: float
 
 
 def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
-              sort: dict | None = None, query_row: int = 0) -> ReducedDocs:
+              sort=None, query_row: int = 0) -> ReducedDocs:
     """Merge per-shard top-k into the global winner list
     (ref SearchPhaseController.sortDocs — TopDocs.merge semantics: score
     desc / sort-key asc, shard index breaks ties like the reference's
-    shard-ordinal tie-break)."""
+    shard-ordinal tie-break). Field sorts compare MATERIALIZED values
+    (strings/numbers), never ordinals — see search/sort.py."""
+    sort = sort_mod.normalize(sort)
     entries = []   # (primary_key, shard_idx, pos, doc_key, score, sort_val)
     total = 0
     max_score = float("-inf")
@@ -54,8 +57,8 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
                 primary = -score if not np.isnan(score) else float("inf")
                 sv = None
             else:
-                sv = float(r.sort_values[query_row][pos])
-                primary = sv if sort.get("order", "asc") == "asc" else -sv
+                sv = r.sort_values[query_row][pos]
+                primary = sort_mod.compare_key(sv, sort)
             entries.append((primary, si, pos, key, score, sv))
     entries.sort(key=lambda e: (e[0], e[1], e[2]))
     window = entries[from_: from_ + size]
@@ -81,7 +84,7 @@ def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
     for si, slots in by_shard.items():
         keys = [reduced.doc_keys[s] for s in slots]
         scores = np.asarray([reduced.scores[s] for s in slots], np.float32)
-        svs = np.asarray([reduced.sort_values[s] for s in slots]) \
+        svs = [reduced.sort_values[s] for s in slots] \
             if reduced.sort_values is not None else None
         fetched = searchers[si].execute_fetch_phase(keys, scores, svs)
         for slot, hit in zip(slots, fetched):
@@ -100,6 +103,6 @@ def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
             "_source": src,
         }
         if reduced.sort_values is not None:
-            entry["sort"] = [h.sort_value]
+            entry["sort"] = h.sort_value
         out.append(entry)
     return out

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..index.segment import Segment
 from ..mapping.mapper import MapperService
 from ..ops import topk as topk_ops
+from . import sort as sort_mod
 from .query_dsl import CollectionStats, Node, SegmentContext
 from .query_parser import QueryParser, merge_query_batch
 
@@ -39,7 +40,7 @@ class QuerySearchResult:
     shard_id: int
     doc_keys: np.ndarray          # i64 [Q, k]  (-1 = empty slot)
     scores: np.ndarray            # f32 [Q, k]
-    sort_values: np.ndarray | None  # f64 [Q, k] when sorting by field
+    sort_values: np.ndarray | None  # object [Q, k]: list of real values/None
     total_hits: np.ndarray        # i64 [Q]
     max_score: np.ndarray         # f32 [Q]
     aggs: list | None = None      # per-shard partial aggregations (search/aggs)
@@ -49,7 +50,7 @@ class QuerySearchResult:
 class FetchedHit:
     doc_key: int
     score: float
-    sort_value: float | None
+    sort_value: list | None       # materialized per-key sort values
     doc_id: str
     type_name: str
     source: dict
@@ -97,12 +98,16 @@ class ShardSearcher:
 
     def execute_query_phase(self, node: Node, *, size: int = 10,
                             from_: int = 0, n_queries: int = 1,
-                            sort: dict | None = None,
+                            sort=None,
                             global_stats: CollectionStats | None = None,
                             track_scores: bool = True,
                             aggs: list | None = None,
-                            search_after: float | None = None) -> QuerySearchResult:
+                            search_after=None) -> QuerySearchResult:
         """Run the batched query tree over all segments of this shard.
+
+        sort: list[SortSpec] (search/sort.py), a legacy single-key dict, or
+        None for score order. search_after: cursor values aligned with the
+        sort keys.
 
         aggs: parsed AggSpec list (search/aggs) — collected in the same pass
         as scoring using each segment's match mask, exactly the reference's
@@ -113,6 +118,13 @@ class ShardSearcher:
         """
         k = max(size + from_, 1)
         Q = n_queries
+        sort = sort_mod.normalize(sort)
+        if search_after is not None and not isinstance(search_after, (list, tuple)):
+            search_after = [search_after]
+        if sort is not None:
+            # sorting by _score tracks scores by definition
+            track_scores = track_scores or any(
+                sp.field == sort_mod.SCORE for sp in sort)
 
         if sort is None and aggs is None and search_after is None:
             # the production fast path: sort-reduce sparse kernel
@@ -138,7 +150,9 @@ class ShardSearcher:
 
         best_scores = np.full((Q, k), -np.inf, np.float32)
         best_keys = np.full((Q, k), -1, np.int64)
-        best_sort = np.full((Q, k), np.inf, np.float64) if sort else None
+        # sorted path: per-row candidate lists merged by MATERIALIZED value
+        # (sort.py module docstring — ordinals never cross a segment boundary)
+        cands: list[list] = [[] for _ in range(Q)] if sort else []
         total = np.zeros((Q,), np.int64)
         max_score = np.full((Q,), -np.inf, np.float32)
         agg_segments: list = []
@@ -154,7 +168,15 @@ class ShardSearcher:
                 agg_segments.append(seg)
                 agg_masks.append(np.asarray(match)[0])
             kk = min(k, seg.n_pad)
+            # totals/aggs reflect the full query match set — search_after
+            # narrows collection below, not the hit count (ref QueryPhase)
             total += np.asarray(topk_ops.count_matches(match))
+            if track_scores:
+                # mask out non-matching / tombstoned docs before the max —
+                # a deleted top doc must not leak its score into max_score
+                masked_sc = np.where(np.asarray(match), np.asarray(scores),
+                                     -np.inf)
+                max_score = np.maximum(max_score, masked_sc.max(axis=1))
             if sort is None:
                 top, idx = topk_ops.topk_scores(scores, match, k=kk)
                 top = np.asarray(top)
@@ -167,41 +189,47 @@ class ShardSearcher:
                 order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
                 best_scores = np.take_along_axis(merged, order, axis=1)
                 best_keys = np.take_along_axis(merged_keys, order, axis=1)
-                if track_scores:
-                    # mask out non-matching / tombstoned docs before the max —
-                    # a deleted top doc must not leak its score into max_score
-                    masked_sc = np.where(np.asarray(match), np.asarray(scores), -np.inf)
-                    max_score = np.maximum(max_score, masked_sc.max(axis=1))
             else:
-                key_arr = self._sort_keys(seg, sort, Q)     # f64 [Q, N], asc-ready
+                # device selection: lexicographic top-k over f64 comparator
+                # keys (keyword keys = this segment's sorted ordinals)
+                keys = sort_mod.segment_keys(seg, sort, scores, Q)
                 if search_after is not None:
-                    # cursor semantics (ref query/QueryPhase.java:117-131
-                    # searchAfter): only keys strictly after the cursor;
-                    # negate for desc to match _sort_keys' encoding
-                    sa = float(search_after)
-                    if sort.get("order", "asc") == "desc":
-                        sa = -sa
-                    match = match & (key_arr > sa)
-                masked = jnp.where(match, key_arr, jnp.inf)
-                # top_k of -key selects the smallest (ascending) sort keys
-                neg, idx = topk_ops.topk_scores(-masked, match, k=kk)
-                vals = -np.asarray(neg)
-                idx = np.asarray(idx)
-                sc = np.take_along_axis(np.asarray(scores), idx, axis=1)
-                seg_keys = np.where(np.isfinite(vals),
-                                    (np.int64(seg_idx) << SEG_SHIFT) | idx.astype(np.int64),
-                                    np.int64(-1))
-                merged_v = np.concatenate([best_sort, vals], axis=1)
-                merged_k = np.concatenate([best_keys, seg_keys], axis=1)
-                merged_s = np.concatenate([best_scores, sc.astype(np.float32)], axis=1)
-                order = np.argsort(merged_v, axis=1, kind="stable")[:, :k]
-                best_sort = np.take_along_axis(merged_v, order, axis=1)
-                best_keys = np.take_along_axis(merged_k, order, axis=1)
-                best_scores = np.take_along_axis(merged_s, order, axis=1)
+                    match = match & sort_mod.after_mask(
+                        seg, sort, search_after, keys)
+                primary = jnp.where(match, keys[0], jnp.inf)
+                doc_idx = jnp.broadcast_to(
+                    jnp.arange(seg.n_pad, dtype=jnp.float64)[None, :],
+                    primary.shape)
+                # lexsort: LAST key is the primary; doc index breaks ties
+                order = jnp.lexsort(
+                    tuple([doc_idx] + list(reversed(keys[1:])) + [primary]))
+                order = np.asarray(order)[:, :kk]
+                sel_match = np.take_along_axis(np.asarray(match), order, axis=1)
+                sel_scores = np.take_along_axis(np.asarray(scores), order, axis=1)
+                for qi in range(Q):
+                    for j in range(kk):
+                        if not sel_match[qi, j]:
+                            continue
+                        local = int(order[qi, j])
+                        dk = (seg_idx << SEG_SHIFT) | local
+                        sc = float(sel_scores[qi, j])
+                        vals = sort_mod.materialize(seg, sort, local, sc, dk)
+                        cands[qi].append(
+                            (sort_mod.compare_key(vals, sort),
+                             seg_idx, local, dk, sc, vals))
 
-        if sort is not None and sort.get("order", "asc") == "desc":
-            # keys were negated in _sort_keys; undo for reporting
-            best_sort = -best_sort
+        sort_vals = None
+        if sort is not None:
+            best_keys = np.full((Q, k), -1, np.int64)
+            best_scores = np.full((Q, k), np.nan, np.float32)
+            sort_vals = np.empty((Q, k), dtype=object)
+            for qi in range(Q):
+                cands[qi].sort(key=lambda c: (c[0], c[1], c[2]))
+                for slot, c in enumerate(cands[qi][:k]):
+                    best_keys[qi, slot] = c[3]
+                    if track_scores:
+                        best_scores[qi, slot] = c[4]
+                    sort_vals[qi, slot] = c[5]
         max_score = np.where(np.isfinite(max_score), max_score, np.nan)
         best_scores = np.where(best_keys >= 0, best_scores, np.nan)
         agg_partials = None
@@ -211,7 +239,7 @@ class ShardSearcher:
                                          query_parser=self.parser)
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
-            sort_values=best_sort, total_hits=total, max_score=max_score,
+            sort_values=sort_vals, total_hits=total, max_score=max_score,
             aggs=agg_partials)
 
     # -- kNN (exact, MXU matmul — ops/knn.py) ------------------------------
@@ -329,35 +357,11 @@ class ShardSearcher:
             max_score=np.where(np.isfinite(mx), mx, np.nan),
             aggs=result.aggs)
 
-    def _sort_keys(self, seg: Segment, sort: dict, Q: int):
-        """Build an ascending-comparable f64 key per doc for field sort
-        (ref search/sort/SortParseElement.java + fielddata comparators)."""
-        field = sort["field"]
-        order = sort.get("order", "asc")
-        missing = sort.get("missing", "_last")
-        nc = seg.numerics.get(field)
-        kc = seg.keywords.get(field)
-        if nc is not None:
-            vals = nc.vals.astype(jnp.float64)
-            miss = nc.missing
-        elif kc is not None:
-            vals = kc.ords.astype(jnp.float64)
-            miss = kc.ords < 0
-        else:
-            vals = jnp.zeros((seg.n_pad,), jnp.float64)
-            miss = jnp.ones((seg.n_pad,), bool)
-        if order == "desc":
-            vals = -vals
-        fill = jnp.float64(np.finfo(np.float64).max if missing == "_last"
-                           else -np.finfo(np.float64).max)
-        vals = jnp.where(miss, fill, vals)
-        return jnp.broadcast_to(vals[None, :], (Q, seg.n_pad))
-
     # -- fetch phase -------------------------------------------------------
 
     def execute_fetch_phase(self, doc_keys: Sequence[int],
                             scores: Sequence[float] | None = None,
-                            sort_values: Sequence[float] | None = None,
+                            sort_values: Sequence[list] | None = None,
                             source_filter=None) -> list[FetchedHit]:
         """Load stored fields for the reduced winners
         (ref search/fetch/FetchPhase.java:79)."""
@@ -372,10 +376,15 @@ class ShardSearcher:
             src = seg.stored[local]
             if source_filter:
                 src = _filter_source(src, source_filter)
+            sv = None
+            if sort_values is not None:
+                sv = sort_values[i]
+                if sv is not None and not isinstance(sv, list):
+                    sv = list(sv) if isinstance(sv, tuple) else [sv]
             hits.append(FetchedHit(
                 doc_key=key,
                 score=float(scores[i]) if scores is not None else float("nan"),
-                sort_value=float(sort_values[i]) if sort_values is not None else None,
+                sort_value=sv,
                 doc_id=seg.ids[local], type_name=seg.types[local], source=src))
         return hits
 

@@ -1,0 +1,321 @@
+"""Multi-key field sort: parsing, device sort-key construction, value
+materialization, and host comparators for the cross-segment / cross-shard
+reduce.
+
+Design (ref search/sort/SortParseElement.java:68, index/fielddata
+comparators): within a segment, docs are selected ON DEVICE by a
+lexicographic top-k over f64 comparator keys — keyword keys are the
+segment's lexicographically-sorted ordinals, so intra-segment order is
+exact. Across segments and shards ordinals are NOT comparable, so every
+merge step compares *materialized* values (the actual strings / numbers)
+instead: selection stays on device, the host k-way merge compares only
+k real values per shard, never ordinals. This is the "materialize at
+reduce time" strategy and is also what makes the `sort` array in the
+response carry real values.
+
+Sorting an analyzed text field is rejected with a 400, like the
+reference's "can't sort on analyzed fields" fielddata errors
+(ref index/fielddata/plain/PagedBytesIndexFieldData + SortParseElement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..mapping import mapper as m
+from .query_dsl import QueryParsingException
+
+SCORE = "_score"
+DOC = "_doc"
+
+# large-but-finite missing fill: +/-inf is reserved for "not a match"
+_BIG = float(np.finfo(np.float64).max) / 4
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    """One sort key (ref search/sort/FieldSortBuilder)."""
+    field: str                 # field path, "_score", or "_doc"
+    order: str = "asc"         # "asc" | "desc"
+    missing: Any = "_last"     # "_first" | "_last" | numeric literal
+    unmapped_ok: bool = False  # ignore_unmapped / unmapped_type given
+
+
+def parse_sort(sort_spec, mappers) -> list[SortSpec] | None:
+    """Normalize the ES sort clause into a list of SortSpec, validating each
+    field against the mapping. Returns None for the default score sort.
+
+    Accepts: "field" | {"field": "desc"} | {"field": {...params}} | a list
+    of any of those (ref search/sort/SortParseElement.java:68-121).
+    """
+    if sort_spec is None:
+        return None
+    items = sort_spec if isinstance(sort_spec, list) else [sort_spec]
+    specs: list[SortSpec] = []
+    for item in items:
+        if isinstance(item, str):
+            field, params = item, {}
+        elif isinstance(item, dict):
+            if len(item) != 1:
+                raise QueryParsingException(
+                    f"sort clause must have exactly one field: {item}")
+            (field, params), = item.items()
+            if isinstance(params, str):
+                params = {"order": params}
+            elif not isinstance(params, dict):
+                raise QueryParsingException(
+                    f"malformed sort parameters for [{field}]")
+        else:
+            raise QueryParsingException(f"malformed sort clause: {item!r}")
+        order = params.get("order", "desc" if field == SCORE else "asc")
+        if order not in ("asc", "desc"):
+            raise QueryParsingException(f"illegal sort order [{order}]")
+        missing = params.get("missing", "_last")
+        if isinstance(missing, str) and missing not in ("_first", "_last"):
+            # ES parses numeric-string missing values ("50") as numbers
+            try:
+                missing = float(missing)
+            except ValueError:
+                raise QueryParsingException(
+                    f"illegal missing value [{missing}] for [{field}]; "
+                    f"expected _first, _last, or a number") from None
+        unmapped_ok = bool(params.get("ignore_unmapped")) \
+            or "unmapped_type" in params
+        specs.append(SortSpec(field=field, order=order, missing=missing,
+                              unmapped_ok=unmapped_ok))
+    if not specs or (len(specs) == 1 and specs[0].field == SCORE
+                     and specs[0].order == "desc"):
+        return None  # the default: score descending
+    for sp in specs:
+        _validate(sp, mappers)
+    return specs
+
+
+def _validate(sp: SortSpec, mappers) -> None:
+    """mappers: one MapperService or a list of them (multi-index search).
+    A field mapped sortable in ANY index is allowed — other indices treat
+    it as missing, like the reference. Analyzed text anywhere is a 400."""
+    if sp.field in (SCORE, DOC) or mappers is None:
+        return
+    svcs = mappers if isinstance(mappers, (list, tuple)) else [mappers]
+    fts = [svc.field_type(sp.field) for svc in svcs if svc is not None]
+    for ft in fts:
+        if ft is None:
+            continue
+        if ft.type == m.TEXT:
+            raise QueryParsingException(
+                f"can't sort on analyzed text field [{sp.field}]; sort on a "
+                f"not-analyzed sub-field (e.g. [{sp.field}.keyword]) instead")
+        if ft.type in (m.DENSE_VECTOR, m.OBJECT, m.GEO_POINT):
+            raise QueryParsingException(
+                f"can't sort on field [{sp.field}] of type [{ft.type}]")
+    if all(ft is None for ft in fts) and not sp.unmapped_ok:
+        raise QueryParsingException(
+            f"No mapping found for [{sp.field}] in order to sort on")
+
+
+# ---------------------------------------------------------------------------
+# Device comparator keys (per segment)
+# ---------------------------------------------------------------------------
+
+def _raw_key(seg, sp: SortSpec, scores, Q: int):
+    """(vals f64 [Q,N] or [N], missing bool [N] or None) before order/fill."""
+    if sp.field == SCORE:
+        return scores.astype(jnp.float64), None
+    if sp.field == DOC:
+        return jnp.arange(seg.n_pad, dtype=jnp.float64), None
+    nc = seg.numerics.get(sp.field)
+    if nc is not None:
+        return nc.vals.astype(jnp.float64), nc.missing
+    kc = seg.keywords.get(sp.field)
+    if kc is not None:
+        return kc.ords.astype(jnp.float64), kc.ords < 0
+    return (jnp.zeros((seg.n_pad,), jnp.float64),
+            jnp.ones((seg.n_pad,), bool))
+
+
+def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int) -> list:
+    """Ascending-comparable f64 keys, one [Q, n_pad] array per sort key.
+
+    desc keys are negated; missing docs filled with +/-_BIG so _first/_last
+    placement survives the negation. _score is a valid sort key here because
+    the query phase always has per-doc scores in hand.
+    """
+    out = []
+    for sp in specs:
+        vals, miss = _raw_key(seg, sp, scores, Q)
+        if miss is not None and _is_number(sp.missing):
+            vals = jnp.where(miss, jnp.float64(float(sp.missing)), vals)
+            miss = None
+        if sp.order == "desc":
+            vals = -vals
+        if miss is not None:
+            fill = jnp.float64(_BIG if sp.missing == "_last" else -_BIG)
+            vals = jnp.where(miss, fill, vals)
+        if vals.ndim == 1:
+            vals = jnp.broadcast_to(vals[None, :], (Q, seg.n_pad))
+        out.append(vals)
+    return out
+
+
+def after_mask(seg, specs: Sequence[SortSpec], cursor: Sequence,
+               keys: list) -> Any:
+    """bool [Q, n_pad]: docs strictly after `cursor` in sort order
+    (ref search/searchafter semantics: resume exactly past the last hit).
+
+    `keys` are the arrays from segment_keys (desc already negated), so
+    "after" is simply lexicographically-greater on the encoded keys; the
+    cursor values get the same encoding. Keyword cursors map onto the
+    segment's ordinal space via binary search; values absent from the
+    segment land between ordinals (x.5) so strict comparison stays exact.
+    """
+    if len(cursor) != len(specs):
+        raise QueryParsingException(
+            f"search_after must have {len(specs)} values, one per sort key")
+    enc: list[float] = []
+    for sp, cv in zip(specs, cursor):
+        enc.append(_encode_cursor(seg, sp, cv))
+    after = jnp.zeros(keys[0].shape, bool)
+    for key_arr, c in zip(reversed(keys), reversed(enc)):
+        c = jnp.float64(c)
+        after = (key_arr > c) | ((key_arr == c) & after)
+    return after
+
+
+def _encode_cursor(seg, sp: SortSpec, cv) -> float:
+    """Map one user-facing cursor value into the same comparable f64 space
+    as segment_keys produced for this segment."""
+    if cv is None:
+        c = _BIG if sp.missing == "_last" else -_BIG
+        return c  # fills are sign-fixed, not order-negated
+    if sp.field not in (SCORE, DOC) and sp.field not in seg.numerics \
+            and sp.field not in seg.keywords:
+        # the segment has no column for this field: every doc's key here is
+        # the +/-_BIG missing fill, so any real cursor value compares as 0
+        # (strictly between the fills) — never parse the cursor itself
+        return 0.0
+    if sp.field in seg.keywords:
+        kc = seg.keywords[sp.field]
+        s = str(cv)
+        pos = _bisect(kc.values, s)
+        if pos < len(kc.values) and kc.values[pos] == s:
+            c = float(pos)
+        else:
+            c = pos - 0.5   # between ordinals: nothing compares equal
+    else:
+        try:
+            c = float(cv)
+        except (TypeError, ValueError) as e:
+            raise QueryParsingException(
+                f"bad search_after value {cv!r} for [{sp.field}]") from e
+    return -c if sp.order == "desc" else c
+
+
+def _bisect(values: list[str], x: str) -> int:
+    import bisect
+    return bisect.bisect_left(values, x)
+
+
+# ---------------------------------------------------------------------------
+# Host-side value materialization + merge comparators
+# ---------------------------------------------------------------------------
+
+def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
+                doc_key: int) -> list:
+    """Real user-facing sort values for one doc (the response `sort` array).
+    None = missing. Strings for keywords, numbers for numerics."""
+    out: list = []
+    for sp in specs:
+        if sp.field == SCORE:
+            out.append(float(score))
+            continue
+        if sp.field == DOC:
+            out.append(int(doc_key))
+            continue
+        nc = seg.numerics.get(sp.field)
+        if nc is not None:
+            vals, miss = _host_numeric(nc)
+            if miss[local]:
+                out.append(float(sp.missing) if _is_number(sp.missing)
+                           else None)
+            else:
+                v = vals[local]
+                out.append(int(v) if nc.dtype == "i64" else float(v))
+            continue
+        kc = seg.keywords.get(sp.field)
+        if kc is not None:
+            o = _host_ords(kc)[local]
+            out.append(None if o < 0 else kc.values[int(o)])
+            continue
+        out.append(float(sp.missing) if _is_number(sp.missing) else None)
+    return out
+
+
+def _host_numeric(nc):
+    vals = getattr(nc, "_vals_np", None)
+    if vals is None:
+        vals = np.asarray(nc.vals)
+        miss = np.asarray(nc.missing)
+        object.__setattr__(nc, "_vals_np", vals)
+        object.__setattr__(nc, "_miss_np", miss)
+    return vals, nc._miss_np
+
+
+def _host_ords(kc):
+    ords = getattr(kc, "_ords_np", None)
+    if ords is None:
+        ords = np.asarray(kc.ords)
+        object.__setattr__(kc, "_ords_np", ords)
+    return ords
+
+
+class _Rev:
+    """Reverses comparison order — desc sort over types (strings) that can't
+    be negated numerically."""
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v
+
+
+def compare_key(values: Sequence, specs: Sequence[SortSpec]) -> tuple:
+    """Turn materialized sort values into a Python-sortable tuple honoring
+    per-key order + missing placement — the cross-segment / cross-shard
+    merge comparator (ref SearchPhaseController.sortDocs via TopDocs.merge)."""
+    out = []
+    for v, sp in zip(values, specs):
+        if v is None and _is_number(sp.missing):
+            v = float(sp.missing)
+        if v is None:
+            rank = 1 if sp.missing == "_last" else -1
+            out.append((rank, 0))
+        else:
+            out.append((0, _Rev(v) if sp.order == "desc" else v))
+    return tuple(out)
+
+
+def _is_number(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def normalize(sort) -> list[SortSpec] | None:
+    """Accept legacy single-key dicts ({"field":..., "order":...}) used by
+    internal callers/tests, or an already-parsed SortSpec list."""
+    if sort is None:
+        return None
+    if isinstance(sort, dict):
+        return [SortSpec(field=sort["field"],
+                         order=sort.get("order", "asc"),
+                         missing=sort.get("missing", "_last"),
+                         unmapped_ok=True)]
+    return list(sort)

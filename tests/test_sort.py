@@ -1,0 +1,295 @@
+"""Field sort correctness: keyword sort across segments/shards with real
+materialized sort values, multi-key sort, missing placement, search_after
+cursors, and the 400 on sorting analyzed text (VERDICT r3 task 3 done-bar).
+
+Reference behavior: search/sort/SortParseElement.java, TopDocs.merge
+semantics in SearchPhaseController.sortDocs.
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search import controller
+from elasticsearch_tpu.search.query_dsl import QueryParsingException
+from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+from elasticsearch_tpu.search.sort import SortSpec, parse_sort
+
+MAPPING = {"_doc": {"properties": {
+    "name": {"type": "text"},
+    "name.keyword": {"type": "keyword"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "double"},
+    "rank": {"type": "long"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+def _mk(tmp_path, docs, refresh_every=None):
+    """Engine with a segment break after every `refresh_every` docs."""
+    mappers = MapperService(mappings=MAPPING)
+    eng = Engine(str(tmp_path), mappers)
+    for i, d in enumerate(docs):
+        eng.index(str(i), d)
+        if refresh_every and (i + 1) % refresh_every == 0:
+            eng.refresh()
+    eng.refresh()
+    return ShardSearcher(0, eng.segments, mappers), mappers
+
+
+class TestKeywordSortAcrossSegments:
+    def test_two_segments_lexicographic(self, tmp_path):
+        # the verdict's exact repro: banana indexed before apple, separate
+        # segments — ordinals are 0 in both; values must still merge right
+        s, _ = _mk(tmp_path, [{"name": "banana", "tag": "banana"},
+                              {"name": "apple", "tag": "apple"}],
+                   refresh_every=1)
+        assert len(s.segments) == 2
+        res = s.execute_query_phase(
+            s.parse([{"match_all": {}}]),
+            sort=[SortSpec(field="tag", order="asc")])
+        hits = s.execute_fetch_phase([int(k) for k in res.doc_keys[0] if k >= 0],
+                                     res.scores[0], res.sort_values[0])
+        assert [h.source["name"] for h in hits] == ["apple", "banana"]
+        assert [h.sort_value for h in hits] == [["apple"], ["banana"]]
+
+    def test_desc_and_missing(self, tmp_path):
+        s, _ = _mk(tmp_path, [{"tag": "b"}, {"tag": "a"}, {"rank": 7},
+                              {"tag": "c"}], refresh_every=2)
+        res = s.execute_query_phase(
+            s.parse([{"match_all": {}}]),
+            sort=[SortSpec(field="tag", order="desc")])
+        hits = s.execute_fetch_phase([int(k) for k in res.doc_keys[0] if k >= 0],
+                                     res.scores[0], res.sort_values[0])
+        # missing doc sorts last by default
+        assert [h.sort_value[0] for h in hits] == ["c", "b", "a", None]
+        res = s.execute_query_phase(
+            s.parse([{"match_all": {}}]),
+            sort=[SortSpec(field="tag", order="asc", missing="_first")])
+        hits = s.execute_fetch_phase([int(k) for k in res.doc_keys[0] if k >= 0],
+                                     res.scores[0], res.sort_values[0])
+        assert [h.sort_value[0] for h in hits] == [None, "a", "b", "c"]
+
+
+class TestMultiKeySort:
+    def test_keyword_then_numeric(self, tmp_path):
+        docs = [{"tag": "x", "price": 3.0}, {"tag": "x", "price": 1.0},
+                {"tag": "a", "price": 9.0}, {"tag": "x", "price": 2.0}]
+        s, _ = _mk(tmp_path, docs, refresh_every=2)
+        res = s.execute_query_phase(
+            s.parse([{"match_all": {}}]),
+            sort=[SortSpec(field="tag", order="asc"),
+                  SortSpec(field="price", order="desc")])
+        hits = s.execute_fetch_phase([int(k) for k in res.doc_keys[0] if k >= 0],
+                                     res.scores[0], res.sort_values[0])
+        assert [h.sort_value for h in hits] == [
+            ["a", 9.0], ["x", 3.0], ["x", 2.0], ["x", 1.0]]
+
+    def test_numeric_then_score_tiebreak(self, tmp_path):
+        docs = [{"name": "fox fox", "rank": 1},
+                {"name": "fox", "rank": 1},
+                {"name": "fox", "rank": 0}]
+        s, _ = _mk(tmp_path, docs)
+        res = s.execute_query_phase(
+            s.parse([{"match": {"name": "fox"}}]),
+            sort=[SortSpec(field="rank", order="asc"),
+                  SortSpec(field="_score", order="desc")])
+        hits = s.execute_fetch_phase([int(k) for k in res.doc_keys[0] if k >= 0],
+                                     res.scores[0], res.sort_values[0])
+        assert [h.doc_id for h in hits][0] == "2"        # rank 0 first
+        assert [h.doc_id for h in hits][1] == "0"        # higher tf wins tie
+        # _score key forces score tracking
+        assert hits[1].sort_value[1] > hits[2].sort_value[1]
+
+
+class TestSortViaNode:
+    def test_two_shard_keyword_sort_with_values(self, node):
+        node.create_index("lib", settings={"number_of_shards": 2},
+                          mappings=MAPPING)
+        # ids chosen to land on different shards under the ES hash
+        for i, nm in enumerate(["banana", "apple", "cherry", "date"]):
+            node.index_doc("lib", str(i), {"name": nm, "tag": nm})
+        node.refresh("lib")
+        out = node.search("lib", {"query": {"match_all": {}},
+                                  "sort": [{"tag": {"order": "asc"}}]})
+        names = [h["_source"]["name"] for h in out["hits"]["hits"]]
+        assert names == ["apple", "banana", "cherry", "date"]
+        assert [h["sort"] for h in out["hits"]["hits"]] == [
+            ["apple"], ["banana"], ["cherry"], ["date"]]
+        # sorted search: scores are null unless track_scores
+        assert all(h["_score"] is None for h in out["hits"]["hits"])
+
+    def test_track_scores(self, node):
+        node.create_index("ts", mappings=MAPPING)
+        node.index_doc("ts", "1", {"name": "fox", "tag": "a"})
+        node.refresh("ts")
+        out = node.search("ts", {"query": {"match": {"name": "fox"}},
+                                 "sort": [{"tag": "asc"}],
+                                 "track_scores": True})
+        assert out["hits"]["hits"][0]["_score"] is not None
+
+    def test_sort_on_text_field_is_400(self, node):
+        node.create_index("txt", mappings=MAPPING)
+        node.index_doc("txt", "1", {"name": "hello"})
+        node.refresh("txt")
+        with pytest.raises(QueryParsingException):
+            node.search("txt", {"query": {"match_all": {}},
+                                "sort": [{"name": "asc"}]})
+
+    def test_sort_on_unmapped_field_is_400(self, node):
+        node.create_index("um", mappings=MAPPING)
+        node.index_doc("um", "1", {"name": "hello"})
+        node.refresh("um")
+        with pytest.raises(QueryParsingException):
+            node.search("um", {"query": {"match_all": {}},
+                               "sort": [{"nope": "asc"}]})
+        # unmapped_type opts out of the error (ref FieldSortBuilder)
+        out = node.search("um", {"query": {"match_all": {}},
+                                 "sort": [{"nope": {"order": "asc",
+                                                    "unmapped_type": "long"}}]})
+        assert out["hits"]["hits"][0]["sort"] == [None]
+
+    def test_search_after_keyword(self, node):
+        node.create_index("sa", settings={"number_of_shards": 2},
+                          mappings=MAPPING)
+        names = ["apple", "banana", "cherry", "date", "elder", "fig"]
+        for i, nm in enumerate(names):
+            node.index_doc("sa", str(i), {"tag": nm})
+        node.refresh("sa")
+        body = {"query": {"match_all": {}},
+                "sort": [{"tag": "asc"}], "size": 2}
+        seen = []
+        cursor = None
+        for _ in range(4):
+            b = dict(body)
+            if cursor is not None:
+                b["search_after"] = cursor
+            out = node.search("sa", b)
+            hits = out["hits"]["hits"]
+            if not hits:
+                break
+            seen += [h["_source"]["tag"] for h in hits]
+            cursor = hits[-1]["sort"]
+        assert seen == sorted(names)
+
+    def test_search_after_multikey(self, node):
+        node.create_index("sam", mappings=MAPPING)
+        docs = [("x", 1), ("x", 2), ("y", 1), ("x", 3), ("y", 2)]
+        for i, (t, r) in enumerate(docs):
+            node.index_doc("sam", str(i), {"tag": t, "rank": r})
+        node.refresh("sam")
+        body = {"query": {"match_all": {}},
+                "sort": [{"tag": "asc"}, {"rank": {"order": "desc"}}],
+                "size": 2}
+        seen, cursor = [], None
+        for _ in range(4):
+            b = dict(body)
+            if cursor is not None:
+                b["search_after"] = cursor
+            out = node.search("sam", b)
+            hits = out["hits"]["hits"]
+            if not hits:
+                break
+            seen += [tuple(h["sort"]) for h in hits]
+            cursor = hits[-1]["sort"]
+        assert seen == [("x", 3), ("x", 2), ("x", 1), ("y", 2), ("y", 1)]
+
+
+class TestParseSort:
+    def test_default_score_sort_is_none(self):
+        mp = MapperService(mappings=MAPPING)
+        assert parse_sort(None, mp) is None
+        assert parse_sort("_score", mp) is None
+        assert parse_sort([{"_score": {"order": "desc"}}], mp) is None
+
+    def test_score_asc_is_a_real_sort(self):
+        mp = MapperService(mappings=MAPPING)
+        specs = parse_sort([{"_score": "asc"}], mp)
+        assert specs is not None and specs[0].order == "asc"
+
+    def test_bad_order_rejected(self):
+        mp = MapperService(mappings=MAPPING)
+        with pytest.raises(QueryParsingException):
+            parse_sort([{"tag": {"order": "sideways"}}], mp)
+
+
+def test_controller_merges_materialized_values():
+    """Cross-shard reduce orders by value, not by per-shard ordinal."""
+    import numpy as np
+    from elasticsearch_tpu.search.shard_searcher import QuerySearchResult
+
+    def r(shard, vals):
+        sv = np.empty((1, len(vals)), dtype=object)
+        for i, v in enumerate(vals):
+            sv[0, i] = [v]
+        return QuerySearchResult(
+            shard_id=shard,
+            doc_keys=np.arange(len(vals), dtype=np.int64)[None, :],
+            scores=np.zeros((1, len(vals)), np.float32),
+            sort_values=sv,
+            total_hits=np.array([len(vals)]),
+            max_score=np.array([np.nan], np.float32))
+
+    specs = [SortSpec(field="tag", order="asc")]
+    red = controller.sort_docs([r(0, ["banana", "dill"]),
+                                r(1, ["apple", "cherry"])],
+                               from_=0, size=4, sort=specs)
+    assert [v[0] for v in red.sort_values] == [
+        "apple", "banana", "cherry", "dill"]
+
+
+class TestReviewRegressions:
+    """Round-4 code-review findings on the sort rewrite."""
+
+    def test_search_after_keyword_with_fieldless_segment(self, node):
+        # one segment has no doc with the sort field at all: the cursor must
+        # compare against the missing-fill there, not be parsed as a float
+        node.create_index("gap", mappings=MAPPING)
+        node.index_doc("gap", "0", {"tag": "t1"})
+        node.refresh("gap")                      # segment 1: has tag
+        node.index_doc("gap", "1", {"rank": 5})
+        node.refresh("gap")                      # segment 2: no tag column
+        out = node.search("gap", {"query": {"match_all": {}},
+                                  "sort": [{"tag": "asc"}],
+                                  "search_after": ["t1"], "size": 5})
+        # only the tag-less doc remains (missing sorts last, after "t1")
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+
+    def test_multi_index_sort_validates_across_all_mappers(self, node):
+        node.create_index("mi1", mappings={"_doc": {"properties": {
+            "name": {"type": "text"}}}})
+        node.create_index("mi2", mappings={"_doc": {"properties": {
+            "price": {"type": "long"}}}})
+        node.index_doc("mi1", "a", {"name": "hello"})
+        node.index_doc("mi2", "b", {"price": 3})
+        node.refresh("_all")
+        # price mapped in mi2 only: allowed; mi1 doc sorts as missing
+        out = node.search("mi1,mi2", {"query": {"match_all": {}},
+                                      "sort": [{"price": "asc"}]})
+        assert [h["sort"] for h in out["hits"]["hits"]] == [[3], [None]]
+        # analyzed text in ANY index is still a 400
+        with pytest.raises(QueryParsingException):
+            node.search("mi1,mi2", {"query": {"match_all": {}},
+                                    "sort": [{"name": "asc"}]})
+
+    def test_numeric_string_missing_parsed_as_number(self, node):
+        node.create_index("nm", mappings=MAPPING)
+        node.index_doc("nm", "lo", {"price": 10.0})
+        node.index_doc("nm", "hi", {"price": 100.0})
+        node.index_doc("nm", "none", {"tag": "x"})
+        node.refresh("nm")
+        out = node.search("nm", {"query": {"match_all": {}},
+                                 "sort": [{"price": {"order": "asc",
+                                                     "missing": "50"}}]})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["lo", "none", "hi"]
+        assert out["hits"]["hits"][1]["sort"] == [50.0]
+        with pytest.raises(QueryParsingException):
+            node.search("nm", {"query": {"match_all": {}},
+                               "sort": [{"price": {"missing": "banana"}}]})

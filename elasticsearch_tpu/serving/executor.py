@@ -78,8 +78,10 @@ def response_raw(view: PackedIndexView, index_name: str, srow: np.ndarray,
     dl = drow[from_:from_ + size]
     n = int((sl > -np.inf).sum())
     if n:
+        # %.9g survives a float32 round-trip, so raw and dict lanes
+        # serialize identical score values (advisor r3)
         ids = view.ids_packed[dl[:n]]
-        ss = np.char.mod("%.6g", sl[:n].astype(np.float64))
+        ss = np.char.mod("%.9g", sl[:n].astype(np.float64))
         prefix = ('{"_index":"' + index_name + '","_type":"'
                   + (view.single_type or "_doc") + '","_id":"')
         parts = np.char.add(np.char.add(np.char.add(prefix, ids),
@@ -87,7 +89,7 @@ def response_raw(view: PackedIndexView, index_name: str, srow: np.ndarray,
         hits_str = ',"_source":{}},'.join(parts.tolist()) + ',"_source":{}}'
     else:
         hits_str = ""
-    mx = "%.6g" % float(srow[0]) \
+    mx = "%.9g" % float(srow[0]) \
         if srow.size and srow[0] > -np.inf else "null"
     return ('{"took":%d,"timed_out":false,"_shards":{"total":%d,'
             '"successful":%d,"failed":0},"hits":{"total":%d,"max_score":%s,'

@@ -1,0 +1,69 @@
+"""Scoring must be lane-independent: the packed one-program lane and the
+general per-shard path score with the same index-global statistics, so the
+same query returns identical scores whichever lane serves it
+(VERDICT r3 weak #4; ref search/dfs/DfsPhase — global stats as the default).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {"body": {"type": "text"}}}}
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick quick quick repetition of quick terms",
+    "a lazy afternoon with a lazy cat",
+    "fox hunting is banned in many countries",
+    "the dog chased the fox across the quick river",
+    "nothing relevant here at all",
+    "dogs and cats living together",
+    "quick thinking saves the day",
+]
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("par", settings={"number_of_shards": 3},
+                   mappings=MAPPING)
+    for i, b in enumerate(DOCS):
+        n.index_doc("par", str(i), {"body": b})
+    n.refresh("par")
+    yield n
+    n.close()
+
+
+def _scores(out):
+    return {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+
+
+class TestLaneScoreParity:
+    @pytest.mark.parametrize("query", [
+        {"match": {"body": "quick fox"}},
+        {"match": {"body": "lazy dog"}},
+        {"match": {"body": "quick"}},
+    ])
+    def test_packed_and_fallback_scores_identical(self, node, query):
+        svc = node.indices["par"]
+        before = svc.search_stats.get("packed", 0)
+        packed_out = node.search("par", {"query": query})
+        assert svc.search_stats.get("packed", 0) == before + 1, \
+            "expected the packed lane to serve the bare query"
+        # track_scores isn't packed-eligible, forcing the general path —
+        # but it doesn't change scoring when there's no sort
+        fallback_out = node.search("par", {"query": query,
+                                           "track_scores": True})
+        assert svc.search_stats.get("packed", 0) == before + 1
+        ps, fs = _scores(packed_out), _scores(fallback_out)
+        assert set(ps) == set(fs)
+        for did in ps:
+            assert ps[did] == pytest.approx(fs[did], rel=1e-5), did
+        assert packed_out["hits"]["total"] == fallback_out["hits"]["total"]
+
+    def test_multi_shard_idf_is_global_on_fallback(self, node):
+        # "fox" appears in 3 docs spread over shards; per-shard IDF would
+        # give different scores for equal-tf docs on different shards
+        out = node.search("par", {"query": {"term": {"body": "banned"}},
+                                  "track_scores": True})
+        assert out["hits"]["hits"], "query must match"

@@ -17,6 +17,7 @@ mutations — device state is only produced at refresh)."""
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -154,13 +155,14 @@ class Engine:
 
     def index(self, doc_id: str, source: dict, type_name: str = "_doc",
               version: int | None = None, version_type: str = "internal",
-              op_type: str = "index") -> EngineResult:
+              op_type: str = "index", sync: bool | None = None) -> EngineResult:
         with self._lock:
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
             self._apply_index(doc_id, source, type_name, new_version)
             self.translog.add({"op": "index", "id": doc_id, "type": type_name,
-                               "source": source, "version": new_version})
+                               "source": source, "version": new_version},
+                              sync=sync)
             self._maybe_refresh_on_size()
             return EngineResult(doc_id=doc_id, version=new_version, created=created)
 
@@ -172,14 +174,16 @@ class Engine:
         self._dirty = True
 
     def delete(self, doc_id: str, version: int | None = None,
-               version_type: str = "internal") -> EngineResult:
+               version_type: str = "internal",
+               sync: bool | None = None) -> EngineResult:
         with self._lock:
             cur = self.current_version(doc_id)
             found = cur != -1
             new_version = self._check_version(doc_id, version, version_type, "delete") \
                 if found or version is not None else 1
             self._apply_delete(doc_id, new_version)
-            self.translog.add({"op": "delete", "id": doc_id, "version": new_version})
+            self.translog.add({"op": "delete", "id": doc_id,
+                               "version": new_version}, sync=sync)
             return EngineResult(doc_id=doc_id, version=new_version,
                                 created=False, found=found)
 
@@ -245,9 +249,35 @@ class Engine:
             self._maybe_merge()
 
     def _maybe_merge(self) -> None:
-        if len(self.segments) < self.MERGE_SEGMENT_COUNT:
-            return
-        self.force_merge(max_num_segments=1)
+        """Size-tiered merge selection (ref index/merge/policy/
+        LogMergePolicy: segments in the same log_{factor}(size) tier merge
+        when the tier fills) — small merges stay small; the corpus is never
+        re-merged all-to-one on every trigger."""
+        factor = self.MERGE_SEGMENT_COUNT
+        tiers: dict[int, list[Segment]] = {}
+        for seg in self.segments:
+            t = int(math.log(max(seg.live_count, 1), factor))
+            tiers.setdefault(t, []).append(seg)
+        for t in sorted(tiers):
+            if len(tiers[t]) >= factor:
+                self._merge_subset(tiers[t])
+                return   # one merge per trigger keeps refresh latency flat
+
+    def _merge_subset(self, subset: list[Segment]) -> None:
+        chosen = set(id(s) for s in subset)
+        merged = merge_segments(subset, self._next_seg_id)
+        self._next_seg_id += 1
+        out: list[Segment] = []
+        placed = False
+        for s in self.segments:
+            if id(s) in chosen:
+                if not placed and merged.n_docs:
+                    out.append(merged)
+                    placed = True
+            else:
+                out.append(s)
+        self.segments = out
+        self.merge_count += 1
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         """Merge segments (ref index/merge/ TieredMergePolicy + optimize API)."""
@@ -256,8 +286,7 @@ class Engine:
                 # may still want to purge deletes
                 if not any(s.live_count < s.n_docs for s in self.segments):
                     return
-            merged = merge_segments(self.segments, self._next_seg_id,
-                                    self.mappers.document_mapper)
+            merged = merge_segments(self.segments, self._next_seg_id)
             self._next_seg_id += 1
             self.segments = [merged] if merged.n_docs else []
             self.merge_count += 1

@@ -65,6 +65,12 @@ class IndexService:
                    **kw) -> EngineResult:
         return self.shard_for(doc_id, routing).delete(doc_id, **kw)
 
+    def sync_translogs(self) -> None:
+        """One fsync per shard — the tail of a deferred-sync bulk request
+        (ref 'request' durability: fsync per request, not per op)."""
+        for e in self.shards:
+            e.translog.sync()
+
     # -- lifecycle ---------------------------------------------------------
 
     def refresh(self) -> None:

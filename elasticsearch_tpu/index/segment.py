@@ -395,32 +395,203 @@ class SegmentBuilder:
 
 def merge_segments(segments: list[Segment], new_seg_id: int,
                    mapper_for_type=None) -> Segment:
-    """Merge segments, dropping tombstoned docs — the TieredMergePolicy analog
-    (ref index/merge/; SURVEY.md §7 M1 'background merge = concat/re-sort').
+    """Merge segments tensor-natively, dropping tombstoned docs
+    (ref index/merge/ + Lucene SegmentMerger — but over CSR tensors).
 
-    `mapper_for_type`: callable type_name -> DocumentMapper so each doc is
-    re-parsed under its own type's mapping (the reference preserves per-type
-    schema across merges; a fixed mapper would silently re-tokenize keyword
-    fields as dynamic text).
-
-    v1 strategy: replay stored sources through a rebuild. Exact and simple;
-    a device-side concat+re-sort fast path can come later since postings are
-    already sorted tensors.
+    NO re-tokenization and NO mapper involvement (mapper_for_type is kept
+    for call-site compatibility and ignored): postings are concatenated and
+    re-grouped by a stable host argsort over the union term ids, doc ids are
+    remapped through per-segment liveness compaction, keyword ordinals are
+    remapped through the union vocabulary, and numeric/vector columns are
+    boolean-mask concatenations. Work is O(P log V) numpy on host — merge
+    cost no longer scales with analyzer complexity, and per-term postings
+    stay sorted by doc id (stable sort + order-preserving remap).
     """
-    from ..mapping.mapper import DocumentMapper
-    from ..analysis.analyzers import AnalysisService
-
-    if mapper_for_type is None:
-        _default = DocumentMapper("_doc", AnalysisService())
-        mapper_for_type = lambda tname: _default  # noqa: E731
-
-    builder = SegmentBuilder(new_seg_id)
+    # -- doc remap: old (seg, local) -> new local, dead docs dropped -------
+    keeps: list[np.ndarray] = []
+    remaps: list[np.ndarray] = []    # old local -> new local (-1 = dead)
+    base = 0
     for seg in segments:
-        for local in range(seg.n_docs):
-            if not seg.live_host[local]:
+        keep = np.flatnonzero(seg.live_host[: seg.n_docs])
+        remap = np.full(seg.n_pad + 1, -1, np.int64)  # +1: PAD sentinel slot
+        remap[keep] = base + np.arange(len(keep))
+        keeps.append(keep)
+        remaps.append(remap)
+        base += len(keep)
+    n = base
+    n_pad = next_pow2(n, floor=8)
+
+    stored: list[dict] = []
+    ids: list[str] = []
+    types: list[str] = []
+    versions: list[int] = []
+    for seg, keep in zip(segments, keeps):
+        for old in keep:
+            stored.append(seg.stored[old])
+            ids.append(seg.ids[old])
+            types.append(seg.types[old])
+            versions.append(seg.versions[old])
+
+    # -- text fields: CSR concat + stable re-group by union term id --------
+    text: dict[str, TextFieldIndex] = {}
+    all_text_fields = {f for seg in segments for f in seg.text}
+    for field in all_text_fields:
+        srcs = [(si, seg.text[field]) for si, seg in enumerate(segments)
+                if field in seg.text]
+        union_terms = sorted(set().union(*(fx.terms for _, fx in srcs)))
+        union_pos = {t: i for i, t in enumerate(union_terms)}
+        V = len(union_terms)
+        have_positions = all(fx.positions is not None and
+                             fx.pos_starts is not None for _, fx in srcs)
+
+        tid_parts, doc_parts, tf_parts = [], [], []
+        ps_parts, pl_parts, posflat_parts = [], [], []
+        pos_off = 0
+        for si, fx in srcs:
+            P = fx.n_postings
+            if P == 0:
                 continue
-            src = seg.stored[local]
-            parsed = mapper_for_type(seg.types[local]).parse(src, doc_id=seg.ids[local])
-            builder.add(parsed, seg.types[local],
-                        version=seg.versions[local])
-    return builder.build()
+            docs_h = fx.doc_ids_host if fx.doc_ids_host is not None \
+                else np.asarray(fx.doc_ids)[:P]
+            tf_h = np.asarray(fx.tf)[:P]
+            # per-posting union term id: repeat each term id by its df
+            seg_terms = list(fx.terms)  # insertion order == sorted
+            seg_to_union = np.array([union_pos[t] for t in seg_terms],
+                                    np.int64)
+            per_post_tid = np.repeat(seg_to_union, fx.term_lens[: len(seg_terms)])
+            alive = remaps[si][docs_h] >= 0
+            tid_parts.append(per_post_tid[alive])
+            doc_parts.append(remaps[si][docs_h][alive])
+            tf_parts.append(tf_h[alive])
+            if have_positions:
+                ps_parts.append(fx.pos_starts[:P][alive] + pos_off)
+                pl_parts.append(fx.pos_lens[:P][alive])
+                posflat_parts.append(fx.positions)
+                pos_off += len(fx.positions)
+
+        if tid_parts:
+            tids = np.concatenate(tid_parts)
+            docs = np.concatenate(doc_parts)
+            tfs = np.concatenate(tf_parts)
+        else:
+            tids = np.zeros(0, np.int64)
+            docs = np.zeros(0, np.int64)
+            tfs = np.zeros(0, np.float32)
+        # stable: within a term, segment order then doc order == ascending
+        # new doc ids (remap preserves per-segment order, bases ascend)
+        order = np.argsort(tids, kind="stable")
+        tids, docs, tfs = tids[order], docs[order], tfs[order]
+        P = len(tids)
+        lens = np.bincount(tids, minlength=V).astype(np.int32) if V else \
+            np.zeros(0, np.int32)
+        starts = np.zeros(V, np.int32)
+        if V:
+            starts[1:] = np.cumsum(lens)[:-1]
+        max_df = int(lens.max()) if V and P else 0
+        p_pad = required_padding(P, max_df)
+        doc_ids = np.full(p_pad, n_pad, np.int32)
+        doc_ids[:P] = docs
+        tf = np.zeros(p_pad, np.float32)
+        tf[:P] = tfs
+
+        # per-doc field length: gather old doc_len at kept docs
+        doc_len = np.ones(n_pad, np.float32)
+        for si, fx in srcs:
+            old_dl = np.asarray(fx.doc_len)
+            keep = keeps[si]
+            doc_len[remaps[si][keep]] = old_dl[np.minimum(
+                keep, old_dl.shape[0] - 1)]
+        dl = np.ones(p_pad, np.float32)
+        dl[:P] = doc_len[np.minimum(doc_ids[:P], n_pad - 1)]
+        # Σ field length over LIVE docs == Σ tf (tf sums to token count)
+        sum_dl = float(tfs.sum())
+
+        pos_starts = pos_lens = positions = doc_ids_host = None
+        doc_ids_host = docs.astype(np.int32)
+        if have_positions and P:
+            ps = np.concatenate(ps_parts)[order]
+            pl = np.concatenate(pl_parts)[order]
+            posflat = np.concatenate(posflat_parts) if posflat_parts \
+                else np.zeros(0, np.int32)
+            ends = np.cumsum(pl)
+            total = int(ends[-1]) if len(ends) else 0
+            flat_idx = np.arange(total) - np.repeat(ends - pl, pl) \
+                + np.repeat(ps, pl)
+            positions = posflat[flat_idx].astype(np.int32)
+            pos_lens = pl.astype(np.int32)
+            pos_starts = np.zeros(P, np.int32)
+            if P:
+                pos_starts[1:] = ends[:-1]
+        elif have_positions:
+            positions = np.zeros(0, np.int32)
+            pos_starts = np.zeros(0, np.int32)
+            pos_lens = np.zeros(0, np.int32)
+
+        text[field] = TextFieldIndex(
+            terms={t: i for i, t in enumerate(union_terms)},
+            term_starts=starts, term_lens=lens,
+            doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
+            doc_len=jnp.asarray(doc_len), dl=jnp.asarray(dl),
+            sum_dl=sum_dl, n_postings=P, max_df=max_df,
+            doc_ids_host=doc_ids_host,
+            pos_starts=pos_starts, pos_lens=pos_lens, positions=positions)
+
+    # -- keyword columns: ordinal remap through the union vocabulary -------
+    keywords: dict[str, KeywordColumn] = {}
+    all_kw = {f for seg in segments for f in seg.keywords}
+    for field in all_kw:
+        srcs = [(si, seg.keywords[field]) for si, seg in enumerate(segments)
+                if field in seg.keywords]
+        union_vals = sorted(set().union(*(kc.values for _, kc in srcs)))
+        union_of = {v: i for i, v in enumerate(union_vals)}
+        ords = np.full(n_pad, -1, np.int32)
+        for si, kc in srcs:
+            keep = keeps[si]
+            old = np.asarray(kc.ords)[keep]
+            # map via the union: ord -1 (missing) stays -1
+            lut = np.array([union_of[v] for v in kc.values] + [-1], np.int32)
+            ords[remaps[si][keep]] = lut[old]
+        keywords[field] = KeywordColumn(
+            ord_map=union_of, values=union_vals, ords=jnp.asarray(ords))
+
+    # -- numeric columns ----------------------------------------------------
+    numerics: dict[str, NumericColumn] = {}
+    all_num = {f for seg in segments for f in seg.numerics}
+    for field in all_num:
+        dtype = next(seg.numerics[field].dtype for seg in segments
+                     if field in seg.numerics)
+        vals = np.zeros(n_pad, np.int64 if dtype == "i64" else np.float64)
+        missing = np.ones(n_pad, bool)
+        for si, seg in enumerate(segments):
+            nc = seg.numerics.get(field)
+            if nc is None:
+                continue
+            keep = keeps[si]
+            vals[remaps[si][keep]] = np.asarray(nc.vals)[keep]
+            missing[remaps[si][keep]] = np.asarray(nc.missing)[keep]
+        numerics[field] = NumericColumn(jnp.asarray(vals),
+                                        jnp.asarray(missing), dtype)
+
+    # -- vector columns ------------------------------------------------------
+    vectors: dict[str, VectorColumn] = {}
+    all_vec = {f for seg in segments for f in seg.vectors}
+    for field in all_vec:
+        dims = next(seg.vectors[field].dims for seg in segments
+                    if field in seg.vectors)
+        mat = np.zeros((n_pad, dims), np.float32)
+        for si, seg in enumerate(segments):
+            vc = seg.vectors.get(field)
+            if vc is None:
+                continue
+            keep = keeps[si]
+            mat[remaps[si][keep]] = np.asarray(vc.vecs)[keep]
+        vectors[field] = VectorColumn(jnp.asarray(mat), dims)
+
+    live = np.zeros(n_pad, bool)
+    live[:n] = True
+    return Segment(
+        seg_id=new_seg_id, n_docs=n, n_pad=n_pad, text=text,
+        keywords=keywords, numerics=numerics, vectors=vectors,
+        stored=stored, ids=ids, types=types,
+        id_to_local={d: i for i, d in enumerate(ids)}, live_host=live,
+        versions=versions)

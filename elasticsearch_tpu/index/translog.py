@@ -51,14 +51,21 @@ class Translog:
 
     # -- write path --------------------------------------------------------
 
-    def add(self, op: dict[str, Any]) -> int:
+    def add(self, op: dict[str, Any], sync: bool | None = None) -> int:
         """Append one operation; returns its location offset
-        (ref Translog.java add -> Location)."""
+        (ref Translog.java add -> Location).
+
+        sync: None = honor the durability mode; False = defer the fsync —
+        the bulk path appends a whole request then calls sync() ONCE, which
+        is exactly the reference's 'request' durability (fsync per request,
+        not per op)."""
         payload = json.dumps(op, separators=(",", ":")).encode("utf-8")
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         loc = self._file.tell()
         self._file.write(rec)
-        if self.durability == "request":
+        if sync is None:
+            sync = self.durability == "request"
+        if sync:
             self._file.flush()
             os.fsync(self._file.fileno())
         self.ops_since_commit += 1

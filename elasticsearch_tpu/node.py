@@ -221,8 +221,11 @@ class NodeService:
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
         """ops: (action, meta, source). ref TransportBulkAction splits by
-        shard; locally we just apply in order per the bulk contract."""
+        shard; locally we just apply in order per the bulk contract.
+        Translog fsyncs are deferred to ONE sync per touched index at the
+        end — the reference's per-request (not per-op) durability."""
         items = []
+        touched: set[str] = set()
         for action, meta, source in operations:
             index = meta.get("_index")
             type_name = meta.get("_type", "_doc")
@@ -232,13 +235,16 @@ class NodeService:
                     _, res = self.index_doc(
                         index, doc_id, source, type_name=type_name,
                         op_type="create" if action == "create" else "index",
-                        routing=meta.get("_routing") or meta.get("routing"))
+                        routing=meta.get("_routing") or meta.get("routing"),
+                        sync=False)
+                    touched.add(index)
                     items.append({action: {
                         "_index": index, "_type": type_name, "_id": res.doc_id,
                         "_version": res.version,
                         "status": 201 if res.created else 200}})
                 elif action == "delete":
-                    res = self.delete_doc(index, doc_id)
+                    res = self.delete_doc(index, doc_id, sync=False)
+                    touched.add(index)
                     items.append({"delete": {
                         "_index": index, "_type": type_name, "_id": doc_id,
                         "_version": res.version, "found": res.found,
@@ -258,6 +264,10 @@ class NodeService:
             except Exception as e:  # noqa: BLE001 — per-item error contract
                 items.append({action: {"_index": index, "_id": doc_id,
                                        "status": 400, "error": str(e)}})
+        for name in touched:
+            svc = self.indices.get(name)
+            if svc is not None:
+                svc.sync_translogs()
         return items
 
     # -- search (the QUERY_THEN_FETCH driver, SURVEY §3.2) -----------------

@@ -1,0 +1,176 @@
+"""Tensor-native merge: no re-tokenization (zero mapper calls), exact
+search/agg/phrase parity across a merge, tombstone purge, ordinal remap,
+and the size-tiered policy (VERDICT r3 task 4 done-bar).
+
+ref index/merge/ + Lucene SegmentMerger semantics.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.segment import merge_segments
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "long"},
+    "vec": {"type": "dense_vector", "dims": 4},
+}}}
+
+DOCS = [
+    {"body": "quick brown fox", "tag": "zoo", "price": 10,
+     "vec": [1, 0, 0, 0]},
+    {"body": "quick quick dog", "tag": "apple", "price": 20,
+     "vec": [0, 1, 0, 0]},
+    {"body": "lazy fox sleeps", "tag": "mango", "price": 30,
+     "vec": [0, 0, 1, 0]},
+    {"body": "dog chases fox", "tag": "apple", "price": 40,
+     "vec": [0, 0, 0, 1]},
+    {"body": "nothing here", "tag": "berry", "price": 50,
+     "vec": [1, 1, 0, 0]},
+]
+
+
+def _engine(tmp_path, refresh_every=2):
+    mp = MapperService(mappings=MAPPING)
+    eng = Engine(str(tmp_path), mp)
+    for i, d in enumerate(DOCS):
+        eng.index(str(i), d)
+        if (i + 1) % refresh_every == 0:
+            eng.refresh()
+    eng.refresh()
+    return eng, mp
+
+
+def _search(eng, mp, body, **kw):
+    s = ShardSearcher(0, eng.segments, mp)
+    res = s.execute_query_phase(s.parse([body]), **kw)
+    keys = [int(k) for k in res.doc_keys[0] if k >= 0]
+    hits = s.execute_fetch_phase(keys, res.scores[0])
+    return res, hits
+
+
+class TestNativeMerge:
+    def test_merge_makes_zero_mapper_calls(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        assert len(eng.segments) > 1
+        calls = {"n": 0}
+        orig = mp.document_mapper
+
+        def spy(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        mp.document_mapper = spy
+        try:
+            eng.force_merge(max_num_segments=1)
+        finally:
+            mp.document_mapper = orig
+        assert calls["n"] == 0, "merge must not touch the mapper"
+        assert len(eng.segments) == 1
+
+    def test_search_parity_across_merge(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        bodies = [
+            {"match": {"body": "quick fox"}},
+            {"match_phrase": {"body": "quick brown fox"}},
+            {"term": {"tag": "apple"}},
+            {"range": {"price": {"gte": 20, "lte": 40}}},
+        ]
+        before = [_search(eng, mp, b) for b in bodies]
+        eng.force_merge(max_num_segments=1)
+        after = [_search(eng, mp, b) for b in bodies]
+        for (bres, bhits), (ares, ahits) in zip(before, after):
+            assert int(bres.total_hits[0]) == int(ares.total_hits[0])
+            bmap = {h.doc_id: h.score for h in bhits}
+            amap = {h.doc_id: h.score for h in ahits}
+            assert set(bmap) == set(amap)
+            for did in bmap:
+                if not (np.isnan(bmap[did]) and np.isnan(amap[did])):
+                    assert bmap[did] == pytest.approx(amap[did], rel=1e-5)
+
+    def test_merge_purges_tombstones_and_keeps_versions(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        eng.index("1", {**DOCS[1], "price": 21})   # bump version
+        eng.delete("2")
+        eng.refresh()
+        eng.force_merge(max_num_segments=1)
+        seg = eng.segments[0]
+        assert seg.n_docs == seg.live_count == 4          # doc 2 gone
+        assert "2" not in seg.id_to_local
+        local = seg.id_to_local["1"]
+        assert seg.versions[local] == 2
+        assert seg.stored[local]["price"] == 21
+        res, hits = _search(eng, mp, {"match_all": {}})
+        assert sorted(h.doc_id for h in hits) == ["0", "1", "3", "4"]
+
+    def test_keyword_ordinals_remap_to_union_vocab(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        eng.force_merge(max_num_segments=1)
+        kc = eng.segments[0].keywords["tag"]
+        assert kc.values == sorted(kc.values)
+        ords = np.asarray(kc.ords)
+        for did, expect in [("0", "zoo"), ("1", "apple"), ("4", "berry")]:
+            local = eng.segments[0].id_to_local[did]
+            assert kc.values[int(ords[local])] == expect
+
+    def test_vectors_and_positions_survive(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        eng.force_merge(max_num_segments=1)
+        seg = eng.segments[0]
+        local = seg.id_to_local["3"]
+        assert np.allclose(np.asarray(seg.vectors["vec"].vecs)[local],
+                           [0, 0, 0, 1])
+        # phrase positions: "dog chases fox" must still phrase-match
+        res, hits = _search(eng, mp, {"match_phrase": {"body": "chases fox"}})
+        assert [h.doc_id for h in hits] == ["3"]
+
+    def test_merge_empty_after_all_deleted(self, tmp_path):
+        eng, mp = _engine(tmp_path)
+        for i in range(len(DOCS)):
+            eng.delete(str(i))
+        eng.refresh()
+        eng.force_merge(max_num_segments=1)
+        assert eng.segments == []
+
+
+class TestTieredPolicy:
+    def test_small_tier_merges_do_not_touch_big_segment(self, tmp_path):
+        mp = MapperService(mappings=MAPPING)
+        eng = Engine(str(tmp_path), mp)
+        # one big segment (64 docs = tier 2 at factor 8)
+        for i in range(64):
+            eng.index(f"big{i}", {"body": f"word{i} common"})
+        eng.refresh()
+        big = eng.segments[0]
+        # 7 single-doc segments: still below the tier-0 fill of 8
+        for i in range(7):
+            eng.index(f"s{i}", {"body": "tiny common"})
+            eng.refresh()
+        assert big in eng.segments
+        assert len(eng.segments) == 8
+        # the 8th tier-0 segment fills the tier: ONE merge, big untouched
+        eng.index("s7", {"body": "tiny common"})
+        eng.refresh()
+        assert big in eng.segments, "tiered merge must not rewrite big segs"
+        assert len(eng.segments) == 2
+        assert eng.doc_count() == 72
+
+    def test_direct_merge_of_store_loaded_segments(self, tmp_path):
+        # segments straight from a commit (host mirrors may be lazy)
+        mp = MapperService(mappings=MAPPING)
+        eng = Engine(str(tmp_path / "a"), mp)
+        for i, d in enumerate(DOCS):
+            eng.index(str(i), d)
+            eng.refresh()
+        eng.flush()
+        eng.close()
+        eng2 = Engine(str(tmp_path / "a"), mp)
+        merged = merge_segments(eng2.segments, 99)
+        assert merged.n_docs == len(DOCS)
+        s = ShardSearcher(0, [merged], mp)
+        res = s.execute_query_phase(s.parse([{"match": {"body": "fox"}}]))
+        assert int(res.total_hits[0]) == 3

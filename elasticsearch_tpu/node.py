@@ -663,15 +663,68 @@ class NodeService:
 
     def _scroll_start(self, index: str, body: dict, size: int,
                       keep_alive: str) -> dict:
+        """Open a scroll context: PIN a point-in-time snapshot of every
+        shard's segment set (frozen liveness), then advance with
+        search_after cursors over the pinned searchers — O(depth) total,
+        and concurrent writes/deletes/merges never change what the scroll
+        sees (ref search/scan/ScanContext.java:55 pinning the reader,
+        SearchService.java:316-330 context keep-alive)."""
+        import threading
+
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+        if any(k in body for k in ("knn", "rescore", "search_after")):
+            raise QueryParsingException(
+                "scroll does not support knn/rescore/search_after")
+        from .search.sort import DOC, SCORE, SortSpec, parse_sort
+        user_sort = parse_sort(body.get("sort"),
+                               [self.indices[n].mappers for n in names])
+        implicit = user_sort is None
+        specs = list(user_sort) if user_sort else \
+            [SortSpec(field=SCORE, order="desc")]
+        if not any(sp.field == DOC for sp in specs):
+            # _doc tiebreak makes the cursor a total order: batches never
+            # repeat or skip docs with equal primary keys
+            specs = specs + [SortSpec(field=DOC, order="asc")]
+
+        # pin: share device arrays, freeze the liveness bitmap
+        import dataclasses as _dc
+        searchers: list[ShardSearcher] = []
+        index_of: list[str] = []
+        for n in names:
+            svc = self.indices[n]
+            for e in svc.shards:
+                segs = [_dc.replace(seg, live_host=seg.live_host.copy(),
+                                    live_count=seg.live_count)
+                        for seg in e.segments]
+                # shard ids unique ACROSS indices: the _doc cursor key
+                # embeds them, and a collision would skip docs mid-scroll
+                searchers.append(ShardSearcher(len(searchers), segs,
+                                               svc.mappers))
+                index_of.append(n)
+
+        query = body.get("query", {"match_all": {}})
+        from .search.query_dsl import CollectionStats
+        from .search.query_parser import QueryParser, merge_query_batch
+        nodes_by_index: dict[str, Any] = {}
+        terms_by_field: dict[str, set] = {}
+        for n in names:
+            parsed = QueryParser(self.indices[n].mappers).parse(query)
+            parsed.collect_terms(terms_by_field)
+            nodes_by_index[n] = merge_query_batch([parsed])
+        stats = CollectionStats.from_segments(
+            [seg for s in searchers for seg in s.segments], terms_by_field)
+
         with self._scroll_lock:
             self._reap_scrolls()
             self._scroll_seq += 1
             sid = f"scroll-{self._scroll_seq}"
-            # scroll iterates in sorted (or score) order with a moving cursor;
-            # the context server-side holds only (request, position) — segment
-            # immutability makes replaying with a deeper window exact
-            import threading
-            ctx = {"index": index, "body": dict(body), "cursor": 0,
+            ctx = {"searchers": searchers, "index_of": index_of,
+                   "nodes": nodes_by_index, "specs": specs, "stats": stats,
+                   "cursor": None, "implicit_sort": implicit,
+                   "source": body.get("_source"),
+                   "aggs": body.get("aggs") or body.get("aggregations"),
                    "expiry": time.monotonic() + _duration_secs(keep_alive),
                    "keep_alive": keep_alive, "lock": threading.Lock()}
             self._scrolls[sid] = ctx
@@ -690,20 +743,62 @@ class NodeService:
                 ctx["keep_alive"] = keep_alive
             ctx["expiry"] = time.monotonic() \
                 + _duration_secs(ctx["keep_alive"])
-        out = self._scroll_batch(ctx, int(ctx["body"].get("size", 10)))
+        out = self._scroll_batch(ctx, ctx.get("size", 10))
         out["_scroll_id"] = scroll_id
         return out
 
-    def _scroll_batch(self, ctx: dict, size: int) -> dict:
-        body = dict(ctx["body"])
-        body.pop("from", None)
+    def _scroll_batch(self, ctx: dict, size: int | None = None) -> dict:
+        t0 = time.perf_counter()
         # per-context lock: two concurrent scrolls on the same id must not
         # read the same cursor and return duplicate batches
         with ctx["lock"]:
-            out = self.search(ctx["index"], body, size=size,
-                              from_=ctx["cursor"])
-            ctx["cursor"] += len(out["hits"]["hits"])
-        return out
+            if size is None:
+                size = ctx.get("size", 10)
+            ctx["size"] = size
+            searchers = ctx["searchers"]
+            agg_specs = None
+            if ctx["cursor"] is None and ctx["aggs"]:
+                agg_specs = parse_aggs(ctx["aggs"])
+            results = [
+                s.execute_query_phase(
+                    ctx["nodes"][ctx["index_of"][i]], size=size,
+                    sort=ctx["specs"], search_after=ctx["cursor"],
+                    global_stats=ctx["stats"],
+                    track_scores=False,   # the _score spec re-enables it
+                    aggs=agg_specs)
+                for i, s in enumerate(searchers)]
+            reduced = controller.sort_docs(results, from_=0, size=size,
+                                           sort=ctx["specs"])
+            src_filter = ctx["source"]
+            hits = controller.fetch_and_merge(
+                reduced, searchers,
+                source_filter=(lambda s: _source_filter(s, src_filter))
+                if src_filter is not None else None)
+            for slot, h in enumerate(hits):
+                h["_index"] = ctx["index_of"][reduced.shard_order[slot]]
+            if hits:
+                ctx["cursor"] = hits[-1]["sort"]
+            if ctx["implicit_sort"]:
+                # default scroll is score-ordered; the synthetic sort keys
+                # are cursor plumbing, not part of the user's response shape
+                for h in hits:
+                    h.pop("sort", None)
+            resp: dict[str, Any] = {
+                "took": int((time.perf_counter() - t0) * 1000),
+                "timed_out": False,
+                "_shards": {"total": len(searchers),
+                            "successful": len(searchers), "failed": 0},
+                "hits": {"total": reduced.total_hits,
+                         "max_score": None
+                         if reduced.max_score != reduced.max_score
+                         else reduced.max_score,
+                         "hits": hits},
+            }
+            if agg_specs:
+                merged = merge_shard_partials(
+                    agg_specs, [r.aggs for r in results if r.aggs])
+                resp["aggregations"] = render_aggs(agg_specs, merged)
+            return resp
 
     def clear_scroll(self, scroll_ids: list[str]) -> int:
         with self._scroll_lock:

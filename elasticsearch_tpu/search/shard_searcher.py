@@ -192,7 +192,8 @@ class ShardSearcher:
             else:
                 # device selection: lexicographic top-k over f64 comparator
                 # keys (keyword keys = this segment's sorted ordinals)
-                keys = sort_mod.segment_keys(seg, sort, scores, Q)
+                keys = sort_mod.segment_keys(seg, sort, scores, Q, seg_idx,
+                                             self.shard_id)
                 if search_after is not None:
                     match = match & sort_mod.after_mask(
                         seg, sort, search_after, keys)
@@ -213,7 +214,8 @@ class ShardSearcher:
                         local = int(order[qi, j])
                         dk = (seg_idx << SEG_SHIFT) | local
                         sc = float(sel_scores[qi, j])
-                        vals = sort_mod.materialize(seg, sort, local, sc, dk)
+                        vals = sort_mod.materialize(seg, sort, local, sc, dk,
+                                                    self.shard_id)
                         cands[qi].append(
                             (sort_mod.compare_key(vals, sort),
                              seg_idx, local, dk, sc, vals))

@@ -122,12 +122,18 @@ def _validate(sp: SortSpec, mappers) -> None:
 # Device comparator keys (per segment)
 # ---------------------------------------------------------------------------
 
-def _raw_key(seg, sp: SortSpec, scores, Q: int):
+def _raw_key(seg, sp: SortSpec, scores, Q: int, seg_idx: int = 0,
+             shard_id: int = 0):
     """(vals f64 [Q,N] or [N], missing bool [N] or None) before order/fill."""
     if sp.field == SCORE:
         return scores.astype(jnp.float64), None
     if sp.field == DOC:
-        return jnp.arange(seg.n_pad, dtype=jnp.float64), None
+        # shard<<42 | seg<<32 | local: a TOTAL order across shards AND
+        # segments (exact in f64 below 2^53) — the scroll cursor tiebreak.
+        # Same-key collisions across shards would make strict-after cursors
+        # skip docs, so the shard id must be part of the key.
+        return (jnp.float64((shard_id << 42) + (seg_idx << 32))
+                + jnp.arange(seg.n_pad, dtype=jnp.float64)), None
     nc = seg.numerics.get(sp.field)
     if nc is not None:
         return nc.vals.astype(jnp.float64), nc.missing
@@ -138,7 +144,8 @@ def _raw_key(seg, sp: SortSpec, scores, Q: int):
             jnp.ones((seg.n_pad,), bool))
 
 
-def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int) -> list:
+def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int,
+                 seg_idx: int = 0, shard_id: int = 0) -> list:
     """Ascending-comparable f64 keys, one [Q, n_pad] array per sort key.
 
     desc keys are negated; missing docs filled with +/-_BIG so _first/_last
@@ -147,7 +154,7 @@ def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int) -> list:
     """
     out = []
     for sp in specs:
-        vals, miss = _raw_key(seg, sp, scores, Q)
+        vals, miss = _raw_key(seg, sp, scores, Q, seg_idx, shard_id)
         if miss is not None and _is_number(sp.missing):
             vals = jnp.where(miss, jnp.float64(float(sp.missing)), vals)
             miss = None
@@ -225,7 +232,7 @@ def _bisect(values: list[str], x: str) -> int:
 # ---------------------------------------------------------------------------
 
 def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
-                doc_key: int) -> list:
+                doc_key: int, shard_id: int = 0) -> list:
     """Real user-facing sort values for one doc (the response `sort` array).
     None = missing. Strings for keywords, numbers for numerics."""
     out: list = []
@@ -234,7 +241,7 @@ def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
             out.append(float(score))
             continue
         if sp.field == DOC:
-            out.append(int(doc_key))
+            out.append((shard_id << 42) + int(doc_key))
             continue
         nc = seg.numerics.get(sp.field)
         if nc is not None:

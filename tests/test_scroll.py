@@ -1,0 +1,129 @@
+"""Scroll over a pinned point-in-time snapshot: O(depth) cursor advance,
+exact once-each coverage, and isolation from concurrent writes/deletes/
+merges (VERDICT r3 task 5 done-bar; ref search/scan/ScanContext.java:55,
+SearchService.java:316-330).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"}, "n": {"type": "long"},
+    "tag": {"type": "keyword"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+def _fill(node, index, count, shards=2):
+    node.create_index(index, settings={"number_of_shards": shards},
+                      mappings=MAPPING)
+    for i in range(count):
+        node.index_doc(index, str(i), {"body": f"doc number {i % 7} common",
+                                       "n": i, "tag": f"t{i % 3}"})
+        if i % 10 == 9:
+            node.refresh(index)   # several segments per shard
+    node.refresh(index)
+
+
+class TestScrollBasics:
+    def test_full_coverage_exactly_once(self, node):
+        _fill(node, "s", 53)
+        out = node.search("s", {"query": {"match_all": {}}, "size": 7},
+                          scroll="1m")
+        seen = [h["_id"] for h in out["hits"]["hits"]]
+        assert out["hits"]["total"] == 53
+        sid = out["_scroll_id"]
+        while True:
+            out = node.scroll(sid)
+            batch = [h["_id"] for h in out["hits"]["hits"]]
+            if not batch:
+                break
+            seen += batch
+        assert sorted(seen, key=int) == [str(i) for i in range(53)]
+        assert len(seen) == len(set(seen)), "no doc may repeat"
+
+    def test_score_order_and_no_sort_leak(self, node):
+        _fill(node, "sc", 30)
+        out = node.search("sc", {"query": {"match": {"body": "common"}},
+                                 "size": 5}, scroll="1m")
+        scores = [h["_score"] for h in out["hits"]["hits"]]
+        assert all(s is not None for s in scores)
+        assert scores == sorted(scores, reverse=True)
+        assert all("sort" not in h for h in out["hits"]["hits"])
+        out2 = node.scroll(out["_scroll_id"])
+        s2 = [h["_score"] for h in out2["hits"]["hits"]]
+        assert all(a >= b for a, b in zip(scores[-1:] + s2, s2))
+
+    def test_sorted_scroll(self, node):
+        _fill(node, "so", 25)
+        out = node.search("so", {"query": {"match_all": {}}, "size": 10,
+                                 "sort": [{"n": {"order": "desc"}}]},
+                          scroll="1m")
+        ns = [h["sort"][0] for h in out["hits"]["hits"]]
+        sid = out["_scroll_id"]
+        while True:
+            out = node.scroll(sid)
+            if not out["hits"]["hits"]:
+                break
+            ns += [h["sort"][0] for h in out["hits"]["hits"]]
+        assert ns == list(range(24, -1, -1))
+
+
+class TestScrollSnapshot:
+    def test_isolated_from_concurrent_writes(self, node):
+        _fill(node, "iso", 20)
+        out = node.search("iso", {"query": {"match_all": {}}, "size": 5},
+                          scroll="1m")
+        sid = out["_scroll_id"]
+        seen = [h["_id"] for h in out["hits"]["hits"]]
+        # mutate AFTER the scroll opened: new docs, deletes, a full merge
+        for i in range(20, 30):
+            node.index_doc("iso", str(i), {"body": "late arrival", "n": i})
+        unseen = [str(i) for i in range(20) if str(i) not in seen]
+        node.delete_doc("iso", unseen[0])
+        node.refresh("iso")
+        node.force_merge("iso")
+        while True:
+            out = node.scroll(sid)
+            if not out["hits"]["hits"]:
+                break
+            seen += [h["_id"] for h in out["hits"]["hits"]]
+        # the snapshot: all 20 original docs (incl. the one deleted later),
+        # none of the late arrivals
+        assert sorted(seen, key=int) == [str(i) for i in range(20)]
+
+    def test_clear_scroll_and_expiry(self, node):
+        _fill(node, "cl", 10)
+        out = node.search("cl", {"query": {"match_all": {}}, "size": 3},
+                          scroll="1m")
+        sid = out["_scroll_id"]
+        assert node.clear_scroll([sid]) == 1
+        with pytest.raises(Exception):
+            node.scroll(sid)
+
+    def test_scroll_rejects_rescore(self, node):
+        from elasticsearch_tpu.search.query_dsl import QueryParsingException
+        _fill(node, "rj", 5)
+        with pytest.raises(QueryParsingException):
+            node.search("rj", {"query": {"match_all": {}},
+                               "rescore": {"query": {"rescore_query":
+                                                     {"match_all": {}}}}},
+                        scroll="1m")
+
+    def test_scroll_first_batch_carries_aggs(self, node):
+        _fill(node, "ag", 12)
+        out = node.search("ag", {"query": {"match_all": {}}, "size": 4,
+                                 "aggs": {"tags": {"terms": {"field": "tag"}}}},
+                          scroll="1m")
+        assert "aggregations" in out
+        buckets = out["aggregations"]["tags"]["buckets"]
+        assert sum(b["doc_count"] for b in buckets) == 12
+        out2 = node.scroll(out["_scroll_id"])
+        assert "aggregations" not in out2

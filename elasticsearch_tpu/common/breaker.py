@@ -1,0 +1,114 @@
+"""Device-memory circuit breakers — HBM accounting with clean rejection.
+
+Analog of the reference's hierarchical breaker service
+(/root/reference/src/main/java/org/elasticsearch/indices/breaker/
+HierarchyCircuitBreakerService.java:43,51-61 and
+common/breaker/ChildMemoryCircuitBreaker.java): child breakers account
+bytes against their own limit AND a shared parent limit; a breach raises
+CircuitBreakingException (HTTP 429) instead of letting the device OOM.
+
+TPU mapping: the dominant device residents are segment postings/columns
+("fielddata" breaker) and the packed serving view's duplicate postings
+("request" breaker, evictable — a breach there degrades to the per-segment
+lane instead of raising).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class CircuitBreakingException(Exception):
+    """Would-exceed-budget rejection (maps to HTTP 429)."""
+
+    def __init__(self, breaker: str, wanted: int, limit: int, used: int):
+        super().__init__(
+            f"[{breaker}] data for device memory would be [{used + wanted}] "
+            f"bytes, which is larger than the limit of [{limit}] bytes")
+        self.breaker = breaker
+        self.wanted = wanted
+        self.limit = limit
+        self.used = used
+
+
+class CircuitBreaker:
+    """One child breaker: used-bytes counter with a limit share."""
+
+    def __init__(self, name: str, limit: int, parent: "CircuitBreakerService"):
+        self.name = name
+        self.limit = limit
+        self.parent = parent
+        self.used = 0
+        self.tripped = 0
+
+    def add_estimate(self, n_bytes: int, check: bool = True) -> None:
+        """Account n_bytes; raise (charging nothing) when over this child's
+        limit or the parent total. check=False force-charges (recovery/boot
+        paths must load regardless, like the reference's unbreakable adds)."""
+        with self.parent._lock:
+            if check and self.limit > 0 and self.used + n_bytes > self.limit:
+                self.tripped += 1
+                raise CircuitBreakingException(
+                    self.name, n_bytes, self.limit, self.used)
+            if check:
+                self.parent._check_parent(self, n_bytes)
+            self.used += n_bytes
+
+    def release(self, n_bytes: int) -> None:
+        with self.parent._lock:
+            self.used = max(0, self.used - n_bytes)
+
+    def stats(self) -> dict:
+        return {"limit_size_in_bytes": self.limit,
+                "estimated_size_in_bytes": self.used,
+                "tripped": self.tripped}
+
+
+class CircuitBreakerService:
+    """Parent limit + named children (fielddata = resident segments,
+    request = evictable serving views)."""
+
+    def __init__(self, settings=None):
+        get = settings.get_bytes if settings is not None else lambda k, d: d
+        total = get("indices.breaker.total.limit", 6 << 30)
+        self._lock = threading.RLock()
+        self.total_limit = int(total)
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self.breaker("fielddata",
+                     int(get("indices.breaker.fielddata.limit",
+                             int(self.total_limit * 0.8))))
+        self.breaker("request",
+                     int(get("indices.breaker.request.limit",
+                             int(self.total_limit * 0.6))))
+
+    def breaker(self, name: str, limit: int | None = None) -> CircuitBreaker:
+        b = self.breakers.get(name)
+        if b is None:
+            b = CircuitBreaker(name, limit if limit is not None
+                               else self.total_limit, self)
+            self.breakers[name] = b
+        return b
+
+    def _check_parent(self, child: CircuitBreaker, wanted: int) -> None:
+        # caller holds the lock
+        total_used = sum(b.used for b in self.breakers.values())
+        if self.total_limit > 0 and total_used + wanted > self.total_limit:
+            child.tripped += 1
+            raise CircuitBreakingException(
+                "parent", wanted, self.total_limit, total_used)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {n: b.stats() for n, b in self.breakers.items()}
+            out["parent"] = {
+                "limit_size_in_bytes": self.total_limit,
+                "estimated_size_in_bytes": sum(
+                    b.used for b in self.breakers.values())}
+            return out
+
+
+# a process-wide no-limit service for embedded/test use without accounting
+NOOP = CircuitBreakerService()
+NOOP.total_limit = 0
+for _b in NOOP.breakers.values():
+    _b.limit = 0

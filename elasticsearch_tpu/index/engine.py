@@ -67,9 +67,14 @@ class Engine:
     MAX_BUFFER_DOCS = 65536          # refresh trigger (indexing buffer analog)
 
     def __init__(self, shard_path: str, mappers: MapperService,
-                 type_name_default: str = "_doc", durability: str = "request"):
+                 type_name_default: str = "_doc", durability: str = "request",
+                 breaker=None):
         self.path = shard_path
         self.mappers = mappers
+        # HBM accounting (common/breaker.py; ref HierarchyCircuitBreaker-
+        # Service): segments charge the "fielddata" breaker at build time
+        self.breaker = breaker
+        self._blocked_reason = None
         os.makedirs(shard_path, exist_ok=True)
         from .store import SegmentStore
         self.store = SegmentStore(shard_path)
@@ -98,6 +103,11 @@ class Engine:
         checksum (ref index/store/Store.java recovery verification)."""
         segments, tombstones = self.store.load()
         self.segments = segments
+        if self.breaker is not None:
+            # recovery loads regardless of pressure (unbreakable add) —
+            # refusing to boot would lose availability, not memory
+            for s in segments:
+                self.breaker.add_estimate(s.memory_bytes(), check=False)
         self._next_seg_id = max((s.seg_id for s in segments), default=0) + 1
         # rebuild the LiveVersionMap: manifest order is chronological, so
         # later segments override earlier ones for re-indexed docs
@@ -157,6 +167,11 @@ class Engine:
               version: int | None = None, version_type: str = "internal",
               op_type: str = "index", sync: bool | None = None) -> EngineResult:
         with self._lock:
+            if self._blocked_reason is not None:
+                # a previous refresh tripped the breaker: re-attempt it (the
+                # budget may have been freed); still-over-limit re-raises
+                # BEFORE this write applies — a clean 429, no partial state
+                self.refresh()
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
             self._apply_index(doc_id, source, type_name, new_version)
@@ -231,7 +246,10 @@ class Engine:
 
     def refresh(self) -> None:
         """Freeze the write buffer into a new device segment — the NRT
-        'new searcher' event (ref InternalEngine refresh, default 1s)."""
+        'new searcher' event (ref InternalEngine refresh, default 1s).
+        Charges the segment's device bytes against the breaker; a breach
+        keeps the buffer, marks the engine write-blocked, and raises
+        CircuitBreakingException (HTTP 429) — never an OOM."""
         with self._lock:
             if not self._buffer_docs:
                 return
@@ -242,6 +260,13 @@ class Engine:
                 builder.add(parsed, tname,
                             version=self.versions[doc_id][0])
             seg = builder.build()
+            if self.breaker is not None:
+                try:
+                    self.breaker.add_estimate(seg.memory_bytes())
+                except Exception as e:
+                    self._blocked_reason = e
+                    raise
+            self._blocked_reason = None
             self._next_seg_id += 1
             self.segments.append(seg)
             self._buffer_docs.clear()
@@ -266,6 +291,7 @@ class Engine:
     def _merge_subset(self, subset: list[Segment]) -> None:
         chosen = set(id(s) for s in subset)
         merged = merge_segments(subset, self._next_seg_id)
+        self._charge_merge(merged, subset)
         self._next_seg_id += 1
         out: list[Segment] = []
         placed = False
@@ -287,9 +313,18 @@ class Engine:
                 if not any(s.live_count < s.n_docs for s in self.segments):
                     return
             merged = merge_segments(self.segments, self._next_seg_id)
+            self._charge_merge(merged, self.segments)
             self._next_seg_id += 1
             self.segments = [merged] if merged.n_docs else []
             self.merge_count += 1
+
+    def _charge_merge(self, merged: Segment, sources: list[Segment]) -> None:
+        """Swap breaker accounting from the source segments to the merged
+        one (the merged set is usually smaller: tombstones purged)."""
+        if self.breaker is None:
+            return
+        self.breaker.add_estimate(merged.memory_bytes(), check=False)
+        self.breaker.release(sum(s.memory_bytes() for s in sources))
 
     def flush(self) -> None:
         """Commit: write NEW segment files + the checksummed commit point,
@@ -328,4 +363,7 @@ class Engine:
                 "buffered_docs": len(self._buffer_docs)}
 
     def close(self) -> None:
+        if self.breaker is not None:
+            self.breaker.release(sum(s.memory_bytes()
+                                     for s in self.segments))
         self.translog.close()

@@ -21,7 +21,7 @@ from .engine import Engine, EngineResult, GetResult
 
 class IndexService:
     def __init__(self, name: str, path: str, settings: Settings | None = None,
-                 mappings: dict | None = None):
+                 mappings: dict | None = None, breakers=None):
         self.name = name
         self.path = path
         self.settings = settings if settings is not None else EMPTY_SETTINGS
@@ -30,9 +30,11 @@ class IndexService:
         self.n_shards = int(get("number_of_shards", 1) or 1)
         self.n_replicas = int(get("number_of_replicas", 1) or 1)
         self.aliases: set[str] = set()
+        self.breakers = breakers           # CircuitBreakerService | None
+        fd = breakers.breaker("fielddata") if breakers is not None else None
         self.mappers = MapperService(mappings=mappings or {})
         self.shards: list[Engine] = [
-            Engine(os.path.join(path, str(s)), self.mappers)
+            Engine(os.path.join(path, str(s)), self.mappers, breaker=fd)
             for s in range(self.n_shards)]
         self.creation_date = None
         # searcher cache: rebuilt per shard only when its segment set changes
@@ -88,6 +90,11 @@ class IndexService:
     def close(self) -> None:
         for e in self.shards:
             e.close()
+        if self.breakers is not None and self._packed_cache is not None \
+                and self._packed_cache[1] is not None:
+            self.breakers.breaker("request").release(
+                self._packed_cache[1].memory_bytes)
+            self._packed_cache = None
 
     def delete_files(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)
@@ -108,7 +115,10 @@ class IndexService:
 
     def packed_view(self):
         """The one-device-program serving view for this index (all shards'
-        segments fused). None when the index is empty."""
+        segments fused). None when the index is empty, or when the "request"
+        breaker refuses the view's duplicate postings (the packed view
+        roughly doubles device residency for text fields — breach degrades
+        to the per-segment lane, it never raises)."""
         from ..serving.packed_view import PackedIndexView
         entries = [(si, seg) for si, e in enumerate(self.shards)
                    for seg in e.segments]
@@ -116,7 +126,13 @@ class IndexService:
             return None
         key = tuple((si, seg.seg_id) for si, seg in entries)
         if self._packed_cache is None or self._packed_cache[0] != key:
-            self._packed_cache = (key, PackedIndexView(entries))
+            req = self.breakers.breaker("request") \
+                if self.breakers is not None else None
+            if self._packed_cache is not None \
+                    and self._packed_cache[1] is not None and req is not None:
+                req.release(self._packed_cache[1].memory_bytes)
+            view = PackedIndexView(entries, breaker=req)
+            self._packed_cache = (key, view)
         return self._packed_cache[1]
 
     # -- introspection -----------------------------------------------------

@@ -59,6 +59,8 @@ class NodeService:
         self.data_path = data_path
         self.settings = settings or Settings()
         self.cluster_name = cluster_name
+        from .common.breaker import CircuitBreakerService
+        self.breakers = CircuitBreakerService(self.settings)
         self.indices: dict[str, IndexService] = {}
         self.templates: dict[str, dict] = {}
         # scroll contexts: id -> (index expr, body, cursor, expiry)
@@ -84,7 +86,8 @@ class NodeService:
                 meta = json.load(f)
             self.indices[name] = IndexService(
                 name, os.path.join(self.data_path, name),
-                Settings(meta.get("settings", {})), meta.get("mappings", {}))
+                Settings(meta.get("settings", {})), meta.get("mappings", {}),
+                breakers=self.breakers)
             self.indices[name].aliases = set(meta.get("aliases", []))
 
     def _persist_index_meta(self, svc: IndexService) -> None:
@@ -118,7 +121,8 @@ class NodeService:
                     merged_mappings.setdefault(t, m)
                 merged_aliases |= set((tpl.get("aliases") or {}).keys())
         svc = IndexService(name, os.path.join(self.data_path, name),
-                           Settings(merged_settings), merged_mappings)
+                           Settings(merged_settings), merged_mappings,
+                           breakers=self.breakers)
         svc.aliases = merged_aliases
         self.indices[name] = svc
         self._persist_index_meta(svc)
@@ -262,8 +266,10 @@ class NodeService:
                 items.append({action: {"_index": index, "_id": doc_id,
                                        "status": 409, "error": str(e)}})
             except Exception as e:  # noqa: BLE001 — per-item error contract
+                from .common.breaker import CircuitBreakingException
+                st = 429 if isinstance(e, CircuitBreakingException) else 400
                 items.append({action: {"_index": index, "_id": doc_id,
-                                       "status": 400, "error": str(e)}})
+                                       "status": st, "error": str(e)}})
         for name in touched:
             svc = self.indices.get(name)
             if svc is not None:
@@ -439,6 +445,8 @@ class NodeService:
         field, k1, b = specs[0][1], specs[0][2], specs[0][3]
         if any(s[1] != field or s[2] != k1 or s[3] != b for s in specs[1:]):
             return None
+        if not view.servable(field):
+            return None     # request breaker refused the packed postings
         queries = [s[0] for s in specs]
         k = max(size + from_, 1)
         scores, docs, hits = view.search(field, queries, k=k, k1=k1, b=b)
@@ -853,7 +861,8 @@ class NodeService:
         }
 
     def stats(self) -> dict:
-        return {"indices": {n: s.stats() for n, s in self.indices.items()}}
+        return {"indices": {n: s.stats() for n, s in self.indices.items()},
+                "breakers": self.breakers.stats()}
 
     def close(self) -> None:
         for svc in self.indices.values():

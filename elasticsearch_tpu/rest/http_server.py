@@ -34,8 +34,11 @@ class RestError(Exception):
 
 
 def _status_of(e: Exception) -> int:
+    from ..common.breaker import CircuitBreakingException
     if isinstance(e, RestError):
         return e.status
+    if isinstance(e, CircuitBreakingException):
+        return 429     # TOO_MANY_REQUESTS, ref EsRejectedExecutionException
     if isinstance(e, IndexMissingException):
         return 404
     if isinstance(e, DocumentMissingException):

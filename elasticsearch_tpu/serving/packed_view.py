@@ -87,9 +87,13 @@ class PackedField:
 class PackedIndexView:
     """The fused serving structure for one index (all shards, all segments)."""
 
-    def __init__(self, segments: list[tuple[int, Segment]]):
-        """segments: (shard_idx, segment) in stable (shard, seg) order."""
+    def __init__(self, segments: list[tuple[int, Segment]], breaker=None):
+        """segments: (shard_idx, segment) in stable (shard, seg) order.
+        breaker: optional "request" CircuitBreaker — each lazily-packed
+        field charges its device bytes; a breach makes that field
+        unservable by this view (field() returns None) instead of raising."""
         self.entries = segments
+        self.breaker = breaker
         sizes = np.array([s.n_pad for _, s in segments], np.int64)
         self.bases = np.zeros(len(segments) + 1, np.int64)
         np.cumsum(sizes, out=self.bases[1:])
@@ -120,6 +124,7 @@ class PackedIndexView:
                               is None)
 
         self._fields: dict[str, PackedField | None] = {}
+        self._refused: set[str] = set()   # breaker-refused (≠ absent) fields
         self._live_key: tuple | None = None
         self._live_dev: jax.Array | None = None
         self.device_calls = 0           # serving counters (observability)
@@ -153,6 +158,12 @@ class PackedIndexView:
                 # (persistent XLA cache makes this ~free after first run)
                 self.warmup(field=name)
         return self._fields[name]
+
+    def servable(self, name: str) -> bool:
+        """False when the request breaker refused this field's packed
+        postings — the caller must fall back to the per-segment lane."""
+        self.field(name)
+        return name not in self._refused
 
     def _pack_field(self, name: str) -> PackedField | None:
         per_seg = []                    # (entry_idx, fx, host doc_ids)
@@ -197,6 +208,16 @@ class PackedIndexView:
             sum_dl += fx.sum_dl
             off += P
 
+        if self.breaker is not None:
+            from ..common.breaker import CircuitBreakingException
+            try:
+                self.breaker.add_estimate(p_pad * 12)
+            except CircuitBreakingException:
+                # NOT the same as an absent field (which legitimately serves
+                # empty results): refusal must push the query to the
+                # per-segment lane, so callers check servable()
+                self._refused.add(name)
+                return None
         self.memory_bytes += p_pad * 12
         return PackedField(
             doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),

@@ -71,6 +71,8 @@ class NodeService:
         self._scroll_seq = 0
         self._scroll_lock = threading.Lock()
         os.makedirs(data_path, exist_ok=True)
+        from .snapshots import SnapshotsService
+        self.snapshots = SnapshotsService(self)
         self._recover_indices()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----

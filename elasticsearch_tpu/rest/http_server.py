@@ -39,6 +39,12 @@ def _status_of(e: Exception) -> int:
         return e.status
     if isinstance(e, CircuitBreakingException):
         return 429     # TOO_MANY_REQUESTS, ref EsRejectedExecutionException
+    from ..snapshots import (RepositoryException, SnapshotException,
+                             SnapshotMissingException)
+    if isinstance(e, SnapshotMissingException):
+        return 404
+    if isinstance(e, (RepositoryException, SnapshotException)):
+        return 400
     if isinstance(e, IndexMissingException):
         return 404
     if isinstance(e, DocumentMissingException):
@@ -116,6 +122,28 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         node.put_template(g["name"], _json_body(b))
         return 200, {"acknowledged": True}
     c.register("PUT", "/_template/{name}", put_template)
+
+    # -- snapshots (ref rest/action/admin/cluster/snapshots/) --------------
+    c.register("PUT", "/_snapshot/{repo}",
+               lambda g, p, b: (200, node.snapshots.put_repository(
+                   g["repo"], _json_body(b))))
+    c.register("POST", "/_snapshot/{repo}",
+               lambda g, p, b: (200, node.snapshots.put_repository(
+                   g["repo"], _json_body(b))))
+    c.register("GET", "/_snapshot/{repo}",
+               lambda g, p, b: (200, node.snapshots.get_repository(g["repo"])))
+    c.register("PUT", "/_snapshot/{repo}/{snap}",
+               lambda g, p, b: (200, node.snapshots.create_snapshot(
+                   g["repo"], g["snap"], _json_body(b))))
+    c.register("GET", "/_snapshot/{repo}/{snap}",
+               lambda g, p, b: (200, node.snapshots.get_snapshots(
+                   g["repo"], g["snap"])))
+    c.register("DELETE", "/_snapshot/{repo}/{snap}",
+               lambda g, p, b: (200, node.snapshots.delete_snapshot(
+                   g["repo"], g["snap"])))
+    c.register("POST", "/_snapshot/{repo}/{snap}/_restore",
+               lambda g, p, b: (200, node.snapshots.restore_snapshot(
+                   g["repo"], g["snap"], _json_body(b))))
 
     # -- search (must register before the generic doc routes) -------------
     def search(g, p, b):

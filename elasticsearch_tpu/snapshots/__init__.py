@@ -1,0 +1,17 @@
+"""Snapshot / restore over the binary segment store.
+
+Analog of the reference's snapshot machinery
+(/root/reference/src/main/java/org/elasticsearch/snapshots/SnapshotsService.java
++ repositories/blobstore/BlobStoreRepository.java): a filesystem repository
+holds content-addressed copies of the write-once segment files; snapshots
+are manifests referencing blobs by checksum, so a second snapshot of a
+mostly-unchanged index copies only the new segments (incremental by
+construction — the same dedupe the reference gets from Lucene's immutable
+segment files).
+"""
+
+from .service import (RepositoryException, SnapshotException,
+                      SnapshotMissingException, SnapshotsService)
+
+__all__ = ["SnapshotsService", "SnapshotException",
+           "SnapshotMissingException", "RepositoryException"]

@@ -1,0 +1,282 @@
+"""Filesystem snapshot repository + snapshot/restore service.
+
+Repository layout (ref BlobStoreRepository's blob-per-file model):
+
+    <location>/index.json             snapshot registry for the repo
+    <location>/blobs/<crc>_<size>     content-addressed segment files
+    <location>/snap_<name>.json       one manifest per snapshot
+
+A blob is keyed by (crc32, size) of the source file; identical segment
+files across snapshots share one blob — the incremental property. Restore
+copies blobs back into a fresh index directory, writes the shard commit
+points and index _meta, and boots an IndexService over them (recovery is
+the store's checksum-verified load; no re-analysis).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+
+from ..common.settings import Settings
+from ..index.store import MANIFEST, _crc
+
+
+class RepositoryException(Exception):
+    pass
+
+
+class SnapshotException(Exception):
+    pass
+
+
+class SnapshotMissingException(Exception):
+    def __init__(self, repo: str, snap: str):
+        super().__init__(f"[{repo}:{snap}] snapshot is missing")
+
+
+class SnapshotsService:
+    """Registered repositories + snapshot lifecycle for one node."""
+
+    def __init__(self, node):
+        self.node = node
+        self._registry = os.path.join(node.data_path, "_repositories.json")
+        self.repos: dict[str, dict] = {}
+        if os.path.exists(self._registry):
+            with open(self._registry) as f:
+                self.repos = json.load(f)
+
+    # -- repositories ------------------------------------------------------
+
+    def put_repository(self, name: str, body: dict) -> dict:
+        rtype = (body or {}).get("type")
+        if rtype != "fs":
+            raise RepositoryException(
+                f"repository type [{rtype}] not supported (only [fs])")
+        location = (body.get("settings") or {}).get("location")
+        if not location:
+            raise RepositoryException("missing location setting")
+        os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
+        idx = os.path.join(location, "index.json")
+        if not os.path.exists(idx):
+            self._write_json(idx, {"snapshots": []})
+        self.repos[name] = {"type": "fs", "settings": {"location": location}}
+        self._write_json(self._registry, self.repos)
+        return {"acknowledged": True}
+
+    def get_repository(self, name: str) -> dict:
+        if name not in self.repos:
+            raise RepositoryException(f"[{name}] missing repository")
+        return {name: self.repos[name]}
+
+    def _location(self, repo: str) -> str:
+        if repo not in self.repos:
+            raise RepositoryException(f"[{repo}] missing repository")
+        return self.repos[repo]["settings"]["location"]
+
+    # -- snapshot creation -------------------------------------------------
+
+    def create_snapshot(self, repo: str, snapshot: str,
+                        body: dict | None = None) -> dict:
+        loc = self._location(repo)
+        registry = self._read_json(os.path.join(loc, "index.json"))
+        if snapshot in registry["snapshots"]:
+            raise SnapshotException(
+                f"[{repo}:{snapshot}] snapshot already exists")
+        indices_expr = (body or {}).get("indices", "_all")
+        names = self.node._resolve(indices_expr)
+        if not names:
+            raise SnapshotException(f"no indices match [{indices_expr}]")
+
+        manifest = {"snapshot": snapshot, "state": "SUCCESS",
+                    "start_time": time.time(), "indices": {}}
+        copied = 0
+        shared = 0
+        for name in names:
+            svc = self.node.indices[name]
+            svc.flush()     # segments + commit point durable on disk
+            shards = []
+            for eng in svc.shards:
+                with eng._lock:
+                    entries = []
+                    for seg in eng.segments:
+                        eng.store.write_segment(seg)
+                        crc, docs_crc = eng.store.persisted[seg.seg_id]
+                        npz = os.path.join(eng.path,
+                                           f"seg_{seg.seg_id}.npz")
+                        docs = os.path.join(
+                            eng.path, f"seg_{seg.seg_id}.docs.jsonl")
+                        blob, was_new = self._blobize(loc, npz, crc)
+                        copied += was_new
+                        shared += (not was_new)
+                        docs_blob, was_new = self._blobize(loc, docs,
+                                                           docs_crc)
+                        copied += was_new
+                        shared += (not was_new)
+                        entries.append({
+                            "seg_id": seg.seg_id, "blob": blob,
+                            "docs_blob": docs_blob, "crc": crc,
+                            "docs_crc": docs_crc,
+                            "dead": [int(i) for i in range(seg.n_docs)
+                                     if not seg.live_host[i]]})
+                    tombstones = {k: v[0] for k, v in eng.versions.items()
+                                  if v[1]}
+                    shards.append({"segments": entries,
+                                   "tombstones": tombstones})
+            manifest["indices"][name] = {
+                "settings": dict(svc.settings),
+                "mappings": svc.mappings_dict(),
+                "aliases": sorted(svc.aliases),
+                "shards": shards,
+            }
+        manifest["end_time"] = time.time()
+        self._write_json(os.path.join(loc, f"snap_{snapshot}.json"), manifest)
+        registry["snapshots"].append(snapshot)
+        self._write_json(os.path.join(loc, "index.json"), registry)
+        return {"snapshot": {"snapshot": snapshot, "state": "SUCCESS",
+                             "indices": sorted(manifest["indices"]),
+                             "blobs_copied": copied,
+                             "blobs_shared": shared}}
+
+    def _blobize(self, loc: str, path: str, crc: int) -> tuple[str, bool]:
+        """Copy-by-checksum: blob key = crc+size; existing blobs are shared
+        (the incremental dedupe; ref BlobStoreRepository generation reuse)."""
+        size = os.path.getsize(path)
+        key = f"{crc:08x}_{size}"
+        dest = os.path.join(loc, "blobs", key)
+        if os.path.exists(dest):
+            return key, False
+        tmp = dest + ".tmp"
+        shutil.copyfile(path, tmp)
+        if _crc(tmp) != crc:
+            os.remove(tmp)
+            raise SnapshotException(f"checksum changed while copying {path}")
+        os.replace(tmp, dest)
+        return key, True
+
+    # -- introspection / deletion ------------------------------------------
+
+    def get_snapshots(self, repo: str, snapshot: str = "_all") -> dict:
+        loc = self._location(repo)
+        registry = self._read_json(os.path.join(loc, "index.json"))
+        names = registry["snapshots"] if snapshot in ("_all", "*") \
+            else [snapshot]
+        out = []
+        for n in names:
+            p = os.path.join(loc, f"snap_{n}.json")
+            if not os.path.exists(p):
+                raise SnapshotMissingException(repo, n)
+            m = self._read_json(p)
+            out.append({"snapshot": n, "state": m["state"],
+                        "indices": sorted(m["indices"])})
+        return {"snapshots": out}
+
+    def delete_snapshot(self, repo: str, snapshot: str) -> dict:
+        loc = self._location(repo)
+        registry = self._read_json(os.path.join(loc, "index.json"))
+        if snapshot not in registry["snapshots"]:
+            raise SnapshotMissingException(repo, snapshot)
+        registry["snapshots"].remove(snapshot)
+        os.remove(os.path.join(loc, f"snap_{snapshot}.json"))
+        self._write_json(os.path.join(loc, "index.json"), registry)
+        self._gc_blobs(loc, registry["snapshots"])
+        return {"acknowledged": True}
+
+    def _gc_blobs(self, loc: str, snapshots: list[str]) -> None:
+        live: set[str] = set()
+        for n in snapshots:
+            m = self._read_json(os.path.join(loc, f"snap_{n}.json"))
+            for imeta in m["indices"].values():
+                for shard in imeta["shards"]:
+                    for e in shard["segments"]:
+                        live.add(e["blob"])
+                        live.add(e["docs_blob"])
+        bdir = os.path.join(loc, "blobs")
+        for fn in os.listdir(bdir):
+            if fn not in live and not fn.endswith(".tmp"):
+                os.remove(os.path.join(bdir, fn))
+
+    # -- restore -----------------------------------------------------------
+
+    def restore_snapshot(self, repo: str, snapshot: str,
+                         body: dict | None = None) -> dict:
+        from ..index.index_service import IndexService
+        from ..index.store import FORMAT
+
+        body = body or {}
+        loc = self._location(repo)
+        p = os.path.join(loc, f"snap_{snapshot}.json")
+        if not os.path.exists(p):
+            raise SnapshotMissingException(repo, snapshot)
+        manifest = self._read_json(p)
+        wanted = body.get("indices")
+        if wanted:
+            names = [n for n in manifest["indices"]
+                     if n in (wanted if isinstance(wanted, list)
+                              else wanted.split(","))]
+        else:
+            names = list(manifest["indices"])
+        pat = body.get("rename_pattern")
+        repl = body.get("rename_replacement")
+
+        restored = []
+        for name in names:
+            dest = re.sub(pat, repl, name) if pat and repl else name
+            if dest in self.node.indices:
+                raise SnapshotException(
+                    f"cannot restore [{name}] to [{dest}]: index exists "
+                    f"(close/delete it or use rename_pattern)")
+            imeta = manifest["indices"][name]
+            dest_path = os.path.join(self.node.data_path, dest)
+            for si, shard in enumerate(imeta["shards"]):
+                sp = os.path.join(dest_path, str(si))
+                os.makedirs(sp, exist_ok=True)
+                commit = {"format": FORMAT, "segments": [],
+                          "tombstones": shard["tombstones"]}
+                for e in shard["segments"]:
+                    for blob_key, fname, crc_key in (
+                            (e["blob"], f"seg_{e['seg_id']}.npz", "crc"),
+                            (e["docs_blob"],
+                             f"seg_{e['seg_id']}.docs.jsonl", "docs_crc")):
+                        src = os.path.join(loc, "blobs", blob_key)
+                        dst = os.path.join(sp, fname)
+                        shutil.copyfile(src, dst)
+                        if _crc(dst) != e[crc_key]:
+                            raise SnapshotException(
+                                f"blob {blob_key} failed verification")
+                    commit["segments"].append({
+                        "seg_id": e["seg_id"],
+                        "file": f"seg_{e['seg_id']}.npz",
+                        "docs_file": f"seg_{e['seg_id']}.docs.jsonl",
+                        "crc": e["crc"], "docs_crc": e["docs_crc"],
+                        "dead": e["dead"]})
+                self._write_json(os.path.join(sp, MANIFEST), commit)
+            svc = IndexService(dest, dest_path,
+                               Settings(imeta["settings"]),
+                               imeta["mappings"],
+                               breakers=getattr(self.node, "breakers", None))
+            svc.aliases = set(imeta.get("aliases", []))
+            self.node.indices[dest] = svc
+            self.node._persist_index_meta(svc)
+            restored.append(dest)
+        return {"snapshot": {"snapshot": snapshot, "indices": restored,
+                             "shards": {"failed": 0}}}
+
+    # -- io ----------------------------------------------------------------
+
+    @staticmethod
+    def _write_json(path: str, obj) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_json(path: str):
+        with open(path) as f:
+            return json.load(f)

@@ -1,0 +1,49 @@
+"""Device aggregation kernels: masked bincount + fused numeric stats.
+
+The collect step of the aggregation framework (search/aggs/aggregators.py)
+runs these on device when the query mask is already device-resident (the
+sparse/packed serving lanes produce it there): one fused XLA program per
+(segment, agg) pair returning a SMALL psum-able partial — counts [V] or a
+5-scalar stats vector — instead of downloading a bool[N] mask per segment
+and reducing on host.
+
+ref search/aggregations/bucket/terms/TermsAggregator (collect loop) and
+metrics/stats/StatsAggregator — here the whole collect is one reduction,
+not a per-doc callback.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def masked_bincount(ords, mask, *, n_bins: int):
+    """Counts per ordinal among masked docs. ords i32[N] (-1 = missing),
+    mask bool[N] -> i32[n_bins]. Missing/unmasked docs fall into a spill
+    bin that is sliced off."""
+    idx = jnp.where(mask & (ords >= 0), ords, n_bins)
+    return jnp.bincount(idx, length=n_bins + 1)[:n_bins]
+
+
+@jax.jit
+def masked_stats(vals, missing, mask):
+    """Fused (count, sum, sum_sq, min, max) over masked present docs.
+    vals f64[N]/i64[N], missing bool[N], mask bool[N] -> f64[5]."""
+    sel = mask & ~missing
+    v = vals.astype(jnp.float64)
+    vz = jnp.where(sel, v, 0.0)
+    cnt = sel.sum().astype(jnp.float64)
+    s = vz.sum()
+    ss = (vz * vz).sum()
+    mn = jnp.where(sel, v, jnp.inf).min()
+    mx = jnp.where(sel, v, -jnp.inf).max()
+    return jnp.stack([cnt, s, ss, mn, mx])
+
+
+@jax.jit
+def count_mask(mask):
+    return mask.sum()

@@ -40,9 +40,16 @@ from .hll import HyperLogLog, _hash64
 from .tdigest import TDigest
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
-                "filter", "filters", "global", "missing"}
+                "filter", "filters", "global", "missing",
+                "significant_terms"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
-                "extended_stats", "cardinality", "percentiles"}
+                "extended_stats", "cardinality", "percentiles", "top_hits"}
+
+
+def has_top_hits(specs: list["AggSpec"]) -> bool:
+    """top_hits needs per-doc scores, which only the dense scoring path
+    materializes — the sparse lane checks this before taking an agg tree."""
+    return any(s.type == "top_hits" or has_top_hits(s.subs) for s in specs)
 
 
 class AggregationParsingException(Exception):
@@ -119,21 +126,64 @@ def _keyword_column(seg: Segment, field: str):
 # Collect: per-segment vectorized partials
 # ---------------------------------------------------------------------------
 
+class MaskView:
+    """A query-match mask that stays DEVICE-resident until a collector
+    genuinely needs host numpy. The hot collectors (keyword terms, numeric
+    metrics) consume `.dev` through ops/aggs kernels — one fused device
+    reduction per (segment, agg), downloading a tiny partial instead of a
+    bool[n_pad] mask. Everything else falls back to `.np` (downloaded once,
+    cached)."""
+
+    __slots__ = ("_dev", "_np")
+
+    def __init__(self, m):
+        if isinstance(m, np.ndarray):
+            self._np = m
+            self._dev = None
+        else:
+            self._dev = m
+            self._np = None
+
+    @property
+    def dev(self):
+        return self._dev
+
+    @property
+    def np(self) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self._dev)
+        return self._np
+
+
+def _mv(m) -> MaskView:
+    return m if isinstance(m, MaskView) else MaskView(m)
+
+
 def collect_shard(specs: list[AggSpec], segments: list[Segment],
-                  masks: list[np.ndarray],
-                  query_parser=None) -> dict:
+                  masks: list,
+                  query_parser=None, scores: list | None = None) -> dict:
     """Collect the agg tree over one shard's segments.
-    masks[i]: bool[n_pad] — (match & live) for segment i from the query phase.
+    masks[i]: bool[n_pad] — (match & live) for segment i from the query
+    phase; either host numpy or a device array (kept on device, MaskView).
+    scores[i]: optional f32[n_pad] score row per segment (top_hits needs it).
     query_parser: compiles filter/filters sub-queries (avoids circular import).
     """
+    masks = [_mv(m) for m in masks]
+    if scores is None:
+        scores = [None] * len(segments)
     partials = {}
     for spec in specs:
         if spec.type == "terms":
             partials[spec.name] = _collect_terms_shard(
+                spec, segments, masks, query_parser, scores)
+            continue
+        if spec.type == "significant_terms":
+            partials[spec.name] = _collect_sig_terms_shard(
                 spec, segments, masks, query_parser)
             continue
-        segs_partials = [_collect_one(spec, seg, mask, query_parser)
-                         for seg, mask in zip(segments, masks)]
+        segs_partials = [
+            _collect_one(spec, seg, mask, query_parser, scores_row=sc)
+            for seg, mask, sc in zip(segments, masks, scores)]
         merged = segs_partials[0] if segs_partials else _empty_partial(spec)
         for p in segs_partials[1:]:
             merged = merge_partial(spec, merged, p)
@@ -141,8 +191,53 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
     return partials
 
 
+def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
+                             masks: list, qp) -> dict:
+    """significant_terms (ref search/aggregations/bucket/significant/
+    SignificantTermsAggregator + JLHScore): per-key FOREGROUND counts over
+    the query matches and BACKGROUND counts over the whole index travel in
+    the partial; the score is computed at render over the merged totals."""
+    fg: dict = {}
+    fg_total = 0
+    bg_total = 0
+    for seg, mask in zip(segments, masks):
+        for key, c in _terms_counts(spec, seg, mask).items():
+            fg[key] = fg.get(key, 0) + c
+        fg_total += int(_mv(mask).np.sum())
+        bg_total += seg.live_count
+    size = int(spec.params.get("size", 10)) or len(fg) or 1
+    shard_size = int(spec.params.get("shard_size", size * 3 + 10))
+    top = sorted(fg.items(), key=lambda kv: (-kv[1], str(kv[0])))[:shard_size]
+    buckets: dict = {}
+    for key, c in top:
+        bg = 0
+        for seg in segments:
+            m = _terms_key_mask(spec, seg, key)
+            if m is not None:
+                bg += int((m[: seg.n_pad]
+                           & seg.live_host[: len(m)]).sum())
+        entry: dict = {"doc_count": int(c), "bg_count": bg}
+        if spec.subs:
+            sub_parts: dict = {}
+            for seg, mask in zip(segments, masks):
+                m = _terms_key_mask(spec, seg, key)
+                if m is None:
+                    continue
+                m = m & _mv(mask).np
+                for s in spec.subs:
+                    part = _collect_one(s, seg, m, qp)
+                    prev = sub_parts.get(s.name)
+                    sub_parts[s.name] = part if prev is None \
+                        else merge_partial(s, prev, part)
+            entry["subs"] = {s.name: sub_parts.get(s.name, _empty_partial(s))
+                             for s in spec.subs}
+        buckets[key] = entry
+    return {"buckets": buckets, "fg_total": fg_total, "bg_total": bg_total}
+
+
 def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
-                         masks: list[np.ndarray], qp) -> dict:
+                         masks: list[np.ndarray], qp,
+                         scores: list | None = None) -> dict:
     """Two-pass terms collection with correct shard_size semantics (ref
     bucket/terms/TermsAggregator shard_size over-collection): pass 1 counts
     every key across ALL segments (vectorized, cheap), the top shard_size
@@ -163,14 +258,16 @@ def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
     for key, c in top:
         entry: dict = {"doc_count": int(c)}
         if spec.subs:
+            if scores is None:
+                scores = [None] * len(segments)
             sub_parts: dict = {}
-            for seg, mask in zip(segments, masks):
+            for seg, mask, sc in zip(segments, masks, scores):
                 m = _terms_key_mask(spec, seg, key)
                 if m is None:
                     continue
-                m = m & mask
+                m = m & _mv(mask).np
                 for s in spec.subs:
-                    part = _collect_one(s, seg, m, qp)
+                    part = _collect_one(s, seg, m, qp, scores_row=sc)
                     prev = sub_parts.get(s.name)
                     sub_parts[s.name] = part if prev is None \
                         else merge_partial(s, prev, part)
@@ -182,19 +279,27 @@ def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
             "error_bound": int(top[-1][1]) if dropped else 0}
 
 
-def _terms_counts(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
-    """Pass 1: key -> doc_count for one segment, fully vectorized."""
+def _terms_counts(spec: AggSpec, seg: Segment, mask) -> dict:
+    """Pass 1: key -> doc_count for one segment, fully vectorized. Device
+    masks take the fused masked-bincount kernel (ops/aggs.py) — only the
+    [V] counts vector crosses to host."""
+    mask = _mv(mask)
     field = spec.params["field"]
-    kw = _keyword_column(seg, field)
-    if kw is not None:
-        ords, values = kw
-        sel = mask & (ords >= 0)
-        counts = np.bincount(ords[sel], minlength=len(values))
-        return {values[o]: int(counts[o]) for o in np.nonzero(counts)[0]}
+    kc = seg.keywords.get(field)
+    if kc is not None:
+        if mask.dev is not None:
+            from ...ops.aggs import masked_bincount
+            counts = np.asarray(masked_bincount(
+                kc.ords, mask.dev, n_bins=len(kc.values)))
+        else:
+            ords, values = _keyword_column(seg, field)
+            sel = mask.np & (ords >= 0)
+            counts = np.bincount(ords[sel], minlength=len(values))
+        return {kc.values[o]: int(counts[o]) for o in np.nonzero(counts)[0]}
     col = _numeric_column(seg, field)
     if col is not None:
         vals, valid = col
-        sel = mask & valid[:len(mask)]
+        sel = mask.np & valid[: len(mask.np)]
         uniq, ucounts = np.unique(vals[sel], return_counts=True)
         if vals.dtype.kind == "i":
             return {int(u): int(c) for u, c in zip(uniq, ucounts)}
@@ -208,7 +313,7 @@ def _terms_counts(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
     P = fx.n_postings
     doc_of = np.asarray(fx.doc_ids)[:P]
     term_of = np.repeat(np.arange(len(fx.term_lens)), fx.term_lens)
-    hit = mask[np.minimum(doc_of, len(mask) - 1)]
+    hit = mask.np[np.minimum(doc_of, len(mask.np) - 1)]
     counts = np.bincount(term_of[hit], minlength=len(fx.term_lens))
     terms_sorted = list(fx.terms)
     return {terms_sorted[t]: int(counts[t]) for t in np.nonzero(counts)[0]}
@@ -243,22 +348,67 @@ def _terms_key_mask(spec: AggSpec, seg: Segment, key) -> np.ndarray | None:
 def _empty_partial(spec: AggSpec) -> dict:
     if spec.type == "terms":
         return {"buckets": {}, "other_doc_count": 0, "error_bound": 0}
+    if spec.type == "significant_terms":
+        return {"buckets": {}, "fg_total": 0, "bg_total": 0}
     if spec.type in BUCKET_TYPES:
         return {"buckets": {}}
+    if spec.type == "top_hits":
+        return {"total": 0, "top": []}
     return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
 
 
-def _collect_one(spec: AggSpec, seg: Segment, mask: np.ndarray,
-                 qp=None) -> dict:
+def _collect_one(spec: AggSpec, seg: Segment, mask,
+                 qp=None, scores_row=None) -> dict:
+    if spec.type == "top_hits":
+        return _top_hits_segment(spec, seg, _mv(mask).np, scores_row)
     if spec.type in METRIC_TYPES:
         return _metric_segment(spec, seg, mask)
-    return _bucket_segment(spec, seg, mask, qp)
+    return _bucket_segment(spec, seg, _mv(mask).np, qp, scores_row)
+
+
+def _top_hits_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
+                      scores_row) -> dict:
+    """top_hits (ref metrics/tophits/TopHitsAggregator): the top-scoring
+    matched docs of the enclosing bucket, as real hit dicts so partials
+    merge across segments and shards by score."""
+    size = int(spec.params.get("size", 3))
+    sel = np.flatnonzero(mask[: seg.n_pad])
+    sel = sel[sel < seg.n_docs]
+    if scores_row is not None and len(sel):
+        sc = np.asarray(scores_row)[sel].astype(np.float64)
+        order = np.argsort(-sc, kind="stable")[:size]
+    else:
+        sc = None
+        order = np.arange(min(size, len(sel)))
+    hits = []
+    for j in order:
+        d = int(sel[j])
+        hits.append({"_id": seg.ids[d], "_type": seg.types[d],
+                     "_score": float(sc[j]) if sc is not None else None,
+                     "_source": seg.stored[d]})
+    return {"total": int(mask.sum()), "top": hits}
 
 
 # -- metric aggs ------------------------------------------------------------
 
-def _metric_segment(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
+_DEVICE_STATS_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
+                       "extended_stats"}
+
+
+def _metric_segment(spec: AggSpec, seg: Segment, mask) -> dict:
+    mask = _mv(mask)
     field = spec.params.get("field")
+    if spec.type in _DEVICE_STATS_TYPES and field and mask.dev is not None:
+        nc = seg.numerics.get(field)
+        if nc is not None:
+            # one fused device program -> a 5-scalar partial
+            from ...ops.aggs import masked_stats
+            cnt, s, ss, mn, mx = np.asarray(
+                masked_stats(nc.vals, nc.missing, mask.dev))
+            return {"count": int(cnt), "sum": float(s), "sum_sq": float(ss),
+                    "min": float(mn) if cnt else math.inf,
+                    "max": float(mx) if cnt else -math.inf}
+    mask = mask.np
     if spec.type == "cardinality" and field:
         kw = _keyword_column(seg, field)
         if kw is not None:
@@ -308,7 +458,7 @@ def _metric_collect(spec: AggSpec, vals: np.ndarray, sel: np.ndarray) -> dict:
 # -- bucket aggs ------------------------------------------------------------
 
 def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
-                    qp=None) -> dict:
+                    qp=None, scores_row=None) -> dict:
     """Compute per-doc bucket keys, then vectorized counts + sub-collects."""
     t = spec.type
     p = spec.params
@@ -317,19 +467,20 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
     if t == "global":   # ignores the query: all live docs (ref bucket/global/)
         live = np.asarray(seg.live)
         return {"buckets": {"_global": _bucket_entry(
-            spec, seg, live, qp)}}
+            spec, seg, live, qp, scores_row)}}
 
     if t == "filter":
         sub_mask = _filter_mask(p, seg, qp)
         m = mask & sub_mask
-        return {"buckets": {"_filter": _bucket_entry(spec, seg, m, qp)}}
+        return {"buckets": {"_filter": _bucket_entry(spec, seg, m, qp,
+                                                     scores_row)}}
 
     if t == "filters":
         out = {}
         flt = p.get("filters", {})
         for fname, fspec in flt.items():
             m = mask & _filter_mask_query(fspec, seg, qp)
-            out[fname] = _bucket_entry(spec, seg, m, qp)
+            out[fname] = _bucket_entry(spec, seg, m, qp, scores_row)
         return {"buckets": out}
 
     if t == "missing":
@@ -346,7 +497,8 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
         else:
             miss = np.ones(n, bool)
         m = mask & miss[:len(mask)]
-        return {"buckets": {"_missing": _bucket_entry(spec, seg, m, qp)}}
+        return {"buckets": {"_missing": _bucket_entry(spec, seg, m, qp,
+                                                      scores_row)}}
 
     if t in ("histogram", "date_histogram"):
         field = p["field"]
@@ -367,7 +519,7 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
         out = {}
         for u in np.unique(keys[sel]):
             m = sel & (keys == u)
-            out[float(u)] = _bucket_entry(spec, seg, m, qp)
+            out[float(u)] = _bucket_entry(spec, seg, m, qp, scores_row)
         return {"buckets": out}
 
     if t in ("range", "date_range"):
@@ -385,7 +537,7 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
                 m &= vals >= float(lo)
             if hi is not None:
                 m &= vals < float(hi)
-            e = _bucket_entry(spec, seg, m, qp)
+            e = _bucket_entry(spec, seg, m, qp, scores_row)
             e["from"] = lo
             e["to"] = hi
             out[key] = e
@@ -394,11 +546,13 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
     raise AggregationParsingException(f"unsupported bucket agg [{t}]")
 
 
-def _bucket_entry(spec: AggSpec, seg: Segment, mask: np.ndarray, qp) -> dict:
+def _bucket_entry(spec: AggSpec, seg: Segment, mask: np.ndarray, qp,
+                  scores_row=None) -> dict:
     entry = {"doc_count": int(mask.sum())}
     if spec.subs:
         entry["subs"] = {
-            s.name: _collect_one(s, seg, mask, qp) for s in spec.subs}
+            s.name: _collect_one(s, seg, mask, qp, scores_row=scores_row)
+            for s in spec.subs}
     return entry
 
 
@@ -499,6 +653,9 @@ def merge_partial(spec: AggSpec, a: dict, b: dict) -> dict:
         out["other_doc_count"] = a.get("other_doc_count", 0) \
             + b.get("other_doc_count", 0)
         out["error_bound"] = a.get("error_bound", 0) + b.get("error_bound", 0)
+    if spec.type == "significant_terms":
+        out["fg_total"] = a.get("fg_total", 0) + b.get("fg_total", 0)
+        out["bg_total"] = a.get("bg_total", 0) + b.get("bg_total", 0)
     buckets = dict(a.get("buckets", {}))
     for key, eb in b.get("buckets", {}).items():
         ea = buckets.get(key)
@@ -506,6 +663,9 @@ def merge_partial(spec: AggSpec, a: dict, b: dict) -> dict:
             buckets[key] = eb
         else:
             merged = {"doc_count": ea["doc_count"] + eb["doc_count"]}
+            if "bg_count" in ea or "bg_count" in eb:
+                merged["bg_count"] = ea.get("bg_count", 0) \
+                    + eb.get("bg_count", 0)
             for extra in ("from", "to"):
                 if extra in ea:
                     merged[extra] = ea[extra]
@@ -520,6 +680,13 @@ def merge_partial(spec: AggSpec, a: dict, b: dict) -> dict:
 
 
 def _merge_metric(spec: AggSpec, a: dict, b: dict) -> dict:
+    if spec.type == "top_hits":
+        size = int(spec.params.get("size", 3))
+        merged = a.get("top", []) + b.get("top", [])
+        merged.sort(key=lambda h: -(h["_score"]
+                                    if h["_score"] is not None else -1e300))
+        return {"total": a.get("total", 0) + b.get("total", 0),
+                "top": merged[:size]}
     if spec.type == "cardinality":
         return {"hll": a["hll"].merge(b["hll"])}
     if spec.type == "percentiles":
@@ -607,6 +774,29 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
                 "sum_other_doc_count": other,
                 "buckets": [rb(k, e) for k, e in top]}
 
+    if t == "significant_terms":
+        # JLH score (ref bucket/significant/heuristics/JLHScore.java):
+        # (fgp - bgp) * (fgp / bgp), only for fgp > bgp
+        fg_total = max(p.get("fg_total", 0), 1)
+        bg_total = max(p.get("bg_total", 0), 1)
+        size = int(spec.params.get("size", 10)) or len(buckets)
+        scored = []
+        for k, e in buckets.items():
+            fgp = e["doc_count"] / fg_total
+            bgp = max(e.get("bg_count", e["doc_count"]), 1) / bg_total
+            if fgp <= bgp:
+                continue
+            score = (fgp - bgp) * (fgp / bgp)
+            scored.append((score, k, e))
+        scored.sort(key=lambda x: (-x[0], str(x[1])))
+        out_buckets = []
+        for score, k, e in scored[:size]:
+            b = rb(k, e)
+            b["score"] = score
+            b["bg_count"] = e.get("bg_count", 0)
+            out_buckets.append(b)
+        return {"doc_count": p.get("fg_total", 0), "buckets": out_buckets}
+
     if t == "histogram":
         items = sorted(buckets.items(), key=lambda kv: kv[0])
         min_count = int(spec.params.get("min_doc_count", 1))
@@ -648,6 +838,12 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
 
 def _render_metric(spec: AggSpec, p: dict) -> dict:
     t = spec.type
+    if t == "top_hits":
+        hits = p.get("top", [])
+        scores = [h["_score"] for h in hits if h["_score"] is not None]
+        return {"hits": {"total": p.get("total", 0),
+                         "max_score": max(scores) if scores else None,
+                         "hits": hits}}
     if t == "cardinality":
         return {"value": p["hll"].cardinality()}
     if t == "percentiles":

@@ -126,22 +126,41 @@ class ShardSearcher:
             track_scores = track_scores or any(
                 sp.field == sort_mod.SCORE for sp in sort)
 
-        if sort is None and aggs is None and search_after is None:
+        if sort is None and search_after is None:
             # the production fast path: sort-reduce sparse kernel
-            # (ops/bm25_sparse) for the plan shapes that dominate traffic
+            # (ops/bm25_sparse) for the plan shapes that dominate traffic.
+            # Aggregations ride it too: the device match_mask (cheap —
+            # presence scatters + columnar compares, no scoring) gates the
+            # ops/aggs collection kernels, so agg queries no longer force
+            # the dense [Q,N] scoring path (VERDICT r3 task 6).
             from .sparse_exec import execute_sparse, extract_sparse_plan
+            from .aggs.aggregators import has_top_hits
             plan = extract_sparse_plan(node)
-            if plan is not None:
+            if plan is not None and not (aggs and has_top_hits(aggs)):
                 stats = self.build_stats(node, global_stats)
                 keys, scores, total, mx = execute_sparse(
                     plan, self.segments, stats, k=k)
+                agg_partials = None
+                if aggs is not None:
+                    from .aggs.aggregators import collect_shard
+                    a_segs, a_masks = [], []
+                    for seg in self.segments:
+                        if seg.n_docs == 0:
+                            continue
+                        ctx = SegmentContext(seg, Q, stats)
+                        m = node.match_mask(ctx) & seg.live[None, :]
+                        a_segs.append(seg)
+                        a_masks.append(m[0])
+                    agg_partials = collect_shard(aggs, a_segs, a_masks,
+                                                 query_parser=self.parser)
                 self.last_query_path = "sparse"
                 self.sparse_queries += 1
                 self._path_stats["sparse"] = \
                     self._path_stats.get("sparse", 0) + 1
                 return QuerySearchResult(
                     shard_id=self.shard_id, doc_keys=keys, scores=scores,
-                    sort_values=None, total_hits=total, max_score=mx)
+                    sort_values=None, total_hits=total, max_score=mx,
+                    aggs=agg_partials)
 
         self.last_query_path = "dense"
         self.dense_queries += 1
@@ -156,7 +175,8 @@ class ShardSearcher:
         total = np.zeros((Q,), np.int64)
         max_score = np.full((Q,), -np.inf, np.float32)
         agg_segments: list = []
-        agg_masks: list[np.ndarray] = []
+        agg_masks: list = []
+        agg_scores: list = []
 
         for seg_idx, seg in enumerate(self.segments):
             if seg.n_docs == 0:
@@ -166,7 +186,8 @@ class ShardSearcher:
             match = match & seg.live[None, :]
             if aggs is not None:
                 agg_segments.append(seg)
-                agg_masks.append(np.asarray(match)[0])
+                agg_masks.append(match[0])   # stays device-resident
+                agg_scores.append(scores[0])  # top_hits ranks with these
             kk = min(k, seg.n_pad)
             # totals/aggs reflect the full query match set — search_after
             # narrows collection below, not the hit count (ref QueryPhase)
@@ -238,7 +259,8 @@ class ShardSearcher:
         if aggs is not None:
             from .aggs.aggregators import collect_shard
             agg_partials = collect_shard(aggs, agg_segments, agg_masks,
-                                         query_parser=self.parser)
+                                         query_parser=self.parser,
+                                         scores=agg_scores)
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=sort_vals, total_hits=total, max_score=max_score,

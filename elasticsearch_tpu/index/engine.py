@@ -167,10 +167,13 @@ class Engine:
               version: int | None = None, version_type: str = "internal",
               op_type: str = "index", sync: bool | None = None) -> EngineResult:
         with self._lock:
-            if self._blocked_reason is not None:
-                # a previous refresh tripped the breaker: re-attempt it (the
-                # budget may have been freed); still-over-limit re-raises
-                # BEFORE this write applies — a clean 429, no partial state
+            if self._blocked_reason is not None \
+                    or len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
+                # flush-or-reject happens BEFORE this write applies: a
+                # breaker trip here is a clean 429 with no partial state
+                # (the doc is neither buffered nor in the translog), and a
+                # previously-blocked engine re-attempts the refresh in case
+                # the budget was freed
                 self.refresh()
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
@@ -178,7 +181,6 @@ class Engine:
             self.translog.add({"op": "index", "id": doc_id, "type": type_name,
                                "source": source, "version": new_version},
                               sync=sync)
-            self._maybe_refresh_on_size()
             return EngineResult(doc_id=doc_id, version=new_version, created=created)
 
     def _apply_index(self, doc_id: str, source: dict, type_name: str,
@@ -239,10 +241,6 @@ class Engine:
             return GetResult(found=False, doc_id=doc_id)
 
     # -- refresh / flush / merge ------------------------------------------
-
-    def _maybe_refresh_on_size(self) -> None:
-        if len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
-            self.refresh()
 
     def refresh(self) -> None:
         """Freeze the write buffer into a new device segment — the NRT
@@ -320,10 +318,13 @@ class Engine:
 
     def _charge_merge(self, merged: Segment, sources: list[Segment]) -> None:
         """Swap breaker accounting from the source segments to the merged
-        one (the merged set is usually smaller: tombstones purged)."""
+        one (the merged set is usually smaller: tombstones purged). An
+        all-tombstoned merge result is DROPPED by the callers, so it must
+        not be charged — that leaked phantom bytes for the node lifetime."""
         if self.breaker is None:
             return
-        self.breaker.add_estimate(merged.memory_bytes(), check=False)
+        if merged.n_docs:
+            self.breaker.add_estimate(merged.memory_bytes(), check=False)
         self.breaker.release(sum(s.memory_bytes() for s in sources))
 
     def flush(self) -> None:

@@ -179,7 +179,7 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
             continue
         if spec.type == "significant_terms":
             partials[spec.name] = _collect_sig_terms_shard(
-                spec, segments, masks, query_parser)
+                spec, segments, masks, query_parser, scores)
             continue
         segs_partials = [
             _collect_one(spec, seg, mask, query_parser, scores_row=sc)
@@ -192,18 +192,26 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
 
 
 def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
-                             masks: list, qp) -> dict:
+                             masks: list, qp,
+                             scores: list | None = None) -> dict:
     """significant_terms (ref search/aggregations/bucket/significant/
     SignificantTermsAggregator + JLHScore): per-key FOREGROUND counts over
     the query matches and BACKGROUND counts over the whole index travel in
     the partial; the score is computed at render over the merged totals."""
+    if scores is None:
+        scores = [None] * len(segments)
     fg: dict = {}
     fg_total = 0
     bg_total = 0
     for seg, mask in zip(segments, masks):
         for key, c in _terms_counts(spec, seg, mask).items():
             fg[key] = fg.get(key, 0) + c
-        fg_total += int(_mv(mask).np.sum())
+        mv = _mv(mask)
+        if mv.dev is not None:
+            from ...ops.aggs import count_mask
+            fg_total += int(np.asarray(count_mask(mv.dev)))
+        else:
+            fg_total += int(mv.np.sum())
         bg_total += seg.live_count
     size = int(spec.params.get("size", 10)) or len(fg) or 1
     shard_size = int(spec.params.get("shard_size", size * 3 + 10))
@@ -211,24 +219,24 @@ def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
     buckets: dict = {}
     for key, c in top:
         bg = 0
-        for seg in segments:
-            m = _terms_key_mask(spec, seg, key)
-            if m is not None:
-                bg += int((m[: seg.n_pad]
-                           & seg.live_host[: len(m)]).sum())
-        entry: dict = {"doc_count": int(c), "bg_count": bg}
-        if spec.subs:
-            sub_parts: dict = {}
-            for seg, mask in zip(segments, masks):
-                m = _terms_key_mask(spec, seg, key)
-                if m is None:
-                    continue
-                m = m & _mv(mask).np
+        sub_parts: dict = {}
+        # ONE key-mask computation per (key, segment) feeds both the
+        # background count and the sub-agg collect
+        for seg, mask, sc in zip(segments, masks, scores):
+            m_key = _terms_key_mask(spec, seg, key)
+            if m_key is None:
+                continue
+            bg += int((m_key[: seg.n_pad]
+                       & seg.live_host[: len(m_key)]).sum())
+            if spec.subs:
+                m = m_key & _mv(mask).np
                 for s in spec.subs:
-                    part = _collect_one(s, seg, m, qp)
+                    part = _collect_one(s, seg, m, qp, scores_row=sc)
                     prev = sub_parts.get(s.name)
                     sub_parts[s.name] = part if prev is None \
                         else merge_partial(s, prev, part)
+        entry: dict = {"doc_count": int(c), "bg_count": bg}
+        if spec.subs:
             entry["subs"] = {s.name: sub_parts.get(s.name, _empty_partial(s))
                              for s in spec.subs}
         buckets[key] = entry

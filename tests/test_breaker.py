@@ -134,3 +134,44 @@ class TestBreakerViaNode:
             "estimated_size_in_bytes"]
         assert 0 < used_after <= used_before
         node.close()
+
+
+class TestBreakerReviewRegressions:
+    def test_empty_merge_releases_all_bytes(self, tmp_path):
+        # delete-everything then merge must not leak phantom usage
+        node = _node(tmp_path, **{"indices.breaker.total.limit": "100mb",
+                                  "indices.breaker.fielddata.limit": "100mb"})
+        node.create_index("z")
+        for i in range(10):
+            node.index_doc("z", str(i), {"body": f"doc {i}"})
+        node.refresh("z")
+        for i in range(10):
+            node.delete_doc("z", str(i))
+        node.refresh("z")
+        node.force_merge("z")
+        used = node.stats()["breakers"]["fielddata"][
+            "estimated_size_in_bytes"]
+        assert used == 0, f"leaked {used} bytes after empty merge"
+        node.close()
+
+    def test_tripping_write_not_partially_applied(self, tmp_path):
+        # the write whose refresh trips must NOT be buffered or translogged
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.common.breaker import CircuitBreakerService
+        from elasticsearch_tpu.mapping.mapper import MapperService
+        svc = CircuitBreakerService(Settings({
+            "indices.breaker.total.limit": "100mb",
+            "indices.breaker.fielddata.limit": "100mb"}))
+        fd = svc.breaker("fielddata")
+        mp = MapperService()
+        eng = Engine(str(tmp_path / "sh"), mp, breaker=fd)
+        eng.MAX_BUFFER_DOCS = 4
+        for i in range(4):
+            eng.index(str(i), {"body": f"doc {i}"})
+        fd.limit = 1          # next refresh must trip
+        with pytest.raises(CircuitBreakingException):
+            eng.index("4", {"body": "tripping write"})
+        assert "4" not in eng._buffer_docs
+        assert all(op["id"] != "4" for op in eng.translog.snapshot())
+        assert eng.current_version("4") == -1
+        eng.close()

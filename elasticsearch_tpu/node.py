@@ -73,6 +73,8 @@ class NodeService:
         os.makedirs(data_path, exist_ok=True)
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
+        from .serving.batcher import SearchBatcher
+        self._batcher = SearchBatcher(self)
         self._recover_indices()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -297,16 +299,24 @@ class NodeService:
                           [self.indices[n].mappers for n in names])
 
         # the packed fast path: one device program over every shard/segment
-        # of the index (serving/packed_view) — the production serving lane
+        # of the index (serving/packed_view) — the production serving lane.
+        # Concurrent solo requests COALESCE through the batcher: under load
+        # the device serves whole queues of independent requests as one
+        # program (serving/batcher.py), which is where TPU QPS comes from.
         if len(names) == 1:
             try:
-                packed = self._packed_search(names[0], [body],
-                                             size=size, from_=from_, t0=t0)
+                from .search.query_parser import QueryParser
+                from .serving.executor import packed_spec_of
+                spec = packed_spec_of(
+                    QueryParser(self.indices[names[0]].mappers), body)
+                if spec is not None:
+                    key = (names[0], size, from_, spec[1], spec[2], spec[3])
+                    out = self._batcher.submit(key, names[0], body, spec,
+                                               size, from_, t0)
+                    if out is not None:
+                        return out
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
-                packed = None
-            if packed is not None:
-                return packed[0]
 
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
@@ -451,7 +461,11 @@ class NodeService:
             return None     # request breaker refused the packed postings
         queries = [s[0] for s in specs]
         k = max(size + from_, 1)
-        scores, docs, hits = view.search(field, queries, k=k, k1=k1, b=b)
+        from .serving.packed_view import FilterColumnRefused
+        try:
+            scores, docs, hits = view.search(field, queries, k=k, k1=k1, b=b)
+        except FilterColumnRefused:
+            return None    # breaker refused a filter column: general path
         took = int((time.perf_counter() - t0) * 1000)
         out = []
         for qi, body in enumerate(bodies):
@@ -864,7 +878,8 @@ class NodeService:
 
     def stats(self) -> dict:
         return {"indices": {n: s.stats() for n, s in self.indices.items()},
-                "breakers": self.breakers.stats()}
+                "breakers": self.breakers.stats(),
+                "search_batcher": self._batcher.stats()}
 
     def close(self) -> None:
         for svc in self.indices.values():

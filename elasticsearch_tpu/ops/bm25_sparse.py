@@ -132,6 +132,41 @@ def slot_budget(term_lens) -> int:
     return next_pow2(int(np.asarray(term_lens).max()), floor=8)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("S", "CHUNK", "R", "k", "FR", "FT", "TV"))
+def bm25_serve_packed_filtered(packed_q: jax.Array, doc_ids: jax.Array,
+                               tf: jax.Array, dl: jax.Array, live: jax.Array,
+                               pad_doc: jax.Array, k1, b, avgdl, const,
+                               fcols: jax.Array,
+                               fr_col: jax.Array, fr_lo: jax.Array,
+                               fr_hi: jax.Array, fr_neg: jax.Array,
+                               ft_col: jax.Array, ft_targets: jax.Array,
+                               ft_neg: jax.Array, *,
+                               S: int, CHUNK: int, R: int, k: int,
+                               FR: int, FT: int, TV: int) -> jax.Array:
+    """bm25_serve_packed + per-query COLUMNAR FILTERS evaluated on device at
+    the candidate positions (the filter analog of Lucene's filtered query
+    inside QueryPhase — BASELINE config #2's bool{match + filter} shape).
+
+    fcols f64[NC, Npad]: the filter columns this batch touches, packed over
+        the global doc space — numeric values (NaN = missing) or keyword
+        ordinals in the view's union vocabulary (-1 = missing).
+    Range slots (AND-ed): fr_col i32[Q, FR] (index into fcols; -1 = slot
+        unused, -2 = active but the field has no column: matches nothing),
+        fr_lo/fr_hi f64[Q, FR] INCLUSIVE bounds, fr_neg i32[Q, FR].
+    Term slots (AND-ed; OR within a slot's TV targets): ft_col i32[Q, FT],
+        ft_targets f64[Q, FT, TV] (NaN = unused target), ft_neg i32[Q, FT].
+
+    Filters gate `keep` exactly like liveness, so total_hits and top-k
+    honor them in the same single program — still 1 upload + 1 download.
+    """
+    return _serve_packed_impl(
+        packed_q, doc_ids, tf, dl, live, pad_doc, k1, b, avgdl, const,
+        S=S, CHUNK=CHUNK, R=R, k=k,
+        filters=(fcols, fr_col, fr_lo, fr_hi, fr_neg,
+                 ft_col, ft_targets, ft_neg, FR, FT, TV))
+
+
 @functools.partial(jax.jit, static_argnames=("S", "CHUNK", "R", "k"))
 def bm25_serve_packed(packed_q: jax.Array, doc_ids: jax.Array, tf: jax.Array,
                       dl: jax.Array, live: jax.Array, pad_doc: jax.Array,
@@ -177,6 +212,13 @@ def bm25_serve_packed(packed_q: jax.Array, doc_ids: jax.Array, tf: jax.Array,
     (search/query/QueryPhase.java:91-168) with one batched program; the
     2-phase contract (ids only, fetch later) is unchanged.
     """
+    return _serve_packed_impl(packed_q, doc_ids, tf, dl, live, pad_doc,
+                              k1, b, avgdl, const,
+                              S=S, CHUNK=CHUNK, R=R, k=k, filters=None)
+
+
+def _serve_packed_impl(packed_q, doc_ids, tf, dl, live, pad_doc,
+                       k1, b, avgdl, const, *, S, CHUNK, R, k, filters):
     Q = packed_q.shape[0]
     starts = packed_q[:, :S]
     lens = packed_q[:, S:2 * S]
@@ -217,6 +259,32 @@ def bm25_serve_packed(packed_q: jax.Array, doc_ids: jax.Array, tf: jax.Array,
                            axis=1) & is_real
     accepted = live.take(d, mode="clip")
     keep = ends & accepted & (count >= min_match[:, None].astype(jnp.float32))
+
+    if filters is not None:
+        (fcols, fr_col, fr_lo, fr_hi, fr_neg,
+         ft_col, ft_targets, ft_neg, FR, FT, TV) = filters
+
+        def eval_one(dq, fr_c, fr_l, fr_h, fr_n, ft_c, ft_t, ft_n):
+            ok = jnp.ones(dq.shape, bool)
+            for fi in range(FR):
+                col = jnp.take(fcols, jnp.maximum(fr_c[fi], 0), axis=0)
+                v = col.take(dq, mode="clip")
+                m = (v >= fr_l[fi]) & (v <= fr_h[fi])
+                m = jnp.where(fr_c[fi] == -2, False, m)  # absent column
+                m = jnp.where(fr_n[fi] > 0, ~m, m)
+                ok = ok & jnp.where(fr_c[fi] != -1, m, True)
+            for fi in range(FT):
+                col = jnp.take(fcols, jnp.maximum(ft_c[fi], 0), axis=0)
+                v = col.take(dq, mode="clip")
+                m = (v[None, :] == ft_t[fi][:, None]).any(axis=0)
+                m = jnp.where(ft_c[fi] == -2, False, m)
+                m = jnp.where(ft_n[fi] > 0, ~m, m)
+                ok = ok & jnp.where(ft_c[fi] != -1, m, True)
+            return ok
+
+        keep = keep & jax.vmap(eval_one)(
+            d, fr_col, fr_lo, fr_hi, fr_neg, ft_col, ft_targets, ft_neg)
+
     masked = jnp.where(keep, total + const, -jnp.inf)
 
     top, pos = jax.lax.top_k(masked, min(k, W))

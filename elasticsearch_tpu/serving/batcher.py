@@ -1,0 +1,107 @@
+"""Dynamic request batcher: concurrent solo `_search` requests coalesce
+into ONE packed device program.
+
+The reference gets its QPS from thread-pool concurrency (one Lucene search
+per thread, search/SearchService + the SEARCH thread pool); a TPU gets it
+from BATCHING — the packed kernel's cost is nearly flat in Q, so serving
+32 queued requests in one program costs barely more than serving one.
+
+Design: continuous batching with ZERO added latency when idle. The first
+request for a compatibility group becomes the LEADER and executes
+immediately with whatever is queued at that moment (itself). Requests
+arriving while the device is busy queue up; when the leader finishes it
+takes the whole accumulated queue as the next batch. Under load, batch
+size self-tunes to (arrival rate x device latency) — exactly the dynamic
+batching window, without a sleep on the idle path.
+
+ref: the role of org.elasticsearch.threadpool.ThreadPool's SEARCH pool —
+but the unit of concurrency is a device batch, not a thread.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class _Entry:
+    __slots__ = ("body", "spec", "event", "out", "err")
+
+    def __init__(self, body, spec):
+        self.body = body
+        self.spec = spec
+        self.event = threading.Event()
+        self.out = None          # response dict, or None -> general path
+        self.err = None
+
+
+class SearchBatcher:
+    """Per-node coalescer for packed-eligible solo searches."""
+
+    MAX_BATCH = 64               # cap one device batch (compile buckets)
+
+    def __init__(self, node):
+        self.node = node
+        self._lock = threading.Lock()
+        self._queues: dict[tuple, list[_Entry]] = {}
+        self._busy: set[tuple] = set()
+        self.batches = 0         # observability: device batches executed
+        self.batched_requests = 0
+
+    def submit(self, key: tuple, name: str, body: dict, spec,
+               size: int, from_: int, t0: float):
+        """Execute (or join) a packed batch for this request. Returns the
+        response dict, or None when the request must take the general path
+        (unservable batch / view refusal)."""
+        e = _Entry(body, spec)
+        with self._lock:
+            self._queues.setdefault(key, []).append(e)
+            leader = key not in self._busy
+            if leader:
+                self._busy.add(key)
+        if not leader:
+            e.event.wait(timeout=30.0)
+            if e.err is not None:
+                raise e.err
+            return e.out
+
+        try:
+            while True:
+                with self._lock:
+                    batch = self._queues.pop(key, [])
+                    if not batch:
+                        break
+                    if len(batch) > self.MAX_BATCH:
+                        self._queues[key] = batch[self.MAX_BATCH:]
+                        batch = batch[:self.MAX_BATCH]
+                self._run(key, name, batch, size, from_, t0)
+        finally:
+            with self._lock:
+                self._busy.discard(key)
+                leftover = self._queues.pop(key, [])
+            for x in leftover:   # no leader left: don't strand them
+                x.out = None
+                x.event.set()
+        if e.err is not None:
+            raise e.err
+        return e.out
+
+    def _run(self, key, name, batch, size, from_, t0):
+        try:
+            outs = self.node._packed_search(
+                name, [x.body for x in batch], size=size, from_=from_,
+                t0=t0, specs=[x.spec for x in batch])
+        except Exception as ex:  # noqa: BLE001 — degrade each to general
+            self.node._packed_error()
+            for x in batch:
+                x.out = None
+                x.event.set()
+            return
+        self.batches += 1
+        self.batched_requests += len(batch)
+        for i, x in enumerate(batch):
+            x.out = None if outs is None else outs[i]
+            x.event.set()
+
+    def stats(self) -> dict:
+        return {"batches": self.batches,
+                "batched_requests": self.batched_requests}

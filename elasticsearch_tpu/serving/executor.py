@@ -16,17 +16,58 @@ from __future__ import annotations
 
 import numpy as np
 
-from .packed_view import PackedIndexView, PackedQuery
+from .packed_view import (F_RANGE, F_TERM, F_TERM_VALS, PackedIndexView,
+                          PackedQuery)
 
 # body keys the packed path understands; anything else (sort, aggs, rescore,
 # knn, search_after, highlight, ...) falls back to the general path
 PACKED_BODY_KEYS = {"query", "size", "from", "_source"}
 
 
+def _packable_filters(plan):
+    """mask/neg nodes -> (negated?, node) pairs the packed kernel's filter
+    slots can evaluate (term + range over columnar fields, within the
+    static slot budget), or None if any node needs the general path."""
+    from ..search.query_dsl import MatchAllNode, RangeNode, TermFilterNode
+
+    out = []
+    nr = nt = 0
+    for neg, nodes in ((False, plan.mask_nodes), (True, plan.neg_nodes)):
+        for n in nodes:
+            if isinstance(n, MatchAllNode):
+                if neg:
+                    return None     # must_not match_all: matches nothing
+                continue
+            if isinstance(n, RangeNode):
+                if not n.bounds_per_query:
+                    return None
+                lo, hi = n.bounds_per_query[0][0], n.bounds_per_query[0][1]
+                if not all(isinstance(x, (int, float, type(None)))
+                           and not isinstance(x, bool) for x in (lo, hi)):
+                    # keyword (string) bounds are fine; mixed junk is not
+                    if not all(isinstance(x, (str, type(None)))
+                               for x in (lo, hi)):
+                        return None
+                nr += 1
+                out.append((neg, n))
+            elif isinstance(n, TermFilterNode):
+                vals = n.values_per_query[0] if n.values_per_query else []
+                if len(vals) > F_TERM_VALS:
+                    return None
+                nt += 1
+                out.append((neg, n))
+            else:
+                return None
+    if nr > F_RANGE or nt > F_TERM:
+        return None
+    return out
+
+
 def packed_spec_of(parser, body: dict):
     """-> (PackedQuery, field, k1, b) if the body is packed-servable,
-    else None. Mirrors sparse_exec.extract_sparse_plan eligibility minus
-    filter/must_not contexts (those need columnar masks — general path)."""
+    else None. Mirrors sparse_exec.extract_sparse_plan eligibility;
+    filter/must_not contexts ride the kernel's columnar filter slots
+    (BASELINE config #2's bool{match + filter} shape)."""
     from ..search.sparse_exec import extract_sparse_plan
 
     if any(k not in PACKED_BODY_KEYS for k in body):
@@ -36,12 +77,20 @@ def packed_spec_of(parser, body: dict):
     except Exception:          # noqa: BLE001 — let the general path raise
         return None
     plan = extract_sparse_plan(node)
-    if plan is None or plan.mask_nodes or plan.neg_nodes:
+    if plan is None:
+        return None
+    filters = _packable_filters(plan)
+    if filters is None:
+        return None
+    if filters and not plan.terms_per_query[0]:
+        # pure-filter queries have no scored postings to draw candidates
+        # from; the general path serves them
         return None
     return (PackedQuery(terms=plan.terms_per_query[0],
                         boost=plan.match_boost * plan.scale,
                         operator=plan.operator, msm=plan.msm,
-                        const=plan.const_boost * plan.scale),
+                        const=plan.const_boost * plan.scale,
+                        filters=tuple(filters)),
             plan.field, plan.k1, plan.b)
 
 

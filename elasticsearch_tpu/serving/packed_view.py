@@ -35,25 +35,48 @@ import jax
 import jax.numpy as jnp
 
 from ..index.segment import Segment, next_pow2
-from ..ops.bm25_sparse import bm25_serve_packed
+from ..ops.bm25_sparse import bm25_serve_packed, bm25_serve_packed_filtered
 
 # Fixed postings chunk: compile-cache keys depend on (Q, S) pow2 buckets only,
 # never on the corpus' df distribution.
 CHUNK = 512
 
+# static filter-slot budget per query (compile-cache keys); queries needing
+# more fall back to the general path (serving/executor.py enforces)
+F_RANGE = 2      # AND-ed range slots
+F_TERM = 2       # AND-ed term slots
+F_TERM_VALS = 4  # OR-ed values per term slot
+
 _JSON_UNSAFE = re.compile(r'["\\\x00-\x1f]')
+
+
+class FilterColumnRefused(Exception):
+    """The request breaker refused a filter column — serve via the
+    per-segment lane instead (not an error)."""
 
 
 @dataclass
 class PackedQuery:
     """One query row of a packed batch (per-query knobs the kernel supports
-    without recompiling: term set, boost, operator/minimum_should_match, and
-    an additive constant applied host-side)."""
+    without recompiling: term set, boost, operator/minimum_should_match, an
+    additive constant applied host-side, and columnar filters evaluated on
+    device — (negated?, TermFilterNode|RangeNode) pairs)."""
     terms: list[str]
     boost: float = 1.0
     operator: str = "or"
     msm: int = 1
     const: float = 0.0
+    filters: tuple = ()
+
+
+@dataclass
+class PackedFilterColumn:
+    """One field's filter column over the global packed doc space, f64-
+    encoded for the kernel: numeric values (NaN = missing) or keyword
+    ordinals in the union vocabulary (-1 = missing)."""
+    kind: str                      # "numeric" | "keyword"
+    vals: jax.Array                # f64[n_pad_total]
+    vocab: list[str] | None = None
 
 
 class PackedField:
@@ -125,6 +148,8 @@ class PackedIndexView:
 
         self._fields: dict[str, PackedField | None] = {}
         self._refused: set[str] = set()   # breaker-refused (≠ absent) fields
+        self._filter_cols: dict[str, PackedFilterColumn | None] = {}
+        self._filter_stacks: dict[tuple, jax.Array] = {}
         self._live_key: tuple | None = None
         self._live_dev: jax.Array | None = None
         self.device_calls = 0           # serving counters (observability)
@@ -248,11 +273,28 @@ class PackedIndexView:
 
         packed_q, S, R = self._build_slots(pf, queries, field, k1, b)
         k_pad = next_pow2(k, floor=8)
-        out = bm25_serve_packed(
-            packed_q, pf.doc_ids, pf.tf, pf.dl, self.live_dev,
-            jnp.int32(self.pad_doc), jnp.float32(k1), jnp.float32(b),
-            jnp.float32(self.avgdl(field)), jnp.float32(0.0),
-            S=S, CHUNK=CHUNK, R=R, k=k_pad)
+        Q_pad = packed_q.shape[0]
+        if any(q.filters for q in queries):
+            (fields, fr_col, fr_lo, fr_hi, fr_neg,
+             ft_col, ft_targets, ft_neg) = \
+                self._filter_descriptors(queries, Q_pad)
+            out = bm25_serve_packed_filtered(
+                packed_q, pf.doc_ids, pf.tf, pf.dl, self.live_dev,
+                jnp.int32(self.pad_doc), jnp.float32(k1), jnp.float32(b),
+                jnp.float32(self.avgdl(field)), jnp.float32(0.0),
+                self._filter_stack(fields),
+                jnp.asarray(fr_col), jnp.asarray(fr_lo),
+                jnp.asarray(fr_hi), jnp.asarray(fr_neg),
+                jnp.asarray(ft_col), jnp.asarray(ft_targets),
+                jnp.asarray(ft_neg),
+                S=S, CHUNK=CHUNK, R=R, k=k_pad,
+                FR=F_RANGE, FT=F_TERM, TV=F_TERM_VALS)
+        else:
+            out = bm25_serve_packed(
+                packed_q, pf.doc_ids, pf.tf, pf.dl, self.live_dev,
+                jnp.int32(self.pad_doc), jnp.float32(k1), jnp.float32(b),
+                jnp.float32(self.avgdl(field)), jnp.float32(0.0),
+                S=S, CHUNK=CHUNK, R=R, k=k_pad)
         self.device_calls += 1
         arr = np.asarray(out)            # the ONE D2H transfer
         arr = arr[:Q]
@@ -347,6 +389,152 @@ class PackedIndexView:
         packed[slot_q, 2 * S + pos] = slot_w.view(np.int32)
         packed[:, 3 * S] = min_match
         return jnp.asarray(packed), S, R
+
+    # -- filter columns (lazy, cached) -------------------------------------
+
+    def filter_column(self, name: str) -> PackedFilterColumn | None:
+        """The f64 filter column for one field over the global doc space.
+        None = no segment has the field (a filter on it matches nothing).
+        Raises FilterColumnRefused when the request breaker refuses the
+        device bytes — the caller serves via the per-segment lane."""
+        if name in self._filter_cols:
+            return self._filter_cols[name]
+        has_kw = any(name in seg.keywords for _, seg in self.entries)
+        has_num = any(name in seg.numerics for _, seg in self.entries)
+        if not has_kw and not has_num:
+            self._filter_cols[name] = None
+            return None
+        if self.breaker is not None:
+            from ..common.breaker import CircuitBreakingException
+            try:
+                self.breaker.add_estimate(self.n_pad_total * 8)
+            except CircuitBreakingException as e:
+                raise FilterColumnRefused(name) from e
+        if has_num:
+            vals = np.full(self.n_pad_total, np.nan)
+            for ei, (_, seg) in enumerate(self.entries):
+                nc = seg.numerics.get(name)
+                if nc is None or seg.n_docs == 0:
+                    continue
+                base = int(self.bases[ei])
+                v = np.asarray(nc.vals).astype(np.float64)
+                miss = np.asarray(nc.missing)
+                n = min(seg.n_pad, len(v))
+                vals[base:base + n] = np.where(miss[:n], np.nan, v[:n])
+            col = PackedFilterColumn("numeric", jnp.asarray(vals))
+        else:
+            vocab = sorted(set().union(*(
+                seg.keywords[name].values for _, seg in self.entries
+                if name in seg.keywords)))
+            union_of = {v: i for i, v in enumerate(vocab)}
+            vals = np.full(self.n_pad_total, -1.0)
+            for ei, (_, seg) in enumerate(self.entries):
+                kc = seg.keywords.get(name)
+                if kc is None or seg.n_docs == 0:
+                    continue
+                base = int(self.bases[ei])
+                lut = np.array([union_of[v] for v in kc.values] + [-1.0])
+                ords = np.asarray(kc.ords)
+                n = min(seg.n_pad, len(ords))
+                vals[base:base + n] = lut[ords[:n]]
+            col = PackedFilterColumn("keyword", jnp.asarray(vals),
+                                     vocab=vocab)
+        self.memory_bytes += self.n_pad_total * 8
+        self._filter_cols[name] = col
+        return col
+
+    def _filter_stack(self, fields: tuple) -> jax.Array:
+        st = self._filter_stacks.get(fields)
+        if st is None:
+            if fields:
+                st = jnp.stack([self._filter_cols[f].vals for f in fields])
+            else:
+                st = jnp.zeros((1, self.n_pad_total), jnp.float64)
+            self._filter_stacks[fields] = st
+        return st
+
+    def _filter_descriptors(self, queries: list[PackedQuery], Q_pad: int):
+        """-> (fields tuple, fr_col, fr_lo, fr_hi, fr_neg, ft_col,
+        ft_targets, ft_neg) numpy descriptor arrays for the kernel.
+        Raises FilterColumnRefused if a needed column was breaker-refused."""
+        from ..search.query_dsl import RangeNode, TermFilterNode
+
+        fields: list[str] = []
+
+        def col_idx(name):
+            col = self.filter_column(name)
+            if col is None:
+                return -2, None     # active slot, absent field
+            if name not in fields:
+                fields.append(name)
+            return fields.index(name), col
+
+        fr_col = np.full((Q_pad, F_RANGE), -1, np.int32)
+        fr_lo = np.zeros((Q_pad, F_RANGE))
+        fr_hi = np.zeros((Q_pad, F_RANGE))
+        fr_neg = np.zeros((Q_pad, F_RANGE), np.int32)
+        ft_col = np.full((Q_pad, F_TERM), -1, np.int32)
+        ft_targets = np.full((Q_pad, F_TERM, F_TERM_VALS), np.nan)
+        ft_neg = np.zeros((Q_pad, F_TERM), np.int32)
+
+        for qi, q in enumerate(queries):
+            ri = ti = 0
+            for neg, node in q.filters:
+                if isinstance(node, RangeNode):
+                    ci, col = col_idx(node.field_name)
+                    lo, hi, inc_lo, inc_hi = node.bounds_per_query[0]
+                    if col is not None and col.kind == "keyword":
+                        # lexicographic bounds -> inclusive ordinal bounds
+                        # over the union vocab (mirrors RangeNode's kc path)
+                        import bisect as _b
+                        l = 0
+                        if lo is not None:
+                            l = _b.bisect_left(col.vocab, str(lo))
+                            if not inc_lo and l < len(col.vocab) \
+                                    and col.vocab[l] == str(lo):
+                                l += 1
+                        h = len(col.vocab) - 1
+                        if hi is not None:
+                            h = _b.bisect_right(col.vocab, str(hi)) - 1
+                            if not inc_hi and h >= 0 \
+                                    and col.vocab[h] == str(hi):
+                                h -= 1
+                        flo, fhi = float(l), float(h)
+                    else:
+                        flo = -np.inf if lo is None else float(lo)
+                        fhi = np.inf if hi is None else float(hi)
+                        if lo is not None and not inc_lo:
+                            flo = np.nextafter(flo, np.inf)
+                        if hi is not None and not inc_hi:
+                            fhi = np.nextafter(fhi, -np.inf)
+                    fr_col[qi, ri] = ci
+                    fr_lo[qi, ri] = flo
+                    fr_hi[qi, ri] = fhi
+                    fr_neg[qi, ri] = int(neg)
+                    ri += 1
+                elif isinstance(node, TermFilterNode):
+                    ci, col = col_idx(node.field_name)
+                    vals = node.values_per_query[0] \
+                        if node.values_per_query else []
+                    for vi, v in enumerate(vals[:F_TERM_VALS]):
+                        if col is None:
+                            break
+                        if col.kind == "keyword":
+                            import bisect as _b
+                            p = _b.bisect_left(col.vocab, str(v))
+                            ft_targets[qi, ti, vi] = float(p) \
+                                if p < len(col.vocab) \
+                                and col.vocab[p] == str(v) else np.nan
+                        else:
+                            try:
+                                ft_targets[qi, ti, vi] = float(v)
+                            except (TypeError, ValueError):
+                                ft_targets[qi, ti, vi] = np.nan
+                    ft_col[qi, ti] = ci
+                    ft_neg[qi, ti] = int(neg)
+                    ti += 1
+        return (tuple(fields), fr_col, fr_lo, fr_hi, fr_neg,
+                ft_col, ft_targets, ft_neg)
 
     # -- host-side doc resolution ------------------------------------------
 

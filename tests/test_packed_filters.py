@@ -1,0 +1,166 @@
+"""Packed-lane columnar filters: bool{match + filter/must_not} served by the
+ONE-program kernel (BASELINE config #2 shape), with exact parity against the
+general path (VERDICT r3 task 2a).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "price": {"type": "long"},
+    "rating": {"type": "double"},
+}}}
+
+DOCS = [
+    {"body": "quick fox",          "tag": "a", "price": 10, "rating": 1.5},
+    {"body": "quick dog",          "tag": "b", "price": 20, "rating": 2.5},
+    {"body": "quick cat",          "tag": "a", "price": 30, "rating": 3.5},
+    {"body": "quick bird",         "tag": "c", "price": 40},
+    {"body": "quick quick fish",   "tag": "b", "price": 50, "rating": 4.5},
+    {"body": "slow worm",          "tag": "a", "price": 60, "rating": 0.5},
+    {"body": "quick snail",                    "price": 70, "rating": 5.0},
+    {"body": "quick horse",        "tag": "c"},
+]
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("px", settings={"number_of_shards": 2}, mappings=MAPPING)
+    for i, d in enumerate(DOCS):
+        n.index_doc("px", str(i), d)
+        if i == 3:
+            n.refresh("px")      # several segments
+    n.refresh("px")
+    yield n
+    n.close()
+
+
+def _both_lanes(node, query, size=10):
+    """(packed_response, general_response) for the same query; asserts the
+    packed lane actually served the first one."""
+    svc = node.indices["px"]
+    before = svc.search_stats.get("packed", 0)
+    packed = node.search("px", {"query": query, "size": size})
+    assert svc.search_stats.get("packed", 0) == before + 1, \
+        f"packed lane must serve {query}"
+    general = node.search("px", {"query": query, "size": size,
+                                 "track_scores": True})
+    return packed, general
+
+
+def _check_parity(packed, general):
+    ph = {h["_id"]: h["_score"] for h in packed["hits"]["hits"]}
+    gh = {h["_id"]: h["_score"] for h in general["hits"]["hits"]}
+    assert ph.keys() == gh.keys()
+    for k in ph:
+        assert ph[k] == pytest.approx(gh[k], rel=1e-5)
+    assert packed["hits"]["total"] == general["hits"]["total"]
+    return set(ph)
+
+
+class TestPackedTermFilter:
+    def test_term_filter(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"term": {"tag": "a"}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"0", "2"}
+
+    def test_terms_filter_multi_value(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"terms": {"tag": ["a", "c"]}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"0", "2", "3", "7"}
+
+    def test_numeric_term_filter(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"term": {"price": 20}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"1"}
+
+    def test_must_not(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "must_not": [{"term": {"tag": "b"}}]}}
+        p, g = _both_lanes(node, q)
+        # must_not matches docs missing the field too (6 has no tag)
+        assert _check_parity(p, g) == {"0", "2", "3", "6", "7"}
+
+
+class TestPackedRangeFilter:
+    def test_long_range_inclusive(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"range": {"price": {"gte": 20,
+                                                      "lte": 40}}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"1", "2", "3"}
+
+    def test_strict_bounds(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"range": {"price": {"gt": 20,
+                                                      "lt": 50}}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"2", "3"}
+
+    def test_double_range_excludes_missing(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"range": {"rating": {"gte": 2.0}}}]}}
+        p, g = _both_lanes(node, q)
+        # docs 3 and 7 have no rating: a range filter never matches missing
+        assert _check_parity(p, g) == {"1", "2", "4", "6"}
+
+    def test_keyword_range(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"range": {"tag": {"gte": "b"}}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"1", "3", "4", "7"}
+
+    def test_combined_term_and_range(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"term": {"tag": "b"}},
+                                 {"range": {"price": {"gte": 30}}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"4"}
+
+
+class TestPackedFilterEdges:
+    def test_filter_on_unmapped_field_matches_nothing(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"term": {"nope": "x"}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == set()
+
+    def test_must_not_on_unmapped_field_matches_all(self, node):
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "must_not": [{"term": {"nope": "x"}}]}}
+        p, g = _both_lanes(node, q)
+        assert len(_check_parity(p, g)) == 7   # all quick docs
+
+    def test_pure_filter_query_stays_on_general_path(self, node):
+        svc = node.indices["px"]
+        before = svc.search_stats.get("packed", 0)
+        out = node.search("px", {"query": {"bool": {
+            "filter": [{"term": {"tag": "a"}}]}}})
+        assert svc.search_stats.get("packed", 0) == before
+        assert out["hits"]["total"] == 3
+
+    def test_too_many_filters_fall_back(self, node):
+        svc = node.indices["px"]
+        before = svc.search_stats.get("packed", 0)
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"range": {"price": {"gte": 0}}},
+                                 {"range": {"price": {"lte": 100}}},
+                                 {"range": {"rating": {"gte": 0}}}]}}
+        out = node.search("px", {"query": q})
+        assert svc.search_stats.get("packed", 0) == before
+        assert out["hits"]["total"] > 0
+
+    def test_filters_with_deletes(self, node):
+        node.delete_doc("px", "2")
+        node.refresh("px")
+        q = {"bool": {"must": [{"match": {"body": "quick"}}],
+                      "filter": [{"term": {"tag": "a"}}]}}
+        p, g = _both_lanes(node, q)
+        assert _check_parity(p, g) == {"0"}

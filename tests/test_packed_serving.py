@@ -178,12 +178,16 @@ class TestPackedBehavior:
 
     def test_fallback_shapes_still_work(self, tmp_path):
         node = make_node(tmp_path)
-        # bool+filter (mask nodes) -> general sparse path
+        # bool+filter now rides the packed kernel's filter slots (r4);
+        # shapes it can't express (aggs/sort/...) still take the general path
         out = node.search("idx", {"query": {"bool": {
             "must": [{"match": {"title": "fox"}}],
             "filter": [{"range": {"rank": {"lte": 3}}}]}}})
         assert {h["_id"] for h in out["hits"]["hits"]} <= {"0", "1", "2", "3"}
         stats = node.indices["idx"].search_stats
+        assert stats["packed"] >= 1
+        out = node.search("idx", {"query": {"match": {"title": "fox"}},
+                                  "aggs": {"r": {"max": {"field": "rank"}}}})
         assert stats["sparse"] >= 1
         node.close()
 

@@ -96,39 +96,55 @@ def run_engine_leg(tag: str) -> dict:
         http(port, "PUT", "/bench", json.dumps(
             {"settings": {"number_of_shards": 1},
              "mappings": {"_doc": {"properties": {
-                 "body": {"type": "string"}}}}}))
+                 "body": {"type": "string"},
+                 "price": {"type": "long"}}}}}))
         batch = 2000
         for i in range(0, len(docs), batch):
             lines = []
             for j, d in enumerate(docs[i:i + batch]):
                 lines.append(json.dumps({"index": {"_id": str(i + j)}}))
-                lines.append(json.dumps({"body": d}))
+                lines.append(json.dumps({"body": d,
+                                         "price": (i + j) % 1000}))
             http(port, "POST", "/bench/_bulk", "\n".join(lines) + "\n")
         http(port, "POST", "/bench/_refresh")
         http(port, "POST", "/bench/_optimize")
         index_secs = time.perf_counter() - t0
 
         queries = make_queries(Q_BATCH * N_BATCHES)
-        payloads = []
-        for bi in range(N_BATCHES):
-            lines = []
-            for q in queries[bi * Q_BATCH:(bi + 1) * Q_BATCH]:
-                lines.append(json.dumps({"index": "bench"}))
-                lines.append(json.dumps(
-                    {"query": {"match": {"body": q}}, "size": K,
-                     "_source": False}))
-            payloads.append("\n".join(lines) + "\n")
 
-        # warmup (compile)
-        http(port, "POST", "/_msearch", payloads[0])
-        t0 = time.perf_counter()
-        n_queries = 0
-        for _ in range(REPS):
-            for pl in payloads:
-                out = http(port, "POST", "/_msearch", pl)
-                n_queries += len(out["responses"])
-        dt = time.perf_counter() - t0
-        qps = n_queries / dt
+        def msearch_payloads(body_of):
+            out = []
+            for bi in range(N_BATCHES):
+                lines = []
+                for q in queries[bi * Q_BATCH:(bi + 1) * Q_BATCH]:
+                    lines.append(json.dumps({"index": "bench"}))
+                    lines.append(json.dumps(body_of(q)))
+                out.append("\n".join(lines) + "\n")
+            return out
+
+        def measure_msearch(payloads):
+            http(port, "POST", "/_msearch", payloads[0])   # warm compile
+            t1 = time.perf_counter()
+            n = 0
+            for _ in range(REPS):
+                for pl in payloads:
+                    out = http(port, "POST", "/_msearch", pl)
+                    n += len(out["responses"])
+            return n / (time.perf_counter() - t1)
+
+        # config #1: match query, top-K
+        qps = measure_msearch(msearch_payloads(
+            lambda q: {"query": {"match": {"body": q}}, "size": K,
+                       "_source": False}))
+        # config #2: bool{match + range filter}, top-K — the packed
+        # kernel's filter slots serve this
+        lo = 100
+        qps_filter = measure_msearch(msearch_payloads(
+            lambda q: {"query": {"bool": {
+                "must": [{"match": {"body": q}}],
+                "filter": [{"range": {"price": {"gte": lo,
+                                                "lte": lo + 500}}}]}},
+                "size": K, "_source": False}))
 
         # solo _search latency, size=10 (BASELINE config #1 shape)
         lat = []
@@ -142,9 +158,42 @@ def run_engine_leg(tag: str) -> dict:
             http(port, "POST", "/bench/_search", body)
             lat.append((time.perf_counter() - t1) * 1000)
         lat.sort()
+
+        # concurrent solo clients (NOT pre-batched msearch): the dynamic
+        # batcher coalesces these into shared device programs
+        import threading
+        CONC = int(os.environ.get("BENCH_CONC", "32"))
+        PER = 8
+        conc_lat: list[float] = []
+        conc_lock = threading.Lock()
+
+        def client(ci: int):
+            for qi in range(PER):
+                q = queries[(ci * PER + qi) % len(queries)]
+                body = json.dumps({"query": {"match": {"body": q}},
+                                   "size": 10, "_source": False})
+                t2 = time.perf_counter()
+                http(port, "POST", "/bench/_search", body)
+                dt = (time.perf_counter() - t2) * 1000
+                with conc_lock:
+                    conc_lat.append(dt)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(CONC)]
+        t1 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        conc_dt = time.perf_counter() - t1
+        conc_lat.sort()
         return {"qps": qps,
+                "qps_filter": qps_filter,
                 "p50_ms": lat[len(lat) // 2],
                 "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+                "conc_qps": CONC * PER / conc_dt,
+                "conc_p50_ms": conc_lat[len(conc_lat) // 2],
+                "conc_clients": CONC,
                 "index_secs": index_secs}
     finally:
         server.stop()
@@ -155,11 +204,11 @@ def run_engine_leg(tag: str) -> dict:
 def main_engine():
     import subprocess
     res = run_engine_leg("main")
-    vs = None                  # null = baseline leg didn't run / failed
+    vs = vs_filter = vs_conc = None   # null = baseline leg didn't run
     import jax
     plat = jax.devices()[0].platform
     if plat == "cpu":
-        vs = 1.0
+        vs = vs_filter = vs_conc = 1.0
     elif os.environ.get("BENCH_CPU", "1") != "0":
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
@@ -172,16 +221,27 @@ def main_engine():
                 if ln.startswith("{"):
                     cpu = json.loads(ln)
                     vs = res["qps"] / max(cpu["value"], 1e-9)
+                    if cpu.get("qps_filter"):
+                        vs_filter = res["qps_filter"] / cpu["qps_filter"]
+                    if cpu.get("conc_qps"):
+                        vs_conc = res["conc_qps"] / cpu["conc_qps"]
                     break
             if vs is None:
                 print(f"cpu leg produced no result (rc={out.returncode}): "
                       f"{out.stderr[-500:]}", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
             print(f"cpu leg failed: {e}", file=sys.stderr)
+    rnd = lambda x: round(x, 3) if x is not None else None  # noqa: E731
     print(json.dumps({
         "metric": f"http_msearch_bm25_top{K}_qps_{N_DOCS // 1000}k_docs",
         "value": round(res["qps"], 2), "unit": "qps",
-        "vs_baseline": round(vs, 3) if vs is not None else None,
+        "vs_baseline": rnd(vs),
+        "qps_filter": round(res["qps_filter"], 2),
+        "vs_baseline_filter": rnd(vs_filter),
+        "conc_qps": round(res["conc_qps"], 2),
+        "vs_baseline_concurrent": rnd(vs_conc),
+        "conc_p50_ms": round(res["conc_p50_ms"], 2),
+        "conc_clients": res["conc_clients"],
         "p50_ms": round(res["p50_ms"], 2),
         "p99_ms": round(res["p99_ms"], 2),
         "index_secs": round(res["index_secs"], 1),
@@ -269,6 +329,10 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_LEG") == "cpu":
         res = run_engine_leg("cpu")
         print(json.dumps({"metric": "cpu_leg", "value": round(res["qps"], 2),
+                          "qps_filter": round(res["qps_filter"], 2),
+                          "conc_qps": round(res["conc_qps"], 2),
+                          "conc_p50_ms": round(res["conc_p50_ms"], 2),
+                          "p50_ms": round(res["p50_ms"], 2),
                           "unit": "qps"}))
     else:
         main_engine()

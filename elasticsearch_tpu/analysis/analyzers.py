@@ -351,6 +351,20 @@ class AnalysisService:
             raise ValueError(f"unknown analyzer [{name}]")
         return a
 
+    def custom(self, tokenizer: str, filters: list[str]) -> Analyzer:
+        """Ad-hoc chain for the _analyze API's tokenizer/filters params
+        (ref rest/action/admin/indices/analyze/RestAnalyzeAction)."""
+        tok = _TOKENIZERS.get(tokenizer)
+        if tok is None:
+            raise ValueError(f"unknown tokenizer [{tokenizer}]")
+        fs = []
+        for fname in filters or []:
+            f = _FILTERS.get(fname)
+            if f is None:
+                raise ValueError(f"unknown token filter [{fname}]")
+            fs.append(f)
+        return Analyzer("_custom", tok, fs)
+
     def default_analyzer(self) -> Analyzer:
         return self._analyzers.get("default", self._analyzers["standard"])
 

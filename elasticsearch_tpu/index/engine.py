@@ -58,6 +58,7 @@ class GetResult:
     version: int = -1
     source: dict | None = None
     type_name: str = "_doc"
+    routing: str | None = None
 
 
 class Engine:
@@ -82,7 +83,8 @@ class Engine:
         self._lock = threading.RLock()
         self.segments: list[Segment] = []
         self._buffer = SegmentBuilder(seg_id=0)
-        self._buffer_docs: dict[str, tuple[dict, str]] = {}   # id -> (source, type)
+        # id -> (source, type, routing)
+        self._buffer_docs: dict[str, tuple[dict, str, str | None]] = {}
         self._next_seg_id = 1
         # LiveVersionMap: id -> (version, deleted)
         self.versions: dict[str, tuple[int, bool]] = {}
@@ -124,7 +126,8 @@ class Engine:
             kind = op["op"]
             if kind == "index":
                 self._apply_index(op["id"], op["source"], op.get("type", "_doc"),
-                                  version=op["version"])
+                                  version=op["version"],
+                                  routing=op.get("routing"))
             elif kind == "delete":
                 self._apply_delete(op["id"], version=op["version"])
             n += 1
@@ -156,6 +159,13 @@ class Engine:
             if raw is not None and version <= raw[0]:
                 raise VersionConflictException(doc_id, raw[0], version)
             return version
+        if version_type == "external_gte":
+            # >= is acceptable (ref VersionType.EXTERNAL_GTE)
+            if raw is not None and version < raw[0]:
+                raise VersionConflictException(doc_id, raw[0], version)
+            return version
+        if version_type == "force":
+            return version          # ref VersionType.FORCE: always wins
         # internal: provided version must equal current
         if cur != version:
             raise VersionConflictException(doc_id, cur, version)
@@ -165,7 +175,8 @@ class Engine:
 
     def index(self, doc_id: str, source: dict, type_name: str = "_doc",
               version: int | None = None, version_type: str = "internal",
-              op_type: str = "index", sync: bool | None = None) -> EngineResult:
+              op_type: str = "index", sync: bool | None = None,
+              routing: str | None = None) -> EngineResult:
         with self._lock:
             if self._blocked_reason is not None \
                     or len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
@@ -177,16 +188,17 @@ class Engine:
                 self.refresh()
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
-            self._apply_index(doc_id, source, type_name, new_version)
+            self._apply_index(doc_id, source, type_name, new_version, routing)
             self.translog.add({"op": "index", "id": doc_id, "type": type_name,
-                               "source": source, "version": new_version},
+                               "source": source, "version": new_version,
+                               "routing": routing},
                               sync=sync)
             return EngineResult(doc_id=doc_id, version=new_version, created=created)
 
     def _apply_index(self, doc_id: str, source: dict, type_name: str,
-                     version: int) -> None:
+                     version: int, routing: str | None = None) -> None:
         self._delete_everywhere(doc_id)
-        self._buffer_docs[doc_id] = (source, type_name)
+        self._buffer_docs[doc_id] = (source, type_name, routing)
         self.versions[doc_id] = (version, False)
         self._dirty = True
 
@@ -227,15 +239,18 @@ class Engine:
                 return GetResult(found=False, doc_id=doc_id)
             version = v[0]
             if realtime and doc_id in self._buffer_docs:
-                src, tname = self._buffer_docs[doc_id]
+                src, tname, routing = self._buffer_docs[doc_id]
                 return GetResult(found=True, doc_id=doc_id, version=version,
-                                 source=src, type_name=tname)
+                                 source=src, type_name=tname,
+                                 routing=routing)
             for seg in self.segments:
                 local = seg.id_to_local.get(doc_id)
                 if local is not None and seg.live_host[local]:
                     return GetResult(found=True, doc_id=doc_id, version=version,
                                      source=seg.stored[local],
-                                     type_name=seg.types[local])
+                                     type_name=seg.types[local],
+                                     routing=seg.routings[local]
+                                     if seg.routings else None)
             # non-realtime get sees only refreshed (searchable) state — an
             # unrefreshed buffer doc is a miss (ref ShardGetService contract)
             return GetResult(found=False, doc_id=doc_id)
@@ -252,9 +267,9 @@ class Engine:
             if not self._buffer_docs:
                 return
             builder = SegmentBuilder(seg_id=self._next_seg_id)
-            for doc_id, (source, tname) in self._buffer_docs.items():
+            for doc_id, (source, tname, routing) in self._buffer_docs.items():
                 mapper = self.mappers.document_mapper(tname)
-                parsed = mapper.parse(source, doc_id=doc_id)
+                parsed = mapper.parse(source, doc_id=doc_id, routing=routing)
                 builder.add(parsed, tname,
                             version=self.versions[doc_id][0])
             seg = builder.build()

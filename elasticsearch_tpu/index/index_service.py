@@ -57,7 +57,7 @@ class IndexService:
     def index_doc(self, doc_id: str, source: dict, type_name: str = "_doc",
                   routing: str | None = None, **kw) -> EngineResult:
         return self.shard_for(doc_id, routing).index(
-            doc_id, source, type_name=type_name, **kw)
+            doc_id, source, type_name=type_name, routing=routing, **kw)
 
     def get_doc(self, doc_id: str, routing: str | None = None,
                 realtime: bool = True) -> GetResult:

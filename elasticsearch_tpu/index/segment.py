@@ -161,6 +161,7 @@ class Segment:
     live_host: np.ndarray            # bool[N_pad] host mirror
     live_count: int = 0
     versions: list[int] = dc_field(default_factory=list)  # per local doc
+    routings: list = dc_field(default_factory=list)       # per local doc
 
     def __post_init__(self):
         # device liveness is uploaded lazily: deletes only dirty the host
@@ -177,6 +178,8 @@ class Segment:
             self.live_count = int(self.live_host[: self.n_docs].sum())
         if not self.versions:
             self.versions = [1] * self.n_docs
+        if not self.routings:
+            self.routings = [None] * self.n_docs
 
     @property
     def live(self) -> jax.Array:
@@ -259,6 +262,7 @@ class SegmentBuilder:
         self.ids: list[str] = []
         self.types: list[str] = []
         self.versions: list[int] = []
+        self.routings: list = []
         self.id_to_local: dict[str, int] = {}
         self.n_docs = 0
 
@@ -280,6 +284,7 @@ class SegmentBuilder:
         self.ids.append(doc.doc_id)
         self.types.append(type_name)
         self.versions.append(version)
+        self.routings.append(doc.routing)
         self.id_to_local[doc.doc_id] = local
 
         for field, tokens in doc.tokens.items():
@@ -390,7 +395,7 @@ class SegmentBuilder:
             keywords=keywords, numerics=numerics, vectors=vectors,
             stored=self.stored, ids=self.ids, types=self.types,
             id_to_local=dict(self.id_to_local), live_host=live,
-            versions=list(self.versions))
+            versions=list(self.versions), routings=list(self.routings))
 
 
 def merge_segments(segments: list[Segment], new_seg_id: int,
@@ -425,12 +430,14 @@ def merge_segments(segments: list[Segment], new_seg_id: int,
     ids: list[str] = []
     types: list[str] = []
     versions: list[int] = []
+    routings: list = []
     for seg, keep in zip(segments, keeps):
         for old in keep:
             stored.append(seg.stored[old])
             ids.append(seg.ids[old])
             types.append(seg.types[old])
             versions.append(seg.versions[old])
+            routings.append(seg.routings[old] if seg.routings else None)
 
     # -- text fields: CSR concat + stable re-group by union term id --------
     text: dict[str, TextFieldIndex] = {}
@@ -594,4 +601,4 @@ def merge_segments(segments: list[Segment], new_seg_id: int,
         keywords=keywords, numerics=numerics, vectors=vectors,
         stored=stored, ids=ids, types=types,
         id_to_local={d: i for i, d in enumerate(ids)}, live_host=live,
-        versions=versions)
+        versions=versions, routings=routings)

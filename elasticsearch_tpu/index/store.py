@@ -75,6 +75,9 @@ class SegmentStore:
             "ids": np.asarray(seg.ids, dtype=np.str_),
             "types": np.asarray(seg.types, dtype=np.str_),
             "versions": np.asarray(seg.versions, np.int64),
+            "routings": np.asarray(
+                [r if r is not None else "" for r in
+                 (seg.routings or [None] * seg.n_docs)], dtype=np.str_),
         }
         schema: dict = {"n_docs": seg.n_docs, "n_pad": seg.n_pad,
                         "text": {}, "keywords": [], "numerics": {},
@@ -243,6 +246,8 @@ class SegmentStore:
         ids = [str(i) for i in data["ids"]]
         types = [str(t) for t in data["types"]]
         versions = [int(v) for v in data["versions"]]
+        routings = [str(r) if str(r) else None for r in data["routings"]] \
+            if "routings" in data else [None] * n_docs
         with open(docs_path) as f:
             stored = [json.loads(ln) for ln in f if ln.strip()]
         if len(stored) != n_docs:
@@ -257,4 +262,4 @@ class SegmentStore:
             keywords=keywords, numerics=numerics, vectors=vectors,
             stored=stored, ids=ids, types=types,
             id_to_local={d: i for i, d in enumerate(ids)},
-            live_host=live, versions=versions)
+            live_host=live, versions=versions, routings=routings)

@@ -70,9 +70,20 @@ class FieldType:
     boost: float = 1.0
 
     def to_dict(self) -> dict:
-        out: dict[str, Any] = {"type": self.type}
-        if self.type == TEXT and self.analyzer != "standard":
-            out["analyzer"] = self.analyzer
+        """Render in the reference's wire vocabulary: analyzed and
+        not-analyzed strings are both "string" (ES 2.x, ref
+        index/mapper/core/StringFieldMapper) — _merge_props parses that
+        form back losslessly, so the mapping round-trips."""
+        if self.type == TEXT:
+            out: dict[str, Any] = {"type": "string"}
+            if self.analyzer != "standard":
+                out["analyzer"] = self.analyzer
+            if not self.index:
+                out["index"] = "no"
+            return out
+        if self.type == KEYWORD:
+            return {"type": "string", "index": "not_analyzed"}
+        out = {"type": self.type}
         if self.type == DENSE_VECTOR:
             out["dims"] = self.dims
         if not self.index:

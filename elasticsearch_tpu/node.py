@@ -31,7 +31,9 @@ from .serving.executor import PACKED_BODY_KEYS
 
 class IndexMissingException(Exception):
     def __init__(self, index: str):
-        super().__init__(f"no such index [{index}]")
+        # the reference's message format: "[name] missing"
+        # (ref IndexMissingException.java)
+        super().__init__(f"[{index}] missing")
         self.index = index
 
 
@@ -45,7 +47,26 @@ class InvalidIndexNameException(Exception):
     pass
 
 
-_VALID_INDEX = re.compile(r"^[a-z0-9][a-z0-9_\-+.]*$")
+# invalid characters, not an allowlist: unicode index names are legal
+# (ref MetaDataCreateIndexService.validateIndexName)
+_INDEX_BAD_CHARS = set(' "*\\<>|,/?#')
+
+
+class _ValidIndex:
+    @staticmethod
+    def match(name: str):
+        if not name or name != name.lower():
+            return None
+        if name.startswith(("_", "-", "+")):
+            return None
+        if any(c in _INDEX_BAD_CHARS for c in name):
+            return None
+        if name in (".", ".."):
+            return None
+        return True
+
+
+_VALID_INDEX = _ValidIndex()
 
 logger = logging.getLogger("elasticsearch_tpu.node")
 
@@ -75,6 +96,11 @@ class NodeService:
         self.snapshots = SnapshotsService(self)
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
+        tpl_path = os.path.join(data_path, "_templates.json")
+        if os.path.exists(tpl_path):
+            import json
+            with open(tpl_path) as f:
+                self.templates.update(json.load(f))
         self._recover_indices()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -187,9 +213,15 @@ class NodeService:
         return self.index_service(index).delete_doc(doc_id, **kw)
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   type_name: str = "_doc") -> tuple[EngineResult, bool]:
+                   type_name: str = "_doc",
+                   version: int | None = None) -> tuple[EngineResult, bool]:
         """Scripted/partial update: get -> transform -> reindex
-        (ref action/update/UpdateHelper.java:61). Returns (result, noop)."""
+        (ref action/update/UpdateHelper.java:61). Returns (result, noop).
+        Auto-creates the index like the reference's update-with-upsert."""
+        if index not in self.indices:
+            if not _VALID_INDEX.match(index):
+                raise InvalidIndexNameException(index)
+            self.create_index(index)
         svc = self.index_service(index)
         cur = svc.get_doc(doc_id)
         if not cur.found:
@@ -200,6 +232,8 @@ class NodeService:
                 res = svc.index_doc(doc_id, body["doc"], type_name=type_name)
                 return res, False
             raise DocumentMissingException(f"[{type_name}][{doc_id}]: document missing")
+        if version is not None and cur.version != version:
+            raise VersionConflictException(doc_id, cur.version, version)
         src = dict(cur.source)
         if "script" in body:
             from .script.engine import run_update_script
@@ -284,13 +318,13 @@ class NodeService:
 
     def search(self, index: str, body: dict | None = None,
                size: int | None = None, from_: int | None = None,
-               scroll: str | None = None) -> dict:
+               scroll: str | None = None, scan: bool = False) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         size = int(body.get("size", 10) if size is None else size)
         from_ = int(body.get("from", 0) if from_ is None else from_)
         if scroll is not None:
-            return self._scroll_start(index, body, size, scroll)
+            return self._scroll_start(index, body, size, scroll, scan=scan)
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
@@ -604,7 +638,8 @@ class NodeService:
             return self.search(index, body)
         except Exception as e:  # noqa: BLE001 — per-item error contract
             from .rest.http_server import _status_of
-            return {"error": f"{type(e).__name__}: {e}",
+            # the reference's Name[detail] error rendering
+            return {"error": f"{type(e).__name__}[{e}]",
                     "status": _status_of(e)}
 
     def _msearch_batch_key(self, index: str, body: dict):
@@ -686,7 +721,7 @@ class NodeService:
     # -- scroll (cursored reads, ref §3.5 scroll/scan call stack) ----------
 
     def _scroll_start(self, index: str, body: dict, size: int,
-                      keep_alive: str) -> dict:
+                      keep_alive: str, scan: bool = False) -> dict:
         """Open a scroll context: PIN a point-in-time snapshot of every
         shard's segment set (frozen liveness), then advance with
         search_after cursors over the pinned searchers — O(depth) total,
@@ -705,8 +740,15 @@ class NodeService:
         user_sort = parse_sort(body.get("sort"),
                                [self.indices[n].mappers for n in names])
         implicit = user_sort is None
-        specs = list(user_sort) if user_sort else \
-            [SortSpec(field=SCORE, order="desc")]
+        if scan:
+            # scan: doc order, no scoring (ref search_type=scan +
+            # search/scan/ScanContext) — first response carries only total
+            user_sort = None
+            implicit = True
+            specs = [SortSpec(field=DOC, order="asc")]
+        else:
+            specs = list(user_sort) if user_sort else \
+                [SortSpec(field=SCORE, order="desc")]
         if not any(sp.field == DOC for sp in specs):
             # _doc tiebreak makes the cursor a total order: batches never
             # repeat or skip docs with equal primary keys
@@ -752,7 +794,14 @@ class NodeService:
                    "expiry": time.monotonic() + _duration_secs(keep_alive),
                    "keep_alive": keep_alive, "lock": threading.Lock()}
             self._scrolls[sid] = ctx
-        out = self._scroll_batch(ctx, size)
+        if scan:
+            # the scan contract: the initial response has totals only;
+            # docs start flowing on the first scroll call
+            ctx["size"] = size
+            out = self._scroll_batch(ctx, 0)
+            ctx["size"] = size
+        else:
+            out = self._scroll_batch(ctx, size)
         out["_scroll_id"] = sid
         return out
 
@@ -859,6 +908,42 @@ class NodeService:
 
     def put_template(self, name: str, body: dict) -> None:
         self.templates[name] = body
+        self._persist_templates()
+
+    def _persist_templates(self) -> None:
+        import json
+        path = os.path.join(self.data_path, "_templates.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.templates, f)
+        os.replace(tmp, path)
+
+    def delete_by_query(self, index: str, body: dict) -> int:
+        """Delete every doc matching the query (ref the 1.x _query API,
+        action/deletebyquery/) — scroll the match set, bulk-delete by id."""
+        query_body = {"query": body.get("query", body or {"match_all": {}}),
+                      "size": 1000, "_source": False}
+        out = self.search(index, query_body, scroll="1m")
+        sid = out.get("_scroll_id")
+        deleted = 0
+        try:
+            while True:
+                hits = out["hits"]["hits"]
+                if not hits:
+                    break
+                for h in hits:
+                    try:
+                        self.delete_doc(h["_index"], h["_id"], sync=False)
+                        deleted += 1
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                out = self.scroll(sid)
+        finally:
+            if sid:
+                self.clear_scroll([sid])
+        for n in self._resolve(index):
+            self.indices[n].sync_translogs()
+        return deleted
 
     def cluster_health(self) -> dict:
         shards = sum(s.n_shards for s in self.indices.values())
@@ -874,6 +959,11 @@ class NodeService:
             "initializing_shards": 0,
             "unassigned_shards": sum(
                 s.n_shards * s.n_replicas for s in self.indices.values()),
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
         }
 
     def stats(self) -> dict:

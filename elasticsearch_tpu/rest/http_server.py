@@ -14,6 +14,7 @@ native runtime milestone; the handler table below is transport-agnostic.)
 from __future__ import annotations
 
 import json
+import fnmatch
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -82,6 +83,10 @@ class RestController:
 
     def dispatch(self, method: str, path: str, params: dict,
                  body: bytes) -> tuple[int, dict | str]:
+        from urllib.parse import unquote
+        # percent-decode per segment (ref RestUtils.decodeComponent) —
+        # unicode index names / ids arrive encoded
+        path = "/".join(unquote(seg) for seg in path.split("/"))
         best = None
         for m, rx, handler, spec in self.routes:
             if m != method:
@@ -114,7 +119,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
 
     c.register("GET", "/_cluster/health",
                lambda g, p, b: (200, node.cluster_health()))
-    c.register("GET", "/_stats", lambda g, p, b: (200, node.stats()))
+    c.register("GET", "/_cluster/health/{index}",
+               lambda g, p, b: (200, node.cluster_health()))
     c.register("GET", "/_cat/indices", _cat_indices(node))
     c.register("GET", "/_cat/health", _cat_health(node))
 
@@ -154,27 +160,51 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             body["size"] = int(p["size"][0])
         if "from" in p:
             body["from"] = int(p["from"][0])
+        if "sort" in p and "sort" not in body:
+            # URI sort: "field", "field:desc", comma lists (RestSearchAction)
+            clauses = []
+            for part in p["sort"][0].split(","):
+                if ":" in part:
+                    f, o = part.rsplit(":", 1)
+                    clauses.append({f: {"order": o}})
+                else:
+                    clauses.append(part)
+            body["sort"] = clauses
         scroll = p.get("scroll", [None])[0]
-        return 200, node.search(g.get("index", "_all"), body, scroll=scroll)
+        scan = p.get("search_type", [None])[0] == "scan"
+        return 200, node.search(g.get("index", "_all"), body, scroll=scroll,
+                                scan=scan)
 
     def scroll_next(g, p, b):
-        body = _json_body(b)
-        sid = body.get("scroll_id") or p.get("scroll_id", [None])[0]
+        body = _json_body(b) if b and b.strip().startswith(b"{") else {}
+        sid = g.get("scroll_id") or body.get("scroll_id") \
+            or p.get("scroll_id", [None])[0] \
+            or (b.decode().strip() if b else None)
         if not sid:
             raise RestError(400, "scroll_id is required")
         keep = body.get("scroll") or p.get("scroll", [None])[0]
         return 200, node.scroll(sid, keep)
     c.register("GET", "/_search/scroll", scroll_next)
     c.register("POST", "/_search/scroll", scroll_next)
+    c.register("GET", "/_search/scroll/{scroll_id}", scroll_next)
+    c.register("POST", "/_search/scroll/{scroll_id}", scroll_next)
 
     def clear_scroll(g, p, b):
         body = _json_body(b)
-        sids = body.get("scroll_id", [])
+        sids = g.get("scroll_id") or body.get("scroll_id") \
+            or p.get("scroll_id", [None])[0] or []
         if isinstance(sids, str):
-            sids = [sids]
-        n = node.clear_scroll(sids)
+            sids = sids.split(",")
+        if sids == ["_all"]:
+            sids = list(node._scrolls)
+            n = node.clear_scroll(sids)
+        else:
+            n = node.clear_scroll(sids)
+            if n == 0 and sids:
+                return 404, {"succeeded": True, "num_freed": 0}
         return 200, {"succeeded": True, "num_freed": n}
     c.register("DELETE", "/_search/scroll", clear_scroll)
+    c.register("DELETE", "/_search/scroll/{scroll_id}", clear_scroll)
     c.register("GET", "/{index}/_search", search)
     c.register("POST", "/{index}/_search", search)
     c.register("GET", "/_search", search)
@@ -273,37 +303,100 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("POST", "/{index}/_forcemerge", optimize)
 
     def get_mapping(g, p, b):
+        tpat = g.get("type")
         out = {}
+        found_type = False
         for n in node._resolve(g.get("index", "_all")):
-            out[n] = {"mappings": node.indices[n].mappings_dict()}
+            md = node.indices[n].mappings_dict()
+            if tpat and tpat not in ("_all", "*"):
+                md = {t: m for t, m in md.items()
+                      if any(fnmatch.fnmatch(t, pat)
+                             for pat in tpat.split(","))}
+            if md:
+                found_type = True
+            out[n] = {"mappings": md}
+        if tpat and tpat not in ("_all", "*") and not found_type:
+            return 404, {"error": f"TypeMissingException: type[[{tpat}]] "
+                                  "missing", "status": 404}
         return 200, out
     c.register("GET", "/{index}/_mapping", get_mapping)
     c.register("GET", "/_mapping", get_mapping)
+    c.register("GET", "/{index}/_mapping/{type}", get_mapping)
+    c.register("GET", "/_mapping/{type}", get_mapping)
+    c.register("GET", "/{index}/{type}/_mapping", get_mapping)
+
+    def head_type(g, p, b):
+        try:
+            for n in node._resolve(g["index"]):
+                if g["type"] in node.indices[n].mappers.types():
+                    return 200, {}
+        except IndexMissingException:
+            pass
+        return 404, {}
+    c.register("HEAD", "/{index}/{type}", head_type)
+
+    def field_mapping(g, p, b):
+        """GET field mappings (ref indices.get_field_mapping spec)."""
+        fields = g.get("field", "*").split(",")
+        tpat = g.get("type")
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            svc = node.indices[n]
+            tmap = {}
+            for t in svc.mappers.types():
+                if tpat and tpat not in ("_all", "*") \
+                        and not any(fnmatch.fnmatch(t, pp)
+                                    for pp in tpat.split(",")):
+                    continue
+                dm = svc.mappers.document_mapper(t, create=False)
+                fmap = {}
+                for path, ft in dm.fields.items():
+                    if any(fnmatch.fnmatch(path, f)
+                           or path.split(".")[-1] == f for f in fields):
+                        fmap[path] = {"full_name": path,
+                                      "mapping": {path.split(".")[-1]:
+                                                  ft.to_dict()}}
+                if fmap:
+                    tmap[t] = fmap
+            out[n] = {"mappings": tmap}
+        return 200, out
+    c.register("GET", "/_mapping/field/{field}", field_mapping)
+    c.register("GET", "/{index}/_mapping/field/{field}", field_mapping)
+    c.register("GET", "/{index}/_mapping/{type}/field/{field}",
+               field_mapping)
+    c.register("GET", "/_mapping/{type}/field/{field}", field_mapping)
 
     def put_mapping(g, p, b):
         body = _json_body(b)
         tname = g.get("type", "_doc")
         mapping = body.get(tname, body)
-        node.put_mapping(g["index"], tname, mapping)
+        for n in node._resolve(g["index"]):
+            node.put_mapping(n, tname, mapping)
         return 200, {"acknowledged": True}
     c.register("PUT", "/{index}/_mapping/{type}", put_mapping)
     c.register("PUT", "/{index}/{type}/_mapping", put_mapping)
-
-    def get_settings(g, p, b):
-        out = {}
-        for n in node._resolve(g.get("index", "_all")):
-            out[n] = {"settings": {"index": dict(node.indices[n].settings)}}
-        return 200, out
-    c.register("GET", "/{index}/_settings", get_settings)
+    c.register("PUT", "/{index}/_mapping", put_mapping)
+    c.register("POST", "/{index}/_mapping/{type}", put_mapping)
 
     def analyze(g, p, b):
         body = _json_body(b)
         text = body.get("text") or (p.get("text", [""])[0])
-        analyzer = body.get("analyzer", p.get("analyzer", ["standard"])[0])
         svc = node.index_service(g["index"]) if g.get("index") else None
-        from ..analysis.analyzers import AnalysisService
+        from ..analysis.analyzers import AnalysisService, Analyzer
         an = (svc.mappers.analysis if svc else AnalysisService())
-        tokens = an.analyzer(analyzer).analyze(
+        tokenizer = body.get("tokenizer", p.get("tokenizer", [None])[0])
+        filters = body.get("filters", body.get("token_filters"))
+        if filters is None:
+            filters = p.get("filters", [None])[0]
+            filters = filters.split(",") if filters else []
+        elif isinstance(filters, str):
+            filters = filters.split(",")
+        if tokenizer:
+            analyzer_obj = an.custom(tokenizer, filters)
+        else:
+            name = body.get("analyzer", p.get("analyzer", ["standard"])[0])
+            analyzer_obj = an.analyzer(name)
+        tokens = analyzer_obj.analyze(
             text if isinstance(text, str) else " ".join(text))
         return 200, {"tokens": [
             {"token": t, "start_offset": 0, "end_offset": 0,
@@ -314,13 +407,6 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/{index}/_analyze", analyze)
     c.register("POST", "/{index}/_analyze", analyze)
 
-    def index_stats(g, p, b):
-        out = {}
-        for n in node._resolve(g.get("index", "_all")):
-            out[n] = node.indices[n].stats()
-        return 200, {"indices": out}
-    c.register("GET", "/{index}/_stats", index_stats)
-
     # -- documents ---------------------------------------------------------
     def put_doc(g, p, b):
         kw = {}
@@ -329,6 +415,10 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             kw["version_type"] = p.get("version_type", ["internal"])[0]
         if p.get("op_type", [None])[0] == "create":
             kw["op_type"] = "create"
+        if "version" in p:
+            kw["version"] = int(p["version"][0])
+        if "version_type" in p:
+            kw["version_type"] = p["version_type"][0]
         _, res = node.index_doc(g["index"], g.get("id"), _json_body(b),
                                 type_name=g.get("type", "_doc"),
                                 routing=p.get("routing", [None])[0], **kw)
@@ -337,7 +427,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         status = 201 if res.created else 200
         return status, {"_index": g["index"], "_type": g.get("type", "_doc"),
                         "_id": res.doc_id, "_version": res.version,
-                        "created": res.created}
+                        "created": res.created,
+                        "_shards": _write_shards(node, g["index"])}
     c.register("PUT", "/{index}/{type}/{id}", put_doc)
     c.register("POST", "/{index}/{type}/{id}", put_doc)
     c.register("POST", "/{index}/{type}", put_doc)
@@ -346,67 +437,736 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         p = {**p, "op_type": ["create"]}
         return put_doc(g, p, b)
     c.register("PUT", "/{index}/{type}/{id}/_create", create_doc)
+    c.register("POST", "/{index}/{type}/{id}/_create", create_doc)
 
-    def get_doc(g, p, b):
+    def _resolve_get(g, p):
+        """Shared GET semantics: realtime, version check, source filtering
+        (ref index/get/ShardGetService + RestGetAction params)."""
         realtime = p.get("realtime", ["true"])[0] != "false"
+        if p.get("refresh", ["false"])[0] != "false":
+            node.refresh(g["index"])
         res = node.get_doc(g["index"], g["id"],
                            routing=p.get("routing", [None])[0],
                            realtime=realtime)
+        if res.found and "version" in p \
+                and int(p["version"][0]) != res.version:
+            raise VersionConflictException(
+                g["id"], res.version, int(p["version"][0]))
+        return res
+
+    def _source_of(res, p):
+        src = res.source
+        spec = p.get("_source", [None])[0]
+        if spec is not None:
+            if spec in ("false", "no"):
+                return None
+            if spec not in ("true", "yes"):
+                src = _source_filter_paths(src, spec.split(","), None)
+        inc = p.get("_source_include", p.get("_source_includes", [None]))[0]
+        exc = p.get("_source_exclude", p.get("_source_excludes", [None]))[0]
+        if inc or exc:
+            src = _source_filter_paths(src, inc.split(",") if inc else None,
+                                       exc.split(",") if exc else None)
+        return src
+
+    def get_doc(g, p, b):
+        res = _resolve_get(g, p)
         out = {"_index": g["index"], "_type": res.type_name, "_id": g["id"],
                "found": res.found}
         if res.found:
             out["_version"] = res.version
-            out["_source"] = res.source
+            src = _source_of(res, p)
+            # fields param suppresses _source unless explicitly requested
+            # (ref RestGetAction: fields and source are separate fetches)
+            if src is not None and not ("fields" in p
+                                        and "_source" not in p):
+                out["_source"] = src
+            if "fields" in p:
+                fields = {}
+                for f in p["fields"][0].split(","):
+                    if f == "_routing":
+                        if res.routing is not None:
+                            fields["_routing"] = res.routing
+                        continue
+                    v = res.source.get(f) if res.source else None
+                    if v is not None:
+                        fields[f] = v if isinstance(v, list) else [v]
+                if fields:
+                    out["fields"] = fields
         return (200 if res.found else 404), out
     c.register("GET", "/{index}/{type}/{id}", get_doc)
 
     def get_source(g, p, b):
-        res = node.get_doc(g["index"], g["id"])
+        res = _resolve_get(g, p)
         if not res.found:
             return 404, {"error": "not found", "status": 404}
-        return 200, res.source
+        src = _source_of(res, p)
+        return 200, src if src is not None else {}
     c.register("GET", "/{index}/{type}/{id}/_source", get_source)
 
     def head_doc(g, p, b):
-        res = node.get_doc(g["index"], g["id"])
+        try:
+            res = _resolve_get(g, p)
+        except IndexMissingException:
+            return 404, {}
         return (200 if res.found else 404), {}
     c.register("HEAD", "/{index}/{type}/{id}", head_doc)
+    c.register("HEAD", "/{index}/{type}/{id}/_source", head_doc)
 
     def delete_doc(g, p, b):
+        kw = {}
+        if "version" in p:
+            kw["version"] = int(p["version"][0])
+        if "version_type" in p:
+            kw["version_type"] = p["version_type"][0]
         res = node.delete_doc(g["index"], g["id"],
-                              routing=p.get("routing", [None])[0])
+                              routing=p.get("routing", [None])[0], **kw)
+        if p.get("refresh", ["false"])[0] != "false":
+            node.refresh(g["index"])
         return (200 if res.found else 404), {
             "found": res.found, "_index": g["index"],
             "_type": g.get("type", "_doc"), "_id": g["id"],
-            "_version": res.version}
+            "_version": res.version,
+            "_shards": _write_shards(node, g["index"])}
     c.register("DELETE", "/{index}/{type}/{id}", delete_doc)
 
     def update_doc(g, p, b):
+        vt = p.get("version_type", ["internal"])[0]
+        if vt not in ("internal", "force"):
+            raise RestError(
+                400, "ActionRequestValidationException: version type "
+                     f"[{vt}] is not supported by the update API")
+        kw = {}
+        if "version" in p:
+            kw["version"] = int(p["version"][0])
         res, noop = node.update_doc(g["index"], g["id"], _json_body(b),
-                                    type_name=g.get("type", "_doc"))
+                                    type_name=g.get("type", "_doc"), **kw)
         if p.get("refresh", ["false"])[0] != "false":
             node.refresh(g["index"])
-        return 200, {"_index": g["index"], "_type": g.get("type", "_doc"),
-                     "_id": g["id"], "_version": res.version}
+        out = {"_index": g["index"], "_type": g.get("type", "_doc"),
+               "_id": g["id"], "_version": res.version,
+               "_shards": _write_shards(node, g["index"])}
+        if "fields" in p:
+            got = node.get_doc(g["index"], g["id"])
+            if got.found:
+                fields = {}
+                src_included = False
+                for f in p["fields"][0].split(","):
+                    if f == "_source":
+                        src_included = True
+                        continue
+                    v = (got.source or {}).get(f)
+                    if v is not None:
+                        fields[f] = v if isinstance(v, list) else [v]
+                entry: dict = {"found": True, "_version": got.version}
+                if src_included:
+                    entry["_source"] = got.source
+                if fields:
+                    entry["fields"] = fields
+                out["get"] = entry
+        return 200, out
     c.register("POST", "/{index}/{type}/{id}/_update", update_doc)
 
     def mget(g, p, b):
         body = _json_body(b)
+        items = body.get("docs")
+        if items is None and "ids" in body:
+            items = [{"_id": i} for i in body["ids"]]
+        if items is None:
+            raise RestError(400, "ActionRequestValidationException: no "
+                                 "documents to get")
         docs = []
-        for d in body.get("docs", []):
+        for d in items:
+            if not isinstance(d, dict):
+                d = {"_id": d}
             idx = d.get("_index", g.get("index"))
-            res = node.get_doc(idx, d["_id"])
+            if "_id" not in d:
+                raise RestError(400, "ActionRequestValidationException: "
+                                     "id is missing")
+            if idx is None:
+                raise RestError(400, "ActionRequestValidationException: "
+                                     "index is missing")
+            doc_id = str(d["_id"])
+            try:
+                res = node.get_doc(idx, doc_id,
+                                   routing=d.get("_routing") or d.get("routing"))
+            except IndexMissingException as e:
+                docs.append({"_index": idx, "_type": d.get("_type", "_doc"),
+                             "_id": doc_id,
+                             "error": str(e), "found": False})
+                continue
             entry = {"_index": idx, "_type": res.type_name,
-                     "_id": d["_id"], "found": res.found}
+                     "_id": doc_id, "found": res.found}
             if res.found:
                 entry["_version"] = res.version
-                entry["_source"] = res.source
+                flds = d.get("fields", d.get("_fields"))
+                if flds:
+                    if isinstance(flds, str):
+                        flds = [flds]
+                    fields = {}
+                    src_included = False
+                    for f in flds:
+                        if f == "_source":
+                            src_included = True
+                        elif f == "_routing":
+                            if res.routing is not None:
+                                fields["_routing"] = res.routing
+                        else:
+                            v = (res.source or {}).get(f)
+                            if v is not None:
+                                fields[f] = v if isinstance(v, list) else [v]
+                    if fields:
+                        entry["fields"] = fields
+                    if src_included:
+                        entry["_source"] = res.source
+                else:
+                    src = res.source
+                    spec = d.get("_source")
+                    if spec is not None:
+                        if spec is False:
+                            src = None
+                        elif spec is not True:
+                            inc = spec if isinstance(spec, list) else \
+                                spec.get("include", spec.get("includes"))
+                            exc = None if isinstance(spec, list) else \
+                                spec.get("exclude", spec.get("excludes"))
+                            src = _source_filter_paths(src, inc, exc)
+                    if src is not None:
+                        entry["_source"] = src
             docs.append(entry)
         return 200, {"docs": docs}
     c.register("GET", "/_mget", mget)
     c.register("POST", "/_mget", mget)
     c.register("GET", "/{index}/_mget", mget)
     c.register("POST", "/{index}/_mget", mget)
+    c.register("GET", "/{index}/{type}/_mget", mget)
+    c.register("POST", "/{index}/{type}/_mget", mget)
+
+    _register_indices_routes(c, node)
+
+
+def _flat_settings(svc) -> dict:
+    """Flat 'index.'-prefixed settings map with the implicit defaults the
+    reference always reports (ref RestGetSettingsAction string rendering)."""
+    out = {"index.number_of_shards": str(svc.n_shards),
+           "index.number_of_replicas": str(svc.n_replicas),
+           "index.version.created": "2000000"}
+    for k, v in dict(svc.settings).items():
+        key = k if k.startswith("index.") else f"index.{k}"
+        out[key] = str(v)
+    return out
+
+
+def _nest_flat(flat: dict) -> dict:
+    out: dict = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        node = out
+        for p in parts[:-1]:
+            nxt = node.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                node[p] = nxt
+            node = nxt
+        node[parts[-1]] = v
+    return out
+
+
+def _render_settings(svc, flat: bool = False) -> dict:
+    f = _flat_settings(svc)
+    return f if flat else _nest_flat(f)
+
+
+def _write_shards(node: NodeService, index: str) -> dict:
+    try:
+        svc = node.indices[node._resolve(index)[0]]
+        total = 1 + svc.n_replicas
+    except Exception:  # noqa: BLE001
+        total = 1
+    return {"total": total, "successful": 1, "failed": 0}
+
+
+def _source_filter_paths(src: dict, includes, excludes) -> dict:
+    from ..search.shard_searcher import _filter_source
+    spec: dict = {}
+    if includes:
+        spec["includes"] = [p if "*" in p else p + "*" for p in includes] \
+            + list(includes)
+    if excludes:
+        spec["excludes"] = list(excludes)
+    return _filter_source(src, spec)
+
+
+def _register_indices_routes(c: RestController, node: NodeService) -> None:
+    """Admin/index APIs beyond the core CRUD set (alias CRUD, templates,
+    settings, validate, segments, stats, cluster info) — the breadth the
+    rest-api-spec YAML suites exercise (ref rest/action/admin/)."""
+
+    # -- GET method variants the specs allow -------------------------------
+    def refresh(g, p, b):
+        node.refresh(g.get("index", "_all"))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("GET", "/{index}/_refresh", refresh)
+    c.register("GET", "/_refresh", refresh)
+
+    def flush(g, p, b):
+        node.flush(g.get("index", "_all"))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("GET", "/{index}/_flush", flush)
+    c.register("GET", "/_flush", flush)
+
+    def optimize(g, p, b):
+        node.force_merge(g.get("index", "_all"),
+                         int(p.get("max_num_segments", ["1"])[0]))
+        return 200, {"_shards": {"failed": 0}}
+    c.register("GET", "/{index}/_optimize", optimize)
+    c.register("GET", "/_optimize", optimize)
+
+    # -- aliases (ref cluster/metadata/MetaDataIndicesAliasesService) ------
+    def _alias_map(index_expr: str | None, name: str | None):
+        """-> {index: [matching aliases]} honoring wildcards in `name`."""
+        names = node._resolve(index_expr or "_all")
+        out: dict[str, list[str]] = {}
+        for n in names:
+            aliases = sorted(node.indices[n].aliases)
+            if name and name not in ("_all", "*"):
+                pats = name.split(",")
+                aliases = [a for a in aliases
+                           if any(fnmatch.fnmatch(a, pat) for pat in pats)]
+            out[n] = aliases
+        return out
+
+    def put_alias(g, p, b):
+        for n in node._resolve(g["index"]):
+            node.indices[n].aliases.add(g["name"])
+            node._persist_index_meta(node.indices[n])
+        return 200, {"acknowledged": True}
+    for pat in ("/{index}/_alias/{name}", "/{index}/_aliases/{name}",
+                "/_alias/{name}", "/_aliases/{name}"):
+        c.register("PUT", pat, put_alias)
+        c.register("POST", pat, put_alias)
+
+    def delete_alias(g, p, b):
+        removed = False
+        for n in node._resolve(g["index"]):
+            svc = node.indices[n]
+            match = [a for a in svc.aliases
+                     if any(fnmatch.fnmatch(a, pat)
+                            for pat in g["name"].split(","))] \
+                if g["name"] not in ("_all", "*") else list(svc.aliases)
+            for a in match:
+                svc.aliases.discard(a)
+                removed = True
+            if match:
+                node._persist_index_meta(svc)
+        if not removed:
+            return 404, {"error": f"aliases [{g['name']}] missing",
+                         "status": 404}
+        return 200, {"acknowledged": True}
+    c.register("DELETE", "/{index}/_alias/{name}", delete_alias)
+    c.register("DELETE", "/{index}/_aliases/{name}", delete_alias)
+
+    def get_alias(g, p, b):
+        amap = _alias_map(g.get("index"), g.get("name"))
+        if g.get("name") and not any(amap.values()):
+            return 404, {"error": f"alias [{g['name']}] missing",
+                         "status": 404}
+        return 200, {n: {"aliases": {a: {} for a in al}}
+                     for n, al in amap.items()
+                     if al or not g.get("name")}
+    for pat in ("/_alias", "/_alias/{name}", "/{index}/_alias",
+                "/{index}/_alias/{name}", "/_aliases", "/_aliases/{name}",
+                "/{index}/_aliases"):
+        c.register("GET", pat, get_alias)
+
+    def head_alias(g, p, b):
+        amap = _alias_map(g.get("index"), g.get("name"))
+        return (200 if any(amap.values()) else 404), {}
+    c.register("HEAD", "/_alias/{name}", head_alias)
+    c.register("HEAD", "/{index}/_alias/{name}", head_alias)
+
+    def update_aliases(g, p, b):
+        body = _json_body(b)
+        for action in body.get("actions", []):
+            (kind, spec), = action.items()
+            indices = spec.get("indices") or [spec["index"]]
+            aliases = spec.get("aliases") or [spec["alias"]]
+            for expr in indices:
+                for n in node._resolve(expr):
+                    svc = node.indices[n]
+                    for a in aliases:
+                        if kind == "add":
+                            svc.aliases.add(a)
+                        else:
+                            svc.aliases.discard(a)
+                    node._persist_index_meta(svc)
+        return 200, {"acknowledged": True}
+    c.register("POST", "/_aliases", update_aliases)
+
+    # -- templates ---------------------------------------------------------
+    def get_template(g, p, b):
+        name = g.get("name")
+        if name is None:
+            return 200, dict(node.templates)
+        out = {t: v for t, v in node.templates.items()
+               if any(fnmatch.fnmatch(t, pat) for pat in name.split(","))}
+        if not out and "*" not in name:
+            return 404, {"error": f"template [{name}] missing",
+                         "status": 404}
+        return 200, out
+    c.register("GET", "/_template", get_template)
+    c.register("GET", "/_template/{name}", get_template)
+
+    def delete_template(g, p, b):
+        match = [t for t in node.templates
+                 if fnmatch.fnmatch(t, g["name"])]
+        if not match:
+            if "*" in g["name"]:    # wildcard deletes are no-match tolerant
+                return 200, {"acknowledged": True}
+            return 404, {"error": f"template [{g['name']}] missing",
+                         "status": 404}
+        for t in match:
+            del node.templates[t]
+        node._persist_templates()
+        return 200, {"acknowledged": True}
+    c.register("DELETE", "/_template/{name}", delete_template)
+
+    c.register("HEAD", "/_template/{name}",
+               lambda g, p, b: ((200 if any(
+                   fnmatch.fnmatch(t, g["name"]) for t in node.templates)
+                   else 404), {}))
+
+    # -- indices.get / settings -------------------------------------------
+    def get_index(g, p, b):
+        flat = p.get("flat_settings", ["false"])[0] == "true"
+        out = {}
+        for n in node._resolve(g["index"]):
+            svc = node.indices[n]
+            out[n] = {"aliases": {a: {} for a in sorted(svc.aliases)},
+                      "mappings": svc.mappings_dict(),
+                      "settings": _render_settings(svc, flat),
+                      "warmers": {}}
+        return 200, out
+    c.register("GET", "/{index}", get_index)
+
+    def get_settings(g, p, b):
+        flat = p.get("flat_settings", ["false"])[0] == "true"
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            out[n] = {"settings": _render_settings(node.indices[n], flat)}
+        return 200, out
+    c.register("GET", "/_settings", get_settings)
+    c.register("GET", "/{index}/_settings", get_settings)
+    c.register("GET", "/{index}/_settings/{setting}", get_settings)
+
+    def put_settings(g, p, b):
+        body = _json_body(b)
+        flat = body.get("settings", body)
+        flat = flat.get("index", flat) if isinstance(
+            flat.get("index", None), dict) else flat
+        for n in node._resolve(g.get("index", "_all")):
+            svc = node.indices[n]
+            data = dict(svc.settings)
+            for k, v in flat.items():
+                key = k if k.startswith("index.") else k
+                data[key] = v
+            from ..common.settings import Settings
+            svc.settings = Settings(data)
+            nr = svc.settings.get("number_of_replicas",
+                                  svc.settings.get(
+                                      "index.number_of_replicas"))
+            if nr is not None:
+                svc.n_replicas = int(nr)
+            node._persist_index_meta(svc)
+        return 200, {"acknowledged": True}
+    c.register("PUT", "/_settings", put_settings)
+    c.register("PUT", "/{index}/_settings", put_settings)
+
+    # -- validate / explain / delete-by-query ------------------------------
+    def validate_query(g, p, b):
+        body = _json_body(b)
+        query = body.get("query", {"match_all": {}})
+        names = node._resolve(g.get("index", "_all"))
+        valid = True
+        err = None
+        try:
+            from ..search.query_parser import QueryParser
+            mappers = node.indices[names[0]].mappers if names else None
+            QueryParser(mappers).parse(query)
+        except Exception as e:  # noqa: BLE001 — that's the point
+            valid = False
+            err = str(e)
+        out = {"valid": valid,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if p.get("explain", ["false"])[0] == "true":
+            expl = {"index": names[0] if names else "_all", "valid": valid}
+            if err:
+                expl["error"] = err
+            out["explanations"] = [expl]
+        return 200, out
+    for pat in ("/_validate/query", "/{index}/_validate/query",
+                "/{index}/{type}/_validate/query"):
+        c.register("GET", pat, validate_query)
+        c.register("POST", pat, validate_query)
+
+    def explain_doc(g, p, b):
+        body = _json_body(b)
+        query = body.get("query", {"match_all": {}})
+        out = node.search(g["index"], {
+            "query": {"bool": {"must": [query],
+                               "filter": [{"ids": {"values": [g["id"]]}}]}},
+            "size": 1, "track_scores": True})
+        hits = out["hits"]["hits"]
+        matched = bool(hits)
+        resp = {"_index": g["index"], "_type": g.get("type", "_doc"),
+                "_id": g["id"], "matched": matched}
+        if matched:
+            score = hits[0]["_score"] or 0.0
+            resp["explanation"] = {"value": score,
+                                   "description": "sum of:", "details": []}
+        return 200, resp
+    c.register("GET", "/{index}/{type}/{id}/_explain", explain_doc)
+    c.register("POST", "/{index}/{type}/{id}/_explain", explain_doc)
+
+    def delete_by_query(g, p, b):
+        body = _json_body(b)
+        if not body and "q" not in p:
+            raise RestError(400, "delete_by_query requires a query")
+        deleted = node.delete_by_query(g["index"], body)
+        return 200, {"_indices": {g["index"]: {"_shards": {
+            "total": 1, "successful": 1, "failed": 0}}},
+            "deleted": deleted}
+    c.register("DELETE", "/{index}/_query", delete_by_query)
+    c.register("DELETE", "/{index}/{type}/_query", delete_by_query)
+
+    # -- segments / cluster info ------------------------------------------
+    def segments_api(g, p, b):
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            svc = node.indices[n]
+            shards = {}
+            for si, e in enumerate(svc.shards):
+                shards[str(si)] = [{
+                    "routing": {"state": "STARTED", "primary": True},
+                    "num_committed_segments": len(e.segments),
+                    "num_search_segments": len(e.segments),
+                    "segments": {
+                        f"_{seg.seg_id}": {
+                            "generation": seg.seg_id,
+                            "num_docs": seg.live_count,
+                            "deleted_docs": seg.n_docs - seg.live_count,
+                            "memory_in_bytes": seg.memory_bytes(),
+                            "search": True, "committed": True,
+                        } for seg in e.segments}}]
+            out[n] = {"shards": shards}
+        return 200, {"_shards": {"failed": 0}, "indices": out}
+    c.register("GET", "/_segments", segments_api)
+    c.register("GET", "/{index}/_segments", segments_api)
+
+    c.register("GET", "/_cluster/pending_tasks",
+               lambda g, p, b: (200, {"tasks": []}))
+    c.register("GET", "/_cluster/settings",
+               lambda g, p, b: (200, {"persistent": {}, "transient": {}}))
+    c.register("PUT", "/_cluster/settings",
+               lambda g, p, b: (200, {"acknowledged": True,
+                                      "persistent": {}, "transient": {}}))
+
+    def cluster_state(g, p, b):
+        meta = {"indices": {}, "templates": dict(node.templates)}
+        metrics = g.get("metric", "_all")
+        idx_expr = g.get("index")
+        names = node._resolve(idx_expr) if idx_expr else list(node.indices)
+        for n in names:
+            svc = node.indices[n]
+            meta["indices"][n] = {
+                "state": "open",
+                "aliases": sorted(svc.aliases),
+                "mappings": svc.mappings_dict(),
+                "settings": _render_settings(svc)}
+        out: dict = {"cluster_name": node.cluster_name,
+                     "master_node": "tpu-node-0"}
+        if metrics in ("_all", "metadata"):
+            out["metadata"] = meta
+        if metrics in ("_all", "nodes"):
+            out["nodes"] = {"tpu-node-0": {"name": "tpu-node-0"}}
+        if metrics in ("_all", "routing_table"):
+            out["routing_table"] = {"indices": {
+                n: {"shards": {}} for n in names}}
+        if metrics in ("_all", "blocks"):
+            out["blocks"] = {}
+        return 200, out
+    c.register("GET", "/_cluster/state", cluster_state)
+    c.register("GET", "/_cluster/state/{metric}", cluster_state)
+    c.register("GET", "/_cluster/state/{metric}/{index}", cluster_state)
+
+    # -- richer _cat -------------------------------------------------------
+    def cat_count(g, p, b):
+        names = node._resolve(g.get("index", "_all"))
+        total = sum(node.indices[n].doc_count() for n in names)
+        return 200, f"{total}\n"
+    c.register("GET", "/_cat/count", cat_count)
+    c.register("GET", "/_cat/count/{index}", cat_count)
+
+    def cat_aliases(g, p, b):
+        rows = []
+        for n, svc in sorted(node.indices.items()):
+            for a in sorted(svc.aliases):
+                if g.get("name") and not fnmatch.fnmatch(a, g["name"]):
+                    continue
+                rows.append(f"{a} {n} - - -")
+        return 200, "\n".join(rows) + ("\n" if rows else "")
+    c.register("GET", "/_cat/aliases", cat_aliases)
+    c.register("GET", "/_cat/aliases/{name}", cat_aliases)
+
+    def cat_shards(g, p, b):
+        rows = []
+        for n, svc in sorted(node.indices.items()):
+            for si, e in enumerate(svc.shards):
+                rows.append(f"{n} {si} p STARTED {e.doc_count()} - - -")
+        return 200, "\n".join(rows) + ("\n" if rows else "")
+    c.register("GET", "/_cat/shards", cat_shards)
+    c.register("GET", "/_cat/shards/{index}", cat_shards)
+
+    def cat_segments(g, p, b):
+        rows = []
+        for n, svc in sorted(node.indices.items()):
+            for si, e in enumerate(svc.shards):
+                for seg in e.segments:
+                    rows.append(f"{n} {si} p _{seg.seg_id} {seg.seg_id} "
+                                f"{seg.live_count} "
+                                f"{seg.n_docs - seg.live_count} "
+                                f"{seg.memory_bytes()}")
+        return 200, "\n".join(rows) + ("\n" if rows else "")
+    c.register("GET", "/_cat/segments", cat_segments)
+    c.register("GET", "/_cat/segments/{index}", cat_segments)
+
+    def cat_nodes(g, p, b):
+        return 200, "127.0.0.1 - tpu-node-0 * mdi\n"
+    c.register("GET", "/_cat/nodes", cat_nodes)
+
+    def cat_master(g, p, b):
+        return 200, "tpu-node-0 127.0.0.1\n"
+    c.register("GET", "/_cat/master", cat_master)
+
+    # -- indices.stats (reference response shape) --------------------------
+    def index_stats_v2(g, p, b):
+        names = node._resolve(g.get("index", "_all"))
+        indices = {}
+        prim_all = {"docs": {"count": 0, "deleted": 0},
+                    "store": {"size_in_bytes": 0},
+                    "indexing": {"index_total": 0},
+                    "search": {"query_total": 0},
+                    "segments": {"count": 0},
+                    "get": {"total": 0}}
+
+        def acc(dst, src):
+            for k, v in src.items():
+                for k2, v2 in v.items():
+                    dst[k][k2] += v2
+
+        total_shards = 0
+        for n in names:
+            svc = node.indices[n]
+            seg = [e.segment_stats() for e in svc.shards]
+            prim = {"docs": {"count": svc.doc_count(),
+                             "deleted": sum(s["deleted"] for s in seg)},
+                    "store": {"size_in_bytes": sum(
+                        s["memory_in_bytes"] for s in seg)},
+                    "indexing": {"index_total": svc.doc_count()},
+                    "search": {"query_total": sum(
+                        svc.search_stats.values())},
+                    "segments": {"count": sum(s["count"] for s in seg)},
+                    "get": {"total": 0}}
+            acc(prim_all, prim)
+            indices[n] = {"primaries": prim, "total": prim}
+            total_shards += svc.n_shards
+        return 200, {"_shards": {"total": total_shards,
+                                 "successful": total_shards, "failed": 0},
+                     "_all": {"primaries": prim_all, "total": prim_all},
+                     "indices": indices}
+    c.register("GET", "/_stats", index_stats_v2)
+    c.register("GET", "/{index}/_stats", index_stats_v2)
+    c.register("GET", "/_stats/{metric}", index_stats_v2)
+    c.register("GET", "/{index}/_stats/{metric}", index_stats_v2)
+
+    # -- nodes info / stats (ref rest/action/admin/cluster/node/) ----------
+    def nodes_info(g, p, b):
+        return 200, {"cluster_name": node.cluster_name, "nodes": {
+            "tpu-node-0": {"name": "tpu-node-0", "version": "2.0.0-tpu",
+                           "host": "localhost", "ip": "127.0.0.1",
+                           "transport_address": "local[1]",
+                           "http_address": "127.0.0.1:9200",
+                           "build": "tensor-native",
+                           "os": {}, "jvm": {}, "transport": {},
+                           "http": {}, "plugins": []}}}
+    c.register("GET", "/_nodes", nodes_info)
+    c.register("GET", "/_nodes/{metric}", nodes_info)
+
+    def nodes_stats(g, p, b):
+        return 200, {"cluster_name": node.cluster_name, "nodes": {
+            "tpu-node-0": {"name": "tpu-node-0",
+                           "indices": {"docs": {"count": sum(
+                               s.doc_count()
+                               for s in node.indices.values())}},
+                           "breakers": node.breakers.stats(),
+                           "search_batcher": node._batcher.stats()}}}
+    c.register("GET", "/_nodes/stats", nodes_stats)
+    c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
+
+    # -- warmers (registry parity; packed-view warmup is the real warmer) --
+    def put_warmer(g, p, b):
+        body = _json_body(b)
+        for n in node._resolve(g.get("index", "_all")):
+            svc = node.indices[n]
+            if not hasattr(svc, "warmers"):
+                svc.warmers = {}
+            svc.warmers[g["name"]] = {
+                "types": [g["type"]] if g.get("type") else [],
+                "source": body}
+        return 200, {"acknowledged": True}
+    c.register("PUT", "/{index}/_warmer/{name}", put_warmer)
+    c.register("PUT", "/{index}/{type}/_warmer/{name}", put_warmer)
+    c.register("PUT", "/_warmer/{name}", put_warmer)
+
+    def get_warmer(g, p, b):
+        name = g.get("name")
+        out = {}
+        for n in node._resolve(g.get("index", "_all")):
+            svc = node.indices[n]
+            wm = getattr(svc, "warmers", {})
+            if name and name not in ("_all", "*"):
+                wm = {w: s for w, s in wm.items()
+                      if any(fnmatch.fnmatch(w, pat)
+                             for pat in name.split(","))}
+            if wm:
+                out[n] = {"warmers": wm}
+        return 200, out
+    for pat in ("/_warmer", "/_warmer/{name}", "/{index}/_warmer",
+                "/{index}/_warmer/{name}"):
+        c.register("GET", pat, get_warmer)
+
+    def delete_warmer(g, p, b):
+        name = g.get("name")
+        if not name:
+            raise RestError(400, "ActionRequestValidationException: "
+                                 "warmer name is missing")
+        removed = False
+        for n in node._resolve(g["index"]):
+            svc = node.indices[n]
+            wm = getattr(svc, "warmers", {})
+            match = list(wm) if name in ("_all", "*") else \
+                [w for w in wm if any(fnmatch.fnmatch(w, pat)
+                                      for pat in name.split(","))]
+            for w in match:
+                del wm[w]
+                removed = True
+        if not removed:
+            return 404, {"error": f"IndexWarmerMissingException: "
+                                  f"index_warmer [{name}] missing",
+                         "status": 404}
+        return 200, {"acknowledged": True}
+    c.register("DELETE", "/{index}/_warmer/{name}", delete_warmer)
+    c.register("DELETE", "/{index}/_warmer", delete_warmer)
 
 
 def _parse_bulk(body: bytes, default_index: str | None) -> list:
@@ -507,7 +1267,13 @@ class HttpServer:
             def do_HEAD(self):
                 self._handle("HEAD")
 
-        self.server = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # stdlib default backlog is 5: a burst of concurrent clients
+            # (the dynamic batcher's whole point) gets connection resets
+            request_queue_size = 128
+            daemon_threads = True
+
+        self.server = Server((host, port), Handler)
         self.port = self.server.server_port
         self._thread: threading.Thread | None = None
 

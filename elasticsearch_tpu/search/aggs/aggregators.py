@@ -369,6 +369,9 @@ def _collect_one(spec: AggSpec, seg: Segment, mask,
                  qp=None, scores_row=None) -> dict:
     if spec.type == "top_hits":
         return _top_hits_segment(spec, seg, _mv(mask).np, scores_row)
+    if spec.type == "significant_terms":   # as a sub-aggregation
+        return _collect_sig_terms_shard(spec, [seg], [mask], qp,
+                                        [scores_row])
     if spec.type in METRIC_TYPES:
         return _metric_segment(spec, seg, mask)
     return _bucket_segment(spec, seg, _mv(mask).np, qp, scores_row)

@@ -181,6 +181,12 @@ class QueryParser:
         return self._term_node(field, list(values), boost)
 
     def _term_node(self, field: str, values: list, boost: float) -> Node:
+        if field in ("_id", "_uid"):
+            # metadata-field term query == ids query (ref IdFieldMapper
+            # termQuery delegating to the _uid lookup)
+            return IdsNode(
+                ids_per_query=[[str(v).split("#", 1)[-1] for v in values]],
+                boost=boost)
         ft = self.mappers.field_type(field)
         if ft is not None and ft.type == DATE:
             values = [eval_date_math(str(v)) if isinstance(v, str) else v for v in values]

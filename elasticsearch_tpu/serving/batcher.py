@@ -37,7 +37,7 @@ class _Entry:
 class SearchBatcher:
     """Per-node coalescer for packed-eligible solo searches."""
 
-    MAX_BATCH = 64               # cap one device batch (compile buckets)
+    MAX_BATCH = 32               # one device batch == one warm Q bucket
 
     def __init__(self, node):
         self.node = node

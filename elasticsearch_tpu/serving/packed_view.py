@@ -313,7 +313,11 @@ class PackedIndexView:
         """Vectorized slot-table construction: terms -> fixed-CHUNK postings
         slots scattered into the packed i32[Q_pad, 3S+1] upload."""
         Q = len(queries)
-        Q_pad = next_pow2(Q, floor=1)
+        # Q buckets are {1, 32, 64, 128, ...}: the dynamic batcher produces
+        # arbitrary batch sizes, and a compile per pow2 bucket would stall
+        # serving for seconds each — two warm shapes cover all solo +
+        # batched traffic instead (warmup() compiles exactly these)
+        Q_pad = 1 if Q == 1 else max(32, next_pow2(Q))
         nseg = pf.starts.shape[1]
 
         qi_l: list[int] = []
@@ -382,7 +386,7 @@ class PackedIndexView:
         # solo query lands on ONE warm compile shape; large (throughput-
         # bound) batches size S tightly — their shape amortizes over the
         # batch and the first msearch warms it
-        S = next_pow2(int(counts.max()), floor=32 if Q_pad <= 16 else 4)
+        S = next_pow2(int(counts.max()), floor=32 if Q_pad <= 32 else 4)
         packed = np.zeros((Q_pad, 3 * S + 1), np.int32)
         packed[slot_q, pos] = slot_start
         packed[slot_q, S + pos] = slot_len
@@ -551,19 +555,37 @@ class PackedIndexView:
         local = int(doc - self.bases[ei])
         return seg.stored[local], seg.types[local], seg.ids[local]
 
-    def warmup(self, field: str, shapes=((1, 32, 16), (1, 64, 16))) -> None:
-        """Precompile the solo-latency (Q=1) shapes so first queries don't
-        eat a multi-second XLA compile (p99 guard; the S floor in
-        _build_slots steers solo queries onto exactly these buckets, and the
-        persistent cache makes this a one-time cost per machine)."""
+    def warmup(self, field: str,
+               shapes=((1, 32, 16), (32, 32, 16), (1, 64, 16)),
+               filtered_shapes=((1, 32, 16), (32, 32, 16))) -> None:
+        """Precompile the solo + batcher shapes so first queries don't eat a
+        multi-second XLA compile (p99 guard): Q in {1, 32} covers every solo
+        and dynamically-batched request (the Q/S buckets in _build_slots
+        steer traffic onto exactly these), for both the plain and the
+        filtered kernel. The persistent compile cache makes this a one-time
+        cost per machine."""
         pf = self._fields.get(field)
         if pf is None:
             return
+        common = (pf.doc_ids, pf.tf, pf.dl, self.live_dev,
+                  jnp.int32(self.pad_doc), jnp.float32(1.2),
+                  jnp.float32(0.75), jnp.float32(1.0), jnp.float32(0.0))
         for (q, s, k) in shapes:
             packed = np.zeros((q, 3 * s + 1), np.int32)
             packed[:, 3 * s] = 1
-            bm25_serve_packed(
-                jnp.asarray(packed), pf.doc_ids, pf.tf, pf.dl,
-                self.live_dev, jnp.int32(self.pad_doc), jnp.float32(1.2),
-                jnp.float32(0.75), jnp.float32(1.0), jnp.float32(0.0),
-                S=s, CHUNK=CHUNK, R=4, k=k)
+            bm25_serve_packed(jnp.asarray(packed), *common,
+                              S=s, CHUNK=CHUNK, R=4, k=k)
+        for (q, s, k) in filtered_shapes:
+            packed = np.zeros((q, 3 * s + 1), np.int32)
+            packed[:, 3 * s] = 1
+            bm25_serve_packed_filtered(
+                jnp.asarray(packed), *common,
+                jnp.zeros((1, self.n_pad_total), jnp.float64),
+                jnp.full((q, F_RANGE), -1, jnp.int32),
+                jnp.zeros((q, F_RANGE)), jnp.zeros((q, F_RANGE)),
+                jnp.zeros((q, F_RANGE), jnp.int32),
+                jnp.full((q, F_TERM), -1, jnp.int32),
+                jnp.full((q, F_TERM, F_TERM_VALS), jnp.nan),
+                jnp.zeros((q, F_TERM), jnp.int32),
+                S=s, CHUNK=CHUNK, R=4, k=k,
+                FR=F_RANGE, FT=F_TERM, TV=F_TERM_VALS)

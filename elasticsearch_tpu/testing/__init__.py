@@ -1,0 +1,7 @@
+"""Test harnesses: the implementation-agnostic REST YAML suite runner
+(ref rest-api-spec/test/README.asciidoc + the reference's
+test/rest/ElasticsearchRestTests.java runner)."""
+
+from .rest_runner import YamlRestRunner, SectionResult
+
+__all__ = ["YamlRestRunner", "SectionResult"]

@@ -1,0 +1,376 @@
+"""YAML REST acceptance-suite runner.
+
+Executes the reference's implementation-agnostic REST test suites
+(/root/reference/rest-api-spec/test/*/*.yaml, format documented in
+test/README.asciidoc; reference runner
+src/test/java/org/elasticsearch/test/rest/ElasticsearchRestTests.java)
+against a live HTTP endpoint. API calls are resolved data-driven from the
+api specs (/root/reference/rest-api-spec/api/*.json): path templates,
+required parts, methods — nothing endpoint-specific is hardcoded here, so
+every suite the surface can satisfy runs unmodified.
+
+Supported statements: do (with catch + stash substitution), match
+(incl. /regex/ values and dotted paths with \\. escapes), length, is_true,
+is_false, lt, gt, lte, gte, set, skip (version ranges against VERSION and
+feature gates).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+import yaml
+
+VERSION = (2, 0, 0)                 # what we report to version skips
+FEATURES = {"regex", "stash_in_path"}
+
+
+@dataclass
+class SectionResult:
+    file: str
+    section: str
+    ok: bool
+    skipped: bool = False
+    error: str | None = None
+    steps_run: int = 0
+
+
+class _Failure(Exception):
+    pass
+
+
+class _Skip(Exception):
+    pass
+
+
+class YamlRestRunner:
+    def __init__(self, base_url: str, api_dir: str):
+        import os
+        self.base_url = base_url.rstrip("/")
+        self.apis: dict[str, dict] = {}
+        for fn in os.listdir(api_dir):
+            if fn.endswith(".json"):
+                with open(os.path.join(api_dir, fn)) as f:
+                    spec = json.load(f)
+                name = fn[:-5]
+                self.apis[name] = spec.get(name) or next(iter(spec.values()))
+
+    # -- http --------------------------------------------------------------
+
+    def _call(self, method: str, path: str, params: dict, body):
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: str(v).lower() if isinstance(v, bool) else v
+                 for k, v in params.items()})
+        data = None
+        if body is not None:
+            if isinstance(body, (dict, list)):
+                data = json.dumps(body).encode()
+            else:
+                data = str(body).encode()
+        if data is not None and method == "GET":
+            method = "POST"         # urllib can't GET-with-body
+        req = urllib.request.Request(url, data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                raw = r.read()
+                status = r.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        try:
+            parsed = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            parsed = raw.decode(errors="replace")
+        return status, parsed
+
+    # -- api resolution ----------------------------------------------------
+
+    def _do_api(self, api_name: str, args: dict):
+        if api_name == "create" and "create" not in self.apis:
+            # the 2015 spec snapshot has no create.json: create == index
+            # with op_type=create (ref RestIndexAction CREATE variant)
+            api_name = "index"
+            args = {**args, "op_type": "create"}
+        spec = self.apis.get(api_name)
+        if spec is None:
+            raise _Failure(f"unknown api [{api_name}]")
+        url = spec["url"]
+        parts = dict(url.get("parts", {}))
+        body = args.pop("body", None)
+        if isinstance(body, str) and api_name != "bulk":
+            # some suites embed the body as a loose-YAML string
+            try:
+                parsed = yaml.safe_load(body)
+                if isinstance(parsed, (dict, list)):
+                    body = parsed
+            except yaml.YAMLError:
+                pass
+        path_args = {k: v for k, v in args.items() if k in parts}
+        q_params = {k: v for k, v in args.items() if k not in parts}
+        # choose the most specific path template all of whose parts we have
+        best = None
+        for tmpl in url.get("paths", [url.get("path", "/")]):
+            needed = re.findall(r"\{(\w+)\}", tmpl)
+            if all(n in path_args for n in needed):
+                if best is None or len(needed) > len(re.findall(r"\{(\w+)\}",
+                                                               best)):
+                    best = tmpl
+        if best is None:
+            raise _Failure(
+                f"no path of [{api_name}] satisfiable with {list(path_args)}")
+        path = best
+        for k, v in path_args.items():
+            if isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            path = path.replace("{%s}" % k,
+                                urllib.parse.quote(str(v), safe=",*"))
+        methods = spec.get("methods", ["GET"])
+        if body is not None and "POST" in methods:
+            method = "POST"
+        elif "GET" in methods:
+            method = "GET"
+        else:
+            method = methods[0]
+        if method == "HEAD":
+            # exists-style APIs: the client maps 200 -> true, 404 -> false
+            status, _ = self._call("HEAD", path, q_params, None)
+            return 200, status < 300
+        if api_name.startswith("indices.put") or api_name in (
+                "index", "create") and "PUT" in methods and "id" in path_args:
+            method = "PUT"
+        if body is not None and isinstance(body, list):
+            # bulk-style ndjson bodies (items may be pre-serialized strings)
+            body = "\n".join(
+                x.strip() if isinstance(x, str) else json.dumps(x)
+                for x in body) + "\n"
+        return self._call(method, path, q_params, body)
+
+    # -- value helpers -----------------------------------------------------
+
+    @staticmethod
+    def _split_path(path: str) -> list[str]:
+        out, cur, i = [], "", 0
+        while i < len(path):
+            c = path[i]
+            if c == "\\" and i + 1 < len(path) and path[i + 1] == ".":
+                cur += "."
+                i += 2
+                continue
+            if c == ".":
+                out.append(cur)
+                cur = ""
+            else:
+                cur += c
+            i += 1
+        out.append(cur)
+        return [p for p in out if p != ""]
+
+    def _lookup(self, response, path: str, stash: dict):
+        if path == "$body" or path == "":
+            return response
+        val = response
+        for part in self._split_path(path):
+            part = self._stash(part, stash)
+            if isinstance(val, dict):
+                val = val.get(str(part))
+            elif isinstance(val, list):
+                try:
+                    val = val[int(part)]
+                except (ValueError, IndexError):
+                    return None
+            else:
+                return None
+        return val
+
+    def _stash(self, v, stash: dict):
+        if isinstance(v, str) and v.startswith("$"):
+            return stash.get(v[1:], v)
+        if isinstance(v, str) and "$" in v:
+            return re.sub(r"\$\{?(\w+)\}?",
+                          lambda m: str(stash.get(m.group(1), m.group(0))), v)
+        if isinstance(v, dict):
+            return {self._stash(k, stash): self._stash(x, stash)
+                    for k, x in v.items()}
+        if isinstance(v, list):
+            return [self._stash(x, stash) for x in v]
+        return v
+
+    # -- assertions --------------------------------------------------------
+
+    @staticmethod
+    def _eq(got, want) -> bool:
+        if isinstance(want, str) and len(want) > 1 and want.strip().startswith("/") \
+                and want.strip().endswith("/"):
+            pat = want.strip()[1:-1]
+            return re.search(pat, str(got), re.VERBOSE | re.S) is not None
+        if isinstance(want, (int, float)) and isinstance(got, (int, float)) \
+                and not isinstance(want, bool) and not isinstance(got, bool):
+            return float(got) == float(want)
+        if isinstance(want, dict) and isinstance(got, dict):
+            return got == want
+        return got == want
+
+    def _assert(self, kind: str, spec, response, stash: dict):
+        if kind == "match":
+            (path, want), = spec.items()
+            got = self._lookup(response, path, stash)
+            want = self._stash(want, stash)
+            if not self._eq(got, want):
+                raise _Failure(f"match {path}: got {got!r}, want {want!r}")
+        elif kind in ("is_true", "is_false"):
+            got = self._lookup(response, spec, stash)
+            truthy = got not in (None, False, "", 0, "false")
+            if truthy != (kind == "is_true"):
+                raise _Failure(f"{kind} {spec}: got {got!r}")
+        elif kind == "length":
+            (path, want), = spec.items()
+            got = self._lookup(response, path, stash)
+            if got is None or len(got) != int(self._stash(want, stash)):
+                raise _Failure(f"length {path}: got "
+                               f"{None if got is None else len(got)}, "
+                               f"want {want}")
+        elif kind in ("lt", "gt", "lte", "gte"):
+            (path, want), = spec.items()
+            got = self._lookup(response, path, stash)
+            if not isinstance(got, (int, float)) or isinstance(got, bool):
+                raise _Failure(f"{kind} {path}: got non-numeric {got!r}")
+            want = float(self._stash(want, stash))
+            ok = {"lt": got < want, "gt": got > want,
+                  "lte": got <= want, "gte": got >= want}[kind]
+            if not ok:
+                raise _Failure(f"{kind} {path}: got {got!r} vs {want!r}")
+        else:
+            raise _Failure(f"unsupported assertion [{kind}]")
+
+    # -- skip --------------------------------------------------------------
+
+    @staticmethod
+    def _version_tuple(s: str):
+        s = s.strip()
+        if not s:
+            return None
+        nums = re.findall(r"\d+", s)
+        return tuple(int(x) for x in nums[:3]) + (0,) * (3 - len(nums[:3]))
+
+    def _should_skip(self, spec: dict) -> str | None:
+        feats = spec.get("features")
+        if feats:
+            feats = feats if isinstance(feats, list) else [feats]
+            missing = [f for f in feats if f not in FEATURES]
+            if missing:
+                return f"features {missing}"
+        ver = spec.get("version")
+        if ver:
+            if str(ver).strip().lower() == "all":
+                return "version all"
+            m = re.match(r"^\s*(.*?)\s*-\s*(.*?)\s*$", str(ver))
+            if m:
+                lo = self._version_tuple(m.group(1)) or (0, 0, 0)
+                hi = self._version_tuple(m.group(2)) or (99, 99, 99)
+                if lo <= VERSION <= hi:
+                    return f"version {ver}"
+        return None
+
+    # -- execution ---------------------------------------------------------
+
+    def _run_steps(self, steps: list, stash: dict) -> int:
+        n = 0
+        response = {}
+        for step in steps:
+            (kind, spec), = step.items()
+            if kind == "skip":
+                why = self._should_skip(spec)
+                if why:
+                    raise _Skip(why)
+                continue
+            if kind == "do":
+                spec = dict(spec)
+                catch = spec.pop("catch", None)
+                (api, args), = spec.items()
+                args = self._stash(dict(args or {}), stash)
+                ignore = args.pop("ignore", None)
+                ignored = [int(x) for x in
+                           (ignore if isinstance(ignore, list) else [ignore])
+                           ] if ignore is not None else []
+                status, response = self._do_api(api.strip(), args)
+                if status in ignored:
+                    n += 1
+                    continue
+                if catch is None:
+                    if status >= 400:
+                        raise _Failure(
+                            f"do {api}: HTTP {status}: {response}")
+                else:
+                    expected = {"missing": (404,), "conflict": (409,),
+                                "forbidden": (403,),
+                                "request": tuple(range(400, 600)),
+                                "param": tuple(range(400, 600)),
+                                "unavailable": (503,)}.get(catch)
+                    if expected is not None:
+                        if status not in expected:
+                            raise _Failure(
+                                f"do {api}: expected {catch}, got "
+                                f"HTTP {status}: {response}")
+                    elif catch.startswith("/"):
+                        if status < 400 or not re.search(
+                                catch.strip("/"), json.dumps(response),
+                                re.VERBOSE | re.S):
+                            raise _Failure(
+                                f"do {api}: error !~ {catch}: {response}")
+                    else:
+                        raise _Failure(f"unknown catch [{catch}]")
+            elif kind == "set":
+                (path, var), = spec.items()
+                stash[var] = self._lookup(response, path, stash)
+            else:
+                self._assert(kind, spec, response, stash)
+            n += 1
+        return n
+
+    def _teardown(self):
+        """Delete all indices and all templates (per the suite contract in
+        test/README.asciidoc)."""
+        self._call("DELETE", "/_all", {}, None)
+        self._call("DELETE", "/_template/*", {}, None)
+
+    def run_file(self, path: str) -> list[SectionResult]:
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+        setup: list = []
+        sections: list[tuple[str, list]] = []
+        for doc in docs:
+            if not doc:
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup = steps
+                else:
+                    sections.append((name, steps))
+        results = []
+        for name, steps in sections:
+            stash: dict = {}
+            try:
+                self._teardown()
+                self._run_steps(setup, stash)
+                n = self._run_steps(steps, stash)
+                results.append(SectionResult(path, name, ok=True,
+                                             steps_run=n))
+            except _Skip as s:
+                results.append(SectionResult(path, name, ok=True,
+                                             skipped=True, error=str(s)))
+            except _Failure as e:
+                results.append(SectionResult(path, name, ok=False,
+                                             error=str(e)))
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                results.append(SectionResult(
+                    path, name, ok=False,
+                    error=f"{type(e).__name__}: {e}"))
+        self._teardown()
+        return results

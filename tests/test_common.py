@@ -125,7 +125,13 @@ class TestMapping:
         svc = MapperService()
         svc.merge("doc", {"properties": {"user": {"properties": {"name": {"type": "keyword"}}}}})
         out = svc.mappings_dict()
-        assert out["doc"]["properties"]["user"]["properties"]["name"]["type"] == "keyword"
+        # rendered in the reference's 2.x vocabulary: keyword == not_analyzed string
+        rendered = out["doc"]["properties"]["user"]["properties"]["name"]
+        assert rendered == {"type": "string", "index": "not_analyzed"}
+        # and it parses back to the same internal schema
+        svc2 = MapperService()
+        svc2.merge("doc", out["doc"])
+        assert svc2.field_type("user.name").type == "keyword"
 
     def test_date_parsing(self):
         assert parse_date_millis("1970-01-01T00:00:00Z") == 0

@@ -1,0 +1,140 @@
+"""The reference's implementation-agnostic REST YAML acceptance suites
+(rest-api-spec/test/) executed against a live HTTP server through the
+data-driven runner (elasticsearch_tpu/testing/rest_runner.py; ref
+test/rest/ElasticsearchRestTests.java). GREEN_SUITES pins the currently-
+passing files — regressions in any pinned suite fail this test; newly
+passing suites should be added (run tests/run_yaml_suites.py to rescore).
+"""
+
+import glob
+import os
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+from elasticsearch_tpu.testing import YamlRestRunner
+
+SPEC_ROOT = "/root/reference/rest-api-spec"
+
+GREEN_SUITES = [
+    "bulk/10_basic.yaml",
+    "bulk/20_list_of_strings.yaml",
+    "bulk/30_big_string.yaml",
+    "cluster.pending_tasks/10_basic.yaml",
+    "cluster.put_settings/10_basic.yaml",
+    "cluster.state/10_basic.yaml",
+    "create/10_with_id.yaml",
+    "create/15_without_id.yaml",
+    "create/30_internal_version.yaml",
+    "create/35_external_version.yaml",
+    "create/36_external_gte_version.yaml",
+    "create/37_force_version.yaml",
+    "create/60_refresh.yaml",
+    "delete/10_basic.yaml",
+    "delete/11_shard_header.yaml",
+    "delete/20_internal_version.yaml",
+    "delete/25_external_version.yaml",
+    "delete/26_external_gte_version.yaml",
+    "delete/27_force_version.yaml",
+    "delete/30_routing.yaml",
+    "delete/45_parent_with_routing.yaml",
+    "delete_by_query/10_basic.yaml",
+    "exists/10_basic.yaml",
+    "exists/40_routing.yaml",
+    "exists/55_parent_with_routing.yaml",
+    "exists/70_defaults.yaml",
+    "get/10_basic.yaml",
+    "get/15_default_values.yaml",
+    "get/70_source_filtering.yaml",
+    "get_source/10_basic.yaml",
+    "get_source/15_default_values.yaml",
+    "get_source/40_routing.yaml",
+    "get_source/55_parent_with_routing.yaml",
+    "get_source/70_source_filtering.yaml",
+    "index/10_with_id.yaml",
+    "index/15_without_id.yaml",
+    "index/20_optype.yaml",
+    "index/30_internal_version.yaml",
+    "index/35_external_version.yaml",
+    "index/36_external_gte_version.yaml",
+    "index/37_force_version.yaml",
+    "index/60_refresh.yaml",
+    "indices.exists/10_basic.yaml",
+    "indices.exists_alias/10_basic.yaml",
+    "indices.exists_type/10_basic.yaml",
+    "indices.get_alias/20_empty.yaml",
+    "indices.get_field_mapping/40_missing_index.yaml",
+    "indices.get_mapping/10_basic.yaml",
+    "indices.get_mapping/30_missing_index.yaml",
+    "indices.get_mapping/40_aliases.yaml",
+    "indices.get_mapping/60_empty.yaml",
+    "indices.get_settings/20_aliases.yaml",
+    "indices.get_template/20_get_missing.yaml",
+    "indices.optimize/10_basic.yaml",
+    "indices.put_alias/10_basic.yaml",
+    "indices.put_settings/all_path_options.yaml",
+    "indices.put_warmer/10_basic.yaml",
+    "indices.put_warmer/20_aliases.yaml",
+    "info/10_info.yaml",
+    "info/20_lucene_version.yaml",
+    "mget/12_non_existent_index.yaml",
+    "msearch/10_basic.yaml",
+    "nodes.info/10_basic.yaml",
+    "nodes.stats/10_basic.yaml",
+    "ping/10_ping.yaml",
+    "script/10_basic.yaml",
+    "script/20_versions.yaml",
+    "scroll/10_basic.yaml",
+    "scroll/11_clear.yaml",
+    "search/20_default_values.yaml",
+    "search/issue4895.yaml",
+    "search/test_sig_terms.yaml",
+    "update/10_doc.yaml",
+    "update/11_shard_header.yaml",
+    "update/15_script.yaml",
+    "update/20_doc_upsert.yaml",
+    "update/22_doc_as_upsert.yaml",
+    "update/25_script_upsert.yaml",
+    "update/35_other_versions.yaml",
+    "update/60_refresh.yaml",
+    "update/80_fields.yaml",
+    "update/85_fields_meta.yaml"
+]
+
+
+@pytest.fixture(scope="module")
+def runner(tmp_path_factory):
+    if not os.path.isdir(SPEC_ROOT):
+        pytest.skip("reference rest-api-spec not available")
+    node = NodeService(str(tmp_path_factory.mktemp("yamlnode")))
+    srv = HttpServer(node, port=0).start()
+    yield YamlRestRunner(f"http://127.0.0.1:{srv.port}",
+                         os.path.join(SPEC_ROOT, "api"))
+    srv.stop()
+    node.close()
+
+
+@pytest.mark.parametrize("suite", GREEN_SUITES)
+def test_yaml_suite(runner, suite):
+    path = os.path.join(SPEC_ROOT, "test", suite)
+    if not os.path.exists(path):
+        pytest.skip(f"{suite} not in this reference checkout")
+    results = runner.run_file(path)
+    failures = [f"{r.section}: {r.error}" for r in results if not r.ok]
+    assert not failures, f"{suite}:\n" + "\n".join(failures)
+
+
+def test_overall_coverage_floor(runner):
+    """At least this many suite files must pass end-to-end — the
+    completeness meter the round-3 verdict asked for."""
+    files = sorted(glob.glob(os.path.join(SPEC_ROOT, "test", "*", "*.yaml")))
+    green = 0
+    for f in files:
+        try:
+            rs = runner.run_file(f)
+        except Exception:
+            continue
+        if rs and all(r.ok for r in rs):
+            green += 1
+    assert green >= 78, f"YAML suite coverage regressed: {green} green files"

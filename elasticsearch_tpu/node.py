@@ -361,6 +361,8 @@ class NodeService:
 
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         query = body.get("query", {"match_all": {}})
+        if _contains_mlt(query):
+            query = self._expand_mlt(query, names)
         knn = body.get("knn")
         rescore_spec = body.get("rescore")
         if isinstance(rescore_spec, list):
@@ -452,6 +454,29 @@ class NodeService:
         for slot, h in enumerate(hits):
             h["_index"] = index_of[reduced.shard_order[slot]]
 
+        hl_spec = None
+        if body.get("highlight") and knn is None:
+            from .search.highlight import highlight_hit, parse_highlight
+            hl_spec = parse_highlight(body["highlight"])
+        if hl_spec is not None:
+            from .search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+            for slot, h in enumerate(hits):
+                si = reduced.shard_order[slot]
+                key = reduced.doc_keys[slot]
+                seg = searchers[si].segments[key >> SEG_SHIFT]
+                raw_src = seg.stored[key & LOCAL_MASK]
+                mappers = self.indices[index_of[si]].mappers
+
+                def an_for(fname, _m=mappers):
+                    for dm in _m._mappers.values():
+                        if fname in dm.fields:
+                            return dm.search_analyzer_for(fname)
+                    return _m.analysis.analyzer("standard")
+
+                hl = highlight_hit(hl_spec, raw_src, terms_by_field, an_for)
+                if hl:
+                    h["highlight"] = hl
+
         resp: dict[str, Any] = {
             "took": int((time.perf_counter() - t0) * 1000),
             "timed_out": False,
@@ -467,7 +492,152 @@ class NodeService:
             merged = merge_shard_partials(
                 agg_specs, [r.aggs for r in results if r.aggs])
             resp["aggregations"] = render_aggs(agg_specs, merged)
+        if body.get("suggest"):
+            resp["suggest"] = self.suggest(index, body["suggest"])
         return resp
+
+    def _expand_mlt(self, q, names: list[str]):
+        """Rewrite more_like_this specs into term-disjunction queries
+        (ref index/query/MoreLikeThisQueryParser + common/lucene/search/
+        MoreLikeThisQuery: select the like-text's top tf*idf terms, query
+        them as a should-of-terms). Runs BEFORE parsing because term
+        selection needs corpus statistics the parser doesn't hold."""
+        if isinstance(q, list):
+            return [self._expand_mlt(x, names) for x in q]
+        if not isinstance(q, dict):
+            return q
+        if not any(_is_mlt_entry(k, v) for k, v in q.items()):
+            return {k: self._expand_mlt(v, names) for k, v in q.items()}
+
+        spec = q.get("more_like_this")
+        if spec is None:
+            spec = q.get("mlt")
+        fields = spec.get("fields") or ["_all"]
+        min_tf = int(spec.get("min_term_freq", 2))
+        min_df = int(spec.get("min_doc_freq", 5))
+        max_terms = int(spec.get("max_query_terms", 25))
+        texts: list[str] = []
+        if spec.get("like_text"):
+            texts.append(str(spec["like_text"]))
+        likes = spec.get("like", [])
+        likes = likes if isinstance(likes, list) else [likes]
+        doc_refs = [d for d in likes if isinstance(d, dict)] \
+            + list(spec.get("docs") or []) \
+            + [{"_id": i} for i in (spec.get("ids") or [])]
+        texts += [t for t in likes if isinstance(t, str)]
+        exclude_ids: list[str] = []
+
+        def _texts_from(source: dict):
+            for f in fields:
+                v = source.get(f) if f != "_all" else None
+                if isinstance(v, str):
+                    texts.append(v)
+                elif f == "_all":
+                    texts.extend(x for x in source.values()
+                                 if isinstance(x, str))
+
+        for ref in doc_refs:
+            if "doc" in ref and isinstance(ref["doc"], dict):
+                _texts_from(ref["doc"])      # artificial document form
+                continue
+            if "_id" not in ref:
+                continue
+            try:
+                got = self.get_doc(ref.get("_index", names[0]),
+                                   str(ref["_id"]))
+            except IndexMissingException:
+                continue
+            if got.found and got.source:
+                exclude_ids.append(str(ref["_id"]))
+                _texts_from(got.source)
+
+        segments = [seg for n in names
+                    for e in self.indices[n].shards for seg in e.segments]
+        all_fields = {f for seg in segments for f in seg.text} \
+            if fields == ["_all"] else set(fields)
+        should = []
+        from .search.query_dsl import MatchNoneNode  # noqa: F401 (shape doc)
+        for field in sorted(all_fields):
+            mappers = self.indices[names[0]].mappers
+            an = None
+            for dm in mappers._mappers.values():
+                if field in dm.fields:
+                    an = dm.search_analyzer_for(field)
+                    break
+            if an is None:
+                an = mappers.analysis.analyzer("standard")
+            tf: dict[str, int] = {}
+            for t in texts:
+                for tok in an(t):
+                    tf[tok] = tf.get(tok, 0) + 1
+            import math as _m
+            n_docs = max(sum(s.n_docs for s in segments), 1)
+            scored = []
+            for term, f in tf.items():
+                if f < min_tf:
+                    continue
+                df = sum(s.doc_freq(field, term) for s in segments)
+                if df < min_df:
+                    continue
+                scored.append((f * _m.log(1 + n_docs / (df + 1)), term))
+            scored.sort(reverse=True)
+            terms = [t for _, t in scored[:max_terms]]
+            if terms:
+                # the reference's default minimum_should_match for MLT
+                # is 30% of the selected terms
+                msm = max(1, round(0.3 * len(terms)))
+                should.append({"match": {field: {
+                    "query": " ".join(terms),
+                    "minimum_should_match": msm}}})
+        if not should:
+            return {"match_none": {}}
+        out: dict = {"bool": {"should": should, "minimum_should_match": 1}}
+        if exclude_ids and not spec.get("include", False):
+            # the reference excludes the input docs themselves
+            # (ref MoreLikeThisQueryParser include=false default)
+            out["bool"]["must_not"] = [{"ids": {"values": exclude_ids}}]
+        return out
+
+    def percolate(self, index: str, body: dict,
+                  type_name: str = "_doc",
+                  doc_id: str | None = None) -> dict:
+        """Match a doc against the index's registered queries
+        (ref percolator/PercolatorService.java:108-132)."""
+        from .search.percolator import percolate as run_percolate
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+        doc = (body or {}).get("doc")
+        if doc is None and doc_id is not None:
+            got = self.get_doc(names[0], doc_id)
+            if not got.found:
+                raise DocumentMissingException(
+                    f"[{type_name}][{doc_id}]: document missing")
+            doc = got.source
+        if doc is None:
+            raise QueryParsingException("percolate requires a doc")
+        total = 0
+        matches: list = []
+        for n in names:
+            out = run_percolate(self.indices[n], n, doc,
+                                type_name=type_name)
+            total += out["total"]
+            matches.extend(out["matches"])
+        return {"took": 0, "_shards": {"total": len(names),
+                                       "successful": len(names),
+                                       "failed": 0},
+                "total": total, "matches": matches}
+
+    def suggest(self, index: str, body: dict) -> dict:
+        """Run suggesters over the index's term dictionaries
+        (ref search/suggest/SuggestPhase.java:43)."""
+        from .search.suggest import run_suggest
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+        segments = [seg for n in names
+                    for e in self.indices[n].shards for seg in e.segments]
+        return run_suggest(body, segments)
 
     def _packed_search(self, name: str, bodies: list[dict], *, size: int,
                        from_: int, t0: float, raw: bool = False,
@@ -977,6 +1147,22 @@ class NodeService:
 
 
 # ---------------------------------------------------------------------------
+
+def _is_mlt_entry(k, v) -> bool:
+    """True only for MLT QUERY nodes — a field literally named 'mlt' in a
+    match/term leaf must not be hijacked (code review r4)."""
+    return k in ("more_like_this", "mlt") and isinstance(v, dict) \
+        and ({"like_text", "like", "docs", "ids", "fields"} & v.keys())
+
+
+def _contains_mlt(q) -> bool:
+    if isinstance(q, dict):
+        return any(_is_mlt_entry(k, v) or _contains_mlt(v)
+                   for k, v in q.items())
+    if isinstance(q, list):
+        return any(_contains_mlt(x) for x in q)
+    return False
+
 
 def _duration_secs(s: str) -> float:
     m = re.match(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)?$", str(s).strip())

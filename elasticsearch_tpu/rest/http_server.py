@@ -236,6 +236,65 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         # raw=True: the packed serving lane pre-serializes hit JSON with
         # vectorized string ops; bytes pass straight through to the socket
         return 200, node.msearch(requests, raw=True)
+    def mlt_api(g, p, b):
+        spec: dict = {"ids": [g["id"]]}
+        if "mlt_fields" in p:
+            spec["fields"] = p["mlt_fields"][0].split(",")
+        for prm, key in (("min_term_freq", "min_term_freq"),
+                         ("min_doc_freq", "min_doc_freq"),
+                         ("max_query_terms", "max_query_terms")):
+            if prm in p:
+                spec[key] = int(p[prm][0])
+        body = _json_body(b)
+        body["query"] = {"more_like_this": spec}
+        return 200, node.search(g["index"], body)
+    c.register("GET", "/{index}/{type}/{id}/_mlt", mlt_api)
+    c.register("POST", "/{index}/{type}/{id}/_mlt", mlt_api)
+
+    def percolate_api(g, p, b):
+        return 200, node.percolate(g["index"], _json_body(b),
+                                   type_name=g.get("type", "_doc"),
+                                   doc_id=g.get("id"))
+    c.register("GET", "/{index}/{type}/_percolate", percolate_api)
+    c.register("POST", "/{index}/{type}/_percolate", percolate_api)
+    c.register("GET", "/{index}/{type}/{id}/_percolate", percolate_api)
+    c.register("POST", "/{index}/{type}/{id}/_percolate", percolate_api)
+
+    def mpercolate_api(g, p, b):
+        lines = [ln for ln in b.decode("utf-8").split("\n") if ln.strip()]
+        responses = []
+        i = 0
+        while i < len(lines):
+            try:
+                head = json.loads(lines[i])
+                i += 1
+                body = json.loads(lines[i]) if i < len(lines) else {}
+                i += 1
+                (_kind, meta), = head.items()
+                responses.append(node.percolate(
+                    meta.get("index", g.get("index", "_all")),
+                    body, type_name=meta.get("type", "_doc"),
+                    doc_id=meta.get("id")))
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                i += i % 2   # re-align to the next header line
+                responses.append({"error": f"{type(e).__name__}[{e}]"})
+        return 200, {"responses": responses}
+    c.register("GET", "/_mpercolate", mpercolate_api)
+    c.register("POST", "/_mpercolate", mpercolate_api)
+    c.register("GET", "/{index}/_mpercolate", mpercolate_api)
+    c.register("POST", "/{index}/_mpercolate", mpercolate_api)
+    c.register("GET", "/{index}/{type}/_mpercolate", mpercolate_api)
+    c.register("POST", "/{index}/{type}/_mpercolate", mpercolate_api)
+
+    def suggest_api(g, p, b):
+        out = node.suggest(g.get("index", "_all"), _json_body(b))
+        return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0},
+                     **out}
+    c.register("GET", "/_suggest", suggest_api)
+    c.register("POST", "/_suggest", suggest_api)
+    c.register("GET", "/{index}/_suggest", suggest_api)
+    c.register("POST", "/{index}/_suggest", suggest_api)
+
     c.register("GET", "/_msearch", msearch)
     c.register("POST", "/_msearch", msearch)
     c.register("GET", "/{index}/_msearch", msearch)

@@ -1,0 +1,141 @@
+"""Plain highlighter: query-term fragment extraction over stored fields.
+
+Analog of the reference's plain highlighter
+(/root/reference/src/main/java/org/elasticsearch/search/highlight/
+PlainHighlighter.java + HighlightPhase.java): re-analyzes the stored field
+value with offsets, marks tokens whose ANALYZED form matches a query term,
+extracts the best fragments, and wraps matches in pre/post tags.
+
+Host-side by design: highlighting touches only the k fetched hits'
+stored fields — never the corpus — so it rides the fetch phase like the
+reference's (SURVEY.md §3.2 fetch).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+
+_WORD = re.compile(r"\w+", re.UNICODE)
+
+DEFAULT_PRE = ["<em>"]
+DEFAULT_POST = ["</em>"]
+
+
+@dataclass
+class HighlightSpec:
+    fields: dict                      # field -> per-field options
+    pre_tags: list = dc_field(default_factory=lambda: DEFAULT_PRE)
+    post_tags: list = dc_field(default_factory=lambda: DEFAULT_POST)
+    fragment_size: int = 100
+    number_of_fragments: int = 5
+    require_field_match: bool = False
+
+
+def parse_highlight(spec: dict | None) -> HighlightSpec | None:
+    if not spec:
+        return None
+    return HighlightSpec(
+        fields=spec.get("fields", {}),
+        pre_tags=spec.get("pre_tags", DEFAULT_PRE),
+        post_tags=spec.get("post_tags", DEFAULT_POST),
+        fragment_size=int(spec.get("fragment_size", 100)),
+        number_of_fragments=int(spec.get("number_of_fragments", 5)),
+        require_field_match=bool(spec.get("require_field_match", False)))
+
+
+def _flatten_value(v) -> str | None:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, list):
+        parts = [x for x in v if isinstance(x, str)]
+        return " ".join(parts) if parts else None
+    return None
+
+
+def highlight_hit(spec: HighlightSpec, source: dict,
+                  terms_by_field: dict[str, set], analyzer_for) -> dict:
+    """-> {field: [fragments]} for one hit (empty dict = no matches).
+    terms_by_field: the query's ANALYZED terms per field; analyzer_for:
+    callable(field) -> (callable(str) -> [normalized tokens]) so candidate
+    tokens normalize with the FIELD's analyzer and stemmed queries still
+    highlight the surface form."""
+    all_terms: set[str] = set()
+    for ts in terms_by_field.values():
+        all_terms |= set(ts)
+    out = {}
+    for fname, fopts in spec.fields.items():
+        raw = source
+        for part in fname.split("."):
+            raw = raw.get(part) if isinstance(raw, dict) else None
+            if raw is None:
+                break
+        text = _flatten_value(raw)
+        if not text:
+            continue
+        if spec.require_field_match:
+            wanted = set(terms_by_field.get(fname, ()))
+        else:
+            wanted = all_terms
+        if not wanted:
+            continue
+        frag_size = int(fopts.get("fragment_size", spec.fragment_size))
+        n_frags = int(fopts.get("number_of_fragments",
+                                spec.number_of_fragments))
+        pre = (fopts.get("pre_tags") or spec.pre_tags)[0]
+        post = (fopts.get("post_tags") or spec.post_tags)[0]
+
+        # offset-aware pass: a token matches if ANY of its analyzed forms
+        # is a wanted term (stemming-safe)
+        analyzer = analyzer_for(fname) if analyzer_for is not None else None
+        matches = []                     # (start, end)
+        for m in _WORD.finditer(text):
+            token = m.group(0)
+            norm = analyzer(token) if analyzer is not None else [token.lower()]
+            if any(t in wanted for t in norm) or token.lower() in wanted:
+                matches.append((m.start(), m.end()))
+        if not matches:
+            continue
+        frags = _build_fragments(text, matches, frag_size, n_frags,
+                                 pre, post)
+        if frags:
+            out[fname] = frags
+    return out
+
+
+def _build_fragments(text: str, matches: list, frag_size: int,
+                     n_frags: int, pre: str, post: str) -> list[str]:
+    """Greedy fragmenting (ref SimpleFragmenter): fixed-size windows over
+    the text; windows containing matches are scored by match count."""
+    if n_frags == 0:
+        # number_of_fragments: 0 == highlight the whole field
+        windows = [(0, len(text))]
+    else:
+        windows = []
+        for start in range(0, max(len(text), 1), max(frag_size, 1)):
+            windows.append((start, min(start + frag_size, len(text))))
+    scored = []
+    for wi, (lo, hi) in enumerate(windows):
+        # a match belongs to the window containing its START; the window
+        # end stretches over a straddling match so it is never dropped
+        inside = [(s, e) for s, e in matches if lo <= s < hi]
+        if inside:
+            hi = max(hi, max(e for _, e in inside))
+            scored.append((len(inside), wi, lo, hi, inside))
+    scored.sort(key=lambda x: (-x[0], x[1]))
+    if n_frags:
+        scored = scored[:n_frags]
+    scored.sort(key=lambda x: x[1])      # render in text order
+    out = []
+    for _, _, lo, hi, inside in scored:
+        buf = []
+        pos = lo
+        for s, e in inside:
+            buf.append(text[pos:s])
+            buf.append(pre)
+            buf.append(text[s:e])
+            buf.append(post)
+            pos = e
+        buf.append(text[pos:hi])
+        out.append("".join(buf))
+    return out

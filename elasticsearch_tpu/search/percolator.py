@@ -1,0 +1,134 @@
+"""Percolator: run one document against every registered query.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/percolator/
+(PercolatorService.java:88,108-132): queries are registered by indexing
+docs of type ".percolator" carrying a query body; percolating a document
+builds a ONE-DOC in-memory segment from it and evaluates the registered
+queries against that segment.
+
+TPU shape (SURVEY.md §7 M6): all registered queries batch into ONE device
+program — merge_query_batch stacks them into query rows, so percolation is
+a [n_queries, 1-doc] match-matrix evaluation, not a per-query loop. That
+is the doc x query matrix the survey called out as a natural kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+PERCOLATOR_TYPE = ".percolator"
+
+
+def registered_queries(svc) -> list[tuple[str, dict]]:
+    """(query_id, query_body) for every live .percolator doc — realtime:
+    unrefreshed buffered registrations count too (ref the reference's
+    in-memory percolator registry). Buffer snapshots are taken under each
+    engine's lock (the REST server is threaded)."""
+    out: list[tuple[str, dict]] = []
+    seen: set[str] = set()
+    for e in svc.shards:
+        with e._lock:
+            buffered = list(e._buffer_docs.items())
+            segments = list(e.segments)
+        for doc_id, entry in buffered:
+            src, tname = entry[0], entry[1]
+            if tname == PERCOLATOR_TYPE and "query" in src:
+                out.append((doc_id, src["query"]))
+                seen.add(doc_id)
+        for seg in segments:
+            for local, tname in enumerate(seg.types):
+                if tname != PERCOLATOR_TYPE or not seg.live_host[local]:
+                    continue
+                doc_id = seg.ids[local]
+                if doc_id in seen:
+                    continue
+                src = seg.stored[local]
+                if "query" in src:
+                    out.append((doc_id, src["query"]))
+                    seen.add(doc_id)
+    return out
+
+
+def _registry_key(svc) -> tuple:
+    return tuple((id(e), tuple(s.seg_id for s in e.segments),
+                  len(e._buffer_docs), e.translog.ops_since_commit)
+                 for e in svc.shards)
+
+
+def parsed_registry(svc) -> list[tuple[str, Any]]:
+    """Cached (query_id, parsed Node) registry — rebuilt only when a shard's
+    segment set or write buffer changes, so percolate requests skip both the
+    corpus scan and the query re-parse (the reference keeps exactly such a
+    live registry, PercolatorService's percolateQueries map)."""
+    from .query_parser import QueryParser
+
+    key = _registry_key(svc)
+    cached = getattr(svc, "_percolator_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    parser = QueryParser(svc.mappers)
+    entries: list[tuple[str, Any]] = []
+    for qid, qbody in registered_queries(svc):
+        try:
+            entries.append((qid, parser.parse(qbody)))
+        except Exception:  # noqa: BLE001 — broken stored query never matches
+            continue
+    svc._percolator_cache = (key, entries)
+    return entries
+
+
+def percolate(svc, index_name: str, doc: dict,
+              type_name: str = "_doc") -> dict:
+    """-> {"total": N, "matches": [{"_index", "_id"}]} (ref
+    PercolatorService.percolate response shape)."""
+    import numpy as np
+
+    from ..index.segment import SegmentBuilder
+    from .query_dsl import CollectionStats, SegmentContext
+    from .query_parser import merge_query_batch
+
+    registry = parsed_registry(svc)
+    if not registry:
+        return {"total": 0, "matches": []}
+    kept = [qid for qid, _ in registry]
+    nodes = [node for _, node in registry]
+
+    mapper = svc.mappers.document_mapper(type_name)
+    parsed = mapper.parse(doc, doc_id="_percolate_doc")
+    builder = SegmentBuilder(seg_id=0)
+    builder.add(parsed, type_name)
+    seg = builder.build()
+    # batch per PLAN SHAPE: same-shaped registered queries stack into one
+    # device program's query rows; each distinct shape costs one program
+    groups: dict[Any, list[int]] = {}
+    for i, n in enumerate(nodes):
+        try:
+            key = n.plan_key()
+        except Exception:  # noqa: BLE001 — unbatchable: solo group
+            key = ("solo", i)
+        groups.setdefault(key, []).append(i)
+    matched_ids: list[str] = []
+    for idxs in groups.values():
+        try:
+            batched = merge_query_batch([nodes[i] for i in idxs])
+            rows = idxs
+        except Exception:  # noqa: BLE001 — shape mismatch: evaluate solo
+            for i in idxs:
+                terms: dict[str, set] = {}
+                nodes[i].collect_terms(terms)
+                st = CollectionStats.from_segments([seg], terms)
+                m = np.asarray(nodes[i].match_mask(
+                    SegmentContext(seg, 1, st)))
+                if m[0, 0]:
+                    matched_ids.append(kept[i])
+            continue
+        terms_by_field: dict[str, set] = {}
+        batched.collect_terms(terms_by_field)
+        stats = CollectionStats.from_segments([seg], terms_by_field)
+        match = np.asarray(batched.match_mask(
+            SegmentContext(seg, len(rows), stats)))
+        for qi in np.flatnonzero(match[:, 0]):
+            matched_ids.append(kept[rows[int(qi)]])
+    matched_ids.sort()
+    matches = [{"_index": index_name, "_id": mid} for mid in matched_ids]
+    return {"total": len(matches), "matches": matches}

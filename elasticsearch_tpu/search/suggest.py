@@ -1,0 +1,165 @@
+"""Suggesters: term (spell correction), phrase, and completion.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/search/suggest/
+(SuggestPhase.java:43, term/TermSuggester + DirectSpellChecker semantics,
+phrase/PhraseSuggester, completion/CompletionSuggester):
+
+  term       — per-token candidates from the field's term dictionary within
+               max_edits Levenshtein distance, scored by similarity then
+               document frequency; suggest_mode missing|popular|always.
+  phrase     — whole-input rewrite built from the best per-token term
+               corrections, scored by the product of candidate scores.
+  completion — prefix lookup over a keyword/completion field's sorted
+               vocabulary (the FST analog is the sorted vocab + bisect).
+
+Host-side over term dictionaries (vocab-sized, not corpus-sized); the
+candidate filter (length band + shared prefix) keeps the edit-distance
+set small, like DirectSpellChecker's prefix requirement.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+from typing import Any
+
+_TOKEN = re.compile(r"\w+", re.UNICODE)
+
+
+def _field_vocab(segments, field: str) -> dict[str, int]:
+    """term -> df across this index's segments (text or keyword fields)."""
+    vocab: dict[str, int] = {}
+    for seg in segments:
+        fx = seg.text.get(field)
+        if fx is not None:
+            for t, tid in fx.terms.items():
+                vocab[t] = vocab.get(t, 0) + int(fx.term_lens[tid])
+            continue
+        kc = seg.keywords.get(field)
+        if kc is not None:
+            import numpy as np
+            ords = np.asarray(kc.ords)[: seg.n_pad]
+            counts = np.bincount(ords[ords >= 0],
+                                 minlength=len(kc.values))
+            for o, v in enumerate(kc.values):
+                if counts[o]:
+                    vocab[v] = vocab.get(v, 0) + int(counts[o])
+    return vocab
+
+
+def _edit_distance(a: str, b: str, cap: int) -> int:
+    """Banded Levenshtein with early exit above cap."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        lo = cap + 1
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != cb))
+            lo = min(lo, cur[j])
+        if lo > cap:
+            return cap + 1
+        prev = cur
+    return prev[-1]
+
+
+def term_candidates(vocab: dict[str, int], token: str, *,
+                    max_edits: int = 2, prefix_length: int = 1,
+                    min_word_length: int = 4, size: int = 5,
+                    suggest_mode: str = "missing") -> list[dict]:
+    """ref term/TermSuggester: candidates within max_edits, sharing
+    prefix_length chars, scored (1 - distance/len) then by df."""
+    tok = token.lower()
+    in_vocab = vocab.get(tok, 0)
+    if suggest_mode == "missing" and in_vocab:
+        return []
+    if len(tok) < min_word_length:
+        return []
+    prefix = tok[:prefix_length]
+    out = []
+    for cand, df in vocab.items():
+        if cand == tok:
+            continue
+        if prefix_length and not cand.startswith(prefix):
+            continue
+        d = _edit_distance(tok, cand, max_edits)
+        if d > max_edits:
+            continue
+        if suggest_mode == "popular" and df <= in_vocab:
+            continue
+        score = 1.0 - d / max(len(tok), len(cand))
+        out.append({"text": cand, "score": round(score, 6), "freq": df})
+    out.sort(key=lambda o: (-o["score"], -o["freq"], o["text"]))
+    return out[:size]
+
+
+def run_suggest(body: dict, segments) -> dict:
+    """Execute a suggest request body over one index's segments.
+    body: {global "text"?, name: {"text"?, "term"|"phrase"|"completion":
+    {...}}} -> {name: [entries]} (ref SuggestPhase response shape)."""
+    global_text = body.get("text")
+    out = {}
+    for name, spec in body.items():
+        if name == "text" or not isinstance(spec, dict):
+            continue
+        text = spec.get("text", global_text) or ""
+        if "term" in spec:
+            p = spec["term"]
+            vocab = _field_vocab(segments, p["field"])
+            entries = []
+            for m in _TOKEN.finditer(str(text)):
+                options = term_candidates(
+                    vocab, m.group(0),
+                    max_edits=int(p.get("max_edits", 2)),
+                    prefix_length=int(p.get("prefix_length", 1)),
+                    min_word_length=int(p.get("min_word_length", 4)),
+                    size=int(p.get("size", 5)),
+                    suggest_mode=p.get("suggest_mode", "missing"))
+                entries.append({"text": m.group(0), "offset": m.start(),
+                                "length": len(m.group(0)),
+                                "options": options})
+            out[name] = entries
+        elif "phrase" in spec:
+            p = spec["phrase"]
+            vocab = _field_vocab(segments, p["field"])
+            tokens = [m.group(0) for m in _TOKEN.finditer(str(text))]
+            rewritten = []
+            score = 1.0
+            changed = False
+            for tok in tokens:
+                cands = term_candidates(
+                    vocab, tok, size=1,
+                    max_edits=int(p.get("max_edits", 2)),
+                    suggest_mode="missing")
+                if cands:
+                    rewritten.append(cands[0]["text"])
+                    score *= cands[0]["score"]
+                    changed = True
+                else:
+                    rewritten.append(tok.lower())
+                    score *= 1.0 if vocab.get(tok.lower()) else 0.5
+            options = []
+            if changed:
+                options.append({"text": " ".join(rewritten),
+                                "score": round(score, 6)})
+            out[name] = [{"text": text, "offset": 0, "length": len(text),
+                          "options": options[:int(p.get("size", 5))]}]
+        elif "completion" in spec:
+            p = spec["completion"]
+            vocab = sorted(_field_vocab(segments, p["field"]).items())
+            values = [v for v, _ in vocab]
+            prefix = str(text)
+            lo = bisect.bisect_left(values, prefix)
+            options = []
+            for i in range(lo, len(values)):
+                if not values[i].startswith(prefix):
+                    break
+                options.append({"text": values[i],
+                                "score": float(vocab[i][1])})
+            options.sort(key=lambda o: (-o["score"], o["text"]))
+            out[name] = [{"text": prefix, "offset": 0,
+                          "length": len(prefix),
+                          "options": options[:int(p.get("size", 5))]}]
+    return out

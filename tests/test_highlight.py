@@ -1,0 +1,94 @@
+"""Plain highlighter: fragment extraction, tags, stemming-aware matching,
+multi-field and field-match semantics (ref search/highlight/
+PlainHighlighter.java + HighlightPhase.java).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "title": {"type": "text"},
+    "body": {"type": "text", "analyzer": "english"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("hl", mappings=MAPPING)
+    n.index_doc("hl", "1", {
+        "title": "The quick brown fox",
+        "body": "Foxes are running quickly through the brown forest. "
+                "The quick fox jumped over the lazy dog near the river."})
+    n.index_doc("hl", "2", {"title": "Slow snails", "body": "nothing here"})
+    n.refresh("hl")
+    yield n
+    n.close()
+
+
+class TestHighlight:
+    def test_basic_fragments_and_tags(self, node):
+        out = node.search("hl", {
+            "query": {"match": {"title": "quick fox"}},
+            "highlight": {"fields": {"title": {}}}})
+        h = out["hits"]["hits"][0]
+        assert h["_id"] == "1"
+        frags = h["highlight"]["title"]
+        assert any("<em>quick</em>" in f for f in frags)
+        assert any("<em>fox</em>" in f for f in frags)
+
+    def test_custom_tags(self, node):
+        out = node.search("hl", {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {"pre_tags": ["<b>"], "post_tags": ["</b>"],
+                          "fields": {"title": {}}}})
+        frags = out["hits"]["hits"][0]["highlight"]["title"]
+        assert any("<b>fox</b>" in f for f in frags)
+
+    def test_stemmed_query_highlights_surface_forms(self, node):
+        # english analyzer stems run/running -> run; the highlighter must
+        # still mark the surface forms in the text
+        out = node.search("hl", {
+            "query": {"match": {"body": "running"}},
+            "highlight": {"fields": {"body": {}}}})
+        frags = out["hits"]["hits"][0]["highlight"]["body"]
+        assert any("<em>running</em>" in f.lower() for f in frags)
+
+    def test_no_match_no_highlight_key(self, node):
+        out = node.search("hl", {
+            "query": {"match_all": {}},
+            "highlight": {"fields": {"title": {}}}})
+        h2 = next(h for h in out["hits"]["hits"] if h["_id"] == "2")
+        assert "highlight" not in h2  # match_all has no terms to mark
+
+    def test_require_field_match(self, node):
+        # query matches on title; body highlight suppressed when
+        # require_field_match is true
+        out = node.search("hl", {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {"require_field_match": True,
+                          "fields": {"body": {}}}})
+        h = out["hits"]["hits"][0]
+        assert "highlight" not in h
+        out = node.search("hl", {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {"fields": {"body": {}}}})
+        assert "highlight" in out["hits"]["hits"][0]
+
+    def test_fragment_size_and_count(self, node):
+        out = node.search("hl", {
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"fields": {"body": {
+                "fragment_size": 30, "number_of_fragments": 2}}}})
+        frags = out["hits"]["hits"][0]["highlight"]["body"]
+        assert 1 <= len(frags) <= 2
+        assert all(len(f) <= 30 + 2 * len("<em></em>") + 10 for f in frags)
+
+    def test_whole_field_with_zero_fragments(self, node):
+        out = node.search("hl", {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {"fields": {"title": {"number_of_fragments": 0}}}})
+        frags = out["hits"]["hits"][0]["highlight"]["title"]
+        assert len(frags) == 1
+        assert frags[0] == "The quick brown <em>fox</em>"

@@ -1,0 +1,114 @@
+"""Percolator (batched doc x query matrix, ref percolator/
+PercolatorService.java) and more_like_this expansion (ref
+MoreLikeThisQueryParser).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"}, "price": {"type": "long"},
+    "tag": {"type": "keyword"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+class TestPercolator:
+    def test_register_and_percolate(self, node):
+        node.create_index("px", mappings=MAPPING)
+        node.index_doc("px", "q1", {"query": {"match": {"body": "fox"}}},
+                       type_name=".percolator")
+        node.index_doc("px", "q2", {"query": {"match": {"body": "dog"}}},
+                       type_name=".percolator")
+        node.index_doc("px", "q3", {"query": {"range":
+                                              {"price": {"gte": 100}}}},
+                       type_name=".percolator")
+        out = node.percolate("px", {"doc": {"body": "quick brown fox",
+                                            "price": 150}})
+        ids = {m["_id"] for m in out["matches"]}
+        assert ids == {"q1", "q3"}
+        assert out["total"] == 2
+
+    def test_realtime_registration_no_refresh(self, node):
+        node.create_index("rt", mappings=MAPPING)
+        node.index_doc("rt", "q1", {"query": {"match": {"body": "alpha"}}},
+                       type_name=".percolator")
+        # no refresh: registration must still be visible
+        out = node.percolate("rt", {"doc": {"body": "alpha beta"}})
+        assert out["total"] == 1
+
+    def test_registered_queries_survive_refresh_and_merge(self, node):
+        node.create_index("pm", mappings=MAPPING)
+        for i in range(6):
+            node.index_doc("pm", f"q{i}",
+                           {"query": {"term": {"tag": f"t{i}"}}},
+                           type_name=".percolator")
+            node.refresh("pm")
+        node.force_merge("pm")
+        out = node.percolate("pm", {"doc": {"tag": "t3"}})
+        assert [m["_id"] for m in out["matches"]] == ["q3"]
+
+    def test_no_queries_no_matches(self, node):
+        node.create_index("empty", mappings=MAPPING)
+        out = node.percolate("empty", {"doc": {"body": "anything"}})
+        assert out == {"took": 0,
+                       "_shards": {"total": 1, "successful": 1, "failed": 0},
+                       "total": 0, "matches": []}
+
+
+class TestMoreLikeThis:
+    @pytest.fixture()
+    def corpus(self, node):
+        node.create_index("mlt", mappings=MAPPING)
+        base = "machine learning with tensors on accelerators"
+        docs = [
+            base,                                        # 0: the seed
+            "machine learning with tensors is fast",     # 1: similar
+            "tensors and accelerators and learning",     # 2: similar
+            "cooking pasta with tomato sauce",           # 3: unrelated
+            "gardening in the spring time",              # 4: unrelated
+            "machine learning with tensors everywhere",  # 5: similar
+        ]
+        for i, d in enumerate(docs):
+            node.index_doc("mlt", str(i), {"body": d + " " + d})  # tf >= 2
+        node.refresh("mlt")
+        return node
+
+    def test_mlt_by_text(self, corpus):
+        out = corpus.search("mlt", {"query": {"more_like_this": {
+            "fields": ["body"],
+            "like_text": "machine learning tensors " * 2,
+            "min_term_freq": 2, "min_doc_freq": 2}}})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert set(ids) >= {"0", "1", "5"}
+        assert "3" not in ids and "4" not in ids
+
+    def test_mlt_by_doc_id(self, corpus):
+        out = corpus.search("mlt", {"query": {"more_like_this": {
+            "fields": ["body"], "ids": ["0"],
+            "min_term_freq": 2, "min_doc_freq": 2}}})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert "1" in ids and "3" not in ids
+        assert "0" not in ids, "the seed doc itself must be excluded"
+
+    def test_mlt_endpoint_via_rest(self, corpus, tmp_path):
+        from elasticsearch_tpu.rest import HttpServer
+        import json as _json
+        import urllib.request
+        srv = HttpServer(corpus, port=0).start()
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/mlt/_doc/0/_mlt"
+                   f"?min_term_freq=2&min_doc_freq=2")
+            with urllib.request.urlopen(
+                    urllib.request.Request(url, method="GET")) as r:
+                out = _json.loads(r.read())
+            assert out["hits"]["total"] >= 2
+        finally:
+            srv.stop()

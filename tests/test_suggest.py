@@ -1,0 +1,86 @@
+"""Suggesters: term spell-correction over the term dictionary, phrase
+rewrite, completion prefix lookup (ref search/suggest/ SuggestPhase +
+DirectSpellChecker semantics).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"},
+    "name": {"type": "keyword"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("sg", mappings=MAPPING)
+    docs = ["the quick brown fox", "quick foxes run quickly",
+            "brown bears sleep", "the lazy dog barks",
+            "quality matters most"]
+    for i, d in enumerate(docs):
+        n.index_doc("sg", str(i), {"body": d, "name": f"item-{i:02d}"})
+    n.index_doc("sg", "x1", {"name": "quick start guide"})
+    n.index_doc("sg", "x2", {"name": "quicksilver"})
+    n.refresh("sg")
+    yield n
+    n.close()
+
+
+class TestTermSuggester:
+    def test_misspelling_corrected(self, node):
+        out = node.suggest("sg", {
+            "sp": {"text": "quikc", "term": {"field": "body"}}})
+        entries = out["sp"]
+        assert entries[0]["text"] == "quikc"
+        options = entries[0]["options"]
+        assert options and options[0]["text"] == "quick"
+        assert options[0]["freq"] >= 2
+
+    def test_existing_word_not_suggested_in_missing_mode(self, node):
+        out = node.suggest("sg", {
+            "sp": {"text": "quick", "term": {"field": "body"}}})
+        assert out["sp"][0]["options"] == []
+
+    def test_always_mode_suggests_for_existing(self, node):
+        out = node.suggest("sg", {
+            "sp": {"text": "quick",
+                   "term": {"field": "body", "suggest_mode": "always"}}})
+        assert out["sp"][0]["options"]   # e.g. quickly
+
+    def test_multi_token_entries(self, node):
+        out = node.suggest("sg", {
+            "sp": {"text": "quikc borwn", "term": {"field": "body"}}})
+        assert len(out["sp"]) == 2
+        assert out["sp"][1]["offset"] == 6
+        assert out["sp"][1]["options"][0]["text"] == "brown"
+
+
+class TestPhraseAndCompletion:
+    def test_phrase_rewrite(self, node):
+        out = node.suggest("sg", {
+            "fix": {"text": "quikc brown foxs",
+                    "phrase": {"field": "body"}}})
+        opts = out["fix"][0]["options"]
+        assert opts and opts[0]["text"] in ("quick brown fox",
+                                           "quick brown foxes")
+
+    def test_completion_prefix(self, node):
+        out = node.suggest("sg", {
+            "c": {"text": "quick", "completion": {"field": "name"}}})
+        texts = [o["text"] for o in out["c"][0]["options"]]
+        assert "quick start guide" in texts
+        assert "quicksilver" in texts
+        assert all(t.startswith("quick") for t in texts)
+
+
+class TestSuggestViaSearchAndRest:
+    def test_suggest_inside_search_body(self, node):
+        out = node.search("sg", {
+            "query": {"match": {"body": "fox"}},
+            "suggest": {"sp": {"text": "quikc",
+                               "term": {"field": "body"}}}})
+        assert out["suggest"]["sp"][0]["options"][0]["text"] == "quick"
+        assert out["hits"]["total"] >= 1

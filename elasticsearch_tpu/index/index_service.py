@@ -118,21 +118,44 @@ class IndexService:
         segments fused). None when the index is empty, or when the "request"
         breaker refuses the view's duplicate postings (the packed view
         roughly doubles device residency for text fields — breach degrades
-        to the per-segment lane, it never raises)."""
+        to the per-segment lane, it never raises).
+
+        NRT-friendly: when the segment set only GREW (refresh without a
+        merge), the new view EXTENDS the cached one — appended segments'
+        postings concatenate on device; cost is O(new postings), not
+        O(index) (advisor r3 medium). Any removal (merge) rebuilds."""
         from ..serving.packed_view import PackedIndexView
-        entries = [(si, seg) for si, e in enumerate(self.shards)
-                   for seg in e.segments]
-        if not entries:
+        live: dict[tuple, object] = {}
+        for si, e in enumerate(self.shards):
+            for seg in e.segments:
+                live[(si, seg.seg_id)] = seg
+        if not live:
             return None
-        key = tuple((si, seg.seg_id) for si, seg in entries)
-        if self._packed_cache is None or self._packed_cache[0] != key:
-            req = self.breakers.breaker("request") \
-                if self.breakers is not None else None
-            if self._packed_cache is not None \
-                    and self._packed_cache[1] is not None and req is not None:
-                req.release(self._packed_cache[1].memory_bytes)
-            view = PackedIndexView(entries, breaker=req)
-            self._packed_cache = (key, view)
+        key = tuple(sorted(live))
+        if self._packed_cache is not None and self._packed_cache[0] == key:
+            return self._packed_cache[1]
+        req = self.breakers.breaker("request") \
+            if self.breakers is not None else None
+        old = self._packed_cache[1] if self._packed_cache else None
+        base = None
+        entries = None
+        if old is not None:
+            old_keys = [(si, seg.seg_id) for si, seg in old.entries]
+            if all(k in live and live[k] is seg
+                   for k, (_, seg) in zip(old_keys, old.entries)) \
+                    and len(old_keys) == len(set(old_keys)):
+                appended = [(si, seg) for (si, sid), seg in live.items()
+                            if (si, sid) not in set(old_keys)]
+                appended.sort(key=lambda x: (x[0], x[1].seg_id))
+                base = old
+                entries = list(old.entries) + appended
+        if entries is None:
+            entries = [(si, seg) for si, e in enumerate(self.shards)
+                       for seg in e.segments]
+        if req is not None and old is not None:
+            req.release(old.memory_bytes)
+        view = PackedIndexView(entries, breaker=req, base=base)
+        self._packed_cache = (key, view)
         return self._packed_cache[1]
 
     # -- introspection -----------------------------------------------------

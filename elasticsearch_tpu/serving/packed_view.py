@@ -84,7 +84,7 @@ class PackedField:
 
     def __init__(self, doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
                  terms: np.ndarray, starts: np.ndarray, lens: np.ndarray,
-                 sum_dl: float):
+                 sum_dl: float, total_p: int = 0):
         self.doc_ids = doc_ids          # i32[P_pad] device, PAD-padded
         self.tf = tf                    # f32[P_pad]
         self.dl = dl                    # f32[P_pad]
@@ -93,6 +93,7 @@ class PackedField:
         self.lens = lens                # i32[V, NSEG] per-segment df
         self.df = lens.sum(axis=1)      # i64[V] global df
         self.sum_dl = sum_dl
+        self.total_p = total_p          # real postings (un-padded)
 
     def term_ids(self, terms: list[str]) -> np.ndarray:
         """Vectorized term lookup; -1 for absent terms."""
@@ -110,11 +111,16 @@ class PackedField:
 class PackedIndexView:
     """The fused serving structure for one index (all shards, all segments)."""
 
-    def __init__(self, segments: list[tuple[int, Segment]], breaker=None):
-        """segments: (shard_idx, segment) in stable (shard, seg) order.
+    def __init__(self, segments: list[tuple[int, Segment]], breaker=None,
+                 base: "PackedIndexView | None" = None):
+        """segments: (shard_idx, segment) in stable insertion order.
         breaker: optional "request" CircuitBreaker — each lazily-packed
         field charges its device bytes; a breach makes that field
-        unservable by this view (field() returns None) instead of raising."""
+        unservable by this view (field() returns None) instead of raising.
+        base: a previous view whose entries are an IDENTITY PREFIX of
+        `segments` — its built fields/filter columns are EXTENDED with the
+        appended segments' postings instead of repacked from scratch, so an
+        NRT refresh costs O(new postings), not O(index) (advisor r3)."""
         self.entries = segments
         self.breaker = breaker
         sizes = np.array([s.n_pad for _, s in segments], np.int64)
@@ -154,6 +160,151 @@ class PackedIndexView:
         self._live_dev: jax.Array | None = None
         self.device_calls = 0           # serving counters (observability)
         self.memory_bytes = 0
+        self.extended_from_base = False
+        if base is not None:
+            self._seed_from(base)
+
+    def _seed_from(self, base: "PackedIndexView") -> None:
+        """Extend the base view's built structures with the appended
+        segments (entries[len(base.entries):])."""
+        assert len(base.entries) <= len(self.entries) and all(
+            b[1] is s[1] for b, s in zip(base.entries, self.entries)), \
+            "base must be an identity prefix"
+        from ..common.breaker import CircuitBreakingException
+        for fname, pf in base._fields.items():
+            if pf is None:
+                continue
+            try:
+                self._fields[fname] = self._extend_field(fname, base, pf)
+            except CircuitBreakingException:
+                self._refused.add(fname)
+                self._fields[fname] = None
+        for fname, col in base._filter_cols.items():
+            if col is None:
+                continue
+            try:
+                self._filter_cols[fname] = self._extend_filter_col(
+                    fname, base, col)
+            except CircuitBreakingException:
+                pass    # rebuilt lazily (and re-gated) on next use
+        self.extended_from_base = True
+
+    def _extend_field(self, name: str, base: "PackedIndexView",
+                      pf: PackedField) -> PackedField:
+        """Append the new segments' postings BLOCKS to an existing packed
+        field: device-side concat of the old buffers (no host repack of old
+        data), plus a vectorized remap of the [V, NSEG] slice table into the
+        union term dictionary. Host work is O(new postings + vocab)."""
+        new = [(len(base.entries) + i, seg)
+               for i, (_, seg) in enumerate(self.entries[len(base.entries):])]
+        per_seg = []
+        for ei, seg in new:
+            fx = seg.text.get(name)
+            if fx is None or seg.n_docs == 0:
+                continue
+            host_ids = fx.doc_ids_host if fx.doc_ids_host is not None \
+                else np.asarray(fx.doc_ids)[:fx.n_postings]
+            per_seg.append((ei, fx, host_ids[:fx.n_postings]))
+        if not per_seg:
+            # stale PAD sentinels inside the old buffer are masked by the
+            # kernel's per-slot valid lanes, so the arrays are reusable
+            return pf
+
+        base_p = pf.total_p
+        total_new = sum(len(h) for _, _, h in per_seg)
+        p_pad = next_pow2(base_p + total_new + CHUNK, floor=CHUNK * 2)
+        if self.breaker is not None:
+            self.breaker.add_estimate(p_pad * 12)
+        tail_docs = np.full(p_pad - base_p, self.pad_doc, np.int32)
+        tail_tf = np.zeros(p_pad - base_p, np.float32)
+        tail_dl = np.ones(p_pad - base_p, np.float32)
+
+        seg_term_arrays = [np.asarray(list(fx.terms), dtype="U")
+                           for _, fx, _ in per_seg]
+        all_terms = np.unique(np.concatenate([pf.terms] + seg_term_arrays)) \
+            if len(pf.terms) else np.unique(np.concatenate(seg_term_arrays))
+        V = len(all_terms)
+        nseg_old = pf.starts.shape[1]
+        starts = np.zeros((V, nseg_old + len(per_seg)), np.int32)
+        lens = np.zeros((V, nseg_old + len(per_seg)), np.int64)
+        if len(pf.terms):
+            pos_old = np.searchsorted(all_terms, pf.terms)
+            starts[pos_old, :nseg_old] = pf.starts
+            lens[pos_old, :nseg_old] = pf.lens
+
+        off = base_p
+        sum_dl = pf.sum_dl
+        for si, (ei, fx, host_ids) in enumerate(per_seg):
+            P = len(host_ids)
+            lo = off - base_p
+            tail_docs[lo:lo + P] = host_ids + int(self.bases[ei])
+            tail_tf[lo:lo + P] = np.asarray(fx.tf[:P])
+            tail_dl[lo:lo + P] = np.asarray(fx.dl[:P])
+            st = seg_term_arrays[si]
+            pos = np.searchsorted(all_terms, st)
+            starts[pos, nseg_old + si] = fx.term_starts[: len(st)] + off
+            lens[pos, nseg_old + si] = fx.term_lens[: len(st)]
+            sum_dl += fx.sum_dl
+            off += P
+
+        doc_ids = jnp.concatenate([pf.doc_ids[:base_p],
+                                   jnp.asarray(tail_docs)])
+        tf = jnp.concatenate([pf.tf[:base_p], jnp.asarray(tail_tf)])
+        dl = jnp.concatenate([pf.dl[:base_p], jnp.asarray(tail_dl)])
+        self.memory_bytes += p_pad * 12
+        return PackedField(doc_ids=doc_ids, tf=tf, dl=dl, terms=all_terms,
+                           starts=starts, lens=lens, sum_dl=sum_dl,
+                           total_p=base_p + total_new)
+
+    def _extend_filter_col(self, name: str, base: "PackedIndexView",
+                           col: PackedFilterColumn) -> PackedFilterColumn:
+        """Extend a filter column over the appended doc space. Keyword
+        columns may need an ordinal REMAP when new segments introduce new
+        vocabulary — numeric ones are a pure concat."""
+        if self.breaker is not None:
+            self.breaker.add_estimate(self.n_pad_total * 8)
+        new_entries = list(enumerate(self.entries))[len(base.entries):]
+        if col.kind == "numeric":
+            tail = np.full(self.n_pad_total - base.n_total, np.nan)
+            for ei, (_, seg) in new_entries:
+                nc = seg.numerics.get(name)
+                if nc is None or seg.n_docs == 0:
+                    continue
+                lo = int(self.bases[ei]) - base.n_total
+                v = np.asarray(nc.vals).astype(np.float64)
+                miss = np.asarray(nc.missing)
+                n = min(seg.n_pad, len(v))
+                tail[lo:lo + n] = np.where(miss[:n], np.nan, v[:n])
+            vals = jnp.concatenate([col.vals[: base.n_total],
+                                    jnp.asarray(tail)])
+            self.memory_bytes += self.n_pad_total * 8
+            return PackedFilterColumn("numeric", vals)
+        # keyword: union vocab; remap old ordinals only if vocab grew
+        new_vocabs = [seg.keywords[name].values
+                      for _, (_, seg) in new_entries
+                      if name in seg.keywords]
+        vocab = sorted(set(col.vocab).union(*new_vocabs)) if new_vocabs \
+            else col.vocab
+        union_of = {v: i for i, v in enumerate(vocab)}
+        if vocab != col.vocab:
+            lut = np.array([union_of[v] for v in col.vocab] + [-1.0])
+            old = np.asarray(col.vals[: base.n_total]).astype(np.int64)
+            head = jnp.asarray(lut[old])
+        else:
+            head = col.vals[: base.n_total]
+        tail = np.full(self.n_pad_total - base.n_total, -1.0)
+        for ei, (_, seg) in new_entries:
+            kc = seg.keywords.get(name)
+            if kc is None or seg.n_docs == 0:
+                continue
+            lo = int(self.bases[ei]) - base.n_total
+            lut = np.array([union_of[v] for v in kc.values] + [-1.0])
+            ords = np.asarray(kc.ords)
+            n = min(seg.n_pad, len(ords))
+            tail[lo:lo + n] = lut[ords[:n]]
+        vals = jnp.concatenate([head, jnp.asarray(tail)])
+        self.memory_bytes += self.n_pad_total * 8
+        return PackedFilterColumn("keyword", vals, vocab=vocab)
 
     # -- liveness (rebuilt on tombstone changes only) ----------------------
 
@@ -247,7 +398,7 @@ class PackedIndexView:
         return PackedField(
             doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
             dl=jnp.asarray(dl), terms=all_terms, starts=starts,
-            lens=lens.astype(np.int64), sum_dl=sum_dl)
+            lens=lens.astype(np.int64), sum_dl=sum_dl, total_p=total_p)
 
     # -- stats (parity with query_dsl.CollectionStats) ---------------------
 

@@ -33,6 +33,11 @@ class IndexService:
         self.breakers = breakers           # CircuitBreakerService | None
         fd = breakers.breaker("fielddata") if breakers is not None else None
         self.mappers = MapperService(mappings=mappings or {})
+        # per-field similarity registry (named configs from index settings,
+        # resolved via the mapping's "similarity" property) — attached to
+        # the mapper service so QueryParser sees it everywhere
+        from .similarity import SimilarityService
+        self.mappers.similarity = SimilarityService(self.settings)
         self.shards: list[Engine] = [
             Engine(os.path.join(path, str(s)), self.mappers, breaker=fd)
             for s in range(self.n_shards)]

@@ -1,0 +1,65 @@
+"""Pluggable similarity: per-field scoring configuration.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/index/similarity/
+SimilarityService.java:36 + SimilarityModule: named similarity configs from
+index settings (index.similarity.<name>.type/k1/b), resolved per field via
+the mapping's "similarity" property.
+
+Supported types:
+  BM25 (default)  — parameterized k1/b; the sparse/packed device kernels
+                    take k1/b as runtime scalars, so custom-parameter BM25
+                    fields keep the fast lanes (plans group by (field,k1,b)).
+  classic/default — Lucene ClassicSimilarity (TF-IDF): sqrt(tf) * idf^2
+                    with 1/sqrt(dl) length norm; scored by a dedicated
+                    dense kernel (ops/bm25.classic_score_batch) — the
+                    sparse/packed lanes decline these fields.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Similarity:
+    type: str = "BM25"        # "BM25" | "classic"
+    k1: float = 1.2
+    b: float = 0.75
+
+
+DEFAULT = Similarity()
+CLASSIC = Similarity(type="classic")
+
+
+class SimilarityService:
+    """Named similarity registry for one index."""
+
+    def __init__(self, settings=None):
+        self.named: dict[str, Similarity] = {
+            "BM25": DEFAULT, "default": CLASSIC, "classic": CLASSIC}
+        if settings is not None and hasattr(settings, "by_prefix"):
+            for prefix in ("index.similarity.", "similarity."):
+                sims = settings.by_prefix(prefix)
+                names = {k.split(".")[0] for k in sims}
+                for name in names:
+                    sub = sims.by_prefix(name + ".")
+                    stype = sub.get_str("type", "BM25")
+                    if stype in ("classic", "default"):
+                        self.named[name] = CLASSIC
+                    else:
+                        self.named[name] = Similarity(
+                            type="BM25",
+                            k1=sub.get_float("k1", 1.2),
+                            b=sub.get_float("b", 0.75))
+
+    def resolve(self, name: str | None) -> Similarity:
+        if name is None:
+            return DEFAULT
+        return self.named.get(name, DEFAULT)
+
+    def for_field(self, mappers, field: str) -> Similarity:
+        """The similarity a text field scores with: the mapping's
+        "similarity" property resolved through the named registry."""
+        ft = mappers.field_type(field) if mappers is not None else None
+        sim_name = getattr(ft, "similarity", None) if ft is not None else None
+        return self.resolve(sim_name)

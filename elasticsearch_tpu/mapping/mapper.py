@@ -68,6 +68,7 @@ class FieldType:
     dims: int = 0                  # dense_vector dimension
     format: str | None = None      # date format
     boost: float = 1.0
+    similarity: str | None = None  # named similarity (index/similarity.py)
 
     def to_dict(self) -> dict:
         """Render in the reference's wire vocabulary: analyzed and
@@ -80,6 +81,8 @@ class FieldType:
                 out["analyzer"] = self.analyzer
             if not self.index:
                 out["index"] = "no"
+            if self.similarity:
+                out["similarity"] = self.similarity
             return out
         if self.type == KEYWORD:
             return {"type": "string", "index": "not_analyzed"}
@@ -244,6 +247,7 @@ class DocumentMapper:
                 dims=int(spec.get("dims", 0)),
                 format=spec.get("format"),
                 boost=float(spec.get("boost", 1.0)),
+                similarity=spec.get("similarity"),
             )
             existing = self.fields.get(path)
             if existing is None:

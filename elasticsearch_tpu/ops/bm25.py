@@ -93,6 +93,33 @@ def bm25_score_batch(doc_ids: jax.Array, tf: jax.Array, doc_len: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def classic_score_batch(doc_ids: jax.Array, tf: jax.Array,
+                        doc_len: jax.Array, term_starts: jax.Array,
+                        term_lens: jax.Array, weights: jax.Array, *,
+                        W: int, n_pad: int) -> jax.Array:
+    """Lucene ClassicSimilarity (TF-IDF) scoring: per-posting contribution
+    is weight * sqrt(tf) / sqrt(dl), where the caller bakes idf^2 * boost
+    into `weights` (ref org.apache.lucene.search.similarities.
+    ClassicSimilarity: tf=sqrt, lengthNorm=1/sqrt(dl), idf squared via
+    weight*idf at both query and doc ends)."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    impact = jnp.sqrt(tfv) / jnp.sqrt(jnp.maximum(dl, 1.0))
+    w = jnp.take_along_axis(weights, t_idx, axis=1)
+    contrib = jnp.where(valid, w * impact, 0.0).astype(jnp.float32)
+    doc = jnp.where(valid, doc, n_pad - 1)
+    scores = jnp.zeros((Q, n_pad), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], doc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
 def term_match_mask(doc_ids: jax.Array, term_starts: jax.Array,
                     term_lens: jax.Array, W: int, n_pad: int) -> jax.Array:
     """Boolean [Q, n_pad]: does doc contain ANY of the given terms.

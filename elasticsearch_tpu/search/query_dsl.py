@@ -164,6 +164,7 @@ class MatchNode(Node):
     minimum_should_match: int = 0    # 0 = default by operator
     k1: float = 1.2
     b: float = 0.75
+    sim: str = "BM25"                # "BM25" | "classic" (index/similarity)
 
     def collect_terms(self, out):
         s = out.setdefault(self.field_name, set())
@@ -190,8 +191,15 @@ class MatchNode(Node):
                 starts[qi, ti] = s
                 lens[qi, ti] = ln
                 if df > 0:
-                    w = math.log(1 + (ctx.stats.doc_count - df + 0.5) / (df + 0.5))
-                    weights[qi, ti] = w * (self.k1 + 1) * self.boost
+                    if self.sim == "classic":
+                        # ClassicSimilarity: idf^2 at the weight
+                        # (query-norm omitted, like modern Lucene)
+                        idf = 1.0 + math.log(
+                            ctx.stats.doc_count / (df + 1.0))
+                        weights[qi, ti] = idf * idf * self.boost
+                    else:
+                        w = math.log(1 + (ctx.stats.doc_count - df + 0.5) / (df + 0.5))
+                        weights[qi, ti] = w * (self.k1 + 1) * self.boost
         return starts, lens, weights, n_terms
 
     def execute(self, ctx):
@@ -202,11 +210,17 @@ class MatchNode(Node):
         starts, lens, weights, n_terms = self._host_arrays(ctx)
         W = _pow2_window(lens)
         avgdl = ctx.stats.avgdl(self.field_name)
-        scores = bm25.bm25_score_batch(
-            fx.doc_ids, fx.tf, fx.doc_len,
-            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights),
-            jnp.float32(self.k1), jnp.float32(self.b), jnp.float32(avgdl),
-            W=W, n_pad=ctx.n_pad)
+        if self.sim == "classic":
+            scores = bm25.classic_score_batch(
+                fx.doc_ids, fx.tf, fx.doc_len,
+                jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(weights), W=W, n_pad=ctx.n_pad)
+        else:
+            scores = bm25.bm25_score_batch(
+                fx.doc_ids, fx.tf, fx.doc_len,
+                jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights),
+                jnp.float32(self.k1), jnp.float32(self.b), jnp.float32(avgdl),
+                W=W, n_pad=ctx.n_pad)
         if self.operator == "and" or self.minimum_should_match > 1:
             # count distinct matching terms per doc: reuse kernel with weight=1, tf→1
             need = np.maximum(self.minimum_should_match, 1) if self.operator != "and" else n_terms
@@ -240,7 +254,8 @@ class MatchNode(Node):
                                     n_pad=ctx.n_pad)
 
     def plan_key(self):
-        return ("match", self.field_name, self.operator, self.minimum_should_match)
+        return ("match", self.field_name, self.operator,
+                self.minimum_should_match, self.sim, self.k1, self.b)
 
 
 _POS_SHIFT = 1 << 21      # doc*SHIFT + position fits i64 for 1M-token docs

@@ -73,6 +73,17 @@ class QueryParser:
     def __init__(self, mappers: MapperService):
         self.mappers = mappers
 
+    def _sim_kw(self, field: str) -> dict:
+        """Per-field similarity knobs for scored text nodes (the mapping's
+        "similarity" resolved through the index's SimilarityService, which
+        IndexService attaches to the MapperService; ref index/similarity/
+        SimilarityService.java:36)."""
+        svc = getattr(self.mappers, "similarity", None)
+        if svc is None:
+            return {}
+        sim = svc.for_field(self.mappers, field)
+        return {"sim": sim.type, "k1": sim.k1, "b": sim.b}
+
     def parse(self, body: dict | None) -> Node:
         if body is None or body == {}:
             return MatchAllNode()
@@ -119,7 +130,7 @@ class QueryParser:
             boost=float(params.get("boost", 1.0)), field_name=field,
             terms_per_query=[terms],
             operator=str(params.get("operator", "or")).lower(),
-            minimum_should_match=msm)
+            minimum_should_match=msm, **self._sim_kw(field))
 
     def _parse_match_phrase(self, spec: dict) -> Node:
         (field, params), = spec.items()
@@ -160,7 +171,8 @@ class QueryParser:
                 boost = float(b)
             terms = self._analyze(f, text)
             if terms:
-                subs.append(MatchNode(field_name=f, terms_per_query=[terms], boost=boost))
+                subs.append(MatchNode(field_name=f, terms_per_query=[terms],
+                                      boost=boost, **self._sim_kw(f)))
         if not subs:
             return MatchNoneNode()
         if mm_type == "most_fields":

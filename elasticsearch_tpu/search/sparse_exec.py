@@ -78,8 +78,12 @@ def _mask_safe(n: Node) -> bool:
 
 
 def extract_sparse_plan(node: Node) -> SparsePlan | None:
-    """Recognize sparse-servable query shapes; None = use the dense tree."""
+    """Recognize sparse-servable query shapes; None = use the dense tree.
+    Non-BM25 similarities (index/similarity.py "classic") score through the
+    dense kernel, so those fields decline the sparse/packed lanes."""
     if isinstance(node, MatchNode):
+        if node.sim != "BM25":
+            return None
         return SparsePlan(
             field=node.field_name, terms_per_query=node.terms_per_query,
             operator=node.operator, msm=node.minimum_should_match,
@@ -93,8 +97,8 @@ def extract_sparse_plan(node: Node) -> SparsePlan | None:
         masks: list[Node] = []
         for m in node.must:
             if isinstance(m, MatchNode):
-                if match is not None:
-                    return None      # two scored text clauses: dense tree
+                if match is not None or m.sim != "BM25":
+                    return None      # two scored clauses / non-BM25: dense
                 match = m
             elif _mask_safe(m):
                 # const-score must: adds its boost to every surviving doc

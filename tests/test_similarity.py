@@ -1,0 +1,93 @@
+"""Pluggable similarity: per-field BM25 parameters from named index-settings
+configs, ClassicSimilarity (TF-IDF) scoring, and lane routing (custom-k1
+BM25 keeps the packed lane; classic takes the dense kernel).
+Ref index/similarity/SimilarityService.java:36.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+def _fill(node, index):
+    docs = [
+        "fox",                                  # short doc, tf 1
+        "fox fox fox fox fox fox fox fox",      # high tf
+        "fox " + "filler " * 40,                # long doc, tf 1
+    ]
+    for i, d in enumerate(docs):
+        node.index_doc(index, str(i), {"body": d})
+    node.refresh(index)
+
+
+class TestBM25Params:
+    def test_custom_k1_b_change_ranking(self, node):
+        # b=0: no length normalization -> the long doc scores as the short
+        node.create_index("nolen", settings={
+            "similarity": {"flat": {"type": "BM25", "k1": 1.2, "b": 0.0}}},
+            mappings={"_doc": {"properties": {
+                "body": {"type": "string", "similarity": "flat"}}}})
+        _fill(node, "nolen")
+        out = node.search("nolen", {"query": {"match": {"body": "fox"}}})
+        scores = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert scores["0"] == pytest.approx(scores["2"], rel=1e-5), \
+            "b=0 must ignore document length"
+
+        node.create_index("len", mappings={"_doc": {"properties": {
+            "body": {"type": "string"}}}})
+        _fill(node, "len")
+        out = node.search("len", {"query": {"match": {"body": "fox"}}})
+        s2 = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert s2["0"] > s2["2"], "default BM25 penalizes long docs"
+
+    def test_k1_zero_ignores_tf(self, node):
+        node.create_index("notf", settings={
+            "similarity": {"bin": {"type": "BM25", "k1": 0.0, "b": 0.0}}},
+            mappings={"_doc": {"properties": {
+                "body": {"type": "string", "similarity": "bin"}}}})
+        _fill(node, "notf")
+        out = node.search("notf", {"query": {"match": {"body": "fox"}}})
+        scores = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert scores["0"] == pytest.approx(scores["1"], rel=1e-5), \
+            "k1=0 must ignore term frequency"
+
+    def test_custom_bm25_keeps_packed_lane(self, node):
+        node.create_index("pk", settings={
+            "similarity": {"flat": {"type": "BM25", "k1": 0.9, "b": 0.3}}},
+            mappings={"_doc": {"properties": {
+                "body": {"type": "string", "similarity": "flat"}}}})
+        _fill(node, "pk")
+        svc = node.indices["pk"]
+        before = svc.search_stats.get("packed", 0)
+        node.search("pk", {"query": {"match": {"body": "fox"}}})
+        assert svc.search_stats.get("packed", 0) == before + 1, \
+            "parameterized BM25 must still ride the packed kernel"
+
+
+class TestClassic:
+    def test_classic_scoring_and_dense_routing(self, node):
+        node.create_index("cl", mappings={"_doc": {"properties": {
+            "body": {"type": "string", "similarity": "classic"}}}})
+        _fill(node, "cl")
+        svc = node.indices["cl"]
+        out = node.search("cl", {"query": {"match": {"body": "fox"}}})
+        assert svc.search_stats.get("packed", 0) == 0
+        assert svc.search_stats.get("dense", 0) >= 1
+        scores = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        # classic: sqrt(tf)/sqrt(dl) — doc 1 (tf=8, dl=8) cancels exactly
+        # to doc 0's (tf=1, dl=1); the long tf=1 doc is length-penalized
+        assert scores["1"] == pytest.approx(scores["0"], rel=1e-4)
+        assert scores["0"] > scores["2"] * 2
+
+    def test_mapping_roundtrip_preserves_similarity(self, node):
+        node.create_index("rt", mappings={"_doc": {"properties": {
+            "body": {"type": "string", "similarity": "classic"}}}})
+        md = node.indices["rt"].mappings_dict()
+        assert md["_doc"]["properties"]["body"]["similarity"] == "classic"

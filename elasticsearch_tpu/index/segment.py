@@ -304,6 +304,12 @@ class SegmentBuilder:
         for field, vals in doc.numerics.items():
             if vals:
                 self._doubles.setdefault(field, {})[local] = vals[0]
+        for field, (lat, lon) in doc.geo.items():
+            # geo_point lands as two numeric columns — persistence, merge,
+            # breaker accounting and columnar filters all come for free
+            # (queries read <field>.lat / <field>.lon; search/query_parser)
+            self._doubles.setdefault(field + ".lat", {})[local] = lat
+            self._doubles.setdefault(field + ".lon", {})[local] = lon
         for field, vec in doc.vectors.items():
             self._vectors.setdefault(field, {})[local] = vec
             self._vector_dims[field] = len(vec)

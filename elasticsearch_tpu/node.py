@@ -101,7 +101,16 @@ class NodeService:
             import json
             with open(tpl_path) as f:
                 self.templates.update(json.load(f))
+        # stored SEARCH templates (mustache-lite bodies, search/templates.py)
+        self.search_templates: dict[str, Any] = {}
+        st_path = os.path.join(data_path, "_search_templates.json")
+        if os.path.exists(st_path):
+            import json
+            with open(st_path) as f:
+                self.search_templates.update(json.load(f))
         self._recover_indices()
+        for svc in self.indices.values():
+            svc.mappers.search_templates = self.search_templates
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
 
@@ -154,6 +163,7 @@ class NodeService:
                            Settings(merged_settings), merged_mappings,
                            breakers=self.breakers)
         svc.aliases = merged_aliases
+        svc.mappers.search_templates = self.search_templates
         self.indices[name] = svc
         self._persist_index_meta(svc)
         return svc
@@ -1086,6 +1096,14 @@ class NodeService:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.templates, f)
+        os.replace(tmp, path)
+
+    def _persist_search_templates(self) -> None:
+        import json
+        path = os.path.join(self.data_path, "_search_templates.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.search_templates, f)
         os.replace(tmp, path)
 
     def delete_by_query(self, index: str, body: dict) -> int:

@@ -286,6 +286,41 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/{index}/{type}/_mpercolate", mpercolate_api)
     c.register("POST", "/{index}/{type}/_mpercolate", mpercolate_api)
 
+    # -- search templates (ref RestSearchTemplateAction + script store) ----
+    def put_search_template(g, p, b):
+        body = _json_body(b)
+        node.search_templates[g["id"]] = body.get("template", body)
+        node._persist_search_templates()
+        return 200, {"_id": g["id"], "created": True, "acknowledged": True}
+    c.register("PUT", "/_search/template/{id}", put_search_template)
+    c.register("POST", "/_search/template/{id}", put_search_template)
+
+    def get_search_template(g, p, b):
+        tpl = node.search_templates.get(g["id"])
+        if tpl is None:
+            return 404, {"_id": g["id"], "found": False}
+        return 200, {"_id": g["id"], "found": True, "lang": "mustache",
+                     "template": tpl}
+    c.register("GET", "/_search/template/{id}", get_search_template)
+
+    def delete_search_template(g, p, b):
+        if node.search_templates.pop(g["id"], None) is None:
+            return 404, {"_id": g["id"], "found": False}
+        node._persist_search_templates()
+        return 200, {"_id": g["id"], "found": True, "acknowledged": True}
+    c.register("DELETE", "/_search/template/{id}", delete_search_template)
+
+    def search_template(g, p, b):
+        from ..search.templates import render_template
+        body = render_template(_json_body(b), node.search_templates)
+        return 200, node.search(g.get("index", "_all"), body)
+    c.register("GET", "/_search/template", search_template)
+    c.register("POST", "/_search/template", search_template)
+    c.register("GET", "/{index}/_search/template", search_template)
+    c.register("POST", "/{index}/_search/template", search_template)
+    c.register("GET", "/{index}/{type}/_search/template", search_template)
+    c.register("POST", "/{index}/{type}/_search/template", search_template)
+
     def suggest_api(g, p, b):
         out = node.suggest(g.get("index", "_all"), _json_body(b))
         return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0},

@@ -592,6 +592,92 @@ class ExistsNode(Node):
 
 
 @dataclass
+class GeoDistanceNode(Node):
+    """geo_distance filter: haversine over the field's lat/lon columns
+    (ref index/query/GeoDistanceFilterParser + common/geo/GeoDistance.java
+    ARC). The distance evaluates as one fused device expression over the
+    columnar doc values — no per-doc host loop."""
+    field_name: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+    _EARTH_R = 6371008.8    # mean earth radius in meters (GeoUtils)
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        la = seg.numerics.get(self.field_name + ".lat")
+        lo = seg.numerics.get(self.field_name + ".lon")
+        if la is None or lo is None:
+            return _zeros(ctx), _false(ctx)
+        lat1 = math.radians(self.lat)
+        lon1 = math.radians(self.lon)
+        lat2 = jnp.radians(la.vals.astype(jnp.float64))
+        lon2 = jnp.radians(lo.vals.astype(jnp.float64))
+        dlat = lat2 - lat1
+        dlon = lon2 - lon1
+        a = jnp.sin(dlat / 2) ** 2 \
+            + math.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
+        dist = 2 * self._EARTH_R * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
+        ok = (dist <= self.distance_m) & ~la.missing
+        match = jnp.broadcast_to(ok[None, :], (ctx.Q, ctx.n_pad))
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("geo_distance", self.field_name, self.lat, self.lon,
+                self.distance_m)
+
+
+@dataclass
+class CommonTermsNode(Node):
+    """common terms query (ref index/query/CommonTermsQueryParser +
+    Lucene CommonTermsQuery): terms above cutoff_frequency become optional
+    scoring clauses; the rare terms are the required match."""
+    field_name: str = ""
+    terms: list[str] = dc_field(default_factory=list)
+    cutoff_frequency: float = 0.01
+    low_freq_operator: str = "or"
+    high_freq_operator: str = "or"
+    minimum_should_match: int = 0
+    sim: str = "BM25"
+    k1: float = 1.2
+    b: float = 0.75
+
+    def collect_terms(self, out):
+        out.setdefault(self.field_name, set()).update(self.terms)
+
+    def _split(self, ctx):
+        n = max(ctx.stats.doc_count, 1)
+        cutoff = self.cutoff_frequency if self.cutoff_frequency < 1 \
+            else self.cutoff_frequency / n
+        low = [t for t in self.terms
+               if ctx.stats.df(self.field_name, t) / n <= cutoff]
+        high = [t for t in self.terms if t not in low]
+        return low, high
+
+    def execute(self, ctx):
+        low, high = self._split(ctx)
+        kw = dict(field_name=self.field_name, sim=self.sim,
+                  k1=self.k1, b=self.b, boost=self.boost)
+        scorer = MatchNode(terms_per_query=[self.terms], **kw)
+        scores, any_match = scorer.execute(ctx)
+        req = low if low else high
+        op = self.low_freq_operator if low else self.high_freq_operator
+        gate = MatchNode(terms_per_query=[req], operator=op,
+                         minimum_should_match=self.minimum_should_match,
+                         **kw)
+        match = gate.match_mask(ctx)
+        return jnp.where(match, scores, 0.0), match
+
+    def match_mask(self, ctx):
+        return self.execute(ctx)[1]
+
+    def plan_key(self):
+        return ("common_terms", self.field_name, self.cutoff_frequency,
+                self.low_freq_operator, self.minimum_should_match)
+
+
+@dataclass
 class IdsNode(Node):
     ids_per_query: list[list[str]] = dc_field(default_factory=list)
 

@@ -17,10 +17,44 @@ from typing import Any
 
 from ..mapping.mapper import MapperService, DATE, KEYWORD, TEXT, parse_date_millis
 from .query_dsl import (
-    BoolNode, BoostingNode, ConstantScoreNode, DisMaxNode, ExistsNode,
-    FunctionScoreNode, IdsNode, MatchAllNode, MatchNode, MatchNoneNode, Node,
-    QueryParsingException, RangeNode, TermFilterNode,
+    BoolNode, BoostingNode, CommonTermsNode, ConstantScoreNode, DisMaxNode,
+    ExistsNode, FunctionScoreNode, GeoDistanceNode, IdsNode, MatchAllNode,
+    MatchNode, MatchNoneNode, Node, QueryParsingException, RangeNode,
+    TermFilterNode,
 )
+
+_DISTANCE_UNITS_M = {
+    "m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
+    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
+    "nmi": 1852.0, "nm": 1852.0, "cm": 0.01, "mm": 0.001, "in": 0.0254,
+}
+
+
+def parse_distance(v) -> float:
+    """"200km" / "1.5mi" / bare meters -> meters
+    (ref common/unit/DistanceUnit.java)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.match(r"^\s*([\d.]+)\s*([a-zA-Z]*)\s*$", str(v))
+    if not m:
+        raise QueryParsingException(f"failed to parse distance [{v}]")
+    unit = m.group(2) or "m"
+    if unit not in _DISTANCE_UNITS_M:
+        raise QueryParsingException(f"unknown distance unit [{unit}]")
+    return float(m.group(1)) * _DISTANCE_UNITS_M[unit]
+
+
+def parse_geo_point(v) -> tuple[float, float]:
+    """(lat, lon) from {lat,lon} / "lat,lon" / [lon,lat] GeoJSON
+    (ref common/geo/GeoUtils.parseGeoPoint)."""
+    if isinstance(v, dict):
+        return float(v["lat"]), float(v["lon"])
+    if isinstance(v, str):
+        lat, lon = v.split(",")
+        return float(lat), float(lon)
+    if isinstance(v, (list, tuple)) and len(v) == 2:
+        return float(v[1]), float(v[0])
+    raise QueryParsingException(f"failed to parse geo point [{v}]")
 
 _DATE_MATH_RE = re.compile(
     r"^now(?P<ops>([+-]\d+[yMwdhHms])*)(?:/(?P<round>[yMwdhHms]))?$")
@@ -226,6 +260,69 @@ class QueryParser:
             hi = eval_date_math(str(hi)) if hi is not None else None
         return RangeNode(field_name=field, bounds_per_query=[(lo, hi, inc_lo, inc_hi)],
                          is_date=is_date, boost=float(params.get("boost", 1.0)))
+
+    def _parse_geo_distance(self, spec: dict) -> Node:
+        spec = dict(spec)
+        distance = parse_distance(spec.pop("distance"))
+        spec.pop("distance_type", None)
+        spec.pop("optimize_bbox", None)
+        (field, point), = spec.items()
+        lat, lon = parse_geo_point(point)
+        return GeoDistanceNode(field_name=field, lat=lat, lon=lon,
+                               distance_m=distance)
+
+    def _parse_geo_bounding_box(self, spec: dict) -> Node:
+        """Rewritten to two columnar range filters over the stored
+        <field>.lat / <field>.lon doc values (ref index/query/
+        GeoBoundingBoxFilterParser — 'indexed' execution mode)."""
+        spec = {k: v for k, v in spec.items()
+                if k not in ("type", "coerce", "ignore_malformed")}
+        (field, box), = spec.items()
+        if "top_left" in box:
+            top, left = parse_geo_point(box["top_left"])
+            bottom, right = parse_geo_point(box["bottom_right"])
+        else:
+            top, bottom = float(box["top"]), float(box["bottom"])
+            left, right = float(box["left"]), float(box["right"])
+        return BoolNode(filter=[
+            RangeNode(field_name=field + ".lat",
+                      bounds_per_query=[(bottom, top, True, True)]),
+            RangeNode(field_name=field + ".lon",
+                      bounds_per_query=[(left, right, True, True)]),
+        ])
+
+    def _parse_common(self, spec: dict) -> Node:
+        (field, params), = spec.items()
+        if not isinstance(params, dict):
+            params = {"query": params}
+        terms = self._analyze(field, params["query"])
+        if not terms:
+            return MatchNoneNode()
+        msm = params.get("minimum_should_match", 0)
+        if isinstance(msm, dict):
+            msm = msm.get("low_freq", 0)
+        return CommonTermsNode(
+            field_name=field, terms=terms,
+            cutoff_frequency=float(params.get("cutoff_frequency", 0.01)),
+            low_freq_operator=str(params.get("low_freq_operator",
+                                             "or")).lower(),
+            high_freq_operator=str(params.get("high_freq_operator",
+                                              "or")).lower(),
+            minimum_should_match=_parse_msm(msm, len(terms)),
+            boost=float(params.get("boost", 1.0)),
+            **self._sim_kw(field))
+
+    _parse_common_terms = _parse_common
+
+    def _parse_template(self, spec: dict) -> Node:
+        """template query: render the mustache-lite template then parse the
+        result (ref index/query/TemplateQueryParser)."""
+        from .templates import render_template
+        rendered = render_template(spec, getattr(self.mappers,
+                                                 "search_templates", None))
+        if isinstance(rendered, dict) and list(rendered) == ["query"]:
+            rendered = rendered["query"]
+        return self.parse(rendered)
 
     def _parse_exists(self, spec: dict) -> Node:
         return ExistsNode(field_name=spec["field"])

@@ -1,0 +1,78 @@
+"""Search templates: mustache-lite rendering of query bodies.
+
+Analog of the reference's script/template support for search
+(rest/action/search/RestSearchTemplateAction + index/query/
+TemplateQueryParser; the reference renders via Mustache). Supported here:
+{{var}} substitution — a JSON value when the placeholder is the entire
+string ("{{var}}" -> 42 / ["a","b"] / {...}), string interpolation when
+embedded ("user_{{name}}"), and {{#toJson}}var{{/toJson}}.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from .query_dsl import QueryParsingException
+
+_FULL = re.compile(r'^\{\{([\w.]+)\}\}$')
+_EMBED = re.compile(r'\{\{([\w.]+)\}\}')
+_TOJSON = re.compile(r'\{\{#toJson\}\}([\w.]+)\{\{/toJson\}\}')
+
+
+def _lookup(params: dict, path: str):
+    v = params
+    for part in path.split("."):
+        if not isinstance(v, dict) or part not in v:
+            raise QueryParsingException(
+                f"template parameter [{path}] is missing")
+        v = v[part]
+    return v
+
+
+def substitute(obj, params: dict):
+    """Recursively substitute {{var}} placeholders."""
+    if isinstance(obj, str):
+        m = _FULL.match(obj)
+        if m:
+            return _lookup(params, m.group(1))   # typed substitution
+        m = _TOJSON.search(obj)
+        if m:
+            return _lookup(params, m.group(1))
+        return _EMBED.sub(lambda mm: str(_lookup(params, mm.group(1))), obj)
+    if isinstance(obj, dict):
+        return {substitute(k, params) if isinstance(k, str) else k:
+                substitute(v, params) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [substitute(x, params) for x in obj]
+    return obj
+
+
+def render_template(spec: dict, stored: dict | None = None) -> dict:
+    """Resolve a template spec ({"query"/"inline"/"id"/"template", "params"})
+    into a concrete body/query dict."""
+    spec = dict(spec or {})
+    params = spec.pop("params", {}) or {}
+    template = spec.get("inline", spec.get("template"))
+    if template is None and "id" in spec:
+        if not stored or spec["id"] not in stored:
+            raise QueryParsingException(
+                f"search template [{spec.get('id')}] not found")
+        template = stored[spec["id"]]
+    if template is None:
+        # TemplateQueryParser form: the spec body (minus params) IS the
+        # template, e.g. {"query": {...{{var}}...}, "params": {...}}
+        template = spec
+    if isinstance(template, str):
+        rendered = substitute(template, params)
+        if isinstance(rendered, str):
+            try:
+                rendered = json.loads(rendered)
+            except json.JSONDecodeError as e:
+                raise QueryParsingException(
+                    f"template rendered invalid JSON: {e}") from e
+        return rendered
+    out = substitute(template, params)
+    # {"query": {...}} unwraps for the template QUERY context; search
+    # bodies keep their shape (the caller decides which it wanted)
+    return out

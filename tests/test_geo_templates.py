@@ -1,0 +1,139 @@
+"""Geo queries over columnar lat/lon doc values, common terms query, and
+search templates (ref GeoDistanceFilterParser, CommonTermsQueryParser,
+TemplateQueryParser + RestSearchTemplateAction).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+MAPPING = {"_doc": {"properties": {
+    "name": {"type": "text"},
+    "location": {"type": "geo_point"},
+}}}
+
+CITIES = {
+    "berlin": (52.52, 13.405),
+    "potsdam": (52.39, 13.06),        # ~35 km from Berlin
+    "hamburg": (53.55, 9.99),         # ~255 km from Berlin
+    "munich": (48.14, 11.58),         # ~504 km from Berlin
+}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("geo", mappings=MAPPING)
+    for name, (lat, lon) in CITIES.items():
+        n.index_doc("geo", name, {"name": name,
+                                  "location": {"lat": lat, "lon": lon}})
+    n.refresh("geo")
+    yield n
+    n.close()
+
+
+class TestGeo:
+    def test_geo_distance(self, node):
+        out = node.search("geo", {"query": {"bool": {
+            "must": [{"match_all": {}}],
+            "filter": [{"geo_distance": {
+                "distance": "100km",
+                "location": {"lat": 52.52, "lon": 13.405}}}]}}})
+        ids = {h["_id"] for h in out["hits"]["hits"]}
+        assert ids == {"berlin", "potsdam"}
+
+    def test_geo_distance_units(self, node):
+        out = node.search("geo", {"query": {"geo_distance": {
+            "distance": "300km", "location": "52.52,13.405"}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == \
+            {"berlin", "potsdam", "hamburg"}
+
+    def test_geo_bounding_box(self, node):
+        out = node.search("geo", {"query": {"geo_bounding_box": {
+            "location": {"top_left": {"lat": 54.0, "lon": 9.0},
+                         "bottom_right": {"lat": 52.0, "lon": 14.0}}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == \
+            {"berlin", "potsdam", "hamburg"}
+
+    def test_geo_survives_flush_and_merge(self, node, tmp_path):
+        node.flush("geo")
+        node.force_merge("geo")
+        out = node.search("geo", {"query": {"geo_distance": {
+            "distance": "50km", "location": [13.405, 52.52]}}})  # GeoJSON
+        assert {h["_id"] for h in out["hits"]["hits"]} == \
+            {"berlin", "potsdam"}
+
+
+class TestCommonTerms:
+    def test_low_freq_terms_required(self, tmp_path):
+        n = NodeService(data_path=str(tmp_path / "ct"))
+        n.create_index("ct")
+        # "the" in every doc (high freq); "phoenix" rare
+        for i in range(20):
+            n.index_doc("ct", str(i), {"body": f"the common filler {i}"})
+        n.index_doc("ct", "rare", {"body": "the phoenix rises"})
+        n.refresh("ct")
+        out = n.search("ct", {"query": {"common": {"body": {
+            "query": "the phoenix", "cutoff_frequency": 0.5}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["rare"]
+        n.close()
+
+
+class TestSearchTemplates:
+    def test_inline_template_search(self, node):
+        out = node.search("geo", {"query": {"template": {
+            "query": {"match": {"name": "{{city}}"}},
+            "params": {"city": "berlin"}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["berlin"]
+
+    def test_stored_template_via_rest(self, node):
+        import json
+        import urllib.request
+        from elasticsearch_tpu.rest import HttpServer
+        srv = HttpServer(node, port=0).start()
+
+        def req(method, path, body=None):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                data=json.dumps(body).encode() if body else None,
+                method=method)
+            try:
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+
+        try:
+            st, _ = req("PUT", "/_search/template/city_search", {
+                "template": {"query": {"match": {"name": "{{city}}"}},
+                             "size": "{{size}}"}})
+            assert st == 200
+            st, out = req("POST", "/geo/_search/template", {
+                "id": "city_search",
+                "params": {"city": "hamburg", "size": 5}})
+            assert st == 200
+            assert [h["_id"] for h in out["hits"]["hits"]] == ["hamburg"]
+            st, out = req("GET", "/_search/template/city_search")
+            assert st == 200 and out["found"]
+            st, _ = req("DELETE", "/_search/template/city_search")
+            assert st == 200
+            st, _ = req("GET", "/_search/template/city_search")
+            assert st == 404
+        finally:
+            srv.stop()
+
+    def test_typed_parameter_substitution(self):
+        from elasticsearch_tpu.search.templates import render_template
+        out = render_template(
+            {"inline": {"query": {"terms": {"tag": "{{tags}}"}},
+                        "size": "{{n}}"},
+             "params": {"tags": ["a", "b"], "n": 3}})
+        assert out == {"query": {"terms": {"tag": ["a", "b"]}}, "size": 3}
+
+    def test_missing_param_is_400(self):
+        from elasticsearch_tpu.search.query_dsl import QueryParsingException
+        from elasticsearch_tpu.search.templates import render_template
+        with pytest.raises(QueryParsingException):
+            render_template({"inline": {"query": {"match":
+                                                  {"a": "{{nope}}"}}},
+                             "params": {}})

@@ -591,6 +591,167 @@ class ExistsNode(Node):
         return ("exists", self.field_name)
 
 
+def _clause_occurrences(fx, terms: list[str]) -> dict[int, list[int]]:
+    """doc -> sorted positions where ANY of `terms` occurs (a span_or
+    clause's occurrence map), from the segment's occurrence CSR."""
+    occ: dict[int, list[int]] = {}
+    for t in terms:
+        s, ln, _ = fx.lookup(t)
+        for pi in range(s, s + ln):
+            d = int(fx.doc_ids_host[pi])
+            ps = fx.positions[fx.pos_starts[pi]:
+                              fx.pos_starts[pi] + fx.pos_lens[pi]]
+            occ.setdefault(d, []).extend(int(p) for p in ps)
+    for d in occ:
+        occ[d].sort()
+    return occ
+
+
+def _min_span_ordered(pos_lists: list[list[int]]) -> int | None:
+    """Minimal width of an IN-ORDER span taking one position per clause
+    (p_1 < p_2 < ... required), or None. Pointer sweep over sorted lists."""
+    best = None
+    import bisect
+    for p0 in pos_lists[0]:
+        prev = p0
+        ok = True
+        for lst in pos_lists[1:]:
+            i = bisect.bisect_right(lst, prev)
+            if i == len(lst):
+                ok = False
+                break
+            prev = lst[i]
+        if ok:
+            width = prev - p0 + 1
+            best = width if best is None else min(best, width)
+    return best
+
+
+def _min_span_unordered(pos_lists: list[list[int]]) -> int | None:
+    """Minimal window covering one position from every clause — the
+    classic smallest-range-over-k-lists sweep, O(total log k)."""
+    import heapq as hq
+    if any(not lst for lst in pos_lists):
+        return None
+    heap = [(lst[0], li) for li, lst in enumerate(pos_lists)]
+    hq.heapify(heap)
+    cur_max = max(lst[0] for lst in pos_lists)
+    best = cur_max - heap[0][0] + 1
+    idx = [0] * len(pos_lists)
+    while True:
+        _, li = hq.heappop(heap)
+        idx[li] += 1
+        if idx[li] == len(pos_lists[li]):
+            return best
+        nxt = pos_lists[li][idx[li]]
+        cur_max = max(cur_max, nxt)
+        hq.heappush(heap, (nxt, li))
+        best = min(best, cur_max - heap[0][0] + 1)
+
+
+@dataclass
+class SpanNearNode(Node):
+    """span_near over span_term / span_or clauses (ref index/query/
+    SpanNearQueryParser + Lucene NearSpansOrdered/Unordered): a doc matches
+    if one position per clause can be chosen with total window width
+    - n_clauses <= slop, respecting clause order when in_order.
+
+    Position verification is host-side over candidate docs only (span
+    traffic is rare; candidates = docs containing every clause). Scoring is
+    the conjunctive BM25 sum over matching docs — the same documented
+    divergence as PhraseNode (Lucene scores by sloppy frequency).
+    """
+    field_name: str = ""
+    clause_terms: list[list[str]] = dc_field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+    sim: str = "BM25"
+    k1: float = 1.2
+    b: float = 0.75
+
+    def collect_terms(self, out):
+        s = out.setdefault(self.field_name, set())
+        for terms in self.clause_terms:
+            s.update(terms)
+
+    def _span_mask_row(self, ctx: SegmentContext) -> np.ndarray:
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        row = np.zeros(ctx.n_pad, bool)
+        if fx is None or fx.positions is None or not self.clause_terms:
+            return row
+        occs = [_clause_occurrences(fx, terms)
+                for terms in self.clause_terms]
+        cands = set(occs[0])
+        for o in occs[1:]:
+            cands &= set(o)
+        n = len(self.clause_terms)
+        for d in cands:
+            lists = [o[d] for o in occs]
+            width = _min_span_ordered(lists) if self.in_order \
+                else _min_span_unordered(lists)
+            if width is not None and width - n <= self.slop:
+                row[d] = True
+        return row
+
+    def execute(self, ctx):
+        flat = sorted({t for ts in self.clause_terms for t in ts})
+        scorer = MatchNode(field_name=self.field_name,
+                           terms_per_query=[flat] * ctx.Q,
+                           boost=self.boost, sim=self.sim,
+                           k1=self.k1, b=self.b)
+        scores, _ = scorer.execute(ctx)
+        row = self._span_mask_row(ctx)
+        match = jnp.broadcast_to(jnp.asarray(row)[None, :],
+                                 (ctx.Q, ctx.n_pad))
+        return jnp.where(match, scores, 0.0), match
+
+    def match_mask(self, ctx):
+        return self.execute(ctx)[1]
+
+    def plan_key(self):
+        return ("span_near", self.field_name, self.slop, self.in_order)
+
+
+@dataclass
+class SpanFirstNode(Node):
+    """span_first: the clause's span must END within the first `end`
+    positions (ref SpanFirstQueryParser / SpanFirstQuery)."""
+    field_name: str = ""
+    terms: list[str] = dc_field(default_factory=list)
+    end: int = 1
+    sim: str = "BM25"
+    k1: float = 1.2
+    b: float = 0.75
+
+    def collect_terms(self, out):
+        out.setdefault(self.field_name, set()).update(self.terms)
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        fx = seg.text.get(self.field_name)
+        row = np.zeros(ctx.n_pad, bool)
+        if fx is not None and fx.positions is not None:
+            occ = _clause_occurrences(fx, self.terms)
+            for d, ps in occ.items():
+                if ps and ps[0] + 1 <= self.end:
+                    row[d] = True
+        scorer = MatchNode(field_name=self.field_name,
+                           terms_per_query=[sorted(set(self.terms))] * ctx.Q,
+                           boost=self.boost, sim=self.sim,
+                           k1=self.k1, b=self.b)
+        scores, _ = scorer.execute(ctx)
+        match = jnp.broadcast_to(jnp.asarray(row)[None, :],
+                                 (ctx.Q, ctx.n_pad))
+        return jnp.where(match, scores, 0.0), match
+
+    def match_mask(self, ctx):
+        return self.execute(ctx)[1]
+
+    def plan_key(self):
+        return ("span_first", self.field_name, self.end)
+
+
 @dataclass
 class GeoDistanceNode(Node):
     """geo_distance filter: haversine over the field's lat/lon columns

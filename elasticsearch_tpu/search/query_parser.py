@@ -20,7 +20,7 @@ from .query_dsl import (
     BoolNode, BoostingNode, CommonTermsNode, ConstantScoreNode, DisMaxNode,
     ExistsNode, FunctionScoreNode, GeoDistanceNode, IdsNode, MatchAllNode,
     MatchNode, MatchNoneNode, Node, QueryParsingException, RangeNode,
-    TermFilterNode,
+    SpanFirstNode, SpanNearNode, TermFilterNode,
 )
 
 _DISTANCE_UNITS_M = {
@@ -260,6 +260,61 @@ class QueryParser:
             hi = eval_date_math(str(hi)) if hi is not None else None
         return RangeNode(field_name=field, bounds_per_query=[(lo, hi, inc_lo, inc_hi)],
                          is_date=is_date, boost=float(params.get("boost", 1.0)))
+
+    def _span_clause(self, clause: dict) -> tuple[str, list[str]]:
+        """-> (field, OR-terms) from a span_term / span_or clause
+        (ref SpanTermQueryParser, SpanOrQueryParser)."""
+        (kind, spec), = clause.items()
+        if kind == "span_term":
+            (field, params), = spec.items()
+            value = params.get("value") if isinstance(params, dict) \
+                else params
+            return field, [str(value)]
+        if kind == "span_or":
+            fields = set()
+            terms: list[str] = []
+            for sub in spec.get("clauses", []):
+                f, ts = self._span_clause(sub)
+                fields.add(f)
+                terms += ts
+            if len(fields) != 1:
+                raise QueryParsingException(
+                    "span_or clauses must target one field")
+            return fields.pop(), terms
+        raise QueryParsingException(
+            f"unsupported span clause [{kind}] (span_term/span_or only)")
+
+    def _parse_span_term(self, spec: dict) -> Node:
+        field, terms = self._span_clause({"span_term": spec})
+        return SpanNearNode(field_name=field, clause_terms=[terms],
+                            slop=0, **self._sim_kw(field))
+
+    def _parse_span_or(self, spec: dict) -> Node:
+        field, terms = self._span_clause({"span_or": spec})
+        return SpanNearNode(field_name=field, clause_terms=[terms],
+                            slop=0, **self._sim_kw(field))
+
+    def _parse_span_near(self, spec: dict) -> Node:
+        clauses = [self._span_clause(c) for c in spec.get("clauses", [])]
+        if not clauses:
+            raise QueryParsingException("span_near requires clauses")
+        fields = {f for f, _ in clauses}
+        if len(fields) != 1:
+            raise QueryParsingException(
+                "span_near clauses must target one field")
+        field = fields.pop()
+        return SpanNearNode(
+            field_name=field, clause_terms=[ts for _, ts in clauses],
+            slop=int(spec.get("slop", 0)),
+            in_order=bool(spec.get("in_order", True)),
+            boost=float(spec.get("boost", 1.0)), **self._sim_kw(field))
+
+    def _parse_span_first(self, spec: dict) -> Node:
+        field, terms = self._span_clause(spec["match"])
+        return SpanFirstNode(field_name=field, terms=terms,
+                             end=int(spec.get("end", 1)),
+                             boost=float(spec.get("boost", 1.0)),
+                             **self._sim_kw(field))
 
     def _parse_geo_distance(self, spec: dict) -> Node:
         spec = dict(spec)

@@ -1,0 +1,102 @@
+"""Span queries: span_term, span_or, span_near (ordered/unordered/slop),
+span_first — position-verified over the occurrence CSR (ref index/query/
+Span*QueryParser + Lucene NearSpansOrdered/Unordered).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+DOCS = {
+    "exact":      "alpha beta gamma",
+    "gapped":     "alpha filler beta gamma",
+    "reversed":   "beta alpha gamma",
+    "far":        "alpha x x x x x x beta",
+    "alpha_only": "alpha delta",
+    "late":       "intro text alpha beta",
+}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("sp")
+    for did, body in DOCS.items():
+        n.index_doc("sp", did, {"body": body})
+    n.refresh("sp")
+    yield n
+    n.close()
+
+
+def _ids(node, query):
+    out = node.search("sp", {"query": query, "size": 20})
+    return {h["_id"] for h in out["hits"]["hits"]}
+
+
+class TestSpans:
+    def test_span_term(self, node):
+        assert _ids(node, {"span_term": {"body": "delta"}}) == {"alpha_only"}
+
+    def test_span_near_exact_adjacency(self, node):
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 0, "in_order": True}}
+        assert _ids(node, q) == {"exact", "late"}
+
+    def test_span_near_with_slop(self, node):
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 1, "in_order": True}}
+        assert _ids(node, q) == {"exact", "late", "gapped"}
+
+    def test_span_near_in_order_false_matches_reversed(self, node):
+        q_ordered = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 0, "in_order": True}}
+        q_any = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 0, "in_order": False}}
+        assert "reversed" not in _ids(node, q_ordered)
+        assert "reversed" in _ids(node, q_any)
+
+    def test_span_near_large_slop(self, node):
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 10, "in_order": True}}
+        assert _ids(node, q) == {"exact", "late", "gapped", "far"}
+
+    def test_span_or_clause(self, node):
+        q = {"span_near": {"clauses": [
+            {"span_or": {"clauses": [
+                {"span_term": {"body": "alpha"}},
+                {"span_term": {"body": "intro"}}]}},
+            {"span_term": {"body": "gamma"}}],
+            "slop": 1, "in_order": True}}
+        assert "exact" in _ids(node, q)
+        assert "reversed" in _ids(node, q)   # alpha gamma adjacent
+
+    def test_span_first(self, node):
+        # "alpha" within the first position only
+        q = {"span_first": {"match": {"span_term": {"body": "alpha"}},
+                            "end": 1}}
+        assert _ids(node, q) == {"exact", "gapped", "far", "alpha_only"}
+        # end=2: the span must END within the first two positions — beta at
+        # index 1 (span end 2) qualifies, like Lucene's SpanFirstQuery
+        q3 = {"span_first": {"match": {"span_term": {"body": "beta"}},
+                             "end": 2}}
+        assert _ids(node, q3) == {"exact", "reversed"}
+
+    def test_span_survives_merge(self, node):
+        node.index_doc("sp", "extra", {"body": "alpha beta closing"})
+        node.refresh("sp")
+        node.force_merge("sp")
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "beta"}}],
+            "slop": 0, "in_order": True}}
+        assert _ids(node, q) == {"exact", "late", "extra"}

@@ -31,6 +31,11 @@ from .query_dsl import QueryParsingException
 
 SCORE = "_score"
 DOC = "_doc"
+GEO = "_geo_distance"
+
+_UNIT_M = {"m": 1.0, "km": 1000.0, "mi": 1609.344, "yd": 0.9144,
+           "ft": 0.3048, "nmi": 1852.0, "cm": 0.01, "mm": 0.001,
+           "in": 0.0254}
 
 # large-but-finite missing fill: +/-inf is reserved for "not a match"
 _BIG = float(np.finfo(np.float64).max) / 4
@@ -38,11 +43,16 @@ _BIG = float(np.finfo(np.float64).max) / 4
 
 @dataclasses.dataclass(frozen=True)
 class SortSpec:
-    """One sort key (ref search/sort/FieldSortBuilder)."""
-    field: str                 # field path, "_score", or "_doc"
+    """One sort key (ref search/sort/FieldSortBuilder +
+    GeoDistanceSortParser for _geo_distance keys)."""
+    field: str                 # field path, "_score", "_doc", "_geo_distance"
     order: str = "asc"         # "asc" | "desc"
     missing: Any = "_last"     # "_first" | "_last" | numeric literal
     unmapped_ok: bool = False  # ignore_unmapped / unmapped_type given
+    geo_field: str | None = None    # _geo_distance: the geo_point field
+    geo_lat: float = 0.0
+    geo_lon: float = 0.0
+    geo_unit: str = "m"
 
 
 def parse_sort(sort_spec, mappers) -> list[SortSpec] | None:
@@ -71,6 +81,26 @@ def parse_sort(sort_spec, mappers) -> list[SortSpec] | None:
                     f"malformed sort parameters for [{field}]")
         else:
             raise QueryParsingException(f"malformed sort clause: {item!r}")
+        if field == GEO:
+            # {"_geo_distance": {"<field>": <point>, "order", "unit"}}
+            # (ref search/sort/GeoDistanceSortParser)
+            from .query_parser import parse_geo_point
+            params = dict(params)
+            order = params.pop("order", "asc")
+            unit = params.pop("unit", "m")
+            params.pop("distance_type", None)
+            params.pop("mode", None)
+            if len(params) != 1:
+                raise QueryParsingException(
+                    "_geo_distance sort needs exactly one geo field")
+            (gfield, point), = params.items()
+            lat, lon = parse_geo_point(point)
+            if unit not in _UNIT_M:
+                raise QueryParsingException(f"unknown unit [{unit}]")
+            specs.append(SortSpec(field=GEO, order=order,
+                                  geo_field=gfield, geo_lat=lat,
+                                  geo_lon=lon, geo_unit=unit))
+            continue
         order = params.get("order", "desc" if field == SCORE else "asc")
         if order not in ("asc", "desc"):
             raise QueryParsingException(f"illegal sort order [{order}]")
@@ -99,7 +129,7 @@ def _validate(sp: SortSpec, mappers) -> None:
     """mappers: one MapperService or a list of them (multi-index search).
     A field mapped sortable in ANY index is allowed — other indices treat
     it as missing, like the reference. Analyzed text anywhere is a 400."""
-    if sp.field in (SCORE, DOC) or mappers is None:
+    if sp.field in (SCORE, DOC, GEO) or mappers is None:
         return
     svcs = mappers if isinstance(mappers, (list, tuple)) else [mappers]
     fts = [svc.field_type(sp.field) for svc in svcs if svc is not None]
@@ -134,6 +164,8 @@ def _raw_key(seg, sp: SortSpec, scores, Q: int, seg_idx: int = 0,
         # skip docs, so the shard id must be part of the key.
         return (jnp.float64((shard_id << 42) + (seg_idx << 32))
                 + jnp.arange(seg.n_pad, dtype=jnp.float64)), None
+    if sp.field == GEO:
+        return _geo_distance_m(seg, sp)
     nc = seg.numerics.get(sp.field)
     if nc is not None:
         return nc.vals.astype(jnp.float64), nc.missing
@@ -142,6 +174,40 @@ def _raw_key(seg, sp: SortSpec, scores, Q: int, seg_idx: int = 0,
         return kc.ords.astype(jnp.float64), kc.ords < 0
     return (jnp.zeros((seg.n_pad,), jnp.float64),
             jnp.ones((seg.n_pad,), bool))
+
+
+def _geo_distance_m(seg, sp: SortSpec):
+    """(distance-in-meters f64[N], missing bool[N]) for a _geo_distance key
+    — haversine over the <field>.lat/.lon doc-value columns (the same fused
+    expression GeoDistanceNode uses)."""
+    import math
+    la = seg.numerics.get(f"{sp.geo_field}.lat")
+    lo = seg.numerics.get(f"{sp.geo_field}.lon")
+    if la is None or lo is None:
+        return (jnp.zeros((seg.n_pad,), jnp.float64),
+                jnp.ones((seg.n_pad,), bool))
+    lat1 = math.radians(sp.geo_lat)
+    lon1 = math.radians(sp.geo_lon)
+    lat2 = jnp.radians(la.vals.astype(jnp.float64))
+    lon2 = jnp.radians(lo.vals.astype(jnp.float64))
+    a = jnp.sin((lat2 - lat1) / 2) ** 2 \
+        + math.cos(lat1) * jnp.cos(lat2) * jnp.sin((lon2 - lon1) / 2) ** 2
+    dist = 2 * 6371008.8 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
+    return dist, la.missing
+
+
+def _geo_distance_np(seg, sp: SortSpec):
+    """Cached host mirror of _geo_distance_m — materialization touches
+    k hits, not one device round-trip per hit."""
+    cache = getattr(seg, "_geo_dist_cache", None)
+    if cache is None:
+        cache = {}
+        seg._geo_dist_cache = cache
+    key = (sp.geo_field, sp.geo_lat, sp.geo_lon)
+    if key not in cache:
+        dist, miss = _geo_distance_m(seg, sp)
+        cache[key] = (np.asarray(dist), np.asarray(miss))
+    return cache[key]
 
 
 def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int,
@@ -199,6 +265,9 @@ def _encode_cursor(seg, sp: SortSpec, cv) -> float:
     if cv is None:
         c = _BIG if sp.missing == "_last" else -_BIG
         return c  # fills are sign-fixed, not order-negated
+    if sp.field == GEO:
+        c = float(cv) * _UNIT_M[sp.geo_unit]   # cursor is in the sort unit
+        return -c if sp.order == "desc" else c
     if sp.field not in (SCORE, DOC) and sp.field not in seg.numerics \
             and sp.field not in seg.keywords:
         # the segment has no column for this field: every doc's key here is
@@ -242,6 +311,13 @@ def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
             continue
         if sp.field == DOC:
             out.append((shard_id << 42) + int(doc_key))
+            continue
+        if sp.field == GEO:
+            dist, miss = _geo_distance_np(seg, sp)
+            if miss[local]:
+                out.append(None)
+            else:
+                out.append(float(dist[local]) / _UNIT_M[sp.geo_unit])
             continue
         nc = seg.numerics.get(sp.field)
         if nc is not None:

@@ -137,3 +137,32 @@ class TestSearchTemplates:
             render_template({"inline": {"query": {"match":
                                                   {"a": "{{nope}}"}}},
                              "params": {}})
+
+
+class TestGeoDistanceSort:
+    def test_sort_by_distance_with_real_values(self, node):
+        out = node.search("geo", {
+            "query": {"match_all": {}},
+            "sort": [{"_geo_distance": {
+                "location": {"lat": 52.52, "lon": 13.405},
+                "order": "asc", "unit": "km"}}]})
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == ["berlin", "potsdam", "hamburg", "munich"]
+        dists = [h["sort"][0] for h in out["hits"]["hits"]]
+        assert dists[0] == pytest.approx(0.0, abs=1e-6)
+        assert 20 < dists[1] < 50          # Potsdam ~35 km
+        assert 230 < dists[2] < 280        # Hamburg ~255 km
+        assert dists == sorted(dists)
+
+    def test_geo_sort_search_after(self, node):
+        body = {"query": {"match_all": {}}, "size": 2,
+                "sort": [{"_geo_distance": {
+                    "location": {"lat": 52.52, "lon": 13.405},
+                    "order": "asc", "unit": "km"}}]}
+        first = node.search("geo", body)
+        second = node.search("geo", {**body,
+                                     "search_after":
+                                     first["hits"]["hits"][-1]["sort"]})
+        ids = [h["_id"] for h in first["hits"]["hits"]] \
+            + [h["_id"] for h in second["hits"]["hits"]]
+        assert ids == ["berlin", "potsdam", "hamburg", "munich"]

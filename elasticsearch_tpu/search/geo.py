@@ -1,0 +1,104 @@
+"""Shared geo vocabulary: units, point parsing (dict / "lat,lon" / GeoJSON
+array / geohash), and the haversine device expression — used by the
+geo_distance / geo_bounding_box queries AND the _geo_distance sort so the
+two surfaces can never drift (ref common/unit/DistanceUnit.java,
+common/geo/GeoUtils.java, GeoHashUtils.java).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import jax.numpy as jnp
+
+from .query_dsl import QueryParsingException
+
+EARTH_RADIUS_M = 6371008.8    # mean radius (GeoUtils.EARTH_MEAN_RADIUS)
+
+DISTANCE_UNITS_M = {
+    "m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
+    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "yards": 0.9144,
+    "ft": 0.3048, "feet": 0.3048, "nmi": 1852.0, "nm": 1852.0,
+    "nauticalmiles": 1852.0, "cm": 0.01, "centimeters": 0.01,
+    "mm": 0.001, "millimeters": 0.001, "in": 0.0254, "inch": 0.0254,
+}
+
+_GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def unit_meters(unit: str) -> float:
+    if unit not in DISTANCE_UNITS_M:
+        raise QueryParsingException(f"unknown distance unit [{unit}]")
+    return DISTANCE_UNITS_M[unit]
+
+
+def parse_distance(v, default_unit: str = "m") -> float:
+    """"200km" / "1.5 miles" / bare number (in default_unit) -> meters."""
+    if isinstance(v, (int, float)):
+        return float(v) * unit_meters(default_unit)
+    m = re.match(r"^\s*([\d.]+)\s*([a-zA-Z]*)\s*$", str(v))
+    if not m:
+        raise QueryParsingException(f"failed to parse distance [{v}]")
+    return float(m.group(1)) * unit_meters(m.group(2) or default_unit)
+
+
+def decode_geohash(h: str) -> tuple[float, float]:
+    """geohash -> (lat, lon) of the cell center (GeoHashUtils.decode)."""
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    even = True
+    for ch in h.lower():
+        cd = _GEOHASH32.find(ch)
+        if cd < 0:
+            raise QueryParsingException(f"invalid geohash [{h}]")
+        for bit in (16, 8, 4, 2, 1):
+            if even:
+                mid = (lon_lo + lon_hi) / 2
+                if cd & bit:
+                    lon_lo = mid
+                else:
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if cd & bit:
+                    lat_lo = mid
+                else:
+                    lat_hi = mid
+            even = not even
+    return (lat_lo + lat_hi) / 2, (lon_lo + lon_hi) / 2
+
+
+def parse_geo_point(v) -> tuple[float, float]:
+    """(lat, lon) from {lat,lon} / "lat,lon" / geohash string /
+    [lon, lat] GeoJSON array (ref GeoUtils.parseGeoPoint)."""
+    try:
+        if isinstance(v, dict):
+            if "geohash" in v:
+                return decode_geohash(str(v["geohash"]))
+            return float(v["lat"]), float(v["lon"])
+        if isinstance(v, str):
+            if "," in v:
+                lat, lon = v.split(",")
+                return float(lat), float(lon)
+            return decode_geohash(v)
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            return float(v[1]), float(v[0])
+    except QueryParsingException:
+        raise
+    except Exception as e:  # noqa: BLE001 — malformed input is a 400
+        raise QueryParsingException(
+            f"failed to parse geo point [{v}]: {e}") from e
+    raise QueryParsingException(f"failed to parse geo point [{v!r}]")
+
+
+def haversine_m(lat: float, lon: float, lat_col, lon_col):
+    """Distance in meters from a fixed point to every doc — ONE fused
+    device expression over the lat/lon doc-value columns."""
+    lat1 = math.radians(lat)
+    lon1 = math.radians(lon)
+    lat2 = jnp.radians(lat_col.astype(jnp.float64))
+    lon2 = jnp.radians(lon_col.astype(jnp.float64))
+    a = jnp.sin((lat2 - lat1) / 2) ** 2 \
+        + math.cos(lat1) * jnp.cos(lat2) * jnp.sin((lon2 - lon1) / 2) ** 2
+    return 2 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))

@@ -591,6 +591,21 @@ class ExistsNode(Node):
         return ("exists", self.field_name)
 
 
+def resolve_msm(msm, n_clauses: int) -> int:
+    """minimum_should_match spec (int / "2" / "75%" / "-25%") -> count
+    (ref common/lucene/search/Queries.calculateMinShouldMatch)."""
+    if msm is None:
+        return 0
+    s = str(msm)
+    if s.endswith("%"):
+        pct = float(s[:-1])
+        if pct < 0:
+            return max(n_clauses - int(n_clauses * -pct / 100.0), 0)
+        return int(n_clauses * pct / 100.0)
+    v = int(s)
+    return v if v >= 0 else max(n_clauses + v, 0)
+
+
 def _clause_occurrences(fx, terms: list[str]) -> dict[int, list[int]]:
     """doc -> sorted positions where ANY of `terms` occurs (a span_or
     clause's occurrence map), from the segment's occurrence CSR."""
@@ -763,23 +778,14 @@ class GeoDistanceNode(Node):
     lon: float = 0.0
     distance_m: float = 0.0
 
-    _EARTH_R = 6371008.8    # mean earth radius in meters (GeoUtils)
-
     def execute(self, ctx):
+        from .geo import haversine_m
         seg = ctx.segment
         la = seg.numerics.get(self.field_name + ".lat")
         lo = seg.numerics.get(self.field_name + ".lon")
         if la is None or lo is None:
             return _zeros(ctx), _false(ctx)
-        lat1 = math.radians(self.lat)
-        lon1 = math.radians(self.lon)
-        lat2 = jnp.radians(la.vals.astype(jnp.float64))
-        lon2 = jnp.radians(lo.vals.astype(jnp.float64))
-        dlat = lat2 - lat1
-        dlon = lon2 - lon1
-        a = jnp.sin(dlat / 2) ** 2 \
-            + math.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
-        dist = 2 * self._EARTH_R * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
+        dist = haversine_m(self.lat, self.lon, la.vals, lo.vals)
         ok = (dist <= self.distance_m) & ~la.missing
         match = jnp.broadcast_to(ok[None, :], (ctx.Q, ctx.n_pad))
         return jnp.where(match, jnp.float32(self.boost), 0.0), match
@@ -799,8 +805,8 @@ class CommonTermsNode(Node):
     cutoff_frequency: float = 0.01
     low_freq_operator: str = "or"
     high_freq_operator: str = "or"
-    minimum_should_match: int = 0
-    sim: str = "BM25"
+    minimum_should_match: Any = 0    # raw spec: int or "50%" — resolved
+    sim: str = "BM25"                # against the LOW-FREQ group size
     k1: float = 1.2
     b: float = 0.75
 
@@ -824,9 +830,11 @@ class CommonTermsNode(Node):
         scores, any_match = scorer.execute(ctx)
         req = low if low else high
         op = self.low_freq_operator if low else self.high_freq_operator
+        # minimum_should_match applies to the REQUIRED (low-freq) group,
+        # not the total term count (ref CommonTermsQuery low-freq msm)
+        msm = resolve_msm(self.minimum_should_match, len(req))
         gate = MatchNode(terms_per_query=[req], operator=op,
-                         minimum_should_match=self.minimum_should_match,
-                         **kw)
+                         minimum_should_match=msm, **kw)
         match = gate.match_mask(ctx)
         return jnp.where(match, scores, 0.0), match
 

@@ -23,38 +23,9 @@ from .query_dsl import (
     SpanFirstNode, SpanNearNode, TermFilterNode,
 )
 
-_DISTANCE_UNITS_M = {
-    "m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
-    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
-    "nmi": 1852.0, "nm": 1852.0, "cm": 0.01, "mm": 0.001, "in": 0.0254,
-}
-
-
-def parse_distance(v) -> float:
-    """"200km" / "1.5mi" / bare meters -> meters
-    (ref common/unit/DistanceUnit.java)."""
-    if isinstance(v, (int, float)):
-        return float(v)
-    m = re.match(r"^\s*([\d.]+)\s*([a-zA-Z]*)\s*$", str(v))
-    if not m:
-        raise QueryParsingException(f"failed to parse distance [{v}]")
-    unit = m.group(2) or "m"
-    if unit not in _DISTANCE_UNITS_M:
-        raise QueryParsingException(f"unknown distance unit [{unit}]")
-    return float(m.group(1)) * _DISTANCE_UNITS_M[unit]
-
-
-def parse_geo_point(v) -> tuple[float, float]:
-    """(lat, lon) from {lat,lon} / "lat,lon" / [lon,lat] GeoJSON
-    (ref common/geo/GeoUtils.parseGeoPoint)."""
-    if isinstance(v, dict):
-        return float(v["lat"]), float(v["lon"])
-    if isinstance(v, str):
-        lat, lon = v.split(",")
-        return float(lat), float(lon)
-    if isinstance(v, (list, tuple)) and len(v) == 2:
-        return float(v[1]), float(v[0])
-    raise QueryParsingException(f"failed to parse geo point [{v}]")
+# shared geo vocabulary lives in search/geo.py (re-exported here for the
+# sort module and external callers)
+from .geo import parse_distance, parse_geo_point  # noqa: E402,F401
 
 _DATE_MATH_RE = re.compile(
     r"^now(?P<ops>([+-]\d+[yMwdhHms])*)(?:/(?P<round>[yMwdhHms]))?$")
@@ -317,21 +288,29 @@ class QueryParser:
                              **self._sim_kw(field))
 
     def _parse_geo_distance(self, spec: dict) -> Node:
-        spec = dict(spec)
-        distance = parse_distance(spec.pop("distance"))
-        spec.pop("distance_type", None)
-        spec.pop("optimize_bbox", None)
+        spec = {k: v for k, v in spec.items()
+                if k not in ("distance_type", "optimize_bbox", "_name",
+                             "coerce", "ignore_malformed",
+                             "validation_method")}
+        unit = spec.pop("unit", "m")
+        distance = parse_distance(spec.pop("distance"), default_unit=unit)
+        if len(spec) != 1:
+            raise QueryParsingException(
+                f"geo_distance needs exactly one geo field, got "
+                f"{sorted(spec)}")
         (field, point), = spec.items()
         lat, lon = parse_geo_point(point)
         return GeoDistanceNode(field_name=field, lat=lat, lon=lon,
                                distance_m=distance)
 
     def _parse_geo_bounding_box(self, spec: dict) -> Node:
-        """Rewritten to two columnar range filters over the stored
+        """Rewritten to columnar range filters over the stored
         <field>.lat / <field>.lon doc values (ref index/query/
-        GeoBoundingBoxFilterParser — 'indexed' execution mode)."""
+        GeoBoundingBoxFilterParser — 'indexed' execution mode). Boxes
+        crossing the antimeridian split into two longitude ranges."""
         spec = {k: v for k, v in spec.items()
-                if k not in ("type", "coerce", "ignore_malformed")}
+                if k not in ("type", "coerce", "ignore_malformed", "_name",
+                             "validation_method")}
         (field, box), = spec.items()
         if "top_left" in box:
             top, left = parse_geo_point(box["top_left"])
@@ -339,12 +318,21 @@ class QueryParser:
         else:
             top, bottom = float(box["top"]), float(box["bottom"])
             left, right = float(box["left"]), float(box["right"])
-        return BoolNode(filter=[
-            RangeNode(field_name=field + ".lat",
-                      bounds_per_query=[(bottom, top, True, True)]),
-            RangeNode(field_name=field + ".lon",
-                      bounds_per_query=[(left, right, True, True)]),
-        ])
+        lat_rng = RangeNode(field_name=field + ".lat",
+                            bounds_per_query=[(bottom, top, True, True)])
+        if left <= right:
+            lon_node: Node = RangeNode(
+                field_name=field + ".lon",
+                bounds_per_query=[(left, right, True, True)])
+        else:
+            # dateline crossing: lon in [left, 180] OR [-180, right]
+            lon_node = BoolNode(should=[
+                RangeNode(field_name=field + ".lon",
+                          bounds_per_query=[(left, 180.0, True, True)]),
+                RangeNode(field_name=field + ".lon",
+                          bounds_per_query=[(-180.0, right, True, True)]),
+            ])
+        return BoolNode(filter=[lat_rng, lon_node])
 
     def _parse_common(self, spec: dict) -> Node:
         (field, params), = spec.items()
@@ -363,7 +351,7 @@ class QueryParser:
                                              "or")).lower(),
             high_freq_operator=str(params.get("high_freq_operator",
                                               "or")).lower(),
-            minimum_should_match=_parse_msm(msm, len(terms)),
+            minimum_should_match=msm,   # resolved vs the low-freq group
             boost=float(params.get("boost", 1.0)),
             **self._sim_kw(field))
 

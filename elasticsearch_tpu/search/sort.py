@@ -33,10 +33,6 @@ SCORE = "_score"
 DOC = "_doc"
 GEO = "_geo_distance"
 
-_UNIT_M = {"m": 1.0, "km": 1000.0, "mi": 1609.344, "yd": 0.9144,
-           "ft": 0.3048, "nmi": 1852.0, "cm": 0.01, "mm": 0.001,
-           "in": 0.0254}
-
 # large-but-finite missing fill: +/-inf is reserved for "not a match"
 _BIG = float(np.finfo(np.float64).max) / 4
 
@@ -84,10 +80,11 @@ def parse_sort(sort_spec, mappers) -> list[SortSpec] | None:
         if field == GEO:
             # {"_geo_distance": {"<field>": <point>, "order", "unit"}}
             # (ref search/sort/GeoDistanceSortParser)
-            from .query_parser import parse_geo_point
+            from .geo import parse_geo_point, unit_meters
             params = dict(params)
             order = params.pop("order", "asc")
             unit = params.pop("unit", "m")
+            unit_meters(unit)    # validate (accepts long forms too)
             params.pop("distance_type", None)
             params.pop("mode", None)
             if len(params) != 1:
@@ -95,8 +92,6 @@ def parse_sort(sort_spec, mappers) -> list[SortSpec] | None:
                     "_geo_distance sort needs exactly one geo field")
             (gfield, point), = params.items()
             lat, lon = parse_geo_point(point)
-            if unit not in _UNIT_M:
-                raise QueryParsingException(f"unknown unit [{unit}]")
             specs.append(SortSpec(field=GEO, order=order,
                                   geo_field=gfield, geo_lat=lat,
                                   geo_lon=lon, geo_unit=unit))
@@ -179,32 +174,32 @@ def _raw_key(seg, sp: SortSpec, scores, Q: int, seg_idx: int = 0,
 def _geo_distance_m(seg, sp: SortSpec):
     """(distance-in-meters f64[N], missing bool[N]) for a _geo_distance key
     — haversine over the <field>.lat/.lon doc-value columns (the same fused
-    expression GeoDistanceNode uses)."""
-    import math
+    expression GeoDistanceNode uses, via the shared geo helper)."""
+    from .geo import haversine_m
     la = seg.numerics.get(f"{sp.geo_field}.lat")
     lo = seg.numerics.get(f"{sp.geo_field}.lon")
     if la is None or lo is None:
         return (jnp.zeros((seg.n_pad,), jnp.float64),
                 jnp.ones((seg.n_pad,), bool))
-    lat1 = math.radians(sp.geo_lat)
-    lon1 = math.radians(sp.geo_lon)
-    lat2 = jnp.radians(la.vals.astype(jnp.float64))
-    lon2 = jnp.radians(lo.vals.astype(jnp.float64))
-    a = jnp.sin((lat2 - lat1) / 2) ** 2 \
-        + math.cos(lat1) * jnp.cos(lat2) * jnp.sin((lon2 - lon1) / 2) ** 2
-    dist = 2 * 6371008.8 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
-    return dist, la.missing
+    return haversine_m(sp.geo_lat, sp.geo_lon, la.vals, lo.vals), la.missing
+
+
+_GEO_CACHE_MAX = 4    # per segment: per-request origins must not pile up
 
 
 def _geo_distance_np(seg, sp: SortSpec):
-    """Cached host mirror of _geo_distance_m — materialization touches
-    k hits, not one device round-trip per hit."""
+    """Bounded cached host mirror of _geo_distance_m — materialization
+    touches k hits, not one device round-trip per hit. The cache holds at
+    most _GEO_CACHE_MAX origins (FIFO): a different-origin-per-request
+    workload would otherwise grow n_pad*9 bytes per origin, unaccounted."""
     cache = getattr(seg, "_geo_dist_cache", None)
     if cache is None:
         cache = {}
         seg._geo_dist_cache = cache
     key = (sp.geo_field, sp.geo_lat, sp.geo_lon)
     if key not in cache:
+        if len(cache) >= _GEO_CACHE_MAX:
+            cache.pop(next(iter(cache)))
         dist, miss = _geo_distance_m(seg, sp)
         cache[key] = (np.asarray(dist), np.asarray(miss))
     return cache[key]
@@ -266,7 +261,8 @@ def _encode_cursor(seg, sp: SortSpec, cv) -> float:
         c = _BIG if sp.missing == "_last" else -_BIG
         return c  # fills are sign-fixed, not order-negated
     if sp.field == GEO:
-        c = float(cv) * _UNIT_M[sp.geo_unit]   # cursor is in the sort unit
+        from .geo import unit_meters
+        c = float(cv) * unit_meters(sp.geo_unit)  # cursor is in sort units
         return -c if sp.order == "desc" else c
     if sp.field not in (SCORE, DOC) and sp.field not in seg.numerics \
             and sp.field not in seg.keywords:
@@ -317,7 +313,8 @@ def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
             if miss[local]:
                 out.append(None)
             else:
-                out.append(float(dist[local]) / _UNIT_M[sp.geo_unit])
+                from .geo import unit_meters
+                out.append(float(dist[local]) / unit_meters(sp.geo_unit))
             continue
         nc = seg.numerics.get(sp.field)
         if nc is not None:

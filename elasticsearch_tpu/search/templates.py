@@ -18,6 +18,7 @@ from .query_dsl import QueryParsingException
 _FULL = re.compile(r'^\{\{([\w.]+)\}\}$')
 _EMBED = re.compile(r'\{\{([\w.]+)\}\}')
 _TOJSON = re.compile(r'\{\{#toJson\}\}([\w.]+)\{\{/toJson\}\}')
+_FULL_TOJSON = re.compile(r'^\{\{#toJson\}\}([\w.]+)\{\{/toJson\}\}$')
 
 
 def _lookup(params: dict, path: str):
@@ -33,13 +34,15 @@ def _lookup(params: dict, path: str):
 def substitute(obj, params: dict):
     """Recursively substitute {{var}} placeholders."""
     if isinstance(obj, str):
-        m = _FULL.match(obj)
+        m = _FULL.match(obj) or _FULL_TOJSON.match(obj)
         if m:
             return _lookup(params, m.group(1))   # typed substitution
-        m = _TOJSON.search(obj)
-        if m:
-            return _lookup(params, m.group(1))
-        return _EMBED.sub(lambda mm: str(_lookup(params, mm.group(1))), obj)
+        # embedded placeholders: toJson renders as JSON, {{var}} as text —
+        # the surrounding string is PRESERVED (a whole-string replace here
+        # turned '{"ids": {{#toJson}}ids{{/toJson}}}' into a bare list)
+        out = _TOJSON.sub(
+            lambda mm: json.dumps(_lookup(params, mm.group(1))), obj)
+        return _EMBED.sub(lambda mm: str(_lookup(params, mm.group(1))), out)
     if isinstance(obj, dict):
         return {substitute(k, params) if isinstance(k, str) else k:
                 substitute(v, params) for k, v in obj.items()}

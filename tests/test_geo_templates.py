@@ -166,3 +166,69 @@ class TestGeoDistanceSort:
         ids = [h["_id"] for h in first["hits"]["hits"]] \
             + [h["_id"] for h in second["hits"]["hits"]]
         assert ids == ["berlin", "potsdam", "hamburg", "munich"]
+
+
+class TestReviewRegressions4:
+    """Round-4 final code-review findings."""
+
+    def test_embedded_tojson_preserves_surroundings(self):
+        from elasticsearch_tpu.search.templates import render_template
+        import json
+        out = render_template({
+            "inline": '{"query": {"terms": {"id": '
+                      '{{#toJson}}ids{{/toJson}} }}}',
+            "params": {"ids": [1, 2, 3]}})
+        assert out == {"query": {"terms": {"id": [1, 2, 3]}}}
+
+    def test_geo_distance_with_unit_param(self, node):
+        out = node.search("geo", {"query": {"geo_distance": {
+            "distance": 100, "unit": "km",
+            "location": {"lat": 52.52, "lon": 13.405}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == \
+            {"berlin", "potsdam"}
+
+    def test_geohash_point_form(self, node):
+        # u33 is the geohash cell around Berlin
+        out = node.search("geo", {"query": {"geo_distance": {
+            "distance": "150km", "location": "u33db"}}})
+        assert "berlin" in {h["_id"] for h in out["hits"]["hits"]}
+
+    def test_bounding_box_across_dateline(self, tmp_path):
+        n = NodeService(data_path=str(tmp_path / "dl"))
+        n.create_index("dl", mappings=MAPPING)
+        n.index_doc("dl", "fiji", {"location": {"lat": -17.7, "lon": 178.0}})
+        n.index_doc("dl", "samoa", {"location": {"lat": -13.8,
+                                                 "lon": -171.7}})
+        n.index_doc("dl", "berlin", {"location": {"lat": 52.5,
+                                                  "lon": 13.4}})
+        n.refresh("dl")
+        out = n.search("dl", {"query": {"geo_bounding_box": {
+            "location": {"top_left": {"lat": 0.0, "lon": 170.0},
+                         "bottom_right": {"lat": -30.0, "lon": -160.0}}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"fiji", "samoa"}
+        n.close()
+
+    def test_common_terms_msm_applies_to_low_freq_group(self, tmp_path):
+        n = NodeService(data_path=str(tmp_path / "msm"))
+        n.create_index("msm")
+        for i in range(20):
+            n.index_doc("msm", str(i), {"body": f"the filler {i}"})
+        n.index_doc("msm", "both", {"body": "the phoenix rises"})
+        n.index_doc("msm", "one", {"body": "the phoenix sleeps"})
+        n.refresh("msm")
+        # 'the' is high-freq; low group = [phoenix, rises]; 100% of the
+        # LOW group (2 terms) — resolving vs all 3 terms made this
+        # unsatisfiable
+        out = n.search("msm", {"query": {"common": {"body": {
+            "query": "the phoenix rises", "cutoff_frequency": 0.5,
+            "minimum_should_match": "100%"}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["both"]
+        n.close()
+
+    def test_long_unit_names_in_geo_sort(self, node):
+        out = node.search("geo", {
+            "query": {"match_all": {}},
+            "sort": [{"_geo_distance": {
+                "location": {"lat": 52.52, "lon": 13.405},
+                "unit": "kilometers"}}]})
+        assert out["hits"]["hits"][0]["_id"] == "berlin"

@@ -331,6 +331,17 @@ class NodeService:
                scroll: str | None = None, scan: bool = False) -> dict:
         t0 = time.perf_counter()
         body = body or {}
+        if "template" in body and "query" not in body:
+            # body-level search template (ref RestSearchTemplateAction when
+            # the template arrives inside a plain _search body)
+            from .search.templates import render_template
+            rendered = render_template(body["template"],
+                                       self.search_templates)
+            if isinstance(rendered, str):
+                import json as _json
+                rendered = _json.loads(rendered)
+            body = {**{k: v for k, v in body.items() if k != "template"},
+                    **rendered}
         size = int(body.get("size", 10) if size is None else size)
         from_ = int(body.get("from", 0) if from_ is None else from_)
         if scroll is not None:

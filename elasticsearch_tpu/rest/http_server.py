@@ -289,9 +289,13 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     # -- search templates (ref RestSearchTemplateAction + script store) ----
     def put_search_template(g, p, b):
         body = _json_body(b)
+        created = g["id"] not in node.search_templates
         node.search_templates[g["id"]] = body.get("template", body)
         node._persist_search_templates()
-        return 200, {"_id": g["id"], "created": True, "acknowledged": True}
+        # templates live in the .scripts system index in the reference
+        return (201 if created else 200), {
+            "_index": ".scripts", "_type": "mustache", "_id": g["id"],
+            "_version": 1, "created": created, "acknowledged": True}
     c.register("PUT", "/_search/template/{id}", put_search_template)
     c.register("POST", "/_search/template/{id}", put_search_template)
 
@@ -299,8 +303,12 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         tpl = node.search_templates.get(g["id"])
         if tpl is None:
             return 404, {"_id": g["id"], "found": False}
-        return 200, {"_id": g["id"], "found": True, "lang": "mustache",
-                     "template": tpl}
+        # the reference stores templates as COMPACT script strings
+        rendered = tpl if isinstance(tpl, str) \
+            else json.dumps(tpl, separators=(",", ":"))
+        return 200, {"_index": ".scripts", "_type": "mustache",
+                     "_id": g["id"], "found": True, "lang": "mustache",
+                     "template": rendered}
     c.register("GET", "/_search/template/{id}", get_search_template)
 
     def delete_search_template(g, p, b):
@@ -485,8 +493,20 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             filters = filters.split(",") if filters else []
         elif isinstance(filters, str):
             filters = filters.split(",")
+        field = body.get("field", p.get("field", [None])[0])
         if tokenizer:
             analyzer_obj = an.custom(tokenizer, filters)
+        elif field and svc is not None \
+                and "analyzer" not in body and "analyzer" not in p:
+            # field form: analyze with THAT field's analyzer — keyword /
+            # not_analyzed fields preserve the raw token
+            ft = svc.mappers.field_type(field)
+            if ft is not None and ft.type == "keyword":
+                analyzer_obj = an.analyzer("keyword")
+            elif ft is not None:
+                analyzer_obj = an.analyzer(ft.analyzer)
+            else:
+                analyzer_obj = an.analyzer("standard")
         else:
             name = body.get("analyzer", p.get("analyzer", ["standard"])[0])
             analyzer_obj = an.analyzer(name)

@@ -360,11 +360,17 @@ class QueryParser:
     def _parse_template(self, spec: dict) -> Node:
         """template query: render the mustache-lite template then parse the
         result (ref index/query/TemplateQueryParser)."""
+        import json as _json
+
         from .templates import render_template
         rendered = render_template(spec, getattr(self.mappers,
                                                  "search_templates", None))
         if isinstance(rendered, dict) and list(rendered) == ["query"]:
             rendered = rendered["query"]
+        if isinstance(rendered, str):
+            # the template body may itself be a JSON string
+            # (TemplateQueryParser's string form)
+            rendered = _json.loads(rendered)
         return self.parse(rendered)
 
     def _parse_exists(self, spec: dict) -> Node:

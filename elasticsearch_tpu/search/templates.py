@@ -31,18 +31,28 @@ def _lookup(params: dict, path: str):
     return v
 
 
+def _escaped(v) -> str:
+    """Embedded-substitution rendering: strings JSON-escape their quotes /
+    backslashes (the reference's mustache uses a JSON escaper, so
+    '{"q": "{{v}}"}' stays valid JSON when v contains quotes)."""
+    if isinstance(v, str):
+        return json.dumps(v)[1:-1]
+    return str(v)
+
+
 def substitute(obj, params: dict):
     """Recursively substitute {{var}} placeholders."""
     if isinstance(obj, str):
         m = _FULL.match(obj) or _FULL_TOJSON.match(obj)
         if m:
             return _lookup(params, m.group(1))   # typed substitution
-        # embedded placeholders: toJson renders as JSON, {{var}} as text —
-        # the surrounding string is PRESERVED (a whole-string replace here
-        # turned '{"ids": {{#toJson}}ids{{/toJson}}}' into a bare list)
+        # embedded placeholders: toJson renders as JSON, {{var}} as escaped
+        # text — the surrounding string is PRESERVED (a whole-string replace
+        # here turned '{"ids": {{#toJson}}ids{{/toJson}}}' into a bare list)
         out = _TOJSON.sub(
             lambda mm: json.dumps(_lookup(params, mm.group(1))), obj)
-        return _EMBED.sub(lambda mm: str(_lookup(params, mm.group(1))), out)
+        return _EMBED.sub(lambda mm: _escaped(_lookup(params, mm.group(1))),
+                          out)
     if isinstance(obj, dict):
         return {substitute(k, params) if isinstance(k, str) else k:
                 substitute(v, params) for k, v in obj.items()}
@@ -57,10 +67,20 @@ def render_template(spec: dict, stored: dict | None = None) -> dict:
     spec = dict(spec or {})
     params = spec.pop("params", {}) or {}
     template = spec.get("inline", spec.get("template"))
+    if isinstance(template, dict) and set(template) <= {"id", "params"}:
+        # {"template": {"id": "x"}} indirection (params may ride inside)
+        params = {**(template.get("params") or {}), **params}
+        spec = {"id": template["id"]}
+        template = None
+    if isinstance(template, str) and not template.lstrip().startswith("{"):
+        # a bare name refers to a stored template
+        spec = {"id": template}
+        template = None
     if template is None and "id" in spec:
         if not stored or spec["id"] not in stored:
             raise QueryParsingException(
-                f"search template [{spec.get('id')}] not found")
+                "ElasticsearchIllegalArgumentException[Unable to find on "
+                f"disk script {spec.get('id')}]")
         template = stored[spec["id"]]
     if template is None:
         # TemplateQueryParser form: the spec body (minus params) IS the

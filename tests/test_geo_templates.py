@@ -107,7 +107,7 @@ class TestSearchTemplates:
             st, _ = req("PUT", "/_search/template/city_search", {
                 "template": {"query": {"match": {"name": "{{city}}"}},
                              "size": "{{size}}"}})
-            assert st == 200
+            assert st == 201   # created in the .scripts store
             st, out = req("POST", "/geo/_search/template", {
                 "id": "city_search",
                 "params": {"city": "hamburg", "size": 5}})

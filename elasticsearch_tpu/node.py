@@ -47,6 +47,15 @@ class InvalidIndexNameException(Exception):
     pass
 
 
+class IndexClosedException(Exception):
+    """Operations on a closed index are blocked (ref ClusterBlockException
+    for INDEX_CLOSED_BLOCK; HTTP 403)."""
+
+    def __init__(self, index: str):
+        super().__init__(f"blocked by: [FORBIDDEN/4/index closed] [{index}]")
+        self.index = index
+
+
 # invalid characters, not an allowlist: unicode index names are legal
 # (ref MetaDataCreateIndexService.validateIndexName)
 _INDEX_BAD_CHARS = set(' "*\\<>|,/?#')
@@ -83,6 +92,7 @@ class NodeService:
         from .common.breaker import CircuitBreakerService
         self.breakers = CircuitBreakerService(self.settings)
         self.indices: dict[str, IndexService] = {}
+        self.closed: dict[str, dict] = {}     # closed index -> metadata
         self.templates: dict[str, dict] = {}
         # scroll contexts: id -> (index expr, body, cursor, expiry)
         # (ref SearchService keep-alive reaper, SearchService.java:132,166);
@@ -115,7 +125,8 @@ class NodeService:
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
 
     def _recover_indices(self) -> None:
-        """Reopen on-disk indices (gateway recovery, SURVEY.md §5.4(b))."""
+        """Reopen on-disk indices (gateway recovery, SURVEY.md §5.4(b));
+        closed indices register metadata-only (no engines)."""
         import json
         for name in sorted(os.listdir(self.data_path)):
             meta_path = os.path.join(self.data_path, name, "_meta.json")
@@ -123,6 +134,9 @@ class NodeService:
                 continue
             with open(meta_path) as f:
                 meta = json.load(f)
+            if meta.get("state") == "close":
+                self.closed[name] = meta
+                continue
             self.indices[name] = IndexService(
                 name, os.path.join(self.data_path, name),
                 Settings(meta.get("settings", {})), meta.get("mappings", {}),
@@ -143,7 +157,7 @@ class NodeService:
     def create_index(self, name: str, settings: dict | None = None,
                      mappings: dict | None = None,
                      aliases: dict | None = None) -> IndexService:
-        if name in self.indices:
+        if name in self.indices or name in self.closed:
             raise IndexAlreadyExistsException(name)
         if not _VALID_INDEX.match(name) or name != name.lower():
             raise InvalidIndexNameException(f"invalid index name [{name}]")
@@ -169,13 +183,71 @@ class NodeService:
         return svc
 
     def delete_index(self, name: str) -> None:
+        import shutil
+        deleted_closed = False
+        for n in list(self.closed):
+            if n == name or fnmatch.fnmatch(n, name) \
+                    or name in ("_all", "*", ""):
+                self.closed.pop(n)
+                shutil.rmtree(os.path.join(self.data_path, n),
+                              ignore_errors=True)
+                deleted_closed = True
+        if deleted_closed and name not in self.indices \
+                and "*" not in name and name not in ("_all", ""):
+            return     # the exact name was a closed index: done
         for n in self._resolve(name):
             svc = self.indices.pop(n)
             svc.close()
             svc.delete_files()
 
+    def close_index(self, expr: str) -> list[str]:
+        """Close indices: engines shut down, device memory released, data
+        retained; reads/writes are blocked until reopened
+        (ref MetaDataIndexStateService.closeIndex)."""
+        names = self._resolve(expr)
+        for n in names:
+            svc = self.indices.pop(n)
+            meta = {"settings": dict(svc.settings),
+                    "mappings": svc.mappings_dict(),
+                    "aliases": sorted(svc.aliases), "state": "close"}
+            svc.flush()
+            svc.close()
+            self.closed[n] = meta
+            self._persist_meta_dict(n, meta)
+        return names
+
+    def open_index(self, expr: str) -> list[str]:
+        """Reopen closed indices (ref MetaDataIndexStateService.openIndex)."""
+        names = [n for n in self.closed
+                 if n == expr or fnmatch.fnmatch(n, expr)
+                 or expr in ("_all", "*", "")]
+        if not names and "*" not in expr and expr not in self.indices:
+            raise IndexMissingException(expr)
+        for n in names:
+            meta = self.closed.pop(n)
+            meta = {**meta, "state": "open"}
+            svc = IndexService(n, os.path.join(self.data_path, n),
+                               Settings(meta.get("settings", {})),
+                               meta.get("mappings", {}),
+                               breakers=self.breakers)
+            svc.aliases = set(meta.get("aliases", []))
+            svc.mappers.search_templates = self.search_templates
+            self.indices[n] = svc
+            self._persist_meta_dict(n, meta)
+        return names
+
+    def _persist_meta_dict(self, name: str, meta: dict) -> None:
+        import json
+        path = os.path.join(self.data_path, name, "_meta.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
     def _resolve(self, expr: str) -> list[str]:
-        """Index expression: name, alias, comma list, wildcards, _all."""
+        """Index expression: name, alias, comma list, wildcards, _all.
+        Wildcards expand to OPEN indices only (expand_wildcards=open, the
+        reference default); naming a closed index directly is a 403."""
         if expr in ("_all", "*", ""):
             return list(self.indices)
         out: list[str] = []
@@ -183,6 +255,8 @@ class NodeService:
             if part in self.indices:
                 out.append(part)
                 continue
+            if part in self.closed:
+                raise IndexClosedException(part)
             matched = [n for n, svc in self.indices.items()
                        if part in svc.aliases or fnmatch.fnmatch(n, part)]
             if not matched and "*" not in part:
@@ -204,6 +278,8 @@ class NodeService:
         """ref TransportIndexAction.java:63 — auto-creates the index like
         the reference's create-index-on-first-doc behavior."""
         if index not in self.indices:
+            if index in self.closed:
+                raise IndexClosedException(index)
             if not auto_create:
                 raise IndexMissingException(index)
             if not _VALID_INDEX.match(index):

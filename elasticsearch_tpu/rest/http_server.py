@@ -22,8 +22,9 @@ from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
 from ..index.engine import VersionConflictException, DocumentMissingException
-from ..node import (IndexAlreadyExistsException, IndexMissingException,
-                    InvalidIndexNameException, NodeService)
+from ..node import (IndexAlreadyExistsException, IndexClosedException,
+                    IndexMissingException, InvalidIndexNameException,
+                    NodeService)
 from ..search.aggs import AggregationParsingException
 from ..search.query_dsl import QueryParsingException
 
@@ -46,6 +47,8 @@ def _status_of(e: Exception) -> int:
         return 404
     if isinstance(e, (RepositoryException, SnapshotException)):
         return 400
+    if isinstance(e, IndexClosedException):
+        return 403     # ClusterBlockException / INDEX_CLOSED_BLOCK
     if isinstance(e, IndexMissingException):
         return 404
     if isinstance(e, DocumentMissingException):
@@ -380,6 +383,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         try:
             node._resolve(g["index"])
             return 200, {}
+        except IndexClosedException:
+            return 200, {}     # closed indices exist
         except IndexMissingException:
             return 404, {}
     c.register("HEAD", "/{index}", index_exists)
@@ -835,6 +840,17 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
         return 200, {"_shards": {"failed": 0}}
     c.register("GET", "/{index}/_optimize", optimize)
     c.register("GET", "/_optimize", optimize)
+
+    # -- open / close (ref rest/action/admin/indices/open+close) ----------
+    def close_index(g, p, b):
+        node.close_index(g["index"])
+        return 200, {"acknowledged": True}
+    c.register("POST", "/{index}/_close", close_index)
+
+    def open_index(g, p, b):
+        node.open_index(g["index"])
+        return 200, {"acknowledged": True}
+    c.register("POST", "/{index}/_open", open_index)
 
     # -- aliases (ref cluster/metadata/MetaDataIndicesAliasesService) ------
     def _alias_map(index_expr: str | None, name: str | None):

@@ -1,0 +1,105 @@
+"""Index open/close lifecycle: closed indices release engines and block
+reads/writes with 403, retain data, survive restarts, and reopen intact
+(ref cluster/metadata/MetaDataIndexStateService).
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import IndexClosedException, NodeService
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+def _fill(node, index, n=10):
+    node.create_index(index)
+    for i in range(n):
+        node.index_doc(index, str(i), {"body": f"doc {i} common"})
+    node.refresh(index)
+
+
+class TestOpenClose:
+    def test_close_blocks_and_open_restores(self, node):
+        _fill(node, "oc")
+        node.close_index("oc")
+        with pytest.raises(IndexClosedException):
+            node.search("oc", {"query": {"match_all": {}}})
+        with pytest.raises(IndexClosedException):
+            node.index_doc("oc", "x", {"body": "nope"}, auto_create=False)
+        node.open_index("oc")
+        out = node.search("oc", {"query": {"match": {"body": "common"}}})
+        assert out["hits"]["total"] == 10
+
+    def test_closed_index_releases_breaker_bytes(self, node):
+        _fill(node, "mem")
+        used = node.stats()["breakers"]["fielddata"][
+            "estimated_size_in_bytes"]
+        assert used > 0
+        node.close_index("mem")
+        assert node.stats()["breakers"]["fielddata"][
+            "estimated_size_in_bytes"] == 0
+        node.open_index("mem")
+        assert node.stats()["breakers"]["fielddata"][
+            "estimated_size_in_bytes"] > 0
+
+    def test_closed_survives_restart(self, node, tmp_path):
+        _fill(node, "rs")
+        node.close_index("rs")
+        node.close()
+        n2 = NodeService(data_path=str(tmp_path))
+        try:
+            assert "rs" in n2.closed
+            with pytest.raises(IndexClosedException):
+                n2.search("rs", {"query": {"match_all": {}}})
+            n2.open_index("rs")
+            out = n2.search("rs", {"query": {"match_all": {}}})
+            assert out["hits"]["total"] == 10
+        finally:
+            n2.close()
+
+    def test_wildcards_skip_closed(self, node):
+        _fill(node, "open1")
+        _fill(node, "shut1")
+        node.close_index("shut1")
+        out = node.search("_all", {"query": {"match_all": {}}, "size": 30})
+        assert out["hits"]["total"] == 10
+        assert node._resolve("*1") == ["open1"]
+
+    def test_delete_closed_index(self, node, tmp_path):
+        _fill(node, "dc")
+        node.close_index("dc")
+        node.delete_index("dc")
+        assert "dc" not in node.closed
+        import os
+        assert not os.path.exists(str(tmp_path / "dc"))
+
+    def test_rest_roundtrip(self, node):
+        import json
+        import urllib.request
+        from elasticsearch_tpu.rest import HttpServer
+        _fill(node, "rest1")
+        srv = HttpServer(node, port=0).start()
+
+        def req(method, path):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}", method=method,
+                data=b"" if method == "POST" else None)
+            try:
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                return e.code, {}
+
+        try:
+            assert req("POST", "/rest1/_close")[0] == 200
+            assert req("GET", "/rest1/_search")[0] == 403
+            assert req("HEAD", "/rest1")[0] == 200   # still exists
+            assert req("POST", "/rest1/_open")[0] == 200
+            st, out = req("GET", "/rest1/_search")
+            assert st == 200 and out["hits"]["total"] == 10
+        finally:
+            srv.stop()

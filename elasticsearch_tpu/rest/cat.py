@@ -1,0 +1,79 @@
+"""_cat API: aligned-column text tables with v/h/help semantics.
+
+Analog of /root/reference/src/main/java/org/elasticsearch/rest/action/cat/
+(RestTable.java renders; each endpoint declares its columns). Contract per
+the cat.* YAML suites: default output is rows only, `v=true` prepends the
+header row, `h=a,b` selects columns (including non-default ones), and
+`help=true` lists every column as "name | alias | description" lines.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+_NUMERIC = re.compile(r"^-?\d+(\.\d+)?([ptgmk]?b|%)?$")
+
+
+def render(p: dict, columns: list[tuple[str, str]], rows: list[dict],
+           defaults: list[str] | None = None,
+           aliases: dict | None = None) -> str:
+    """columns: [(name, help_text)]; rows: dicts keyed by column name.
+    aliases: short-form column names (h=a,b may use them; the header echoes
+    the requested token, values resolve through the canonical name)."""
+    if p.get("help", ["false"])[0] not in ("false", None):
+        return "".join(f"{name:<14} | - | {hlp}\n" for name, hlp in columns)
+    sel = p.get("h", [None])
+    sel = ",".join(sel) if isinstance(sel, list) and sel != [None] else \
+        (sel[0] if isinstance(sel, list) else sel)
+    amap = aliases or {}
+    known = {name for name, _ in columns}
+    if sel:
+        requested = [c.strip(" '\"") for c in str(sel).strip("[]").split(",")
+                     if c.strip(" '\"")]
+        # unknown columns are silently dropped (RestTable behavior)
+        names = [n for n in requested if amap.get(n, n) in known]
+    else:
+        names = defaults or [name for name, _ in columns]
+    data = [[str(r.get(amap.get(n, n), r.get(n, ""))) for n in names]
+            for r in rows]
+    header = p.get("v", ["false"])[0] == "true"
+    if not data and not header:
+        return ""
+    # header width only counts when the header prints; numeric columns
+    # right-align (RestTable's alignment rules)
+    widths = [max(([len(n)] if header else [0])
+                  + [len(row[i]) for row in data] + [1])
+              for i, n in enumerate(names)]
+    num = [all(_NUMERIC.match(row[i]) for row in data if row[i])
+           and any(row[i] for row in data)
+           for i in range(len(names))]
+    out = []
+    if header:
+        # headers are always left-aligned (the suites anchor ^ on the
+        # first header token); only VALUES right-align in numeric columns
+        out.append(" ".join(n.ljust(w) for n, w in zip(names, widths))
+                   .rstrip() + " \n")
+    for row in data:
+        out.append(" ".join(
+            (v.rjust(w) if num[i] else v.ljust(w))
+            for i, (v, w) in enumerate(zip(row, widths)))
+            .rstrip() + " \n")
+    return "".join(out)
+
+
+def human_bytes(n: int) -> str:
+    """520 -> "520b", 2048 -> "2kb" (RestTable's ByteSizeValue rendering)."""
+    for unit, div in (("pb", 1 << 50), ("tb", 1 << 40), ("gb", 1 << 30),
+                      ("mb", 1 << 20), ("kb", 1 << 10)):
+        if n >= div:
+            v = n / div
+            return f"{v:.1f}{unit}" if v < 10 and v != int(v) \
+                else f"{int(v)}{unit}"
+    return f"{int(n)}b"
+
+
+def now_cols() -> dict:
+    t = int(time.time())
+    return {"epoch": t, "timestamp": time.strftime("%H:%M:%S",
+                                                   time.gmtime(t))}

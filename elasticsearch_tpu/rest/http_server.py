@@ -124,8 +124,6 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                lambda g, p, b: (200, node.cluster_health()))
     c.register("GET", "/_cluster/health/{index}",
                lambda g, p, b: (200, node.cluster_health()))
-    c.register("GET", "/_cat/indices", _cat_indices(node))
-    c.register("GET", "/_cat/health", _cat_health(node))
 
     def put_template(g, p, b):
         node.put_template(g["name"], _json_body(b))
@@ -1129,54 +1127,306 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/_cluster/state/{metric}", cluster_state)
     c.register("GET", "/_cluster/state/{metric}/{index}", cluster_state)
 
-    # -- richer _cat -------------------------------------------------------
+    # -- _cat (RestTable contract: v/h/help, aligned columns) --------------
+    from . import cat as _cat
+
     def cat_count(g, p, b):
         names = node._resolve(g.get("index", "_all"))
         total = sum(node.indices[n].doc_count() for n in names)
-        return 200, f"{total}\n"
+        return 200, _cat.render(p, [
+            ("epoch", "seconds since 1970-01-01 00:00:00"),
+            ("timestamp", "time in HH:MM:SS"),
+            ("count", "the document count")],
+            [{**_cat.now_cols(), "count": total}])
     c.register("GET", "/_cat/count", cat_count)
     c.register("GET", "/_cat/count/{index}", cat_count)
+
+    def cat_health(g, p, b):
+        h = node.cluster_health()
+        return 200, _cat.render(p, [
+            ("epoch", "seconds since 1970-01-01 00:00:00"),
+            ("timestamp", "time in HH:MM:SS"),
+            ("cluster", "cluster name"), ("status", "health status"),
+            ("node.total", "total number of nodes"),
+            ("node.data", "number of nodes that can store data"),
+            ("shards", "total number of shards"),
+            ("pri", "number of primary shards"),
+            ("relo", "number of relocating nodes"),
+            ("init", "number of initializing nodes"),
+            ("unassign", "number of unassigned shards"),
+            ("pending_tasks", "number of pending tasks")],
+            [{**_cat.now_cols(), "cluster": h["cluster_name"],
+              "status": h["status"], "node.total": h["number_of_nodes"],
+              "node.data": h["number_of_data_nodes"],
+              "shards": h["active_shards"],
+              "pri": h["active_primary_shards"],
+              "relo": h["relocating_shards"],
+              "init": h["initializing_shards"],
+              "unassign": h["unassigned_shards"],
+              "pending_tasks": h["number_of_pending_tasks"]}])
+    c.register("GET", "/_cat/health", cat_health)
+
+    def cat_indices(g, p, b):
+        rows = []
+        for n in sorted(node._resolve(g.get("index", "_all"))):
+            svc = node.indices[n]
+            size = sum(e.segment_stats()["memory_in_bytes"]
+                       for e in svc.shards)
+            deleted = sum(e.segment_stats()["deleted"] for e in svc.shards)
+            rows.append({
+                "health": "green" if svc.n_replicas == 0 else "yellow",
+                "status": "open", "index": n, "pri": svc.n_shards,
+                "rep": svc.n_replicas, "docs.count": svc.doc_count(),
+                "docs.deleted": deleted,
+                "store.size": _cat.human_bytes(size),
+                "pri.store.size": _cat.human_bytes(size)})
+        for n in sorted(node.closed):
+            rows.append({"health": "green", "status": "close", "index": n,
+                         "pri": "", "rep": "", "docs.count": "",
+                         "docs.deleted": "", "store.size": "",
+                         "pri.store.size": ""})
+        return 200, _cat.render(p, [
+            ("health", "current health status"), ("status", "open/close"),
+            ("index", "index name"), ("pri", "number of primary shards"),
+            ("rep", "number of replica shards"),
+            ("docs.count", "available docs"),
+            ("docs.deleted", "deleted docs"),
+            ("store.size", "store size of primaries & replicas"),
+            ("pri.store.size", "store size of primaries")], rows)
+    c.register("GET", "/_cat/indices", cat_indices)
+    c.register("GET", "/_cat/indices/{index}", cat_indices)
 
     def cat_aliases(g, p, b):
         rows = []
         for n, svc in sorted(node.indices.items()):
             for a in sorted(svc.aliases):
-                if g.get("name") and not fnmatch.fnmatch(a, g["name"]):
+                if g.get("name") and not any(
+                        fnmatch.fnmatch(a, pat)
+                        for pat in g["name"].split(",")):
                     continue
-                rows.append(f"{a} {n} - - -")
-        return 200, "\n".join(rows) + ("\n" if rows else "")
+                rows.append({"alias": a, "index": n, "filter": "-",
+                             "routing.index": "-", "routing.search": "-"})
+        return 200, _cat.render(p, [
+            ("alias", "alias name"), ("index", "index the alias points to"),
+            ("filter", "filter"), ("routing.index", "index routing"),
+            ("routing.search", "search routing")], rows)
     c.register("GET", "/_cat/aliases", cat_aliases)
     c.register("GET", "/_cat/aliases/{name}", cat_aliases)
 
     def cat_shards(g, p, b):
         rows = []
-        for n, svc in sorted(node.indices.items()):
+        for n in sorted(node._resolve(g.get("index", "_all"))):
+            svc = node.indices[n]
             for si, e in enumerate(svc.shards):
-                rows.append(f"{n} {si} p STARTED {e.doc_count()} - - -")
-        return 200, "\n".join(rows) + ("\n" if rows else "")
+                size = e.segment_stats()["memory_in_bytes"]
+                rows.append({"index": n, "shard": si, "prirep": "p",
+                             "state": "STARTED", "docs": e.doc_count(),
+                             "store": _cat.human_bytes(size),
+                             "ip": "127.0.0.1", "node": "tpu-node-0"})
+                shadow = str(svc.settings.get(
+                    "shadow_replicas",
+                    svc.settings.get("index.shadow_replicas",
+                                     False))).lower() == "true"
+                for _ in range(svc.n_replicas):
+                    rows.append({"index": n, "shard": si,
+                                 "prirep": "s" if shadow else "r",
+                                 "state": "UNASSIGNED", "docs": "",
+                                 "store": "", "ip": "", "node": ""})
+        return 200, _cat.render(p, [
+            ("index", "index name"), ("shard", "shard id"),
+            ("prirep", "primary or replica"), ("state", "shard state"),
+            ("docs", "number of docs"), ("store", "store size"),
+            ("ip", "node ip"), ("node", "node name")], rows)
     c.register("GET", "/_cat/shards", cat_shards)
     c.register("GET", "/_cat/shards/{index}", cat_shards)
 
     def cat_segments(g, p, b):
         rows = []
-        for n, svc in sorted(node.indices.items()):
+        for n in sorted(node._resolve(g.get("index", "_all"))):
+            svc = node.indices[n]
             for si, e in enumerate(svc.shards):
                 for seg in e.segments:
-                    rows.append(f"{n} {si} p _{seg.seg_id} {seg.seg_id} "
-                                f"{seg.live_count} "
-                                f"{seg.n_docs - seg.live_count} "
-                                f"{seg.memory_bytes()}")
-        return 200, "\n".join(rows) + ("\n" if rows else "")
+                    rows.append({
+                        "index": n, "shard": si, "prirep": "p",
+                        "ip": "127.0.0.1", "segment": f"_{seg.seg_id}",
+                        "generation": seg.seg_id,
+                        "docs.count": seg.live_count,
+                        "docs.deleted": seg.n_docs - seg.live_count,
+                        "size": _cat.human_bytes(seg.memory_bytes()),
+                        "size.memory": seg.memory_bytes(),
+                        "committed": str(
+                            seg.seg_id in e.store.persisted).lower(),
+                        "searchable": "true", "version": "2.0.0",
+                        "compound": "false"})
+        return 200, _cat.render(p, [
+            ("index", "index name"), ("shard", "shard id"),
+            ("prirep", "primary or replica"), ("ip", "node ip"),
+            ("segment", "segment name"), ("generation", "generation"),
+            ("docs.count", "number of docs in segment"),
+            ("docs.deleted", "number of deleted docs in segment"),
+            ("size", "segment size in bytes"),
+            ("size.memory", "segment memory in bytes"),
+            ("committed", "is segment committed"),
+            ("searchable", "is segment searched"),
+            ("version", "version"), ("compound", "is segment compound")],
+            rows)
     c.register("GET", "/_cat/segments", cat_segments)
     c.register("GET", "/_cat/segments/{index}", cat_segments)
 
     def cat_nodes(g, p, b):
-        return 200, "127.0.0.1 - tpu-node-0 * mdi\n"
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        heap = rss_kb * 1024
+        row = {"host": "localhost", "ip": "127.0.0.1",
+               "heap.percent": 42, "ram.percent": 50, "load": "1.00",
+               "node.role": "d", "master": "*", "name": "tpu-node-0",
+               "heap.current": _cat.human_bytes(heap),
+               "heap.max": _cat.human_bytes(4 << 30),
+               "file_desc.current": 256, "file_desc.percent": 1,
+               "file_desc.max": 65536}
+        return 200, _cat.render(p, [
+            ("host", "host name"), ("ip", "ip address"),
+            ("heap.percent", "used heap ratio"),
+            ("ram.percent", "used machine memory ratio"),
+            ("load", "most recent load avg"),
+            ("node.role", "d:data node, c:client node"),
+            ("master", "*:current master, m:master eligible"),
+            ("name", "node name"),
+            ("heap.current", "used heap"), ("heap.max", "max heap"),
+            ("file_desc.current", "used file descriptors"),
+            ("file_desc.percent", "used file descriptor ratio"),
+            ("file_desc.max", "max file descriptors")],
+            [row],
+            defaults=["host", "ip", "heap.percent", "ram.percent", "load",
+                      "node.role", "master", "name"])
     c.register("GET", "/_cat/nodes", cat_nodes)
 
     def cat_master(g, p, b):
-        return 200, "tpu-node-0 127.0.0.1\n"
+        return 200, _cat.render(p, [
+            ("id", "node id"), ("host", "host name"),
+            ("ip", "ip address"), ("node", "node name")],
+            [{"id": "tpu0", "host": "localhost", "ip": "127.0.0.1",
+              "node": "tpu-node-0"}])
     c.register("GET", "/_cat/master", cat_master)
+
+    def cat_pending_tasks(g, p, b):
+        return 200, _cat.render(p, [
+            ("insertOrder", "task insertion order"),
+            ("timeInQueue", "how long task has been in queue"),
+            ("priority", "task priority"),
+            ("source", "task source")], [])
+    c.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
+
+    def cat_allocation(g, p, b):
+        nid = g.get("node_id")
+        if nid and nid not in ("tpu-node-0", "tpu0", "_master", "*",
+                               "_all", "_local"):
+            return 200, _cat.render(p, [("shards", "")], [])
+        total = sum(e.segment_stats()["memory_in_bytes"]
+                    for svc in node.indices.values() for e in svc.shards)
+        n_shards = sum(svc.n_shards for svc in node.indices.values())
+        unit = p.get("bytes", [None])[0]
+        scale = {"b": 1, "k": 1 << 10, "m": 1 << 20,
+                 "g": 1 << 30, "t": 1 << 40}.get(unit)
+        size = (lambda n: int(n // scale)) if scale             else _cat.human_bytes
+        return 200, _cat.render(p, [
+            ("shards", "number of shards on node"),
+            ("disk.used", "disk used (total, not just ES)"),
+            ("disk.avail", "disk available"),
+            ("disk.total", "total capacity"),
+            ("disk.percent", "percent disk used"),
+            ("host", "host name"), ("ip", "ip address"),
+            ("node", "node name")],
+            [{"shards": n_shards, "disk.used": size(total),
+              "disk.avail": size(100 << 30),
+              "disk.total": size(100 << 30),
+              "disk.percent": 1, "host": "localhost", "ip": "127.0.0.1",
+              "node": "tpu-node-0"}])
+    c.register("GET", "/_cat/allocation", cat_allocation)
+    c.register("GET", "/_cat/allocation/{node_id}", cat_allocation)
+
+    def cat_fielddata(g, p, b):
+        used = node.breakers.breaker("fielddata").used
+        return 200, _cat.render(p, [
+            ("id", "node id"), ("host", "host name"), ("ip", "ip address"),
+            ("node", "node name"), ("total", "total field data usage")],
+            [{"id": "tpu0", "host": "localhost", "ip": "127.0.0.1",
+              "node": "tpu-node-0", "total": _cat.human_bytes(used)}])
+    c.register("GET", "/_cat/fielddata", cat_fielddata)
+    c.register("GET", "/_cat/fielddata/{fields}", cat_fielddata)
+
+    def cat_recovery(g, p, b):
+        rows = []
+        for n in sorted(node._resolve(g.get("index", "_all"))):
+            svc = node.indices[n]
+            for si in range(svc.n_shards):
+                rows.append({"index": n, "shard": si, "time": 0,
+                             "type": "gateway", "stage": "done",
+                             "source_host": "localhost",
+                             "target_host": "localhost",
+                             "repository": "n/a", "snapshot": "n/a",
+                             "files": 0, "files_percent": "100.0%",
+                             "bytes": 0, "bytes_percent": "100.0%",
+                             "total_files": 0, "total_bytes": 0,
+                             "translog": 0, "translog_percent": "100.0%",
+                             "total_translog": 0})
+        return 200, _cat.render(p, [
+            ("index", "index name"), ("shard", "shard id"),
+            ("time", "recovery time"), ("type", "recovery type"),
+            ("stage", "recovery stage"),
+            ("source_host", "source host"), ("target_host", "target host"),
+            ("repository", "repository"), ("snapshot", "snapshot"),
+            ("files", "number of files"),
+            ("files_percent", "percent of files recovered"),
+            ("bytes", "number of bytes"),
+            ("bytes_percent", "percent of bytes recovered"),
+            ("total_files", "total number of files"),
+            ("total_bytes", "total number of bytes"),
+            ("translog", "translog operations recovered"),
+            ("translog_percent", "percent of translog recovered"),
+            ("total_translog", "total translog operations")], rows)
+    c.register("GET", "/_cat/recovery", cat_recovery)
+    c.register("GET", "/_cat/recovery/{index}", cat_recovery)
+
+    def cat_thread_pool(g, p, b):
+        import os as _os
+        pools = ("bulk", "flush", "generic", "get", "index", "management",
+                 "merge", "optimize", "percolate", "refresh", "search",
+                 "snapshot", "suggest", "warmer")
+        row = {"pid": _os.getpid(), "id": "tpu0", "host": "localhost",
+               "ip": "127.0.0.1", "port": 9300}
+        for pool in pools:
+            row.update({f"{pool}.type": "fixed", f"{pool}.active": 0,
+                        f"{pool}.size": 1, f"{pool}.queue": 0,
+                        f"{pool}.queueSize": "", f"{pool}.rejected": 0,
+                        f"{pool}.largest": 0, f"{pool}.completed": 0,
+                        f"{pool}.min": "", f"{pool}.max": "",
+                        f"{pool}.keepAlive": ""})
+        rows = [row]
+        columns = [("pid", "process id"), ("id", "unique node id"),
+                   ("host", "host name"), ("ip", "ip address"),
+                   ("port", "bound transport port")]
+        for pool in pools:
+            for suffix in ("type", "active", "size", "queue", "queueSize",
+                           "rejected", "largest", "completed", "min",
+                           "max", "keepAlive"):
+                columns.append((f"{pool}.{suffix}",
+                                f"{pool} thread pool {suffix}"))
+        return 200, _cat.render(p, columns, rows,
+            defaults=["host", "ip", "bulk.active", "bulk.queue",
+                      "bulk.rejected", "index.active", "index.queue",
+                      "index.rejected", "search.active", "search.queue",
+                      "search.rejected"],
+            aliases={"h": "host", "i": "ip", "po": "port",
+                     "ba": "bulk.active", "bq": "bulk.queue",
+                     "br": "bulk.rejected", "ia": "index.active",
+                     "iq": "index.queue", "ir": "index.rejected",
+                     "sa": "search.active", "sq": "search.queue",
+                     "sr": "search.rejected", "fa": "flush.active",
+                     "gea": "get.active", "ga": "generic.active",
+                     "maa": "management.active",
+                     "oa": "optimize.active", "pa": "percolate.active"})
+    c.register("GET", "/_cat/thread_pool", cat_thread_pool)
 
     # -- indices.stats (reference response shape) --------------------------
     def index_stats_v2(g, p, b):
@@ -1319,22 +1569,6 @@ def _parse_bulk(body: bytes, default_index: str | None) -> list:
     return ops
 
 
-def _cat_indices(node: NodeService):
-    def handler(g, p, b):
-        rows = []
-        for n, svc in sorted(node.indices.items()):
-            rows.append(f"green open {n} {svc.n_shards} {svc.n_replicas} "
-                        f"{svc.doc_count()} 0")
-        return 200, "\n".join(rows) + "\n"
-    return handler
-
-
-def _cat_health(node: NodeService):
-    def handler(g, p, b):
-        h = node.cluster_health()
-        return 200, (f"{h['cluster_name']} {h['status']} "
-                     f"{h['number_of_nodes']} {h['number_of_data_nodes']}\n")
-    return handler
 
 
 # ---------------------------------------------------------------------------

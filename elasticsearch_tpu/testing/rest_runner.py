@@ -84,8 +84,12 @@ class YamlRestRunner:
         except urllib.error.HTTPError as e:
             raw = e.read()
             status = e.code
+        if not raw:
+            return status, ""
+        if raw[:1] not in (b"{", b"["):
+            return status, raw.decode(errors="replace")   # text (_cat etc.)
         try:
-            parsed = json.loads(raw) if raw else {}
+            parsed = json.loads(raw)
         except json.JSONDecodeError:
             parsed = raw.decode(errors="replace")
         return status, parsed

@@ -29,7 +29,8 @@ class IndexService:
             f"index.{k}", self.settings.get(k, d))
         self.n_shards = int(get("number_of_shards", 1) or 1)
         self.n_replicas = int(get("number_of_replicas", 1) or 1)
-        self.aliases: set[str] = set()
+        # alias name -> properties ({filter, index_routing, search_routing})
+        self.aliases: dict[str, dict] = {}
         self.breakers = breakers           # CircuitBreakerService | None
         fd = breakers.breaker("fielddata") if breakers is not None else None
         self.mappers = MapperService(mappings=mappings or {})

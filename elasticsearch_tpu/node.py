@@ -80,6 +80,24 @@ _VALID_INDEX = _ValidIndex()
 logger = logging.getLogger("elasticsearch_tpu.node")
 
 
+def alias_dict(x) -> dict:
+    """Normalize persisted alias forms (legacy name lists or prop dicts)
+    into {name: props}; a bare "routing" fans out to both routings
+    (ref AliasAction/AliasMetaData semantics)."""
+    if isinstance(x, dict):
+        out = {k: dict(v or {}) for k, v in x.items()}
+    else:
+        out = {a: {} for a in (x or [])}
+    for props in out.values():
+        if "routing" in props:
+            props.setdefault("index_routing", props["routing"])
+            props.setdefault("search_routing", props["routing"])
+        for k in ("routing", "index_routing", "search_routing"):
+            if k in props:
+                props[k] = str(props[k])   # routing values are strings
+    return out
+
+
 class NodeService:
     """One node holding every shard locally (multi-node arrives with the
     cluster layer; the API surface is already the distributed one)."""
@@ -141,13 +159,13 @@ class NodeService:
                 name, os.path.join(self.data_path, name),
                 Settings(meta.get("settings", {})), meta.get("mappings", {}),
                 breakers=self.breakers)
-            self.indices[name].aliases = set(meta.get("aliases", []))
+            self.indices[name].aliases = alias_dict(meta.get("aliases", []))
 
     def _persist_index_meta(self, svc: IndexService) -> None:
         import json
         meta = {"settings": dict(svc.settings),
                 "mappings": svc.mappings_dict(),
-                "aliases": sorted(svc.aliases)}
+                "aliases": dict(sorted(svc.aliases.items()))}
         path = os.path.join(svc.path, "_meta.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -163,7 +181,7 @@ class NodeService:
             raise InvalidIndexNameException(f"invalid index name [{name}]")
         merged_settings = dict(settings or {})
         merged_mappings = dict(mappings or {})
-        merged_aliases = set((aliases or {}).keys())
+        merged_aliases = alias_dict(aliases or {})
         # index templates (ref MetaDataIndexTemplateService): apply by pattern
         for tname, tpl in sorted(self.templates.items(),
                                  key=lambda kv: kv[1].get("order", 0)):
@@ -172,7 +190,9 @@ class NodeService:
                     merged_settings.setdefault(k, v)
                 for t, m in (tpl.get("mappings") or {}).items():
                     merged_mappings.setdefault(t, m)
-                merged_aliases |= set((tpl.get("aliases") or {}).keys())
+                for a, props in alias_dict(tpl.get("aliases")
+                                           or {}).items():
+                    merged_aliases.setdefault(a, props)
         svc = IndexService(name, os.path.join(self.data_path, name),
                            Settings(merged_settings), merged_mappings,
                            breakers=self.breakers)
@@ -209,7 +229,8 @@ class NodeService:
             svc = self.indices.pop(n)
             meta = {"settings": dict(svc.settings),
                     "mappings": svc.mappings_dict(),
-                    "aliases": sorted(svc.aliases), "state": "close"}
+                    "aliases": dict(sorted(svc.aliases.items())),
+                    "state": "close"}
             svc.flush()
             svc.close()
             self.closed[n] = meta
@@ -230,7 +251,7 @@ class NodeService:
                                Settings(meta.get("settings", {})),
                                meta.get("mappings", {}),
                                breakers=self.breakers)
-            svc.aliases = set(meta.get("aliases", []))
+            svc.aliases = alias_dict(meta.get("aliases", []))
             svc.mappers.search_templates = self.search_templates
             self.indices[n] = svc
             self._persist_meta_dict(n, meta)
@@ -425,6 +446,7 @@ class NodeService:
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
+        body = self._apply_alias_filters(index, names, body)
         from .search.sort import parse_sort
         sort = parse_sort(body.get("sort"),
                           [self.indices[n].mappers for n in names])
@@ -592,6 +614,24 @@ class NodeService:
         if body.get("suggest"):
             resp["suggest"] = self.suggest(index, body["suggest"])
         return resp
+
+    def _apply_alias_filters(self, expr: str, names: list[str],
+                             body: dict) -> dict:
+        """Searching THROUGH a filtered alias adds the alias filter to the
+        query (ref cluster/metadata/AliasMetaData + the filtering-alias
+        resolution in TransportSearchTypeAction)."""
+        filters = []
+        for part in str(expr).split(","):
+            for n in names:
+                props = self.indices[n].aliases.get(part)
+                if props and props.get("filter"):
+                    filters.append(props["filter"])
+                    break
+        if not filters:
+            return body
+        return {**body, "query": {"bool": {
+            "must": [body.get("query", {"match_all": {}})],
+            "filter": filters}}}
 
     def _expand_mlt(self, q, names: list[str]):
         """Rewrite more_like_this specs into term-disjunction queries
@@ -1000,6 +1040,7 @@ class NodeService:
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
+        body = self._apply_alias_filters(index, names, body)
         if any(k in body for k in ("knn", "rescore", "search_after")):
             raise QueryParsingException(
                 "scroll does not support knn/rescore/search_after")

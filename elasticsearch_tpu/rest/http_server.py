@@ -865,8 +865,10 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
         return out
 
     def put_alias(g, p, b):
+        from ..node import alias_dict
+        props = alias_dict({g["name"]: _json_body(b)})[g["name"]]
         for n in node._resolve(g["index"]):
-            node.indices[n].aliases.add(g["name"])
+            node.indices[n].aliases[g["name"]] = props
             node._persist_index_meta(node.indices[n])
         return 200, {"acknowledged": True}
     for pat in ("/{index}/_alias/{name}", "/{index}/_aliases/{name}",
@@ -883,7 +885,7 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                             for pat in g["name"].split(","))] \
                 if g["name"] not in ("_all", "*") else list(svc.aliases)
             for a in match:
-                svc.aliases.discard(a)
+                svc.aliases.pop(a, None)
                 removed = True
             if match:
                 node._persist_index_meta(svc)
@@ -897,14 +899,22 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     def get_alias(g, p, b):
         amap = _alias_map(g.get("index"), g.get("name"))
         if g.get("name") and not any(amap.values()):
+            if g.get("index"):
+                # missing alias scoped to an existing index: empty body
+                # (ref get_alias REST contract)
+                return 200, {}
             return 404, {"error": f"alias [{g['name']}] missing",
                          "status": 404}
-        return 200, {n: {"aliases": {a: {} for a in al}}
+        def render_props(n, a):
+            props = node.indices[n].aliases.get(a, {})
+            return {k: v for k, v in props.items()
+                    if k in ("filter", "index_routing", "search_routing")}
+        return 200, {n: {"aliases": {a: render_props(n, a) for a in al}}
                      for n, al in amap.items()
                      if al or not g.get("name")}
     for pat in ("/_alias", "/_alias/{name}", "/{index}/_alias",
                 "/{index}/_alias/{name}", "/_aliases", "/_aliases/{name}",
-                "/{index}/_aliases"):
+                "/{index}/_aliases", "/{index}/_aliases/{name}"):
         c.register("GET", pat, get_alias)
 
     def head_alias(g, p, b):
@@ -914,19 +924,24 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("HEAD", "/{index}/_alias/{name}", head_alias)
 
     def update_aliases(g, p, b):
+        from ..node import alias_dict
         body = _json_body(b)
         for action in body.get("actions", []):
             (kind, spec), = action.items()
             indices = spec.get("indices") or [spec["index"]]
             aliases = spec.get("aliases") or [spec["alias"]]
+            props = alias_dict({"x": {
+                k: v for k, v in spec.items()
+                if k in ("filter", "routing", "index_routing",
+                         "search_routing")}})["x"]
             for expr in indices:
                 for n in node._resolve(expr):
                     svc = node.indices[n]
                     for a in aliases:
                         if kind == "add":
-                            svc.aliases.add(a)
+                            svc.aliases[a] = props
                         else:
-                            svc.aliases.discard(a)
+                            svc.aliases.pop(a, None)
                     node._persist_index_meta(svc)
         return 200, {"acknowledged": True}
     c.register("POST", "/_aliases", update_aliases)
@@ -970,7 +985,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
         out = {}
         for n in node._resolve(g["index"]):
             svc = node.indices[n]
-            out[n] = {"aliases": {a: {} for a in sorted(svc.aliases)},
+            out[n] = {"aliases": {a: svc.aliases[a]
+                                  for a in sorted(svc.aliases)},
                       "mappings": svc.mappings_dict(),
                       "settings": _render_settings(svc, flat),
                       "warmers": {}}
@@ -1204,8 +1220,13 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                         fnmatch.fnmatch(a, pat)
                         for pat in g["name"].split(",")):
                     continue
-                rows.append({"alias": a, "index": n, "filter": "-",
-                             "routing.index": "-", "routing.search": "-"})
+                props = svc.aliases[a]
+                rows.append({"alias": a, "index": n,
+                             "filter": "*" if props.get("filter") else "-",
+                             "routing.index":
+                                 props.get("index_routing", "-") or "-",
+                             "routing.search":
+                                 props.get("search_routing", "-") or "-"})
         return 200, _cat.render(p, [
             ("alias", "alias name"), ("index", "index the alias points to"),
             ("filter", "filter"), ("routing.index", "index routing"),

@@ -129,7 +129,7 @@ class SnapshotsService:
             manifest["indices"][name] = {
                 "settings": dict(svc.settings),
                 "mappings": svc.mappings_dict(),
-                "aliases": sorted(svc.aliases),
+                "aliases": dict(sorted(svc.aliases.items())),
                 "shards": shards,
             }
         manifest["end_time"] = time.time()
@@ -258,7 +258,8 @@ class SnapshotsService:
                                Settings(imeta["settings"]),
                                imeta["mappings"],
                                breakers=getattr(self.node, "breakers", None))
-            svc.aliases = set(imeta.get("aliases", []))
+            from ..node import alias_dict
+            svc.aliases = alias_dict(imeta.get("aliases", []))
             self.node.indices[dest] = svc
             self.node._persist_index_meta(svc)
             restored.append(dest)

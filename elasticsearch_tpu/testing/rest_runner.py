@@ -303,7 +303,14 @@ class YamlRestRunner:
                 ignored = [int(x) for x in
                            (ignore if isinstance(ignore, list) else [ignore])
                            ] if ignore is not None else []
-                status, response = self._do_api(api.strip(), args)
+                try:
+                    status, response = self._do_api(api.strip(), args)
+                except _Failure:
+                    if catch in ("param", "request"):
+                        # client-side validation failure was EXPECTED
+                        n += 1
+                        continue
+                    raise
                 if status in ignored:
                     n += 1
                     continue

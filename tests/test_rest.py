@@ -313,3 +313,37 @@ class TestAdmin:
         assert res.found and res.source == {"k": "v"}
         assert node2.indices["persist"].mappers.field_type("k").type == "keyword"
         node2.close()
+
+
+class TestFilteredAliases:
+    def test_alias_filter_and_routing_props(self, server):
+        req(server, "PUT", "/books2", {"mappings": {"_doc": {"properties": {
+            "genre": {"type": "keyword"}, "title": {"type": "text"}}}}})
+        for i, (t, g) in enumerate([("alpha one", "fiction"),
+                                    ("alpha two", "cooking"),
+                                    ("alpha three", "fiction")]):
+            req(server, "PUT", f"/books2/_doc/{i}",
+                {"title": t, "genre": g})
+        req(server, "POST", "/books2/_refresh")
+        status, _ = req(server, "PUT", "/books2/_alias/fiction_books", {
+            "filter": {"term": {"genre": "fiction"}}, "routing": "r1"})
+        assert status == 200
+        # searching through the alias applies the filter
+        status, out = req(server, "POST", "/fiction_books/_search",
+                          {"query": {"match": {"title": "alpha"}}})
+        assert out["hits"]["total"] == 2
+        assert {h["_source"]["genre"] for h in out["hits"]["hits"]} \
+            == {"fiction"}
+        # searching the index directly does not
+        status, out = req(server, "POST", "/books2/_search",
+                          {"query": {"match": {"title": "alpha"}}})
+        assert out["hits"]["total"] == 3
+        # props round-trip through the alias API
+        status, out = req(server, "GET", "/books2/_alias/fiction_books")
+        props = out["books2"]["aliases"]["fiction_books"]
+        assert props["filter"] == {"term": {"genre": "fiction"}}
+        assert props["index_routing"] == "r1"
+        assert props["search_routing"] == "r1"
+        # and through _cat/aliases
+        status, out = req(server, "GET", "/_cat/aliases/fiction_books")
+        assert "fiction_books" in out and "*" in out and "r1" in out

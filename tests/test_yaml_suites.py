@@ -21,6 +21,7 @@ GREEN_SUITES = [
     "bulk/10_basic.yaml",
     "bulk/20_list_of_strings.yaml",
     "bulk/30_big_string.yaml",
+    "cat.aliases/10_basic.yaml",
     "cat.allocation/10_basic.yaml",
     "cat.count/10_basic.yaml",
     "cat.health/10_basic.yaml",
@@ -72,10 +73,13 @@ GREEN_SUITES = [
     "index/37_force_version.yaml",
     "index/60_refresh.yaml",
     "indices.analyze/10_analyze.yaml",
+    "indices.delete_alias/10_basic.yaml",
+    "indices.delete_alias/all_path_options.yaml",
     "indices.exists/10_basic.yaml",
     "indices.exists_alias/10_basic.yaml",
     "indices.exists_template/10_basic.yaml",
     "indices.exists_type/10_basic.yaml",
+    "indices.get_alias/10_basic.yaml",
     "indices.get_alias/20_empty.yaml",
     "indices.get_field_mapping/40_missing_index.yaml",
     "indices.get_mapping/10_basic.yaml",
@@ -88,9 +92,13 @@ GREEN_SUITES = [
     "indices.open/20_multiple_indices.yaml",
     "indices.optimize/10_basic.yaml",
     "indices.put_alias/10_basic.yaml",
+    "indices.put_alias/all_path_options.yaml",
     "indices.put_settings/all_path_options.yaml",
     "indices.put_warmer/10_basic.yaml",
     "indices.put_warmer/20_aliases.yaml",
+    "indices.put_warmer/all_path_options.yaml",
+    "indices.update_aliases/10_basic.yaml",
+    "indices.update_aliases/20_routing.yaml",
     "info/10_info.yaml",
     "info/20_lucene_version.yaml",
     "mget/12_non_existent_index.yaml",
@@ -161,4 +169,4 @@ def test_overall_coverage_floor(runner):
             continue
         if rs and all(r.ok for r in rs):
             green += 1
-    assert green >= 102, f"YAML suite coverage regressed: {green} green files"
+    assert green >= 110, f"YAML suite coverage regressed: {green} green files"

@@ -272,13 +272,31 @@ class Engine:
                 parsed = mapper.parse(source, doc_id=doc_id, routing=routing)
                 builder.add(parsed, tname,
                             version=self.versions[doc_id][0])
-            seg = builder.build()
             if self.breaker is not None:
+                # charge BEFORE build() uploads device arrays: a tripped
+                # breaker prevents the allocation itself, not just the
+                # accounting (advisor r4). Estimate mirrors memory_bytes().
+                est = builder.estimate_bytes()
                 try:
-                    self.breaker.add_estimate(seg.memory_bytes())
+                    self.breaker.add_estimate(est)
                 except Exception as e:
                     self._blocked_reason = e
                     raise
+            try:
+                seg = builder.build()
+            except BaseException:
+                # device upload failed — undo the charge or the breaker
+                # ratchets up on every retried refresh
+                if self.breaker is not None:
+                    self.breaker.release(est)
+                raise
+            if self.breaker is not None:
+                # true up any estimate drift without re-tripping
+                drift = seg.memory_bytes() - est
+                if drift > 0:
+                    self.breaker.add_estimate(drift, check=False)
+                elif drift < 0:
+                    self.breaker.release(-drift)
             self._blocked_reason = None
             self._next_seg_id += 1
             self.segments.append(seg)

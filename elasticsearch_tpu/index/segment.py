@@ -315,6 +315,26 @@ class SegmentBuilder:
             self._vector_dims[field] = len(vec)
         return local
 
+    def estimate_bytes(self) -> int:
+        """Device-byte estimate from host-side builder state, BEFORE any
+        device allocation — must mirror Segment.memory_bytes() exactly so
+        breaker charge/release stay balanced. Lets the engine charge the
+        breaker before build() uploads arrays (a tripped breaker then
+        really does prevent the allocation, not just account for it)."""
+        n_pad = next_pow2(self.n_docs, floor=8)
+        total = 0
+        for term_map in self._postings.values():
+            lens = [len(v) for v in term_map.values()]
+            P = sum(lens)
+            p_pad = required_padding(P, max(lens) if lens else 0)
+            # doc_ids + tf + dl are p_pad-sized; doc_len is n_pad-sized
+            total += p_pad * 4 * 3 + n_pad * 4
+        total += len(self._keywords) * n_pad * 4
+        total += (len(self._longs) + len(self._doubles)) * (n_pad * 8 + n_pad)
+        for field in self._vectors:
+            total += n_pad * self._vector_dims[field] * 4
+        return total
+
     def build(self) -> Segment:
         n = self.n_docs
         n_pad = next_pow2(n, floor=8)

@@ -446,7 +446,12 @@ class NodeService:
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
-        body = self._apply_alias_filters(index, names, body)
+        alias_flt = self._alias_filters_by_index(index, names)
+        if len(names) == 1 and alias_flt:
+            # single index: wrapping the body keeps the packed lane eligible
+            body = {**body, "query": self._wrap_alias_query(
+                body.get("query"), alias_flt[names[0]])}
+            alias_flt = {}
         from .search.sort import parse_sort
         sort = parse_sort(body.get("sort"),
                           [self.indices[n].mappers for n in names])
@@ -535,7 +540,9 @@ class NodeService:
             terms_by_field: dict[str, set] = {}
             for n in names:
                 from .search.query_parser import QueryParser, merge_query_batch
-                parsed = QueryParser(self.indices[n].mappers).parse(query)
+                q_n = self._wrap_alias_query(query, alias_flt[n]) \
+                    if n in alias_flt else query
+                parsed = QueryParser(self.indices[n].mappers).parse(q_n)
                 parsed.collect_terms(terms_by_field)
                 nodes_by_index[n] = merge_query_batch([parsed])
             all_segs = [seg for s in searchers for seg in s.segments]
@@ -615,23 +622,37 @@ class NodeService:
             resp["suggest"] = self.suggest(index, body["suggest"])
         return resp
 
-    def _apply_alias_filters(self, expr: str, names: list[str],
-                             body: dict) -> dict:
-        """Searching THROUGH a filtered alias adds the alias filter to the
-        query (ref cluster/metadata/AliasMetaData + the filtering-alias
-        resolution in TransportSearchTypeAction)."""
-        filters = []
-        for part in str(expr).split(","):
+    def _alias_filters_by_index(self, expr: str,
+                                names: list[str]) -> dict[str, list]:
+        """Per-index alias filters: each index searched THROUGH a filtered
+        alias gets that alias's filter applied to ITS shards only; multiple
+        filtered aliases targeting one index OR together (ref
+        cluster/metadata/AliasMetaData + filtering-alias resolution in
+        TransportSearchTypeAction — filters are per-index, should-combined)."""
+        by_index: dict[str, list] = {}
+        unfiltered: set[str] = set()   # reached concretely or via a
+        for part in str(expr).split(","):   # filter-less alias → no filter
             for n in names:
+                if part == n or ("*" in part and fnmatch.fnmatch(n, part)):
+                    unfiltered.add(n)
+                    continue
                 props = self.indices[n].aliases.get(part)
-                if props and props.get("filter"):
-                    filters.append(props["filter"])
-                    break
-        if not filters:
-            return body
-        return {**body, "query": {"bool": {
-            "must": [body.get("query", {"match_all": {}})],
-            "filter": filters}}}
+                if props is None:
+                    continue
+                if props.get("filter"):
+                    by_index.setdefault(n, []).append(props["filter"])
+                else:
+                    unfiltered.add(n)
+        for n in unfiltered:
+            by_index.pop(n, None)
+        return by_index
+
+    @staticmethod
+    def _wrap_alias_query(query, filters: list):
+        flt = filters[0] if len(filters) == 1 \
+            else {"bool": {"should": filters}}
+        return {"bool": {"must": [query or {"match_all": {}}],
+                         "filter": [flt]}}
 
     def _expand_mlt(self, q, names: list[str]):
         """Rewrite more_like_this specs into term-disjunction queries
@@ -1040,7 +1061,7 @@ class NodeService:
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
-        body = self._apply_alias_filters(index, names, body)
+        alias_flt = self._alias_filters_by_index(index, names)
         if any(k in body for k in ("knn", "rescore", "search_after")):
             raise QueryParsingException(
                 "scroll does not support knn/rescore/search_after")
@@ -1084,7 +1105,9 @@ class NodeService:
         nodes_by_index: dict[str, Any] = {}
         terms_by_field: dict[str, set] = {}
         for n in names:
-            parsed = QueryParser(self.indices[n].mappers).parse(query)
+            q_n = self._wrap_alias_query(query, alias_flt[n]) \
+                if n in alias_flt else query
+            parsed = QueryParser(self.indices[n].mappers).parse(q_n)
             parsed.collect_terms(terms_by_field)
             nodes_by_index[n] = merge_query_batch([parsed])
         stats = CollectionStats.from_segments(

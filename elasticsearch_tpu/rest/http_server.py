@@ -266,6 +266,7 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         responses = []
         i = 0
         while i < len(lines):
+            start = i
             try:
                 head = json.loads(lines[i])
                 i += 1
@@ -277,7 +278,7 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                     body, type_name=meta.get("type", "_doc"),
                     doc_id=meta.get("id")))
             except Exception as e:  # noqa: BLE001 — per-item contract
-                i += i % 2   # re-align to the next header line
+                i = start + 2   # skip the malformed header+body pair
                 responses.append({"error": f"{type(e).__name__}[{e}]"})
         return 200, {"responses": responses}
     c.register("GET", "/_mpercolate", mpercolate_api)

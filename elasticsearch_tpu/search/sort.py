@@ -378,9 +378,17 @@ def compare_key(values: Sequence, specs: Sequence[SortSpec]) -> tuple:
             v = float(sp.missing)
         if v is None:
             rank = 1 if sp.missing == "_last" else -1
-            out.append((rank, 0))
+            out.append((rank, 0, 0))
         else:
-            out.append((0, _Rev(v) if sp.order == "desc" else v))
+            # type rank keeps cross-index comparisons total when the same
+            # sort field is keyword in one index and numeric in another:
+            # numbers < strings < everything else, never str-vs-float
+            # TypeError from the cross-shard reduce (advisor r4).
+            trank = 0 if _is_number(v) else (1 if isinstance(v, str) else 2)
+            if sp.order == "desc":
+                out.append((0, -trank, _Rev(v)))   # desc mirrors asc exactly
+            else:
+                out.append((0, trank, v))
     return tuple(out)
 
 

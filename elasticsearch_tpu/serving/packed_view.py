@@ -207,7 +207,15 @@ class PackedIndexView:
             per_seg.append((ei, fx, host_ids[:fx.n_postings]))
         if not per_seg:
             # stale PAD sentinels inside the old buffer are masked by the
-            # kernel's per-slot valid lanes, so the arrays are reusable
+            # kernel's per-slot valid lanes, so the arrays are reusable —
+            # but the old view's charge was released by IndexService, so the
+            # still-resident buffers must be re-charged into THIS view
+            # (check=False: memory already exists) or repeated NRT refreshes
+            # progressively undercount the request breaker (advisor r4).
+            reused = int(pf.doc_ids.size) * 12   # doc_ids+tf+dl at p_pad
+            if self.breaker is not None and reused:
+                self.breaker.add_estimate(reused, check=False)
+            self.memory_bytes += reused
             return pf
 
         base_p = pf.total_p

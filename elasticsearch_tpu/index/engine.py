@@ -84,7 +84,8 @@ class Engine:
         self.segments: list[Segment] = []
         self._buffer = SegmentBuilder(seg_id=0)
         # id -> (source, type, routing)
-        self._buffer_docs: dict[str, tuple[dict, str, str | None]] = {}
+        # id -> (source, type, routing, parent, ParsedDocument)
+        self._buffer_docs: dict[str, tuple] = {}
         self._next_seg_id = 1
         # LiveVersionMap: id -> (version, deleted)
         self.versions: dict[str, tuple[int, bool]] = {}
@@ -115,7 +116,8 @@ class Engine:
         # later segments override earlier ones for re-indexed docs
         for seg in segments:
             for local, doc_id in enumerate(seg.ids):
-                if seg.live_host[local]:
+                if seg.live_host[local] \
+                        and not seg.types[local].startswith("__"):
                     self.versions[doc_id] = (seg.versions[local], False)
         for doc_id, v in tombstones.items():
             self.versions[doc_id] = (int(v), True)
@@ -127,7 +129,8 @@ class Engine:
             if kind == "index":
                 self._apply_index(op["id"], op["source"], op.get("type", "_doc"),
                                   version=op["version"],
-                                  routing=op.get("routing"))
+                                  routing=op.get("routing"),
+                                  parent=op.get("parent"))
             elif kind == "delete":
                 self._apply_delete(op["id"], version=op["version"])
             n += 1
@@ -176,7 +179,8 @@ class Engine:
     def index(self, doc_id: str, source: dict, type_name: str = "_doc",
               version: int | None = None, version_type: str = "internal",
               op_type: str = "index", sync: bool | None = None,
-              routing: str | None = None) -> EngineResult:
+              routing: str | None = None,
+              parent: str | None = None) -> EngineResult:
         with self._lock:
             if self._blocked_reason is not None \
                     or len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
@@ -188,17 +192,29 @@ class Engine:
                 self.refresh()
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
-            self._apply_index(doc_id, source, type_name, new_version, routing)
-            self.translog.add({"op": "index", "id": doc_id, "type": type_name,
-                               "source": source, "version": new_version,
-                               "routing": routing},
-                              sync=sync)
+            self._apply_index(doc_id, source, type_name, new_version, routing,
+                              parent)
+            op = {"op": "index", "id": doc_id, "type": type_name,
+                  "source": source, "version": new_version,
+                  "routing": routing}
+            if parent is not None:
+                op["parent"] = parent
+            self.translog.add(op, sync=sync)
             return EngineResult(doc_id=doc_id, version=new_version, created=created)
 
     def _apply_index(self, doc_id: str, source: dict, type_name: str,
-                     version: int, routing: str | None = None) -> None:
+                     version: int, routing: str | None = None,
+                     parent: str | None = None) -> None:
+        # parse NOW, not at refresh: a malformed doc (bad date, missing
+        # parent, wrong vector dims) must 400 this request — parsing lazily
+        # would poison the shared refresh instead (ref IndexShard.prepareIndex
+        # parses before the engine op; code review r5)
+        mapper = self.mappers.document_mapper(type_name)
+        parsed = mapper.parse(source, doc_id=doc_id, routing=routing,
+                              parent=parent)
         self._delete_everywhere(doc_id)
-        self._buffer_docs[doc_id] = (source, type_name, routing)
+        self._buffer_docs[doc_id] = (source, type_name, routing, parent,
+                                     parsed)
         self.versions[doc_id] = (version, False)
         self._dirty = True
 
@@ -239,7 +255,8 @@ class Engine:
                 return GetResult(found=False, doc_id=doc_id)
             version = v[0]
             if realtime and doc_id in self._buffer_docs:
-                src, tname, routing = self._buffer_docs[doc_id]
+                src, tname, routing, _parent, _parsed = \
+                    self._buffer_docs[doc_id]
                 return GetResult(found=True, doc_id=doc_id, version=version,
                                  source=src, type_name=tname,
                                  routing=routing)
@@ -267,9 +284,8 @@ class Engine:
             if not self._buffer_docs:
                 return
             builder = SegmentBuilder(seg_id=self._next_seg_id)
-            for doc_id, (source, tname, routing) in self._buffer_docs.items():
-                mapper = self.mappers.document_mapper(tname)
-                parsed = mapper.parse(source, doc_id=doc_id, routing=routing)
+            for doc_id, (_src, tname, _routing, _parent, parsed) \
+                    in self._buffer_docs.items():
                 builder.add(parsed, tname,
                             version=self.versions[doc_id][0])
             if self.breaker is not None:
@@ -387,7 +403,10 @@ class Engine:
 
     def doc_count(self) -> int:
         with self._lock:
-            return sum(s.live_count for s in self.segments) + len(self._buffer_docs)
+            # root docs only — nested block rows are an implementation
+            # detail of the block join, not user documents
+            return sum(s.root_live_count for s in self.segments) \
+                + len(self._buffer_docs)
 
     def segment_stats(self) -> dict:
         return {"count": len(self.segments),

@@ -61,16 +61,27 @@ class IndexService:
     # -- document ops (ref index/shard/IndexShard.java:444-523) ------------
 
     def index_doc(self, doc_id: str, source: dict, type_name: str = "_doc",
-                  routing: str | None = None, **kw) -> EngineResult:
+                  routing: str | None = None, parent: str | None = None,
+                  **kw) -> EngineResult:
+        # _parent doubles as routing so parent and children co-locate
+        # (ref index/mapper/internal/ParentFieldMapper routing contract)
+        if parent is not None and routing is None:
+            routing = parent
         return self.shard_for(doc_id, routing).index(
-            doc_id, source, type_name=type_name, routing=routing, **kw)
+            doc_id, source, type_name=type_name, routing=routing,
+            parent=parent, **kw)
 
     def get_doc(self, doc_id: str, routing: str | None = None,
-                realtime: bool = True) -> GetResult:
+                realtime: bool = True,
+                parent: str | None = None) -> GetResult:
+        if parent is not None and routing is None:
+            routing = parent
         return self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
 
     def delete_doc(self, doc_id: str, routing: str | None = None,
-                   **kw) -> EngineResult:
+                   parent: str | None = None, **kw) -> EngineResult:
+        if parent is not None and routing is None:
+            routing = parent
         return self.shard_for(doc_id, routing).delete(doc_id, **kw)
 
     def sync_translogs(self) -> None:

@@ -162,6 +162,13 @@ class Segment:
     live_count: int = 0
     versions: list[int] = dc_field(default_factory=list)  # per local doc
     routings: list = dc_field(default_factory=list)       # per local doc
+    # block-join layout (ref Lucene block join / ObjectMapper nested mode):
+    # nested sub-document rows carry the local id of their ROOT document;
+    # root rows carry -1. None when the segment has no nested rows (the
+    # common case — zero overhead). Nested rows also appear in the
+    # `_nested_path` keyword column; they are excluded from every normal
+    # query/agg via root_live and only reachable through nested queries.
+    parent_of: np.ndarray | None = None   # i32[N_pad] host
 
     def __post_init__(self):
         # device liveness is uploaded lazily: deletes only dirty the host
@@ -170,6 +177,8 @@ class Segment:
         self._live_dev: jax.Array | None = None
         self._live_dirty = True
         self._live_padded: jax.Array | None = None
+        self._live_all_dev: jax.Array | None = None
+        self._parent_dev: jax.Array | None = None
         # monotonic tombstone generation: serving views (serving/packed_view)
         # cache packed liveness keyed on this, so delete-only changes refresh
         # one device row instead of rebuilding the view
@@ -183,18 +192,63 @@ class Segment:
 
     @property
     def live(self) -> jax.Array:
-        """bool[N_pad] device tombstone bitmap (Lucene liveDocs analog)."""
+        """bool[N_pad] device ROOT-doc liveness: tombstone bitmap AND not a
+        nested sub-row (Lucene liveDocs + the root-documents filter every
+        top-level query carries, ref NonNestedDocsFilter). Queries, aggs and
+        the packed/sparse lanes all consume this; nested rows are reachable
+        only through `live_all` (the raw bitmap) inside nested queries."""
         if self._live_dirty or self._live_dev is None:
-            self._live_dev = jnp.asarray(self.live_host)
+            self._live_dev = jnp.asarray(self.root_live_host)
+            self._live_all_dev = None
             self._live_padded = None
             self._live_dirty = False
         return self._live_dev
 
+    @property
+    def root_live_host(self) -> np.ndarray:
+        """bool[N_pad] host: live AND root (nested rows excluded)."""
+        if self.parent_of is None:
+            return self.live_host
+        return self.live_host & (self.parent_of < 0)
+
+    @property
+    def live_all(self) -> jax.Array:
+        """bool[N_pad] device raw tombstone bitmap INCLUDING nested rows —
+        only nested-query/agg evaluation wants this."""
+        if self.parent_of is None:
+            return self.live
+        if self._live_dirty or getattr(self, "_live_all_dev", None) is None:
+            _ = self.live                       # refresh both mirrors
+            self._live_all_dev = jnp.asarray(self.live_host)
+        return self._live_all_dev
+
+    @property
+    def parent_dev(self) -> jax.Array | None:
+        """i32[N_pad] device mirror of parent_of (lazy)."""
+        if self.parent_of is None:
+            return None
+        if getattr(self, "_parent_dev", None) is None:
+            self._parent_dev = jnp.asarray(self.parent_of)
+        return self._parent_dev
+
+    @property
+    def root_live_count(self) -> int:
+        """Live ROOT docs (what doc_count means to users)."""
+        if self.parent_of is None:
+            return self.live_count
+        return int(self.root_live_host[: self.n_docs].sum())
+
     def delete_local(self, local: int) -> bool:
-        """Flip the tombstone bit. Returns True if the doc was live."""
+        """Flip the tombstone bit (cascading to the doc's nested block rows).
+        Returns True if the doc was live."""
         if not self.live_host[local]:
             return False
         self.live_host[local] = False
+        if self.parent_of is not None:
+            for child in np.flatnonzero(self.parent_of == local):
+                if self.live_host[child]:
+                    self.live_host[child] = False
+                    self.live_count -= 1
         self._live_dirty = True
         self.live_gen += 1
         self.live_count -= 1
@@ -264,28 +318,50 @@ class SegmentBuilder:
         self.versions: list[int] = []
         self.routings: list = []
         self.id_to_local: dict[str, int] = {}
+        self.parent_of: list[int] = []   # per row; -1 = root
         self.n_docs = 0
 
     def add(self, doc: ParsedDocument, type_name: str = "_doc",
             version: int = 1) -> int:
+        """Add one document — and its nested block, children-first, root
+        last (Lucene block-join order; ref ObjectMapper nested mode).
+        Returns the ROOT row's local id."""
         # validate BEFORE mutating builder state: a mid-add raise must not
         # leave a half-indexed ghost doc behind (code review r3)
-        for field, tokens in doc.tokens.items():
-            if len(tokens) > _MAX_DOC_POSITIONS:
-                # position keys pack as doc * 2^21 + (pos + bias); a longer
-                # doc would collide with its neighbor's key space
-                # (search/query_dsl.py _POS_SHIFT/_POS_BIAS; advisor r2)
-                raise ValueError(
-                    f"field [{field}] has {len(tokens)} tokens; the maximum "
-                    f"is {_MAX_DOC_POSITIONS} per document")
+        for d in [doc] + [sub for _, sub in doc.nested]:
+            for field, tokens in d.tokens.items():
+                if len(tokens) > _MAX_DOC_POSITIONS:
+                    # position keys pack as doc * 2^21 + (pos + bias); a
+                    # longer doc would collide with its neighbor's key space
+                    # (search/query_dsl.py _POS_SHIFT/_POS_BIAS; advisor r2)
+                    raise ValueError(
+                        f"field [{field}] has {len(tokens)} tokens; the "
+                        f"maximum is {_MAX_DOC_POSITIONS} per document")
+        child_rows: list[int] = []
+        for path, sub in doc.nested:
+            row = self._add_row(sub, "__" + path, version,
+                                doc_id=f"{doc.doc_id}#n{self.n_docs}",
+                                register_id=False)
+            self._keywords.setdefault("_nested_path", {})[row] = path
+            child_rows.append(row)
+        local = self._add_row(doc, type_name, version, doc_id=doc.doc_id,
+                              register_id=True)
+        for r in child_rows:
+            self.parent_of[r] = local
+        return local
+
+    def _add_row(self, doc: ParsedDocument, type_name: str, version: int,
+                 doc_id: str, register_id: bool) -> int:
         local = self.n_docs
         self.n_docs += 1
         self.stored.append(doc.source)
-        self.ids.append(doc.doc_id)
+        self.ids.append(doc_id)
         self.types.append(type_name)
         self.versions.append(version)
         self.routings.append(doc.routing)
-        self.id_to_local[doc.doc_id] = local
+        self.parent_of.append(-1)
+        if register_id:
+            self.id_to_local[doc_id] = local
 
         for field, tokens in doc.tokens.items():
             fld = self._postings.setdefault(field, {})
@@ -416,12 +492,17 @@ class SegmentBuilder:
 
         live = np.zeros(n_pad, bool)
         live[:n] = True
+        parent_of = None
+        if any(p >= 0 for p in self.parent_of):
+            parent_of = np.full(n_pad, -1, np.int32)
+            parent_of[:n] = self.parent_of
         return Segment(
             seg_id=self.seg_id, n_docs=n, n_pad=n_pad, text=text,
             keywords=keywords, numerics=numerics, vectors=vectors,
             stored=self.stored, ids=self.ids, types=self.types,
             id_to_local=dict(self.id_to_local), live_host=live,
-            versions=list(self.versions), routings=list(self.routings))
+            versions=list(self.versions), routings=list(self.routings),
+            parent_of=parent_of)
 
 
 def merge_segments(segments: list[Segment], new_seg_id: int,
@@ -622,9 +703,30 @@ def merge_segments(segments: list[Segment], new_seg_id: int,
 
     live = np.zeros(n_pad, bool)
     live[:n] = True
+
+    # -- block-join parent pointers: remap through the same doc compaction.
+    # Children of dead roots are themselves dead (delete_local cascades),
+    # so every kept child's parent is kept too.
+    parent_of = None
+    if any(seg.parent_of is not None for seg in segments):
+        parent_of = np.full(n_pad, -1, np.int32)
+        for si, seg in enumerate(segments):
+            if seg.parent_of is None:
+                continue
+            keep = keeps[si]
+            old_p = seg.parent_of[keep]
+            has_p = old_p >= 0
+            parent_of[remaps[si][keep[has_p]]] = \
+                remaps[si][old_p[has_p]]
+        if not (parent_of >= 0).any():
+            parent_of = None
+
     return Segment(
         seg_id=new_seg_id, n_docs=n, n_pad=n_pad, text=text,
         keywords=keywords, numerics=numerics, vectors=vectors,
         stored=stored, ids=ids, types=types,
-        id_to_local={d: i for i, d in enumerate(ids)}, live_host=live,
-        versions=versions, routings=routings)
+        # nested placeholder rows (type "__<path>") are not id-addressable
+        id_to_local={d: i for i, d in enumerate(ids)
+                     if not types[i].startswith("__")},
+        live_host=live,
+        versions=versions, routings=routings, parent_of=parent_of)

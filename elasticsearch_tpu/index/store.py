@@ -79,6 +79,8 @@ class SegmentStore:
                 [r if r is not None else "" for r in
                  (seg.routings or [None] * seg.n_docs)], dtype=np.str_),
         }
+        if seg.parent_of is not None:
+            arrays["parent_of"] = np.asarray(seg.parent_of, np.int32)
         schema: dict = {"n_docs": seg.n_docs, "n_pad": seg.n_pad,
                         "text": {}, "keywords": [], "numerics": {},
                         "vectors": {}}
@@ -261,5 +263,9 @@ class SegmentStore:
             seg_id=entry["seg_id"], n_docs=n_docs, n_pad=n_pad, text=text,
             keywords=keywords, numerics=numerics, vectors=vectors,
             stored=stored, ids=ids, types=types,
-            id_to_local={d: i for i, d in enumerate(ids)},
-            live_host=live, versions=versions, routings=routings)
+            # nested placeholder rows (type "__<path>") are not addressable
+            id_to_local={d: i for i, d in enumerate(ids)
+                         if not types[i].startswith("__")},
+            live_host=live, versions=versions, routings=routings,
+            parent_of=np.asarray(data["parent_of"], np.int32)
+            if "parent_of" in data else None)

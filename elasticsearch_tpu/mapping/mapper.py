@@ -14,8 +14,14 @@ typed channels that the tensor segment builder consumes:
   dense_vector   -> float list            -> [N, dim] matrix for kNN
 
 Differences from the reference, by design:
-  * Object fields flatten to dot-paths (same as reference); `nested` is not
-    yet supported.
+  * Object fields flatten to dot-paths (same as reference); `nested` objects
+    parse into per-element sub-documents (ParsedDocument.nested) that the
+    segment builder lays out as ADJACENT ROWS before their root document
+    with a parent-pointer column — the tensor analog of Lucene's block join
+    (ref index/mapper/object/ObjectMapper.java nested mode).
+  * `_parent` (ref index/mapper/internal/ParentFieldMapper) becomes a
+    keyword column `_parent` on child documents; the parent id doubles as
+    routing so parent and children share a shard.
   * `string` fields are mapped to text (analyzed) unless
     `"index": "not_analyzed"` (ES 2.x) — and modern `text`/`keyword` types are
     accepted directly.
@@ -50,6 +56,12 @@ IP = "ip"
 DENSE_VECTOR = "dense_vector"
 GEO_POINT = "geo_point"
 OBJECT = "object"
+NESTED = "nested"
+
+# keyword column recording which nested path a sub-document row belongs to
+NESTED_PATH_FIELD = "_nested_path"
+# keyword column holding a child document's parent id (_parent mapping)
+PARENT_FIELD = "_parent"
 
 _INT_TYPES = {LONG, INTEGER, SHORT, BYTE}
 _FLOAT_TYPES = {DOUBLE, FLOAT}
@@ -177,6 +189,9 @@ class ParsedDocument:
     longs: dict[str, list[int]] = dc_field(default_factory=dict)       # long/int/date/ip/bool
     vectors: dict[str, list[float]] = dc_field(default_factory=dict)   # dense_vector
     geo: dict[str, tuple[float, float]] = dc_field(default_factory=dict)  # (lat, lon)
+    # nested sub-documents: (path, sub-doc) in source order — the builder
+    # lays them out as adjacent rows BEFORE this root doc (block join order)
+    nested: list = dc_field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +218,10 @@ class DocumentMapper:
         self.dynamic = dynamic
         self.date_detection = date_detection
         self._mapping_version = 0
+        # nested object paths -> {"include_in_parent": bool, "include_in_root": bool}
+        self.nested_paths: dict[str, dict] = {}
+        # _parent mapping: the parent TYPE this type's docs join to
+        self.parent_type: str | None = None
         if mapping:
             self.merge_mapping(mapping)
 
@@ -212,11 +231,27 @@ class DocumentMapper:
         """Merge a mapping dict ({"properties": {...}}). Returns True if the
         schema changed. Raises MergeMappingException on type conflicts
         (ref: MapperService.merge / DocumentMapper.merge)."""
-        props = mapping.get("properties", mapping)
+        props = mapping.get("properties")
+        if props is None:
+            # bare property map: strip meta fields (_parent, _all, ...)
+            props = {k: v for k, v in mapping.items()
+                     if not k.startswith("_")
+                     and k not in ("dynamic", "date_detection")}
         if "dynamic" in mapping:
             dyn = mapping["dynamic"]
             self.dynamic = dyn is True or str(dyn).lower() == "true"
-        changed = self._merge_props("", props)
+        changed = False
+        pt = mapping.get("_parent", {}).get("type") \
+            if isinstance(mapping.get("_parent"), dict) else None
+        if pt is not None:
+            if self.parent_type is not None and self.parent_type != pt:
+                raise MergeMappingException(
+                    f"The _parent field's type option can't be changed: "
+                    f"[{self.parent_type}]->[{pt}]")
+            if self.parent_type is None:
+                self.parent_type = pt
+                changed = True
+        changed |= self._merge_props("", props)
         if changed:
             self._mapping_version += 1
         return changed
@@ -231,6 +266,14 @@ class DocumentMapper:
                 changed |= self._merge_props(path + ".", spec["properties"])
                 continue
             ftype = _TYPE_ALIASES.get(spec.get("type", OBJECT), spec.get("type", OBJECT))
+            if ftype == NESTED:
+                if path not in self.nested_paths:
+                    self.nested_paths[path] = {
+                        "include_in_parent": bool(spec.get("include_in_parent")),
+                        "include_in_root": bool(spec.get("include_in_root"))}
+                    changed = True
+                changed |= self._merge_props(path + ".", spec.get("properties", {}))
+                continue
             if ftype == OBJECT:
                 changed |= self._merge_props(path + ".", spec.get("properties", {}))
                 continue
@@ -278,13 +321,37 @@ class DocumentMapper:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = ft.to_dict()
-        return {"properties": root}
+        for path, opts in self.nested_paths.items():
+            parts = path.split(".")
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            leaf = node.setdefault(parts[-1], {})
+            leaf["type"] = NESTED
+            for k in ("include_in_parent", "include_in_root"):
+                if opts.get(k):
+                    leaf[k] = True
+        out: dict[str, Any] = {"properties": root}
+        if self.parent_type:
+            out["_parent"] = {"type": self.parent_type}
+        return out
 
     # -- document parsing --------------------------------------------------
 
-    def parse(self, source: dict, doc_id: str, routing: str | None = None) -> ParsedDocument:
+    def parse(self, source: dict, doc_id: str, routing: str | None = None,
+              parent: str | None = None) -> ParsedDocument:
         doc = ParsedDocument(doc_id=doc_id, routing=routing, source=source)
         new_fields: dict[str, FieldType] = {}
+        if parent is not None:
+            if self.parent_type is None:
+                raise MapperParsingException(
+                    f"can't specify parent if no parent field has been "
+                    f"configured for type [{self.type_name}]")
+            doc.keywords[PARENT_FIELD] = [str(parent)]
+        elif self.parent_type is not None:
+            raise MapperParsingException(
+                f"routing is required for [{self.type_name}] documents: "
+                f"parent id missing")
         self._parse_obj("", source, doc, new_fields)
         if new_fields:
             if not self.dynamic:
@@ -305,6 +372,26 @@ class DocumentMapper:
             if value is None:
                 continue
             path = f"{prefix}{name}"
+            if path in self.nested_paths:
+                # nested object: each element becomes a sub-document row in
+                # the block (ref ObjectMapper nested mode — one Lucene doc
+                # per element, root doc last in the block)
+                opts = self.nested_paths[path]
+                elems = value if isinstance(value, list) else [value]
+                for elem in elems:
+                    if not isinstance(elem, dict):
+                        raise MapperParsingException(
+                            f"object mapping for [{path}] tried to parse "
+                            f"field as object, but found a concrete value")
+                    sub = ParsedDocument(doc_id=doc.doc_id, routing=None,
+                                         source=elem)
+                    self._parse_obj(path + ".", elem, sub, new_fields)
+                    doc.nested.append((path, sub))
+                    if opts.get("include_in_parent") \
+                            or opts.get("include_in_root"):
+                        # ALSO flatten into the root doc (ES option)
+                        self._parse_obj(path + ".", elem, doc, new_fields)
+                continue
             if isinstance(value, dict):
                 ft = self.fields.get(path)
                 if ft is not None and ft.type == GEO_POINT:
@@ -442,6 +529,14 @@ class MapperService:
             if ft is not None:
                 return ft
         return None
+
+    def nested_path(self, path: str) -> bool:
+        """True if any type maps `path` as a nested object."""
+        return any(path in m.nested_paths for m in self._mappers.values())
+
+    def parent_type_of(self, child_type: str) -> str | None:
+        m = self._mappers.get(child_type)
+        return m.parent_type if m is not None else None
 
     def mapping_version(self) -> int:
         return sum(m._mapping_version for m in self._mappers.values())

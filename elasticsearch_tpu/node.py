@@ -321,22 +321,29 @@ class NodeService:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    type_name: str = "_doc",
-                   version: int | None = None) -> tuple[EngineResult, bool]:
+                   version: int | None = None,
+                   routing: str | None = None,
+                   parent: str | None = None) -> tuple[EngineResult, bool]:
         """Scripted/partial update: get -> transform -> reindex
         (ref action/update/UpdateHelper.java:61). Returns (result, noop).
-        Auto-creates the index like the reference's update-with-upsert."""
+        Auto-creates the index like the reference's update-with-upsert.
+        routing/parent route the get AND carry into the re-index so child
+        documents keep their _parent (code review r5)."""
         if index not in self.indices:
             if not _VALID_INDEX.match(index):
                 raise InvalidIndexNameException(index)
             self.create_index(index)
         svc = self.index_service(index)
-        cur = svc.get_doc(doc_id)
+        cur = svc.get_doc(doc_id, routing=routing, parent=parent)
         if not cur.found:
             if "upsert" in body:
-                res = svc.index_doc(doc_id, body["upsert"], type_name=type_name)
+                res = svc.index_doc(doc_id, body["upsert"],
+                                    type_name=type_name,
+                                    routing=routing, parent=parent)
                 return res, False
             if body.get("doc_as_upsert") and "doc" in body:
-                res = svc.index_doc(doc_id, body["doc"], type_name=type_name)
+                res = svc.index_doc(doc_id, body["doc"], type_name=type_name,
+                                    routing=routing, parent=parent)
                 return res, False
             raise DocumentMissingException(f"[{type_name}][{doc_id}]: document missing")
         if version is not None and cur.version != version:
@@ -364,8 +371,15 @@ class NodeService:
                 return EngineResult(doc_id=doc_id, version=cur.version,
                                     created=False), True
             src = merged
+        if parent is None and svc.mappers.parent_type_of(cur.type_name):
+            # child docs route by parent id, so the stored routing IS the
+            # parent (ref UpdateHelper preserves _parent across the reindex)
+            parent = routing if routing is not None else cur.routing
         res = svc.index_doc(doc_id, src, type_name=cur.type_name,
-                            version=cur.version)
+                            version=cur.version,
+                            routing=routing if routing is not None
+                            else cur.routing,
+                            parent=parent)
         return res, False
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
@@ -385,6 +399,7 @@ class NodeService:
                         index, doc_id, source, type_name=type_name,
                         op_type="create" if action == "create" else "index",
                         routing=meta.get("_routing") or meta.get("routing"),
+                        parent=meta.get("_parent") or meta.get("parent"),
                         sync=False)
                     touched.add(index)
                     items.append({action: {

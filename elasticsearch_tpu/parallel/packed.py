@@ -81,8 +81,9 @@ class PackedIndex:
         counts = np.zeros((S,), np.int32)
         fields: set[str] = set()
         for si, seg in enumerate(shard_segments):
-            live[si, :seg.n_docs] = seg.live_host[:seg.n_docs]
-            counts[si] = seg.live_count
+            # nested block rows never serve as top-level hits (root live)
+            live[si, :seg.n_docs] = seg.root_live_host[:seg.n_docs]
+            counts[si] = seg.root_live_count
             fields.update(seg.text.keys())
 
         text: dict[str, PackedTextField] = {}

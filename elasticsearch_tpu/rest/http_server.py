@@ -58,8 +58,10 @@ def _status_of(e: Exception) -> int:
     if isinstance(e, VersionConflictException):
         return 409
     from ..script.engine import ScriptException
+    from ..mapping.mapper import MapperParsingException, MergeMappingException
     if isinstance(e, (InvalidIndexNameException, QueryParsingException,
                       AggregationParsingException, ScriptException,
+                      MapperParsingException, MergeMappingException,
                       json.JSONDecodeError, KeyError, ValueError)):
         return 400
     return 500
@@ -539,7 +541,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             kw["version_type"] = p["version_type"][0]
         _, res = node.index_doc(g["index"], g.get("id"), _json_body(b),
                                 type_name=g.get("type", "_doc"),
-                                routing=p.get("routing", [None])[0], **kw)
+                                routing=p.get("routing", [None])[0],
+                                parent=p.get("parent", [None])[0], **kw)
         if p.get("refresh", ["false"])[0] != "false":
             node.refresh(g["index"])
         status = 201 if res.created else 200
@@ -565,6 +568,7 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             node.refresh(g["index"])
         res = node.get_doc(g["index"], g["id"],
                            routing=p.get("routing", [None])[0],
+                           parent=p.get("parent", [None])[0],
                            realtime=realtime)
         if res.found and "version" in p \
                 and int(p["version"][0]) != res.version:
@@ -638,7 +642,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         if "version_type" in p:
             kw["version_type"] = p["version_type"][0]
         res = node.delete_doc(g["index"], g["id"],
-                              routing=p.get("routing", [None])[0], **kw)
+                              routing=p.get("routing", [None])[0],
+                              parent=p.get("parent", [None])[0], **kw)
         if p.get("refresh", ["false"])[0] != "false":
             node.refresh(g["index"])
         return (200 if res.found else 404), {
@@ -658,7 +663,9 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         if "version" in p:
             kw["version"] = int(p["version"][0])
         res, noop = node.update_doc(g["index"], g["id"], _json_body(b),
-                                    type_name=g.get("type", "_doc"), **kw)
+                                    type_name=g.get("type", "_doc"),
+                                    routing=p.get("routing", [None])[0],
+                                    parent=p.get("parent", [None])[0], **kw)
         if p.get("refresh", ["false"])[0] != "false":
             node.refresh(g["index"])
         out = {"_index": g["index"], "_type": g.get("type", "_doc"),

@@ -41,7 +41,7 @@ from .tdigest import TDigest
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing",
-                "significant_terms"}
+                "significant_terms", "nested", "reverse_nested", "children"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                 "extended_stats", "cardinality", "percentiles", "top_hits"}
 
@@ -159,6 +159,26 @@ def _mv(m) -> MaskView:
     return m if isinstance(m, MaskView) else MaskView(m)
 
 
+class _ShardScopedParser:
+    """Wraps the query parser so filter/filters agg queries that contain
+    parent/child joins resolve against the WHOLE shard's segments (the join
+    spans segments; per-segment execution of an unresolved HasChildNode
+    raises — code review r5)."""
+
+    def __init__(self, qp, segments):
+        self._qp = qp
+        self._segments = segments
+        self.mappers = qp.mappers
+
+    def parse(self, spec):
+        node = self._qp.parse(spec)
+        from ..query_dsl import contains_joins
+        if contains_joins(node):
+            from ..joins import resolve_joins
+            node = resolve_joins(node, self._segments, self.mappers, 1)
+        return node
+
+
 def collect_shard(specs: list[AggSpec], segments: list[Segment],
                   masks: list,
                   query_parser=None, scores: list | None = None) -> dict:
@@ -168,6 +188,9 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
     scores[i]: optional f32[n_pad] score row per segment (top_hits needs it).
     query_parser: compiles filter/filters sub-queries (avoids circular import).
     """
+    if query_parser is not None \
+            and not isinstance(query_parser, _ShardScopedParser):
+        query_parser = _ShardScopedParser(query_parser, segments)
     masks = [_mv(m) for m in masks]
     if scores is None:
         scores = [None] * len(segments)
@@ -181,6 +204,10 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
             partials[spec.name] = _collect_sig_terms_shard(
                 spec, segments, masks, query_parser, scores)
             continue
+        if spec.type == "children":
+            partials[spec.name] = _collect_children_shard(
+                spec, segments, masks, query_parser, scores)
+            continue
         segs_partials = [
             _collect_one(spec, seg, mask, query_parser, scores_row=sc)
             for seg, mask, sc in zip(segments, masks, scores)]
@@ -189,6 +216,52 @@ def collect_shard(specs: list[AggSpec], segments: list[Segment],
             merged = merge_partial(spec, merged, p)
         partials[spec.name] = merged
     return partials
+
+
+def _collect_children_shard(spec: AggSpec, segments: list[Segment],
+                            masks: list, qp,
+                            scores: list | None = None) -> dict:
+    """children agg (ref search/aggregations/bucket/children/
+    ParentToChildrenAggregator): parent docs in the bucket -> their child
+    docs of `type`. The p/c join spans segments (children landed wherever
+    their own rows did), so it is a shard-level two-pass: collect parent
+    ids, then mask children per segment via the _parent ordinal column.
+    Supported at the top of the agg tree (per-bucket sub-agg joins would
+    need the cross-segment bucket context)."""
+    ctype = str(spec.params.get("type", ""))
+    if scores is None:
+        scores = [None] * len(segments)
+    parent_ids: set = set()
+    for seg, mask in zip(segments, masks):
+        m = _mv(mask).np
+        for r in np.flatnonzero(m[: seg.n_docs]):
+            parent_ids.add(seg.ids[r])
+    merged = None
+    for seg, sc in zip(segments, scores):
+        kc = seg.keywords.get("_parent")
+        if kc is None:
+            continue
+        in_set = np.array([v in parent_ids for v in kc.values] + [False])
+        ords = np.asarray(kc.ords)
+        cmask = in_set[np.where(ords >= 0, ords, len(kc.values))]
+        cmask &= np.array(
+            [t == ctype for t in seg.types]
+            + [False] * (seg.n_pad - seg.n_docs), bool)
+        cmask &= seg.live_host
+        part = _bucket_entry(spec, seg, cmask, qp, sc)
+        merged = part if merged is None else _merge_entry(spec, merged, part)
+    if merged is None:
+        merged = {"doc_count": 0}
+    return {"buckets": {"_children": merged}}
+
+
+def _merge_entry(spec: AggSpec, a: dict, b: dict) -> dict:
+    out = {"doc_count": a["doc_count"] + b["doc_count"]}
+    if spec.subs:
+        out["subs"] = {s.name: merge_partial(s, a["subs"][s.name],
+                                             b["subs"][s.name])
+                       for s in spec.subs}
+    return out
 
 
 def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
@@ -212,7 +285,7 @@ def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
             fg_total += int(np.asarray(count_mask(mv.dev)))
         else:
             fg_total += int(mv.np.sum())
-        bg_total += seg.live_count
+        bg_total += seg.root_live_count
     size = int(spec.params.get("size", 10)) or len(fg) or 1
     shard_size = int(spec.params.get("shard_size", size * 3 + 10))
     top = sorted(fg.items(), key=lambda kv: (-kv[1], str(kv[0])))[:shard_size]
@@ -227,7 +300,7 @@ def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
             if m_key is None:
                 continue
             bg += int((m_key[: seg.n_pad]
-                       & seg.live_host[: len(m_key)]).sum())
+                       & seg.root_live_host[: len(m_key)]).sum())
             if spec.subs:
                 m = m_key & _mv(mask).np
                 for s in spec.subs:
@@ -369,6 +442,8 @@ def _collect_one(spec: AggSpec, seg: Segment, mask,
                  qp=None, scores_row=None) -> dict:
     if spec.type == "top_hits":
         return _top_hits_segment(spec, seg, _mv(mask).np, scores_row)
+    if spec.type == "terms":               # as a sub-aggregation
+        return _collect_terms_shard(spec, [seg], [mask], qp, [scores_row])
     if spec.type == "significant_terms":   # as a sub-aggregation
         return _collect_sig_terms_shard(spec, [seg], [mask], qp,
                                         [scores_row])
@@ -480,6 +555,35 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
         return {"buckets": {"_global": _bucket_entry(
             spec, seg, live, qp, scores_row)}}
 
+    if t == "nested":
+        # switch the doc set from ROOT rows to this path's nested block
+        # rows whose root is in the current bucket (ref search/aggregations/
+        # bucket/nested/NestedAggregator.java — child-doc iteration becomes
+        # one parent-gather over the block-join column)
+        path = str(p.get("path", ""))
+        kc = seg.keywords.get("_nested_path")
+        child = np.zeros(n, bool)
+        if kc is not None and seg.parent_of is not None:
+            o = kc.ord_of(path)
+            if o >= 0:
+                is_child = (np.asarray(kc.ords) == o) \
+                    & seg.live_host & (seg.parent_of >= 0)
+                child = is_child & mask[np.maximum(seg.parent_of, 0)]
+        return {"buckets": {"_nested": _bucket_entry(spec, seg, child, qp,
+                                                     scores_row)}}
+
+    if t == "reverse_nested":
+        # back out of nested context to the root docs (ref bucket/nested/
+        # ReverseNestedAggregator.java; path-targeted variants reduce to
+        # the root here because parent_of always points at the root row)
+        roots = np.zeros(n, bool)
+        if seg.parent_of is not None:
+            sel = np.flatnonzero(mask & (seg.parent_of >= 0))
+            roots[seg.parent_of[sel]] = True
+            roots &= seg.root_live_host
+        return {"buckets": {"_reverse": _bucket_entry(spec, seg, roots, qp,
+                                                      scores_row)}}
+
     if t == "filter":
         sub_mask = _filter_mask(p, seg, qp)
         m = mask & sub_mask
@@ -554,6 +658,10 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
             out[key] = e
         return {"buckets": out}
 
+    if t == "children":
+        raise AggregationParsingException(
+            "children aggregation is supported at the top of the agg tree "
+            "(the parent/child join needs cross-segment bucket context)")
     raise AggregationParsingException(f"unsupported bucket agg [{t}]")
 
 

@@ -1,0 +1,132 @@
+"""Shard-level parent/child join resolution.
+
+The reference joins parent and child docs through global ordinals on the
+`_parent` field (index/fielddata/plain/ParentChildIndexFieldData.java,
+index/query/HasChildQueryParser.java, HasParentQueryParser.java). Children
+are routed to the parent's shard (routing = parent id), so the join is
+always shard-local — but it spans SEGMENTS, which the per-segment Node
+execution model cannot see. This pass runs before the query phase: it
+executes each join's inner query over all of the shard's segments, builds
+the id->score table on the host, and substitutes a segment-executable
+bitmap node (IdScoreNode / ParentRefNode) into the tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .query_dsl import (CollectionStats, HasChildNode, HasParentNode,
+                        IdScoreNode, Node, ParentRefNode, SegmentContext,
+                        contains_joins)
+
+
+def resolve_joins(node: Node, segments, mappers, Q: int) -> Node:
+    """Return a tree with every HasChildNode/HasParentNode replaced by its
+    resolved, per-segment-executable form. No-op when the tree has none."""
+    if not contains_joins(node):
+        return node
+    if isinstance(node, HasChildNode):
+        inner = resolve_joins(node.inner, segments, mappers, Q)
+        return _resolve_has_child(node, inner, segments, mappers, Q)
+    if isinstance(node, HasParentNode):
+        inner = resolve_joins(node.inner, segments, mappers, Q)
+        return _resolve_has_parent(node, inner, segments, mappers, Q)
+    kwargs = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, Node):
+            kwargs[f.name] = resolve_joins(v, segments, mappers, Q)
+        elif isinstance(v, list) and v and isinstance(v[0], Node):
+            kwargs[f.name] = [resolve_joins(x, segments, mappers, Q)
+                              for x in v]
+        else:
+            kwargs[f.name] = v
+    return type(node)(**kwargs)
+
+
+def _inner_matches(inner: Node, segments, Q: int):
+    """Run the (already join-free) inner query over all segments; yield
+    (segment, scores np[Q, n_pad], match np[Q, n_pad])."""
+    terms: dict[str, set] = {}
+    inner.collect_terms(terms)
+    stats = CollectionStats.from_segments(segments, terms)
+    for seg in segments:
+        if seg.n_docs == 0:
+            continue
+        ctx = SegmentContext(seg, Q, stats)
+        s, m = inner.execute(ctx)
+        m = m & seg.live[None, :]         # live ROOT docs only
+        yield seg, np.asarray(s), np.asarray(m)
+
+
+def _resolve_has_child(n: HasChildNode, inner: Node, segments, mappers,
+                       Q: int) -> IdScoreNode:
+    """Match children of `child_type` with the inner query, aggregate their
+    scores per parent id under score_mode, emit the parent-id table."""
+    parent_type = mappers.parent_type_of(n.child_type)
+    # (sum, count, max, min) running aggregate per parent id, per query row
+    acc: list[dict] = [dict() for _ in range(Q)]
+    for seg, s, m in _inner_matches(inner, segments, Q):
+        kc = seg.keywords.get("_parent")
+        if kc is None:
+            continue
+        ords = np.asarray(kc.ords)
+        tmask = np.array([t == n.child_type for t in seg.types], bool)
+        for qi in range(Q):
+            rows = np.flatnonzero(m[qi][: seg.n_docs]
+                                  & tmask & (ords[: seg.n_docs] >= 0))
+            for r in rows:
+                pid = kc.values[ords[r]]
+                sc = float(s[qi, r])
+                st = acc[qi].get(pid)
+                if st is None:
+                    acc[qi][pid] = [sc, 1, sc, sc]
+                else:
+                    st[0] += sc
+                    st[1] += 1
+                    st[2] = max(st[2], sc)
+                    st[3] = min(st[3], sc)
+    tables: list[dict] = []
+    for qi in range(Q):
+        t = {}
+        for pid, (tot, cnt, mx, mn) in acc[qi].items():
+            if n.min_children and cnt < n.min_children:
+                continue
+            if n.max_children and cnt > n.max_children:
+                continue
+            if n.score_mode in ("sum", "total"):
+                t[pid] = tot
+            elif n.score_mode == "max":
+                t[pid] = mx
+            elif n.score_mode == "min":
+                t[pid] = mn
+            elif n.score_mode == "avg":
+                t[pid] = tot / cnt
+            else:                         # none: constant
+                t[pid] = 1.0
+        tables.append(t)
+    return IdScoreNode(boost=n.boost, tables=tables,
+                       type_filter=parent_type)
+
+
+def _resolve_has_parent(n: HasParentNode, inner: Node, segments, mappers,
+                        Q: int) -> ParentRefNode:
+    """Match parents of `parent_type`; children whose _parent is in the
+    matched set match, inheriting the parent score if score_mode=score."""
+    child_types = tuple(sorted(
+        t for t in mappers.types()
+        if mappers.parent_type_of(t) == n.parent_type))
+    tables: list[dict] = [dict() for _ in range(Q)]
+    for seg, s, m in _inner_matches(inner, segments, Q):
+        types = seg.types
+        for qi in range(Q):
+            rows = np.flatnonzero(m[qi][: seg.n_docs])
+            for r in rows:
+                if types[r] != n.parent_type:
+                    continue
+                tables[qi][seg.ids[r]] = float(s[qi, r]) \
+                    if n.score_mode == "score" else 1.0
+    return ParentRefNode(boost=n.boost, tables=tables,
+                        child_types=child_types)

@@ -867,6 +867,206 @@ class IdsNode(Node):
 
 
 @dataclass
+class NestedNode(Node):
+    """nested query (ref index/query/NestedQueryParser.java +
+    Lucene ToParentBlockJoinQuery): run the inner query over the path's
+    nested block rows, then join child scores to ROOT rows through the
+    segment's parent_of column — the block join is ONE scatter-reduce on
+    device instead of Lucene's per-doc parent-bitset iteration."""
+    path: str = ""
+    inner: Node | None = None
+    score_mode: str = "avg"
+
+    def collect_terms(self, out):
+        self.inner.collect_terms(out)
+
+    def _child_mask(self, ctx):
+        """bool[1, n_pad]: live nested rows on this path, or None."""
+        seg = ctx.segment
+        kc = seg.keywords.get("_nested_path")
+        if seg.parent_dev is None or kc is None:
+            return None
+        o = kc.ord_of(self.path)
+        if o < 0:
+            return None
+        return (kc.ords == o)[None, :] & seg.live_all[None, :]
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        child = self._child_mask(ctx)
+        if child is None:
+            return _zeros(ctx), _false(ctx)
+        s, m = self.inner.execute(ctx)
+        m = m & child
+        safe_parent = jnp.maximum(seg.parent_dev, 0)
+        match_p = _false(ctx).at[:, safe_parent].max(m)
+        if self.score_mode == "none":
+            scores_p = jnp.where(match_p, jnp.float32(self.boost), 0.0)
+        elif self.score_mode == "max":
+            mx = jnp.full((ctx.Q, ctx.n_pad), -jnp.inf, jnp.float32) \
+                .at[:, safe_parent].max(jnp.where(m, s, -jnp.inf))
+            scores_p = jnp.where(match_p, mx * self.boost, 0.0)
+        elif self.score_mode == "min":
+            mn = jnp.full((ctx.Q, ctx.n_pad), jnp.inf, jnp.float32) \
+                .at[:, safe_parent].min(jnp.where(m, s, jnp.inf))
+            scores_p = jnp.where(match_p, mn * self.boost, 0.0)
+        else:                         # sum / avg / "total"
+            tot = _zeros(ctx).at[:, safe_parent].add(jnp.where(m, s, 0.0))
+            if self.score_mode in ("sum", "total"):
+                scores_p = jnp.where(match_p, tot * self.boost, 0.0)
+            else:                     # avg (ES default)
+                cnt = jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32) \
+                    .at[:, safe_parent].add(m.astype(jnp.float32))
+                scores_p = jnp.where(match_p,
+                                     tot / jnp.maximum(cnt, 1.0) * self.boost,
+                                     0.0)
+        # parent must itself be a live root row
+        match_p = match_p & seg.live[None, :]
+        return jnp.where(match_p, scores_p, 0.0), match_p
+
+    def match_mask(self, ctx):
+        seg = ctx.segment
+        child = self._child_mask(ctx)
+        if child is None:
+            return _false(ctx)
+        m = self.inner.match_mask(ctx) & child
+        safe_parent = jnp.maximum(seg.parent_dev, 0)
+        return _false(ctx).at[:, safe_parent].max(m) & seg.live[None, :]
+
+    def plan_key(self):
+        return ("nested", self.path, self.score_mode,
+                self.inner.plan_key())
+
+
+@dataclass
+class HasChildNode(Node):
+    """has_child (ref index/query/HasChildQueryParser.java). Parent/child
+    spans SEGMENTS (children live wherever their own rows landed), so this
+    node cannot execute per-segment: ShardSearcher resolves it into an
+    IdScoreNode via a shard-level host join first (the global-ordinals
+    p/c join analog, ref index/fielddata/plain/ParentChildIndexFieldData)."""
+    child_type: str = ""
+    inner: Node | None = None
+    score_mode: str = "none"
+    min_children: int = 0
+    max_children: int = 0
+
+    def collect_terms(self, out):
+        pass    # inner stats are computed during shard-level resolution
+
+    def execute(self, ctx):
+        raise QueryParsingException(
+            "has_child must be resolved at shard level before execution")
+
+    def plan_key(self):
+        return ("has_child", self.child_type, self.score_mode,
+                self.min_children, self.max_children,
+                self.inner.plan_key())
+
+
+@dataclass
+class HasParentNode(Node):
+    """has_parent (ref index/query/HasParentQueryParser.java); resolved at
+    shard level like HasChildNode."""
+    parent_type: str = ""
+    inner: Node | None = None
+    score_mode: str = "none"     # none | score
+
+    def collect_terms(self, out):
+        pass
+
+    def execute(self, ctx):
+        raise QueryParsingException(
+            "has_parent must be resolved at shard level before execution")
+
+    def plan_key(self):
+        return ("has_parent", self.parent_type, self.score_mode,
+                self.inner.plan_key())
+
+
+@dataclass
+class IdScoreNode(Node):
+    """Resolved form of has_child: per-query {doc_id: score} tables,
+    optionally restricted to one _type. Host-built bitmap per segment."""
+    tables: list[dict] = dc_field(default_factory=list)   # per query row
+    type_filter: str | None = None
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        Q = ctx.Q
+        sc = np.zeros((Q, ctx.n_pad), np.float32)
+        mk = np.zeros((Q, ctx.n_pad), bool)
+        for qi, table in enumerate(self.tables[:Q]):
+            for did, v in table.items():
+                local = seg.id_to_local.get(did)
+                if local is None:
+                    continue
+                if self.type_filter is not None \
+                        and seg.types[local] != self.type_filter:
+                    continue
+                mk[qi, local] = True
+                sc[qi, local] = v
+        match = jnp.asarray(mk)
+        return jnp.asarray(sc) * jnp.float32(self.boost), match
+
+    def plan_key(self):
+        return ("id_score", self.type_filter)
+
+
+@dataclass
+class ParentRefNode(Node):
+    """Resolved form of has_parent: match docs whose _parent value is in a
+    per-query {parent_id: score} table; the child doc inherits the parent's
+    score when score_mode=score."""
+    tables: list[dict] = dc_field(default_factory=list)
+    child_types: tuple = ()        # types whose _parent mapping joins here
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        Q = ctx.Q
+        kc = seg.keywords.get("_parent")
+        if kc is None:
+            return _zeros(ctx), _false(ctx)
+        n_vals = len(kc.values)
+        lut_s = np.zeros((Q, n_vals + 1), np.float32)
+        lut_m = np.zeros((Q, n_vals + 1), bool)
+        for qi, table in enumerate(self.tables[:Q]):
+            for vi, v in enumerate(kc.values):
+                s = table.get(v)
+                if s is not None:
+                    lut_m[qi, vi] = True
+                    lut_s[qi, vi] = s
+        col = np.asarray(kc.ords)            # -1 = missing -> last slot
+        col = np.where(col >= 0, col, n_vals)
+        sc = lut_s[:, col]
+        mk = lut_m[:, col]
+        if self.child_types:
+            tmask = np.array([t in self.child_types for t in seg.types]
+                             + [False] * (ctx.n_pad - seg.n_docs), bool)
+            mk = mk & tmask[None, :]
+        match = jnp.asarray(mk)
+        return jnp.asarray(sc) * jnp.float32(self.boost), match
+
+    def plan_key(self):
+        return ("parent_ref", self.child_types)
+
+
+def contains_joins(node: Node) -> bool:
+    """True if the tree holds any unresolved parent/child join node."""
+    if isinstance(node, (HasChildNode, HasParentNode)):
+        return True
+    import dataclasses as _dc
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, Node) and contains_joins(v):
+            return True
+        if isinstance(v, list) and any(
+                isinstance(x, Node) and contains_joins(x) for x in v):
+            return True
+    return False
+
+
+@dataclass
 class BoolNode(Node):
     """bool query (ref index/query/BoolQueryParser.java): scores sum over
     scoring clauses; match follows Lucene semantics incl. filter context and

@@ -18,8 +18,9 @@ from typing import Any
 from ..mapping.mapper import MapperService, DATE, KEYWORD, TEXT, parse_date_millis
 from .query_dsl import (
     BoolNode, BoostingNode, CommonTermsNode, ConstantScoreNode, DisMaxNode,
-    ExistsNode, FunctionScoreNode, GeoDistanceNode, IdsNode, MatchAllNode,
-    MatchNode, MatchNoneNode, Node, QueryParsingException, RangeNode,
+    ExistsNode, FunctionScoreNode, GeoDistanceNode, HasChildNode,
+    HasParentNode, IdsNode, MatchAllNode, MatchNode, MatchNoneNode,
+    NestedNode, Node, QueryParsingException, RangeNode,
     SpanFirstNode, SpanNearNode, TermFilterNode,
 )
 
@@ -419,6 +420,47 @@ class QueryParser:
             filter=[self.parse(q) for q in as_list(spec.get("filter"))],
             minimum_should_match=_parse_msm(msm, n_should) if msm is not None else None,
             boost=float(spec.get("boost", 1.0)))
+
+    def _parse_nested(self, spec: dict) -> Node:
+        # ref index/query/NestedQueryParser.java
+        path = spec.get("path")
+        if not path:
+            raise QueryParsingException("nested requires a path")
+        inner = spec.get("query", spec.get("filter"))
+        if inner is None:
+            raise QueryParsingException("nested requires a query")
+        return NestedNode(path=str(path), inner=self.parse(inner),
+                          score_mode=str(spec.get("score_mode", "avg")),
+                          boost=float(spec.get("boost", 1.0)))
+
+    def _parse_has_child(self, spec: dict) -> Node:
+        # ref index/query/HasChildQueryParser.java
+        ctype = spec.get("type", spec.get("child_type"))
+        if not ctype:
+            raise QueryParsingException("has_child requires a type")
+        inner = spec.get("query", spec.get("filter"))
+        if inner is None:
+            raise QueryParsingException("has_child requires a query")
+        return HasChildNode(child_type=str(ctype), inner=self.parse(inner),
+                            score_mode=str(spec.get("score_mode", "none")),
+                            min_children=int(spec.get("min_children", 0)),
+                            max_children=int(spec.get("max_children", 0)),
+                            boost=float(spec.get("boost", 1.0)))
+
+    def _parse_has_parent(self, spec: dict) -> Node:
+        # ref index/query/HasParentQueryParser.java
+        ptype = spec.get("parent_type", spec.get("type"))
+        if not ptype:
+            raise QueryParsingException("has_parent requires a parent_type")
+        inner = spec.get("query", spec.get("filter"))
+        if inner is None:
+            raise QueryParsingException("has_parent requires a query")
+        score_mode = spec.get("score_mode")
+        if score_mode is None:
+            score_mode = "score" if spec.get("score") else "none"
+        return HasParentNode(parent_type=str(ptype), inner=self.parse(inner),
+                             score_mode=str(score_mode),
+                             boost=float(spec.get("boost", 1.0)))
 
     def _parse_constant_score(self, spec: dict) -> Node:
         inner = spec.get("filter", spec.get("query"))

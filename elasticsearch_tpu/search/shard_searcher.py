@@ -118,6 +118,12 @@ class ShardSearcher:
         """
         k = max(size + from_, 1)
         Q = n_queries
+        from .query_dsl import contains_joins
+        if contains_joins(node):
+            # parent/child joins span segments: resolve them into
+            # segment-executable bitmap nodes first (search/joins.py)
+            from .joins import resolve_joins
+            node = resolve_joins(node, self.segments, self.mappers, Q)
         sort = sort_mod.normalize(sort)
         if search_after is not None and not isinstance(search_after, (list, tuple)):
             search_after = [search_after]

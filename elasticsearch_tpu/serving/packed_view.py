@@ -325,7 +325,8 @@ class PackedIndexView:
         if self._live_dev is None or key != self._live_key:
             live = np.zeros(self.n_pad_total, bool)
             for ei, (_, seg) in enumerate(self.entries):
-                live[self.bases[ei]:self.bases[ei] + seg.n_pad] = seg.live_host
+                live[self.bases[ei]:self.bases[ei] + seg.n_pad] = \
+                    seg.root_live_host   # nested rows never serve as hits
             live[self.n_total:] = False
             self._live_dev = jnp.asarray(live)
             self._live_key = key

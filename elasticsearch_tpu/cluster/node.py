@@ -56,11 +56,19 @@ A_WRITE_R = "indices:data/write/op[r]"
 A_GET = "indices:data/read/get"
 A_QUERY = "indices:data/read/search[phase/query]"
 A_FETCH = "indices:data/read/search[phase/fetch/id]"
+A_TERM_STATS = "indices:data/read/search[phase/dfs]"
+A_SCROLL_NEXT = "indices:data/read/search[phase/scroll]"
+A_SCROLL_CLEAR = "indices:data/read/search[free_context]"
 A_RECOVERY = "internal:index/shard/recovery/files"
 
 
 class NoMasterException(Exception):
     pass
+
+
+class SearchContextMissingException(Exception):
+    """Expired/unknown scroll id (ref search/SearchContextMissingException
+    — a routine 404, not a server fault)."""
 
 
 class UnavailableShardsException(Exception):
@@ -104,8 +112,20 @@ class ClusterNode:
                 (A_WRITE_P, self._on_primary_write),
                 (A_WRITE_R, self._on_replica_write),
                 (A_GET, self._on_get), (A_QUERY, self._on_query),
-                (A_FETCH, self._on_fetch), (A_RECOVERY, self._on_recovery)]:
+                (A_FETCH, self._on_fetch),
+                (A_TERM_STATS, self._on_term_stats),
+                (A_SCROLL_NEXT, self._on_scroll_next),
+                (A_SCROLL_CLEAR, self._on_scroll_clear),
+                (A_RECOVERY, self._on_recovery)]:
             self.transport.register_handler(action, handler)
+        # per-(index, shard) round-robin cursor for read copy selection
+        # (ref cluster/routing/OperationRouting.java:144-154)
+        self._read_rr: dict[tuple[str, int], int] = {}
+        # shard-level pinned scroll contexts this node hosts (data-node side
+        # of the distributed scroll; ref SearchService contexts + reaper)
+        self._scroll_ctx: dict[str, dict] = {}
+        self._scroll_seq = 0
+        self._scroll_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # membership / election (ref ZenDiscovery.java:354 innerJoinCluster)
@@ -548,6 +568,45 @@ class ClusterNode:
         return self._write_op(index, {"op": "delete", "id": doc_id,
                                       "routing": routing, **kw})
 
+    def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
+        """(action, meta, source) ops -> per-item results (ref
+        TransportBulkAction split-by-shard; per-item error contract)."""
+        items = []
+        for action, meta, source in operations:
+            index = meta.get("_index")
+            type_name = meta.get("_type", "_doc")
+            doc_id = meta.get("_id")
+            try:
+                if action in ("index", "create"):
+                    r = self.index_doc(
+                        index, doc_id, source, type_name=type_name,
+                        routing=meta.get("_routing") or meta.get("routing"),
+                        op_type="create" if action == "create" else "index")
+                    items.append({action: {
+                        "_index": index, "_type": type_name,
+                        "_id": r["_id"], "_version": r["_version"],
+                        "status": 201 if r.get("created") else 200}})
+                elif action == "delete":
+                    r = self.delete_doc(
+                        index, doc_id,
+                        routing=meta.get("_routing") or meta.get("routing"))
+                    items.append({"delete": {
+                        "_index": index, "_type": type_name, "_id": doc_id,
+                        "_version": r["_version"],
+                        "found": r.get("found", True),
+                        "status": 200 if r.get("found", True) else 404}})
+                else:
+                    items.append({action: {
+                        "status": 400,
+                        "error": f"unsupported bulk action [{action}]"}})
+            except VersionConflictException as e:
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 409, "error": str(e)}})
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                items.append({action: {"_index": index, "_id": doc_id,
+                                       "status": 400, "error": str(e)}})
+        return items
+
     def _write_op(self, index: str, op: dict, timeout: float = 10.0) -> dict:
         """Route to the primary, retrying on stale routing / primary
         failover — the reference's retry-on-cluster-state-change loop."""
@@ -617,11 +676,25 @@ class ClusterNode:
             raise UnavailableShardsException(
                 f"[{index}][{sid}] primary not on [{self.node_id}]")
         if req["op"] == "index":
+            mappers = self._mappers[index]
+            mv = mappers.mapping_version()
             res = holder.engine.index(
                 req["id"], req["source"], type_name=req.get("type", "_doc"),
                 version=req.get("version"),
                 version_type=req.get("version_type", "internal"),
                 op_type=req.get("op_type", "index"))
+            if mappers.mapping_version() != mv:
+                # dynamic mapping delta -> master metadata, so COORDINATORS
+                # can parse queries/sorts on the new fields (ref
+                # TransportIndexAction.java:194-227 MappingUpdatedAction;
+                # here post-ack because replicas re-derive deterministically)
+                tname = req.get("type", "_doc")
+                try:
+                    self._master_call(A_PUT_MAPPING, {
+                        "index": index, "type": tname,
+                        "mapping": mappers._mappers[tname].mapping_dict()})
+                except Exception:  # noqa: BLE001 — next write retries
+                    pass
         else:
             res = holder.engine.delete(
                 req["id"], version=req.get("version"),
@@ -703,8 +776,106 @@ class ClusterNode:
                 "_source": r.source if r.found else None}
 
     # -- distributed search (QUERY_THEN_FETCH over the transport seam) --
+    #
+    # The FULL search body crosses the seam: query, sort, aggs, highlight,
+    # suggest, rescore, knn, search_after, _source. The shard side parses
+    # with ITS mappers and returns wire-encoded QuerySearchResult pieces
+    # (doc keys + scores + materialized sort values + agg partials +
+    # suggest partials); the coordinator reduces exactly like the
+    # single-node controller. A DFS term-stats round runs first so every
+    # shard scores with cluster-global IDF — distributed answers match the
+    # single-node engine bit-for-bit (ref TransportSearchTypeAction.java:
+    # 85-177 + SearchPhaseController.java:282-399 + DfsPhase.java:57-81).
 
-    def search(self, index: str, body: dict | None = None) -> dict:
+    def search_shards(self, state: ClusterState, names: list[str],
+                      preference: str | None = None) -> list[tuple]:
+        """One STARTED copy per shard, round-robin across copies so
+        replicas add read QPS (ref OperationRouting.java:144-154);
+        preference=_local / _primary / _only_local supported."""
+        targets: list[tuple[str, str, int]] = []   # (node, index, shard)
+        for name in names:
+            for sid in range(len(state.routing[name])):
+                copies = state.started_copies(name, sid)
+                if not copies:
+                    raise UnavailableShardsException(f"[{name}][{sid}]")
+                if preference in ("_local", "_only_local"):
+                    node = next((c["node"] for c in copies
+                                 if c["node"] == self.node_id), None)
+                    if node is None:
+                        if preference == "_only_local":
+                            raise UnavailableShardsException(
+                                f"[{name}][{sid}] has no local copy")
+                        node = copies[0]["node"]
+                elif preference == "_primary":
+                    node = next((c["node"] for c in copies if c["primary"]),
+                                copies[0])["node"] \
+                        if any(c["primary"] for c in copies) \
+                        else copies[0]["node"]
+                else:
+                    rr = self._read_rr.get((name, sid), 0)
+                    self._read_rr[(name, sid)] = rr + 1
+                    node = copies[rr % len(copies)]["node"]
+                targets.append((node, name, sid))
+        return targets
+
+    def _shard_call(self, node: str, action: str, payload: dict):
+        # always through the network object — self-sends round-trip the
+        # wire format too, so wire-unsafe payloads fail in every test
+        # topology, not only when the shard happens to be remote
+        return self.transport.send(node, action, payload)
+
+    def _dfs_stats(self, targets, query, names) -> dict | None:
+        """All-reduce term statistics across shards (ref DfsPhase.java:57-81)
+        so BM25 IDF is corpus-global. Returns a wire dict or None when the
+        query holds no terms."""
+        from ..search.query_parser import QueryParser
+        terms: dict[str, set] = {}
+        for name in names:
+            mappers = self._mappers.get(name)
+            if mappers is None:
+                continue
+            try:
+                QueryParser(mappers).parse(query).collect_terms(terms)
+            except Exception:  # noqa: BLE001 — shard-side parse will report
+                return None
+        if not any(terms.values()):
+            return None       # term-less query: nothing to all-reduce
+        terms_wire = {f: sorted(ts) for f, ts in terms.items()}
+        dfs = {"doc_count": 0, "sum_dl": {}, "dfs": {}}
+        for node, name, sid in targets:
+            try:
+                r = self._shard_call(node, A_TERM_STATS, {
+                    "index": name, "shard": sid, "terms": terms_wire})
+            except (ConnectTransportException, RemoteTransportException):
+                continue       # the query round will account the failure
+            dfs["doc_count"] += r["doc_count"]
+            for f, v in r["sum_dl"].items():
+                dfs["sum_dl"][f] = dfs["sum_dl"].get(f, 0.0) + v
+            for f, t, df in r["dfs"]:
+                key = f + "\x00" + t
+                dfs["dfs"][key] = dfs["dfs"].get(key, 0) + df
+        return {"doc_count": dfs["doc_count"], "sum_dl": dfs["sum_dl"],
+                "dfs": [[*k.split("\x00", 1), v]
+                        for k, v in dfs["dfs"].items()],
+                "terms": terms_wire}
+
+    def _on_term_stats(self, from_id: str, req: dict) -> dict:
+        holder = self._shards.get((req["index"], req["shard"]))
+        if holder is None or holder.engine is None:
+            raise UnavailableShardsException(
+                f"[{req['index']}][{req['shard']}]")
+        from ..search.query_dsl import CollectionStats
+        searcher = self._searcher(req["index"], req["shard"], holder)
+        tbf = {f: set(ts) for f, ts in (req.get("terms") or {}).items()}
+        stats = CollectionStats.from_segments(searcher.segments, tbf)
+        return {"doc_count": stats.doc_count,
+                "sum_dl": stats.field_sum_dl,
+                "dfs": [[f, t, df]
+                        for (f, t), df in stats.doc_freqs.items()]}
+
+    def search(self, index: str, body: dict | None = None,
+               preference: str | None = None,
+               scroll: str | None = None) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         size = int(body.get("size", 10))
@@ -713,71 +884,163 @@ class ClusterNode:
         names = state.resolve_index(index)
         if not names:
             raise KeyError(f"no such index [{index}]")
-        # shard targets: prefer the local copy, else first started
-        targets: list[tuple[str, str, int]] = []   # (node, index, shard)
-        for name in names:
-            for sid in range(len(state.routing[name])):
-                copies = state.started_copies(name, sid)
-                if not copies:
-                    raise UnavailableShardsException(f"[{name}][{sid}]")
-                node = next((c["node"] for c in copies
-                             if c["node"] == self.node_id),
-                            copies[0]["node"])
-                targets.append((node, name, sid))
-        # phase 1: query — per-shard top-(from+size) ids and scores
-        per_shard: list[dict] = []
-        for node, name, sid in targets:
+        targets = self.search_shards(state, names, preference)
+        if scroll is not None:
+            return self._scroll_start(targets, body, size, scroll, t0)
+
+        query = body.get("query") or {"match_all": {}}
+        if body.get("knn") is not None and body.get("sort") is not None:
+            raise ValueError("knn search cannot be combined with sort")
+        dfs = self._dfs_stats(targets, query, names) \
+            if body.get("knn") is None else None
+        agg_specs = None
+        if body.get("aggs") or body.get("aggregations"):
+            from ..search.aggs.aggregators import parse_aggs
+            agg_specs = parse_aggs(body.get("aggs")
+                                   or body.get("aggregations"))
+
+        # phase 1: query fan-out, partial-failure accounting (a failed
+        # shard reduces coverage, never aborts the search — ref
+        # TransportSearchTypeAction onFirstPhaseResult failure path)
+        per_shard: list[tuple[int, dict]] = []
+        failures: list[dict] = []
+        for ti, (node, name, sid) in enumerate(targets):
             payload = {"index": name, "shard": sid, "body": body,
-                       "size": size + from_}
-            if node == self.node_id:
-                per_shard.append(self._on_query(self.node_id, payload))
-            else:
-                per_shard.append(self.transport.send(node, A_QUERY, payload))
-        # reduce (ref SearchPhaseController.sortDocs :147)
-        cands = []
+                       "size": size + from_, "dfs": dfs}
+            try:
+                per_shard.append(
+                    (ti, self._shard_call(node, A_QUERY, payload)))
+            except (ConnectTransportException,
+                    RemoteTransportException) as e:
+                failures.append({"shard": sid, "index": name,
+                                 "node": node, "reason": str(e)})
+        if not per_shard and targets:
+            raise UnavailableShardsException(
+                f"all shards failed for [{index}]: {failures}")
+
+        reduced = self._reduce(per_shard, targets, body, names,
+                               from_, size)
+        hits = self._fetch_phase(reduced, targets, body)
+        resp = self._render_response(reduced, hits, targets, failures,
+                                     agg_specs, per_shard, body, t0)
+        return resp
+
+    def _parse_sort_specs(self, body: dict, names: list[str]):
+        from ..search.sort import parse_sort
+        mappers = [self._mappers[n] for n in names if n in self._mappers]
+        return parse_sort(body.get("sort"), mappers)
+
+    def _reduce(self, per_shard, targets, body, names, from_, size):
+        """Cross-shard sort-merge on wire results
+        (ref SearchPhaseController.sortDocs:147,233)."""
+        from ..search import sort as sort_mod
+        sort = self._parse_sort_specs(body, names)
+        entries = []
         total = 0
         max_score = None
-        for ti, r in enumerate(per_shard):
+        for ti, r in per_shard:
             total += r["total"]
             if r["max_score"] is not None:
                 ms = float(r["max_score"])
                 if max_score is None or ms > max_score:
                     max_score = ms
-            for h in r["hits"]:
-                cands.append((ti, h["id"], h["score"]))
-        cands.sort(key=lambda c: (-c[2], c[1]))
-        winners = cands[from_:from_ + size]
-        # phase 2: fetch — only from shards owning winners
+            for pos, doc_id in enumerate(r["ids"]):
+                score = r["scores"][pos]
+                sv = r["sort"][pos] if r.get("sort") is not None else None
+                if sort is None:
+                    primary = -score if score is not None else float("inf")
+                else:
+                    primary = sort_mod.compare_key(sv, sort)
+                entries.append((primary, ti, pos, doc_id, score, sv))
+        entries.sort(key=lambda e: (e[0], e[1], e[2]))
+        window = entries[from_: from_ + size]
+        return {"window": window, "total": total, "max_score": max_score,
+                "sorted": sort is not None}
+
+    def _fetch_phase(self, reduced, targets, body) -> dict:
+        """Fetch fan-out to winning shards only; highlight runs ON the data
+        node inside fetch (ref FetchPhase sub-phases)."""
         by_target: dict[int, list[str]] = {}
-        for ti, doc_id, _ in winners:
+        for _, ti, _pos, doc_id, _score, _sv in reduced["window"]:
             by_target.setdefault(ti, []).append(doc_id)
-        sources: dict[tuple[int, str], dict | None] = {}
+        fetched: dict[tuple[int, str], dict] = {}
         for ti, ids in by_target.items():
             node, name, sid = targets[ti]
             payload = {"index": name, "shard": sid, "ids": ids,
-                       "_source": body.get("_source", True)}
-            if node == self.node_id:
-                fr = self._on_fetch(self.node_id, payload)
-            else:
-                fr = self.transport.send(node, A_FETCH, payload)
-            for doc_id, src in zip(ids, fr["sources"]):
-                sources[(ti, doc_id)] = src
-        hits = [{"_index": targets[ti][1], "_id": doc_id,
-                 "_score": score, "_source": sources.get((ti, doc_id))}
-                for ti, doc_id, score in winners]
-        return {"took": int((time.perf_counter() - t0) * 1000),
+                       "_source": body.get("_source", True),
+                       "highlight": body.get("highlight"),
+                       "query": body.get("query")}
+            try:
+                fr = self._shard_call(node, A_FETCH, payload)
+            except (ConnectTransportException, RemoteTransportException):
+                continue    # hit rendered without source (copy just died)
+            for doc_id, hit in zip(ids, fr["hits"]):
+                fetched[(ti, doc_id)] = hit
+        return fetched
+
+    def _render_response(self, reduced, fetched, targets, failures,
+                         agg_specs, per_shard, body, t0) -> dict:
+        hits = []
+        for _, ti, _pos, doc_id, score, sv in reduced["window"]:
+            h = fetched.get((ti, doc_id), {})
+            entry = {"_index": targets[ti][1],
+                     "_type": h.get("_type", "_doc"),
+                     "_id": doc_id, "_score": score,
+                     "_source": h.get("_source")}
+            if reduced["sorted"]:
+                entry["sort"] = sv
+            if h.get("highlight"):
+                entry["highlight"] = h["highlight"]
+            hits.append(entry)
+        resp = {"took": int((time.perf_counter() - t0) * 1000),
                 "timed_out": False,
                 "_shards": {"total": len(targets),
-                            "successful": len(targets), "failed": 0},
-                "hits": {"total": total, "max_score": max_score,
+                            "successful": len(per_shard),
+                            "failed": len(failures),
+                            **({"failures": failures} if failures else {})},
+                "hits": {"total": reduced["total"],
+                         "max_score": reduced["max_score"],
                          "hits": hits}}
+        if agg_specs is not None:
+            from ..search.aggs.aggregators import (merge_shard_partials,
+                                                   render)
+            from ..search.aggs.wire import partials_from_wire
+            parts = [partials_from_wire(agg_specs, r["aggs"])
+                     for _, r in per_shard if r.get("aggs") is not None]
+            resp["aggregations"] = render(
+                agg_specs, merge_shard_partials(agg_specs, parts))
+        sugg = [r["suggest"] for _, r in per_shard
+                if r.get("suggest") is not None]
+        if sugg:
+            from ..search.suggest import merge_suggest
+            resp["suggest"] = merge_suggest(body.get("suggest") or {}, sugg)
+        return resp
+
+    def msearch(self, items: list[tuple[dict, dict]]) -> dict:
+        """(header, body) pairs -> {"responses": [...]}, per-item errors
+        (ref TransportMultiSearchAction)."""
+        responses = []
+        for header, sbody in items:
+            try:
+                responses.append(self.search(
+                    header.get("index", "_all"), sbody,
+                    preference=header.get("preference")))
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                responses.append({"error": f"{type(e).__name__}[{e}]"})
+        return {"responses": responses}
+
+    def count(self, index: str, body: dict | None = None) -> dict:
+        r = self.search(index, {**(body or {}), "size": 0, "from": 0})
+        return {"count": r["hits"]["total"], "_shards": r["_shards"]}
 
     def _searcher(self, index: str, sid: int,
                   holder: _ShardHolder) -> ShardSearcher:
-        key = tuple(s.seg_id for s in holder.engine.segments)
+        eng = holder.engine
+        key = (tuple(s.seg_id for s in eng.segments),
+               tuple(s.live_gen for s in eng.segments))
         if holder.searcher is None or holder.searcher[0] != key:
             holder.searcher = (key, ShardSearcher(
-                sid, holder.engine.segments, self._mappers[index]))
+                sid, eng.segments, self._mappers[index]))
         return holder.searcher[1]
 
     def _on_query(self, from_id: str, req: dict) -> dict:
@@ -787,32 +1050,164 @@ class ClusterNode:
                 f"[{req['index']}][{req['shard']}]")
         searcher = self._searcher(req["index"], req["shard"], holder)
         body = req.get("body") or {}
-        node = searcher.parse([body.get("query") or {"match_all": {}}])
-        r = searcher.execute_query_phase(node, size=req["size"], from_=0)
-        hits = []
-        for pos in range(r.doc_keys.shape[1]):
-            key = int(r.doc_keys[0, pos])
-            if key < 0:
-                continue
-            seg = searcher.segments[key >> 32]
-            hits.append({"id": seg.ids[key & 0xFFFFFFFF],
-                         "score": float(r.scores[0, pos])})
-        mx = float(r.max_score[0])
-        return {"hits": hits, "total": int(r.total_hits[0]),
-                "max_score": None if mx != mx else mx}
+        k = int(req["size"])
+        return _shard_query_phase(searcher, self._mappers[req["index"]],
+                                  body, k, req.get("dfs"),
+                                  search_after=req.get("search_after"))
 
     def _on_fetch(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))
         if holder is None or holder.engine is None:
             raise UnavailableShardsException(f"[{req['index']}]")
-        sources = []
-        for doc_id in req["ids"]:
-            r = holder.engine.get(doc_id, realtime=False)
-            src = r.source if r.found else None
-            if src is not None and req.get("_source") is False:
-                src = None
-            sources.append(src)
-        return {"sources": sources}
+        return _shard_fetch_phase(holder.engine,
+                                  self._mappers[req["index"]], req)
+
+    # -- distributed scroll (ref scroll_id encoding per-shard context ids,
+    #    action/search/type/TransportSearchHelper + SearchService
+    #    keep-alive contexts; cursors advance per shard by the LAST
+    #    GLOBALLY-EMITTED doc, the lastEmittedDocPerShard contract of
+    #    SearchPhaseController.sortDocs) --------------------------------
+
+    def _scroll_start(self, targets, body, size, keep_alive, t0) -> dict:
+        if any(k in body for k in ("knn", "rescore", "search_after")):
+            raise ValueError("scroll does not support "
+                             "knn/rescore/search_after")
+        ctxs = []
+        for node, name, sid in targets:
+            r = self._shard_call(node, A_SCROLL_NEXT, {
+                "index": name, "shard": sid,
+                "init": {"body": body, "keep_alive": keep_alive}})
+            ctxs.append(r["ctx"])
+        with self._scroll_lock:
+            self._scroll_seq += 1
+            scroll_id = f"c-scroll-{self.node_id}-{self._scroll_seq}"
+            ctx = {"targets": list(targets), "ctxs": ctxs,
+                   "cursors": [None] * len(targets), "size": size,
+                   "keep_alive": keep_alive,
+                   "expiry": time.monotonic() + _keepalive_secs(keep_alive),
+                   "lock": threading.Lock()}
+            self._scroll_ctx[scroll_id] = ctx
+        out = self._scroll_batch(ctx, t0)
+        out["_scroll_id"] = scroll_id
+        return out
+
+    def scroll(self, scroll_id: str, keep_alive: str | None = None) -> dict:
+        t0 = time.perf_counter()
+        with self._scroll_lock:
+            ctx = self._scroll_ctx.get(scroll_id)
+            if ctx is None or ctx["expiry"] < time.monotonic():
+                self._scroll_ctx.pop(scroll_id, None)
+                ctx = None
+        if ctx is None:
+            raise SearchContextMissingException(
+                f"No search context found for id [{scroll_id}]")
+        if keep_alive:
+            ctx["keep_alive"] = keep_alive
+        ctx["expiry"] = time.monotonic() + _keepalive_secs(ctx["keep_alive"])
+        out = self._scroll_batch(ctx, t0)
+        out["_scroll_id"] = scroll_id
+        return out
+
+    def clear_scroll(self, scroll_id: str) -> bool:
+        ctx = self._scroll_ctx.pop(scroll_id, None)
+        if ctx is None:
+            return False
+        for (node, name, sid), cid in zip(ctx["targets"], ctx["ctxs"]):
+            try:
+                self._shard_call(node, A_SCROLL_CLEAR, {"ctx": cid})
+            except (ConnectTransportException, RemoteTransportException):
+                pass
+        return True
+
+    def _scroll_batch(self, ctx, t0) -> dict:
+        with ctx.get("lock") or threading.Lock():
+            return self._scroll_batch_locked(ctx, t0)
+
+    def _scroll_batch_locked(self, ctx, t0) -> dict:
+        from ..search import sort as sort_mod
+        size = ctx["size"]
+        per_shard = []
+        failures = []
+        for ti, ((node, name, sid), cid) in enumerate(
+                zip(ctx["targets"], ctx["ctxs"])):
+            try:
+                r = self._shard_call(node, A_SCROLL_NEXT, {
+                    "index": name, "shard": sid, "ctx": cid, "size": size,
+                    "after": ctx["cursors"][ti],
+                    "keep_alive": ctx["keep_alive"]})
+                per_shard.append((ti, r))
+            except (ConnectTransportException,
+                    RemoteTransportException) as e:
+                failures.append({"shard": sid, "index": name,
+                                 "reason": str(e)})
+        entries = []
+        total = 0
+        max_score = None
+        specs = None
+        for ti, r in per_shard:
+            total += r["total"]
+            if r["max_score"] is not None:
+                ms = float(r["max_score"])
+                max_score = ms if max_score is None else max(max_score, ms)
+            if specs is None and r.get("specs") is not None:
+                specs = [sort_mod.SortSpec(**sp) for sp in r["specs"]]
+            for h in r["hits"]:
+                entries.append((sort_mod.compare_key(h["sort"], specs),
+                                ti, h))
+        entries.sort(key=lambda e: (e[0], e[1]))
+        window = entries[:size]
+        # advance each shard's cursor to its LAST EMITTED doc
+        for _, ti, h in window:
+            ctx["cursors"][ti] = h["sort"]
+        hits = []
+        for _, ti, h in window:
+            entry = {"_index": ctx["targets"][ti][1],
+                     "_type": h.get("_type", "_doc"), "_id": h["_id"],
+                     "_score": h.get("score"), "_source": h.get("_source")}
+            if not h.get("implicit_sort"):
+                entry["sort"] = h["sort"]
+            hits.append(entry)
+        return {"took": int((time.perf_counter() - t0) * 1000),
+                "timed_out": False,
+                "_shards": {"total": len(ctx["targets"]),
+                            "successful": len(per_shard),
+                            "failed": len(failures)},
+                "hits": {"total": total, "max_score": max_score,
+                         "hits": hits}}
+
+    def _on_scroll_next(self, from_id: str, req: dict) -> dict:
+        self._reap_scroll_ctx()
+        if "init" in req:
+            holder = self._shards.get((req["index"], req["shard"]))
+            if holder is None or holder.engine is None:
+                raise UnavailableShardsException(
+                    f"[{req['index']}][{req['shard']}]")
+            searcher = self._searcher(req["index"], req["shard"], holder)
+            init = req["init"]
+            with self._scroll_lock:
+                self._scroll_seq += 1
+                cid = f"ctx-{self.node_id}-{self._scroll_seq}"
+                self._scroll_ctx[cid] = _make_shard_scroll_ctx(
+                    searcher, self._mappers[req["index"]], init["body"],
+                    _keepalive_secs(init["keep_alive"]))
+            return {"ctx": cid}
+        ctx = self._scroll_ctx.get(req["ctx"])
+        if ctx is None:
+            raise UnavailableShardsException(
+                f"scroll context [{req['ctx']}] expired")
+        ctx["expiry"] = time.monotonic() \
+            + _keepalive_secs(req.get("keep_alive", "1m"))
+        return _shard_scroll_batch(ctx, int(req["size"]), req.get("after"))
+
+    def _on_scroll_clear(self, from_id: str, req: dict) -> dict:
+        return {"found": self._scroll_ctx.pop(req["ctx"], None) is not None}
+
+    def _reap_scroll_ctx(self) -> None:
+        now = time.monotonic()
+        with self._scroll_lock:
+            for cid in [c for c, ctx in self._scroll_ctx.items()
+                        if ctx.get("expiry", now) < now]:
+                del self._scroll_ctx[cid]
 
     # ------------------------------------------------------------------
     # broadcast admin (ref TransportBroadcastOperationAction)
@@ -870,3 +1265,225 @@ class ClusterNode:
             for holder in self._shards.values():
                 if holder.engine is not None:
                     holder.engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Data-node search phases (shared by RPC handlers; ref SearchService
+# executeQueryPhase/executeFetchPhase — the shard side of the 2-phase
+# protocol, returning WIRE-SAFE results)
+# ---------------------------------------------------------------------------
+
+def _keepalive_secs(s: str) -> float:
+    s = str(s).strip()
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    for u in ("ms", "s", "m", "h", "d"):
+        if s.endswith(u):
+            return float(s[: -len(u)]) * units[u]
+    return float(s)
+
+
+def _jsonval(v):
+    """Materialized sort values / scores -> JSON-safe."""
+    import numpy as np
+    if isinstance(v, (list, tuple)):
+        return [_jsonval(x) for x in v]
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        f = float(v)
+        return None if f != f else f
+    if isinstance(v, float) and v != v:
+        return None
+    if isinstance(v, (np.str_, np.bool_)):
+        return v.item()
+    return v
+
+
+def _stats_from_wire(dfs: dict | None):
+    if dfs is None:
+        return None
+    from ..search.query_dsl import CollectionStats
+    return CollectionStats(
+        doc_count=dfs["doc_count"],
+        field_sum_dl=dict(dfs["sum_dl"]),
+        doc_freqs={(f, t): df for f, t, df in dfs["dfs"]})
+
+
+def _shard_query_phase(searcher: ShardSearcher, mappers: MapperService,
+                       body: dict, k: int, dfs: dict | None,
+                       search_after=None) -> dict:
+    """Execute the FULL query phase for one shard and wire-encode the
+    result (keys + scores + materialized sort values + agg/suggest
+    partials). The coordinator windows [from, from+size) after the merge,
+    so `k` = from + size here."""
+    from ..search.aggs.aggregators import parse_aggs
+    from ..search.sort import parse_sort
+
+    stats = _stats_from_wire(dfs)
+    sort = parse_sort(body.get("sort"), [mappers])
+    if search_after is None:
+        search_after = body.get("search_after") or None
+    if search_after is not None and sort is None:
+        raise ValueError("search_after requires a sort")
+    agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations")) \
+        if (body.get("aggs") or body.get("aggregations")) else None
+    rescore_spec = body.get("rescore")
+    if isinstance(rescore_spec, list):
+        rescore_spec = rescore_spec[0] if rescore_spec else None
+    if rescore_spec is not None and sort is not None:
+        raise ValueError("rescore cannot be used with a sort")
+    window = int(rescore_spec.get("window_size", k)) if rescore_spec else 0
+    knn = body.get("knn")
+
+    if knn is not None:
+        fnode = searcher.parse([knn["filter"]]) if knn.get("filter") else None
+        r = searcher.execute_knn(
+            knn["field"], [knn["query_vector"]],
+            k=int(knn.get("k", k)), metric=knn.get("metric", "cosine"),
+            filter_node=fnode)
+    else:
+        node = searcher.parse([body.get("query") or {"match_all": {}}])
+        r = searcher.execute_query_phase(
+            node, size=max(k, window), from_=0, sort=sort,
+            global_stats=stats, aggs=agg_specs,
+            search_after=search_after,
+            track_scores=bool(body.get("track_scores", False))
+            if sort is not None else True)
+        if rescore_spec is not None:
+            r = searcher.rescore(r, rescore_spec)
+
+    from ..search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+    ids, scores, svs = [], [], []
+    for pos in range(r.doc_keys.shape[1]):
+        key = int(r.doc_keys[0, pos])
+        if key < 0:
+            continue
+        seg = searcher.segments[key >> SEG_SHIFT]
+        # doc IDS cross the seam, not positional keys: the fetch phase may
+        # race a flush/merge that reshuffles (segment, local) addresses —
+        # ids stay stable (the reference's fetch uses context-pinned
+        # readers; id addressing is the equivalent safety here)
+        ids.append(seg.ids[key & LOCAL_MASK])
+        sc = float(r.scores[0, pos])
+        scores.append(None if sc != sc else sc)
+        if r.sort_values is not None:
+            svs.append(_jsonval(r.sort_values[0, pos]))
+    mx = float(r.max_score[0])
+    out: dict = {"ids": ids, "scores": scores,
+                 "sort": svs if r.sort_values is not None else None,
+                 "total": int(r.total_hits[0]),
+                 "max_score": None if mx != mx else mx}
+    if agg_specs is not None and r.aggs is not None:
+        from ..search.aggs.wire import partials_to_wire
+        out["aggs"] = partials_to_wire(agg_specs, r.aggs)
+    if body.get("suggest"):
+        from ..search.suggest import run_suggest
+        out["suggest"] = run_suggest(body["suggest"], searcher.segments)
+    return out
+
+
+def _shard_fetch_phase(engine: Engine, mappers: MapperService,
+                       req: dict) -> dict:
+    """Resolve doc IDS to rendered hits; _source filtering and HIGHLIGHT
+    run here, on the data node (ref FetchPhase.java sub-phases). Fetch is
+    by id, not positional key, so a flush/merge racing between the query
+    and fetch phases can never serve the wrong document."""
+    from ..search.query_parser import QueryParser
+    from ..search.shard_searcher import _filter_source
+
+    hl_spec = None
+    terms_by_field: dict[str, set] = {}
+    if req.get("highlight"):
+        from ..search.highlight import parse_highlight
+        hl_spec = parse_highlight(req["highlight"])
+        if req.get("query"):
+            try:
+                QueryParser(mappers).parse(req["query"]) \
+                    .collect_terms(terms_by_field)
+            except Exception:  # noqa: BLE001 — highlight degrades to none
+                pass
+
+    src_spec = req.get("_source", True)
+    hits = []
+    for doc_id in req["ids"]:
+        r = engine.get(doc_id, realtime=False)
+        if not r.found:
+            hits.append({"_id": doc_id, "_type": "_doc", "_source": None})
+            continue
+        raw_src = r.source
+        src = None if src_spec is False \
+            else _filter_source(raw_src, src_spec if src_spec is not True
+                                else None)
+        hit = {"_id": doc_id, "_type": r.type_name, "_source": src}
+        if hl_spec is not None:
+            from ..search.highlight import highlight_hit
+
+            def an_for(fname):
+                for dm in mappers._mappers.values():
+                    if fname in dm.fields:
+                        return dm.search_analyzer_for(fname)
+                return mappers.analysis.analyzer("standard")
+
+            hl = highlight_hit(hl_spec, raw_src, terms_by_field, an_for)
+            if hl:
+                hit["highlight"] = hl
+        hits.append(hit)
+    return {"hits": hits}
+
+
+def _make_shard_scroll_ctx(searcher: ShardSearcher, mappers: MapperService,
+                           body: dict, keep_secs: float) -> dict:
+    """Pin a point-in-time snapshot of the shard for scrolling: copy the
+    segment list with frozen liveness (concurrent deletes/merges never
+    change what the scroll sees — ref ScanContext reader pinning)."""
+    import dataclasses as _dc
+
+    from ..search.sort import DOC, SCORE, SortSpec, parse_sort
+
+    segs = [_dc.replace(s, live_host=s.live_host.copy(),
+                        live_count=s.live_count)
+            for s in searcher.segments]
+    pinned = ShardSearcher(searcher.shard_id, segs, mappers)
+    user_sort = parse_sort(body.get("sort"), [mappers])
+    implicit = user_sort is None
+    specs = list(user_sort) if user_sort else \
+        [SortSpec(field=SCORE, order="desc")]
+    if not any(sp.field == DOC for sp in specs):
+        specs = specs + [SortSpec(field=DOC, order="asc")]
+    return {"searcher": pinned, "body": body, "specs": specs,
+            "implicit": implicit,
+            "expiry": time.monotonic() + keep_secs}
+
+
+def _shard_scroll_batch(ctx: dict, size: int, after) -> dict:
+    """One scroll batch from a pinned shard context: the next `size` docs
+    after the shard's last GLOBALLY-emitted cursor, with sources inline
+    (scroll fetches eagerly — one RPC per shard per batch)."""
+    from ..search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+
+    searcher: ShardSearcher = ctx["searcher"]
+    body = ctx["body"]
+    specs = ctx["specs"]
+    node = searcher.parse([body.get("query") or {"match_all": {}}])
+    r = searcher.execute_query_phase(
+        node, size=size, from_=0, sort=specs, search_after=after,
+        track_scores=True)
+    hits = []
+    for pos in range(r.doc_keys.shape[1]):
+        key = int(r.doc_keys[0, pos])
+        if key < 0:
+            continue
+        seg = searcher.segments[key >> SEG_SHIFT]
+        local = key & LOCAL_MASK
+        sc = float(r.scores[0, pos])
+        hits.append({"_id": seg.ids[local], "_type": seg.types[local],
+                     "_source": seg.stored[local],
+                     "score": None if sc != sc else sc,
+                     "sort": _jsonval(r.sort_values[0, pos]),
+                     "implicit_sort": ctx["implicit"]})
+    mx = float(r.max_score[0])
+    return {"hits": hits, "total": int(r.total_hits[0]),
+            "max_score": None if mx != mx else mx,
+            "specs": [{"field": sp.field, "order": sp.order,
+                       "missing": sp.missing}
+                      for sp in specs]}

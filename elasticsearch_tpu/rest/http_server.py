@@ -71,10 +71,10 @@ class RestController:
     """Method+path-pattern dispatch (ref rest/RestController.java:44,119,163
     path trie; regex table is equivalent at this route count)."""
 
-    def __init__(self, node: NodeService):
+    def __init__(self, node: NodeService, registrar: Callable | None = None):
         self.node = node
         self.routes: list[tuple[str, re.Pattern, Callable]] = []
-        _register_routes(self, node)
+        (registrar or _register_routes)(self, node)
 
     def register(self, method: str, pattern: str, handler: Callable) -> None:
         # {name} -> named group; e.g. /{index}/_search
@@ -1606,8 +1606,8 @@ class HttpServer:
     """Threaded HTTP front-end (ref http/HttpServer.java + netty transport)."""
 
     def __init__(self, node: NodeService, host: str = "127.0.0.1",
-                 port: int = 9200):
-        self.controller = RestController(node)
+                 port: int = 9200, registrar: Callable | None = None):
+        self.controller = RestController(node, registrar=registrar)
         controller = self.controller
 
         class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,101 @@
+"""Wire codec for per-shard aggregation partials.
+
+The reference streams InternalAggregation objects between data nodes and the
+coordinating node (Streamable readFrom/writeTo; SearchPhaseController.merge
+then reduces them). Here partials cross the transport seam as JSON-safe
+trees: HLL registers travel as tagged bytes, t-digest centroids as float
+lists, bucket maps as [key, entry] PAIR LISTS so non-string keys (histogram
+floats, numeric terms) survive JSON — a plain dict would stringify them and
+desynchronize the cross-shard merge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .aggregators import AggSpec, BUCKET_TYPES, METRIC_TYPES
+from .hll import HyperLogLog
+from .tdigest import TDigest
+
+
+def _key_to_wire(k):
+    if isinstance(k, np.integer):
+        return int(k)
+    if isinstance(k, np.floating):
+        return float(k)
+    if isinstance(k, (np.str_, np.bool_)):
+        return k.item()
+    return k
+
+
+def _num(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def partial_to_wire(spec: AggSpec, p: dict) -> dict:
+    t = spec.type
+    if t == "cardinality":
+        hll: HyperLogLog = p["hll"]
+        return {"hll": {"p": hll.p, "regs": hll.registers.tobytes()}}
+    if t == "percentiles":
+        td: TDigest = p["tdigest"]
+        td._compress()
+        return {"tdigest": {"means": [float(x) for x in td.means],
+                            "weights": [float(x) for x in td.weights],
+                            "compression": td.compression},
+                "percents": p.get("percents")}
+    if t == "top_hits":
+        return {"total": _num(p.get("total", 0)),
+                "top": [{k: (_num(v) if k == "_score" else v)
+                         for k, v in h.items()} for h in p.get("top", [])]}
+    if t in METRIC_TYPES:
+        return {k: _num(v) for k, v in p.items()}
+    # bucket aggs: encode the bucket map as pairs, recurse into subs
+    out: dict = {k: _num(v) for k, v in p.items() if k != "buckets"}
+    pairs = []
+    for key, entry in p.get("buckets", {}).items():
+        e: dict = {k: _num(v) for k, v in entry.items() if k != "subs"}
+        if "subs" in entry:
+            e["subs"] = {s.name: partial_to_wire(s, entry["subs"][s.name])
+                         for s in spec.subs}
+        pairs.append([_key_to_wire(key), e])
+    out["buckets"] = pairs
+    return out
+
+
+def partial_from_wire(spec: AggSpec, w: dict) -> dict:
+    t = spec.type
+    if t == "cardinality":
+        regs = np.frombuffer(w["hll"]["regs"], np.uint8).copy()
+        return {"hll": HyperLogLog(precision=w["hll"]["p"], registers=regs)}
+    if t == "percentiles":
+        td = TDigest(compression=w["tdigest"].get("compression", 100.0),
+                     means=np.asarray(w["tdigest"]["means"], np.float64),
+                     weights=np.asarray(w["tdigest"]["weights"], np.float64))
+        return {"tdigest": td, "percents": w.get("percents")}
+    if t == "top_hits" or t in METRIC_TYPES:
+        return dict(w)
+    out = {k: v for k, v in w.items() if k != "buckets"}
+    buckets = {}
+    for key, e in w.get("buckets", []):
+        entry = {k: v for k, v in e.items() if k != "subs"}
+        if "subs" in e:
+            entry["subs"] = {s.name: partial_from_wire(s, e["subs"][s.name])
+                             for s in spec.subs}
+        buckets[key] = entry
+    out["buckets"] = buckets
+    return out
+
+
+def partials_to_wire(specs: list[AggSpec], partials: dict) -> dict:
+    return {s.name: partial_to_wire(s, partials[s.name])
+            for s in specs if s.name in partials}
+
+
+def partials_from_wire(specs: list[AggSpec], wire: dict) -> dict:
+    return {s.name: partial_from_wire(s, wire[s.name])
+            for s in specs if s.name in wire}

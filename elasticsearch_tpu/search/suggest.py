@@ -95,6 +95,42 @@ def term_candidates(vocab: dict[str, int], token: str, *,
     return out[:size]
 
 
+def merge_suggest(body: dict, parts: list[dict]) -> dict:
+    """Merge per-shard suggest results (ref SearchPhaseController.merge
+    suggest reduce): entries align by position (same text/offset on every
+    shard); options merge by text — freq sums, score maxes — then re-rank."""
+    out: dict = {}
+    for part in parts:
+        for name, entries in part.items():
+            if name not in out:
+                out[name] = [dict(e, options=list(e["options"]))
+                             for e in entries]
+                continue
+            for cur, new in zip(out[name], entries):
+                by_text = {o["text"]: o for o in cur["options"]}
+                for o in new["options"]:
+                    ex = by_text.get(o["text"])
+                    if ex is None:
+                        o = dict(o)
+                        cur["options"].append(o)
+                        by_text[o["text"]] = o
+                    else:
+                        if "freq" in ex or "freq" in o:
+                            ex["freq"] = ex.get("freq", 0) + o.get("freq", 0)
+                        ex["score"] = max(ex.get("score", 0.0),
+                                          o.get("score", 0.0))
+    for name, entries in out.items():
+        spec = body.get(name, {}) if isinstance(body.get(name), dict) else {}
+        inner = spec.get("term") or spec.get("phrase") \
+            or spec.get("completion") or {}
+        size = int(inner.get("size", 5))
+        for e in entries:
+            e["options"].sort(key=lambda o: (-o.get("score", 0.0),
+                                             o["text"]))
+            e["options"] = e["options"][:size]
+    return out
+
+
 def run_suggest(body: dict, segments) -> dict:
     """Execute a suggest request body over one index's segments.
     body: {global "text"?, name: {"text"?, "term"|"phrase"|"completion":

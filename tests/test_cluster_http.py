@@ -1,0 +1,155 @@
+"""REST over a REAL 3-node cluster: HttpServer fronting ClusterNode.
+
+VERDICT r4 #1 — "front a ClusterHarness with HttpServer so REST requests
+hit a real cluster". Requests enter over HTTP, coordinate via the
+transport seam, and fan out to shards on three nodes.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.cluster import TestCluster
+from elasticsearch_tpu.rest import HttpServer
+from elasticsearch_tpu.rest.cluster_gateway import register_cluster_routes
+
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    cluster = TestCluster(3, str(tmp_path_factory.mktemp("chttp")))
+    server = HttpServer(cluster.client(), port=0,
+                        registrar=register_cluster_routes).start()
+    yield cluster, f"http://127.0.0.1:{server.port}"
+    server.stop()
+    cluster.close()
+
+
+def req(base, method, path, body=None, raw=False):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) \
+            else json.dumps(body).encode()
+    r = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        resp = urllib.request.urlopen(r)
+        code, payload = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, payload = e.code, e.read()
+    if raw:
+        return code, payload.decode()
+    return code, (json.loads(payload) if payload else {})
+
+
+def test_cluster_over_http_end_to_end(http):
+    cluster, base = http
+    code, banner = req(base, "GET", "/")
+    assert code == 200 and banner["tagline"] == "You Know, for Search"
+
+    code, _ = req(base, "PUT", "/docs", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 1},
+        "mappings": {"_doc": {"properties": {
+            "title": {"type": "string"},
+            "n": {"type": "long"},
+            "tag": {"type": "string", "index": "not_analyzed"}}}}})
+    assert code == 200
+    code, h = req(base, "GET", "/_cluster/health?wait_for_status=green")
+    assert h["status"] == "green"
+    assert h["number_of_nodes"] == 3
+    assert h["active_shards"] == 6          # 3 primaries + 3 replicas
+
+    # bulk over HTTP -> replicated writes across nodes
+    lines = []
+    for i in range(30):
+        lines.append(json.dumps({"index": {"_index": "docs", "_id": str(i)}}))
+        lines.append(json.dumps({"title": f"quick brown doc {i}",
+                                 "n": i, "tag": ["a", "b", "c"][i % 3]}))
+    code, out = req(base, "POST", "/_bulk?refresh=true",
+                    ("\n".join(lines) + "\n").encode())
+    assert code == 200 and out["errors"] is False
+
+    # distributed search with aggs + sort over HTTP
+    code, out = req(base, "POST", "/docs/_search", {
+        "query": {"match": {"title": "quick"}},
+        "sort": [{"n": "desc"}], "size": 5,
+        "aggs": {"tags": {"terms": {"field": "tag"}},
+                 "avg_n": {"avg": {"field": "n"}}}})
+    assert code == 200
+    assert out["hits"]["total"] == 30
+    assert [h["sort"][0] for h in out["hits"]["hits"]] == [29, 28, 27, 26, 25]
+    assert out["_shards"] == {"total": 3, "successful": 3, "failed": 0}
+    assert out["aggregations"]["avg_n"]["value"] == pytest.approx(14.5)
+    assert {b["key"]: b["doc_count"]
+            for b in out["aggregations"]["tags"]["buckets"]} \
+        == {"a": 10, "b": 10, "c": 10}
+
+    # doc CRUD routed by id
+    code, out = req(base, "GET", "/docs/_doc/7")
+    assert code == 200 and out["_source"]["n"] == 7
+    code, out = req(base, "DELETE", "/docs/_doc/7?refresh=true")
+    assert code == 200
+    code, out = req(base, "GET", "/docs/_doc/7")
+    assert code == 404
+
+    # count
+    code, out = req(base, "GET", "/docs/_count")
+    assert out["count"] == 29
+
+    # scroll over HTTP
+    code, out = req(base, "POST", "/docs/_search?scroll=1m",
+                    {"query": {"match_all": {}}, "size": 10})
+    sid = out["_scroll_id"]
+    seen = [h["_id"] for h in out["hits"]["hits"]]
+    while True:
+        code, out = req(base, "POST", "/_search/scroll",
+                        {"scroll_id": sid, "scroll": "1m"})
+        if not out["hits"]["hits"]:
+            break
+        seen.extend(h["_id"] for h in out["hits"]["hits"])
+    assert len(seen) == 29 and len(set(seen)) == 29
+    code, out = req(base, "DELETE", "/_search/scroll", {"scroll_id": sid})
+    assert out["found"] is True
+
+    # msearch
+    body = "\n".join([
+        json.dumps({"index": "docs"}),
+        json.dumps({"query": {"term": {"tag": "a"}}, "size": 0}),
+        json.dumps({"index": "docs"}),
+        json.dumps({"size": 0,
+                    "aggs": {"m": {"max": {"field": "n"}}}})]) + "\n"
+    code, out = req(base, "POST", "/_msearch", body.encode())
+    assert out["responses"][0]["hits"]["total"] == 10
+    assert out["responses"][1]["aggregations"]["m"]["value"] == 29
+
+    # mapping round-trip over the master
+    code, _ = req(base, "PUT", "/docs/_mapping/_doc",
+                  {"properties": {"extra": {"type": "long"}}})
+    code, out = req(base, "GET", "/docs/_mapping")
+    assert out["docs"]["mappings"]["_doc"]["properties"]["extra"][
+        "type"] == "long"
+
+    # cat endpoints
+    code, txt = req(base, "GET", "/_cat/shards", raw=True)
+    assert code == 200 and "docs" in txt and " p " in txt
+    code, txt = req(base, "GET", "/_cat/nodes", raw=True)
+    assert "*" in txt and len(txt.strip().split("\n")) == 3
+
+
+def test_http_search_survives_node_loss(http):
+    cluster, base = http
+    code, _ = req(base, "PUT", "/ha", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1}})
+    req(base, "GET", "/_cluster/health?wait_for_status=green")
+    for i in range(10):
+        req(base, "PUT", f"/ha/_doc/{i}", {"v": i})
+    req(base, "POST", "/ha/_refresh")
+    # kill a node that is NOT the HTTP coordinator; replicas cover
+    coordinator = cluster.client().node_id
+    victim = next(nid for nid in cluster.nodes if nid != coordinator)
+    cluster.kill_node(victim)
+    cluster.detect_once()
+    code, out = req(base, "GET", "/_cluster/health?wait_for_status=yellow")
+    code, out = req(base, "POST", "/ha/_search",
+                    {"query": {"match_all": {}}, "size": 10})
+    assert code == 200
+    assert out["hits"]["total"] == 10       # replicas served the dead node's

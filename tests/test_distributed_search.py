@@ -1,0 +1,317 @@
+"""Distributed search parity: the full body over the transport seam.
+
+VERDICT r4 #1 — aggs, sort, highlight, suggest, scroll, search_after and
+rescore must cross the cluster seam and reduce to the SAME answers the
+single-node engine gives (the DFS stats round makes IDF cluster-global, so
+scores match bit-for-bit regardless of sharding).
+Ref: action/search/type/TransportSearchTypeAction.java:85-177,
+search/controller/SearchPhaseController.java:282-399, DfsPhase.java:57-81.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster import TestCluster
+from elasticsearch_tpu.node import NodeService
+
+DOCS = [
+    {"_id": str(i),
+     "title": f"doc {i} " + ("quick brown fox " * (i % 3 + 1)),
+     "body": ("lazy dog jumps" if i % 2 else "sleepy cat sits")
+             + f" token{i % 5}",
+     "rank": i % 7,
+     "price": float(100 - i),
+     "tag": ["red", "green", "blue"][i % 3]}
+    for i in range(60)
+]
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """(single NodeService, 3-node cluster client) over the SAME corpus."""
+    root = tmp_path_factory.mktemp("dist")
+    single = NodeService(str(root / "single"))
+    single.create_index("docs", settings={"number_of_shards": 1})
+    for d in DOCS:
+        src = {k: v for k, v in d.items() if k != "_id"}
+        single.index_doc("docs", d["_id"], src)
+    single.refresh("docs")
+
+    cluster = TestCluster(3, str(root / "cluster"))
+    client = cluster.client()
+    client.create_index("docs", {"number_of_shards": 3,
+                                 "number_of_replicas": 1})
+    cluster.ensure_green()
+    for d in DOCS:
+        src = {k: v for k, v in d.items() if k != "_id"}
+        client.index_doc("docs", d["_id"], src)
+    client.refresh("docs")
+    yield single, client
+    single.close()
+    cluster.close()
+
+
+def _hits(resp):
+    return [(h["_id"], round(h["_score"], 5) if h["_score"] else h["_score"])
+            for h in resp["hits"]["hits"]]
+
+
+class TestParity:
+    def test_match_scores_match_single_node(self, pair):
+        single, client = pair
+        body = {"query": {"match": {"body": "lazy token1"}}, "size": 20}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        assert c["hits"]["total"] == s["hits"]["total"]
+        # scores match bit-for-bit thanks to the DFS global-IDF round; WHICH
+        # equal-score tie makes the size cutoff depends on shard layout
+        # (true in the reference too: TopDocs.merge ties break by shard
+        # ordinal) — so compare the score multiset and per-id scores
+        assert sorted(h[1] for h in _hits(c)) \
+            == sorted(h[1] for h in _hits(s))
+        s_by_id = dict(_hits(s))
+        for hid, score in _hits(c):
+            if hid in s_by_id:
+                assert score == s_by_id[hid]
+        assert c["hits"]["max_score"] == pytest.approx(
+            s["hits"]["max_score"], rel=1e-5)
+
+    def test_sort_parity(self, pair):
+        single, client = pair
+        body = {"query": {"match_all": {}},
+                "sort": [{"rank": "asc"}, {"price": "desc"}], "size": 15}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        assert [h["_id"] for h in c["hits"]["hits"]] \
+            == [h["_id"] for h in s["hits"]["hits"]]
+        assert [h["sort"] for h in c["hits"]["hits"]] \
+            == [h["sort"] for h in s["hits"]["hits"]]
+
+    def test_from_pagination_parity(self, pair):
+        single, client = pair
+        body = {"query": {"match": {"title": "quick"}},
+                "sort": [{"price": "desc"}], "from": 5, "size": 7}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        assert [h["_id"] for h in c["hits"]["hits"]] \
+            == [h["_id"] for h in s["hits"]["hits"]]
+
+    def test_aggs_parity(self, pair):
+        single, client = pair
+        body = {"size": 0, "aggs": {
+            "tags": {"terms": {"field": "tag"},
+                     "aggs": {"avg_price": {"avg": {"field": "price"}}}},
+            "ranks": {"histogram": {"field": "rank", "interval": 2}},
+            "price_stats": {"extended_stats": {"field": "price"}},
+            "uniq": {"cardinality": {"field": "tag"}},
+            "pct": {"percentiles": {"field": "price",
+                                    "percents": [50, 95]}}}}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        assert c["aggregations"]["tags"] == s["aggregations"]["tags"]
+        assert c["aggregations"]["ranks"] == s["aggregations"]["ranks"]
+        for k, v in s["aggregations"]["price_stats"].items():
+            assert c["aggregations"]["price_stats"][k] == pytest.approx(
+                v, rel=1e-9), k
+        assert c["aggregations"]["uniq"] == s["aggregations"]["uniq"]
+        for k, v in s["aggregations"]["pct"]["values"].items():
+            assert c["aggregations"]["pct"]["values"][k] == pytest.approx(
+                v, rel=1e-6)
+
+    def test_filter_agg_and_range_parity(self, pair):
+        single, client = pair
+        body = {"size": 0, "aggs": {
+            "cheap": {"filter": {"range": {"price": {"lt": 70}}},
+                      "aggs": {"n": {"value_count": {"field": "price"}}}},
+            "bands": {"range": {"field": "price", "ranges": [
+                {"to": 50}, {"from": 50, "to": 80}, {"from": 80}]}}}}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        assert c["aggregations"] == s["aggregations"]
+
+    def test_highlight_parity(self, pair):
+        single, client = pair
+        body = {"query": {"match": {"body": "lazy"}},
+                "sort": [{"price": "asc"}],
+                "highlight": {"fields": {"body": {}}}, "size": 5}
+        s = single.search("docs", dict(body))
+        c = client.search("docs", dict(body))
+        sh = {h["_id"]: h.get("highlight") for h in s["hits"]["hits"]}
+        ch = {h["_id"]: h.get("highlight") for h in c["hits"]["hits"]}
+        assert ch == sh
+        assert any(v for v in ch.values())
+
+    def test_source_filtering(self, pair):
+        _single, client = pair
+        c = client.search("docs", {"query": {"match_all": {}},
+                                   "_source": ["title"], "size": 3})
+        for h in c["hits"]["hits"]:
+            assert set(h["_source"]) == {"title"}
+        c = client.search("docs", {"query": {"match_all": {}},
+                                   "_source": False, "size": 3})
+        assert all(h["_source"] is None for h in c["hits"]["hits"])
+
+    def test_search_after_parity(self, pair):
+        single, client = pair
+        body = {"query": {"match_all": {}},
+                "sort": [{"price": "asc"}], "size": 10}
+        s1 = single.search("docs", dict(body))
+        c1 = client.search("docs", dict(body))
+        after = c1["hits"]["hits"][-1]["sort"]
+        body2 = {**body, "search_after": after}
+        s2 = single.search("docs", dict(body2))
+        c2 = client.search("docs", dict(body2))
+        assert [h["_id"] for h in c2["hits"]["hits"]] \
+            == [h["_id"] for h in s2["hits"]["hits"]]
+
+    def test_suggest_over_cluster(self, pair):
+        _single, client = pair
+        r = client.search("docs", {"size": 0, "suggest": {
+            "fix": {"text": "lazi", "term": {"field": "body"}}}})
+        opts = r["suggest"]["fix"][0]["options"]
+        assert any(o["text"] == "lazy" for o in opts)
+
+    def test_msearch(self, pair):
+        _single, client = pair
+        out = client.msearch([
+            ({"index": "docs"}, {"query": {"match": {"body": "lazy"}}}),
+            ({"index": "missing-idx"}, {"query": {"match_all": {}}}),
+            ({"index": "docs"}, {"size": 0,
+                                 "aggs": {"t": {"terms": {"field": "tag"}}}}),
+        ])
+        assert out["responses"][0]["hits"]["total"] == 30
+        assert "error" in out["responses"][1]
+        assert len(out["responses"][2]["aggregations"]["t"]["buckets"]) == 3
+
+    def test_count(self, pair):
+        _single, client = pair
+        assert client.count(
+            "docs", {"query": {"match": {"body": "lazy"}}})["count"] == 30
+
+    def test_rescore_over_cluster(self, pair):
+        single, client = pair
+        body = {"query": {"match": {"title": "quick"}}, "size": 10,
+                "rescore": {"window_size": 10, "query": {
+                    "rescore_query": {"match": {"body": "lazy"}},
+                    "query_weight": 1.0, "rescore_query_weight": 2.0}}}
+        # rescore windows and the rescore query's IDF are per-shard in the
+        # reference too, so exact cross-layout parity is not expected —
+        # verify the rescore actually reranked: every top hit that matches
+        # the rescore query must outrank every one that doesn't
+        c = client.search("docs", dict(body))
+        plain = client.search("docs", {"query": {"match": {"title": "quick"}},
+                                       "size": 10})
+        assert c["_shards"]["failed"] == 0
+        scores = [(("lazy" in h["_source"]["body"]), h["_score"])
+                  for h in c["hits"]["hits"]]
+        lazy_min = min((s for is_l, s in scores if is_l), default=0)
+        other_max = max((s for is_l, s in scores if not is_l), default=0)
+        assert lazy_min > other_max
+        assert c["hits"]["hits"][0]["_score"] \
+            > plain["hits"]["hits"][0]["_score"]
+
+
+class TestScrollDistributed:
+    def test_scroll_streams_everything_once(self, pair):
+        _single, client = pair
+        r = client.search("docs", {"query": {"match_all": {}}, "size": 7},
+                          scroll="1m")
+        sid = r["_scroll_id"]
+        seen = [h["_id"] for h in r["hits"]["hits"]]
+        assert r["hits"]["total"] == 60
+        while True:
+            r = client.scroll(sid)
+            batch = [h["_id"] for h in r["hits"]["hits"]]
+            if not batch:
+                break
+            seen.extend(batch)
+        assert len(seen) == 60
+        assert len(set(seen)) == 60
+        assert client.clear_scroll(sid)
+
+    def test_scroll_sorted_order_is_global(self, pair):
+        _single, client = pair
+        r = client.search("docs", {"query": {"match_all": {}},
+                                   "sort": [{"price": "asc"}], "size": 9},
+                          scroll="1m")
+        sid = r["_scroll_id"]
+        prices = [h["sort"][0] for h in r["hits"]["hits"]]
+        while True:
+            r = client.scroll(sid)
+            if not r["hits"]["hits"]:
+                break
+            prices.extend(h["sort"][0] for h in r["hits"]["hits"])
+        assert prices == sorted(prices)
+        assert len(prices) == 60
+        client.clear_scroll(sid)
+
+    def test_scroll_isolated_from_writes(self, pair):
+        _single, client = pair
+        r = client.search("docs", {"query": {"match_all": {}}, "size": 10},
+                          scroll="1m")
+        sid = r["_scroll_id"]
+        client.index_doc("docs", "new-doc", {"title": "late arrival",
+                                             "body": "lazy dog jumps",
+                                             "rank": 1, "price": 1.0,
+                                             "tag": "red"})
+        client.refresh("docs")
+        seen = [h["_id"] for h in r["hits"]["hits"]]
+        while True:
+            r = client.scroll(sid)
+            if not r["hits"]["hits"]:
+                break
+            seen.extend(h["_id"] for h in r["hits"]["hits"])
+        assert "new-doc" not in seen        # pinned snapshot
+        assert len(seen) == 60
+        client.clear_scroll(sid)
+        client.delete_doc("docs", "new-doc")
+        client.refresh("docs")
+
+
+class TestPartialFailure:
+    def test_failed_shard_counted_not_fatal(self, tmp_path):
+        cluster = TestCluster(3, str(tmp_path))
+        try:
+            client = cluster.client()
+            client.create_index("logs", {"number_of_shards": 3,
+                                         "number_of_replicas": 0})
+            cluster.ensure_green()
+            for i in range(30):
+                client.index_doc("logs", str(i), {"n": i})
+            client.refresh("logs")
+            # kill a non-client node hosting a primary; with 0 replicas the
+            # shard is simply gone -> partial results, failed accounted
+            state = client.cluster.current()
+            victim = next(
+                c["node"] for sid in range(3)
+                for c in state.started_copies("logs", sid)
+                if c["node"] != client.node_id)
+            cluster.network.disconnect(victim)
+            out = client.search("logs", {"query": {"match_all": {}},
+                                         "size": 30})
+            assert out["_shards"]["failed"] >= 1
+            assert out["_shards"]["successful"] \
+                == out["_shards"]["total"] - out["_shards"]["failed"]
+            assert out["_shards"]["failures"]
+            assert 0 < out["hits"]["total"] < 30
+        finally:
+            cluster.close()
+
+
+class TestReplicaReadBalancing:
+    def test_reads_spread_across_copies(self, tmp_path):
+        cluster = TestCluster(2, str(tmp_path))
+        try:
+            client = cluster.client()
+            client.create_index("docs", {"number_of_shards": 1,
+                                         "number_of_replicas": 1})
+            cluster.ensure_green()
+            client.index_doc("docs", "1", {"t": "x"})
+            client.refresh("docs")
+            state = client.cluster.current()
+            nodes_used = set()
+            for _ in range(6):
+                targets = client.search_shards(state, ["docs"])
+                nodes_used.add(targets[0][0])
+            assert len(nodes_used) == 2     # round-robin over both copies
+        finally:
+            cluster.close()

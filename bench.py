@@ -46,6 +46,16 @@ K = 1000                  # top-1000 (headline metric)
 T = 4                     # terms per query
 LATENCY_N = 50            # solo _search latency probes
 
+# config #3: terms + date_histogram analytics over a log-event corpus
+AGG_DOCS = int(os.environ.get("BENCH_AGG_DOCS", str(1_000_000)))
+AGG_Q = 64                # agg requests per msearch batch
+AGG_BATCHES = 4
+# configs #4/#5: stored-vector cosine + BM25->dense hybrid rescore
+VEC_DOCS = int(os.environ.get("BENCH_VEC_DOCS", str(20_000)))
+VEC_DIMS = 768
+VEC_Q = 32
+VEC_BATCHES = 4
+
 
 def make_corpus(n_docs: int, seed: int = 7):
     """Zipf-distributed synthetic English-like corpus, built as strings so
@@ -77,6 +87,209 @@ def http(port: int, method: str, path: str, body: bytes | str = b"",
                                  data=body or None, method=method)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def run_agg_leg(tag: str) -> dict:
+    """BASELINE config #3: terms + date_histogram aggregations over an
+    AGG_DOCS log-event index, through HTTP — the device-side masked
+    bincount / affine-histogram collect path (ops/aggs.py)."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+
+    workdir = tempfile.mkdtemp(prefix=f"bench-agg-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"))
+    server = HttpServer(node, port=0).start()
+    port = server.port
+    try:
+        rng = np.random.default_rng(11)
+        tags = [f"svc{i:02d}" for i in range(20)]
+        t0 = time.perf_counter()
+        http(port, "PUT", "/logs", json.dumps(
+            {"settings": {"number_of_shards": 1},
+             "mappings": {"_doc": {"properties": {
+                 "tag": {"type": "string", "index": "not_analyzed"},
+                 "ts": {"type": "date"},
+                 "value": {"type": "long"}}}}}))
+        base_ms = 1_700_000_000_000
+        batch = 10_000
+        tag_ids = rng.integers(0, len(tags), AGG_DOCS)
+        ts = base_ms + rng.integers(0, 30 * 86_400_000, AGG_DOCS)
+        vals = rng.integers(0, 10_000, AGG_DOCS)
+        for i in range(0, AGG_DOCS, batch):
+            lines = []
+            for j in range(i, min(i + batch, AGG_DOCS)):
+                lines.append('{"index":{"_id":"%d"}}' % j)
+                lines.append('{"tag":"%s","ts":%d,"value":%d}'
+                             % (tags[tag_ids[j]], ts[j], vals[j]))
+            http(port, "POST", "/logs/_bulk", "\n".join(lines) + "\n")
+        http(port, "POST", "/logs/_refresh")
+        http(port, "POST", "/logs/_optimize")
+        index_secs = time.perf_counter() - t0
+
+        payloads = []
+        for bi in range(AGG_BATCHES):
+            lines = []
+            for qi in range(AGG_Q):
+                tag = tags[(bi * AGG_Q + qi) % len(tags)]
+                lines.append('{"index":"logs"}')
+                lines.append(json.dumps({
+                    "size": 0,
+                    "query": {"term": {"tag": tag}},
+                    "aggs": {
+                        "per_day": {"date_histogram": {"field": "ts",
+                                                       "interval": "1d"}},
+                        "by_tag": {"terms": {"field": "tag"}},
+                        "val_stats": {"stats": {"field": "value"}}}}))
+            payloads.append("\n".join(lines) + "\n")
+        http(port, "POST", "/_msearch", payloads[0])     # warm compile
+        t1 = time.perf_counter()
+        n = 0
+        for _ in range(REPS):
+            for pl in payloads:
+                out = http(port, "POST", "/_msearch", pl)
+                n += len(out["responses"])
+        return {"agg_qps": n / (time.perf_counter() - t1),
+                "agg_index_secs": index_secs}
+    finally:
+        server.stop()
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_vector_leg(tag: str) -> dict:
+    """BASELINE configs #4/#5: function_score cosine over stored 768-d
+    vectors (exact kNN through the product) and BM25->dense hybrid rescore,
+    with recall@10 against a numpy brute-force oracle."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+
+    workdir = tempfile.mkdtemp(prefix=f"bench-vec-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"))
+    server = HttpServer(node, port=0).start()
+    port = server.port
+    try:
+        rng = np.random.default_rng(23)
+        vecs = rng.normal(0, 1, (VEC_DOCS, VEC_DIMS)).astype(np.float32)
+        vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+        docs = make_corpus(VEC_DOCS, seed=29)
+        t0 = time.perf_counter()
+        http(port, "PUT", "/vec", json.dumps(
+            {"settings": {"number_of_shards": 1},
+             "mappings": {"_doc": {"properties": {
+                 "body": {"type": "string"},
+                 "emb": {"type": "dense_vector",
+                         "dims": VEC_DIMS}}}}}))
+        batch = 500
+        for i in range(0, VEC_DOCS, batch):
+            lines = []
+            for j in range(i, min(i + batch, VEC_DOCS)):
+                lines.append('{"index":{"_id":"%d"}}' % j)
+                emb = ",".join("%.3f" % x for x in vecs[j])
+                lines.append('{"body":%s,"emb":[%s]}'
+                             % (json.dumps(docs[j]), emb))
+            http(port, "POST", "/vec/_bulk", "\n".join(lines) + "\n")
+        http(port, "POST", "/vec/_refresh")
+        http(port, "POST", "/vec/_optimize")
+        index_secs = time.perf_counter() - t0
+
+        nq = VEC_Q * VEC_BATCHES
+        qv = rng.normal(0, 1, (nq, VEC_DIMS)).astype(np.float32)
+        qv /= np.linalg.norm(qv, axis=1, keepdims=True)
+        # brute-force oracle top-10 by cosine
+        oracle = np.argsort(-(qv @ vecs.T), axis=1)[:, :10]
+        queries = make_queries(nq, seed=31)
+
+        def measure(body_of, oracle_of=None):
+            payloads = []
+            for bi in range(VEC_BATCHES):
+                lines = []
+                for qi in range(VEC_Q):
+                    gi = bi * VEC_Q + qi
+                    lines.append('{"index":"vec"}')
+                    lines.append(json.dumps(body_of(gi)))
+                payloads.append("\n".join(lines) + "\n")
+            first = http(port, "POST", "/_msearch", payloads[0])  # warm
+            recall = None
+            if oracle_of is not None:
+                hits_total = 0
+                match_total = 0
+                for bi, pl in enumerate(payloads):
+                    out = first if bi == 0 \
+                        else http(port, "POST", "/_msearch", pl)
+                    for qi, resp in enumerate(out["responses"]):
+                        gi = bi * VEC_Q + qi
+                        want = oracle_of(gi)
+                        got = {int(h["_id"])
+                               for h in resp["hits"]["hits"][:len(want)]}
+                        match_total += len(got & want)
+                        hits_total += len(want)
+                recall = match_total / max(hits_total, 1)
+            t1 = time.perf_counter()
+            n = 0
+            for _ in range(REPS):
+                for pl in payloads:
+                    out = http(port, "POST", "/_msearch", pl)
+                    n += len(out["responses"])
+            return n / (time.perf_counter() - t1), recall
+
+        # config #4: exact kNN through the product (knn body -> MXU matmul)
+        knn_qps, knn_recall = measure(
+            lambda gi: {"knn": {"field": "emb",
+                                "query_vector": [round(float(x), 3)
+                                                 for x in qv[gi]],
+                                "k": 10},
+                        "size": 10, "_source": False},
+            oracle_of=lambda gi: set(oracle[gi]))
+
+        # hybrid recall oracle: cosine top-10 restricted to each query's
+        # BM25 top-K candidate window (rerank quality — end-to-end recall
+        # vs global kNN would only measure the BM25 gate on random text)
+        cand_lines = []
+        for gi in range(nq):
+            cand_lines.append('{"index":"vec"}')
+            cand_lines.append(json.dumps(
+                {"query": {"match": {"body": queries[gi]}}, "size": K,
+                 "_source": False}))
+        cand_out = http(port, "POST", "/_msearch",
+                        "\n".join(cand_lines) + "\n")
+        hybrid_oracle = []
+        for gi, resp in enumerate(cand_out["responses"]):
+            cand = np.array([int(h["_id"])
+                             for h in resp["hits"]["hits"]], np.int64)
+            if len(cand) == 0:
+                hybrid_oracle.append(set())
+                continue
+            sims = qv[gi] @ vecs[cand].T
+            top = cand[np.argsort(-sims)[:10]]
+            hybrid_oracle.append(set(int(x) for x in top))
+        # config #5: hybrid — BM25 top-1000 then dense rescore to top-10
+        hybrid_qps, hybrid_recall = measure(
+            lambda gi: {"query": {"match": {"body": queries[gi]}},
+                        "size": 10,
+                        "rescore": {"window_size": K, "query": {
+                            "rescore_query": {"function_score": {
+                                "query": {"match_all": {}},
+                                "cosine": {"field": "emb",
+                                           "query_vectors": [
+                                               [round(float(x), 3)
+                                                for x in qv[gi]]]},
+                                "boost_mode": "replace"}},
+                            "query_weight": 0.0,
+                            "rescore_query_weight": 1.0,
+                            "score_mode": "total"}},
+                        "_source": False},
+            oracle_of=lambda gi: hybrid_oracle[gi])
+        return {"knn_qps": knn_qps, "knn_recall": knn_recall,
+                "hybrid_qps": hybrid_qps, "hybrid_recall": hybrid_recall,
+                "vec_index_secs": index_secs}
+    finally:
+        server.stop()
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def run_engine_leg(tag: str) -> dict:
@@ -201,14 +414,25 @@ def run_engine_leg(tag: str) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _run_all_legs(tag: str) -> dict:
+    res = run_engine_leg(tag)
+    if os.environ.get("BENCH_AGG", "1") != "0":
+        res.update(run_agg_leg(tag))
+    if os.environ.get("BENCH_VEC", "1") != "0":
+        res.update(run_vector_leg(tag))
+    return res
+
+
 def main_engine():
     import subprocess
-    res = run_engine_leg("main")
-    vs = vs_filter = vs_conc = None   # null = baseline leg didn't run
+    res = _run_all_legs("main")
+    ratios: dict = {}
     import jax
     plat = jax.devices()[0].platform
+    ratio_keys = ["qps", "qps_filter", "conc_qps", "agg_qps", "knn_qps",
+                  "hybrid_qps"]
     if plat == "cpu":
-        vs = vs_filter = vs_conc = 1.0
+        ratios = {k: 1.0 for k in ratio_keys if k in res}
     elif os.environ.get("BENCH_CPU", "1") != "0":
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
@@ -216,36 +440,50 @@ def main_engine():
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=3600)
+                env=env, capture_output=True, text=True, timeout=7200)
             for ln in out.stdout.splitlines():
                 if ln.startswith("{"):
                     cpu = json.loads(ln)
-                    vs = res["qps"] / max(cpu["value"], 1e-9)
-                    if cpu.get("qps_filter"):
-                        vs_filter = res["qps_filter"] / cpu["qps_filter"]
-                    if cpu.get("conc_qps"):
-                        vs_conc = res["conc_qps"] / cpu["conc_qps"]
+                    for k in ratio_keys:
+                        if res.get(k) and cpu.get(k):
+                            ratios[k] = res[k] / cpu[k]
                     break
-            if vs is None:
+            if not ratios:
                 print(f"cpu leg produced no result (rc={out.returncode}): "
                       f"{out.stderr[-500:]}", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
             print(f"cpu leg failed: {e}", file=sys.stderr)
     rnd = lambda x: round(x, 3) if x is not None else None  # noqa: E731
-    print(json.dumps({
+    line = {
         "metric": f"http_msearch_bm25_top{K}_qps_{N_DOCS // 1000}k_docs",
         "value": round(res["qps"], 2), "unit": "qps",
-        "vs_baseline": rnd(vs),
+        "vs_baseline": rnd(ratios.get("qps")),
         "qps_filter": round(res["qps_filter"], 2),
-        "vs_baseline_filter": rnd(vs_filter),
+        "vs_baseline_filter": rnd(ratios.get("qps_filter")),
         "conc_qps": round(res["conc_qps"], 2),
-        "vs_baseline_concurrent": rnd(vs_conc),
+        "vs_baseline_concurrent": rnd(ratios.get("conc_qps")),
         "conc_p50_ms": round(res["conc_p50_ms"], 2),
         "conc_clients": res["conc_clients"],
         "p50_ms": round(res["p50_ms"], 2),
         "p99_ms": round(res["p99_ms"], 2),
         "index_secs": round(res["index_secs"], 1),
-        "platform": plat}))
+        "platform": plat}
+    if "agg_qps" in res:
+        line.update({
+            "agg_qps": round(res["agg_qps"], 2),
+            "vs_baseline_agg": rnd(ratios.get("agg_qps")),
+            "agg_docs": AGG_DOCS,
+            "agg_index_secs": round(res["agg_index_secs"], 1)})
+    if "knn_qps" in res:
+        line.update({
+            "knn_qps": round(res["knn_qps"], 2),
+            "vs_baseline_knn": rnd(ratios.get("knn_qps")),
+            "knn_recall_at_10": round(res["knn_recall"], 4),
+            "hybrid_qps": round(res["hybrid_qps"], 2),
+            "vs_baseline_hybrid": rnd(ratios.get("hybrid_qps")),
+            "hybrid_recall_at_10": round(res["hybrid_recall"], 4),
+            "vec_docs": VEC_DOCS, "vec_dims": VEC_DIMS})
+    print(json.dumps(line))
 
 
 # ---------------------------------------------------------------------------
@@ -327,12 +565,11 @@ if __name__ == "__main__":
     if "--kernel" in sys.argv:
         main_kernel()
     elif os.environ.get("BENCH_LEG") == "cpu":
-        res = run_engine_leg("cpu")
-        print(json.dumps({"metric": "cpu_leg", "value": round(res["qps"], 2),
-                          "qps_filter": round(res["qps_filter"], 2),
-                          "conc_qps": round(res["conc_qps"], 2),
-                          "conc_p50_ms": round(res["conc_p50_ms"], 2),
-                          "p50_ms": round(res["p50_ms"], 2),
-                          "unit": "qps"}))
+        res = _run_all_legs("cpu")
+        out = {"metric": "cpu_leg", "unit": "qps"}
+        for k, v in res.items():
+            if isinstance(v, (int, float)):
+                out[k] = round(v, 3)
+        print(json.dumps(out))
     else:
         main_engine()

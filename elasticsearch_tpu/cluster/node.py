@@ -1073,11 +1073,21 @@ class ClusterNode:
             raise ValueError("scroll does not support "
                              "knn/rescore/search_after")
         ctxs = []
+        ok_targets = []
         for node, name, sid in targets:
-            r = self._shard_call(node, A_SCROLL_NEXT, {
-                "index": name, "shard": sid,
-                "init": {"body": body, "keep_alive": keep_alive}})
+            try:
+                r = self._shard_call(node, A_SCROLL_NEXT, {
+                    "index": name, "shard": sid,
+                    "init": {"body": body, "keep_alive": keep_alive}})
+            except (ConnectTransportException,
+                    RemoteTransportException):
+                continue    # partial scroll, like the query phase
             ctxs.append(r["ctx"])
+            ok_targets.append((node, name, sid))
+        if not ok_targets:
+            raise UnavailableShardsException(
+                "scroll could not pin any shard context")
+        targets = ok_targets
         with self._scroll_lock:
             self._scroll_seq += 1
             scroll_id = f"c-scroll-{self.node_id}-{self._scroll_seq}"
@@ -1120,7 +1130,7 @@ class ClusterNode:
         return True
 
     def _scroll_batch(self, ctx, t0) -> dict:
-        with ctx.get("lock") or threading.Lock():
+        with ctx["lock"]:
             return self._scroll_batch_locked(ctx, t0)
 
     def _scroll_batch_locked(self, ctx, t0) -> dict:
@@ -1274,12 +1284,8 @@ class ClusterNode:
 # ---------------------------------------------------------------------------
 
 def _keepalive_secs(s: str) -> float:
-    s = str(s).strip()
-    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
-    for u in ("ms", "s", "m", "h", "d"):
-        if s.endswith(u):
-            return float(s[: -len(u)]) * units[u]
-    return float(s)
+    from ..node import _duration_secs     # one duration grammar everywhere
+    return _duration_secs(s)
 
 
 def _jsonval(v):
@@ -1403,6 +1409,12 @@ def _shard_fetch_phase(engine: Engine, mappers: MapperService,
             except Exception:  # noqa: BLE001 — highlight degrades to none
                 pass
 
+    def an_for(fname):
+        for dm in mappers._mappers.values():
+            if fname in dm.fields:
+                return dm.search_analyzer_for(fname)
+        return mappers.analysis.analyzer("standard")
+
     src_spec = req.get("_source", True)
     hits = []
     for doc_id in req["ids"]:
@@ -1417,13 +1429,6 @@ def _shard_fetch_phase(engine: Engine, mappers: MapperService,
         hit = {"_id": doc_id, "_type": r.type_name, "_source": src}
         if hl_spec is not None:
             from ..search.highlight import highlight_hit
-
-            def an_for(fname):
-                for dm in mappers._mappers.values():
-                    if fname in dm.fields:
-                        return dm.search_analyzer_for(fname)
-                return mappers.analysis.analyzer("standard")
-
             hl = highlight_hit(hl_spec, raw_src, terms_by_field, an_for)
             if hl:
                 hit["highlight"] = hl

@@ -987,18 +987,63 @@ class NodeService:
 
     def _msearch_batch_key(self, index: str, body: dict):
         """Group key for device batching, or None if the request needs the
-        general path (aggs/sort/knn/... or an unparseable query)."""
-        if any(k not in self._BATCHABLE_KEYS for k in body):
+        general path (sort/knn/... or an unparseable query). Requests with
+        IDENTICAL agg trees batch together: the query phase runs once with
+        Q rows and agg collect runs per row against device masks — the
+        analytics-workload analog of the packed lane (BASELINE config #3)."""
+        aggs = body.get("aggs") or body.get("aggregations")
+        if any(k not in self._BATCHABLE_KEYS
+               and k not in ("aggs", "aggregations", "knn", "rescore")
+               for k in body):
             return None
         try:
+            import json as _json
+            knn = body.get("knn")
+            if knn is not None:
+                # batched exact kNN: one MXU matmul per shard serves the
+                # whole group (per-query vectors vary; shape must not)
+                if aggs is not None or body.get("rescore") is not None \
+                        or knn.get("filter") is not None:
+                    return None
+                qv = knn.get("query_vector")
+                if qv is None:
+                    return None
+                return (index, int(body.get("size", 10)),
+                        int(body.get("from", 0)), "knn", knn.get("field"),
+                        int(knn.get("k", 10)),
+                        knn.get("metric", "cosine"), len(qv))
+            agg_key = None
+            if aggs is not None:
+                from .search.aggs.aggregators import has_top_hits, parse_aggs
+                if has_top_hits(parse_aggs(aggs)):
+                    return None     # top_hits needs per-row scores
+                agg_key = _json.dumps(aggs, sort_keys=True)
             names = self._resolve(index)
             if not names:
                 return None
             from .search.query_parser import QueryParser
-            node = QueryParser(self.indices[names[0]].mappers).parse(
-                body.get("query") or {"match_all": {}})
+            parser = QueryParser(self.indices[names[0]].mappers)
+            node = parser.parse(body.get("query") or {"match_all": {}})
+            rescore_key = None
+            rescore = body.get("rescore")
+            if rescore is not None:
+                # batched hybrid rescore: same plan + knobs, per-row vectors
+                if isinstance(rescore, list):
+                    if len(rescore) != 1:
+                        return None
+                    rescore = rescore[0]
+                rs = rescore.get("query", rescore)
+                rq = rs.get("rescore_query")
+                if rq is None or body.get("sort") is not None:
+                    return None
+                rescore_key = (parser.parse(rq).plan_key(),
+                               int(rescore.get("window_size", 0)),
+                               rs.get("score_mode", "total"),
+                               float(rs.get("query_weight", 1.0)),
+                               float(rs.get("rescore_query_weight", 1.0)))
             return (index, int(body.get("size", 10)),
-                    int(body.get("from", 0)), node.plan_key())
+                    int(body.get("from", 0)), node.plan_key(), agg_key,
+                    rescore_key)
         except Exception:  # noqa: BLE001
             return None
 
@@ -1016,7 +1061,25 @@ class NodeService:
             for s in self.indices[n].searchers():
                 searchers.append(s)
                 index_of.append(n)
+        knn = first_body.get("knn")
+        if knn is not None:
+            # batched exact kNN: one matmul per shard for the whole group
+            qvs = [b["knn"]["query_vector"] for _, b in metas]
+            knn_k = int(knn.get("k", 10))
+            results = [
+                s.execute_knn(knn["field"], qvs, k=max(knn_k, size + from_),
+                              metric=knn.get("metric", "cosine"))
+                for s in searchers]
+            size = min(size, max(knn_k - from_, 0))
+            return self._batched_reduce(metas, searchers, index_of, results,
+                                        size, from_, None, t0)
+
         queries = [b.get("query") or {"match_all": {}} for _, b in metas]
+        rescore_spec0 = first_body.get("rescore")
+        if isinstance(rescore_spec0, list):
+            rescore_spec0 = rescore_spec0[0] if rescore_spec0 else None
+        window = int(rescore_spec0.get("window_size", size)) \
+            if rescore_spec0 else 0
         # parse once per index (shards share a MapperService), not per shard;
         # index-global stats keep this lane score-consistent with the packed
         # lane (same IDF everywhere)
@@ -1032,10 +1095,80 @@ class NodeService:
         global_stats = CollectionStats.from_segments(
             [seg for s in searchers for seg in s.segments], terms_by_field)
         results = [
-            s.execute_query_phase(nodes_by_index[index_of[i]], size=size,
+            s.execute_query_phase(nodes_by_index[index_of[i]],
+                                  size=max(size, window),
                                   from_=from_, n_queries=len(queries),
                                   global_stats=global_stats)
             for i, s in enumerate(searchers)]
+        if rescore_spec0 is not None:
+            specs = []
+            for _, b in metas:
+                rs = b.get("rescore")
+                specs.append(rs[0] if isinstance(rs, list) else rs)
+            results = [s.rescore_batch(r, specs)
+                       for s, r in zip(searchers, results)]
+
+        # identical agg trees across the batch (guaranteed by the group
+        # key): ONE batched match-mask program per segment, then per-row
+        # device collect — the config #3 analytics fast lane
+        agg_rendered: list[dict] | None = None
+        aggs_body = first_body.get("aggs") or first_body.get("aggregations")
+        if aggs_body is not None:
+            from .search.aggs.aggregators import (collect_shard,
+                                                  merge_shard_partials,
+                                                  parse_aggs)
+            from .search.aggs.aggregators import render as render_aggs
+            from .search.query_dsl import SegmentContext
+            from .search.aggs.aggregators import collect_shard_batched
+            agg_specs = parse_aggs(aggs_body)
+            Q = len(queries)
+            seg_masks: list[tuple[int, Any, Any]] = []  # (searcher i, seg, m)
+            for i, s in enumerate(searchers):
+                for seg in s.segments:
+                    if seg.n_docs == 0:
+                        continue
+                    ctx = SegmentContext(seg, Q, global_stats)
+                    m = nodes_by_index[index_of[i]].match_mask(ctx) \
+                        & seg.live[None, :]
+                    seg_masks.append((i, seg, m))
+            by_shard: dict[int, tuple[list, list]] = {}
+            for i, seg, m in seg_masks:
+                segs, ms = by_shard.setdefault(i, ([], []))
+                segs.append(seg)
+                ms.append(m)
+            # leaf agg trees: ONE device program per (agg, segment) covers
+            # every row — per-row launches would pay Q round-trips each
+            rows_by_shard = {}
+            for i, (segs, ms) in by_shard.items():
+                rows = collect_shard_batched(agg_specs, segs, ms)
+                if rows is None:
+                    rows_by_shard = None
+                    break
+                rows_by_shard[i] = rows
+            agg_rendered = []
+            if rows_by_shard is not None:
+                for qi in range(Q):
+                    partials = [rows[qi]
+                                for rows in rows_by_shard.values()]
+                    agg_rendered.append(render_aggs(
+                        agg_specs,
+                        merge_shard_partials(agg_specs, partials)))
+            else:
+                # general per-row path (sub-aggs, non-columnar fields, ...)
+                for qi in range(Q):
+                    partials = [collect_shard(
+                        agg_specs, segs, [m[qi] for m in ms],
+                        query_parser=searchers[i].parser)
+                        for i, (segs, ms) in by_shard.items()]
+                    agg_rendered.append(render_aggs(
+                        agg_specs, merge_shard_partials(agg_specs,
+                                                        partials)))
+
+        return self._batched_reduce(metas, searchers, index_of, results,
+                                    size, from_, agg_rendered, t0)
+
+    def _batched_reduce(self, metas, searchers, index_of, results,
+                        size, from_, agg_rendered, t0) -> list[dict]:
         took = int((time.perf_counter() - t0) * 1000)
         outs = []
         for qi, (_, body) in enumerate(metas):
@@ -1048,7 +1181,7 @@ class NodeService:
                 if src_filter is not None else None)
             for slot, h in enumerate(hits):
                 h["_index"] = index_of[reduced.shard_order[slot]]
-            outs.append({
+            out = {
                 "took": took,
                 "timed_out": False,
                 "_shards": {"total": len(searchers),
@@ -1058,7 +1191,10 @@ class NodeService:
                          if reduced.max_score != reduced.max_score
                          else reduced.max_score,
                          "hits": hits},
-            })
+            }
+            if agg_rendered is not None:
+                out["aggregations"] = agg_rendered[qi]
+            outs.append(out)
         return outs
 
     # -- scroll (cursored reads, ref §3.5 scroll/scan call stack) ----------

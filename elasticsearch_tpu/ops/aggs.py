@@ -47,3 +47,82 @@ def masked_stats(vals, missing, mask):
 @jax.jit
 def count_mask(mask):
     return mask.sum()
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def masked_histogram(vals, missing, mask, base, interval, *, n_bins: int):
+    """Histogram/date_histogram collect as ONE bincount: bucket id is an
+    affine transform of the numeric column (floor((v - base)/interval)).
+    Out-of-range/missing/unmasked docs land in a spill bin that is sliced
+    off. vals [N], base/interval scalars -> i32[n_bins] counts."""
+    sel = mask & ~missing
+    idx = jnp.floor((vals.astype(jnp.float64) - base)
+                    / interval).astype(jnp.int32)
+    idx = jnp.where(sel & (idx >= 0) & (idx < n_bins), idx, n_bins)
+    return jnp.bincount(idx, length=n_bins + 1)[:n_bins]
+
+
+@jax.jit
+def masked_ranges(vals, missing, mask, los, his):
+    """range/date_range collect: counts per [lo, hi) interval, all ranges
+    in one program. los/his f64[R] (±inf for open ends) -> i64[R]."""
+    sel = (mask & ~missing)[None, :]
+    v = vals.astype(jnp.float64)[None, :]
+    inr = sel & (v >= los[:, None]) & (v < his[:, None])
+    return inr.sum(axis=1)
+
+
+# -- row-batched variants: one device call serves a WHOLE msearch batch
+# (mask [Q, N]); on a tunneled chip per-row launches would pay Q RTTs ------
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def masked_bincount_q(ords, mask, *, n_bins: int):
+    """mask bool[Q, N] -> counts i32[Q, n_bins]."""
+    idx = jnp.where(mask & (ords >= 0)[None, :], ords[None, :], n_bins)
+    return jax.vmap(lambda ix: jnp.bincount(ix, length=n_bins + 1))(
+        idx)[:, :n_bins]
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def masked_histogram_q(vals, missing, mask, base, interval, *, n_bins: int):
+    """mask bool[Q, N] -> counts i32[Q, n_bins]."""
+    idx = jnp.floor((vals.astype(jnp.float64) - base)
+                    / interval).astype(jnp.int32)
+    ok = (~missing) & (idx >= 0) & (idx < n_bins)
+    idx = jnp.where(mask & ok[None, :], idx[None, :], n_bins)
+    return jax.vmap(lambda ix: jnp.bincount(ix, length=n_bins + 1))(
+        idx)[:, :n_bins]
+
+
+@jax.jit
+def masked_stats_q(vals, missing, mask):
+    """mask bool[Q, N] -> f64[Q, 5] (count, sum, sum_sq, min, max)."""
+    sel = mask & ~missing[None, :]
+    v = vals.astype(jnp.float64)[None, :]
+    vz = jnp.where(sel, v, 0.0)
+    cnt = sel.sum(axis=1).astype(jnp.float64)
+    s = vz.sum(axis=1)
+    ss = (vz * vz).sum(axis=1)
+    mn = jnp.where(sel, v, jnp.inf).min(axis=1)
+    mx = jnp.where(sel, v, -jnp.inf).max(axis=1)
+    return jnp.stack([cnt, s, ss, mn, mx], axis=1)
+
+
+@jax.jit
+def masked_ranges_q(vals, missing, mask, los, his):
+    """mask bool[Q, N] -> i64[Q, R]."""
+    ok = ~missing
+    v = vals.astype(jnp.float64)
+    inr = ok[None, :] & (v[None, :] >= los[:, None]) \
+        & (v[None, :] < his[:, None])              # [R, N]
+    return (mask[:, None, :] & inr[None, :, :]).sum(axis=2)
+
+
+@jax.jit
+def col_minmax(vals, missing):
+    """(min, max) over present values — cached per immutable segment so
+    histogram bucket counts can be sized without downloading the column."""
+    v = vals.astype(jnp.float64)
+    mn = jnp.where(missing, jnp.inf, v).min()
+    mx = jnp.where(missing, -jnp.inf, v).max()
+    return jnp.stack([mn, mx])

@@ -204,7 +204,7 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
         sids = body.get("scroll_id") or []
         if isinstance(sids, str):
             sids = [sids]
-        found = any(node.clear_scroll(s) for s in sids)
+        found = any([node.clear_scroll(s) for s in sids])  # clear ALL ids
         return 200, {"succeeded": True, "found": found}
     c.register("DELETE", "/_search/scroll", clear_scroll)
 

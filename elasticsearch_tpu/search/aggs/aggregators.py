@@ -159,6 +159,118 @@ def _mv(m) -> MaskView:
     return m if isinstance(m, MaskView) else MaskView(m)
 
 
+def collect_shard_batched(specs: list[AggSpec], segments: list[Segment],
+                          masks: list) -> list[dict] | None:
+    """Row-batched collect for a WHOLE msearch group: masks[i] is a DEVICE
+    bool[Q, n_pad] for segment i; one device program per (agg, segment)
+    serves every row (on a tunneled chip, per-row launches would pay Q
+    round-trips). Returns per-row partials, or None when any spec needs
+    the general per-row path (sub-aggs, non-columnar fields, calendar
+    intervals)."""
+    q = None
+    for spec in specs:
+        if spec.subs or spec.type not in (
+                "terms", "histogram", "date_histogram", "range",
+                "date_range", "min", "max", "sum", "avg", "value_count",
+                "stats", "extended_stats"):
+            return None
+    out_rows: list[dict] | None = None
+    for spec in specs:
+        per_seg_rows = None
+        for seg, mask in zip(segments, masks):
+            if seg.n_docs == 0:
+                continue
+            rows = _collect_one_batched(spec, seg, mask)
+            if rows is None:
+                return None
+            if q is None:
+                q = len(rows)
+            if per_seg_rows is None:
+                per_seg_rows = rows
+            else:
+                per_seg_rows = [merge_partial(spec, a, b)
+                                for a, b in zip(per_seg_rows, rows)]
+        if per_seg_rows is None:
+            if q is None:
+                q = int(np.asarray(masks[0]).shape[0]) if masks else 1
+            per_seg_rows = [_empty_partial(spec) for _ in range(q)]
+        if out_rows is None:
+            out_rows = [dict() for _ in range(len(per_seg_rows))]
+        for row, part in zip(out_rows, per_seg_rows):
+            row[spec.name] = part
+    return out_rows
+
+
+def _collect_one_batched(spec: AggSpec, seg: Segment, mask) -> list | None:
+    """-> per-row partials for one leaf agg over one segment, or None."""
+    t = spec.type
+    p = spec.params
+    field = p.get("field")
+    if t == "terms":
+        kc = seg.keywords.get(field)
+        if kc is None:
+            return None
+        from ...ops.aggs import masked_bincount_q
+        counts = np.asarray(masked_bincount_q(kc.ords, mask,
+                                              n_bins=len(kc.values)))
+        return [{"buckets": {kc.values[o]: {"doc_count": int(c[o])}
+                             for o in np.nonzero(c)[0]},
+                 "other_doc_count": 0, "error_bound": 0} for c in counts]
+    nc = seg.numerics.get(field) if field else None
+    if nc is None:
+        return None
+    if t in ("min", "max", "sum", "avg", "value_count", "stats",
+             "extended_stats"):
+        from ...ops.aggs import masked_stats_q
+        st = np.asarray(masked_stats_q(nc.vals, nc.missing, mask))
+        return [{"count": int(r[0]), "sum": float(r[1]),
+                 "sum_sq": float(r[2]),
+                 "min": float(r[3]) if r[0] else math.inf,
+                 "max": float(r[4]) if r[0] else -math.inf} for r in st]
+    if t in ("histogram", "date_histogram"):
+        if t == "histogram":
+            interval = float(p["interval"])
+        else:
+            interval = _fixed_interval_ms(p.get("interval", "1d"))
+            if interval is None:
+                return None       # calendar intervals: host path
+        if interval <= 0:
+            return None
+        mn, mx = _col_minmax(seg, field, nc)
+        if not np.isfinite(mn) or not np.isfinite(mx):
+            return [{"buckets": {}}
+                    for _ in range(int(np.asarray(mask).shape[0]))]
+        base = math.floor(mn / interval) * interval
+        n_bins = int((mx - base) // interval) + 1
+        if n_bins > _MAX_DEVICE_BINS:
+            return None
+        from ...ops.aggs import masked_histogram_q
+        counts = np.asarray(masked_histogram_q(
+            nc.vals, nc.missing, mask, base, float(interval),
+            n_bins=n_bins))
+        return [{"buckets": {float(base + i * interval):
+                             {"doc_count": int(c[i])}
+                             for i in np.nonzero(c)[0]}} for c in counts]
+    if t in ("range", "date_range"):
+        keys, los, his = [], [], []
+        for rr in p.get("ranges", []):
+            key, lo, hi = _resolve_range(rr, is_date=(t == "date_range"))
+            keys.append((key, lo, hi))
+            los.append(-np.inf if lo is None else float(lo))
+            his.append(np.inf if hi is None else float(hi))
+        if not keys:
+            return None
+        from ...ops.aggs import masked_ranges_q
+        counts = np.asarray(masked_ranges_q(
+            nc.vals, nc.missing, mask,
+            np.asarray(los, np.float64), np.asarray(his, np.float64)))
+        return [{"buckets": {key: {"doc_count": int(row[ri]),
+                                   "from": lo, "to": hi}
+                             for ri, (key, lo, hi) in enumerate(keys)}}
+                for row in counts]
+    return None
+
+
 class _ShardScopedParser:
     """Wraps the query parser so filter/filters agg queries that contain
     parent/child joins resolve against the WHOLE shard's segments (the join
@@ -449,7 +561,7 @@ def _collect_one(spec: AggSpec, seg: Segment, mask,
                                         [scores_row])
     if spec.type in METRIC_TYPES:
         return _metric_segment(spec, seg, mask)
-    return _bucket_segment(spec, seg, _mv(mask).np, qp, scores_row)
+    return _bucket_segment(spec, seg, _mv(mask), qp, scores_row)
 
 
 def _top_hits_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
@@ -543,12 +655,107 @@ def _metric_collect(spec: AggSpec, vals: np.ndarray, sel: np.ndarray) -> dict:
 
 # -- bucket aggs ------------------------------------------------------------
 
-def _bucket_segment(spec: AggSpec, seg: Segment, mask: np.ndarray,
+def _col_minmax(seg: Segment, field: str, nc) -> tuple[float, float]:
+    """Cached (min, max) of a numeric column — one device reduction per
+    immutable segment, reused by every histogram over it."""
+    cache = getattr(seg, "_minmax_cache", None)
+    if cache is None:
+        cache = {}
+        seg._minmax_cache = cache
+    if field not in cache:
+        from ...ops.aggs import col_minmax
+        mn, mx = np.asarray(col_minmax(nc.vals, nc.missing))
+        cache[field] = (float(mn), float(mx))
+    return cache[field]
+
+
+_FIXED_INTERVAL_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+                      "d": 86_400_000, "w": 7 * 86_400_000}
+_MAX_DEVICE_BINS = 1 << 14
+
+
+def _fixed_interval_ms(interval: str) -> float | None:
+    m = re.match(r"^(\d+)?\s*(ms|s|m|h|d|w|second|minute|hour|day|week)$",
+                 str(interval).strip())
+    if not m:
+        return None
+    mult = int(m.group(1) or 1)
+    unit = {"second": "s", "minute": "m", "hour": "h", "day": "d",
+            "week": "w"}.get(m.group(2), m.group(2))
+    return float(mult * _FIXED_INTERVAL_MS[unit])
+
+
+def _device_histogram(spec: AggSpec, seg: Segment, mv: "MaskView",
+                      nc, interval: float) -> dict | None:
+    """Leaf histogram collect fused on device (VERDICT r4 #3): bucket id =
+    affine transform of the column, ONE bincount per (segment, agg); only
+    the counts vector crosses to host. None -> host fallback (sub-aggs
+    need per-bucket masks; huge ranges exceed the bin cap)."""
+    if spec.subs or mv.dev is None or interval <= 0:
+        return None
+    mn, mx = _col_minmax(seg, spec.params["field"], nc)
+    if not np.isfinite(mn) or not np.isfinite(mx):
+        return {"buckets": {}}
+    base = math.floor(mn / interval) * interval
+    n_bins = int((mx - base) // interval) + 1
+    if n_bins > _MAX_DEVICE_BINS:
+        return None
+    from ...ops.aggs import masked_histogram
+    counts = np.asarray(masked_histogram(
+        nc.vals, nc.missing, mv.dev, base, float(interval), n_bins=n_bins))
+    out = {}
+    for i in np.nonzero(counts)[0]:
+        out[float(base + i * interval)] = {"doc_count": int(counts[i])}
+    return {"buckets": out}
+
+
+def _bucket_segment(spec: AggSpec, seg: Segment, mask,
                     qp=None, scores_row=None) -> dict:
-    """Compute per-doc bucket keys, then vectorized counts + sub-collects."""
+    """Compute per-doc bucket keys, then vectorized counts + sub-collects.
+    Leaf histogram/date_histogram/range over numeric columns collect ON
+    DEVICE (ops/aggs.py kernels) when the query mask is device-resident."""
     t = spec.type
     p = spec.params
     n = seg.n_pad
+    mv = _mv(mask)
+
+    if t in ("histogram", "date_histogram", "range", "date_range") \
+            and mv.dev is not None and not spec.subs:
+        field = p.get("field")
+        nc = seg.numerics.get(field) if field else None
+        if nc is not None:
+            if t == "histogram":
+                r = _device_histogram(spec, seg, mv, nc,
+                                      float(p["interval"]))
+                if r is not None:
+                    return r
+            elif t == "date_histogram":
+                iv = _fixed_interval_ms(p.get("interval", "1d"))
+                if iv is not None:
+                    r = _device_histogram(spec, seg, mv, nc, iv)
+                    if r is not None:
+                        return r
+            else:   # range / date_range: all ranges in one device program
+                from ...ops.aggs import masked_ranges
+                keys, los, his = [], [], []
+                for rr in p.get("ranges", []):
+                    key, lo, hi = _resolve_range(rr,
+                                                 is_date=(t == "date_range"))
+                    keys.append((key, lo, hi))
+                    los.append(-np.inf if lo is None else float(lo))
+                    his.append(np.inf if hi is None else float(hi))
+                if keys:
+                    counts = np.asarray(masked_ranges(
+                        nc.vals, nc.missing, mv.dev,
+                        np.asarray(los, np.float64),
+                        np.asarray(his, np.float64)))
+                    out = {}
+                    for (key, lo, hi), cnt in zip(keys, counts):
+                        out[key] = {"doc_count": int(cnt),
+                                    "from": lo, "to": hi}
+                    return {"buckets": out}
+
+    mask = mv.np
 
     if t == "global":   # ignores the query: all live docs (ref bucket/global/)
         live = np.asarray(seg.live)

@@ -387,6 +387,65 @@ class ShardSearcher:
             max_score=np.where(np.isfinite(mx), mx, np.nan),
             aggs=result.aggs)
 
+    def rescore_batch(self, result: QuerySearchResult,
+                      specs: list[dict]) -> QuerySearchResult:
+        """Row-batched rescore: each row has its OWN rescore spec (same
+        plan shape — e.g. per-query cosine vectors); the secondary scoring
+        runs as ONE device program per involved segment for the whole
+        batch instead of Q separate rescores (the msearch hybrid lane)."""
+        Q, K = result.doc_keys.shape
+        assert len(specs) == Q
+        spec0 = specs[0].get("query", specs[0])
+        window = int(specs[0].get("window_size", K))
+        rq_nodes = []
+        for sp in specs:
+            s = sp.get("query", sp)
+            if s.get("rescore_query") is None:
+                return result
+            rq_nodes.append(self.parser.parse(s["rescore_query"]))
+        node = merge_query_batch(rq_nodes)
+        stats = self.build_stats(node, None)
+        q_weight = float(spec0.get("query_weight", 1.0))
+        r_weight = float(spec0.get("rescore_query_weight", 1.0))
+        mode = spec0.get("score_mode", "total")
+
+        sec = np.zeros((Q, K), np.float32)
+        w = min(window, K)
+        kw = result.doc_keys[:, :w]
+        valid = kw >= 0
+        seg_of = np.where(valid, kw >> SEG_SHIFT, 0)
+        for seg_idx in np.unique(seg_of[valid]):
+            ctx = SegmentContext(self.segments[int(seg_idx)], Q, stats)
+            s, m = node.execute(ctx)
+            arr = np.asarray(jnp.where(m, s, 0.0))
+            qq, pp = np.nonzero(valid & (seg_of == seg_idx))
+            sec[qq, pp] = arr[qq, kw[qq, pp] & LOCAL_MASK]
+
+        from ..ops.knn import combine_scores
+        prim = np.nan_to_num(result.scores, nan=0.0)
+        combined = np.asarray(combine_scores(
+            jnp.asarray(prim), jnp.asarray(sec), mode, q_weight, r_weight))
+        in_window = np.arange(K)[None, :] < window
+        new_scores = np.where(in_window & (result.doc_keys >= 0),
+                              combined, prim)
+        sort_key = np.where(result.doc_keys >= 0, new_scores, -np.inf)
+        order = np.argsort(-np.where(in_window, sort_key, -np.inf),
+                           axis=1, kind="stable")
+        full_order = np.concatenate(
+            [order[:, :window],
+             np.broadcast_to(np.arange(window, K), (Q, K - window))],
+            axis=1) if K > window else order
+        mx = sort_key.max(axis=1)
+        out_keys = np.take_along_axis(result.doc_keys, full_order, axis=1)
+        out_scores = np.take_along_axis(new_scores, full_order, axis=1)
+        out_scores = np.where(out_keys >= 0, out_scores, np.nan)
+        return QuerySearchResult(
+            shard_id=result.shard_id, doc_keys=out_keys,
+            scores=out_scores, sort_values=None,
+            total_hits=result.total_hits,
+            max_score=np.where(np.isfinite(mx), mx, np.nan),
+            aggs=result.aggs)
+
     # -- fetch phase -------------------------------------------------------
 
     def execute_fetch_phase(self, doc_keys: Sequence[int],

@@ -59,6 +59,35 @@ class GetResult:
     source: dict | None = None
     type_name: str = "_doc"
     routing: str | None = None
+    parent: str | None = None
+    timestamp: int | None = None     # _timestamp metadata (epoch ms)
+    ttl_expiry: int | None = None    # _ttl expiry instant (epoch ms)
+
+
+def _segment_long(seg: Segment, field: str, local: int) -> int | None:
+    """Host-cached read of an i64 metadata column (_timestamp/_ttl_expiry)."""
+    nc = seg.numerics.get(field)
+    if nc is None:
+        return None
+    vals = getattr(nc, "_vals_np2", None)
+    if vals is None:
+        vals = (np.asarray(nc.vals), np.asarray(nc.missing))
+        object.__setattr__(nc, "_vals_np2", vals)
+    v, miss = vals
+    return None if miss[local] else int(v[local])
+
+
+def _segment_parent(seg: Segment, local: int) -> str | None:
+    """The doc's _parent id from the keyword column (host-cached ords)."""
+    kc = seg.keywords.get("_parent")
+    if kc is None:
+        return None
+    ords = getattr(kc, "_ords_np", None)
+    if ords is None:
+        ords = np.asarray(kc.ords)
+        object.__setattr__(kc, "_ords_np", ords)
+    o = int(ords[local])
+    return kc.values[o] if o >= 0 else None
 
 
 class Engine:
@@ -82,6 +111,10 @@ class Engine:
         self.translog = Translog(os.path.join(shard_path, "translog"), durability)
         self._lock = threading.RLock()
         self.segments: list[Segment] = []
+        # deletes staged until the next refresh (NRT delete visibility);
+        # the set mirror answers "is this copy stale?" for O(1) get checks
+        self._pending_deletes: list[tuple] = []
+        self._pending_set: set[tuple[int, int]] = set()
         self._buffer = SegmentBuilder(seg_id=0)
         # id -> (source, type, routing)
         # id -> (source, type, routing, parent, ParsedDocument)
@@ -127,10 +160,17 @@ class Engine:
         for op in self.translog.snapshot():
             kind = op["op"]
             if kind == "index":
-                self._apply_index(op["id"], op["source"], op.get("type", "_doc"),
-                                  version=op["version"],
-                                  routing=op.get("routing"),
-                                  parent=op.get("parent"))
+                from ..mapping.mapper import AlreadyExpiredException
+                try:
+                    self._apply_index(op["id"], op["source"],
+                                      op.get("type", "_doc"),
+                                      version=op["version"],
+                                      routing=op.get("routing"),
+                                      parent=op.get("parent"),
+                                      timestamp=op.get("ts"),
+                                      ttl=op.get("ttl"))
+                except AlreadyExpiredException:
+                    continue    # the doc's TTL lapsed while we were down
             elif kind == "delete":
                 self._apply_delete(op["id"], version=op["version"])
             n += 1
@@ -180,7 +220,8 @@ class Engine:
               version: int | None = None, version_type: str = "internal",
               op_type: str = "index", sync: bool | None = None,
               routing: str | None = None,
-              parent: str | None = None) -> EngineResult:
+              parent: str | None = None,
+              timestamp=None, ttl=None) -> EngineResult:
         with self._lock:
             if self._blocked_reason is not None \
                     or len(self._buffer_docs) >= self.MAX_BUFFER_DOCS:
@@ -192,26 +233,32 @@ class Engine:
                 self.refresh()
             new_version = self._check_version(doc_id, version, version_type, op_type)
             created = self.current_version(doc_id) == -1
+            if timestamp is None:
+                # resolve NOW so translog replay reproduces the same value
+                timestamp = int(time.time() * 1000)
             self._apply_index(doc_id, source, type_name, new_version, routing,
-                              parent)
+                              parent, timestamp, ttl)
             op = {"op": "index", "id": doc_id, "type": type_name,
                   "source": source, "version": new_version,
-                  "routing": routing}
+                  "routing": routing, "ts": timestamp}
             if parent is not None:
                 op["parent"] = parent
+            if ttl is not None:
+                op["ttl"] = ttl
             self.translog.add(op, sync=sync)
             return EngineResult(doc_id=doc_id, version=new_version, created=created)
 
     def _apply_index(self, doc_id: str, source: dict, type_name: str,
                      version: int, routing: str | None = None,
-                     parent: str | None = None) -> None:
+                     parent: str | None = None,
+                     timestamp=None, ttl=None) -> None:
         # parse NOW, not at refresh: a malformed doc (bad date, missing
         # parent, wrong vector dims) must 400 this request — parsing lazily
         # would poison the shared refresh instead (ref IndexShard.prepareIndex
         # parses before the engine op; code review r5)
         mapper = self.mappers.document_mapper(type_name)
         parsed = mapper.parse(source, doc_id=doc_id, routing=routing,
-                              parent=parent)
+                              parent=parent, timestamp=timestamp, ttl=ttl)
         self._delete_everywhere(doc_id)
         self._buffer_docs[doc_id] = (source, type_name, routing, parent,
                                      parsed)
@@ -238,11 +285,17 @@ class Engine:
         self._dirty = True
 
     def _delete_everywhere(self, doc_id: str) -> None:
+        """Remove from the write buffer now; segment tombstones are
+        DEFERRED to the next refresh — deletes are invisible to search
+        until a new searcher, exactly the NRT contract (realtime GET sees
+        them immediately through the version map; ref InternalEngine
+        delete + refresh visibility)."""
         self._buffer_docs.pop(doc_id, None)
         for seg in self.segments:
             local = seg.id_to_local.get(doc_id)
-            if local is not None:
-                seg.delete_local(local)
+            if local is not None and seg.live_host[local]:
+                self._pending_deletes.append((seg, local))
+                self._pending_set.add((seg.seg_id, local))
 
     # -- read ops ----------------------------------------------------------
 
@@ -255,19 +308,31 @@ class Engine:
                 return GetResult(found=False, doc_id=doc_id)
             version = v[0]
             if realtime and doc_id in self._buffer_docs:
-                src, tname, routing, _parent, _parsed = \
+                src, tname, routing, parent, parsed = \
                     self._buffer_docs[doc_id]
+                ts = parsed.longs.get("_timestamp")
+                ex = parsed.longs.get("_ttl_expiry")
                 return GetResult(found=True, doc_id=doc_id, version=version,
                                  source=src, type_name=tname,
-                                 routing=routing)
+                                 routing=routing, parent=parent,
+                                 timestamp=ts[0] if ts else None,
+                                 ttl_expiry=ex[0] if ex else None)
             for seg in self.segments:
                 local = seg.id_to_local.get(doc_id)
-                if local is not None and seg.live_host[local]:
+                if local is not None and seg.live_host[local] \
+                        and (seg.seg_id, local) not in self._pending_set:
+                    # a pending-delete copy is stale: returning it would
+                    # pair the OLD source with the NEW version (review r5)
                     return GetResult(found=True, doc_id=doc_id, version=version,
                                      source=seg.stored[local],
                                      type_name=seg.types[local],
                                      routing=seg.routings[local]
-                                     if seg.routings else None)
+                                     if seg.routings else None,
+                                     parent=_segment_parent(seg, local),
+                                     timestamp=_segment_long(
+                                         seg, "_timestamp", local),
+                                     ttl_expiry=_segment_long(
+                                         seg, "_ttl_expiry", local))
             # non-realtime get sees only refreshed (searchable) state — an
             # unrefreshed buffer doc is a miss (ref ShardGetService contract)
             return GetResult(found=False, doc_id=doc_id)
@@ -281,6 +346,12 @@ class Engine:
         keeps the buffer, marks the engine write-blocked, and raises
         CircuitBreakingException (HTTP 429) — never an OOM."""
         with self._lock:
+            if self._pending_deletes:
+                for seg, local in self._pending_deletes:
+                    seg.delete_local(local)
+                self._pending_deletes.clear()
+                self._pending_set.clear()
+                self._maybe_merge()
             if not self._buffer_docs:
                 return
             builder = SegmentBuilder(seg_id=self._next_seg_id)
@@ -355,6 +426,7 @@ class Engine:
     def force_merge(self, max_num_segments: int = 1) -> None:
         """Merge segments (ref index/merge/ TieredMergePolicy + optimize API)."""
         with self._lock:
+            self.refresh()     # staged docs AND deferred deletes first
             if len(self.segments) <= max_num_segments:
                 # may still want to purge deletes
                 if not any(s.live_count < s.n_docs for s in self.segments):

@@ -114,6 +114,30 @@ class MergeMappingException(Exception):
     pass
 
 
+class RoutingMissingException(Exception):
+    """Child-type doc indexed without a parent/routing value
+    (ref action/RoutingMissingException — a 400, caught by YAML suites
+    with /RoutingMissingException/)."""
+
+
+class AlreadyExpiredException(Exception):
+    """_ttl + timestamp lies in the past (ref index/AlreadyExpiredException)."""
+
+
+def parse_ttl_ms(v) -> int:
+    """'100000' | 100000 | '20s' | '1d' -> milliseconds."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return int(v)
+    s = str(v).strip()
+    m = re.match(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d|w)?$", s)
+    if not m:
+        raise MapperParsingException(f"failed to parse TTL [{v}]")
+    n = float(m.group(1))
+    mult = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+            "d": 86_400_000, "w": 7 * 86_400_000, None: 1}[m.group(2)]
+    return int(n * mult)
+
+
 # ---------------------------------------------------------------------------
 # Date parsing (ref: common/joda + core/DateFieldMapper)
 # ---------------------------------------------------------------------------
@@ -201,6 +225,10 @@ class ParsedDocument:
 _TYPE_ALIASES = {"string": TEXT, "half_float": FLOAT, "scaled_float": DOUBLE}
 
 
+def _truthy(v) -> bool:
+    return v is True or v == 1 or str(v).lower() in ("true", "yes", "on", "1")
+
+
 class DocumentMapper:
     """Parses source documents against a schema; grows it dynamically.
 
@@ -222,6 +250,11 @@ class DocumentMapper:
         self.nested_paths: dict[str, dict] = {}
         # _parent mapping: the parent TYPE this type's docs join to
         self.parent_type: str | None = None
+        # _timestamp / _ttl metadata mappings (ref internal/Timestamp-
+        # FieldMapper, TTLFieldMapper): index time + expiry as i64 columns
+        self.ts_enabled = False
+        self.ttl_enabled = False
+        self.ttl_default_ms: int | None = None
         if mapping:
             self.merge_mapping(mapping)
 
@@ -251,6 +284,18 @@ class DocumentMapper:
             if self.parent_type is None:
                 self.parent_type = pt
                 changed = True
+        ts = mapping.get("_timestamp")
+        if isinstance(ts, dict) and _truthy(ts.get("enabled")) \
+                and not self.ts_enabled:
+            self.ts_enabled = True
+            changed = True
+        ttl = mapping.get("_ttl")
+        if isinstance(ttl, dict) and _truthy(ttl.get("enabled")) \
+                and not self.ttl_enabled:
+            self.ttl_enabled = True
+            if ttl.get("default") is not None:
+                self.ttl_default_ms = parse_ttl_ms(ttl["default"])
+            changed = True
         changed |= self._merge_props("", props)
         if changed:
             self._mapping_version += 1
@@ -334,12 +379,20 @@ class DocumentMapper:
         out: dict[str, Any] = {"properties": root}
         if self.parent_type:
             out["_parent"] = {"type": self.parent_type}
+        if self.ts_enabled:
+            out["_timestamp"] = {"enabled": True}
+        if self.ttl_enabled:
+            ttl_out: dict[str, Any] = {"enabled": True}
+            if self.ttl_default_ms is not None:
+                ttl_out["default"] = self.ttl_default_ms
+            out["_ttl"] = ttl_out
         return out
 
     # -- document parsing --------------------------------------------------
 
     def parse(self, source: dict, doc_id: str, routing: str | None = None,
-              parent: str | None = None) -> ParsedDocument:
+              parent: str | None = None, timestamp=None,
+              ttl=None) -> ParsedDocument:
         doc = ParsedDocument(doc_id=doc_id, routing=routing, source=source)
         new_fields: dict[str, FieldType] = {}
         if parent is not None:
@@ -349,9 +402,22 @@ class DocumentMapper:
                     f"configured for type [{self.type_name}]")
             doc.keywords[PARENT_FIELD] = [str(parent)]
         elif self.parent_type is not None:
-            raise MapperParsingException(
-                f"routing is required for [{self.type_name}] documents: "
-                f"parent id missing")
+            raise RoutingMissingException(
+                f"routing is required for [{self.type_name}] documents "
+                f"with a _parent mapping")
+        ts_ms = parse_date_millis(timestamp) if timestamp is not None \
+            else int(_dt.datetime.now(_dt.timezone.utc).timestamp() * 1000)
+        if self.ts_enabled:
+            doc.longs["_timestamp"] = [ts_ms]
+        ttl_ms = parse_ttl_ms(ttl) if ttl is not None else self.ttl_default_ms
+        if self.ttl_enabled and ttl_ms is not None:
+            expiry = ts_ms + ttl_ms
+            now = int(_dt.datetime.now(_dt.timezone.utc).timestamp() * 1000)
+            if expiry <= now:
+                raise AlreadyExpiredException(
+                    f"already expired [{doc_id}]: expiry [{expiry}] <= "
+                    f"now [{now}]")
+            doc.longs["_ttl_expiry"] = [expiry]
         self._parse_obj("", source, doc, new_fields)
         if new_fields:
             if not self.dynamic:

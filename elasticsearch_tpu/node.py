@@ -323,7 +323,8 @@ class NodeService:
                    type_name: str = "_doc",
                    version: int | None = None,
                    routing: str | None = None,
-                   parent: str | None = None) -> tuple[EngineResult, bool]:
+                   parent: str | None = None,
+                   timestamp=None, ttl=None) -> tuple[EngineResult, bool]:
         """Scripted/partial update: get -> transform -> reindex
         (ref action/update/UpdateHelper.java:61). Returns (result, noop).
         Auto-creates the index like the reference's update-with-upsert.
@@ -334,16 +335,39 @@ class NodeService:
                 raise InvalidIndexNameException(index)
             self.create_index(index)
         svc = self.index_service(index)
+        if routing is None and parent is None \
+                and svc.mappers.parent_type_of(type_name):
+            from .mapping.mapper import RoutingMissingException
+            raise RoutingMissingException(
+                f"routing is required for [{index}]/[{type_name}]/"
+                f"[{doc_id}]")
         cur = svc.get_doc(doc_id, routing=routing, parent=parent)
         if not cur.found:
+            if version is not None:
+                # update-with-version on a missing doc is a CONFLICT
+                # (ref UpdateRequest validation / VersionConflictEngine-
+                # Exception on upsert-with-version)
+                raise VersionConflictException(doc_id, -1, version)
             if "upsert" in body:
-                res = svc.index_doc(doc_id, body["upsert"],
-                                    type_name=type_name,
-                                    routing=routing, parent=parent)
+                upsert = dict(body["upsert"])
+                # inline metadata in the upsert doc (legacy ES form the
+                # YAML suites use: {"foo": "bar", "_parent": 5})
+                meta_parent = upsert.pop("_parent", None)
+                meta_routing = upsert.pop("_routing", None)
+                res = svc.index_doc(
+                    doc_id, upsert, type_name=type_name,
+                    routing=routing if routing is not None
+                    else (str(meta_routing)
+                          if meta_routing is not None else None),
+                    parent=parent if parent is not None
+                    else (str(meta_parent)
+                          if meta_parent is not None else None),
+                    timestamp=timestamp, ttl=ttl)
                 return res, False
             if body.get("doc_as_upsert") and "doc" in body:
                 res = svc.index_doc(doc_id, body["doc"], type_name=type_name,
-                                    routing=routing, parent=parent)
+                                    routing=routing, parent=parent,
+                                    timestamp=timestamp, ttl=ttl)
                 return res, False
             raise DocumentMissingException(f"[{type_name}][{doc_id}]: document missing")
         if version is not None and cur.version != version:
@@ -367,7 +391,9 @@ class NodeService:
                                     created=False), True
         elif "doc" in body:
             merged = _deep_merge(src, body["doc"])
-            if body.get("detect_noop", True) and merged == src:
+            # metadata-only updates (new ttl/timestamp) are NOT noops
+            if body.get("detect_noop", True) and merged == src \
+                    and ttl is None and timestamp is None:
                 return EngineResult(doc_id=doc_id, version=cur.version,
                                     created=False), True
             src = merged
@@ -379,7 +405,8 @@ class NodeService:
                             version=cur.version,
                             routing=routing if routing is not None
                             else cur.routing,
-                            parent=parent)
+                            parent=parent,
+                            timestamp=timestamp, ttl=ttl)
         return res, False
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
@@ -800,6 +827,127 @@ class NodeService:
                                        "successful": len(names),
                                        "failed": 0},
                 "total": total, "matches": matches}
+
+    def refresh_doc_shard(self, index: str, doc_id: str,
+                          routing: str | None = None) -> None:
+        """Per-op ?refresh=true refreshes only the WRITTEN shard (ref
+        TransportShardReplicationOperationAction per-shard refresh) — other
+        shards' pending deletes stay invisible until their own refresh."""
+        for name in self._resolve(index):   # aliases resolve like writes do
+            svc = self.indices.get(name)
+            if svc is not None:
+                svc.shard_for(doc_id, routing).refresh()
+
+    def termvectors(self, index: str, doc_id: str, type_name: str = "_doc",
+                    fields: list[str] | None = None, realtime: bool = True,
+                    term_statistics: bool = False,
+                    field_statistics: bool = True,
+                    positions: bool = True, offsets: bool = True,
+                    routing: str | None = None,
+                    parent: str | None = None) -> dict:
+        """Per-document term vectors (ref action/termvectors/
+        TransportTermVectorsAction + TermVectorsResponse): term/position/
+        offset lists re-derived from the stored source through the SAME
+        analysis chain that indexed it (tensor segments don't keep per-doc
+        postings slices addressable by doc, so re-analysis — which is
+        exact, same analyzer, same source — replaces Lucene's stored term
+        vectors)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+        name = names[0]
+        svc = self.indices[name]
+        res = svc.get_doc(doc_id, routing=routing, parent=parent,
+                          realtime=realtime)
+        out = {"_index": name, "_type": res.type_name if res.found
+               else type_name, "_id": doc_id, "found": res.found,
+               "took": 0}
+        if not res.found:
+            return out
+        out["_version"] = res.version
+        mapper = svc.mappers.document_mapper(res.type_name, create=False) \
+            or svc.mappers.document_mapper(type_name)
+        segments = [seg for e in svc.shards for seg in e.segments]
+
+        def flat(prefix, obj, into):
+            for k, v in obj.items():
+                path = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    flat(path + ".", v, into)
+                else:
+                    into[path] = v
+
+        flat_src: dict[str, Any] = {}
+        flat("", res.source or {}, flat_src)
+        tv: dict[str, dict] = {}
+        for field, value in flat_src.items():
+            ft = mapper.fields.get(field)
+            if ft is None or ft.type != "text":
+                continue
+            if fields is not None and field not in fields:
+                continue
+            analyzer = mapper._analyzer_for(ft)
+            texts = value if isinstance(value, list) else [value]
+            terms: dict[str, dict] = {}
+            pos = 0
+            for text in texts:
+                for m in re.finditer(r"\w+(?:[.']\w+)*", str(text)):
+                    toks = analyzer(m.group(0))
+                    if not toks:
+                        continue     # filtered out (stopword etc.)
+                    t = toks[0]
+                    entry = terms.setdefault(t, {"term_freq": 0,
+                                                 "tokens": []})
+                    entry["term_freq"] += 1
+                    tok: dict = {}
+                    if positions:
+                        tok["position"] = pos
+                    if offsets:
+                        tok["start_offset"] = m.start()
+                        tok["end_offset"] = m.end()
+                    if tok:
+                        entry["tokens"].append(tok)
+                    pos += 1
+            if not terms:
+                continue
+            if term_statistics:
+                for t, entry in terms.items():
+                    df = ttf = 0
+                    for seg in segments:
+                        fx = seg.text.get(field)
+                        if fx is None:
+                            continue
+                        s, ln, tid = fx.lookup(t)
+                        if tid >= 0:
+                            df += ln
+                            import numpy as _np
+                            ttf += int(_np.asarray(fx.tf)[s:s + ln].sum())
+                    entry["doc_freq"] = df
+                    entry["ttf"] = ttf
+            fstat = None
+            if field_statistics:
+                sum_df = doc_count = 0
+                sum_ttf = 0.0
+                for seg in segments:
+                    fx = seg.text.get(field)
+                    if fx is None:
+                        continue
+                    sum_df += int(fx.term_lens.sum())
+                    sum_ttf += fx.sum_dl        # Σ tokens == Σ tf
+                    doc_count += seg.root_live_count
+                fstat = {"sum_doc_freq": sum_df,
+                         "doc_count": doc_count,
+                         "sum_ttf": int(sum_ttf)}
+            entry_out: dict = {}
+            if fstat is not None:
+                entry_out["field_statistics"] = fstat
+            entry_out["terms"] = terms
+            tv[field] = entry_out
+        out["term_vectors"] = tv
+        out["took"] = int((_time.perf_counter() - t0) * 1000)
+        return out
 
     def suggest(self, index: str, body: dict) -> dict:
         """Run suggesters over the index's term dictionaries

@@ -58,10 +58,14 @@ def _status_of(e: Exception) -> int:
     if isinstance(e, VersionConflictException):
         return 409
     from ..script.engine import ScriptException
-    from ..mapping.mapper import MapperParsingException, MergeMappingException
+    from ..mapping.mapper import (AlreadyExpiredException,
+                                  MapperParsingException,
+                                  MergeMappingException,
+                                  RoutingMissingException)
     if isinstance(e, (InvalidIndexNameException, QueryParsingException,
                       AggregationParsingException, ScriptException,
                       MapperParsingException, MergeMappingException,
+                      RoutingMissingException, AlreadyExpiredException,
                       json.JSONDecodeError, KeyError, ValueError)):
         return 400
     return 500
@@ -103,6 +107,27 @@ class RestController:
             raise RestError(400, f"no handler for [{method} {path}]")
         handler, match, _ = best
         return handler(match.groupdict(), params, body)
+
+
+def _pbool(p: dict, name: str, default: bool) -> bool:
+    """Boolean URL param: accepts true/false, 1/0, yes/no (ES client
+    convention — the YAML suites use all three spellings)."""
+    v = p.get(name, [None])[0]
+    if v is None:
+        return default
+    return str(v).lower() not in ("false", "0", "no", "off")
+
+
+def _meta_field_of(res, f: str):
+    """_timestamp / _ttl rendering for `fields` (ref internal field
+    mappers: _timestamp returns the index instant, _ttl the REMAINING
+    time-to-live)."""
+    import time as _time
+    if f == "_timestamp":
+        return res.timestamp
+    if f == "_ttl" and res.ttl_expiry is not None:
+        return res.ttl_expiry - int(_time.time() * 1000)
+    return None
 
 
 def _json_body(body: bytes) -> dict:
@@ -539,12 +564,16 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             kw["version"] = int(p["version"][0])
         if "version_type" in p:
             kw["version_type"] = p["version_type"][0]
+        routing = p.get("routing", [None])[0]
+        parent = p.get("parent", [None])[0]
         _, res = node.index_doc(g["index"], g.get("id"), _json_body(b),
                                 type_name=g.get("type", "_doc"),
-                                routing=p.get("routing", [None])[0],
-                                parent=p.get("parent", [None])[0], **kw)
-        if p.get("refresh", ["false"])[0] != "false":
-            node.refresh(g["index"])
+                                routing=routing, parent=parent,
+                                timestamp=p.get("timestamp", [None])[0],
+                                ttl=p.get("ttl", [None])[0], **kw)
+        if _pbool(p, "refresh", False):
+            node.refresh_doc_shard(g["index"], res.doc_id,
+                                   routing or parent)
         status = 201 if res.created else 200
         return status, {"_index": g["index"], "_type": g.get("type", "_doc"),
                         "_id": res.doc_id, "_version": res.version,
@@ -563,15 +592,26 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     def _resolve_get(g, p):
         """Shared GET semantics: realtime, version check, source filtering
         (ref index/get/ShardGetService + RestGetAction params)."""
-        realtime = p.get("realtime", ["true"])[0] != "false"
-        if p.get("refresh", ["false"])[0] != "false":
+        realtime = _pbool(p, "realtime", True)
+        if _pbool(p, "refresh", False):
             node.refresh(g["index"])
+        routing = p.get("routing", [None])[0]
+        parent = p.get("parent", [None])[0]
+        tname = g.get("type")
+        if routing is None and parent is None and tname:
+            svc = node.indices.get(g["index"])
+            if svc is not None and svc.mappers.parent_type_of(tname):
+                from ..mapping.mapper import RoutingMissingException
+                raise RoutingMissingException(
+                    f"routing is required for [{g['index']}]/[{tname}]/"
+                    f"[{g['id']}]")
         res = node.get_doc(g["index"], g["id"],
-                           routing=p.get("routing", [None])[0],
-                           parent=p.get("parent", [None])[0],
+                           routing=routing, parent=parent,
                            realtime=realtime)
         if res.found and "version" in p \
+                and p.get("version_type", ["internal"])[0] != "force" \
                 and int(p["version"][0]) != res.version:
+            # force never conflicts on reads (ref VersionType.FORCE)
             raise VersionConflictException(
                 g["id"], res.version, int(p["version"][0]))
         return res
@@ -600,15 +640,28 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             src = _source_of(res, p)
             # fields param suppresses _source unless explicitly requested
             # (ref RestGetAction: fields and source are separate fetches)
-            if src is not None and not ("fields" in p
-                                        and "_source" not in p):
+            fld_list = p["fields"][0].split(",") if "fields" in p else None
+            if src is not None and (fld_list is None
+                                    or "_source" in fld_list
+                                    or "_source" in p):
                 out["_source"] = src
-            if "fields" in p:
+            if fld_list is not None:
                 fields = {}
-                for f in p["fields"][0].split(","):
+                for f in fld_list:
+                    if f == "_source":
+                        continue
                     if f == "_routing":
                         if res.routing is not None:
                             fields["_routing"] = res.routing
+                        continue
+                    if f == "_parent":
+                        if res.parent is not None:
+                            fields["_parent"] = res.parent
+                        continue
+                    if f in ("_timestamp", "_ttl"):
+                        v = _meta_field_of(res, f)
+                        if v is not None:
+                            fields[f] = v
                         continue
                     v = res.source.get(f) if res.source else None
                     if v is not None:
@@ -641,11 +694,13 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             kw["version"] = int(p["version"][0])
         if "version_type" in p:
             kw["version_type"] = p["version_type"][0]
+        routing = p.get("routing", [None])[0]
+        parent = p.get("parent", [None])[0]
         res = node.delete_doc(g["index"], g["id"],
-                              routing=p.get("routing", [None])[0],
-                              parent=p.get("parent", [None])[0], **kw)
-        if p.get("refresh", ["false"])[0] != "false":
-            node.refresh(g["index"])
+                              routing=routing, parent=parent, **kw)
+        if _pbool(p, "refresh", False):
+            node.refresh_doc_shard(g["index"], g["id"],
+                                   routing or parent)
         return (200 if res.found else 404), {
             "found": res.found, "_index": g["index"],
             "_type": g.get("type", "_doc"), "_id": g["id"],
@@ -665,14 +720,20 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         res, noop = node.update_doc(g["index"], g["id"], _json_body(b),
                                     type_name=g.get("type", "_doc"),
                                     routing=p.get("routing", [None])[0],
-                                    parent=p.get("parent", [None])[0], **kw)
-        if p.get("refresh", ["false"])[0] != "false":
-            node.refresh(g["index"])
+                                    parent=p.get("parent", [None])[0],
+                                    timestamp=p.get("timestamp", [None])[0],
+                                    ttl=p.get("ttl", [None])[0], **kw)
+        if _pbool(p, "refresh", False):
+            node.refresh_doc_shard(g["index"], g["id"],
+                                   p.get("routing", [None])[0]
+                                   or p.get("parent", [None])[0])
         out = {"_index": g["index"], "_type": g.get("type", "_doc"),
                "_id": g["id"], "_version": res.version,
                "_shards": _write_shards(node, g["index"])}
         if "fields" in p:
-            got = node.get_doc(g["index"], g["id"])
+            got = node.get_doc(g["index"], g["id"],
+                               routing=p.get("routing", [None])[0],
+                               parent=p.get("parent", [None])[0])
             if got.found:
                 fields = {}
                 src_included = False
@@ -697,9 +758,17 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         items = body.get("docs")
         if items is None and "ids" in body:
             items = [{"_id": i} for i in body["ids"]]
-        if items is None:
-            raise RestError(400, "ActionRequestValidationException: no "
-                                 "documents to get")
+        if not items:
+            raise RestError(400, "ActionRequestValidationException: "
+                                 "Validation Failed: 1: no documents "
+                                 "to get;")
+        realtime = _pbool(p, "realtime", True)
+        if _pbool(p, "refresh", False):
+            node.refresh(g.get("index", "_all"))
+        url_fields = p.get("fields", [None])[0]
+        if url_fields is not None:
+            url_fields = url_fields.split(",")
+        default_type = g.get("type")
         docs = []
         for d in items:
             if not isinstance(d, dict):
@@ -712,19 +781,34 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                 raise RestError(400, "ActionRequestValidationException: "
                                      "index is missing")
             doc_id = str(d["_id"])
+            want_type = d.get("_type", default_type)
+            routing = d.get("_routing") or d.get("routing")
+            parent = d.get("_parent") or d.get("parent")
             try:
-                res = node.get_doc(idx, doc_id,
-                                   routing=d.get("_routing") or d.get("routing"))
+                res = node.get_doc(
+                    idx, doc_id,
+                    routing=str(routing) if routing is not None else None,
+                    parent=str(parent) if parent is not None else None,
+                    realtime=realtime)
             except IndexMissingException as e:
-                docs.append({"_index": idx, "_type": d.get("_type", "_doc"),
+                docs.append({"_index": idx,
+                             "_type": want_type or "_doc",
                              "_id": doc_id,
                              "error": str(e), "found": False})
                 continue
-            entry = {"_index": idx, "_type": res.type_name,
-                     "_id": doc_id, "found": res.found}
-            if res.found:
+            # type filter: a requested type must MATCH the stored type
+            # (ref TransportGetAction type resolution; "_all" matches any)
+            found = res.found
+            if found and want_type not in (None, "_all") \
+                    and res.type_name != want_type:
+                found = False
+            entry = {"_index": idx,
+                     "_type": res.type_name if found
+                     else (want_type or "_doc"),
+                     "_id": doc_id, "found": found}
+            if found:
                 entry["_version"] = res.version
-                flds = d.get("fields", d.get("_fields"))
+                flds = d.get("fields", d.get("_fields", url_fields))
                 if flds:
                     if isinstance(flds, str):
                         flds = [flds]
@@ -736,6 +820,9 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                         elif f == "_routing":
                             if res.routing is not None:
                                 fields["_routing"] = res.routing
+                        elif f == "_parent":
+                            if getattr(res, "parent", None) is not None:
+                                fields["_parent"] = res.parent
                         else:
                             v = (res.source or {}).get(f)
                             if v is not None:
@@ -751,6 +838,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                         if spec is False:
                             src = None
                         elif spec is not True:
+                            if isinstance(spec, str):
+                                spec = [spec]
                             inc = spec if isinstance(spec, list) else \
                                 spec.get("include", spec.get("includes"))
                             exc = None if isinstance(spec, list) else \
@@ -766,6 +855,120 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("POST", "/{index}/_mget", mget)
     c.register("GET", "/{index}/{type}/_mget", mget)
     c.register("POST", "/{index}/{type}/_mget", mget)
+
+    # -- termvectors / mtermvectors (ref action/termvectors/) -------------
+    def termvectors(g, p, b):
+        body = _json_body(b) if b else {}
+        flds = p.get("fields", [None])[0]
+        if flds is not None:
+            flds = flds.split(",")
+        elif body.get("fields"):
+            flds = list(body["fields"])
+        return 200, node.termvectors(
+            g["index"], str(g.get("id", body.get("_id", ""))),
+            type_name=g.get("type", "_doc"), fields=flds,
+            realtime=_pbool(p, "realtime", True),
+            term_statistics=_pbool(p, "term_statistics", False)
+            or bool(body.get("term_statistics")),
+            field_statistics=_pbool(p, "field_statistics", True),
+            positions=_pbool(p, "positions", True),
+            offsets=_pbool(p, "offsets", True),
+            routing=p.get("routing", [None])[0],
+            parent=p.get("parent", [None])[0])
+    for pat in ("/{index}/{type}/{id}/_termvectors",
+                "/{index}/{type}/{id}/_termvector",
+                "/{index}/{type}/_termvectors",
+                "/{index}/{type}/_termvector"):
+        c.register("GET", pat, termvectors)
+        c.register("POST", pat, termvectors)
+
+    def mtermvectors(g, p, b):
+        body = _json_body(b) if b else {}
+        items = body.get("docs")
+        if items is None and "ids" in body:
+            items = [{"_id": i} for i in body["ids"]]
+        if items is None and "ids" in p:
+            items = [{"_id": i} for i in p["ids"][0].split(",")]
+        if not items:
+            raise RestError(400, "ActionRequestValidationException: "
+                                 "Validation Failed: 1: no documents "
+                                 "requested;")
+        tstats = _pbool(p, "term_statistics", False) \
+            or bool(body.get("term_statistics"))
+        docs = []
+        for d in items:
+            idx = d.get("_index", g.get("index"))
+            if idx is None:
+                docs.append({"error": "index is missing"})
+                continue
+            try:
+                docs.append(node.termvectors(
+                    idx, str(d["_id"]),
+                    type_name=d.get("_type", g.get("type", "_doc")),
+                    fields=d.get("fields"),
+                    realtime=_pbool(p, "realtime", True),
+                    term_statistics=tstats or bool(d.get("term_statistics")),
+                    routing=d.get("_routing") or d.get("routing"),
+                    parent=d.get("_parent") or d.get("parent")))
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                docs.append({"_index": idx, "_id": str(d.get("_id")),
+                             "error": f"{type(e).__name__}[{e}]"})
+        return 200, {"docs": docs}
+    for pat in ("/_mtermvectors", "/{index}/_mtermvectors",
+                "/{index}/{type}/_mtermvectors"):
+        c.register("GET", pat, mtermvectors)
+        c.register("POST", pat, mtermvectors)
+
+    # -- search_shards (ref TransportSearchShardsAction) -------------------
+    def search_shards(g, p, b):
+        names = node._resolve(g.get("index", "_all"))
+        shards = []
+        nodes = {"node0": {"name": "tpu-node-0",
+                           "transport_address": "local[1]"}}
+        for n in names:
+            for sid, _e in enumerate(node.indices[n].shards):
+                shards.append([{"index": n, "shard": sid, "primary": True,
+                                "state": "STARTED", "node": "node0"}])
+        return 200, {"nodes": nodes, "shards": shards}
+    c.register("GET", "/_search_shards", search_shards)
+    c.register("POST", "/_search_shards", search_shards)
+    c.register("GET", "/{index}/_search_shards", search_shards)
+    c.register("POST", "/{index}/_search_shards", search_shards)
+
+    # -- cache clear (ref indices/cache/clear) -----------------------------
+    def clear_cache(g, p, b):
+        names = node._resolve(g.get("index", "_all"))
+        return 200, {"_shards": {
+            "total": sum(len(node.indices[n].shards) for n in names),
+            "successful": sum(len(node.indices[n].shards) for n in names),
+            "failed": 0}}
+    for pat in ("/_cache/clear", "/{index}/_cache/clear"):
+        c.register("POST", pat, clear_cache)
+        c.register("GET", pat, clear_cache)
+
+    # -- recovery status API (ref action/admin/indices/recovery) ----------
+    def recovery_api(g, p, b):
+        names = node._resolve(g.get("index", "_all"))
+        out = {}
+        for n in names:
+            svc = node.indices[n]
+            shards = []
+            for sid, e in enumerate(svc.shards):
+                shards.append({
+                    "id": sid, "type": "GATEWAY", "stage": "DONE",
+                    "primary": True,
+                    "source": {"id": "node0", "name": "tpu-node-0"},
+                    "target": {"id": "node0", "name": "tpu-node-0"},
+                    "index": {"size": {
+                        "total_in_bytes": sum(s.memory_bytes()
+                                              for s in e.segments)},
+                        "files": {"total": len(e.segments)}},
+                    "translog": {"recovered": 0},
+                })
+            out[n] = {"shards": shards}
+        return 200, out
+    c.register("GET", "/_recovery", recovery_api)
+    c.register("GET", "/{index}/_recovery", recovery_api)
 
     _register_indices_routes(c, node)
 

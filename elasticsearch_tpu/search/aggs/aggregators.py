@@ -252,23 +252,33 @@ def _collect_one_batched(spec: AggSpec, seg: Segment, mask) -> list | None:
                              {"doc_count": int(c[i])}
                              for i in np.nonzero(c)[0]}} for c in counts]
     if t in ("range", "date_range"):
-        keys, los, his = [], [], []
-        for rr in p.get("ranges", []):
-            key, lo, hi = _resolve_range(rr, is_date=(t == "date_range"))
-            keys.append((key, lo, hi))
-            los.append(-np.inf if lo is None else float(lo))
-            his.append(np.inf if hi is None else float(hi))
-        if not keys:
+        bounds = _range_bounds(p, is_date=(t == "date_range"))
+        if bounds is None:
             return None
+        keys, los, his = bounds
         from ...ops.aggs import masked_ranges_q
-        counts = np.asarray(masked_ranges_q(
-            nc.vals, nc.missing, mask,
-            np.asarray(los, np.float64), np.asarray(his, np.float64)))
+        counts = np.asarray(masked_ranges_q(nc.vals, nc.missing, mask,
+                                            los, his))
         return [{"buckets": {key: {"doc_count": int(row[ri]),
                                    "from": lo, "to": hi}
                              for ri, (key, lo, hi) in enumerate(keys)}}
                 for row in counts]
     return None
+
+
+def _range_bounds(p: dict, is_date: bool):
+    """Shared range-spec resolution for the solo and row-batched device
+    collects — ONE place derives (keys, los, his) so the lanes can't
+    diverge (code review r5)."""
+    keys, los, his = [], [], []
+    for rr in p.get("ranges", []):
+        key, lo, hi = _resolve_range(rr, is_date=is_date)
+        keys.append((key, lo, hi))
+        los.append(-np.inf if lo is None else float(lo))
+        his.append(np.inf if hi is None else float(hi))
+    if not keys:
+        return None
+    return keys, np.asarray(los, np.float64), np.asarray(his, np.float64)
 
 
 class _ShardScopedParser:
@@ -736,19 +746,12 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask,
                     if r is not None:
                         return r
             else:   # range / date_range: all ranges in one device program
-                from ...ops.aggs import masked_ranges
-                keys, los, his = [], [], []
-                for rr in p.get("ranges", []):
-                    key, lo, hi = _resolve_range(rr,
-                                                 is_date=(t == "date_range"))
-                    keys.append((key, lo, hi))
-                    los.append(-np.inf if lo is None else float(lo))
-                    his.append(np.inf if hi is None else float(hi))
-                if keys:
+                bounds = _range_bounds(p, is_date=(t == "date_range"))
+                if bounds is not None:
+                    keys, los, his = bounds
+                    from ...ops.aggs import masked_ranges
                     counts = np.asarray(masked_ranges(
-                        nc.vals, nc.missing, mv.dev,
-                        np.asarray(los, np.float64),
-                        np.asarray(his, np.float64)))
+                        nc.vals, nc.missing, mv.dev, los, his))
                     out = {}
                     for (key, lo, hi), cnt in zip(keys, counts):
                         out[key] = {"doc_count": int(cnt),

@@ -65,9 +65,14 @@ class YamlRestRunner:
     def _call(self, method: str, path: str, params: dict, body):
         url = self.base_url + path
         if params:
+            def enc(v):
+                if isinstance(v, bool):
+                    return str(v).lower()
+                if isinstance(v, list):
+                    return ",".join(str(x) for x in v)   # ES list params
+                return v
             url += "?" + urllib.parse.urlencode(
-                {k: str(v).lower() if isinstance(v, bool) else v
-                 for k, v in params.items()})
+                {k: enc(v) for k, v in params.items()})
         data = None
         if body is not None:
             if isinstance(body, (dict, list)):
@@ -143,9 +148,11 @@ class YamlRestRunner:
         else:
             method = methods[0]
         if method == "HEAD":
-            # exists-style APIs: the client maps 200 -> true, 404 -> false
+            # exists-style APIs: the client maps 200 -> true, 404 -> false;
+            # the REAL status flows through so `catch: request` can see
+            # 4xx validation failures (the bool payload marks this shape)
             status, _ = self._call("HEAD", path, q_params, None)
-            return 200, status < 300
+            return status, status < 300
         if api_name.startswith("indices.put") or api_name in (
                 "index", "create") and "PUT" in methods and "id" in path_args:
             method = "PUT"
@@ -315,7 +322,9 @@ class YamlRestRunner:
                     n += 1
                     continue
                 if catch is None:
-                    if status >= 400:
+                    # bool responses are HEAD/exists results: a 404 means
+                    # "false", not a failed step
+                    if status >= 400 and not isinstance(response, bool):
                         raise _Failure(
                             f"do {api}: HTTP {status}: {response}")
                 else:
@@ -330,9 +339,11 @@ class YamlRestRunner:
                                 f"do {api}: expected {catch}, got "
                                 f"HTTP {status}: {response}")
                     elif catch.startswith("/"):
+                        # catch regexes match literally (spaces count) —
+                        # only body `match:` regexes use COMMENTS mode
                         if status < 400 or not re.search(
                                 catch.strip("/"), json.dumps(response),
-                                re.VERBOSE | re.S):
+                                re.S):
                             raise _Failure(
                                 f"do {api}: error !~ {catch}: {response}")
                     else:

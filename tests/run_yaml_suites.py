@@ -1,0 +1,54 @@
+"""Rescore every reference YAML suite file against a live server; print
+red files with their first failure so the remaining product gaps are
+visible (the docstring in test_yaml_suites.py points here)."""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from elasticsearch_tpu.node import NodeService             # noqa: E402
+from elasticsearch_tpu.rest import HttpServer              # noqa: E402
+from elasticsearch_tpu.testing import YamlRestRunner       # noqa: E402
+
+SPEC_ROOT = "/root/reference/rest-api-spec"
+
+
+def main():
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="yaml-rescore-")
+    node = NodeService(os.path.join(workdir, "node"))
+    srv = HttpServer(node, port=0).start()
+    runner = YamlRestRunner(f"http://127.0.0.1:{srv.port}",
+                            os.path.join(SPEC_ROOT, "api"))
+    files = sorted(glob.glob(os.path.join(SPEC_ROOT, "test", "*", "*.yaml")))
+    green, red = [], []
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    for f in files:
+        rel = os.path.relpath(f, os.path.join(SPEC_ROOT, "test"))
+        if only and only not in rel:
+            continue
+        try:
+            rs = runner.run_file(f)
+        except Exception as e:  # noqa: BLE001
+            red.append((rel, f"harness: {type(e).__name__}: {e}"))
+            continue
+        bad = [r for r in rs if not r.ok]
+        if rs and not bad:
+            green.append(rel)
+        else:
+            msg = f"{bad[0].section}: {str(bad[0].error)[:160]}" if bad \
+                else "no sections ran"
+            red.append((rel, msg))
+    print(f"GREEN {len(green)} / {len(green) + len(red)}")
+    for rel, msg in red:
+        print(f"RED  {rel}\n     {msg}")
+    srv.stop()
+    node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,12 @@ class TestEngineCrud:
         engine.refresh()
         assert engine.doc_count() == 2
         engine.delete("1")
+        # NRT contract: the tombstone is INVISIBLE to search (and segment
+        # counts) until the next refresh; realtime get sees it immediately
+        # (ref InternalEngine delete + refresh visibility)
+        assert not engine.get("1").found
+        assert engine.segments[0].live_count == 2
+        engine.refresh()
         assert engine.doc_count() == 1
         assert engine.segments[0].live_count == 1
 

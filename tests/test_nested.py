@@ -260,12 +260,12 @@ def _seed_pc(node):
 class TestParentChild:
     def test_parent_required_at_index_time(self, node):
         node.create_index("pc", mappings=PC_MAPPINGS)
-        from elasticsearch_tpu.mapping.mapper import MapperParsingException
+        from elasticsearch_tpu.mapping.mapper import RoutingMissingException
         node.index_doc("pc", "c9", {"author": "x"}, type_name="comment",
                        parent="b1")
         # rejected at INDEX time — a lazy (refresh-time) raise would poison
         # the shared buffer and block every later doc (code review r5)
-        with pytest.raises(MapperParsingException):
+        with pytest.raises(RoutingMissingException):
             node.index_doc("pc", "c10", {"author": "x"},
                            type_name="comment")
         # the engine is not poisoned: valid docs still flow

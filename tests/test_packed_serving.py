@@ -88,6 +88,7 @@ class TestPackedParity:
         ids = {h["_id"] for h in before["hits"]["hits"]}
         assert "5" in ids
         node.delete_doc("idx", "5")
+        node.refresh("idx")   # NRT: deletes visible to search after refresh
         after = node.search("idx", {"query": {"match": {"title": "fox"}}})
         assert "5" not in {h["_id"] for h in after["hits"]["hits"]}
         assert after["hits"]["total"] == before["hits"]["total"] - 1

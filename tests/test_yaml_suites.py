@@ -39,7 +39,12 @@ GREEN_SUITES = [
     "create/35_external_version.yaml",
     "create/36_external_gte_version.yaml",
     "create/37_force_version.yaml",
+    "create/40_routing.yaml",
+    "create/50_parent.yaml",
+    "create/55_parent_with_routing.yaml",
     "create/60_refresh.yaml",
+    "create/70_timestamp.yaml",
+    "create/75_ttl.yaml",
     "delete/10_basic.yaml",
     "delete/11_shard_header.yaml",
     "delete/20_internal_version.yaml",
@@ -47,21 +52,33 @@ GREEN_SUITES = [
     "delete/26_external_gte_version.yaml",
     "delete/27_force_version.yaml",
     "delete/30_routing.yaml",
+    "delete/40_parent.yaml",
     "delete/45_parent_with_routing.yaml",
+    "delete/50_refresh.yaml",
     "delete/60_missing.yaml",
     "delete_by_query/10_basic.yaml",
     "exists/10_basic.yaml",
+    "exists/30_parent.yaml",
     "exists/40_routing.yaml",
     "exists/55_parent_with_routing.yaml",
+    "exists/60_realtime_refresh.yaml",
     "exists/70_defaults.yaml",
     "get/10_basic.yaml",
     "get/15_default_values.yaml",
+    "get/20_fields.yaml",
+    "get/30_parent.yaml",
+    "get/40_routing.yaml",
+    "get/55_parent_with_routing.yaml",
+    "get/60_realtime_refresh.yaml",
     "get/70_source_filtering.yaml",
     "get/80_missing.yaml",
+    "get/90_versions.yaml",
     "get_source/10_basic.yaml",
     "get_source/15_default_values.yaml",
+    "get_source/30_parent.yaml",
     "get_source/40_routing.yaml",
     "get_source/55_parent_with_routing.yaml",
+    "get_source/60_realtime_refresh.yaml",
     "get_source/70_source_filtering.yaml",
     "get_source/80_missing.yaml",
     "index/10_with_id.yaml",
@@ -71,8 +88,14 @@ GREEN_SUITES = [
     "index/35_external_version.yaml",
     "index/36_external_gte_version.yaml",
     "index/37_force_version.yaml",
+    "index/40_routing.yaml",
+    "index/50_parent.yaml",
+    "index/55_parent_with_routing.yaml",
     "index/60_refresh.yaml",
+    "index/70_timestamp.yaml",
+    "index/75_ttl.yaml",
     "indices.analyze/10_analyze.yaml",
+    "indices.clear_cache/10_basic.yaml",
     "indices.delete_alias/10_basic.yaml",
     "indices.delete_alias/all_path_options.yaml",
     "indices.exists/10_basic.yaml",
@@ -101,11 +124,21 @@ GREEN_SUITES = [
     "indices.update_aliases/20_routing.yaml",
     "info/10_info.yaml",
     "info/20_lucene_version.yaml",
+    "mget/10_basic.yaml",
+    "mget/11_default_index_type.yaml",
     "mget/12_non_existent_index.yaml",
+    "mget/13_missing_metadata.yaml",
+    "mget/15_ids.yaml",
+    "mget/20_fields.yaml",
+    "mget/30_parent.yaml",
+    "mget/40_routing.yaml",
+    "mget/55_parent_with_routing.yaml",
+    "mget/60_realtime_refresh.yaml",
     "mlt/10_basic.yaml",
     "mlt/20_docs.yaml",
     "mpercolate/10_basic.yaml",
     "msearch/10_basic.yaml",
+    "mtermvectors/10_basic.yaml",
     "nodes.info/10_basic.yaml",
     "nodes.stats/10_basic.yaml",
     "percolate/18_highligh_with_query.yaml",
@@ -119,16 +152,27 @@ GREEN_SUITES = [
     "search/40_search_request_template.yaml",
     "search/issue4895.yaml",
     "search/test_sig_terms.yaml",
+    "search_shards/10_basic.yaml",
     "suggest/10_basic.yaml",
     "template/20_search.yaml",
+    "termvectors/10_basic.yaml",
+    "termvectors/20_issue7121.yaml",
+    "termvectors/30_realtime.yaml",
+    "termvectors/40_versions.yaml",
     "update/10_doc.yaml",
     "update/11_shard_header.yaml",
     "update/15_script.yaml",
     "update/20_doc_upsert.yaml",
     "update/22_doc_as_upsert.yaml",
     "update/25_script_upsert.yaml",
+    "update/30_internal_version.yaml",
     "update/35_other_versions.yaml",
+    "update/40_routing.yaml",
+    "update/50_parent.yaml",
+    "update/55_parent_with_routing.yaml",
     "update/60_refresh.yaml",
+    "update/70_timestamp.yaml",
+    "update/75_ttl.yaml",
     "update/80_fields.yaml",
     "update/85_fields_meta.yaml",
     "update/90_missing.yaml"
@@ -169,4 +213,4 @@ def test_overall_coverage_floor(runner):
             continue
         if rs and all(r.ok for r in rs):
             green += 1
-    assert green >= 110, f"YAML suite coverage regressed: {green} green files"
+    assert green >= 150, f"YAML suite coverage regressed: {green} green files"

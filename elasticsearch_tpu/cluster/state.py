@@ -24,6 +24,7 @@ from typing import Any, Iterator
 UNASSIGNED = "UNASSIGNED"
 INITIALIZING = "INITIALIZING"
 STARTED = "STARTED"
+RELOCATING = "RELOCATING"   # still serving; a target copy is initializing
 
 
 class ClusterState:
@@ -106,8 +107,11 @@ class ClusterState:
         return None
 
     def started_copies(self, index: str, shard: int) -> list[dict]:
+        # a RELOCATING source keeps serving until the handoff completes
+        # (ref ShardRouting.relocating() — active includes relocating)
         return [c for c in self.routing[index][shard]
-                if c["state"] == STARTED and c["node"] is not None]
+                if c["state"] in (STARTED, RELOCATING)
+                and c["node"] is not None]
 
     def assigned_shards(self, node_id: str) -> Iterator[tuple[str, int, dict]]:
         for index, shards in self.routing.items():
@@ -119,20 +123,23 @@ class ClusterState:
     def health(self) -> dict:
         """green = all copies started; yellow = all primaries started;
         red = some primary down (ref cluster/health/ClusterHealthStatus)."""
-        active_primary = active = init = unassigned = 0
+        active_primary = active = init = unassigned = reloc = 0
         red = yellow = False
         for shards in self.routing.values():
             for copies in shards:
                 primary_ok = False
                 for c in copies:
-                    if c["state"] == STARTED:
+                    if c["state"] in (STARTED, RELOCATING):
                         active += 1
+                        if c["state"] == RELOCATING:
+                            reloc += 1
                         if c["primary"]:
                             primary_ok = True
                             active_primary += 1
                     elif c["state"] == INITIALIZING:
                         init += 1
-                        yellow = True
+                        if not c.get("relocation"):
+                            yellow = True   # relocation targets are surplus
                     else:
                         unassigned += 1
                         yellow = True
@@ -144,6 +151,7 @@ class ClusterState:
             "number_of_data_nodes": len(self.nodes),
             "active_primary_shards": active_primary,
             "active_shards": active,
+            "relocating_shards": reloc,
             "initializing_shards": init,
             "unassigned_shards": unassigned,
         }
@@ -196,27 +204,131 @@ def allocate(state: ClusterState) -> bool:
     return changed
 
 
+def rebalance(state: ClusterState, max_moves: int = 2) -> bool:
+    """Move STARTED copies from overloaded to underloaded nodes via the
+    RELOCATING state machine (ref allocator/BalancedShardsAllocator.java +
+    ShardRouting RELOCATING): the source keeps serving, a surplus target
+    copy initializes via peer recovery, and the handoff completes when the
+    target reports started. Runs only on a stable table (no unassigned /
+    non-relocation initializing copies) and caps moves per pass so a
+    joining node fills up without a thundering herd."""
+    live = set(state.nodes)
+    if not live:
+        return False
+    loads = {n: 0 for n in live}
+    for shards in state.routing.values():
+        for copies in shards:
+            for c in copies:
+                if c["state"] in (UNASSIGNED, INITIALIZING) \
+                        and not c.get("relocation"):
+                    return False      # allocate()'s job first
+                if c["state"] == RELOCATING:
+                    return False      # one wave at a time
+                if c["node"] in loads:
+                    loads[c["node"]] += 1
+    changed = False
+    for _ in range(max_moves):
+        src_node = max(loads, key=lambda n: (loads[n], n))
+        dst_node = min(loads, key=lambda n: (loads[n], n))
+        if loads[src_node] - loads[dst_node] <= 1:
+            break
+        moved = False
+        for index, shards in state.routing.items():
+            for copies in shards:
+                holders = {c["node"] for c in copies
+                           if c["node"] is not None}
+                if dst_node in holders:
+                    continue
+                for c in copies:
+                    if c["node"] == src_node and c["state"] == STARTED:
+                        c["state"] = RELOCATING
+                        c["relocating_to"] = dst_node
+                        copies.append({
+                            "node": dst_node, "primary": False,
+                            "state": INITIALIZING, "relocation": True,
+                            "recover_from": src_node,
+                            "primary_target": c["primary"]})
+                        loads[src_node] -= 1
+                        loads[dst_node] += 1
+                        moved = changed = True
+                        break
+                if moved:
+                    break
+            if moved:
+                break
+        if not moved:
+            break
+    return changed
+
+
+def finish_relocation(state: ClusterState, index: str, sid: int,
+                      target_node: str) -> bool:
+    """Target caught up: hand off — the target becomes a normal copy
+    (inheriting primaryhood) and the source copy disappears."""
+    copies = state.routing[index][sid]
+    target = next((c for c in copies if c["node"] == target_node
+                   and c.get("relocation")), None)
+    if target is None:
+        return False
+    source = next((c for c in copies if c["state"] == RELOCATING
+                   and c.get("relocating_to") == target_node), None)
+    target["state"] = STARTED
+    # inherit primaryhood from the source AT HANDOFF TIME — the source may
+    # have been promoted mid-relocation when the old primary died (a stale
+    # snapshot would leave the shard primary-less; code review r5)
+    target["primary"] = bool(source["primary"]) if source is not None \
+        else bool(target.get("primary_target", False))
+    target.pop("primary_target", None)
+    target.pop("relocation", None)
+    target.pop("recover_from", None)
+    if source is not None:
+        copies.remove(source)
+    return True
+
+
+def cancel_relocations_for(state: ClusterState, node_id: str) -> None:
+    """A relocation endpoint died: revert sources, drop surplus targets —
+    including targets whose RECOVERY SOURCE died (they would retry a dead
+    node forever while squatting on their slot; code review r5)."""
+    for shards in state.routing.values():
+        for copies in shards:
+            for c in [c for c in copies
+                      if c.get("relocation")
+                      and (c["node"] == node_id
+                           or c.get("recover_from") == node_id)]:
+                copies.remove(c)
+            for c in copies:
+                if c["state"] == RELOCATING \
+                        and c.get("relocating_to") == node_id:
+                    c["state"] = STARTED
+                    c.pop("relocating_to", None)
+
+
 def remove_node(state: ClusterState, node_id: str) -> None:
     """Node-leave: drop it from nodes, promote replicas for its primaries,
     unassign its replicas (ref AllocationService on node departure — the
     elastic-recovery reaction in SURVEY.md §5.3)."""
     state.nodes.pop(node_id, None)
+    cancel_relocations_for(state, node_id)
     for index, shards in state.routing.items():
         for copies in shards:
             lost_primary = False
-            for c in copies:
-                if c["node"] == node_id:
-                    if c["primary"]:
-                        lost_primary = True
-                    c["node"] = None
-                    c["state"] = UNASSIGNED
-                    c["primary"] = False
-                    c.pop("fresh", None)
+            for c in [c for c in copies if c["node"] == node_id]:
+                if c.get("relocation"):
+                    copies.remove(c)     # surplus target: just drop it
+                    continue
+                if c["primary"]:
+                    lost_primary = True
+                c["node"] = None
+                c["state"] = UNASSIGNED
+                c["primary"] = False
+                c.pop("fresh", None)
+                c.pop("relocating_to", None)
             if lost_primary:
                 # promote the first started replica (ref
                 # RoutingNodes.activePrimary promotion)
                 for c in copies:
-                    if c["state"] == STARTED:
+                    if c["state"] in (STARTED, RELOCATING):
                         c["primary"] = True
                         break
     allocate(state)

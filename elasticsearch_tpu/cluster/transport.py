@@ -108,6 +108,8 @@ class LocalTransport:
         self._disconnected: set[tuple[str | None, str]] = set()
         self.messages_sent = 0
         self.bytes_sent = 0
+        self.max_message_bytes = 0   # largest single frame (recovery tests
+                                     # assert chunking bounds this)
 
     def register(self, service: "TransportService") -> None:
         with self._lock:
@@ -159,9 +161,15 @@ class LocalTransport:
         with self._lock:
             self.messages_sent += 1
             self.bytes_sent += len(wire)
+            self.max_message_bytes = max(self.max_message_bytes, len(wire))
         request = _decode(json.loads(wire))
         response = target._handle(from_id, action, request)
-        return roundtrip(response)
+        wire_resp = json.dumps(_encode(response))
+        with self._lock:
+            self.bytes_sent += len(wire_resp)
+            self.max_message_bytes = max(self.max_message_bytes,
+                                         len(wire_resp))
+        return _decode(json.loads(wire_resp))
 
 
 class TransportService:

@@ -928,6 +928,7 @@ class NodeService:
                     entry["ttf"] = ttf
             fstat = None
             if field_statistics:
+                import numpy as _np
                 sum_df = doc_count = 0
                 sum_ttf = 0.0
                 for seg in segments:
@@ -936,7 +937,14 @@ class NodeService:
                         continue
                     sum_df += int(fx.term_lens.sum())
                     sum_ttf += fx.sum_dl        # Σ tokens == Σ tf
-                    doc_count += seg.root_live_count
+                    if fx.doc_ids_host is not None:
+                        # docs CONTAINING the field (ref FieldStats
+                        # docCount), not all docs in the segment
+                        uniq = _np.unique(fx.doc_ids_host)
+                        doc_count += int(
+                            seg.live_host[uniq].sum())
+                    else:
+                        doc_count += seg.root_live_count
                 fstat = {"sum_doc_freq": sum_df,
                          "doc_count": doc_count,
                          "sum_ttf": int(sum_ttf)}

@@ -764,7 +764,15 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                                  "to get;")
         realtime = _pbool(p, "realtime", True)
         if _pbool(p, "refresh", False):
-            node.refresh(g.get("index", "_all"))
+            # refresh every index the request touches, incl. per-doc _index
+            touched = {d.get("_index", g.get("index")) for d in items
+                       if isinstance(d, dict)} | {g.get("index")}
+            for idx in touched:
+                if idx:
+                    try:
+                        node.refresh(idx)
+                    except IndexMissingException:
+                        pass
         url_fields = p.get("fields", [None])[0]
         if url_fields is not None:
             url_fields = url_fields.split(",")

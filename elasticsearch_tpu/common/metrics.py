@@ -1,0 +1,97 @@
+"""Per-phase search timers + search slowlog.
+
+The observability floor (SURVEY §5.1/§5.5; VERDICT r4 #10):
+  * PhaseTimers — parse / device(query) / fetch / render wall-time
+    accumulators, surfaced through `_nodes/stats` and `_stats`. This is
+    the TPU analog of the reference's per-phase stats (SearchStats
+    queryTime/fetchTime) — here the interesting split is host parse vs
+    device program vs response render, because host overhead is where
+    TPU serving loses its speedup.
+  * SlowLog — per-index query slowlog with live-updatable thresholds
+    (ref index/search/slowlog/ShardSlowLogSearchService.java: warn/info/
+    debug/trace thresholds from index settings, applied per request).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+
+class PhaseTimers:
+    """Lock-cheap accumulators: {phase: (count, total_ms, max_ms)}."""
+
+    PHASES = ("parse", "device", "fetch", "render", "total")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc: dict[str, list] = {p: [0, 0.0, 0.0] for p in self.PHASES}
+
+    def record(self, phase: str, ms: float) -> None:
+        with self._lock:
+            a = self._acc.setdefault(phase, [0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += ms
+            a[2] = max(a[2], ms)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {p: {"count": a[0],
+                        "time_in_millis": round(a[1], 3),
+                        "max_millis": round(a[2], 3)}
+                    for p, a in self._acc.items() if a[0]}
+
+
+def _threshold_ms(settings, level: str) -> float | None:
+    """index.search.slowlog.threshold.query.<level> -> ms (live: read per
+    request, so a settings update applies immediately)."""
+    for key in (f"index.search.slowlog.threshold.query.{level}",
+                f"search.slowlog.threshold.query.{level}"):
+        v = settings.get(key)
+        if v is not None:
+            from ..mapping.mapper import parse_ttl_ms
+            try:
+                return float(parse_ttl_ms(v))
+            except Exception:  # noqa: BLE001
+                return None
+    return None
+
+
+class SlowLog:
+    """Query slowlog: threshold-gated log lines + a bounded in-memory tail
+    (the reference writes log files; the tail makes it assertable and
+    REST-visible)."""
+
+    def __init__(self, maxlen: int = 128):
+        self.logger = logging.getLogger(
+            "elasticsearch_tpu.index.search.slowlog.query")
+        self.tail: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def snapshot(self) -> list:
+        """Race-free copy for REST rendering (the HTTP server is threaded
+        and searches append concurrently)."""
+        with self._lock:
+            return list(self.tail)
+
+    def maybe_log(self, settings, index: str, took_ms: float,
+                  body: dict) -> str | None:
+        """Returns the level logged at, or None."""
+        for level, log_fn in (("warn", self.logger.warning),
+                              ("info", self.logger.info),
+                              ("debug", self.logger.debug),
+                              ("trace", self.logger.debug)):
+            thr = _threshold_ms(settings, level)
+            if thr is not None and took_ms >= thr:
+                import json
+                entry = {"level": level, "index": index,
+                         "took_millis": round(took_ms, 2),
+                         "source": json.dumps(body)[:512]}
+                with self._lock:
+                    self.tail.append(entry)
+                log_fn("[%s] took[%sms], source[%s]", index,
+                       entry["took_millis"], entry["source"])
+                return level
+        return None

@@ -122,6 +122,9 @@ class NodeService:
         os.makedirs(data_path, exist_ok=True)
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
+        from .common.metrics import PhaseTimers, SlowLog
+        self.phase_timers = PhaseTimers()
+        self.slowlog = SlowLog()
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
         tpl_path = os.path.join(data_path, "_templates.json")
@@ -514,6 +517,14 @@ class NodeService:
                     out = self._batcher.submit(key, names[0], body, spec,
                                                size, from_, t0)
                     if out is not None:
+                        # batcher lane: only TOTAL is honest here — the
+                        # request's wall time includes queue wait and
+                        # shared-batch work, not this request's device time
+                        took = (time.perf_counter() - t0) * 1000
+                        self.phase_timers.record("total", took)
+                        self.slowlog.maybe_log(
+                            self.indices[names[0]].settings, names[0],
+                            took, body)
                         return out
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
@@ -591,6 +602,8 @@ class NodeService:
             global_stats = CollectionStats.from_segments(
                 all_segs, terms_by_field)
 
+        t_parse_done = time.perf_counter()
+        self.phase_timers.record("parse", (t_parse_done - t0) * 1000)
         results = []
         shard_failures = 0
         for i, s in enumerate(searchers):
@@ -612,6 +625,9 @@ class NodeService:
                 r = s.rescore(r, rescore_spec)
             results.append(r)
 
+        t_device_done = time.perf_counter()
+        self.phase_timers.record("device",
+                                 (t_device_done - t_parse_done) * 1000)
         reduced = controller.sort_docs(results, from_=from_, size=size,
                                        sort=sort)
         src_filter = body.get("_source")
@@ -662,6 +678,12 @@ class NodeService:
             resp["aggregations"] = render_aggs(agg_specs, merged)
         if body.get("suggest"):
             resp["suggest"] = self.suggest(index, body["suggest"])
+        now = time.perf_counter()
+        self.phase_timers.record("fetch", (now - t_device_done) * 1000)
+        self.phase_timers.record("total", (now - t0) * 1000)
+        for n in names:     # every searched index's thresholds apply
+            self.slowlog.maybe_log(self.indices[n].settings, n,
+                                   (now - t0) * 1000, body)
         return resp
 
     def _alias_filters_by_index(self, expr: str,
@@ -1250,12 +1272,47 @@ class NodeService:
             nodes_by_index[n].collect_terms(terms_by_field)
         global_stats = CollectionStats.from_segments(
             [seg for s in searchers for seg in s.segments], terms_by_field)
-        results = [
-            s.execute_query_phase(nodes_by_index[index_of[i]],
-                                  size=max(size, window),
-                                  from_=from_, n_queries=len(queries),
-                                  global_stats=global_stats)
-            for i, s in enumerate(searchers)]
+        aggs_body = first_body.get("aggs") or first_body.get("aggregations")
+        count_only = size + from_ == 0 and rescore_spec0 is None
+        seg_masks: list | None = None
+        if count_only or aggs_body is not None:
+            # ONE match-mask program per segment serves totals (count-only
+            # fast path) AND agg collect — never computed twice
+            from .search.query_dsl import SegmentContext
+            Q = len(queries)
+            seg_masks = []
+            for i, s in enumerate(searchers):
+                for seg in s.segments:
+                    if seg.n_docs == 0:
+                        continue
+                    ctx = SegmentContext(seg, Q, global_stats)
+                    m = nodes_by_index[index_of[i]].match_mask(ctx) \
+                        & seg.live[None, :]
+                    seg_masks.append((i, seg, m))
+        if count_only:
+            # agg/count-only batch: SKIP scoring entirely. The dense [Q, N]
+            # scoring pass cost the r5 agg bench ~99% of its time at 1M docs.
+            from .search.shard_searcher import QuerySearchResult
+            import numpy as _np
+            Q = len(queries)
+            totals = {i: _np.zeros((Q,), _np.int64)
+                      for i in range(len(searchers))}
+            for i, _seg, m in seg_masks:
+                totals[i] += _np.asarray(m.sum(axis=1))
+            results = [QuerySearchResult(
+                shard_id=s.shard_id,
+                doc_keys=_np.full((Q, 0), -1, _np.int64),
+                scores=_np.full((Q, 0), _np.nan, _np.float32),
+                sort_values=None, total_hits=totals[i],
+                max_score=_np.full((Q,), _np.nan, _np.float32))
+                for i, s in enumerate(searchers)]
+        else:
+            results = [
+                s.execute_query_phase(nodes_by_index[index_of[i]],
+                                      size=max(size, window),
+                                      from_=from_, n_queries=len(queries),
+                                      global_stats=global_stats)
+                for i, s in enumerate(searchers)]
         if rescore_spec0 is not None:
             specs = []
             for _, b in metas:
@@ -1265,28 +1322,17 @@ class NodeService:
                        for s, r in zip(searchers, results)]
 
         # identical agg trees across the batch (guaranteed by the group
-        # key): ONE batched match-mask program per segment, then per-row
-        # device collect — the config #3 analytics fast lane
+        # key): the shared match-mask programs above gate per-row device
+        # collect — the config #3 analytics fast lane
         agg_rendered: list[dict] | None = None
-        aggs_body = first_body.get("aggs") or first_body.get("aggregations")
         if aggs_body is not None:
             from .search.aggs.aggregators import (collect_shard,
                                                   merge_shard_partials,
                                                   parse_aggs)
             from .search.aggs.aggregators import render as render_aggs
-            from .search.query_dsl import SegmentContext
             from .search.aggs.aggregators import collect_shard_batched
             agg_specs = parse_aggs(aggs_body)
             Q = len(queries)
-            seg_masks: list[tuple[int, Any, Any]] = []  # (searcher i, seg, m)
-            for i, s in enumerate(searchers):
-                for seg in s.segments:
-                    if seg.n_docs == 0:
-                        continue
-                    ctx = SegmentContext(seg, Q, global_stats)
-                    m = nodes_by_index[index_of[i]].match_mask(ctx) \
-                        & seg.live[None, :]
-                    seg_masks.append((i, seg, m))
             by_shard: dict[int, tuple[list, list]] = {}
             for i, seg, m in seg_masks:
                 segs, ms = by_shard.setdefault(i, ([], []))

@@ -491,7 +491,7 @@ class QueryParser:
         else:
             single = {k: v for k, v in spec.items()
                       if k in ("field_value_factor", "script_score", "random_score",
-                               "gauss", "exp", "linear", "weight")}
+                               "cosine", "gauss", "exp", "linear", "weight")}
             if single:
                 functions.append(self._parse_function(single))
         return FunctionScoreNode(

@@ -34,6 +34,12 @@ SEG_SHIFT = 32
 LOCAL_MASK = (1 << 32) - 1
 
 
+@jax.jit
+def _masked_rowmax(scores, match):
+    """Per-row max over matched docs — [Q] comes back, not [Q, N]."""
+    return jnp.where(match, scores, -jnp.inf).max(axis=1)
+
+
 @dataclasses.dataclass
 class QuerySearchResult:
     """Per-shard query-phase result (ref search/query/QuerySearchResult.java)."""
@@ -199,11 +205,11 @@ class ShardSearcher:
             # narrows collection below, not the hit count (ref QueryPhase)
             total += np.asarray(topk_ops.count_matches(match))
             if track_scores:
-                # mask out non-matching / tombstoned docs before the max —
-                # a deleted top doc must not leak its score into max_score
-                masked_sc = np.where(np.asarray(match), np.asarray(scores),
-                                     -np.inf)
-                max_score = np.maximum(max_score, masked_sc.max(axis=1))
+                # mask + max ON DEVICE — downloading the [Q, N] score and
+                # match matrices to host cost ~0.5 GB per 64-query batch at
+                # 1M docs over a tunneled chip (bench r5 agg leg: 0.75 QPS)
+                seg_max = np.asarray(_masked_rowmax(scores, match))
+                max_score = np.maximum(max_score, seg_max)
             if sort is None:
                 top, idx = topk_ops.topk_scores(scores, match, k=kk)
                 top = np.asarray(top)

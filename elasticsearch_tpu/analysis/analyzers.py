@@ -79,11 +79,8 @@ def edge_ngram_tokenizer(text: str, min_gram: int = 1, max_gram: int = 8) -> lis
 # Token filters
 # ---------------------------------------------------------------------------
 
-# Lucene's default English stopword set (StandardAnalyzer.STOP_WORDS_SET).
-ENGLISH_STOPWORDS = frozenset(
-    "a an and are as at be but by for if in into is it no not of on or such "
-    "that the their then there these they this to was will with".split()
-)
+# Lucene's default English stopword set — one source (languages.py).
+from .languages import _ENGLISH as ENGLISH_STOPWORDS  # noqa: E402
 
 
 def lowercase_filter(tokens: list[Token]) -> list[Token]:
@@ -249,6 +246,99 @@ def porter_stem_filter(tokens: list[Token]) -> list[Token]:
     return [porter_stem(t) for t in tokens]
 
 
+# --- synonyms (ref index/analysis/SynonymTokenFilterFactory.java) ----------
+
+class SynonymFilter:
+    """Solr-format synonym rules:
+         "a, b => c"   mapping: a or b rewrite to c
+         "x, y, z"     equivalence class: each emits the whole class
+    Multi-token synonyms are matched on SINGLE input tokens only (phrase
+    synonyms are out of scope; the reference supports them via its token
+    graph, a tokenizer-level feature)."""
+
+    def __init__(self, rules: list[str], expand: bool = True):
+        self.map: dict[str, list[str]] = {}
+        for rule in rules or []:
+            rule = str(rule).strip()
+            if not rule or rule.startswith("#"):
+                continue
+            if "=>" in rule:
+                lhs, rhs = rule.split("=>", 1)
+                targets = [t.strip() for t in rhs.split(",") if t.strip()]
+                for src in (s.strip() for s in lhs.split(",")):
+                    if src:
+                        self.map.setdefault(src, []).extend(
+                            t for t in targets
+                            if t not in self.map.get(src, []))
+            else:
+                cls = [t.strip() for t in rule.split(",") if t.strip()]
+                for src in cls:
+                    outs = cls if expand else cls[:1]
+                    self.map.setdefault(src, []).extend(
+                        t for t in outs if t not in self.map.get(src, []))
+
+    def __call__(self, tokens: list[Token]) -> list[Token]:
+        # mapping rules REPLACE the source (targets exclude it);
+        # equivalence classes EXPAND it (targets include it)
+        out: list[Token] = []
+        for t in tokens:
+            out.extend(self.map.get(t, (t,)))
+        return out
+
+
+# --- compound words (ref DictionaryCompoundWordTokenFilterFactory) ---------
+
+class DictionaryDecompounder:
+    """Emits the original token plus any dictionary subwords found inside
+    it (greedy substring scan; min/max subword lengths per the reference
+    factory's defaults)."""
+
+    def __init__(self, word_list: list[str], min_subword_size: int = 2,
+                 max_subword_size: int = 15, only_longest_match: bool = False):
+        self.words = {w.lower() for w in word_list or []}
+        self.min_sub = min_subword_size
+        self.max_sub = max_subword_size
+        self.only_longest = only_longest_match
+
+    def __call__(self, tokens: list[Token]) -> list[Token]:
+        out = []
+        for t in tokens:
+            out.append(t)
+            low = t.lower()
+            found = []
+            for i in range(len(low)):
+                for j in range(i + self.min_sub,
+                               min(len(low), i + self.max_sub) + 1):
+                    if low[i:j] in self.words and low[i:j] != low:
+                        found.append(low[i:j])
+            if found and self.only_longest:
+                found = [max(found, key=len)]
+            out.extend(found)
+        return out
+
+
+# --- elision (l'avion -> avion; ref ElisionTokenFilterFactory) -------------
+
+_DEFAULT_ELISION = ("l", "m", "t", "qu", "n", "s", "j", "d", "c",
+                    "jusqu", "quoiqu", "lorsqu", "puisqu")
+
+
+def make_elision_filter(articles=None):
+    arts = tuple(articles) if articles else _DEFAULT_ELISION
+
+    def f(tokens):
+        out = []
+        for t in tokens:
+            for a in arts:
+                if t.lower().startswith(a + "'"):
+                    t = t[len(a) + 1:]
+                    break
+            if t:
+                out.append(t)
+        return out
+    return f
+
+
 # ---------------------------------------------------------------------------
 # Analyzers and the registry
 # ---------------------------------------------------------------------------
@@ -283,6 +373,36 @@ BUILTIN_ANALYZERS: dict[str, Analyzer] = {
     "english": _std("english", lowercase_filter, stop_filter, porter_stem_filter),
 }
 
+
+def _register_language_analyzers() -> None:
+    """Language analyzers (ref the per-language *AnalyzerProvider classes):
+    lowercase -> language stopwords -> light stemmer (+ elision for
+    french/italian; cjk uses bigrams)."""
+    from .languages import (STOPWORDS, cjk_bigram, make_light_stemmer)
+
+    def stop_for(lang):
+        sw = STOPWORDS.get(lang)
+        if sw is None:
+            return None
+        return lambda toks: [t for t in toks if t not in sw]
+
+    for lang in ("french", "german", "spanish", "italian", "portuguese",
+                 "dutch", "russian", "swedish", "danish", "norwegian",
+                 "finnish"):
+        filters = [lowercase_filter]
+        if lang in ("french", "italian"):
+            filters.append(make_elision_filter())
+        sf = stop_for(lang)
+        if sf is not None:
+            filters.append(sf)
+        filters.append(make_light_stemmer(lang))
+        BUILTIN_ANALYZERS[lang] = Analyzer(lang, standard_tokenizer, filters)
+    BUILTIN_ANALYZERS["cjk"] = Analyzer("cjk", standard_tokenizer,
+                                        [lowercase_filter, cjk_bigram])
+
+
+_register_language_analyzers()
+
 _TOKENIZERS: dict[str, Tokenizer] = {
     "standard": standard_tokenizer,
     "whitespace": whitespace_tokenizer,
@@ -303,8 +423,77 @@ _FILTERS: dict[str, TokenFilter] = {
     "unique": unique_filter,
     "porter_stem": porter_stem_filter,
     "stemmer": porter_stem_filter,
+    "snowball": porter_stem_filter,
     "shingle": shingle_filter,
+    "elision": make_elision_filter(),
 }
+
+
+def _filter_factory(ftype: str, params: dict) -> TokenFilter:
+    """Build a PARAMETERIZED token filter from its settings definition
+    (ref index.analysis.filter.<name>.{type, ...} — AnalysisModule's
+    TokenFilterFactory registry)."""
+    from .languages import STOPWORDS, cjk_bigram, make_light_stemmer
+
+    if ftype == "synonym":
+        rules = params.get("synonyms") or []
+        if isinstance(rules, str):
+            rules = [rules]
+        return SynonymFilter(rules, expand=params.get("expand", True)
+                             not in (False, "false"))
+    if ftype in ("dictionary_decompounder", "hyphenation_decompounder"):
+        return DictionaryDecompounder(
+            params.get("word_list") or [],
+            min_subword_size=int(params.get("min_subword_size", 2)),
+            max_subword_size=int(params.get("max_subword_size", 15)),
+            only_longest_match=params.get("only_longest_match")
+            in (True, "true"))
+    if ftype in ("stemmer", "snowball", "light_stemmer"):
+        lang = str(params.get("language", params.get("name",
+                                                     "english"))).lower()
+        if lang in ("english", "porter", "porter2", "minimal_english",
+                    "light_english"):
+            return porter_stem_filter
+        base = lang.replace("light_", "").replace("minimal_", "")
+        return make_light_stemmer(base)
+    if ftype == "stop":
+        sw = params.get("stopwords", "_english_")
+        if isinstance(sw, str):
+            lang = sw.strip("_")
+            if lang == "none":
+                sw = frozenset()      # explicit "keep everything"
+            else:
+                sw = STOPWORDS.get(lang, ENGLISH_STOPWORDS)
+        sw = frozenset(str(x) for x in sw)
+        return lambda toks: [t for t in toks if t not in sw]
+    if ftype == "shingle":
+        return lambda toks: shingle_filter(
+            toks, min_size=int(params.get("min_shingle_size", 2)),
+            max_size=int(params.get("max_shingle_size", 2)),
+            output_unigrams=params.get("output_unigrams", True)
+            not in (False, "false"))
+    if ftype == "length":
+        lo = int(params.get("min", 0))
+        hi = int(params.get("max", 1 << 30))
+        return lambda toks: length_filter(toks, lo, hi)
+    if ftype in ("ngram", "nGram"):
+        lo = int(params.get("min_gram", 1))
+        hi = int(params.get("max_gram", 2))
+        return lambda toks: [g for t in toks
+                             for g in _ngram(t, lo, hi, edge=False)]
+    if ftype in ("edge_ngram", "edgeNGram"):
+        lo = int(params.get("min_gram", 1))
+        hi = int(params.get("max_gram", 8))
+        return lambda toks: [g for t in toks
+                             for g in _ngram(t, lo, hi, edge=True)]
+    if ftype == "elision":
+        return make_elision_filter(params.get("articles"))
+    if ftype == "cjk_bigram":
+        return cjk_bigram
+    f = _FILTERS.get(ftype)
+    if f is not None:
+        return f
+    raise ValueError(f"unknown token filter type [{ftype}]")
 
 
 class AnalysisService:
@@ -325,6 +514,55 @@ class AnalysisService:
 
         if not isinstance(settings, Settings):
             settings = Settings(settings)
+
+        # 1. named CUSTOM FILTER definitions with parameters
+        #    (index.analysis.filter.<name>.{type, synonyms, language, ...})
+        # Build errors are RECORDED, not raised: an unsupported filter type
+        # must not brick node recovery of an existing index — create_index
+        # checks build_errors and rejects new indices loudly instead.
+        self.build_errors: list[str] = []
+        self._custom_filters: dict[str, TokenFilter] = {}
+        fdefs = settings.by_prefix("index.analysis.filter.")
+        for name in {k.split(".")[0] for k in fdefs}:
+            sub = fdefs.by_prefix(name + ".")
+            params = {k: sub.get(k) for k in sub
+                      if not k.split(".")[-1].isdigit()}
+            for lp in ("synonyms", "word_list", "articles", "stopwords"):
+                raw = sub.get(lp)
+                if isinstance(raw, (list, tuple)):
+                    params[lp] = list(raw)
+                elif raw is None:       # flat numbered keys (syn.0, syn.1)
+                    lv = sub.get_list(lp)
+                    if lv is not None:
+                        params[lp] = lv
+                # scalar strings pass through UNSPLIT ("a, b => c" is one
+                # synonym rule; "_french_" is one language marker)
+            ftype = str(params.pop("type", name))
+            try:
+                self._custom_filters[name] = _filter_factory(ftype, params)
+            except Exception as e:  # noqa: BLE001 — recovery must not die
+                self.build_errors.append(
+                    f"filter [{name}]: {type(e).__name__}: {e}")
+
+        # 2. named custom TOKENIZER definitions (ngram params etc.)
+        self._custom_tokenizers: dict[str, Tokenizer] = {}
+        tdefs = settings.by_prefix("index.analysis.tokenizer.")
+        for name in {k.split(".")[0] for k in tdefs}:
+            sub = tdefs.by_prefix(name + ".")
+            ttype = sub.get_str("type", name)
+            if ttype in ("ngram", "nGram", "edge_ngram", "edgeNGram"):
+                lo = int(sub.get("min_gram", 1))
+                hi = int(sub.get("max_gram",
+                                 2 if "edge" not in ttype.lower()
+                                 and "Edge" not in ttype else 8))
+                edge = "edge" in ttype.lower() or ttype == "edgeNGram"
+                self._custom_tokenizers[name] = \
+                    (lambda lo=lo, hi=hi, edge=edge:
+                     lambda text: _ngram(text, lo, hi, edge))()
+            elif ttype in _TOKENIZERS:
+                self._custom_tokenizers[name] = _TOKENIZERS[ttype]
+
+        # 3. analyzer chains referencing builtins or the custom components
         custom = settings.by_prefix("index.analysis.analyzer.")
         names = {k.split(".")[0] for k in custom}
         for name in names:
@@ -334,15 +572,27 @@ class AnalysisService:
                 self._analyzers[name] = BUILTIN_ANALYZERS[atype]
                 continue
             tok_name = sub.get_str("tokenizer", "standard")
-            tokenizer = _TOKENIZERS.get(tok_name)
+            tokenizer = self._custom_tokenizers.get(tok_name) \
+                or _TOKENIZERS.get(tok_name)
             if tokenizer is None:
-                raise ValueError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+                self.build_errors.append(
+                    f"analyzer [{name}]: unknown tokenizer [{tok_name}]")
+                continue
             filters = []
+            broken = None
             for fname in sub.get_list("filter", []) or []:
-                f = _FILTERS.get(fname)
+                f = self._custom_filters.get(fname) or _FILTERS.get(fname)
                 if f is None:
-                    raise ValueError(f"unknown token filter [{fname}] for analyzer [{name}]")
+                    try:
+                        f = _filter_factory(fname, {})
+                    except ValueError:
+                        broken = fname
+                        break
                 filters.append(f)
+            if broken is not None:
+                self.build_errors.append(
+                    f"analyzer [{name}]: unknown token filter [{broken}]")
+                continue
             self._analyzers[name] = Analyzer(name, tokenizer, filters)
 
     def analyzer(self, name: str) -> Analyzer:

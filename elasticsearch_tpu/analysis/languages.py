@@ -1,0 +1,204 @@
+"""Language analysis: stopword lists + light suffix stemmers.
+
+The breadth analog of the reference's ~40 language analyzers
+(/root/reference/src/main/java/org/elasticsearch/index/analysis/ — e.g.
+FrenchAnalyzerProvider, GermanAnalyzerProvider; Lucene's language packs).
+Design choice: LIGHT stemmers (suffix-strip tables in the spirit of the
+published "light" stemmer family used by Lucene's *LightStemmer classes)
+rather than full Snowball ports — they normalize the common inflectional
+morphology that drives recall, in ~10 lines per language, and stay
+deterministic across nodes. Stemming is host-side string work; its output
+feeds the tensor segment builder like any other analysis chain.
+"""
+
+from __future__ import annotations
+
+# -- stopwords (compact high-frequency function-word sets per language) ----
+
+# Lucene's default English stopword set (StandardAnalyzer.STOP_WORDS_SET);
+# analyzers.py re-exports this as ENGLISH_STOPWORDS — single source.
+_ENGLISH = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or "
+    "such that the their then there these they this to was will with"
+    .split())
+
+STOPWORDS: dict[str, frozenset] = {
+    "english": _ENGLISH,
+    "french": frozenset(
+        "au aux avec ce ces dans de des du elle en et eux il ils je la le "
+        "les leur lui ma mais me même mes moi mon ne nos notre nous on ou "
+        "par pas pour qu que qui sa se ses son sur ta te tes toi ton tu un "
+        "une vos votre vous c d j l à m n s t y été étée étées étés étant "
+        "suis es est sommes êtes sont sera serai au".split()),
+    "german": frozenset(
+        "aber alle als also am an auch auf aus bei bin bis bist da damit "
+        "dann das dass dein der den des dem die dies dir doch dort du er es "
+        "ein eine einem einen einer eines für hab habe haben hat hatte ich "
+        "ihr im in ist ja kann kein können mein mich mir mit muss nach "
+        "nicht noch nun nur ob oder ohne sehr sein sich sie sind so über um "
+        "und uns unser vom von vor war was wenn werden wie wieder wir wird "
+        "zu zum zur".split()),
+    "spanish": frozenset(
+        "a al algo como con de del desde donde dos el ella ellas ellos en "
+        "entre era es esa ese eso esta este esto fue ha hay la las le les "
+        "lo los mas me mi mis mucho muy nada ni no nos nosotros o os otra "
+        "otro para pero poco por porque que quien se ser si sin sobre son "
+        "su sus también te tiene todo tu tus un una uno unos y ya yo"
+        .split()),
+    "italian": frozenset(
+        "a ad al alla alle agli ai anche che chi ci come con cui da dal "
+        "dalla de degli dei del della delle di dove e ed era fra gli ha "
+        "hanno il in io la le lei lo loro lui ma mi mia mio ne nei nel "
+        "nella noi non nostro o per perché più quale quando questa queste "
+        "questi questo se sei si sia sono su sua sue sui suo tra tu tua "
+        "tuo un una uno vi voi".split()),
+    "portuguese": frozenset(
+        "a ao aos as às com como da das de do dos e em entre era és foi "
+        "há isso isto já la lhe lo mais mas me mesmo meu minha muito na "
+        "não nas nem no nos nós o os ou para pela pelo por qual quando que "
+        "quem se sem ser seu sua são só também te tem teu tua tudo um uma "
+        "você vos".split()),
+    "dutch": frozenset(
+        "aan al alles als altijd andere ben bij daar dan dat de der deze "
+        "die dit doch doen door dus een en er ge geen geweest haar had heb "
+        "hebben heeft hem het hier hij hoe hun iemand iets ik in is ja je "
+        "kan kon kunnen maar me meer men met mij mijn moet na naar niet "
+        "niets nog nu of om omdat onder ons ook op over reeds te tegen toch "
+        "toen tot u uit uw van veel voor want waren was wat werd wezen wie "
+        "wil worden wordt zal ze zelf zich zij zijn zo zonder zou".split()),
+    "russian": frozenset(
+        "а без более бы был была были было быть в вам вас весь во вот все "
+        "всего всех вы где да даже для до его ее если есть еще же за здесь "
+        "и из или им их к как ко когда кто ли либо мне может мы на надо "
+        "наш не него нее нет ни них но ну о об однако он она они оно от "
+        "очень по под при с со так также такой там те тем то того тоже той "
+        "только том ты у уже хотя чего чей чем что чтобы чье чья эта эти "
+        "это я".split()),
+    "swedish": frozenset(
+        "alla allt att av blev bli blir blivit de dem den denna deras dess "
+        "det detta dig din dina du där då efter ej eller en er era ett "
+        "från för ha hade han hans har henne hennes hon honom hur här i "
+        "icke ingen inom inte jag ju kan kunde man med mellan men mig min "
+        "mina mot mycket ni nu när någon något några och om oss på samma "
+        "sedan sig sin sina sitta skulle som så sådan till under upp ut "
+        "utan vad var vara varför varit varje vars vem vi vid vilken än är "
+        "åt över".split()),
+    "danish": frozenset(
+        "af alle andet andre at begge da de den denne der deres det dette "
+        "dig din dog du ej eller en end ene eneste enhver et få for fordi "
+        "fra ham han hans har hendes her hun hvad hvem hver hvilken hvis "
+        "hvor hvordan hvorfor hvornår i ikke ind ingen intet jeg jeres kan "
+        "kom kunne man mange med meget men mig mine mit mod ned når nogen "
+        "noget nogle nu ny og også om op os over på se sig skal skulle som "
+        "sådan thi til ud under var vi vil ville vor være været".split()),
+    "norwegian": frozenset(
+        "alle at av både båe da de deg dei deim deira dem den denne der "
+        "dere deres det dette di din disse ditt du dykk eg ein eit eitt "
+        "eller elles en er et ett etter for fordi fra før ha hadde han "
+        "hans har hennar henne hennes her hjå ho hun hva hvem hver hvilke "
+        "hvis hvor hvordan hvorfor i ikke ingen ja jeg kan kom korleis "
+        "kva kvar kvi man mange me med meg men mi min mitt mot mykje nå "
+        "når noen noko nokon nokor nokre og også om opp oss over på så "
+        "sidan sin sine sitt sjøl skal skulle slik som somme somt til um "
+        "upp ut uten var vart varte ved vere verte vi vil ville vore vors "
+        "vort være vært".split()),
+    "finnish": frozenset(
+        "ei eivät emme en et ette että he heidän heidät heihin heille "
+        "heillä heiltä heissä heistä heitä hän häneen hänelle hänellä "
+        "häneltä hänen hänessä hänestä hänet häntä ja jos joka jotka kuin "
+        "kun me meidän meidät meihin meille meillä meiltä meissä meistä "
+        "meitä minkä minua minulla minulle minulta minun minussa minusta "
+        "minut minuun minä mitä mukaan mutta ne niiden niihin niille "
+        "niillä niiltä niin niissä niistä niitä nuo nyt näiden näihin "
+        "näille näillä näiltä näissä näistä näitä nämä ole olemme olen "
+        "olet olette oli olimme olin olisi olisimme olisin olisit olisitte "
+        "olisivat olit olitte olivat olla olleet ollut on ovat se sekä sen "
+        "siihen siinä siitä sille sillä siltä sinua sinulla sinulle "
+        "sinulta sinun sinussa sinusta sinut sinuun sinä sitä tai te "
+        "teidän teidät teihin teille teillä teiltä teissä teistä teitä tuo "
+        "tähän tälle tällä tältä tämä tämän tässä tästä tätä vaan vai "
+        "vaikka ja".split()),
+}
+
+# -- light suffix stemmers --------------------------------------------------
+# Longest-match suffix stripping with a minimum-stem guard; tables follow
+# the inflectional morphology each language's "light" stemmer targets.
+
+_SUFFIXES: dict[str, list[str]] = {
+    "french": ["issements", "issement", "atrices", "atrice", "ateurs",
+               "ations", "ateur", "ation", "euses", "ments", "ement",
+               "euse", "ence", "esse", "asse", "ant", "ent", "eux", "aux",
+               "ier", "ive", "ifs", "es", "er", "ez", "s", "e"],
+    "german": ["erinnen", "erin", "heiten", "heit", "keiten", "keit",
+               "ungen", "ung", "isch", "ern", "em", "er", "en", "es",
+               "e", "s", "n"],
+    "spanish": ["amientos", "imientos", "amiento", "imiento", "aciones",
+                "adoras", "adores", "ancias", "acion", "ación", "adora",
+                "ador", "ancia", "mente", "ible", "able", "istas", "ista",
+                "osos", "osas", "oso", "osa", "idad", "iva", "ivo", "es",
+                "as", "os", "s", "a", "o", "e"],
+    "italian": ["amenti", "imenti", "amento", "imento", "azioni", "azione",
+                "atrice", "atore", "mente", "anza", "enza", "ichi", "iche",
+                "abili", "ibili", "ista", "iste", "isti", "oso", "osa",
+                "osi", "ose", "i", "e", "a", "o"],
+    "portuguese": ["amentos", "imentos", "amento", "imento", "adoras",
+                   "adores", "aço~es", "ações", "ância", "mente", "idades",
+                   "idade", "ismos", "ismo", "istas", "ista", "osos",
+                   "osas", "oso", "osa", "es", "as", "os", "s", "a", "o",
+                   "e"],
+    "dutch": ["heden", "heid", "ingen", "ing", "eren", "en", "e", "s"],
+    "russian": ["иями", "иях", "ями", "ами", "ием", "иям", "ием", "ого",
+                "ому", "ыми", "его", "ему", "ими", "ов", "ев", "ей", "ий",
+                "ый", "ой", "ая", "яя", "ое", "ее", "ие", "ые", "ом", "ем",
+                "ам", "ям", "ах", "ях", "ую", "юю", "а", "я", "о", "е",
+                "и", "ы", "у", "ю", "й", "ь"],
+    "swedish": ["heterna", "heten", "heter", "arna", "erna", "orna", "ande",
+                "ende", "aste", "ast", "are", "en", "ar", "er", "or", "et",
+                "a", "e", "t", "s"],
+    "danish": ["erende", "hederne", "heden", "heder", "erne", "erer",
+               "ende", "erne", "ede", "er", "en", "et", "e", "s"],
+    "norwegian": ["hetene", "heten", "heter", "ende", "ande", "else",
+                  "ene", "ane", "ede", "er", "en", "et", "ar", "a", "e"],
+    "finnish": ["isuuksien", "isuuden", "isuus", "uksen", "ukset", "inen",
+                "isen", "iset", "ista", "istä", "ssa", "ssä", "sta", "stä",
+                "lla", "llä", "lta", "ltä", "lle", "ksi", "in", "en", "an",
+                "än", "at", "ät", "a", "ä", "n", "t"],
+}
+
+_MIN_STEM = {"russian": 3, "finnish": 3}
+
+
+def light_stem(lang: str, word: str) -> str:
+    """Strip the longest matching suffix, keeping a minimum stem."""
+    min_stem = _MIN_STEM.get(lang, 4)
+    for suf in _SUFFIXES.get(lang, ()):
+        if word.endswith(suf) and len(word) - len(suf) >= min_stem:
+            return word[: -len(suf)]
+    return word
+
+
+def make_light_stemmer(lang: str):
+    def f(tokens):
+        return [light_stem(lang, t) for t in tokens]
+    f.__name__ = f"{lang}_light_stem"
+    return f
+
+
+# -- CJK bigrams ------------------------------------------------------------
+
+def cjk_bigram(tokens):
+    """Han/Hiragana/Katakana/Hangul runs re-emitted as overlapping bigrams
+    (ref Lucene CJKAnalyzer): the standard unigram-ambiguity workaround
+    for unsegmented scripts."""
+    out = []
+    for t in tokens:
+        if len(t) >= 2 and any("⺀" <= c <= "鿿"
+                               or "぀" <= c <= "ヿ"
+                               or "가" <= c <= "힯" for c in t):
+            out.extend(t[i:i + 2] for i in range(len(t) - 1))
+        else:
+            out.append(t)
+    return out
+
+
+LANGUAGES = sorted(set(STOPWORDS) | set(_SUFFIXES))

@@ -33,7 +33,12 @@ class IndexService:
         self.aliases: dict[str, dict] = {}
         self.breakers = breakers           # CircuitBreakerService | None
         fd = breakers.breaker("fielddata") if breakers is not None else None
-        self.mappers = MapperService(mappings=mappings or {})
+        # custom analyzer/filter/tokenizer chains come from INDEX settings
+        # (ref AnalysisService built per-index from its Settings)
+        from ..analysis.analyzers import AnalysisService
+        self.mappers = MapperService(
+            analysis=AnalysisService(self.settings),
+            mappings=mappings or {})
         # per-field similarity registry (named configs from index settings,
         # resolved via the mapping's "similarity" property) — attached to
         # the mapper service so QueryParser sees it everywhere

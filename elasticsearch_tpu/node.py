@@ -199,6 +199,14 @@ class NodeService:
         svc = IndexService(name, os.path.join(self.data_path, name),
                            Settings(merged_settings), merged_mappings,
                            breakers=self.breakers)
+        errs = getattr(svc.mappers.analysis, "build_errors", None)
+        if errs:
+            # strict at CREATE time (the user can fix the request); node
+            # RECOVERY of existing indices stays lenient (code review r5)
+            svc.close()
+            import shutil
+            shutil.rmtree(svc.path, ignore_errors=True)
+            raise ValueError("analysis configuration: " + "; ".join(errs))
         svc.aliases = merged_aliases
         svc.mappers.search_templates = self.search_templates
         self.indices[name] = svc

@@ -20,11 +20,33 @@ import jax
 import jax.numpy as jnp
 
 
+# bincounts with small bin counts lower to ONE-HOT MATMULS, not scatters:
+# counts[b] = Σ_n mask[n]·(ids[n]==b) is a [1..Q, N] x [N, B] contraction —
+# MXU work with exact f32 accumulation (0/1 inputs), where jnp.bincount's
+# scatter-add serializes (13s per 64x1M batch measured on both backends).
+# Large B falls back to bincount (the one-hot would not fit).
+_MATMUL_BINS = 256   # one-hot is [N, B] bf16 — cap its footprint
+
+
+def _onehot_counts(ids, valid, n_bins: int):
+    """ids i32[N], valid bool[..., N] -> f32[..., n_bins] exact counts."""
+    oh = (ids[:, None] == jnp.arange(n_bins, dtype=ids.dtype)[None, :])
+    v2 = valid[None, :] if valid.ndim == 1 else valid
+    out = jax.lax.dot_general(
+        v2.astype(jnp.bfloat16), oh.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out[0] if valid.ndim == 1 else out
+
+
 @partial(jax.jit, static_argnames=("n_bins",))
 def masked_bincount(ords, mask, *, n_bins: int):
     """Counts per ordinal among masked docs. ords i32[N] (-1 = missing),
     mask bool[N] -> i32[n_bins]. Missing/unmasked docs fall into a spill
     bin that is sliced off."""
+    if n_bins <= _MATMUL_BINS:
+        valid = mask & (ords >= 0)
+        return _onehot_counts(ords, valid, n_bins).astype(jnp.int32)
     idx = jnp.where(mask & (ords >= 0), ords, n_bins)
     return jnp.bincount(idx, length=n_bins + 1)[:n_bins]
 
@@ -51,14 +73,16 @@ def count_mask(mask):
 
 @partial(jax.jit, static_argnames=("n_bins",))
 def masked_histogram(vals, missing, mask, base, interval, *, n_bins: int):
-    """Histogram/date_histogram collect as ONE bincount: bucket id is an
-    affine transform of the numeric column (floor((v - base)/interval)).
-    Out-of-range/missing/unmasked docs land in a spill bin that is sliced
-    off. vals [N], base/interval scalars -> i32[n_bins] counts."""
+    """Histogram/date_histogram collect: bucket id is an affine transform
+    of the numeric column (floor((v - base)/interval)); counting is a
+    one-hot matmul (see _onehot_counts). vals [N] -> i32[n_bins]."""
     sel = mask & ~missing
     idx = jnp.floor((vals.astype(jnp.float64) - base)
                     / interval).astype(jnp.int32)
-    idx = jnp.where(sel & (idx >= 0) & (idx < n_bins), idx, n_bins)
+    ok = sel & (idx >= 0) & (idx < n_bins)
+    if n_bins <= _MATMUL_BINS:
+        return _onehot_counts(idx, ok, n_bins).astype(jnp.int32)
+    idx = jnp.where(ok, idx, n_bins)
     return jnp.bincount(idx, length=n_bins + 1)[:n_bins]
 
 
@@ -77,7 +101,10 @@ def masked_ranges(vals, missing, mask, los, his):
 
 @partial(jax.jit, static_argnames=("n_bins",))
 def masked_bincount_q(ords, mask, *, n_bins: int):
-    """mask bool[Q, N] -> counts i32[Q, n_bins]."""
+    """mask bool[Q, N] -> counts i32[Q, n_bins] (one-hot matmul)."""
+    if n_bins <= _MATMUL_BINS:
+        valid = mask & (ords >= 0)[None, :]
+        return _onehot_counts(ords, valid, n_bins).astype(jnp.int32)
     idx = jnp.where(mask & (ords >= 0)[None, :], ords[None, :], n_bins)
     return jax.vmap(lambda ix: jnp.bincount(ix, length=n_bins + 1))(
         idx)[:, :n_bins]
@@ -85,10 +112,13 @@ def masked_bincount_q(ords, mask, *, n_bins: int):
 
 @partial(jax.jit, static_argnames=("n_bins",))
 def masked_histogram_q(vals, missing, mask, base, interval, *, n_bins: int):
-    """mask bool[Q, N] -> counts i32[Q, n_bins]."""
+    """mask bool[Q, N] -> counts i32[Q, n_bins] (one-hot matmul)."""
     idx = jnp.floor((vals.astype(jnp.float64) - base)
                     / interval).astype(jnp.int32)
     ok = (~missing) & (idx >= 0) & (idx < n_bins)
+    if n_bins <= _MATMUL_BINS:
+        return _onehot_counts(idx, mask & ok[None, :],
+                              n_bins).astype(jnp.int32)
     idx = jnp.where(mask & ok[None, :], idx[None, :], n_bins)
     return jax.vmap(lambda ix: jnp.bincount(ix, length=n_bins + 1))(
         idx)[:, :n_bins]

@@ -1222,17 +1222,46 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/{index}/_settings", get_settings)
     c.register("GET", "/{index}/_settings/{setting}", get_settings)
 
+    # runtime-updatable index settings (ref cluster/settings/
+    # DynamicSettings.java:30 + IndexDynamicSettings): everything else is
+    # STATIC and rejected on an open index, like the reference
+    _DYNAMIC_INDEX_SETTINGS = (
+        "number_of_replicas", "refresh_interval", "max_result_window",
+        "translog.", "slowlog.", "search.slowlog.", "indexing.slowlog.",
+        "blocks.", "routing.", "merge.", "gc_deletes", "warmer.",
+        "mapping.", "auto_expand_replicas", "mapper.",
+    )
+
+    def _is_dynamic_setting(key: str) -> bool:
+        k = key[6:] if key.startswith("index.") else key
+        return any(k == d or (d.endswith(".") and k.startswith(d))
+                   for d in _DYNAMIC_INDEX_SETTINGS)
+
+    def _flatten_settings(obj, prefix="") -> dict:
+        out = {}
+        for k, v in obj.items():
+            if isinstance(v, dict):
+                out.update(_flatten_settings(v, f"{prefix}{k}."))
+            else:
+                out[f"{prefix}{k}"] = v
+        return out
+
     def put_settings(g, p, b):
         body = _json_body(b)
         flat = body.get("settings", body)
         flat = flat.get("index", flat) if isinstance(
             flat.get("index", None), dict) else flat
+        flat = _flatten_settings(flat)   # nested {"translog": {...}} form
+        for k in flat:
+            if not _is_dynamic_setting(k):
+                raise RestError(
+                    400, f"IllegalArgumentException: can't update non "
+                         f"dynamic settings [[{k}]] for open indices")
         for n in node._resolve(g.get("index", "_all")):
             svc = node.indices[n]
             data = dict(svc.settings)
             for k, v in flat.items():
-                key = k if k.startswith("index.") else k
-                data[key] = v
+                data[k] = v
             from ..common.settings import Settings
             svc.settings = Settings(data)
             nr = svc.settings.get("number_of_replicas",
@@ -1240,6 +1269,11 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                                       "index.number_of_replicas"))
             if nr is not None:
                 svc.n_replicas = int(nr)
+            dur = svc.settings.get("index.translog.durability",
+                                   svc.settings.get("translog.durability"))
+            if dur is not None:
+                for e in svc.shards:     # applied LIVE to running engines
+                    e.translog.durability = str(dur).lower()
             node._persist_index_meta(svc)
         return 200, {"acknowledged": True}
     c.register("PUT", "/_settings", put_settings)

@@ -1,0 +1,111 @@
+"""Analysis breadth (VERDICT r4 missing #5): language analyzers, synonym,
+compound-word, elision, parameterized filter/tokenizer factories.
+Ref: index/analysis/ (149 files, ~40 language analyzers,
+SynonymTokenFilterFactory, DictionaryCompoundWordTokenFilterFactory)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import (AnalysisService,
+                                                  BUILTIN_ANALYZERS)
+
+
+class TestLanguageAnalyzers:
+    def test_registry_breadth(self):
+        langs = {"english", "french", "german", "spanish", "italian",
+                 "portuguese", "dutch", "russian", "swedish", "danish",
+                 "norwegian", "finnish", "cjk"}
+        assert langs <= set(BUILTIN_ANALYZERS)
+
+    def test_french_elision_stop_stem(self):
+        a = BUILTIN_ANALYZERS["french"]
+        toks = a("L'avion des montagnes volantes")
+        assert "avion" in toks                 # elision stripped l'
+        assert "des" not in toks               # stopword removed
+        assert any(t.startswith("volant") or t.startswith("vola")
+                   for t in toks)              # stemmed
+
+    def test_german_stemming_folds_inflections(self):
+        a = BUILTIN_ANALYZERS["german"]
+        assert a("Häuser")[0] == a("Häusern")[0]    # same stem
+
+    def test_russian_stemming(self):
+        a = BUILTIN_ANALYZERS["russian"]
+        assert a("книгами")[0] == a("книга")[0]
+
+    def test_cjk_bigrams(self):
+        a = BUILTIN_ANALYZERS["cjk"]
+        assert a("日本語テキスト mixed words")[:2] == ["日本", "本語"]
+        assert "mixed" in a("日本語 mixed")
+
+
+class TestCustomChains:
+    def test_synonym_equivalence_and_mapping(self):
+        svc = AnalysisService({
+            "index.analysis.filter.syn.type": "synonym",
+            "index.analysis.filter.syn.synonyms": [
+                "quick, fast", "car => automobile"],
+            "index.analysis.analyzer.my.tokenizer": "standard",
+            "index.analysis.analyzer.my.filter": ["lowercase", "syn"],
+        })
+        a = svc.analyzer("my")
+        assert set(a("quick car")) == {"quick", "fast", "automobile"}
+
+    def test_dictionary_decompounder(self):
+        svc = AnalysisService({
+            "index.analysis.filter.comp.type": "dictionary_decompounder",
+            "index.analysis.filter.comp.word_list": ["donau", "dampf",
+                                                     "schiff"],
+            "index.analysis.analyzer.de.tokenizer": "standard",
+            "index.analysis.analyzer.de.filter": ["lowercase", "comp"],
+        })
+        toks = svc.analyzer("de")("Donaudampfschiff")
+        assert "donaudampfschiff" in toks
+        assert {"donau", "dampf", "schiff"} <= set(toks)
+
+    def test_language_stemmer_filter_param(self):
+        svc = AnalysisService({
+            "index.analysis.filter.st.type": "stemmer",
+            "index.analysis.filter.st.language": "spanish",
+            "index.analysis.analyzer.es.tokenizer": "standard",
+            "index.analysis.analyzer.es.filter": ["lowercase", "st"],
+        })
+        a = svc.analyzer("es")
+        assert a("gatos")[0] == a("gato")[0]
+
+    def test_custom_stop_language(self):
+        svc = AnalysisService({
+            "index.analysis.filter.fs.type": "stop",
+            "index.analysis.filter.fs.stopwords": "_french_",
+            "index.analysis.analyzer.fr.tokenizer": "standard",
+            "index.analysis.analyzer.fr.filter": ["lowercase", "fs"],
+        })
+        assert "des" not in svc.analyzer("fr")("le vol des oiseaux")
+
+    def test_custom_ngram_tokenizer(self):
+        svc = AnalysisService({
+            "index.analysis.tokenizer.tri.type": "ngram",
+            "index.analysis.tokenizer.tri.min_gram": 3,
+            "index.analysis.tokenizer.tri.max_gram": 3,
+            "index.analysis.analyzer.ng.tokenizer": "tri",
+            "index.analysis.analyzer.ng.filter": ["lowercase"],
+        })
+        assert svc.analyzer("ng")("abcd") == ["abc", "bcd"]
+
+    def test_end_to_end_synonym_search(self, tmp_path):
+        from elasticsearch_tpu.node import NodeService
+        n = NodeService(str(tmp_path))
+        n.create_index("syn", settings={
+            "index.analysis.filter.syn.type": "synonym",
+            "index.analysis.filter.syn.synonyms": ["tv, television"],
+            "index.analysis.analyzer.syn_an.tokenizer": "standard",
+            "index.analysis.analyzer.syn_an.filter": ["lowercase", "syn"],
+        }, mappings={"_doc": {"properties": {
+            "body": {"type": "string", "analyzer": "syn_an"}}}})
+        n.index_doc("syn", "1", {"body": "I bought a new TV"})
+        n.refresh("syn")
+        # synonym applied at index AND search time: both spellings match
+        assert n.search("syn", {"query": {"match": {
+            "body": "television"}}})["hits"]["total"] == 1
+        assert n.search("syn", {"query": {"match": {
+            "body": "tv"}}})["hits"]["total"] == 1
+        n.close()

@@ -18,10 +18,17 @@ class TestCluster:
     __test__ = False        # not a pytest class, despite the name
 
     def __init__(self, n_nodes: int, data_path: str,
-                 minimum_master_nodes: int | None = None):
+                 minimum_master_nodes: int | None = None,
+                 transport: str = "local"):
         if minimum_master_nodes is None:
             minimum_master_nodes = n_nodes // 2 + 1
-        self.network = LocalTransport()
+        if transport == "tcp":
+            # real loopback sockets + binary frames (cluster/tcp.py) — the
+            # same node code, the production wire
+            from .tcp import TcpTransport
+            self.network = TcpTransport()
+        else:
+            self.network = LocalTransport()
         self.data_path = data_path
         self.minimum_master_nodes = minimum_master_nodes
         self.nodes: dict[str, ClusterNode] = {}
@@ -110,3 +117,5 @@ class TestCluster:
         for node in self.nodes.values():
             if not node.closed:
                 node.close()
+        if hasattr(self.network, "close"):
+            self.network.close()

@@ -1069,8 +1069,9 @@ class ClusterNode:
             h = fetched.get((ti, doc_id), {})
             entry = {"_index": targets[ti][1],
                      "_type": h.get("_type", "_doc"),
-                     "_id": doc_id, "_score": score,
-                     "_source": h.get("_source")}
+                     "_id": doc_id, "_score": score}
+            if h.get("_source") is not None:
+                entry["_source"] = h["_source"]
             if reduced["sorted"]:
                 entry["sort"] = sv
             if h.get("highlight"):
@@ -1257,7 +1258,9 @@ class ClusterNode:
         for _, ti, h in window:
             entry = {"_index": ctx["targets"][ti][1],
                      "_type": h.get("_type", "_doc"), "_id": h["_id"],
-                     "_score": h.get("score"), "_source": h.get("_source")}
+                     "_score": h.get("score")}
+            if h.get("_source") is not None:
+                entry["_source"] = h["_source"]
             if not h.get("implicit_sort"):
                 entry["sort"] = h["sort"]
             hits.append(entry)

@@ -1,0 +1,175 @@
+"""Named bounded thread pools.
+
+The analog of the reference's ThreadPool
+(/root/reference/src/main/java/org/elasticsearch/threadpool/ThreadPool.java:116
+— named executors per operation class: search = 3×cores queue 1000,
+index/bulk = cores queue 50/200, get, management, snapshot, refresh, generic
+— each with a bounded queue whose overflow is a *rejection*, not unbounded
+buffering; EsRejectedExecutionException surfaces to the client as 429).
+
+TPU-first note: device programs serialize on the chip anyway, so pools here
+bound *host-side* concurrency (parse/pack/render, IO, management) and give
+rejection a well-defined point before any HBM is charged — the same
+admission-control role the reference's search pool plays in front of Lucene.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+import os as _os
+
+_CORES = _os.cpu_count() or 4
+
+DEFAULT_POOLS = {
+    # name: (threads, queue_size)  — queue_size None = unbounded (scaling
+    # pools in the reference: management/generic/snapshot). Sizes follow
+    # ThreadPool.java:116-129: search 3×cores q1000, index cores q200,
+    # bulk cores q50, get cores q1000.
+    "search": (3 * _CORES, 1000),
+    "index": (_CORES, 200),
+    "bulk": (_CORES, 50),
+    "get": (_CORES, 1000),
+    "management": (2, None),
+    "generic": (4, None),
+    "snapshot": (1, None),
+    "refresh": (2, None),
+}
+
+
+class EsRejectedExecutionException(Exception):
+    """Bounded queue overflow — maps to HTTP 429 (ref
+    common/util/concurrent/EsRejectedExecutionException.java)."""
+
+
+class _Pool:
+    def __init__(self, name: str, threads: int, queue_size: int | None):
+        self.name = name
+        self.size = threads
+        self.queue_size = queue_size
+        self._q: queue.Queue = (queue.Queue(queue_size)
+                                if queue_size else queue.Queue())
+        self.active = 0
+        self.completed = 0
+        self.rejected = 0
+        self.largest_queue = 0
+        self._lock = threading.Lock()
+        self._shutdown = False
+        # workers spawn LAZILY on demand up to `threads` (the reference's
+        # executors do the same) — a NodeService that never serves traffic
+        # costs zero threads
+        self._started = 0
+        self._idle = 0
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            with self._lock:
+                self._idle -= 1
+                self.active += 1
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — tasks carry their own futures
+                pass
+            finally:
+                with self._lock:
+                    self.active -= 1
+                    self.completed += 1
+                    self._idle += 1
+
+    def execute(self, fn: Callable, *args) -> None:
+        if self._shutdown:
+            raise EsRejectedExecutionException(f"pool [{self.name}] shut down")
+        try:
+            self._q.put_nowait((fn, args))
+        except queue.Full:
+            with self._lock:
+                self.rejected += 1
+            raise EsRejectedExecutionException(
+                f"rejected execution on pool [{self.name}] "
+                f"(queue capacity {self.queue_size})") from None
+        with self._lock:
+            self.largest_queue = max(self.largest_queue, self._q.qsize())
+            if self._idle == 0 and self._started < self.size:
+                self._started += 1
+                self._idle += 1
+                threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"es[{self.name}][{self._started - 1}]").start()
+
+    def submit(self, fn: Callable, *args):
+        """-> a waitable holder; .result() re-raises task exceptions."""
+        done = threading.Event()
+        box: dict[str, Any] = {}
+
+        def run():
+            try:
+                box["value"] = fn(*args)
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                done.set()
+        self.execute(run)
+
+        class _F:
+            def result(self, timeout: float | None = None):
+                if not done.wait(timeout):
+                    raise TimeoutError(f"task on [{_pool.name}] timed out")
+                if "error" in box:
+                    raise box["error"]
+                return box.get("value")
+        _pool = self
+        return _F()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"threads": self.size, "queue": self._q.qsize(),
+                    "queue_size": self.queue_size or -1,
+                    "active": self.active, "rejected": self.rejected,
+                    "largest": self.largest_queue,
+                    "completed": self.completed}
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._lock:
+            n = self._started
+        for _ in range(n):
+            self._q.put(None)
+
+
+class ThreadPool:
+    """The per-node pool registry (ref ThreadPool.java — `executor(name)`).
+
+    Settings may override sizes: `threadpool.<name>.size` /
+    `threadpool.<name>.queue_size` (the reference's dynamic threadpool
+    settings; here applied at construction)."""
+
+    def __init__(self, settings: dict | None = None):
+        self.pools: dict[str, _Pool] = {}
+        settings = settings or {}
+        for name, (threads, qsize) in DEFAULT_POOLS.items():
+            threads = int(settings.get(f"threadpool.{name}.size", threads))
+            q = settings.get(f"threadpool.{name}.queue_size", qsize)
+            q = None if q in (None, -1, "-1") else int(q)
+            self.pools[name] = _Pool(name, threads, q)
+
+    def executor(self, name: str) -> _Pool:
+        return self.pools[name]
+
+    def execute(self, name: str, fn: Callable, *args) -> None:
+        self.pools[name].execute(fn, *args)
+
+    def submit(self, name: str, fn: Callable, *args):
+        return self.pools[name].submit(fn, *args)
+
+    def stats(self) -> dict:
+        return {name: p.stats() for name, p in sorted(self.pools.items())}
+
+    def shutdown(self) -> None:
+        for p in self.pools.values():
+            p.shutdown()

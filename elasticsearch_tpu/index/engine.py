@@ -139,6 +139,8 @@ class Engine:
         checksum (ref index/store/Store.java recovery verification)."""
         segments, tombstones = self.store.load()
         self.segments = segments
+        for s in segments:
+            s.breaker = self.breaker    # fielddata loads charge it too
         if self.breaker is not None:
             # recovery loads regardless of pressure (unbreakable add) —
             # refusing to boot would lose availability, not memory
@@ -386,6 +388,7 @@ class Engine:
                     self.breaker.release(-drift)
             self._blocked_reason = None
             self._next_seg_id += 1
+            seg.breaker = self.breaker
             self.segments.append(seg)
             self._buffer_docs.clear()
             self.refresh_count += 1
@@ -416,6 +419,7 @@ class Engine:
         for s in self.segments:
             if id(s) in chosen:
                 if not placed and merged.n_docs:
+                    merged.breaker = self.breaker
                     out.append(merged)
                     placed = True
             else:
@@ -434,6 +438,7 @@ class Engine:
             merged = merge_segments(self.segments, self._next_seg_id)
             self._charge_merge(merged, self.segments)
             self._next_seg_id += 1
+            merged.breaker = self.breaker
             self.segments = [merged] if merged.n_docs else []
             self.merge_count += 1
 
@@ -447,6 +452,9 @@ class Engine:
         if merged.n_docs:
             self.breaker.add_estimate(merged.memory_bytes(), check=False)
         self.breaker.release(sum(s.memory_bytes() for s in sources))
+        # loaded fielddata dies with its source segments
+        self.breaker.release(sum(sum(s.fielddata_bytes().values())
+                                 for s in sources))
 
     def flush(self) -> None:
         """Commit: write NEW segment files + the checksummed commit point,
@@ -490,5 +498,7 @@ class Engine:
     def close(self) -> None:
         if self.breaker is not None:
             self.breaker.release(sum(s.memory_bytes()
+                                     for s in self.segments))
+            self.breaker.release(sum(sum(s.fielddata_bytes().values())
                                      for s in self.segments))
         self.translog.close()

@@ -277,6 +277,58 @@ class Segment:
             return 0.0, 0
         return fx.sum_dl, self.n_docs
 
+    def text_fielddata(self, field: str):
+        """Lazily-built fielddata for sorting an ANALYZED text field:
+        per-doc min/max term ordinal (Lucene's uninverted fielddata +
+        MultiValueMode MIN/MAX; ref index/fielddata/plain/
+        PagedBytesIndexFieldData.java — loaded on first sort, cached, and
+        reported by `_cat/fielddata`).
+
+        -> (min_ords i64[n_pad], max_ords i64[n_pad], missing bool[n_pad],
+            vocab list[str], nbytes) or None if the field has no postings.
+        """
+        cache = getattr(self, "_fielddata", None)
+        if cache is None:
+            cache = self._fielddata = {}
+        fd = cache.get(field)
+        if fd is not None:
+            return fd
+        fx = self.text.get(field)
+        if fx is None:
+            return None
+        breaker = getattr(self, "breaker", None)
+        if breaker is not None:
+            # admission control BEFORE building: loading fielddata under
+            # memory pressure 429s cleanly (ref fielddata breaker in
+            # HierarchyCircuitBreakerService)
+            breaker.add_estimate(self.n_pad * 17)
+        V = len(fx.terms)
+        lens = np.asarray(fx.term_lens[:V], np.int64)
+        starts = np.asarray(fx.term_starts[:V], np.int64)
+        docs_host = fx.doc_ids_host if fx.doc_ids_host is not None \
+            else np.asarray(fx.doc_ids)
+        total = int(lens.sum())
+        # posting index per (term, occurrence): CSR starts + within offsets
+        off = np.arange(total, dtype=np.int64) \
+            - np.repeat(np.cumsum(lens) - lens, lens)
+        pos = np.repeat(starts, lens) + off
+        docs = np.asarray(docs_host, np.int64)[pos]
+        tids = np.repeat(np.arange(V, dtype=np.int64), lens)
+        mn = np.full(self.n_pad, V, np.int64)
+        np.minimum.at(mn, docs, tids)
+        mx = np.full(self.n_pad, -1, np.int64)
+        np.maximum.at(mx, docs, tids)
+        miss = mx < 0
+        fd = (mn, mx, miss, list(fx.terms),
+              mn.nbytes + mx.nbytes + miss.nbytes)
+        cache[field] = fd
+        return fd
+
+    def fielddata_bytes(self) -> dict[str, int]:
+        """field -> loaded fielddata bytes (empty until a sort loads it)."""
+        return {f: fd[4]
+                for f, fd in getattr(self, "_fielddata", {}).items()}
+
     def memory_bytes(self) -> int:
         total = 0
         for fx in self.text.values():

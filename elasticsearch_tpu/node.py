@@ -125,6 +125,10 @@ class NodeService:
         from .common.metrics import PhaseTimers, SlowLog
         self.phase_timers = PhaseTimers()
         self.slowlog = SlowLog()
+        # named bounded executors (ref ThreadPool.java:116); the HTTP layer
+        # routes each request class through its pool, overflow -> 429
+        from .common.threadpool import ThreadPool
+        self.thread_pool = ThreadPool()
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
         tpl_path = os.path.join(data_path, "_templates.json")
@@ -639,10 +643,14 @@ class NodeService:
         reduced = controller.sort_docs(results, from_=from_, size=size,
                                        sort=sort)
         src_filter = body.get("_source")
+        fields_spec = body.get("fields")
+        if isinstance(fields_spec, str):
+            fields_spec = [fields_spec]
         hits = controller.fetch_and_merge(
             reduced, searchers,
             source_filter=(lambda s: _source_filter(s, src_filter))
-            if src_filter is not None else None)
+            if src_filter is not None else None,
+            fields_spec=fields_spec)
         for slot, h in enumerate(hits):
             h["_index"] = index_of[reduced.shard_order[slot]]
 
@@ -1385,10 +1393,14 @@ class NodeService:
             reduced = controller.sort_docs(results, from_=from_, size=size,
                                            query_row=qi)
             src_filter = body.get("_source")
+            fields_spec = body.get("fields")
+            if isinstance(fields_spec, str):
+                fields_spec = [fields_spec]
             hits = controller.fetch_and_merge(
                 reduced, searchers,
                 source_filter=(lambda s: _source_filter(s, src_filter))
-                if src_filter is not None else None)
+                if src_filter is not None else None,
+                fields_spec=fields_spec)
             for slot, h in enumerate(hits):
                 h["_index"] = index_of[reduced.shard_order[slot]]
             out = {
@@ -1482,6 +1494,7 @@ class NodeService:
                    "nodes": nodes_by_index, "specs": specs, "stats": stats,
                    "cursor": None, "implicit_sort": implicit,
                    "source": body.get("_source"),
+                   "fields": body.get("fields"),
                    "aggs": body.get("aggs") or body.get("aggregations"),
                    "expiry": time.monotonic() + _duration_secs(keep_alive),
                    "keep_alive": keep_alive, "lock": threading.Lock()}
@@ -1535,10 +1548,14 @@ class NodeService:
             reduced = controller.sort_docs(results, from_=0, size=size,
                                            sort=ctx["specs"])
             src_filter = ctx["source"]
+            fields_spec = ctx.get("fields")
+            if isinstance(fields_spec, str):
+                fields_spec = [fields_spec]
             hits = controller.fetch_and_merge(
                 reduced, searchers,
                 source_filter=(lambda s: _source_filter(s, src_filter))
-                if src_filter is not None else None)
+                if src_filter is not None else None,
+                fields_spec=fields_spec)
             for slot, h in enumerate(hits):
                 h["_index"] = ctx["index_of"][reduced.shard_order[slot]]
             if hits:
@@ -1674,6 +1691,7 @@ class NodeService:
     def close(self) -> None:
         for svc in self.indices.values():
             svc.close()
+        self.thread_pool.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -1713,24 +1731,15 @@ def _deep_merge(base: dict, patch: dict) -> dict:
     return out
 
 
-def _source_filter(src: dict, spec) -> dict | bool:
-    import fnmatch as fn
+def _source_filter(src: dict, spec) -> dict | None:
+    """None = omit the _source key entirely (the `_source: false` contract —
+    the reference drops the field, it does not send an empty object)."""
     if spec is False:
-        return {}
+        return None
     if spec is True or spec is None:
         return src
-    if isinstance(spec, str):
-        spec = [spec]
-    if isinstance(spec, list):
-        return {k: v for k, v in src.items()
-                if any(fn.fnmatch(k, p) for p in spec)}
-    includes = spec.get("includes", spec.get("include"))
-    excludes = spec.get("excludes", spec.get("exclude")) or []
-    out = {}
-    for k, v in src.items():
-        if includes is not None and not any(fn.fnmatch(k, p) for p in includes):
-            continue
-        if any(fn.fnmatch(k, p) for p in excludes):
-            continue
-        out[k] = v
-    return out
+    # path-aware include/exclude over FLATTENED source paths, so
+    # "include.field1" style dotted patterns reach nested objects
+    # (ref search/fetch/source/FetchSourceSubPhase)
+    from .search.shard_searcher import _filter_source
+    return _filter_source(src, spec)

@@ -55,10 +55,11 @@ def render(p: dict, columns: list[tuple[str, str]], rows: list[dict],
         out.append(" ".join(n.ljust(w) for n, w in zip(names, widths))
                    .rstrip() + " \n")
     for row in data:
-        out.append(" ".join(
-            (v.rjust(w) if num[i] else v.ljust(w))
-            for i, (v, w) in enumerate(zip(row, widths)))
-            .rstrip() + " \n")
+        # pad through the LAST column too (RestTable pads trailing cells, and
+        # the suites' regexes require `\s+` separators around empty values)
+        line = " ".join((v.rjust(w) if num[i] else v.ljust(w))
+                        for i, (v, w) in enumerate(zip(row, widths)))
+        out.append((line.rstrip() if row and row[-1] else line) + " \n")
     return "".join(out)
 
 

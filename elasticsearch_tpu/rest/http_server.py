@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import fnmatch
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -37,9 +38,10 @@ class RestError(Exception):
 
 def _status_of(e: Exception) -> int:
     from ..common.breaker import CircuitBreakingException
+    from ..common.threadpool import EsRejectedExecutionException
     if isinstance(e, RestError):
         return e.status
-    if isinstance(e, CircuitBreakingException):
+    if isinstance(e, (CircuitBreakingException, EsRejectedExecutionException)):
         return 429     # TOO_MANY_REQUESTS, ref EsRejectedExecutionException
     from ..snapshots import (RepositoryException, SnapshotException,
                              SnapshotMissingException)
@@ -137,6 +139,12 @@ def _json_body(body: bytes) -> dict:
 
 
 def _register_routes(c: RestController, node: NodeService) -> None:
+    def _resolve_lenient(expr, p):
+        return _resolve_lenient_impl(node, expr, p)
+
+    def _expand_indices(expr, p):
+        return _expand_indices_impl(node, expr, p)
+
     # -- cluster / node level ---------------------------------------------
     def root(g, p, b):
         return 200, {"status": 200, "name": "tpu-node-0",
@@ -153,6 +161,10 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                lambda g, p, b: (200, node.cluster_health()))
 
     def put_template(g, p, b):
+        if _pbool(p, "create", False) and g["name"] in node.templates:
+            raise RestError(400, f"IndexTemplateAlreadyExistsException: "
+                                 f"index_template [{g['name']}] already "
+                                 f"exists")
         node.put_template(g["name"], _json_body(b))
         return 200, {"acknowledged": True}
     c.register("PUT", "/_template/{name}", put_template)
@@ -198,6 +210,22 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                 else:
                     clauses.append(part)
             body["sort"] = clauses
+        # URL _source/_source_include/_source_exclude override the body spec
+        # (ref RestSearchAction.parseSearchSource fetchSource handling)
+        s = p.get("_source", [None])[0]
+        if s is not None:
+            body["_source"] = False if s == "false" else \
+                (True if s == "true" else s.split(","))
+        inc = p.get("_source_include", p.get("_source_includes", [None]))[0]
+        exc = p.get("_source_exclude", p.get("_source_excludes", [None]))[0]
+        if inc or exc:
+            # combine with any ?_source= list into ONE fetch-source context
+            cur = body.get("_source")
+            inc_l = inc.split(",") if inc else \
+                (cur if isinstance(cur, list)
+                 else [cur] if isinstance(cur, str) else None)
+            body["_source"] = {"include": inc_l,
+                               "exclude": exc.split(",") if exc else None}
         scroll = p.get("scroll", [None])[0]
         scan = p.get("search_type", [None])[0] == "scan"
         return 200, node.search(g.get("index", "_all"), body, scroll=scroll,
@@ -318,6 +346,14 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     # -- search templates (ref RestSearchTemplateAction + script store) ----
     def put_search_template(g, p, b):
         body = _json_body(b)
+        tpl = body.get("template", body)
+        compact = tpl if isinstance(tpl, str) \
+            else json.dumps(tpl, separators=(",", ":"))
+        if re.search(r"\{\{\s*\}\}", compact):
+            # empty mustache variable — the reference's compile-time reject
+            raise RestError(
+                400, "ElasticsearchIllegalArgumentException[Unable to parse "
+                     "template: empty mustache variable]")
         created = g["id"] not in node.search_templates
         node.search_templates[g["id"]] = body.get("template", body)
         node._persist_search_templates()
@@ -342,9 +378,12 @@ def _register_routes(c: RestController, node: NodeService) -> None:
 
     def delete_search_template(g, p, b):
         if node.search_templates.pop(g["id"], None) is None:
-            return 404, {"_id": g["id"], "found": False}
+            return 404, {"_index": ".scripts", "_type": "mustache",
+                         "_id": g["id"], "found": False}
         node._persist_search_templates()
-        return 200, {"_id": g["id"], "found": True, "acknowledged": True}
+        return 200, {"_index": ".scripts", "_type": "mustache",
+                     "_id": g["id"], "_version": 2, "found": True,
+                     "acknowledged": True}
     c.register("DELETE", "/_search/template/{id}", delete_search_template)
 
     def search_template(g, p, b):
@@ -393,9 +432,13 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     # -- admin per index ---------------------------------------------------
     def create_index(g, p, b):
         body = _json_body(b)
-        node.create_index(g["index"], settings=body.get("settings"),
-                          mappings=body.get("mappings"),
-                          aliases=body.get("aliases"))
+        svc = node.create_index(g["index"], settings=body.get("settings"),
+                                mappings=body.get("mappings"),
+                                aliases=body.get("aliases"))
+        if body.get("warmers"):
+            svc.warmers = {w: {"types": spec.get("types", []),
+                               "source": spec.get("source", {})}
+                           for w, spec in body["warmers"].items()}
         return 200, {"acknowledged": True}
     c.register("PUT", "/{index}", create_index)
     c.register("POST", "/{index}", create_index)
@@ -439,7 +482,8 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         tpat = g.get("type")
         out = {}
         found_type = False
-        for n in node._resolve(g.get("index", "_all")):
+        opens, closeds = _expand_indices(g.get("index", "_all"), p)
+        for n in opens:
             md = node.indices[n].mappings_dict()
             if tpat and tpat not in ("_all", "*"):
                 md = {t: m for t, m in md.items()
@@ -448,9 +492,12 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             if md:
                 found_type = True
             out[n] = {"mappings": md}
+        for n in closeds:
+            if n not in out:
+                out[n] = {"mappings": node.closed[n].get("mappings") or {}}
+                found_type = True
         if tpat and tpat not in ("_all", "*") and not found_type:
-            return 404, {"error": f"TypeMissingException: type[[{tpat}]] "
-                                  "missing", "status": 404}
+            return 200, {}     # no matching type: empty body, HTTP 200
         return 200, out
     c.register("GET", "/{index}/_mapping", get_mapping)
     c.register("GET", "/_mapping", get_mapping)
@@ -469,10 +516,15 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("HEAD", "/{index}/{type}", head_type)
 
     def field_mapping(g, p, b):
-        """GET field mappings (ref indices.get_field_mapping spec)."""
+        """GET field mappings (ref indices.get_field_mapping spec +
+        TransportGetFieldMappingsAction: full-path patterns key by full
+        path, leaf-relative patterns key by leaf name; empty result = {};
+        unknown explicit type = TypeMissingException 404)."""
         fields = g.get("field", "*").split(",")
         tpat = g.get("type")
+        include_defaults = _pbool(p, "include_defaults", False)
         out = {}
+        matched_type = False
         for n in node._resolve(g.get("index", "_all")):
             svc = node.indices[n]
             tmap = {}
@@ -481,17 +533,35 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                         and not any(fnmatch.fnmatch(t, pp)
                                     for pp in tpat.split(",")):
                     continue
+                matched_type = True
                 dm = svc.mappers.document_mapper(t, create=False)
                 fmap = {}
-                for path, ft in dm.fields.items():
-                    if any(fnmatch.fnmatch(path, f)
-                           or path.split(".")[-1] == f for f in fields):
-                        fmap[path] = {"full_name": path,
-                                      "mapping": {path.split(".")[-1]:
-                                                  ft.to_dict()}}
+                for f in fields:
+                    # full-name matches win; ONLY if a pattern matches no
+                    # full name does it fall back to leaf (index-name)
+                    # matching, keyed by the leaf-relative name
+                    hits = [(path, path) for path in dm.fields
+                            if fnmatch.fnmatch(path, f)]
+                    if not hits:
+                        hits = [(path.split(".")[-1], path)
+                                for path in dm.fields
+                                if fnmatch.fnmatch(path.split(".")[-1], f)]
+                    for key, path in hits:
+                        ft = dm.fields[path]
+                        d = ft.to_dict()
+                        if include_defaults and d.get("type") == "string" \
+                                and "analyzer" not in d \
+                                and d.get("index") != "not_analyzed":
+                            d = {**d, "analyzer": "default"}
+                        fmap[key] = {"full_name": path,
+                                     "mapping": {path.split(".")[-1]: d}}
                 if fmap:
                     tmap[t] = fmap
-            out[n] = {"mappings": tmap}
+            if tmap:
+                out[n] = {"mappings": tmap}
+        if tpat and tpat not in ("_all", "*") and not matched_type:
+            return 404, {"error": f"TypeMissingException: "
+                                  f"type[[{tpat}]] missing", "status": 404}
         return 200, out
     c.register("GET", "/_mapping/field/{field}", field_mapping)
     c.register("GET", "/{index}/_mapping/field/{field}", field_mapping)
@@ -776,6 +846,19 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         url_fields = p.get("fields", [None])[0]
         if url_fields is not None:
             url_fields = url_fields.split(",")
+        # URL-level _source / _source_include / _source_exclude apply to
+        # every doc that doesn't carry its own spec (ref RestMultiGetAction
+        # defaultFetchSource)
+        url_spec = None
+        s = p.get("_source", [None])[0]
+        if s is not None:
+            url_spec = False if s == "false" else \
+                (True if s == "true" else s.split(","))
+        inc = p.get("_source_include", p.get("_source_includes", [None]))[0]
+        exc = p.get("_source_exclude", p.get("_source_excludes", [None]))[0]
+        if inc or exc:
+            url_spec = {"include": inc.split(",") if inc else None,
+                        "exclude": exc.split(",") if exc else None}
         default_type = g.get("type")
         docs = []
         for d in items:
@@ -841,7 +924,7 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                         entry["_source"] = res.source
                 else:
                     src = res.source
-                    spec = d.get("_source")
+                    spec = d["_source"] if "_source" in d else url_spec
                     if spec is not None:
                         if spec is False:
                             src = None
@@ -981,6 +1064,63 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     _register_indices_routes(c, node)
 
 
+def _resolve_lenient_impl(node, expr, p) -> list[str]:
+    """IndicesOptions handling at the REST seam: ignore_unavailable skips
+    missing concrete names; whitespace in comma lists is trimmed
+    (ref action/support/IndicesOptions)."""
+    iu = _pbool(p, "ignore_unavailable", False)
+    out: list[str] = []
+    expr = str(expr or "_all")
+    for part in expr.split(","):
+        part = part.strip()
+        try:
+            out.extend(n for n in node._resolve(part) if n not in out)
+        except IndexMissingException:
+            if not iu:
+                raise
+    if not out and not _pbool(p, "allow_no_indices", True) \
+            and ("*" in expr or expr == "_all"):
+        raise IndexMissingException(expr)
+    return out
+
+
+def _expand_indices_impl(node, expr, p) -> tuple[list[str], list[str]]:
+    """-> (open_names, closed_names) honoring expand_wildcards
+    (open/closed/all/none; ref IndicesOptions.fromRequest)."""
+    ew = (p.get("expand_wildcards", ["open"])[0] or "open").split(",")
+    if "all" in ew:
+        ew = ["open", "closed"]
+    expr = str(expr or "_all")
+    parts = [x.strip() for x in expr.split(",")]
+    if "none" in ew:
+        return ([x for x in parts if x in node.indices],
+                [x for x in parts if x in node.closed])
+    opens = []
+    closeds = []
+    for part in parts:
+        if part in node.closed:
+            # expand_wildcards governs WILDCARD expansion only; a closed
+            # index named concretely always resolves (IndicesOptions)
+            if part not in closeds:
+                closeds.append(part)
+            continue
+        if "open" in ew:
+            try:
+                opens.extend(n for n in _resolve_lenient_impl(node, part, p)
+                             if n not in opens)
+            except IndexClosedException:      # closed reached via alias
+                pass
+        elif part in node.indices:
+            opens.append(part)
+    if "closed" in ew:
+        closeds.extend(
+            n for n in node.closed
+            if n not in closeds and any(fnmatch.fnmatch(n, x)
+                                        or x in ("_all", "*")
+                                        for x in parts))
+    return opens, closeds
+
+
 def _flat_settings(svc) -> dict:
     """Flat 'index.'-prefixed settings map with the implicit defaults the
     reference always reports (ref RestGetSettingsAction string rendering)."""
@@ -1024,6 +1164,10 @@ def _write_shards(node: NodeService, index: str) -> dict:
 
 def _source_filter_paths(src: dict, includes, excludes) -> dict:
     from ..search.shard_searcher import _filter_source
+    if isinstance(includes, str):
+        includes = [includes]
+    if isinstance(excludes, str):
+        excludes = [excludes]
     spec: dict = {}
     if includes:
         spec["includes"] = [p if "*" in p else p + "*" for p in includes] \
@@ -1037,6 +1181,12 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     """Admin/index APIs beyond the core CRUD set (alias CRUD, templates,
     settings, validate, segments, stats, cluster info) — the breadth the
     rest-api-spec YAML suites exercise (ref rest/action/admin/)."""
+
+    def _resolve_lenient(expr, p):
+        return _resolve_lenient_impl(node, expr, p)
+
+    def _expand_indices(expr, p):
+        return _expand_indices_impl(node, expr, p)
 
     # -- GET method variants the specs allow -------------------------------
     def refresh(g, p, b):
@@ -1132,9 +1282,24 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                      for n, al in amap.items()
                      if al or not g.get("name")}
     for pat in ("/_alias", "/_alias/{name}", "/{index}/_alias",
-                "/{index}/_alias/{name}", "/_aliases", "/_aliases/{name}",
-                "/{index}/_aliases", "/{index}/_aliases/{name}"):
+                "/{index}/_alias/{name}"):
         c.register("GET", pat, get_alias)
+
+    def get_aliases_old(g, p, b):
+        # the legacy `_aliases` GET contract: matching indices always
+        # appear, each with its (possibly empty) aliases map, HTTP 200 —
+        # no 404 for a missing alias (ref RestGetAliasesAction vs
+        # RestGetIndicesAliasesAction)
+        amap = _alias_map(g.get("index"), g.get("name"))
+        def render_props(n, a):
+            props = node.indices[n].aliases.get(a, {})
+            return {k: v for k, v in props.items()
+                    if k in ("filter", "index_routing", "search_routing")}
+        return 200, {n: {"aliases": {a: render_props(n, a) for a in al}}
+                     for n, al in amap.items()}
+    for pat in ("/_aliases", "/_aliases/{name}", "/{index}/_aliases",
+                "/{index}/_aliases/{name}"):
+        c.register("GET", pat, get_aliases_old)
 
     def head_alias(g, p, b):
         amap = _alias_map(g.get("index"), g.get("name"))
@@ -1166,11 +1331,28 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("POST", "/_aliases", update_aliases)
 
     # -- templates ---------------------------------------------------------
+    def _tpl_render(tpl: dict, flat: bool) -> dict:
+        # settings render in the normalized index.* string form, nested by
+        # default / flat with flat_settings (ref MetaDataIndexTemplateService
+        # -> RestGetIndexTemplateAction settings serialization)
+        out = dict(tpl)
+        f = {}
+        for k, v in (tpl.get("settings") or {}).items():
+            key = k if k.startswith("index.") else f"index.{k}"
+            f[key] = str(v)
+        out["settings"] = f if flat else _nest_flat(f)
+        if tpl.get("aliases"):
+            from ..node import alias_dict
+            out["aliases"] = alias_dict(tpl["aliases"])
+        return out
+
     def get_template(g, p, b):
         name = g.get("name")
+        flat = p.get("flat_settings", ["false"])[0] == "true"
         if name is None:
-            return 200, dict(node.templates)
-        out = {t: v for t, v in node.templates.items()
+            return 200, {t: _tpl_render(v, flat)
+                         for t, v in node.templates.items()}
+        out = {t: _tpl_render(v, flat) for t, v in node.templates.items()
                if any(fnmatch.fnmatch(t, pat) for pat in name.split(","))}
         if not out and "*" not in name:
             return 404, {"error": f"template [{name}] missing",
@@ -1199,26 +1381,78 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                    else 404), {}))
 
     # -- indices.get / settings -------------------------------------------
+    _GET_FEATURES = {"_settings": "settings", "_mappings": "mappings",
+                     "_mapping": "mappings", "_warmers": "warmers",
+                     "_warmer": "warmers", "_aliases": "aliases",
+                     "_alias": "aliases"}
+
     def get_index(g, p, b):
         flat = p.get("flat_settings", ["false"])[0] == "true"
+        feats = None
+        if g.get("feature"):
+            feats = []
+            for f in g["feature"].split(","):
+                if f not in _GET_FEATURES:
+                    raise RestError(
+                        400, f"no handler for [GET /{g['index']}/{f}]")
+                feats.append(_GET_FEATURES[f])
         out = {}
-        for n in node._resolve(g["index"]):
+        opens, closeds = _expand_indices(g["index"], p)
+        for n in opens:
             svc = node.indices[n]
-            out[n] = {"aliases": {a: svc.aliases[a]
-                                  for a in sorted(svc.aliases)},
-                      "mappings": svc.mappings_dict(),
-                      "settings": _render_settings(svc, flat),
-                      "warmers": {}}
+            sections = {"aliases": {a: svc.aliases[a]
+                                    for a in sorted(svc.aliases)},
+                        "mappings": svc.mappings_dict(),
+                        "settings": _render_settings(svc, flat),
+                        "warmers": getattr(svc, "warmers", {})}
+            out[n] = sections if feats is None \
+                else {k: v for k, v in sections.items() if k in feats}
+        for n in closeds:
+            if n in out:
+                continue
+            meta = node.closed[n]
+            f = {f"index.{k}" if not k.startswith("index.") else k: str(v)
+                 for k, v in (meta.get("settings") or {}).items()}
+            f.setdefault("index.number_of_shards", "1")
+            f.setdefault("index.number_of_replicas", "0")
+            sections = {"aliases": meta.get("aliases") or {},
+                        "mappings": meta.get("mappings") or {},
+                        "settings": f if flat else _nest_flat(f),
+                        "warmers": {}}
+            out[n] = sections if feats is None \
+                else {k: v for k, v in sections.items() if k in feats}
         return 200, out
     c.register("GET", "/{index}", get_index)
+    c.register("GET", "/{index}/{feature}", get_index)
 
     def get_settings(g, p, b):
         flat = p.get("flat_settings", ["false"])[0] == "true"
+        sel = g.get("setting") or p.get("name", [None])[0]
+        if sel in ("_all", "*"):
+            sel = None
         out = {}
-        for n in node._resolve(g.get("index", "_all")):
-            out[n] = {"settings": _render_settings(node.indices[n], flat)}
+        opens, closeds = _expand_indices(g.get("index", "_all"), p)
+        flats = [(n, _flat_settings(node.indices[n])) for n in opens]
+        for n in closeds:
+            if any(n == m for m, _ in flats):
+                continue
+            meta = node.closed[n]
+            f = {k if k.startswith("index.") else f"index.{k}": str(v)
+                 for k, v in (meta.get("settings") or {}).items()}
+            f.setdefault("index.number_of_shards", "1")
+            f.setdefault("index.number_of_replicas", "0")
+            flats.append((n, f))
+        for n, f in flats:
+            if sel:
+                pats = sel.split(",")
+                f = {k: v for k, v in f.items()
+                     if any(fnmatch.fnmatch(k, pat)
+                            or fnmatch.fnmatch(k[6:], pat)
+                            for pat in pats)}
+            out[n] = {"settings": f if flat else _nest_flat(f)}
         return 200, out
     c.register("GET", "/_settings", get_settings)
+    c.register("GET", "/_settings/{setting}", get_settings)
     c.register("GET", "/{index}/_settings", get_settings)
     c.register("GET", "/{index}/_settings/{setting}", get_settings)
 
@@ -1257,7 +1491,7 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 raise RestError(
                     400, f"IllegalArgumentException: can't update non "
                          f"dynamic settings [[{k}]] for open indices")
-        for n in node._resolve(g.get("index", "_all")):
+        for n in _resolve_lenient(g.get("index", "_all"), p):
             svc = node.indices[n]
             data = dict(svc.settings)
             for k, v in flat.items():
@@ -1514,6 +1748,63 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/_cat/shards", cat_shards)
     c.register("GET", "/_cat/shards/{index}", cat_shards)
 
+    # every pool name the reference's table shows (ThreadPool.Names); pools
+    # this build doesn't run report zeros with their reference pool type
+    _TP_ALL = ["bulk", "flush", "generic", "get", "index", "management",
+               "optimize", "percolate", "refresh", "search", "snapshot",
+               "suggest", "warmer"]
+    _TP_TYPE = {"bulk": "fixed", "index": "fixed", "search": "fixed",
+                "get": "fixed", "percolate": "fixed", "suggest": "fixed",
+                "generic": "cached", "management": "scaling",
+                "flush": "scaling", "optimize": "scaling",
+                "refresh": "scaling", "snapshot": "scaling",
+                "warmer": "scaling"}
+    _TP_ALIAS = {"h": "host", "i": "ip", "po": "port", "p": "pid",
+                 "ba": "bulk.active", "fa": "flush.active",
+                 "gea": "generic.active", "ga": "get.active",
+                 "ia": "index.active", "maa": "management.active",
+                 "oa": "optimize.active", "pa": "percolate.active",
+                 "ra": "refresh.active", "sa": "search.active",
+                 "sna": "snapshot.active", "sua": "suggest.active",
+                 "wa": "warmer.active"}
+
+    def cat_thread_pool(g, p, b):
+        # ref rest/action/cat/RestThreadPoolAction.java:108-150 — one row
+        # per node; default columns host/ip + bulk/index/search gauges
+        st = node.thread_pool.stats()
+        full = p.get("full_id", ["false"])[0] == "true"
+        row = {"id": "tpu-node-0" if full else "tpu0",
+               "pid": os.getpid(), "host": "localhost",
+               "ip": "127.0.0.1", "port": 9300}
+        cols = [("id", "unique node id"), ("pid", "process id"),
+                ("host", "host name"), ("ip", "ip address"),
+                ("port", "bound transport port")]
+        for name in _TP_ALL:
+            s = st.get(name)
+            typ = _TP_TYPE[name]
+            row[f"{name}.type"] = typ
+            row[f"{name}.active"] = s["active"] if s else 0
+            row[f"{name}.size"] = s["threads"] if s else 0
+            row[f"{name}.queue"] = s["queue"] if s else 0
+            row[f"{name}.queueSize"] = (s["queue_size"] if s
+                                        and s["queue_size"] > 0 else "")
+            row[f"{name}.rejected"] = s["rejected"] if s else 0
+            row[f"{name}.largest"] = s["largest"] if s else 0
+            row[f"{name}.completed"] = s["completed"] if s else 0
+            row[f"{name}.min"] = s["threads"] if s and typ == "fixed" else ""
+            row[f"{name}.max"] = s["threads"] if s and typ == "fixed" else ""
+            row[f"{name}.keepAlive"] = "" if typ == "fixed" else "5m"
+            for col in ("type", "active", "size", "queue", "queueSize",
+                        "rejected", "largest", "completed", "min", "max",
+                        "keepAlive"):
+                cols.append((f"{name}.{col}", f"{name} pool {col}"))
+        defaults = ["host", "ip"] + [f"{n}.{c}"
+                                     for n in ("bulk", "index", "search")
+                                     for c in ("active", "queue", "rejected")]
+        return 200, _cat.render(p, cols, [row], defaults=defaults,
+                                aliases=_TP_ALIAS)
+    c.register("GET", "/_cat/thread_pool", cat_thread_pool)
+
     def cat_segments(g, p, b):
         rows = []
         for n in sorted(node._resolve(g.get("index", "_all"))):
@@ -1620,12 +1911,29 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/_cat/allocation/{node_id}", cat_allocation)
 
     def cat_fielddata(g, p, b):
-        used = node.breakers.breaker("fielddata").used
-        return 200, _cat.render(p, [
-            ("id", "node id"), ("host", "host name"), ("ip", "ip address"),
-            ("node", "node name"), ("total", "total field data usage")],
-            [{"id": "tpu0", "host": "localhost", "ip": "127.0.0.1",
-              "node": "tpu-node-0", "total": _cat.human_bytes(used)}])
+        # loaded per-field fielddata bytes across every segment (ref
+        # rest/action/cat/RestFielddataAction.java — one column per field)
+        per_field: dict[str, int] = {}
+        for svc in node.indices.values():
+            for e in svc.shards:
+                for seg in e.segments:
+                    for f, nb in seg.fielddata_bytes().items():
+                        per_field[f] = per_field.get(f, 0) + nb
+        fsel = g.get("fields") or ",".join(p.get("fields", []))
+        if fsel:
+            want = fsel.split(",")
+            per_field = {f: nb for f, nb in per_field.items() if f in want}
+        cols = [("id", "node id"), ("host", "host name"),
+                ("ip", "ip address"), ("node", "node name"),
+                ("total", "total field data usage")]
+        row = {"id": "tpu0", "host": "localhost", "ip": "127.0.0.1",
+               "node": "tpu-node-0",
+               "total": _cat.human_bytes(sum(per_field.values()))}
+        if p.get("help", ["false"])[0] in ("false", None):
+            for f in sorted(per_field):
+                cols.append((f, f"field data usage of [{f}]"))
+                row[f] = _cat.human_bytes(per_field[f])
+        return 200, _cat.render(p, cols, [row])
     c.register("GET", "/_cat/fielddata", cat_fielddata)
     c.register("GET", "/_cat/fielddata/{fields}", cat_fielddata)
 
@@ -1761,7 +2069,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                            "transport_address": "local[1]",
                            "http_address": "127.0.0.1:9200",
                            "build": "tensor-native",
-                           "os": {}, "jvm": {}, "transport": {},
+                           "os": {}, "jvm": {},
+                           "transport": {"profiles": {}},
                            "http": {}, "plugins": []}}}
     c.register("GET", "/_nodes", nodes_info)
     c.register("GET", "/_nodes/{metric}", nodes_info)
@@ -1777,6 +2086,7 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                                s.doc_count()
                                for s in node.indices.values())}},
                            "breakers": node.breakers.stats(),
+                           "thread_pool": node.thread_pool.stats(),
                            "search_phases": node.phase_timers.stats(),
                            "slowlog_tail": node.slowlog.snapshot(),
                            "search_batcher": node._batcher.stats()}}}
@@ -1804,11 +2114,14 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
         for n in node._resolve(g.get("index", "_all")):
             svc = node.indices[n]
             wm = getattr(svc, "warmers", {})
-            if name and name not in ("_all", "*"):
+            if name:
+                pats = ["*" if x == "_all" else x for x in name.split(",")]
                 wm = {w: s for w, s in wm.items()
-                      if any(fnmatch.fnmatch(w, pat)
-                             for pat in name.split(","))}
-            if wm:
+                      if any(fnmatch.fnmatch(w, pat) for pat in pats)}
+                if wm:
+                    out[n] = {"warmers": wm}
+            else:
+                # unfiltered listing shows every index, empty map included
                 out[n] = {"warmers": wm}
         return 200, out
     for pat in ("/_warmer", "/_warmer/{name}", "/{index}/_warmer",
@@ -1863,6 +2176,31 @@ def _parse_bulk(body: bytes, default_index: str | None) -> list:
 
 # ---------------------------------------------------------------------------
 
+def _pool_of(method: str, path: str) -> str | None:
+    """Which named thread pool serves this request class (ref
+    ThreadPool.Names mapping in each TransportAction's executor()); None =
+    run inline on the connection thread (management/admin)."""
+    seg = [s for s in path.split("/") if s]
+    _SEARCH = {"_search", "_msearch", "_count", "_suggest", "_percolate",
+               "_mpercolate", "_count_percolate", "_explain", "_validate",
+               "_mlt", "_knn", "_termvectors", "_termvector",
+               "_mtermvectors", "_search_shards"}
+    if any(s in _SEARCH for s in seg):
+        return "search"
+    if "_bulk" in seg:
+        return "bulk"
+    if "_mget" in seg:
+        return "get"
+    if (len(seg) == 3 and not any(s.startswith("_") for s in seg[:2])):
+        if method in ("GET", "HEAD"):
+            return "get"
+        if method in ("PUT", "POST", "DELETE"):
+            return "index"
+    if len(seg) == 4 and seg[3] == "_update":
+        return "index"
+    return None
+
+
 class HttpServer:
     """Threaded HTTP front-end (ref http/HttpServer.java + netty transport)."""
 
@@ -1883,8 +2221,18 @@ class HttpServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 try:
-                    status, payload = controller.dispatch(
-                        method, parsed.path, params, body)
+                    # admission control: each request class runs on its
+                    # named bounded pool; queue overflow -> 429 before any
+                    # engine/device work (ref ThreadPool.java:116 +
+                    # EsRejectedExecutionException)
+                    pool = _pool_of(method, parsed.path)
+                    if pool is None:
+                        status, payload = controller.dispatch(
+                            method, parsed.path, params, body)
+                    else:
+                        status, payload = node.thread_pool.submit(
+                            pool, controller.dispatch,
+                            method, parsed.path, params, body).result()
                 except Exception as e:  # noqa: BLE001 — REST error contract
                     status = _status_of(e)
                     payload = {"error": f"{type(e).__name__}: {e}",

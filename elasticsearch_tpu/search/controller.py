@@ -72,7 +72,7 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
 
 
 def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
-                    source_filter=None) -> list[dict]:
+                    source_filter=None, fields_spec=None) -> list[dict]:
     """Fetch phase fan-out to winning shards only + final hit assembly
     (ref FetchPhase + SearchPhaseController.merge). `searchers` is aligned
     with the results list passed to sort_docs."""
@@ -100,9 +100,33 @@ def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
             "_type": h.type_name,
             "_id": h.doc_id,
             "_score": None if np.isnan(h.score) else float(h.score),
-            "_source": src,
         }
+        if fields_spec is not None:
+            # body `fields`: dot-path extraction from source, values as
+            # lists; _source omitted unless listed (ref
+            # search/fetch/fieldvisitor + FetchPhase stored-fields contract)
+            flds = {}
+            for f in fields_spec:
+                if f == "_source":
+                    continue
+                v = _path_get(h.source, f)
+                if v is not None:
+                    flds[f] = v if isinstance(v, list) else [v]
+            if flds:
+                entry["fields"] = flds
+            if "_source" not in fields_spec:
+                src = None
+        if src is not None:     # None = `_source: false` (key omitted)
+            entry["_source"] = src
         if reduced.sort_values is not None:
             entry["sort"] = h.sort_value
         out.append(entry)
     return out
+
+
+def _path_get(obj, path: str):
+    for part in path.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj

@@ -511,14 +511,24 @@ def _filter_source(src: dict, spec) -> dict:
                 out[path] = v
         return out
 
+    if isinstance(includes, str):
+        includes = [includes]
+    if isinstance(excludes, str):
+        excludes = [excludes]
+
+    def hit(path, pat):
+        # a pattern names a path OR a whole subtree ("include" matches
+        # "include.field1"), like the reference's XContentMapValues filter
+        return fnmatch.fnmatch(path, pat) or fnmatch.fnmatch(path, pat + ".*")
+
     flat = flatten(src)
     keep = {}
     for path, v in flat.items():
         ok = True
         if includes:
-            ok = any(fnmatch.fnmatch(path, pat) for pat in includes)
+            ok = any(hit(path, pat) for pat in includes)
         if ok and excludes:
-            ok = not any(fnmatch.fnmatch(path, pat) for pat in excludes)
+            ok = not any(hit(path, pat) for pat in excludes)
         if ok:
             keep[path] = v
     out: dict = {}

@@ -131,10 +131,8 @@ def _validate(sp: SortSpec, mappers) -> None:
     for ft in fts:
         if ft is None:
             continue
-        if ft.type == m.TEXT:
-            raise QueryParsingException(
-                f"can't sort on analyzed text field [{sp.field}]; sort on a "
-                f"not-analyzed sub-field (e.g. [{sp.field}.keyword]) instead")
+        # analyzed TEXT sorts via uninverted fielddata (min/max term per
+        # doc — Lucene MultiValueMode over fielddata; Segment.text_fielddata)
         if ft.type in (m.DENSE_VECTOR, m.OBJECT, m.GEO_POINT):
             raise QueryParsingException(
                 f"can't sort on field [{sp.field}] of type [{ft.type}]")
@@ -167,6 +165,12 @@ def _raw_key(seg, sp: SortSpec, scores, Q: int, seg_idx: int = 0,
     kc = seg.keywords.get(sp.field)
     if kc is not None:
         return kc.ords.astype(jnp.float64), kc.ords < 0
+    fd = seg.text_fielddata(sp.field)
+    if fd is not None:
+        mn, mx, miss, _, _ = fd
+        # MultiValueMode: asc compares each doc's MIN term, desc its MAX
+        ords = mn if sp.order == "asc" else mx
+        return jnp.asarray(ords, jnp.float64), jnp.asarray(miss)
     return (jnp.zeros((seg.n_pad,), jnp.float64),
             jnp.ones((seg.n_pad,), bool))
 
@@ -265,7 +269,7 @@ def _encode_cursor(seg, sp: SortSpec, cv) -> float:
         c = float(cv) * unit_meters(sp.geo_unit)  # cursor is in sort units
         return -c if sp.order == "desc" else c
     if sp.field not in (SCORE, DOC) and sp.field not in seg.numerics \
-            and sp.field not in seg.keywords:
+            and sp.field not in seg.keywords and sp.field not in seg.text:
         # the segment has no column for this field: every doc's key here is
         # the +/-_BIG missing fill, so any real cursor value compares as 0
         # (strictly between the fills) — never parse the cursor itself
@@ -278,6 +282,11 @@ def _encode_cursor(seg, sp: SortSpec, cv) -> float:
             c = float(pos)
         else:
             c = pos - 0.5   # between ordinals: nothing compares equal
+    elif sp.field not in seg.numerics and sp.field in seg.text:
+        vocab = seg.text_fielddata(sp.field)[3]
+        s = str(cv)
+        pos = _bisect(vocab, s)
+        c = float(pos) if pos < len(vocab) and vocab[pos] == s else pos - 0.5
     else:
         try:
             c = float(cv)
@@ -330,6 +339,15 @@ def materialize(seg, specs: Sequence[SortSpec], local: int, score: float,
         if kc is not None:
             o = _host_ords(kc)[local]
             out.append(None if o < 0 else kc.values[int(o)])
+            continue
+        fd = seg.text_fielddata(sp.field)
+        if fd is not None:
+            mn, mx, miss, vocab, _ = fd
+            if miss[local]:
+                out.append(None)
+            else:
+                o = mn[local] if sp.order == "asc" else mx[local]
+                out.append(vocab[int(o)])
             continue
         out.append(float(sp.missing) if _is_number(sp.missing) else None)
     return out

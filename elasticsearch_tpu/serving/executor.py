@@ -105,11 +105,14 @@ def response_dict(view: PackedIndexView, index_name: str, srow: np.ndarray,
     for i in range(n):
         src, tname, doc_id = view.source_of(int(dl[i]))
         if src_spec is False:
-            src = {}
+            src = None
         elif src_filter_fn is not None:
             src = src_filter_fn(src)
-        hits.append({"_index": index_name, "_type": tname, "_id": doc_id,
-                     "_score": float(sl[i]), "_source": src})
+        hit = {"_index": index_name, "_type": tname, "_id": doc_id,
+               "_score": float(sl[i])}
+        if src is not None:      # `_source: false` omits the key
+            hit["_source"] = src
+        hits.append(hit)
     mx = float(srow[0]) if srow.size and srow[0] > -np.inf else None
     return {
         "took": took, "timed_out": False,
@@ -135,7 +138,7 @@ def response_raw(view: PackedIndexView, index_name: str, srow: np.ndarray,
                   + (view.single_type or "_doc") + '","_id":"')
         parts = np.char.add(np.char.add(np.char.add(prefix, ids),
                                         '","_score":'), ss)
-        hits_str = ',"_source":{}},'.join(parts.tolist()) + ',"_source":{}}'
+        hits_str = "},".join(parts.tolist()) + "}"
     else:
         hits_str = ""
     mx = "%.9g" % float(srow[0]) \

@@ -1,0 +1,46 @@
+"""Child-process node for the cross-process TCP cluster test.
+
+Started by tests/test_tcp_transport.py with a seed address on argv; forms a
+real two-process cluster over loopback TCP (the capability the reference
+gets from its Netty transport — two JVMs forming one cluster). Prints
+"JOINED <master_id>" when in, then idles until stdin closes, running a
+fault-detection round per second so master-side failures are noticed.
+"""
+
+import os
+import sys
+import time
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elasticsearch_tpu.cluster.node import ClusterNode          # noqa: E402
+from elasticsearch_tpu.cluster.tcp import TcpTransport          # noqa: E402
+
+
+def main() -> None:
+    host, port, data_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    net = TcpTransport(seeds=[(host, port)])
+    node = ClusterNode("node-z2", data_path, net, minimum_master_nodes=1)
+    found = net.ping_seeds("node-z2")
+    if not found:
+        print("NOSEED", flush=True)
+        return
+    node.join(found[0])
+    print(f"JOINED {found[0]}", flush=True)
+
+    stop = threading.Event()
+
+    def watch_stdin():
+        sys.stdin.read()          # EOF = parent is done
+        stop.set()
+    threading.Thread(target=watch_stdin, daemon=True).start()
+    while not stop.is_set():
+        time.sleep(0.2)
+    node.close()
+    net.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -148,7 +148,7 @@ class TestParity:
             assert set(h["_source"]) == {"title"}
         c = client.search("docs", {"query": {"match_all": {}},
                                    "_source": False, "size": 3})
-        assert all(h["_source"] is None for h in c["hits"]["hits"])
+        assert all("_source" not in h for h in c["hits"]["hits"])
 
     def test_search_after_parity(self, pair):
         single, client = pair

@@ -146,7 +146,7 @@ class TestPackedBehavior:
                 == [h["_id"] for h in rc["hits"]["hits"]]
             for hr, hc in zip(rr["hits"]["hits"], rc["hits"]["hits"]):
                 assert hr["_score"] == pytest.approx(hc["_score"], rel=1e-4)
-                assert hr["_source"] == {}
+                assert "_source" not in hr
         node.close()
 
     def test_msearch_mixed_batch(self, tmp_path):
@@ -174,7 +174,7 @@ class TestPackedBehavior:
         assert "rank" in h["_source"] and "title" not in h["_source"]
         out = node.search("idx", {"query": {"match": {"title": "fox"}},
                                   "_source": False})
-        assert out["hits"]["hits"][0]["_source"] == {}
+        assert "_source" not in out["hits"]["hits"][0]
         node.close()
 
     def test_fallback_shapes_still_work(self, tmp_path):

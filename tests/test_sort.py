@@ -135,13 +135,21 @@ class TestSortViaNode:
                                  "track_scores": True})
         assert out["hits"]["hits"][0]["_score"] is not None
 
-    def test_sort_on_text_field_is_400(self, node):
+    def test_sort_on_text_field_uses_fielddata(self, node):
+        # min term per doc on asc, max on desc (MultiValueMode over the
+        # uninverted fielddata; ref PagedBytesIndexFieldData)
         node.create_index("txt", mappings=MAPPING)
-        node.index_doc("txt", "1", {"name": "hello"})
+        node.index_doc("txt", "1", {"name": "delta alpha"})
+        node.index_doc("txt", "2", {"name": "bravo charlie"})
         node.refresh("txt")
-        with pytest.raises(QueryParsingException):
-            node.search("txt", {"query": {"match_all": {}},
-                                "sort": [{"name": "asc"}]})
+        out = node.search("txt", {"query": {"match_all": {}},
+                                  "sort": [{"name": "asc"}]})
+        assert [h["sort"] for h in out["hits"]["hits"]] \
+            == [["alpha"], ["bravo"]]
+        out = node.search("txt", {"query": {"match_all": {}},
+                                  "sort": [{"name": "desc"}]})
+        assert [h["sort"] for h in out["hits"]["hits"]] \
+            == [["delta"], ["charlie"]]
 
     def test_sort_on_unmapped_field_is_400(self, node):
         node.create_index("um", mappings=MAPPING)
@@ -274,10 +282,11 @@ class TestReviewRegressions:
         out = node.search("mi1,mi2", {"query": {"match_all": {}},
                                       "sort": [{"price": "asc"}]})
         assert [h["sort"] for h in out["hits"]["hits"]] == [[3], [None]]
-        # analyzed text in ANY index is still a 400
-        with pytest.raises(QueryParsingException):
-            node.search("mi1,mi2", {"query": {"match_all": {}},
-                                    "sort": [{"name": "asc"}]})
+        # analyzed text sorts via uninverted fielddata (min term per doc,
+        # Lucene MultiValueMode MIN on asc) — ES 2.0 allows it
+        out = node.search("mi1,mi2", {"query": {"match_all": {}},
+                                      "sort": [{"name": "asc"}]})
+        assert [h["sort"] for h in out["hits"]["hits"]] == [["hello"], [None]]
 
     def test_numeric_string_missing_parsed_as_number(self, node):
         node.create_index("nm", mappings=MAPPING)

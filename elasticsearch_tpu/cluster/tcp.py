@@ -347,7 +347,8 @@ class TcpTransport:
             with self._lock:
                 if peer_id and addr and peer_id not in self._local:
                     self._addrs[peer_id] = (addr[0], int(addr[1]))
-                known = {nid: list(a) for nid, a in self._addrs.items()}
+        with self._lock:
+            known = {nid: list(a) for nid, a in self._addrs.items()}
         return {"node_id": node_id, "peers": known}
 
     def _handshake(self, from_id: str, addr: tuple[str, int]) -> str:

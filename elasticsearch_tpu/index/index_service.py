@@ -27,8 +27,10 @@ class IndexService:
         self.settings = settings if settings is not None else EMPTY_SETTINGS
         get = lambda k, d: self.settings.get(  # noqa: E731 — "index." optional
             f"index.{k}", self.settings.get(k, d))
-        self.n_shards = int(get("number_of_shards", 1) or 1)
-        self.n_replicas = int(get("number_of_replicas", 1) or 1)
+        ns = get("number_of_shards", 1)
+        nr = get("number_of_replicas", 1)   # 0 is a VALID replica count
+        self.n_shards = int(ns) if ns is not None and str(ns) != "" else 1
+        self.n_replicas = int(nr) if nr is not None and str(nr) != "" else 1
         # alias name -> properties ({filter, index_routing, search_routing})
         self.aliases: dict[str, dict] = {}
         self.breakers = breakers           # CircuitBreakerService | None
@@ -53,6 +55,13 @@ class IndexService:
         # query-path counters live here so they survive across requests
         self._searcher_cache: dict[int, tuple[tuple, ShardSearcher]] = {}
         self.search_stats = {"sparse": 0, "dense": 0, "packed": 0}
+        # op counters surfaced by _stats (ref index/shard stats holders:
+        # IndexingStats w/ per-type breakdown, SearchStats w/ groups, GetStats)
+        self.indexing_stats: dict = {"index_total": 0, "delete_total": 0,
+                                     "types": {}}
+        self.search_groups: dict[str, int] = {}
+        self.query_total = 0
+        self.get_total = 0
         # fused serving view over all shards' segments (serving/packed_view):
         # rebuilt only when the segment set changes; tombstone-only changes
         # refresh its liveness row in place
@@ -72,22 +81,29 @@ class IndexService:
         # (ref index/mapper/internal/ParentFieldMapper routing contract)
         if parent is not None and routing is None:
             routing = parent
-        return self.shard_for(doc_id, routing).index(
+        res = self.shard_for(doc_id, routing).index(
             doc_id, source, type_name=type_name, routing=routing,
             parent=parent, **kw)
+        self.indexing_stats["index_total"] += 1
+        tmap = self.indexing_stats["types"]
+        tmap[type_name] = tmap.get(type_name, 0) + 1
+        return res
 
     def get_doc(self, doc_id: str, routing: str | None = None,
                 realtime: bool = True,
                 parent: str | None = None) -> GetResult:
         if parent is not None and routing is None:
             routing = parent
+        self.get_total += 1
         return self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
 
     def delete_doc(self, doc_id: str, routing: str | None = None,
                    parent: str | None = None, **kw) -> EngineResult:
         if parent is not None and routing is None:
             routing = parent
-        return self.shard_for(doc_id, routing).delete(doc_id, **kw)
+        res = self.shard_for(doc_id, routing).delete(doc_id, **kw)
+        self.indexing_stats["delete_total"] += 1
+        return res
 
     def sync_translogs(self) -> None:
         """One fsync per shard — the tail of a deferred-sync bulk request

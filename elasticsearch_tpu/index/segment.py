@@ -30,11 +30,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import threading
+
 from ..mapping.mapper import (
     ParsedDocument, FieldType, TEXT, KEYWORD, DATE, BOOLEAN, IP,
     NUMERIC_TYPES, _INT_TYPES, DENSE_VECTOR,
 )
 from ..ops.bm25_sparse import required_padding
+
+# serializes fielddata builds across segments (see Segment.text_fielddata)
+_FIELDDATA_LOCK = threading.Lock()
 
 
 # hard cap on token positions per doc: phrase verification packs positions
@@ -287,6 +292,13 @@ class Segment:
         -> (min_ords i64[n_pad], max_ords i64[n_pad], missing bool[n_pad],
             vocab list[str], nbytes) or None if the field has no postings.
         """
+        # one lock for all fielddata builds: concurrent first sorts on the
+        # same field must not both build + charge the breaker (the release
+        # paths only see ONE build's bytes)
+        with _FIELDDATA_LOCK:
+            return self._text_fielddata_locked(field)
+
+    def _text_fielddata_locked(self, field: str):
         cache = getattr(self, "_fielddata", None)
         if cache is None:
             cache = self._fielddata = {}

@@ -243,6 +243,11 @@ class DocumentMapper:
         self.type_name = type_name
         self.analysis = analysis
         self.fields: dict[str, FieldType] = {}
+        # parent path -> explicit multi-field sub-paths (indexed alongside)
+        self.multi_fields: dict[str, list[str]] = {}
+        # completion field path -> context spec ({name: {type, default,
+        # path, precision}}; ref suggest/context/ContextMapping)
+        self.completion_contexts: dict[str, dict] = {}
         self.dynamic = dynamic
         self.date_detection = date_detection
         self._mapping_version = 0
@@ -307,6 +312,13 @@ class DocumentMapper:
             if not isinstance(spec, dict):
                 raise MapperParsingException(f"invalid mapping for field [{name}]")
             path = f"{prefix}{name}"
+            if spec.get("type") == "multi_field":
+                # legacy multi_field (ref mapper/multifield): the sub-field
+                # sharing the parent's name IS the parent mapping; the rest
+                # become ordinary multi-fields
+                subs = dict(spec.get("fields") or {})
+                own = subs.pop(name, None) or {"type": "string"}
+                spec = {**own, "fields": subs}
             if "properties" in spec and "type" not in spec:
                 changed |= self._merge_props(path + ".", spec["properties"])
                 continue
@@ -325,6 +337,8 @@ class DocumentMapper:
             # ES 2.x: {"type": "string", "index": "not_analyzed"} == keyword
             if ftype == TEXT and spec.get("index") == "not_analyzed":
                 ftype = KEYWORD
+            if ftype == "completion" and spec.get("context"):
+                self.completion_contexts[path] = dict(spec["context"])
             ft = FieldType(
                 name=path, type=ftype,
                 analyzer=spec.get("analyzer", "standard"),
@@ -354,18 +368,37 @@ class DocumentMapper:
                 if subpath not in self.fields:
                     self.fields[subpath] = FieldType(name=subpath, type=stype,
                                                     analyzer=subspec.get("analyzer", "standard"))
+                    if subpath != path + ".keyword":
+                        self.multi_fields.setdefault(path, []).append(subpath)
                     changed = True
         return changed
 
     def mapping_dict(self) -> dict:
         """Render the schema back as a nested mapping dict (GET _mapping)."""
         root: dict[str, Any] = {}
+        mf_children = {sub for subs in self.multi_fields.values()
+                       for sub in subs}
         for path, ft in sorted(self.fields.items()):
+            if path in mf_children:
+                continue     # rendered under the parent's "fields" below
             parts = path.split(".")
             node = root
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = ft.to_dict()
+        for parent, subs in self.multi_fields.items():
+            parts = parent.split(".")
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            pnode = node.get(parts[-1])
+            if not isinstance(pnode, dict):
+                continue
+            for sub in subs:
+                sft = self.fields.get(sub)
+                if sft is not None:
+                    pnode.setdefault("fields", {})[
+                        sub.split(".")[-1]] = sft.to_dict()
         for path, opts in self.nested_paths.items():
             parts = path.split(".")
             node = root
@@ -462,6 +495,8 @@ class DocumentMapper:
                 ft = self.fields.get(path)
                 if ft is not None and ft.type == GEO_POINT:
                     self._index_value(ft, value, doc)
+                elif ft is not None and ft.type == "completion":
+                    self._index_completion(ft, value, doc)
                 else:
                     self._parse_obj(path + ".", value, doc, new_fields)
                 continue
@@ -490,6 +525,20 @@ class DocumentMapper:
                 if kw is not None:
                     for v in values:
                         doc.keywords.setdefault(kw.name, []).append(str(v)[:256])
+            # explicit multi-fields index the SAME value under their own
+            # type (ref mapper/core/AbstractFieldMapper multiFields);
+            # completion sub-fields land in the keyword column the
+            # completion suggester reads
+            for sub in self.multi_fields.get(path, ()):
+                sft = self.fields.get(sub)
+                if sft is None:
+                    continue
+                if sft.type == "completion":
+                    for v in values:
+                        doc.keywords.setdefault(sub, []).append(str(v)[:256])
+                else:
+                    for v in values:
+                        self._index_value(sft, v, doc)
 
     def _infer_type(self, path: str, v: Any) -> FieldType | None:
         """Dynamic type inference (ref: index/mapper/DocumentParser dynamic
@@ -519,8 +568,63 @@ class DocumentMapper:
             return self.analysis.analyzer("keyword")
         return self.analysis.analyzer(ft.search_analyzer or ft.analyzer)
 
+    COMPLETION_CTX_SEP = "\x1f"
+
+    def _index_completion(self, ft: FieldType, value: Any,
+                          doc: ParsedDocument) -> None:
+        """Completion field entries land in the keyword column, each input
+        PREFIX-ENCODED with its context keys (category value or geohash) —
+        the same trick the reference's ContextMapping plays inside the FST
+        (ref suggest/completion + suggest/context/ContextMapping)."""
+        if isinstance(value, str):
+            inputs, ctx_map, weight = [value], {}, 1
+        elif isinstance(value, list):
+            inputs, ctx_map, weight = [str(x) for x in value], {}, 1
+        else:
+            inputs = value.get("input") or []
+            inputs = [inputs] if isinstance(inputs, str) else list(inputs)
+            if value.get("output"):
+                inputs = inputs or [str(value["output"])]
+            ctx_map = value.get("context") or {}
+            weight = int(value.get("weight", 1))
+        ctx_spec = self.completion_contexts.get(ft.name)
+        keys = [""]
+        if ctx_spec:
+            keys = []
+            for cname, cspec in ctx_spec.items():
+                vals = ctx_map.get(cname)
+                if str(cspec.get("type")) == "geo":
+                    from ..search.geo import (encode_geohash,
+                                              geohash_length_for,
+                                              parse_geo_point)
+                    if vals is None:
+                        continue
+                    lat, lon = parse_geo_point(vals)
+                    ln = geohash_length_for(cspec.get("precision", "1km"))
+                    keys.append(encode_geohash(lat, lon, ln))
+                    continue
+                if vals is None:
+                    pth = cspec.get("path")
+                    if pth is not None and doc.source.get(pth) is not None:
+                        vals = doc.source[pth]
+                    elif "default" in cspec:
+                        vals = cspec["default"]
+                if vals is None:
+                    continue
+                vals = vals if isinstance(vals, list) else [vals]
+                keys.extend(str(v) for v in vals)
+        sep = self.COMPLETION_CTX_SEP
+        for inp in inputs:
+            for key in keys:
+                entry = f"{key}{sep}{inp}" if ctx_spec else str(inp)
+                for _ in range(max(weight, 1)):
+                    doc.keywords.setdefault(ft.name, []).append(entry)
+
     def _index_value(self, ft: FieldType, v: Any, doc: ParsedDocument) -> None:
         t = ft.type
+        if t == "completion":
+            self._index_completion(ft, v, doc)
+            return
         try:
             if t == TEXT:
                 doc.tokens.setdefault(ft.name, []).extend(self._analyzer_for(ft)(str(v)))

@@ -503,6 +503,10 @@ class NodeService:
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
+        for n in names:   # stats-group tallies (body "stats": [tags])
+            for tag in body.get("stats") or []:
+                svc = self.indices[n]
+                svc.search_groups[tag] = svc.search_groups.get(tag, 0) + 1
         alias_flt = self._alias_filters_by_index(index, names)
         if len(names) == 1 and alias_flt:
             # single index: wrapping the body keeps the packed lane eligible
@@ -540,6 +544,11 @@ class NodeService:
                         return out
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
+
+        # SearchStats query_total for the general path (the packed/batcher
+        # lanes and _search_batched count their own serves)
+        for n in names:
+            self.indices[n].query_total += 1
 
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
@@ -677,6 +686,23 @@ class NodeService:
                 if hl:
                     h["highlight"] = hl
 
+        if body.get("script_fields"):
+            # per-hit computed fields (ref search/fetch/script/
+            # ScriptFieldsFetchSubPhase + lang-expression doc[...] access)
+            from .script.engine import run_search_script
+            from .search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+            for slot, h in enumerate(hits):
+                si = reduced.shard_order[slot]
+                key = reduced.doc_keys[slot]
+                seg = searchers[si].segments[key >> SEG_SHIFT]
+                raw_src = seg.stored[key & LOCAL_MASK]
+                flds = h.setdefault("fields", {})
+                for fname, fspec in body["script_fields"].items():
+                    val = run_search_script(
+                        fspec, raw_src, params=(fspec or {}).get("params")
+                        if isinstance(fspec, dict) else None)
+                    flds[fname] = [val]
+
         resp: dict[str, Any] = {
             "took": int((time.perf_counter() - t0) * 1000),
             "timed_out": False,
@@ -789,6 +815,31 @@ class NodeService:
                 exclude_ids.append(str(ref["_id"]))
                 _texts_from(got.source)
 
+        # ignore_like: terms appearing in these docs are STRUCK from the
+        # selected term set (ref MoreLikeThisQueryParser "ignore_like" /
+        # unlike handling)
+        ignore_texts: list[str] = []
+        ignores = spec.get("ignore_like") or spec.get("unlike") or []
+        ignores = ignores if isinstance(ignores, list) else [ignores]
+        for ref in ignores:
+            if isinstance(ref, str):
+                ignore_texts.append(ref)
+                continue
+            if isinstance(ref, dict) and "doc" in ref:
+                ignore_texts.extend(x for x in ref["doc"].values()
+                                    if isinstance(x, str))
+                continue
+            if not isinstance(ref, dict) or "_id" not in ref:
+                continue
+            try:
+                got = self.get_doc(ref.get("_index", names[0]),
+                                   str(ref["_id"]))
+            except IndexMissingException:
+                continue
+            if got.found and got.source:
+                ignore_texts.extend(x for x in got.source.values()
+                                    if isinstance(x, str))
+
         segments = [seg for n in names
                     for e in self.indices[n].shards for seg in e.segments]
         all_fields = {f for seg in segments for f in seg.text} \
@@ -808,6 +859,9 @@ class NodeService:
             for t in texts:
                 for tok in an(t):
                     tf[tok] = tf.get(tok, 0) + 1
+            for t in ignore_texts:
+                for tok in an(t):
+                    tf.pop(tok, None)
             import math as _m
             n_docs = max(sum(s.n_docs for s in segments), 1)
             scored = []
@@ -854,11 +908,23 @@ class NodeService:
             doc = got.source
         if doc is None:
             raise QueryParsingException("percolate requires a doc")
+        # body filter/query restricts WHICH registered .percolator docs
+        # participate, evaluated against their own indexed fields
+        # (ref PercolatorService percolate-with-filter)
+        flt = (body or {}).get("filter") or (body or {}).get("query")
         total = 0
         matches: list = []
         for n in names:
             out = run_percolate(self.indices[n], n, doc,
                                 type_name=type_name)
+            if flt is not None and out["matches"]:
+                res = self.search(n, {
+                    "query": {"bool": {"filter": [flt]}},
+                    "size": 10_000, "_source": False})
+                allowed = {h["_id"] for h in res["hits"]["hits"]}
+                out["matches"] = [m for m in out["matches"]
+                                  if m["_id"] in allowed]
+                out["total"] = len(out["matches"])
             total += out["total"]
             matches.extend(out["matches"])
         return {"took": 0, "_shards": {"total": len(names),
@@ -1004,7 +1070,8 @@ class NodeService:
             raise IndexMissingException(index)
         segments = [seg for n in names
                     for e in self.indices[n].shards for seg in e.segments]
-        return run_suggest(body, segments)
+        return run_suggest(body, segments,
+                           mappers=self.indices[names[0]].mappers)
 
     def _packed_search(self, name: str, bodies: list[dict], *, size: int,
                        from_: int, t0: float, raw: bool = False,
@@ -1057,6 +1124,7 @@ class NodeService:
         # back to the general path and must not be booked as a packed serve
         svc.search_stats["packed"] = \
             svc.search_stats.get("packed", 0) + len(bodies)
+        svc.query_total += len(bodies)
         return out
 
     _packed_error_logged = 0
@@ -1249,6 +1317,8 @@ class NodeService:
         size = int(first_body.get("size", 10))
         from_ = int(first_body.get("from", 0))
         names = self._resolve(index)
+        for n in names:
+            self.indices[n].query_total += len(metas)
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
         for n in names:
@@ -1662,11 +1732,34 @@ class NodeService:
             self.indices[n].sync_translogs()
         return deleted
 
-    def cluster_health(self) -> dict:
+    def cluster_health(self, level: str = "cluster") -> dict:
         shards = sum(s.n_shards for s in self.indices.values())
-        return {
+        unassigned = sum(s.n_shards * s.n_replicas
+                         for s in self.indices.values())
+        per_index = {}
+        if level in ("indices", "shards"):
+            for n, s in self.indices.items():
+                ih = {"status": "yellow" if s.n_replicas else "green",
+                      "number_of_shards": s.n_shards,
+                      "number_of_replicas": s.n_replicas,
+                      "active_primary_shards": s.n_shards,
+                      "active_shards": s.n_shards,
+                      "relocating_shards": 0, "initializing_shards": 0,
+                      "unassigned_shards": s.n_shards * s.n_replicas}
+                if level == "shards":
+                    ih["shards"] = {
+                        str(i): {"status": ih["status"],
+                                 "primary_active": True,
+                                 "active_shards": 1,
+                                 "relocating_shards": 0,
+                                 "initializing_shards": 0,
+                                 "unassigned_shards": s.n_replicas}
+                        for i in range(s.n_shards)}
+                per_index[n] = ih
+        return {** ({"indices": per_index}
+                    if level in ("indices", "shards") else {}),
             "cluster_name": self.cluster_name,
-            "status": "green",
+            "status": "yellow" if unassigned else "green",
             "timed_out": False,
             "number_of_nodes": 1,
             "number_of_data_nodes": 1,

@@ -156,9 +156,11 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("HEAD", "/", lambda g, p, b: (200, {}))
 
     c.register("GET", "/_cluster/health",
-               lambda g, p, b: (200, node.cluster_health()))
+               lambda g, p, b: (200, node.cluster_health(
+                   p.get("level", ["cluster"])[0])))
     c.register("GET", "/_cluster/health/{index}",
-               lambda g, p, b: (200, node.cluster_health()))
+               lambda g, p, b: (200, node.cluster_health(
+                   p.get("level", ["cluster"])[0])))
 
     def put_template(g, p, b):
         if _pbool(p, "create", False) and g["name"] in node.templates:
@@ -176,8 +178,19 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("POST", "/_snapshot/{repo}",
                lambda g, p, b: (200, node.snapshots.put_repository(
                    g["repo"], _json_body(b))))
-    c.register("GET", "/_snapshot/{repo}",
-               lambda g, p, b: (200, node.snapshots.get_repository(g["repo"])))
+    def get_repo(g, p, b):
+        name = g.get("repo")
+        if name in (None, "_all", "*"):
+            return 200, dict(node.snapshots.repos)
+        return 200, node.snapshots.get_repository(name)
+    c.register("GET", "/_snapshot", get_repo)
+    c.register("GET", "/_snapshot/{repo}", get_repo)
+    c.register("POST", "/_snapshot/{repo}/_verify",
+               lambda g, p, b: (
+                   200, {"nodes": {"tpu-node-0": {"name": "tpu-node-0"}}})
+               if g["repo"] in node.snapshots.repos
+               else (404, {"error": f"RepositoryMissingException: "
+                                    f"[{g['repo']}] missing", "status": 404}))
     c.register("PUT", "/_snapshot/{repo}/{snap}",
                lambda g, p, b: (200, node.snapshots.create_snapshot(
                    g["repo"], g["snap"], _json_body(b))))
@@ -307,14 +320,36 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/{index}/{type}/{id}/_mlt", mlt_api)
     c.register("POST", "/{index}/{type}/{id}/_mlt", mlt_api)
 
-    def percolate_api(g, p, b):
-        return 200, node.percolate(g["index"], _json_body(b),
-                                   type_name=g.get("type", "_doc"),
-                                   doc_id=g.get("id"))
-    c.register("GET", "/{index}/{type}/_percolate", percolate_api)
-    c.register("POST", "/{index}/{type}/_percolate", percolate_api)
-    c.register("GET", "/{index}/{type}/{id}/_percolate", percolate_api)
-    c.register("POST", "/{index}/{type}/{id}/_percolate", percolate_api)
+    def percolate_api(g, p, b, count_only=False):
+        body = _json_body(b)
+        doc_index, doc_type = g["index"], g.get("type", "_doc")
+        # percolate_index/percolate_type: fetch the doc from one index,
+        # match against ANOTHER's registered queries (ref
+        # RestPercolateAction existing-doc routing)
+        perc_index = p.get("percolate_index", [doc_index])[0]
+        perc_type = p.get("percolate_type", [doc_type])[0]
+        if g.get("id") is not None and "doc" not in (body or {}):
+            got = node.get_doc(node._resolve(doc_index)[0], str(g["id"]))
+            if not got.found:
+                raise DocumentMissingException(
+                    f"[{doc_type}][{g['id']}]: document missing")
+            want_ver = p.get("version", [None])[0]
+            if want_ver is not None and int(want_ver) != got.version:
+                raise VersionConflictException(str(g["id"]), got.version,
+                                               int(want_ver))
+            body = {**(body or {}), "doc": got.source}
+        out = node.percolate(perc_index, body, type_name=perc_type,
+                             doc_id=None)
+        if count_only:
+            out = {k: v for k, v in out.items() if k != "matches"}
+        return 200, out
+    for m in ("GET", "POST"):
+        c.register(m, "/{index}/{type}/_percolate", percolate_api)
+        c.register(m, "/{index}/{type}/{id}/_percolate", percolate_api)
+        c.register(m, "/{index}/{type}/_percolate/count",
+                   lambda g, p, b: percolate_api(g, p, b, count_only=True))
+        c.register(m, "/{index}/{type}/{id}/_percolate/count",
+                   lambda g, p, b: percolate_api(g, p, b, count_only=True))
 
     def mpercolate_api(g, p, b):
         lines = [ln for ln in b.decode("utf-8").split("\n") if ln.strip()]
@@ -573,13 +608,16 @@ def _register_routes(c: RestController, node: NodeService) -> None:
         body = _json_body(b)
         tname = g.get("type", "_doc")
         mapping = body.get(tname, body)
-        for n in node._resolve(g["index"]):
+        for n in node._resolve(g.get("index", "_all")):
             node.put_mapping(n, tname, mapping)
         return 200, {"acknowledged": True}
     c.register("PUT", "/{index}/_mapping/{type}", put_mapping)
     c.register("PUT", "/{index}/{type}/_mapping", put_mapping)
     c.register("PUT", "/{index}/_mapping", put_mapping)
     c.register("POST", "/{index}/_mapping/{type}", put_mapping)
+    c.register("POST", "/{index}/{type}/_mapping", put_mapping)
+    c.register("PUT", "/_mapping/{type}", put_mapping)   # blank index = _all
+    c.register("POST", "/_mapping/{type}", put_mapping)
 
     def analyze(g, p, b):
         body = _json_body(b)
@@ -1045,16 +1083,31 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             svc = node.indices[n]
             shards = []
             for sid, e in enumerate(svc.shards):
+                nbytes = sum(s.memory_bytes() for s in e.segments)
+                ep = {"id": "node0", "name": "tpu-node-0",
+                      "host": "localhost", "transport_address":
+                      "127.0.0.1:9300", "ip": "127.0.0.1"}
                 shards.append({
                     "id": sid, "type": "GATEWAY", "stage": "DONE",
                     "primary": True,
-                    "source": {"id": "node0", "name": "tpu-node-0"},
-                    "target": {"id": "node0", "name": "tpu-node-0"},
-                    "index": {"size": {
-                        "total_in_bytes": sum(s.memory_bytes()
-                                              for s in e.segments)},
-                        "files": {"total": len(e.segments)}},
-                    "translog": {"recovered": 0},
+                    "start_time_in_millis": 0, "total_time_in_millis": 0,
+                    "source": dict(ep), "target": dict(ep),
+                    "index": {
+                        "size": {"total_in_bytes": nbytes,
+                                 "reused_in_bytes": 0,
+                                 "recovered_in_bytes": nbytes,
+                                 "percent": "100.0%"},
+                        "files": {"total": len(e.segments), "reused": 0,
+                                  "recovered": len(e.segments),
+                                  "percent": "100.0%"},
+                        "total_time_in_millis": 0,
+                        "source_throttle_time_in_millis": 0,
+                        "target_throttle_time_in_millis": 0},
+                    "translog": {"recovered": 0, "total": -1,
+                                 "total_on_start": 0, "percent": "-1.0%",
+                                 "total_time_in_millis": 0},
+                    "start": {"check_index_time_in_millis": 0,
+                              "total_time_in_millis": 0},
                 })
             out[n] = {"shards": shards}
         return 200, out
@@ -1077,6 +1130,9 @@ def _resolve_lenient_impl(node, expr, p) -> list[str]:
             out.extend(n for n in node._resolve(part) if n not in out)
         except IndexMissingException:
             if not iu:
+                raise
+        except IndexClosedException:
+            if not iu:    # ignore_unavailable also skips closed indices
                 raise
     if not out and not _pbool(p, "allow_no_indices", True) \
             and ("*" in expr or expr == "_all"):
@@ -1514,6 +1570,22 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("PUT", "/{index}/_settings", put_settings)
 
     # -- validate / explain / delete-by-query ------------------------------
+    def _lucene_str(q) -> str:
+        """Rough Lucene toString rendering of a parsed query (enough for
+        the validate_query explain contract; ref Query.toString())."""
+        (kind, spec), = q.items() if isinstance(q, dict) and q else \
+            (("match_all", {}),)
+        if kind == "match_all":
+            return "ConstantScore(*:*)"
+        if kind in ("term", "match"):
+            (f, v), = spec.items()
+            if isinstance(v, dict):
+                v = v.get("value", v.get("query"))
+            return f"{f}:{v}"
+        if kind == "query_string":
+            return str(spec.get("query", ""))
+        return json.dumps(q, separators=(",", ":"))
+
     def validate_query(g, p, b):
         body = _json_body(b)
         query = body.get("query", {"match_all": {}})
@@ -1533,6 +1605,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             expl = {"index": names[0] if names else "_all", "valid": valid}
             if err:
                 expl["error"] = err
+            else:
+                expl["explanation"] = _lucene_str(query)
             out["explanations"] = [expl]
         return 200, out
     for pat in ("/_validate/query", "/{index}/_validate/query",
@@ -1543,18 +1617,38 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     def explain_doc(g, p, b):
         body = _json_body(b)
         query = body.get("query", {"match_all": {}})
+        concrete = node._resolve(g["index"])[0]   # alias -> concrete name
         out = node.search(g["index"], {
             "query": {"bool": {"must": [query],
                                "filter": [{"ids": {"values": [g["id"]]}}]}},
             "size": 1, "track_scores": True})
         hits = out["hits"]["hits"]
         matched = bool(hits)
-        resp = {"_index": g["index"], "_type": g.get("type", "_doc"),
+        resp = {"_index": concrete, "_type": g.get("type", "_doc"),
                 "_id": g["id"], "matched": matched}
         if matched:
             score = hits[0]["_score"] or 0.0
             resp["explanation"] = {"value": score,
                                    "description": "sum of:", "details": []}
+        # URL _source params attach the fetched doc as a `get` section
+        # (ref RestExplainAction fetchSource handling)
+        s = p.get("_source", [None])[0]
+        inc = p.get("_source_include", p.get("_source_includes", [None]))[0]
+        exc = p.get("_source_exclude", p.get("_source_excludes", [None]))[0]
+        if s is not None or inc or exc:
+            got = node.get_doc(concrete, str(g["id"]))
+            if got.found:
+                gsec: dict = {"found": True}
+                if s != "false":
+                    src = got.source
+                    if s not in (None, "true"):
+                        src = _source_filter_paths(src, s.split(","), None)
+                    if inc or exc:
+                        src = _source_filter_paths(
+                            src, inc.split(",") if inc else None,
+                            exc.split(",") if exc else None)
+                    gsec["_source"] = src
+                resp["get"] = gsec
         return 200, resp
     c.register("GET", "/{index}/{type}/{id}/_explain", explain_doc)
     c.register("POST", "/{index}/{type}/{id}/_explain", explain_doc)
@@ -1573,7 +1667,9 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     # -- segments / cluster info ------------------------------------------
     def segments_api(g, p, b):
         out = {}
-        for n in node._resolve(g.get("index", "_all")):
+        names = _resolve_lenient(g.get("index", "_all"), p)
+        total = sum(node.indices[n].n_shards for n in names)
+        for n in names:
             svc = node.indices[n]
             shards = {}
             for si, e in enumerate(svc.shards):
@@ -1582,7 +1678,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                     "num_committed_segments": len(e.segments),
                     "num_search_segments": len(e.segments),
                     "segments": {
-                        f"_{seg.seg_id}": {
+                        # Lucene generation names start at _0; seg ids at 1
+                        f"_{seg.seg_id - 1}": {
                             "generation": seg.seg_id,
                             "num_docs": seg.live_count,
                             "deleted_docs": seg.n_docs - seg.live_count,
@@ -1590,7 +1687,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                             "search": True, "committed": True,
                         } for seg in e.segments}}]
             out[n] = {"shards": shards}
-        return 200, {"_shards": {"failed": 0}, "indices": out}
+        return 200, {"_shards": {"total": total, "successful": total,
+                                 "failed": 0}, "indices": out}
     c.register("GET", "/_segments", segments_api)
     c.register("GET", "/{index}/_segments", segments_api)
 
@@ -1602,33 +1700,109 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                lambda g, p, b: (200, {"acknowledged": True,
                                       "persistent": {}, "transient": {}}))
 
+    _BLOCK_IDS = {"read_only": ("5", "index read-only (api)"),
+                  "read": ("7", "index read (api)"),
+                  "write": ("8", "index write (api)"),
+                  "metadata": ("9", "index metadata (api)")}
+
     def cluster_state(g, p, b):
-        meta = {"indices": {}, "templates": dict(node.templates)}
-        metrics = g.get("metric", "_all")
+        metrics = set((g.get("metric") or "_all").split(","))
         idx_expr = g.get("index")
-        names = node._resolve(idx_expr) if idx_expr else list(node.indices)
-        for n in names:
-            svc = node.indices[n]
-            meta["indices"][n] = {
-                "state": "open",
-                "aliases": sorted(svc.aliases),
-                "mappings": svc.mappings_dict(),
-                "settings": _render_settings(svc)}
+        if idx_expr:
+            opens, closeds = _expand_indices(idx_expr, p)
+        else:
+            opens, closeds = list(node.indices), list(node.closed)
         out: dict = {"cluster_name": node.cluster_name,
                      "master_node": "tpu-node-0"}
-        if metrics in ("_all", "metadata"):
+        if metrics & {"_all", "metadata"}:
+            meta = {"indices": {}, "templates": dict(node.templates)}
+            for n in opens:
+                svc = node.indices[n]
+                meta["indices"][n] = {
+                    "state": "open",
+                    "aliases": sorted(svc.aliases),
+                    "mappings": svc.mappings_dict(),
+                    "settings": _render_settings(svc)}
+            for n in closeds:
+                cm = node.closed[n]
+                meta["indices"][n] = {
+                    "state": "close",
+                    "aliases": sorted(cm.get("aliases") or {}),
+                    "mappings": cm.get("mappings") or {},
+                    "settings": _nest_flat(
+                        {k if k.startswith("index.") else f"index.{k}":
+                         str(v)
+                         for k, v in (cm.get("settings") or {}).items()})}
             out["metadata"] = meta
-        if metrics in ("_all", "nodes"):
+        if metrics & {"_all", "nodes"}:
             out["nodes"] = {"tpu-node-0": {"name": "tpu-node-0"}}
-        if metrics in ("_all", "routing_table"):
+        if metrics & {"_all", "routing_table"}:
             out["routing_table"] = {"indices": {
-                n: {"shards": {}} for n in names}}
-        if metrics in ("_all", "blocks"):
-            out["blocks"] = {}
+                n: {"shards": {}} for n in opens}}
+        if metrics & {"_all", "routing_nodes", "routing_table"}:
+            out["routing_nodes"] = {"unassigned": [], "nodes": {
+                "tpu-node-0": []}}
+        if metrics & {"_all", "blocks"}:
+            blocks: dict = {}
+            bi: dict = {}
+            for n in opens:
+                ib = {}
+                for key, (bid, desc) in _BLOCK_IDS.items():
+                    v = node.indices[n].settings.get(f"index.blocks.{key}")
+                    if str(v).lower() == "true":
+                        ib[bid] = {"description": desc, "retryable": False,
+                                   "levels": ["write", "metadata_write"]}
+                if ib:
+                    bi[n] = ib
+            for n in closeds:
+                bi[n] = {"4": {"description": "index closed",
+                               "retryable": False,
+                               "levels": ["read", "write"]}}
+            if bi:
+                blocks["indices"] = bi
+            out["blocks"] = blocks
         return 200, out
     c.register("GET", "/_cluster/state", cluster_state)
     c.register("GET", "/_cluster/state/{metric}", cluster_state)
     c.register("GET", "/_cluster/state/{metric}/{index}", cluster_state)
+
+    def cluster_reroute(g, p, b):
+        # ref cluster/routing/allocation/command/* + RestClusterRerouteAction
+        # (single-node build: commands are explained, never applied; the
+        # real relocation machinery lives in cluster/state.py rebalance)
+        body = _json_body(b) if b else {}
+        explanations = []
+        for cmd in (body.get("commands") or []):
+            (kind, params), = cmd.items()
+            params = {"allow_primary": False, **(params or {})}
+            explanations.append({
+                "command": kind,
+                "parameters": params,
+                "decisions": [{
+                    "decider": f"{kind}_allocation_command",
+                    "decision": "NO",
+                    "explanation": f"[{kind}] cannot apply: no matching "
+                                   f"started shard copy on this node"}]})
+        metric = set((p.get("metric", [""])[0] or "").split(",")) - {""}
+        state: dict = {"version": 1, "master_node": "tpu-node-0"}
+        # metadata is EXCLUDED from the default reroute response
+        # (ref RestClusterRerouteAction.DEFAULT_METRICS)
+        if not metric or "nodes" in metric or "_all" in metric:
+            if not metric or "_all" in metric:
+                state["nodes"] = {"tpu-node-0": {"name": "tpu-node-0"}}
+            elif "nodes" in metric:
+                state["nodes"] = {"tpu-node-0": {"name": "tpu-node-0"}}
+        if "metadata" in metric or "_all" in metric:
+            state["metadata"] = {"indices": {
+                n: {"state": "open"} for n in node.indices}}
+        if not metric or "routing_table" in metric or "_all" in metric:
+            state["routing_table"] = {"indices": {
+                n: {"shards": {}} for n in node.indices}}
+        out = {"acknowledged": True, "state": state}
+        if _pbool(p, "explain", False):
+            out["explanations"] = explanations
+        return 200, out
+    c.register("POST", "/_cluster/reroute", cluster_reroute)
 
     # -- _cat (RestTable contract: v/h/help, aligned columns) --------------
     from . import cat as _cat
@@ -1970,92 +2144,202 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/_cat/recovery", cat_recovery)
     c.register("GET", "/_cat/recovery/{index}", cat_recovery)
 
-    def cat_thread_pool(g, p, b):
-        import os as _os
-        pools = ("bulk", "flush", "generic", "get", "index", "management",
-                 "merge", "optimize", "percolate", "refresh", "search",
-                 "snapshot", "suggest", "warmer")
-        row = {"pid": _os.getpid(), "id": "tpu0", "host": "localhost",
-               "ip": "127.0.0.1", "port": 9300}
-        for pool in pools:
-            row.update({f"{pool}.type": "fixed", f"{pool}.active": 0,
-                        f"{pool}.size": 1, f"{pool}.queue": 0,
-                        f"{pool}.queueSize": "", f"{pool}.rejected": 0,
-                        f"{pool}.largest": 0, f"{pool}.completed": 0,
-                        f"{pool}.min": "", f"{pool}.max": "",
-                        f"{pool}.keepAlive": ""})
-        rows = [row]
-        columns = [("pid", "process id"), ("id", "unique node id"),
-                   ("host", "host name"), ("ip", "ip address"),
-                   ("port", "bound transport port")]
-        for pool in pools:
-            for suffix in ("type", "active", "size", "queue", "queueSize",
-                           "rejected", "largest", "completed", "min",
-                           "max", "keepAlive"):
-                columns.append((f"{pool}.{suffix}",
-                                f"{pool} thread pool {suffix}"))
-        return 200, _cat.render(p, columns, rows,
-            defaults=["host", "ip", "bulk.active", "bulk.queue",
-                      "bulk.rejected", "index.active", "index.queue",
-                      "index.rejected", "search.active", "search.queue",
-                      "search.rejected"],
-            aliases={"h": "host", "i": "ip", "po": "port",
-                     "ba": "bulk.active", "bq": "bulk.queue",
-                     "br": "bulk.rejected", "ia": "index.active",
-                     "iq": "index.queue", "ir": "index.rejected",
-                     "sa": "search.active", "sq": "search.queue",
-                     "sr": "search.rejected", "fa": "flush.active",
-                     "gea": "get.active", "ga": "generic.active",
-                     "maa": "management.active",
-                     "oa": "optimize.active", "pa": "percolate.active"})
-    c.register("GET", "/_cat/thread_pool", cat_thread_pool)
 
-    # -- indices.stats (reference response shape) --------------------------
+    # -- indices.stats (reference response shape: CommonStats sections,
+    #    metric/level/fields/groups/types filtering; ref
+    #    action/admin/indices/stats/CommonStats.java + RestIndicesStatsAction)
+    _STATS_METRICS = {
+        "docs", "store", "indexing", "get", "search", "merge", "refresh",
+        "flush", "warmer", "filter_cache", "id_cache", "fielddata",
+        "percolate", "completion", "segments", "translog", "suggest",
+        "recovery", "query_cache",
+    }
+
+    def _csv_param(p, name):
+        v = p.get(name)
+        if not v:
+            return None
+        return [x.strip(" '\"[]") for x in ",".join(v).split(",")
+                if x.strip(" '\"[]")]
+
     def index_stats_v2(g, p, b):
         names = node._resolve(g.get("index", "_all"))
-        indices = {}
-        prim_all = {"docs": {"count": 0, "deleted": 0},
-                    "store": {"size_in_bytes": 0},
-                    "indexing": {"index_total": 0},
-                    "search": {"query_total": 0},
-                    "segments": {"count": 0},
-                    "get": {"total": 0}}
+        metric = g.get("metric") or ",".join(p.get("metric", [])) or "_all"
+        want = set(x.strip() for x in metric.split(","))
+        if "_all" in want:
+            want = set(_STATS_METRICS)
+        level = p.get("level", ["indices"])[0]
+        fields_sel = _csv_param(p, "fields")
+        fd_sel = fields_sel or _csv_param(p, "fielddata_fields")
+        comp_sel = fields_sel or _csv_param(p, "completion_fields")
+        groups_sel = _csv_param(p, "groups")
+        types_sel = _csv_param(p, "types")
+
+        def shard_stats(svc):
+            seg = [e.segment_stats() for e in svc.shards]
+            fd_fields: dict[str, int] = {}
+            comp_fields: dict[str, int] = {}
+            for e in svc.shards:
+                for s in e.segments:
+                    for f, nb in s.fielddata_bytes().items():
+                        fd_fields[f] = fd_fields.get(f, 0) + nb
+                    for f, kc in s.keywords.items():
+                        ft_types = [dm.fields.get(f)
+                                    for dm in svc.mappers._mappers.values()]
+                        if any(ft is not None and ft.type == "completion"
+                               for ft in ft_types):
+                            comp_fields[f] = comp_fields.get(f, 0) \
+                                + int(kc.ords.size) * 4 \
+                                + sum(len(v) for v in kc.values)
+            out = {}
+            if "docs" in want:
+                out["docs"] = {"count": svc.doc_count(),
+                               "deleted": sum(s["deleted"] for s in seg)}
+            if "store" in want:
+                out["store"] = {"size_in_bytes": sum(
+                    s["memory_in_bytes"] for s in seg),
+                    "throttle_time_in_millis": 0}
+            if "indexing" in want:
+                ix = {"index_total": svc.indexing_stats["index_total"],
+                      "index_time_in_millis": 0, "index_current": 0,
+                      "delete_total": svc.indexing_stats["delete_total"],
+                      "noop_update_total": 0, "is_throttled": False,
+                      "throttle_time_in_millis": 0}
+                if types_sel:
+                    ix["types"] = {
+                        t: {"index_total": c, "index_time_in_millis": 0,
+                            "index_current": 0, "delete_total": 0}
+                        for t, c in svc.indexing_stats["types"].items()
+                        if any(fnmatch.fnmatch(t, x) for x in types_sel)}
+                out["indexing"] = ix
+            if "get" in want:
+                out["get"] = {"total": svc.get_total, "exists_total": 0,
+                              "missing_total": 0, "current": 0,
+                              "time_in_millis": 0}
+            if "search" in want:
+                se = {"open_contexts": 0,
+                      "query_total": svc.query_total,
+                      "query_time_in_millis": 0, "query_current": 0,
+                      "fetch_total": svc.query_total,
+                      "fetch_time_in_millis": 0, "fetch_current": 0}
+                if groups_sel:
+                    se["groups"] = {
+                        t: {"query_total": c, "query_time_in_millis": 0,
+                            "query_current": 0, "fetch_total": c,
+                            "fetch_time_in_millis": 0, "fetch_current": 0}
+                        for t, c in svc.search_groups.items()
+                        if any(fnmatch.fnmatch(t, x) for x in groups_sel)}
+                out["search"] = se
+            if "merge" in want:
+                out["merges"] = {
+                    "current": 0, "current_docs": 0, "current_size_in_bytes": 0,
+                    "total": sum(e.merge_count for e in svc.shards),
+                    "total_time_in_millis": 0, "total_docs": 0,
+                    "total_size_in_bytes": 0}
+            if "refresh" in want:
+                out["refresh"] = {"total": sum(e.refresh_count
+                                               for e in svc.shards),
+                                  "total_time_in_millis": 0}
+            if "flush" in want:
+                out["flush"] = {"total": sum(
+                    getattr(e, "flush_count", 0) for e in svc.shards),
+                    "total_time_in_millis": 0}
+            if "warmer" in want:
+                out["warmer"] = {"current": 0, "total": 0,
+                                 "total_time_in_millis": 0}
+            if "filter_cache" in want:
+                out["filter_cache"] = {"memory_size_in_bytes": 0,
+                                       "evictions": 0}
+            if "id_cache" in want:
+                out["id_cache"] = {"memory_size_in_bytes": 0}
+            if "fielddata" in want:
+                fd = {"memory_size_in_bytes": sum(fd_fields.values()),
+                      "evictions": 0}
+                if fd_sel:
+                    fd["fields"] = {
+                        f: {"memory_size_in_bytes": nb}
+                        for f, nb in fd_fields.items()
+                        if any(fnmatch.fnmatch(f, x) for x in fd_sel)}
+                out["fielddata"] = fd
+            if "percolate" in want:
+                out["percolate"] = {"total": 0, "time_in_millis": 0,
+                                    "current": 0,
+                                    "memory_size_in_bytes": -1,
+                                    "memory_size": "-1b", "queries": 0}
+            if "completion" in want:
+                co = {"size_in_bytes": sum(comp_fields.values())}
+                if comp_sel:
+                    co["fields"] = {
+                        f: {"size_in_bytes": nb}
+                        for f, nb in comp_fields.items()
+                        if any(fnmatch.fnmatch(f, x) for x in comp_sel)}
+                out["completion"] = co
+            if "segments" in want:
+                out["segments"] = {
+                    "count": sum(s["count"] for s in seg),
+                    "memory_in_bytes": sum(s["memory_in_bytes"]
+                                           for s in seg)}
+            if "translog" in want:
+                out["translog"] = {"operations": sum(
+                    len(list(e.translog.snapshot())) for e in svc.shards),
+                    "size_in_bytes": 0}
+            if "suggest" in want:
+                out["suggest"] = {"total": 0, "time_in_millis": 0,
+                                  "current": 0}
+            if "recovery" in want:
+                out["recovery"] = {"current_as_source": 0,
+                                   "current_as_target": 0,
+                                   "throttle_time_in_millis": 0}
+            return out
 
         def acc(dst, src):
             for k, v in src.items():
+                d = dst.setdefault(k, {})
                 for k2, v2 in v.items():
-                    dst[k][k2] += v2
+                    if isinstance(v2, dict):
+                        d2 = d.setdefault(k2, {})
+                        for k3, v3 in v2.items():
+                            if isinstance(v3, (int, float)) \
+                                    and not isinstance(v3, bool):
+                                d3 = d2.setdefault(k3, 0)
+                                d2[k3] = d3 + v3
+                            else:
+                                d2[k3] = v3
+                    elif isinstance(v2, (int, float)) \
+                            and not isinstance(v2, bool):
+                        d[k2] = d.get(k2, 0) + v2
+                    else:
+                        d[k2] = v2
 
+        indices = {}
+        prim_all: dict = {}
         total_shards = 0
+        total_copies = 0
         for n in names:
             svc = node.indices[n]
-            seg = [e.segment_stats() for e in svc.shards]
-            prim = {"docs": {"count": svc.doc_count(),
-                             "deleted": sum(s["deleted"] for s in seg)},
-                    "store": {"size_in_bytes": sum(
-                        s["memory_in_bytes"] for s in seg)},
-                    "indexing": {"index_total": svc.doc_count()},
-                    "search": {"query_total": sum(
-                        svc.search_stats.values())},
-                    "segments": {"count": sum(s["count"] for s in seg)},
-                    "get": {"total": 0}}
+            prim = shard_stats(svc)
             acc(prim_all, prim)
-            indices[n] = {"primaries": prim, "total": prim}
+            entry = {"primaries": prim, "total": prim}
+            if level == "shards":
+                entry["shards"] = {
+                    str(i): [dict(prim, routing={
+                        "state": "STARTED", "primary": True,
+                        "node": "tpu-node-0"})]
+                    for i in range(svc.n_shards)}
+            indices[n] = entry
             total_shards += svc.n_shards
-        phases = node.phase_timers.stats()
-        if not g.get("index"):
-            # node-wide timers only make sense on the unfiltered view —
-            # a per-index _stats must not absorb other indices' time
-            prim_all["search"]["query_time_in_millis"] = int(
-                phases.get("total", {}).get("time_in_millis", 0))
-        return 200, {"_shards": {"total": total_shards,
-                                 "successful": total_shards, "failed": 0},
-                     "_all": {"primaries": prim_all, "total": prim_all},
-                     # HBM accounting: the breaker hierarchy IS the memory
-                     # observability surface (ref AllCircuitBreakerStats)
-                     "breakers": node.breakers.stats(),
-                     "search_phases": phases,
-                     "indices": indices}
+            total_copies += svc.n_shards * (1 + svc.n_replicas)
+        out = {"_shards": {"total": total_copies,
+                           "successful": total_shards, "failed": 0},
+               "_all": {"primaries": prim_all, "total": prim_all}}
+        if level != "cluster":
+            out["indices"] = indices
+        if not g.get("index") and "search" in want:
+            # node-wide device timers + breaker hierarchy: the TPU
+            # observability surface (ref AllCircuitBreakerStats)
+            out["breakers"] = node.breakers.stats()
+            out["search_phases"] = node.phase_timers.stats()
+        return 200, out
     c.register("GET", "/_stats", index_stats_v2)
     c.register("GET", "/{index}/_stats", index_stats_v2)
     c.register("GET", "/_stats/{metric}", index_stats_v2)
@@ -2226,11 +2510,12 @@ class HttpServer:
                     # engine/device work (ref ThreadPool.java:116 +
                     # EsRejectedExecutionException)
                     pool = _pool_of(method, parsed.path)
-                    if pool is None:
+                    tp = getattr(node, "thread_pool", None)
+                    if pool is None or tp is None:
                         status, payload = controller.dispatch(
                             method, parsed.path, params, body)
                     else:
-                        status, payload = node.thread_pool.submit(
+                        status, payload = tp.submit(
                             pool, controller.dispatch,
                             method, parsed.path, params, body).result()
                 except Exception as e:  # noqa: BLE001 — REST error contract

@@ -190,3 +190,43 @@ def _eval(node: ast.expr, env: _Env) -> Any:
         args = [_eval(a, env) for a in node.args]
         return getattr(obj, node.func.attr)(*args)
     raise ScriptException(f"expression not allowed: {type(node).__name__}")
+
+
+def run_search_script(script, source: dict, params: dict | None = None):
+    """Evaluate a SEARCH-time expression over one doc (script_fields /
+    script query; ref script/expression/ExpressionScriptEngineService —
+    `doc['field'].value` accessors over doc values). Returns the value;
+    numeric results coerce to float like Lucene expressions (always
+    doubles)."""
+    if isinstance(script, dict):
+        code = script.get("inline") or script.get("source") or \
+            script.get("script") or ""
+        params = params or script.get("params") or {}
+    else:
+        code = str(script)
+    params = params or {}
+
+    def flatten(obj, prefix=""):
+        out = {}
+        for k, v in (obj or {}).items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(flatten(v, path + "."))
+            else:
+                out[path] = v if isinstance(v, list) else [v]
+        return out
+
+    doc = {f: {"value": (vs[0] if vs else None), "values": vs,
+               "empty": not vs}
+           for f, vs in flatten(source).items()}
+    env = _Env({"_source": source}, params)
+    env.names["doc"] = doc
+    env.names["_source"] = source
+    try:
+        tree = ast.parse(code, mode="eval")
+    except SyntaxError as e:
+        raise ScriptException(f"script parse error: {e}") from e
+    out = _eval(tree.body, env)
+    if isinstance(out, int) and not isinstance(out, bool):
+        return float(out)
+    return out

@@ -1046,6 +1046,35 @@ def merge_shard_partials(specs: list[AggSpec], shard_partials: list[dict]) -> di
 # Render: ES 2.0 response shapes
 # ---------------------------------------------------------------------------
 
+def _decimal_format(pattern: str, v) -> str:
+    """Minimal Java DecimalFormat: literal prefix/suffix around a numeric
+    pattern of #/0/,/. — fraction digits from the 0s/#s after the point
+    (ref org.elasticsearch.search.aggregations ValueFormatter.Number)."""
+    import re as _re
+    m = _re.search(r"[#0][#0,.]*", pattern)
+    if not m:
+        return pattern
+    num = m.group(0)
+    prefix, suffix = pattern[:m.start()], pattern[m.end():]
+    if "." in num:
+        frac = num.split(".", 1)[1]
+        min_frac = frac.count("0")
+        max_frac = len(frac)
+        s = f"{float(v):.{max_frac}f}"
+        if max_frac > min_frac:
+            # strip OPTIONAL (#) fraction digits only, never below min_frac
+            ip, fp = s.split(".")
+            fp = fp[:min_frac] + fp[min_frac:].rstrip("0")
+            s = ip + ("." + fp if fp else "")
+    else:
+        s = str(int(round(float(v))))
+    if "," in num:
+        parts = s.split(".")
+        parts[0] = f"{int(parts[0]):,}"
+        s = ".".join(parts)
+    return prefix + s + suffix
+
+
 def _iso(ms: float) -> str:
     return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc) \
         .strftime("%Y-%m-%dT%H:%M:%S.") + f"{int(ms) % 1000:03d}Z"
@@ -1129,8 +1158,16 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
     if t == "histogram":
         items = sorted(buckets.items(), key=lambda kv: kv[0])
         min_count = int(spec.params.get("min_doc_count", 1))
-        return {"buckets": [rb(k, e) for k, e in items
-                            if e["doc_count"] >= min_count]}
+        fmt = spec.params.get("format")
+        out = []
+        for k, e in items:
+            if e["doc_count"] < min_count:
+                continue
+            b = rb(k, e)
+            if fmt:
+                b["key_as_string"] = _decimal_format(fmt, k)
+            out.append(b)
+        return {"buckets": out}
 
     if t == "date_histogram":
         items = sorted(buckets.items(), key=lambda kv: kv[0])

@@ -102,3 +102,52 @@ def haversine_m(lat: float, lon: float, lat_col, lon_col):
     a = jnp.sin((lat2 - lat1) / 2) ** 2 \
         + math.cos(lat1) * jnp.cos(lat2) * jnp.sin((lon2 - lon1) / 2) ** 2
     return 2 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0, 1)))
+
+
+def encode_geohash(lat: float, lon: float, length: int = 12) -> str:
+    """(lat, lon) -> geohash of `length` chars (GeoHashUtils.encode)."""
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    even = True
+    out = []
+    cd = 0
+    nbits = 0
+    while len(out) < length:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                cd = (cd << 1) | 1
+                lon_lo = mid
+            else:
+                cd <<= 1
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                cd = (cd << 1) | 1
+                lat_lo = mid
+            else:
+                cd <<= 1
+                lat_hi = mid
+        even = not even
+        nbits += 1
+        if nbits == 5:
+            out.append(_GEOHASH32[cd])
+            cd = 0
+            nbits = 0
+    return "".join(out)
+
+
+# geohash cell WIDTH in meters per length (GeoUtils.geoHashCellWidth)
+_GH_CELL_M = (5009400.0, 1252300.0, 156500.0, 39100.0, 4890.0, 1220.0,
+              153.0, 38.2, 4.77, 1.19, 0.149, 0.037)
+
+
+def geohash_length_for(precision) -> int:
+    """precision ("5km", meters) -> geohash length whose cell is at most
+    that size (GeoUtils.geoHashLevelsForPrecision)."""
+    m = parse_distance(precision)
+    for i, w in enumerate(_GH_CELL_M):
+        if w <= m:
+            return i + 1
+    return len(_GH_CELL_M)

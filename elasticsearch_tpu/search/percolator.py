@@ -96,7 +96,9 @@ def percolate(svc, index_name: str, doc: dict,
     mapper = svc.mappers.document_mapper(type_name)
     parsed = mapper.parse(doc, doc_id="_percolate_doc")
     builder = SegmentBuilder(seg_id=0)
-    builder.add(parsed, type_name)
+    # nested sub-docs occupy the leading rows (block-join order); the ROOT
+    # row is where match columns must be read
+    root = builder.add(parsed, type_name)
     seg = builder.build()
     # batch per PLAN SHAPE: same-shaped registered queries stack into one
     # device program's query rows; each distinct shape costs one program
@@ -119,7 +121,7 @@ def percolate(svc, index_name: str, doc: dict,
                 st = CollectionStats.from_segments([seg], terms)
                 m = np.asarray(nodes[i].match_mask(
                     SegmentContext(seg, 1, st)))
-                if m[0, 0]:
+                if m[0, root]:
                     matched_ids.append(kept[i])
             continue
         terms_by_field: dict[str, set] = {}
@@ -127,7 +129,7 @@ def percolate(svc, index_name: str, doc: dict,
         stats = CollectionStats.from_segments([seg], terms_by_field)
         match = np.asarray(batched.match_mask(
             SegmentContext(seg, len(rows), stats)))
-        for qi in np.flatnonzero(match[:, 0]):
+        for qi in np.flatnonzero(match[:, root]):
             matched_ids.append(kept[rows[int(qi)]])
     matched_ids.sort()
     matches = [{"_index": index_name, "_id": mid} for mid in matched_ids]

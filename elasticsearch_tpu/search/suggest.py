@@ -131,7 +131,7 @@ def merge_suggest(body: dict, parts: list[dict]) -> dict:
     return out
 
 
-def run_suggest(body: dict, segments) -> dict:
+def run_suggest(body: dict, segments, mappers=None) -> dict:
     """Execute a suggest request body over one index's segments.
     body: {global "text"?, name: {"text"?, "term"|"phrase"|"completion":
     {...}}} -> {name: [entries]} (ref SuggestPhase response shape)."""
@@ -186,16 +186,54 @@ def run_suggest(body: dict, segments) -> dict:
             p = spec["completion"]
             vocab = sorted(_field_vocab(segments, p["field"]).items())
             values = [v for v, _ in vocab]
-            prefix = str(text)
-            lo = bisect.bisect_left(values, prefix)
+            # context-aware lookup: entries are prefix-encoded as
+            # "<ctxkey>\x1f<input>" (mapper._index_completion); a context
+            # in the request scopes the scan to that key's range
+            ctx = p.get("context") or p.get("contexts")
+            ctx_spec = _completion_ctx_spec(mappers, p["field"])
+            ctx_keys = None
+            if ctx and ctx_spec:
+                ctx_keys = []
+                for cname, cval in ctx.items():
+                    cspec = ctx_spec.get(cname) or {}
+                    if str(cspec.get("type")) == "geo" \
+                            or isinstance(cval, dict):
+                        from .geo import (encode_geohash,
+                                          geohash_length_for,
+                                          parse_geo_point)
+                        lat, lon = parse_geo_point(cval)
+                        ln = geohash_length_for(
+                            cspec.get("precision", "1km"))
+                        ctx_keys.append(encode_geohash(lat, lon, ln))
+                    else:
+                        ctx_keys.extend(str(v) for v in (
+                            cval if isinstance(cval, list) else [cval]))
+            want = str(text).lower()
             options = []
-            for i in range(lo, len(values)):
-                if not values[i].startswith(prefix):
-                    break
-                options.append({"text": values[i],
-                                "score": float(vocab[i][1])})
+            seen = set()
+            for i, v in enumerate(values):
+                key, _, inp = v.rpartition("\x1f")
+                if ctx_keys is not None and key not in ctx_keys:
+                    continue
+                # completion analysis is case-insensitive (simple analyzer)
+                # but the ORIGINAL input is surfaced
+                if not inp.lower().startswith(want) or inp in seen:
+                    continue
+                seen.add(inp)
+                options.append({"text": inp, "score": float(vocab[i][1])})
             options.sort(key=lambda o: (-o["score"], o["text"]))
-            out[name] = [{"text": prefix, "offset": 0,
-                          "length": len(prefix),
+            out[name] = [{"text": str(text), "offset": 0,
+                          "length": len(str(text)),
                           "options": options[:int(p.get("size", 5))]}]
     return out
+
+
+def _completion_ctx_spec(mappers, field: str) -> dict | None:
+    """Context spec for a completion field from any type's mapper."""
+    if mappers is None:
+        return None
+    for dm in mappers._mappers.values():
+        spec = dm.completion_contexts.get(field)
+        if spec:
+            return spec
+    return None

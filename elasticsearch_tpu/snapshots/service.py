@@ -53,9 +53,18 @@ class SnapshotsService:
 
     def put_repository(self, name: str, body: dict) -> dict:
         rtype = (body or {}).get("type")
+        if rtype == "url":
+            # read-only URL repository (ref repositories/uri/URLRepository):
+            # registry metadata only — no local blob store to create
+            url = (body.get("settings") or {}).get("url")
+            if not url:
+                raise RepositoryException("missing url setting")
+            self.repos[name] = {"type": "url", "settings": {"url": url}}
+            self._write_json(self._registry, self.repos)
+            return {"acknowledged": True}
         if rtype != "fs":
             raise RepositoryException(
-                f"repository type [{rtype}] not supported (only [fs])")
+                f"repository type [{rtype}] not supported (only [fs, url])")
         location = (body.get("settings") or {}).get("location")
         if not location:
             raise RepositoryException("missing location setting")
@@ -75,7 +84,12 @@ class SnapshotsService:
     def _location(self, repo: str) -> str:
         if repo not in self.repos:
             raise RepositoryException(f"[{repo}] missing repository")
-        return self.repos[repo]["settings"]["location"]
+        meta = self.repos[repo]
+        if meta.get("type") != "fs" or "location" not in meta["settings"]:
+            raise RepositoryException(
+                f"[{repo}] repository type [{meta.get('type')}] is "
+                f"read-only; snapshot operations require an [fs] repository")
+        return meta["settings"]["location"]
 
     # -- snapshot creation -------------------------------------------------
 

@@ -24,15 +24,23 @@ GREEN_SUITES = [
     "cat.aliases/10_basic.yaml",
     "cat.allocation/10_basic.yaml",
     "cat.count/10_basic.yaml",
+    "cat.fielddata/10_basic.yaml",
     "cat.health/10_basic.yaml",
     "cat.indices/10_basic.yaml",
     "cat.nodes/10_basic.yaml",
     "cat.recovery/10_basic.yaml",
     "cat.segments/10_basic.yaml",
     "cat.shards/10_basic.yaml",
+    "cat.thread_pool/10_basic.yaml",
+    "cluster.health/10_basic.yaml",
     "cluster.pending_tasks/10_basic.yaml",
     "cluster.put_settings/10_basic.yaml",
+    "cluster.reroute/10_basic.yaml",
+    "cluster.reroute/11_explain.yaml",
+    "cluster.reroute/20_response_filtering.yaml",
     "cluster.state/10_basic.yaml",
+    "cluster.state/20_filtering.yaml",
+    "cluster.state/30_expand_wildcards.yaml",
     "create/10_with_id.yaml",
     "create/15_without_id.yaml",
     "create/30_internal_version.yaml",
@@ -63,6 +71,8 @@ GREEN_SUITES = [
     "exists/55_parent_with_routing.yaml",
     "exists/60_realtime_refresh.yaml",
     "exists/70_defaults.yaml",
+    "explain/10_basic.yaml",
+    "explain/20_source_filtering.yaml",
     "get/10_basic.yaml",
     "get/15_default_values.yaml",
     "get/20_fields.yaml",
@@ -96,32 +106,59 @@ GREEN_SUITES = [
     "index/75_ttl.yaml",
     "indices.analyze/10_analyze.yaml",
     "indices.clear_cache/10_basic.yaml",
+    "indices.create/10_basic.yaml",
     "indices.delete_alias/10_basic.yaml",
     "indices.delete_alias/all_path_options.yaml",
+    "indices.delete_warmer/all_path_options.yaml",
     "indices.exists/10_basic.yaml",
     "indices.exists_alias/10_basic.yaml",
     "indices.exists_template/10_basic.yaml",
     "indices.exists_type/10_basic.yaml",
+    "indices.get/10_basic.yaml",
     "indices.get_alias/10_basic.yaml",
     "indices.get_alias/20_empty.yaml",
+    "indices.get_aliases/10_basic.yaml",
+    "indices.get_field_mapping/10_basic.yaml",
+    "indices.get_field_mapping/20_missing_field.yaml",
+    "indices.get_field_mapping/30_missing_type.yaml",
     "indices.get_field_mapping/40_missing_index.yaml",
+    "indices.get_field_mapping/50_field_wildcards.yaml",
     "indices.get_mapping/10_basic.yaml",
+    "indices.get_mapping/20_missing_type.yaml",
     "indices.get_mapping/30_missing_index.yaml",
     "indices.get_mapping/40_aliases.yaml",
+    "indices.get_mapping/50_wildcard_expansion.yaml",
     "indices.get_mapping/60_empty.yaml",
+    "indices.get_settings/10_basic.yaml",
     "indices.get_settings/20_aliases.yaml",
+    "indices.get_template/10_basic.yaml",
     "indices.get_template/20_get_missing.yaml",
+    "indices.get_warmer/10_basic.yaml",
+    "indices.get_warmer/20_empty.yaml",
     "indices.open/10_basic.yaml",
     "indices.open/20_multiple_indices.yaml",
     "indices.optimize/10_basic.yaml",
     "indices.put_alias/10_basic.yaml",
     "indices.put_alias/all_path_options.yaml",
+    "indices.put_mapping/10_basic.yaml",
+    "indices.put_mapping/all_path_options.yaml",
+    "indices.put_settings/10_basic.yaml",
     "indices.put_settings/all_path_options.yaml",
+    "indices.put_template/10_basic.yaml",
     "indices.put_warmer/10_basic.yaml",
     "indices.put_warmer/20_aliases.yaml",
     "indices.put_warmer/all_path_options.yaml",
+    "indices.recovery/10_basic.yaml",
+    "indices.segments/10_basic.yaml",
+    "indices.stats/10_index.yaml",
+    "indices.stats/11_metric.yaml",
+    "indices.stats/12_level.yaml",
+    "indices.stats/13_fields.yaml",
+    "indices.stats/14_groups.yaml",
+    "indices.stats/15_types.yaml",
     "indices.update_aliases/10_basic.yaml",
     "indices.update_aliases/20_routing.yaml",
+    "indices.validate_query/10_basic.yaml",
     "info/10_info.yaml",
     "info/20_lucene_version.yaml",
     "mget/10_basic.yaml",
@@ -134,26 +171,39 @@ GREEN_SUITES = [
     "mget/40_routing.yaml",
     "mget/55_parent_with_routing.yaml",
     "mget/60_realtime_refresh.yaml",
+    "mget/70_source_filtering.yaml",
     "mlt/10_basic.yaml",
     "mlt/20_docs.yaml",
+    "mlt/30_ignore.yaml",
     "mpercolate/10_basic.yaml",
     "msearch/10_basic.yaml",
     "mtermvectors/10_basic.yaml",
     "nodes.info/10_basic.yaml",
+    "nodes.info/20_transport.yaml",
     "nodes.stats/10_basic.yaml",
+    "percolate/15_new.yaml",
+    "percolate/16_existing_doc.yaml",
+    "percolate/17_empty.yaml",
     "percolate/18_highligh_with_query.yaml",
+    "percolate/19_nested.yaml",
     "ping/10_ping.yaml",
     "script/10_basic.yaml",
     "script/20_versions.yaml",
+    "script/30_expressions.yaml",
     "scroll/10_basic.yaml",
     "scroll/11_clear.yaml",
+    "search.aggregation/10_histogram.yaml",
+    "search/10_source_filtering.yaml",
     "search/20_default_values.yaml",
     "search/30_template_query_execution.yaml",
     "search/40_search_request_template.yaml",
     "search/issue4895.yaml",
     "search/test_sig_terms.yaml",
     "search_shards/10_basic.yaml",
+    "snapshot.get_repository/10_basic.yaml",
     "suggest/10_basic.yaml",
+    "suggest/20_context.yaml",
+    "template/10_basic.yaml",
     "template/20_search.yaml",
     "termvectors/10_basic.yaml",
     "termvectors/20_issue7121.yaml",
@@ -175,7 +225,7 @@ GREEN_SUITES = [
     "update/75_ttl.yaml",
     "update/80_fields.yaml",
     "update/85_fields_meta.yaml",
-    "update/90_missing.yaml"
+    "update/90_missing.yaml",
 ]
 
 

@@ -1,0 +1,98 @@
+"""ClusterInfoService: disk usage + shard size sampling for allocation.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/cluster/
+InternalClusterInfoService.java (periodic NodesStats fs + IndicesStats
+store sampling feeding DiskThresholdDecider — cluster/routing/allocation/
+decider/DiskThresholdDecider.java: low watermark blocks NEW shard
+allocation, high watermark triggers moves off the node).
+"""
+
+from __future__ import annotations
+
+import shutil
+import time
+
+
+class DiskUsage:
+    __slots__ = ("node_id", "total_bytes", "free_bytes")
+
+    def __init__(self, node_id: str, total_bytes: int, free_bytes: int):
+        self.node_id = node_id
+        self.total_bytes = total_bytes
+        self.free_bytes = free_bytes
+
+    @property
+    def used_percent(self) -> float:
+        if not self.total_bytes:
+            return 0.0
+        return 100.0 * (self.total_bytes - self.free_bytes) \
+            / self.total_bytes
+
+
+class ClusterInfoService:
+    """Samples per-node disk usage + per-shard sizes on demand (the
+    reference samples on a 30s cadence; here refresh() is called by the
+    master before allocation rounds — same data, pull not push)."""
+
+    def __init__(self, usage_fn=None):
+        # usage_fn(node_id, data_path) -> DiskUsage; overridable for tests
+        self._usage_fn = usage_fn or self._real_usage
+        self._paths: dict[str, str] = {}
+        self.usages: dict[str, DiskUsage] = {}
+        self.shard_sizes: dict[tuple[str, int, str], int] = {}
+        self.last_refresh = 0.0
+
+    @staticmethod
+    def _real_usage(node_id: str, path: str) -> DiskUsage:
+        try:
+            du = shutil.disk_usage(path)
+            return DiskUsage(node_id, du.total, du.free)
+        except OSError:
+            return DiskUsage(node_id, 0, 0)
+
+    def register_node(self, node_id: str, data_path: str) -> None:
+        self._paths[node_id] = data_path
+
+    def refresh(self, shard_sizes: dict | None = None) -> None:
+        for node_id, path in self._paths.items():
+            self.usages[node_id] = self._usage_fn(node_id, path)
+        if shard_sizes is not None:
+            self.shard_sizes = dict(shard_sizes)
+        self.last_refresh = time.time()
+
+    def stats(self) -> dict:
+        return {
+            "nodes": {nid: {"total_in_bytes": u.total_bytes,
+                            "free_in_bytes": u.free_bytes,
+                            "used_percent": round(u.used_percent, 1)}
+                      for nid, u in self.usages.items()},
+            "shard_sizes": {f"{i}[{s}][{n}]": b
+                            for (i, s, n), b in self.shard_sizes.items()},
+        }
+
+
+class DiskThresholdDecider:
+    """Low/high watermark decider (ref DiskThresholdDecider.java:90):
+    nodes above the LOW watermark receive no new shards; nodes above the
+    HIGH watermark should shed shards (rebalance treats them as
+    overloaded)."""
+
+    def __init__(self, info: ClusterInfoService,
+                 low_pct: float = 85.0, high_pct: float = 90.0,
+                 enabled: bool = True):
+        self.info = info
+        self.low_pct = low_pct
+        self.high_pct = high_pct
+        self.enabled = enabled
+
+    def can_allocate(self, node_id: str) -> bool:
+        if not self.enabled:
+            return True
+        u = self.info.usages.get(node_id)
+        return u is None or u.used_percent < self.low_pct
+
+    def should_evacuate(self, node_id: str) -> bool:
+        if not self.enabled:
+            return False
+        u = self.info.usages.get(node_id)
+        return u is not None and u.used_percent >= self.high_pct

@@ -159,14 +159,19 @@ class ClusterState:
 
 # -- allocation (ref AllocationService.reroute + BalancedShardsAllocator) ---
 
-def allocate(state: ClusterState) -> bool:
+def allocate(state: ClusterState, decider=None) -> bool:
     """Assign UNASSIGNED copies to live nodes, balancing by shard count.
     Mutates `state` in place (call inside a mutate()d successor only).
     Returns True if anything changed. Invariants: a node holds at most one
     copy of a given shard (SameShardAllocationDecider analog); an unassigned
     PRIMARY is only placed where it can recover (fresh index) — primaries of
-    lost shards stay unassigned (red) rather than silently reborn empty."""
+    lost shards stay unassigned (red) rather than silently reborn empty.
+    `decider`: optional object with can_allocate(node_id) — the disk
+    watermark gate (cluster/info.DiskThresholdDecider; ref
+    allocation/decider/DiskThresholdDecider.java)."""
     live = set(state.nodes)
+    if decider is not None:
+        live = {n for n in live if decider.can_allocate(n)}
     loads = {n: 0 for n in live}
     for index, shards in state.routing.items():
         for copies in shards:
@@ -204,14 +209,18 @@ def allocate(state: ClusterState) -> bool:
     return changed
 
 
-def rebalance(state: ClusterState, max_moves: int = 2) -> bool:
+def rebalance(state: ClusterState, max_moves: int = 2,
+              decider=None) -> bool:
     """Move STARTED copies from overloaded to underloaded nodes via the
     RELOCATING state machine (ref allocator/BalancedShardsAllocator.java +
     ShardRouting RELOCATING): the source keeps serving, a surplus target
     copy initializes via peer recovery, and the handoff completes when the
     target reports started. Runs only on a stable table (no unassigned /
     non-relocation initializing copies) and caps moves per pass so a
-    joining node fills up without a thundering herd."""
+    joining node fills up without a thundering herd.
+    `decider` (cluster/info.DiskThresholdDecider): nodes over the LOW
+    watermark receive no shards; nodes over the HIGH watermark count as
+    maximally loaded so their shards move off first."""
     live = set(state.nodes)
     if not live:
         return False
@@ -227,10 +236,21 @@ def rebalance(state: ClusterState, max_moves: int = 2) -> bool:
                 if c["node"] in loads:
                     loads[c["node"]] += 1
     changed = False
+    evac = {n for n in live
+            if decider is not None and decider.should_evacuate(n)}
+    targets = {n for n in live
+               if decider is None or decider.can_allocate(n)}
     for _ in range(max_moves):
-        src_node = max(loads, key=lambda n: (loads[n], n))
-        dst_node = min(loads, key=lambda n: (loads[n], n))
-        if loads[src_node] - loads[dst_node] <= 1:
+        # evacuating nodes drain first; destinations must pass the decider
+        src_node = max(loads, key=lambda n: (n in evac, loads[n], n))
+        dst_pool = targets - {src_node}
+        if not dst_pool:
+            break     # nowhere under the watermark to move shards to
+        dst_node = min(dst_pool, key=lambda n: (loads[n], n))
+        if src_node not in evac \
+                and loads[src_node] - loads[dst_node] <= 1:
+            break
+        if src_node in evac and loads[src_node] == 0:
             break
         moved = False
         for index, shards in state.routing.items():

@@ -44,11 +44,11 @@ class PhaseTimers:
                     for p, a in self._acc.items() if a[0]}
 
 
-def _threshold_ms(settings, level: str) -> float | None:
-    """index.search.slowlog.threshold.query.<level> -> ms (live: read per
-    request, so a settings update applies immediately)."""
-    for key in (f"index.search.slowlog.threshold.query.{level}",
-                f"search.slowlog.threshold.query.{level}"):
+def _threshold_ms(settings, level: str,
+                  kind: str = "search.slowlog.threshold.query") -> float | None:
+    """index.<kind>.<level> -> ms (live: read per request, so a settings
+    update applies immediately)."""
+    for key in (f"index.{kind}.{level}", f"{kind}.{level}"):
         v = settings.get(key)
         if v is not None:
             from ..mapping.mapper import parse_ttl_ms
@@ -62,11 +62,15 @@ def _threshold_ms(settings, level: str) -> float | None:
 class SlowLog:
     """Query slowlog: threshold-gated log lines + a bounded in-memory tail
     (the reference writes log files; the tail makes it assertable and
-    REST-visible)."""
+    REST-visible). Subclasses set KIND (the settings-key prefix) and
+    PAYLOAD_FIELD (what the log line carries)."""
+
+    KIND = "search.slowlog.threshold.query"
+    PAYLOAD_FIELD = "source"
+    LOGGER_NAME = "elasticsearch_tpu.index.search.slowlog.query"
 
     def __init__(self, maxlen: int = 128):
-        self.logger = logging.getLogger(
-            "elasticsearch_tpu.index.search.slowlog.query")
+        self.logger = logging.getLogger(self.LOGGER_NAME)
         self.tail: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
 
@@ -77,21 +81,33 @@ class SlowLog:
             return list(self.tail)
 
     def maybe_log(self, settings, index: str, took_ms: float,
-                  body: dict) -> str | None:
+                  body) -> str | None:
         """Returns the level logged at, or None."""
         for level, log_fn in (("warn", self.logger.warning),
                               ("info", self.logger.info),
                               ("debug", self.logger.debug),
                               ("trace", self.logger.debug)):
-            thr = _threshold_ms(settings, level)
+            thr = _threshold_ms(settings, level, kind=self.KIND)
             if thr is not None and took_ms >= thr:
                 import json
+                payload = json.dumps(body)[:512] \
+                    if isinstance(body, (dict, list)) else str(body)[:128]
                 entry = {"level": level, "index": index,
                          "took_millis": round(took_ms, 2),
-                         "source": json.dumps(body)[:512]}
+                         self.PAYLOAD_FIELD: payload}
                 with self._lock:
                     self.tail.append(entry)
-                log_fn("[%s] took[%sms], source[%s]", index,
-                       entry["took_millis"], entry["source"])
+                log_fn("[%s] took[%sms], %s[%s]", index,
+                       entry["took_millis"], self.PAYLOAD_FIELD, payload)
                 return level
         return None
+
+
+class IndexingSlowLog(SlowLog):
+    """Indexing slowlog (ref index/indexing/slowlog/
+    ShardSlowLogIndexingService.java — index.indexing.slowlog.threshold.
+    index.<level> thresholds applied per write)."""
+
+    KIND = "indexing.slowlog.threshold.index"
+    PAYLOAD_FIELD = "id"
+    LOGGER_NAME = "elasticsearch_tpu.index.indexing.slowlog.index"

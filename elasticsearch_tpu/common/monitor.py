@@ -1,0 +1,187 @@
+"""Host monitoring: os / process / fs sampling + hot_threads.
+
+The analog of the reference's monitor module
+(/root/reference/src/main/java/org/elasticsearch/monitor/ — os/OsService,
+process/ProcessService, fs/FsService sample sigar-or-/proc sources on a
+cadence; jvm/HotThreads.java:36,83 samples thread stacks N times and ranks
+them by busyness). Python host: /proc + os.getloadavg + shutil.disk_usage
++ sys._current_frames give the same observability surface; the "jvm"
+section reports the Python runtime + gc the way the reference reports heap
++ collectors.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+import traceback
+
+
+def os_stats() -> dict:
+    out: dict = {"timestamp": int(time.time() * 1000)}
+    try:
+        la = os.getloadavg()
+        out["load_average"] = [round(x, 2) for x in la]
+    except OSError:
+        out["load_average"] = [0.0, 0.0, 0.0]
+    out["cpu"] = {"percent": _cpu_percent()}
+    mem: dict = {}
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        total = info.get("MemTotal", 0)
+        free = info.get("MemAvailable", info.get("MemFree", 0))
+        mem = {"total_in_bytes": total, "free_in_bytes": free,
+               "used_in_bytes": total - free,
+               "free_percent": int(100 * free / total) if total else 0,
+               "used_percent": int(100 * (total - free) / total)
+               if total else 0}
+        out["swap"] = {"total_in_bytes": info.get("SwapTotal", 0),
+                       "free_in_bytes": info.get("SwapFree", 0),
+                       "used_in_bytes": info.get("SwapTotal", 0)
+                       - info.get("SwapFree", 0)}
+    except OSError:
+        pass
+    out["mem"] = mem
+    return out
+
+
+_last_cpu: list = []
+
+
+def _cpu_percent() -> int:
+    """Whole-host cpu busy %, from consecutive /proc/stat samples."""
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()[1:]
+        vals = [int(x) for x in parts[:8]]
+    except (OSError, ValueError):
+        return 0
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+    total = sum(vals)
+    if _last_cpu:
+        dt = total - _last_cpu[0]
+        di = idle - _last_cpu[1]
+        pct = int(100 * (dt - di) / dt) if dt > 0 else 0
+    else:
+        pct = 0
+    _last_cpu[:] = [total, idle]
+    return max(0, min(100, pct))
+
+
+def process_stats() -> dict:
+    out: dict = {"timestamp": int(time.time() * 1000),
+                 "id": os.getpid()}
+    try:
+        with open("/proc/self/status") as f:
+            info = {}
+            for line in f:
+                parts = line.split()
+                if parts and parts[0].rstrip(":") in (
+                        "VmRSS", "VmSize", "Threads", "FDSize"):
+                    info[parts[0].rstrip(":")] = int(parts[1])
+        out["mem"] = {
+            "resident_in_bytes": info.get("VmRSS", 0) * 1024,
+            "total_virtual_in_bytes": info.get("VmSize", 0) * 1024}
+        out["threads"] = info.get("Threads", threading.active_count())
+    except (OSError, ValueError):
+        out["mem"] = {}
+        out["threads"] = threading.active_count()
+    try:
+        out["open_file_descriptors"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        out["open_file_descriptors"] = -1
+    try:
+        t = os.times()
+        out["cpu"] = {"total_in_millis": int((t.user + t.system) * 1000)}
+    except OSError:
+        pass
+    return out
+
+
+def fs_stats(paths: list[str]) -> dict:
+    import shutil
+    data = []
+    total = {"total_in_bytes": 0, "free_in_bytes": 0,
+             "available_in_bytes": 0}
+    for p in paths:
+        try:
+            du = shutil.disk_usage(p)
+        except OSError:
+            continue
+        data.append({"path": p, "total_in_bytes": du.total,
+                     "free_in_bytes": du.free,
+                     "available_in_bytes": du.free})
+        total["total_in_bytes"] += du.total
+        total["free_in_bytes"] += du.free
+        total["available_in_bytes"] += du.free
+    return {"timestamp": int(time.time() * 1000), "total": total,
+            "data": data}
+
+
+def runtime_stats() -> dict:
+    """Python runtime stats — the reference's jvm section (heap + gc)."""
+    import gc
+    counts = gc.get_count()
+    stats = gc.get_stats() if hasattr(gc, "get_stats") else []
+    collected = sum(s.get("collected", 0) for s in stats)
+    collections_n = sum(s.get("collections", 0) for s in stats)
+    return {
+        "timestamp": int(time.time() * 1000),
+        "uptime_in_millis": int(
+            (time.monotonic() - _START_MONO) * 1000),
+        "version": sys.version.split()[0],
+        "mem": {"heap_used_in_bytes": _rss(),
+                "heap_max_in_bytes": 0},
+        "gc": {"collectors": {"python": {
+            "collection_count": collections_n,
+            "collected": collected,
+            "pending": sum(counts)}}},
+        "threads": {"count": threading.active_count()},
+    }
+
+
+_START_MONO = time.monotonic()
+
+
+def _rss() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def hot_threads(threads: int = 3, snapshots: int = 10,
+                interval_ms: float = 50.0) -> str:
+    """Sample every thread's stack `snapshots` times; rank stacks by how
+    often they appear (ref monitor/jvm/HotThreads.java:83 — N samples at
+    an interval, grouped by identical stack, top-N rendered as text)."""
+    samples: collections.Counter = collections.Counter()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    for i in range(snapshots):
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = "".join(traceback.format_stack(frame, limit=12))
+            samples[(tid, stack)] += 1
+        if i < snapshots - 1:
+            time.sleep(interval_ms / 1000.0)
+    lines = [f"::: {{tpu-node-0}}{{local}}\n   Hot threads at "
+             f"{time.strftime('%Y-%m-%dT%H:%M:%S')}, interval="
+             f"{interval_ms}ms, busiestThreads={threads}:\n"]
+    for (tid, stack), n in samples.most_common(threads):
+        pct = 100.0 * n / snapshots
+        lines.append(
+            f"   {pct:.1f}% ({n}/{snapshots} snapshots) cpu usage by "
+            f"thread '{names.get(tid, tid)}'\n"
+            + "".join(f"     {ln}\n" for ln in stack.splitlines()[-6:]))
+    return "".join(lines)

@@ -64,6 +64,19 @@ class GetResult:
     ttl_expiry: int | None = None    # _ttl expiry instant (epoch ms)
 
 
+def _rough_doc_bytes(source: dict) -> int:
+    """Cheap buffered-source size estimate (IndexingMemoryController input;
+    exactness doesn't matter — relative shard pressure does)."""
+    try:
+        n = 64
+        for k, v in source.items():
+            n += len(k) + (len(v) if isinstance(v, str)
+                           else 8 * len(v) if isinstance(v, list) else 16)
+        return n
+    except Exception:  # noqa: BLE001 — estimates must never raise
+        return 256
+
+
 def _segment_long(seg: Segment, field: str, local: int) -> int | None:
     """Host-cached read of an i64 metadata column (_timestamp/_ttl_expiry)."""
     nc = seg.numerics.get(field)
@@ -119,6 +132,8 @@ class Engine:
         # id -> (source, type, routing)
         # id -> (source, type, routing, parent, ParsedDocument)
         self._buffer_docs: dict[str, tuple] = {}
+        # rough host bytes buffered (IndexingMemoryController's input)
+        self._buffer_bytes = 0
         self._next_seg_id = 1
         # LiveVersionMap: id -> (version, deleted)
         self.versions: dict[str, tuple[int, bool]] = {}
@@ -261,9 +276,10 @@ class Engine:
         mapper = self.mappers.document_mapper(type_name)
         parsed = mapper.parse(source, doc_id=doc_id, routing=routing,
                               parent=parent, timestamp=timestamp, ttl=ttl)
-        self._delete_everywhere(doc_id)
+        self._delete_everywhere(doc_id)   # pops any buffered predecessor
         self._buffer_docs[doc_id] = (source, type_name, routing, parent,
                                      parsed)
+        self._buffer_bytes += _rough_doc_bytes(source)
         self.versions[doc_id] = (version, False)
         self._dirty = True
 
@@ -292,7 +308,9 @@ class Engine:
         until a new searcher, exactly the NRT contract (realtime GET sees
         them immediately through the version map; ref InternalEngine
         delete + refresh visibility)."""
-        self._buffer_docs.pop(doc_id, None)
+        popped = self._buffer_docs.pop(doc_id, None)
+        if popped is not None:
+            self._buffer_bytes -= _rough_doc_bytes(popped[0])
         for seg in self.segments:
             local = seg.id_to_local.get(doc_id)
             if local is not None and seg.live_host[local]:
@@ -391,6 +409,7 @@ class Engine:
             seg.breaker = self.breaker
             self.segments.append(seg)
             self._buffer_docs.clear()
+            self._buffer_bytes = 0
             self.refresh_count += 1
             self._maybe_merge()
 

@@ -8,9 +8,13 @@ exact hash function (parallel/routing.py).
 
 from __future__ import annotations
 
+import itertools
 import os
 import shutil
 from typing import Any
+
+# monotonic index-incarnation ids (request-cache keys include one)
+_INCARNATIONS = itertools.count(1)
 
 from ..common.settings import Settings, EMPTY as EMPTY_SETTINGS
 from ..mapping.mapper import MapperService
@@ -62,10 +66,26 @@ class IndexService:
         self.search_groups: dict[str, int] = {}
         self.query_total = 0
         self.get_total = 0
+        # shard request cache counters (ref indices/cache/request/
+        # IndicesRequestCache — size-0 responses keyed by reader version)
+        self.request_cache_hits = 0
+        self.request_cache_misses = 0
+        # unique per index INCARNATION: delete+recreate under the same name
+        # must never hit the old incarnation's cache entries
+        self._incarnation = next(_INCARNATIONS)
         # fused serving view over all shards' segments (serving/packed_view):
         # rebuilt only when the segment set changes; tombstone-only changes
         # refresh its liveness row in place
         self._packed_cache: tuple[tuple, "object"] | None = None
+
+    def reader_generation(self) -> tuple:
+        """Changes whenever a refresh/merge/delete changes what a searcher
+        can see — the request-cache key component (the reference keys on
+        the IndexReader version the same way)."""
+        return tuple((e.refresh_count, e.merge_count,
+                      sum(s.live_gen for s in e.segments),
+                      len(e._buffer_docs))
+                     for e in self.shards)
 
     # -- routing -----------------------------------------------------------
 

@@ -11,6 +11,7 @@ winners only, aggregation tree reduce).
 
 from __future__ import annotations
 
+import copy
 import fnmatch
 import logging
 import os
@@ -122,15 +123,19 @@ class NodeService:
         os.makedirs(data_path, exist_ok=True)
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
-        from .common.metrics import PhaseTimers, SlowLog
+        from .common.metrics import IndexingSlowLog, PhaseTimers, SlowLog
         self.phase_timers = PhaseTimers()
         self.slowlog = SlowLog()
+        self.indexing_slowlog = IndexingSlowLog()
         # named bounded executors (ref ThreadPool.java:116); the HTTP layer
         # routes each request class through its pool, overflow -> 429
         from .common.threadpool import ThreadPool
         self.thread_pool = ThreadPool()
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
+        # shard request cache: size-0 responses keyed by (body, reader
+        # generation); bounded FIFO (ref IndicesRequestCache)
+        self._request_cache: dict = {}
         tpl_path = os.path.join(data_path, "_templates.json")
         if os.path.exists(tpl_path):
             import json
@@ -325,7 +330,10 @@ class NodeService:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
         svc = self.indices[index]
+        t0 = time.perf_counter()
         res = svc.index_doc(doc_id, source, type_name=type_name, **kw)
+        self.indexing_slowlog.maybe_log(
+            svc.settings, index, (time.perf_counter() - t0) * 1000, doc_id)
         return index, res
 
     def get_doc(self, index: str, doc_id: str, **kw):
@@ -476,13 +484,18 @@ class NodeService:
             svc = self.indices.get(name)
             if svc is not None:
                 svc.sync_translogs()
+        # shared indexing-buffer budget across shards (the reference's
+        # IndexingMemoryController runs on a schedule; per-bulk keeps the
+        # invariant without a thread)
+        self.check_indexing_memory()
         return items
 
     # -- search (the QUERY_THEN_FETCH driver, SURVEY §3.2) -----------------
 
     def search(self, index: str, body: dict | None = None,
                size: int | None = None, from_: int | None = None,
-               scroll: str | None = None, scan: bool = False) -> dict:
+               scroll: str | None = None, scan: bool = False,
+               request_cache: bool | None = None) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         if "template" in body and "query" not in body:
@@ -507,6 +520,41 @@ class NodeService:
             for tag in body.get("stats") or []:
                 svc = self.indices[n]
                 svc.search_groups[tag] = svc.search_groups.get(tag, 0) + 1
+
+        # shard request cache (ref IndicesRequestCache): size-0 bodies are
+        # cacheable by default, keyed on body + reader generation; any
+        # refresh/delete/merge rotates the generation = auto-invalidation
+        cacheable = (request_cache is not False and size == 0
+                     and from_ == 0
+                     and (request_cache or "script_fields" not in body))
+        cache_key = None
+        if cacheable:
+            import json as _json
+            try:
+                body_json = _json.dumps(body, sort_keys=True, default=str)
+                # wall-clock-relative date math must never cache (the
+                # reference refuses now-based requests the same way)
+                if "now" in body_json:
+                    cache_key = None
+                else:
+                    gens = tuple(
+                        (n, self.indices[n]._incarnation,
+                         self.indices[n].reader_generation())
+                        for n in names)
+                    # the raw index EXPRESSION is part of the key: a
+                    # filtered alias and its index must not share entries
+                    cache_key = (str(index), body_json, gens)
+            except TypeError:
+                cache_key = None
+            if cache_key is not None:
+                hit = self._request_cache.get(cache_key)
+                if hit is not None:
+                    for n in names:
+                        self.indices[n].request_cache_hits += 1
+                    return copy.deepcopy(hit)
+                for n in names:
+                    self.indices[n].request_cache_misses += 1
+
         alias_flt = self._alias_filters_by_index(index, names)
         if len(names) == 1 and alias_flt:
             # single index: wrapping the body keeps the packed lane eligible
@@ -726,6 +774,14 @@ class NodeService:
         for n in names:     # every searched index's thresholds apply
             self.slowlog.maybe_log(self.indices[n].settings, n,
                                    (now - t0) * 1000, body)
+        if cache_key is not None:
+            if len(self._request_cache) >= 256:   # bounded FIFO eviction
+                try:        # threaded server: a racing evictor is fine
+                    self._request_cache.pop(
+                        next(iter(self._request_cache)), None)
+                except (StopIteration, RuntimeError):
+                    pass
+            self._request_cache[cache_key] = copy.deepcopy(resp)
         return resp
 
     def _alias_filters_by_index(self, expr: str,
@@ -1375,23 +1431,15 @@ class NodeService:
                     m = nodes_by_index[index_of[i]].match_mask(ctx) \
                         & seg.live[None, :]
                     seg_masks.append((i, seg, m))
+        total_devs: list = []
         if count_only:
             # agg/count-only batch: SKIP scoring entirely. The dense [Q, N]
-            # scoring pass cost the r5 agg bench ~99% of its time at 1M docs.
-            from .search.shard_searcher import QuerySearchResult
-            import numpy as _np
-            Q = len(queries)
-            totals = {i: _np.zeros((Q,), _np.int64)
-                      for i in range(len(searchers))}
-            for i, _seg, m in seg_masks:
-                totals[i] += _np.asarray(m.sum(axis=1))
-            results = [QuerySearchResult(
-                shard_id=s.shard_id,
-                doc_keys=_np.full((Q, 0), -1, _np.int64),
-                scores=_np.full((Q, 0), _np.nan, _np.float32),
-                sort_values=None, total_hits=totals[i],
-                max_score=_np.full((Q,), _np.nan, _np.float32))
-                for i, s in enumerate(searchers)]
+            # scoring pass cost the r5 agg bench ~99% of its time at 1M
+            # docs. The per-segment totals stay ON DEVICE here and ride the
+            # agg collect's single device_get below (one tunnel round-trip
+            # for the whole batch).
+            total_devs = [(i, m.sum(axis=1)) for i, _seg, m in seg_masks]
+            results = None
         else:
             results = [
                 s.execute_query_phase(nodes_by_index[index_of[i]],
@@ -1411,12 +1459,13 @@ class NodeService:
         # key): the shared match-mask programs above gate per-row device
         # collect — the config #3 analytics fast lane
         agg_rendered: list[dict] | None = None
+        totals_host: list = []
         if aggs_body is not None:
             from .search.aggs.aggregators import (collect_shard,
+                                                  collect_shards_batched,
                                                   merge_shard_partials,
                                                   parse_aggs)
             from .search.aggs.aggregators import render as render_aggs
-            from .search.aggs.aggregators import collect_shard_batched
             agg_specs = parse_aggs(aggs_body)
             Q = len(queries)
             by_shard: dict[int, tuple[list, list]] = {}
@@ -1425,14 +1474,11 @@ class NodeService:
                 segs.append(seg)
                 ms.append(m)
             # leaf agg trees: ONE device program per (agg, segment) covers
-            # every row — per-row launches would pay Q round-trips each
-            rows_by_shard = {}
-            for i, (segs, ms) in by_shard.items():
-                rows = collect_shard_batched(agg_specs, segs, ms)
-                if rows is None:
-                    rows_by_shard = None
-                    break
-                rows_by_shard[i] = rows
+            # every row, ONE device_get covers the whole batch (+ count-only
+            # totals riding along)
+            rows_by_shard, totals_host = collect_shards_batched(
+                agg_specs, by_shard,
+                extra_devs=[d for _, d in total_devs])
             agg_rendered = []
             if rows_by_shard is not None:
                 for qi in range(Q):
@@ -1451,6 +1497,27 @@ class NodeService:
                     agg_rendered.append(render_aggs(
                         agg_specs, merge_shard_partials(agg_specs,
                                                         partials)))
+        elif total_devs:
+            import jax
+            totals_host = jax.device_get([d for _, d in total_devs])
+
+        if results is None:
+            # materialize the count-only QuerySearchResults from the fused
+            # fetch's totals
+            from .search.shard_searcher import QuerySearchResult
+            import numpy as _np
+            Q = len(queries)
+            totals = {i: _np.zeros((Q,), _np.int64)
+                      for i in range(len(searchers))}
+            for (i, _d), hv in zip(total_devs, totals_host):
+                totals[i] += _np.asarray(hv)
+            results = [QuerySearchResult(
+                shard_id=s.shard_id,
+                doc_keys=_np.full((Q, 0), -1, _np.int64),
+                scores=_np.full((Q, 0), _np.nan, _np.float32),
+                sort_values=None, total_hits=totals[i],
+                max_score=_np.full((Q,), _np.nan, _np.float32))
+                for i, s in enumerate(searchers)]
 
         return self._batched_reduce(metas, searchers, index_of, results,
                                     size, from_, agg_rendered, t0)
@@ -1732,6 +1799,88 @@ class NodeService:
             self.indices[n].sync_translogs()
         return deleted
 
+    # -- TTL purger (ref indices/ttl/IndicesTTLService.java:66) -----------
+
+    def purge_expired_docs(self, now_ms: int | None = None) -> int:
+        """Sweep every shard for docs whose _ttl expiry lies in the past
+        and delete them (the reference's 60s PurgerThread does exactly
+        this with a bulk request)."""
+        import numpy as _np
+        now = int(time.time() * 1000) if now_ms is None else int(now_ms)
+        deleted = 0
+        for name, svc in list(self.indices.items()):
+            expired: list[tuple[str, Any]] = []
+            for e in svc.shards:
+                with e._lock:
+                    segments = list(e.segments)
+                for seg in segments:
+                    nc = seg.numerics.get("_ttl_expiry")
+                    if nc is None:
+                        continue
+                    vals = _np.asarray(nc.vals)
+                    miss = _np.asarray(nc.missing)
+                    hits = _np.flatnonzero(~miss[:seg.n_docs]
+                                           & (vals[:seg.n_docs] < now))
+                    for local in hits:
+                        local = int(local)
+                        if not seg.live_host[local] \
+                                or seg.types[local].startswith("__"):
+                            continue
+                        expired.append((seg.ids[local],
+                                        seg.routings[local]))
+            for doc_id, routing in expired:
+                try:
+                    svc.delete_doc(doc_id, routing=routing)
+                    deleted += 1
+                except Exception:  # noqa: BLE001 — already re-deleted/raced
+                    pass
+            if expired:
+                svc.refresh()
+        return deleted
+
+    def start_ttl_purger(self, interval_s: float = 60.0) -> None:
+        """Background purger thread (off by default; tests drive
+        purge_expired_docs directly)."""
+        import threading as _th
+        if getattr(self, "_ttl_thread", None) is not None:
+            return
+        self._ttl_stop = _th.Event()
+
+        def loop():
+            while not self._ttl_stop.wait(interval_s):
+                try:
+                    self.purge_expired_docs()
+                except Exception:  # noqa: BLE001 — keep the purger alive
+                    pass
+        self._ttl_thread = _th.Thread(target=loop, daemon=True,
+                                      name="es[ttl_purger]")
+        self._ttl_thread.start()
+
+    # -- IndexingMemoryController (ref indices/memory/
+    #    IndexingMemoryController.java:60) ---------------------------------
+
+    def check_indexing_memory(self) -> int:
+        """One shared indexing-buffer byte budget across ALL shards
+        (`indices.memory.index_buffer_size`); over budget, the largest
+        buffers refresh until back under. Returns refreshes triggered."""
+        raw = self.settings.get("indices.memory.index_buffer_size",
+                                "128mb")
+        try:
+            budget = _parse_bytes(str(raw))
+        except ValueError:
+            budget = 128 << 20
+        engines = [e for svc in self.indices.values() for e in svc.shards]
+        total = sum(e._buffer_bytes for e in engines)
+        refreshed = 0
+        while total > budget:
+            biggest = max(engines, key=lambda e: e._buffer_bytes)
+            if biggest._buffer_bytes <= 0:
+                break
+            total -= biggest._buffer_bytes
+            biggest.refresh()
+            refreshed += 1
+        return refreshed
+
     def cluster_health(self, level: str = "cluster") -> dict:
         shards = sum(s.n_shards for s in self.indices.values())
         unassigned = sum(s.n_shards * s.n_replicas
@@ -1822,6 +1971,16 @@ def _deep_merge(base: dict, patch: dict) -> dict:
         else:
             out[k] = v
     return out
+
+
+def _parse_bytes(v: str) -> int:
+    """"128mb" / "1gb" / "512kb" / plain ints -> bytes (ByteSizeValue)."""
+    s = str(v).strip().lower()
+    for suffix, mult in (("pb", 1 << 50), ("tb", 1 << 40), ("gb", 1 << 30),
+                         ("mb", 1 << 20), ("kb", 1 << 10), ("b", 1)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s))
 
 
 def _source_filter(src: dict, spec) -> dict | None:

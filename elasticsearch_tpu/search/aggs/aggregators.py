@@ -159,50 +159,82 @@ def _mv(m) -> MaskView:
     return m if isinstance(m, MaskView) else MaskView(m)
 
 
+_BATCHED_LEAF_TYPES = ("terms", "histogram", "date_histogram", "range",
+                       "date_range", "min", "max", "sum", "avg",
+                       "value_count", "stats", "extended_stats")
+
+
+def collect_shards_batched(specs: list[AggSpec], by_shard: dict,
+                           extra_devs=()) -> tuple[dict | None, list]:
+    """Row-batched collect for a WHOLE msearch group across ALL shards:
+    by_shard[i] = (segments, device bool[Q, n_pad] masks). One device
+    program per (agg, segment), then ONE device_get for everything — on a
+    tunneled chip the whole analytics batch costs a single round-trip, not
+    one per program (perf r5: the agg leg was RTT-bound at ~8 syncs/batch).
+
+    `extra_devs` rides the same fetch (the count-only totals). Returns
+    ({shard: per-row partials} | None if any spec needs the general path,
+    extra_host_values)."""
+    import jax
+    eligible = all(not spec.subs and spec.type in _BATCHED_LEAF_TYPES
+                   for spec in specs)
+    launches: list = []          # (shard_idx, spec_idx, dev, finish)
+    if eligible:
+        for i, (segments, masks) in by_shard.items():
+            for si, spec in enumerate(specs):
+                for seg, mask in zip(segments, masks):
+                    if seg.n_docs == 0:
+                        continue
+                    lr = _launch_one_batched(spec, seg, mask)
+                    if lr is None:
+                        eligible = False
+                        break
+                    launches.append((i, si, lr[0], lr[1]))
+                if not eligible:
+                    break
+            if not eligible:
+                break
+    if not eligible:
+        extra_host = jax.device_get(list(extra_devs)) if extra_devs else []
+        return None, extra_host
+    fetched = jax.device_get(list(extra_devs)
+                             + [d for _, _, d, _ in launches])
+    extra_host = fetched[:len(extra_devs)]
+    host_vals = fetched[len(extra_devs):]
+    out: dict[int, list] = {}
+    for (i, si, _, finish), hv in zip(launches, host_vals):
+        rows = finish(hv)
+        per_shard = out.setdefault(i, {})
+        cur = per_shard.get(si)
+        per_shard[si] = rows if cur is None else \
+            [merge_partial(specs[si], a, b) for a, b in zip(cur, rows)]
+    result: dict[int, list] = {}
+    for i, (segments, masks) in by_shard.items():
+        q = int(masks[0].shape[0]) if masks else 1
+        per_shard = out.get(i, {})
+        rows_q = None
+        out_rows = [dict() for _ in range(q)]
+        for si, spec in enumerate(specs):
+            per_seg_rows = per_shard.get(si) \
+                or [_empty_partial(spec) for _ in range(q)]
+            rows_q = len(per_seg_rows)
+            for row, part in zip(out_rows, per_seg_rows):
+                row[spec.name] = part
+        result[i] = out_rows[:rows_q] if rows_q else out_rows
+    return result, extra_host
+
+
 def collect_shard_batched(specs: list[AggSpec], segments: list[Segment],
                           masks: list) -> list[dict] | None:
-    """Row-batched collect for a WHOLE msearch group: masks[i] is a DEVICE
-    bool[Q, n_pad] for segment i; one device program per (agg, segment)
-    serves every row (on a tunneled chip, per-row launches would pay Q
-    round-trips). Returns per-row partials, or None when any spec needs
-    the general per-row path (sub-aggs, non-columnar fields, calendar
-    intervals)."""
-    q = None
-    for spec in specs:
-        if spec.subs or spec.type not in (
-                "terms", "histogram", "date_histogram", "range",
-                "date_range", "min", "max", "sum", "avg", "value_count",
-                "stats", "extended_stats"):
-            return None
-    out_rows: list[dict] | None = None
-    for spec in specs:
-        per_seg_rows = None
-        for seg, mask in zip(segments, masks):
-            if seg.n_docs == 0:
-                continue
-            rows = _collect_one_batched(spec, seg, mask)
-            if rows is None:
-                return None
-            if q is None:
-                q = len(rows)
-            if per_seg_rows is None:
-                per_seg_rows = rows
-            else:
-                per_seg_rows = [merge_partial(spec, a, b)
-                                for a, b in zip(per_seg_rows, rows)]
-        if per_seg_rows is None:
-            if q is None:
-                q = int(np.asarray(masks[0]).shape[0]) if masks else 1
-            per_seg_rows = [_empty_partial(spec) for _ in range(q)]
-        if out_rows is None:
-            out_rows = [dict() for _ in range(len(per_seg_rows))]
-        for row, part in zip(out_rows, per_seg_rows):
-            row[spec.name] = part
-    return out_rows
+    """Single-shard convenience wrapper over collect_shards_batched."""
+    rows_by_shard, _ = collect_shards_batched(specs, {0: (segments, masks)})
+    return None if rows_by_shard is None else rows_by_shard[0]
 
 
-def _collect_one_batched(spec: AggSpec, seg: Segment, mask) -> list | None:
-    """-> per-row partials for one leaf agg over one segment, or None."""
+def _launch_one_batched(spec: AggSpec, seg: Segment, mask):
+    """Launch one leaf agg's device program over one segment.
+    -> (device_array, finish(host_array) -> per-row partials) or None when
+    the spec needs the general path. The device array is NOT synced here."""
     t = spec.type
     p = spec.params
     field = p.get("field")
@@ -211,22 +243,29 @@ def _collect_one_batched(spec: AggSpec, seg: Segment, mask) -> list | None:
         if kc is None:
             return None
         from ...ops.aggs import masked_bincount_q
-        counts = np.asarray(masked_bincount_q(kc.ords, mask,
-                                              n_bins=len(kc.values)))
-        return [{"buckets": {kc.values[o]: {"doc_count": int(c[o])}
-                             for o in np.nonzero(c)[0]},
-                 "other_doc_count": 0, "error_bound": 0} for c in counts]
+        dev = masked_bincount_q(kc.ords, mask, n_bins=len(kc.values))
+
+        def fin_terms(counts, kc=kc):
+            return [{"buckets": {kc.values[o]: {"doc_count": int(c[o])}
+                                 for o in np.nonzero(c)[0]},
+                     "other_doc_count": 0, "error_bound": 0}
+                    for c in counts]
+        return dev, fin_terms
     nc = seg.numerics.get(field) if field else None
     if nc is None:
         return None
     if t in ("min", "max", "sum", "avg", "value_count", "stats",
              "extended_stats"):
         from ...ops.aggs import masked_stats_q
-        st = np.asarray(masked_stats_q(nc.vals, nc.missing, mask))
-        return [{"count": int(r[0]), "sum": float(r[1]),
-                 "sum_sq": float(r[2]),
-                 "min": float(r[3]) if r[0] else math.inf,
-                 "max": float(r[4]) if r[0] else -math.inf} for r in st]
+        dev = masked_stats_q(nc.vals, nc.missing, mask)
+
+        def fin_stats(st):
+            return [{"count": int(r[0]), "sum": float(r[1]),
+                     "sum_sq": float(r[2]),
+                     "min": float(r[3]) if r[0] else math.inf,
+                     "max": float(r[4]) if r[0] else -math.inf}
+                    for r in st]
+        return dev, fin_stats
     if t in ("histogram", "date_histogram"):
         if t == "histogram":
             interval = float(p["interval"])
@@ -238,31 +277,38 @@ def _collect_one_batched(spec: AggSpec, seg: Segment, mask) -> list | None:
             return None
         mn, mx = _col_minmax(seg, field, nc)
         if not np.isfinite(mn) or not np.isfinite(mx):
-            return [{"buckets": {}}
-                    for _ in range(int(np.asarray(mask).shape[0]))]
+            nrows = int(mask.shape[0])
+            return (np.zeros(0),
+                    lambda _hv, n=nrows: [{"buckets": {}}
+                                          for _ in range(n)])
         base = math.floor(mn / interval) * interval
         n_bins = int((mx - base) // interval) + 1
         if n_bins > _MAX_DEVICE_BINS:
             return None
         from ...ops.aggs import masked_histogram_q
-        counts = np.asarray(masked_histogram_q(
-            nc.vals, nc.missing, mask, base, float(interval),
-            n_bins=n_bins))
-        return [{"buckets": {float(base + i * interval):
-                             {"doc_count": int(c[i])}
-                             for i in np.nonzero(c)[0]}} for c in counts]
+        dev = masked_histogram_q(nc.vals, nc.missing, mask, base,
+                                 float(interval), n_bins=n_bins)
+
+        def fin_hist(counts, base=base, interval=interval):
+            return [{"buckets": {float(base + i * interval):
+                                 {"doc_count": int(c[i])}
+                                 for i in np.nonzero(c)[0]}}
+                    for c in counts]
+        return dev, fin_hist
     if t in ("range", "date_range"):
         bounds = _range_bounds(p, is_date=(t == "date_range"))
         if bounds is None:
             return None
         keys, los, his = bounds
         from ...ops.aggs import masked_ranges_q
-        counts = np.asarray(masked_ranges_q(nc.vals, nc.missing, mask,
-                                            los, his))
-        return [{"buckets": {key: {"doc_count": int(row[ri]),
-                                   "from": lo, "to": hi}
-                             for ri, (key, lo, hi) in enumerate(keys)}}
-                for row in counts]
+        dev = masked_ranges_q(nc.vals, nc.missing, mask, los, his)
+
+        def fin_ranges(counts, keys=keys):
+            return [{"buckets": {key: {"doc_count": int(row[ri]),
+                                       "from": lo, "to": hi}
+                                 for ri, (key, lo, hi) in enumerate(keys)}}
+                    for row in counts]
+        return dev, fin_ranges
     return None
 
 

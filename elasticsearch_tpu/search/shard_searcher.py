@@ -202,18 +202,25 @@ class ShardSearcher:
                 agg_scores.append(scores[0])  # top_hits ranks with these
             kk = min(k, seg.n_pad)
             # totals/aggs reflect the full query match set — search_after
-            # narrows collection below, not the hit count (ref QueryPhase)
-            total += np.asarray(topk_ops.count_matches(match))
+            # narrows collection below, not the hit count (ref QueryPhase).
+            # All of this segment's device results come down in ONE fetch:
+            # a tunneled chip pays one RTT per segment, not one per array.
+            fetch: dict = {"total": topk_ops.count_matches(match)}
             if track_scores:
                 # mask + max ON DEVICE — downloading the [Q, N] score and
                 # match matrices to host cost ~0.5 GB per 64-query batch at
                 # 1M docs over a tunneled chip (bench r5 agg leg: 0.75 QPS)
-                seg_max = np.asarray(_masked_rowmax(scores, match))
-                max_score = np.maximum(max_score, seg_max)
+                fetch["mx"] = _masked_rowmax(scores, match)
             if sort is None:
-                top, idx = topk_ops.topk_scores(scores, match, k=kk)
-                top = np.asarray(top)
-                idx = np.asarray(idx)
+                top_d, idx_d = topk_ops.topk_scores(scores, match, k=kk)
+                fetch["top"] = top_d
+                fetch["idx"] = idx_d
+            got = jax.device_get(fetch)
+            total += got["total"]
+            if track_scores:
+                max_score = np.maximum(max_score, got["mx"])
+            if sort is None:
+                top, idx = got["top"], got["idx"]
                 seg_keys = np.where(top > -np.inf,
                                     (np.int64(seg_idx) << SEG_SHIFT) | idx.astype(np.int64),
                                     np.int64(-1))
@@ -237,9 +244,11 @@ class ShardSearcher:
                 # lexsort: LAST key is the primary; doc index breaks ties
                 order = jnp.lexsort(
                     tuple([doc_idx] + list(reversed(keys[1:])) + [primary]))
-                order = np.asarray(order)[:, :kk]
-                sel_match = np.take_along_axis(np.asarray(match), order, axis=1)
-                sel_scores = np.take_along_axis(np.asarray(scores), order, axis=1)
+                order, match_h, scores_h = jax.device_get(
+                    (order, match, scores))      # one RTT for the triple
+                order = order[:, :kk]
+                sel_match = np.take_along_axis(match_h, order, axis=1)
+                sel_scores = np.take_along_axis(scores_h, order, axis=1)
                 for qi in range(Q):
                     for j in range(kk):
                         if not sel_match[qi, j]:
@@ -309,10 +318,11 @@ class ShardSearcher:
             sims = jnp.where(live, sims, -jnp.inf)
             kk = min(k, seg.n_pad)
             top, idx = jax.lax.top_k(sims, kk)
-            top = np.asarray(top)
-            idx = np.asarray(idx)
-            total += np.asarray((np.asarray(live).sum(axis=1)
-                                 if live.ndim == 2 else live.sum()))
+            live_tot = live.sum(axis=1) if live.ndim == 2 \
+                else jnp.broadcast_to(live.sum(), (Q,))
+            # ONE fetch per segment (a tunneled chip pays RTT per sync)
+            top, idx, seg_tot = jax.device_get((top, idx, live_tot))
+            total += np.asarray(seg_tot)
             seg_keys = np.where(np.isfinite(top),
                                 (np.int64(seg_idx) << SEG_SHIFT)
                                 | idx.astype(np.int64), np.int64(-1))
@@ -368,7 +378,7 @@ class ShardSearcher:
         from ..ops.knn import combine_scores
         prim = np.nan_to_num(result.scores, nan=0.0)
         combined = np.asarray(combine_scores(
-            jnp.asarray(prim), jnp.asarray(sec), mode, q_weight, r_weight))
+            prim, sec, mode, q_weight, r_weight))   # host-side [Q,K] math
         in_window = np.arange(K)[None, :] < window
         new_scores = np.where(in_window & (result.doc_keys >= 0),
                               combined, prim)
@@ -429,8 +439,10 @@ class ShardSearcher:
 
         from ..ops.knn import combine_scores
         prim = np.nan_to_num(result.scores, nan=0.0)
+        # [Q, K] combine is trivial arithmetic — numpy inputs keep it on
+        # the host, no extra device round-trip on a tunneled chip
         combined = np.asarray(combine_scores(
-            jnp.asarray(prim), jnp.asarray(sec), mode, q_weight, r_weight))
+            prim, sec, mode, q_weight, r_weight))
         in_window = np.arange(K)[None, :] < window
         new_scores = np.where(in_window & (result.doc_keys >= 0),
                               combined, prim)

@@ -1,0 +1,153 @@
+"""Monitor subsystem: os/process/fs/jvm sampling, hot_threads, cluster
+stats API, ClusterInfoService + disk watermark allocation decider.
+
+Reference model: monitor/os/OsService, monitor/process/ProcessService,
+monitor/fs/FsService, monitor/jvm/HotThreads.java:36,83,
+cluster/InternalClusterInfoService.java + allocation/decider/
+DiskThresholdDecider.java.
+"""
+
+import threading
+import time
+
+from elasticsearch_tpu.common import monitor
+from elasticsearch_tpu.cluster.info import (ClusterInfoService, DiskUsage,
+                                            DiskThresholdDecider)
+from elasticsearch_tpu.cluster.state import allocate, new_index_routing
+
+
+def test_os_process_fs_runtime_stats():
+    o = monitor.os_stats()
+    assert len(o["load_average"]) == 3
+    assert o["mem"]["total_in_bytes"] > 0
+    p = monitor.process_stats()
+    assert p["mem"]["resident_in_bytes"] > 0
+    assert p["threads"] >= 1
+    f = monitor.fs_stats(["/tmp"])
+    assert f["total"]["total_in_bytes"] > 0
+    assert f["data"][0]["path"] == "/tmp"
+    j = monitor.runtime_stats()
+    assert j["mem"]["heap_used_in_bytes"] > 0
+    assert j["threads"]["count"] >= 1
+
+
+def test_hot_threads_samples_busy_thread():
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(1000))
+    t = threading.Thread(target=spin, name="busy-spinner", daemon=True)
+    t.start()
+    try:
+        out = monitor.hot_threads(threads=5, snapshots=4, interval_ms=10)
+    finally:
+        stop.set()
+        t.join()
+    assert "Hot threads at" in out
+    assert "busy-spinner" in out
+    assert "spin" in out              # the sampled stack names the function
+
+
+def test_nodes_stats_and_cluster_stats_over_http(tmp_path):
+    import json
+    import urllib.request
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+    node = NodeService(str(tmp_path))
+    srv = HttpServer(node, port=0).start()
+    try:
+        node.create_index("m1")
+        node.index_doc("m1", "1", {"x": "hello"})
+        node.refresh("m1")
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}") as r:
+                body = r.read()
+            try:
+                return json.loads(body)
+            except ValueError:
+                return body.decode()
+        ns = get("/_nodes/stats")["nodes"]["tpu-node-0"]
+        assert ns["os"]["mem"]["total_in_bytes"] > 0
+        assert ns["process"]["mem"]["resident_in_bytes"] > 0
+        assert ns["fs"]["total"]["total_in_bytes"] > 0
+        assert ns["jvm"]["threads"]["count"] >= 1
+        cs = get("/_cluster/stats")
+        assert cs["indices"]["count"] == 1
+        assert cs["indices"]["docs"]["count"] == 1
+        assert cs["nodes"]["count"]["total"] == 1
+        ht = get("/_nodes/hot_threads?snapshots=2&interval=5ms")
+        assert "Hot threads at" in ht
+    finally:
+        srv.stop()
+        node.close()
+
+
+def test_disk_threshold_decider_blocks_full_node():
+    info = ClusterInfoService()
+    info.usages = {
+        "node-a": DiskUsage("node-a", 100, 50),    # 50% used: fine
+        "node-b": DiskUsage("node-b", 100, 5),     # 95% used: over low
+    }
+    dec = DiskThresholdDecider(info, low_pct=85.0, high_pct=90.0)
+    assert dec.can_allocate("node-a")
+    assert not dec.can_allocate("node-b")
+    assert dec.should_evacuate("node-b")
+    assert not dec.should_evacuate("node-a")
+    # unknown node: no data, no veto (the reference allows)
+    assert dec.can_allocate("node-c")
+
+
+def test_allocate_honors_disk_decider(tmp_path):
+    from elasticsearch_tpu.cluster.state import ClusterState
+    st = ClusterState.empty().mutate()
+    st.nodes["node-a"] = {"id": "node-a"}
+    st.nodes["node-b"] = {"id": "node-b"}
+    st.data["master_node"] = "node-a"
+    st.routing["idx"] = new_index_routing(4, 0)
+    info = ClusterInfoService()
+    info.usages = {"node-a": DiskUsage("node-a", 100, 60),
+                   "node-b": DiskUsage("node-b", 100, 2)}   # 98% full
+    dec = DiskThresholdDecider(info)
+    assert allocate(st, decider=dec)
+    placed = [c["node"] for sh in st.routing["idx"] for c in sh]
+    assert placed == ["node-a"] * 4     # the full node received nothing
+
+
+def test_cluster_samples_disk_in_fd_round(tmp_path):
+    from elasticsearch_tpu.cluster import TestCluster
+    c = TestCluster(2, str(tmp_path))
+    try:
+        c.detect_once()
+        master = c.master_node()
+        assert set(master.cluster_info.usages) == {"node-1", "node-2"}
+        for u in master.cluster_info.usages.values():
+            assert u.total_bytes > 0
+    finally:
+        c.close()
+
+
+def test_rebalance_evacuates_high_watermark_node():
+    from elasticsearch_tpu.cluster.state import (ClusterState, STARTED,
+                                                 RELOCATING, rebalance)
+    st = ClusterState.empty().mutate()
+    for n in ("node-a", "node-b"):
+        st.nodes[n] = {"id": n}
+    st.data["master_node"] = "node-a"
+    # two started shards on node-b, none on node-a — balanced enough that
+    # plain rebalance would not move anything...
+    st.routing["idx"] = [
+        [{"node": "node-b", "primary": True, "state": STARTED}],
+        [{"node": "node-a", "primary": True, "state": STARTED}],
+    ]
+    info = ClusterInfoService()
+    info.usages = {"node-a": DiskUsage("node-a", 100, 60),
+                   "node-b": DiskUsage("node-b", 100, 5)}   # 95%: evacuate
+    dec = DiskThresholdDecider(info)
+    assert rebalance(st, decider=dec)
+    moving = [c for sh in st.routing["idx"] for c in sh
+              if c["state"] == RELOCATING]
+    assert len(moving) == 1 and moving[0]["node"] == "node-b"
+    assert moving[0]["relocating_to"] == "node-a"

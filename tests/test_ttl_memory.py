@@ -1,0 +1,100 @@
+"""TTL purger service + IndexingMemoryController + indexing slowlog.
+
+Reference model: indices/ttl/IndicesTTLService.java:66 (PurgerThread
+bulk-deleting expired docs), indices/memory/IndexingMemoryController.java:60
+(one indexing-buffer budget across shards), index/indexing/slowlog/
+ShardSlowLogIndexingService.java.
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = NodeService(str(tmp_path))
+    yield n
+    n.close()
+
+
+def test_ttl_purger_deletes_expired(node):
+    node.create_index("t", mappings={"_doc": {
+        "_ttl": {"enabled": True}, "properties": {"x": {"type": "string"}}}})
+    now = int(time.time() * 1000)
+    node.index_doc("t", "dead", {"x": "a"}, ttl="2s", timestamp=now)
+    node.index_doc("t", "alive", {"x": "b"}, ttl="1h", timestamp=now)
+    node.index_doc("t", "none", {"x": "c"})
+    node.refresh("t")
+    # sweep AS IF 10s have passed: "dead" (2s ttl) expired, "alive" not
+    assert node.purge_expired_docs(now_ms=now + 10_000) == 1
+    out = node.search("t", {"query": {"match_all": {}}})
+    assert {h["_id"] for h in out["hits"]["hits"]} == {"alive", "none"}
+    # idempotent: nothing left to purge
+    assert node.purge_expired_docs() == 0
+
+
+def test_indexing_memory_controller_refreshes_largest(node):
+    from elasticsearch_tpu.common.settings import Settings
+    node.settings = Settings({"indices.memory.index_buffer_size": "2kb"})
+    node.create_index("a")
+    node.create_index("b")
+    # stuff index a's buffer well past the 2kb budget
+    big = "word " * 200
+    for i in range(5):
+        node.index_doc("a", str(i), {"x": big})
+    node.index_doc("b", "1", {"x": "tiny"})
+    a_buf = sum(e._buffer_bytes for e in node.indices["a"].shards)
+    assert a_buf > 2048
+    assert node.check_indexing_memory() >= 1
+    assert sum(e._buffer_bytes for e in node.indices["a"].shards) == 0
+    # the small index's buffer survives (only the largest flush)
+    assert sum(e._buffer_bytes for e in node.indices["b"].shards) > 0
+
+
+def test_indexing_slowlog_records(node):
+    node.create_index("sl", settings={
+        "index.indexing.slowlog.threshold.index.trace": "0ms"})
+    node.index_doc("sl", "1", {"x": "hello"})
+    tail = node.indexing_slowlog.snapshot()
+    assert tail and tail[0]["index"] == "sl"
+    assert tail[0]["level"] == "trace"
+
+
+def test_buffer_bytes_accounting(node):
+    node.create_index("acc", settings={"number_of_shards": 1})
+    e = node.indices["acc"].shards[0]
+    node.index_doc("acc", "1", {"x": "hello world"})
+    assert e._buffer_bytes > 0
+    node.delete_doc("acc", "1")
+    assert e._buffer_bytes == 0
+    node.index_doc("acc", "2", {"x": "hello"})
+    node.refresh("acc")
+    assert e._buffer_bytes == 0
+
+
+def test_request_cache_size0_with_invalidation(node):
+    node.create_index("rc")
+    node.index_doc("rc", "1", {"tag": "a"})
+    node.refresh("rc")
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"t": {"terms": {"field": "tag.keyword"}}}}
+    r1 = node.search("rc", dict(body))
+    svc = node.indices["rc"]
+    assert svc.request_cache_misses >= 1
+    r2 = node.search("rc", dict(body))
+    assert svc.request_cache_hits >= 1
+    assert r2["hits"]["total"] == r1["hits"]["total"]
+    assert r2["aggregations"] == r1["aggregations"]
+    # a write + refresh rotates the reader generation: cache must miss
+    node.index_doc("rc", "2", {"tag": "b"})
+    node.refresh("rc")
+    r3 = node.search("rc", dict(body))
+    assert r3["hits"]["total"] == 2
+    # explicit opt-out bypasses the cache entirely
+    h0 = svc.request_cache_hits
+    node.search("rc", dict(body), request_cache=False)
+    node.search("rc", dict(body), request_cache=False)
+    assert svc.request_cache_hits == h0    # opt-out never touches the cache

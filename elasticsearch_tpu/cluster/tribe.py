@@ -1,0 +1,117 @@
+"""Tribe node: a federated read view over multiple clusters.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/tribe/
+(TribeService.java:63 — a node that joins N clusters as a client, merges
+their cluster states into one view, and serves reads across all of them;
+index-name conflicts resolve by preference order, like the reference's
+on_conflict: prefer_<cluster> setting).
+
+Reads (search/msearch/get) fan out to the owning cluster; writes are
+rejected (the reference's tribe node is read-only on the merged view
+unless the index is unambiguous — we keep the stricter, simpler contract).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any
+
+
+class TribeWriteException(Exception):
+    pass
+
+
+class TribeNode:
+    """members: {cluster_alias: NodeService-like} in PREFERENCE order —
+    on index-name conflicts the first member owning the name wins."""
+
+    def __init__(self, members: dict[str, Any]):
+        self.members = dict(members)
+
+    # -- merged view -------------------------------------------------------
+
+    def index_owner(self, name: str):
+        for alias, node in self.members.items():
+            if name in node.indices:
+                return alias, node
+        return None, None
+
+    def merged_indices(self) -> dict[str, str]:
+        """index name -> owning cluster alias (first wins on conflict)."""
+        out: dict[str, str] = {}
+        for alias, node in self.members.items():
+            for n in node.indices:
+                out.setdefault(n, alias)
+        return out
+
+    def cluster_state(self) -> dict:
+        merged = self.merged_indices()
+        return {"cluster_name": "tribe",
+                "indices": {n: {"cluster": a} for n, a in merged.items()},
+                "members": sorted(self.members)}
+
+    def _resolve(self, expr: str) -> dict[Any, list[str]]:
+        """index expression -> {owning node: [concrete names]}."""
+        merged = self.merged_indices()
+        out: dict[Any, list[str]] = {}
+        for part in str(expr or "_all").split(","):
+            part = part.strip()
+            for n, alias in merged.items():
+                if part in ("_all", "*", "") or part == n \
+                        or ("*" in part and fnmatch.fnmatch(n, part)):
+                    node = self.members[alias]
+                    out.setdefault(node, [])
+                    if n not in out[node]:
+                        out[node].append(n)
+        return out
+
+    # -- reads -------------------------------------------------------------
+
+    def search(self, index: str, body: dict | None = None) -> dict:
+        """Scatter to each owning cluster, merge hit lists by score (the
+        coordinator-side reduce the reference runs over its merged view)."""
+        by_node = self._resolve(index)
+        if not by_node:
+            from ..node import IndexMissingException
+            raise IndexMissingException(index)
+        body = body or {}
+        size = int(body.get("size", 10))
+        parts = [node.search(",".join(names), dict(body))
+                 for node, names in by_node.items()]
+        hits: list = []
+        total = 0
+        max_score = None
+        took = 0
+        for p in parts:
+            total += p["hits"]["total"]
+            took = max(took, p.get("took", 0))
+            ms = p["hits"]["max_score"]
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score, ms)
+            hits.extend(p["hits"]["hits"])
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        return {"took": took, "timed_out": False,
+                "_shards": {"total": sum(p["_shards"]["total"]
+                                         for p in parts),
+                            "successful": sum(p["_shards"]["successful"]
+                                              for p in parts),
+                            "failed": sum(p["_shards"]["failed"]
+                                          for p in parts)},
+                "hits": {"total": total, "max_score": max_score,
+                         "hits": hits[:size]}}
+
+    def get_doc(self, index: str, doc_id: str, **kw):
+        _, node = self.index_owner(index)
+        if node is None:
+            from ..node import IndexMissingException
+            raise IndexMissingException(index)
+        return node.get_doc(index, doc_id, **kw)
+
+    # -- writes: rejected on the merged view ------------------------------
+
+    def index_doc(self, *a, **kw):
+        raise TribeWriteException(
+            "tribe node is read-only over the merged view "
+            "(write to a member cluster directly)")
+
+    delete_doc = index_doc

@@ -1,0 +1,72 @@
+"""Component lifecycle state machine.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/common/
+component/Lifecycle.java (INITIALIZED -> STARTED -> STOPPED -> CLOSED with
+guarded transitions) + AbstractLifecycleComponent's moveToStarted/Stopped/
+Closed discipline. Components embed one of these and gate their work on
+`started`; illegal transitions raise instead of corrupting state.
+"""
+
+from __future__ import annotations
+
+import threading
+
+INITIALIZED = "INITIALIZED"
+STARTED = "STARTED"
+STOPPED = "STOPPED"
+CLOSED = "CLOSED"
+
+
+class IllegalStateTransition(Exception):
+    pass
+
+
+class Lifecycle:
+    _ALLOWED = {
+        INITIALIZED: {STARTED, CLOSED},
+        STARTED: {STOPPED},
+        STOPPED: {STARTED, CLOSED},
+        CLOSED: set(),
+    }
+
+    def __init__(self):
+        self.state = INITIALIZED
+        self._lock = threading.Lock()
+
+    def _move(self, to: str) -> bool:
+        with self._lock:
+            if self.state == to:
+                return False           # idempotent re-entry
+            if to not in self._ALLOWED[self.state]:
+                raise IllegalStateTransition(
+                    f"cannot move from [{self.state}] to [{to}]")
+            self.state = to
+            return True
+
+    def move_to_started(self) -> bool:
+        return self._move(STARTED)
+
+    def move_to_stopped(self) -> bool:
+        return self._move(STOPPED)
+
+    def move_to_closed(self) -> bool:
+        # closing from STARTED implies a stop first (the reference's
+        # close() calls stop() when started)
+        with self._lock:
+            if self.state == CLOSED:
+                return False
+            if self.state == STARTED:
+                self.state = STOPPED
+            if CLOSED not in self._ALLOWED[self.state]:
+                raise IllegalStateTransition(
+                    f"cannot close from [{self.state}]")
+            self.state = CLOSED
+            return True
+
+    @property
+    def started(self) -> bool:
+        return self.state == STARTED
+
+    @property
+    def closed(self) -> bool:
+        return self.state == CLOSED

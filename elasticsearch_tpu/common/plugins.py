@@ -1,0 +1,99 @@
+"""PluginsService: discovery + lifecycle hooks for node plugins.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/plugins/
+(PluginsService.java:91 — scan the plugins dir, read each plugin's
+descriptor, instantiate, surface in nodes-info; plugins can register REST
+handlers and lifecycle hooks).
+
+Python shape: `<data>/plugins/<name>/plugin.json` holds
+{"name", "version", "description", "module"?}. When "module" names a
+python file inside the plugin dir, it is imported and its optional
+`init(node)` hook runs at node boot; an optional
+`register_routes(controller, node)` hook adds REST endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class PluginInfo:
+    __slots__ = ("name", "version", "description", "path", "module")
+
+    def __init__(self, name, version, description, path, module=None):
+        self.name = name
+        self.version = version
+        self.description = description
+        self.path = path
+        self.module = module
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "description": self.description, "jvm": False,
+                "site": False}
+
+
+class PluginsService:
+    def __init__(self, plugins_dir: str):
+        self.plugins_dir = plugins_dir
+        self.plugins: list[PluginInfo] = []
+        self.load_errors: list[str] = []
+        self._scan()
+
+    def _scan(self) -> None:
+        if not os.path.isdir(self.plugins_dir):
+            return
+        for name in sorted(os.listdir(self.plugins_dir)):
+            pdir = os.path.join(self.plugins_dir, name)
+            desc = os.path.join(pdir, "plugin.json")
+            if not os.path.isfile(desc):
+                continue
+            try:
+                with open(desc) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError) as e:
+                self.load_errors.append(f"{name}: bad descriptor: {e}")
+                continue
+            info = PluginInfo(meta.get("name", name),
+                              str(meta.get("version", "0")),
+                              meta.get("description", ""), pdir)
+            mod_file = meta.get("module")
+            if mod_file:
+                try:
+                    info.module = self._load_module(
+                        f"es_tpu_plugin_{name}",
+                        os.path.join(pdir, mod_file))
+                except Exception as e:  # noqa: BLE001 — a broken plugin
+                    self.load_errors.append(f"{name}: {e}")
+                    continue            # must not take the node down
+            self.plugins.append(info)
+
+    @staticmethod
+    def _load_module(modname: str, path: str):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(modname, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def on_node_start(self, node) -> None:
+        for p in self.plugins:
+            hook = getattr(p.module, "init", None)
+            if callable(hook):
+                try:
+                    hook(node)
+                except Exception as e:  # noqa: BLE001
+                    self.load_errors.append(f"{p.name}: init failed: {e}")
+
+    def register_routes(self, controller, node) -> None:
+        for p in self.plugins:
+            hook = getattr(p.module, "register_routes", None)
+            if callable(hook):
+                try:
+                    hook(controller, node)
+                except Exception as e:  # noqa: BLE001
+                    self.load_errors.append(f"{p.name}: routes failed: {e}")
+
+    def infos(self) -> list[dict]:
+        return [p.to_dict() for p in self.plugins]

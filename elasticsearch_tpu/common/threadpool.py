@@ -25,13 +25,16 @@ _CORES = _os.cpu_count() or 4
 
 DEFAULT_POOLS = {
     # name: (threads, queue_size)  — queue_size None = unbounded (scaling
-    # pools in the reference: management/generic/snapshot). Sizes follow
-    # ThreadPool.java:116-129: search 3×cores q1000, index cores q200,
-    # bulk cores q50, get cores q1000.
-    "search": (3 * _CORES, 1000),
-    "index": (_CORES, 200),
-    "bulk": (_CORES, 50),
-    "get": (_CORES, 1000),
+    # pools in the reference: management/generic/snapshot). The reference
+    # sizes search at 3×cores (ThreadPool.java:116-129) because its search
+    # threads BURN cpu in Lucene; ours mostly WAIT on a device program, so
+    # the search pool floors at 32 — narrower would strangle the dynamic
+    # batcher, whose whole point is coalescing many concurrent waiters
+    # into one device launch (serving/batcher.py).
+    "search": (max(32, 3 * _CORES), 1000),
+    "index": (max(4, _CORES), 200),
+    "bulk": (max(4, _CORES), 50),
+    "get": (max(8, _CORES), 1000),
     "management": (2, None),
     "generic": (4, None),
     "snapshot": (1, None),

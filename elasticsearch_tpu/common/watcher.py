@@ -1,0 +1,92 @@
+"""ResourceWatcherService: mtime-based file/dir change notification.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/watcher/
+(ResourceWatcherService.java — registered watchers checked on an interval;
+FileWatcher + FileChangesListener onFileCreated/Changed/Deleted). The
+reference drives file-script hot reload with this; here NodeService points
+it at a `scripts/` dir for the same effect.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class FileWatcher:
+    """Watches one directory (non-recursive): detects created / changed /
+    deleted files between check() calls."""
+
+    def __init__(self, path: str, listener):
+        self.path = path
+        self.listener = listener       # on_file_created/changed/deleted(p)
+        self._seen: dict[str, float] = {}
+        self._init_done = False
+
+    def check(self) -> None:
+        try:
+            entries = {os.path.join(self.path, f): os.path.getmtime(
+                os.path.join(self.path, f))
+                for f in os.listdir(self.path)
+                if os.path.isfile(os.path.join(self.path, f))}
+        except OSError:
+            entries = {}
+        if not self._init_done:
+            # first pass primes state AND reports existing files as created
+            for p in sorted(entries):
+                self.listener.on_file_created(p)
+            self._seen = entries
+            self._init_done = True
+            return
+        for p in sorted(entries):
+            if p not in self._seen:
+                self.listener.on_file_created(p)
+            elif entries[p] != self._seen[p]:
+                self.listener.on_file_changed(p)
+        for p in sorted(set(self._seen) - set(entries)):
+            self.listener.on_file_deleted(p)
+        self._seen = entries
+
+
+class ResourceWatcherService:
+    """Registry + optional interval thread (ref ResourceWatcherService
+    HIGH/MEDIUM/LOW frequencies; one cadence suffices here)."""
+
+    def __init__(self, interval_s: float = 5.0):
+        self.interval_s = interval_s
+        self._watchers: list[FileWatcher] = []
+        self._lock = threading.Lock()
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+
+    def add(self, watcher: FileWatcher) -> FileWatcher:
+        with self._lock:
+            self._watchers.append(watcher)
+        watcher.check()                 # prime immediately, like the ref
+        return watcher
+
+    def check_now(self) -> None:
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            w.check()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.check_now()
+                except Exception:  # noqa: BLE001 — keep watching
+                    pass
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="es[resource_watcher]")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        self._thread = None

@@ -1,0 +1,183 @@
+"""XContent: pluggable request/response body formats.
+
+The analog of /root/reference/src/main/java/org/elasticsearch/common/
+xcontent/ (XContentType.java — JSON, SMILE, YAML, CBOR with auto-detection
+from bytes/Content-Type; every REST body decodes through one seam).
+
+JSON is native. YAML rides PyYAML (safe_load). CBOR is a self-contained
+RFC 7049 codec below (major types 0-7, the subset JSON-shaped documents
+need). SMILE is not implemented — callers get a clear 406 instead of a
+guess (the reference's SMILE is a Jackson binary format with no Python
+stdlib analog; CBOR covers the binary-body use case).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+
+def detect(content_type: str | None, body: bytes) -> str:
+    """-> "json" | "yaml" | "cbor" (XContentType.fromMediaTypeOrFormat +
+    the magic-byte sniff in XContentFactory.xContentType)."""
+    ct = (content_type or "").lower()
+    if "yaml" in ct:
+        return "yaml"
+    if "cbor" in ct:
+        return "cbor"
+    if "smile" in ct:
+        raise ValueError("SMILE bodies are not supported; send JSON, "
+                         "YAML, or CBOR")
+    if "json" in ct:
+        return "json"
+    # sniff: CBOR maps start 0xA0-0xBF / 0xD9 tag; YAML docs "---"
+    if body[:1] and 0xA0 <= body[0] <= 0xBF or body[:1] == b"\xd9":
+        return "cbor"
+    if body[:3] == b"---":
+        return "yaml"
+    return "json"
+
+
+def decode(body: bytes, content_type: str | None = None) -> Any:
+    fmt = detect(content_type, body)
+    if fmt == "json":
+        return json.loads(body)
+    if fmt == "yaml":
+        import yaml
+        return yaml.safe_load(body)
+    return cbor_loads(body)
+
+
+def encode(obj: Any, fmt: str = "json") -> tuple[bytes, str]:
+    """-> (payload bytes, content type)."""
+    if fmt == "yaml":
+        import yaml
+        return (yaml.safe_dump(obj, default_flow_style=False,
+                               sort_keys=False).encode("utf-8"),
+                "application/yaml")
+    if fmt == "cbor":
+        return cbor_dumps(obj), "application/cbor"
+    return (json.dumps(obj).encode("utf-8"),
+            "application/json; charset=UTF-8")
+
+
+# ---------------------------------------------------------------------------
+# Minimal CBOR (RFC 7049): the JSON-shaped subset — ints, floats, strings,
+# bytes, bools, null, arrays, maps
+# ---------------------------------------------------------------------------
+
+def _head(major: int, arg: int) -> bytes:
+    if arg < 24:
+        return bytes([(major << 5) | arg])
+    if arg < 0x100:
+        return bytes([(major << 5) | 24, arg])
+    if arg < 0x10000:
+        return bytes([(major << 5) | 25]) + struct.pack(">H", arg)
+    if arg < 0x100000000:
+        return bytes([(major << 5) | 26]) + struct.pack(">I", arg)
+    return bytes([(major << 5) | 27]) + struct.pack(">Q", arg)
+
+
+def cbor_dumps(obj: Any) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def _enc(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(0xF6)
+    elif obj is True:
+        out.append(0xF5)
+    elif obj is False:
+        out.append(0xF4)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            out += _head(0, obj)
+        else:
+            out += _head(1, -1 - obj)
+    elif isinstance(obj, float):
+        out.append(0xFB)
+        out += struct.pack(">d", obj)
+    elif isinstance(obj, bytes):
+        out += _head(2, len(obj))
+        out += obj
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out += _head(3, len(b))
+        out += b
+    elif isinstance(obj, (list, tuple)):
+        out += _head(4, len(obj))
+        for v in obj:
+            _enc(v, out)
+    elif isinstance(obj, dict):
+        out += _head(5, len(obj))
+        for k, v in obj.items():
+            _enc(str(k), out)
+            _enc(v, out)
+    else:
+        raise TypeError(f"cannot CBOR-encode {type(obj).__name__}")
+
+
+def cbor_loads(data: bytes) -> Any:
+    obj, pos = _dec(data, 0)
+    return obj
+
+
+def _dec(data: bytes, pos: int) -> tuple[Any, int]:
+    ib = data[pos]
+    pos += 1
+    major, info = ib >> 5, ib & 0x1F
+    if major == 7:
+        if info == 20:
+            return False, pos
+        if info == 21:
+            return True, pos
+        if info == 22 or info == 23:
+            return None, pos
+        if info == 26:
+            return struct.unpack(">f", data[pos:pos + 4])[0], pos + 4
+        if info == 27:
+            return struct.unpack(">d", data[pos:pos + 8])[0], pos + 8
+        raise ValueError(f"unsupported CBOR simple value {info}")
+    if info < 24:
+        arg = info
+    elif info == 24:
+        arg = data[pos]
+        pos += 1
+    elif info == 25:
+        arg = struct.unpack(">H", data[pos:pos + 2])[0]
+        pos += 2
+    elif info == 26:
+        arg = struct.unpack(">I", data[pos:pos + 4])[0]
+        pos += 4
+    elif info == 27:
+        arg = struct.unpack(">Q", data[pos:pos + 8])[0]
+        pos += 8
+    else:
+        raise ValueError(f"unsupported CBOR length encoding {info}")
+    if major == 0:
+        return arg, pos
+    if major == 1:
+        return -1 - arg, pos
+    if major == 2:
+        return data[pos:pos + arg], pos + arg
+    if major == 3:
+        return data[pos:pos + arg].decode("utf-8"), pos + arg
+    if major == 4:
+        out = []
+        for _ in range(arg):
+            v, pos = _dec(data, pos)
+            out.append(v)
+        return out, pos
+    if major == 5:
+        m = {}
+        for _ in range(arg):
+            k, pos = _dec(data, pos)
+            v, pos = _dec(data, pos)
+            m[k] = v
+        return m, pos
+    if major == 6:                       # tag: skip, decode the content
+        return _dec(data, pos)
+    raise ValueError(f"unsupported CBOR major type {major}")

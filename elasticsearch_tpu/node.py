@@ -131,6 +131,28 @@ class NodeService:
         # routes each request class through its pool, overflow -> 429
         from .common.threadpool import ThreadPool
         self.thread_pool = ThreadPool()
+        # NodeEnvironment dir lock (ref env/NodeEnvironment.java:118 —
+        # an flock on the node dir so two nodes can't share data paths)
+        self._node_lock = open(os.path.join(data_path, "node.lock"), "w")
+        try:
+            import fcntl
+            fcntl.flock(self._node_lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._node_lock.close()
+            raise RuntimeError(
+                f"failed to obtain node lock on [{data_path}]: is another "
+                f"node using the same data path?") from None
+        # lifecycle state machine (ref common/component/Lifecycle.java)
+        from .common.lifecycle import Lifecycle
+        self.lifecycle = Lifecycle()
+        # plugins (ref plugins/PluginsService.java:91)
+        from .common.plugins import PluginsService
+        self.plugins = PluginsService(os.path.join(data_path, "plugins"))
+        # file-script hot reload via the resource watcher (ref watcher/
+        # ResourceWatcherService + config/scripts file scripts); the
+        # scripts-dir watcher attaches after search_templates exists below
+        from .common.watcher import ResourceWatcherService
+        self.watcher = ResourceWatcherService()
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
         # shard request cache: size-0 responses keyed by (body, reader
@@ -151,6 +173,13 @@ class NodeService:
         self._recover_indices()
         for svc in self.indices.values():
             svc.mappers.search_templates = self.search_templates
+        from .common.watcher import FileWatcher
+        scripts_dir = os.path.join(data_path, "scripts")
+        os.makedirs(scripts_dir, exist_ok=True)
+        self.watcher.add(FileWatcher(scripts_dir, _ScriptDirListener(self)))
+        self.watcher.start()     # interval thread: hot reload after boot
+        self.plugins.on_node_start(self)
+        self.lifecycle.move_to_started()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
 
@@ -1931,9 +1960,20 @@ class NodeService:
                 "search_batcher": self._batcher.stats()}
 
     def close(self) -> None:
+        if not self.lifecycle.move_to_closed():
+            return                      # idempotent double-close
+        self.watcher.stop()
+        if getattr(self, "_ttl_stop", None) is not None:
+            self._ttl_stop.set()
         for svc in self.indices.values():
             svc.close()
         self.thread_pool.shutdown()
+        try:
+            import fcntl
+            fcntl.flock(self._node_lock, fcntl.LOCK_UN)
+            self._node_lock.close()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -1971,6 +2011,39 @@ def _deep_merge(base: dict, patch: dict) -> dict:
         else:
             out[k] = v
     return out
+
+
+class _ScriptDirListener:
+    """FileWatcher listener: *.mustache / *.json files in <data>/scripts
+    become stored search templates named by file stem (the reference's
+    config/scripts file scripts, hot-reloaded by the resource watcher)."""
+
+    def __init__(self, node: "NodeService"):
+        self.node = node
+
+    def _load(self, path: str) -> None:
+        stem, ext = os.path.splitext(os.path.basename(path))
+        if ext not in (".mustache", ".json"):
+            return
+        try:
+            with open(path) as f:
+                content = f.read()
+        except OSError:
+            return
+        self.node.search_templates[stem] = content
+        for svc in self.node.indices.values():
+            svc.mappers.search_templates = self.node.search_templates
+
+    def on_file_created(self, path: str) -> None:
+        self._load(path)
+
+    def on_file_changed(self, path: str) -> None:
+        self._load(path)
+
+    def on_file_deleted(self, path: str) -> None:
+        stem, ext = os.path.splitext(os.path.basename(path))
+        if ext in (".mustache", ".json"):
+            self.node.search_templates.pop(stem, None)
 
 
 def _parse_bytes(v: str) -> int:

@@ -1983,6 +1983,19 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                                 aliases=_TP_ALIAS)
     c.register("GET", "/_cat/thread_pool", cat_thread_pool)
 
+    def cat_plugins(g, p, b):
+        # ref rest/action/cat/RestPluginsAction
+        infos = node.plugins.infos() if getattr(node, "plugins", None) \
+            else []
+        rows = [{"name": "tpu-node-0", "component": i["name"],
+                 "version": i["version"], "type": "j",
+                 "description": i["description"]} for i in infos]
+        return 200, _cat.render(p, [
+            ("name", "node name"), ("component", "plugin name"),
+            ("version", "plugin version"), ("type", "plugin type"),
+            ("description", "plugin description")], rows)
+    c.register("GET", "/_cat/plugins", cat_plugins)
+
     def cat_segments(g, p, b):
         rows = []
         for n in sorted(node._resolve(g.get("index", "_all"))):
@@ -2365,7 +2378,9 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                            "build": "tensor-native",
                            "os": {}, "jvm": {},
                            "transport": {"profiles": {}},
-                           "http": {}, "plugins": []}}}
+                           "http": {},
+                           "plugins": getattr(node, "plugins", None)
+                           and node.plugins.infos() or []}}}
     c.register("GET", "/_nodes", nodes_info)
     c.register("GET", "/_nodes/{metric}", nodes_info)
 
@@ -2567,6 +2582,10 @@ class HttpServer:
     def __init__(self, node: NodeService, host: str = "127.0.0.1",
                  port: int = 9200, registrar: Callable | None = None):
         self.controller = RestController(node, registrar=registrar)
+        if getattr(node, "plugins", None) is not None:
+            # plugins may contribute REST endpoints (ref PluginsService +
+            # RestModule handler registration)
+            node.plugins.register_routes(self.controller, node)
         controller = self.controller
 
         class Handler(BaseHTTPRequestHandler):
@@ -2580,6 +2599,24 @@ class HttpServer:
                 params = parse_qs(parsed.query)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # XContent seam (common/xcontent.py; ref XContentFactory):
+                # YAML/CBOR request bodies normalize to JSON at the edge so
+                # every handler stays single-format
+                ctype_in = self.headers.get("Content-Type") or ""
+                if body and ("yaml" in ctype_in or "cbor" in ctype_in
+                             or "smile" in ctype_in):
+                    from ..common import xcontent
+                    try:
+                        body = json.dumps(
+                            xcontent.decode(body, ctype_in)).encode()
+                    except Exception as e:  # noqa: BLE001 — yaml/cbor
+                        # parsers raise their own types; ALL malformed
+                        # bodies must 406, never drop the connection
+                        self._reply(406, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}",
+                             "status": 406}).encode(),
+                            "application/json; charset=UTF-8", method)
+                        return
                 try:
                     # admission control: each request class runs on its
                     # named bounded pool; queue overflow -> 429 before any
@@ -2598,15 +2635,26 @@ class HttpServer:
                     status = _status_of(e)
                     payload = {"error": f"{type(e).__name__}: {e}",
                                "status": status}
+                fmt = params.get("format", [None])[0]
                 if isinstance(payload, bytes):
                     data = payload           # pre-serialized JSON fast lane
                     ctype = "application/json; charset=UTF-8"
                 elif isinstance(payload, str):
                     data = payload.encode("utf-8")
                     ctype = "text/plain; charset=UTF-8"
+                elif fmt in ("yaml", "cbor"):
+                    from ..common import xcontent
+                    try:
+                        data, ctype = xcontent.encode(payload, fmt)
+                    except Exception:  # noqa: BLE001 — unencodable value:
+                        data = json.dumps(payload).encode()  # JSON fallback
+                        ctype = "application/json; charset=UTF-8"
                 else:
                     data = json.dumps(payload).encode("utf-8")
                     ctype = "application/json; charset=UTF-8"
+                self._reply(status, data, ctype, method)
+
+            def _reply(self, status, data, ctype, method):
                 if method == "HEAD":
                     data = b""
                 self.send_response(status)

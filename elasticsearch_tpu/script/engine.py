@@ -103,6 +103,9 @@ def _delete(target: ast.expr, env: _Env) -> None:
 
 
 def _resolve_container(target: ast.expr, env: _Env):
+    if isinstance(target, ast.Name):
+        # script-local variable (scripted_metric combine/reduce temps)
+        return env.names, target.id
     if isinstance(target, ast.Attribute):
         obj = _eval(target.value, env)
         if not isinstance(obj, dict):
@@ -192,6 +195,26 @@ def _eval(node: ast.expr, env: _Env) -> Any:
     raise ScriptException(f"expression not allowed: {type(node).__name__}")
 
 
+def doc_values_view(source: dict) -> dict:
+    """`doc['field'].value` accessor view over a stored source — flattened
+    dotted paths, each with value/values/empty (the lang-expression doc
+    contract; shared by script queries, script_fields and scripted_metric
+    so every script dialect sees the same shape)."""
+    def flatten(obj, prefix=""):
+        out = {}
+        for k, v in (obj or {}).items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(flatten(v, path + "."))
+            else:
+                out[path] = v if isinstance(v, list) else [v]
+        return out
+
+    return {f: {"value": (vs[0] if vs else None), "values": vs,
+                "empty": not vs}
+            for f, vs in flatten(source).items()}
+
+
 def run_search_script(script, source: dict, params: dict | None = None):
     """Evaluate a SEARCH-time expression over one doc (script_fields /
     script query; ref script/expression/ExpressionScriptEngineService —
@@ -206,19 +229,7 @@ def run_search_script(script, source: dict, params: dict | None = None):
         code = str(script)
     params = params or {}
 
-    def flatten(obj, prefix=""):
-        out = {}
-        for k, v in (obj or {}).items():
-            path = f"{prefix}{k}"
-            if isinstance(v, dict):
-                out.update(flatten(v, path + "."))
-            else:
-                out[path] = v if isinstance(v, list) else [v]
-        return out
-
-    doc = {f: {"value": (vs[0] if vs else None), "values": vs,
-               "empty": not vs}
-           for f, vs in flatten(source).items()}
+    doc = doc_values_view(source)
     env = _Env({"_source": source}, params)
     env.names["doc"] = doc
     env.names["_source"] = source
@@ -230,3 +241,28 @@ def run_search_script(script, source: dict, params: dict | None = None):
     if isinstance(out, int) and not isinstance(out, bool):
         return float(out)
     return out
+
+
+def run_agg_script(script, names: dict, params: dict | None = None) -> None:
+    """Execute statements against caller-provided names (scripted_metric's
+    _agg / doc / _aggs environment; ref metrics/scripted/
+    ScriptedMetricAggregator). Mutates the passed objects in place; returns
+    the value of a trailing bare expression, if any."""
+    if isinstance(script, dict):
+        code = script.get("inline") or script.get("source") or ""
+        params = params or script.get("params") or {}
+    else:
+        code = str(script)
+    try:
+        tree = ast.parse(code, mode="exec")
+    except SyntaxError as e:
+        raise ScriptException(f"script parse error: {e}") from e
+    env = _Env({}, params or {})
+    env.names.update(names)
+    result = None
+    for i, stmt in enumerate(tree.body):
+        if i == len(tree.body) - 1 and isinstance(stmt, ast.Expr):
+            result = _eval(stmt.value, env)
+        else:
+            _exec_stmt(stmt, env)
+    return result

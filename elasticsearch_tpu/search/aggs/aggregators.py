@@ -41,9 +41,11 @@ from .tdigest import TDigest
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing",
-                "significant_terms", "nested", "reverse_nested", "children"}
+                "significant_terms", "nested", "reverse_nested", "children",
+                "geohash_grid", "geo_distance", "sampler"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
-                "extended_stats", "cardinality", "percentiles", "top_hits"}
+                "extended_stats", "cardinality", "percentiles", "top_hits",
+                "geo_bounds", "scripted_metric"}
 
 
 def has_top_hits(specs: list["AggSpec"]) -> bool:
@@ -603,6 +605,11 @@ def _empty_partial(spec: AggSpec) -> dict:
         return {"buckets": {}}
     if spec.type == "top_hits":
         return {"total": 0, "top": []}
+    if spec.type == "geo_bounds":
+        return {"top": -math.inf, "bottom": math.inf,
+                "left": math.inf, "right": -math.inf}
+    if spec.type == "scripted_metric":
+        return {"states": []}
     return _metric_collect(spec, np.zeros(0), np.zeros(0, bool))
 
 
@@ -663,6 +670,52 @@ def _metric_segment(spec: AggSpec, seg: Segment, mask) -> dict:
                     "min": float(mn) if cnt else math.inf,
                     "max": float(mx) if cnt else -math.inf}
     mask = mask.np
+    if spec.type == "geo_bounds" and field:
+        # ref search/aggregations/metrics/geobounds/GeoBoundsAggregator
+        la = _numeric_column(seg, f"{field}.lat")
+        lo = _numeric_column(seg, f"{field}.lon")
+        if la is None or lo is None:
+            return {"top": -math.inf, "bottom": math.inf,
+                    "left": math.inf, "right": -math.inf}
+        sel = mask & la[1][:len(mask)] & lo[1][:len(mask)]
+        if not sel.any():
+            return {"top": -math.inf, "bottom": math.inf,
+                    "left": math.inf, "right": -math.inf}
+        lats = la[0][sel]
+        lons = lo[0][sel]
+        return {"top": float(lats.max()), "bottom": float(lats.min()),
+                "left": float(lons.min()), "right": float(lons.max())}
+    if spec.type == "scripted_metric":
+        # ref search/aggregations/metrics/scripted/ScriptedMetricAggregator:
+        # init/map per doc (AST-whitelisted dialect, script/engine.py),
+        # combine per segment; partials carry per-segment states for the
+        # final reduce_script at render time
+        from ...script.engine import run_agg_script
+        params = dict(spec.params.get("params") or {})
+        agg: dict = {}
+        if spec.params.get("init_script"):
+            run_agg_script(spec.params["init_script"], {"_agg": agg},
+                           params)
+        map_src = spec.params.get("map_script")
+        if map_src:
+            from ...script.engine import doc_values_view
+            for d in np.flatnonzero(mask[: seg.n_docs]):
+                d = int(d)
+                if not seg.live_host[d] or seg.types[d].startswith("__"):
+                    continue
+                # same doc['field'].value accessor view as script queries
+                # and script_fields — one dialect everywhere
+                run_agg_script(
+                    map_src,
+                    {"_agg": agg, "doc": doc_values_view(seg.stored[d]),
+                     "_source": seg.stored[d]}, params)
+        state = agg
+        if spec.params.get("combine_script"):
+            out = run_agg_script(spec.params["combine_script"],
+                                 {"_agg": agg}, params)
+            if out is not None:
+                state = out
+        return {"states": [state]}
     if spec.type == "cardinality" and field:
         kw = _keyword_column(seg, field)
         if kw is not None:
@@ -914,6 +967,75 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask,
             out[key] = e
         return {"buckets": out}
 
+    if t == "geohash_grid":
+        # ref search/aggregations/bucket/geogrid/GeoHashGridAggregator:
+        # bucket key = the doc's geohash cell at `precision`
+        field = p["field"]
+        la = _numeric_column(seg, f"{field}.lat")
+        lo = _numeric_column(seg, f"{field}.lon")
+        if la is None or lo is None:
+            return {"buckets": {}}
+        from ..geo import encode_geohash
+        precision = int(p.get("precision", 5))
+        sel = mask & la[1][:len(mask)] & lo[1][:len(mask)]
+        idx = np.flatnonzero(sel)
+        keys = np.array([encode_geohash(float(la[0][d]), float(lo[0][d]),
+                                        precision) for d in idx])
+        out = {}
+        for u in np.unique(keys) if len(idx) else []:
+            m = np.zeros(n, bool)
+            m[idx[keys == u]] = True
+            out[str(u)] = _bucket_entry(spec, seg, m, qp, scores_row)
+        return {"buckets": out}
+
+    if t == "geo_distance":
+        # ref search/aggregations/bucket/range/geodistance/
+        # GeoDistanceParser: range buckets over haversine distance from an
+        # origin point, in the requested unit
+        from ..geo import parse_geo_point, unit_meters
+        field = p["field"]
+        la = _numeric_column(seg, f"{field}.lat")
+        lo = _numeric_column(seg, f"{field}.lon")
+        if la is None or lo is None:
+            return {"buckets": {}}
+        from ..geo import haversine_m
+        olat, olon = parse_geo_point(p["origin"])
+        unit = unit_meters(str(p.get("unit", "m")))
+        dist = np.asarray(haversine_m(olat, olon, la[0], lo[0])) / unit
+        sel = mask & la[1][:len(mask)] & lo[1][:len(mask)]
+        out = {}
+        for r in p.get("ranges", []):
+            lo_v = r.get("from")
+            hi_v = r.get("to")
+            key = r.get("key") or (
+                f"{'*' if lo_v is None else float(lo_v)}-"
+                f"{'*' if hi_v is None else float(hi_v)}")
+            m = sel.copy()
+            if lo_v is not None:
+                m &= dist >= float(lo_v)
+            if hi_v is not None:
+                m &= dist < float(hi_v)
+            e = _bucket_entry(spec, seg, m, qp, scores_row)
+            e["from"] = None if lo_v is None else float(lo_v)
+            e["to"] = None if hi_v is None else float(hi_v)
+            out[key] = e
+        return {"buckets": out}
+
+    if t == "sampler":
+        # ref search/aggregations/bucket/sampler/SamplerAggregator: sub-aggs
+        # run over only the TOP-scoring shard_size matched docs
+        shard_size = int(p.get("shard_size", 100))
+        sel = np.flatnonzero(mask)
+        if scores_row is not None and len(sel) > shard_size:
+            sc = np.asarray(scores_row)[sel].astype(np.float64)
+            keep = sel[np.argsort(-sc, kind="stable")[:shard_size]]
+        else:
+            keep = sel[:shard_size]
+        m = np.zeros(n, bool)
+        m[keep] = True
+        return {"buckets": {"_sample": _bucket_entry(spec, seg, m, qp,
+                                                     scores_row)}}
+
     if t == "children":
         raise AggregationParsingException(
             "children aggregation is supported at the top of the agg tree "
@@ -1067,6 +1189,13 @@ def _merge_metric(spec: AggSpec, a: dict, b: dict) -> dict:
     if spec.type == "percentiles":
         return {"tdigest": a["tdigest"].merge(b["tdigest"]),
                 "percents": a.get("percents", b.get("percents"))}
+    if spec.type == "geo_bounds":
+        return {"top": max(a["top"], b["top"]),
+                "bottom": min(a["bottom"], b["bottom"]),
+                "left": min(a["left"], b["left"]),
+                "right": max(a["right"], b["right"])}
+    if spec.type == "scripted_metric":
+        return {"states": a.get("states", []) + b.get("states", [])}
     return {"count": a["count"] + b["count"], "sum": a["sum"] + b["sum"],
             "min": min(a["min"], b["min"]), "max": max(a["max"], b["max"]),
             "sum_sq": a["sum_sq"] + b["sum_sq"]}
@@ -1239,7 +1368,24 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
         return {"buckets": {k: rb(k, e, key_field=False)
                             for k, e in buckets.items()}}
 
-    # filter / global / missing: single anonymous bucket
+    if t == "geohash_grid":
+        size = int(spec.params.get("size", 10_000)) or len(buckets)
+        items = sorted(buckets.items(), key=lambda kv: str(kv[0]))
+        items.sort(key=lambda kv: kv[1]["doc_count"], reverse=True)
+        return {"buckets": [rb(k, e) for k, e in items[:size]]}
+
+    if t == "geo_distance":
+        ordered = []
+        for r in spec.params.get("ranges", []):
+            lo_v, hi_v = r.get("from"), r.get("to")
+            key = r.get("key") or (
+                f"{'*' if lo_v is None else float(lo_v)}-"
+                f"{'*' if hi_v is None else float(hi_v)}")
+            if key in buckets:
+                ordered.append((key, buckets[key]))
+        return {"buckets": [rb(k, e) for k, e in ordered]}
+
+    # filter / global / missing / sampler: single anonymous bucket
     entry = next(iter(buckets.values()), {"doc_count": 0})
     out = {"doc_count": entry["doc_count"]}
     for s in spec.subs:
@@ -1258,6 +1404,22 @@ def _render_metric(spec: AggSpec, p: dict) -> dict:
                          "hits": hits}}
     if t == "cardinality":
         return {"value": p["hll"].cardinality()}
+    if t == "geo_bounds":
+        if p["top"] == -math.inf:
+            return {}                  # no located docs: empty bounds
+        return {"bounds": {
+            "top_left": {"lat": p["top"], "lon": p["left"]},
+            "bottom_right": {"lat": p["bottom"], "lon": p["right"]}}}
+    if t == "scripted_metric":
+        states = p.get("states", [])
+        reduce_src = spec.params.get("reduce_script")
+        if reduce_src:
+            from ...script.engine import run_agg_script
+            value = run_agg_script(
+                reduce_src, {"_aggs": states},
+                dict(spec.params.get("params") or {}))
+            return {"value": value}
+        return {"value": states if len(states) != 1 else states[0]}
     if t == "percentiles":
         td = p["tdigest"]
         percents = p.get("percents") or [1, 5, 25, 50, 75, 95, 99]

@@ -796,6 +796,72 @@ class GeoDistanceNode(Node):
 
 
 @dataclass
+class GeoPolygonNode(Node):
+    """geo_polygon filter (ref index/query/GeoPolygonFilterParser +
+    common/geo — point-in-polygon). Even-odd ray casting, vectorized over
+    the lat/lon doc-value columns on the host (polygon vertex counts are
+    tiny; the column scan is the work and numpy handles it)."""
+    field_name: str = ""
+    points: tuple = ()               # ((lat, lon), ...)
+
+    def execute(self, ctx):
+        import numpy as _np
+        seg = ctx.segment
+        la = seg.numerics.get(self.field_name + ".lat")
+        lo = seg.numerics.get(self.field_name + ".lon")
+        if la is None or lo is None or len(self.points) < 3:
+            return _zeros(ctx), _false(ctx)
+        y = _np.asarray(la.vals, _np.float64)
+        x = _np.asarray(lo.vals, _np.float64)
+        inside = _np.zeros(len(y), bool)
+        pts = list(self.points)
+        j = len(pts) - 1
+        for i in range(len(pts)):
+            yi, xi = pts[i]
+            yj, xj = pts[j]
+            cond = ((yi > y) != (yj > y)) \
+                & (x < (xj - xi) * (y - yi) / ((yj - yi) or 1e-12) + xi)
+            inside ^= cond
+            j = i
+        ok = jnp.asarray(inside) & ~la.missing & ~lo.missing
+        match = jnp.broadcast_to(ok[None, :], (ctx.Q, ctx.n_pad))
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("geo_polygon", self.field_name, self.points)
+
+
+@dataclass
+class ScriptQueryNode(Node):
+    """script query (ref index/query/ScriptFilterParser): the expression
+    evaluates per live doc against its source — an explicitly-scripted
+    host filter, same contract as the reference's script filter."""
+    script: Any = None
+    params: Any = None
+
+    def execute(self, ctx):
+        import numpy as _np
+        from ..script.engine import ScriptException, run_search_script
+        seg = ctx.segment
+        ok = _np.zeros(ctx.n_pad, bool)
+        for d in range(seg.n_docs):
+            if not seg.live_host[d] or seg.types[d].startswith("__"):
+                continue
+            try:
+                v = run_search_script(self.script, seg.stored[d],
+                                      params=self.params)
+            except ScriptException:
+                v = False
+            ok[d] = bool(v)
+        match = jnp.broadcast_to(jnp.asarray(ok)[None, :],
+                                 (ctx.Q, ctx.n_pad))
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        raise TypeError("script queries never batch")
+
+
+@dataclass
 class CommonTermsNode(Node):
     """common terms query (ref index/query/CommonTermsQueryParser +
     Lucene CommonTermsQuery): terms above cutoff_frequency become optional

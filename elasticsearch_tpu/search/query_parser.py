@@ -288,6 +288,55 @@ class QueryParser:
                              boost=float(spec.get("boost", 1.0)),
                              **self._sim_kw(field))
 
+    def _parse_span_not(self, spec: dict) -> Node:
+        """span_not (ref SpanNotQueryParser): include-spans minus docs where
+        the exclude span matches. DOC-level subtraction — the reference
+        subtracts only OVERLAPPING spans; for the common single-occurrence
+        case the two agree, and the deviation is documented here."""
+        inc = self.parse(spec["include"])
+        exc = self.parse(spec["exclude"])
+        from .query_dsl import BoolNode
+        return BoolNode(must=[inc], must_not=[exc],
+                        boost=float(spec.get("boost", 1.0)))
+
+    def _parse_span_multi(self, spec: dict) -> Node:
+        """span_multi (ref SpanMultiTermQueryParser): a multi-term query
+        (prefix/wildcard/fuzzy/regexp/range) lifted into span context.
+        Standalone span_multi matches exactly the docs its inner query
+        matches, so it parses to the inner node directly; embedding inside
+        other span clauses is not supported."""
+        inner = spec.get("match")
+        if not isinstance(inner, dict):
+            raise QueryParsingException("span_multi requires a [match] "
+                                        "multi-term query")
+        return self.parse(inner)
+
+    def _parse_script(self, spec: dict) -> Node:
+        from .query_dsl import ScriptQueryNode
+        script = spec.get("script") or spec.get("inline") \
+            or spec.get("source")
+        if script is None:
+            raise QueryParsingException("script query requires a script")
+        return ScriptQueryNode(script=script, params=spec.get("params"),
+                               boost=float(spec.get("boost", 1.0)))
+
+    def _parse_geo_polygon(self, spec: dict) -> Node:
+        spec = {k: v for k, v in spec.items()
+                if k not in ("_name", "coerce", "ignore_malformed",
+                             "validation_method")}
+        boost = float(spec.pop("boost", 1.0))
+        if len(spec) != 1:
+            raise QueryParsingException(
+                "geo_polygon needs exactly one geo field")
+        (field, params), = spec.items()
+        from .geo import parse_geo_point
+        from .query_dsl import GeoPolygonNode
+        pts = tuple(parse_geo_point(p) for p in params.get("points", []))
+        if len(pts) < 3:
+            raise QueryParsingException(
+                "geo_polygon requires at least 3 points")
+        return GeoPolygonNode(field_name=field, points=pts, boost=boost)
+
     def _parse_geo_distance(self, spec: dict) -> Node:
         spec = {k: v for k, v in spec.items()
                 if k not in ("distance_type", "optimize_bbox", "_name",

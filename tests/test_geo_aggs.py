@@ -1,0 +1,103 @@
+"""geohash_grid / geo_distance / geo_bounds / scripted_metric / sampler.
+
+Reference model: search/aggregations/bucket/geogrid/GeoHashGridAggregator,
+bucket/range/geodistance/GeoDistanceParser, metrics/geobounds/
+GeoBoundsAggregator, metrics/scripted/ScriptedMetricAggregator,
+bucket/sampler/SamplerAggregator.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("geoagg")))
+    n.create_index("places", mappings={"_doc": {"properties": {
+        "name": {"type": "string"},
+        "loc": {"type": "geo_point"},
+        "price": {"type": "long"}}}})
+    docs = [
+        ("amsterdam1", 52.37, 4.89, 10),
+        ("amsterdam2", 52.38, 4.90, 20),
+        ("berlin", 52.52, 13.40, 30),
+        ("sydney", -33.87, 151.21, 40),
+    ]
+    for name, lat, lon, price in docs:
+        n.index_doc("places", name, {"name": name,
+                                     "loc": {"lat": lat, "lon": lon},
+                                     "price": price})
+    n.refresh("places")
+    yield n
+    n.close()
+
+
+def agg(node, spec):
+    out = node.search("places", {"size": 0, "query": {"match_all": {}},
+                                 "aggs": spec})
+    return out["aggregations"]
+
+
+def test_geohash_grid(node):
+    out = agg(node, {"cells": {"geohash_grid": {"field": "loc",
+                                                "precision": 3}}})
+    buckets = {b["key"]: b["doc_count"] for b in out["cells"]["buckets"]}
+    # the two amsterdam docs share a 3-char cell; berlin and sydney differ
+    assert max(buckets.values()) == 2
+    assert len(buckets) == 3
+    # buckets come back count-descending
+    counts = [b["doc_count"] for b in out["cells"]["buckets"]]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_geo_distance_ranges(node):
+    out = agg(node, {"near": {"geo_distance": {
+        "field": "loc", "origin": {"lat": 52.37, "lon": 4.89},
+        "unit": "km",
+        "ranges": [{"to": 100}, {"from": 100, "to": 1000},
+                   {"from": 1000}]}}})
+    b = out["near"]["buckets"]
+    assert [x["doc_count"] for x in b] == [2, 1, 1]
+    assert b[0]["to"] == 100.0
+    assert b[1]["from"] == 100.0 and b[1]["to"] == 1000.0
+
+
+def test_geo_bounds(node):
+    out = agg(node, {"box": {"geo_bounds": {"field": "loc"}}})
+    b = out["box"]["bounds"]
+    assert b["top_left"]["lat"] == pytest.approx(52.52)
+    assert b["top_left"]["lon"] == pytest.approx(4.89)
+    assert b["bottom_right"]["lat"] == pytest.approx(-33.87)
+    assert b["bottom_right"]["lon"] == pytest.approx(151.21)
+
+
+def test_scripted_metric(node):
+    out = agg(node, {"total": {"scripted_metric": {
+        "init_script": "_agg.sum = 0",
+        "map_script": "_agg.sum += doc.price.value",
+        "reduce_script":
+            "total = 0\n"
+            "if _aggs == _aggs:\n"
+            "    total = params.base\n"
+            "total + _aggs[0].sum",
+        "params": {"base": 0}}}})
+    # single shard/segment: one state; reduce sums it
+    assert out["total"]["value"] == 100
+
+
+def test_sampler_limits_sub_agg_population(node):
+    out = agg(node, {"sample": {"sampler": {"shard_size": 2},
+                                "aggs": {"avg_price": {
+                                    "avg": {"field": "price"}}}}})
+    assert out["sample"]["doc_count"] == 2
+    assert out["sample"]["avg_price"]["value"] is not None
+
+
+def test_geo_distance_sub_aggs(node):
+    out = agg(node, {"near": {"geo_distance": {
+        "field": "loc", "origin": "52.37,4.89", "unit": "km",
+        "ranges": [{"to": 100}]},
+        "aggs": {"p": {"stats": {"field": "price"}}}}})
+    b = out["near"]["buckets"][0]
+    assert b["doc_count"] == 2 and b["p"]["sum"] == 30

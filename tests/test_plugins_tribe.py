@@ -1,0 +1,155 @@
+"""Plugins, ResourceWatcher file scripts, NodeEnvironment lock, lifecycle,
+tribe node.
+
+Reference model: plugins/PluginsService.java:91, watcher/
+ResourceWatcherService.java, env/NodeEnvironment.java:118 (dir locks),
+common/component/Lifecycle.java, tribe/TribeService.java:63.
+"""
+
+import json
+import os
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+
+def test_plugin_discovery_and_hooks(tmp_path):
+    pdir = tmp_path / "plugins" / "myplug"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.json").write_text(json.dumps(
+        {"name": "myplug", "version": "1.2", "description": "test plugin",
+         "module": "plug.py"}))
+    (pdir / "plug.py").write_text(
+        "def init(node):\n"
+        "    node.plugin_inited = True\n"
+        "def register_routes(c, node):\n"
+        "    c.register('GET', '/_myplug',\n"
+        "               lambda g, p, b: (200, {'plug': 'ok'}))\n")
+    node = NodeService(str(tmp_path))
+    try:
+        assert [p.name for p in node.plugins.plugins] == ["myplug"]
+        assert node.plugin_inited is True
+        from elasticsearch_tpu.rest import HttpServer
+        import urllib.request
+        srv = HttpServer(node, port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_myplug") as r:
+                assert json.loads(r.read()) == {"plug": "ok"}
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_nodes") as r:
+                info = json.loads(r.read())
+            assert info["nodes"]["tpu-node-0"]["plugins"][0]["name"] \
+                == "myplug"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_cat/plugins") as r:
+                assert b"myplug" in r.read()
+        finally:
+            srv.stop()
+    finally:
+        node.close()
+
+
+def test_broken_plugin_does_not_kill_node(tmp_path):
+    pdir = tmp_path / "plugins" / "broken"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.json").write_text(json.dumps(
+        {"name": "broken", "module": "nope.py"}))
+    node = NodeService(str(tmp_path))
+    try:
+        assert node.plugins.plugins == []
+        assert node.plugins.load_errors
+    finally:
+        node.close()
+
+
+def test_file_scripts_hot_reload(tmp_path):
+    node = NodeService(str(tmp_path))
+    try:
+        sdir = tmp_path / "scripts"
+        (sdir / "bytag.mustache").write_text(
+            '{"query": {"match": {"tag": "{{t}}"}}}')
+        node.watcher.check_now()
+        assert "bytag" in node.search_templates
+        node.create_index("ft")
+        node.index_doc("ft", "1", {"tag": "red"})
+        node.refresh("ft")
+        out = node.search("ft", {"template": {"id": "bytag",
+                                              "params": {"t": "red"}}})
+        assert out["hits"]["total"] == 1
+        (sdir / "bytag.mustache").unlink()
+        node.watcher.check_now()
+        assert "bytag" not in node.search_templates
+    finally:
+        node.close()
+
+
+def test_node_dir_lock(tmp_path):
+    node = NodeService(str(tmp_path))
+    try:
+        with pytest.raises(RuntimeError, match="node lock"):
+            NodeService(str(tmp_path))
+    finally:
+        node.close()
+    # released on close: a new node can use the path
+    node2 = NodeService(str(tmp_path))
+    node2.close()
+
+
+def test_lifecycle_states(tmp_path):
+    from elasticsearch_tpu.common.lifecycle import (Lifecycle,
+                                                    IllegalStateTransition)
+    lc = Lifecycle()
+    assert not lc.started
+    assert lc.move_to_started() and lc.started
+    assert lc.move_to_stopped()
+    assert lc.move_to_started()           # restartable from STOPPED
+    assert lc.move_to_closed() and lc.closed
+    with pytest.raises(IllegalStateTransition):
+        lc.move_to_started()
+    node = NodeService(str(tmp_path))
+    assert node.lifecycle.started
+    node.close()
+    assert node.lifecycle.closed
+    node.close()                          # idempotent
+
+
+def test_tribe_node_reads_two_clusters(tmp_path):
+    from elasticsearch_tpu.cluster.tribe import TribeNode, TribeWriteException
+    a = NodeService(str(tmp_path / "a"))
+    b = NodeService(str(tmp_path / "b"))
+    try:
+        a.create_index("logs")
+        a.index_doc("logs", "1", {"body": "alpha event"})
+        a.refresh("logs")
+        b.create_index("docs")
+        b.index_doc("docs", "2", {"body": "alpha paper"})
+        b.refresh("docs")
+        # conflict: both clusters own "shared" — preference order wins
+        a.create_index("shared")
+        a.index_doc("shared", "a-doc", {"body": "from a"})
+        a.refresh("shared")
+        b.create_index("shared")
+        b.index_doc("shared", "b-doc", {"body": "from b"})
+        b.refresh("shared")
+
+        tribe = TribeNode({"t1": a, "t2": b})
+        st = tribe.cluster_state()
+        assert st["indices"]["logs"]["cluster"] == "t1"
+        assert st["indices"]["docs"]["cluster"] == "t2"
+        assert st["indices"]["shared"]["cluster"] == "t1"   # prefer first
+
+        out = tribe.search("_all", {"query": {"match": {"body": "alpha"}}})
+        assert out["hits"]["total"] == 2
+        assert {h["_index"] for h in out["hits"]["hits"]} \
+            == {"logs", "docs"}
+        out = tribe.search("shared", {"query": {"match_all": {}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["a-doc"]
+        got = tribe.get_doc("docs", "2")
+        assert got.found
+        with pytest.raises(TribeWriteException):
+            tribe.index_doc("logs", "9", {"x": 1})
+    finally:
+        a.close()
+        b.close()

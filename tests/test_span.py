@@ -100,3 +100,56 @@ class TestSpans:
             {"span_term": {"body": "beta"}}],
             "slop": 0, "in_order": True}}
         assert _ids(node, q) == {"exact", "late", "extra"}
+
+
+class TestNewSpanAndScriptQueries:
+    def test_span_not(self, tmp_path):
+        from elasticsearch_tpu.node import NodeService
+        node = NodeService(str(tmp_path / "sn"))
+        node.create_index("s")
+        node.index_doc("s", "1", {"body": "quick brown fox"})
+        node.index_doc("s", "2", {"body": "quick red fox"})
+        node.refresh("s")
+        out = node.search("s", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_term": {"body": "brown"}}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["2"]
+        node.close()
+
+    def test_span_multi_prefix(self, tmp_path):
+        from elasticsearch_tpu.node import NodeService
+        node = NodeService(str(tmp_path / "sm"))
+        node.create_index("s")
+        node.index_doc("s", "1", {"body": "quarterly report"})
+        node.index_doc("s", "2", {"body": "annual report"})
+        node.refresh("s")
+        out = node.search("s", {"query": {"span_multi": {
+            "match": {"prefix": {"body": {"value": "quart"}}}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+        node.close()
+
+    def test_script_query(self, tmp_path):
+        from elasticsearch_tpu.node import NodeService
+        node = NodeService(str(tmp_path / "sq"))
+        node.create_index("s")
+        node.index_doc("s", "1", {"price": 10})
+        node.index_doc("s", "2", {"price": 99})
+        node.refresh("s")
+        out = node.search("s", {"query": {"bool": {"filter": [{"script": {
+            "script": 'doc["price"].value > 50'}}]}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["2"]
+        node.close()
+
+    def test_geo_polygon(self, tmp_path):
+        from elasticsearch_tpu.node import NodeService
+        node = NodeService(str(tmp_path / "gp"))
+        node.create_index("g", mappings={"_doc": {"properties": {
+            "loc": {"type": "geo_point"}}}})
+        node.index_doc("g", "in", {"loc": {"lat": 0.5, "lon": 0.5}})
+        node.index_doc("g", "out", {"loc": {"lat": 5.0, "lon": 5.0}})
+        node.refresh("g")
+        out = node.search("g", {"query": {"geo_polygon": {"loc": {
+            "points": [{"lat": 0, "lon": 0}, {"lat": 0, "lon": 1},
+                       {"lat": 1, "lon": 1}, {"lat": 1, "lon": 0}]}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["in"]
+        node.close()

@@ -210,7 +210,7 @@ def test_threadpool_submit_and_stats():
         with pytest.raises(ZeroDivisionError):
             tp.submit("index", lambda: 1 // 0).result(5)
         st = tp.stats()
-        assert st["search"]["threads"] == 3 * (os.cpu_count() or 4)
+        assert st["search"]["threads"] == max(32, 3 * (os.cpu_count() or 4))
         assert st["search"]["completed"] >= 1
         assert set(st) >= {"search", "index", "bulk", "get", "management",
                            "generic", "snapshot", "refresh"}

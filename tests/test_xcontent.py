@@ -1,0 +1,81 @@
+"""XContent multi-format bodies: YAML + CBOR in/out (ref common/xcontent/
+XContentType.java auto-detection; SMILE intentionally rejected with 406)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+
+
+def test_cbor_roundtrip():
+    doc = {"a": 1, "b": -7, "pi": 3.5, "s": "héllo", "yes": True,
+           "no": False, "nil": None, "list": [1, "two", {"x": 2 ** 40}]}
+    assert xcontent.cbor_loads(xcontent.cbor_dumps(doc)) == doc
+
+
+def test_detect():
+    assert xcontent.detect("application/json", b"{}") == "json"
+    assert xcontent.detect("application/yaml", b"a: 1") == "yaml"
+    assert xcontent.detect("application/cbor", b"\xa1") == "cbor"
+    assert xcontent.detect(None, b"\xa1aa\x01") == "cbor"      # sniffed map
+    assert xcontent.detect(None, b"---\na: 1") == "yaml"
+    with pytest.raises(ValueError):
+        xcontent.detect("application/smile", b"")
+
+
+@pytest.fixture
+def server(tmp_path):
+    node = NodeService(str(tmp_path))
+    srv = HttpServer(node, port=0).start()
+    yield srv.port
+    srv.stop()
+    node.close()
+
+
+def req(port, method, path, body=None, ctype=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                               data=body, method=method)
+    if ctype:
+        r.add_header("Content-Type", ctype)
+    try:
+        resp = urllib.request.urlopen(r)
+        return resp.status, resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+def test_yaml_request_and_response(server):
+    body = b"---\nquery:\n  match_all: {}\n"
+    req(server, "PUT", "/y/d/1",
+        json.dumps({"x": "hello"}).encode())
+    req(server, "POST", "/_refresh")
+    code, data, _ = req(server, "POST", "/y/_search", body,
+                        "application/yaml")
+    assert code == 200
+    assert json.loads(data)["hits"]["total"] == 1
+    code, data, ctype = req(server, "POST", "/y/_search?format=yaml", body,
+                            "application/yaml")
+    assert code == 200 and "yaml" in ctype
+    import yaml
+    assert yaml.safe_load(data)["hits"]["total"] == 1
+
+
+def test_cbor_request_and_response(server):
+    req(server, "PUT", "/c/d/1", json.dumps({"x": "bye"}).encode())
+    req(server, "POST", "/_refresh")
+    body = xcontent.cbor_dumps({"query": {"match_all": {}}})
+    code, data, ctype = req(server, "POST", "/c/_search?format=cbor", body,
+                            "application/cbor")
+    assert code == 200 and "cbor" in ctype
+    assert xcontent.cbor_loads(data)["hits"]["total"] == 1
+
+
+def test_smile_rejected_406(server):
+    code, data, _ = req(server, "POST", "/_search", b"\x3a\x29\x0a",
+                        "application/smile")
+    assert code == 406
+    assert b"SMILE" in data

@@ -51,6 +51,12 @@ A_SHARD_FAILED = "internal:cluster/shard/failed"
 A_CREATE_INDEX = "indices:admin/create"
 A_DELETE_INDEX = "indices:admin/delete"
 A_PUT_MAPPING = "indices:admin/mapping/put"
+A_PUT_ALIAS = "indices:admin/aliases/put"
+A_DELETE_ALIAS = "indices:admin/aliases/delete"
+A_UPDATE_SETTINGS = "indices:admin/settings/update"
+A_CLOSE_INDEX = "indices:admin/close"
+A_OPEN_INDEX = "indices:admin/open"
+A_SHARD_DATA = "internal:gateway/local/started_shards"
 A_REFRESH = "indices:admin/refresh"
 A_FLUSH = "indices:admin/flush"
 A_WRITE_P = "indices:data/write/op[p]"
@@ -112,6 +118,12 @@ class ClusterNode:
                 (A_CREATE_INDEX, self._on_create_index),
                 (A_DELETE_INDEX, self._on_delete_index),
                 (A_PUT_MAPPING, self._on_put_mapping),
+                (A_PUT_ALIAS, self._on_put_alias),
+                (A_DELETE_ALIAS, self._on_delete_alias),
+                (A_UPDATE_SETTINGS, self._on_update_settings),
+                (A_CLOSE_INDEX, self._on_close_index),
+                (A_OPEN_INDEX, self._on_open_index),
+                (A_SHARD_DATA, self._on_shard_data),
                 (A_REFRESH, self._on_refresh), (A_FLUSH, self._on_flush),
                 (A_WRITE_P, self._on_primary_write),
                 (A_WRITE_R, self._on_replica_write),
@@ -416,6 +428,162 @@ class ClusterNode:
         self.cluster.submit_task(f"delete-index[{name}]", task)
         return {"acknowledged": True}
 
+    # -- cluster-level metadata services (ref cluster/metadata/
+    #    MetaDataIndexAliasesService, MetaDataUpdateSettingsService,
+    #    MetaDataIndexStateService) ---------------------------------------
+
+    def put_alias(self, index: str, alias: str,
+                  props: dict | None = None) -> None:
+        self._master_call(A_PUT_ALIAS, {"index": index, "alias": alias,
+                                        "props": props or {}})
+
+    def delete_alias(self, index: str, alias: str) -> None:
+        self._master_call(A_DELETE_ALIAS, {"index": index, "alias": alias})
+
+    def update_index_settings(self, index: str, settings: dict) -> None:
+        self._master_call(A_UPDATE_SETTINGS, {"index": index,
+                                              "settings": settings})
+
+    def close_index(self, index: str) -> None:
+        self._master_call(A_CLOSE_INDEX, {"index": index})
+
+    def open_index(self, index: str) -> None:
+        self._master_call(A_OPEN_INDEX, {"index": index})
+
+    def _on_put_alias(self, from_id: str, req: dict) -> dict:
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(req["index"])
+            if meta is None:
+                raise KeyError(f"no such index [{req['index']}]")
+            aliases = meta.get("aliases")
+            if not isinstance(aliases, dict):     # legacy list form
+                aliases = {a: {} for a in (aliases or [])}
+            aliases[req["alias"]] = req.get("props") or {}
+            meta["aliases"] = aliases
+            return st
+        self.cluster.submit_task(f"put-alias[{req['alias']}]", task)
+        return {"acknowledged": True}
+
+    def _on_delete_alias(self, from_id: str, req: dict) -> dict:
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(req["index"])
+            if meta is None:
+                raise KeyError(f"no such index [{req['index']}]")
+            aliases = meta.get("aliases")
+            if isinstance(aliases, dict):
+                aliases.pop(req["alias"], None)
+            elif isinstance(aliases, list) and req["alias"] in aliases:
+                aliases.remove(req["alias"])
+            return st
+        self.cluster.submit_task(f"delete-alias[{req['alias']}]", task)
+        return {"acknowledged": True}
+
+    def _on_update_settings(self, from_id: str, req: dict) -> dict:
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(req["index"])
+            if meta is None:
+                raise KeyError(f"no such index [{req['index']}]")
+            settings = dict(meta.get("settings") or {})
+            settings.update(req.get("settings") or {})
+            meta["settings"] = settings
+            # a replica-count change RESIZES the routing table live
+            # (ref MetaDataUpdateSettingsService.updateSettings ->
+            # routing table rebuild + reallocation). Read the count from
+            # the UPDATE REQUEST (either key form) — the merged map holds
+            # stale creation-time values under the other key
+            upd = req.get("settings") or {}
+            nr = upd.get("index.number_of_replicas",
+                         upd.get("number_of_replicas"))
+            if nr is not None:
+                nr = int(nr)
+                for copies in st.routing.get(req["index"], []):
+                    replicas = [c for c in copies if not c["primary"]]
+                    # shed UNASSIGNED/INITIALIZING copies before STARTED
+                    # ones (the reference drops ignored/unassigned first)
+                    order = {UNASSIGNED: 0, INITIALIZING: 1, STARTED: 2}
+                    replicas.sort(key=lambda c: order.get(c["state"], 1))
+                    for surplus in replicas[: max(len(replicas) - nr, 0)]:
+                        copies.remove(surplus)
+                    for _ in range(nr - len(replicas)):
+                        copies.append({"node": None, "primary": False,
+                                       "state": UNASSIGNED})
+                allocate(st, decider=self.disk_decider)
+            return st
+        self.cluster.submit_task(f"update-settings[{req['index']}]", task)
+        return {"acknowledged": True}
+
+    def _on_close_index(self, from_id: str, req: dict) -> dict:
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(req["index"])
+            if meta is None:
+                raise KeyError(f"no such index [{req['index']}]")
+            meta["state"] = "close"
+            # deallocate: reconcilers drop local shards; data dirs remain
+            st.routing.pop(req["index"], None)
+            return st
+        self.cluster.submit_task(f"close-index[{req['index']}]", task)
+        return {"acknowledged": True}
+
+    def _on_open_index(self, from_id: str, req: dict) -> dict:
+        name = req["index"]
+        # gateway-style primary allocation: probe which nodes still hold
+        # shard data from before the close, and pin primaries there so
+        # reopening recovers the documents (ref gateway/
+        # GatewayAllocator primary-by-existing-copy allocation)
+        holders: dict[int, str] = {}
+        for node_id in sorted(self.cluster.current().nodes):
+            try:
+                if node_id == self.node_id:
+                    out = self._on_shard_data(self.node_id, {"index": name})
+                else:
+                    out = self.transport.send(node_id, A_SHARD_DATA,
+                                              {"index": name})
+            except (ConnectTransportException, RemoteTransportException):
+                continue
+            for sid in out.get("shards", []):
+                holders.setdefault(int(sid), node_id)
+
+        def task(cur: ClusterState) -> ClusterState:
+            st = cur.mutate()
+            meta = st.indices.get(name)
+            if meta is None:
+                raise KeyError(f"no such index [{name}]")
+            if meta.get("state") != "close":
+                return None
+            meta["state"] = "open"
+            settings = meta.get("settings") or {}
+
+            def get_s(key, default):
+                return settings.get(key, settings.get(f"index.{key}",
+                                                      default))
+            routing = new_index_routing(int(get_s("number_of_shards", 1)),
+                                        int(get_s("number_of_replicas", 1)))
+            for sid, copies in enumerate(routing):
+                node_id = holders.get(sid)
+                if node_id is not None and node_id in st.nodes:
+                    copies[0]["node"] = node_id
+                    copies[0]["state"] = INITIALIZING
+            st.routing[name] = routing
+            allocate(st, decider=self.disk_decider)
+            return st
+        self.cluster.submit_task(f"open-index[{name}]", task)
+        return {"acknowledged": True}
+
+    def _on_shard_data(self, from_id: str, req: dict) -> dict:
+        """Which shards of `index` have data dirs on this node (the
+        gateway allocator's TransportNodesListGatewayStartedShards)."""
+        base = os.path.join(self.data_path, "indices", req["index"])
+        out = []
+        if os.path.isdir(base):
+            for d in os.listdir(base):
+                if d.isdigit():
+                    out.append(int(d))
+        return {"shards": sorted(out)}
+
     def _on_put_mapping(self, from_id: str, req: dict) -> dict:
         def task(cur: ClusterState) -> ClusterState:
             st = cur.mutate()
@@ -449,13 +617,21 @@ class ClusterNode:
             # (ref indices/store/IndicesStore state-driven GC)
             assigned = {(i, s) for i, s, _ in
                         state.assigned_shards(self.node_id)}
+            closed = {i for i, m in state.indices.items()
+                      if (m or {}).get("state") == "close"}
             for key in [k for k in self._shards
                         if k not in assigned or k[0] not in state.indices]:
                 holder = self._shards.pop(key)
                 if holder.engine is not None:
                     holder.engine.close()
-                import shutil
-                shutil.rmtree(self._shard_path(*key), ignore_errors=True)
+                # a CLOSED index keeps its shard data on disk (the engine
+                # shuts down, the files stay for reopen — ref
+                # MetaDataIndexStateService close semantics); only deleted
+                # or relocated-away shards GC their directories
+                if key[0] not in closed:
+                    import shutil
+                    shutil.rmtree(self._shard_path(*key),
+                                  ignore_errors=True)
             for index in [i for i in self._mappers
                           if i not in state.indices]:
                 del self._mappers[index]
@@ -876,17 +1052,39 @@ class ClusterNode:
 
     def get_doc(self, index: str, doc_id: str,
                 routing: str | None = None) -> dict:
+        """Single-shard read with retry-on-next-copy (ref action/support/
+        single/shard/TransportShardSingleOperationAction.java:123 — a
+        failed copy falls through to the next one in the iteration;
+        round-robin start spreads read load across copies)."""
         state = self.cluster.current()
         if index not in state.routing:
             raise KeyError(f"no such index [{index}]")
         sid = route_shard(doc_id, len(state.routing[index]), routing)
-        primary = state.primary_of(index, sid)
-        if primary is None or primary["state"] != STARTED:
+        copies = [c for c in state.routing[index][sid]
+                  if c["state"] == STARTED]
+        if not copies:
             raise UnavailableShardsException(f"[{index}][{sid}]")
+        # prefer local, then rotate (OperationRouting.java:144-154)
+        rr = self._read_rr
+        start = rr.get((index, sid), 0)
+        rr[(index, sid)] = start + 1
+        ordered = sorted(
+            copies, key=lambda c: (c["node"] != self.node_id,))
+        if ordered[0]["node"] != self.node_id and len(ordered) > 1:
+            ordered = ordered[start % len(ordered):] \
+                + ordered[: start % len(ordered)]
         payload = {"index": index, "shard": sid, "id": doc_id}
-        if primary["node"] == self.node_id:
-            return self._on_get(self.node_id, payload)
-        return self.transport.send(primary["node"], A_GET, payload)
+        last_err: Exception | None = None
+        for c in ordered:
+            try:
+                if c["node"] == self.node_id:
+                    return self._on_get(self.node_id, payload)
+                return self.transport.send(c["node"], A_GET, payload)
+            except (ConnectTransportException, RemoteTransportException,
+                    UnavailableShardsException) as e:
+                last_err = e             # dead/stale copy: try the next
+        raise UnavailableShardsException(
+            f"[{index}][{sid}]: all copies failed") from last_err
 
     def _on_get(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))

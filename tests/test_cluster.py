@@ -268,3 +268,100 @@ def test_writes_replicate_during_and_after_recovery(tmp_path):
             assert h.engine.doc_count() == 9
     finally:
         c.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster-level metadata services (ref MetaDataIndexAliasesService,
+# MetaDataUpdateSettingsService, MetaDataIndexStateService) + single-shard
+# retry-on-next-copy (TransportShardSingleOperationAction.java:123)
+
+
+def test_cluster_alias_and_settings_services(tmp_path):
+    c = TestCluster(2, str(tmp_path))
+    try:
+        client = c.client()
+        client.create_index("idx", {"number_of_shards": 1,
+                                    "number_of_replicas": 0})
+        c.ensure_green()
+        client.put_alias("idx", "books")
+        client.index_doc("idx", "1", {"body": "hello alias"})
+        client.refresh("idx")
+        out = client.search("books", {"query": {"match_all": {}}})
+        assert out["hits"]["total"] == 1
+        client.delete_alias("idx", "books")
+        import pytest as _pt
+        with _pt.raises(Exception):
+            client.search("books", {"query": {"match_all": {}}})
+        # live replica resize 0 -> 1: a replica appears and starts
+        client.update_index_settings("idx", {"number_of_replicas": 1})
+        c.ensure_green()
+        copies = c.client().cluster.current().routing["idx"][0]
+        assert len(copies) == 2
+        assert all(cp["state"] == STARTED for cp in copies)
+    finally:
+        c.close()
+
+
+def test_cluster_close_open_index(tmp_path):
+    c = TestCluster(2, str(tmp_path))
+    try:
+        client = c.client()
+        client.create_index("co", {"number_of_shards": 1,
+                                   "number_of_replicas": 0})
+        c.ensure_green()
+        client.index_doc("co", "1", {"x": "y"})
+        client.close_index("co")
+
+        def wait_closed():
+            import time as _t
+            for _ in range(100):
+                st = client.cluster.current()
+                if "co" not in st.routing:
+                    return True
+                _t.sleep(0.02)
+            return False
+        assert wait_closed()
+        assert client.cluster.current().indices["co"]["state"] == "close"
+        client.open_index("co")
+        c.ensure_green()
+        assert client.cluster.current().indices["co"].get("state") == "open"
+        # the documents SURVIVE the close/open cycle (gateway-style
+        # primary allocation pins the reopened primary on the data holder)
+        out = client.search("co", {"query": {"match_all": {}}})
+        assert out["hits"]["total"] == 1
+        got = client.get_doc("co", "1")
+        assert got["found"] and got["_source"] == {"x": "y"}
+    finally:
+        c.close()
+
+
+def test_get_retries_next_copy(tmp_path):
+    c = TestCluster(3, str(tmp_path))
+    try:
+        client = c.client()
+        client.create_index("r", {"number_of_shards": 1,
+                                  "number_of_replicas": 1})
+        c.ensure_green()
+        client.index_doc("r", "42", {"v": 1})
+        client.refresh("r")
+        # read from a COORDINATOR that holds no copy, and cut off every
+        # copy-holder one at a time: each read must fall through to a
+        # surviving copy (TransportShardSingleOperationAction.java:123)
+        state = client.cluster.current()
+        holders = [cp["node"] for cp in state.routing["r"][0]]
+        reader = c.nodes[next(n for n in c.nodes if n not in holders)]
+        for victim in holders:
+            c.network.heal()
+            c.network.disconnect(victim)
+            out = reader.get_doc("r", "42")
+            assert out["found"] and out["_source"] == {"v": 1}, victim
+        c.network.heal()
+        # every copy gone: the read fails with all-copies-failed
+        for victim in holders:
+            c.network.disconnect(victim)
+        import pytest as _pt
+        from elasticsearch_tpu.cluster.node import UnavailableShardsException
+        with _pt.raises(UnavailableShardsException):
+            reader.get_doc("r", "42")
+    finally:
+        c.close()

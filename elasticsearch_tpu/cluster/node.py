@@ -558,8 +558,10 @@ class ClusterNode:
             settings = meta.get("settings") or {}
 
             def get_s(key, default):
-                return settings.get(key, settings.get(f"index.{key}",
-                                                      default))
+                # prefixed key WINS: updates arrive as index.* and must
+                # not be shadowed by the stale bare creation-time key
+                return settings.get(f"index.{key}",
+                                    settings.get(key, default))
             routing = new_index_routing(int(get_s("number_of_shards", 1)),
                                         int(get_s("number_of_replicas", 1)))
             for sid, copies in enumerate(routing):
@@ -632,6 +634,16 @@ class ClusterNode:
                     import shutil
                     shutil.rmtree(self._shard_path(*key),
                                   ignore_errors=True)
+            # GC data dirs of indices DELETED from the metadata entirely —
+            # including ones closed first (their shards left self._shards
+            # at close time, so the loop above can't see them)
+            idx_root = os.path.join(self.data_path, "indices")
+            if os.path.isdir(idx_root):
+                import shutil
+                for iname in os.listdir(idx_root):
+                    if iname not in state.indices:
+                        shutil.rmtree(os.path.join(idx_root, iname),
+                                      ignore_errors=True)
             for index in [i for i in self._mappers
                           if i not in state.indices]:
                 del self._mappers[index]

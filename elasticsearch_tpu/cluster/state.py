@@ -21,6 +21,15 @@ from __future__ import annotations
 import copy
 from typing import Any, Iterator
 
+
+class IndexClosedError(Exception):
+    """Concrete name targets a CLOSED index (ref ClusterBlockException /
+    INDEX_CLOSED_BLOCK)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"index [{name}] is closed")
+        self.index = name
+
 UNASSIGNED = "UNASSIGNED"
 INITIALIZING = "INITIALIZING"
 STARTED = "STARTED"
@@ -83,17 +92,26 @@ class ClusterState:
         return self.indices.get(index)
 
     def resolve_index(self, expr: str) -> list[str]:
-        """name / alias / _all / comma list (wildcards via fnmatch)."""
+        """name / alias / _all / comma list (wildcards via fnmatch).
+        CLOSED indices are excluded from wildcard/_all expansion and raise
+        when named concretely (ref IndicesOptions + IndexClosedException —
+        a closed index has no routing to search)."""
         import fnmatch
+
+        def is_open(n: str) -> bool:
+            return (self.indices[n] or {}).get("state") != "close"
         if expr in ("_all", "*", ""):
-            return sorted(self.indices)
+            return sorted(n for n in self.indices if is_open(n))
         out: list[str] = []
         for part in expr.split(","):
             if part in self.indices:
+                if not is_open(part):
+                    raise IndexClosedError(part)
                 out.append(part)
                 continue
             hit = [n for n, m in self.indices.items()
-                   if part in m.get("aliases", []) or fnmatch.fnmatch(n, part)]
+                   if (part in m.get("aliases", [])
+                       or fnmatch.fnmatch(n, part)) and is_open(n)]
             out.extend(h for h in hit if h not in out)
         return out
 

@@ -69,7 +69,8 @@ class SegmentStore:
         if seg.seg_id in self.persisted:
             return
         npz_path = os.path.join(self.path, f"seg_{seg.seg_id}.npz")
-        docs_path = os.path.join(self.path, f"seg_{seg.seg_id}.docs.jsonl")
+        docs_path = os.path.join(self.path,
+                                 f"seg_{seg.seg_id}.docs.jsonl.gz")
 
         arrays: dict[str, np.ndarray] = {
             "ids": np.asarray(seg.ids, dtype=np.str_),
@@ -119,12 +120,19 @@ class SegmentStore:
             os.fsync(f.fileno())
         os.replace(tmp, npz_path)
 
+        # stored fields compress on disk (the reference's Lucene stored
+        # fields are LZ4-compressed by default; gzip level 1 is the
+        # stdlib analog — ~4-6x smaller, negligible CPU at flush)
+        import gzip
         tmp = docs_path + ".tmp"
-        with open(tmp, "w") as f:
-            for src in seg.stored:
-                f.write(json.dumps(src, separators=(",", ":")) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        with open(tmp, "wb") as raw:
+            with gzip.GzipFile(fileobj=raw, mode="wb",
+                               compresslevel=1, mtime=0) as f:
+                for src in seg.stored:
+                    f.write((json.dumps(src, separators=(",", ":"))
+                             + "\n").encode("utf-8"))
+            raw.flush()
+            os.fsync(raw.fileno())
         os.replace(tmp, docs_path)
         self.persisted[seg.seg_id] = (_crc(npz_path), _crc(docs_path))
 
@@ -143,7 +151,7 @@ class SegmentStore:
             manifest["segments"].append({
                 "seg_id": seg.seg_id,
                 "file": f"seg_{seg.seg_id}.npz",
-                "docs_file": f"seg_{seg.seg_id}.docs.jsonl",
+                "docs_file": self.docs_name(seg.seg_id),
                 "crc": crc, "docs_crc": docs_crc, "dead": dead})
         tmp = os.path.join(self.path, MANIFEST + ".tmp")
         with open(tmp, "w") as f:
@@ -153,10 +161,20 @@ class SegmentStore:
         os.replace(tmp, os.path.join(self.path, MANIFEST))
         self._gc({s.seg_id for s in segments})
 
+    def docs_name(self, seg_id: int) -> str:
+        """The stored-fields filename actually ON DISK for a segment —
+        pre-compression segments keep their plain .jsonl name (they are
+        never rewritten: write_segment skips persisted ids), new ones use
+        the compressed form."""
+        plain = f"seg_{seg_id}.docs.jsonl"
+        if os.path.exists(os.path.join(self.path, plain)):
+            return plain
+        return plain + ".gz"
+
     def _gc(self, keep: set[int]) -> None:
         import re
         for fn in os.listdir(self.path):
-            m = re.match(r"^seg_(\d+)\.(npz|docs\.jsonl)$", fn)
+            m = re.match(r"^seg_(\d+)\.(npz|docs\.jsonl(\.gz)?)$", fn)
             if m and int(m.group(1)) not in keep:
                 try:
                     os.remove(os.path.join(self.path, fn))
@@ -250,7 +268,10 @@ class SegmentStore:
         versions = [int(v) for v in data["versions"]]
         routings = [str(r) if str(r) else None for r in data["routings"]] \
             if "routings" in data else [None] * n_docs
-        with open(docs_path) as f:
+        import gzip
+        opener = (lambda: gzip.open(docs_path, "rt")) \
+            if docs_path.endswith(".gz") else (lambda: open(docs_path))
+        with opener() as f:
             stored = [json.loads(ln) for ln in f if ln.strip()]
         if len(stored) != n_docs:
             raise CorruptIndexException(

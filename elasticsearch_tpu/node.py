@@ -179,6 +179,10 @@ class NodeService:
         self.watcher.add(FileWatcher(scripts_dir, _ScriptDirListener(self)))
         self.watcher.start()     # interval thread: hot reload after boot
         self.plugins.on_node_start(self)
+        import threading as _th
+        self._maint_stop = _th.Event()
+        _th.Thread(target=self._maintenance_loop, daemon=True,
+                   name="es[index_maintenance]").start()
         self.lifecycle.move_to_started()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -1828,6 +1832,61 @@ class NodeService:
             self.indices[n].sync_translogs()
         return deleted
 
+    # -- index maintenance scheduler: LIVE dynamic settings ----------------
+
+    def run_index_maintenance(self) -> dict:
+        """One pass of the per-index schedulers that the reference runs as
+        background services, each reading its threshold from LIVE settings
+        so `_settings` updates apply to a running index immediately:
+          * index.refresh_interval  — periodic NRT refresh
+            (ref index/shard/IndexShard refresh scheduler; default here is
+            manual-refresh to keep NRT tests deterministic)
+          * index.translog.flush_threshold_ops — flush when the translog
+            accumulates that many ops (ref index/translog/
+            TranslogService.java:105-115)
+        Returns {"refreshed": n, "flushed": n}."""
+        now = time.monotonic()
+        refreshed = flushed = 0
+        for name, svc in list(self.indices.items()):
+            s = svc.settings
+            ri = s.get("index.refresh_interval", s.get("refresh_interval"))
+            if ri not in (None, "", "-1", -1):
+                from .mapping.mapper import parse_ttl_ms
+                try:
+                    interval = parse_ttl_ms(ri) / 1000.0
+                except Exception:  # noqa: BLE001
+                    interval = None
+                last = getattr(svc, "_last_sched_refresh", 0.0)
+                if interval is not None and now - last >= interval:
+                    svc._last_sched_refresh = now
+                    try:
+                        svc.refresh()
+                        refreshed += 1
+                    except Exception:  # noqa: BLE001 — keep the scheduler
+                        pass
+            fto = s.get("index.translog.flush_threshold_ops",
+                        s.get("translog.flush_threshold_ops"))
+            if fto not in (None, ""):
+                try:
+                    fto = int(fto)
+                except ValueError:
+                    continue
+                for e in svc.shards:
+                    if e.translog.ops_since_commit >= fto > 0:
+                        try:
+                            e.flush()
+                            flushed += 1
+                        except Exception:  # noqa: BLE001
+                            pass
+        return {"refreshed": refreshed, "flushed": flushed}
+
+    def _maintenance_loop(self) -> None:
+        while not self._maint_stop.wait(0.25):
+            try:
+                self.run_index_maintenance()
+            except Exception:  # noqa: BLE001 — scheduler must survive
+                pass
+
     # -- TTL purger (ref indices/ttl/IndicesTTLService.java:66) -----------
 
     def purge_expired_docs(self, now_ms: int | None = None) -> int:
@@ -1963,6 +2022,8 @@ class NodeService:
         if not self.lifecycle.move_to_closed():
             return                      # idempotent double-close
         self.watcher.stop()
+        if getattr(self, "_maint_stop", None) is not None:
+            self._maint_stop.set()
         if getattr(self, "_ttl_stop", None) is not None:
             self._ttl_stop.set()
         for svc in self.indices.values():

@@ -121,8 +121,11 @@ class SnapshotsService:
                         crc, docs_crc = eng.store.persisted[seg.seg_id]
                         npz = os.path.join(eng.path,
                                            f"seg_{seg.seg_id}.npz")
+                        # the store knows which stored-fields filename is
+                        # actually on disk (pre-compression segments keep
+                        # their plain .jsonl name)
                         docs = os.path.join(
-                            eng.path, f"seg_{seg.seg_id}.docs.jsonl")
+                            eng.path, eng.store.docs_name(seg.seg_id))
                         blob, was_new = self._blobize(loc, npz, crc)
                         copied += was_new
                         shared += (not was_new)
@@ -251,10 +254,17 @@ class SnapshotsService:
                 commit = {"format": FORMAT, "segments": [],
                           "tombstones": shard["tombstones"]}
                 for e in shard["segments"]:
+                    # a docs blob from a pre-compression snapshot is plain
+                    # jsonl — sniff the gzip magic so the restored file
+                    # gets the name load() will decode it under
+                    docs_src = os.path.join(loc, "blobs", e["docs_blob"])
+                    with open(docs_src, "rb") as bf:
+                        is_gz = bf.read(2) == b"\x1f\x8b"
+                    docs_name = f"seg_{e['seg_id']}.docs.jsonl" \
+                        + (".gz" if is_gz else "")
                     for blob_key, fname, crc_key in (
                             (e["blob"], f"seg_{e['seg_id']}.npz", "crc"),
-                            (e["docs_blob"],
-                             f"seg_{e['seg_id']}.docs.jsonl", "docs_crc")):
+                            (e["docs_blob"], docs_name, "docs_crc")):
                         src = os.path.join(loc, "blobs", blob_key)
                         dst = os.path.join(sp, fname)
                         shutil.copyfile(src, dst)
@@ -264,7 +274,7 @@ class SnapshotsService:
                     commit["segments"].append({
                         "seg_id": e["seg_id"],
                         "file": f"seg_{e['seg_id']}.npz",
-                        "docs_file": f"seg_{e['seg_id']}.docs.jsonl",
+                        "docs_file": docs_name,
                         "crc": e["crc"], "docs_crc": e["docs_crc"],
                         "dead": e["dead"]})
                 self._write_json(os.path.join(sp, MANIFEST), commit)

@@ -365,3 +365,66 @@ def test_get_retries_next_copy(tmp_path):
             reader.get_doc("r", "42")
     finally:
         c.close()
+
+
+def test_closed_index_excluded_from_search(tmp_path):
+    c = TestCluster(2, str(tmp_path))
+    try:
+        client = c.client()
+        client.create_index("open1", {"number_of_shards": 1,
+                                      "number_of_replicas": 0})
+        client.create_index("shut", {"number_of_shards": 1,
+                                     "number_of_replicas": 0})
+        c.ensure_green()
+        client.index_doc("open1", "1", {"x": "y"})
+        client.refresh("open1")
+        client.close_index("shut")
+        import time as _t
+        for _ in range(100):
+            if "shut" not in client.cluster.current().routing:
+                break
+            _t.sleep(0.02)
+        # _all expansion skips the closed index instead of KeyError-ing
+        out = client.search("_all", {"query": {"match_all": {}}})
+        assert out["hits"]["total"] == 1
+        # naming it concretely is a clean closed-index error
+        import pytest as _pt
+        from elasticsearch_tpu.cluster.state import IndexClosedError
+        with _pt.raises(IndexClosedError):
+            client.search("shut", {"query": {"match_all": {}}})
+    finally:
+        c.close()
+
+
+def test_delete_closed_index_gcs_data(tmp_path):
+    import os as _os
+    c = TestCluster(1, str(tmp_path), minimum_master_nodes=1)
+    try:
+        client = c.client()
+        client.create_index("zomb", {"number_of_shards": 1,
+                                     "number_of_replicas": 0})
+        c.ensure_green()
+        client.index_doc("zomb", "1", {"ghost": "doc"})
+        client.flush("zomb")
+        client.close_index("zomb")
+        import time as _t
+        for _ in range(100):
+            if "zomb" not in client.cluster.current().routing:
+                break
+            _t.sleep(0.02)
+        shard_dir = _os.path.join(client.data_path, "indices", "zomb")
+        assert _os.path.isdir(shard_dir)        # closed keeps its data
+        client.delete_index("zomb")
+        for _ in range(100):
+            if not _os.path.isdir(shard_dir):
+                break
+            _t.sleep(0.02)
+        assert not _os.path.isdir(shard_dir)    # delete GCs it
+        # recreating the name must NOT resurrect the old doc
+        client.create_index("zomb", {"number_of_shards": 1,
+                                     "number_of_replicas": 0})
+        c.ensure_green()
+        out = client.search("zomb", {"query": {"match_all": {}}})
+        assert out["hits"]["total"] == 0
+    finally:
+        c.close()

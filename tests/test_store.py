@@ -126,7 +126,7 @@ class TestCorruption:
         eng.index("1", {"t": "hello world"})
         eng.flush()
         eng.close()
-        self._corrupt(tmp_path / "s" / "seg_1.docs.jsonl", offset=5)
+        self._corrupt(tmp_path / "s" / "seg_1.docs.jsonl.gz", offset=5)
         with pytest.raises(CorruptIndexException, match="checksum"):
             make_engine(tmp_path / "s")
 
@@ -163,3 +163,44 @@ class TestStoreRoundTrip:
         res = s.execute_query_phase(s.parse([{"match": {"title": "fox"}}]))
         assert int(res.total_hits[0]) == 1
         eng2.close()
+
+
+def test_pre_compression_segments_stay_loadable(tmp_path):
+    """A store written before stored-fields compression (plain .jsonl)
+    must survive a reopen AND a further flush (the commit manifest keeps
+    the on-disk filename per segment)."""
+    import gzip
+    import json as _json
+    import os as _os
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.mapping.mapper import MapperService
+    path = str(tmp_path / "old")
+    eng = Engine(path, MapperService())
+    eng.index("1", {"body": "ancient scroll"})
+    eng.flush()
+    eng.close()
+    # rewrite the segment's stored fields in the OLD uncompressed form
+    man_path = _os.path.join(path, "commit.json")
+    man = _json.load(open(man_path))
+    for e in man["segments"]:
+        gz = _os.path.join(path, e["docs_file"])
+        if not gz.endswith(".gz"):
+            continue
+        plain = gz[:-3]
+        with gzip.open(gz, "rb") as f:
+            data = f.read()
+        open(plain, "wb").write(data)
+        _os.remove(gz)
+        e["docs_file"] = _os.path.basename(plain)
+        import zlib as _z
+        e["docs_crc"] = _z.crc32(data)
+    _json.dump(man, open(man_path, "w"))
+    # reopen: loads the plain file; index + flush: commit keeps its name
+    eng2 = Engine(path, MapperService())
+    assert eng2.get("1").found
+    eng2.index("2", {"body": "new doc"})
+    eng2.flush()
+    eng2.close()
+    eng3 = Engine(path, MapperService())
+    assert eng3.get("1").found and eng3.get("2").found
+    eng3.close()

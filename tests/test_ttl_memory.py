@@ -98,3 +98,39 @@ def test_request_cache_size0_with_invalidation(node):
     node.search("rc", dict(body), request_cache=False)
     node.search("rc", dict(body), request_cache=False)
     assert svc.request_cache_hits == h0    # opt-out never touches the cache
+
+
+def test_dynamic_refresh_interval_applies_live(node):
+    import time as _t
+    node.create_index("dyn")
+    node.index_doc("dyn", "1", {"x": "first"})
+    # manual-refresh default: the doc is NOT searchable yet
+    assert node.search("dyn", {"query": {"match_all": {}}})["hits"]["total"] == 0
+    # flip refresh_interval on the RUNNING index — the scheduler picks the
+    # new threshold up live (no restart, no explicit refresh)
+    from elasticsearch_tpu.common.settings import Settings
+    svc = node.indices["dyn"]
+    svc.settings = Settings({**dict(svc.settings),
+                             "index.refresh_interval": "50ms"})
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline:
+        if node.search("dyn",
+                       {"query": {"match_all": {}}})["hits"]["total"] == 1:
+            break
+        _t.sleep(0.05)
+    assert node.search("dyn", {"query": {"match_all": {}}})["hits"]["total"] == 1
+
+
+def test_dynamic_translog_flush_threshold(node):
+    import time as _t
+    node.create_index("tl", settings={
+        "index.translog.flush_threshold_ops": 5})
+    for i in range(6):
+        node.index_doc("tl", str(i), {"n": i})
+    e = node.indices["tl"].shards[0]
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline:
+        if e.translog.ops_since_commit == 0:
+            break
+        _t.sleep(0.05)
+    assert e.translog.ops_since_commit == 0   # the scheduler flushed

@@ -51,7 +51,7 @@ AGG_DOCS = int(os.environ.get("BENCH_AGG_DOCS", str(4_000_000)))
 AGG_Q = 128               # agg requests per msearch batch
 AGG_BATCHES = 4
 # configs #4/#5: stored-vector cosine + BM25->dense hybrid rescore
-VEC_DOCS = int(os.environ.get("BENCH_VEC_DOCS", str(60_000)))
+VEC_DOCS = int(os.environ.get("BENCH_VEC_DOCS", str(100_000)))
 VEC_DIMS = 768
 VEC_Q = 128
 VEC_BATCHES = 4

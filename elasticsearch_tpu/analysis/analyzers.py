@@ -386,12 +386,18 @@ def _register_language_analyzers() -> None:
             return None
         return lambda toks: [t for t in toks if t not in sw]
 
-    for lang in ("french", "german", "spanish", "italian", "portuguese",
-                 "dutch", "russian", "swedish", "danish", "norwegian",
-                 "finnish"):
+    from .languages import LANGUAGES
+    for lang in LANGUAGES:
+        if lang == "english":
+            continue                 # "english" is the default chain
         filters = [lowercase_filter]
         if lang in ("french", "italian"):
             filters.append(make_elision_filter())
+        elif lang == "catalan":       # Lucene CatalanAnalyzer elision set
+            filters.append(make_elision_filter(("d", "l", "m", "n", "s",
+                                                "t")))
+        elif lang == "irish":         # Lucene IrishAnalyzer elision set
+            filters.append(make_elision_filter(("d", "m", "b")))
         sf = stop_for(lang)
         if sf is not None:
             filters.append(sf)
@@ -399,6 +405,9 @@ def _register_language_analyzers() -> None:
         BUILTIN_ANALYZERS[lang] = Analyzer(lang, standard_tokenizer, filters)
     BUILTIN_ANALYZERS["cjk"] = Analyzer("cjk", standard_tokenizer,
                                         [lowercase_filter, cjk_bigram])
+    # the reference's ChineseAnalyzerProvider delegates to the standard
+    # chain (Lucene deprecated ChineseAnalyzer); CJK bigrams serve better
+    BUILTIN_ANALYZERS["chinese"] = BUILTIN_ANALYZERS["cjk"]
 
 
 _register_language_analyzers()

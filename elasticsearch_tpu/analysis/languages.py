@@ -163,18 +163,73 @@ _SUFFIXES: dict[str, list[str]] = {
                 "isen", "iset", "ista", "istä", "ssa", "ssä", "sta", "stä",
                 "lla", "llä", "lta", "ltä", "lle", "ksi", "in", "en", "an",
                 "än", "at", "ät", "a", "ä", "n", "t"],
+    # the remaining members of the reference's language-analyzer roster,
+    # each a published-light-stemmer-style suffix table (Lucene's
+    # *LightStemmer family): common inflectional morphology only
+    "arabic": ["ها", "ان", "ات", "ون", "ين", "يه", "ية", "ه", "ة", "ي"],
+    "bulgarian": ["ията", "ият", "ите", "ето", "ата", "ото", "та", "то",
+                  "ят", "ия", "а", "я", "о", "е"],
+    "catalan": ["aments", "ament", "ques", "es", "os", "or", "a", "e", "o",
+                "s"],
+    "czech": ["atech", "atům", "ých", "ami", "emi", "ého", "ému", "ích",
+              "ími", "ách", "ata", "aty", "ové", "ovi", "ými", "em", "es",
+              "ém", "ím", "ám", "os", "us", "ým", "mi", "ou", "ů", "e",
+              "i", "í", "ě", "u", "y", "a", "o", "á", "é", "ý"],
+    "greek": ["ματος", "ματα", "οντας", "ωντας", "ες", "ος", "ης", "ου",
+              "ων", "ας", "ής", "ού", "ών", "α", "η", "ι", "ο", "ς"],
+    "hindi": ["ियों", "ियाँ", "ियां", "ाओं", "ाएँ", "ुओं", "ुएँ", "ओं", "एँ",
+              "ें", "ों", "ीं", "ाँ", "ां", "ो", "े", "ू", "ु", "ी", "ि", "ा"],
+    "hungarian": ["okkal", "ekkel", "akkal", "nak", "nek", "val", "vel",
+                  "ban", "ben", "ból", "ből", "hoz", "hez", "nál", "nél",
+                  "ról", "ről", "tól", "től", "ok", "ek", "ak", "ai", "ei",
+                  "át", "et", "ot", "a", "e", "i", "o", "ó", "ő", "t", "k"],
+    "indonesian": ["kannya", "kanlah", "annya", "kan", "an", "nya", "lah",
+                   "kah", "i"],
+    "irish": ["acha", "anna", "ach", "aí", "í"],
+    "latvian": ["ajiem", "ajām", "iem", "ajā", "ām", "ās", "am", "as",
+                "ies", "em", "es", "is", "us", "ai", "ei", "u", "s", "a",
+                "e", "i"],
+    "persian": ["هایی", "های", "ترین", "ها", "ات", "ان", "تر", "ی"],
+    "romanian": ["urile", "ilor", "ului", "elor", "uri", "ul", "ile", "ea",
+                 "le", "lor", "ii", "iei", "ie", "ei", "a", "i"],
+    "turkish": ["larının", "lerinin", "ların", "lerin", "ları", "leri",
+                "lar", "ler", "dan", "den", "tan", "ten", "da", "de", "ta",
+                "te", "ın", "in", "un", "ün", "ı", "i", "u", "ü", "a", "e"],
+    "armenian": ["ները", "ներին", "ների", "երի", "ներ", "եր", "ում", "ը",
+                 "ի", "ն"],
+    "basque": ["etako", "etan", "ak", "ek", "en", "ra", "an", "a", "k"],
+    "sorani": ["ەکان", "ەکە", "یان", "مان", "تان", "ان", "ەی", "ی", "ە"],
+    "galician": ["amentos", "amento", "cións", "ción", "eiras", "eiros",
+                 "eira", "eiro", "anza", "ois", "áns", "es", "ns", "s",
+                 "a", "o", "e"],
+    "brazilian": ["amentos", "amento", "adores", "ações", "ância", "agem",
+                  "mente", "idade", "ção", "ções", "ista", "ismo", "oso",
+                  "osa", "eza", "es", "os", "as", "a", "o", "e", "s"],
 }
 
-_MIN_STEM = {"russian": 3, "finnish": 3}
+_MIN_STEM = {"russian": 3, "finnish": 3, "arabic": 3,
+             "hindi": 2, "persian": 3, "sorani": 3,
+             "greek": 3, "armenian": 3, "hungarian": 3,
+             "czech": 3, "turkish": 3, "latvian": 3,
+             "bulgarian": 3}
 
 
 def light_stem(lang: str, word: str) -> str:
-    """Strip the longest matching suffix, keeping a minimum stem."""
+    """Strip matching suffixes to a FIXPOINT, keeping a minimum stem.
+    Fixpoint matters for index/query symmetry: a single pass maps
+    "kapıları"->"kapı" but the query "kapı"->"kap" — different terms for
+    the same lemma and recall silently drops to zero. Iterating until no
+    suffix applies makes stemming idempotent, so both sides of the match
+    land on the same term."""
     min_stem = _MIN_STEM.get(lang, 4)
-    for suf in _SUFFIXES.get(lang, ()):
-        if word.endswith(suf) and len(word) - len(suf) >= min_stem:
-            return word[: -len(suf)]
-    return word
+    sufs = _SUFFIXES.get(lang, ())
+    while True:
+        for suf in sufs:
+            if word.endswith(suf) and len(word) - len(suf) >= min_stem:
+                word = word[: -len(suf)]
+                break
+        else:
+            return word
 
 
 def make_light_stemmer(lang: str):

@@ -1406,8 +1406,6 @@ class NodeService:
         size = int(first_body.get("size", 10))
         from_ = int(first_body.get("from", 0))
         names = self._resolve(index)
-        for n in names:
-            self.indices[n].query_total += len(metas)
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
         for n in names:
@@ -1552,8 +1550,17 @@ class NodeService:
                 max_score=_np.full((Q,), _np.nan, _np.float32))
                 for i, s in enumerate(searchers)]
 
-        return self._batched_reduce(metas, searchers, index_of, results,
+        outs = self._batched_reduce(metas, searchers, index_of, results,
                                     size, from_, agg_rendered, t0)
+        # count AFTER successful assembly — a raise above degrades the
+        # batch to the solo path, which books its own query_total (the
+        # packed lane documents the same convention)
+        for n in names:
+            svc = self.indices[n]
+            svc.query_total += len(metas)
+            svc.search_stats["batched"] = \
+                svc.search_stats.get("batched", 0) + len(metas)
+        return outs
 
     def _batched_reduce(self, metas, searchers, index_of, results,
                         size, from_, agg_rendered, t0) -> list[dict]:

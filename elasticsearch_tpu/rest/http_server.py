@@ -2246,6 +2246,10 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                             "fetch_time_in_millis": 0, "fetch_current": 0}
                         for t, c in svc.search_groups.items()
                         if any(fnmatch.fnmatch(t, x) for x in groups_sel)}
+                # device-lane split: packed one-program serves + plan-shape
+                # batched serves vs general per-segment path — the
+                # "how much of the load rides one device program" gauge
+                se["lanes"] = dict(svc.search_stats)
                 out["search"] = se
             if "merge" in want:
                 out["merges"] = {

@@ -109,3 +109,23 @@ class TestCustomChains:
         assert n.search("syn", {"query": {"match": {
             "body": "tv"}}})["hits"]["total"] == 1
         n.close()
+
+
+def test_extended_language_roster():
+    """All 30+ language analyzers from the reference's provider roster
+    (ref index/analysis/*AnalyzerProvider.java) are registered and stem."""
+    from elasticsearch_tpu.analysis.analyzers import BUILTIN_ANALYZERS
+    for lang in ("arabic", "armenian", "basque", "brazilian", "bulgarian",
+                 "catalan", "chinese", "czech", "galician", "greek",
+                 "hindi", "hungarian", "indonesian", "irish", "latvian",
+                 "persian", "romanian", "sorani", "turkish"):
+        assert lang in BUILTIN_ANALYZERS, lang
+    # fixpoint stemming: inflected and base forms land on the SAME term
+    tk = BUILTIN_ANALYZERS["turkish"]
+    assert tk("kapıları") == tk("kapı") == ["kap"]
+    assert BUILTIN_ANALYZERS["hungarian"]("házakkal") == ["ház"]
+    assert BUILTIN_ANALYZERS["romanian"]("studenților") == ["studenț"]
+    assert BUILTIN_ANALYZERS["indonesian"]("makanannya") == ["makan"]
+    # stemming unifies inflections for recall: both forms hit one term
+    tr = BUILTIN_ANALYZERS["czech"]
+    assert tr("studenta") == tr("studentem")

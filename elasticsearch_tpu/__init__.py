@@ -15,7 +15,6 @@ Layer map (mirrors SURVEY.md §1):
   search/    — query DSL compilation, query/fetch phases, aggs     (ref index/query, search/)
   parallel/  — mesh, doc routing, cross-shard collective reduce    (ref cluster/routing, SearchPhaseController)
   cluster/   — cluster state, routing table, allocation, service   (ref cluster/)
-  models/    — similarity/scoring models (BM25, TF-IDF, dense)     (ref index/similarity)
   rest/      — HTTP REST API surface                               (ref rest/, http/)
 """
 

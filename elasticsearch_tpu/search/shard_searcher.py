@@ -244,11 +244,15 @@ class ShardSearcher:
                 # lexsort: LAST key is the primary; doc index breaks ties
                 order = jnp.lexsort(
                     tuple([doc_idx] + list(reversed(keys[1:])) + [primary]))
-                order, match_h, scores_h = jax.device_get(
-                    (order, match, scores))      # one RTT for the triple
-                order = order[:, :kk]
-                sel_match = np.take_along_axis(match_h, order, axis=1)
-                sel_scores = np.take_along_axis(scores_h, order, axis=1)
+                # top-kk selection stays ON DEVICE: downloading the full
+                # [Q, n_pad] match/score matrices cost O(corpus) transfer
+                # per sorted batch (25 MB at 100k docs x 64 q) — gather at
+                # the winning positions first, then ONE small fetch
+                order = order[:, :kk].astype(jnp.int32)
+                sel_match_d = jnp.take_along_axis(match, order, axis=1)
+                sel_scores_d = jnp.take_along_axis(scores, order, axis=1)
+                order, sel_match, sel_scores = jax.device_get(
+                    (order, sel_match_d, sel_scores_d))
                 for qi in range(Q):
                     for j in range(kk):
                         if not sel_match[qi, j]:

@@ -70,6 +70,7 @@ A_SCROLL_CLEAR = "indices:data/read/search[free_context]"
 A_RECOVERY = "internal:index/shard/recovery/start"
 A_RECOVERY_CHUNK = "internal:index/shard/recovery/chunk"
 A_FS_STATS = "internal:monitor/fs"
+A_NODE_STATS = "cluster:monitor/nodes/stats"
 
 
 class NoMasterException(Exception):
@@ -134,7 +135,8 @@ class ClusterNode:
                 (A_SCROLL_CLEAR, self._on_scroll_clear),
                 (A_RECOVERY, self._on_recovery),
                 (A_RECOVERY_CHUNK, self._on_recovery_chunk),
-                (A_FS_STATS, self._on_fs_stats)]:
+                (A_FS_STATS, self._on_fs_stats),
+                (A_NODE_STATS, self._on_node_stats)]:
             self.transport.register_handler(action, handler)
         # ClusterInfoService + disk watermark decider (cluster/info.py;
         # ref InternalClusterInfoService + DiskThresholdDecider) — the
@@ -193,6 +195,48 @@ class ClusterNode:
         cur = self.cluster.current()
         return {"node": self.node_id, "version": cur.version,
                 "master": cur.master_node}
+
+    def _on_node_stats(self, from_id: str, req: Any) -> dict:
+        """Full per-node stats for the nodes-template fan-out (ref
+        action/admin/cluster/node/stats/TransportNodesStatsAction — every
+        node answers for itself; the coordinator assembles the map)."""
+        from ..common import monitor
+        docs = 0
+        shards = 0
+        with self._shards_lock:         # the reconciler mutates _shards
+            holders = list(self._shards.values())
+        for holder in holders:
+            if holder.engine is not None:
+                docs += holder.engine.doc_count()
+                shards += 1
+        return {"name": self.node_id,
+                "indices": {"docs": {"count": docs},
+                            "shard_count": shards},
+                "os": monitor.os_stats(),
+                "process": monitor.process_stats(),
+                "jvm": monitor.runtime_stats(),
+                "fs": monitor.fs_stats([self.data_path])}
+
+    def nodes_stats(self) -> dict:
+        """Coordinator-side fan-out to every live node (the nodes
+        template, ref TransportNodesOperationAction)."""
+        state = self.cluster.current()
+        out: dict = {}
+        failures: list = []
+        for node_id in sorted(state.nodes):
+            try:
+                if node_id == self.node_id:
+                    out[node_id] = self._on_node_stats(self.node_id, {})
+                else:
+                    out[node_id] = self.transport.send(
+                        node_id, A_NODE_STATS, {})
+            except ConnectTransportException:
+                continue              # dead node: absent from the map
+            except RemoteTransportException as e:
+                # LIVE node whose handler errored: report, don't hide
+                # (ref TransportNodesOperationAction FailedNodeException)
+                failures.append({"node": node_id, "reason": str(e)})
+        return {"nodes": out, "failures": failures}
 
     def _on_fs_stats(self, from_id: str, req: Any) -> dict:
         """Per-node disk usage for the master's ClusterInfoService

@@ -1775,6 +1775,27 @@ class NodeService:
     def refresh(self, index: str = "_all") -> None:
         for n in self._resolve(index):
             self.indices[n].refresh()
+            self._run_warmers(n)
+
+    def _run_warmers(self, name: str) -> None:
+        """Execute registered warmer searches against the FRESH searcher
+        (ref indices/warmer/IndicesWarmer + IndexWarmersMetaData: warmers
+        run on every new reader so caches/packed views are hot before the
+        first real query). Best-effort: a broken warmer logs, never fails
+        the refresh."""
+        svc = self.indices.get(name)
+        warmers = getattr(svc, "warmers", None)
+        if not warmers:
+            return
+        for wname, spec in list(warmers.items()):
+            body = dict(spec.get("source") or {})
+            body.setdefault("size", 0)
+            try:
+                self.search(name, body, request_cache=False)
+                svc.warmer_runs = getattr(svc, "warmer_runs", 0) + 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("warmer [%s] on [%s] failed: %s",
+                               wname, name, e)
 
     def flush(self, index: str = "_all") -> None:
         for n in self._resolve(index):
@@ -1868,6 +1889,7 @@ class NodeService:
                     svc._last_sched_refresh = now
                     try:
                         svc.refresh()
+                        self._run_warmers(name)
                         refreshed += 1
                     except Exception:  # noqa: BLE001 — keep the scheduler
                         pass

@@ -65,6 +65,40 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
                          for i, shards in st.routing.items()}}}
     c.register("GET", "/_cluster/state", cluster_state)
 
+    def nodes_stats(g, p, b):
+        # the nodes template over the REAL transport: every live node
+        # answers for itself; handler errors on live nodes surface as
+        # per-node failures (ref TransportNodesStatsAction +
+        # FailedNodeException)
+        res = node.nodes_stats()
+        out = {"cluster_name": "elasticsearch-tpu", "nodes": res["nodes"]}
+        if res["failures"]:
+            out["failures"] = res["failures"]
+        return 200, out
+    c.register("GET", "/_nodes/stats", nodes_stats)
+    c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
+
+    def nodes_info(g, p, b):
+        # node INFO shape (addresses/version — what client sniffers read;
+        # ref RestNodesInfoAction), distinct from the stats body
+        st = node.cluster.current()
+        infos = {}
+        for node_id in sorted(st.nodes):
+            addr = None
+            net = getattr(node.transport, "network", None)
+            if net is not None and hasattr(net, "address_of"):
+                addr = net.address_of(node_id)
+            infos[node_id] = {
+                "name": node_id, "version": "2.0.0-tpu",
+                "build": "tensor-native",
+                "transport_address": f"{addr[0]}:{addr[1]}" if addr
+                else f"local[{node_id}]",
+                "http_address": None, "host": "localhost",
+                "ip": "127.0.0.1", "os": {}, "jvm": {},
+                "transport": {"profiles": {}}, "http": {}, "plugins": []}
+        return 200, {"cluster_name": "elasticsearch-tpu", "nodes": infos}
+    c.register("GET", "/_nodes", nodes_info)
+
     # -- index admin (master template) ------------------------------------
     def create_index(g, p, b):
         body = _json_body(b)

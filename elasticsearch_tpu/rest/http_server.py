@@ -1698,11 +1698,45 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
 
     c.register("GET", "/_cluster/pending_tasks",
                lambda g, p, b: (200, {"tasks": []}))
-    c.register("GET", "/_cluster/settings",
-               lambda g, p, b: (200, {"persistent": {}, "transient": {}}))
-    c.register("PUT", "/_cluster/settings",
-               lambda g, p, b: (200, {"acknowledged": True,
-                                      "persistent": {}, "transient": {}}))
+    def get_cluster_settings(g, p, b):
+        cs = getattr(node, "_cluster_settings",
+                     {"persistent": {}, "transient": {}})
+        return 200, {"persistent": dict(cs["persistent"]),
+                     "transient": dict(cs["transient"])}
+
+    def put_cluster_settings(g, p, b):
+        # per-component logger levels apply LIVE (ref
+        # common/logging + RestClusterUpdateSettingsAction: the
+        # `logger.<component>: <level>` dynamic settings)
+        import logging as _logging
+        body = _json_body(b)
+        cs = getattr(node, "_cluster_settings", None)
+        if cs is None:
+            cs = node._cluster_settings = {"persistent": {},
+                                           "transient": {}}
+        def logger_for(k: str):
+            name = k[len("logger."):]
+            return _logging.getLogger(
+                "elasticsearch_tpu" if name in ("_root", "")
+                else f"elasticsearch_tpu.{name}")
+        for scope in ("persistent", "transient"):
+            for k, v in _flatten_settings(body.get(scope) or {}).items():
+                if v is None:
+                    cs[scope].pop(k, None)
+                    if k.startswith("logger."):
+                        # null RESTORES the default (inherit from parent)
+                        logger_for(k).setLevel(_logging.NOTSET)
+                    continue
+                cs[scope][k] = v
+                if k.startswith("logger."):
+                    lvl = getattr(_logging, str(v).upper(), None)
+                    if isinstance(lvl, int):
+                        logger_for(k).setLevel(lvl)
+        return 200, {"acknowledged": True,
+                     "persistent": dict(cs["persistent"]),
+                     "transient": dict(cs["transient"])}
+    c.register("GET", "/_cluster/settings", get_cluster_settings)
+    c.register("PUT", "/_cluster/settings", put_cluster_settings)
 
     _BLOCK_IDS = {"read_only": ("5", "index read-only (api)"),
                   "read": ("7", "index read (api)"),

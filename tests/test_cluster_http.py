@@ -153,3 +153,17 @@ def test_http_search_survives_node_loss(http):
                     {"query": {"match_all": {}}, "size": 10})
     assert code == 200
     assert out["hits"]["total"] == 10       # replicas served the dead node's
+
+
+def test_nodes_stats_fan_out(http):
+    """Every live node answers the nodes template over the transport
+    (ref TransportNodesStatsAction fan-out)."""
+    cluster, base = http
+    code, out = req(base, "GET", "/_nodes/stats")
+    assert code == 200
+    live = [n for n, cn in cluster.nodes.items() if not cn.closed]
+    assert set(out["nodes"]) == set(live)
+    for stats in out["nodes"].values():
+        assert stats["os"]["mem"]["total_in_bytes"] > 0
+        assert stats["fs"]["total"]["total_in_bytes"] > 0
+        assert "indices" in stats

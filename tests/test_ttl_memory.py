@@ -134,3 +134,55 @@ def test_dynamic_translog_flush_threshold(node):
             break
         _t.sleep(0.05)
     assert e.translog.ops_since_commit == 0   # the scheduler flushed
+
+
+def test_warmers_execute_on_refresh(node):
+    node.create_index("w")
+    node.indices["w"].warmers = {"warm1": {
+        "types": [], "source": {"query": {"match_all": {}}}}}
+    node.index_doc("w", "1", {"x": "y"})
+    node.refresh("w")
+    # the warmer search ran against the fresh reader (ref IndicesWarmer)
+    assert getattr(node.indices["w"], "warmer_runs", 0) >= 1
+    # broken warmers never fail the refresh
+    node.indices["w"].warmers["bad"] = {"source": {"query": {"nope": {}}}}
+    node.index_doc("w", "2", {"x": "z"})
+    node.refresh("w")
+    assert node.search("w", {"query": {"match_all": {}}})["hits"]["total"] == 2
+
+
+def test_cluster_settings_logger_levels(node):
+    import json
+    import logging
+    import urllib.request
+    from elasticsearch_tpu.rest import HttpServer
+    srv = HttpServer(node, port=0).start()
+    lg = logging.getLogger("elasticsearch_tpu.index.search.slowlog")
+    old_level = lg.level
+    try:
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/_cluster/settings",
+            data=json.dumps({"transient": {
+                "logger.index.search.slowlog": "DEBUG"}}).encode(),
+            method="PUT")
+        out = json.loads(urllib.request.urlopen(r).read())
+        assert out["transient"]["logger.index.search.slowlog"] == "DEBUG"
+        lg = logging.getLogger(
+            "elasticsearch_tpu.index.search.slowlog")
+        assert lg.level == logging.DEBUG
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/_cluster/settings") as resp:
+            got = json.loads(resp.read())
+        assert got["transient"]["logger.index.search.slowlog"] == "DEBUG"
+        # null RESETS both the setting and the live logger level
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/_cluster/settings",
+            data=json.dumps({"transient": {
+                "logger.index.search.slowlog": None}}).encode(),
+            method="PUT")
+        out = json.loads(urllib.request.urlopen(r).read())
+        assert "logger.index.search.slowlog" not in out["transient"]
+        assert lg.level == logging.NOTSET
+    finally:
+        lg.setLevel(old_level)
+        srv.stop()

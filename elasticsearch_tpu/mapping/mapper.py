@@ -495,8 +495,9 @@ class DocumentMapper:
                 ft = self.fields.get(path)
                 if ft is not None and ft.type == GEO_POINT:
                     self._index_value(ft, value, doc)
-                elif ft is not None and ft.type == "completion":
-                    self._index_completion(ft, value, doc)
+                elif ft is not None and ft.type in ("completion",
+                                                   "geo_shape"):
+                    self._index_value(ft, value, doc)
                 else:
                     self._parse_obj(path + ".", value, doc, new_fields)
                 continue
@@ -570,6 +571,49 @@ class DocumentMapper:
 
     COMPLETION_CTX_SEP = "\x1f"
 
+    @staticmethod
+    def shape_bbox(shape: dict) -> tuple[float, float, float, float] | None:
+        """GeoJSON-ish shape -> (minlat, maxlat, minlon, maxlon).
+        Supports point / envelope / polygon / multipolygon / linestring /
+        circle (ref common/geo/builders/ShapeBuilder). The bbox is the
+        segment's INDEXED representation — the tensor-native analog of the
+        reference's prefix-tree grid approximation (geo_shape queries are
+        approximate there too; exact only for points/envelopes here)."""
+        t = str(shape.get("type", "")).lower()
+        coords = shape.get("coordinates")
+
+        def flat(c):
+            # leaves are [lon, lat] pairs at arbitrary nesting depth
+            if not isinstance(c, (list, tuple)) or not c:
+                raise ValueError(f"malformed coordinates {c!r}")
+            if isinstance(c[0], (int, float)) \
+                    and not isinstance(c[0], bool):
+                if len(c) < 2 or not isinstance(c[1], (int, float)):
+                    raise ValueError(f"malformed coordinate pair {c!r}")
+                return [c]
+            out = []
+            for x in c:
+                out.extend(flat(x))
+            return out
+        if coords is None:
+            return None
+        if t == "circle":
+            lon, lat = float(coords[0]), float(coords[1])
+            from ..search.geo import parse_distance
+            import math as _m
+            r = parse_distance(shape.get("radius", "0m"))
+            dlat = r / 111_320.0
+            dlon = r / (111_320.0 * max(_m.cos(_m.radians(lat)), 1e-6))
+            return (lat - dlat, lat + dlat, lon - dlon, lon + dlon)
+        if t == "envelope":
+            (lon1, lat1), (lon2, lat2) = coords[0], coords[1]
+            return (min(lat1, lat2), max(lat1, lat2),
+                    min(lon1, lon2), max(lon1, lon2))
+        pts = flat(coords)
+        lons = [float(p[0]) for p in pts]
+        lats = [float(p[1]) for p in pts]
+        return (min(lats), max(lats), min(lons), max(lons))
+
     def _index_completion(self, ft: FieldType, value: Any,
                           doc: ParsedDocument) -> None:
         """Completion field entries land in the keyword column, each input
@@ -624,6 +668,29 @@ class DocumentMapper:
         t = ft.type
         if t == "completion":
             self._index_completion(ft, v, doc)
+            return
+        if t == "geo_shape":
+            # bbox columns <field>.minlat/.maxlat/.minlon/.maxlon — the
+            # indexed form geo_shape queries evaluate against. Multi-valued
+            # fields UNION into one bbox (the segment keeps one value per
+            # doc per column), widening coverage instead of dropping shapes
+            if isinstance(v, dict):
+                try:
+                    box = self.shape_bbox(v)
+                except (ValueError, TypeError, KeyError, IndexError) as e:
+                    raise MapperParsingException(
+                        f"failed to parse geo_shape [{ft.name}]: {e}") \
+                        from e
+                if box is not None:
+                    combine = (min, max, min, max)
+                    for suffix, val, comb in zip(
+                            (".minlat", ".maxlat", ".minlon", ".maxlon"),
+                            box, combine):
+                        cur = doc.numerics.setdefault(ft.name + suffix, [])
+                        if cur:
+                            cur[0] = comb(cur[0], float(val))
+                        else:
+                            cur.append(float(val))
             return
         try:
             if t == TEXT:

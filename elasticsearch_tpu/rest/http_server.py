@@ -1729,9 +1729,19 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                     continue
                 cs[scope][k] = v
                 if k.startswith("logger."):
-                    lvl = getattr(_logging, str(v).upper(), None)
+                    name = str(v).upper()
+                    # ES supports TRACE below DEBUG; register it once
+                    if name == "TRACE":
+                        _logging.addLevelName(5, "TRACE")
+                        lvl = 5
+                    else:
+                        lvl = getattr(_logging, name, None)
                     if isinstance(lvl, int):
                         logger_for(k).setLevel(lvl)
+                    else:
+                        raise RestError(
+                            400, f"IllegalArgumentException: unknown "
+                                 f"logger level [{v}] for [{k}]")
         return 200, {"acknowledged": True,
                      "persistent": dict(cs["persistent"]),
                      "transient": dict(cs["transient"])}

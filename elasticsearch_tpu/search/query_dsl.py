@@ -832,6 +832,46 @@ class GeoPolygonNode(Node):
 
 
 @dataclass
+class GeoShapeNode(Node):
+    """geo_shape filter (ref index/query/GeoShapeQueryParser): relation
+    between the query shape's bbox and each doc's indexed shape bbox
+    (mapper.shape_bbox columns). intersects/within/disjoint/contains over
+    boxes — exact for point/envelope shapes, bbox-approximate for
+    polygons, mirroring the reference's prefix-tree approximation."""
+    field_name: str = ""
+    box: tuple = ()                  # (minlat, maxlat, minlon, maxlon)
+    relation: str = "intersects"
+
+    def execute(self, ctx):
+        seg = ctx.segment
+        cols = [seg.numerics.get(self.field_name + s)
+                for s in (".minlat", ".maxlat", ".minlon", ".maxlon")]
+        if any(c is None for c in cols) or len(self.box) != 4:
+            return _zeros(ctx), _false(ctx)
+        dminlat, dmaxlat, dminlon, dmaxlon = (c.vals for c in cols)
+        qminlat, qmaxlat, qminlon, qmaxlon = (jnp.float64(x)
+                                              for x in self.box)
+        intersects = ((dminlat <= qmaxlat) & (dmaxlat >= qminlat)
+                      & (dminlon <= qmaxlon) & (dmaxlon >= qminlon))
+        if self.relation == "within":        # doc shape inside query shape
+            ok = ((dminlat >= qminlat) & (dmaxlat <= qmaxlat)
+                  & (dminlon >= qminlon) & (dmaxlon <= qmaxlon))
+        elif self.relation == "contains":    # doc shape contains query
+            ok = ((dminlat <= qminlat) & (dmaxlat >= qmaxlat)
+                  & (dminlon <= qminlon) & (dmaxlon >= qmaxlon))
+        elif self.relation == "disjoint":
+            ok = ~intersects
+        else:
+            ok = intersects
+        ok = ok & ~cols[0].missing
+        match = jnp.broadcast_to(ok[None, :], (ctx.Q, ctx.n_pad))
+        return jnp.where(match, jnp.float32(self.boost), 0.0), match
+
+    def plan_key(self):
+        return ("geo_shape", self.field_name, self.box, self.relation)
+
+
+@dataclass
 class ScriptQueryNode(Node):
     """script query (ref index/query/ScriptFilterParser): the expression
     evaluates per live doc against its source — an explicitly-scripted

@@ -320,6 +320,35 @@ class QueryParser:
         return ScriptQueryNode(script=script, params=spec.get("params"),
                                boost=float(spec.get("boost", 1.0)))
 
+    def _parse_geo_shape(self, spec: dict) -> Node:
+        spec = {k: v for k, v in spec.items()
+                if k not in ("_name", "ignore_unmapped")}
+        boost = float(spec.pop("boost", 1.0))
+        if len(spec) != 1:
+            raise QueryParsingException(
+                "geo_shape needs exactly one shape field")
+        (field, params), = spec.items()
+        shape = params.get("shape")
+        if shape is None:
+            if params.get("indexed_shape"):
+                raise QueryParsingException(
+                    "indexed_shape references are not supported; inline "
+                    "the shape in the query")
+            raise QueryParsingException("geo_shape requires a [shape]")
+        from ..mapping.mapper import DocumentMapper
+        from .query_dsl import GeoShapeNode
+        try:
+            box = DocumentMapper.shape_bbox(shape)
+        except (ValueError, TypeError, KeyError, IndexError) as e:
+            raise QueryParsingException(
+                f"unparseable shape {shape!r}: {e}") from e
+        if box is None:
+            raise QueryParsingException(f"unparseable shape {shape!r}")
+        return GeoShapeNode(
+            field_name=field, box=tuple(float(x) for x in box),
+            relation=str(params.get("relation", "intersects")).lower(),
+            boost=boost)
+
     def _parse_geo_polygon(self, spec: dict) -> Node:
         spec = {k: v for k, v in spec.items()
                 if k not in ("_name", "coerce", "ignore_malformed",

@@ -101,3 +101,74 @@ def test_geo_distance_sub_aggs(node):
         "aggs": {"p": {"stats": {"field": "price"}}}}})
     b = out["near"]["buckets"][0]
     assert b["doc_count"] == 2 and b["p"]["sum"] == 30
+
+
+class TestGeoShape:
+    @pytest.fixture(scope="class")
+    def gnode(self, tmp_path_factory):
+        n = NodeService(str(tmp_path_factory.mktemp("geoshape")))
+        n.create_index("shapes", mappings={"_doc": {"properties": {
+            "area": {"type": "geo_shape"}}}})
+        n.index_doc("shapes", "pt", {"area": {
+            "type": "point", "coordinates": [4.89, 52.37]}})
+        n.index_doc("shapes", "box", {"area": {
+            "type": "envelope", "coordinates": [[0.0, 10.0], [10.0, 0.0]]}})
+        n.index_doc("shapes", "poly", {"area": {
+            "type": "polygon", "coordinates": [[[100.0, 0.0], [101.0, 0.0],
+                                                [101.0, 1.0], [100.0, 1.0],
+                                                [100.0, 0.0]]]}})
+        n.refresh("shapes")
+        yield n
+        n.close()
+
+    def q(self, node, shape, relation="intersects"):
+        out = node.search("shapes", {"query": {"geo_shape": {"area": {
+            "shape": shape, "relation": relation}}}})
+        return sorted(h["_id"] for h in out["hits"]["hits"])
+
+    def test_intersects(self, gnode):
+        probe = {"type": "envelope", "coordinates": [[3.0, 53.0],
+                                                     [6.0, 51.0]]}
+        assert self.q(gnode, probe) == ["pt"]
+        wide = {"type": "envelope", "coordinates": [[-10.0, 60.0],
+                                                    [120.0, -10.0]]}
+        assert self.q(gnode, wide) == ["box", "poly", "pt"]
+
+    def test_within_and_contains(self, gnode):
+        wide = {"type": "envelope", "coordinates": [[99.0, 2.0],
+                                                    [102.0, -1.0]]}
+        assert self.q(gnode, wide, "within") == ["poly"]
+        tiny = {"type": "point", "coordinates": [5.0, 5.0]}
+        assert self.q(gnode, tiny, "contains") == ["box"]
+
+    def test_disjoint_and_circle(self, gnode):
+        far = {"type": "circle", "coordinates": [-170.0, -80.0],
+               "radius": "1km"}
+        assert self.q(gnode, far) == []
+        assert self.q(gnode, far, "disjoint") == ["box", "poly", "pt"]
+
+
+def test_geo_shape_malformed_and_multivalue(tmp_path):
+    from elasticsearch_tpu.mapping.mapper import MapperParsingException
+    from elasticsearch_tpu.search.query_parser import QueryParsingException
+    n = NodeService(str(tmp_path / "gs2"))
+    n.create_index("s2", mappings={"_doc": {"properties": {
+        "area": {"type": "geo_shape"}}}})
+    # malformed shapes are clean 400-class errors, not crashes
+    with pytest.raises(MapperParsingException):
+        n.index_doc("s2", "bad", {"area": {"type": "polygon",
+                                           "coordinates": []}})
+    # multi-valued field: bboxes UNION, so both shapes are findable
+    n.index_doc("s2", "multi", {"area": [
+        {"type": "point", "coordinates": [10.0, 10.0]},
+        {"type": "point", "coordinates": [50.0, 50.0]}]})
+    n.refresh("s2")
+    probe = {"type": "envelope", "coordinates": [[49.0, 51.0],
+                                                 [51.0, 49.0]]}
+    out = n.search("s2", {"query": {"geo_shape": {"area": {
+        "shape": probe}}}})
+    assert [h["_id"] for h in out["hits"]["hits"]] == ["multi"]
+    with pytest.raises(QueryParsingException):
+        n.search("s2", {"query": {"geo_shape": {"area": {"shape": {
+            "type": "polygon", "coordinates": ["x", "y"]}}}}})
+    n.close()

@@ -184,8 +184,7 @@ def run_suggest(body: dict, segments, mappers=None) -> dict:
                           "options": options[:int(p.get("size", 5))]}]
         elif "completion" in spec:
             p = spec["completion"]
-            vocab = sorted(_field_vocab(segments, p["field"]).items())
-            values = [v for v, _ in vocab]
+            entries = _completion_entries(segments, p["field"])
             # context-aware lookup: entries are prefix-encoded as
             # "<ctxkey>\x1f<input>" (mapper._index_completion); a context
             # in the request scopes the scan to that key's range
@@ -208,23 +207,66 @@ def run_suggest(body: dict, segments, mappers=None) -> dict:
                     else:
                         ctx_keys.extend(str(v) for v in (
                             cval if isinstance(cval, list) else [cval]))
+            # sorted-prefix lookup, the FST-automaton analog: entries are
+            # sorted by (ctx, lowercase input), so each (ctx, prefix) pair
+            # is one bisect + a contiguous walk — O(log V + hits), not a
+            # corpus scan (ref suggest/completion's FST traversal)
             want = str(text).lower()
             options = []
             seen = set()
-            for i, v in enumerate(values):
-                key, _, inp = v.rpartition("\x1f")
-                if ctx_keys is not None and key not in ctx_keys:
-                    continue
-                # completion analysis is case-insensitive (simple analyzer)
-                # but the ORIGINAL input is surfaced
-                if not inp.lower().startswith(want) or inp in seen:
-                    continue
-                seen.add(inp)
-                options.append({"text": inp, "score": float(vocab[i][1])})
+            if ctx_keys is None:
+                # no request context: every ctx bucket participates (incl.
+                # the un-contexted "" bucket) — same one bisect-per-bucket
+                # path, so scoring/dedup can never diverge between modes
+                ctx_keys = sorted({e[0] for e in entries})
+            for ck in ctx_keys:
+                lo = bisect.bisect_left(entries, (ck, want))
+                for ckey, lower, original, weight in entries[lo:]:
+                    if ckey != ck or not lower.startswith(want):
+                        break            # left the (ctx, prefix) range
+                    if original not in seen:
+                        seen.add(original)
+                        options.append({"text": original,
+                                        "score": float(weight)})
             options.sort(key=lambda o: (-o["score"], o["text"]))
             out[name] = [{"text": str(text), "offset": 0,
                           "length": len(str(text)),
                           "options": options[:int(p.get("size", 5))]}]
+    return out
+
+
+_COMPLETION_MERGED: dict = {}          # bounded memo of merged views
+
+
+def _completion_entries(segments, field: str) -> list[tuple]:
+    """Merged, SORTED completion entries across segments:
+    [(ctx_key, lowercase_input, original_input, weight_df)], ordered by
+    (ctx_key, lowercase_input) so prefix lookups bisect. The merged sorted
+    view is memoized per (field, segment set) — the FST-build analog done
+    once per reader, not per query (segments are append-immutable)."""
+    key = (field, tuple((id(s), s.seg_id, s.n_docs) for s in segments))
+    hit = _COMPLETION_MERGED.get(key)
+    if hit is not None:
+        return hit
+    merged: dict[tuple, float] = {}
+    for seg in segments:
+        cache = getattr(seg, "_completion_cache", None)
+        if cache is None:
+            cache = seg._completion_cache = {}
+        ents = cache.get(field)
+        if ents is None:
+            ents = []
+            for value, df in _field_vocab([seg], field).items():
+                ckey, _, inp = value.rpartition("\x1f")
+                ents.append((ckey, inp.lower(), inp, df))
+            cache[field] = ents
+        for ckey, lower, inp, df in ents:
+            k = (ckey, lower, inp)
+            merged[k] = merged.get(k, 0) + df
+    out = sorted((ck, lo, inp, w) for (ck, lo, inp), w in merged.items())
+    if len(_COMPLETION_MERGED) >= 64:
+        _COMPLETION_MERGED.pop(next(iter(_COMPLETION_MERGED)))
+    _COMPLETION_MERGED[key] = out
     return out
 
 

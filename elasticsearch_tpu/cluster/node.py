@@ -71,6 +71,7 @@ A_RECOVERY = "internal:index/shard/recovery/start"
 A_RECOVERY_CHUNK = "internal:index/shard/recovery/chunk"
 A_FS_STATS = "internal:monitor/fs"
 A_NODE_STATS = "cluster:monitor/nodes/stats"
+A_SHARD_STATS = "indices:monitor/stats[shard]"
 
 
 class NoMasterException(Exception):
@@ -136,7 +137,8 @@ class ClusterNode:
                 (A_RECOVERY, self._on_recovery),
                 (A_RECOVERY_CHUNK, self._on_recovery_chunk),
                 (A_FS_STATS, self._on_fs_stats),
-                (A_NODE_STATS, self._on_node_stats)]:
+                (A_NODE_STATS, self._on_node_stats),
+                (A_SHARD_STATS, self._on_shard_stats)]:
             self.transport.register_handler(action, handler)
         # ClusterInfoService + disk watermark decider (cluster/info.py;
         # ref InternalClusterInfoService + DiskThresholdDecider) — the
@@ -195,6 +197,82 @@ class ClusterNode:
         cur = self.cluster.current()
         return {"node": self.node_id, "version": cur.version,
                 "master": cur.master_node}
+
+    def _on_shard_stats(self, from_id: str, req: Any) -> dict:
+        """Per-shard stats for the BROADCAST template (ref action/support/
+        broadcast/TransportBroadcastOperationAction — every node answers
+        for the shards it holds; the coordinator aggregates)."""
+        names = set(req.get("indices") or [])
+        out = []
+        with self._shards_lock:
+            holders = list(self._shards.items())
+        for (index, sid), holder in holders:
+            if names and index not in names:
+                continue
+            if holder.engine is None:
+                continue
+            st = holder.engine.segment_stats()
+            out.append({"index": index, "shard": sid,
+                        "docs": holder.engine.doc_count(),
+                        "deleted": st["deleted"],
+                        "segments": st["count"],
+                        "store_bytes": st["memory_in_bytes"]})
+        return {"shards": out}
+
+    def indices_stats(self, index: str = "_all") -> dict:
+        """Broadcast fan-out: collect shard stats from every node holding
+        copies, aggregate per index (the _stats shape over a real
+        cluster)."""
+        state = self.cluster.current()
+        names = state.resolve_index(index)
+        if not names and index not in ("_all", "*", ""):
+            raise KeyError(f"no such index [{index}]")
+        per_index: dict[str, dict] = {
+            n: {"docs": 0, "deleted": 0, "segments": 0, "store_bytes": 0,
+                "shards": 0} for n in names}
+        # _shards counts SHARD COPIES consulted, like the reference's
+        # broadcast responses — not nodes
+        total = sum(1 for n in names
+                    for copies in state.routing.get(n, [])
+                    for c in copies if c["state"] == STARTED)
+        successful = 0
+        for node_id in sorted(state.nodes):
+            try:
+                if node_id == self.node_id:
+                    out = self._on_shard_stats(self.node_id,
+                                               {"indices": names})
+                else:
+                    out = self.transport.send(node_id, A_SHARD_STATS,
+                                              {"indices": names})
+            except (ConnectTransportException, RemoteTransportException):
+                continue
+            successful += len(out["shards"])
+            for sh in out["shards"]:
+                agg = per_index.get(sh["index"])
+                if agg is None:
+                    continue
+                agg["docs"] += sh["docs"]
+                agg["deleted"] += sh["deleted"]
+                agg["segments"] += sh["segments"]
+                agg["store_bytes"] += sh["store_bytes"]
+                agg["shards"] += 1
+        indices = {
+            n: {"total": {
+                "docs": {"count": a["docs"], "deleted": a["deleted"]},
+                "store": {"size_in_bytes": a["store_bytes"]},
+                "segments": {"count": a["segments"]},
+                "shard_copies": a["shards"]}}
+            for n, a in per_index.items()}
+        return {"_shards": {"total": total, "successful": successful,
+                            "failed": max(total - successful, 0)},
+                "_all": {"total": {
+                    "docs": {"count": sum(a["docs"]
+                                          for a in per_index.values()),
+                             "deleted": sum(a["deleted"]
+                                            for a in per_index.values())},
+                    "store": {"size_in_bytes": sum(
+                        a["store_bytes"] for a in per_index.values())}}},
+                "indices": indices}
 
     def _on_node_stats(self, from_id: str, req: Any) -> dict:
         """Full per-node stats for the nodes-template fan-out (ref

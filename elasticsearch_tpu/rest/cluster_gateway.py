@@ -99,6 +99,14 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
         return 200, {"cluster_name": "elasticsearch-tpu", "nodes": infos}
     c.register("GET", "/_nodes", nodes_info)
 
+    def indices_stats(g, p, b):
+        # the broadcast template over the transport: shard stats from
+        # every holder, coordinator-aggregated (ref
+        # TransportIndicesStatsAction over TransportBroadcastOperation)
+        return 200, node.indices_stats(g.get("index", "_all"))
+    c.register("GET", "/_stats", indices_stats)
+    c.register("GET", "/{index}/_stats", indices_stats)
+
     # -- index admin (master template) ------------------------------------
     def create_index(g, p, b):
         body = _json_body(b)

@@ -221,7 +221,8 @@ def run_suggest(body: dict, segments, mappers=None) -> dict:
                 ctx_keys = sorted({e[0] for e in entries})
             for ck in ctx_keys:
                 lo = bisect.bisect_left(entries, (ck, want))
-                for ckey, lower, original, weight in entries[lo:]:
+                for j in range(lo, len(entries)):   # no tail copy
+                    ckey, lower, original, weight = entries[j]
                     if ckey != ck or not lower.startswith(want):
                         break            # left the (ctx, prefix) range
                     if original not in seen:

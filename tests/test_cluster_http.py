@@ -167,3 +167,17 @@ def test_nodes_stats_fan_out(http):
         assert stats["os"]["mem"]["total_in_bytes"] > 0
         assert stats["fs"]["total"]["total_in_bytes"] > 0
         assert "indices" in stats
+
+
+def test_indices_stats_broadcast(http):
+    """Shard stats aggregate across every copy-holding node (the broadcast
+    template; ref TransportBroadcastOperationAction)."""
+    cluster, base = http
+    code, out = req(base, "GET", "/ha/_stats")
+    assert code == 200
+    st = out["indices"]["ha"]["total"]
+    assert st["docs"]["count"] >= 10            # primaries + replicas
+    assert out["_shards"]["failed"] == 0
+    assert st["shard_copies"] >= 1
+    code, out = req(base, "GET", "/_stats")
+    assert code == 200 and out["_all"]["total"]["docs"]["count"] >= 10

@@ -88,19 +88,87 @@ def highlight_hit(spec: HighlightSpec, source: dict,
         # offset-aware pass: a token matches if ANY of its analyzed forms
         # is a wanted term (stemming-safe)
         analyzer = analyzer_for(fname) if analyzer_for is not None else None
-        matches = []                     # (start, end)
+        matches = []                     # (start, end, matched term)
         for m in _WORD.finditer(text):
             token = m.group(0)
             norm = analyzer(token) if analyzer is not None else [token.lower()]
-            if any(t in wanted for t in norm) or token.lower() in wanted:
-                matches.append((m.start(), m.end()))
+            hit_term = next((t for t in norm if t in wanted), None)
+            if hit_term is None and token.lower() in wanted:
+                hit_term = token.lower()
+            if hit_term is not None:
+                matches.append((m.start(), m.end(), hit_term))
         if not matches:
             continue
-        frags = _build_fragments(text, matches, frag_size, n_frags,
-                                 pre, post)
+        ht = str(fopts.get("type", fopts.get("highlighter_type", "plain")))
+        if ht in ("fvh", "fast-vector-highlighter", "postings"):
+            frags = _build_fragments_fvh(text, matches, frag_size,
+                                         n_frags, pre, post)
+        else:
+            frags = _build_fragments(text,
+                                     [(s, e) for s, e, _ in matches],
+                                     frag_size, n_frags, pre, post)
         if frags:
             out[fname] = frags
     return out
+
+
+def _build_fragments_fvh(text: str, matches: list, frag_size: int,
+                         n_frags: int, pre: str, post: str) -> list[str]:
+    """Match-centered fragmenting (ref FastVectorHighlighter's
+    SimpleFragListBuilder + ScoreOrderFragmentsBuilder, and the Lucene
+    postings highlighter's passage scoring): windows CENTER on match
+    clusters instead of fixed grid positions, score by (distinct terms,
+    match count), and snap to word boundaries — the quality difference
+    over the plain fragmenter, minus the stored-offsets shortcut (offsets
+    come from the same re-analysis pass here)."""
+    if n_frags == 0:
+        return _build_fragments(text, [(s, e) for s, e, _ in matches],
+                                frag_size, 0, pre, post)
+    # greedy clustering: extend a window while the next match still fits
+    clusters = []                        # (lo, hi, [(s, e, term)])
+    cur: list = []
+    for s, e, t in matches:
+        if cur and e - cur[0][0] > max(frag_size, 1):
+            clusters.append(cur)
+            cur = []
+        cur.append((s, e, t))
+    if cur:
+        clusters.append(cur)
+    scored = []
+    for ci, cl in enumerate(clusters):
+        span_lo, span_hi = cl[0][0], cl[-1][1]
+        pad = max((frag_size - (span_hi - span_lo)) // 2, 0)
+        lo = max(span_lo - pad, 0)
+        hi = min(span_hi + pad, len(text))
+        # snap OUTWARD-trimmed boundaries to word edges
+        while lo > 0 and text[lo - 1].isalnum():
+            lo -= 1
+        while hi < len(text) and text[hi].isalnum():
+            hi += 1
+        # the window may have grown past the cluster (padding/snapping):
+        # EVERY match visible in [lo, hi) gets tags, wherever it clustered
+        inside = [(s, e) for s, e, _ in matches if lo <= s and e <= hi]
+        scored.append((len({t for _, _, t in cl}), len(cl), ci, lo, hi,
+                       inside))
+    scored.sort(key=lambda x: (-x[0], -x[1], x[2]))
+    scored = scored[:n_frags]
+    scored.sort(key=lambda x: x[2])      # render in text order
+    return [_render_fragment(text, lo, hi, inside, pre, post)
+            for _, _, _, lo, hi, inside in scored]
+
+
+def _render_fragment(text: str, lo: int, hi: int, inside: list,
+                     pre: str, post: str) -> str:
+    buf = []
+    pos = lo
+    for s, e in inside:
+        buf.append(text[pos:s])
+        buf.append(pre)
+        buf.append(text[s:e])
+        buf.append(post)
+        pos = e
+    buf.append(text[pos:hi])
+    return "".join(buf)
 
 
 def _build_fragments(text: str, matches: list, frag_size: int,
@@ -126,16 +194,5 @@ def _build_fragments(text: str, matches: list, frag_size: int,
     if n_frags:
         scored = scored[:n_frags]
     scored.sort(key=lambda x: x[1])      # render in text order
-    out = []
-    for _, _, lo, hi, inside in scored:
-        buf = []
-        pos = lo
-        for s, e in inside:
-            buf.append(text[pos:s])
-            buf.append(pre)
-            buf.append(text[s:e])
-            buf.append(post)
-            pos = e
-        buf.append(text[pos:hi])
-        out.append("".join(buf))
-    return out
+    return [_render_fragment(text, lo, hi, inside, pre, post)
+            for _, _, lo, hi, inside in scored]

@@ -92,3 +92,30 @@ class TestHighlight:
         frags = out["hits"]["hits"][0]["highlight"]["title"]
         assert len(frags) == 1
         assert frags[0] == "The quick brown <em>fox</em>"
+
+
+def test_fvh_highlighter_centers_fragments(tmp_path):
+    """type: fvh — match-centered fragments scored by distinct terms
+    (ref FastVectorHighlighter / postings highlighter passage scoring)."""
+    from elasticsearch_tpu.node import NodeService
+    node = NodeService(str(tmp_path / "fvh"))
+    node.create_index("h")
+    filler = "filler " * 40
+    node.index_doc("h", "1", {"body": f"{filler}quick brown fox{filler}"
+                                      f"only quick here{filler}"})
+    node.refresh("h")
+    out = node.search("h", {
+        "query": {"match": {"body": "quick brown"}},
+        "highlight": {"fields": {"body": {"type": "fvh",
+                                          "fragment_size": 60,
+                                          "number_of_fragments": 1}}}})
+    frags = out["hits"]["hits"][0]["highlight"]["body"]
+    # the single best fragment is the TWO-distinct-term cluster, centered
+    assert len(frags) == 1
+    assert "<em>quick</em>" in frags[0] and "<em>brown</em>" in frags[0]
+    # plain type still works through the same request shape
+    out2 = node.search("h", {
+        "query": {"match": {"body": "quick"}},
+        "highlight": {"fields": {"body": {"type": "plain"}}}})
+    assert out2["hits"]["hits"][0]["highlight"]["body"]
+    node.close()

@@ -36,6 +36,22 @@ import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Wall-clock budget: the harness kills bench.py at ~870s (round 5 hit
+# rc=124 and lost the whole headline line). Legs check the budget between
+# measurement passes and DEGRADE — the headline JSON always prints from
+# whatever completed.
+BENCH_T0 = time.monotonic()
+BENCH_TIME_BUDGET = float(os.environ.get("BENCH_TIME_BUDGET", "600"))
+
+
+def _remaining() -> float:
+    return BENCH_TIME_BUDGET - (time.monotonic() - BENCH_T0)
+
+
+def _over_budget(margin: float = 0.0) -> bool:
+    return _remaining() <= margin
+
+
 N_DOCS = int(os.environ.get("BENCH_DOCS", str(100_000)))
 VOCAB = 30_000
 AVG_DL = 20
@@ -150,6 +166,8 @@ def run_agg_leg(tag: str) -> dict:
             for pl in payloads:
                 out = http(port, "POST", "/_msearch", pl)
                 n += len(out["responses"])
+            if _over_budget():
+                break          # a slow leg degrades the number, not erases it
         return {"agg_qps": n / (time.perf_counter() - t1),
                 "agg_index_secs": index_secs}
     finally:
@@ -253,6 +271,8 @@ def run_vector_leg(tag: str) -> dict:
                 for pl in payloads:
                     out = http(port, "POST", "/_msearch", pl)
                     n += len(out["responses"])
+                if _over_budget():
+                    break
             return n / (time.perf_counter() - t1), recall
 
         # config #4: exact kNN through the product (knn body -> MXU matmul)
@@ -341,6 +361,8 @@ def run_engine_leg(tag: str) -> dict:
                 for pl in payloads:
                     out = http(port, "POST", "/_msearch", pl)
                     n += len(out["responses"])
+                if _over_budget():
+                    break
             return n / (time.perf_counter() - t1)
 
         # config #1: match query, top-K
@@ -371,7 +393,14 @@ def run_engine_leg(tag: str) -> dict:
         lat.sort()
 
         # concurrent solo clients (NOT pre-batched msearch): the dynamic
-        # batcher coalesces these into shared device programs
+        # batcher coalesces these into shared device programs. Skipped
+        # cleanly when the wall-clock budget is spent.
+        if _over_budget(margin=30.0):
+            return {"qps": qps, "qps_filter": qps_filter,
+                    "p50_ms": lat[len(lat) // 2],
+                    "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+                    "conc_qps": None, "conc_p50_ms": None,
+                    "conc_clients": 0, "index_secs": index_secs}
         import threading
         CONC = int(os.environ.get("BENCH_CONC", "32"))
         PER = 8
@@ -423,10 +452,20 @@ def run_engine_leg(tag: str) -> dict:
 
 def _run_all_legs(tag: str) -> dict:
     res = run_engine_leg(tag)
-    if os.environ.get("BENCH_AGG", "1") != "0":
-        res.update(run_agg_leg(tag))
-    if os.environ.get("BENCH_VEC", "1") != "0":
-        res.update(run_vector_leg(tag))
+    # optional legs run only while the budget allows AND degrade to
+    # absent keys on failure — the headline line always prints
+    for flag, leg in (("BENCH_AGG", run_agg_leg),
+                      ("BENCH_VEC", run_vector_leg)):
+        if os.environ.get(flag, "1") == "0":
+            continue
+        if _over_budget(margin=90.0):
+            print(f"{flag} leg skipped: {_remaining():.0f}s of "
+                  f"BENCH_TIME_BUDGET left", file=sys.stderr)
+            continue
+        try:
+            res.update(leg(tag))
+        except Exception as e:  # noqa: BLE001 — legs are best-effort
+            print(f"{flag} leg failed: {e}", file=sys.stderr)
     return res
 
 
@@ -440,14 +479,19 @@ def main_engine():
                   "hybrid_qps"]
     if plat == "cpu":
         ratios = {k: 1.0 for k in ratio_keys if k in res}
-    elif os.environ.get("BENCH_CPU", "1") != "0":
+    elif os.environ.get("BENCH_CPU", "1") != "0" and not _over_budget(60.0):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_LEG"] = "cpu"
+        # the CPU leg gets what's LEFT of the budget (minus headroom to
+        # print): a timeout here degrades vs_baseline to null, it no
+        # longer erases the headline line (BENCH_r05 rc=124)
+        env["BENCH_TIME_BUDGET"] = str(max(30.0, _remaining() - 30.0))
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=7200)
+                env=env, capture_output=True, text=True,
+                timeout=max(30.0, _remaining() - 15.0))
             for ln in out.stdout.splitlines():
                 if ln.startswith("{"):
                     cpu = json.loads(ln)
@@ -461,19 +505,22 @@ def main_engine():
         except Exception as e:  # noqa: BLE001 — baseline leg is best-effort
             print(f"cpu leg failed: {e}", file=sys.stderr)
     rnd = lambda x: round(x, 3) if x is not None else None  # noqa: E731
+    r2 = lambda x: round(x, 2) \
+        if isinstance(x, (int, float)) else None  # noqa: E731
     line = {
         "metric": f"http_msearch_bm25_top{K}_qps_{N_DOCS // 1000}k_docs",
-        "value": round(res["qps"], 2), "unit": "qps",
+        "value": r2(res["qps"]), "unit": "qps",
         "vs_baseline": rnd(ratios.get("qps")),
-        "qps_filter": round(res["qps_filter"], 2),
+        "qps_filter": r2(res["qps_filter"]),
         "vs_baseline_filter": rnd(ratios.get("qps_filter")),
-        "conc_qps": round(res["conc_qps"], 2),
+        "conc_qps": r2(res["conc_qps"]),
         "vs_baseline_concurrent": rnd(ratios.get("conc_qps")),
-        "conc_p50_ms": round(res["conc_p50_ms"], 2),
+        "conc_p50_ms": r2(res["conc_p50_ms"]),
         "conc_clients": res["conc_clients"],
-        "p50_ms": round(res["p50_ms"], 2),
-        "p99_ms": round(res["p99_ms"], 2),
+        "p50_ms": r2(res["p50_ms"]),
+        "p99_ms": r2(res["p99_ms"]),
         "index_secs": round(res["index_secs"], 1),
+        "budget_secs_left": round(_remaining(), 1),
         "platform": plat}
     if "agg_qps" in res:
         line.update({

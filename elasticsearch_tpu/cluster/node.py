@@ -112,6 +112,11 @@ class ClusterNode:
         self._mappers: dict[str, MapperService] = {}
         self._shards_lock = threading.RLock()
         self.closed = False
+        # distributed task registry: coordinator tasks here, shard tasks on
+        # the copy-holders with the coordinator as parent (the `_task` wire
+        # header on shard messages; ref tasks/TaskManager + TaskId)
+        from ..common.tasks import TaskManager
+        self.tasks = TaskManager(node_id)
         for action, handler in [
                 (A_JOIN, self._on_join), (A_PING, self._on_ping),
                 (A_NODE_FAILED, self._on_node_failed),
@@ -1327,9 +1332,21 @@ class ClusterNode:
                 "dfs": [[f, t, df]
                         for (f, t), df in stats.doc_freqs.items()]}
 
+    def _task_header(self, task) -> dict:
+        """Wire header linking a shard-level message to its coordinator
+        task (crosses the JSON transport as plain strings)."""
+        return {"parent": task.id, "trace": task.trace_id,
+                "opaque": task.opaque_id}
+
     def search(self, index: str, body: dict | None = None,
                preference: str | None = None,
                scroll: str | None = None) -> dict:
+        with self.tasks.scope("indices:data/read/search",
+                              description=f"indices[{index}]") as task:
+            return self._search(index, body, preference, scroll, task)
+
+    def _search(self, index: str, body: dict | None,
+                preference: str | None, scroll: str | None, task) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         size = int(body.get("size", 10))
@@ -1360,7 +1377,8 @@ class ClusterNode:
         failures: list[dict] = []
         for ti, (node, name, sid) in enumerate(targets):
             payload = {"index": name, "shard": sid, "body": body,
-                       "size": size + from_, "dfs": dfs}
+                       "size": size + from_, "dfs": dfs,
+                       "_task": self._task_header(task)}
             try:
                 per_shard.append(
                     (ti, self._shard_call(node, A_QUERY, payload)))
@@ -1374,7 +1392,7 @@ class ClusterNode:
 
         reduced = self._reduce(per_shard, targets, body, names,
                                from_, size)
-        hits = self._fetch_phase(reduced, targets, body)
+        hits = self._fetch_phase(reduced, targets, body, task)
         resp = self._render_response(reduced, hits, targets, failures,
                                      agg_specs, per_shard, body, t0)
         return resp
@@ -1411,7 +1429,7 @@ class ClusterNode:
         return {"window": window, "total": total, "max_score": max_score,
                 "sorted": sort is not None}
 
-    def _fetch_phase(self, reduced, targets, body) -> dict:
+    def _fetch_phase(self, reduced, targets, body, task=None) -> dict:
         """Fetch fan-out to winning shards only; highlight runs ON the data
         node inside fetch (ref FetchPhase sub-phases)."""
         by_target: dict[int, list[str]] = {}
@@ -1424,6 +1442,8 @@ class ClusterNode:
                        "_source": body.get("_source", True),
                        "highlight": body.get("highlight"),
                        "query": body.get("query")}
+            if task is not None:
+                payload["_task"] = self._task_header(task)
             try:
                 fr = self._shard_call(node, A_FETCH, payload)
             except (ConnectTransportException, RemoteTransportException):
@@ -1498,6 +1518,16 @@ class ClusterNode:
                 sid, eng.segments, self._mappers[index]))
         return holder.searcher[1]
 
+    def _shard_task_scope(self, action: str, req: dict):
+        """Register the shard-level action under the coordinator task the
+        message carries (remote copy-holders show the coordinator as
+        parent — TaskId-over-the-wire semantics)."""
+        hdr = req.get("_task") or {}
+        return self.tasks.scope(
+            action, description=f"shard [{req['index']}][{req['shard']}]",
+            parent_task_id=hdr.get("parent"), trace_id=hdr.get("trace"),
+            opaque_id=hdr.get("opaque"))
+
     def _on_query(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))
         if holder is None or holder.engine is None:
@@ -1506,16 +1536,20 @@ class ClusterNode:
         searcher = self._searcher(req["index"], req["shard"], holder)
         body = req.get("body") or {}
         k = int(req["size"])
-        return _shard_query_phase(searcher, self._mappers[req["index"]],
-                                  body, k, req.get("dfs"),
-                                  search_after=req.get("search_after"))
+        with self._shard_task_scope(
+                "indices:data/read/search[phase/query]", req):
+            return _shard_query_phase(searcher, self._mappers[req["index"]],
+                                      body, k, req.get("dfs"),
+                                      search_after=req.get("search_after"))
 
     def _on_fetch(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))
         if holder is None or holder.engine is None:
             raise UnavailableShardsException(f"[{req['index']}]")
-        return _shard_fetch_phase(holder.engine,
-                                  self._mappers[req["index"]], req)
+        with self._shard_task_scope(
+                "indices:data/read/search[phase/fetch/id]", req):
+            return _shard_fetch_phase(holder.engine,
+                                      self._mappers[req["index"]], req)
 
     # -- distributed scroll (ref scroll_id encoding per-shard context ids,
     #    action/search/type/TransportSearchHelper + SearchService

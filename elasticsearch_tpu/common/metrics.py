@@ -1,4 +1,4 @@
-"""Per-phase search timers + search slowlog.
+"""Per-phase search timers, histogram metrics, request profiling + slowlog.
 
 The observability floor (SURVEY §5.1/§5.5; VERDICT r4 #10):
   * PhaseTimers — parse / device(query) / fetch / render wall-time
@@ -7,16 +7,30 @@ The observability floor (SURVEY §5.1/§5.5; VERDICT r4 #10):
     queryTime/fetchTime) — here the interesting split is host parse vs
     device program vs response render, because host overhead is where
     TPU serving loses its speedup.
+  * MetricsRegistry — histogram-capable named timers (count/sum/min/max/
+    p50/p99 from a bounded reservoir), the `profiling` section of
+    `_nodes/stats`.
+  * RequestProfiler — the per-request timing tree behind `"profile": true`
+    on `_search` (ref search/profile/ Profilers + InternalProfiler in
+    later reference versions). The TPU twist the reference never had: jit
+    retraces and host↔device transfers silently dominate tail latency, so
+    the profiler also diffs process-wide compile events (jax.monitoring)
+    and counts bytes crossing the device boundary per request.
   * SlowLog — per-index query slowlog with live-updatable thresholds
     (ref index/search/slowlog/ShardSlowLogSearchService.java: warn/info/
-    debug/trace thresholds from index settings, applied per request).
+    debug/trace thresholds from index settings, applied per request),
+    stamped with the request's trace/opaque ids so one id correlates the
+    slowlog, the task listing and the profile output.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import threading
 import time
+import uuid
 from collections import deque
 
 
@@ -42,6 +56,245 @@ class PhaseTimers:
                         "time_in_millis": round(a[1], 3),
                         "max_millis": round(a[2], 3)}
                     for p, a in self._acc.items() if a[0]}
+
+
+class MetricsRegistry:
+    """Named wall-time histograms: count/sum/min/max plus p50/p99 computed
+    from a bounded sample reservoir (the reference keeps count+sum only;
+    tail percentiles are what a latency SLO actually needs)."""
+
+    def __init__(self, reservoir: int = 512):
+        self._lock = threading.Lock()
+        self._reservoir = reservoir
+        self._timers: dict[str, dict] = {}
+
+    def record(self, name: str, ms: float) -> None:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = {
+                    "count": 0, "sum": 0.0,
+                    "min": float("inf"), "max": 0.0,
+                    "samples": deque(maxlen=self._reservoir)}
+            t["count"] += 1
+            t["sum"] += ms
+            t["min"] = min(t["min"], ms)
+            t["max"] = max(t["max"], ms)
+            t["samples"].append(ms)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - t0) * 1000)
+
+    def stats(self) -> dict:
+        with self._lock:
+            snap = {n: (t["count"], t["sum"], t["min"], t["max"],
+                        sorted(t["samples"]))
+                    for n, t in self._timers.items()}
+        out = {}
+        for name, (count, total, mn, mx, samples) in snap.items():
+            entry = {"count": count,
+                     "time_in_millis": round(total, 3),
+                     "min_millis": round(mn, 3),
+                     "max_millis": round(mx, 3)}
+            if samples:
+                entry["p50_millis"] = round(
+                    samples[len(samples) // 2], 3)
+                entry["p99_millis"] = round(
+                    samples[min(len(samples) - 1,
+                                int(len(samples) * 0.99))], 3)
+            out[name] = entry
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Device-level counters: jit compiles (retraces) via jax.monitoring, bytes
+# crossing the host↔device boundary via the device_fetch/note_h2d seams.
+# Process-wide accumulators; RequestProfiler diffs them around a request.
+# ---------------------------------------------------------------------------
+
+_DEVICE_EVENTS = {"compiles": 0, "compile_ms": 0.0}
+_DEVICE_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def _install_compile_listener() -> None:
+    """Register a jax.monitoring duration listener (idempotent). Compile
+    events fire only on an actual retrace+compile, never on a cache-hit
+    dispatch — exactly the signal the no-retrace tripwire needs. Degrades
+    to zeros on jax builds without the monitoring API."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    _LISTENER_INSTALLED = True
+    try:
+        import jax
+
+        def _on_duration(name, secs, **kw):  # noqa: ANN001 — jax callback
+            if "/jax/core/compile/" not in name:
+                return
+            with _DEVICE_LOCK:
+                if name.endswith("backend_compile_duration"):
+                    _DEVICE_EVENTS["compiles"] += 1
+                _DEVICE_EVENTS["compile_ms"] += secs * 1000.0
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — observability must never break serving
+        pass
+
+
+def device_events_snapshot() -> tuple[int, float]:
+    with _DEVICE_LOCK:
+        return _DEVICE_EVENTS["compiles"], _DEVICE_EVENTS["compile_ms"]
+
+
+def _nbytes(x) -> int:
+    if isinstance(x, dict):
+        return sum(_nbytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_nbytes(v) for v in x)
+    return int(getattr(x, "nbytes", 0))
+
+
+def device_fetch(x):
+    """jax.device_get with per-request accounting: when a profiler is
+    active, the fetch counts as one device round-trip and its payload as
+    device→host bytes. The hot paths call this INSTEAD of jax.device_get,
+    so `"profile": true` sees every transfer without touching the kernels."""
+    import jax
+    out = jax.device_get(x)
+    prof = _PROFILER.get()
+    if prof is not None:
+        prof.note_dispatch()
+        prof.note_d2h(_nbytes(out))
+    return out
+
+
+_PROFILER: contextvars.ContextVar["RequestProfiler | None"] = \
+    contextvars.ContextVar("es_request_profiler", default=None)
+
+
+def current_profiler() -> "RequestProfiler | None":
+    return _PROFILER.get()
+
+
+@contextlib.contextmanager
+def use_profiler(prof: "RequestProfiler"):
+    tok = _PROFILER.set(prof)
+    try:
+        yield prof
+    finally:
+        _PROFILER.reset(tok)
+
+
+class RequestProfiler:
+    """Per-request timing tree: coordinator phases, per-shard query
+    execution with per-DSL-node score/match wall time (non-jit-visible
+    timers around the jitted calls — query_dsl.Node instruments itself
+    against the active profiler), plus the device section (jit cache
+    hit/miss, compile time when a retrace fired, host↔device bytes)."""
+
+    def __init__(self, trace_id: str | None = None):
+        _install_compile_listener()
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.phases: dict[str, float] = {}
+        self.shards: list[dict] = []
+        self._shard_stack: list[dict] = []
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.d2h_bytes = 0
+        self.h2d_bytes = 0
+        self._jit0 = device_events_snapshot()
+
+    # -- coordinator phases ------------------------------------------------
+
+    def record_phase(self, name: str, ms: float) -> None:
+        with self._lock:
+            self.phases[name] = self.phases.get(name, 0.0) + ms
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_phase(name, (time.perf_counter() - t0) * 1000)
+
+    # -- per-shard tree ----------------------------------------------------
+
+    @contextlib.contextmanager
+    def shard(self, index: str, shard_id: int):
+        entry = {"index": index, "shard_id": shard_id,
+                 "time_in_millis": 0.0, "query": {}}
+        with self._lock:
+            self.shards.append(entry)
+            self._shard_stack.append(entry)
+        t0 = time.perf_counter()
+        try:
+            yield entry
+        finally:
+            entry["time_in_millis"] = round(
+                (time.perf_counter() - t0) * 1000, 3)
+            with self._lock:
+                self._shard_stack.pop()
+
+    def record_node(self, node_type: str, op: str, ms: float) -> None:
+        """One DSL-node execution (op: score|match) — aggregated per node
+        type inside the current shard, or under a synthetic 'coordinator'
+        shard when node execution happens outside a shard scope."""
+        with self._lock:
+            if self._shard_stack:
+                tree = self._shard_stack[-1]["query"]
+            else:
+                if not self.shards or self.shards[-1].get("index") != "_coordinator":
+                    self.shards.append({"index": "_coordinator",
+                                        "shard_id": -1,
+                                        "time_in_millis": 0.0, "query": {}})
+                tree = self.shards[-1]["query"]
+            b = tree.setdefault(node_type, {
+                "score_count": 0, "score_time_in_millis": 0.0,
+                "match_count": 0, "match_time_in_millis": 0.0})
+            b[f"{op}_count"] += 1
+            b[f"{op}_time_in_millis"] = round(
+                b[f"{op}_time_in_millis"] + ms, 3)
+
+    # -- device counters ---------------------------------------------------
+
+    def note_dispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self.dispatches += n
+
+    def note_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+
+    def note_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+
+    def device_section(self) -> dict:
+        compiles, compile_ms = device_events_snapshot()
+        misses = compiles - self._jit0[0]
+        return {"jit_cache_misses": misses,
+                "jit_cache_hits": max(self.dispatches - misses, 0),
+                "compile_time_in_millis": round(
+                    compile_ms - self._jit0[1], 3),
+                "bytes_device_to_host": self.d2h_bytes,
+                "bytes_host_to_device": self.h2d_bytes}
+
+    def render(self, opaque_id: str | None = None) -> dict:
+        out = {"trace_id": self.trace_id,
+               "phases": {k: round(v, 3) for k, v in self.phases.items()},
+               "shards": [{"id": f"[{s['index']}][{s['shard_id']}]", **s}
+                          for s in self.shards],
+               "device": self.device_section()}
+        if opaque_id is not None:
+            out["x_opaque_id"] = opaque_id
+        return out
 
 
 def _threshold_ms(settings, level: str,
@@ -81,8 +334,11 @@ class SlowLog:
             return list(self.tail)
 
     def maybe_log(self, settings, index: str, took_ms: float,
-                  body) -> str | None:
-        """Returns the level logged at, or None."""
+                  body, trace_id: str | None = None,
+                  opaque_id: str | None = None) -> str | None:
+        """Returns the level logged at, or None. trace_id/opaque_id stamp
+        the tail entry so a slow request correlates with its task listing
+        and profile output (the X-Opaque-Id contract)."""
         for level, log_fn in (("warn", self.logger.warning),
                               ("info", self.logger.info),
                               ("debug", self.logger.debug),
@@ -95,6 +351,10 @@ class SlowLog:
                 entry = {"level": level, "index": index,
                          "took_millis": round(took_ms, 2),
                          self.PAYLOAD_FIELD: payload}
+                if trace_id is not None:
+                    entry["trace_id"] = trace_id
+                if opaque_id is not None:
+                    entry["x_opaque_id"] = opaque_id
                 with self._lock:
                     self.tail.append(entry)
                 log_fn("[%s] took[%sms], %s[%s]", index,

@@ -1,0 +1,178 @@
+"""Distributed task management: every coordinator and shard-level action
+registers here with an id, parent task id, action name, start time and
+description.
+
+Analog of the reference's TaskManager + ListTasksAction surface
+(tasks/TaskManager, rest/action/admin/cluster/node/tasks — `GET /_tasks`,
+`GET /_tasks/{id}`, `GET /_cat/tasks` in later reference versions). Parent
+linkage crosses the cluster transport as a `_task` header on the shard
+messages (cluster/node.py), so a shard task on a remote copy-holder shows
+its coordinator as parent — the reference's TaskId(nodeId, id) wire header.
+
+Trace propagation rides the same context: each task carries the request's
+generated trace id plus the caller-supplied `X-Opaque-Id`, and child scopes
+inherit both. One id then correlates the task listing, the slowlog tail and
+the profile output.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import re
+import threading
+import time
+import uuid
+from collections import deque
+
+_CURRENT: contextvars.ContextVar["Task | None"] = \
+    contextvars.ContextVar("es_current_task", default=None)
+
+
+def current_task() -> "Task | None":
+    return _CURRENT.get()
+
+
+class Task:
+    __slots__ = ("id", "node", "seq", "action", "description",
+                 "parent_task_id", "start_time_ms", "_start_mono",
+                 "opaque_id", "trace_id")
+
+    def __init__(self, node: str, seq: int, action: str, description: str,
+                 parent_task_id: str | None, opaque_id: str | None,
+                 trace_id: str):
+        self.node = node
+        self.seq = seq
+        self.id = f"{node}:{seq}"
+        self.action = action
+        self.description = description
+        self.parent_task_id = parent_task_id
+        self.start_time_ms = int(time.time() * 1000)
+        self._start_mono = time.monotonic()
+        self.opaque_id = opaque_id
+        self.trace_id = trace_id
+
+    def running_time_ns(self) -> int:
+        return int((time.monotonic() - self._start_mono) * 1e9)
+
+    def info(self, detailed: bool = False) -> dict:
+        out = {"node": self.node, "id": self.seq, "type": "transport",
+               "action": self.action,
+               "start_time_in_millis": self.start_time_ms,
+               "running_time_in_nanos": self.running_time_ns(),
+               "cancellable": False,
+               "headers": {}}
+        if self.parent_task_id is not None:
+            out["parent_task_id"] = self.parent_task_id
+        if self.opaque_id is not None:
+            out["headers"]["X-Opaque-Id"] = self.opaque_id
+        out["headers"]["trace_id"] = self.trace_id
+        if detailed:
+            out["description"] = self.description
+        return out
+
+
+class TaskManager:
+    """Node-level registry of in-flight actions. Registration is a dict
+    insert under a lock — cheap enough to wrap every request AND every
+    per-shard phase. A bounded ring of recently-completed task infos keeps
+    short-lived tasks assertable (the reference's tasks are observable via
+    the list API only while running; the ring is this repo's test seam,
+    exposed under `GET /_tasks?recent=true`)."""
+
+    def __init__(self, node_id: str, recent: int = 128):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tasks: dict[str, Task] = {}
+        self.total_started = 0
+        self._recent: deque = deque(maxlen=recent)
+
+    def register(self, action: str, description: str = "",
+                 parent_task_id: str | None = None,
+                 opaque_id: str | None = None,
+                 trace_id: str | None = None) -> Task:
+        with self._lock:
+            self._seq += 1
+            self.total_started += 1
+            task = Task(self.node_id, self._seq, action, description,
+                        parent_task_id, opaque_id,
+                        trace_id or uuid.uuid4().hex[:16])
+            self._tasks[task.id] = task
+            return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            self._recent.append(task.info(detailed=True))
+
+    @contextlib.contextmanager
+    def scope(self, action: str, description: str = "",
+              parent_task_id: str | None = None,
+              opaque_id: str | None = None,
+              trace_id: str | None = None):
+        """Register a task for the duration of the block and make it the
+        current task — children opened inside inherit parent/trace/opaque
+        automatically (coordinator → shard linkage without plumbing)."""
+        cur = _CURRENT.get()
+        if cur is not None:
+            if parent_task_id is None:
+                parent_task_id = cur.id
+            if opaque_id is None:
+                opaque_id = cur.opaque_id
+            if trace_id is None:
+                trace_id = cur.trace_id
+        task = self.register(action, description, parent_task_id,
+                             opaque_id, trace_id)
+        tok = _CURRENT.set(task)
+        try:
+            yield task
+        finally:
+            _CURRENT.reset(tok)
+            self.unregister(task)
+
+    # -- listing (the GET /_tasks wire shape) ------------------------------
+
+    @staticmethod
+    def _action_match(action: str, patterns: list[str] | None) -> bool:
+        """ES simple-match: ONLY `*` is a wildcard — action names contain
+        `[`/`]` (phase suffixes), which fnmatch would read as char classes."""
+        if not patterns:
+            return True
+        return any(
+            re.fullmatch(".*".join(re.escape(part)
+                                   for part in p.split("*")), action)
+            for p in patterns)
+
+    def task_infos(self, actions: str | None = None,
+                   detailed: bool = False) -> dict[str, dict]:
+        patterns = [p for p in str(actions).split(",") if p] \
+            if actions else None
+        with self._lock:
+            tasks = list(self._tasks.values())
+        return {t.id: t.info(detailed)
+                for t in tasks if self._action_match(t.action, patterns)}
+
+    def list_tasks(self, actions: str | None = None,
+                   detailed: bool = False) -> dict:
+        return {"nodes": {self.node_id: {
+            "name": self.node_id,
+            "transport_address": "local[1]",
+            "tasks": self.task_infos(actions, detailed)}}}
+
+    def get(self, task_id: str) -> Task | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def recent_infos(self, actions: str | None = None) -> list[dict]:
+        patterns = [p for p in str(actions).split(",") if p] \
+            if actions else None
+        with self._lock:
+            recent = list(self._recent)
+        return [i for i in recent
+                if self._action_match(i["action"], patterns)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"running": len(self._tasks),
+                    "total_started": self.total_started}

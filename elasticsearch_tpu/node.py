@@ -123,10 +123,16 @@ class NodeService:
         os.makedirs(data_path, exist_ok=True)
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
-        from .common.metrics import IndexingSlowLog, PhaseTimers, SlowLog
+        from .common.metrics import (IndexingSlowLog, MetricsRegistry,
+                                     PhaseTimers, SlowLog)
         self.phase_timers = PhaseTimers()
+        self.metrics = MetricsRegistry()
         self.slowlog = SlowLog()
         self.indexing_slowlog = IndexingSlowLog()
+        # task registry: every coordinator + shard-level action in flight
+        # (ref tasks/TaskManager; GET /_tasks)
+        from .common.tasks import TaskManager
+        self.tasks = TaskManager("tpu-node-0")
         # named bounded executors (ref ThreadPool.java:116); the HTTP layer
         # routes each request class through its pool, overflow -> 429
         from .common.threadpool import ThreadPool
@@ -525,10 +531,56 @@ class NodeService:
 
     # -- search (the QUERY_THEN_FETCH driver, SURVEY §3.2) -----------------
 
+    def _trace_ids(self) -> tuple[str | None, str | None]:
+        """(trace_id, opaque_id) of the current request, from the active
+        task (REST path) or profiler (direct calls) — stamps slowlog
+        entries so one id correlates slowlog + tasks + profile."""
+        from .common.metrics import current_profiler
+        from .common.tasks import current_task
+        t = current_task()
+        if t is not None:
+            return t.trace_id, t.opaque_id
+        p = current_profiler()
+        if p is not None:
+            return p.trace_id, None
+        return None, None
+
+    def _record_phase(self, phase: str, ms: float) -> None:
+        self.phase_timers.record(phase, ms)
+        self.metrics.record(f"search.{phase}", ms)
+
     def search(self, index: str, body: dict | None = None,
                size: int | None = None, from_: int | None = None,
                scroll: str | None = None, scan: bool = False,
                request_cache: bool | None = None) -> dict:
+        """Entry point: installs a RequestProfiler when the body carries
+        `"profile": true` (ref search/profile — the per-request timing
+        tree), then runs the QUERY_THEN_FETCH driver."""
+        body = body or {}
+        if not body.get("profile") or scroll is not None:
+            return self._search_exec(index, body, size=size, from_=from_,
+                                     scroll=scroll, scan=scan,
+                                     request_cache=request_cache)
+        from .common.metrics import (RequestProfiler, current_profiler,
+                                     use_profiler)
+        from .common.tasks import current_task
+        task = current_task()
+        if current_profiler() is not None:   # nested (warmer/percolate)
+            return self._search_exec(index, body, size=size, from_=from_,
+                                     request_cache=request_cache)
+        prof = RequestProfiler(
+            trace_id=task.trace_id if task is not None else None)
+        with use_profiler(prof):
+            resp = self._search_exec(index, body, size=size, from_=from_,
+                                     request_cache=False)
+        resp["profile"] = prof.render(
+            opaque_id=task.opaque_id if task is not None else None)
+        return resp
+
+    def _search_exec(self, index: str, body: dict | None = None,
+                     size: int | None = None, from_: int | None = None,
+                     scroll: str | None = None, scan: bool = False,
+                     request_cache: bool | None = None) -> dict:
         t0 = time.perf_counter()
         body = body or {}
         if "template" in body and "query" not in body:
@@ -618,10 +670,11 @@ class NodeService:
                         # request's wall time includes queue wait and
                         # shared-batch work, not this request's device time
                         took = (time.perf_counter() - t0) * 1000
-                        self.phase_timers.record("total", took)
+                        self._record_phase("total", took)
+                        tid, oid = self._trace_ids()
                         self.slowlog.maybe_log(
                             self.indices[names[0]].settings, names[0],
-                            took, body)
+                            took, body, trace_id=tid, opaque_id=oid)
                         return out
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
@@ -705,31 +758,44 @@ class NodeService:
                 all_segs, terms_by_field)
 
         t_parse_done = time.perf_counter()
-        self.phase_timers.record("parse", (t_parse_done - t0) * 1000)
+        self._record_phase("parse", (t_parse_done - t0) * 1000)
+        from .common.metrics import current_profiler
+        prof = current_profiler()
+        if prof is not None:
+            prof.record_phase("parse", (t_parse_done - t0) * 1000)
         results = []
         shard_failures = 0
         for i, s in enumerate(searchers):
-            if knn is not None:
-                fnode = s.parse([knn["filter"]]) if knn.get("filter") else None
-                r = s.execute_knn(knn["field"], [qv_single], k=knn_k,
-                                  metric=knn.get("metric", "cosine"),
-                                  filter_node=fnode)
-            else:
-                r = s.execute_query_phase(
-                    nodes_by_index[index_of[i]], size=max(size, window),
-                    from_=from_, sort=sort,
-                    global_stats=global_stats,
-                    aggs=agg_specs if agg_specs else None,
-                    search_after=search_after,
-                    track_scores=bool(body.get("track_scores", False))
-                    if sort is not None else True)
-            if rescore_spec is not None:
-                r = s.rescore(r, rescore_spec)
+            # shard-level action registered under the coordinator task
+            # (ref TransportSearchTypeAction per-shard phase actions)
+            with self.tasks.scope(
+                    "indices:data/read/search[phase/query]",
+                    description=f"shard [{index_of[i]}][{s.shard_id}]"), \
+                 _maybe_shard_profile(prof, index_of[i], s.shard_id):
+                if knn is not None:
+                    fnode = s.parse([knn["filter"]]) \
+                        if knn.get("filter") else None
+                    r = s.execute_knn(knn["field"], [qv_single], k=knn_k,
+                                      metric=knn.get("metric", "cosine"),
+                                      filter_node=fnode)
+                else:
+                    r = s.execute_query_phase(
+                        nodes_by_index[index_of[i]], size=max(size, window),
+                        from_=from_, sort=sort,
+                        global_stats=global_stats,
+                        aggs=agg_specs if agg_specs else None,
+                        search_after=search_after,
+                        track_scores=bool(body.get("track_scores", False))
+                        if sort is not None else True)
+                if rescore_spec is not None:
+                    r = s.rescore(r, rescore_spec)
             results.append(r)
 
         t_device_done = time.perf_counter()
-        self.phase_timers.record("device",
-                                 (t_device_done - t_parse_done) * 1000)
+        self._record_phase("device",
+                           (t_device_done - t_parse_done) * 1000)
+        if prof is not None:
+            prof.record_phase("query", (t_device_done - t_parse_done) * 1000)
         reduced = controller.sort_docs(results, from_=from_, size=size,
                                        sort=sort)
         src_filter = body.get("_source")
@@ -748,6 +814,7 @@ class NodeService:
         if body.get("highlight") and knn is None:
             from .search.highlight import highlight_hit, parse_highlight
             hl_spec = parse_highlight(body["highlight"])
+        t_hl0 = time.perf_counter()
         if hl_spec is not None:
             from .search.shard_searcher import LOCAL_MASK, SEG_SHIFT
             for slot, h in enumerate(hits):
@@ -766,6 +833,9 @@ class NodeService:
                 hl = highlight_hit(hl_spec, raw_src, terms_by_field, an_for)
                 if hl:
                     h["highlight"] = hl
+        if hl_spec is not None and prof is not None:
+            prof.record_phase("highlight",
+                              (time.perf_counter() - t_hl0) * 1000)
 
         if body.get("script_fields"):
             # per-hit computed fields (ref search/fetch/script/
@@ -796,17 +866,31 @@ class NodeService:
                      "hits": hits},
         }
         if agg_specs:
+            t_agg0 = time.perf_counter()
             merged = merge_shard_partials(
                 agg_specs, [r.aggs for r in results if r.aggs])
             resp["aggregations"] = render_aggs(agg_specs, merged)
+            if prof is not None:
+                prof.record_phase("aggregations",
+                                  (time.perf_counter() - t_agg0) * 1000)
         if body.get("suggest"):
             resp["suggest"] = self.suggest(index, body["suggest"])
         now = time.perf_counter()
-        self.phase_timers.record("fetch", (now - t_device_done) * 1000)
-        self.phase_timers.record("total", (now - t0) * 1000)
+        self._record_phase("fetch", (now - t_device_done) * 1000)
+        self._record_phase("total", (now - t0) * 1000)
+        if prof is not None:
+            # response-assembly remainder: everything after the device
+            # phase that isn't already booked (reduce/fetch/highlight/aggs)
+            post = sum(v for k, v in prof.phases.items()
+                       if k not in ("parse", "query"))
+            prof.record_phase("serialize", max(
+                (now - t_device_done) * 1000 - post, 0.0))
+        resp["took"] = int((now - t0) * 1000)
+        tid, oid = self._trace_ids()
         for n in names:     # every searched index's thresholds apply
             self.slowlog.maybe_log(self.indices[n].settings, n,
-                                   (now - t0) * 1000, body)
+                                   (now - t0) * 1000, body,
+                                   trace_id=tid, opaque_id=oid)
         if cache_key is not None:
             if len(self._request_cache) >= 256:   # bounded FIFO eviction
                 try:        # threaded server: a racing evictor is fine
@@ -2067,6 +2151,14 @@ class NodeService:
 
 
 # ---------------------------------------------------------------------------
+
+def _maybe_shard_profile(prof, index: str, shard_id: int):
+    """prof.shard(...) when profiling, else a no-op context."""
+    import contextlib
+    if prof is None:
+        return contextlib.nullcontext()
+    return prof.shard(index, shard_id)
+
 
 def _is_mlt_entry(k, v) -> bool:
     """True only for MLT QUERY nodes — a field literally named 'mlt' in a

@@ -43,6 +43,19 @@ K1_DEFAULT = 1.2
 B_DEFAULT = 0.75
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: top-level `check_vma` (new) vs
+    experimental `check_rep` (0.4.x) — replica-consistency checks off
+    either way (the query batch is INTENTIONALLY different per replica)."""
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False)
+
+
 def _query_step(doc_ids, tf, dl, sum_dl, doc_counts,
                 term_starts, term_lens, boosts, *, Wt: int, n_pad: int,
                 k: int, k1: float, b: float):
@@ -131,10 +144,10 @@ class DistributedSearcher:
         query_specs = P(SHARD_AXIS, REPLICA_AXIS)
         out_specs = (P(REPLICA_AXIS), P(REPLICA_AXIS),
                      P(REPLICA_AXIS), P(REPLICA_AXIS))
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             fn, mesh=self.mesh,
             in_specs=(shard_specs,) * 5 + (query_specs,) * 3,
-            out_specs=out_specs, check_vma=False)
+            out_specs=out_specs)
         step = jax.jit(mapped)
         self._steps[key] = step
         return step
@@ -167,16 +180,17 @@ class DistributedSearcher:
             out_s, pos = lax.top_k(g_s, min(k, S * kk))
             return out_s, jnp.take_along_axis(g_k, pos, axis=-1)
 
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(_shard_map(
             knn_step, mesh=self.mesh,
             in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS)),
-            out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)), check_vma=False))
+            out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS))))
         self._steps[key] = step
         return step
 
     def search_knn(self, field: str, query_vectors, *, k: int = 10,
                    metric: str = "cosine"):
         """-> (scores f32[Q,k], keys i64[Q,k])."""
+        from ..common.metrics import current_profiler
         vf = self.index.vectors[field]
         n_rep = self.mesh.shape[REPLICA_AXIS]
         qv = np.asarray(query_vectors, np.float32)
@@ -186,6 +200,16 @@ class DistributedSearcher:
             qv = np.concatenate([qv, np.zeros((q_pad - Q, qv.shape[1]),
                                               np.float32)])
         step = self.build_knn_step(k=k, metric=metric)
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_h2d(qv.nbytes)
+            with prof.phase("spmd_query"):
+                scores, keys = step(vf.vecs, self.index.live,
+                                    jnp.asarray(qv))
+                scores, keys = np.asarray(scores), np.asarray(keys)
+            prof.note_dispatch()
+            prof.note_d2h(scores.nbytes + keys.nbytes)
+            return scores[:Q], keys[:Q]
         scores, keys = step(vf.vecs, self.index.live, jnp.asarray(qv))
         return np.asarray(scores)[:Q], np.asarray(keys)[:Q]
 
@@ -210,6 +234,24 @@ class DistributedSearcher:
             b_arr[:Q] = boosts
             bsts = jnp.broadcast_to(jnp.asarray(b_arr)[None], ts.shape)
         step = self.build_step(Wt=Wt, k=k, k1=k1, b=b)
+        from ..common.metrics import current_profiler
+        prof = current_profiler()
+        if prof is not None:
+            # term tables + boosts are this request's host→device upload;
+            # the SPMD program's result fetch is its device→host leg
+            prof.note_h2d(ts.nbytes + tl.nbytes + bsts.nbytes)
+            with prof.phase("spmd_query"):
+                scores, keys, total, mx = step(
+                    fx.doc_ids, fx.tf, fx.dl, fx.sum_dl,
+                    self.index.doc_counts, ts, tl, bsts)
+                scores, keys, total, mx = (np.asarray(scores),
+                                           np.asarray(keys),
+                                           np.asarray(total),
+                                           np.asarray(mx))
+            prof.note_dispatch()
+            prof.note_d2h(scores.nbytes + keys.nbytes
+                          + total.nbytes + mx.nbytes)
+            return scores[:Q], keys[:Q], total[:Q], mx[:Q]
         scores, keys, total, mx = step(
             fx.doc_ids, fx.tf, fx.dl, fx.sum_dl, self.index.doc_counts,
             ts, tl, bsts)

@@ -78,6 +78,18 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
 
+    def list_tasks(g, p, b):
+        # tasks running on THIS coordinator (shard tasks live on the
+        # copy-holders' own managers, parent-linked over the transport)
+        detailed = p.get("detailed", ["false"])[0] not in ("false", None)
+        out = node.tasks.list_tasks(actions=p.get("actions", [None])[0],
+                                    detailed=detailed)
+        if p.get("recent", ["false"])[0] not in ("false", None):
+            out["recent"] = node.tasks.recent_infos(
+                actions=p.get("actions", [None])[0])
+        return 200, out
+    c.register("GET", "/_tasks", list_tasks)
+
     def nodes_info(g, p, b):
         # node INFO shape (addresses/version — what client sniffers read;
         # ref RestNodesInfoAction), distinct from the stats body

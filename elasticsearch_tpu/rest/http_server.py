@@ -94,7 +94,8 @@ class RestController:
                             (literal, -len(segs))))
 
     def dispatch(self, method: str, path: str, params: dict,
-                 body: bytes) -> tuple[int, dict | str]:
+                 body: bytes,
+                 headers: dict | None = None) -> tuple[int, dict | str]:
         from urllib.parse import unquote
         # percent-decode per segment (ref RestUtils.decodeComponent) —
         # unicode index names / ids arrive encoded
@@ -109,7 +110,39 @@ class RestController:
         if best is None:
             raise RestError(400, f"no handler for [{method} {path}]")
         handler, match, _ = best
-        return handler(match.groupdict(), params, body)
+        tasks = getattr(self.node, "tasks", None)
+        if tasks is None:
+            return handler(match.groupdict(), params, body)
+        # every REST request is a registered task carrying the caller's
+        # X-Opaque-Id plus a generated trace id; child scopes (per-shard
+        # phases, transport handlers) inherit both via the task context
+        opaque = (headers or {}).get("x-opaque-id")
+        with tasks.scope(_action_of(method, path),
+                         description=f"{method} {path}",
+                         opaque_id=opaque):
+            return handler(match.groupdict(), params, body)
+
+
+def _action_of(method: str, path: str) -> str:
+    """Reference-style action name for the task registry (each
+    TransportAction declares one; here the route class implies it)."""
+    seg = [s for s in path.split("/") if s]
+    if any(s in ("_search", "_msearch", "_count", "_suggest", "_percolate",
+                 "_mpercolate", "_mlt", "_explain", "_validate")
+           for s in seg):
+        return "indices:data/read/search"
+    if "_bulk" in seg:
+        return "indices:data/write/bulk"
+    if "_mget" in seg:
+        return "indices:data/read/mget"
+    if "_tasks" in seg or ("_cat" in seg and "tasks" in seg):
+        return "cluster:monitor/tasks/lists"
+    if any(s in ("_nodes", "_cluster", "_cat", "_stats") for s in seg):
+        return "cluster:monitor"
+    if len(seg) >= 3 and not any(s.startswith("_") for s in seg[:2]):
+        return "indices:data/read/get" if method in ("GET", "HEAD") \
+            else "indices:data/write/index"
+    return f"rest:{method.lower()}" + ("/" + seg[0] if seg else "/")
 
 
 def _pbool(p: dict, name: str, default: bool) -> bool:
@@ -2101,6 +2134,26 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                       "node.role", "master", "name"])
     c.register("GET", "/_cat/nodes", cat_nodes)
 
+    def cat_tasks(g, p, b):
+        infos = node.tasks.task_infos(
+            actions=p.get("actions", [None])[0], detailed=True)
+        rows = [{"action": i["action"], "task_id": tid,
+                 "parent_task_id": i.get("parent_task_id", "-"),
+                 "type": i["type"], "start_time": i["start_time_in_millis"],
+                 "running_time": f"{i['running_time_in_nanos'] // 1000}micros",
+                 "node": i["node"],
+                 "description": i.get("description", "")}
+                for tid, i in sorted(infos.items())]
+        return 200, _cat.render(p, [
+            ("action", "task action"), ("task_id", "task id"),
+            ("parent_task_id", "parent task id"), ("type", "task type"),
+            ("start_time", "start time in millis"),
+            ("running_time", "running time"), ("node", "node name"),
+            ("description", "task description")], rows,
+            defaults=["action", "task_id", "parent_task_id", "type",
+                      "start_time", "running_time", "node"])
+    c.register("GET", "/_cat/tasks", cat_tasks)
+
     def cat_master(g, p, b):
         return 200, _cat.render(p, [
             ("id", "node id"), ("host", "host name"),
@@ -2451,10 +2504,35 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                            "breakers": node.breakers.stats(),
                            "thread_pool": node.thread_pool.stats(),
                            "search_phases": node.phase_timers.stats(),
+                           "profiling": node.metrics.stats(),
+                           "tasks": node.tasks.stats(),
                            "slowlog_tail": node.slowlog.snapshot(),
                            "search_batcher": node._batcher.stats()}}}
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
+
+    # -- task management (ref tasks/TaskManager + ListTasksAction:
+    #    GET /_tasks, GET /_tasks/{id}, GET /_cat/tasks) -------------------
+    def list_tasks_api(g, p, b):
+        out = node.tasks.list_tasks(
+            actions=p.get("actions", [None])[0],
+            detailed=_pbool(p, "detailed", False))
+        if _pbool(p, "recent", False):
+            # recently-completed ring: short-lived shard tasks stay
+            # assertable after the request finishes (test seam)
+            out["recent"] = node.tasks.recent_infos(
+                actions=p.get("actions", [None])[0])
+        return 200, out
+    c.register("GET", "/_tasks", list_tasks_api)
+
+    def get_task_api(g, p, b):
+        t = node.tasks.get(g["task_id"])
+        if t is None:
+            return 404, {"error": f"ResourceNotFoundException: task "
+                                  f"[{g['task_id']}] isn't running",
+                         "status": 404}
+        return 200, {"completed": False, "task": t.info(detailed=True)}
+    c.register("GET", "/_tasks/{task_id}", get_task_api)
 
     def _duration_ms(v: str, default: float) -> float:
         s = str(v).strip().lower()
@@ -2665,6 +2743,7 @@ class HttpServer:
                              "status": 406}).encode(),
                             "application/json; charset=UTF-8", method)
                         return
+                req_headers = {k.lower(): v for k, v in self.headers.items()}
                 try:
                     # admission control: each request class runs on its
                     # named bounded pool; queue overflow -> 429 before any
@@ -2674,11 +2753,12 @@ class HttpServer:
                     tp = getattr(node, "thread_pool", None)
                     if pool is None or tp is None:
                         status, payload = controller.dispatch(
-                            method, parsed.path, params, body)
+                            method, parsed.path, params, body, req_headers)
                     else:
                         status, payload = tp.submit(
                             pool, controller.dispatch,
-                            method, parsed.path, params, body).result()
+                            method, parsed.path, params, body,
+                            req_headers).result()
                 except Exception as e:  # noqa: BLE001 — REST error contract
                     status = _status_of(e)
                     payload = {"error": f"{type(e).__name__}: {e}",
@@ -2700,14 +2780,18 @@ class HttpServer:
                 else:
                     data = json.dumps(payload).encode("utf-8")
                     ctype = "application/json; charset=UTF-8"
-                self._reply(status, data, ctype, method)
+                self._reply(status, data, ctype, method,
+                            opaque_id=req_headers.get("x-opaque-id"))
 
-            def _reply(self, status, data, ctype, method):
+            def _reply(self, status, data, ctype, method, opaque_id=None):
                 if method == "HEAD":
                     data = b""
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if opaque_id:
+                    # the reference echoes X-Opaque-Id on every response
+                    self.send_header("X-Opaque-Id", opaque_id)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -12,10 +12,12 @@ coordinator between pods).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from ..common.metrics import current_profiler
 from . import sort as sort_mod
 from .shard_searcher import QuerySearchResult, ShardSearcher, FetchedHit
 
@@ -38,6 +40,7 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
     desc / sort-key asc, shard index breaks ties like the reference's
     shard-ordinal tie-break). Field sorts compare MATERIALIZED values
     (strings/numbers), never ordinals — see search/sort.py."""
+    t0 = time.perf_counter()
     sort = sort_mod.normalize(sort)
     entries = []   # (primary_key, shard_idx, pos, doc_key, score, sort_val)
     total = 0
@@ -62,6 +65,9 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
             entries.append((primary, si, pos, key, score, sv))
     entries.sort(key=lambda e: (e[0], e[1], e[2]))
     window = entries[from_: from_ + size]
+    prof = current_profiler()
+    if prof is not None:
+        prof.record_phase("reduce", (time.perf_counter() - t0) * 1000)
     return ReducedDocs(
         shard_order=[e[1] for e in window],
         doc_keys=[e[3] for e in window],
@@ -76,6 +82,7 @@ def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
     """Fetch phase fan-out to winning shards only + final hit assembly
     (ref FetchPhase + SearchPhaseController.merge). `searchers` is aligned
     with the results list passed to sort_docs."""
+    t0 = time.perf_counter()
     # group result slots by shard (the docIdsToLoad structure)
     by_shard: dict[int, list[int]] = {}
     for slot, si in enumerate(reduced.shard_order):
@@ -121,6 +128,9 @@ def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
         if reduced.sort_values is not None:
             entry["sort"] = h.sort_value
         out.append(entry)
+    prof = current_profiler()
+    if prof is not None:
+        prof.record_phase("fetch", (time.perf_counter() - t0) * 1000)
     return out
 
 

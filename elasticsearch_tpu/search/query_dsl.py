@@ -102,9 +102,43 @@ class CollectionStats:
 # AST nodes
 # ---------------------------------------------------------------------------
 
+def _profiled(op: str, fn):
+    """Non-jit-visible wall timer around a DSL node's device execution:
+    times the HOST-side dispatch of the jitted calls (the profiler's
+    per-DSL-node breakdown for `"profile": true`). A no-op — one contextvar
+    read — when no profiler is active, so the hot path stays unchanged."""
+    import functools
+    import time as _time
+
+    from ..common.metrics import current_profiler
+
+    @functools.wraps(fn)
+    def timed(self, ctx, *a, **kw):
+        prof = current_profiler()
+        if prof is None:
+            return fn(self, ctx, *a, **kw)
+        t0 = _time.perf_counter()
+        out = fn(self, ctx, *a, **kw)
+        prof.record_node(type(self).__name__, op,
+                         (_time.perf_counter() - t0) * 1000)
+        return out
+
+    timed.__profiled__ = True
+    return timed
+
+
 @dataclass
 class Node:
     boost: float = 1.0
+
+    def __init_subclass__(cls, **kw):
+        # every concrete node type gets profiler timing on its own
+        # execute/match_mask override — one hook instruments the whole DSL
+        super().__init_subclass__(**kw)
+        for op, meth in (("score", "execute"), ("match", "match_mask")):
+            fn = cls.__dict__.get(meth)
+            if fn is not None and not getattr(fn, "__profiled__", False):
+                setattr(cls, meth, _profiled(op, fn))
 
     def collect_terms(self, out: dict[str, set[str]]) -> None:
         """Gather (field, term) pairs so CollectionStats can be prefetched."""

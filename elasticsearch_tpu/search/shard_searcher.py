@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common.metrics import current_profiler, device_fetch
 from ..index.segment import Segment
 from ..mapping.mapper import MapperService
 from ..ops import topk as topk_ops
@@ -215,7 +216,7 @@ class ShardSearcher:
                 top_d, idx_d = topk_ops.topk_scores(scores, match, k=kk)
                 fetch["top"] = top_d
                 fetch["idx"] = idx_d
-            got = jax.device_get(fetch)
+            got = device_fetch(fetch)
             total += got["total"]
             if track_scores:
                 max_score = np.maximum(max_score, got["mx"])
@@ -251,7 +252,7 @@ class ShardSearcher:
                 order = order[:, :kk].astype(jnp.int32)
                 sel_match_d = jnp.take_along_axis(match, order, axis=1)
                 sel_scores_d = jnp.take_along_axis(scores, order, axis=1)
-                order, sel_match, sel_scores = jax.device_get(
+                order, sel_match, sel_scores = device_fetch(
                     (order, sel_match_d, sel_scores_d))
                 for qi in range(Q):
                     for j in range(kk):
@@ -302,6 +303,9 @@ class ShardSearcher:
         from ..ops import knn as knn_ops
 
         qv = jnp.asarray(np.asarray(query_vectors, np.float32))
+        prof = current_profiler()
+        if prof is not None:     # query vectors are the host→device upload
+            prof.note_h2d(int(qv.size) * 4)
         Q = qv.shape[0]
         best_scores = np.full((Q, k), -np.inf, np.float32)
         best_keys = np.full((Q, k), -1, np.int64)
@@ -325,7 +329,7 @@ class ShardSearcher:
             live_tot = live.sum(axis=1) if live.ndim == 2 \
                 else jnp.broadcast_to(live.sum(), (Q,))
             # ONE fetch per segment (a tunneled chip pays RTT per sync)
-            top, idx, seg_tot = jax.device_get((top, idx, live_tot))
+            top, idx, seg_tot = device_fetch((top, idx, live_tot))
             total += np.asarray(seg_tot)
             seg_keys = np.where(np.isfinite(top),
                                 (np.int64(seg_idx) << SEG_SHIFT)

@@ -25,6 +25,7 @@ specialization.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -190,6 +191,11 @@ def execute_sparse(plan: SparsePlan, segments: list[Segment],
             continue
         Wt = slot_budget(lens)
         doc_mask = _segment_mask(seg, plan, Q, stats)
+        from ..common.metrics import current_profiler
+        prof = current_profiler()
+        if prof is not None:    # query term arrays are the per-request upload
+            prof.note_h2d(starts.nbytes + lens.nbytes + weights_np.nbytes)
+        t0_prof = time.perf_counter() if prof is not None else 0.0
         top, docs, hits = bm25_topk_sparse_masked(
             fx.doc_ids, fx.tf, fx.dl,
             jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights_np),
@@ -198,6 +204,11 @@ def execute_sparse(plan: SparsePlan, segments: list[Segment],
             Wt=Wt, k=k_pad, n_docs=seg.n_pad)
         top = np.asarray(top)[:, :k]
         docs = np.asarray(docs)[:, :k]
+        if prof is not None:
+            prof.note_dispatch()
+            prof.note_d2h(top.nbytes + docs.nbytes + Q * 8)
+            prof.record_node("SparsePlan", "score",
+                             (time.perf_counter() - t0_prof) * 1000)
         finite = top > -np.inf
         top = np.where(finite, top + const, -np.inf)
         seg_keys = np.where(

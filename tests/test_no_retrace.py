@@ -1,0 +1,61 @@
+"""Retrace regression tripwire: two IDENTICAL searches must not recompile.
+
+A jit retrace on the hot path silently multiplies tail latency (the TPU
+failure mode the reference never had — ISSUE 1). The profile device section
+counts process-wide compile events (jax.monitoring) diffed around the
+request, so the second identical search asserting `jit_cache_miss == 0` is
+a standing guard for the serving path's compile-cache keys."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("retrace")))
+    n.create_index("t", settings={"number_of_shards": 2},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    for i in range(40):
+        n.index_doc("t", str(i), {"body": f"quick brown fox {i}", "n": i})
+    n.refresh("t")
+    yield n
+    n.close()
+
+
+def _search(node, body):
+    # fresh dict per call: a cached/mutated body must not mask a retrace
+    return node.search("t", json.loads(json.dumps(body)))
+
+
+def test_sparse_path_no_retrace_on_identical_search(node):
+    body = {"profile": True, "size": 5,
+            "query": {"match": {"body": "quick"}}}
+    _search(node, body)                      # warm: compiles are expected
+    out = _search(node, body)
+    dev = out["profile"]["device"]
+    assert dev["jit_cache_misses"] == 0, \
+        f"hot path retraced: {dev}"
+    assert dev["compile_time_in_millis"] <= 1.0
+
+
+def test_dense_sorted_path_no_retrace_on_identical_search(node):
+    body = {"profile": True, "size": 5,
+            "query": {"match": {"body": "brown"}},
+            "sort": [{"n": {"order": "desc"}}]}
+    _search(node, body)
+    out = _search(node, body)
+    assert out["profile"]["device"]["jit_cache_misses"] == 0
+
+
+def test_second_search_reports_cache_hits(node):
+    body = {"profile": True, "query": {"match": {"body": "fox"}}}
+    _search(node, body)
+    dev = _search(node, body)["profile"]["device"]
+    # dispatches happened and none of them compiled
+    assert dev["jit_cache_hits"] >= 1
+    assert dev["jit_cache_misses"] == 0

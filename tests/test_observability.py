@@ -23,9 +23,13 @@ def http(tmp_path_factory):
         r = urllib.request.Request(base + path, data=data, method=method)
         try:
             resp = urllib.request.urlopen(r)
-            return resp.status, json.loads(resp.read())
+            raw = resp.read()
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read())
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:           # text bodies (hot_threads, _cat)
+            return resp.status, raw.decode()
     yield node, req
     srv.stop()
     node.close()
@@ -80,3 +84,43 @@ def test_slowlog_threshold_is_live(http):
     # visible over REST
     code, stats = req("GET", "/_nodes/stats")
     assert stats["nodes"]["tpu-node-0"]["slowlog_tail"]
+
+
+def test_nodes_stats_monitor_sections_wire_format(http):
+    """The documented os/process/fs keys of /_nodes/stats, asserted over
+    HTTP (test_monitor.py covers the module; this pins the wire shape)."""
+    node, req = http
+    code, stats = req("GET", "/_nodes/stats")
+    assert code == 200
+    n = stats["nodes"]["tpu-node-0"]
+    assert len(n["os"]["load_average"]) == 3
+    assert n["os"]["mem"]["total_in_bytes"] > 0
+    assert "percent" in n["os"]["cpu"]
+    assert n["process"]["mem"]["resident_in_bytes"] > 0
+    assert n["process"]["threads"] >= 1
+    assert n["fs"]["total"]["total_in_bytes"] > 0
+    assert n["fs"]["data"][0]["path"]
+    assert n["jvm"]["mem"]["heap_used_in_bytes"] > 0
+    # the ISSUE-1 additions ride the same body
+    assert "tasks" in n and "running" in n["tasks"]
+    assert isinstance(n["profiling"], dict)
+
+
+def test_hot_threads_over_rest(http):
+    node, req = http
+    code, out = req("GET", "/_nodes/hot_threads")
+    assert code == 200
+    assert "Hot threads at" in out       # text/plain body, not JSON
+
+
+def test_profiling_histograms_in_nodes_stats(http):
+    node, req = http
+    req("POST", "/obs/_search", {"query": {"match": {"body": "fox"}}})
+    code, stats = req("GET", "/_nodes/stats")
+    prof = stats["nodes"]["tpu-node-0"]["profiling"]
+    assert prof["search.total"]["count"] >= 1
+    for key in ("time_in_millis", "min_millis", "max_millis",
+                "p50_millis", "p99_millis"):
+        assert key in prof["search.total"]
+    assert prof["search.total"]["p99_millis"] >= \
+        prof["search.total"]["p50_millis"]

@@ -1,0 +1,163 @@
+"""Search Profile API: `"profile": true` on _search/_msearch returns a
+per-shard timing tree (coordinator phases + per-DSL-node device wall time)
+plus a device section (jit cache hit/miss, compile time, host↔device
+bytes), correlated with the task listing and slowlog via X-Opaque-Id and a
+generated trace id. Ref search/profile (later reference versions); the
+device counters are the TPU twist (ISSUE 1)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    node = NodeService(str(tmp_path_factory.mktemp("prof")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method,
+                                   headers=headers or {})
+        try:
+            resp = urllib.request.urlopen(r)
+            return resp.status, json.loads(resp.read()), resp.headers
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), e.headers
+
+    code, _, _ = req("PUT", "/prof", {
+        "settings": {"number_of_shards": 3},
+        "mappings": {"_doc": {"properties": {
+            "body": {"type": "string"},
+            "n": {"type": "long"}}}}})
+    assert code == 200
+    for i in range(60):
+        req("PUT", f"/prof/_doc/{i}",
+            {"body": f"quick brown fox jumps {i}", "n": i})
+    req("POST", "/prof/_refresh")
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def test_profile_shape_and_phase_sum_within_took(http):
+    node, req = http
+    code, out, _ = req("POST", "/prof/_search", {
+        "profile": True, "query": {"match": {"body": "quick"}}, "size": 5})
+    assert code == 200
+    prof = out["profile"]
+    assert prof["trace_id"]
+    # coordinator phases partition the request: their sum stays within took
+    phases = prof["phases"]
+    assert "parse" in phases and "query" in phases
+    assert sum(phases.values()) <= out["took"] + 2   # int-truncation slack
+    # one entry per shard, each with its own time + per-DSL-node breakdown
+    real_shards = [s for s in prof["shards"] if s["shard_id"] >= 0]
+    assert len(real_shards) == 3
+    for s in real_shards:
+        assert s["index"] == "prof"
+        assert s["time_in_millis"] >= 0
+        assert s["query"]       # at least one node type timed
+        for b in s["query"].values():
+            assert b["score_count"] + b["match_count"] >= 1
+
+
+def test_profile_device_section(http):
+    node, req = http
+    code, out, _ = req("POST", "/prof/_search", {
+        "profile": True, "query": {"match": {"body": "fox"}}})
+    dev = out["profile"]["device"]
+    for key in ("jit_cache_hits", "jit_cache_misses",
+                "compile_time_in_millis", "bytes_device_to_host",
+                "bytes_host_to_device"):
+        assert key in dev
+    assert dev["jit_cache_misses"] >= 0
+    assert dev["bytes_device_to_host"] >= 0
+
+
+def test_took_monotonic_ge_max_shard_time(http):
+    """`took` comes from ONE monotonic clock at the coordinator, so it
+    bounds every per-shard time it contains (never a per-shard sum)."""
+    node, req = http
+    code, out, _ = req("POST", "/prof/_search", {
+        "profile": True, "query": {"match": {"body": "quick"}}})
+    shard_times = [s["time_in_millis"] for s in out["profile"]["shards"]
+                   if s["shard_id"] >= 0]
+    assert shard_times
+    assert out["took"] + 1 >= max(shard_times)   # +1: int truncation
+
+
+def test_dense_path_profiles_dsl_nodes(http):
+    """A sorted search takes the dense tree — the per-DSL-node timers must
+    name the executed node types."""
+    node, req = http
+    code, out, _ = req("POST", "/prof/_search", {
+        "profile": True, "query": {"match": {"body": "quick"}},
+        "sort": [{"n": {"order": "desc"}}]})
+    assert code == 200
+    types = set()
+    for s in out["profile"]["shards"]:
+        types |= set(s["query"])
+    assert "MatchNode" in types
+
+
+def test_opaque_id_correlates_profile_tasks_and_slowlog(http):
+    node, req = http
+    code, _, _ = req("PUT", "/prof/_settings", {
+        "index.search.slowlog.threshold.query.warn": "0ms"})
+    assert code == 200
+    oid = "corr-42"
+    code, out, hdrs = req("POST", "/prof/_search",
+                          {"profile": True,
+                           "query": {"match": {"body": "brown"}}},
+                          headers={"X-Opaque-Id": oid})
+    assert code == 200
+    # 1) profile output carries the caller's id + the generated trace id
+    assert out["profile"]["x_opaque_id"] == oid
+    trace = out["profile"]["trace_id"]
+    assert hdrs.get("X-Opaque-Id") == oid          # response header echo
+    # 2) the threshold-triggered slowlog entry is stamped with both
+    entry = node.slowlog.tail[-1]
+    assert entry["x_opaque_id"] == oid
+    assert entry["trace_id"] == trace
+    # 3) the task listing (recent ring: the search already finished) shows
+    # the coordinator task and its per-shard children under the same id
+    code, tasks, _ = req("GET", "/_tasks?recent=true&detailed=true")
+    mine = [t for t in tasks["recent"]
+            if t["headers"].get("X-Opaque-Id") == oid]
+    coord = [t for t in mine if t["action"] == "indices:data/read/search"]
+    shards = [t for t in mine
+              if t["action"] == "indices:data/read/search[phase/query]"]
+    assert coord and shards
+    assert all(t["headers"]["trace_id"] == trace for t in mine)
+    coord_id = f"{coord[0]['node']}:{coord[0]['id']}"
+    assert all(t["parent_task_id"] == coord_id for t in shards)
+
+
+def test_msearch_honors_profile_flag(http):
+    node, req = http
+    # a profiled body rides the solo lane of msearch (profile is not a
+    # batchable key), so each response carries its own tree
+    out = node.msearch([({"index": "prof"},
+                         {"profile": True,
+                          "query": {"match": {"body": "quick"}}}),
+                        ({"index": "prof"},
+                         {"query": {"match": {"body": "quick"}}})])
+    assert "profile" in out["responses"][0]
+    assert out["responses"][0]["profile"]["shards"]
+    assert "profile" not in out["responses"][1]
+
+
+def test_profile_responses_bypass_request_cache(http):
+    node, req = http
+    body = {"profile": True, "size": 0,
+            "query": {"match": {"body": "jumps"}}}
+    _, first, _ = req("POST", "/prof/_search", body)
+    _, second, _ = req("POST", "/prof/_search", body)
+    # a cached copy would replay the FIRST profile verbatim
+    assert second["profile"]["trace_id"] != first["profile"]["trace_id"]

@@ -1,0 +1,212 @@
+"""Distributed task management: TaskManager registry, GET /_tasks (+
+filters), GET /_tasks/{id}, GET /_cat/tasks, and coordinator→shard parent
+linkage over the cluster transport (ref tasks/TaskManager +
+ListTasksAction; the `_task` wire header plays TaskId-over-the-wire)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.tasks import TaskManager, current_task
+
+
+# ---------------------------------------------------------------------------
+# registry unit behavior
+
+
+def test_register_scope_and_parent_inheritance():
+    tm = TaskManager("n1")
+    assert tm.stats() == {"running": 0, "total_started": 0}
+    with tm.scope("a:parent", description="outer",
+                  opaque_id="oid-1") as parent:
+        assert current_task() is parent
+        assert parent.id == "n1:1"
+        with tm.scope("a:child") as child:
+            # child inherits parent linkage + trace/opaque context
+            assert child.parent_task_id == parent.id
+            assert child.opaque_id == "oid-1"
+            assert child.trace_id == parent.trace_id
+            assert tm.stats()["running"] == 2
+    assert current_task() is None
+    assert tm.stats() == {"running": 0, "total_started": 2}
+    # the recent ring keeps completed infos assertable (child first)
+    recent = tm.recent_infos()
+    assert [i["action"] for i in recent] == ["a:child", "a:parent"]
+    assert recent[0]["parent_task_id"] == "n1:1"
+
+
+def test_action_filter_and_listing_shape():
+    tm = TaskManager("n1")
+    t1 = tm.register("indices:data/read/search", "s")
+    t2 = tm.register("cluster:monitor/health", "h")
+    out = tm.list_tasks()
+    tasks = out["nodes"]["n1"]["tasks"]
+    assert set(tasks) == {t1.id, t2.id}
+    assert "description" not in tasks[t1.id]          # not detailed
+    det = tm.list_tasks(detailed=True)["nodes"]["n1"]["tasks"]
+    assert det[t1.id]["description"] == "s"
+    only = tm.list_tasks(actions="indices:data/read/*")
+    assert set(only["nodes"]["n1"]["tasks"]) == {t1.id}
+    tm.unregister(t1)
+    tm.unregister(t2)
+
+
+# ---------------------------------------------------------------------------
+# REST surface
+
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+    node = NodeService(str(tmp_path_factory.mktemp("tasks")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method,
+                                   headers=headers or {})
+        try:
+            resp = urllib.request.urlopen(r)
+            raw = resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def test_rest_lists_in_flight_tasks_with_parent_child(http):
+    node, req = http
+    started = threading.Event()
+    release = threading.Event()
+
+    def long_search_task():
+        # an in-flight coordinator action holding a shard-level child open
+        with node.tasks.scope("indices:data/read/search",
+                              description="indices[slowidx]",
+                              opaque_id="flight-1"):
+            with node.tasks.scope(
+                    "indices:data/read/search[phase/query]",
+                    description="shard [slowidx][0]"):
+                started.set()
+                release.wait(timeout=10)
+
+    t = threading.Thread(target=long_search_task, daemon=True)
+    t.start()
+    assert started.wait(timeout=10)
+    try:
+        code, out = req("GET", "/_tasks?detailed=true")
+        assert code == 200
+        tasks = out["nodes"]["tpu-node-0"]["tasks"]
+        coord = {tid: i for tid, i in tasks.items()
+                 if i["action"] == "indices:data/read/search"}
+        child = {tid: i for tid, i in tasks.items()
+                 if i["action"].endswith("[phase/query]")}
+        assert coord and child
+        (coord_id, coord_info), = coord.items()
+        assert coord_info["description"] == "indices[slowidx]"
+        assert coord_info["headers"]["X-Opaque-Id"] == "flight-1"
+        assert list(child.values())[0]["parent_task_id"] == coord_id
+        assert list(child.values())[0]["running_time_in_nanos"] > 0
+
+        # ?actions= narrows the listing
+        code, only = req("GET", "/_tasks?actions=*[phase/query]")
+        assert set(only["nodes"]["tpu-node-0"]["tasks"]) == set(child)
+
+        # GET /_tasks/{id} resolves one running task
+        code, one = req("GET", f"/_tasks/{coord_id}")
+        assert code == 200 and one["completed"] is False
+        assert one["task"]["action"] == "indices:data/read/search"
+
+        # _cat/tasks renders the table with the parent column
+        code, cat = req("GET", "/_cat/tasks?v=true")
+        assert "indices:data/read/search" in cat
+        assert coord_id in cat
+    finally:
+        release.set()
+        t.join(timeout=10)
+    code, missing = req("GET", f"/_tasks/{coord_id}")
+    assert code == 404
+
+
+def test_every_rest_request_registers_a_task(http):
+    node, req = http
+    before = node.tasks.stats()["total_started"]
+    code, out = req("GET", "/_tasks")
+    assert code == 200
+    # the listing request itself is a registered (and listed) task
+    listed = out["nodes"]["tpu-node-0"]["tasks"]
+    assert any(i["action"] == "cluster:monitor/tasks/lists"
+               for i in listed.values())
+    assert node.tasks.stats()["total_started"] > before
+
+
+def test_search_registers_shard_children_with_trace(http):
+    node, req = http
+    req("PUT", "/tidx", {"settings": {"number_of_shards": 2},
+                         "mappings": {"_doc": {"properties": {
+                             "body": {"type": "string"}}}}})
+    req("PUT", "/tidx/_doc/1", {"body": "hello world"})
+    req("POST", "/tidx/_refresh")
+    # track_scores forces the general (per-shard) path — the packed lane
+    # serves whole batches and has no per-shard phase to register
+    req("POST", "/tidx/_search", {"query": {"match": {"body": "hello"}},
+                                  "track_scores": True},
+        headers={"X-Opaque-Id": "rest-oid"})
+    code, out = req("GET", "/_tasks?recent=true&detailed=true")
+    mine = [i for i in out["recent"]
+            if i["headers"].get("X-Opaque-Id") == "rest-oid"]
+    coord = [i for i in mine if i["action"] == "indices:data/read/search"]
+    shards = [i for i in mine if i["action"].endswith("[phase/query]")]
+    assert coord and len(shards) == 2
+    coord_id = f"{coord[0]['node']}:{coord[0]['id']}"
+    assert {s["parent_task_id"] for s in shards} == {coord_id}
+    assert {s["headers"]["trace_id"] for s in shards} \
+        == {coord[0]["headers"]["trace_id"]}
+
+
+# ---------------------------------------------------------------------------
+# cluster transport: shard tasks on copy-holders parent to the coordinator
+
+
+def test_cluster_shard_tasks_parent_to_coordinator(tmp_path):
+    from elasticsearch_tpu.cluster import TestCluster
+    c = TestCluster(3, str(tmp_path))
+    try:
+        client = c.client()
+        client.create_index("docs", {"number_of_shards": 3,
+                                     "number_of_replicas": 0})
+        c.ensure_green()
+        for i in range(12):
+            client.index_doc("docs", str(i), {"body": f"common term{i % 3}"})
+        client.refresh("docs")
+        out = client.search("docs", {"query": {"match": {"body": "common"}}})
+        assert out["hits"]["total"] == 12
+
+        coord = [i for i in client.tasks.recent_infos()
+                 if i["action"] == "indices:data/read/search"][-1]
+        coord_id = f"{coord['node']}:{coord['id']}"
+        trace = coord["headers"]["trace_id"]
+        # every node that served a shard phase recorded the COORDINATOR as
+        # parent and carries the same trace id — the linkage crossed the
+        # JSON wire, not shared memory
+        shard_infos = [i for n in c.nodes.values()
+                       for i in n.tasks.recent_infos()
+                       if i["action"].startswith(
+                           "indices:data/read/search[phase/")]
+        mine = [i for i in shard_infos
+                if i.get("parent_task_id") == coord_id]
+        assert len(mine) >= 3        # 3 query phases (+ fetch phases)
+        assert all(i["headers"]["trace_id"] == trace for i in mine)
+        remote = [i for i in mine if i["node"] != coord["node"]]
+        assert remote                # at least one shard was truly remote
+    finally:
+        c.close()

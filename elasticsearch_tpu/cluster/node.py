@@ -71,6 +71,7 @@ A_RECOVERY = "internal:index/shard/recovery/start"
 A_RECOVERY_CHUNK = "internal:index/shard/recovery/chunk"
 A_FS_STATS = "internal:monitor/fs"
 A_NODE_STATS = "cluster:monitor/nodes/stats"
+A_NODE_METRICS = "cluster:monitor/nodes/metrics"
 A_SHARD_STATS = "indices:monitor/stats[shard]"
 
 
@@ -143,6 +144,7 @@ class ClusterNode:
                 (A_RECOVERY_CHUNK, self._on_recovery_chunk),
                 (A_FS_STATS, self._on_fs_stats),
                 (A_NODE_STATS, self._on_node_stats),
+                (A_NODE_METRICS, self._on_node_metrics),
                 (A_SHARD_STATS, self._on_shard_stats)]:
             self.transport.register_handler(action, handler)
         # ClusterInfoService + disk watermark decider (cluster/info.py;
@@ -299,6 +301,57 @@ class ClusterNode:
                 "process": monitor.process_stats(),
                 "jvm": monitor.runtime_stats(),
                 "fs": monitor.fs_stats([self.data_path])}
+
+    def metric_sections(self) -> dict:
+        """This node's scrapeable registries as OpenMetrics walk input
+        (common/metrics.openmetrics_families) — the cluster analog of
+        NodeService.metric_sections(), restricted to what a ClusterNode
+        actually runs (shard engines, tasks, host monitor)."""
+        from ..common import monitor
+        docs = 0
+        shards = 0
+        with self._shards_lock:
+            holders = list(self._shards.values())
+        for holder in holders:
+            if holder.engine is not None:
+                docs += holder.engine.doc_count()
+                shards += 1
+        proc = monitor.process_stats()
+        os_st = monitor.os_stats()
+        load = os_st.get("load_average") or [0.0]
+        return {
+            "node": (None, {"docs": docs, "shards": shards}),
+            "tasks": (None, self.tasks.stats()),
+            "process": (None, {
+                "resident_bytes": proc.get("mem", {})
+                .get("resident_in_bytes", 0),
+                "threads": proc.get("threads", 0)}),
+            "os": (None, {"load_1m": load[0],
+                          "cpu_percent": os_st["cpu"]["percent"]}),
+        }
+
+    def _on_node_metrics(self, from_id: str, req: Any) -> dict:
+        return {"sections": self.metric_sections()}
+
+    def nodes_metric_sections(self) -> dict:
+        """Fan out the metrics action to every live node; live nodes whose
+        handler errors surface as failure entries (the nodes template,
+        same contract as nodes_stats)."""
+        state = self.cluster.current()
+        out: dict = {}
+        failures: list = []
+        for node_id in sorted(state.nodes):
+            try:
+                if node_id == self.node_id:
+                    out[node_id] = self.metric_sections()
+                else:
+                    out[node_id] = self.transport.send(
+                        node_id, A_NODE_METRICS, {})["sections"]
+            except ConnectTransportException:
+                continue              # dead node: absent from the map
+            except RemoteTransportException as e:
+                failures.append({"node": node_id, "reason": str(e)})
+        return {"sections_by_node": out, "failures": failures}
 
     def nodes_stats(self) -> dict:
         """Coordinator-side fan-out to every live node (the nodes

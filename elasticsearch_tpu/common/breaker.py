@@ -39,7 +39,8 @@ class CircuitBreaker:
         self.limit = limit
         self.parent = parent
         self.used = 0
-        self.tripped = 0
+        self.max_used = 0      # high-water mark: device-memory headroom is
+        self.tripped = 0       # judged against the PEAK, not the instant
 
     def add_estimate(self, n_bytes: int, check: bool = True) -> None:
         """Account n_bytes; raise (charging nothing) when over this child's
@@ -53,6 +54,7 @@ class CircuitBreaker:
             if check:
                 self.parent._check_parent(self, n_bytes)
             self.used += n_bytes
+            self.max_used = max(self.max_used, self.used)
 
     def release(self, n_bytes: int) -> None:
         with self.parent._lock:
@@ -61,6 +63,7 @@ class CircuitBreaker:
     def stats(self) -> dict:
         return {"limit_size_in_bytes": self.limit,
                 "estimated_size_in_bytes": self.used,
+                "max_estimated_size_in_bytes": self.max_used,
                 "tripped": self.tripped}
 
 

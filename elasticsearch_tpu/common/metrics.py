@@ -28,6 +28,8 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import logging
+import math
+import re as _re
 import threading
 import time
 import uuid
@@ -111,13 +113,89 @@ class MetricsRegistry:
         return out
 
 
+class Meter:
+    """Exponentially-weighted moving-average rate meter (the codahale
+    Meter the reference exposes through its stats APIs): 1m/5m/15m rates
+    ticked on a fixed 5s interval, plus a lifetime mean. The clock is
+    injectable so tests drive exact tick sequences with no sleeping —
+    rates are then a pure function of (marks, tick times)."""
+
+    TICK_S = 5.0
+    WINDOWS = (60, 300, 900)
+
+    def __init__(self, clock=None):
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self.count = 0
+        self._uncounted = 0
+        self._start = self._last_tick = self._clock()
+        # EWMA per window; None until the first tick initializes it to the
+        # first interval's instant rate (the codahale bootstrap)
+        self._ewma: dict[int, float | None] = {w: None for w in self.WINDOWS}
+
+    def _tick(self, now: float) -> None:
+        # caller holds the lock
+        intervals = int((now - self._last_tick) / self.TICK_S)
+        if intervals <= 0:
+            return
+        instant = self._uncounted / self.TICK_S
+        self._uncounted = 0
+        self._last_tick += intervals * self.TICK_S
+        for w in self.WINDOWS:
+            alpha = 1.0 - math.exp(-self.TICK_S / w)
+            r = self._ewma[w]
+            if r is None:
+                r = instant
+                intervals_left = intervals - 1
+            else:
+                r += alpha * (instant - r)
+                intervals_left = intervals - 1
+            # idle intervals after the first decay toward zero
+            for _ in range(intervals_left):
+                r += alpha * (0.0 - r)
+            self._ewma[w] = r
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self._tick(self._clock())
+            self.count += n
+            self._uncounted += n
+
+    def rate(self, window: int = 60) -> float:
+        """Events/second over the EWMA window (0.0 before the first tick)."""
+        with self._lock:
+            self._tick(self._clock())
+            r = self._ewma[window]
+            return r if r is not None else 0.0
+
+    def mean_rate(self) -> float:
+        with self._lock:
+            elapsed = self._clock() - self._start
+            return self.count / elapsed if elapsed > 0 else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._tick(self._clock())
+            out = {"count": self.count,
+                   "mean_rate": round(self.mean_rate_locked(), 4)}
+            for w, label in zip(self.WINDOWS, ("1m", "5m", "15m")):
+                r = self._ewma[w]
+                out[f"rate_{label}"] = round(r, 4) if r is not None else 0.0
+            return out
+
+    def mean_rate_locked(self) -> float:
+        elapsed = self._clock() - self._start
+        return self.count / elapsed if elapsed > 0 else 0.0
+
+
 # ---------------------------------------------------------------------------
 # Device-level counters: jit compiles (retraces) via jax.monitoring, bytes
 # crossing the host↔device boundary via the device_fetch/note_h2d seams.
 # Process-wide accumulators; RequestProfiler diffs them around a request.
 # ---------------------------------------------------------------------------
 
-_DEVICE_EVENTS = {"compiles": 0, "compile_ms": 0.0}
+_DEVICE_EVENTS = {"compiles": 0, "compile_ms": 0.0,
+                  "h2d_bytes": 0, "d2h_bytes": 0, "fetches": 0}
 _DEVICE_LOCK = threading.Lock()
 _LISTENER_INSTALLED = False
 
@@ -152,6 +230,29 @@ def device_events_snapshot() -> tuple[int, float]:
         return _DEVICE_EVENTS["compiles"], _DEVICE_EVENTS["compile_ms"]
 
 
+def transfer_snapshot() -> dict:
+    """Process-wide host↔device transfer counters (every device_fetch /
+    note_h2d call accounts here, profiler active or not) — the scrape's
+    `es_transfer_*` series."""
+    with _DEVICE_LOCK:
+        return {"bytes_to_device_total": _DEVICE_EVENTS["h2d_bytes"],
+                "bytes_from_device_total": _DEVICE_EVENTS["d2h_bytes"],
+                "device_fetches_total": _DEVICE_EVENTS["fetches"]}
+
+
+def note_h2d(nbytes: int) -> None:
+    """Account host→device bytes: always process-wide, and into the active
+    RequestProfiler when one is installed. Hot paths call this at their
+    upload points so the scrape sees every transfer, not just profiled
+    requests."""
+    n = int(nbytes)
+    with _DEVICE_LOCK:
+        _DEVICE_EVENTS["h2d_bytes"] += n
+    prof = _PROFILER.get()
+    if prof is not None:
+        prof.note_h2d(n)
+
+
 def _nbytes(x) -> int:
     if isinstance(x, dict):
         return sum(_nbytes(v) for v in x.values())
@@ -167,10 +268,14 @@ def device_fetch(x):
     so `"profile": true` sees every transfer without touching the kernels."""
     import jax
     out = jax.device_get(x)
+    nb = _nbytes(out)
+    with _DEVICE_LOCK:
+        _DEVICE_EVENTS["d2h_bytes"] += nb
+        _DEVICE_EVENTS["fetches"] += 1
     prof = _PROFILER.get()
     if prof is not None:
         prof.note_dispatch()
-        prof.note_d2h(_nbytes(out))
+        prof.note_d2h(nb)
     return out
 
 
@@ -371,3 +476,137 @@ class IndexingSlowLog(SlowLog):
     KIND = "indexing.slowlog.threshold.index"
     PAYLOAD_FIELD = "id"
     LOGGER_NAME = "elasticsearch_tpu.index.indexing.slowlog.index"
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition (`GET /_metrics`): every stats registry renders as
+# one scrapeable text document. The walk is generic over *sections* — a
+# section is either a flat dict of leaves or a {entry: leaves} registry
+# labeled by pool/breaker/timer/index/... — so a NEW registry joins the
+# scrape by adding one entry to NodeService.metric_sections(), and the
+# strict-parser test fails if a stats source forgets to.
+# ---------------------------------------------------------------------------
+
+# leaf keys that are MONOTONE counters in the existing stats dicts (the
+# scrape renames them to the OpenMetrics `_total` convention); any curated
+# leaf already ending in `_total` is a counter by construction
+_COUNTER_LEAVES = frozenset({
+    "count", "completed", "rejected", "tripped", "time_in_millis",
+    "batches", "batched_requests", "compiles", "total_started",
+    "index_total", "delete_total", "query_total", "collection_count",
+    "collected",
+})
+
+_NAME_SANITIZE = _re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_leaf(key: str) -> tuple[str, str]:
+    """(leaf name, type): byte/milli renames + counter `_total` suffixing."""
+    leaf = key
+    if leaf.endswith("_in_bytes"):
+        leaf = leaf[: -len("_in_bytes")] + "_bytes"
+    if leaf.endswith("_in_millis"):
+        leaf = leaf[: -len("_in_millis")] + "_millis"
+    if leaf == "total_started":
+        leaf = "started"          # -> *_started_total, not *_total_started_*
+    if key in _COUNTER_LEAVES or key.endswith("_total") \
+            or key.endswith("time_in_millis"):
+        if not leaf.endswith("_total"):
+            leaf += "_total"
+        return leaf, "counter"
+    return leaf, "gauge"
+
+
+class _Family:
+    __slots__ = ("name", "mtype", "help", "samples")
+
+    def __init__(self, name: str, mtype: str, help_text: str):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self.samples: list[tuple[dict, float]] = []
+
+
+def _flatten(prefix: str, payload: dict, out: list) -> None:
+    for k, v in payload.items():
+        key = f"{prefix}_{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            _flatten(key, v, out)
+        elif isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        elif v == float("inf") or v != v:
+            continue
+        else:
+            out.append((key, v))
+
+
+def openmetrics_families(sections: dict, node: str,
+                         families: dict | None = None) -> dict:
+    """sections: {section: (label_name | None, payload)}. Labeled payloads
+    are registries ({entry: {leaf: num}}); unlabeled ones flatten directly.
+    Merging several nodes into one `families` dict is the cluster fan-out
+    (`/_cluster/_metrics`) — same family, one sample per node."""
+    fams = families if families is not None else {}
+
+    def emit(section, labels, key, value):
+        leaf, mtype = _metric_leaf(key)
+        name = _NAME_SANITIZE.sub("_", f"es_{section}_{leaf}")
+        fam = fams.get(name)
+        if fam is None:
+            fam = fams[name] = _Family(
+                name, mtype, f"{section} {key} ({mtype})")
+        elif fam.mtype != mtype:
+            raise ValueError(
+                f"metric family [{name}] registered as {fam.mtype} "
+                f"and {mtype}")
+        fam.samples.append((labels, float(value)))
+
+    for section, (label_name, payload) in sections.items():
+        if not isinstance(payload, dict):
+            continue
+        if label_name is None:
+            leaves: list = []
+            _flatten("", payload, leaves)
+            for key, v in leaves:
+                emit(section, {"node": node}, key, v)
+        else:
+            for entry, sub in payload.items():
+                if not isinstance(sub, dict):
+                    continue
+                leaves = []
+                _flatten("", sub, leaves)
+                for key, v in leaves:
+                    emit(section, {"node": node, label_name: str(entry)},
+                         key, v)
+    return fams
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render_families(families: dict, comments: list[str] | None = None) -> str:
+    out: list[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        out.append(f"# HELP {name} {fam.help}\n")
+        out.append(f"# TYPE {name} {fam.mtype}\n")
+        for labels, value in fam.samples:
+            lbl = ",".join(f'{k}="{_escape_label(str(v))}"'
+                           for k, v in sorted(labels.items()))
+            out.append(f"{name}{{{lbl}}} {_fmt_value(value)}\n")
+    for c in comments or ():
+        out.append(f"# {c}\n")
+    out.append("# EOF\n")
+    return "".join(out)
+
+
+def render_openmetrics(sections: dict, node: str = "tpu-node-0") -> str:
+    """One node's full exposition: `GET /_metrics`."""
+    return render_families(openmetrics_families(sections, node))

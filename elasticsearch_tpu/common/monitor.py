@@ -159,6 +159,95 @@ def _rss() -> int:
         return 0
 
 
+class StatsSampler:
+    """Periodic node-gauge history: a bounded ring of flat snapshots (the
+    reference's monitor services sample os/process/fs on a cadence —
+    OsService/ProcessService refresh intervals; this keeps the SAMPLES, so
+    a spike between two manual stats calls is still inspectable post-hoc
+    via `GET /_nodes/stats/history` without an external TSDB).
+
+    `snapshot_fn() -> {gauge: number}` decouples the ring from what is
+    sampled; tests drive `sample()` directly (no wall-clock sleeps) and
+    inject `clock` for deterministic timestamps."""
+
+    def __init__(self, snapshot_fn, interval_s: float = 10.0,
+                 maxlen: int = 360, clock=None):
+        self._snapshot_fn = snapshot_fn
+        self.interval_s = float(interval_s)
+        self._clock = clock or time.time
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self) -> dict:
+        """Take ONE snapshot and append it to the ring (the background loop
+        calls this on the cadence; tests call it directly)."""
+        try:
+            metrics = self._snapshot_fn()
+        except Exception:  # noqa: BLE001 — sampling must never break serving
+            metrics = {}
+        entry = {"timestamp": int(self._clock() * 1000),
+                 "metrics": {k: v for k, v in metrics.items()
+                             if isinstance(v, (int, float))
+                             and not isinstance(v, bool) and v == v}}
+        with self._lock:
+            self._ring.append(entry)
+        return entry
+
+    def start(self) -> None:
+        if self._thread is not None or self.interval_s <= 0:
+            return
+
+        def loop():
+            self.sample()          # boot sample: history is never empty
+            while not self._stop.wait(self.interval_s):
+                self.sample()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="es[stats_sampler]")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- the `GET /_nodes/stats/history` body -------------------------------
+
+    def history(self, metrics: list[str] | None = None) -> dict:
+        """Samples plus per-gauge min/max/avg/last rollups; `metrics` is an
+        optional list of gauge-name patterns (`*` wildcards, the stats
+        ?metric= convention)."""
+        import fnmatch
+        with self._lock:
+            samples = [dict(s, metrics=dict(s["metrics"]))
+                       for s in self._ring]
+        if metrics:
+            for s in samples:
+                s["metrics"] = {
+                    k: v for k, v in s["metrics"].items()
+                    if any(fnmatch.fnmatch(k, pat) for pat in metrics)}
+        rollups: dict[str, dict] = {}
+        for s in samples:
+            for k, v in s["metrics"].items():
+                r = rollups.get(k)
+                if r is None:
+                    rollups[k] = {"min": v, "max": v, "sum": v,
+                                  "count": 1, "last": v}
+                else:
+                    r["min"] = min(r["min"], v)
+                    r["max"] = max(r["max"], v)
+                    r["sum"] += v
+                    r["count"] += 1
+                    r["last"] = v
+        for r in rollups.values():
+            r["avg"] = round(r.pop("sum") / r["count"], 4)
+        return {"interval_in_seconds": self.interval_s,
+                "sample_count": len(samples),
+                "samples": samples,
+                "rollups": rollups}
+
+
 def hot_threads(threads: int = 3, snapshots: int = 10,
                 interval_ms: float = 50.0) -> str:
     """Sample every thread's stack `snapshots` times; rank stacks by how

@@ -58,6 +58,10 @@ class _Pool:
         self.completed = 0
         self.rejected = 0
         self.largest_queue = 0
+        # windowed throughput (EWMA, common/metrics.Meter): the trajectory
+        # the raw `completed` counter can't show between two stats calls
+        from .metrics import Meter
+        self.meter = Meter()
         self._lock = threading.Lock()
         self._shutdown = False
         # workers spawn LAZILY on demand up to `threads` (the reference's
@@ -84,6 +88,7 @@ class _Pool:
                     self.active -= 1
                     self.completed += 1
                     self._idle += 1
+                self.meter.mark()
 
     def execute(self, fn: Callable, *args) -> None:
         if self._shutdown:
@@ -131,11 +136,13 @@ class _Pool:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"threads": self.size, "queue": self._q.qsize(),
-                    "queue_size": self.queue_size or -1,
-                    "active": self.active, "rejected": self.rejected,
-                    "largest": self.largest_queue,
-                    "completed": self.completed}
+            out = {"threads": self.size, "queue": self._q.qsize(),
+                   "queue_size": self.queue_size or -1,
+                   "active": self.active, "rejected": self.rejected,
+                   "largest": self.largest_queue,
+                   "completed": self.completed}
+        out["completed_rate_1m"] = round(self.meter.rate(60), 4)
+        return out
 
     def shutdown(self) -> None:
         self._shutdown = True

@@ -66,6 +66,13 @@ class IndexService:
         self.search_groups: dict[str, int] = {}
         self.query_total = 0
         self.get_total = 0
+        # windowed op rates (1m/5m/15m EWMA) — `*_rate` in `_stats`,
+        # `_cat/indices` and the /_metrics scrape; every op-count bump
+        # below also marks its meter
+        from ..common.metrics import Meter
+        self.meters: dict[str, Meter] = {"search": Meter(),
+                                         "indexing": Meter(),
+                                         "get": Meter()}
         # shard request cache counters (ref indices/cache/request/
         # IndicesRequestCache — size-0 responses keyed by reader version)
         self.request_cache_hits = 0
@@ -105,6 +112,7 @@ class IndexService:
             doc_id, source, type_name=type_name, routing=routing,
             parent=parent, **kw)
         self.indexing_stats["index_total"] += 1
+        self.meters["indexing"].mark()
         tmap = self.indexing_stats["types"]
         tmap[type_name] = tmap.get(type_name, 0) + 1
         return res
@@ -115,6 +123,7 @@ class IndexService:
         if parent is not None and routing is None:
             routing = parent
         self.get_total += 1
+        self.meters["get"].mark()
         return self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
 
     def delete_doc(self, doc_id: str, routing: str | None = None,
@@ -123,6 +132,7 @@ class IndexService:
             routing = parent
         res = self.shard_for(doc_id, routing).delete(doc_id, **kw)
         self.indexing_stats["delete_total"] += 1
+        self.meters["indexing"].mark()
         return res
 
     def sync_translogs(self) -> None:

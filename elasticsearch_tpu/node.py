@@ -123,12 +123,18 @@ class NodeService:
         os.makedirs(data_path, exist_ok=True)
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
-        from .common.metrics import (IndexingSlowLog, MetricsRegistry,
+        from .common.metrics import (IndexingSlowLog, Meter, MetricsRegistry,
                                      PhaseTimers, SlowLog)
         self.phase_timers = PhaseTimers()
         self.metrics = MetricsRegistry()
         self.slowlog = SlowLog()
         self.indexing_slowlog = IndexingSlowLog()
+        # node-wide windowed op rates (1m/5m/15m EWMA) — `_nodes/stats`
+        # `rates` section + the /_metrics scrape; per-index meters live on
+        # each IndexService
+        self.meters: dict[str, Meter] = {"search": Meter(),
+                                         "indexing": Meter(),
+                                         "get": Meter()}
         # task registry: every coordinator + shard-level action in flight
         # (ref tasks/TaskManager; GET /_tasks)
         from .common.tasks import TaskManager
@@ -189,6 +195,18 @@ class NodeService:
         self._maint_stop = _th.Event()
         _th.Thread(target=self._maintenance_loop, daemon=True,
                    name="es[index_maintenance]").start()
+        # stats-history sampler (common/monitor.StatsSampler): a bounded
+        # ring of node-gauge snapshots on a cadence (ref monitor/ services;
+        # `node.sampler.interval` seconds, <=0 disables the thread — tests
+        # drive sample() manually either way)
+        from .common.monitor import StatsSampler
+        try:
+            interval = float(self.settings.get("node.sampler.interval", 10))
+        except (TypeError, ValueError):
+            interval = 10.0
+        self.sampler = StatsSampler(self._sampler_snapshot,
+                                    interval_s=interval)
+        self.sampler.start()
         self.lifecycle.move_to_started()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -371,14 +389,17 @@ class NodeService:
         svc = self.indices[index]
         t0 = time.perf_counter()
         res = svc.index_doc(doc_id, source, type_name=type_name, **kw)
+        self.meters["indexing"].mark()
         self.indexing_slowlog.maybe_log(
             svc.settings, index, (time.perf_counter() - t0) * 1000, doc_id)
         return index, res
 
     def get_doc(self, index: str, doc_id: str, **kw):
+        self.meters["get"].mark()
         return self.index_service(index).get_doc(doc_id, **kw)
 
     def delete_doc(self, index: str, doc_id: str, **kw):
+        self.meters["indexing"].mark()
         return self.index_service(index).delete_doc(doc_id, **kw)
 
     def update_doc(self, index: str, doc_id: str, body: dict,
@@ -681,8 +702,10 @@ class NodeService:
 
         # SearchStats query_total for the general path (the packed/batcher
         # lanes and _search_batched count their own serves)
+        self.meters["search"].mark()
         for n in names:
             self.indices[n].query_total += 1
+            self.indices[n].meters["search"].mark()
 
         searchers: list[ShardSearcher] = []
         index_of: list[str] = []
@@ -1298,6 +1321,8 @@ class NodeService:
         svc.search_stats["packed"] = \
             svc.search_stats.get("packed", 0) + len(bodies)
         svc.query_total += len(bodies)
+        svc.meters["search"].mark(len(bodies))
+        self.meters["search"].mark(len(bodies))
         return out
 
     _packed_error_logged = 0
@@ -1639,11 +1664,13 @@ class NodeService:
         # count AFTER successful assembly — a raise above degrades the
         # batch to the solo path, which books its own query_total (the
         # packed lane documents the same convention)
+        self.meters["search"].mark(len(metas))
         for n in names:
             svc = self.indices[n]
             svc.query_total += len(metas)
             svc.search_stats["batched"] = \
                 svc.search_stats.get("batched", 0) + len(metas)
+            svc.meters["search"].mark(len(metas))
         return outs
 
     def _batched_reduce(self, metas, searchers, index_of, results,
@@ -2131,10 +2158,103 @@ class NodeService:
                 "breakers": self.breakers.stats(),
                 "search_batcher": self._batcher.stats()}
 
+    # -- telemetry (the /_metrics exposition + stats-history sampler) ------
+
+    def metric_sections(self) -> dict:
+        """Every stats registry of this node as OpenMetrics walk input:
+        {section: (label_name | None, payload)}. A NEW stats source joins
+        the `/_metrics` scrape (and the strict-parser tripwire test) by
+        adding one entry here — labeled registries (pools, breakers,
+        timers, indices) pick up new entries automatically."""
+        from .common import monitor
+        from .common.metrics import device_events_snapshot, transfer_snapshot
+        batcher = self._batcher.stats()
+        occupancy = batcher.pop("occupancy", {})
+        per_index = {}
+        for n, svc in self.indices.items():
+            seg = [e.segment_stats() for e in svc.shards]
+            per_index[n] = {
+                "docs": svc.doc_count(),
+                "store_size_in_bytes": sum(s["memory_in_bytes"]
+                                           for s in seg),
+                "segments": sum(s["count"] for s in seg),
+                "search_total": svc.query_total,
+                "indexing_total": svc.indexing_stats["index_total"],
+                "delete_total": svc.indexing_stats["delete_total"],
+                "request_cache_hits_total": svc.request_cache_hits,
+                "request_cache_misses_total": svc.request_cache_misses,
+                "search_rate_1m": svc.meters["search"].rate(60),
+                "indexing_rate_1m": svc.meters["indexing"].rate(60),
+            }
+        compiles, compile_ms = device_events_snapshot()
+        os_st = monitor.os_stats()
+        proc = monitor.process_stats()
+        load = os_st.get("load_average") or [0.0]
+        return {
+            "threadpool": ("pool", self.thread_pool.stats()),
+            "breaker": ("breaker", self.breakers.stats()),
+            "search_phase": ("phase", self.phase_timers.stats()),
+            "timer": ("timer", self.metrics.stats()),
+            "search_batcher": (None, batcher),
+            "batch_occupancy": ("size",
+                                {str(k): {"count": v}
+                                 for k, v in occupancy.items()}),
+            "index": ("index", per_index),
+            "jit": (None, {"compiles": compiles,
+                           "compile_time_in_millis": round(compile_ms, 3)}),
+            "transfer": (None, transfer_snapshot()),
+            "tasks": (None, self.tasks.stats()),
+            "rate": ("op", {n: m.stats() for n, m in self.meters.items()}),
+            "process": (None, {
+                "resident_bytes": proc.get("mem", {})
+                .get("resident_in_bytes", 0),
+                "threads": proc.get("threads", 0),
+                "open_file_descriptors":
+                    proc.get("open_file_descriptors", 0)}),
+            "os": (None, {"load_1m": load[0],
+                          "cpu_percent": os_st["cpu"]["percent"],
+                          "mem_used_bytes": os_st.get("mem", {})
+                          .get("used_in_bytes", 0)}),
+        }
+
+    def _sampler_snapshot(self) -> dict:
+        """Flat gauge snapshot for the stats-history ring: the signals an
+        incident inspection reaches for first (queue pressure, rejection,
+        device-memory headroom, rates, batch coalescing, host health)."""
+        from .common import monitor
+        from .common.metrics import device_events_snapshot
+        pool = self.thread_pool.stats().get("search", {})
+        br = self.breakers.stats()
+        batcher = self._batcher.stats()
+        os_st = monitor.os_stats()
+        load = os_st.get("load_average") or [0.0]
+        out = {
+            "heap_used_bytes": monitor._rss(),
+            "threads": monitor.process_stats().get("threads", 0),
+            "load_1m": load[0],
+            "cpu_percent": os_st["cpu"]["percent"],
+            "search_rate_1m": self.meters["search"].rate(60),
+            "indexing_rate_1m": self.meters["indexing"].rate(60),
+            "get_rate_1m": self.meters["get"].rate(60),
+            "pool_search_queue": pool.get("queue", 0),
+            "pool_search_active": pool.get("active", 0),
+            "pool_search_rejected_total": pool.get("rejected", 0),
+            "batcher_batches_total": batcher["batches"],
+            "batcher_batched_requests_total": batcher["batched_requests"],
+            "docs": sum(s.doc_count() for s in self.indices.values()),
+            "tasks_running": self.tasks.stats()["running"],
+            "jit_compiles_total": device_events_snapshot()[0],
+        }
+        for name, b in br.items():
+            out[f"breaker_{name}_used_bytes"] = b["estimated_size_in_bytes"]
+        return out
+
     def close(self) -> None:
         if not self.lifecycle.move_to_closed():
             return                      # idempotent double-close
         self.watcher.stop()
+        if getattr(self, "sampler", None) is not None:
+            self.sampler.stop()
         if getattr(self, "_maint_stop", None) is not None:
             self._maint_stop.set()
         if getattr(self, "_ttl_stop", None) is not None:

@@ -201,8 +201,9 @@ class DistributedSearcher:
                                               np.float32)])
         step = self.build_knn_step(k=k, metric=metric)
         prof = current_profiler()
+        from ..common.metrics import note_h2d
+        note_h2d(qv.nbytes)
         if prof is not None:
-            prof.note_h2d(qv.nbytes)
             with prof.phase("spmd_query"):
                 scores, keys = step(vf.vecs, self.index.live,
                                     jnp.asarray(qv))
@@ -234,12 +235,12 @@ class DistributedSearcher:
             b_arr[:Q] = boosts
             bsts = jnp.broadcast_to(jnp.asarray(b_arr)[None], ts.shape)
         step = self.build_step(Wt=Wt, k=k, k1=k1, b=b)
-        from ..common.metrics import current_profiler
+        from ..common.metrics import current_profiler, note_h2d
         prof = current_profiler()
+        # term tables + boosts are this request's host→device upload;
+        # the SPMD program's result fetch is its device→host leg
+        note_h2d(ts.nbytes + tl.nbytes + bsts.nbytes)
         if prof is not None:
-            # term tables + boosts are this request's host→device upload;
-            # the SPMD program's result fetch is its device→host leg
-            prof.note_h2d(ts.nbytes + tl.nbytes + bsts.nbytes)
             with prof.phase("spmd_query"):
                 scores, keys, total, mx = step(
                     fx.doc_ids, fx.tf, fx.dl, fx.sum_dl,

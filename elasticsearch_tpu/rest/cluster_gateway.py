@@ -78,6 +78,32 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
 
+    def metrics_local(g, p, b):
+        # the coordinator's OWN exposition (same contract as the
+        # single-node /_metrics)
+        from ..common.metrics import render_openmetrics
+        return 200, render_openmetrics(node.metric_sections(),
+                                       node=node.node_id)
+    c.register("GET", "/_metrics", metrics_local)
+    c.register("GET", "/_prometheus/metrics", metrics_local)
+
+    def cluster_metrics(g, p, b):
+        # cluster-wide exposition: per-node sections fan out over the
+        # transport and merge into ONE valid document (same family, one
+        # sample per node via the `node` label); live nodes whose handler
+        # errored surface as comment entries, never a dropped scrape
+        from ..common.metrics import openmetrics_families, render_families
+        res = node.nodes_metric_sections()
+        fams: dict = {}
+        for node_id, sections in sorted(res["sections_by_node"].items()):
+            openmetrics_families(sections, node_id, fams)
+        comments = [
+            f"node-failure node={f['node']} reason="
+            + str(f["reason"])[:200].replace("\n", " ")
+            for f in res["failures"]]
+        return 200, render_families(fams, comments=comments)
+    c.register("GET", "/_cluster/_metrics", cluster_metrics)
+
     def list_tasks(g, p, b):
         # tasks running on THIS coordinator (shard tasks live on the
         # copy-holders' own managers, parent-linked over the transport)

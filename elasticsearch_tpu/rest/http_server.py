@@ -1937,12 +1937,16 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 "rep": svc.n_replicas, "docs.count": svc.doc_count(),
                 "docs.deleted": deleted,
                 "store.size": _cat.human_bytes(size),
-                "pri.store.size": _cat.human_bytes(size)})
+                "pri.store.size": _cat.human_bytes(size),
+                "search.rate": f"{svc.meters['search'].rate(60):.2f}",
+                "indexing.rate":
+                    f"{svc.meters['indexing'].rate(60):.2f}"})
         for n in sorted(node.closed):
             rows.append({"health": "green", "status": "close", "index": n,
                          "pri": "", "rep": "", "docs.count": "",
                          "docs.deleted": "", "store.size": "",
-                         "pri.store.size": ""})
+                         "pri.store.size": "", "search.rate": "",
+                         "indexing.rate": ""})
         return 200, _cat.render(p, [
             ("health", "current health status"), ("status", "open/close"),
             ("index", "index name"), ("pri", "number of primary shards"),
@@ -1950,7 +1954,10 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             ("docs.count", "available docs"),
             ("docs.deleted", "deleted docs"),
             ("store.size", "store size of primaries & replicas"),
-            ("pri.store.size", "store size of primaries")], rows)
+            ("pri.store.size", "store size of primaries"),
+            ("search.rate", "1m EWMA searches per second"),
+            ("indexing.rate", "1m EWMA indexing ops per second")], rows,
+            aliases={"sr": "search.rate", "ir": "indexing.rate"})
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/indices/{index}", cat_indices)
 
@@ -2014,14 +2021,21 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 "flush": "scaling", "optimize": "scaling",
                 "refresh": "scaling", "snapshot": "scaling",
                 "warmer": "scaling"}
-    _TP_ALIAS = {"h": "host", "i": "ip", "po": "port", "p": "pid",
-                 "ba": "bulk.active", "fa": "flush.active",
-                 "gea": "generic.active", "ga": "get.active",
-                 "ia": "index.active", "maa": "management.active",
-                 "oa": "optimize.active", "pa": "percolate.active",
-                 "ra": "refresh.active", "sa": "search.active",
-                 "sna": "snapshot.active", "sua": "suggest.active",
-                 "wa": "warmer.active"}
+    # short-form column aliases (ref RestThreadPoolAction's per-pool alias
+    # scheme): <pool prefix> + a/q/r/s/l/c/t for active/queue/rejected/
+    # size/largest/completed/type, e.g. h=sq,sr,sl selects the search
+    # pool's live queue depth, rejections and high-water queue mark
+    _TP_PFX = {"bulk": "b", "flush": "f", "generic": "ge", "get": "g",
+               "index": "i", "management": "ma", "optimize": "o",
+               "percolate": "p", "refresh": "r", "search": "s",
+               "snapshot": "sn", "suggest": "su", "warmer": "w"}
+    _TP_ALIAS = {"h": "host", "i": "ip", "po": "port", "p": "pid"}
+    for _pool, _pfx in _TP_PFX.items():
+        for _short, _col in (("a", "active"), ("q", "queue"),
+                             ("r", "rejected"), ("s", "size"),
+                             ("l", "largest"), ("c", "completed"),
+                             ("t", "type"), ("qs", "queueSize")):
+            _TP_ALIAS[f"{_pfx}{_short}"] = f"{_pool}.{_col}"
 
     def cat_thread_pool(g, p, b):
         # ref rest/action/cat/RestThreadPoolAction.java:108-150 — one row
@@ -2316,6 +2330,9 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             if "indexing" in want:
                 ix = {"index_total": svc.indexing_stats["index_total"],
                       "index_time_in_millis": 0, "index_current": 0,
+                      "index_rate_1m": svc.meters["indexing"].rate(60),
+                      "index_rate_5m": svc.meters["indexing"].rate(300),
+                      "index_rate_15m": svc.meters["indexing"].rate(900),
                       "delete_total": svc.indexing_stats["delete_total"],
                       "noop_update_total": 0, "is_throttled": False,
                       "throttle_time_in_millis": 0}
@@ -2334,6 +2351,9 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 se = {"open_contexts": 0,
                       "query_total": svc.query_total,
                       "query_time_in_millis": 0, "query_current": 0,
+                      "query_rate_1m": svc.meters["search"].rate(60),
+                      "query_rate_5m": svc.meters["search"].rate(300),
+                      "query_rate_15m": svc.meters["search"].rate(900),
                       "fetch_total": svc.query_total,
                       "fetch_time_in_millis": 0, "fetch_current": 0}
                 if groups_sel:
@@ -2507,9 +2527,30 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                            "profiling": node.metrics.stats(),
                            "tasks": node.tasks.stats(),
                            "slowlog_tail": node.slowlog.snapshot(),
-                           "search_batcher": node._batcher.stats()}}}
+                           "search_batcher": node._batcher.stats(),
+                           "rates": {name: m.stats()
+                                     for name, m in node.meters.items()}}}}
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
+
+    def nodes_stats_history(g, p, b):
+        # the StatsSampler ring (common/monitor.py): timestamped gauge
+        # samples + min/max/avg rollups, so a spike BETWEEN two stats
+        # calls is still inspectable without an external TSDB
+        sel = _csv_param(p, "metric")
+        return 200, {"cluster_name": node.cluster_name, "nodes": {
+            "tpu-node-0": node.sampler.history(sel)}}
+    c.register("GET", "/_nodes/stats/history", nodes_stats_history)
+
+    def metrics_exposition(g, p, b):
+        # OpenMetrics text over every stats registry (common/metrics.py
+        # render walk; `# TYPE`/`# HELP`, `_total`/`_bytes` conventions,
+        # node/pool/breaker/index labels) — the standard scrape surface
+        from ..common.metrics import render_openmetrics
+        return 200, render_openmetrics(node.metric_sections(),
+                                       node="tpu-node-0")
+    c.register("GET", "/_metrics", metrics_exposition)
+    c.register("GET", "/_prometheus/metrics", metrics_exposition)
 
     # -- task management (ref tasks/TaskManager + ListTasksAction:
     #    GET /_tasks, GET /_tasks/{id}, GET /_cat/tasks) -------------------

@@ -303,9 +303,10 @@ class ShardSearcher:
         from ..ops import knn as knn_ops
 
         qv = jnp.asarray(np.asarray(query_vectors, np.float32))
-        prof = current_profiler()
-        if prof is not None:     # query vectors are the host→device upload
-            prof.note_h2d(int(qv.size) * 4)
+        # query vectors are the host→device upload (process-wide transfer
+        # counters + the active profiler, when one is installed)
+        from ..common.metrics import note_h2d
+        note_h2d(int(qv.size) * 4)
         Q = qv.shape[0]
         best_scores = np.full((Q, k), -np.inf, np.float32)
         best_keys = np.full((Q, k), -1, np.int64)

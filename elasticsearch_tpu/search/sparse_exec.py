@@ -191,10 +191,10 @@ def execute_sparse(plan: SparsePlan, segments: list[Segment],
             continue
         Wt = slot_budget(lens)
         doc_mask = _segment_mask(seg, plan, Q, stats)
-        from ..common.metrics import current_profiler
+        from ..common.metrics import current_profiler, note_h2d
         prof = current_profiler()
-        if prof is not None:    # query term arrays are the per-request upload
-            prof.note_h2d(starts.nbytes + lens.nbytes + weights_np.nbytes)
+        # query term arrays are the per-request upload
+        note_h2d(starts.nbytes + lens.nbytes + weights_np.nbytes)
         t0_prof = time.perf_counter() if prof is not None else 0.0
         top, docs, hits = bm25_topk_sparse_masked(
             fx.doc_ids, fx.tf, fx.dl,

@@ -21,10 +21,11 @@ but the unit of concurrency is a device batch, not a thread.
 from __future__ import annotations
 
 import threading
+import time
 
 
 class _Entry:
-    __slots__ = ("body", "spec", "event", "out", "err")
+    __slots__ = ("body", "spec", "event", "out", "err", "t_submit")
 
     def __init__(self, body, spec):
         self.body = body
@@ -32,6 +33,7 @@ class _Entry:
         self.event = threading.Event()
         self.out = None          # response dict, or None -> general path
         self.err = None
+        self.t_submit = time.perf_counter()
 
 
 class SearchBatcher:
@@ -46,6 +48,11 @@ class SearchBatcher:
         self._busy: set[tuple] = set()
         self.batches = 0         # observability: device batches executed
         self.batched_requests = 0
+        # batch-occupancy histogram {batch size: batches}: how full the
+        # coalescing window actually runs — THE serving-efficiency gauge
+        # (occupancy 1 = no coalescing happened; near MAX_BATCH = the
+        # arrival rate saturates the device latency window)
+        self.occupancy: dict[int, int] = {}
 
     def submit(self, key: tuple, name: str, body: dict, spec,
                size: int, from_: int, t0: float):
@@ -86,6 +93,16 @@ class SearchBatcher:
         return e.out
 
     def _run(self, key, name, batch, size, from_, t0):
+        # queue-wait timer: time each entry spent waiting for the device
+        # (leader ≈ 0; followers accrue while the previous batch runs) —
+        # the admission-latency half of batcher cost, invisible to the
+        # device timers because it happens entirely on the host
+        now = time.perf_counter()
+        metrics = getattr(self.node, "metrics", None)
+        if metrics is not None:
+            for x in batch:
+                metrics.record("batcher.queue_wait",
+                               (now - x.t_submit) * 1000)
         try:
             outs = self.node._packed_search(
                 name, [x.body for x in batch], size=size, from_=from_,
@@ -96,12 +113,17 @@ class SearchBatcher:
                 x.out = None
                 x.event.set()
             return
-        self.batches += 1
-        self.batched_requests += len(batch)
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += len(batch)
+            self.occupancy[len(batch)] = \
+                self.occupancy.get(len(batch), 0) + 1
         for i, x in enumerate(batch):
             x.out = None if outs is None else outs[i]
             x.event.set()
 
     def stats(self) -> dict:
-        return {"batches": self.batches,
-                "batched_requests": self.batched_requests}
+        with self._lock:
+            return {"batches": self.batches,
+                    "batched_requests": self.batched_requests,
+                    "occupancy": dict(sorted(self.occupancy.items()))}

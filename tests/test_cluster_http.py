@@ -181,3 +181,40 @@ def test_indices_stats_broadcast(http):
     assert st["shard_copies"] >= 1
     code, out = req(base, "GET", "/_stats")
     assert code == 200 and out["_all"]["total"]["docs"]["count"] >= 10
+
+
+def test_cluster_metrics_fan_out_with_failures(http):
+    """/_cluster/_metrics merges per-node expositions into one document
+    (same family, one sample per node) and reports a live node whose
+    handler errors as a failure entry instead of dropping the scrape."""
+    cluster, base = http
+    code, text = req(base, "GET", "/_cluster/_metrics", raw=True)
+    assert code == 200
+    assert text.endswith("# EOF\n")
+    live = {n for n, cn in cluster.nodes.items() if not cn.closed}
+    sample_nodes = set()
+    type_lines = []
+    for ln in text.splitlines():
+        if ln.startswith("# TYPE "):
+            type_lines.append(ln.split()[2])
+        elif ln and not ln.startswith("#") and 'node="' in ln:
+            sample_nodes.add(ln.split('node="')[1].split('"')[0])
+    assert sample_nodes == live               # one exposition, every node
+    assert len(type_lines) == len(set(type_lines))   # families merge
+    assert "es_tasks_running" in type_lines
+
+    # a LIVE node whose handler errors surfaces as a failure comment
+    coordinator = cluster.client().node_id
+    victim = next(n for n in sorted(live) if n != coordinator)
+    from elasticsearch_tpu.cluster.node import A_NODE_METRICS
+
+    def broken(from_id, req_):
+        raise RuntimeError("scrape handler down")
+    cluster.nodes[victim].transport.register_handler(A_NODE_METRICS, broken)
+    code, text = req(base, "GET", "/_cluster/_metrics", raw=True)
+    assert code == 200
+    assert f"# node-failure node={victim}" in text
+
+    # the single-node exposition also serves from the gateway
+    code, text = req(base, "GET", "/_metrics", raw=True)
+    assert code == 200 and "# TYPE es_tasks_running gauge" in text

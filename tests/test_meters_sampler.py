@@ -1,0 +1,186 @@
+"""Deterministic telemetry primitives: the EWMA Meter under an injected
+clock (known tick sequence -> exact expected rates, no sleeping) and the
+StatsSampler ring/rollups driven by manual sample() ticks."""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.metrics import Meter
+from elasticsearch_tpu.common.monitor import StatsSampler
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- Meter ------------------------------------------------------------------
+
+def test_meter_first_tick_is_instant_rate():
+    clock = FakeClock()
+    m = Meter(clock=clock)
+    m.mark(300)
+    assert m.rate(60) == 0.0            # no tick elapsed yet
+    clock.advance(5.0)
+    # first 5s tick initializes every EWMA to the interval's instant rate
+    assert m.rate(60) == pytest.approx(300 / 5.0)
+    assert m.rate(300) == pytest.approx(60.0)
+    assert m.rate(900) == pytest.approx(60.0)
+    assert m.count == 300
+
+
+def test_meter_idle_decay_matches_ewma_formula():
+    clock = FakeClock()
+    m = Meter(clock=clock)
+    m.mark(300)
+    clock.advance(5.0)
+    r0 = m.rate(60)                      # 60 ev/s after the first tick
+    # 12 idle ticks (one minute): r = r0 * (1 - alpha)^12 exactly
+    clock.advance(60.0)
+    alpha_1m = 1.0 - math.exp(-5.0 / 60.0)
+    assert m.rate(60) == pytest.approx(r0 * (1 - alpha_1m) ** 12, rel=1e-9)
+    alpha_5m = 1.0 - math.exp(-5.0 / 300.0)
+    assert m.rate(300) == pytest.approx(r0 * (1 - alpha_5m) ** 12, rel=1e-9)
+    # the longer window decays slower — the whole point of 1m/5m/15m
+    assert m.rate(900) > m.rate(300) > m.rate(60) > 0
+
+
+def test_meter_steady_state_converges_to_arrival_rate():
+    clock = FakeClock()
+    m = Meter(clock=clock)
+    for _ in range(240):                 # 20 minutes at 10 ev/s
+        m.mark(50)
+        clock.advance(5.0)
+    assert m.rate(60) == pytest.approx(10.0, rel=1e-3)
+    assert m.rate(300) == pytest.approx(10.0, rel=0.05)
+    assert m.mean_rate() == pytest.approx(10.0, rel=1e-3)
+
+
+def test_meter_stats_shape():
+    clock = FakeClock()
+    m = Meter(clock=clock)
+    m.mark(10)
+    clock.advance(5.0)
+    st = m.stats()
+    assert st["count"] == 10
+    for key in ("rate_1m", "rate_5m", "rate_15m", "mean_rate"):
+        assert key in st
+    assert st["rate_1m"] == pytest.approx(2.0)
+
+
+# -- StatsSampler -----------------------------------------------------------
+
+def test_sampler_ring_bounds_and_rollups():
+    clock = FakeClock(1000.0)
+    vals = iter(range(10))
+
+    def snap():
+        v = next(vals)
+        return {"gauge": v, "constant": 7, "bad": float("nan"),
+                "skip": "not-a-number"}
+
+    s = StatsSampler(snap, interval_s=10.0, maxlen=3, clock=clock)
+    for _ in range(5):
+        s.sample()
+        clock.advance(10.0)
+    h = s.history()
+    assert h["sample_count"] == 3                 # ring bound holds
+    assert [x["metrics"]["gauge"] for x in h["samples"]] == [2, 3, 4]
+    assert all("bad" not in x["metrics"] and "skip" not in x["metrics"]
+               for x in h["samples"])
+    r = h["rollups"]["gauge"]
+    assert (r["min"], r["max"], r["last"], r["count"]) == (2, 4, 4, 3)
+    assert r["avg"] == pytest.approx(3.0)
+    assert h["rollups"]["constant"]["avg"] == 7
+    # timestamps are milliseconds of the injected clock
+    assert h["samples"][0]["timestamp"] == int(1000.0 + 2 * 10.0) * 1000
+
+
+def test_sampler_metric_filter_wildcards():
+    s = StatsSampler(lambda: {"pool_search_queue": 1, "pool_search_active": 0,
+                              "docs": 5}, interval_s=10.0, maxlen=8)
+    s.sample()
+    h = s.history(["pool_search_*"])
+    assert set(h["samples"][0]["metrics"]) \
+        == {"pool_search_queue", "pool_search_active"}
+    assert set(h["rollups"]) == {"pool_search_queue", "pool_search_active"}
+
+
+def test_sampler_snapshot_fn_errors_never_raise():
+    def boom():
+        raise RuntimeError("sampling must never break serving")
+    s = StatsSampler(boom, interval_s=10.0)
+    entry = s.sample()
+    assert entry["metrics"] == {}
+
+
+# -- node integration (the acceptance path, no wall-clock sleeps) -----------
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+    node = NodeService(str(tmp_path_factory.mktemp("hist")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method)
+        resp = urllib.request.urlopen(r)
+        return resp.status, json.loads(resp.read())
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def test_nodes_stats_history_after_two_ticks(http):
+    node, req = http
+    req("PUT", "/h1", {"mappings": {"_doc": {"properties": {
+        "body": {"type": "string"}}}}})
+    req("PUT", "/h1/_doc/1", {"body": "quick brown fox"})
+    req("POST", "/h1/_refresh")
+    req("POST", "/h1/_search", {"query": {"match": {"body": "quick"}}})
+    node.sampler.sample()       # manual ticks: tier-1 never sleeps
+    node.sampler.sample()
+    code, out = req("GET", "/_nodes/stats/history")
+    assert code == 200
+    h = out["nodes"]["tpu-node-0"]
+    assert h["sample_count"] >= 2
+    assert all("timestamp" in s and "metrics" in s for s in h["samples"])
+    for key in ("docs", "pool_search_queue", "search_rate_1m",
+                "breaker_parent_used_bytes", "batcher_batches_total"):
+        assert key in h["samples"][-1]["metrics"], key
+        assert {"min", "max", "avg", "last", "count"} \
+            <= set(h["rollups"][key]), key
+    assert h["rollups"]["docs"]["last"] >= 1
+
+    code, out = req("GET", "/_nodes/stats/history?metric=docs")
+    h = out["nodes"]["tpu-node-0"]
+    assert set(h["rollups"]) == {"docs"}
+
+
+def test_rates_surfaced_in_stats_apis(http):
+    node, req = http
+    code, stats = req("GET", "/_nodes/stats")
+    rates = stats["nodes"]["tpu-node-0"]["rates"]
+    for op in ("search", "indexing", "get"):
+        assert {"count", "rate_1m", "rate_5m", "rate_15m", "mean_rate"} \
+            <= set(rates[op])
+    assert rates["search"]["count"] >= 1
+    assert rates["indexing"]["count"] >= 1
+
+    code, istats = req("GET", "/h1/_stats")
+    se = istats["indices"]["h1"]["primaries"]["search"]
+    assert "query_rate_1m" in se and "query_rate_5m" in se
+    ix = istats["indices"]["h1"]["primaries"]["indexing"]
+    assert "index_rate_1m" in ix and "index_rate_15m" in ix

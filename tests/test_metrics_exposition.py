@@ -1,0 +1,180 @@
+"""Strict OpenMetrics exposition tripwire (`GET /_metrics`).
+
+A minimal parser validates the document's grammar (every family declared
+with `# TYPE` before its samples, no duplicate family declarations,
+counters end in `_total`, gauges never do, values parse as floats) and the
+coverage assertions pin every registry — a new stats section that forgets
+to join `NodeService.metric_sections()` fails here, not in production.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+
+SAMPLE_RX = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'\{(?P<labels>[^}]*)\}\s+(?P<value>\S+)$')
+LABEL_RX = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+
+
+def parse_openmetrics(text: str) -> dict:
+    """-> {family: {"type": t, "help": h, "samples": [(labels, value)]}}.
+    Raises AssertionError on any grammar violation."""
+    assert text.endswith("# EOF\n"), "exposition must end with # EOF"
+    families: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            fam = families.setdefault(name, {"type": None, "help": None,
+                                             "samples": []})
+            assert fam["help"] is None, f"duplicate HELP for [{name}]"
+            fam["help"] = line.split(None, 3)[3]
+        elif line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            fam = families.setdefault(name, {"type": None, "help": None,
+                                             "samples": []})
+            assert fam["type"] is None, f"duplicate TYPE for [{name}]"
+            assert not fam["samples"], \
+                f"TYPE for [{name}] must precede its samples"
+            assert mtype in ("counter", "gauge"), \
+                f"unknown type [{mtype}] for [{name}]"
+            fam["type"] = mtype
+        elif line.startswith("#"):
+            continue                        # free-form comment (EOF, notes)
+        else:
+            m = SAMPLE_RX.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            name = m.group("name")
+            assert name in families and families[name]["type"], \
+                f"sample for undeclared family [{name}]"
+            labels = {}
+            for part in m.group("labels").split(","):
+                lm = LABEL_RX.match(part)
+                assert lm, f"malformed label in {line!r}"
+                labels[lm.group(1)] = lm.group(2)
+            value = float(m.group("value"))     # raises on junk
+            families[name]["samples"].append((labels, value))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"[{name}] has HELP but no TYPE"
+        assert fam["samples"], f"family [{name}] declared but empty"
+        if fam["type"] == "counter":
+            assert name.endswith("_total"), \
+                f"counter [{name}] must end in _total"
+            assert all(v >= 0 for _, v in fam["samples"]), \
+                f"counter [{name}] has a negative sample"
+        else:
+            assert not name.endswith("_total"), \
+                f"gauge [{name}] must not end in _total"
+    return families
+
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    node = NodeService(str(tmp_path_factory.mktemp("expo")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method)
+        resp = urllib.request.urlopen(r)
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+
+    # traffic so every subsystem has non-trivial samples
+    req("PUT", "/expo", {"mappings": {"_doc": {"properties": {
+        "body": {"type": "string"}}}}})
+    for i in range(10):
+        req("PUT", f"/expo/_doc/{i}", {"body": f"quick brown fox {i}"})
+    req("POST", "/expo/_refresh")
+    req("POST", "/expo/_search", {"query": {"match": {"body": "quick"}}})
+    req("POST", "/expo/_search", {"query": {"match": {"body": "fox"}},
+                                  "size": 0})
+    req("GET", "/expo/_doc/1")
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def scrape(req):
+    code, text = req("GET", "/_metrics")
+    assert code == 200
+    assert isinstance(text, str)
+    return parse_openmetrics(text)
+
+
+def test_exposition_is_valid_and_broad(http):
+    node, req = http
+    families = scrape(req)
+    n_series = sum(len(f["samples"]) for f in families.values())
+    subsystems = {name.split("_")[1] for name in families}
+    # acceptance floor: ≥40 series across ≥8 subsystems
+    assert n_series >= 40, f"only {n_series} series"
+    for want in ("threadpool", "breaker", "search", "timer", "jit",
+                 "transfer", "index", "tasks", "rate", "process", "os"):
+        assert want in subsystems, f"subsystem [{want}] missing"
+    # every sample carries the node label
+    for fam in families.values():
+        for labels, _ in fam["samples"]:
+            assert labels.get("node") == "tpu-node-0"
+
+
+def test_every_registry_is_scraped(http):
+    """Drift guard: pools, breakers and histogram timers appear in the
+    exposition with one sample per registered entry."""
+    node, req = http
+    families = scrape(req)
+
+    pool_labels = {lb["pool"] for lb, _
+                   in families["es_threadpool_rejected_total"]["samples"]}
+    assert pool_labels == set(node.thread_pool.stats())
+
+    breaker_labels = {lb["breaker"] for lb, _ in
+                      families["es_breaker_estimated_size_bytes"]["samples"]}
+    assert breaker_labels == set(node.breakers.stats())
+
+    timer_labels = {lb["timer"] for lb, _
+                    in families["es_timer_count_total"]["samples"]}
+    assert timer_labels == set(node.metrics.stats())
+
+    index_labels = {lb["index"] for lb, _
+                    in families["es_index_docs"]["samples"]}
+    assert index_labels == set(node.indices)
+
+
+def test_new_timer_joins_the_scrape_automatically(http):
+    node, req = http
+    node.metrics.record("custom.drift_guard", 1.25)
+    families = scrape(req)
+    timer_labels = {lb["timer"] for lb, _
+                    in families["es_timer_count_total"]["samples"]}
+    assert "custom.drift_guard" in timer_labels
+
+
+def test_aliases_and_content(http):
+    node, req = http
+    code, a = req("GET", "/_metrics")
+    code2, b = req("GET", "/_prometheus/metrics")
+    assert code == code2 == 200
+    # same families on both paths (values may drift between scrapes)
+    assert {ln.split("{")[0] for ln in a.splitlines()
+            if ln and not ln.startswith("#")} \
+        == {ln.split("{")[0] for ln in b.splitlines()
+            if ln and not ln.startswith("#")}
+    # indexed docs + searches are visible in the scrape
+    fams = parse_openmetrics(a)
+    total = sum(v for _, v in fams["es_index_docs"]["samples"])
+    assert total >= 10
+    searches = sum(v for _, v
+                   in fams["es_index_search_total"]["samples"])
+    assert searches >= 2

@@ -124,3 +124,60 @@ def test_profiling_histograms_in_nodes_stats(http):
         assert key in prof["search.total"]
     assert prof["search.total"]["p99_millis"] >= \
         prof["search.total"]["p50_millis"]
+
+
+def test_cat_thread_pool_pressure_columns(http):
+    """Live queue-depth / high-water / rejected columns with ?h= selection
+    (long names AND the per-pool short aliases)."""
+    node, req = http
+    code, out = req(
+        "GET", "/_cat/thread_pool?v=true"
+        "&h=search.active,search.queue,search.largest,search.rejected")
+    assert code == 200
+    header, row = out.splitlines()[:2]
+    assert header.split() == ["search.active", "search.queue",
+                              "search.largest", "search.rejected"]
+    active, queue, largest, rejected = (int(x) for x in row.split())
+    assert largest >= 1          # this very request rode the search pool
+    assert rejected == 0
+    # short aliases render the same values under the requested tokens
+    code, out2 = req("GET", "/_cat/thread_pool?v=true&h=sa,sq,sl,sr")
+    assert out2.splitlines()[0].split() == ["sa", "sq", "sl", "sr"]
+    assert [int(x) for x in out2.splitlines()[1].split()][2] >= 1
+
+
+def test_cat_indices_rate_columns(http):
+    node, req = http
+    req("POST", "/obs/_search", {"query": {"match_all": {}}})
+    code, out = req("GET", "/_cat/indices?v=true"
+                           "&h=index,search.rate,indexing.rate")
+    assert code == 200
+    lines = out.splitlines()
+    assert lines[0].split() == ["index", "search.rate", "indexing.rate"]
+    row = next(ln for ln in lines[1:] if ln.split()[0] == "obs")
+    float(row.split()[1])        # numeric 1m EWMA rate
+    float(row.split()[2])
+    # default ?v output carries the rate columns too
+    code, out = req("GET", "/_cat/indices?v=true")
+    assert "search.rate" in out.splitlines()[0]
+    assert "indexing.rate" in out.splitlines()[0]
+
+
+def test_batcher_occupancy_and_queue_wait(http):
+    """The batcher's serving-efficiency surfaces: occupancy histogram in
+    its stats section, queue-wait timer in the profiling histograms."""
+    node, req = http
+    for _ in range(3):
+        req("POST", "/obs/_search",
+            {"query": {"match": {"body": "quick"}}})
+    code, stats = req("GET", "/_nodes/stats")
+    n = stats["nodes"]["tpu-node-0"]
+    bst = n["search_batcher"]
+    assert bst["batches"] >= 1
+    occ = bst["occupancy"]
+    assert sum(occ.values()) == bst["batches"]
+    assert sum(int(k) * v for k, v in occ.items()) \
+        == bst["batched_requests"]
+    assert "batcher.queue_wait" in n["profiling"]
+    assert n["profiling"]["batcher.queue_wait"]["count"] \
+        >= bst["batched_requests"]

@@ -1,0 +1,61 @@
+"""Route-registry tripwire: the controller's route count stays at or above
+its current floor, no (method, pattern) is registered twice, and every
+endpoint the README's Observability section documents resolves to a real
+handler — docs and the route table can't silently drift apart."""
+
+import os
+import re
+
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest.http_server import RestController
+
+README = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "README.md")
+
+
+@pytest.fixture(scope="module")
+def controller(tmp_path_factory):
+    node = NodeService(str(tmp_path_factory.mktemp("routes")))
+    c = RestController(node)
+    yield c
+    node.close()
+
+
+def _resolves(controller, path: str) -> bool:
+    return any(rx.match(path) for _m, rx, _h, _s in controller.routes)
+
+
+def test_route_count_floor_and_uniqueness(controller):
+    # floor, not exact: new PRs add routes; LOSING routes is the bug.
+    # (211 at ISSUE-2 time + this PR's /_metrics, /_prometheus/metrics,
+    # /_nodes/stats/history)
+    assert len(controller.routes) >= 214, len(controller.routes)
+    seen = set()
+    for method, rx, _h, _s in controller.routes:
+        key = (method, rx.pattern)
+        assert key not in seen, f"duplicate route {key}"
+        seen.add(key)
+
+
+def test_new_observability_routes_resolve(controller):
+    for path in ("/_metrics", "/_prometheus/metrics",
+                 "/_nodes/stats/history", "/_nodes/stats",
+                 "/_cat/thread_pool", "/_cat/indices"):
+        assert _resolves(controller, path), path
+
+
+def test_readme_observability_endpoints_resolve(controller):
+    with open(README) as f:
+        text = f.read()
+    section = text.split("## Observability", 1)[1].split("\n## ", 1)[0]
+    paths = set()
+    for m in re.finditer(r"localhost:9200(/[^\s'\"]*)", section):
+        p = m.group(1).split("?", 1)[0].rstrip("'\"")
+        if p != "/":
+            paths.add(p)
+    assert len(paths) >= 6, f"README section lost its examples: {paths}"
+    for p in sorted(paths):
+        assert _resolves(controller, p), \
+            f"README documents [{p}] but no route matches it"

@@ -1,0 +1,291 @@
+"""Generic byte-accounted LRU cache — the one cache core every cache uses.
+
+Analog of the reference's common/cache/Cache (guava-style builder in
+org.elasticsearch.common.cache: weigher, maximumWeight, expireAfter,
+RemovalListener) — the shared substrate under IndicesRequestCache,
+Lucene's LRUQueryCache and the fielddata cache. Here every node-level
+cache (request responses, parsed query plans, fielddata columns, packed
+serving views, geo-distance mirrors) is an instance of this class, so
+eviction policy, byte accounting and hit/miss/eviction stats are uniform
+and a new cache joins the `_nodes/stats` + `/_metrics` surfaces for free.
+
+Design points:
+  * thread-safe LRU over an OrderedDict (get promotes, evict pops oldest);
+  * pluggable `weigher(value) -> bytes` + max-bytes / max-entries budgets;
+  * optional TTL with an injectable clock (the Meter/StatsSampler pattern:
+    tests drive exact expiry sequences with no sleeping);
+  * removal listeners fire on every exit path (replace/evict/expire/
+    invalidate/clear) with the reason — breaker releases hang off these;
+  * optional circuit breaker: entries charge it on insert and release on
+    removal; when a charge trips, the cache evicts its own LRU tail to
+    make room and, if the budget still doesn't fit, REFUSES the insert
+    (counted as an overflow) instead of raising — a full cache degrades
+    to uncached serving, never to a 5xx.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable
+
+from .breaker import CircuitBreakingException
+
+
+class RemovalReason:
+    """Why an entry left the cache (removal-listener argument)."""
+
+    REPLACED = "replaced"
+    EVICTED = "evicted"        # LRU/byte-budget/breaker-pressure eviction
+    EXPIRED = "expired"        # TTL
+    INVALIDATED = "invalidated"
+    CLEARED = "cleared"
+
+
+class _Entry:
+    __slots__ = ("value", "weight", "expiry")
+
+    def __init__(self, value, weight: int, expiry: float | None):
+        self.value = value
+        self.weight = weight
+        self.expiry = expiry
+
+
+class Cache:
+    """Thread-safe LRU with byte accounting. See module docstring."""
+
+    def __init__(self, name: str = "cache", *,
+                 max_bytes: int = 0, max_entries: int = 0,
+                 ttl_s: float | None = None,
+                 weigher: Callable[[Any], int] | None = None,
+                 clock: Callable[[], float] | None = None,
+                 removal_listener=None, breaker=None):
+        self.name = name
+        self.max_bytes = int(max_bytes)
+        self.max_entries = int(max_entries)
+        self.ttl_s = ttl_s
+        self._weigher = weigher
+        self._clock = clock or time.monotonic
+        self._listeners = list(removal_listener) \
+            if isinstance(removal_listener, (list, tuple)) \
+            else ([removal_listener] if removal_listener else [])
+        self.breaker = breaker
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self._bytes = 0
+        # monotone counters (leaf names follow the OpenMetrics conventions
+        # the /_metrics walk expects: *_total = counter, rest = gauge)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.expirations = 0
+        self.overflows = 0          # inserts refused (breaker/budget)
+        self.puts = 0
+
+    # -- internals (caller holds the lock) ---------------------------------
+
+    def _weight(self, value) -> int:
+        if self._weigher is None:
+            return 0
+        try:
+            return max(int(self._weigher(value)), 0)
+        except Exception:  # noqa: BLE001 — a broken weigher must not 500
+            return 0
+
+    def _remove_locked(self, key, reason: str) -> _Entry | None:
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return None
+        self._bytes -= ent.weight
+        if self.breaker is not None and ent.weight:
+            self.breaker.release(ent.weight)
+        for fn in self._listeners:
+            try:
+                fn(key, ent.value, reason)
+            except Exception:  # noqa: BLE001 — listeners must not break us
+                pass
+        return ent
+
+    def _evict_one_locked(self) -> bool:
+        try:
+            key = next(iter(self._entries))
+        except StopIteration:
+            return False
+        self._remove_locked(key, RemovalReason.EVICTED)
+        self.evictions += 1
+        return True
+
+    def _expired_locked(self, ent: _Entry) -> bool:
+        return ent.expiry is not None and self._clock() >= ent.expiry
+
+    # -- public API --------------------------------------------------------
+
+    def get(self, key, default=None):
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return default
+            if self._expired_locked(ent):
+                self._remove_locked(key, RemovalReason.EXPIRED)
+                self.expirations += 1
+                self.misses += 1
+                return default
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent.value
+
+    def peek(self, key, default=None):
+        """get() without stats or LRU promotion — for introspection walks
+        that must not skew hit ratios or recency."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or self._expired_locked(ent):
+                return default
+            return ent.value
+
+    def make_room(self, breaker, n_bytes: int) -> None:
+        """Charge `n_bytes` against `breaker`, evicting this cache's LRU
+        tail under pressure until the charge fits. Raises
+        CircuitBreakingException only once the cache has nothing left to
+        evict — the admission-control seam fielddata builds go through
+        BEFORE doing the expensive work."""
+        with self._lock:
+            while True:
+                try:
+                    breaker.add_estimate(int(n_bytes))
+                    return
+                except CircuitBreakingException:
+                    if not self._evict_one_locked():
+                        raise
+
+    def put(self, key, value, weight: int | None = None) -> bool:
+        """Insert (LRU-newest). `weight` overrides the weigher when the
+        caller already knows the entry's bytes. Returns False when the
+        entry was refused — single entry over the byte budget, or the
+        breaker still trips after evicting everything else — so callers
+        degrade to uncached."""
+        weight = self._weight(value) if weight is None else max(int(weight), 0)
+        with self._lock:
+            if self.max_bytes > 0 and weight > self.max_bytes:
+                self.overflows += 1
+                return False
+            self._remove_locked(key, RemovalReason.REPLACED)
+            if self.breaker is not None and weight:
+                try:
+                    self.make_room(self.breaker, weight)
+                except CircuitBreakingException:
+                    self.overflows += 1
+                    return False
+            expiry = self._clock() + self.ttl_s \
+                if self.ttl_s is not None else None
+            self._entries[key] = _Entry(value, weight, expiry)
+            self._bytes += weight
+            self.puts += 1
+            while (self.max_entries > 0
+                   and len(self._entries) > self.max_entries) \
+                    or (self.max_bytes > 0 and self._bytes > self.max_bytes):
+                if not self._evict_one_locked():
+                    break
+            return key in self._entries
+
+    def get_or_compute(self, key, fn):
+        """get() or compute-and-put. The compute runs OUTSIDE the lock
+        (it may be expensive); two racers may both compute, last insert
+        wins — the reference's loading-cache accepts the same race."""
+        hit = self.get(key, default=_MISSING)
+        if hit is not _MISSING:
+            return hit
+        value = fn()
+        self.put(key, value)
+        return value
+
+    def invalidate(self, key) -> bool:
+        with self._lock:
+            return self._remove_locked(
+                key, RemovalReason.INVALIDATED) is not None
+
+    def invalidate_where(self, pred) -> int:
+        """Remove every entry where pred(key, value) — `_cache/clear`
+        index filtering."""
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if pred(k, e.value)]
+            for k in doomed:
+                self._remove_locked(k, RemovalReason.INVALIDATED)
+            return len(doomed)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            for k in list(self._entries):
+                self._remove_locked(k, RemovalReason.CLEARED)
+            return n
+
+    def prune_expired(self) -> int:
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if self._expired_locked(e)]
+            for k in doomed:
+                self._remove_locked(k, RemovalReason.EXPIRED)
+                self.expirations += 1
+            return len(doomed)
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            ent = self._entries.get(key)
+            return ent is not None and not self._expired_locked(ent)
+
+    @property
+    def memory_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def entries_snapshot(self) -> list[tuple[Any, Any, int]]:
+        """[(key, value, weight)] — race-free copy for stats walks."""
+        with self._lock:
+            return [(k, e.value, e.weight)
+                    for k, e in self._entries.items()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memory_size_in_bytes": self._bytes,
+                "entries": len(self._entries),
+                "max_size_in_bytes": self.max_bytes,
+                "hits_total": self.hits,
+                "misses_total": self.misses,
+                "evictions_total": self.evictions,
+                "expirations_total": self.expirations,
+                "overflows_total": self.overflows,
+            }
+
+
+_MISSING = object()
+
+
+def parse_size(raw, total: int, default: int = 0) -> int:
+    """'10%' (of `total`), '64mb', plain ints -> bytes. The reference's
+    ByteSizeValue-or-percentage settings parser (e.g.
+    `indices.requests.cache.size: 1%`)."""
+    if raw is None:
+        return default
+    s = str(raw).strip().lower()
+    try:
+        if s.endswith("%"):
+            return int(total * float(s[:-1]) / 100.0)
+        for suffix, mult in (("pb", 1 << 50), ("tb", 1 << 40),
+                             ("gb", 1 << 30), ("mb", 1 << 20),
+                             ("kb", 1 << 10), ("b", 1)):
+            if s.endswith(suffix):
+                return int(float(s[: -len(suffix)]) * mult)
+        return int(float(s))
+    except (TypeError, ValueError):
+        return default

@@ -111,12 +111,17 @@ class Engine:
 
     def __init__(self, shard_path: str, mappers: MapperService,
                  type_name_default: str = "_doc", durability: str = "request",
-                 breaker=None):
+                 breaker=None, fielddata_cache=None, index_name=None):
         self.path = shard_path
         self.mappers = mappers
         # HBM accounting (common/breaker.py; ref HierarchyCircuitBreaker-
         # Service): segments charge the "fielddata" breaker at build time
         self.breaker = breaker
+        # node-level fielddata tier (indices/cache_service.FielddataCache):
+        # when attached, built sort columns live THERE (LRU, evictable
+        # under breaker pressure) instead of pinned per-segment dicts
+        self.fielddata_cache = fielddata_cache
+        self.index_name = index_name
         self._blocked_reason = None
         os.makedirs(shard_path, exist_ok=True)
         from .store import SegmentStore
@@ -155,7 +160,7 @@ class Engine:
         segments, tombstones = self.store.load()
         self.segments = segments
         for s in segments:
-            s.breaker = self.breaker    # fielddata loads charge it too
+            self._adopt(s)              # fielddata loads charge it too
         if self.breaker is not None:
             # recovery loads regardless of pressure (unbreakable add) —
             # refusing to boot would lose availability, not memory
@@ -406,7 +411,7 @@ class Engine:
                     self.breaker.release(-drift)
             self._blocked_reason = None
             self._next_seg_id += 1
-            seg.breaker = self.breaker
+            self._adopt(seg)
             self.segments.append(seg)
             self._buffer_docs.clear()
             self._buffer_bytes = 0
@@ -438,7 +443,7 @@ class Engine:
         for s in self.segments:
             if id(s) in chosen:
                 if not placed and merged.n_docs:
-                    merged.breaker = self.breaker
+                    self._adopt(merged)
                     out.append(merged)
                     placed = True
             else:
@@ -457,23 +462,40 @@ class Engine:
             merged = merge_segments(self.segments, self._next_seg_id)
             self._charge_merge(merged, self.segments)
             self._next_seg_id += 1
-            merged.breaker = self.breaker
+            self._adopt(merged)
             self.segments = [merged] if merged.n_docs else []
             self.merge_count += 1
+
+    def _adopt(self, seg: Segment) -> None:
+        """Stamp a segment with this shard's accounting hooks: the breaker
+        its device bytes/fielddata charge, the node fielddata cache its
+        sort columns live in, and the index name cache entries carry (so
+        `_cache/clear?index=` can target them)."""
+        seg.breaker = self.breaker
+        seg.fielddata_cache = self.fielddata_cache
+        seg.index_name = self.index_name
+
+    def _drop_fielddata(self, sources: list[Segment]) -> None:
+        """Loaded fielddata dies with its source segments: cache-managed
+        columns invalidate through the cache (its removal listener hands
+        bytes back to the breaker); legacy per-segment dicts release
+        directly."""
+        for s in sources:
+            if getattr(s, "fielddata_cache", None) is not None:
+                s.fielddata_cache.drop_segment(s)
+            elif self.breaker is not None:
+                self.breaker.release(sum(s.fielddata_bytes().values()))
 
     def _charge_merge(self, merged: Segment, sources: list[Segment]) -> None:
         """Swap breaker accounting from the source segments to the merged
         one (the merged set is usually smaller: tombstones purged). An
         all-tombstoned merge result is DROPPED by the callers, so it must
         not be charged — that leaked phantom bytes for the node lifetime."""
-        if self.breaker is None:
-            return
-        if merged.n_docs:
-            self.breaker.add_estimate(merged.memory_bytes(), check=False)
-        self.breaker.release(sum(s.memory_bytes() for s in sources))
-        # loaded fielddata dies with its source segments
-        self.breaker.release(sum(sum(s.fielddata_bytes().values())
-                                 for s in sources))
+        if self.breaker is not None:
+            if merged.n_docs:
+                self.breaker.add_estimate(merged.memory_bytes(), check=False)
+            self.breaker.release(sum(s.memory_bytes() for s in sources))
+        self._drop_fielddata(sources)
 
     def flush(self) -> None:
         """Commit: write NEW segment files + the checksummed commit point,
@@ -518,6 +540,5 @@ class Engine:
         if self.breaker is not None:
             self.breaker.release(sum(s.memory_bytes()
                                      for s in self.segments))
-            self.breaker.release(sum(sum(s.fielddata_bytes().values())
-                                     for s in self.segments))
+        self._drop_fielddata(self.segments)
         self.translog.close()

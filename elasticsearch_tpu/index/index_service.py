@@ -25,9 +25,10 @@ from .engine import Engine, EngineResult, GetResult
 
 class IndexService:
     def __init__(self, name: str, path: str, settings: Settings | None = None,
-                 mappings: dict | None = None, breakers=None):
+                 mappings: dict | None = None, breakers=None, caches=None):
         self.name = name
         self.path = path
+        self.caches = caches               # IndicesCacheService | None
         self.settings = settings if settings is not None else EMPTY_SETTINGS
         get = lambda k, d: self.settings.get(  # noqa: E731 — "index." optional
             f"index.{k}", self.settings.get(k, d))
@@ -51,7 +52,10 @@ class IndexService:
         from .similarity import SimilarityService
         self.mappers.similarity = SimilarityService(self.settings)
         self.shards: list[Engine] = [
-            Engine(os.path.join(path, str(s)), self.mappers, breaker=fd)
+            Engine(os.path.join(path, str(s)), self.mappers, breaker=fd,
+                   fielddata_cache=caches.fielddata
+                   if caches is not None else None,
+                   index_name=name)
             for s in range(self.n_shards)]
         self.creation_date = None
         # searcher cache: rebuilt per shard only when its segment set changes
@@ -82,8 +86,16 @@ class IndexService:
         self._incarnation = next(_INCARNATIONS)
         # fused serving view over all shards' segments (serving/packed_view):
         # rebuilt only when the segment set changes; tombstone-only changes
-        # refresh its liveness row in place
-        self._packed_cache: tuple[tuple, "object"] | None = None
+        # refresh its liveness row in place. A single-entry common.cache
+        # Cache so its bytes/evictions surface uniformly; the removal
+        # listener releases the "request" breaker charge on every exit
+        from ..common.cache import Cache
+        self._packed_view_cache = Cache(
+            "packed_view", max_entries=1,
+            weigher=lambda v: getattr(v[1], "memory_bytes", 0),
+            removal_listener=self._on_packed_removed)
+        if caches is not None:
+            caches.register(f"packed_view[{name}]", self._packed_view_cache)
 
     def reader_generation(self) -> tuple:
         """Changes whenever a refresh/merge/delete changes what a searcher
@@ -155,14 +167,18 @@ class IndexService:
         for e in self.shards:
             e.force_merge(max_num_segments)
 
+    def _on_packed_removed(self, _key, value, _reason) -> None:
+        """Packed-view cache removal: hand the view's duplicate-postings
+        bytes back to the `request` breaker (the view charged them at
+        build time)."""
+        _k, view = value
+        if self.breakers is not None and view is not None:
+            self.breakers.breaker("request").release(view.memory_bytes)
+
     def close(self) -> None:
         for e in self.shards:
             e.close()
-        if self.breakers is not None and self._packed_cache is not None \
-                and self._packed_cache[1] is not None:
-            self.breakers.breaker("request").release(
-                self._packed_cache[1].memory_bytes)
-            self._packed_cache = None
+        self._packed_view_cache.clear()
 
     def delete_files(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)
@@ -200,11 +216,12 @@ class IndexService:
         if not live:
             return None
         key = tuple(sorted(live))
-        if self._packed_cache is not None and self._packed_cache[0] == key:
-            return self._packed_cache[1]
+        cached = self._packed_view_cache.get("view")
+        if cached is not None and cached[0] == key:
+            return cached[1]
         req = self.breakers.breaker("request") \
             if self.breakers is not None else None
-        old = self._packed_cache[1] if self._packed_cache else None
+        old = cached[1] if cached is not None else None
         base = None
         entries = None
         if old is not None:
@@ -220,11 +237,13 @@ class IndexService:
         if entries is None:
             entries = [(si, seg) for si, e in enumerate(self.shards)
                        for seg in e.segments]
-        if req is not None and old is not None:
-            req.release(old.memory_bytes)
+        if old is not None:
+            # release the stale view's charge (removal listener) BEFORE
+            # building — the new view needs the breaker headroom
+            self._packed_view_cache.invalidate("view")
         view = PackedIndexView(entries, breaker=req, base=base)
-        self._packed_cache = (key, view)
-        return self._packed_cache[1]
+        self._packed_view_cache.put("view", (key, view))
+        return view
 
     # -- introspection -----------------------------------------------------
 
@@ -242,6 +261,7 @@ class IndexService:
                                            for e in self.shards)},
             "shards": {"total": self.n_shards * (1 + self.n_replicas),
                        "primaries": self.n_shards},
+            "packed_view_cache": self._packed_view_cache.stats(),
         }
 
     def mappings_dict(self) -> dict:

@@ -299,21 +299,35 @@ class Segment:
             return self._text_fielddata_locked(field)
 
     def _text_fielddata_locked(self, field: str):
+        if self.text.get(field) is None:
+            return None
+        fdc = getattr(self, "fielddata_cache", None)
+        if fdc is not None:
+            # node-level fielddata tier (indices/cache_service): LRU
+            # storage + breaker charge with eviction-under-pressure —
+            # admission happens inside get_or_build, before the build
+            return fdc.get_or_build(self, field,
+                                    lambda: self._build_fielddata(field))
         cache = getattr(self, "_fielddata", None)
         if cache is None:
             cache = self._fielddata = {}
         fd = cache.get(field)
         if fd is not None:
             return fd
-        fx = self.text.get(field)
-        if fx is None:
-            return None
         breaker = getattr(self, "breaker", None)
         if breaker is not None:
             # admission control BEFORE building: loading fielddata under
             # memory pressure 429s cleanly (ref fielddata breaker in
             # HierarchyCircuitBreakerService)
             breaker.add_estimate(self.n_pad * 17)
+        fd = self._build_fielddata(field)
+        cache[field] = fd
+        return fd
+
+    def _build_fielddata(self, field: str):
+        """Uninvert one text field into per-doc min/max term ordinals —
+        the expensive part both caching paths share."""
+        fx = self.text.get(field)
         V = len(fx.terms)
         lens = np.asarray(fx.term_lens[:V], np.int64)
         starts = np.asarray(fx.term_starts[:V], np.int64)
@@ -331,13 +345,14 @@ class Segment:
         mx = np.full(self.n_pad, -1, np.int64)
         np.maximum.at(mx, docs, tids)
         miss = mx < 0
-        fd = (mn, mx, miss, list(fx.terms),
-              mn.nbytes + mx.nbytes + miss.nbytes)
-        cache[field] = fd
-        return fd
+        return (mn, mx, miss, list(fx.terms),
+                mn.nbytes + mx.nbytes + miss.nbytes)
 
     def fielddata_bytes(self) -> dict[str, int]:
         """field -> loaded fielddata bytes (empty until a sort loads it)."""
+        fdc = getattr(self, "fielddata_cache", None)
+        if fdc is not None:
+            return fdc.bytes_for(self)
         return {f: fd[4]
                 for f, fd in getattr(self, "_fielddata", {}).items()}
 

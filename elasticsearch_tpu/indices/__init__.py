@@ -1,0 +1,4 @@
+"""Node-level indices services (ref org.elasticsearch.indices.*): the
+cross-index cache subsystem lives here."""
+
+from .cache_service import IndicesCacheService  # noqa: F401

@@ -1,0 +1,361 @@
+"""Node-level cache subsystem: request / query-plan / fielddata tiers.
+
+The reference engine's hot-path economics rest on three caches the TPU
+repro now has too, all instances of the one `common.cache.Cache` core:
+
+  * `IndicesRequestCache` (ref indices/cache/request/IndicesRequestCache
+    in ES 2.0): whole size-0 response bodies shared across indices, keyed
+    by (index expression, canonical body, per-index engine generation) so
+    any refresh/delete/merge invalidates naturally. Entries charge the
+    `request` circuit breaker — under memory pressure the cache evicts its
+    LRU tail and, at worst, refuses the insert; searches keep returning
+    uncached results instead of 5xx-ing. Budget:
+    `indices.requests.cache.size` (default 1% of the breaker-total "heap"),
+    optional TTL `indices.requests.cache.expire`.
+
+  * `QueryPlanCache` (the Lucene LRUQueryCache analog for this engine):
+    normalized DSL body -> parsed executable Node tree, keyed by (index,
+    incarnation, mapping version, canonical query JSON). Parsed trees are
+    stateless w.r.t. execution (all per-segment work flows through
+    SegmentContext), so repeated query templates skip host-side re-parse —
+    and because the tree's plan_key() feeds the jit compile cache, a
+    stable tree also means zero jit-key churn. Bodies containing date math
+    ("now"), stored-template references or indexed-shape lookups are never
+    cached (their parse output depends on wall clock / external state).
+
+  * `FielddataCache` (ref indices/fielddata/cache/IndicesFieldDataCache):
+    per-(segment, field) uninverted sort columns. Builds go through
+    `make_room` admission — under `fielddata` breaker pressure the cache
+    evicts least-recently-sorted columns (actually freeing their memory
+    and breaker charge) before giving up with a clean 429.
+
+One `stats()` walk feeds `_nodes/stats`, the `/_metrics` OpenMetrics
+scrape and the stats-history sampler; `clear()` is the real engine under
+`POST /_cache/clear?query=&request=&fielddata=`.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import threading
+import weakref
+from typing import Any
+
+from ..common.cache import Cache, RemovalReason, parse_size
+
+# tokens identify segments inside the fielddata cache without pinning the
+# segment objects themselves (id() reuse after gc would alias entries)
+_SEG_TOKENS = itertools.count(1)
+
+
+def response_weight(resp: dict) -> int:
+    """Bytes a cached response is accounted at: its JSON wire size (the
+    response IS a JSON document; `default=str` covers stray numpy
+    scalars)."""
+    try:
+        return len(json.dumps(resp, default=str).encode())
+    except (TypeError, ValueError):
+        return 1024
+
+
+class _RequestEntry:
+    __slots__ = ("resp", "names", "nbytes")
+
+    def __init__(self, resp: dict, names: tuple, nbytes: int):
+        self.resp = resp
+        self.names = names
+        self.nbytes = nbytes
+
+
+class IndicesRequestCache:
+    """Shared request cache with per-index byte/eviction attribution (the
+    `{index}/_stats` request_cache section needs per-index numbers out of
+    one node-wide cache; multi-index entries attribute to every index they
+    cover)."""
+
+    def __init__(self, max_bytes: int, ttl_s: float | None = None,
+                 breaker=None, clock=None):
+        self._lock = threading.Lock()
+        self._by_index: dict[str, dict] = {}
+        self.cache = Cache("request", max_bytes=max_bytes, ttl_s=ttl_s,
+                           weigher=lambda e: e.nbytes, clock=clock,
+                           removal_listener=self._on_removal,
+                           breaker=breaker)
+
+    def _slot(self, name: str) -> dict:
+        return self._by_index.setdefault(
+            name, {"bytes": 0, "count": 0, "evictions": 0})
+
+    def _on_removal(self, key, entry: _RequestEntry, reason: str) -> None:
+        with self._lock:
+            for n in entry.names:
+                s = self._slot(n)
+                s["bytes"] -= entry.nbytes
+                s["count"] -= 1
+                if reason in (RemovalReason.EVICTED, RemovalReason.EXPIRED):
+                    s["evictions"] += 1
+
+    def get(self, key) -> dict | None:
+        ent = self.cache.get(key)
+        if ent is None:
+            return None
+        return copy.deepcopy(ent.resp)
+
+    def put(self, key, names, resp: dict) -> bool:
+        entry = _RequestEntry(copy.deepcopy(resp), tuple(names),
+                              response_weight(resp))
+        ok = self.cache.put(key, entry)
+        if ok:
+            with self._lock:
+                for n in entry.names:
+                    s = self._slot(n)
+                    s["bytes"] += entry.nbytes
+                    s["count"] += 1
+        return ok
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(
+            lambda _k, e: bool(want & set(e.names)))
+
+    def index_stats(self, name: str) -> dict:
+        with self._lock:
+            s = self._by_index.get(name)
+            return {"bytes": max(s["bytes"], 0), "count": max(s["count"], 0),
+                    "evictions": s["evictions"]} if s \
+                else {"bytes": 0, "count": 0, "evictions": 0}
+
+    def stats(self) -> dict:
+        return self.cache.stats()
+
+
+class _FdEntry:
+    __slots__ = ("fd", "nbytes", "breaker", "index_name", "field", "token")
+
+    def __init__(self, fd, nbytes, breaker, index_name, field, token):
+        self.fd = fd
+        self.nbytes = nbytes
+        self.breaker = breaker
+        self.index_name = index_name
+        self.field = field
+        self.token = token
+
+
+class FielddataCache:
+    """Node-level fielddata tier: owns the built (segment, field) columns,
+    releases their breaker charge on any exit, and evicts LRU columns
+    under breaker pressure so a hot sort workload on a full device sheds
+    cold columns instead of 429-ing forever."""
+
+    def __init__(self, max_bytes: int = 0):
+        self._lock = threading.Lock()
+        self._by_seg: dict[int, set[str]] = {}
+        self._evictions_by_index: dict[str, int] = {}
+        self.cache = Cache("fielddata", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _FdEntry, reason: str) -> None:
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+        with self._lock:
+            fields = self._by_seg.get(entry.token)
+            if fields is not None:
+                fields.discard(entry.field)
+                if not fields:
+                    self._by_seg.pop(entry.token, None)
+            if reason == RemovalReason.EVICTED and entry.index_name:
+                self._evictions_by_index[entry.index_name] = \
+                    self._evictions_by_index.get(entry.index_name, 0) + 1
+
+    @staticmethod
+    def token_of(seg) -> int:
+        tok = getattr(seg, "_fd_token", None)
+        if tok is None:
+            tok = seg._fd_token = next(_SEG_TOKENS)
+        return tok
+
+    def get_or_build(self, seg, field: str, build):
+        """The segment's fielddata entry, building (and charging the
+        segment's breaker, with eviction-under-pressure) on first use.
+        Raises CircuitBreakingException only when evicting every other
+        column still can't fit the new one. `build()` returns the
+        (mn, mx, miss, vocab, nbytes) tuple segment sorts consume."""
+        token = self.token_of(seg)
+        key = (token, field)
+        ent = self.cache.get(key)
+        if ent is not None:
+            return ent.fd
+        breaker = getattr(seg, "breaker", None)
+        charge = seg.n_pad * 17        # mirrors the built column's nbytes
+        if breaker is not None:
+            self.cache.make_room(breaker, charge)
+        try:
+            fd = build()
+        except BaseException:
+            if breaker is not None:
+                breaker.release(charge)
+            raise
+        if fd is None:
+            if breaker is not None:
+                breaker.release(charge)
+            return None
+        nbytes = fd[4]
+        if breaker is not None and nbytes != charge:
+            # true up estimate drift without re-tripping
+            if nbytes > charge:
+                breaker.add_estimate(nbytes - charge, check=False)
+            else:
+                breaker.release(charge - nbytes)
+        entry = _FdEntry(fd, nbytes, breaker,
+                         getattr(seg, "index_name", None), field, token)
+        if self.cache.put(key, entry):
+            with self._lock:
+                self._by_seg.setdefault(token, set()).add(field)
+        elif breaker is not None:
+            breaker.release(nbytes)   # refused by budget: nothing retained
+        return fd
+
+    def bytes_for(self, seg) -> dict[str, int]:
+        """field -> bytes loaded for this segment (the `_cat/fielddata` /
+        `_stats` fielddata walk)."""
+        token = getattr(seg, "_fd_token", None)
+        if token is None:
+            return {}
+        with self._lock:
+            fields = list(self._by_seg.get(token, ()))
+        out = {}
+        for f in fields:
+            ent = self.cache.peek((token, f))
+            if ent is not None:
+                out[f] = ent.nbytes
+        return out
+
+    def drop_segment(self, seg) -> int:
+        """Invalidate every column of a dead segment (merge/close path) —
+        the removal listener releases the breaker charge."""
+        token = getattr(seg, "_fd_token", None)
+        if token is None:
+            return 0
+        return self.cache.invalidate_where(lambda k, _e: k[0] == token)
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(
+            lambda _k, e: e.index_name in want)
+
+    def evictions_of(self, name: str) -> int:
+        with self._lock:
+            return self._evictions_by_index.get(name, 0)
+
+    def stats(self) -> dict:
+        return self.cache.stats()
+
+
+class IndicesCacheService:
+    """The node's cache roster. One `stats()`/`clear()` surface over the
+    three tiers; per-index packed-view caches register here so their
+    bytes join the same walk."""
+
+    def __init__(self, settings=None, breakers=None, clock=None):
+        get = settings.get if settings is not None else lambda k, d=None: d
+        total = breakers.total_limit if breakers is not None \
+            and breakers.total_limit > 0 else 6 << 30
+        req_bytes = parse_size(get("indices.requests.cache.size", "1%"),
+                               total, default=total // 100)
+        ttl_raw = get("indices.requests.cache.expire")
+        ttl_s = None
+        if ttl_raw not in (None, ""):
+            from ..mapping.mapper import parse_ttl_ms
+            try:
+                ttl_s = parse_ttl_ms(ttl_raw) / 1000.0
+            except Exception:  # noqa: BLE001 — bad setting != no cache
+                ttl_s = None
+        self.request_cache = IndicesRequestCache(
+            max_bytes=req_bytes, ttl_s=ttl_s,
+            breaker=breakers.breaker("request")
+            if breakers is not None else None,
+            clock=clock)
+        try:
+            plan_entries = int(get("indices.queries.cache.count", 1024))
+        except (TypeError, ValueError):
+            plan_entries = 1024
+        self.query_plan = Cache(
+            "query_plan", max_entries=plan_entries,
+            max_bytes=parse_size(get("indices.queries.cache.size", "1%"),
+                                 total, default=total // 100),
+            clock=clock)
+        self.fielddata = FielddataCache(
+            max_bytes=parse_size(get("indices.fielddata.cache.size", 0),
+                                 total, default=0))
+        # per-index packed-view caches (serving views) register here so
+        # their byte totals surface without the service owning them
+        self._registered: "weakref.WeakValueDictionary[str, Cache]" = \
+            weakref.WeakValueDictionary()
+
+    # -- query-plan tier ---------------------------------------------------
+
+    _UNCACHEABLE_MARKERS = ('"now', '"template"', '"indexed_shape"',
+                            '"script"')
+
+    def plan_key(self, index: str, incarnation: int, mapping_version: int,
+                 query) -> tuple | None:
+        """Cache key for a parsed query, or None when the body must not be
+        cached (unserializable, date math, external-state lookups)."""
+        try:
+            qj = json.dumps(query, sort_keys=True)
+        except (TypeError, ValueError):
+            return None
+        if any(m in qj for m in self._UNCACHEABLE_MARKERS):
+            return None
+        return (index, incarnation, mapping_version, qj)
+
+    def get_plan(self, key):
+        return self.query_plan.get(key) if key is not None else None
+
+    def put_plan(self, key, node) -> None:
+        if key is not None:
+            # weight: canonical-JSON size × a small tree-overhead factor —
+            # exactness doesn't matter for a host-side tree, bounding does
+            self.query_plan.put(key, node, weight=len(key[3]) * 4 + 256)
+
+    # -- roster ------------------------------------------------------------
+
+    def register(self, name: str, cache: Cache) -> None:
+        self._registered[name] = cache
+
+    def clear(self, *, query: bool = False, request: bool = False,
+              fielddata: bool = False,
+              indices: list[str] | None = None) -> dict:
+        out = {}
+        if request:
+            out["request"] = self.request_cache.clear(indices)
+        if query:
+            if indices is None:
+                out["query"] = self.query_plan.clear()
+            else:
+                want = set(indices)
+                out["query"] = self.query_plan.invalidate_where(
+                    lambda k, _v: k[0] in want)
+        if fielddata:
+            out["fielddata"] = self.fielddata.clear(indices)
+        return out
+
+    def stats(self) -> dict:
+        out = {"request": self.request_cache.stats(),
+               "query_plan": self.query_plan.stats(),
+               "fielddata": self.fielddata.stats()}
+        for name, cache in list(self._registered.items()):
+            out[name] = cache.stats()
+        return out
+
+    def close(self) -> None:
+        self.request_cache.cache.clear()
+        self.query_plan.clear()
+        self.fielddata.cache.clear()

@@ -11,7 +11,6 @@ winners only, aggregation tree reduce).
 
 from __future__ import annotations
 
-import copy
 import fnmatch
 import logging
 import os
@@ -110,6 +109,12 @@ class NodeService:
         self.cluster_name = cluster_name
         from .common.breaker import CircuitBreakerService
         self.breakers = CircuitBreakerService(self.settings)
+        # node-level cache subsystem (indices/cache_service.py): request
+        # responses, parsed query plans, fielddata columns — byte-accounted
+        # LRU tiers behind one core (ref IndicesRequestCache +
+        # LRUQueryCache + IndicesFieldDataCache)
+        from .indices import IndicesCacheService
+        self.caches = IndicesCacheService(self.settings, self.breakers)
         self.indices: dict[str, IndexService] = {}
         self.closed: dict[str, dict] = {}     # closed index -> metadata
         self.templates: dict[str, dict] = {}
@@ -167,9 +172,6 @@ class NodeService:
         self.watcher = ResourceWatcherService()
         from .serving.batcher import SearchBatcher
         self._batcher = SearchBatcher(self)
-        # shard request cache: size-0 responses keyed by (body, reader
-        # generation); bounded FIFO (ref IndicesRequestCache)
-        self._request_cache: dict = {}
         tpl_path = os.path.join(data_path, "_templates.json")
         if os.path.exists(tpl_path):
             import json
@@ -227,7 +229,7 @@ class NodeService:
             self.indices[name] = IndexService(
                 name, os.path.join(self.data_path, name),
                 Settings(meta.get("settings", {})), meta.get("mappings", {}),
-                breakers=self.breakers)
+                breakers=self.breakers, caches=self.caches)
             self.indices[name].aliases = alias_dict(meta.get("aliases", []))
 
     def _persist_index_meta(self, svc: IndexService) -> None:
@@ -264,7 +266,7 @@ class NodeService:
                     merged_aliases.setdefault(a, props)
         svc = IndexService(name, os.path.join(self.data_path, name),
                            Settings(merged_settings), merged_mappings,
-                           breakers=self.breakers)
+                           breakers=self.breakers, caches=self.caches)
         errs = getattr(svc.mappers.analysis, "build_errors", None)
         if errs:
             # strict at CREATE time (the user can fix the request); node
@@ -327,7 +329,7 @@ class NodeService:
             svc = IndexService(n, os.path.join(self.data_path, n),
                                Settings(meta.get("settings", {})),
                                meta.get("mappings", {}),
-                               breakers=self.breakers)
+                               breakers=self.breakers, caches=self.caches)
             svc.aliases = alias_dict(meta.get("aliases", []))
             svc.mappers.search_templates = self.search_templates
             self.indices[n] = svc
@@ -570,6 +572,24 @@ class NodeService:
         self.phase_timers.record(phase, ms)
         self.metrics.record(f"search.{phase}", ms)
 
+    def _parse_cached(self, name: str, query):
+        """Parse a query through the node-level query-plan cache
+        (indices/cache_service): repeated query templates skip host-side
+        re-parse, and a stable tree keeps the jit compile-cache keys
+        stable too. Parsed trees are execution-stateless (every
+        per-segment computation flows through SegmentContext), so sharing
+        one tree across requests is safe; bodies the cache refuses (date
+        math, templates, ...) parse fresh."""
+        svc = self.indices[name]
+        from .search.query_parser import QueryParser
+        key = self.caches.plan_key(name, svc._incarnation,
+                                   svc.mappers.mapping_version(), query)
+        node = self.caches.get_plan(key)
+        if node is None:
+            node = QueryParser(svc.mappers).parse(query)
+            self.caches.put_plan(key, node)
+        return node
+
     def search(self, index: str, body: dict | None = None,
                size: int | None = None, from_: int | None = None,
                scroll: str | None = None, scan: bool = False,
@@ -627,12 +647,18 @@ class NodeService:
                 svc = self.indices[n]
                 svc.search_groups[tag] = svc.search_groups.get(tag, 0) + 1
 
-        # shard request cache (ref IndicesRequestCache): size-0 bodies are
-        # cacheable by default, keyed on body + reader generation; any
-        # refresh/delete/merge rotates the generation = auto-invalidation
+        # shard request cache (indices/cache_service.IndicesRequestCache):
+        # size-0 bodies are cacheable by default, keyed on body + reader
+        # generation; any refresh/delete/merge rotates the generation =
+        # auto-invalidation. `index.requests.cache.enable: false` opts an
+        # index out; an explicit `?request_cache=true` overrides it per
+        # request (the reference's per-request override contract).
         cacheable = (request_cache is not False and size == 0
                      and from_ == 0
                      and (request_cache or "script_fields" not in body))
+        if cacheable and request_cache is None:
+            cacheable = all(_req_cache_enabled(self.indices[n].settings)
+                            for n in names)
         cache_key = None
         if cacheable:
             import json as _json
@@ -653,11 +679,11 @@ class NodeService:
             except TypeError:
                 cache_key = None
             if cache_key is not None:
-                hit = self._request_cache.get(cache_key)
+                hit = self.caches.request_cache.get(cache_key)
                 if hit is not None:
                     for n in names:
                         self.indices[n].request_cache_hits += 1
-                    return copy.deepcopy(hit)
+                    return hit
                 for n in names:
                     self.indices[n].request_cache_misses += 1
 
@@ -770,10 +796,10 @@ class NodeService:
             from .search.query_dsl import CollectionStats
             terms_by_field: dict[str, set] = {}
             for n in names:
-                from .search.query_parser import QueryParser, merge_query_batch
+                from .search.query_parser import merge_query_batch
                 q_n = self._wrap_alias_query(query, alias_flt[n]) \
                     if n in alias_flt else query
-                parsed = QueryParser(self.indices[n].mappers).parse(q_n)
+                parsed = self._parse_cached(n, q_n)
                 parsed.collect_terms(terms_by_field)
                 nodes_by_index[n] = merge_query_batch([parsed])
             all_segs = [seg for s in searchers for seg in s.segments]
@@ -915,13 +941,10 @@ class NodeService:
                                    (now - t0) * 1000, body,
                                    trace_id=tid, opaque_id=oid)
         if cache_key is not None:
-            if len(self._request_cache) >= 256:   # bounded FIFO eviction
-                try:        # threaded server: a racing evictor is fine
-                    self._request_cache.pop(
-                        next(iter(self._request_cache)), None)
-                except (StopIteration, RuntimeError):
-                    pass
-            self._request_cache[cache_key] = copy.deepcopy(resp)
+            # byte-accounted LRU insert charging the `request` breaker; a
+            # refused insert (budget/breaker pressure) just means this
+            # response goes out uncached — never a 5xx
+            self.caches.request_cache.put(cache_key, names, resp)
         return resp
 
     def _alias_filters_by_index(self, expr: str,
@@ -1481,9 +1504,8 @@ class NodeService:
             names = self._resolve(index)
             if not names:
                 return None
-            from .search.query_parser import QueryParser
-            parser = QueryParser(self.indices[names[0]].mappers)
-            node = parser.parse(body.get("query") or {"match_all": {}})
+            node = self._parse_cached(
+                names[0], body.get("query") or {"match_all": {}})
             rescore_key = None
             rescore = body.get("rescore")
             if rescore is not None:
@@ -1496,7 +1518,7 @@ class NodeService:
                 rq = rs.get("rescore_query")
                 if rq is None or body.get("sort") is not None:
                     return None
-                rescore_key = (parser.parse(rq).plan_key(),
+                rescore_key = (self._parse_cached(names[0], rq).plan_key(),
                                int(rescore.get("window_size", 0)),
                                rs.get("score_mode", "total"),
                                float(rs.get("query_weight", 1.0)),
@@ -1547,10 +1569,9 @@ class NodeService:
         nodes_by_index = {}
         terms_by_field: dict[str, set] = {}
         for n in names:
-            from .search.query_parser import QueryParser, merge_query_batch
-            parser = QueryParser(self.indices[n].mappers)
+            from .search.query_parser import merge_query_batch
             nodes_by_index[n] = merge_query_batch(
-                [parser.parse(q) for q in queries])
+                [self._parse_cached(n, q) for q in queries])
             nodes_by_index[n].collect_terms(terms_by_field)
         global_stats = CollectionStats.from_segments(
             [seg for s in searchers for seg in s.segments], terms_by_field)
@@ -2156,6 +2177,7 @@ class NodeService:
     def stats(self) -> dict:
         return {"indices": {n: s.stats() for n, s in self.indices.items()},
                 "breakers": self.breakers.stats(),
+                "caches": self.caches.stats(),
                 "search_batcher": self._batcher.stats()}
 
     # -- telemetry (the /_metrics exposition + stats-history sampler) ------
@@ -2173,6 +2195,7 @@ class NodeService:
         per_index = {}
         for n, svc in self.indices.items():
             seg = [e.segment_stats() for e in svc.shards]
+            rc = self.caches.request_cache.index_stats(n)
             per_index[n] = {
                 "docs": svc.doc_count(),
                 "store_size_in_bytes": sum(s["memory_in_bytes"]
@@ -2183,6 +2206,8 @@ class NodeService:
                 "delete_total": svc.indexing_stats["delete_total"],
                 "request_cache_hits_total": svc.request_cache_hits,
                 "request_cache_misses_total": svc.request_cache_misses,
+                "request_cache_memory_in_bytes": rc["bytes"],
+                "request_cache_evictions_total": rc["evictions"],
                 "search_rate_1m": svc.meters["search"].rate(60),
                 "indexing_rate_1m": svc.meters["indexing"].rate(60),
             }
@@ -2200,6 +2225,9 @@ class NodeService:
                                 {str(k): {"count": v}
                                  for k, v in occupancy.items()}),
             "index": ("index", per_index),
+            # the cache subsystem: one sample set per tier (request /
+            # query_plan / fielddata / registered extras), uniform leaves
+            "cache": ("cache", self.caches.stats()),
             "jit": (None, {"compiles": compiles,
                            "compile_time_in_millis": round(compile_ms, 3)}),
             "transfer": (None, transfer_snapshot()),
@@ -2244,6 +2272,11 @@ class NodeService:
             "docs": sum(s.doc_count() for s in self.indices.values()),
             "tasks_running": self.tasks.stats()["running"],
             "jit_compiles_total": device_events_snapshot()[0],
+            "request_cache_memory_bytes":
+                self.caches.request_cache.cache.memory_bytes,
+            "request_cache_hits_total": self.caches.request_cache.cache.hits,
+            "fielddata_cache_memory_bytes":
+                self.caches.fielddata.cache.memory_bytes,
         }
         for name, b in br.items():
             out[f"breaker_{name}_used_bytes"] = b["estimated_size_in_bytes"]
@@ -2261,6 +2294,7 @@ class NodeService:
             self._ttl_stop.set()
         for svc in self.indices.values():
             svc.close()
+        self.caches.close()     # releases request-breaker charges
         self.thread_pool.shutdown()
         try:
             import fcntl
@@ -2294,6 +2328,17 @@ def _contains_mlt(q) -> bool:
     if isinstance(q, list):
         return any(_contains_mlt(x) for x in q)
     return False
+
+
+def _req_cache_enabled(settings) -> bool:
+    """`index.requests.cache.enable` (default true) — the per-index
+    request-cache opt-out (ref IndicesRequestCache INDEX_CACHE_REQUEST_
+    ENABLED setting)."""
+    v = settings.get("index.requests.cache.enable",
+                     settings.get("requests.cache.enable", True))
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off")
+    return bool(v)
 
 
 def _duration_secs(s: str) -> float:

@@ -1101,13 +1101,34 @@ def _register_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/{index}/_search_shards", search_shards)
     c.register("POST", "/{index}/_search_shards", search_shards)
 
-    # -- cache clear (ref indices/cache/clear) -----------------------------
+    # -- cache clear (ref indices/cache/clear/TransportClearIndicesCache-
+    #    Action): real invalidation against the node cache subsystem.
+    #    ?query= / ?request= / ?fielddata= select tiers (aliases the
+    #    reference accepted — filter/filter_cache/query_cache/request_cache
+    #    — map onto the same three); no flag at all clears everything. ----
     def clear_cache(g, p, b):
         names = node._resolve(g.get("index", "_all"))
+
+        def flag(*keys):
+            for k in keys:
+                v = p.get(k, [None])[0]
+                if v is not None:
+                    # bare `?request` (no value) means true, like the ref
+                    return str(v).strip().lower() not in ("false", "0", "no")
+            return None
+        q = flag("query", "query_cache", "filter", "filter_cache")
+        r = flag("request", "request_cache")
+        f = flag("fielddata", "field_data")
+        if q is None and r is None and f is None:
+            q = r = f = True
+        cleared = node.caches.clear(
+            query=bool(q), request=bool(r), fielddata=bool(f),
+            indices=None if g.get("index") in (None, "", "_all", "*")
+            else names)
         return 200, {"_shards": {
             "total": sum(len(node.indices[n].shards) for n in names),
             "successful": sum(len(node.indices[n].shards) for n in names),
-            "failed": 0}}
+            "failed": 0}, "cleared": cleared}
     for pat in ("/_cache/clear", "/{index}/_cache/clear"):
         c.register("POST", pat, clear_cache)
         c.register("GET", pat, clear_cache)
@@ -1931,6 +1952,8 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             size = sum(e.segment_stats()["memory_in_bytes"]
                        for e in svc.shards)
             deleted = sum(e.segment_stats()["deleted"] for e in svc.shards)
+            rc = node.caches.request_cache.index_stats(n)
+            rc_ops = svc.request_cache_hits + svc.request_cache_misses
             rows.append({
                 "health": "green" if svc.n_replicas == 0 else "yellow",
                 "status": "open", "index": n, "pri": svc.n_shards,
@@ -1940,13 +1963,18 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 "pri.store.size": _cat.human_bytes(size),
                 "search.rate": f"{svc.meters['search'].rate(60):.2f}",
                 "indexing.rate":
-                    f"{svc.meters['indexing'].rate(60):.2f}"})
+                    f"{svc.meters['indexing'].rate(60):.2f}",
+                "request_cache.memory": _cat.human_bytes(rc["bytes"]),
+                "request_cache.hit_ratio":
+                    f"{svc.request_cache_hits / rc_ops:.2f}"
+                    if rc_ops else ""})
         for n in sorted(node.closed):
             rows.append({"health": "green", "status": "close", "index": n,
                          "pri": "", "rep": "", "docs.count": "",
                          "docs.deleted": "", "store.size": "",
                          "pri.store.size": "", "search.rate": "",
-                         "indexing.rate": ""})
+                         "indexing.rate": "", "request_cache.memory": "",
+                         "request_cache.hit_ratio": ""})
         return 200, _cat.render(p, [
             ("health", "current health status"), ("status", "open/close"),
             ("index", "index name"), ("pri", "number of primary shards"),
@@ -1956,8 +1984,13 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             ("store.size", "store size of primaries & replicas"),
             ("pri.store.size", "store size of primaries"),
             ("search.rate", "1m EWMA searches per second"),
-            ("indexing.rate", "1m EWMA indexing ops per second")], rows,
-            aliases={"sr": "search.rate", "ir": "indexing.rate"})
+            ("indexing.rate", "1m EWMA indexing ops per second"),
+            ("request_cache.memory", "request cache bytes for this index"),
+            ("request_cache.hit_ratio",
+             "request cache hits / lookups")], rows,
+            aliases={"sr": "search.rate", "ir": "indexing.rate",
+                     "rcm": "request_cache.memory",
+                     "rchr": "request_cache.hit_ratio"})
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/indices/{index}", cat_indices)
 
@@ -2280,7 +2313,7 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
         "docs", "store", "indexing", "get", "search", "merge", "refresh",
         "flush", "warmer", "filter_cache", "id_cache", "fielddata",
         "percolate", "completion", "segments", "translog", "suggest",
-        "recovery", "query_cache",
+        "recovery", "query_cache", "request_cache",
     }
 
     def _csv_param(p, name):
@@ -2385,20 +2418,45 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             if "warmer" in want:
                 out["warmer"] = {"current": 0, "total": 0,
                                  "total_time_in_millis": 0}
+            rc = node.caches.request_cache.index_stats(svc.name)
             if "filter_cache" in want:
-                out["filter_cache"] = {"memory_size_in_bytes": 0,
-                                       "evictions": 0}
+                # the query-plan cache is this engine's filter/query-cache
+                # analog (compiled executables, not doc-id bitsets); its
+                # per-index share keyed by the plan key's index component
+                plan_bytes = plan_entries = 0
+                for k, _v, w in node.caches.query_plan.entries_snapshot():
+                    if k[0] == svc.name:
+                        plan_bytes += w
+                        plan_entries += 1
+                out["filter_cache"] = {"memory_size_in_bytes": plan_bytes,
+                                       "entries": plan_entries,
+                                       "evictions":
+                                           node.caches.query_plan.evictions}
             if "query_cache" in want:
+                # wire-format parity: ES 2.0 clients read the request
+                # cache's numbers under this section name too
                 out["query_cache"] = {
-                    "memory_size_in_bytes": 0,
+                    "memory_size_in_bytes": rc["bytes"],
                     "hit_count": svc.request_cache_hits,
                     "miss_count": svc.request_cache_misses,
-                    "evictions": 0}
+                    "evictions": rc["evictions"]}
+            if "request_cache" in want:
+                out["request_cache"] = {
+                    "memory_size_in_bytes": rc["bytes"],
+                    "entries": rc["count"],
+                    "hit_count": svc.request_cache_hits,
+                    "miss_count": svc.request_cache_misses,
+                    "evictions": rc["evictions"]}
             if "id_cache" in want:
-                out["id_cache"] = {"memory_size_in_bytes": 0}
+                # parent/child id maps ride the fielddata tier here: the
+                # live bytes of _parent/_uid columns, usually 0
+                out["id_cache"] = {"memory_size_in_bytes": sum(
+                    nb for f, nb in fd_fields.items()
+                    if f.startswith(("_parent", "_uid")))}
             if "fielddata" in want:
                 fd = {"memory_size_in_bytes": sum(fd_fields.values()),
-                      "evictions": 0}
+                      "evictions":
+                          node.caches.fielddata.evictions_of(svc.name)}
                 if fd_sel:
                     fd["fields"] = {
                         f: {"memory_size_in_bytes": nb}
@@ -2528,6 +2586,7 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                            "tasks": node.tasks.stats(),
                            "slowlog_tail": node.slowlog.snapshot(),
                            "search_batcher": node._batcher.stats(),
+                           "caches": node.caches.stats(),
                            "rates": {name: m.stats()
                                      for name, m in node.meters.items()}}}}
     c.register("GET", "/_nodes/stats", nodes_stats)

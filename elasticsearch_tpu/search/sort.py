@@ -193,20 +193,23 @@ _GEO_CACHE_MAX = 4    # per segment: per-request origins must not pile up
 
 def _geo_distance_np(seg, sp: SortSpec):
     """Bounded cached host mirror of _geo_distance_m — materialization
-    touches k hits, not one device round-trip per hit. The cache holds at
-    most _GEO_CACHE_MAX origins (FIFO): a different-origin-per-request
-    workload would otherwise grow n_pad*9 bytes per origin, unaccounted."""
+    touches k hits, not one device round-trip per hit. A per-segment
+    common.cache.Cache holds at most _GEO_CACHE_MAX origins (LRU, byte-
+    weighed): a different-origin-per-request workload would otherwise grow
+    n_pad*9 bytes per origin, unbounded and unobservable."""
+    from ..common.cache import Cache
     cache = getattr(seg, "_geo_dist_cache", None)
     if cache is None:
-        cache = {}
+        cache = Cache("geo_distance", max_entries=_GEO_CACHE_MAX,
+                      weigher=lambda v: v[0].nbytes + v[1].nbytes)
         seg._geo_dist_cache = cache
     key = (sp.geo_field, sp.geo_lat, sp.geo_lon)
-    if key not in cache:
-        if len(cache) >= _GEO_CACHE_MAX:
-            cache.pop(next(iter(cache)))
+    hit = cache.get(key)
+    if hit is None:
         dist, miss = _geo_distance_m(seg, sp)
-        cache[key] = (np.asarray(dist), np.asarray(miss))
-    return cache[key]
+        hit = (np.asarray(dist), np.asarray(miss))
+        cache.put(key, hit)
+    return hit
 
 
 def segment_keys(seg, specs: Sequence[SortSpec], scores, Q: int,

@@ -253,14 +253,18 @@ def _completion_entries(segments, field: str) -> list[tuple]:
     for seg in segments:
         cache = getattr(seg, "_completion_cache", None)
         if cache is None:
-            cache = seg._completion_cache = {}
+            # per-segment entry memo: one entry per completion field,
+            # bounded + observable like every other cache (ISSUE 3 lint)
+            from ..common.cache import Cache
+            cache = seg._completion_cache = Cache(
+                "completion_entries", max_entries=8)
         ents = cache.get(field)
         if ents is None:
             ents = []
             for value, df in _field_vocab([seg], field).items():
                 ckey, _, inp = value.rpartition("\x1f")
                 ents.append((ckey, inp.lower(), inp, df))
-            cache[field] = ents
+            cache.put(field, ents)
         for ckey, lower, inp, df in ents:
             k = (ckey, lower, inp)
             merged[k] = merged.get(k, 0) + df

@@ -281,7 +281,8 @@ class SnapshotsService:
             svc = IndexService(dest, dest_path,
                                Settings(imeta["settings"]),
                                imeta["mappings"],
-                               breakers=getattr(self.node, "breakers", None))
+                               breakers=getattr(self.node, "breakers", None),
+                               caches=getattr(self.node, "caches", None))
             from ..node import alias_dict
             svc.aliases = alias_dict(imeta.get("aliases", []))
             self.node.indices[dest] = svc

@@ -1,0 +1,51 @@
+"""Static tripwire: no new ad-hoc dict-as-cache attributes.
+
+ISSUE 3 replaced the scatter of unbounded `dict`-shaped caches
+(`_request_cache`, `_geo_dist_cache`, `_packed_cache`, ...) with
+`common.cache.Cache` — byte-accounted, evicting, observable. This lint
+(the `test_no_retrace.py` pattern: grep the source, fail on drift) keeps
+it that way: assigning a bare `{}` / `dict(...)` / `OrderedDict(...)` to
+any name ending in `_cache` anywhere under `elasticsearch_tpu/` fails
+unless the (file, name) pair is explicitly allowlisted below with a
+reason. New caches must be `Cache` instances — bounded and observable —
+or argue their way onto the allowlist in review."""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "elasticsearch_tpu")
+
+# (relative path, attribute/variable name) -> why a plain dict is OK here
+ALLOWLIST = {
+    # keyed by the live segment-set tuple, bounded by shard count, holds
+    # no payload beyond the ShardSearcher the engine owns anyway
+    ("index/index_service.py", "_searcher_cache"),
+}
+
+# an assignment like `self._foo_cache = {}` / `x_cache: dict = dict()` /
+# `bar_cache = OrderedDict()`
+_DICT_CACHE_RX = re.compile(
+    r"(?:self\.)?(\w*_cache)\s*(?::\s*[^=]+)?=\s*"
+    r"(?:\{\}|dict\(|collections\.OrderedDict\(|OrderedDict\()")
+
+
+def test_no_adhoc_dict_caches():
+    offenders = []
+    for root, _dirs, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, PKG)
+            if rel == os.path.join("common", "cache.py"):
+                continue        # the one place a raw store is the point
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    m = _DICT_CACHE_RX.search(line)
+                    if m and (rel, m.group(1)) not in ALLOWLIST:
+                        offenders.append(f"{rel}:{lineno} [{m.group(1)}]")
+    assert not offenders, (
+        "ad-hoc dict-as-cache attributes found — use common.cache.Cache "
+        "(bounded, byte-accounted, observable) or allowlist with a "
+        "reason:\n  " + "\n  ".join(offenders))

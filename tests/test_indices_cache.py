@@ -1,0 +1,408 @@
+"""Indices cache subsystem (ISSUE 3): the generic byte-accounted LRU core
+plus the request / query-plan / fielddata tiers wired end to end —
+invalidation on refresh/delete, exact LRU eviction stats under a tiny byte
+budget, breaker-trip-returns-uncached (never 5xx), concurrent get/put
+races, and `_cache/clear` per-type filters over HTTP."""
+
+import json
+import threading
+
+import pytest
+
+from elasticsearch_tpu.common.breaker import (CircuitBreakerService,
+                                              CircuitBreakingException)
+from elasticsearch_tpu.common.cache import Cache, RemovalReason, parse_size
+from elasticsearch_tpu.node import NodeService
+
+
+# ---------------------------------------------------------------------------
+# common.cache.Cache unit coverage
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_under_byte_budget_exact_stats():
+    c = Cache("t", max_bytes=10, weigher=len)
+    assert c.put("a", "xxxx")           # 4 bytes
+    assert c.put("b", "xxxx")           # 8 bytes
+    assert c.get("a") == "xxxx"         # promotes a over b
+    assert c.put("c", "xxxx")           # 12 > 10 -> evicts LRU (b)
+    assert c.get("b") is None
+    assert c.get("a") == "xxxx"
+    assert c.get("c") == "xxxx"
+    st = c.stats()
+    assert st["memory_size_in_bytes"] == 8
+    assert st["entries"] == 2
+    assert st["evictions_total"] == 1
+    assert st["hits_total"] == 3        # a, a, c
+    assert st["misses_total"] == 1      # b
+    # a single entry bigger than the whole budget is refused, not stored
+    assert not c.put("big", "x" * 11)
+    assert c.stats()["overflows_total"] == 1
+    assert len(c) == 2
+
+
+def test_max_entries_lru_order():
+    c = Cache("t", max_entries=2)
+    c.put(1, "a")
+    c.put(2, "b")
+    c.get(1)
+    c.put(3, "c")                       # evicts 2 (LRU), not 1
+    assert c.get(2) is None
+    assert c.get(1) == "a"
+    assert c.get(3) == "c"
+
+
+def test_ttl_expiry_with_injected_clock():
+    now = [0.0]
+    c = Cache("t", ttl_s=10.0, clock=lambda: now[0])
+    c.put("k", "v")
+    assert c.get("k") == "v"
+    now[0] = 9.9
+    assert c.get("k") == "v"
+    now[0] = 10.1
+    assert c.get("k") is None           # expired reads as a miss
+    st = c.stats()
+    assert st["expirations_total"] == 1
+    assert st["entries"] == 0
+    assert st["memory_size_in_bytes"] == 0
+
+
+def test_removal_listener_reasons():
+    seen = []
+    c = Cache("t", max_entries=1,
+              removal_listener=lambda k, v, r: seen.append((k, r)))
+    c.put("a", 1)
+    c.put("a", 2)                       # replace
+    c.put("b", 3)                       # evicts a
+    c.invalidate("b")
+    c.put("c", 4)
+    c.clear()
+    assert seen == [("a", RemovalReason.REPLACED),
+                    ("a", RemovalReason.EVICTED),
+                    ("b", RemovalReason.INVALIDATED),
+                    ("c", RemovalReason.CLEARED)]
+
+
+def test_breaker_backed_cache_evicts_then_refuses():
+    brs = CircuitBreakerService()
+    br = brs.breaker("request")
+    br.limit = 100
+    c = Cache("t", weigher=len, breaker=br)
+    assert c.put("a", "x" * 60)
+    assert br.used == 60
+    # would exceed: evicts `a` to make room instead of raising
+    assert c.put("b", "x" * 80)
+    assert br.used == 80
+    assert c.get("a") is None
+    assert c.stats()["evictions_total"] == 1
+    # larger than the whole breaker: refused AFTER shedding everything
+    assert not c.put("c", "x" * 150)
+    assert br.used == 0 and len(c) == 0
+    assert c.stats()["overflows_total"] == 1
+    # a clean raise path stays available for admission-control callers
+    with pytest.raises(CircuitBreakingException):
+        c.make_room(br, 150)
+
+
+def test_concurrent_get_put_invalidate_race():
+    c = Cache("t", max_bytes=4096, weigher=len)
+    errs = []
+
+    def worker(wid):
+        try:
+            for i in range(300):
+                k = (wid, i % 7)
+                c.put(k, "v" * (i % 40 + 1))
+                c.get((wid, (i + 3) % 7))
+                if i % 11 == 0:
+                    c.invalidate(k)
+                if i % 97 == 0:
+                    c.clear()
+        except Exception as e:  # noqa: BLE001 — the assertion below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    # accounting stays exact after the storm: bytes == sum of live weights
+    live = sum(w for _k, _v, w in c.entries_snapshot())
+    assert c.memory_bytes == live
+    c.clear()
+    assert c.memory_bytes == 0 and len(c) == 0
+
+
+def test_parse_size_forms():
+    assert parse_size("1%", 1000) == 10
+    assert parse_size("64mb", 0) == 64 << 20
+    assert parse_size("2kb", 0) == 2048
+    assert parse_size(123, 0) == 123
+    assert parse_size("junk", 0, default=7) == 7
+
+
+# ---------------------------------------------------------------------------
+# node integration: request cache round trips
+# ---------------------------------------------------------------------------
+
+AGG_BODY = {"size": 0, "query": {"term": {"tag": "a"}},
+            "aggs": {"vals": {"stats": {"field": "v"}}}}
+
+
+def _fresh(body):
+    return json.loads(json.dumps(body))
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(str(tmp_path / "node"))
+    n.create_index("c", mappings={"_doc": {"properties": {
+        "tag": {"type": "string", "index": "not_analyzed"},
+        "txt": {"type": "string"},
+        "v": {"type": "long"}}}})
+    for i in range(12):
+        n.index_doc("c", str(i), {"tag": "a" if i % 2 else "b",
+                                  "txt": f"word{i:02d} filler", "v": i})
+    n.refresh("c")
+    yield n
+    n.close()
+
+
+def test_request_cache_hit_and_memory_and_clear(node):
+    svc = node.indices["c"]
+    r1 = node.search("c", _fresh(AGG_BODY))
+    r2 = node.search("c", _fresh(AGG_BODY))
+    assert r1 == r2
+    assert svc.request_cache_hits >= 1
+    idx = node.caches.request_cache.index_stats("c")
+    assert idx["bytes"] > 0 and idx["count"] >= 1
+    node.caches.clear(request=True)
+    idx = node.caches.request_cache.index_stats("c")
+    assert idx["bytes"] == 0 and idx["count"] == 0
+    # request breaker charge fully released with the entries
+    assert node.caches.request_cache.cache.memory_bytes == 0
+
+
+def test_invalidation_on_refresh_roundtrip(node):
+    r1 = node.search("c", _fresh(AGG_BODY))
+    node.index_doc("c", "99", {"tag": "a", "v": 99})
+    node.refresh("c")
+    r2 = node.search("c", _fresh(AGG_BODY))
+    assert r2["hits"]["total"] == r1["hits"]["total"] + 1
+    assert r2["aggregations"]["vals"]["max"] == 99.0
+
+
+def test_invalidation_on_delete_roundtrip(node):
+    r1 = node.search("c", _fresh(AGG_BODY))
+    assert r1["hits"]["total"] > 0
+    node.delete_doc("c", "1")           # tag=a
+    node.refresh("c")
+    r2 = node.search("c", _fresh(AGG_BODY))
+    assert r2["hits"]["total"] == r1["hits"]["total"] - 1
+
+
+def test_request_breaker_trip_returns_uncached_not_5xx(tmp_path):
+    from elasticsearch_tpu.common.settings import Settings
+    n = NodeService(str(tmp_path / "tiny"),
+                    settings=Settings({
+                        "indices.breaker.request.limit": "1b"}))
+    try:
+        n.create_index("c", mappings={"_doc": {"properties": {
+            "tag": {"type": "string", "index": "not_analyzed"}}}})
+        n.index_doc("c", "1", {"tag": "a"})
+        n.refresh("c")
+        body = {"size": 0, "query": {"term": {"tag": "a"}}}
+        r1 = n.search("c", _fresh(body))     # insert refused by breaker
+        r2 = n.search("c", _fresh(body))     # still correct, still uncached
+        assert r1["hits"]["total"] == r2["hits"]["total"] == 1
+        st = n.caches.request_cache.stats()
+        assert st["memory_size_in_bytes"] == 0
+        assert st["overflows_total"] >= 1
+        assert n.indices["c"].request_cache_hits == 0
+    finally:
+        n.close()
+
+
+def test_index_level_opt_out_and_explicit_override(tmp_path):
+    n = NodeService(str(tmp_path / "optout"))
+    try:
+        n.create_index("noc", settings={"index.requests.cache.enable":
+                                        "false"})
+        n.index_doc("noc", "1", {"v": 1})
+        n.refresh("noc")
+        body = {"size": 0, "query": {"match_all": {}}}
+        n.search("noc", _fresh(body))
+        n.search("noc", _fresh(body))
+        svc = n.indices["noc"]
+        assert svc.request_cache_hits == 0
+        assert svc.request_cache_misses == 0   # never even consulted
+        # explicit per-request opt-IN overrides the index setting
+        n.search("noc", _fresh(body), request_cache=True)
+        n.search("noc", _fresh(body), request_cache=True)
+        assert svc.request_cache_hits >= 1
+    finally:
+        n.close()
+
+
+def test_query_plan_cache_reparse_skipped_and_mapping_invalidation(node):
+    body = {"size": 3, "query": {"term": {"tag": "a"}}}
+    node.search("c", _fresh(body))
+    h0 = node.caches.query_plan.stats()["hits_total"]
+    node.search("c", _fresh(body))
+    assert node.caches.query_plan.stats()["hits_total"] > h0
+    # a mapping change rotates the key (mapping_version) — no stale plans
+    node.put_mapping("c", "_doc", {"properties": {
+        "extra": {"type": "long"}}})
+    key_hits = node.caches.query_plan.stats()["hits_total"]
+    node.search("c", _fresh(body))
+    st = node.caches.query_plan.stats()
+    assert st["hits_total"] == key_hits      # fresh key -> miss, re-parse
+    assert st["misses_total"] >= 2
+
+
+def test_fielddata_cache_loads_and_clears(node):
+    node.search("c", {"size": 3, "sort": [{"txt": {"order": "asc"}}]})
+    fd = node.caches.fielddata.stats()
+    assert fd["memory_size_in_bytes"] > 0 and fd["entries"] >= 1
+    br = node.breakers.breaker("fielddata")
+    used_before = br.used
+    node.caches.clear(fielddata=True)
+    assert node.caches.fielddata.stats()["memory_size_in_bytes"] == 0
+    assert br.used < used_before         # charge actually handed back
+    # segments report no loaded fielddata after the clear
+    assert all(not seg.fielddata_bytes()
+               for e in node.indices["c"].shards for seg in e.segments)
+    # next sort rebuilds cleanly
+    node.search("c", {"size": 3, "sort": [{"txt": {"order": "asc"}}]})
+    assert node.caches.fielddata.stats()["memory_size_in_bytes"] > 0
+
+
+def test_fielddata_eviction_under_breaker_pressure(node):
+    node.search("c", {"size": 3, "sort": [{"txt": {"order": "asc"}}]})
+    fd0 = node.caches.fielddata.stats()
+    assert fd0["entries"] >= 1
+    br = node.breakers.breaker("fielddata")
+    # squeeze the limit so the NEXT column can only fit by evicting the
+    # least-recently-sorted one
+    old_limit = br.limit
+    try:
+        br.limit = br.used + 10
+        seg = next(seg for e in node.indices["c"].shards
+                   for seg in e.segments if seg.n_docs)
+        fd = seg.text_fielddata("txt")       # rebuild forces the squeeze
+        assert fd is not None
+        assert node.caches.fielddata.stats()["evictions_total"] \
+            >= fd0["evictions_total"]
+    finally:
+        br.limit = old_limit
+
+
+# ---------------------------------------------------------------------------
+# REST: _cache/clear per-type filters + live _stats sections
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    from elasticsearch_tpu.rest import HttpServer
+    node = NodeService(str(tmp_path_factory.mktemp("cachehttp")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        import urllib.request
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method)
+        resp = urllib.request.urlopen(r)
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+
+    req("PUT", "/h", {"mappings": {"_doc": {"properties": {
+        "tag": {"type": "string", "index": "not_analyzed"},
+        "txt": {"type": "string"},
+        "v": {"type": "long"}}}}})
+    for i in range(8):
+        req("PUT", f"/h/_doc/{i}", {"tag": "t", "txt": f"w{i:02d} x",
+                                    "v": i})
+    req("POST", "/h/_refresh")
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def _prime(req):
+    body = {"size": 0, "query": {"term": {"tag": "t"}},
+            "aggs": {"s": {"stats": {"field": "v"}}}}
+    req("POST", "/h/_search", body)
+    req("POST", "/h/_search", body)
+    req("POST", "/h/_search", {"size": 2,
+                               "sort": [{"txt": {"order": "asc"}}]})
+
+
+def test_stats_sections_live_and_acceptance_roundtrip(http):
+    node, req = http
+    _prime(req)
+    code, st = req("GET", "/h/_stats")
+    assert code == 200
+    total = st["indices"]["h"]["total"]
+    rc = total["request_cache"]
+    assert rc["hit_count"] >= 1
+    assert rc["memory_size_in_bytes"] > 0
+    assert total["query_cache"]["memory_size_in_bytes"] \
+        == rc["memory_size_in_bytes"]
+    assert total["filter_cache"]["memory_size_in_bytes"] > 0  # plan cache
+    assert "memory_size_in_bytes" in total["id_cache"]
+    # clear ONLY the request tier; plan cache survives
+    code, out = req("POST", "/_cache/clear?request=true")
+    assert code == 200 and out["cleared"] == {"request": out["cleared"]
+                                              ["request"]}
+    code, st = req("GET", "/h/_stats")
+    assert st["indices"]["h"]["total"]["request_cache"]
+    assert st["indices"]["h"]["total"][
+        "request_cache"]["memory_size_in_bytes"] == 0
+    assert st["indices"]["h"]["total"][
+        "filter_cache"]["memory_size_in_bytes"] > 0
+    # scrape exposes the cache families
+    code, text = req("GET", "/_metrics")
+    assert "es_cache_hits_total" in text
+    assert "es_cache_memory_size_bytes" in text
+    assert "es_index_request_cache_memory_bytes" in text
+    assert "es_index_request_cache_evictions_total" in text
+
+
+def test_cache_clear_fielddata_filter(http):
+    node, req = http
+    _prime(req)
+    assert node.caches.fielddata.stats()["memory_size_in_bytes"] > 0
+    code, out = req("POST", "/h/_cache/clear?fielddata=true")
+    assert code == 200
+    assert node.caches.fielddata.stats()["memory_size_in_bytes"] == 0
+    # the request tier was untouched by the fielddata-only clear
+    assert "request" not in out["cleared"]
+
+
+def test_cache_clear_query_filter(http):
+    node, req = http
+    _prime(req)
+    assert node.caches.query_plan.stats()["entries"] >= 1
+    code, out = req("POST", "/_cache/clear?query=true")
+    assert code == 200 and "query" in out["cleared"]
+    assert node.caches.query_plan.stats()["entries"] == 0
+
+
+def test_cat_indices_hit_ratio_columns(http):
+    node, req = http
+    _prime(req)
+    code, text = req(
+        "GET", "/_cat/indices?v=true&h=index,request_cache.hit_ratio,"
+               "request_cache.memory")
+    assert code == 200
+    header = text.splitlines()[0]
+    assert "request_cache.hit_ratio" in header
+    row = text.splitlines()[1].split()
+    assert row[0] == "h" and float(row[1]) > 0
+    # short aliases resolve too
+    code, text = req("GET", "/_cat/indices?h=index,rchr,rcm")
+    assert code == 200 and float(text.split()[1]) > 0

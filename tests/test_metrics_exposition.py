@@ -118,10 +118,12 @@ def test_exposition_is_valid_and_broad(http):
     families = scrape(req)
     n_series = sum(len(f["samples"]) for f in families.values())
     subsystems = {name.split("_")[1] for name in families}
-    # acceptance floor: ≥40 series across ≥8 subsystems
-    assert n_series >= 40, f"only {n_series} series"
+    # acceptance floor: ≥55 series across ≥9 subsystems (ISSUE-3 bumped
+    # it from 40 — the cache tiers alone add ~24 series)
+    assert n_series >= 55, f"only {n_series} series"
     for want in ("threadpool", "breaker", "search", "timer", "jit",
-                 "transfer", "index", "tasks", "rate", "process", "os"):
+                 "transfer", "index", "tasks", "rate", "process", "os",
+                 "cache"):
         assert want in subsystems, f"subsystem [{want}] missing"
     # every sample carries the node label
     for fam in families.values():
@@ -150,6 +152,13 @@ def test_every_registry_is_scraped(http):
     index_labels = {lb["index"] for lb, _
                     in families["es_index_docs"]["samples"]}
     assert index_labels == set(node.indices)
+
+    cache_labels = {lb["cache"] for lb, _
+                    in families["es_cache_hits_total"]["samples"]}
+    assert cache_labels >= {"request", "query_plan", "fielddata"}
+    # request-cache byte/eviction families ride the per-index section
+    assert "es_index_request_cache_memory_bytes" in families
+    assert "es_index_request_cache_evictions_total" in families
 
 
 def test_new_timer_joins_the_scrape_automatically(http):

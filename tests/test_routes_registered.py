@@ -29,9 +29,9 @@ def _resolves(controller, path: str) -> bool:
 
 def test_route_count_floor_and_uniqueness(controller):
     # floor, not exact: new PRs add routes; LOSING routes is the bug.
-    # (211 at ISSUE-2 time + this PR's /_metrics, /_prometheus/metrics,
-    # /_nodes/stats/history)
-    assert len(controller.routes) >= 214, len(controller.routes)
+    # (249 registered at ISSUE-3 time — the cache subsystem changed
+    # handlers, not the route table, so the floor just re-anchors)
+    assert len(controller.routes) >= 249, len(controller.routes)
     seen = set()
     for method, rx, _h, _s in controller.routes:
         key = (method, rx.pattern)
@@ -42,7 +42,9 @@ def test_route_count_floor_and_uniqueness(controller):
 def test_new_observability_routes_resolve(controller):
     for path in ("/_metrics", "/_prometheus/metrics",
                  "/_nodes/stats/history", "/_nodes/stats",
-                 "/_cat/thread_pool", "/_cat/indices"):
+                 "/_cat/thread_pool", "/_cat/indices",
+                 "/_cache/clear", "/someindex/_cache/clear",
+                 "/_cat/fielddata"):
         assert _resolves(controller, path), path
 
 

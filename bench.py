@@ -247,6 +247,102 @@ def run_agg_leg(tag: str) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_multiseg_leg(tag: str) -> dict:
+    """ISSUE 4: the live-index (never force-merged, ~8 segments/shard)
+    dense workload. Two identical indices — one on the segment-stacked
+    dense lane, one pinned to the per-segment loop
+    (`index.search.stacked.enable: false`) — serve the same dense
+    unsorted query mix; the p50 gap is the stacked win, and the
+    device-fetch counter delta is the fetches-per-query proof."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.rest import HttpServer
+    from elasticsearch_tpu.common.metrics import transfer_snapshot
+
+    n_docs = int(os.environ.get("BENCH_MS_DOCS", "40000"))
+    n_segments = int(os.environ.get("BENCH_MS_SEGMENTS", "8"))
+    reps = int(os.environ.get("BENCH_MS_REPS", "60"))
+    workdir = tempfile.mkdtemp(prefix=f"bench-ms-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"))
+    server = HttpServer(node, port=0).start()
+    port = server.port
+    try:
+        rng = np.random.default_rng(23)
+        words = [f"w{i:03d}" for i in range(300)]
+        mapping = {"mappings": {"_doc": {"properties": {
+            "body": {"type": "string"},
+            "n": {"type": "long"}}}}}
+        for name, extra in (("live", {}),
+                            ("live_loop",
+                             {"index.search.stacked.enable": False})):
+            http(port, "PUT", f"/{name}", json.dumps(
+                {**mapping,
+                 "settings": {"number_of_shards": 1, **extra}}))
+        word_ids = rng.integers(0, len(words), (n_docs, 6))
+        # two size tiers (half big, half small segments) — the realistic
+        # live-index shape, and no single tier fills the engine's
+        # 8-segment merge trigger, so all ~8 segments survive refresh
+        big = n_segments // 2
+        small_sz = max(n_docs // 100, 8)
+        big_sz = (n_docs - small_sz * (n_segments - big)) // big
+        sizes = [big_sz] * big + [small_sz] * (n_segments - big)
+        for name in ("live", "live_loop"):
+            j = 0
+            for sz in sizes:
+                lines = []
+                for _ in range(sz):
+                    lines.append('{"index":{"_id":"%d"}}' % j)
+                    lines.append(json.dumps({
+                        "body": " ".join(words[w] for w in word_ids[j]),
+                        "n": int(j)}))
+                    j += 1
+                http(port, "POST", f"/{name}/_bulk",
+                     "\n".join(lines) + "\n")
+                # refresh per batch -> one segment per round, NO force
+                # merge: this leg measures the live-index shape
+                http(port, "POST", f"/{name}/_refresh")
+
+        def body_of(i: int) -> str:
+            # should-scoring keeps the query off the sparse/packed lanes:
+            # this is the dense tree the stacked lane serves
+            a, b = words[i % len(words)], words[(i * 7 + 3) % len(words)]
+            return json.dumps({"size": 10, "query": {"bool": {
+                "should": [{"match": {"body": a}}, {"match": {"body": b}}],
+                "filter": [{"range": {"n": {"gte": (i * 13) % 1000}}}]}}})
+
+        out: dict = {}
+        seg_counts = {
+            name: http(port, "GET", f"/{name}/_stats")["indices"][name]
+            ["total"]["segments"]["count"]
+            for name in ("live", "live_loop")}
+        for name, key in (("live", "stacked"), ("live_loop", "per_segment")):
+            http(port, "POST", f"/{name}/_search", body_of(0))   # warm
+            f0 = transfer_snapshot()["device_fetches_total"]
+            lat = []
+            served = 0
+            for i in range(reps):
+                t0 = time.perf_counter()
+                http(port, "POST", f"/{name}/_search", body_of(i))
+                lat.append((time.perf_counter() - t0) * 1000)
+                served += 1
+                if _over_budget():
+                    break
+            f1 = transfer_snapshot()["device_fetches_total"]
+            lat.sort()
+            out[f"{key}_p50_ms"] = lat[len(lat) // 2]
+            out[f"{key}_fetches_per_query"] = (f1 - f0) / max(served, 1)
+        out["multiseg_segments"] = seg_counts.get("live", n_segments)
+        if out.get("per_segment_p50_ms"):
+            out["multiseg_speedup"] = (out["per_segment_p50_ms"]
+                                       / out["stacked_p50_ms"])
+        return out
+    finally:
+        server.stop()
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def run_vector_leg(tag: str) -> dict:
     """BASELINE configs #4/#5: function_score cosine over stored 768-d
     vectors (exact kNN through the product) and BM25->dense hybrid rescore,
@@ -546,6 +642,7 @@ def _run_all_legs(tag: str) -> dict:
     # optional legs run only while the budget allows AND degrade to
     # absent keys on failure — the headline line always prints
     for flag, leg in (("BENCH_AGG", run_agg_leg),
+                      ("BENCH_MULTISEG", run_multiseg_leg),
                       ("BENCH_VEC", run_vector_leg)):
         if os.environ.get(flag, "1") == "0":
             continue

@@ -230,6 +230,23 @@ def device_events_snapshot() -> tuple[int, float]:
         return _DEVICE_EVENTS["compiles"], _DEVICE_EVENTS["compile_ms"]
 
 
+_FETCH_HIST: dict[int, int] = {}
+
+
+def record_shard_fetches(n: int) -> None:
+    """One shard query phase performed `n` device_fetch round-trips —
+    bucket counts for the fetches-per-shard-query histogram on the
+    `/_metrics` scrape (the stacked dense lane's whole point is n == 1)."""
+    with _DEVICE_LOCK:
+        _FETCH_HIST[int(n)] = _FETCH_HIST.get(int(n), 0) + 1
+
+
+def shard_fetch_histogram() -> dict[int, int]:
+    """{device_fetches_per_shard_query: occurrences} snapshot."""
+    with _DEVICE_LOCK:
+        return dict(_FETCH_HIST)
+
+
 def transfer_snapshot() -> dict:
     """Process-wide host↔device transfer counters (every device_fetch /
     note_h2d call accounts here, profiler active or not) — the scrape's
@@ -308,12 +325,23 @@ class RequestProfiler:
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self.phases: dict[str, float] = {}
         self.shards: list[dict] = []
-        self._shard_stack: list[dict] = []
+        # per-THREAD shard stack: shard phases fan out concurrently onto
+        # the search pool, and each worker must attribute node timings to
+        # its own shard entry, not whichever shard another thread opened
+        self._local = threading.local()
         self._lock = threading.Lock()
         self.dispatches = 0
         self.d2h_bytes = 0
         self.h2d_bytes = 0
+        self.paths: dict[str, int] = {}   # device path -> shard query count
         self._jit0 = device_events_snapshot()
+
+    @property
+    def _shard_stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
 
     # -- coordinator phases ------------------------------------------------
 
@@ -381,6 +409,12 @@ class RequestProfiler:
         with self._lock:
             self.h2d_bytes += int(nbytes)
 
+    def note_path(self, path: str) -> None:
+        """One shard query phase served by `path` (sparse / stacked /
+        dense / packed) — the _path_stats view scoped to THIS request."""
+        with self._lock:
+            self.paths[path] = self.paths.get(path, 0) + 1
+
     def device_section(self) -> dict:
         compiles, compile_ms = device_events_snapshot()
         misses = compiles - self._jit0[0]
@@ -389,7 +423,8 @@ class RequestProfiler:
                 "compile_time_in_millis": round(
                     compile_ms - self._jit0[1], 3),
                 "bytes_device_to_host": self.d2h_bytes,
-                "bytes_host_to_device": self.h2d_bytes}
+                "bytes_host_to_device": self.h2d_bytes,
+                "query_paths": dict(self.paths)}
 
     def render(self, opaque_id: str | None = None) -> dict:
         out = {"trace_id": self.trace_id,

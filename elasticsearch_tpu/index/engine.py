@@ -377,6 +377,7 @@ class Engine:
                 self._pending_deletes.clear()
                 self._pending_set.clear()
                 self._maybe_merge()
+            self._drop_dead_segments()
             if not self._buffer_docs:
                 return
             builder = SegmentBuilder(seg_id=self._next_seg_id)
@@ -417,6 +418,19 @@ class Engine:
             self._buffer_bytes = 0
             self.refresh_count += 1
             self._maybe_merge()
+
+    def _drop_dead_segments(self) -> None:
+        """Dead-empty segments (zero live docs — fully tombstoned, or an
+        empty load) leave the segment set at refresh: searchers stop
+        paying per-query empty checks for them, their device bytes go
+        back to the breaker, and loaded fielddata dies with them."""
+        dead = [s for s in self.segments if s.live_count == 0]
+        if not dead:
+            return
+        self.segments = [s for s in self.segments if s.live_count > 0]
+        if self.breaker is not None:
+            self.breaker.release(sum(s.memory_bytes() for s in dead))
+        self._drop_fielddata(dead)
 
     def _maybe_merge(self) -> None:
         """Size-tiered merge selection (ref index/merge/policy/

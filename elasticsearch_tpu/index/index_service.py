@@ -62,7 +62,14 @@ class IndexService:
         # (the NRT "acquire searcher" analog — ref SearcherManager); device
         # query-path counters live here so they survive across requests
         self._searcher_cache: dict[int, tuple[tuple, ShardSearcher]] = {}
-        self.search_stats = {"sparse": 0, "dense": 0, "packed": 0}
+        self.search_stats = {"sparse": 0, "dense": 0, "packed": 0,
+                             "stacked": 0}
+        # the stacked dense lane is on unless the index opts out
+        # (`index.search.stacked.enable: false` — bench uses it to measure
+        # the per-segment loop it replaces)
+        raw_stacked = get("search.stacked.enable", True)
+        self._stacked_enabled = str(raw_stacked).strip().lower() \
+            not in ("false", "0", "no")
         # op counters surfaced by _stats (ref index/shard stats holders:
         # IndexingStats w/ per-type breakdown, SearchStats w/ groups, GetStats)
         self.indexing_stats: dict = {"index_total": 0, "delete_total": 0,
@@ -158,14 +165,28 @@ class IndexService:
     def refresh(self) -> None:
         for e in self.shards:
             e.refresh()
+        self._drop_stale_stacks()
 
     def flush(self) -> None:
         for e in self.shards:
             e.flush()
+        self._drop_stale_stacks()
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for e in self.shards:
             e.force_merge(max_num_segments)
+        self._drop_stale_stacks()
+
+    def _drop_stale_stacks(self) -> None:
+        """A refresh/merge changed some shard's segment set: free stale
+        packed segment stacks NOW (their removal listener hands the device
+        bytes back to the fielddata breaker) instead of waiting for the
+        next query's put to displace them."""
+        if self.caches is None:
+            return
+        valid = {(si, tuple(s.seg_id for s in e.segments if s.n_docs > 0))
+                 for si, e in enumerate(self.shards)}
+        self.caches.segment_stacks.drop_stale(self.name, valid)
 
     def _on_packed_removed(self, _key, value, _reason) -> None:
         """Packed-view cache removal: hand the view's duplicate-postings
@@ -179,6 +200,8 @@ class IndexService:
         for e in self.shards:
             e.close()
         self._packed_view_cache.clear()
+        if self.caches is not None:
+            self.caches.segment_stacks.clear([self.name])
 
     def delete_files(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)
@@ -191,8 +214,12 @@ class IndexService:
             key = tuple(s.seg_id for s in e.segments)
             cached = self._searcher_cache.get(si)
             if cached is None or cached[0] != key:
-                cached = (key, ShardSearcher(si, e.segments, self.mappers,
-                                             stats=self.search_stats))
+                cached = (key, ShardSearcher(
+                    si, e.segments, self.mappers, stats=self.search_stats,
+                    stack_cache=self.caches.segment_stacks
+                    if self.caches is not None else None,
+                    index_name=self.name, incarnation=self._incarnation,
+                    stacked=self._stacked_enabled))
                 self._searcher_cache[si] = cached
             out.append(cached[1])
         return out

@@ -258,6 +258,106 @@ class FielddataCache:
         return self.cache.stats()
 
 
+class _StackEntry:
+    __slots__ = ("stack", "nbytes", "breaker", "index_name")
+
+    def __init__(self, stack, nbytes, breaker, index_name):
+        self.stack = stack
+        self.nbytes = nbytes
+        self.breaker = breaker
+        self.index_name = index_name
+
+
+class SegmentStackCache:
+    """Per-(index, shard) packed segment stacks for the stacked dense lane
+    (search/stacked.py). Entries charge the `fielddata` breaker at build
+    (make_room admission: LRU stacks shed under pressure before anything
+    429s), release on any removal, and are keyed by the shard's exact
+    segment-id set — refresh/merge produce a new key, and the stale
+    sibling is invalidated on the next put (plus eagerly via drop_stale).
+    Oversized stacks (estimate beyond the byte budget) are declined up
+    front: callers fall back to the per-segment loop, never raise."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.oversized = 0
+        self.declined = 0                # breaker refused the build charge
+        self.cache = Cache("segment_stack", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _StackEntry, reason: str) -> None:
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+
+    def get_or_build(self, index_name, shard_id, incarnation, segments,
+                     breaker=None):
+        """The shard's SegmentStack, building (and breaker-charging) on
+        first use. Returns None when declined — empty shard, oversized
+        stack, or breaker pressure even after shedding other stacks."""
+        from ..search import stacked as stacked_mod
+        live = [s for s in segments if s.n_docs > 0]
+        if not live:
+            return None
+        key = (index_name, shard_id, incarnation,
+               tuple(s.seg_id for s in live))
+        ent = self.cache.get(key)
+        if ent is not None:
+            return ent.stack
+        est = stacked_mod.estimate_stack_bytes(live)
+        if self.cache.max_bytes > 0 and est > self.cache.max_bytes:
+            self.oversized += 1
+            return None
+        if breaker is not None:
+            try:
+                self.cache.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429 a search
+                self.declined += 1
+                return None
+        try:
+            stack = stacked_mod.build_stack(live)
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if stack is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        nbytes = stack.nbytes
+        if breaker is not None and nbytes != est:
+            if nbytes > est:      # true up estimate drift without re-tripping
+                breaker.add_estimate(nbytes - est, check=False)
+            else:
+                breaker.release(est - nbytes)
+        entry = _StackEntry(stack, nbytes, breaker, index_name)
+        if self.cache.put(key, entry):
+            # a refresh/merge changed the segment set: the predecessor
+            # entry for this shard frees its device bytes NOW
+            self.cache.invalidate_where(
+                lambda k, _e: k[:3] == key[:3] and k != key)
+        elif breaker is not None:
+            breaker.release(nbytes)   # refused by budget: nothing retained
+        return stack
+
+    def drop_stale(self, index_name: str, valid: set) -> int:
+        """Invalidate entries whose (shard, segment-id set) is no longer
+        the live one — the refresh/merge hook (IndexService)."""
+        return self.cache.invalidate_where(
+            lambda k, _e: k[0] == index_name and (k[1], k[3]) not in valid)
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(lambda k, _e: k[0] in want)
+
+    def stats(self) -> dict:
+        out = self.cache.stats()
+        out["oversized"] = self.oversized
+        out["declined"] = self.declined
+        return out
+
+
 class IndicesCacheService:
     """The node's cache roster. One `stats()`/`clear()` surface over the
     three tiers; per-index packed-view caches register here so their
@@ -294,6 +394,12 @@ class IndicesCacheService:
         self.fielddata = FielddataCache(
             max_bytes=parse_size(get("indices.fielddata.cache.size", 0),
                                  total, default=0))
+        # packed segment stacks for the stacked dense lane: a real slice of
+        # device memory (stacks duplicate segment residency), so the budget
+        # defaults to 10% of the breaker total
+        self.segment_stacks = SegmentStackCache(
+            max_bytes=parse_size(get("indices.stacked.cache.size", "10%"),
+                                 total, default=total // 10))
         # per-index packed-view caches (serving views) register here so
         # their byte totals surface without the service owning them
         self._registered: "weakref.WeakValueDictionary[str, Cache]" = \
@@ -343,6 +449,10 @@ class IndicesCacheService:
                 want = set(indices)
                 out["query"] = self.query_plan.invalidate_where(
                     lambda k, _v: k[0] in want)
+            # packed segment stacks are query-execution structures: they
+            # ride the `query` tier flag (removal releases their breaker
+            # charge)
+            out["segment_stack"] = self.segment_stacks.clear(indices)
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
         return out
@@ -350,7 +460,8 @@ class IndicesCacheService:
     def stats(self) -> dict:
         out = {"request": self.request_cache.stats(),
                "query_plan": self.query_plan.stats(),
-               "fielddata": self.fielddata.stats()}
+               "fielddata": self.fielddata.stats(),
+               "segment_stack": self.segment_stacks.stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
         return out
@@ -359,3 +470,4 @@ class IndicesCacheService:
         self.request_cache.cache.clear()
         self.query_plan.clear()
         self.fielddata.cache.clear()
+        self.segment_stacks.cache.clear()

@@ -12,9 +12,11 @@ winners only, aggregation tree reduce).
 from __future__ import annotations
 
 import fnmatch
+import functools
 import logging
 import os
 import re
+import threading
 import time
 from typing import Any
 
@@ -812,9 +814,7 @@ class NodeService:
         prof = current_profiler()
         if prof is not None:
             prof.record_phase("parse", (t_parse_done - t0) * 1000)
-        results = []
-        shard_failures = 0
-        for i, s in enumerate(searchers):
+        def _run_shard(i: int, s: ShardSearcher):
             # shard-level action registered under the coordinator task
             # (ref TransportSearchTypeAction per-shard phase actions)
             with self.tasks.scope(
@@ -838,7 +838,56 @@ class NodeService:
                         if sort is not None else True)
                 if rescore_spec is not None:
                     r = s.rescore(r, rescore_spec)
-            results.append(r)
+            return r
+
+        shard_failures = 0
+        shard_failure_details: list[dict] = []
+        if len(searchers) == 1:
+            # sequential fast path: no job/context machinery, errors raise
+            # straight through exactly as before
+            results = [_run_shard(0, searchers[0])]
+        else:
+            # concurrent fan-out onto the bounded `search` pool. Each job
+            # runs in a COPY of the coordinator's context so tasks.scope
+            # parenting and the active profiler propagate; claim-once
+            # semantics let the coordinator steal any job the pool hasn't
+            # started (deadlock-free even when coordinators themselves
+            # occupy the search pool), and pool-queue overflow simply
+            # leaves the remainder to run inline.
+            import contextvars
+            from .common.threadpool import EsRejectedExecutionException
+            jobs = []
+            for i, s in enumerate(searchers):
+                ctx = contextvars.copy_context()
+                jobs.append(_ShardJob(
+                    functools.partial(ctx.run, _run_shard, i, s)))
+            try:
+                for job in jobs[1:]:
+                    self.thread_pool.execute("search", job.run)
+            except EsRejectedExecutionException:
+                pass
+            jobs[0].run()
+            results = []
+            first_error = None
+            for i, job in enumerate(jobs):
+                job.join()
+                if job.error is not None:
+                    # shard-failure accounting (ref per-shard onFailure in
+                    # TransportSearchTypeAction): the response carries the
+                    # failure; only an all-shards failure raises
+                    shard_failures += 1
+                    first_error = first_error or job.error
+                    shard_failure_details.append({
+                        "index": index_of[i],
+                        "shard": searchers[i].shard_id,
+                        "reason": f"{type(job.error).__name__}: "
+                                  f"{job.error}"})
+                    results.append(_empty_shard_result(
+                        searchers[i].shard_id, sort=sort))
+                else:
+                    results.append(job.result)
+            if shard_failures == len(searchers) and first_error is not None:
+                raise first_error
 
         t_device_done = time.perf_counter()
         self._record_phase("device",
@@ -903,12 +952,16 @@ class NodeService:
                         if isinstance(fspec, dict) else None)
                     flds[fname] = [val]
 
+        shards_section: dict[str, Any] = {
+            "total": len(searchers),
+            "successful": len(searchers) - shard_failures,
+            "failed": shard_failures}
+        if shard_failure_details:
+            shards_section["failures"] = shard_failure_details
         resp: dict[str, Any] = {
             "took": int((time.perf_counter() - t0) * 1000),
             "timed_out": False,
-            "_shards": {"total": len(searchers),
-                        "successful": len(searchers) - shard_failures,
-                        "failed": shard_failures},
+            "_shards": shards_section,
             "hits": {"total": reduced.total_hits,
                      "max_score": None if reduced.max_score != reduced.max_score
                      else reduced.max_score,
@@ -2215,6 +2268,25 @@ class NodeService:
         os_st = monitor.os_stats()
         proc = monitor.process_stats()
         load = os_st.get("load_average") or [0.0]
+        # device execution-path counters summed across indices: how many
+        # per-segment programs ran vs how many segment-stacked ones (the
+        # stacked dense lane replaces G dispatches + G fetches with 1 + 1)
+        from .common.metrics import shard_fetch_histogram
+        path_totals: dict[str, int] = {}
+        for svc in self.indices.values():
+            for pk, pv in svc.search_stats.items():
+                path_totals[pk] = path_totals.get(pk, 0) + pv
+        search_exec = {
+            "segment_dispatches_total":
+                path_totals.get("segment_dispatches", 0),
+            "stacked_dispatches_total":
+                path_totals.get("stacked_dispatches", 0),
+            "stacked_queries_total": path_totals.get("stacked", 0),
+            "stacked_errors_total": path_totals.get("stacked_errors", 0),
+            "sparse_queries_total": path_totals.get("sparse", 0),
+            "dense_queries_total": path_totals.get("dense", 0),
+            "packed_queries_total": path_totals.get("packed", 0),
+        }
         return {
             "threadpool": ("pool", self.thread_pool.stats()),
             "breaker": ("breaker", self.breakers.stats()),
@@ -2228,6 +2300,14 @@ class NodeService:
             # the cache subsystem: one sample set per tier (request /
             # query_plan / fielddata / registered extras), uniform leaves
             "cache": ("cache", self.caches.stats()),
+            # stacked-vs-segment dispatch counters (ISSUE 4) plus a
+            # fetches-per-shard-query histogram: bucket n = a shard query
+            # phase that needed n device round-trips (stacked lane: 1)
+            "search": (None, search_exec),
+            "search_fetches": ("fetches_per_query",
+                               {str(n): {"count": c}
+                                for n, c in sorted(
+                                    shard_fetch_histogram().items())}),
             "jit": (None, {"compiles": compiles,
                            "compile_time_in_millis": round(compile_ms, 3)}),
             "transfer": (None, transfer_snapshot()),
@@ -2277,6 +2357,8 @@ class NodeService:
             "request_cache_hits_total": self.caches.request_cache.cache.hits,
             "fielddata_cache_memory_bytes":
                 self.caches.fielddata.cache.memory_bytes,
+            "segment_stack_cache_memory_bytes":
+                self.caches.segment_stacks.cache.memory_bytes,
         }
         for name, b in br.items():
             out[f"breaker_{name}_used_bytes"] = b["estimated_size_in_bytes"]
@@ -2305,6 +2387,57 @@ class NodeService:
 
 
 # ---------------------------------------------------------------------------
+
+class _ShardJob:
+    """Claim-once shard execution for the concurrent query fan-out: a
+    search-pool worker runs the job if it picks it up first, otherwise the
+    coordinator steals it and runs it inline (join() claims before
+    waiting). Because the coordinator can always execute every job itself,
+    the fan-out stays deadlock-free even when the coordinators themselves
+    occupy the same bounded pool."""
+
+    __slots__ = ("fn", "done", "result", "error", "_claim")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        self._claim = threading.Lock()
+
+    def run(self) -> None:
+        if not self._claim.acquire(blocking=False):
+            return                          # someone else owns it
+        try:
+            self.result = self.fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced via .error
+            self.error = e
+        finally:
+            self.done.set()
+
+    def join(self) -> None:
+        self.run()                          # steal if still queued
+        self.done.wait()
+
+
+def _empty_shard_result(shard_id: int, sort=None):
+    """Placeholder result for a failed shard: keeps the reduce's
+    result-per-searcher alignment while contributing zero hits."""
+    import numpy as np
+
+    from .search.shard_searcher import QuerySearchResult
+    sv = None
+    if sort is not None:
+        sv = np.empty((1, 1), dtype=object)
+        sv[0, 0] = None
+    return QuerySearchResult(
+        shard_id=shard_id,
+        doc_keys=np.full((1, 1), -1, np.int64),
+        scores=np.full((1, 1), np.nan, np.float32),
+        sort_values=sv,
+        total_hits=np.zeros((1,), np.int64),
+        max_score=np.full((1,), np.nan, np.float32))
+
 
 def _maybe_shard_profile(prof, index: str, shard_id: int):
     """prof.shard(...) when profiling, else a no-op context."""

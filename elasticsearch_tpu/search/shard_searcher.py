@@ -67,17 +67,39 @@ class ShardSearcher:
     """Executes search phases over one shard's live segment set."""
 
     def __init__(self, shard_id: int, segments: Sequence[Segment],
-                 mappers: MapperService, stats: dict | None = None):
+                 mappers: MapperService, stats: dict | None = None,
+                 stack_cache=None, index_name: str | None = None,
+                 incarnation: int = 0, stacked: bool = True):
         self.shard_id = shard_id
         self.segments = list(segments)
         self.mappers = mappers
         self.parser = QueryParser(mappers)
+        # empty segments are skipped ONCE here instead of being re-checked
+        # inside every query's per-segment loop (pairs keep the original
+        # segment index — doc keys encode it)
+        self.live_segments = [(i, s) for i, s in enumerate(self.segments)
+                              if s.n_docs > 0]
         # which device program served the last query phase — tests assert the
         # sparse sort-reduce kernel is the production scoring path
         self.last_query_path: str | None = None
+        # dense-lane mode of the last dense query: "stacked" | "loop"
+        self.last_dense_mode: str | None = None
         self.sparse_queries = 0
         self.dense_queries = 0
         self._path_stats = stats if stats is not None else {}
+        # segment-stacked dense lane (search/stacked.py): the packed stack
+        # lives in the node cache service when one is attached (breaker-
+        # charged, invalidated by refresh/merge/_cache/clear); direct
+        # constructions memoize locally — this searcher is itself rebuilt
+        # whenever the segment set changes, so the memo cannot go stale
+        self.stacked_enabled = bool(stacked)
+        self.stack_cache = stack_cache
+        self.index_name = index_name
+        self.incarnation = incarnation
+        self._stack_memo = None          # False = build declined/failed
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self._path_stats[key] = self._path_stats.get(key, 0) + n
 
     # -- statistics (DFS support, ref search/dfs/DfsPhase.java:57-81) ------
 
@@ -157,9 +179,7 @@ class ShardSearcher:
                 if aggs is not None:
                     from .aggs.aggregators import collect_shard
                     a_segs, a_masks = [], []
-                    for seg in self.segments:
-                        if seg.n_docs == 0:
-                            continue
+                    for _si, seg in self.live_segments:
                         ctx = SegmentContext(seg, Q, stats)
                         m = node.match_mask(ctx) & seg.live[None, :]
                         a_segs.append(seg)
@@ -168,16 +188,38 @@ class ShardSearcher:
                                                  query_parser=self.parser)
                 self.last_query_path = "sparse"
                 self.sparse_queries += 1
-                self._path_stats["sparse"] = \
-                    self._path_stats.get("sparse", 0) + 1
+                self._bump("sparse")
+                self._bump("segment_dispatches", len(self.live_segments))
+                from ..common.metrics import record_shard_fetches
+                record_shard_fetches(len(self.live_segments))
+                prof = current_profiler()
+                if prof is not None:
+                    prof.note_path("sparse")
                 return QuerySearchResult(
                     shard_id=self.shard_id, doc_keys=keys, scores=scores,
                     sort_values=None, total_hits=total, max_score=mx,
                     aggs=agg_partials)
 
+            # segment-stacked dense lane: the whole tree executes once over
+            # the shard's packed segment stack and comes down in ONE
+            # device_fetch (search/stacked.py). Falls through to the
+            # per-segment loop when the stack is declined (breaker pressure,
+            # oversized, disabled) or a stacked execution fails.
+            if self.stacked_enabled and self.live_segments:
+                out = self._try_stacked(node, k=k, Q=Q,
+                                        global_stats=global_stats,
+                                        track_scores=track_scores,
+                                        aggs=aggs)
+                if out is not None:
+                    return out
+
         self.last_query_path = "dense"
+        self.last_dense_mode = "loop"
         self.dense_queries += 1
-        self._path_stats["dense"] = self._path_stats.get("dense", 0) + 1
+        self._bump("dense")
+        prof_path = current_profiler()
+        if prof_path is not None:
+            prof_path.note_path("dense")
         stats = self.build_stats(node, global_stats)
 
         best_scores = np.full((Q, k), -np.inf, np.float32)
@@ -190,10 +232,10 @@ class ShardSearcher:
         agg_segments: list = []
         agg_masks: list = []
         agg_scores: list = []
+        n_fetches = 0
 
-        for seg_idx, seg in enumerate(self.segments):
-            if seg.n_docs == 0:
-                continue
+        for seg_idx, seg in self.live_segments:
+            self._bump("segment_dispatches")
             ctx = SegmentContext(seg, Q, stats)
             scores, match = node.execute(ctx)
             match = match & seg.live[None, :]
@@ -217,6 +259,7 @@ class ShardSearcher:
                 fetch["top"] = top_d
                 fetch["idx"] = idx_d
             got = device_fetch(fetch)
+            n_fetches += 1
             total += got["total"]
             if track_scores:
                 max_score = np.maximum(max_score, got["mx"])
@@ -254,6 +297,7 @@ class ShardSearcher:
                 sel_scores_d = jnp.take_along_axis(scores, order, axis=1)
                 order, sel_match, sel_scores = device_fetch(
                     (order, sel_match_d, sel_scores_d))
+                n_fetches += 1
                 for qi in range(Q):
                     for j in range(kk):
                         if not sel_match[qi, j]:
@@ -287,10 +331,111 @@ class ShardSearcher:
             agg_partials = collect_shard(aggs, agg_segments, agg_masks,
                                          query_parser=self.parser,
                                          scores=agg_scores)
+        from ..common.metrics import record_shard_fetches
+        record_shard_fetches(n_fetches)
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=sort_vals, total_hits=total, max_score=max_score,
             aggs=agg_partials)
+
+    # -- segment-stacked dense lane (search/stacked.py) --------------------
+
+    def _acquire_stack(self):
+        """The shard's packed SegmentStack: through the node cache service
+        when attached (breaker-charged, invalidated by refresh/merge/
+        `_cache/clear`), else memoized on this searcher — which is itself
+        rebuilt whenever the segment set changes. None = declined (breaker
+        pressure / oversized / nothing live): callers fall back to the
+        per-segment loop."""
+        if self.stack_cache is not None:
+            breaker = next((getattr(s, "breaker", None)
+                            for _i, s in self.live_segments
+                            if getattr(s, "breaker", None) is not None), None)
+            return self.stack_cache.get_or_build(
+                self.index_name, self.shard_id, self.incarnation,
+                self.segments, breaker=breaker)
+        if self._stack_memo is None:
+            from .stacked import build_stack
+            try:
+                self._stack_memo = build_stack(self.segments) or False
+            except Exception:  # noqa: BLE001 — degrade to the loop
+                self._stack_memo = False
+        return self._stack_memo or None
+
+    def _try_stacked(self, node: Node, *, k: int, Q: int,
+                     global_stats: CollectionStats | None,
+                     track_scores: bool,
+                     aggs: list | None) -> QuerySearchResult | None:
+        """One stacked execution attempt; None falls back to the loop."""
+        try:
+            stack = self._acquire_stack()
+            if stack is None:
+                return None
+            return self._execute_stacked(stack, node, k=k, Q=Q,
+                                         global_stats=global_stats,
+                                         track_scores=track_scores,
+                                         aggs=aggs)
+        except Exception:  # noqa: BLE001 — the loop is always correct
+            self._bump("stacked_errors")
+            return None
+
+    def _execute_stacked(self, stack, node: Node, *, k: int, Q: int,
+                         global_stats, track_scores: bool,
+                         aggs: list | None) -> QuerySearchResult:
+        from .stacked import StackedContext, execute_tree, stacked_reduce
+        stats = self.build_stats(node, global_stats)
+        sctx = StackedContext(stack, Q, stats)
+        scores, match = execute_tree(node, sctx)
+        live = stack.live_stack()
+        out = stacked_reduce(scores, match, live, stack.seg_ids_dev, k=k)
+        # per-segment totals, masked row-max and the cross-segment top-k
+        # merge all happened ON DEVICE — this is the shard's ONE fetch
+        keys_d, top_d, total_d, mx_d = out
+        got = device_fetch({"keys": keys_d, "top": top_d,
+                            "total": total_d, "mx": mx_d})
+        best_keys = np.asarray(got["keys"], np.int64)
+        # keep the device dtype: trees over f64 columns promote scores to
+        # f64 exactly like the per-segment loop's merge does
+        best_scores = np.asarray(got["top"])
+        if best_keys.shape[1] < k:        # pad to the loop's [Q, k] contract
+            pad = k - best_keys.shape[1]
+            best_keys = np.concatenate(
+                [best_keys, np.full((Q, pad), -1, np.int64)], axis=1)
+            best_scores = np.concatenate(
+                [best_scores,
+                 np.full((Q, pad), -np.inf, best_scores.dtype)], axis=1)
+        best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+        mx = np.asarray(got["mx"])
+        max_score = np.where(np.isfinite(mx), mx, np.nan) if track_scores \
+            else np.full((Q,), np.nan, mx.dtype)
+        agg_partials = None
+        if aggs is not None:
+            from .aggs.aggregators import collect_shard
+            a_segs, a_masks, a_scores = [], [], []
+            for gi, seg in enumerate(stack.segments):
+                a_segs.append(seg)
+                a_masks.append((match[gi, 0] & live[gi])[: seg.n_pad])
+                a_scores.append(scores[gi, 0, : seg.n_pad])
+            agg_partials = collect_shard(aggs, a_segs, a_masks,
+                                         query_parser=self.parser,
+                                         scores=a_scores)
+        # the stacked lane IS the dense lane (one program instead of G):
+        # dense counters keep their meaning, `stacked` marks the mode
+        self.last_query_path = "dense"
+        self.last_dense_mode = "stacked"
+        self.dense_queries += 1
+        self._bump("dense")
+        self._bump("stacked")
+        self._bump("stacked_dispatches")
+        from ..common.metrics import record_shard_fetches
+        record_shard_fetches(1)
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_path("stacked")
+        return QuerySearchResult(
+            shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
+            sort_values=None, total_hits=np.asarray(got["total"], np.int64),
+            max_score=max_score, aggs=agg_partials)
 
     # -- kNN (exact, MXU matmul — ops/knn.py) ------------------------------
 
@@ -312,10 +457,12 @@ class ShardSearcher:
         best_keys = np.full((Q, k), -1, np.int64)
         total = np.zeros((Q,), np.int64)
 
-        for seg_idx, seg in enumerate(self.segments):
+        n_fetches = 0
+        for seg_idx, seg in self.live_segments:
             vc = seg.vectors.get(field)
-            if vc is None or seg.n_docs == 0:
+            if vc is None:
                 continue
+            self._bump("segment_dispatches")
             live = seg.live
             if filter_node is not None:
                 stats = self.build_stats(filter_node, None)
@@ -331,6 +478,7 @@ class ShardSearcher:
                 else jnp.broadcast_to(live.sum(), (Q,))
             # ONE fetch per segment (a tunneled chip pays RTT per sync)
             top, idx, seg_tot = device_fetch((top, idx, live_tot))
+            n_fetches += 1
             total += np.asarray(seg_tot)
             seg_keys = np.where(np.isfinite(top),
                                 (np.int64(seg_idx) << SEG_SHIFT)
@@ -343,6 +491,8 @@ class ShardSearcher:
 
         mx = np.where(np.isfinite(best_scores[:, 0]), best_scores[:, 0], np.nan)
         best_scores = np.where(best_keys >= 0, best_scores, np.nan)
+        from ..common.metrics import record_shard_fetches
+        record_shard_fetches(n_fetches)
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=None, total_hits=total, max_score=mx)

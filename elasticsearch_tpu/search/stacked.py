@@ -1,0 +1,696 @@
+"""Segment-stacked dense execution: one device program over a shard's stack.
+
+The dense query phase used to pay one kernel dispatch AND one host
+`device_fetch` round-trip per segment, sequentially — ~G serialized device
+RTTs per shard per query batch on any index that hasn't been force-merged.
+This module packs a shard's live segments into pow2-bucketed stacked tensors
+with a leading segment axis `[G_pad, ...]` (the packing idiom of
+parallel/packed.py, applied to segments instead of shards), executes the
+parsed DSL tree ONCE over the stack (vmap / leading-axis broadcast), and
+fuses per-segment totals, the masked row-max and the cross-segment top-k
+merge into one jitted reduce — so the whole shard comes down to host in ONE
+`device_fetch` instead of one per segment.
+
+Shapes are pow2-bucketed on every axis (G_pad segments, N_pad docs, P_pad
+postings) so refresh→query cycles that stay inside the same bucket reuse
+every jit cache entry — zero retraces (tests/test_no_retrace.py tripwire).
+
+Node coverage: the columnar/text node types that dominate dense traffic
+(match/term/terms/range/exists/ids/bool/constant_score/dis_max/boosting)
+execute natively over the stack via vmapped kernels. Every OTHER node type
+goes through `_generic_exec`, which runs the node's ordinary per-segment
+`execute` and stacks the padded results — per-node dispatches stay
+per-segment for those, but the query still performs exactly one
+`device_fetch` per shard (the reduce below). Sorted / search_after paths
+and oversized stacks fall back to the per-segment loop entirely
+(search/shard_searcher.py).
+
+The packed stack itself is cached on the PR-3 Cache core
+(indices/cache_service.SegmentStackCache): keyed by (index, shard,
+incarnation, segment-id set), charged to the `fielddata` breaker, and
+invalidated by refresh/merge/`_cache/clear`.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..index.segment import Segment, next_pow2
+from ..ops import bm25
+from .query_dsl import (
+    BoolNode, BoostingNode, ConstantScoreNode, DisMaxNode, ExistsNode,
+    IdsNode, MatchAllNode, MatchNode, MatchNoneNode, Node, RangeNode,
+    SegmentContext, TermFilterNode, _bisect, _coerce_to_column, _next_down,
+    _next_up, _pow2_window,
+)
+
+SEG_SHIFT = 32
+
+
+# ---------------------------------------------------------------------------
+# The stack: a shard's live segments as leading-axis device tensors
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StackedTextField:
+    """One text field across G segments. CSR starts/lens stay host-side per
+    segment (each segment keeps its own term dictionary, exactly like
+    per-segment Lucene term dicts); only the postings payload stacks."""
+    doc_ids: jax.Array               # i32[G_pad, P_pad] (PAD sentinel = n_pad)
+    tf: jax.Array                    # f32[G_pad, P_pad]
+    doc_len: jax.Array               # f32[G_pad, N_pad]
+    max_postings: int = 0
+
+
+@dataclass
+class StackedKeywordField:
+    ords: jax.Array                  # i32[G_pad, N_pad], -1 = missing
+
+
+@dataclass
+class StackedNumericField:
+    vals: jax.Array                  # [G_pad, N_pad] i64 | f64
+    missing: jax.Array               # bool[G_pad, N_pad]
+    dtype: str
+
+
+@dataclass
+class SegmentStack:
+    """Immutable packed view of one shard's live (non-empty) segments.
+
+    `segments[g]` is the source Segment of stack row g and `seg_indices[g]`
+    its index in the searcher's full segment list — the top-k reduce encodes
+    THAT index into doc keys so the fetch phase resolves unchanged.
+    Tombstone liveness is NOT baked in: `live_stack()` re-assembles the
+    [G_pad, N_pad] mask whenever any segment's live_gen moves, so deletes
+    invalidate one device row, never the stack."""
+    segments: tuple                  # live Segments, stack-row order
+    seg_indices: tuple               # original index per stack row
+    g_pad: int
+    n_pad: int
+    text: dict = dc_field(default_factory=dict)
+    keywords: dict = dc_field(default_factory=dict)
+    numerics: dict = dc_field(default_factory=dict)
+    mixed: frozenset = frozenset()   # fields with inconsistent column kinds
+    nbytes: int = 0
+    seg_ids_dev: jax.Array | None = None   # i64[G_pad] original seg index
+
+    def __post_init__(self):
+        self._live_key = None
+        self._live_dev = None
+
+    def live_stack(self) -> jax.Array:
+        """bool[G_pad, N_pad] root-doc liveness; padding rows all-False.
+        Cached on the segments' tombstone generations."""
+        key = tuple(s.live_gen for s in self.segments)
+        if self._live_key != key or self._live_dev is None:
+            arr = np.zeros((self.g_pad, self.n_pad), bool)
+            for gi, seg in enumerate(self.segments):
+                arr[gi, : seg.n_pad] = np.asarray(seg.root_live_host)
+            self._live_dev = jnp.asarray(arr)
+            self._live_key = key
+        return self._live_dev
+
+
+def _field_kinds(segments: Sequence[Segment]):
+    text, kw, num = set(), set(), set()
+    for seg in segments:
+        text.update(seg.text)
+        kw.update(seg.keywords)
+        num.update(seg.numerics)
+    mixed = (text & kw) | (text & num) | (kw & num)
+    return text, kw, num, mixed
+
+
+def estimate_stack_bytes(segments: Sequence[Segment]) -> int:
+    """Device bytes a stack over `segments` will occupy — the pre-build
+    breaker charge. Mirrors build_stack()'s allocation arithmetic exactly
+    so charge and weigher stay balanced."""
+    live = [s for s in segments if s.n_docs > 0]
+    if not live:
+        return 0
+    g_pad = next_pow2(len(live), floor=1)
+    n_pad = max(s.n_pad for s in live)
+    text, kw, num, _ = _field_kinds(live)
+    total = g_pad * n_pad + g_pad * 8          # live mask + seg ids
+    for f in text:
+        p_pad = next_pow2(max((s.text[f].n_postings for s in live
+                               if f in s.text), default=1), floor=8)
+        total += g_pad * (p_pad * 8 + n_pad * 4)   # doc_ids+tf, doc_len
+    total += len(kw) * g_pad * n_pad * 4
+    total += len(num) * g_pad * n_pad * 9          # vals(8) + missing(1)
+    return total
+
+
+def build_stack(segments: Sequence[Segment]) -> SegmentStack | None:
+    """Pack live segments into the stacked tensors. Empty segments are
+    skipped HERE, once, instead of being re-checked inside every query's
+    loop. Returns None when there is nothing live to stack."""
+    rows = [(i, s) for i, s in enumerate(segments) if s.n_docs > 0]
+    if not rows:
+        return None
+    live = [s for _, s in rows]
+    g = len(rows)
+    g_pad = next_pow2(g, floor=1)
+    n_pad = max(s.n_pad for s in live)
+    text_f, kw_f, num_f, mixed = _field_kinds(live)
+    nbytes = g_pad * n_pad + g_pad * 8
+
+    text: dict[str, StackedTextField] = {}
+    for f in sorted(text_f):
+        p_max = max((s.text[f].n_postings for s in live if f in s.text),
+                    default=1)
+        p_pad = next_pow2(p_max, floor=8)
+        doc_ids = np.full((g_pad, p_pad), n_pad, np.int32)   # PAD sentinel
+        tf = np.zeros((g_pad, p_pad), np.float32)
+        doc_len = np.ones((g_pad, n_pad), np.float32)        # 1.0: no div-0
+        for gi, seg in enumerate(live):
+            fx = seg.text.get(f)
+            if fx is None:
+                continue
+            P = fx.n_postings
+            if P:
+                src = fx.doc_ids_host if fx.doc_ids_host is not None \
+                    else np.asarray(fx.doc_ids)[:P]
+                doc_ids[gi, :P] = src[:P]
+                tf[gi, :P] = np.asarray(fx.tf)[:P]
+            doc_len[gi, : fx.doc_len.shape[0]] = np.asarray(fx.doc_len)
+        text[f] = StackedTextField(doc_ids=jnp.asarray(doc_ids),
+                                   tf=jnp.asarray(tf),
+                                   doc_len=jnp.asarray(doc_len),
+                                   max_postings=p_max)
+        nbytes += g_pad * (p_pad * 8 + n_pad * 4)
+
+    keywords: dict[str, StackedKeywordField] = {}
+    for f in sorted(kw_f):
+        ords = np.full((g_pad, n_pad), -1, np.int32)
+        for gi, seg in enumerate(live):
+            kc = seg.keywords.get(f)
+            if kc is not None:
+                o = np.asarray(kc.ords)
+                ords[gi, : o.shape[0]] = o
+        keywords[f] = StackedKeywordField(ords=jnp.asarray(ords))
+        nbytes += g_pad * n_pad * 4
+
+    numerics: dict[str, StackedNumericField] = {}
+    for f in sorted(num_f):
+        dtypes = {s.numerics[f].dtype for s in live if f in s.numerics}
+        if len(dtypes) > 1:
+            mixed = mixed | {f}      # inconsistent dtype: generic path
+            nbytes += g_pad * n_pad * 9   # keep the estimate arithmetic
+            continue
+        dt = dtypes.pop()
+        vals = np.zeros((g_pad, n_pad),
+                        np.int64 if dt == "i64" else np.float64)
+        missing = np.ones((g_pad, n_pad), bool)
+        for gi, seg in enumerate(live):
+            nc = seg.numerics.get(f)
+            if nc is not None:
+                v = np.asarray(nc.vals)
+                vals[gi, : v.shape[0]] = v
+                missing[gi, : v.shape[0]] = np.asarray(nc.missing)
+        numerics[f] = StackedNumericField(vals=jnp.asarray(vals),
+                                          missing=jnp.asarray(missing),
+                                          dtype=dt)
+        nbytes += g_pad * n_pad * 9
+
+    seg_ids = np.zeros(g_pad, np.int64)
+    seg_ids[:g] = [i for i, _ in rows]
+    return SegmentStack(
+        segments=tuple(live), seg_indices=tuple(i for i, _ in rows),
+        g_pad=g_pad, n_pad=n_pad, text=text, keywords=keywords,
+        numerics=numerics, mixed=frozenset(mixed), nbytes=nbytes,
+        seg_ids_dev=jnp.asarray(seg_ids))
+
+
+# ---------------------------------------------------------------------------
+# Stacked kernels: module-level jitted wrappers (stable compile-cache keys)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def _bm25_stack(doc_ids, tf, doc_len, starts, lens, weights,
+                k1, b, avgdl, *, W: int, n_pad: int):
+    """vmap of the dense BM25 kernel over the segment axis: per-segment CSR
+    pointers [G,Q,T], shared idf weights [Q,T] -> scores f32[G,Q,n_pad]."""
+    def one(di, tfv, dl, st, ln):
+        return bm25.bm25_score_batch(di, tfv, dl, st, ln, weights,
+                                     k1, b, avgdl, W=W, n_pad=n_pad)
+    return jax.vmap(one)(doc_ids, tf, doc_len, starts, lens)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def _classic_stack(doc_ids, tf, doc_len, starts, lens, weights,
+                   *, W: int, n_pad: int):
+    def one(di, tfv, dl, st, ln):
+        return bm25.classic_score_batch(di, tfv, dl, st, ln, weights,
+                                        W=W, n_pad=n_pad)
+    return jax.vmap(one)(doc_ids, tf, doc_len, starts, lens)
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def _term_mask_stack(doc_ids, starts, lens, *, W: int, n_pad: int):
+    def one(di, st, ln):
+        return bm25.term_match_mask(di, st, ln, W=W, n_pad=n_pad)
+    return jax.vmap(one)(doc_ids, starts, lens)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def stacked_reduce(scores, match, live, seg_ids, *, k: int):
+    """The fused shard reduce: liveness gate, per-query totals, masked
+    row-max, per-segment top-k AND the cross-segment merge (one top-k over
+    G·k candidates with segment-encoded keys) — one program, one fetch.
+
+    scores f32[G,Q,N], match bool[G,Q,N], live bool[G,N], seg_ids i64[G]
+    -> (keys i64[Q,k'], top f32[Q,k'], total i64[Q], mx f32[Q])."""
+    m = match & live[:, None, :]
+    total = jnp.sum(m, axis=(0, 2), dtype=jnp.int64)
+    masked = jnp.where(m, scores, -jnp.inf)
+    mx = masked.max(axis=(0, 2))
+    # a single segment holds at most N candidates, but the MERGED winner
+    # list may need up to k of the G·kk candidates
+    kk = min(k, scores.shape[2])
+    top, idx = jax.lax.top_k(masked, kk)                     # [G,Q,kk]
+    keys = jnp.where(top > -jnp.inf,
+                     (seg_ids[:, None, None] << SEG_SHIFT)
+                     | idx.astype(jnp.int64),
+                     jnp.int64(-1))
+    Q = scores.shape[1]
+    # candidate order = segment order then within-segment rank — the same
+    # tie order the per-segment loop's stable merge produces, and
+    # lax.top_k keeps the lower-index (earlier) candidate on equal scores
+    cand_s = jnp.moveaxis(top, 0, 1).reshape(Q, -1)          # [Q, G*kk]
+    cand_k = jnp.moveaxis(keys, 0, 1).reshape(Q, -1)
+    best, pos = jax.lax.top_k(cand_s, min(k, cand_s.shape[1]))
+    return (jnp.take_along_axis(cand_k, pos, axis=1), best, total, mx)
+
+
+# ---------------------------------------------------------------------------
+# Stacked tree execution
+# ---------------------------------------------------------------------------
+
+class StackedContext:
+    """Binds a compiled query batch to one shard's SegmentStack — the
+    stacked analog of SegmentContext."""
+
+    def __init__(self, stack: SegmentStack, n_queries: int, stats):
+        self.stack = stack
+        self.Q = n_queries
+        self.stats = stats
+
+    @property
+    def n_pad(self) -> int:
+        return self.stack.n_pad
+
+    @property
+    def g_pad(self) -> int:
+        return self.stack.g_pad
+
+
+def _zeros(ctx: StackedContext):
+    return jnp.zeros((ctx.g_pad, ctx.Q, ctx.n_pad), jnp.float32)
+
+
+def _false(ctx: StackedContext):
+    return jnp.zeros((ctx.g_pad, ctx.Q, ctx.n_pad), bool)
+
+
+def _true(ctx: StackedContext):
+    return jnp.ones((ctx.g_pad, ctx.Q, ctx.n_pad), bool)
+
+
+def execute_tree(node: Node, ctx: StackedContext):
+    """-> (scores f32[G_pad,Q,N_pad], match bool[G_pad,Q,N_pad]). Node
+    types without a stacked handler run their ordinary per-segment execute
+    and stack the padded results (_generic_exec) — the final reduce/fetch
+    stays fused either way."""
+    h = _EXEC.get(type(node))
+    if h is None:
+        return _generic_exec(node, ctx)
+    from ..common.metrics import current_profiler
+    prof = current_profiler()
+    if prof is None:
+        return h(node, ctx)
+    t0 = time.perf_counter()
+    out = h(node, ctx)
+    prof.record_node(type(node).__name__, "score",
+                     (time.perf_counter() - t0) * 1000)
+    return out
+
+
+def match_tree(node: Node, ctx: StackedContext):
+    """Filter-context stacked evaluation (the match_mask analog)."""
+    h = _MATCH.get(type(node))
+    if h is None:
+        return execute_tree(node, ctx)[1]
+    return h(node, ctx)
+
+
+def _generic_exec(node: Node, ctx: StackedContext):
+    """Universal fallback: per-segment execute, results padded to the
+    bucket and stacked. Costs per-segment dispatches for THIS node only;
+    totals/top-k/fetch stay fused at the shard level."""
+    stack, Q, N = ctx.stack, ctx.Q, ctx.n_pad
+    rows_s, rows_m = [], []
+    for seg in stack.segments:
+        s, m = node.execute(SegmentContext(seg, Q, ctx.stats))
+        pad = N - seg.n_pad
+        if pad:
+            s = jnp.pad(s, ((0, 0), (0, pad)))
+            m = jnp.pad(m, ((0, 0), (0, pad)))
+        rows_s.append(s)
+        rows_m.append(m)
+    for _ in range(stack.g_pad - len(stack.segments)):
+        rows_s.append(jnp.zeros((Q, N), jnp.float32))
+        rows_m.append(jnp.zeros((Q, N), bool))
+    return jnp.stack(rows_s), jnp.stack(rows_m)
+
+
+# -- leaf handlers -----------------------------------------------------------
+
+def _h_match_all(node: MatchAllNode, ctx):
+    return jnp.full((ctx.g_pad, ctx.Q, ctx.n_pad), node.boost,
+                    jnp.float32), _true(ctx)
+
+
+def _h_match_none(node: MatchNoneNode, ctx):
+    return _zeros(ctx), _false(ctx)
+
+
+def _match_host(node: MatchNode, ctx: StackedContext):
+    """Per-segment CSR pointers with a leading G axis + the shared
+    (stats-derived, segment-independent) idf weights."""
+    stack, Q = ctx.stack, ctx.Q
+    T = max((len(t) for t in node.terms_per_query), default=1) or 1
+    starts = np.zeros((stack.g_pad, Q, T), np.int32)
+    lens = np.zeros((stack.g_pad, Q, T), np.int32)
+    weights = np.zeros((Q, T), np.float32)
+    n_terms = np.zeros((Q,), np.int32)
+    for gi, seg in enumerate(stack.segments):
+        s_, l_, w_, n_ = node._host_arrays(SegmentContext(seg, Q, ctx.stats))
+        starts[gi], lens[gi] = s_, l_
+        weights, n_terms = w_, n_
+    return starts, lens, weights, n_terms
+
+
+def _h_match(node: MatchNode, ctx: StackedContext):
+    sf = ctx.stack.text.get(node.field_name)
+    if sf is None:
+        return _zeros(ctx), _false(ctx)
+    starts, lens, weights, n_terms = _match_host(node, ctx)
+    W = _pow2_window(lens)
+    starts_d, lens_d = jnp.asarray(starts), jnp.asarray(lens)
+    if node.sim == "classic":
+        scores = _classic_stack(sf.doc_ids, sf.tf, sf.doc_len,
+                                starts_d, lens_d, jnp.asarray(weights),
+                                W=W, n_pad=ctx.n_pad)
+    else:
+        scores = _bm25_stack(sf.doc_ids, sf.tf, sf.doc_len,
+                             starts_d, lens_d, jnp.asarray(weights),
+                             jnp.float32(node.k1), jnp.float32(node.b),
+                             jnp.float32(ctx.stats.avgdl(node.field_name)),
+                             W=W, n_pad=ctx.n_pad)
+    if node.operator == "and" or node.minimum_should_match > 1:
+        need = np.maximum(node.minimum_should_match, 1) \
+            if node.operator != "and" else n_terms
+        counts = _bm25_stack(sf.doc_ids, jnp.ones_like(sf.tf),
+                             jnp.full_like(sf.doc_len, 1.0),
+                             starts_d, lens_d,
+                             jnp.asarray(np.ones_like(weights)),
+                             jnp.float32(0.0), jnp.float32(0.0),
+                             jnp.float32(1.0), W=W, n_pad=ctx.n_pad)
+        need_arr = jnp.asarray(np.broadcast_to(
+            np.asarray(need, np.float32), (ctx.Q,)))
+        match = counts >= jnp.maximum(need_arr, 1.0)[None, :, None]
+    else:
+        match = scores > 0
+    return jnp.where(match, scores, 0.0), match
+
+
+def _m_match(node: MatchNode, ctx: StackedContext):
+    """Presence-only filter mask (the term_match_mask fast path)."""
+    if node.operator == "and" or node.minimum_should_match > 1:
+        return _h_match(node, ctx)[1]
+    sf = ctx.stack.text.get(node.field_name)
+    if sf is None:
+        return _false(ctx)
+    starts, lens, _, _ = _match_host(node, ctx)
+    return _term_mask_stack(sf.doc_ids, jnp.asarray(starts),
+                            jnp.asarray(lens), W=_pow2_window(lens),
+                            n_pad=ctx.n_pad)
+
+
+def _h_term(node: TermFilterNode, ctx: StackedContext):
+    stack, Q = ctx.stack, ctx.Q
+    f = node.field_name
+    if f in stack.mixed:
+        return _generic_exec(node, ctx)
+    V = max((len(v) for v in node.values_per_query), default=1) or 1
+    kw = stack.keywords.get(f)
+    num = stack.numerics.get(f)
+    if kw is not None:
+        targets = np.full((stack.g_pad, Q, V), -2, np.int64)
+        for gi, seg in enumerate(stack.segments):
+            kc = seg.keywords.get(f)
+            if kc is None:
+                continue
+            for qi, vals in enumerate(node.values_per_query):
+                for vi, v in enumerate(vals):
+                    o = kc.ord_of(str(v))
+                    if o >= 0:
+                        targets[gi, qi, vi] = o
+        col = kw.ords.astype(jnp.int64)
+        match = (col[:, None, :, None]
+                 == jnp.asarray(targets)[:, :, None, :]).any(axis=3)
+    elif num is not None:
+        if num.dtype == "f64":
+            tf64 = np.full((Q, V), np.nan)
+            for qi, vals in enumerate(node.values_per_query):
+                for vi, v in enumerate(vals):
+                    tf64[qi, vi] = float(v)
+            match = (num.vals[:, None, :, None]
+                     == jnp.asarray(tf64)[None, :, None, :]).any(axis=3)
+            match = match & ~num.missing[:, None, :]
+            return jnp.where(match, node.boost, 0.0), match
+        targets = np.full((Q, V), np.iinfo(np.int64).min, np.int64)
+        for qi, vals in enumerate(node.values_per_query):
+            for vi, v in enumerate(vals):
+                targets[qi, vi] = _coerce_to_column(v, num)
+        match = (num.vals[:, None, :, None]
+                 == jnp.asarray(targets)[None, :, None, :]).any(axis=3)
+        match = match & ~num.missing[:, None, :]
+    else:
+        if ctx.stack.text.get(f) is None:
+            return _zeros(ctx), _false(ctx)
+        sub = MatchNode(boost=node.boost, field_name=f,
+                        terms_per_query=[[str(v) for v in vals]
+                                         for vals in node.values_per_query])
+        return _h_match(sub, ctx)
+    return jnp.where(match, jnp.float32(node.boost), 0.0), match
+
+
+def _h_range(node: RangeNode, ctx: StackedContext):
+    stack, Q = ctx.stack, ctx.Q
+    f = node.field_name
+    if f in stack.mixed:
+        return _generic_exec(node, ctx)
+    num = stack.numerics.get(f)
+    kw = stack.keywords.get(f)
+    if num is not None:
+        if num.dtype == "i64":
+            lo_fill, hi_fill = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+            dt = np.int64
+        else:
+            lo_fill, hi_fill = -np.inf, np.inf
+            dt = np.float64
+        los = np.full(Q, lo_fill, dt)
+        his = np.full(Q, hi_fill, dt)
+        for qi, (lo, hi, inc_lo, inc_hi) in enumerate(node.bounds_per_query):
+            if lo is not None:
+                los[qi] = lo if inc_lo else _next_up(lo, dt)
+            if hi is not None:
+                his[qi] = hi if inc_hi else _next_down(hi, dt)
+        match = (num.vals[:, None, :] >= jnp.asarray(los)[None, :, None]) \
+            & (num.vals[:, None, :] <= jnp.asarray(his)[None, :, None]) \
+            & ~num.missing[:, None, :]
+        return jnp.where(match, jnp.float32(node.boost), 0.0), match
+    if kw is not None:
+        los = np.zeros((stack.g_pad, Q), np.int32)
+        his = np.full((stack.g_pad, Q), -1, np.int32)   # default: empty
+        for gi, seg in enumerate(stack.segments):
+            kc = seg.keywords.get(f)
+            if kc is None:
+                continue
+            his[gi, :] = len(kc.values) - 1
+            for qi, (lo, hi, inc_lo, inc_hi) \
+                    in enumerate(node.bounds_per_query):
+                if lo is not None:
+                    i = _bisect(kc.values, str(lo), left=True)
+                    if not inc_lo and i < len(kc.values) \
+                            and kc.values[i] == str(lo):
+                        i += 1
+                    los[gi, qi] = i
+                if hi is not None:
+                    i = _bisect(kc.values, str(hi), left=False) - 1
+                    if not inc_hi and i >= 0 and kc.values[i] == str(hi):
+                        i -= 1
+                    his[gi, qi] = i
+        ords = kw.ords
+        match = (ords[:, None, :] >= jnp.asarray(los)[:, :, None]) \
+            & (ords[:, None, :] <= jnp.asarray(his)[:, :, None]) \
+            & (ords[:, None, :] >= 0)
+        return jnp.where(match, jnp.float32(node.boost), 0.0), match
+    return _zeros(ctx), _false(ctx)
+
+
+def _h_exists(node: ExistsNode, ctx: StackedContext):
+    stack = ctx.stack
+    f = node.field_name
+    if f in stack.mixed:
+        return _generic_exec(node, ctx)
+    num = stack.numerics.get(f)
+    kw = stack.keywords.get(f)
+    sf = stack.text.get(f)
+    if num is not None:
+        match = jnp.broadcast_to(~num.missing[:, None, :],
+                                 (ctx.g_pad, ctx.Q, ctx.n_pad))
+    elif kw is not None:
+        match = jnp.broadcast_to((kw.ords >= 0)[:, None, :],
+                                 (ctx.g_pad, ctx.Q, ctx.n_pad))
+    elif sf is not None:
+        starts = np.zeros((stack.g_pad, 1, 1), np.int32)
+        lens = np.zeros((stack.g_pad, 1, 1), np.int32)
+        for gi, seg in enumerate(stack.segments):
+            fx = seg.text.get(f)
+            if fx is not None:
+                lens[gi, 0, 0] = fx.n_postings
+        W = max(8, 1 << (max(int(lens.max()), 1) - 1).bit_length())
+        hits = _term_mask_stack(sf.doc_ids, jnp.asarray(starts),
+                                jnp.asarray(lens), W=W, n_pad=ctx.n_pad)
+        match = jnp.broadcast_to(hits, (ctx.g_pad, ctx.Q, ctx.n_pad))
+    else:
+        return _zeros(ctx), _false(ctx)
+    return jnp.where(match, jnp.float32(node.boost), 0.0), match
+
+
+def _h_ids(node: IdsNode, ctx: StackedContext):
+    mask = np.zeros((ctx.g_pad, ctx.Q, ctx.n_pad), bool)
+    for gi, seg in enumerate(ctx.stack.segments):
+        for qi, ids in enumerate(node.ids_per_query):
+            for i in ids:
+                local = seg.id_to_local.get(i)
+                if local is not None:
+                    mask[gi, qi, local] = True
+    match = jnp.asarray(mask)
+    return jnp.where(match, jnp.float32(node.boost), 0.0), match
+
+
+# -- structural handlers -----------------------------------------------------
+
+def _h_bool(node: BoolNode, ctx: StackedContext):
+    scores = _zeros(ctx)
+    match = _true(ctx)
+    any_positive = bool(node.must or node.filter)
+    for n in node.must:
+        s, m = execute_tree(n, ctx)
+        scores = scores + s
+        match = match & m
+    for n in node.filter:
+        _, m = execute_tree(n, ctx)
+        match = match & m
+    if node.should:
+        msm = node.minimum_should_match
+        if msm is None:
+            msm = 0 if any_positive else 1
+        should_count = jnp.zeros((ctx.g_pad, ctx.Q, ctx.n_pad), jnp.int32)
+        for n in node.should:
+            s, m = execute_tree(n, ctx)
+            scores = scores + jnp.where(m, s, 0.0)
+            should_count = should_count + m.astype(jnp.int32)
+        if msm > 0:
+            match = match & (should_count >= msm)
+    for n in node.must_not:
+        _, m = execute_tree(n, ctx)
+        match = match & ~m
+    scores = jnp.where(match, scores * node.boost, 0.0)
+    return scores, match
+
+
+def _m_bool(node: BoolNode, ctx: StackedContext):
+    match = _true(ctx)
+    for n in node.must + node.filter:
+        match = match & match_tree(n, ctx)
+    if node.should:
+        msm = node.minimum_should_match
+        if msm is None:
+            msm = 0 if (node.must or node.filter) else 1
+        if msm == 1:
+            any_should = _false(ctx)
+            for n in node.should:
+                any_should = any_should | match_tree(n, ctx)
+            match = match & any_should
+        elif msm > 1:
+            cnt = jnp.zeros((ctx.g_pad, ctx.Q, ctx.n_pad), jnp.int32)
+            for n in node.should:
+                cnt = cnt + match_tree(n, ctx).astype(jnp.int32)
+            match = match & (cnt >= msm)
+    for n in node.must_not:
+        match = match & ~match_tree(n, ctx)
+    return match
+
+
+def _h_const(node: ConstantScoreNode, ctx: StackedContext):
+    m = match_tree(node.inner, ctx)
+    return jnp.where(m, jnp.float32(node.boost), 0.0), m
+
+
+def _m_const(node: ConstantScoreNode, ctx: StackedContext):
+    return match_tree(node.inner, ctx)
+
+
+def _h_dis_max(node: DisMaxNode, ctx: StackedContext):
+    best = _zeros(ctx)
+    total = _zeros(ctx)
+    match = _false(ctx)
+    for n in node.queries:
+        s, m = execute_tree(n, ctx)
+        s = jnp.where(m, s, 0.0)
+        best = jnp.maximum(best, s)
+        total = total + s
+        match = match | m
+    scores = best + node.tie_breaker * (total - best)
+    return jnp.where(match, scores * node.boost, 0.0), match
+
+
+def _h_boosting(node: BoostingNode, ctx: StackedContext):
+    s, m = execute_tree(node.positive, ctx)
+    _, nm = execute_tree(node.negative, ctx)
+    s = jnp.where(nm, s * node.negative_boost, s)
+    return jnp.where(m, s * node.boost, 0.0), m
+
+
+_EXEC = {
+    MatchAllNode: _h_match_all,
+    MatchNoneNode: _h_match_none,
+    MatchNode: _h_match,
+    TermFilterNode: _h_term,
+    RangeNode: _h_range,
+    ExistsNode: _h_exists,
+    IdsNode: _h_ids,
+    BoolNode: _h_bool,
+    ConstantScoreNode: _h_const,
+    DisMaxNode: _h_dis_max,
+    BoostingNode: _h_boosting,
+}
+
+_MATCH = {
+    MatchNode: _m_match,
+    BoolNode: _m_bool,
+    ConstantScoreNode: _m_const,
+}

@@ -59,3 +59,67 @@ def test_second_search_reports_cache_hits(node):
     # dispatches happened and none of them compiled
     assert dev["jit_cache_hits"] >= 1
     assert dev["jit_cache_misses"] == 0
+
+
+# -- stacked dense lane (ISSUE 4) -------------------------------------------
+
+STACKED_BODY = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+@pytest.fixture(scope="module")
+def stacked_node(tmp_path_factory):
+    """One shard, segments added in same-size refresh rounds so every
+    stack axis (G_pad, N_pad, P_pad) stays inside one pow2 bucket."""
+    n = NodeService(str(tmp_path_factory.mktemp("stacked")))
+    n.create_index("s", settings={"number_of_shards": 1},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    n._doc_seq = 0
+
+    def add_segment():
+        for _ in range(40):
+            i = n._doc_seq
+            n._doc_seq += 1
+            n.index_doc("s", str(i),
+                        {"body": f"quick brown fox jumps {i}", "n": i})
+        n.refresh("s")
+    n._add_segment = add_segment
+    yield n
+    n.close()
+
+
+def test_refresh_cycles_within_bucket_zero_retraces(stacked_node):
+    """refresh→query cycles whose stack shapes stay in the same pow2
+    bucket must trigger ZERO new jit compiles on the stacked path."""
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = stacked_node
+    for _ in range(5):                       # 5 segments -> G_pad = 8
+        n._add_segment()
+    _search_s = lambda: n.search("s", json.loads(json.dumps(STACKED_BODY)))
+    _search_s()                              # warm: compiles expected
+    _search_s()
+    assert n.indices["s"].search_stats.get("stacked", 0) >= 2
+    before = device_events_snapshot()[0]
+    for _ in range(2):                       # segments 6 and 7: same bucket
+        n._add_segment()
+        _search_s()
+    assert device_events_snapshot()[0] == before, \
+        "refresh→query cycle inside the pow2 bucket retraced"
+
+
+def test_dense_unsorted_batch_single_fetch_per_shard(stacked_node):
+    """Counter-asserted: a dense unsorted query batch performs exactly one
+    device_fetch per shard on the stacked path."""
+    from elasticsearch_tpu.common.metrics import transfer_snapshot
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))   # warm
+    before = transfer_snapshot()["device_fetches_total"]
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))
+    delta = transfer_snapshot()["device_fetches_total"] - before
+    n_shards = len(n.indices["s"].shards)
+    assert delta == n_shards, \
+        f"{delta} device fetches for {n_shards} shard(s)"

@@ -1,0 +1,423 @@
+"""Segment-stacked dense execution (ISSUE 4): equivalence, single-fetch,
+stack-cache lifecycle, concurrent shard fan-out.
+
+The stacked lane replaces the dense per-segment loop's G serialized
+dispatch+fetch round-trips with ONE stacked program and ONE device_fetch
+per shard. These tests pin the contract:
+
+  * stacked results are bitwise-identical to the per-segment loop across
+    multi-segment fixtures — tombstones, missing fields, Q>1 batches,
+    every supported node type plus generic-fallback nodes;
+  * dense unsorted query batches perform exactly one device_fetch per
+    shard (counter-asserted, not observed);
+  * the packed stack is breaker-charged and invalidated by refresh,
+    merge and `_cache/clear`;
+  * the coordinator fans shards out concurrently while preserving result
+    order and shard-failure accounting;
+  * dead-empty segments leave the engine's segment set at refresh.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search.shard_searcher import ShardSearcher
+
+DOCS = [
+    {"title": "the quick brown fox", "tag": "a", "n": 1, "price": 3.5},
+    {"title": "the quick red fox jumps", "tag": "b", "n": 2},
+    {"title": "lazy brown dog", "tag": "a", "n": 3, "price": 1.25},
+    {"title": "quick quick quick fox", "tag": "b", "n": 4},
+    {"title": "unrelated text entirely", "tag": "a", "n": 5, "price": 9.0},
+    {"title": "fox fox fox fox brown", "tag": "c", "n": 6},
+    {"title": "brown dog sleeps", "tag": "c", "n": 7, "price": 2.0},
+    {"title": "quick dog", "nokw": "x", "n": 8},
+    {"title": "fox and dog and fox", "tag": "a"},        # n missing
+    {"body": "different field here", "tag": "b", "n": 10},
+]
+
+QUERIES = [
+    {"match_all": {}},
+    # should-scoring: never sparse-eligible -> exercises the dense lane
+    {"bool": {"should": [{"match": {"title": "fox"}},
+                         {"match": {"title": "dog"}}]}},
+    {"bool": {"should": [{"match": {"title": "quick"}}],
+              "filter": [{"range": {"n": {"gte": 2, "lt": 7}}}]}},
+    {"term": {"tag": "a"}},
+    {"terms": {"tag": ["a", "c"]}},
+    {"term": {"n": 4}},
+    {"term": {"price": 2.0}},
+    {"range": {"n": {"gt": 3}}},
+    {"range": {"tag": {"gte": "a", "lte": "b"}}},
+    {"exists": {"field": "price"}},
+    {"exists": {"field": "title"}},
+    {"ids": {"values": ["1", "5", "8"]}},
+    {"constant_score": {"filter": {"term": {"tag": "b"}}, "boost": 2.5}},
+    {"dis_max": {"queries": [{"match": {"title": "fox"}},
+                             {"match": {"title": "dog"}}],
+                 "tie_breaker": 0.4}},
+    {"bool": {"must": [{"match": {"title": "fox"}}],
+              "must_not": [{"term": {"tag": "c"}}],
+              "should": [{"match": {"title": "brown"}}]}},
+    {"bool": {"should": [{"match": {"title": {"query": "fox brown",
+                                              "operator": "and"}}}]}},
+    # generic-fallback node types (no typed stacked handler): the stacked
+    # lane must still produce identical results through _generic_exec
+    {"prefix": {"title": "qu"}},
+    {"bool": {"should": [{"wildcard": {"title": "f*x"}}]}},
+    {"function_score": {"query": {"match": {"title": "fox"}},
+                        "field_value_factor": {"field": "n",
+                                               "missing": 1.0}}},
+]
+
+
+def build_searcher(n_segments=3, tombstone=None, **kw):
+    ms = MapperService()
+    mapper = ms.document_mapper("_doc")
+    builders = [SegmentBuilder(seg_id=i) for i in range(n_segments)]
+    for i, d in enumerate(DOCS):
+        builders[i % n_segments].add(mapper.parse(d, doc_id=str(i)), "_doc")
+    segs = [b.build() for b in builders]
+    if tombstone is not None:
+        for seg in segs:
+            local = seg.id_to_local.get(tombstone)
+            if local is not None:
+                seg.delete_local(local)
+    return ShardSearcher(0, segs, ms, **kw)
+
+
+def _run(searcher, bodies, size=10, mode=None, aggs=None):
+    node = searcher.parse(bodies)
+    r = searcher.execute_query_phase(node, size=size,
+                                     n_queries=len(bodies), aggs=aggs)
+    if mode is not None:
+        assert searcher.last_dense_mode == mode, \
+            f"expected {mode}, got {searcher.last_dense_mode} " \
+            f"(path {searcher.last_query_path})"
+    return r
+
+
+def _assert_identical(a, b, q):
+    assert np.array_equal(a.doc_keys, b.doc_keys), q
+    # NaN-safe bitwise score compare (empty slots are NaN in both)
+    assert np.array_equal(a.scores.view(np.int32),
+                          b.scores.view(np.int32)), q
+    assert np.array_equal(a.total_hits, b.total_hits), q
+    assert np.array_equal(a.max_score.view(np.int32),
+                          b.max_score.view(np.int32)), q
+
+
+class TestStackedEquivalence:
+    @pytest.mark.parametrize("q", QUERIES,
+                             ids=[json.dumps(q)[:48] for q in QUERIES])
+    def test_bitwise_identical_to_loop(self, q):
+        s = build_searcher(n_segments=3)
+        stacked = _run(s, [q])
+        if s.last_query_path != "dense":
+            pytest.skip("query rides the sparse lane")
+        assert s.last_dense_mode == "stacked"
+        s.stacked_enabled = False
+        loop = _run(s, [q], mode="loop")
+        _assert_identical(stacked, loop, q)
+
+    @pytest.mark.parametrize("q", QUERIES[:8],
+                             ids=[json.dumps(q)[:48] for q in QUERIES[:8]])
+    def test_tombstones_identical(self, q):
+        s = build_searcher(n_segments=3, tombstone="1")
+        s2 = build_searcher(n_segments=3, tombstone="1")
+        stacked = _run(s, [q])
+        if s.last_query_path != "dense":
+            pytest.skip("query rides the sparse lane")
+        s2.stacked_enabled = False
+        loop = _run(s2, [q])
+        _assert_identical(stacked, loop, q)
+        # the tombstoned doc never surfaces
+        keys = [int(k) for k in stacked.doc_keys[0] if k >= 0]
+        hits = s.execute_fetch_phase(keys)
+        assert "1" not in [h.doc_id for h in hits]
+
+    def test_batched_rows_identical(self):
+        """Q>1 batches: each row keeps its own terms/bounds."""
+        bodies = [{"bool": {"should": [{"match": {"title": "fox"}}],
+                            "filter": [{"range": {"n": {"gte": 1}}}]}},
+                  {"bool": {"should": [{"match": {"title": "dog brown"}}],
+                            "filter": [{"range": {"n": {"lte": 6}}}]}},
+                  {"bool": {"should": [{"match": {"title": "quick"}}],
+                            "filter": [{"range": {"n": {"lte": 4}}}]}}]
+        s = build_searcher(n_segments=3)
+        stacked = _run(s, bodies, mode="stacked")
+        s.stacked_enabled = False
+        loop = _run(s, bodies, mode="loop")
+        _assert_identical(stacked, loop, bodies)
+
+    def test_single_segment_stack(self):
+        s = build_searcher(n_segments=1)
+        q = {"bool": {"should": [{"match": {"title": "fox"}},
+                                 {"match": {"title": "dog"}}]}}
+        stacked = _run(s, [q], mode="stacked")
+        s.stacked_enabled = False
+        loop = _run(s, [q], mode="loop")
+        _assert_identical(stacked, loop, q)
+
+    def test_aggregations_ride_the_stack(self):
+        from elasticsearch_tpu.search.aggs import (merge_shard_partials,
+                                                   parse_aggs, render)
+        specs = parse_aggs({"tags": {"terms": {"field": "tag"}},
+                            "avg_n": {"avg": {"field": "n"}}})
+        q = {"bool": {"should": [{"match": {"title": "fox"}},
+                                 {"match": {"title": "dog"}}]}}
+        s = build_searcher(n_segments=3)
+        stacked = _run(s, [q], mode="stacked", aggs=specs)
+        s.stacked_enabled = False
+        loop = _run(s, [q], mode="loop", aggs=specs)
+        out_a = render(specs, merge_shard_partials(specs, [stacked.aggs]))
+        out_b = render(specs, merge_shard_partials(specs, [loop.aggs]))
+        assert out_a == out_b
+        assert out_a["tags"]["buckets"]
+
+    def test_deep_pagination_crosses_segment_capacity(self):
+        """k above one segment's n_pad must return winners from EVERY
+        segment — the cross-segment merge takes up to k of the G*kk
+        candidates (regression: the first cut truncated at n_pad)."""
+        s = build_searcher(n_segments=3)
+        q = {"match_all": {}}
+        stacked = _run(s, [q], size=100, mode="stacked")
+        live = sum(seg.live_count for seg in s.segments)
+        assert int((stacked.doc_keys[0] >= 0).sum()) == live
+        s.stacked_enabled = False
+        loop = _run(s, [q], size=100, mode="loop")
+        _assert_identical(stacked, loop, q)
+
+
+class TestSingleFetch:
+    def test_one_device_fetch_per_shard(self):
+        """Dense unsorted batches pay EXACTLY one device_fetch per shard."""
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        s = build_searcher(n_segments=4)
+        node = s.parse([{"bool": {"should": [
+            {"match": {"title": "fox"}}, {"match": {"title": "dog"}}]}}])
+        s.execute_query_phase(node, size=5)          # warm compiles
+        before = transfer_snapshot()["device_fetches_total"]
+        s.execute_query_phase(node, size=5)
+        after = transfer_snapshot()["device_fetches_total"]
+        assert after - before == 1, \
+            f"{after - before} fetches for one shard's dense query"
+        assert s.last_dense_mode == "stacked"
+
+    def test_loop_pays_per_segment(self):
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        s = build_searcher(n_segments=4, stacked=False)
+        node = s.parse([{"bool": {"should": [
+            {"match": {"title": "fox"}}, {"match": {"title": "dog"}}]}}])
+        s.execute_query_phase(node, size=5)
+        before = transfer_snapshot()["device_fetches_total"]
+        s.execute_query_phase(node, size=5)
+        after = transfer_snapshot()["device_fetches_total"]
+        assert after - before == len(s.live_segments)
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(str(tmp_path / "node"))
+    yield n
+    n.close()
+
+
+def _fill_multiseg(n, name, shards=1, rounds=3, per_round=8):
+    n.create_index(name, settings={"number_of_shards": shards},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "tag": {"type": "string", "index": "not_analyzed"},
+                       "n": {"type": "long"}}}})
+    di = 0
+    for _ in range(rounds):
+        for _ in range(per_round):
+            n.index_doc(name, str(di),
+                        {"body": f"quick brown fox {di}",
+                         "tag": f"t{di % 3}", "n": di})
+            di += 1
+        n.refresh(name)
+    return di
+
+
+DENSE_Q = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+class TestStackCacheLifecycle:
+    def test_breaker_charged_and_released(self, node):
+        _fill_multiseg(node, "t")
+        br = node.breakers.breaker("fielddata")
+        used0 = br.used
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        st = node.caches.segment_stacks.stats()
+        assert st["entries"] == 1
+        assert st["memory_size_in_bytes"] > 0
+        assert br.used >= used0 + st["memory_size_in_bytes"]
+        cleared = node.caches.clear(query=True)
+        assert cleared["segment_stack"] == 1
+        assert node.caches.segment_stacks.stats()["entries"] == 0
+        assert br.used <= used0 + 1   # charge handed back on removal
+
+    def test_refresh_invalidates(self, node):
+        _fill_multiseg(node, "t")
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.segment_stacks.stats()["entries"] == 1
+        node.index_doc("t", "zzz", {"body": "new doc", "n": 999})
+        node.refresh("t")
+        # the old segment set's stack died with the refresh
+        assert node.caches.segment_stacks.stats()["entries"] == 0
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.segment_stacks.stats()["entries"] == 1
+
+    def test_merge_invalidates(self, node):
+        _fill_multiseg(node, "t")
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        node.force_merge("t")
+        assert node.caches.segment_stacks.stats()["entries"] == 0
+        out = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert out["hits"]["total"] > 0
+
+    def test_cache_clear_http(self, node, tmp_path):
+        from elasticsearch_tpu.rest import HttpServer
+        import http.client
+        _fill_multiseg(node, "t")
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.segment_stacks.stats()["entries"] == 1
+        server = HttpServer(node, port=0).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("POST", "/t/_cache/clear?query=true")
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200
+            assert out["cleared"]["segment_stack"] == 1
+        finally:
+            server.stop()
+        assert node.caches.segment_stacks.stats()["entries"] == 0
+
+    def test_stacked_opt_out_setting(self, node):
+        node.create_index("off", settings={
+            "number_of_shards": 1, "index.search.stacked.enable": False})
+        node.index_doc("off", "1", {"body": "quick fox"})
+        node.refresh("off")
+        node.search("off", json.loads(json.dumps(DENSE_Q)))
+        assert node.indices["off"].search_stats.get("stacked", 0) == 0
+
+    def test_delete_delta_invalidate_via_live_gen(self, node):
+        """Deletes don't rebuild the stack — liveness refreshes in place."""
+        _fill_multiseg(node, "t")
+        out1 = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        total1 = out1["hits"]["total"]
+        node.delete_doc("t", "0")
+        node.refresh_doc_shard("t", "0")   # tombstone without full refresh
+        node.indices["t"].refresh()
+        out2 = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert out2["hits"]["total"] == total1 - 1
+        ids = [h["_id"] for h in out2["hits"]["hits"]]
+        assert "0" not in ids
+
+
+class TestConcurrentFanOut:
+    def test_result_order_preserved(self, node):
+        """Multi-shard fan-out returns the same response as 5 repeats."""
+        _fill_multiseg(node, "t", shards=4, rounds=2, per_round=16)
+        body = {"size": 20, "query": {"bool": {
+            "should": [{"match": {"body": "quick"}},
+                       {"match": {"body": "fox"}}]}},
+            "sort": [{"n": {"order": "desc"}}]}
+        first = node.search("t", json.loads(json.dumps(body)))
+        assert first["_shards"] == {"total": 4, "successful": 4, "failed": 0}
+        order = [h["_id"] for h in first["hits"]["hits"]]
+        assert order == sorted(order, key=int, reverse=True)
+        for _ in range(5):
+            again = node.search("t", json.loads(json.dumps(body)))
+            assert [h["_id"] for h in again["hits"]["hits"]] == order
+            assert again["hits"]["total"] == first["hits"]["total"]
+
+    def test_shard_failure_accounting(self, node, monkeypatch):
+        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=12)
+        searchers = node.indices["t"].searchers()
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected shard failure")
+        monkeypatch.setattr(searchers[1], "execute_query_phase", boom)
+        out = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert out["_shards"]["total"] == 3
+        assert out["_shards"]["failed"] == 1
+        assert out["_shards"]["successful"] == 2
+        assert "injected shard failure" in \
+            out["_shards"]["failures"][0]["reason"]
+        # surviving shards still contribute hits
+        assert out["hits"]["total"] > 0
+
+    def test_all_shards_failing_raises(self, node, monkeypatch):
+        _fill_multiseg(node, "t", shards=2, rounds=1, per_round=4)
+        for s in node.indices["t"].searchers():
+            monkeypatch.setattr(s, "execute_query_phase",
+                                lambda *a, **kw: (_ for _ in ()).throw(
+                                    RuntimeError("total loss")))
+        with pytest.raises(RuntimeError, match="total loss"):
+            node.search("t", json.loads(json.dumps(DENSE_Q)))
+
+    def test_profile_survives_concurrency(self, node):
+        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=9)
+        body = {"profile": True, **json.loads(json.dumps(DENSE_Q))}
+        out = node.search("t", body)
+        prof = out["profile"]
+        real = [s for s in prof["shards"] if s["index"] == "t"]
+        assert len(real) == 3
+        for s in real:
+            assert s["query"], "per-shard node timings survived fan-out"
+        assert prof["device"]["query_paths"].get("stacked", 0) >= 1
+
+
+class TestDeadSegments:
+    def test_dead_empty_segment_dropped_at_refresh(self, node):
+        node.create_index("d", settings={"number_of_shards": 1})
+        for i in range(4):
+            node.index_doc("d", f"a{i}", {"body": f"first batch {i}"})
+        node.refresh("d")
+        for i in range(4):
+            node.index_doc("d", f"b{i}", {"body": f"second batch {i}"})
+        node.refresh("d")
+        eng = node.indices["d"].shards[0]
+        assert len(eng.segments) == 2
+        for i in range(4):       # tombstone the whole first segment
+            node.delete_doc("d", f"a{i}")
+        node.refresh("d")
+        assert all(s.live_count > 0 for s in eng.segments)
+        assert len(eng.segments) == 1
+        out = node.search("d", {"query": {"match_all": {}}, "size": 10})
+        assert out["hits"]["total"] == 4
+
+    def test_breaker_released_for_dead_segment(self, node):
+        node.create_index("d", settings={"number_of_shards": 1})
+        br = node.breakers.breaker("fielddata")
+        for i in range(4):
+            node.index_doc("d", f"a{i}", {"body": f"doc {i}"})
+        node.refresh("d")
+        used_full = br.used
+        for i in range(4):
+            node.delete_doc("d", f"a{i}")
+        node.refresh("d")
+        assert br.used < used_full
+
+
+class TestStackedMetrics:
+    def test_dispatch_counters_and_fetch_histogram(self, node):
+        _fill_multiseg(node, "t")
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        from elasticsearch_tpu.common.metrics import render_openmetrics
+        text = render_openmetrics(node.metric_sections())
+        assert "es_search_stacked_dispatches_total" in text
+        assert "es_search_segment_dispatches_total" in text
+        assert "es_search_fetches_count_total" in text
+        # the stacked query registered exactly one fetch bucket sample
+        assert 'fetches_per_query="1"' in text
+        st = node.stats()["caches"]["segment_stack"]
+        assert st["entries"] == 1

@@ -26,6 +26,7 @@ Design notes (TPU-first deviations from the reference, on purpose):
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -118,6 +119,11 @@ class ClusterNode:
         # header on shard messages; ref tasks/TaskManager + TaskId)
         from ..common.tasks import TaskManager
         self.tasks = TaskManager(node_id)
+        # span tracer: shard subtrees on copy-holders continue the
+        # coordinator's trace via the `_trace` wire header (partial traces
+        # land in THIS node's ring under the same trace id)
+        from ..common.tracing import Tracer
+        self.tracer = Tracer()
         for action, handler in [
                 (A_JOIN, self._on_join), (A_PING, self._on_ping),
                 (A_NODE_FAILED, self._on_node_failed),
@@ -1391,6 +1397,14 @@ class ClusterNode:
         return {"parent": task.id, "trace": task.trace_id,
                 "opaque": task.opaque_id}
 
+    @staticmethod
+    def _trace_header() -> dict | None:
+        """The `_trace` wire header (next to `_task`): the active span's
+        (trace id, span id), so the copy-holder's shard subtree parents
+        under the coordinator's span. None when nothing is traced."""
+        from ..common import tracing
+        return tracing.wire_header()
+
     def search(self, index: str, body: dict | None = None,
                preference: str | None = None,
                scroll: str | None = None) -> dict:
@@ -1431,7 +1445,8 @@ class ClusterNode:
         for ti, (node, name, sid) in enumerate(targets):
             payload = {"index": name, "shard": sid, "body": body,
                        "size": size + from_, "dfs": dfs,
-                       "_task": self._task_header(task)}
+                       "_task": self._task_header(task),
+                       "_trace": self._trace_header()}
             try:
                 per_shard.append(
                     (ti, self._shard_call(node, A_QUERY, payload)))
@@ -1497,6 +1512,7 @@ class ClusterNode:
                        "query": body.get("query")}
             if task is not None:
                 payload["_task"] = self._task_header(task)
+                payload["_trace"] = self._trace_header()
             try:
                 fr = self._shard_call(node, A_FETCH, payload)
             except (ConnectTransportException, RemoteTransportException):
@@ -1571,15 +1587,24 @@ class ClusterNode:
                 sid, eng.segments, self._mappers[index]))
         return holder.searcher[1]
 
+    @contextlib.contextmanager
     def _shard_task_scope(self, action: str, req: dict):
         """Register the shard-level action under the coordinator task the
         message carries (remote copy-holders show the coordinator as
-        parent — TaskId-over-the-wire semantics)."""
+        parent — TaskId-over-the-wire semantics). When the message also
+        carries a `_trace` header, the shard phase records a local span
+        subtree continuing the coordinator's trace."""
         hdr = req.get("_task") or {}
-        return self.tasks.scope(
-            action, description=f"shard [{req['index']}][{req['shard']}]",
-            parent_task_id=hdr.get("parent"), trace_id=hdr.get("trace"),
-            opaque_id=hdr.get("opaque"))
+        desc = f"shard [{req['index']}][{req['shard']}]"
+        with self.tasks.scope(
+                action, description=desc,
+                parent_task_id=hdr.get("parent"),
+                trace_id=hdr.get("trace"),
+                opaque_id=hdr.get("opaque")) as task:
+            with self.tracer.remote(req.get("_trace"), action,
+                                    attrs={"description": desc,
+                                           "node": self.node_id}):
+                yield task
 
     def _on_query(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))

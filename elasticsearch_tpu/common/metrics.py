@@ -262,12 +262,14 @@ def note_h2d(nbytes: int) -> None:
     RequestProfiler when one is installed. Hot paths call this at their
     upload points so the scrape sees every transfer, not just profiled
     requests."""
+    from . import tracing
     n = int(nbytes)
     with _DEVICE_LOCK:
         _DEVICE_EVENTS["h2d_bytes"] += n
     prof = _PROFILER.get()
     if prof is not None:
         prof.note_h2d(n)
+    tracing.note_h2d(n)
 
 
 def _nbytes(x) -> int:
@@ -282,8 +284,12 @@ def device_fetch(x):
     """jax.device_get with per-request accounting: when a profiler is
     active, the fetch counts as one device round-trip and its payload as
     device→host bytes. The hot paths call this INSTEAD of jax.device_get,
-    so `"profile": true` sees every transfer without touching the kernels."""
+    so `"profile": true` sees every transfer without touching the kernels.
+    An active trace additionally gets a timed `device_fetch` span and its
+    bytes in the trace's device section (common/tracing.py)."""
     import jax
+    from . import tracing
+    t0 = tracing.note_fetch_start()
     out = jax.device_get(x)
     nb = _nbytes(out)
     with _DEVICE_LOCK:
@@ -293,6 +299,8 @@ def device_fetch(x):
     if prof is not None:
         prof.note_dispatch()
         prof.note_d2h(nb)
+    if t0 is not None:
+        tracing.note_fetch_end(t0, nb)
     return out
 
 

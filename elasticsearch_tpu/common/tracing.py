@@ -1,0 +1,538 @@
+"""Span-based request tracing: a Dapper-style per-request span tree.
+
+PR 4 made shard execution concurrent (`_ShardJob` fan-out) and collapsed
+segment loops into single stacked dispatches, so a query's wall clock is
+the max over parallel subtrees — flat per-phase timers can no longer say
+where a SPECIFIC slow request's time went (queue-wait vs run, cache miss
+vs stack build, jit compile vs device fetch). This module is the answer
+modern serving stacks converged on (Dapper, as adopted by the OTel
+ecosystem): one trace tree per request, sampled, retained in-process in a
+bounded ring, exportable to standard viewers.
+
+  * `Tracer.request(...)` roots a trace at the trace id the task layer
+    already generates/echoes (common/tasks.py); `span(name, **attrs)` is
+    the in-request instrumentation primitive — a context manager that is
+    a near-free no-op when no trace is active, so the hot path pays one
+    contextvar read when tracing is off or the request wasn't opened.
+  * Propagation is contextvars-native: the coordinator's `_ShardJob`
+    fan-out copies the request context onto the search pool, so shard
+    subtrees parent correctly with no plumbing; `wire_header()` /
+    `Tracer.remote(...)` carry (trace id, parent span id) across the
+    cluster transport as the `_trace` header next to `_task`.
+  * Completed traces land in a ring (`node.tracing.retention`, default
+    256 traces); retention is decided at COMPLETION: `?trace=true`
+    forces, a slowlog hit forces (the request proved itself interesting),
+    otherwise `node.tracing.sample_rate` draws. `node.tracing.enabled:
+    false` removes every span allocation.
+  * Export: the stored trace renders as a nested tree
+    (`GET /_traces/{id}`), Chrome trace-event JSON (`?format=chrome`,
+    loadable in chrome://tracing or Perfetto) and OTLP-shaped span JSON
+    (`?format=otlp`).
+
+Spans carry monotonic-ns timestamps (duration-exact); a wall-clock anchor
+captured at trace start converts to unix nanos for OTLP export.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+
+# (trace, current span) of the running request; copied into shard jobs by
+# the fan-out's contextvars.copy_context() and into transport handlers by
+# Tracer.remote()
+_ACTIVE: ContextVar["tuple[Trace, Span] | None"] = \
+    ContextVar("es_active_trace", default=None)
+
+
+def now_ns() -> int:
+    return time.monotonic_ns()
+
+
+def current_trace() -> "Trace | None":
+    active = _ACTIVE.get()
+    return active[0] if active is not None else None
+
+
+class Span:
+    __slots__ = ("span_id", "parent_id", "name", "start_ns", "end_ns",
+                 "attrs", "thread")
+
+    def __init__(self, span_id: int, parent_id: int | None, name: str,
+                 start_ns: int, attrs: dict):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = start_ns
+        self.attrs = attrs
+        self.thread = threading.get_ident()
+
+
+class Trace:
+    """One in-flight request's span set (flat, parent-linked; the tree is
+    built at render time). Span appends cross threads (the shard fan-out),
+    so they serialize on a lock; device counters accumulate here so the
+    stored trace carries its own device section."""
+
+    __slots__ = ("trace_id", "root", "spans", "max_spans", "dropped_spans",
+                 "forced", "slowlogged", "remote_parent", "opaque_id",
+                 "fetches", "d2h_bytes", "h2d_bytes", "_jit0",
+                 "_wall_anchor_ns", "_mono_anchor_ns", "_seq", "_lock")
+
+    def __init__(self, trace_id: str, max_spans: int = 512):
+        self.trace_id = trace_id
+        self.root: Span | None = None
+        self.spans: list[Span] = []
+        self.max_spans = max_spans
+        self.dropped_spans = 0
+        self.forced = False
+        self.slowlogged = False
+        self.remote_parent: int | None = None
+        self.opaque_id: str | None = None
+        self.fetches = 0
+        self.d2h_bytes = 0
+        self.h2d_bytes = 0
+        from .metrics import device_events_snapshot
+        self._jit0 = device_events_snapshot()
+        self._wall_anchor_ns = time.time_ns()
+        self._mono_anchor_ns = time.monotonic_ns()
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def new_span(self, name: str, parent_id: int | None, start_ns: int,
+                 attrs: dict) -> Span | None:
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped_spans += 1
+                return None
+            self._seq += 1
+            span = Span(self._seq, parent_id, name, start_ns, attrs)
+            self.spans.append(span)
+            return span
+
+    def note_fetch(self, nbytes: int) -> None:
+        with self._lock:
+            self.fetches += 1
+            self.d2h_bytes += int(nbytes)
+
+    def note_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+
+    def device_section(self) -> dict:
+        from .metrics import device_events_snapshot
+        compiles, compile_ms = device_events_snapshot()
+        return {"device_fetches": self.fetches,
+                "bytes_device_to_host": self.d2h_bytes,
+                "bytes_host_to_device": self.h2d_bytes,
+                "jit_compiles": compiles - self._jit0[0],
+                "jit_compile_time_in_millis": round(
+                    compile_ms - self._jit0[1], 3)}
+
+    def render(self) -> dict:
+        """The stored (ring) form: plain JSON-safe dict, offsets in µs
+        from the root start so every export derives from one snapshot."""
+        root = self.root
+        t0 = root.start_ns if root is not None else self._mono_anchor_ns
+        spans = []
+        with self._lock:
+            snap = list(self.spans)
+        for s in snap:
+            entry = {"id": s.span_id, "parent_id": s.parent_id,
+                     "name": s.name,
+                     "start_us": round((s.start_ns - t0) / 1e3, 3),
+                     "duration_us": round(
+                         max(s.end_ns - s.start_ns, 0) / 1e3, 3),
+                     "thread": s.thread}
+            if s.attrs:
+                entry["attributes"] = dict(s.attrs)
+            spans.append(entry)
+        out = {"trace_id": self.trace_id,
+               "root": root.name if root is not None else "",
+               "start_time_in_millis": self._wall_anchor_ns // 1_000_000,
+               "start_time_unix_nanos": self._wall_anchor_ns
+               + (t0 - self._mono_anchor_ns),
+               "duration_in_millis": round(
+                   max(root.end_ns - root.start_ns, 0) / 1e6, 3)
+               if root is not None else 0.0,
+               "span_count": len(spans),
+               "dropped_spans": self.dropped_spans,
+               "slowlog": self.slowlogged,
+               "forced": self.forced,
+               "device": self.device_section(),
+               "spans": spans}
+        if self.remote_parent is not None:
+            out["remote_parent_span"] = self.remote_parent
+        if self.opaque_id is not None:
+            out["x_opaque_id"] = self.opaque_id
+        return out
+
+
+# ---------------------------------------------------------------------------
+# in-request instrumentation primitives (module-level: call sites never
+# need a Tracer reference, and every one is a no-op without an active trace)
+# ---------------------------------------------------------------------------
+
+class _SpanCtx:
+    """`with span("name", k=v) as sp:` — class-based (not
+    contextlib.contextmanager) to keep the inactive path allocation-light
+    on seams that run on every request."""
+
+    __slots__ = ("name", "attrs", "start_ns", "_span", "_tok")
+
+    def __init__(self, name: str, start_ns: int | None, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.start_ns = start_ns
+        self._span = None
+        self._tok = None
+
+    def __enter__(self) -> Span | None:
+        active = _ACTIVE.get()
+        if active is None:
+            return None
+        trace, parent = active
+        span = trace.new_span(
+            self.name, parent.span_id if parent is not None else None,
+            self.start_ns if self.start_ns is not None
+            else time.monotonic_ns(), self.attrs)
+        if span is None:            # per-trace span cap: dropped, counted
+            return None
+        self._span = span
+        self._tok = _ACTIVE.set((trace, span))
+        return span
+
+    def __exit__(self, *exc) -> bool:
+        if self._span is not None:
+            self._span.end_ns = time.monotonic_ns()
+            _ACTIVE.reset(self._tok)
+        return False
+
+
+def span(name: str, start_ns: int | None = None, **attrs) -> _SpanCtx:
+    """Open a child span of the current span for the block. `start_ns`
+    backdates the start (the shard-span-covers-queue-wait case)."""
+    return _SpanCtx(name, start_ns, attrs)
+
+
+def add_span(name: str, start_ns: int, end_ns: int, **attrs) -> None:
+    """Record a completed child span with explicit timestamps (phases the
+    caller already timed — queue_wait, parse — need no second timer)."""
+    active = _ACTIVE.get()
+    if active is None:
+        return
+    trace, parent = active
+    sp = trace.new_span(name,
+                        parent.span_id if parent is not None else None,
+                        int(start_ns), attrs)
+    if sp is not None:
+        sp.end_ns = int(end_ns)
+
+
+def add_event(name: str, **attrs) -> None:
+    """Zero-duration marker span (cache evictions, ...)."""
+    t = time.monotonic_ns()
+    add_span(name, t, t, **attrs)
+
+
+def mark_slowlog() -> None:
+    """The request crossed a slowlog threshold: force trace retention so
+    the slowlog entry's trace id always resolves in `GET /_traces`."""
+    trace = current_trace()
+    if trace is not None:
+        trace.slowlogged = True
+
+
+def note_fetch_start() -> int | None:
+    """ns timestamp when a trace is active, else None — the device_fetch
+    seam's cheap gate."""
+    return time.monotonic_ns() if _ACTIVE.get() is not None else None
+
+
+def note_fetch_end(start_ns: int, nbytes: int) -> None:
+    active = _ACTIVE.get()
+    if active is None:
+        return
+    active[0].note_fetch(nbytes)
+    add_span("device_fetch", start_ns, time.monotonic_ns(), bytes=nbytes)
+
+
+def note_h2d(nbytes: int) -> None:
+    trace = current_trace()
+    if trace is not None:
+        trace.note_h2d(nbytes)
+
+
+def wire_header() -> dict | None:
+    """The `_trace` transport header: (trace id, parent span id) — None
+    when nothing is being traced, so untraced requests add zero bytes."""
+    active = _ACTIVE.get()
+    if active is None:
+        return None
+    trace, span_ = active
+    return {"trace_id": trace.trace_id,
+            "span": span_.span_id if span_ is not None else None}
+
+
+# ---------------------------------------------------------------------------
+# the tracer: per-node roots, sampling, the bounded ring, exports
+# ---------------------------------------------------------------------------
+
+def _as_bool(v, default: bool) -> bool:
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off")
+    return bool(v)
+
+
+class Tracer:
+    """Node-level trace store. Settings (all live at node boot):
+
+      node.tracing.enabled      default true — false removes every span
+      node.tracing.sample_rate  default 1.0 — retention probability for
+                                traces that neither forced nor slowlogged
+      node.tracing.retention    default 256 — finished-trace ring size
+      node.tracing.max_spans    default 512 — per-trace span cap; beyond
+                                it spans drop (counted), the trace survives
+    """
+
+    def __init__(self, settings=None, rng=None):
+        get = settings.get if settings is not None else \
+            (lambda k, d=None: d)
+        self.enabled = _as_bool(get("node.tracing.enabled"), True)
+        try:
+            self.sample_rate = float(get("node.tracing.sample_rate", 1.0))
+        except (TypeError, ValueError):
+            self.sample_rate = 1.0
+        try:
+            retention = int(get("node.tracing.retention", 256))
+        except (TypeError, ValueError):
+            retention = 256
+        try:
+            self.max_spans = int(get("node.tracing.max_spans", 512))
+        except (TypeError, ValueError):
+            self.max_spans = 512
+        self._rng = rng or random.random
+        self._ring: deque = deque(maxlen=max(retention, 1))
+        self._lock = threading.Lock()
+        self.active = 0
+        self.traces_started = 0
+        self.traces_retained = 0
+        self.traces_sampled_out = 0
+        self.dropped_traces = 0        # ring evictions (oldest pushed out)
+        self.dropped_spans = 0
+        self.spans_total = 0
+
+    # -- roots -------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def request(self, name: str, trace_id: str | None = None,
+                force: bool = False, opaque_id: str | None = None,
+                attrs: dict | None = None):
+        """Root a trace for the request (nested roots — warmers,
+        percolate-inner-search — join the surrounding trace as plain
+        spans instead of starting a second one)."""
+        if not self.enabled:
+            yield None
+            return
+        if _ACTIVE.get() is not None:
+            with span(name, **(attrs or {})):
+                yield None
+            return
+        import uuid
+        trace = Trace(trace_id or uuid.uuid4().hex[:16],
+                      max_spans=self.max_spans)
+        trace.forced = bool(force)
+        trace.opaque_id = opaque_id
+        trace.root = trace.new_span(name, None, time.monotonic_ns(),
+                                    dict(attrs or {}))
+        with self._lock:
+            self.active += 1
+            self.traces_started += 1
+        tok = _ACTIVE.set((trace, trace.root))
+        try:
+            yield trace
+        finally:
+            trace.root.end_ns = time.monotonic_ns()
+            _ACTIVE.reset(tok)
+            self._finalize(trace)
+
+    @contextlib.contextmanager
+    def remote(self, header: dict | None, name: str,
+               attrs: dict | None = None):
+        """Continue a trace that crossed the cluster transport: the local
+        subtree roots at the coordinator's (trace id, span id) from the
+        `_trace` wire header and lands in THIS node's ring as a partial
+        trace — `GET /_traces/{id}` on the copy-holder shows its side."""
+        if not self.enabled or not header or not header.get("trace_id"):
+            yield None
+            return
+        trace = Trace(str(header["trace_id"]), max_spans=self.max_spans)
+        trace.forced = True        # explicitly propagated => keep it
+        rp = header.get("span")
+        trace.remote_parent = int(rp) if rp is not None else None
+        trace.root = trace.new_span(name, None, time.monotonic_ns(),
+                                    dict(attrs or {}))
+        with self._lock:
+            self.active += 1
+            self.traces_started += 1
+        tok = _ACTIVE.set((trace, trace.root))
+        try:
+            yield trace
+        finally:
+            trace.root.end_ns = time.monotonic_ns()
+            _ACTIVE.reset(tok)
+            self._finalize(trace)
+
+    def _finalize(self, trace: Trace) -> None:
+        retain = trace.forced or trace.slowlogged \
+            or self.sample_rate >= 1.0 or self._rng() < self.sample_rate
+        with self._lock:
+            self.active -= 1
+            self.spans_total += len(trace.spans)
+            self.dropped_spans += trace.dropped_spans
+            if not retain:
+                self.traces_sampled_out += 1
+                return
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped_traces += 1
+            self._ring.append(trace.render())
+            self.traces_retained += 1
+
+    # -- the REST surface --------------------------------------------------
+
+    def list(self) -> list[dict]:
+        """Newest-first summaries: the `GET /_traces` body."""
+        with self._lock:
+            snap = list(self._ring)
+        return [{"trace_id": t["trace_id"], "root": t["root"],
+                 "start_time_in_millis": t["start_time_in_millis"],
+                 "duration_in_millis": t["duration_in_millis"],
+                 "span_count": t["span_count"],
+                 "slowlog": t["slowlog"]}
+                for t in reversed(snap)]
+
+    def get(self, trace_id: str) -> dict | None:
+        with self._lock:
+            snap = list(self._ring)
+        for t in reversed(snap):
+            if t["trace_id"] == trace_id:
+                return t
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"traces_started_total": self.traces_started,
+                    "traces_retained_total": self.traces_retained,
+                    "traces_sampled_out_total": self.traces_sampled_out,
+                    "dropped_traces_total": self.dropped_traces,
+                    "dropped_spans_total": self.dropped_spans,
+                    "spans_total": self.spans_total,
+                    "active_traces": self.active,
+                    "retained_traces": len(self._ring)}
+
+
+# ---------------------------------------------------------------------------
+# exports: nested tree, Chrome trace-event JSON, OTLP span JSON
+# ---------------------------------------------------------------------------
+
+def span_tree(trace: dict) -> dict:
+    """Stored trace -> nested tree (`GET /_traces/{id}` default body)."""
+    by_id: dict[int, dict] = {}
+    for s in trace["spans"]:
+        by_id[s["id"]] = {**s, "children": []}
+    root = None
+    orphans = []
+    for s in trace["spans"]:
+        node = by_id[s["id"]]
+        pid = s.get("parent_id")
+        if pid is None:
+            if root is None:
+                root = node
+            else:
+                orphans.append(node)
+        elif pid in by_id:
+            by_id[pid]["children"].append(node)
+        else:
+            orphans.append(node)
+    if root is None:
+        root = {"id": 0, "name": trace.get("root", ""), "children": orphans}
+    else:
+        root["children"] = root.get("children", []) + orphans
+    out = {k: v for k, v in trace.items() if k != "spans"}
+    out["tree"] = root
+    return out
+
+
+def chrome_trace(trace: dict) -> dict:
+    """Chrome trace-event JSON (the `?format=chrome` body): complete (X)
+    events with µs timestamps, one tid lane per recording thread —
+    loadable in chrome://tracing and Perfetto as-is."""
+    tid_of: dict[int, int] = {}
+    events: list[dict] = []
+    for s in trace["spans"]:
+        thread = s.get("thread", 0)
+        tid = tid_of.setdefault(thread, len(tid_of) + 1)
+        args = {k: v for k, v in (s.get("attributes") or {}).items()}
+        args["span_id"] = s["id"]
+        if s.get("parent_id") is not None:
+            args["parent_span_id"] = s["parent_id"]
+        events.append({"name": s["name"], "cat": "es", "ph": "X",
+                       "ts": s["start_us"], "dur": s["duration_us"],
+                       "pid": 1, "tid": tid, "args": args})
+    for thread, tid in tid_of.items():
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": tid,
+                       "args": {"name": f"thread-{tid}"}})
+    return {"displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace["trace_id"],
+                          "root": trace["root"]},
+            "traceEvents": events}
+
+
+def _otlp_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def otlp_trace(trace: dict) -> dict:
+    """OTLP-shaped span JSON (the `?format=otlp` body): resourceSpans →
+    scopeSpans → spans with hex ids and unix-nano timestamps."""
+    tid32 = (trace["trace_id"].replace("-", "") + "0" * 32)[:32]
+    anchor = int(trace.get("start_time_unix_nanos",
+                           trace["start_time_in_millis"] * 1_000_000))
+    spans = []
+    for s in trace["spans"]:
+        start = anchor + int(s["start_us"] * 1000)
+        parent = s.get("parent_id")
+        if parent is None and trace.get("remote_parent_span") is not None:
+            parent = trace["remote_parent_span"]
+        entry = {"traceId": tid32,
+                 "spanId": "%016x" % s["id"],
+                 "name": s["name"], "kind": 1,
+                 "startTimeUnixNano": str(start),
+                 "endTimeUnixNano": str(
+                     start + int(s["duration_us"] * 1000)),
+                 "attributes": [
+                     {"key": k, "value": _otlp_value(v)}
+                     for k, v in (s.get("attributes") or {}).items()]}
+        if parent is not None:
+            entry["parentSpanId"] = "%016x" % parent
+        spans.append(entry)
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": "elasticsearch-tpu"}}]},
+        "scopeSpans": [{"scope": {"name": "elasticsearch_tpu.tracing"},
+                        "spans": spans}]}]}

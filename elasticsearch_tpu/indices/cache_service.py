@@ -43,6 +43,7 @@ import threading
 import weakref
 from typing import Any
 
+from ..common import tracing
 from ..common.cache import Cache, RemovalReason, parse_size
 
 # tokens identify segments inside the fielddata cache without pinning the
@@ -89,6 +90,9 @@ class IndicesRequestCache:
             name, {"bytes": 0, "count": 0, "evictions": 0})
 
     def _on_removal(self, key, entry: _RequestEntry, reason: str) -> None:
+        if reason in (RemovalReason.EVICTED, RemovalReason.EXPIRED):
+            tracing.add_event("cache.evict", tier="request", reason=reason,
+                              bytes=entry.nbytes)
         with self._lock:
             for n in entry.names:
                 s = self._slot(n)
@@ -98,7 +102,10 @@ class IndicesRequestCache:
                     s["evictions"] += 1
 
     def get(self, key) -> dict | None:
-        ent = self.cache.get(key)
+        with tracing.span("cache.get", tier="request") as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
         if ent is None:
             return None
         return copy.deepcopy(ent.resp)
@@ -106,7 +113,11 @@ class IndicesRequestCache:
     def put(self, key, names, resp: dict) -> bool:
         entry = _RequestEntry(copy.deepcopy(resp), tuple(names),
                               response_weight(resp))
-        ok = self.cache.put(key, entry)
+        with tracing.span("cache.put", tier="request",
+                          bytes=entry.nbytes) as sp:
+            ok = self.cache.put(key, entry)
+            if sp is not None:
+                sp.attrs["accepted"] = ok
         if ok:
             with self._lock:
                 for n in entry.names:
@@ -160,6 +171,10 @@ class FielddataCache:
                            removal_listener=self._on_removal)
 
     def _on_removal(self, key, entry: _FdEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="fielddata",
+                              reason=reason, field=entry.field,
+                              bytes=entry.nbytes)
         if entry.breaker is not None:
             entry.breaker.release(entry.nbytes)
         with self._lock:
@@ -187,7 +202,11 @@ class FielddataCache:
         (mn, mx, miss, vocab, nbytes) tuple segment sorts consume."""
         token = self.token_of(seg)
         key = (token, field)
-        ent = self.cache.get(key)
+        with tracing.span("cache.get", tier="fielddata",
+                          field=field) as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
         if ent is not None:
             return ent.fd
         breaker = getattr(seg, "breaker", None)
@@ -286,6 +305,9 @@ class SegmentStackCache:
                            removal_listener=self._on_removal)
 
     def _on_removal(self, key, entry: _StackEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="segment_stack",
+                              reason=reason, bytes=entry.nbytes)
         if entry.breaker is not None:
             entry.breaker.release(entry.nbytes)
 
@@ -300,7 +322,11 @@ class SegmentStackCache:
             return None
         key = (index_name, shard_id, incarnation,
                tuple(s.seg_id for s in live))
-        ent = self.cache.get(key)
+        with tracing.span("cache.get", tier="segment_stack",
+                          shard=shard_id) as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
         if ent is not None:
             return ent.stack
         est = stacked_mod.estimate_stack_bytes(live)
@@ -423,13 +449,21 @@ class IndicesCacheService:
         return (index, incarnation, mapping_version, qj)
 
     def get_plan(self, key):
-        return self.query_plan.get(key) if key is not None else None
+        if key is None:
+            return None
+        with tracing.span("cache.get", tier="query_plan") as sp:
+            node = self.query_plan.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = node is not None
+        return node
 
     def put_plan(self, key, node) -> None:
         if key is not None:
             # weight: canonical-JSON size × a small tree-overhead factor —
             # exactness doesn't matter for a host-side tree, bounding does
-            self.query_plan.put(key, node, weight=len(key[3]) * 4 + 256)
+            with tracing.span("cache.put", tier="query_plan"):
+                self.query_plan.put(key, node,
+                                    weight=len(key[3]) * 4 + 256)
 
     # -- roster ------------------------------------------------------------
 

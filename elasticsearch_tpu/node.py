@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any
 
+from .common import tracing
 from .common.settings import Settings
 from .index.engine import (DocumentMissingException, EngineResult,
                            VersionConflictException)
@@ -146,6 +147,11 @@ class NodeService:
         # (ref tasks/TaskManager; GET /_tasks)
         from .common.tasks import TaskManager
         self.tasks = TaskManager("tpu-node-0")
+        # span tracer (common/tracing.py): per-request span trees rooted
+        # at the task trace id, retained in a bounded ring under
+        # node.tracing.* settings — GET /_traces
+        from .common.tracing import Tracer
+        self.tracer = Tracer(self.settings)
         # named bounded executors (ref ThreadPool.java:116); the HTTP layer
         # routes each request class through its pool, overflow -> 429
         from .common.threadpool import ThreadPool
@@ -625,6 +631,7 @@ class NodeService:
                      scroll: str | None = None, scan: bool = False,
                      request_cache: bool | None = None) -> dict:
         t0 = time.perf_counter()
+        tns0 = tracing.now_ns()
         body = body or {}
         if "template" in body and "query" not in body:
             # body-level search template (ref RestSearchTemplateAction when
@@ -712,8 +719,12 @@ class NodeService:
                     QueryParser(self.indices[names[0]].mappers), body)
                 if spec is not None:
                     key = (names[0], size, from_, spec[1], spec[2], spec[3])
-                    out = self._batcher.submit(key, names[0], body, spec,
-                                               size, from_, t0)
+                    with tracing.span("packed_batch", index=names[0]):
+                        # queue wait + the shared device program of the
+                        # coalesced batch (serving/batcher.py): the span
+                        # covers this request's whole stay in the lane
+                        out = self._batcher.submit(key, names[0], body,
+                                                   spec, size, from_, t0)
                     if out is not None:
                         # batcher lane: only TOTAL is honest here — the
                         # request's wall time includes queue wait and
@@ -721,9 +732,11 @@ class NodeService:
                         took = (time.perf_counter() - t0) * 1000
                         self._record_phase("total", took)
                         tid, oid = self._trace_ids()
-                        self.slowlog.maybe_log(
-                            self.indices[names[0]].settings, names[0],
-                            took, body, trace_id=tid, opaque_id=oid)
+                        if self.slowlog.maybe_log(
+                                self.indices[names[0]].settings, names[0],
+                                took, body, trace_id=tid,
+                                opaque_id=oid) is not None:
+                            tracing.mark_slowlog()
                         return out
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
@@ -810,86 +823,109 @@ class NodeService:
 
         t_parse_done = time.perf_counter()
         self._record_phase("parse", (t_parse_done - t0) * 1000)
+        tracing.add_span("parse", tns0, tracing.now_ns())
         from .common.metrics import current_profiler
         prof = current_profiler()
         if prof is not None:
             prof.record_phase("parse", (t_parse_done - t0) * 1000)
-        def _run_shard(i: int, s: ShardSearcher):
+        def _run_shard(i: int, s: ShardSearcher,
+                       submit_ns: int | None = None):
             # shard-level action registered under the coordinator task
-            # (ref TransportSearchTypeAction per-shard phase actions)
+            # (ref TransportSearchTypeAction per-shard phase actions).
+            # The trace's shard span covers submit→done; queue_wait
+            # (submit→start) and run (start→done) split it so a saturated
+            # search pool is visibly queue time, not shard work.
+            start_ns = tracing.now_ns()
             with self.tasks.scope(
                     "indices:data/read/search[phase/query]",
                     description=f"shard [{index_of[i]}][{s.shard_id}]"), \
-                 _maybe_shard_profile(prof, index_of[i], s.shard_id):
-                if knn is not None:
-                    fnode = s.parse([knn["filter"]]) \
-                        if knn.get("filter") else None
-                    r = s.execute_knn(knn["field"], [qv_single], k=knn_k,
-                                      metric=knn.get("metric", "cosine"),
-                                      filter_node=fnode)
-                else:
-                    r = s.execute_query_phase(
-                        nodes_by_index[index_of[i]], size=max(size, window),
-                        from_=from_, sort=sort,
-                        global_stats=global_stats,
-                        aggs=agg_specs if agg_specs else None,
-                        search_after=search_after,
-                        track_scores=bool(body.get("track_scores", False))
-                        if sort is not None else True)
-                if rescore_spec is not None:
-                    r = s.rescore(r, rescore_spec)
+                 _maybe_shard_profile(prof, index_of[i], s.shard_id), \
+                 tracing.span("shard",
+                              start_ns=submit_ns if submit_ns is not None
+                              else start_ns,
+                              index=index_of[i], shard=s.shard_id):
+                if submit_ns is not None:
+                    tracing.add_span("queue_wait", submit_ns, start_ns)
+                with tracing.span("run", start_ns=start_ns):
+                    if knn is not None:
+                        fnode = s.parse([knn["filter"]]) \
+                            if knn.get("filter") else None
+                        r = s.execute_knn(knn["field"], [qv_single],
+                                          k=knn_k,
+                                          metric=knn.get("metric",
+                                                         "cosine"),
+                                          filter_node=fnode)
+                    else:
+                        r = s.execute_query_phase(
+                            nodes_by_index[index_of[i]],
+                            size=max(size, window),
+                            from_=from_, sort=sort,
+                            global_stats=global_stats,
+                            aggs=agg_specs if agg_specs else None,
+                            search_after=search_after,
+                            track_scores=bool(body.get("track_scores",
+                                                       False))
+                            if sort is not None else True)
+                    if rescore_spec is not None:
+                        r = s.rescore(r, rescore_spec)
             return r
 
         shard_failures = 0
         shard_failure_details: list[dict] = []
-        if len(searchers) == 1:
-            # sequential fast path: no job/context machinery, errors raise
-            # straight through exactly as before
-            results = [_run_shard(0, searchers[0])]
-        else:
-            # concurrent fan-out onto the bounded `search` pool. Each job
-            # runs in a COPY of the coordinator's context so tasks.scope
-            # parenting and the active profiler propagate; claim-once
-            # semantics let the coordinator steal any job the pool hasn't
-            # started (deadlock-free even when coordinators themselves
-            # occupy the search pool), and pool-queue overflow simply
-            # leaves the remainder to run inline.
-            import contextvars
-            from .common.threadpool import EsRejectedExecutionException
-            jobs = []
-            for i, s in enumerate(searchers):
-                ctx = contextvars.copy_context()
-                jobs.append(_ShardJob(
-                    functools.partial(ctx.run, _run_shard, i, s)))
-            try:
-                for job in jobs[1:]:
-                    self.thread_pool.execute("search", job.run)
-            except EsRejectedExecutionException:
-                pass
-            jobs[0].run()
-            results = []
-            first_error = None
-            for i, job in enumerate(jobs):
-                job.join()
-                if job.error is not None:
-                    # shard-failure accounting (ref per-shard onFailure in
-                    # TransportSearchTypeAction): the response carries the
-                    # failure; only an all-shards failure raises
-                    shard_failures += 1
-                    first_error = first_error or job.error
-                    shard_failure_details.append({
-                        "index": index_of[i],
-                        "shard": searchers[i].shard_id,
-                        "reason": f"{type(job.error).__name__}: "
-                                  f"{job.error}"})
-                    results.append(_empty_shard_result(
-                        searchers[i].shard_id, sort=sort))
-                else:
-                    results.append(job.result)
-            if shard_failures == len(searchers) and first_error is not None:
-                raise first_error
+        with tracing.span("query"):
+            if len(searchers) == 1:
+                # sequential fast path: no job/context machinery, errors
+                # raise straight through exactly as before
+                results = [_run_shard(0, searchers[0])]
+            else:
+                # concurrent fan-out onto the bounded `search` pool. Each
+                # job runs in a COPY of the coordinator's context so
+                # tasks.scope parenting, the active profiler AND the active
+                # trace span propagate; claim-once semantics let the
+                # coordinator steal any job the pool hasn't started
+                # (deadlock-free even when coordinators themselves occupy
+                # the search pool), and pool-queue overflow simply leaves
+                # the remainder to run inline.
+                import contextvars
+                from .common.threadpool import EsRejectedExecutionException
+                jobs = []
+                for i, s in enumerate(searchers):
+                    ctx = contextvars.copy_context()
+                    jobs.append(_ShardJob(
+                        functools.partial(ctx.run, _run_shard, i, s,
+                                          tracing.now_ns())))
+                try:
+                    for job in jobs[1:]:
+                        self.thread_pool.execute("search", job.run)
+                except EsRejectedExecutionException:
+                    pass
+                jobs[0].run()
+                results = []
+                first_error = None
+                for i, job in enumerate(jobs):
+                    job.join()
+                    if job.error is not None:
+                        # shard-failure accounting (ref per-shard onFailure
+                        # in TransportSearchTypeAction): the response
+                        # carries the failure; only an all-shards failure
+                        # raises
+                        shard_failures += 1
+                        first_error = first_error or job.error
+                        shard_failure_details.append({
+                            "index": index_of[i],
+                            "shard": searchers[i].shard_id,
+                            "reason": f"{type(job.error).__name__}: "
+                                      f"{job.error}"})
+                        results.append(_empty_shard_result(
+                            searchers[i].shard_id, sort=sort))
+                    else:
+                        results.append(job.result)
+                if shard_failures == len(searchers) \
+                        and first_error is not None:
+                    raise first_error
 
         t_device_done = time.perf_counter()
+        tns_fetch0 = tracing.now_ns()
         self._record_phase("device",
                            (t_device_done - t_parse_done) * 1000)
         if prof is not None:
@@ -969,15 +1005,17 @@ class NodeService:
         }
         if agg_specs:
             t_agg0 = time.perf_counter()
-            merged = merge_shard_partials(
-                agg_specs, [r.aggs for r in results if r.aggs])
-            resp["aggregations"] = render_aggs(agg_specs, merged)
+            with tracing.span("aggregations"):
+                merged = merge_shard_partials(
+                    agg_specs, [r.aggs for r in results if r.aggs])
+                resp["aggregations"] = render_aggs(agg_specs, merged)
             if prof is not None:
                 prof.record_phase("aggregations",
                                   (time.perf_counter() - t_agg0) * 1000)
         if body.get("suggest"):
             resp["suggest"] = self.suggest(index, body["suggest"])
         now = time.perf_counter()
+        tracing.add_span("fetch", tns_fetch0, tracing.now_ns())
         self._record_phase("fetch", (now - t_device_done) * 1000)
         self._record_phase("total", (now - t0) * 1000)
         if prof is not None:
@@ -989,10 +1027,16 @@ class NodeService:
                 (now - t_device_done) * 1000 - post, 0.0))
         resp["took"] = int((now - t0) * 1000)
         tid, oid = self._trace_ids()
+        slow = None
         for n in names:     # every searched index's thresholds apply
-            self.slowlog.maybe_log(self.indices[n].settings, n,
-                                   (now - t0) * 1000, body,
-                                   trace_id=tid, opaque_id=oid)
+            slow = self.slowlog.maybe_log(self.indices[n].settings, n,
+                                          (now - t0) * 1000, body,
+                                          trace_id=tid, opaque_id=oid) \
+                or slow
+        if slow is not None:
+            # a slowlogged request always keeps its trace — the slowlog
+            # entry's trace_id must resolve in GET /_traces
+            tracing.mark_slowlog()
         if cache_key is not None:
             # byte-accounted LRU insert charging the `request` breaker; a
             # refused insert (budget/breaker pressure) just means this
@@ -2312,6 +2356,9 @@ class NodeService:
                            "compile_time_in_millis": round(compile_ms, 3)}),
             "transfer": (None, transfer_snapshot()),
             "tasks": (None, self.tasks.stats()),
+            # span tracer: started/retained/sampled-out trace counters,
+            # ring-eviction + span-cap drop counters, live gauges
+            "tracing": (None, self.tracer.stats()),
             "rate": ("op", {n: m.stats() for n, m in self.meters.items()}),
             "process": (None, {
                 "resident_bytes": proc.get("mem", {})
@@ -2360,6 +2407,9 @@ class NodeService:
             "segment_stack_cache_memory_bytes":
                 self.caches.segment_stacks.cache.memory_bytes,
         }
+        tr = self.tracer.stats()
+        out["tracing_active_traces"] = tr["active_traces"]
+        out["tracing_dropped_total"] = tr["dropped_traces_total"]
         for name, b in br.items():
             out[f"breaker_{name}_used_bytes"] = b["estimated_size_in_bytes"]
         return out

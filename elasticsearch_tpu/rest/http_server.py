@@ -115,12 +115,26 @@ class RestController:
             return handler(match.groupdict(), params, body)
         # every REST request is a registered task carrying the caller's
         # X-Opaque-Id plus a generated trace id; child scopes (per-shard
-        # phases, transport handlers) inherit both via the task context
+        # phases, transport handlers) inherit both via the task context.
+        # The span tracer roots at the SAME trace id, so slowlog, task
+        # listing, profile and GET /_traces correlate on one id;
+        # `?trace=true` forces retention past the sampler.
         opaque = (headers or {}).get("x-opaque-id")
         with tasks.scope(_action_of(method, path),
                          description=f"{method} {path}",
-                         opaque_id=opaque):
-            return handler(match.groupdict(), params, body)
+                         opaque_id=opaque) as task:
+            tracer = getattr(self.node, "tracer", None)
+            if tracer is None or not tracer.enabled \
+                    or path.startswith("/_traces"):
+                # reading traces must never perturb the trace store
+                return handler(match.groupdict(), params, body)
+            with tracer.request(f"{method} {path}",
+                                trace_id=task.trace_id,
+                                force=_pbool(params, "trace", False),
+                                opaque_id=opaque,
+                                attrs={"method": method, "path": path,
+                                       "action": task.action}):
+                return handler(match.groupdict(), params, body)
 
 
 def _action_of(method: str, path: str) -> str:
@@ -2591,6 +2605,48 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                                      for name, m in node.meters.items()}}}}
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_nodes/stats/{metric}", nodes_stats)
+
+    # -- span tracing (common/tracing.py): the retained-trace ring ---------
+    def list_traces(g, p, b):
+        # newest-first summaries; GET /_traces/{id} has the full tree
+        return 200, {"traces": node.tracer.list()}
+    c.register("GET", "/_traces", list_traces)
+
+    def get_trace(g, p, b):
+        from ..common.tracing import chrome_trace, otlp_trace, span_tree
+        t = node.tracer.get(g["trace_id"])
+        if t is None:
+            return 404, {"error": f"ResourceNotFoundException: trace "
+                                  f"[{g['trace_id']}] not found "
+                                  f"(expired from the ring or never "
+                                  f"retained)", "status": 404}
+        fmt = p.get("format", [None])[0]
+        if fmt == "chrome":
+            # Chrome trace-event JSON: load in chrome://tracing / Perfetto
+            return 200, chrome_trace(t)
+        if fmt == "otlp":
+            return 200, otlp_trace(t)
+        return 200, span_tree(t)
+    c.register("GET", "/_traces/{trace_id}", get_trace)
+
+    def nodes_slowlog(g, p, b):
+        # the slowlog tails as a first-class endpoint: each entry carries
+        # its trace_id, so a slow line links straight to GET /_traces/{id}
+        import fnmatch as _fn
+        want = p.get("index", [None])[0]
+
+        def _filter(entries):
+            if not want:
+                return entries
+            pats = [x for x in str(want).split(",") if x]
+            return [e for e in entries
+                    if any(_fn.fnmatch(e.get("index", ""), pat)
+                           for pat in pats)]
+        return 200, {"cluster_name": node.cluster_name, "nodes": {
+            "tpu-node-0": {
+                "search": _filter(node.slowlog.snapshot()),
+                "indexing": _filter(node.indexing_slowlog.snapshot())}}}
+    c.register("GET", "/_nodes/slowlog", nodes_slowlog)
 
     def nodes_stats_history(g, p, b):
         # the StatsSampler ring (common/monitor.py): timestamped gauge

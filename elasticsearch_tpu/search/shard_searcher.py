@@ -382,17 +382,22 @@ class ShardSearcher:
     def _execute_stacked(self, stack, node: Node, *, k: int, Q: int,
                          global_stats, track_scores: bool,
                          aggs: list | None) -> QuerySearchResult:
+        from ..common import tracing
         from .stacked import StackedContext, execute_tree, stacked_reduce
         stats = self.build_stats(node, global_stats)
-        sctx = StackedContext(stack, Q, stats)
-        scores, match = execute_tree(node, sctx)
-        live = stack.live_stack()
-        out = stacked_reduce(scores, match, live, stack.seg_ids_dev, k=k)
-        # per-segment totals, masked row-max and the cross-segment top-k
-        # merge all happened ON DEVICE — this is the shard's ONE fetch
-        keys_d, top_d, total_d, mx_d = out
-        got = device_fetch({"keys": keys_d, "top": top_d,
-                            "total": total_d, "mx": mx_d})
+        with tracing.span("stacked_dispatch", shard=self.shard_id,
+                          segments=len(stack.segments), k=k):
+            sctx = StackedContext(stack, Q, stats)
+            scores, match = execute_tree(node, sctx)
+            live = stack.live_stack()
+            out = stacked_reduce(scores, match, live, stack.seg_ids_dev,
+                                 k=k)
+            # per-segment totals, masked row-max and the cross-segment
+            # top-k merge all happened ON DEVICE — this is the shard's
+            # ONE fetch
+            keys_d, top_d, total_d, mx_d = out
+            got = device_fetch({"keys": keys_d, "top": top_d,
+                                "total": total_d, "mx": mx_d})
         best_keys = np.asarray(got["keys"], np.int64)
         # keep the device dtype: trees over f64 columns promote scores to
         # f64 exactly like the per-segment loop's merge does

@@ -152,7 +152,19 @@ def estimate_stack_bytes(segments: Sequence[Segment]) -> int:
 def build_stack(segments: Sequence[Segment]) -> SegmentStack | None:
     """Pack live segments into the stacked tensors. Empty segments are
     skipped HERE, once, instead of being re-checked inside every query's
-    loop. Returns None when there is nothing live to stack."""
+    loop. Returns None when there is nothing live to stack. A traced
+    request that pays the build sees it as a `stack_build` span — the
+    cache-miss cost of the stacked lane, attributed."""
+    from ..common import tracing
+    with tracing.span("stack_build", segments=sum(
+            1 for s in segments if s.n_docs > 0)) as _sp:
+        out = _build_stack(segments)
+        if _sp is not None and out is not None:
+            _sp.attrs["bytes"] = out.nbytes
+    return out
+
+
+def _build_stack(segments: Sequence[Segment]) -> SegmentStack | None:
     rows = [(i, s) for i, s in enumerate(segments) if s.n_docs > 0]
     if not rows:
         return None

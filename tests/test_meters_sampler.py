@@ -158,7 +158,8 @@ def test_nodes_stats_history_after_two_ticks(http):
     assert h["sample_count"] >= 2
     assert all("timestamp" in s and "metrics" in s for s in h["samples"])
     for key in ("docs", "pool_search_queue", "search_rate_1m",
-                "breaker_parent_used_bytes", "batcher_batches_total"):
+                "breaker_parent_used_bytes", "batcher_batches_total",
+                "tracing_active_traces", "tracing_dropped_total"):
         assert key in h["samples"][-1]["metrics"], key
         assert {"min", "max", "avg", "last", "count"} \
             <= set(h["rollups"][key]), key

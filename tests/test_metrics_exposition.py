@@ -118,12 +118,12 @@ def test_exposition_is_valid_and_broad(http):
     families = scrape(req)
     n_series = sum(len(f["samples"]) for f in families.values())
     subsystems = {name.split("_")[1] for name in families}
-    # acceptance floor: ≥55 series across ≥9 subsystems (ISSUE-3 bumped
-    # it from 40 — the cache tiers alone add ~24 series)
-    assert n_series >= 55, f"only {n_series} series"
+    # acceptance floor: ≥60 series (ISSUE-5 bumped it from 55 — the
+    # tracing registry adds 8 families)
+    assert n_series >= 60, f"only {n_series} series"
     for want in ("threadpool", "breaker", "search", "timer", "jit",
                  "transfer", "index", "tasks", "rate", "process", "os",
-                 "cache"):
+                 "cache", "tracing"):
         assert want in subsystems, f"subsystem [{want}] missing"
     # every sample carries the node label
     for fam in families.values():
@@ -159,6 +159,17 @@ def test_every_registry_is_scraped(http):
     # request-cache byte/eviction families ride the per-index section
     assert "es_index_request_cache_memory_bytes" in families
     assert "es_index_request_cache_evictions_total" in families
+
+    # the tracing registry (ISSUE 5): counters typed as counters, live
+    # gauges as gauges
+    for fam, mtype in (("es_tracing_traces_started_total", "counter"),
+                       ("es_tracing_dropped_traces_total", "counter"),
+                       ("es_tracing_dropped_spans_total", "counter"),
+                       ("es_tracing_spans_total", "counter"),
+                       ("es_tracing_active_traces", "gauge"),
+                       ("es_tracing_retained_traces", "gauge")):
+        assert fam in families, fam
+        assert families[fam]["type"] == mtype, fam
 
 
 def test_new_timer_joins_the_scrape_automatically(http):

@@ -123,3 +123,58 @@ def test_dense_unsorted_batch_single_fetch_per_shard(stacked_node):
     n_shards = len(n.indices["s"].shards)
     assert delta == n_shards, \
         f"{delta} device fetches for {n_shards} shard(s)"
+
+
+# -- span tracing overhead (ISSUE 5) ----------------------------------------
+
+def test_tracing_disabled_zero_device_overhead(tmp_path_factory):
+    """With node.tracing.enabled=false the trace-instrumented query path
+    performs ZERO extra device fetches and ZERO jit compiles vs the PR 4
+    counters: one fetch per shard on the warm stacked path, no retrace,
+    and no trace machinery engaged at all."""
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    from elasticsearch_tpu.common.settings import Settings
+    n = NodeService(str(tmp_path_factory.mktemp("notrace")),
+                    settings=Settings({"node.tracing.enabled": False}))
+    try:
+        n.create_index("d", settings={"number_of_shards": 1},
+                       mappings={"_doc": {"properties": {
+                           "body": {"type": "string"},
+                           "n": {"type": "long"}}}})
+        for i in range(40):
+            n.index_doc("d", str(i),
+                        {"body": f"quick brown fox jumps {i}", "n": i})
+        n.refresh("d")
+        body = {"size": 5, "query": {"bool": {"should": [
+            {"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+        n.search("d", json.loads(json.dumps(body)))       # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        c0 = device_events_snapshot()[0]
+        n.search("d", json.loads(json.dumps(body)))
+        assert transfer_snapshot()["device_fetches_total"] - f0 == 1
+        assert device_events_snapshot()[0] - c0 == 0
+        assert n.tracer.stats()["traces_started_total"] == 0
+        assert n.tracer.stats()["spans_total"] == 0
+    finally:
+        n.close()
+
+
+def test_tracing_active_adds_no_device_work(stacked_node):
+    """An ACTIVE trace is host-side bookkeeping only: the traced query
+    performs the same one fetch per shard and compiles nothing."""
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))   # warm
+    f0 = transfer_snapshot()["device_fetches_total"]
+    c0 = device_events_snapshot()[0]
+    with n.tracer.request("tripwire", force=True):
+        n.search("s", json.loads(json.dumps(STACKED_BODY)))
+    n_shards = len(n.indices["s"].shards)
+    assert transfer_snapshot()["device_fetches_total"] - f0 == n_shards
+    assert device_events_snapshot()[0] - c0 == 0
+    t = n.tracer.list()[0]
+    assert t["span_count"] >= 3               # spans recorded, device idle

@@ -29,9 +29,9 @@ def _resolves(controller, path: str) -> bool:
 
 def test_route_count_floor_and_uniqueness(controller):
     # floor, not exact: new PRs add routes; LOSING routes is the bug.
-    # (249 registered at ISSUE-3 time — the cache subsystem changed
-    # handlers, not the route table, so the floor just re-anchors)
-    assert len(controller.routes) >= 249, len(controller.routes)
+    # (252 registered at ISSUE-5 time: tracing added /_traces,
+    # /_traces/{trace_id} and /_nodes/slowlog)
+    assert len(controller.routes) >= 252, len(controller.routes)
     seen = set()
     for method, rx, _h, _s in controller.routes:
         key = (method, rx.pattern)
@@ -44,7 +44,9 @@ def test_new_observability_routes_resolve(controller):
                  "/_nodes/stats/history", "/_nodes/stats",
                  "/_cat/thread_pool", "/_cat/indices",
                  "/_cache/clear", "/someindex/_cache/clear",
-                 "/_cat/fielddata"):
+                 "/_cat/fielddata",
+                 "/_traces", "/_traces/abcdef0123456789",
+                 "/_nodes/slowlog"):
         assert _resolves(controller, path), path
 
 

@@ -1,0 +1,344 @@
+"""Span-based request tracing (ISSUE 5, common/tracing.py).
+
+Covers the acceptance surface: a 2-shard concurrent query returns ONE
+rooted span tree containing both shard subtrees with distinct queue-wait
+and run spans, cache-tier hit/miss attributes and a device section;
+`?format=chrome` emits valid Chrome trace-event JSON; sampling honors
+`sample_rate=0` with the `?trace=true` override and the would-slowlog
+force; the ring evicts oldest and counts drops; the `_trace` wire header
+parents remote subtrees; `GET /_nodes/slowlog` links entries to traces.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.tracing import Tracer, otlp_trace
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.rest import HttpServer
+
+# dense bool/should tree: off the sparse AND packed fast lanes, so the
+# full coordinator -> fan-out -> shard pipeline (the instrumented one)
+# serves it
+DENSE_BODY = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+@pytest.fixture(scope="module")
+def http(tmp_path_factory):
+    node = NodeService(str(tmp_path_factory.mktemp("tracing")))
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(base + path, data=data, method=method)
+        try:
+            resp = urllib.request.urlopen(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+
+    req("PUT", "/t", {"settings": {"number_of_shards": 2},
+                      "mappings": {"_doc": {"properties": {
+                          "body": {"type": "string"},
+                          "n": {"type": "long"}}}}})
+    for i in range(40):
+        req("PUT", f"/t/_doc/{i}", {"body": f"quick brown fox {i}",
+                                    "n": i})
+    req("POST", "/t/_refresh")
+    req("POST", "/t/_search", DENSE_BODY)        # warm compiles
+    yield node, req
+    srv.stop()
+    node.close()
+
+
+def _traced_search(req, body=None, qs="?trace=true"):
+    code, _ = req("POST", f"/t/_search{qs}", body or DENSE_BODY)
+    assert code == 200
+    code, lst = req("GET", "/_traces")
+    assert code == 200
+    for t in lst["traces"]:                       # newest first
+        if "_search" in t["root"]:
+            return t
+    raise AssertionError(f"no search trace retained: {lst}")
+
+
+def _children(node, name):
+    return [c for c in node["children"] if c["name"] == name]
+
+
+# -- the acceptance tree ----------------------------------------------------
+
+def test_two_shard_query_one_rooted_tree(http):
+    node, req = http
+    summary = _traced_search(req)
+    code, full = req("GET", f"/_traces/{summary['trace_id']}")
+    assert code == 200
+    root = full["tree"]
+    assert root["name"].endswith("/t/_search")
+    assert root["parent_id"] is None
+
+    query = _children(root, "query")
+    assert len(query) == 1, [c["name"] for c in root["children"]]
+    shards = _children(query[0], "shard")
+    assert len(shards) == 2
+    assert {s["attributes"]["shard"] for s in shards} == {0, 1}
+    for s in shards:
+        qw = _children(s, "queue_wait")
+        run = _children(s, "run")
+        assert len(qw) == 1 and len(run) == 1, \
+            [c["name"] for c in s["children"]]
+        # submit->start plus start->done fit inside the submit->done parent
+        assert qw[0]["duration_us"] + run[0]["duration_us"] \
+            <= s["duration_us"] + 100
+        # shard work nests under run, not directly under the shard span
+        assert run[0]["children"], "run span recorded no shard work"
+    # coordinator phases recorded alongside the fan-out
+    assert _children(root, "parse") and _children(root, "fetch")
+
+
+def test_cache_spans_carry_tier_and_hit_attributes(http):
+    node, req = http
+    summary = _traced_search(req)
+    code, full = req("GET", f"/_traces/{summary['trace_id']}")
+    cache_spans = [s for s in _walk(full["tree"])
+                   if s["name"] == "cache.get"]
+    assert cache_spans, "no cache.get spans in the trace"
+    tiers = {s["attributes"]["tier"] for s in cache_spans}
+    assert "query_plan" in tiers
+    for s in cache_spans:
+        assert isinstance(s["attributes"]["hit"], bool)
+
+
+def _walk(node):
+    yield node
+    for c in node["children"]:
+        yield from _walk(c)
+
+
+def test_device_section_jit_and_fetch_bytes(http):
+    node, req = http
+    summary = _traced_search(req)
+    code, full = req("GET", f"/_traces/{summary['trace_id']}")
+    dev = full["device"]
+    for key in ("device_fetches", "bytes_device_to_host",
+                "bytes_host_to_device", "jit_compiles",
+                "jit_compile_time_in_millis"):
+        assert key in dev, dev
+    # warm 2-shard dense query: one fetch per shard, bytes came down
+    assert dev["device_fetches"] == 2
+    assert dev["bytes_device_to_host"] > 0
+    assert dev["jit_compiles"] == 0
+    # the per-fetch spans agree with the device section
+    fetch_spans = [s for s in _walk(full["tree"])
+                   if s["name"] == "device_fetch"]
+    assert len(fetch_spans) == 2
+    assert sum(s["attributes"]["bytes"] for s in fetch_spans) \
+        == dev["bytes_device_to_host"]
+
+
+# -- exports ----------------------------------------------------------------
+
+def test_chrome_trace_event_schema(http):
+    node, req = http
+    summary = _traced_search(req)
+    code, ch = req("GET", f"/_traces/{summary['trace_id']}?format=chrome")
+    assert code == 200
+    events = ch["traceEvents"]
+    assert isinstance(events, list) and events
+    phs = {e["ph"] for e in events}
+    assert phs <= {"X", "M"} and "X" in phs
+    for e in events:
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert e["args"]["span_id"] >= 1
+    # must round-trip as pure JSON (what chrome://tracing loads)
+    json.loads(json.dumps(ch))
+    # the concurrent fan-out shows up as >1 thread lane
+    assert len({e["tid"] for e in events if e["ph"] == "X"}) >= 2
+
+
+def test_otlp_export_ids_and_parents(http):
+    node, req = http
+    summary = _traced_search(req)
+    code, ot = req("GET", f"/_traces/{summary['trace_id']}?format=otlp")
+    assert code == 200
+    spans = ot["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == summary["span_count"]
+    by_id = {s["spanId"] for s in spans}
+    roots = 0
+    for s in spans:
+        assert len(s["traceId"]) == 32
+        assert len(s["spanId"]) == 16
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+        if "parentSpanId" in s:
+            assert s["parentSpanId"] in by_id
+        else:
+            roots += 1
+    assert roots == 1
+
+
+def test_unknown_trace_404(http):
+    node, req = http
+    code, out = req("GET", "/_traces/definitelynotatrace")
+    assert code == 404
+
+
+# -- sampling / retention ---------------------------------------------------
+
+def test_sample_rate_zero_retains_nothing_but_trace_true_forces(http):
+    node, req = http
+    node.tracer.sample_rate = 0.0
+    try:
+        before = node.tracer.stats()["traces_sampled_out_total"]
+        code, _ = req("POST", "/t/_search", DENSE_BODY)
+        assert code == 200
+        # the unsampled search was finalized but NOT retained
+        assert node.tracer.stats()["traces_sampled_out_total"] > before
+        forced = _traced_search(req)          # ?trace=true overrides
+        assert forced is not None
+        code, full = req("GET", f"/_traces/{forced['trace_id']}")
+        assert code == 200 and full["forced"] is True
+    finally:
+        node.tracer.sample_rate = 1.0
+
+
+def test_would_slowlog_forces_retention(http):
+    node, req = http
+    node.tracer.sample_rate = 0.0
+    req("PUT", "/t/_settings",
+        {"index.search.slowlog.threshold.query.warn": "0ms"})
+    try:
+        code, _ = req("POST", "/t/_search", DENSE_BODY)   # no ?trace=true
+        assert code == 200
+        code, lst = req("GET", "/_traces")
+        t = next(x for x in lst["traces"] if "_search" in x["root"])
+        assert t["slowlog"] is True
+        # the slowlog entry's trace id resolves to this trace
+        tail = node.slowlog.snapshot()
+        assert tail and tail[-1]["trace_id"] == t["trace_id"]
+    finally:
+        node.tracer.sample_rate = 1.0
+        req("PUT", "/t/_settings",
+            {"index.search.slowlog.threshold.query.warn": "10h"})
+
+
+def test_ring_retention_evicts_oldest_and_counts_drops():
+    tracer = Tracer(Settings({"node.tracing.retention": 3}))
+    ids = [f"ring-{i:02d}" for i in range(5)]
+    for tid in ids:
+        with tracer.request("req", trace_id=tid, force=True):
+            pass
+    listed = [t["trace_id"] for t in tracer.list()]
+    assert listed == ["ring-04", "ring-03", "ring-02"]   # newest first
+    assert tracer.get("ring-00") is None                 # evicted
+    assert tracer.stats()["dropped_traces_total"] == 2
+    assert tracer.stats()["retained_traces"] == 3
+
+
+def test_span_cap_drops_and_counts():
+    tracer = Tracer(Settings({"node.tracing.max_spans": 4}))
+    with tracer.request("req", trace_id="cap", force=True):
+        for _ in range(10):
+            with tracing.span("s"):
+                pass
+    t = tracer.get("cap")
+    assert t["span_count"] == 4
+    assert t["dropped_spans"] == 7          # 11 wanted, 4 kept
+    assert tracer.stats()["dropped_spans_total"] == 7
+
+
+def test_disabled_tracer_records_nothing():
+    tracer = Tracer(Settings({"node.tracing.enabled": False}))
+    with tracer.request("req", trace_id="x", force=True) as t:
+        assert t is None
+        with tracing.span("child") as sp:
+            assert sp is None
+        assert tracing.wire_header() is None
+    assert tracer.list() == []
+    assert tracer.stats()["traces_started_total"] == 0
+
+
+# -- cross-transport propagation --------------------------------------------
+
+def test_wire_header_parents_remote_subtree():
+    coord = Tracer()
+    with coord.request("coordinator", trace_id="abcdef0123456789") as t:
+        with tracing.span("dispatch"):
+            hdr = tracing.wire_header()
+    assert hdr == {"trace_id": "abcdef0123456789", "span": 2}
+
+    remote = Tracer()
+    with remote.remote(hdr, "indices:data/read/search[phase/query]",
+                       attrs={"node": "node-1"}):
+        with tracing.span("run"):
+            pass
+    got = remote.get("abcdef0123456789")
+    assert got is not None
+    assert got["remote_parent_span"] == 2
+    assert got["span_count"] == 2
+    # OTLP export stitches the subtree under the coordinator's span id
+    ot = otlp_trace(got)
+    spans = ot["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    root = next(s for s in spans
+                if s["name"].startswith("indices:data/read"))
+    assert root["parentSpanId"] == "%016x" % 2
+    assert spans[0]["traceId"] == "abcdef0123456789" + "0" * 16
+
+
+def test_remote_scope_noop_without_header():
+    remote = Tracer()
+    with remote.remote(None, "action") as t:
+        assert t is None
+    assert remote.stats()["traces_started_total"] == 0
+
+
+# -- GET /_nodes/slowlog ----------------------------------------------------
+
+def test_nodes_slowlog_endpoint_links_traces(http):
+    node, req = http
+    req("PUT", "/t/_settings",
+        {"index.search.slowlog.threshold.query.warn": "0ms"})
+    try:
+        req("POST", "/t/_search?trace=true", DENSE_BODY)
+        code, out = req("GET", "/_nodes/slowlog")
+        assert code == 200
+        tail = out["nodes"]["tpu-node-0"]["search"]
+        assert tail, "slowlog tail empty"
+        entry = tail[-1]
+        assert entry["index"] == "t"
+        tid = entry["trace_id"]
+        code, full = req("GET", f"/_traces/{tid}")
+        assert code == 200 and full["trace_id"] == tid
+        assert "indexing" in out["nodes"]["tpu-node-0"]
+        # ?index= filter
+        code, out = req("GET", "/_nodes/slowlog?index=nomatch*")
+        assert out["nodes"]["tpu-node-0"]["search"] == []
+        code, out = req("GET", "/_nodes/slowlog?index=t")
+        assert out["nodes"]["tpu-node-0"]["search"]
+    finally:
+        req("PUT", "/t/_settings",
+            {"index.search.slowlog.threshold.query.warn": "10h"})
+
+
+def test_trace_list_summary_shape(http):
+    node, req = http
+    summary = _traced_search(req)
+    for key in ("trace_id", "root", "duration_in_millis", "span_count",
+                "start_time_in_millis", "slowlog"):
+        assert key in summary
+    assert summary["duration_in_millis"] >= 0
+    assert summary["span_count"] >= 1

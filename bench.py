@@ -407,6 +407,59 @@ def run_multiseg_leg(tag: str) -> dict:
         if out.get("per_segment_p50_ms"):
             out["multiseg_speedup"] = (out["per_segment_p50_ms"]
                                        / out["stacked_p50_ms"])
+
+        # mesh lane (ISSUE 6): the ≥4-shard config — one shard_map program
+        # with an on-device cross-shard reduce vs the thread-pool fan-out
+        # over per-shard stacked programs. Skipped when the host lacks the
+        # devices to seat the shards (the production fallback, measured
+        # honestly as absent keys rather than a fake number).
+        import jax as _jax
+        n_mesh_shards = int(os.environ.get("BENCH_MS_SHARDS", "4"))
+        if len(_jax.devices()) >= n_mesh_shards \
+                and not _over_budget(margin=60.0):
+            for name, extra in (("live_mesh", {}),
+                                ("live_fanout",
+                                 {"index.search.mesh.enable": False})):
+                http(port, "PUT", f"/{name}", json.dumps(
+                    {**mapping, "settings": {
+                        "number_of_shards": n_mesh_shards, **extra}}))
+            for name in ("live_mesh", "live_fanout"):
+                j = 0
+                for sz in sizes:
+                    if _over_budget(margin=45.0):
+                        return out       # keep the 1-shard numbers
+                    lines = []
+                    for _ in range(sz):
+                        lines.append('{"index":{"_id":"%d"}}' % j)
+                        lines.append(json.dumps({
+                            "body": " ".join(words[w] for w in word_ids[j]),
+                            "n": int(j)}))
+                        j += 1
+                    http(port, "POST", f"/{name}/_bulk",
+                         "\n".join(lines) + "\n")
+                    http(port, "POST", f"/{name}/_refresh")
+            for name, key in (("live_mesh", "mesh"),
+                              ("live_fanout", "fanout")):
+                http(port, "POST", f"/{name}/_search", body_of(0))   # warm
+                f0 = transfer_snapshot()["device_fetches_total"]
+                lat = []
+                served = 0
+                for i in range(reps):
+                    t0 = time.perf_counter()
+                    http(port, "POST", f"/{name}/_search", body_of(i))
+                    lat.append((time.perf_counter() - t0) * 1000)
+                    served += 1
+                    if _over_budget():
+                        break
+                f1 = transfer_snapshot()["device_fetches_total"]
+                lat.sort()
+                out[f"{key}_p50_ms"] = lat[len(lat) // 2]
+                out[f"{key}_fetches_per_query"] = \
+                    (f1 - f0) / max(served, 1)
+            out["mesh_shards"] = n_mesh_shards
+            if out.get("fanout_p50_ms") and out.get("mesh_p50_ms"):
+                out["mesh_speedup"] = (out["fanout_p50_ms"]
+                                       / out["mesh_p50_ms"])
         return out
     finally:
         server.stop()
@@ -826,6 +879,18 @@ def main_engine():
             "per_segment_fetches_per_query":
                 r2(res.get("per_segment_fetches_per_query")),
             "multiseg_segments": res.get("multiseg_segments")})
+        if "mesh_p50_ms" in res:
+            # mesh lane (ISSUE 6): one collective program vs the
+            # thread-pool fan-out on the multi-shard config
+            line.update({
+                "mesh_p50_ms": r2(res.get("mesh_p50_ms")),
+                "fanout_p50_ms": r2(res.get("fanout_p50_ms")),
+                "mesh_speedup": rnd(res.get("mesh_speedup")),
+                "mesh_fetches_per_query":
+                    r2(res.get("mesh_fetches_per_query")),
+                "fanout_fetches_per_query":
+                    r2(res.get("fanout_fetches_per_query")),
+                "mesh_shards": res.get("mesh_shards")})
     if "knn_qps" in res:
         line.update({
             "knn_qps": round(res["knn_qps"], 2),

@@ -247,6 +247,22 @@ def shard_fetch_histogram() -> dict[int, int]:
         return dict(_FETCH_HIST)
 
 
+_HOST_MERGES = [0]
+
+
+def record_host_merge() -> None:
+    """One host-side cross-shard merge ran (controller.sort_docs). The
+    mesh-sharded query lane's whole point is replacing these with one
+    on-device collective reduce — tests tripwire on the delta staying 0."""
+    with _DEVICE_LOCK:
+        _HOST_MERGES[0] += 1
+
+
+def host_merge_count() -> int:
+    with _DEVICE_LOCK:
+        return _HOST_MERGES[0]
+
+
 def transfer_snapshot() -> dict:
     """Process-wide host↔device transfer counters (every device_fetch /
     note_h2d call accounts here, profiler active or not) — the scrape's

@@ -63,12 +63,19 @@ class IndexService:
         # query-path counters live here so they survive across requests
         self._searcher_cache: dict[int, tuple[tuple, ShardSearcher]] = {}
         self.search_stats = {"sparse": 0, "dense": 0, "packed": 0,
-                             "stacked": 0}
+                             "stacked": 0, "mesh": 0}
         # the stacked dense lane is on unless the index opts out
         # (`index.search.stacked.enable: false` — bench uses it to measure
         # the per-segment loop it replaces)
         raw_stacked = get("search.stacked.enable", True)
         self._stacked_enabled = str(raw_stacked).strip().lower() \
+            not in ("false", "0", "no")
+        # the mesh-sharded query lane (parallel/mesh_exec) engages for
+        # multi-shard unsorted queries unless the index opts out
+        # (`index.search.mesh.enable: false` — bench uses it to measure
+        # the thread-pool fan-out it replaces)
+        raw_mesh = get("search.mesh.enable", True)
+        self._mesh_enabled = str(raw_mesh).strip().lower() \
             not in ("false", "0", "no")
         # op counters surfaced by _stats (ref index/shard stats holders:
         # IndexingStats w/ per-type breakdown, SearchStats w/ groups, GetStats)
@@ -187,6 +194,7 @@ class IndexService:
         valid = {(si, tuple(s.seg_id for s in e.segments if s.n_docs > 0))
                  for si, e in enumerate(self.shards)}
         self.caches.segment_stacks.drop_stale(self.name, valid)
+        self.caches.mesh_stacks.drop_stale(self.name, valid)
 
     def _on_packed_removed(self, _key, value, _reason) -> None:
         """Packed-view cache removal: hand the view's duplicate-postings
@@ -202,6 +210,7 @@ class IndexService:
         self._packed_view_cache.clear()
         if self.caches is not None:
             self.caches.segment_stacks.clear([self.name])
+            self.caches.mesh_stacks.clear([self.name])
 
     def delete_files(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)

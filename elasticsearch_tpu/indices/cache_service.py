@@ -384,6 +384,111 @@ class SegmentStackCache:
         return out
 
 
+class MeshStackCache:
+    """Per-index packed MESH stacks for the mesh-sharded query lane
+    (parallel/mesh_exec.py): all S shards' segment stacks one level up,
+    sharded over the device mesh's "shard" axis. Same lifecycle contract
+    as SegmentStackCache — fielddata-breaker-charged at build through
+    make_room admission, released on any removal, keyed by the index's
+    FULL per-shard segment-id sets so any refresh/flush/merge produces a
+    new key (stale siblings die on the next put and eagerly via
+    drop_stale). Oversized estimates are declined up front: callers fall
+    back to the concurrent fan-out, never raise."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.oversized = 0
+        self.declined = 0
+        self.cache = Cache("mesh_stack", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _StackEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="mesh_stack",
+                              reason=reason, bytes=entry.nbytes)
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+
+    def get_or_build(self, index_name, incarnation, per_shard_segments,
+                     breaker=None):
+        """The index's MeshStack, building (and breaker-charging) on first
+        use. None when declined — no live docs, no mesh topology on this
+        host (fewer devices than shards), oversized, or breaker pressure
+        even after shedding other stacks."""
+        from ..parallel import mesh_exec
+        info = mesh_exec.mesh_for(len(per_shard_segments))
+        if info is None:
+            return None
+        mesh, s_pad, n_replicas = info
+        entries = tuple(
+            (si, tuple(s.seg_id for s in segs if s.n_docs > 0))
+            for si, segs in enumerate(per_shard_segments))
+        if not any(ids for _si, ids in entries):
+            return None
+        key = (index_name, incarnation, entries)
+        with tracing.span("cache.get", tier="mesh_stack") as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
+        if ent is not None:
+            return ent.stack
+        est = mesh_exec.estimate_mesh_stack_bytes(per_shard_segments)
+        if self.cache.max_bytes > 0 and est > self.cache.max_bytes:
+            self.oversized += 1
+            return None
+        if breaker is not None:
+            try:
+                self.cache.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429 a search
+                self.declined += 1
+                return None
+        try:
+            stack = mesh_exec.build_mesh_stack(per_shard_segments, mesh,
+                                               s_pad, n_replicas)
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if stack is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        nbytes = stack.nbytes
+        if breaker is not None and nbytes != est:
+            if nbytes > est:
+                breaker.add_estimate(nbytes - est, check=False)
+            else:
+                breaker.release(est - nbytes)
+        entry = _StackEntry(stack, nbytes, breaker, index_name)
+        if self.cache.put(key, entry):
+            # a refresh/merge changed some shard's segment set: the
+            # predecessor mesh stack frees its device bytes NOW
+            self.cache.invalidate_where(
+                lambda k, _e: k[:2] == key[:2] and k != key)
+        elif breaker is not None:
+            breaker.release(nbytes)
+        return stack
+
+    def drop_stale(self, index_name: str, valid: set) -> int:
+        """Invalidate entries whose per-shard segment-id sets no longer
+        match the live ones — rides the same refresh/flush/merge hook as
+        the segment-stack tier (`valid` = {(shard, live seg-id tuple)})."""
+        return self.cache.invalidate_where(
+            lambda k, _e: k[0] == index_name and set(k[2]) != valid)
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(lambda k, _e: k[0] in want)
+
+    def stats(self) -> dict:
+        out = self.cache.stats()
+        out["oversized"] = self.oversized
+        out["declined"] = self.declined
+        return out
+
+
 class IndicesCacheService:
     """The node's cache roster. One `stats()`/`clear()` surface over the
     three tiers; per-index packed-view caches register here so their
@@ -425,6 +530,11 @@ class IndicesCacheService:
         # defaults to 10% of the breaker total
         self.segment_stacks = SegmentStackCache(
             max_bytes=parse_size(get("indices.stacked.cache.size", "10%"),
+                                 total, default=total // 10))
+        # mesh stacks duplicate the whole index's segment residency onto
+        # the device mesh — same default budget slice as segment stacks
+        self.mesh_stacks = MeshStackCache(
+            max_bytes=parse_size(get("indices.mesh.cache.size", "10%"),
                                  total, default=total // 10))
         # per-index packed-view caches (serving views) register here so
         # their byte totals surface without the service owning them
@@ -483,10 +593,11 @@ class IndicesCacheService:
                 want = set(indices)
                 out["query"] = self.query_plan.invalidate_where(
                     lambda k, _v: k[0] in want)
-            # packed segment stacks are query-execution structures: they
-            # ride the `query` tier flag (removal releases their breaker
-            # charge)
+            # packed segment/mesh stacks are query-execution structures:
+            # they ride the `query` tier flag (removal releases their
+            # breaker charge)
             out["segment_stack"] = self.segment_stacks.clear(indices)
+            out["mesh_stack"] = self.mesh_stacks.clear(indices)
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
         return out
@@ -495,7 +606,8 @@ class IndicesCacheService:
         out = {"request": self.request_cache.stats(),
                "query_plan": self.query_plan.stats(),
                "fielddata": self.fielddata.stats(),
-               "segment_stack": self.segment_stacks.stats()}
+               "segment_stack": self.segment_stacks.stats(),
+               "mesh_stack": self.mesh_stacks.stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
         return out
@@ -505,3 +617,4 @@ class IndicesCacheService:
         self.query_plan.clear()
         self.fielddata.cache.clear()
         self.segment_stacks.cache.clear()
+        self.mesh_stacks.cache.clear()

@@ -872,8 +872,24 @@ class NodeService:
 
         shard_failures = 0
         shard_failure_details: list[dict] = []
+        mesh_reduced = None
         with tracing.span("query"):
-            if len(searchers) == 1:
+            # mesh-sharded query lane (parallel/mesh_exec): when this node
+            # owns every shard and the device mesh can seat them, the
+            # whole multi-shard query phase — per-shard stacked execution
+            # AND the cross-shard merge — runs as ONE shard_map program
+            # with ONE device fetch and zero host-side per-shard merges.
+            # Sorted/search_after/knn/rescore/agg bodies, cross-host
+            # shards and unsupported plans fall through to the fan-out.
+            if (len(names) == 1 and len(searchers) > 1 and knn is None
+                    and sort is None and search_after is None
+                    and rescore_spec is None and not agg_specs):
+                mesh_reduced = self._try_mesh(
+                    names[0], searchers, nodes_by_index[names[0]],
+                    global_stats, size=size, from_=from_)
+            if mesh_reduced is not None:
+                results = []
+            elif len(searchers) == 1:
                 # sequential fast path: no job/context machinery, errors
                 # raise straight through exactly as before
                 results = [_run_shard(0, searchers[0])]
@@ -930,8 +946,11 @@ class NodeService:
                            (t_device_done - t_parse_done) * 1000)
         if prof is not None:
             prof.record_phase("query", (t_device_done - t_parse_done) * 1000)
-        reduced = controller.sort_docs(results, from_=from_, size=size,
-                                       sort=sort)
+        # the mesh lane already reduced ON DEVICE — sort_docs (the host
+        # cross-shard merge) runs only for the fan-out path
+        reduced = mesh_reduced if mesh_reduced is not None \
+            else controller.sort_docs(results, from_=from_, size=size,
+                                      sort=sort)
         src_filter = body.get("_source")
         fields_spec = body.get("fields")
         if isinstance(fields_spec, str):
@@ -1444,6 +1463,82 @@ class NodeService:
         svc.meters["search"].mark(len(bodies))
         self.meters["search"].mark(len(bodies))
         return out
+
+    # -- mesh-sharded query lane (parallel/mesh_exec, ISSUE 6) -------------
+
+    def _try_mesh(self, name: str, searchers, node_tree, global_stats, *,
+                  size: int, from_: int):
+        """One mesh-lane attempt for an unsorted multi-shard query:
+        returns the ReducedDocs the on-device collective reduce produced,
+        or None to fall back to the PR-4 concurrent fan-out (opt-out
+        settings, joins, unsupported plan shapes, too few devices,
+        breaker-declined/oversized mesh stacks, or any execution error)."""
+        svc = self.indices[name]
+        if not svc._mesh_enabled \
+                or not _mesh_enabled_setting(self.settings):
+            return None
+        from .search.query_dsl import contains_joins
+        if contains_joins(node_tree):
+            return None
+        from .parallel import mesh_exec
+        if not mesh_exec.plan_types_supported(node_tree):
+            return None
+        if mesh_exec.mesh_for(len(searchers)) is None:
+            return None     # cross-host topology / fewer devices than shards
+        k = max(size + from_, 1)
+        try:
+            stack = self.caches.mesh_stacks.get_or_build(
+                name, svc._incarnation,
+                [list(s.segments) for s in searchers],
+                breaker=self.breakers.breaker("fielddata"))
+            if stack is None:
+                return None
+            with tracing.span("mesh_reduce", index=name,
+                              shards=len(searchers), k=k):
+                out = mesh_exec.execute(stack, node_tree, global_stats,
+                                        k=k, Q=1)
+            if out is None:
+                return None     # plan has no collective form (field shapes)
+        except Exception:  # noqa: BLE001 — the fan-out is always correct
+            self._mesh_error(svc)
+            return None
+        keys, shard_of, scores, total, mx = out
+        svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
+        svc.search_stats["mesh_dispatches"] = \
+            svc.search_stats.get("mesh_dispatches", 0) + 1
+        from .common.metrics import current_profiler, record_shard_fetches
+        record_shard_fetches(1)     # ONE fetch served every shard
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_path("mesh")
+        row_k, row_sh, row_s = keys[0], shard_of[0], scores[0]
+        valid = row_k >= 0
+        vk, vsh, vs = row_k[valid], row_sh[valid], row_s[valid]
+        window = slice(from_, from_ + size)
+        import math as _math
+        mxv = float(mx[0])
+        from .search.controller import ReducedDocs
+        return ReducedDocs(
+            shard_order=[int(x) for x in vsh[window]],
+            doc_keys=[int(x) for x in vk[window]],
+            scores=[float(x) for x in vs[window]],
+            sort_values=None,
+            total_hits=int(total[0]),
+            max_score=mxv if _math.isfinite(mxv) else float("nan"))
+
+    _mesh_error_logged = 0
+
+    def _mesh_error(self, svc=None) -> None:
+        """The mesh lane degrades to the fan-out on any exception — but a
+        silently-swallowed bug in it would read as a perf regression, so
+        count and (rate-limited) log."""
+        if svc is not None:
+            svc.search_stats["mesh_errors"] = \
+                svc.search_stats.get("mesh_errors", 0) + 1
+        if NodeService._mesh_error_logged < 10:
+            NodeService._mesh_error_logged += 1
+            logger.warning("mesh query lane failed; served via the "
+                           "concurrent fan-out instead", exc_info=True)
 
     _packed_error_logged = 0
 
@@ -2320,6 +2415,7 @@ class NodeService:
         for svc in self.indices.values():
             for pk, pv in svc.search_stats.items():
                 path_totals[pk] = path_totals.get(pk, 0) + pv
+        from .common.metrics import host_merge_count
         search_exec = {
             "segment_dispatches_total":
                 path_totals.get("segment_dispatches", 0),
@@ -2327,6 +2423,13 @@ class NodeService:
                 path_totals.get("stacked_dispatches", 0),
             "stacked_queries_total": path_totals.get("stacked", 0),
             "stacked_errors_total": path_totals.get("stacked_errors", 0),
+            # mesh-sharded lane (ISSUE 6): whole-index collective programs
+            # vs per-shard stacked/segment dispatches, plus how many
+            # host-side cross-shard merges still ran (fan-out path)
+            "mesh_dispatches_total": path_totals.get("mesh_dispatches", 0),
+            "mesh_queries_total": path_totals.get("mesh", 0),
+            "mesh_errors_total": path_totals.get("mesh_errors", 0),
+            "host_merges_total": host_merge_count(),
             "sparse_queries_total": path_totals.get("sparse", 0),
             "dense_queries_total": path_totals.get("dense", 0),
             "packed_queries_total": path_totals.get("packed", 0),
@@ -2406,6 +2509,8 @@ class NodeService:
                 self.caches.fielddata.cache.memory_bytes,
             "segment_stack_cache_memory_bytes":
                 self.caches.segment_stacks.cache.memory_bytes,
+            "mesh_stack_cache_memory_bytes":
+                self.caches.mesh_stacks.cache.memory_bytes,
         }
         tr = self.tracer.stats()
         out["tracing_active_traces"] = tr["active_traces"]
@@ -2511,6 +2616,16 @@ def _contains_mlt(q) -> bool:
     if isinstance(q, list):
         return any(_contains_mlt(x) for x in q)
     return False
+
+
+def _mesh_enabled_setting(settings) -> bool:
+    """`node.search.mesh.enable` (default true) — the node-level opt-out
+    of the mesh-sharded query lane (read live, so tests and `_settings`
+    overlays apply without a restart)."""
+    v = settings.get("node.search.mesh.enable", True)
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off")
+    return bool(v)
 
 
 def _req_cache_enabled(settings) -> bool:

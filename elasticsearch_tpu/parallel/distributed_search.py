@@ -111,8 +111,12 @@ class DistributedSearcher:
 
     def __post_init__(self):
         # jit caches by function identity — memoize compiled steps per
-        # static config or every search would retrace + recompile
-        self._steps: dict[tuple, object] = {}
+        # static config or every search would retrace + recompile.
+        # A bounded common.cache.Cache, not a bare dict: step configs are
+        # user-driven (k, Wt vary per request shape) and an unbounded memo
+        # is a slow leak (tests/test_cache_lint.py tripwire)
+        from ..common.cache import Cache
+        self._step_cache = Cache("dist_steps", max_entries=64)
 
     def place(self):
         """Shard the packed index onto the mesh (one device_put per array;
@@ -134,7 +138,7 @@ class DistributedSearcher:
                    k1: float = K1_DEFAULT, b: float = B_DEFAULT):
         """jit(shard_map) of the query step, memoized per static config."""
         key = (Wt, k, k1, b)
-        cached = self._steps.get(key)
+        cached = self._step_cache.get(key)
         if cached is not None:
             return cached
         n_pad = self.index.n_pad
@@ -149,7 +153,7 @@ class DistributedSearcher:
             in_specs=(shard_specs,) * 5 + (query_specs,) * 3,
             out_specs=out_specs)
         step = jax.jit(mapped)
-        self._steps[key] = step
+        self._step_cache.put(key, step, weight=1)
         return step
 
     def build_knn_step(self, *, k: int, metric: str = "cosine"):
@@ -157,16 +161,21 @@ class DistributedSearcher:
         all_gather cross-shard reduce as text search. One compiled program
         for the whole mesh."""
         key = ("knn", k, metric)
-        cached = self._steps.get(key)
+        cached = self._step_cache.get(key)
         if cached is not None:
             return cached
 
-        def knn_step(vecs, live, qv):
+        def knn_step(vecs, live, qv, q_valid):
             from ..ops import knn as knn_ops
             vecs = vecs[0]            # [N, D]
             live_b = live[0]          # [N]
             sims = knn_ops._sim(qv, vecs, metric)
             sims = jnp.where(live_b[None, :], sims, -jnp.inf)
+            # replica-padding rows are all-zero query vectors: cosine on
+            # them divides 0 by ~0, and a NaN lane would poison the
+            # top-k/keys math below — mask pad rows INSIDE the step so
+            # they contribute -inf (no hits), not NaN
+            sims = jnp.where(q_valid[:, None], sims, -jnp.inf)
             top, idx = lax.top_k(sims, k)
             my_shard = lax.axis_index(SHARD_AXIS).astype(jnp.int64)
             keys = jnp.where(top > -jnp.inf,
@@ -182,9 +191,10 @@ class DistributedSearcher:
 
         step = jax.jit(_shard_map(
             knn_step, mesh=self.mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS)),
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS),
+                      P(REPLICA_AXIS)),
             out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS))))
-        self._steps[key] = step
+        self._step_cache.put(key, step, weight=1)
         return step
 
     def search_knn(self, field: str, query_vectors, *, k: int = 10,
@@ -199,6 +209,8 @@ class DistributedSearcher:
         if q_pad != Q:
             qv = np.concatenate([qv, np.zeros((q_pad - Q, qv.shape[1]),
                                               np.float32)])
+        q_valid = np.zeros((q_pad,), bool)
+        q_valid[:Q] = True
         step = self.build_knn_step(k=k, metric=metric)
         prof = current_profiler()
         from ..common.metrics import note_h2d
@@ -206,12 +218,13 @@ class DistributedSearcher:
         if prof is not None:
             with prof.phase("spmd_query"):
                 scores, keys = step(vf.vecs, self.index.live,
-                                    jnp.asarray(qv))
+                                    jnp.asarray(qv), jnp.asarray(q_valid))
                 scores, keys = np.asarray(scores), np.asarray(keys)
             prof.note_dispatch()
             prof.note_d2h(scores.nbytes + keys.nbytes)
             return scores[:Q], keys[:Q]
-        scores, keys = step(vf.vecs, self.index.live, jnp.asarray(qv))
+        scores, keys = step(vf.vecs, self.index.live, jnp.asarray(qv),
+                            jnp.asarray(q_valid))
         return np.asarray(scores)[:Q], np.asarray(keys)[:Q]
 
     def search_terms(self, field: str, queries: list[list[str]], *,

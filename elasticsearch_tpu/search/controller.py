@@ -41,6 +41,8 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
     shard-ordinal tie-break). Field sorts compare MATERIALIZED values
     (strings/numbers), never ordinals — see search/sort.py."""
     t0 = time.perf_counter()
+    from ..common.metrics import record_host_merge
+    record_host_merge()
     sort = sort_mod.normalize(sort)
     entries = []   # (primary_key, shard_idx, pos, doc_key, score, sort_val)
     total = 0

@@ -1,0 +1,446 @@
+"""Mesh-sharded query lane (ISSUE 6): equivalence vs the fan-out,
+single-fetch/zero-host-merge counters, the mesh-stack cache lifecycle,
+the fallback ladder, and the distributed-search satellites.
+
+The mesh lane replaces the coordinator's thread-pool fan-out (S device
+fetches + a host-side cross-shard merge per multi-shard query) with ONE
+shard_map program over the ("replica", "shard") mesh: per-shard stacked
+execution, in-shard merge AND the cross-shard top-k reduce fused on
+device. These tests pin the contract:
+
+  * mesh results are bitwise-identical to the concurrent fan-out across
+    the mesh-native query-shape matrix (same stable merge order, same
+    score dtype promotion);
+  * a multi-shard mesh query performs exactly ONE device_fetch and ZERO
+    host-side per-shard merges (counter-asserted);
+  * the mesh stack is fielddata-breaker-charged and invalidated by
+    refresh/merge/`_cache/clear`/close;
+  * the fallback ladder — sorted bodies, unsupported plans, opt-out
+    settings, more shards than devices, oversized/declined stacks,
+    cross-host clusters — lands on the fan-out, never errors.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import NodeService
+
+N_SHARDS = 4
+WORDS = ["quick", "brown", "fox", "jumps", "lazy", "dog", "sleeps",
+         "swift", "river", "stone"]
+
+# mesh-native query shapes (every node type with a typed mesh handler)
+MESH_QUERIES = [
+    {"match_all": {}},
+    {"bool": {"should": [{"match": {"body": "fox"}},
+                         {"match": {"body": "dog"}}]}},
+    {"bool": {"should": [{"match": {"body": "quick"}}],
+              "filter": [{"range": {"n": {"gte": 2, "lt": 60}}}]}},
+    {"term": {"tag": "t1"}},
+    {"terms": {"tag": ["t0", "t2"]}},
+    {"term": {"n": 4}},
+    {"term": {"price": 6.5}},
+    {"range": {"n": {"gt": 30}}},
+    {"range": {"price": {"gte": 2.0, "lt": 50.0}}},
+    {"range": {"tag": {"gte": "t0", "lte": "t1"}}},
+    {"exists": {"field": "price"}},
+    {"exists": {"field": "body"}},
+    {"ids": {"values": ["1", "5", "8", "77"]}},
+    {"ids": {"values": ["zzz-absent"]}},
+    {"constant_score": {"filter": {"term": {"tag": "t1"}}, "boost": 2.5}},
+    {"dis_max": {"queries": [{"match": {"body": "fox"}},
+                             {"match": {"body": "dog"}}],
+                 "tie_breaker": 0.4}},
+    {"bool": {"must": [{"match": {"body": "fox"}}],
+              "must_not": [{"term": {"tag": "t2"}}],
+              "should": [{"match": {"body": "brown"}}]}},
+    {"bool": {"should": [{"match": {"body": {"query": "fox brown",
+                                             "operator": "and"}}}]}},
+    {"bool": {"should": [{"match": {"body": "quick"}},
+                         {"match": {"body": "river"}}],
+              "minimum_should_match": 2}},
+]
+
+DENSE_Q = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "string"},
+    "tag": {"type": "string", "index": "not_analyzed"},
+    "n": {"type": "long"},
+    "price": {"type": "double"}}}}
+
+
+def _fill(n, names, shards=N_SHARDS, rounds=3, per_round=16):
+    for name in names:
+        if name not in n.indices:
+            n.create_index(name, settings={"number_of_shards": shards},
+                           mappings=MAPPING)
+    di = 0
+    for _ in range(rounds):
+        for _ in range(per_round):
+            doc = {"body": f"{WORDS[di % 10]} {WORDS[(di * 3 + 1) % 10]} "
+                           f"{WORDS[(di * 7 + 2) % 10]}",
+                   "tag": f"t{di % 3}", "n": di}
+            if di % 2 == 0:
+                doc["price"] = di / 2.0
+            for name in names:
+                n.index_doc(name, str(di), dict(doc))
+            di += 1
+        for name in names:
+            n.refresh(name)
+    return di
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """Two identical 4-shard corpora: "m" on the mesh lane, "f" pinned to
+    the concurrent fan-out (`index.search.mesh.enable: false`). Same doc
+    ids -> same routing -> identical shard layouts."""
+    n = NodeService(str(tmp_path_factory.mktemp("mesh")))
+    n.create_index("m", settings={"number_of_shards": N_SHARDS},
+                   mappings=MAPPING)
+    n.create_index("f", settings={"number_of_shards": N_SHARDS,
+                                  "index.search.mesh.enable": False},
+                   mappings=MAPPING)
+    _fill(n, ["m", "f"])
+    yield n
+    n.close()
+
+
+def _hits(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+def _search(n, name, q, **extra):
+    return n.search(name, json.loads(json.dumps(
+        {"size": 10, "query": q, **extra})))
+
+
+class TestMeshEquivalence:
+    @pytest.mark.parametrize("q", MESH_QUERIES,
+                             ids=[json.dumps(q)[:48] for q in MESH_QUERIES])
+    def test_bitwise_identical_to_fanout(self, pair, q):
+        n = pair
+        before = n.indices["m"].search_stats.get("mesh", 0)
+        got = _search(n, "m", q)
+        assert n.indices["m"].search_stats.get("mesh", 0) == before + 1, \
+            f"mesh lane did not engage for {q}"
+        want = _search(n, "f", q)
+        assert n.indices["f"].search_stats.get("mesh", 0) == 0
+        assert got["hits"]["total"] == want["hits"]["total"], q
+        assert got["hits"]["max_score"] == want["hits"]["max_score"], q
+        assert _hits(got) == _hits(want), q
+
+    def test_deep_pagination_identical(self, pair):
+        n = pair
+        q = {"match_all": {}}
+        got = _search(n, "m", q, size=40, **{"from": 5})
+        want = _search(n, "f", q, size=40, **{"from": 5})
+        assert _hits(got) == _hits(want)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert len(got["hits"]["hits"]) == 40
+
+    def test_tombstones_identical(self, pair):
+        n = pair
+        for name in ("m", "f"):
+            n.delete_doc(name, "7")
+            n.refresh(name)
+        q = {"bool": {"should": [{"match": {"body": "fox"}},
+                                 {"match": {"body": "dog"}}]}}
+        got = _search(n, "m", q, size=96)
+        want = _search(n, "f", q, size=96)
+        assert _hits(got) == _hits(want)
+        assert "7" not in [h for h, _s in _hits(got)]
+
+    def test_shards_section_all_successful(self, pair):
+        out = _search(pair, "m", {"match_all": {}})
+        assert out["_shards"] == {"total": N_SHARDS,
+                                  "successful": N_SHARDS, "failed": 0}
+
+
+class TestMeshCounters:
+    def test_one_fetch_zero_host_merges(self, pair):
+        from elasticsearch_tpu.common.metrics import (host_merge_count,
+                                                      transfer_snapshot)
+        n = pair
+        n.search("m", json.loads(json.dumps(DENSE_Q)))        # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        h0 = host_merge_count()
+        n.search("m", json.loads(json.dumps(DENSE_Q)))
+        assert transfer_snapshot()["device_fetches_total"] - f0 == 1, \
+            "a multi-shard mesh query must pay exactly ONE device fetch"
+        assert host_merge_count() - h0 == 0, \
+            "the mesh lane must not run the host-side cross-shard merge"
+
+    def test_fanout_pays_per_shard(self, pair):
+        from elasticsearch_tpu.common.metrics import (host_merge_count,
+                                                      transfer_snapshot)
+        n = pair
+        n.search("f", json.loads(json.dumps(DENSE_Q)))        # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        h0 = host_merge_count()
+        n.search("f", json.loads(json.dumps(DENSE_Q)))
+        assert transfer_snapshot()["device_fetches_total"] - f0 == N_SHARDS
+        assert host_merge_count() - h0 == 1
+
+    def test_profile_query_paths_mesh(self, pair):
+        out = pair.search("m", {"profile": True,
+                                **json.loads(json.dumps(DENSE_Q))})
+        assert out["profile"]["device"]["query_paths"].get("mesh", 0) == 1
+
+    def test_trace_mesh_reduce_span(self, pair):
+        n = pair
+        with n.tracer.request("mesh-span-test", force=True):
+            n.search("m", json.loads(json.dumps(DENSE_Q)))
+        trace = n.tracer.list()[0]
+        full = n.tracer.get(trace["trace_id"])
+        assert any(s["name"] == "mesh_reduce" for s in full["spans"])
+        # zero shard fan-out subtrees: the collective replaced them
+        assert not any(s["name"] == "shard" for s in full["spans"])
+
+
+class TestFallbackLadder:
+    def test_sorted_falls_back_to_fanout(self, pair):
+        n = pair
+        before = n.indices["m"].search_stats.get("mesh", 0)
+        body = {"size": 10, "query": {"match_all": {}},
+                "sort": [{"n": {"order": "desc"}}]}
+        out = n.search("m", json.loads(json.dumps(body)))
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == sorted(ids, key=int, reverse=True)[:len(ids)]
+        assert n.indices["m"].search_stats.get("mesh", 0) == before
+
+    def test_unsupported_plan_falls_back(self, pair):
+        n = pair
+        before = n.indices["m"].search_stats.get("mesh", 0)
+        out = _search(n, "m", {"prefix": {"body": "qu"}})
+        assert out["hits"]["total"] > 0
+        assert n.indices["m"].search_stats.get("mesh", 0) == before
+
+    def test_aggs_fall_back(self, pair):
+        n = pair
+        before = n.indices["m"].search_stats.get("mesh", 0)
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"tags": {"terms": {"field": "tag"}}}}
+        out = n.search("m", json.loads(json.dumps(body)),
+                       request_cache=False)
+        assert out["aggregations"]["tags"]["buckets"]
+        assert n.indices["m"].search_stats.get("mesh", 0) == before
+
+    def test_more_shards_than_devices_falls_back(self, tmp_path):
+        import jax
+        n = NodeService(str(tmp_path / "wide"))
+        try:
+            shards = len(jax.devices()) * 2     # S_pad > device count
+            n.create_index("w", settings={"number_of_shards": shards},
+                           mappings=MAPPING)
+            for i in range(32):
+                n.index_doc("w", str(i), {"body": f"quick fox {i}", "n": i})
+            n.refresh("w")
+            out = n.search("w", json.loads(json.dumps(DENSE_Q)))
+            assert out["hits"]["total"] > 0
+            assert n.indices["w"].search_stats.get("mesh", 0) == 0
+        finally:
+            n.close()
+
+    def test_oversized_stack_declined(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+        n = NodeService(str(tmp_path / "tiny"),
+                        settings=Settings({"indices.mesh.cache.size": 64}))
+        try:
+            _fill(n, ["t"], rounds=2, per_round=8)
+            out = n.search("t", json.loads(json.dumps(DENSE_Q)))
+            assert out["hits"]["total"] > 0
+            assert n.indices["t"].search_stats.get("mesh", 0) == 0
+            assert n.caches.mesh_stacks.stats()["oversized"] >= 1
+        finally:
+            n.close()
+
+    def test_node_level_opt_out(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+        n = NodeService(str(tmp_path / "off"), settings=Settings(
+            {"node.search.mesh.enable": False}))
+        try:
+            _fill(n, ["t"], rounds=2, per_round=8)
+            out = n.search("t", json.loads(json.dumps(DENSE_Q)))
+            assert out["hits"]["total"] > 0
+            assert n.indices["t"].search_stats.get("mesh", 0) == 0
+        finally:
+            n.close()
+
+    def test_cross_host_cluster_falls_back(self, tmp_path):
+        """Shards spread over cluster nodes never see the mesh lane: the
+        cluster driver fans out over the transport and merges host-side
+        (the inter-host RPC half of SURVEY §5.8's topology)."""
+        from elasticsearch_tpu.cluster import TestCluster
+        from elasticsearch_tpu.parallel import mesh_exec
+        cluster = TestCluster(2, str(tmp_path / "cluster"))
+        try:
+            client = cluster.client()
+            client.create_index("docs", {"number_of_shards": 2,
+                                         "number_of_replicas": 0})
+            cluster.ensure_green()
+            for i in range(20):
+                client.index_doc("docs", str(i),
+                                 {"body": f"quick brown fox {i}"})
+            client.refresh("docs")
+            st0 = mesh_exec.program_cache_stats()
+            lookups0 = st0["hits_total"] + st0["misses_total"]
+            out = client.search("docs", json.loads(json.dumps(DENSE_Q)))
+            assert out["hits"]["total"] == 20
+            st1 = mesh_exec.program_cache_stats()
+            assert st1["hits_total"] + st1["misses_total"] == lookups0, \
+                "no mesh program may run for cluster-spread shards"
+        finally:
+            cluster.close()
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(str(tmp_path / "node"))
+    yield n
+    n.close()
+
+
+class TestMeshStackCache:
+    def test_breaker_charged_and_released(self, node):
+        _fill(node, ["t"])
+        br = node.breakers.breaker("fielddata")
+        used0 = br.used
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        st = node.caches.mesh_stacks.stats()
+        assert st["entries"] == 1
+        assert st["memory_size_in_bytes"] > 0
+        assert br.used >= used0 + st["memory_size_in_bytes"]
+        cleared = node.caches.clear(query=True)
+        assert cleared["mesh_stack"] == 1
+        assert node.caches.mesh_stacks.stats()["entries"] == 0
+        assert br.used <= used0 + 1
+
+    def test_refresh_invalidates(self, node):
+        _fill(node, ["t"])
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.mesh_stacks.stats()["entries"] == 1
+        node.index_doc("t", "zzz", {"body": "new doc", "n": 999})
+        node.refresh("t")
+        assert node.caches.mesh_stacks.stats()["entries"] == 0
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.mesh_stacks.stats()["entries"] == 1
+
+    def test_merge_invalidates(self, node):
+        _fill(node, ["t"])
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        node.force_merge("t")
+        assert node.caches.mesh_stacks.stats()["entries"] == 0
+        out = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert out["hits"]["total"] > 0
+
+    def test_cache_clear_http(self, node):
+        import http.client
+
+        from elasticsearch_tpu.rest import HttpServer
+        _fill(node, ["t"])
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.mesh_stacks.stats()["entries"] == 1
+        server = HttpServer(node, port=0).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("POST", "/t/_cache/clear?query=true")
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200
+            assert out["cleared"]["mesh_stack"] == 1
+        finally:
+            server.stop()
+        assert node.caches.mesh_stacks.stats()["entries"] == 0
+
+    def test_index_close_clears(self, node):
+        _fill(node, ["t"])
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert node.caches.mesh_stacks.stats()["entries"] == 1
+        node.close_index("t")
+        assert node.caches.mesh_stacks.stats()["entries"] == 0
+
+    def test_delete_serves_via_liveness_not_rebuild(self, node):
+        _fill(node, ["t"])
+        out1 = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        total1 = out1["hits"]["total"]
+        victim = out1["hits"]["hits"][0]["_id"]
+        node.delete_doc("t", victim)
+        node.indices["t"].refresh()
+        out2 = node.search("t", json.loads(json.dumps(DENSE_Q)))
+        assert out2["hits"]["total"] == total1 - 1
+        assert victim not in [h["_id"] for h in out2["hits"]["hits"]]
+
+
+class TestMeshMetrics:
+    def test_scrape_families_and_sampler(self, node):
+        _fill(node, ["t"])
+        node.search("t", json.loads(json.dumps(DENSE_Q)))
+        from elasticsearch_tpu.common.metrics import render_openmetrics
+        text = render_openmetrics(node.metric_sections())
+        assert "es_search_mesh_dispatches_total" in text
+        assert "es_search_host_merges_total" in text
+        assert 'cache="mesh_stack"' in text
+        snap = node._sampler_snapshot()
+        assert snap["mesh_stack_cache_memory_bytes"] > 0
+        assert node.stats()["caches"]["mesh_stack"]["entries"] == 1
+
+
+# -- distributed-search satellites (ISSUE 6) --------------------------------
+
+class TestDistributedSatellites:
+    def test_knn_replica_padding_rows_masked(self):
+        """Q not divisible by n_replicas pads with all-zero query vectors;
+        pad rows must contribute -inf inside the step (never NaN through
+        cosine 0/0) and the [:Q] rows must come back NaN-free."""
+        import jax
+
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        from elasticsearch_tpu.mapping.mapper import MapperService
+        from elasticsearch_tpu.parallel import (DistributedSearcher,
+                                                PackedIndex, make_mesh,
+                                                shard_id)
+        rng = np.random.default_rng(7)
+        ms = MapperService(mappings={"_doc": {"properties": {
+            "v": {"type": "dense_vector", "dims": 8}}}})
+        mapper = ms.document_mapper("_doc")
+        builders = [SegmentBuilder(seg_id=i) for i in range(4)]
+        for i in range(24):
+            vec = rng.normal(0, 1, 8).astype(np.float32)
+            builders[shard_id(str(i), 4)].add(
+                mapper.parse({"v": [float(x) for x in vec]},
+                             doc_id=str(i)), "_doc")
+        shards = [b.build() for b in builders]
+        mesh = make_mesh(n_shards=4, n_replicas=2,
+                         devices=jax.devices()[:8])
+        ds = DistributedSearcher(index=PackedIndex.from_segments(shards),
+                                 mesh=mesh).place()
+        qv = rng.normal(0, 1, (3, 8)).astype(np.float32)   # pads to 4
+        scores, keys = ds.search_knn("v", qv, k=5, metric="cosine")
+        assert scores.shape == (3, 5)
+        assert not np.isnan(scores).any()
+        assert (keys >= 0).all()
+
+    def test_step_memo_is_bounded_cache(self):
+        """DistributedSearcher's compiled-step memo rides the common
+        Cache core (bounded, observable) and still memoizes."""
+        from elasticsearch_tpu.common.cache import Cache
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        from elasticsearch_tpu.mapping.mapper import MapperService
+        from elasticsearch_tpu.parallel import (DistributedSearcher,
+                                                PackedIndex, make_mesh)
+        ms = MapperService()
+        mapper = ms.document_mapper("_doc")
+        b = SegmentBuilder(seg_id=0)
+        b.add(mapper.parse({"body": "quick fox"}, doc_id="0"), "_doc")
+        ds = DistributedSearcher(
+            index=PackedIndex.from_segments([b.build()]),
+            mesh=make_mesh(n_shards=1, n_replicas=1))
+        assert isinstance(ds._step_cache, Cache)
+        s1 = ds.build_step(Wt=8, k=5)
+        assert ds.build_step(Wt=8, k=5) is s1
+        assert ds._step_cache.stats()["entries"] == 1

@@ -125,6 +125,71 @@ def test_dense_unsorted_batch_single_fetch_per_shard(stacked_node):
         f"{delta} device fetches for {n_shards} shard(s)"
 
 
+# -- mesh-sharded query lane (ISSUE 6) --------------------------------------
+
+MESH_BODY = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+@pytest.fixture(scope="module")
+def mesh_node(tmp_path_factory):
+    """4 shards on the 8-device test mesh; segments added in same-size
+    refresh rounds so every mesh-stack axis (S_pad, G_pad, N_pad, P_pad)
+    stays inside one pow2 bucket."""
+    n = NodeService(str(tmp_path_factory.mktemp("meshnr")))
+    n.create_index("mq", settings={"number_of_shards": 4},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    n._doc_seq = 0
+
+    def add_round():
+        for _ in range(16):
+            i = n._doc_seq
+            n._doc_seq += 1
+            n.index_doc("mq", str(i),
+                        {"body": f"quick brown fox jumps {i}", "n": i})
+        n.refresh("mq")
+    n._add_round = add_round
+    yield n
+    n.close()
+
+
+def test_mesh_refresh_cycles_within_bucket_zero_retraces(mesh_node):
+    """refresh→query cycles whose mesh-stack shapes stay in the same pow2
+    bucket must compile ZERO new programs on the mesh path."""
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = mesh_node
+    for _ in range(3):                 # ~3 segments/shard -> G_pad = 4
+        n._add_round()
+    _q = lambda: n.search("mq", json.loads(json.dumps(MESH_BODY)))
+    _q()                               # warm: compiles expected
+    _q()
+    assert n.indices["mq"].search_stats.get("mesh", 0) >= 2
+    before = device_events_snapshot()[0]
+    n._add_round()                     # 4th segment round: same G bucket
+    _q()
+    assert device_events_snapshot()[0] == before, \
+        "refresh→query cycle inside the pow2 bucket retraced the mesh lane"
+
+
+def test_mesh_query_one_fetch_zero_host_merges(mesh_node):
+    """Counter-asserted: a multi-shard mesh query performs exactly one
+    device_fetch TOTAL and zero host-side per-shard merges."""
+    from elasticsearch_tpu.common.metrics import (host_merge_count,
+                                                  transfer_snapshot)
+    n = mesh_node
+    if not n.indices["mq"].shards[0].segments:
+        n._add_round()
+    n.search("mq", json.loads(json.dumps(MESH_BODY)))     # warm
+    f0 = transfer_snapshot()["device_fetches_total"]
+    h0 = host_merge_count()
+    n.search("mq", json.loads(json.dumps(MESH_BODY)))
+    assert transfer_snapshot()["device_fetches_total"] - f0 == 1, \
+        "mesh lane must serve all 4 shards in one fetch"
+    assert host_merge_count() - h0 == 0
+
+
 # -- span tracing overhead (ISSUE 5) ----------------------------------------
 
 def test_tracing_disabled_zero_device_overhead(tmp_path_factory):

@@ -30,8 +30,12 @@ def http(tmp_path_factory):
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read()), e.headers
 
+    # mesh opt-out: these tests pin the per-shard fan-out's profile shape
+    # (one entry per shard); the mesh lane's single-program profile is
+    # covered in tests/test_mesh.py
     code, _, _ = req("PUT", "/prof", {
-        "settings": {"number_of_shards": 3},
+        "settings": {"number_of_shards": 3,
+                     "index.search.mesh.enable": False},
         "mappings": {"_doc": {"properties": {
             "body": {"type": "string"},
             "n": {"type": "long"}}}}})

@@ -227,8 +227,9 @@ def node(tmp_path):
     n.close()
 
 
-def _fill_multiseg(n, name, shards=1, rounds=3, per_round=8):
-    n.create_index(name, settings={"number_of_shards": shards},
+def _fill_multiseg(n, name, shards=1, rounds=3, per_round=8, mesh=True):
+    extra = {} if mesh else {"index.search.mesh.enable": False}
+    n.create_index(name, settings={"number_of_shards": shards, **extra},
                    mappings={"_doc": {"properties": {
                        "body": {"type": "string"},
                        "tag": {"type": "string", "index": "not_analyzed"},
@@ -340,7 +341,10 @@ class TestConcurrentFanOut:
             assert again["hits"]["total"] == first["hits"]["total"]
 
     def test_shard_failure_accounting(self, node, monkeypatch):
-        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=12)
+        # mesh opt-out: shard-failure accounting is a fan-out contract —
+        # the mesh lane's single program bypasses per-shard execution
+        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=12,
+                       mesh=False)
         searchers = node.indices["t"].searchers()
 
         def boom(*a, **kw):
@@ -356,7 +360,8 @@ class TestConcurrentFanOut:
         assert out["hits"]["total"] > 0
 
     def test_all_shards_failing_raises(self, node, monkeypatch):
-        _fill_multiseg(node, "t", shards=2, rounds=1, per_round=4)
+        _fill_multiseg(node, "t", shards=2, rounds=1, per_round=4,
+                       mesh=False)
         for s in node.indices["t"].searchers():
             monkeypatch.setattr(s, "execute_query_phase",
                                 lambda *a, **kw: (_ for _ in ()).throw(
@@ -365,7 +370,9 @@ class TestConcurrentFanOut:
             node.search("t", json.loads(json.dumps(DENSE_Q)))
 
     def test_profile_survives_concurrency(self, node):
-        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=9)
+        # mesh opt-out: pins the fan-out's per-shard profile attribution
+        _fill_multiseg(node, "t", shards=3, rounds=1, per_round=9,
+                       mesh=False)
         body = {"profile": True, **json.loads(json.dumps(DENSE_Q))}
         out = node.search("t", body)
         prof = out["profile"]

@@ -151,7 +151,10 @@ def test_every_rest_request_registers_a_task(http):
 
 def test_search_registers_shard_children_with_trace(http):
     node, req = http
-    req("PUT", "/tidx", {"settings": {"number_of_shards": 2},
+    # mesh opt-out: this test pins the fan-out's per-shard task children;
+    # the mesh lane runs one collective program with no shard phases
+    req("PUT", "/tidx", {"settings": {"number_of_shards": 2,
+                                      "index.search.mesh.enable": False},
                          "mappings": {"_doc": {"properties": {
                              "body": {"type": "string"}}}}})
     req("PUT", "/tidx/_doc/1", {"body": "hello world"})

@@ -47,7 +47,11 @@ def http(tmp_path_factory):
         except ValueError:
             return resp.status, raw.decode()
 
-    req("PUT", "/t", {"settings": {"number_of_shards": 2},
+    # mesh opt-out: these tests pin the fan-out's shard subtrees and
+    # multi-thread lanes; the mesh lane's mesh_reduce span is covered in
+    # tests/test_mesh.py
+    req("PUT", "/t", {"settings": {"number_of_shards": 2,
+                                   "index.search.mesh.enable": False},
                       "mappings": {"_doc": {"properties": {
                           "body": {"type": "string"},
                           "n": {"type": "long"}}}}})

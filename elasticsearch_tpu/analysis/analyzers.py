@@ -19,6 +19,27 @@ Token = str
 Tokenizer = Callable[[str], list[Token]]
 TokenFilter = Callable[[list[Token]], list[Token]]
 
+
+def per_token(f):
+    """Mark a token filter as PER-TOKEN: its output is the concatenation of
+    `f([t])` over the input tokens — no cross-token state (order, adjacency,
+    dedup). The batched ingest lane (index/bulk_ingest.py) applies chains of
+    per-token filters over a bulk request's *unique* vocabulary once instead
+    of per occurrence; unmarked filters (shingle, synonym, decompounder,
+    unique) force the per-doc fallback so semantics never change."""
+    f.per_token = True
+    return f
+
+
+# per-doc Analyzer.analyze invocations — the batched ingest lane's tripwire
+# counter (tests assert a ZERO delta across a vectorized _bulk; the whole
+# point of the batch lane is that this stays off the per-doc path)
+_ANALYZE_CALLS = [0]
+
+
+def analyze_call_count() -> int:
+    return _ANALYZE_CALLS[0]
+
 # ---------------------------------------------------------------------------
 # Tokenizers (ref: index/analysis/StandardTokenizerFactory.java etc.)
 # ---------------------------------------------------------------------------
@@ -83,18 +104,22 @@ def edge_ngram_tokenizer(text: str, min_gram: int = 1, max_gram: int = 8) -> lis
 from .languages import _ENGLISH as ENGLISH_STOPWORDS  # noqa: E402
 
 
+@per_token
 def lowercase_filter(tokens: list[Token]) -> list[Token]:
     return [t.lower() for t in tokens]
 
 
+@per_token
 def uppercase_filter(tokens: list[Token]) -> list[Token]:
     return [t.upper() for t in tokens]
 
 
+@per_token
 def stop_filter(tokens: list[Token], stopwords: frozenset[str] = ENGLISH_STOPWORDS) -> list[Token]:
     return [t for t in tokens if t not in stopwords]
 
 
+@per_token
 def asciifolding_filter(tokens: list[Token]) -> list[Token]:
     out = []
     for t in tokens:
@@ -103,6 +128,7 @@ def asciifolding_filter(tokens: list[Token]) -> list[Token]:
     return out
 
 
+@per_token
 def trim_filter(tokens: list[Token]) -> list[Token]:
     return [t.strip() for t in tokens]
 
@@ -116,6 +142,7 @@ def unique_filter(tokens: list[Token]) -> list[Token]:
     return out
 
 
+@per_token
 def length_filter(tokens: list[Token], min_len: int = 0, max_len: int = 1 << 30) -> list[Token]:
     return [t for t in tokens if min_len <= len(t) <= max_len]
 
@@ -242,6 +269,7 @@ def porter_stem(w: str) -> str:
     return w
 
 
+@per_token
 def porter_stem_filter(tokens: list[Token]) -> list[Token]:
     return [porter_stem(t) for t in tokens]
 
@@ -336,7 +364,7 @@ def make_elision_filter(articles=None):
             if t:
                 out.append(t)
         return out
-    return f
+    return per_token(f)
 
 
 # ---------------------------------------------------------------------------
@@ -350,6 +378,7 @@ class Analyzer:
     filters: list[TokenFilter] = field(default_factory=list)
 
     def analyze(self, text: str) -> list[Token]:
+        _ANALYZE_CALLS[0] += 1
         if text is None:
             return []
         tokens = self.tokenizer(str(text))
@@ -384,7 +413,7 @@ def _register_language_analyzers() -> None:
         sw = STOPWORDS.get(lang)
         if sw is None:
             return None
-        return lambda toks: [t for t in toks if t not in sw]
+        return per_token(lambda toks: [t for t in toks if t not in sw])
 
     from .languages import LANGUAGES
     for lang in LANGUAGES:
@@ -474,7 +503,7 @@ def _filter_factory(ftype: str, params: dict) -> TokenFilter:
             else:
                 sw = STOPWORDS.get(lang, ENGLISH_STOPWORDS)
         sw = frozenset(str(x) for x in sw)
-        return lambda toks: [t for t in toks if t not in sw]
+        return per_token(lambda toks: [t for t in toks if t not in sw])
     if ftype == "shingle":
         return lambda toks: shingle_filter(
             toks, min_size=int(params.get("min_shingle_size", 2)),
@@ -484,17 +513,19 @@ def _filter_factory(ftype: str, params: dict) -> TokenFilter:
     if ftype == "length":
         lo = int(params.get("min", 0))
         hi = int(params.get("max", 1 << 30))
-        return lambda toks: length_filter(toks, lo, hi)
+        return per_token(lambda toks: length_filter(toks, lo, hi))
     if ftype in ("ngram", "nGram"):
         lo = int(params.get("min_gram", 1))
         hi = int(params.get("max_gram", 2))
-        return lambda toks: [g for t in toks
-                             for g in _ngram(t, lo, hi, edge=False)]
+        return per_token(lambda toks: [g for t in toks
+                                       for g in _ngram(t, lo, hi,
+                                                       edge=False)])
     if ftype in ("edge_ngram", "edgeNGram"):
         lo = int(params.get("min_gram", 1))
         hi = int(params.get("max_gram", 8))
-        return lambda toks: [g for t in toks
-                             for g in _ngram(t, lo, hi, edge=True)]
+        return per_token(lambda toks: [g for t in toks
+                                       for g in _ngram(t, lo, hi,
+                                                       edge=True)])
     if ftype == "elision":
         return make_elision_filter(params.get("articles"))
     if ftype == "cjk_bigram":

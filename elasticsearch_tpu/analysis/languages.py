@@ -236,6 +236,9 @@ def make_light_stemmer(lang: str):
     def f(tokens):
         return [light_stem(lang, t) for t in tokens]
     f.__name__ = f"{lang}_light_stem"
+    # per-token map (no cross-token state): the batched ingest lane may
+    # apply it over a bulk's unique vocabulary (analyzers.per_token contract)
+    f.per_token = True
     return f
 
 
@@ -255,5 +258,8 @@ def cjk_bigram(tokens):
             out.append(t)
     return out
 
+
+# each token expands independently into its bigrams — per-token contract
+cjk_bigram.per_token = True
 
 LANGUAGES = sorted(set(STOPWORDS) | set(_SUFFIXES))

@@ -1049,61 +1049,89 @@ class ClusterNode:
 
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   type_name: str = "_doc", routing: str | None = None,
-                  **kw) -> dict:
+                  _local_defer: set | None = None, **kw) -> dict:
         if doc_id is None:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
         return self._write_op(index, {
             "op": "index", "id": doc_id, "source": source, "type": type_name,
-            "routing": routing, **kw})
+            "routing": routing, **kw}, local_defer=_local_defer)
 
     def delete_doc(self, index: str, doc_id: str,
-                   routing: str | None = None, **kw) -> dict:
+                   routing: str | None = None,
+                   _local_defer: set | None = None, **kw) -> dict:
         return self._write_op(index, {"op": "delete", "id": doc_id,
-                                      "routing": routing, **kw})
+                                      "routing": routing, **kw},
+                              local_defer=_local_defer)
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
         """(action, meta, source) ops -> per-item results (ref
-        TransportBulkAction split-by-shard; per-item error contract)."""
+        TransportBulkAction split-by-shard; per-item error contract).
+
+        Group commit for locally-held primaries: their ops defer the
+        per-op translog fsync and every touched local engine syncs ONCE
+        at the end of the request (the reference's per-request
+        durability). Ops forwarded to remote primaries keep their per-op
+        durability — the remote node acks only after its own fsync."""
         items = []
-        for action, meta, source in operations:
-            index = meta.get("_index")
-            type_name = meta.get("_type", "_doc")
-            doc_id = meta.get("_id")
-            try:
-                if action in ("index", "create"):
-                    r = self.index_doc(
-                        index, doc_id, source, type_name=type_name,
-                        routing=meta.get("_routing") or meta.get("routing"),
-                        op_type="create" if action == "create" else "index")
-                    items.append({action: {
-                        "_index": index, "_type": type_name,
-                        "_id": r["_id"], "_version": r["_version"],
-                        "status": 201 if r.get("created") else 200}})
-                elif action == "delete":
-                    r = self.delete_doc(
-                        index, doc_id,
-                        routing=meta.get("_routing") or meta.get("routing"))
-                    items.append({"delete": {
-                        "_index": index, "_type": type_name, "_id": doc_id,
-                        "_version": r["_version"],
-                        "found": r.get("found", True),
-                        "status": 200 if r.get("found", True) else 404}})
-                else:
-                    items.append({action: {
-                        "status": 400,
-                        "error": f"unsupported bulk action [{action}]"}})
-            except VersionConflictException as e:
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "status": 409, "error": str(e)}})
-            except Exception as e:  # noqa: BLE001 — per-item contract
-                items.append({action: {"_index": index, "_id": doc_id,
-                                       "status": 400, "error": str(e)}})
+        deferred: set = set()    # local engines written with sync=False
+        try:
+            for op_t in operations:
+                # (action, meta, source) or (action, meta, source, raw_len)
+                action, meta, source = op_t[0], op_t[1], op_t[2]
+                index = meta.get("_index")
+                type_name = meta.get("_type", "_doc")
+                doc_id = meta.get("_id")
+                try:
+                    if action in ("index", "create"):
+                        r = self.index_doc(
+                            index, doc_id, source, type_name=type_name,
+                            routing=meta.get("_routing")
+                            or meta.get("routing"),
+                            op_type="create" if action == "create"
+                            else "index",
+                            _local_defer=deferred)
+                        items.append({action: {
+                            "_index": index, "_type": type_name,
+                            "_id": r["_id"], "_version": r["_version"],
+                            "status": 201 if r.get("created") else 200}})
+                    elif action == "delete":
+                        r = self.delete_doc(
+                            index, doc_id,
+                            routing=meta.get("_routing")
+                            or meta.get("routing"),
+                            _local_defer=deferred)
+                        items.append({"delete": {
+                            "_index": index, "_type": type_name,
+                            "_id": doc_id,
+                            "_version": r["_version"],
+                            "found": r.get("found", True),
+                            "status": 200 if r.get("found", True) else 404}})
+                    else:
+                        items.append({action: {
+                            "status": 400,
+                            "error": f"unsupported bulk action [{action}]"}})
+                except VersionConflictException as e:
+                    items.append({action: {"_index": index, "_id": doc_id,
+                                           "status": 409, "error": str(e)}})
+                except Exception as e:  # noqa: BLE001 — per-item contract
+                    items.append({action: {"_index": index, "_id": doc_id,
+                                           "status": 400, "error": str(e)}})
+        finally:
+            for eng in deferred:
+                try:
+                    eng.translog.sync()
+                except Exception:  # noqa: BLE001 — engine may have closed
+                    pass
         return items
 
-    def _write_op(self, index: str, op: dict, timeout: float = 10.0) -> dict:
+    def _write_op(self, index: str, op: dict, timeout: float = 10.0,
+                  local_defer: set | None = None) -> dict:
         """Route to the primary, retrying on stale routing / primary
-        failover — the reference's retry-on-cluster-state-change loop."""
+        failover — the reference's retry-on-cluster-state-change loop.
+        local_defer: when set and the primary is LOCAL, the engine write
+        skips its per-op fsync and the engine joins the set for the
+        caller's single end-of-request sync (bulk group commit)."""
         deadline = time.monotonic() + timeout
         last_err: Exception | None = None
         while time.monotonic() < deadline:
@@ -1132,7 +1160,14 @@ class ClusterNode:
             payload = {**op, "index": index, "shard": sid}
             try:
                 if primary["node"] == self.node_id:
-                    return self._on_primary_write(self.node_id, payload)
+                    if local_defer is not None:
+                        payload = {**payload, "sync": False}
+                    res = self._on_primary_write(self.node_id, payload)
+                    if local_defer is not None:
+                        holder = self._shards.get((index, sid))
+                        if holder is not None and holder.engine is not None:
+                            local_defer.add(holder.engine)
+                    return res
                 return self.transport.send(primary["node"], A_WRITE_P, payload)
             except ConnectTransportException as e:
                 last_err = e
@@ -1177,7 +1212,8 @@ class ClusterNode:
                 req["id"], req["source"], type_name=req.get("type", "_doc"),
                 version=req.get("version"),
                 version_type=req.get("version_type", "internal"),
-                op_type=req.get("op_type", "index"))
+                op_type=req.get("op_type", "index"),
+                sync=req.get("sync"))
             if mappers.mapping_version() != mv:
                 # dynamic mapping delta -> master metadata, so COORDINATORS
                 # can parse queries/sorts on the new fields (ref
@@ -1193,7 +1229,8 @@ class ClusterNode:
         else:
             res = holder.engine.delete(
                 req["id"], version=req.get("version"),
-                version_type=req.get("version_type", "internal"))
+                version_type=req.get("version_type", "internal"),
+                sync=req.get("sync"))
         # sync replication fan-out (ref :118-120 — replicas ack before we do)
         replica_req = {"index": index, "shard": sid, "op": req["op"],
                        "id": req["id"], "source": req.get("source"),

@@ -263,6 +263,43 @@ def host_merge_count() -> int:
         return _HOST_MERGES[0]
 
 
+# bulk-ingest lane counters (ISSUE 7): how many `_bulk` requests rode the
+# vectorized batch lane vs fell back to the per-doc path, how many docs each
+# carried, and a docs-per-bulk pow2 histogram — es_indexing_* on the scrape
+_BULK_INGEST = {"vectorized_bulks": 0, "fallback_bulks": 0,
+                "vectorized_docs": 0, "fallback_docs": 0}
+_BULK_DOCS_HIST: dict[int, int] = {}
+
+
+def record_bulk_ingest(docs: int, vectorized: bool) -> None:
+    """One `_bulk` request finished: `docs` ops, fully vectorized or not
+    (a request with ANY per-doc-lane op counts as fallback — mixed
+    requests are what the fallback ladder is for)."""
+    with _DEVICE_LOCK:
+        if vectorized:
+            _BULK_INGEST["vectorized_bulks"] += 1
+            _BULK_INGEST["vectorized_docs"] += docs
+        else:
+            _BULK_INGEST["fallback_bulks"] += 1
+            _BULK_INGEST["fallback_docs"] += docs
+        bucket = 1 << max(int(docs) - 1, 0).bit_length() if docs else 0
+        _BULK_DOCS_HIST[bucket] = _BULK_DOCS_HIST.get(bucket, 0) + 1
+
+
+def bulk_ingest_snapshot() -> dict:
+    with _DEVICE_LOCK:
+        return {"vectorized_bulks_total": _BULK_INGEST["vectorized_bulks"],
+                "fallback_bulks_total": _BULK_INGEST["fallback_bulks"],
+                "vectorized_docs_total": _BULK_INGEST["vectorized_docs"],
+                "fallback_docs_total": _BULK_INGEST["fallback_docs"]}
+
+
+def bulk_docs_histogram() -> dict[int, int]:
+    """{pow2 docs-per-bulk bucket: request count} snapshot."""
+    with _DEVICE_LOCK:
+        return dict(_BULK_DOCS_HIST)
+
+
 def transfer_snapshot() -> dict:
     """Process-wide host↔device transfer counters (every device_fetch /
     note_h2d call accounts here, profiler active or not) — the scrape's

@@ -51,11 +51,17 @@ class IndexService:
         # the mapper service so QueryParser sees it everywhere
         from .similarity import SimilarityService
         self.mappers.similarity = SimilarityService(self.settings)
+        # the vectorized bulk-ingest lane (index/bulk_ingest.py) is on
+        # unless the index opts out (`index.bulk.vectorized.enable: false`
+        # — the equivalence suite and bench use it to pin the per-doc path)
+        raw_vec = get("bulk.vectorized.enable", True)
+        self._bulk_vectorized = str(raw_vec).strip().lower() \
+            not in ("false", "0", "no")
         self.shards: list[Engine] = [
             Engine(os.path.join(path, str(s)), self.mappers, breaker=fd,
                    fielddata_cache=caches.fielddata
                    if caches is not None else None,
-                   index_name=name)
+                   index_name=name, vectorized=self._bulk_vectorized)
             for s in range(self.n_shards)]
         self.creation_date = None
         # searcher cache: rebuilt per shard only when its segment set changes
@@ -160,6 +166,50 @@ class IndexService:
         self.indexing_stats["delete_total"] += 1
         self.meters["indexing"].mark()
         return res
+
+    def bulk_ingest(self, ops: list) -> list:
+        """Vectorized bulk lane: route a run of BulkOps to their shards and
+        apply each shard's slice as ONE Engine.index_batch pass (batched
+        analysis + columnar buffer + group-commit translog). Preserves
+        per-shard op order (same-id ops always route to the same shard, so
+        cross-shard order is immaterial). Translog fsyncs are deferred —
+        the caller ends the request with sync_translogs(). Returns results
+        aligned with `ops` (EngineResult or the per-item exception)."""
+        for op in ops:
+            if op.routing is None and op.parent is not None:
+                op.routing = op.parent  # _parent doubles as routing
+        if self.n_shards == 1:
+            # single-shard indices (the bench shape) skip the per-op
+            # routing hash entirely
+            results = self.shards[0].index_batch(ops, sync=False)
+        else:
+            by_shard: dict[int, tuple[list[int], list]] = {}
+            for pos, op in enumerate(ops):
+                sid = route_shard(op.doc_id, self.n_shards, op.routing)
+                slot = by_shard.setdefault(sid, ([], []))
+                slot[0].append(pos)
+                slot[1].append(op)
+            results = [None] * len(ops)
+            for sid, (positions, shard_ops) in by_shard.items():
+                out = self.shards[sid].index_batch(shard_ops, sync=False)
+                for pos, res in zip(positions, out):
+                    results[pos] = res
+        # op counters mirror the per-doc path: successes only, per type
+        n_index = n_delete = 0
+        tmap = self.indexing_stats["types"]
+        for op, res in zip(ops, results):
+            if not isinstance(res, EngineResult):
+                continue
+            if op.action == "delete":
+                n_delete += 1
+            else:
+                n_index += 1
+                tmap[op.type_name] = tmap.get(op.type_name, 0) + 1
+        self.indexing_stats["index_total"] += n_index
+        self.indexing_stats["delete_total"] += n_delete
+        if n_index or n_delete:
+            self.meters["indexing"].mark(n_index + n_delete)
+        return results
 
     def sync_translogs(self) -> None:
         """One fsync per shard — the tail of a deferred-sync bulk request

@@ -391,6 +391,21 @@ class SegmentBuilder:
         self._doubles: dict[str, dict[int, float]] = {}
         self._vectors: dict[str, dict[int, list[float]]] = {}
         self._vector_dims: dict[str, int] = {}
+        # columnar side-store fed by add_batch (the vectorized bulk lane):
+        # text fields accumulate OCCURRENCE arrays (term id into the
+        # field's growing vocab dict, doc local, within-doc position) and
+        # the scalar channels accumulate (locals, values) pairs; build()
+        # merges them with the per-doc dicts through one lexsort per field
+        self._batch_text: dict[str, dict] = {}
+        # field -> ([locals lists], [token-count lists]): columnar doc_len
+        # (doc lengths are integers, so float summation is EXACT in any
+        # order — vectorizing cannot drift sum_dl/avgdl)
+        self._batch_doclen: dict[str, tuple[list, list]] = {}
+        self._batch_keywords: dict[str, tuple[list, list]] = {}
+        self._batch_longs: dict[str, tuple[list, list]] = {}
+        self._batch_doubles: dict[str, tuple[list, list]] = {}
+        self._batch_vectors: dict[str, tuple[list, list]] = {}
+        self._csr_memo: dict | None = None
         self.stored: list[dict] = []
         self.ids: list[str] = []
         self.types: list[str] = []
@@ -433,6 +448,7 @@ class SegmentBuilder:
                  doc_id: str, register_id: bool) -> int:
         local = self.n_docs
         self.n_docs += 1
+        self._csr_memo = None
         self.stored.append(doc.source)
         self.ids.append(doc_id)
         self.types.append(type_name)
@@ -470,6 +486,281 @@ class SegmentBuilder:
             self._vector_dims[field] = len(vec)
         return local
 
+    def add_batch(self, batch: list[tuple[ParsedDocument, str, int]]) -> list[int]:
+        """Columnar append of a run of parsed documents — the vectorized
+        bulk lane's segment write (ISSUE 7). Entries are (parsed, type,
+        version) tuples WITHOUT nested blocks (the caller routes nested
+        docs through add()). Builder state ends EXACTLY as sequential
+        add() calls would leave it — same locals, same per-(term, doc)
+        postings/positions, same ordinal/numeric/vector values — but text
+        tokens land as numpy occurrence blocks and the scalar channels as
+        (locals, values) runs, so build() does one lexsort per field
+        instead of per-token dict work. Returns the new local ids."""
+        base = self.n_docs
+        # pass 1 — collect into LOCAL structures, validating as we go: no
+        # builder state mutates until the whole batch has been walked, so
+        # a mid-batch raise leaves no half-indexed ghost docs (mirror add())
+        fld: dict[str, tuple] = {}      # field -> (locals, toks, encs, lens)
+        fld_get = fld.get
+        scalars: dict[int, dict] = {0: {}, 1: {}, 2: {}, 3: {}}
+        kw_loc, long_loc, dbl_loc, vec_loc = (scalars[i] for i in range(4))
+        max_pos = _MAX_DOC_POSITIONS
+        for i, (doc, type_name, version) in enumerate(batch):
+            if doc.nested:
+                raise ValueError("add_batch cannot take nested blocks; "
+                                 "route nested documents through add()")
+            local = base + i
+            enc = doc.token_enc
+            for field, tokens in doc.tokens.items():
+                n_tok = len(tokens)
+                if n_tok > max_pos:
+                    raise ValueError(
+                        f"field [{field}] has {n_tok} tokens; the "
+                        f"maximum is {max_pos} per document")
+                ent = fld_get(field)
+                if ent is None:
+                    ent = fld[field] = ([], [], [], [])
+                ent[0].append(local)
+                ent[1].append(tokens)
+                ent[2].append(enc.get(field) if enc is not None else None)
+                ent[3].append(n_tok)
+            if doc.keywords:
+                for field, vals in doc.keywords.items():
+                    if vals:
+                        blk = kw_loc.get(field)
+                        if blk is None:
+                            blk = kw_loc[field] = ([], [])
+                        blk[0].append(local)
+                        blk[1].append(vals[0])
+            if doc.longs:
+                for field, vals in doc.longs.items():
+                    if vals:
+                        blk = long_loc.get(field)
+                        if blk is None:
+                            blk = long_loc[field] = ([], [])
+                        blk[0].append(local)
+                        blk[1].append(vals[0])
+            if doc.numerics:
+                for field, vals in doc.numerics.items():
+                    if vals:
+                        blk = dbl_loc.get(field)
+                        if blk is None:
+                            blk = dbl_loc[field] = ([], [])
+                        blk[0].append(local)
+                        blk[1].append(vals[0])
+            if doc.geo:
+                for field, (lat, lon) in doc.geo.items():
+                    for suffix, val in ((".lat", lat), (".lon", lon)):
+                        blk = dbl_loc.get(field + suffix)
+                        if blk is None:
+                            blk = dbl_loc[field + suffix] = ([], [])
+                        blk[0].append(local)
+                        blk[1].append(val)
+            if doc.vectors:
+                for field, vec in doc.vectors.items():
+                    blk = vec_loc.get(field)
+                    if blk is None:
+                        blk = vec_loc[field] = ([], [])
+                    blk[0].append(local)
+                    blk[1].append(vec)
+        # pass 2 — commit: one C-level extend per column instead of seven
+        # appends per doc
+        self._csr_memo = None
+        self.stored.extend(d.source for d, _t, _v in batch)
+        self.ids.extend(d.doc_id for d, _t, _v in batch)
+        self.types.extend(t for _d, t, _v in batch)
+        self.versions.extend(v for _d, _t, v in batch)
+        self.routings.extend(d.routing for d, _t, _v in batch)
+        self.parent_of.extend([-1] * len(batch))
+        self.id_to_local.update(
+            zip((d.doc_id for d, _t, _v in batch),
+                range(base, base + len(batch))))
+        for local_map, store in ((kw_loc, self._batch_keywords),
+                                 (long_loc, self._batch_longs),
+                                 (dbl_loc, self._batch_doubles)):
+            for field, (locs, vals) in local_map.items():
+                blk = store.get(field)
+                if blk is None:
+                    store[field] = (locs, vals)
+                else:
+                    blk[0].extend(locs)
+                    blk[1].extend(vals)
+        for field, (locs, vecs) in vec_loc.items():
+            blk = self._batch_vectors.get(field)
+            if blk is None:
+                self._batch_vectors[field] = (locs, vecs)
+            else:
+                blk[0].extend(locs)
+                blk[1].extend(vecs)
+            self._vector_dims[field] = len(vecs[-1])
+        # text: encode occurrences against the field's growing vocab dict.
+        # Docs that carry analysis-time integer encodings (ParsedDocument
+        # .token_enc, filled by the bulk lane's TextBatcher) skip the
+        # per-token dict encode entirely: their per-flush output vocab
+        # remaps onto the builder vocab once per UNIQUE token, and the
+        # occurrence ids are one numpy gather.
+        for field, (locals_l, tok_lists, encs, lens_l) in fld.items():
+            dlblk = self._batch_doclen.get(field)
+            if dlblk is None:
+                dlblk = self._batch_doclen[field] = ([], [])
+            dlblk[0].append(locals_l)
+            dlblk[1].append(lens_l)
+            blk = self._batch_text.get(field)
+            if blk is None:
+                blk = self._batch_text[field] = {
+                    "vocab": {}, "tids": [], "docs": [], "poss": []}
+            vocab = blk["vocab"]
+            setd = vocab.setdefault
+            # split into encoded doc groups (by shared analysis vocab) and
+            # the string-encode remainder
+            enc_groups: dict[int, tuple] = {}  # id(avocab) -> (avocab, locals, ids)
+            str_locals: list[int] = []
+            str_toklists: list[list[str]] = []
+            for local, toks, enc_list in zip(locals_l, tok_lists, encs):
+                if enc_list:
+                    avocab = enc_list[0][0]
+                    if len(enc_list) == 1:
+                        ids_arr = enc_list[0][1]
+                    elif all(e[0] is avocab for e in enc_list[1:]):
+                        ids_arr = np.concatenate([e[1] for e in enc_list])
+                    else:       # mixed vocabs can't happen in one flush;
+                        avocab = None               # be safe anyway
+                    if avocab is not None and len(ids_arr) == len(toks):
+                        g = enc_groups.get(id(avocab))
+                        if g is None:
+                            g = enc_groups[id(avocab)] = (avocab, [], [])
+                        g[1].append(local)
+                        g[2].append(ids_arr)
+                        continue
+                str_locals.append(local)
+                str_toklists.append(toks)
+            for avocab, locs, ids_arrs in enc_groups.values():
+                lens = np.fromiter(map(len, ids_arrs), np.int64,
+                                   count=len(ids_arrs))
+                total = int(lens.sum())
+                if not total:
+                    continue
+                local_ids = np.concatenate(ids_arrs)
+                # remap analysis-vocab ids -> field-vocab ids, registering
+                # ONLY tokens this field actually uses (the analysis vocab
+                # is shared across all fields of an analyzer — blanket
+                # registration would leak other fields' terms in here)
+                used = np.unique(local_ids)
+                lut = np.zeros(int(used[-1]) + 1, np.int64)
+                for i in used.tolist():
+                    lut[i] = setd(avocab[i], len(vocab))
+                blk["tids"].append(lut[local_ids])
+                blk["docs"].append(
+                    np.repeat(np.asarray(locs, np.int64), lens))
+                cum = np.cumsum(lens)
+                blk["poss"].append(
+                    np.arange(total, dtype=np.int64)
+                    - np.repeat(cum - lens, lens))
+            if str_toklists:
+                ids: list[int] = []
+                app = ids.append
+                counts = np.empty(len(str_toklists), np.int64)
+                for di, toks in enumerate(str_toklists):
+                    counts[di] = len(toks)
+                    for t in toks:
+                        app(setd(t, len(vocab)))
+                total = int(counts.sum())
+                if total:
+                    blk["tids"].append(np.asarray(ids, np.int64))
+                    blk["docs"].append(
+                        np.repeat(np.asarray(str_locals, np.int64),
+                                  counts))
+                    # within-doc position = index into doc.tokens[field]
+                    cum = np.cumsum(counts)
+                    blk["poss"].append(
+                        np.arange(total, dtype=np.int64)
+                        - np.repeat(cum - counts, counts))
+        self.n_docs = base + len(batch)
+        return list(range(base, self.n_docs))
+
+    def _text_csr_all(self) -> dict[str, dict]:
+        """Merge per-doc dict postings and columnar occurrence blocks into
+        the final per-field CSR layout (one lexsort per field). Memoized —
+        estimate_bytes() and build() run back-to-back in refresh and must
+        see the same layout; any add invalidates."""
+        if self._csr_memo is not None:
+            return self._csr_memo
+        out: dict[str, dict] = {}
+        fields = list(self._postings)
+        for f in self._batch_text:
+            if f not in self._postings:
+                fields.append(f)
+        for field in fields:
+            term_map = self._postings.get(field, {})
+            blk = self._batch_text.get(field)
+            vocab_set = set(term_map)
+            if blk is not None:
+                vocab_set.update(blk["vocab"])
+            union_terms = sorted(vocab_set)
+            tid_of = {t: i for i, t in enumerate(union_terms)}
+            V = len(union_terms)
+            occ_t, occ_d, occ_p = [], [], []
+            if term_map:
+                # expand the per-doc dict's (term, doc) entries into
+                # occurrences (same loop cost the old build paid)
+                tids: list[int] = []
+                docs: list[int] = []
+                lens: list[int] = []
+                flat: list[int] = []
+                for t, lst in term_map.items():
+                    ti = tid_of[t]
+                    for d, c, ps in lst:
+                        tids.append(ti)
+                        docs.append(d)
+                        lens.append(c)
+                        flat.extend(ps)
+                lens_a = np.asarray(lens, np.int64)
+                occ_t.append(np.repeat(np.asarray(tids, np.int64), lens_a))
+                occ_d.append(np.repeat(np.asarray(docs, np.int64), lens_a))
+                occ_p.append(np.asarray(flat, np.int64))
+            if blk is not None and blk["tids"]:
+                lut = np.fromiter((tid_of[t] for t in blk["vocab"]),
+                                  np.int64, count=len(blk["vocab"]))
+                occ_t.append(lut[np.concatenate(blk["tids"])])
+                occ_d.append(np.concatenate(blk["docs"]))
+                occ_p.append(np.concatenate(blk["poss"]))
+            if occ_t:
+                ot = np.concatenate(occ_t)
+                od = np.concatenate(occ_d)
+                op = np.concatenate(occ_p)
+            else:
+                ot = od = op = np.zeros(0, np.int64)
+            # (term, doc, pos) triples are unique, so one argsort over a
+            # packed composite key equals the 3-key lexsort at ~40% of the
+            # cost; positions stay < 2^21 (_MAX_DOC_POSITIONS) and the doc
+            # axis < 2^22, so the pack fits i64 whenever V <= 2^20
+            if V <= (1 << 20) and self.n_docs < (1 << 22):
+                order = np.argsort((ot << 43) | (od << 21) | op)
+            else:
+                order = np.lexsort((op, od, ot))
+            ot, od, op = ot[order], od[order], op[order]
+            O = len(ot)
+            if O:
+                new_g = np.empty(O, bool)
+                new_g[0] = True
+                new_g[1:] = (ot[1:] != ot[:-1]) | (od[1:] != od[:-1])
+                g_start = np.flatnonzero(new_g)
+                g_len = np.diff(np.append(g_start, O))
+                g_tid = ot[g_start]
+                g_doc = od[g_start]
+            else:
+                g_start = g_len = g_tid = g_doc = np.zeros(0, np.int64)
+            P = len(g_start)
+            lens_v = np.bincount(g_tid, minlength=V).astype(np.int32) \
+                if V else np.zeros(0, np.int32)
+            max_df = int(lens_v.max()) if V and P else 0
+            out[field] = {"union_terms": union_terms, "lens": lens_v,
+                          "max_df": max_df, "P": P, "g_doc": g_doc,
+                          "g_len": g_len, "g_start": g_start,
+                          "positions": op}
+        self._csr_memo = out
+        return out
+
     def estimate_bytes(self) -> int:
         """Device-byte estimate from host-side builder state, BEFORE any
         device allocation — must mirror Segment.memory_bytes() exactly so
@@ -478,15 +769,16 @@ class SegmentBuilder:
         really does prevent the allocation, not just account for it)."""
         n_pad = next_pow2(self.n_docs, floor=8)
         total = 0
-        for term_map in self._postings.values():
-            lens = [len(v) for v in term_map.values()]
-            P = sum(lens)
-            p_pad = required_padding(P, max(lens) if lens else 0)
+        for c in self._text_csr_all().values():
+            p_pad = required_padding(c["P"], c["max_df"])
             # doc_ids + tf + dl are p_pad-sized; doc_len is n_pad-sized
             total += p_pad * 4 * 3 + n_pad * 4
-        total += len(self._keywords) * n_pad * 4
-        total += (len(self._longs) + len(self._doubles)) * (n_pad * 8 + n_pad)
-        for field in self._vectors:
+        n_kw = len(set(self._keywords) | set(self._batch_keywords))
+        total += n_kw * n_pad * 4
+        n_num = len(set(self._longs) | set(self._batch_longs)) \
+            + len(set(self._doubles) | set(self._batch_doubles))
+        total += n_num * (n_pad * 8 + n_pad)
+        for field in set(self._vectors) | set(self._batch_vectors):
             total += n_pad * self._vector_dims[field] * 4
         return total
 
@@ -494,79 +786,108 @@ class SegmentBuilder:
         n = self.n_docs
         n_pad = next_pow2(n, floor=8)
 
+        # text: unified columnar CSR over BOTH sources (per-doc dict + batch
+        # occurrence blocks) — one lexsort per field groups occurrences into
+        # (term, doc) postings in exactly the order the old per-entry loop
+        # produced (terms lexicographic, docs ascending, positions ascending)
         text: dict[str, TextFieldIndex] = {}
-        for field, term_map in self._postings.items():
-            terms_sorted = sorted(term_map)
-            term_ids = {t: i for i, t in enumerate(terms_sorted)}
-            lens = np.array([len(term_map[t]) for t in terms_sorted], np.int32)
-            starts = np.zeros(len(terms_sorted), np.int32)
+        for field, c in self._text_csr_all().items():
+            union_terms = c["union_terms"]
+            term_ids = {t: i for i, t in enumerate(union_terms)}
+            lens = c["lens"]
+            starts = np.zeros(len(union_terms), np.int32)
             if len(lens):
                 starts[1:] = np.cumsum(lens)[:-1]
-            P = int(lens.sum())
-            max_df = int(lens.max()) if len(lens) else 0
+            P = c["P"]
+            max_df = c["max_df"]
             p_pad = required_padding(P, max_df)
             doc_ids = np.full(p_pad, n_pad, np.int32)   # PAD sentinel
+            doc_ids[:P] = c["g_doc"]
             tf = np.zeros(p_pad, np.float32)
-            pos_starts = np.zeros(P, np.int32)
-            pos_lens = np.zeros(P, np.int32)
-            flat_positions: list[int] = []
-            pos = 0
-            for t in terms_sorted:
-                for d, c, ps in term_map[t]:
-                    doc_ids[pos] = d
-                    tf[pos] = c
-                    pos_starts[pos] = len(flat_positions)
-                    pos_lens[pos] = len(ps)
-                    flat_positions.extend(ps)
-                    pos += 1
+            tf[:P] = c["g_len"]
             dl_map = self._doc_len.get(field, {})
             doc_len = np.ones(n_pad, np.float32)  # pad with 1 to avoid div-by-0
             for d, L in dl_map.items():
                 doc_len[d] = max(L, 1.0)
+            sum_dl = float(sum(dl_map.values()))
+            dlblk = self._batch_doclen.get(field)
+            if dlblk is not None:
+                for locs, lens_l in zip(*dlblk):
+                    la = np.asarray(locs, np.int64)
+                    lv = np.asarray(lens_l, np.int64)
+                    doc_len[la] = np.maximum(lv, 1).astype(np.float32)
+                    # integer token counts: float accumulation is exact,
+                    # so this np.sum cannot differ from the per-doc sum
+                    sum_dl += float(lv.sum())
             dl = np.ones(p_pad, np.float32)
             dl[:P] = doc_len[np.minimum(doc_ids[:P], n_pad - 1)]
             text[field] = TextFieldIndex(
                 terms=term_ids, term_starts=starts, term_lens=lens,
                 doc_ids=jnp.asarray(doc_ids), tf=jnp.asarray(tf),
                 doc_len=jnp.asarray(doc_len), dl=jnp.asarray(dl),
-                sum_dl=float(sum(dl_map.values())), n_postings=P,
+                sum_dl=sum_dl, n_postings=P,
                 max_df=max_df,
                 doc_ids_host=doc_ids[:P].copy(),
-                pos_starts=pos_starts, pos_lens=pos_lens,
-                positions=np.asarray(flat_positions, np.int32))
+                pos_starts=c["g_start"].astype(np.int32),
+                pos_lens=c["g_len"].astype(np.int32),
+                positions=c["positions"].astype(np.int32))
 
         keywords: dict[str, KeywordColumn] = {}
-        for field, val_map in self._keywords.items():
-            uniq = sorted(set(val_map.values()))
+        kw_fields = list(self._keywords)
+        kw_fields += [f for f in self._batch_keywords
+                      if f not in self._keywords]
+        for field in kw_fields:
+            val_map = self._keywords.get(field, {})
+            blk = self._batch_keywords.get(field)
+            vals_set = set(val_map.values())
+            if blk is not None:
+                vals_set.update(blk[1])
+            uniq = sorted(vals_set)
             ord_map = {v: i for i, v in enumerate(uniq)}
             ords = np.full(n_pad, -1, np.int32)
             for d, v in val_map.items():
                 ords[d] = ord_map[v]
+            if blk is not None and blk[0]:
+                ords[np.asarray(blk[0], np.int64)] = np.fromiter(
+                    (ord_map[v] for v in blk[1]), np.int32,
+                    count=len(blk[1]))
             keywords[field] = KeywordColumn(ord_map=ord_map, values=uniq,
                                             ords=jnp.asarray(ords))
 
         numerics: dict[str, NumericColumn] = {}
-        for field, val_map in self._longs.items():
-            vals = np.zeros(n_pad, np.int64)
-            missing = np.ones(n_pad, bool)
-            for d, v in val_map.items():
-                vals[d] = v
-                missing[d] = False
-            numerics[field] = NumericColumn(jnp.asarray(vals), jnp.asarray(missing), "i64")
-        for field, val_map in self._doubles.items():
-            vals = np.zeros(n_pad, np.float64)
-            missing = np.ones(n_pad, bool)
-            for d, v in val_map.items():
-                vals[d] = v
-                missing[d] = False
-            numerics[field] = NumericColumn(jnp.asarray(vals), jnp.asarray(missing), "f64")
+        for val_maps, blocks, np_dtype, tag in (
+                (self._longs, self._batch_longs, np.int64, "i64"),
+                (self._doubles, self._batch_doubles, np.float64, "f64")):
+            num_fields = list(val_maps)
+            num_fields += [f for f in blocks if f not in val_maps]
+            for field in num_fields:
+                val_map = val_maps.get(field, {})
+                blk = blocks.get(field)
+                vals = np.zeros(n_pad, np_dtype)
+                missing = np.ones(n_pad, bool)
+                for d, v in val_map.items():
+                    vals[d] = v
+                    missing[d] = False
+                if blk is not None and blk[0]:
+                    la = np.asarray(blk[0], np.int64)
+                    vals[la] = np.asarray(blk[1], np_dtype)
+                    missing[la] = False
+                numerics[field] = NumericColumn(jnp.asarray(vals),
+                                                jnp.asarray(missing), tag)
 
         vectors: dict[str, VectorColumn] = {}
-        for field, vec_map in self._vectors.items():
+        vec_fields = list(self._vectors)
+        vec_fields += [f for f in self._batch_vectors
+                       if f not in self._vectors]
+        for field in vec_fields:
             dims = self._vector_dims[field]
             mat = np.zeros((n_pad, dims), np.float32)
-            for d, v in vec_map.items():
+            for d, v in self._vectors.get(field, {}).items():
                 mat[d] = v
+            blk = self._batch_vectors.get(field)
+            if blk is not None and blk[0]:
+                mat[np.asarray(blk[0], np.int64)] = \
+                    np.asarray(blk[1], np.float32)
             vectors[field] = VectorColumn(jnp.asarray(mat), dims)
 
         live = np.zeros(n_pad, bool)

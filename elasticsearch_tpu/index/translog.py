@@ -72,6 +72,31 @@ class Translog:
         self.size_bytes = loc + len(rec)
         return loc
 
+    def add_batch(self, ops: list[dict[str, Any]],
+                  sync: bool | None = False) -> int:
+        """Group commit (ref Translog.java add called under
+        TransportShardBulkAction's single shard pass): ALL ops of a bulk
+        request serialize as ONE checksummed batch record (`{"b": [...]}`,
+        one json.dumps + one buffered write instead of one per op) — and,
+        when sync is requested, exactly ONE fsync for the whole batch.
+        snapshot() expands batch records back into individual ops, so
+        recovery is shape-agnostic. Returns the record's location offset."""
+        if not ops:
+            return self._file.tell()
+        payload = json.dumps({"b": ops},
+                             separators=(",", ":")).encode("utf-8")
+        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        loc = self._file.tell()
+        self._file.write(rec)
+        if sync is None:
+            sync = self.durability == "request"
+        if sync:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        self.ops_since_commit += len(ops)
+        self.size_bytes = loc + len(rec)
+        return loc
+
     def sync(self) -> None:
         self._file.flush()
         os.fsync(self._file.fileno())
@@ -97,7 +122,11 @@ class Translog:
                         raise TranslogCorruptedException("truncated record payload")
                     if zlib.crc32(payload) != crc:
                         raise TranslogCorruptedException("checksum mismatch")
-                    yield json.loads(payload.decode("utf-8"))
+                    rec = json.loads(payload.decode("utf-8"))
+                    if "b" in rec and "op" not in rec:
+                        yield from rec["b"]     # group-commit batch record
+                    else:
+                        yield rec
 
     def _generations(self) -> list[int]:
         return [int(f.split("-")[1].split(".")[0])

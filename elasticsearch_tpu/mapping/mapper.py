@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import re
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any
 
 from ..analysis.analyzers import AnalysisService, Analyzer
@@ -201,21 +201,36 @@ def parse_ip(value: Any) -> int:
 # Parsed document — the "Lucene Document" analog
 # ---------------------------------------------------------------------------
 
-@dataclass
 class ParsedDocument:
-    doc_id: str
-    routing: str | None
-    source: dict
-    # channel -> field -> values
-    tokens: dict[str, list[str]] = dc_field(default_factory=dict)     # text: analyzed tokens
-    keywords: dict[str, list[str]] = dc_field(default_factory=dict)   # keyword: raw values
-    numerics: dict[str, list[float]] = dc_field(default_factory=dict)  # double/float
-    longs: dict[str, list[int]] = dc_field(default_factory=dict)       # long/int/date/ip/bool
-    vectors: dict[str, list[float]] = dc_field(default_factory=dict)   # dense_vector
-    geo: dict[str, tuple[float, float]] = dc_field(default_factory=dict)  # (lat, lon)
-    # nested sub-documents: (path, sub-doc) in source order — the builder
-    # lays them out as adjacent rows BEFORE this root doc (block join order)
-    nested: list = dc_field(default_factory=list)
+    """Channel -> field -> values. A __slots__ class, not a dataclass: one
+    instance is built per indexed document, and the generated kwargs
+    __init__ costs ~5µs — a measurable slice of the 20k+ docs/s ingest
+    budget (ISSUE 7)."""
+
+    __slots__ = ("doc_id", "routing", "source", "tokens", "keywords",
+                 "numerics", "longs", "vectors", "geo", "nested",
+                 "token_enc")
+
+    def __init__(self, doc_id: str, routing: str | None = None,
+                 source: dict | None = None):
+        self.doc_id = doc_id
+        self.routing = routing
+        self.source = source
+        # optional batched-ingest side channel: field -> [(vocab, ids)]
+        # integer encodings of self.tokens (index/bulk_ingest.TextBatcher
+        # fills it; SegmentBuilder.add_batch consumes it to skip per-token
+        # re-encoding at refresh). None on the per-doc path.
+        self.token_enc: dict | None = None
+        self.tokens: dict[str, list[str]] = {}    # text: analyzed tokens
+        self.keywords: dict[str, list[str]] = {}  # keyword: raw values
+        self.numerics: dict[str, list[float]] = {}  # double/float
+        self.longs: dict[str, list[int]] = {}     # long/int/date/ip/bool
+        self.vectors: dict[str, list[float]] = {}  # dense_vector
+        self.geo: dict[str, tuple[float, float]] = {}  # (lat, lon)
+        # nested sub-documents: (path, sub-doc) in source order — the
+        # builder lays them out as adjacent rows BEFORE this root doc
+        # (block join order)
+        self.nested: list = []
 
 
 # ---------------------------------------------------------------------------
@@ -425,7 +440,12 @@ class DocumentMapper:
 
     def parse(self, source: dict, doc_id: str, routing: str | None = None,
               parent: str | None = None, timestamp=None,
-              ttl=None) -> ParsedDocument:
+              ttl=None, text_collector=None) -> ParsedDocument:
+        """text_collector: optional `(analyzer, field, text, doc)` sink the
+        batched ingest lane (index/bulk_ingest.py) installs — text values
+        are COLLECTED instead of analyzed inline, then tokenized across the
+        whole bulk request in one batch pass. Everything else (dynamic
+        mapping, per-item validation errors) behaves identically."""
         doc = ParsedDocument(doc_id=doc_id, routing=routing, source=source)
         new_fields: dict[str, FieldType] = {}
         if parent is not None:
@@ -438,7 +458,10 @@ class DocumentMapper:
             raise RoutingMissingException(
                 f"routing is required for [{self.type_name}] documents "
                 f"with a _parent mapping")
-        ts_ms = parse_date_millis(timestamp) if timestamp is not None \
+        # int fast path: the batch lane stamps epoch-ms ints — skip the
+        # parse_date_millis dispatch on the per-doc hot path
+        ts_ms = timestamp if timestamp.__class__ is int \
+            else parse_date_millis(timestamp) if timestamp is not None \
             else int(_dt.datetime.now(_dt.timezone.utc).timestamp() * 1000)
         if self.ts_enabled:
             doc.longs["_timestamp"] = [ts_ms]
@@ -451,7 +474,8 @@ class DocumentMapper:
                     f"already expired [{doc_id}]: expiry [{expiry}] <= "
                     f"now [{now}]")
             doc.longs["_ttl_expiry"] = [expiry]
-        self._parse_obj("", source, doc, new_fields)
+        self._parse_obj("", source, doc, new_fields,
+                        text_collector=text_collector)
         if new_fields:
             if not self.dynamic:
                 # dynamic=false: unmapped fields are ignored (not indexed)
@@ -465,13 +489,163 @@ class DocumentMapper:
     def dynamic_new_fields(self) -> int:
         return self._mapping_version
 
+    # -- compiled per-field parse plan (ISSUE 7) ---------------------------
+    # A mapping-version-keyed dict of `path -> handler(value, doc,
+    # text_collector)` closures for SIMPLE scalar fields: the handler has
+    # its analyzer, keyword sub-field and error message pre-bound, so the
+    # per-value work is one dict get + one call instead of the generic
+    # path-building / field-resolution / type-dispatch chain. Structural
+    # values (dict/list), nested paths, unknown fields and exotic types
+    # (vector, geo, completion, shapes, multi-fields) take the generic
+    # branch unchanged.
+
+    def _parser_plan(self) -> dict:
+        if getattr(self, "_plan_ver", None) == self._mapping_version:
+            return self._plan
+        plan: dict = {}
+        for path, ft in self.fields.items():
+            if self.nested_paths and path in self.nested_paths:
+                continue
+            if path in self.multi_fields:     # multi-field parents: generic
+                continue
+            h = self._make_handler(ft)
+            if h is not None:
+                plan[path] = h
+        self._plan = plan
+        self._plan_ver = self._mapping_version
+        return plan
+
+    def _make_handler(self, ft: FieldType):
+        name = ft.name
+        t = ft.type
+        if t == TEXT:
+            analyzer = self._analyzer_for(ft)
+            kw = self.fields.get(name + ".keyword")
+            kw_name = kw.name if kw is not None and kw.type == KEYWORD \
+                else None
+
+            def h_text(v, doc, coll):
+                s = v if v.__class__ is str else str(v)
+                if coll is not None:
+                    coll(analyzer, name, s, doc)
+                else:
+                    toks = doc.tokens.get(name)
+                    if toks is None:
+                        toks = doc.tokens[name] = []
+                    toks.extend(analyzer(s))
+                if kw_name is not None:
+                    kws = doc.keywords.get(kw_name)
+                    if kws is None:
+                        kws = doc.keywords[kw_name] = []
+                    kws.append(s[:256])
+            return h_text
+        if t == KEYWORD:
+            def h_kw(v, doc, coll):
+                kws = doc.keywords.get(name)
+                if kws is None:
+                    kws = doc.keywords[name] = []
+                kws.append(str(v))
+            return h_kw
+        if t in _INT_TYPES or t == DATE or t == BOOLEAN or t == IP:
+            if t in _INT_TYPES:
+                conv = int
+            elif t == DATE:
+                conv = parse_date_millis
+            elif t == IP:
+                conv = parse_ip
+            else:
+                conv = (lambda v: 1 if (v if isinstance(v, bool)
+                                        else str(v).lower()
+                                        in ("true", "1", "on")) else 0)
+
+            def h_long(v, doc, coll):
+                try:
+                    iv = conv(v)
+                except (ValueError, TypeError) as e:
+                    raise MapperParsingException(
+                        f"failed to parse [{name}]: {e}") from e
+                l = doc.longs.get(name)
+                if l is None:
+                    l = doc.longs[name] = []
+                l.append(iv)
+            return h_long
+        if t in _FLOAT_TYPES:
+            def h_dbl(v, doc, coll):
+                try:
+                    fv = float(v)
+                except (ValueError, TypeError) as e:
+                    raise MapperParsingException(
+                        f"failed to parse [{name}]: {e}") from e
+                l = doc.numerics.get(name)
+                if l is None:
+                    l = doc.numerics[name] = []
+                l.append(fv)
+            return h_dbl
+        return None                     # exotic types: generic path
+
     def _parse_obj(self, prefix: str, obj: dict, doc: ParsedDocument,
-                   new_fields: dict[str, FieldType]) -> None:
+                   new_fields: dict[str, FieldType],
+                   text_collector=None) -> None:
+        # hoisted lookups: this loop runs once per field per document and
+        # dominates host-side ingest cost (ISSUE 7) — scalar values on
+        # known fields take the early path below the structural dispatch
+        nested_paths = self.nested_paths
+        fields_get = self.fields.get
+        new_get = new_fields.get
+        multi_fields = self.multi_fields
+        plan_get = self._parser_plan().get
         for name, value in obj.items():
             if value is None:
                 continue
-            path = f"{prefix}{name}"
-            if path in self.nested_paths:
+            path = prefix + name if prefix else name
+            scalar = not isinstance(value, (dict, list))
+            if scalar:
+                h = plan_get(path)
+                if h is not None:
+                    h(value, doc, text_collector)
+                    continue
+            if scalar and not (nested_paths and path in nested_paths):
+                # -- scalar fast path (no container dispatch, no [v] wrap)
+                ft = fields_get(path) or new_get(path)
+                if ft is None:
+                    if not self.dynamic:
+                        continue
+                    ft = self._infer_type(path, value)
+                    if ft is None:
+                        continue
+                    new_fields[path] = ft
+                    # text fields get a raw keyword sub-field for aggs/sort
+                    if ft.type == TEXT:
+                        new_fields[path + ".keyword"] = FieldType(
+                            name=path + ".keyword", type=KEYWORD)
+                if ft.type == TEXT and text_collector is not None:
+                    # inlined _index_value TEXT branch: the collector call
+                    # is the per-text-value hot spot of batched ingest
+                    text_collector(self._analyzer_for(ft), ft.name,
+                                   value if value.__class__ is str
+                                   else str(value), doc)
+                else:
+                    self._index_value(ft, value, doc,
+                                      text_collector=text_collector)
+                if ft.type == TEXT:
+                    kw = fields_get(path + ".keyword") \
+                        or new_get(path + ".keyword")
+                    if kw is not None:
+                        doc.keywords.setdefault(kw.name, []).append(
+                            str(value)[:256])
+                if multi_fields:
+                    for sub in multi_fields.get(path, ()):
+                        sft = fields_get(sub)
+                        if sft is None:
+                            continue
+                        if sft.type == "completion":
+                            doc.keywords.setdefault(sub, []).append(
+                                str(value)[:256])
+                        else:
+                            self._index_value(sft, value, doc,
+                                              text_collector=text_collector)
+                continue
+            if nested_paths and path in nested_paths:
                 # nested object: each element becomes a sub-document row in
                 # the block (ref ObjectMapper nested mode — one Lucene doc
                 # per element, root doc last in the block)
@@ -484,12 +658,14 @@ class DocumentMapper:
                             f"field as object, but found a concrete value")
                     sub = ParsedDocument(doc_id=doc.doc_id, routing=None,
                                          source=elem)
-                    self._parse_obj(path + ".", elem, sub, new_fields)
+                    self._parse_obj(path + ".", elem, sub, new_fields,
+                                    text_collector=text_collector)
                     doc.nested.append((path, sub))
                     if opts.get("include_in_parent") \
                             or opts.get("include_in_root"):
                         # ALSO flatten into the root doc (ES option)
-                        self._parse_obj(path + ".", elem, doc, new_fields)
+                        self._parse_obj(path + ".", elem, doc, new_fields,
+                                        text_collector=text_collector)
                 continue
             if isinstance(value, dict):
                 ft = self.fields.get(path)
@@ -499,7 +675,8 @@ class DocumentMapper:
                                                    "geo_shape"):
                     self._index_value(ft, value, doc)
                 else:
-                    self._parse_obj(path + ".", value, doc, new_fields)
+                    self._parse_obj(path + ".", value, doc, new_fields,
+                                    text_collector=text_collector)
                 continue
             ft = self.fields.get(path) or new_fields.get(path)
             # a list IS the value for vectors and [lon, lat] geo points
@@ -520,7 +697,7 @@ class DocumentMapper:
                 if ft.type == TEXT:
                     new_fields[path + ".keyword"] = FieldType(name=path + ".keyword", type=KEYWORD)
             for v in values:
-                self._index_value(ft, v, doc)
+                self._index_value(ft, v, doc, text_collector=text_collector)
             if ft.type == TEXT:
                 kw = self.fields.get(path + ".keyword") or new_fields.get(path + ".keyword")
                 if kw is not None:
@@ -539,7 +716,8 @@ class DocumentMapper:
                         doc.keywords.setdefault(sub, []).append(str(v)[:256])
                 else:
                     for v in values:
-                        self._index_value(sft, v, doc)
+                        self._index_value(sft, v, doc,
+                                          text_collector=text_collector)
 
     def _infer_type(self, path: str, v: Any) -> FieldType | None:
         """Dynamic type inference (ref: index/mapper/DocumentParser dynamic
@@ -561,7 +739,13 @@ class DocumentMapper:
         return None
 
     def _analyzer_for(self, ft: FieldType) -> Analyzer:
-        return self.analysis.analyzer(ft.analyzer)
+        # per-FieldType memo (one mapper == one AnalysisService, so the
+        # resolution can never change identity under a given ft)
+        a = getattr(ft, "_resolved_analyzer", None)
+        if a is None:
+            a = self.analysis.analyzer(ft.analyzer)
+            ft._resolved_analyzer = a
+        return a
 
     def search_analyzer_for(self, field_name: str) -> Analyzer:
         ft = self.fields.get(field_name)
@@ -664,7 +848,8 @@ class DocumentMapper:
                 for _ in range(max(weight, 1)):
                     doc.keywords.setdefault(ft.name, []).append(entry)
 
-    def _index_value(self, ft: FieldType, v: Any, doc: ParsedDocument) -> None:
+    def _index_value(self, ft: FieldType, v: Any, doc: ParsedDocument,
+                     text_collector=None) -> None:
         t = ft.type
         if t == "completion":
             self._index_completion(ft, v, doc)
@@ -694,7 +879,14 @@ class DocumentMapper:
             return
         try:
             if t == TEXT:
-                doc.tokens.setdefault(ft.name, []).extend(self._analyzer_for(ft)(str(v)))
+                if text_collector is not None:
+                    # batched lane: defer tokenization — the collector runs
+                    # the analyzer over the whole bulk request at once
+                    text_collector(self._analyzer_for(ft), ft.name,
+                                   str(v), doc)
+                else:
+                    doc.tokens.setdefault(ft.name, []).extend(
+                        self._analyzer_for(ft)(str(v)))
             elif t == KEYWORD:
                 doc.keywords.setdefault(ft.name, []).append(str(v))
             elif t in _INT_TYPES:

@@ -508,8 +508,13 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                      for i in items)
         if p.get("refresh", ["false"])[0] != "false":
             node.refresh(default_index or "_all")
-        return 200, {"took": int((time.perf_counter() - t0) * 1000),
-                     "errors": errors, "items": items}
+        # pre-serialized compact bytes: a 100k-doc ingest emits ~10MB of
+        # item acks — compact separators + the handler's bytes fast lane
+        # keep response encoding out of the ingest budget
+        return 200, json.dumps(
+            {"took": int((time.perf_counter() - t0) * 1000),
+             "errors": errors, "items": items},
+            separators=(",", ":")).encode()
     c.register("POST", "/_bulk", bulk)
     c.register("PUT", "/_bulk", bulk)
     c.register("POST", "/{index}/_bulk", bulk)
@@ -2810,22 +2815,34 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
 
 
 def _parse_bulk(body: bytes, default_index: str | None) -> list:
-    """NDJSON bulk format (ref rest/action/bulk/RestBulkAction)."""
+    """NDJSON bulk format (ref rest/action/bulk/RestBulkAction).
+
+    All lines parse as ONE json array (a single C-level loads instead of
+    one per line — measurable at 100k-doc ingests); the python walk only
+    pairs action lines with their sources. Ops carry the raw source
+    line's byte length as a 4th element so the engine's buffered-bytes
+    estimate skips re-walking each source dict (node.bulk accepts both
+    3- and 4-tuples)."""
+    lines = [ln for ln in body.split(b"\n") if ln and not ln.isspace()]
+    if not lines:
+        return []
+    docs = json.loads(b"[" + b",".join(lines) + b"]")
     ops = []
-    lines = [ln for ln in body.decode("utf-8").split("\n") if ln.strip()]
     i = 0
-    while i < len(lines):
-        action_line = json.loads(lines[i])
+    n = len(docs)
+    while i < n:
+        action_line = docs[i]
         (action, meta), = action_line.items()
-        meta = dict(meta)
         if default_index and "_index" not in meta:
             meta["_index"] = default_index
         i += 1
         source = None
-        if action != "delete":
-            source = json.loads(lines[i])
+        raw_len = 0
+        if action != "delete" and i < n:
+            source = docs[i]
+            raw_len = len(lines[i])
             i += 1
-        ops.append((action, meta, source))
+        ops.append((action, meta, source, raw_len))
     return ops
 
 

@@ -1,0 +1,483 @@
+"""Vectorized bulk-ingest lane (ISSUE 7): bitwise equivalence with the
+per-doc path, per-item bulk semantics, group-commit durability, and the
+zero-per-doc-analysis tripwire.
+
+The batch lane (index/bulk_ingest.py + SegmentBuilder.add_batch +
+Translog.add_batch) must be INVISIBLE except for speed: identical segment
+tensors, identical per-item responses, identical recovery — with exactly
+one translog fsync per touched index per `_bulk` request.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import (BUILTIN_ANALYZERS,
+                                                  analyze_call_count)
+from elasticsearch_tpu.index.bulk_ingest import analyze_batch
+from elasticsearch_tpu.node import NodeService
+
+TEXT_ATTRS = ("term_starts", "term_lens", "doc_ids", "tf", "doc_len", "dl",
+              "pos_starts", "pos_lens", "positions", "doc_ids_host")
+
+
+def _assert_segments_equal(sa, sb):
+    """Bitwise tensor equality across every column family."""
+    assert set(sa.text) == set(sb.text)
+    for f in sa.text:
+        fa, fb = sa.text[f], sb.text[f]
+        assert fa.terms == fb.terms, f
+        for attr in TEXT_ATTRS:
+            va = np.asarray(getattr(fa, attr))
+            vb = np.asarray(getattr(fb, attr))
+            assert va.dtype == vb.dtype, (f, attr)
+            assert va.shape == vb.shape, (f, attr)
+            assert (va == vb).all(), (f, attr)
+        assert fa.sum_dl == fb.sum_dl, f
+        assert fa.n_postings == fb.n_postings and fa.max_df == fb.max_df
+    assert set(sa.keywords) == set(sb.keywords)
+    for f in sa.keywords:
+        ka, kb = sa.keywords[f], sb.keywords[f]
+        assert ka.values == kb.values and ka.ord_map == kb.ord_map
+        assert (np.asarray(ka.ords) == np.asarray(kb.ords)).all(), f
+    assert set(sa.numerics) == set(sb.numerics)
+    for f in sa.numerics:
+        na_, nb_ = sa.numerics[f], sb.numerics[f]
+        assert na_.dtype == nb_.dtype, f
+        assert (np.asarray(na_.vals) == np.asarray(nb_.vals)).all(), f
+        assert (np.asarray(na_.missing) == np.asarray(nb_.missing)).all()
+    assert set(sa.vectors) == set(sb.vectors)
+    for f in sa.vectors:
+        assert (np.asarray(sa.vectors[f].vecs)
+                == np.asarray(sb.vectors[f].vecs)).all(), f
+    assert sa.ids == sb.ids and sa.types == sb.types
+    assert sa.versions == sb.versions
+    assert sa.n_docs == sb.n_docs and sa.n_pad == sb.n_pad
+    assert (sa.live_host == sb.live_host).all()
+    if sa.parent_of is None:
+        assert sb.parent_of is None
+    else:
+        assert (sa.parent_of == sb.parent_of).all()
+    assert sa.memory_bytes() == sb.memory_bytes()
+
+
+MAPPINGS = {"_doc": {"properties": {
+    "body": {"type": "string"},
+    "en": {"type": "string", "analyzer": "english"},
+    "ws": {"type": "string", "analyzer": "whitespace"},
+    "shingled": {"type": "string", "analyzer": "my_shingle"},
+    "tag": {"type": "string", "index": "not_analyzed"},
+    "price": {"type": "long"},
+    "score": {"type": "double"},
+    "when": {"type": "date"},
+    "active": {"type": "boolean"},
+    "addr": {"type": "ip"},
+    "vec": {"type": "dense_vector", "dims": 3},
+    "pt": {"type": "geo_point"},
+}}}
+
+SETTINGS_EXTRA = {
+    "index.analysis.analyzer.my_shingle.tokenizer": "standard",
+    "index.analysis.analyzer.my_shingle.filter": ["lowercase", "shingle"],
+}
+
+
+def _matrix_docs():
+    docs = []
+    for i in range(37):
+        docs.append({
+            "body": f"the Quick l'avion nº{i} fox jump{'s' if i % 2 else ''}"
+                    f" OVER term{i % 7}",
+            "en": f"running runners ran {i} quickly the",
+            "ws": f"Keep  Case-{i} as\tis",
+            "shingled": f"alpha beta gamma {i}",
+            "tag": f"tag{i % 5}",
+            "price": i * 3,
+            "score": i * 1.5,
+            "when": "2024-03-%02d" % (i % 27 + 1),
+            "active": i % 2 == 0,
+            "addr": "10.0.%d.%d" % (i % 200, i % 250),
+            "vec": [float(i), float(i % 7), 1.0],
+            "pt": {"lat": 40.0 + i * 0.1, "lon": -70.0 - i * 0.1},
+            # dynamic field: exercises inference + the .keyword sub-field
+            "dyn": f"dynamic text value {i % 3}",
+        })
+    return docs
+
+
+def _mk_node(tmp_path, name, vectorized):
+    n = NodeService(str(tmp_path / name))
+    n.create_index("t", settings={
+        "number_of_shards": 1,
+        "index.bulk.vectorized.enable": vectorized,
+        **SETTINGS_EXTRA}, mappings=MAPPINGS)
+    return n
+
+
+def _bulk_index(n, docs, start=0):
+    ops = [("index", {"_index": "t", "_id": str(start + i)}, d)
+           for i, d in enumerate(docs)]
+    return n.bulk(ops)
+
+
+class TestEquivalence:
+    def test_mapping_matrix_bitwise_identical(self, tmp_path):
+        docs = _matrix_docs()
+        na = _mk_node(tmp_path, "vec", True)
+        nb = _mk_node(tmp_path, "ref", False)
+        for n in (na, nb):
+            items = _bulk_index(n, docs)
+            assert all(next(iter(i.values()))["status"] == 201
+                       for i in items)
+            # a second bulk + a single-doc API write: mixed-source buffer
+            n.bulk([("index", {"_index": "t", "_id": "x1"},
+                     {"body": "second bulk", "price": 1})])
+            n.index_doc("t", "x2", {"body": "api doc", "price": 2})
+            n.refresh("t")
+        sa = na.indices["t"].shards[0].segments[0]
+        sb = nb.indices["t"].shards[0].segments[0]
+        _assert_segments_equal(sa, sb)
+        # same query results through the full stack
+        body = {"query": {"match": {"body": "quick"}}, "size": 5}
+        ra = na.search("t", json.loads(json.dumps(body)))
+        rb = nb.search("t", json.loads(json.dumps(body)))
+        assert ra["hits"]["total"] == rb["hits"]["total"]
+        assert [h["_id"] for h in ra["hits"]["hits"]] == \
+            [h["_id"] for h in rb["hits"]["hits"]]
+        assert [h["_score"] for h in ra["hits"]["hits"]] == \
+            [h["_score"] for h in rb["hits"]["hits"]]
+        na.close()
+        nb.close()
+
+    def test_nested_docs_fall_back_identically(self, tmp_path):
+        mappings = {"_doc": {"properties": {
+            "body": {"type": "string"},
+            "items": {"type": "nested", "properties": {
+                "name": {"type": "string"},
+                "qty": {"type": "long"}}}}}}
+        segs = {}
+        for lane, vec in (("a", True), ("b", False)):
+            n = NodeService(str(tmp_path / lane))
+            n.create_index("t", settings={
+                "number_of_shards": 1,
+                "index.bulk.vectorized.enable": vec}, mappings=mappings)
+            ops = []
+            for i in range(9):
+                src = {"body": f"root {i}",
+                       "items": [{"name": f"n{i}a", "qty": i},
+                                 {"name": f"n{i}b", "qty": i + 1}]}
+                ops.append(("index", {"_index": "t", "_id": str(i)}, src))
+            n.bulk(ops)
+            n.refresh("t")
+            segs[lane] = n.indices["t"].shards[0].segments[0]
+            out = n.search("t", {"query": {"nested": {
+                "path": "items",
+                "query": {"term": {"items.qty": 3}}}}})
+            assert out["hits"]["total"] >= 1
+            n.close()
+        _assert_segments_equal(segs["a"], segs["b"])
+
+    def test_merge_after_both_lanes_identical(self, tmp_path):
+        docs = _matrix_docs()
+        na = _mk_node(tmp_path, "mva", True)
+        nb = _mk_node(tmp_path, "mvb", False)
+        for n in (na, nb):
+            _bulk_index(n, docs[:20])
+            n.refresh("t")
+            _bulk_index(n, docs[20:], start=20)
+            n.delete_doc("t", "3")
+            n.refresh("t")
+            n.indices["t"].force_merge(1)
+        _assert_segments_equal(na.indices["t"].shards[0].segments[0],
+                               nb.indices["t"].shards[0].segments[0])
+        na.close()
+        nb.close()
+
+
+class TestAnalyzeBatch:
+    CASES = [
+        "The quick brown fox l'avion d'été",
+        "Stemming horses running quickly — ubiquitously",
+        "ALL CAPS and MixedCase tokens",
+        "",
+        "    ",
+        "one",
+        "O'Neill's car won't start 'quoted'",
+        "naïve café déjà-vu niño",
+        "日本語のテキスト and latin mixed",
+        "a b c a b c a",
+    ]
+
+    @pytest.mark.parametrize("name", ["standard", "simple", "whitespace",
+                                      "keyword", "stop", "english",
+                                      "french", "cjk"])
+    def test_matches_per_doc_analyze(self, name):
+        analyzer = BUILTIN_ANALYZERS[name]
+        expect = [analyzer.analyze(t) for t in self.CASES]
+        got = analyze_batch(analyzer, list(self.CASES))
+        if got is None:     # unbatchable chain: fallback, not wrong output
+            return
+        assert got == expect, name
+
+    def test_encode_roundtrip(self):
+        analyzer = BUILTIN_ANALYZERS["english"]
+        rows, vocab, ids = analyze_batch(analyzer, list(self.CASES),
+                                         encode=True)
+        assert rows == [analyzer.analyze(t) for t in self.CASES]
+        for row, id_arr in zip(rows, ids):
+            assert [vocab[i] for i in id_arr] == row
+
+    def test_unbatchable_chain_returns_none(self):
+        from elasticsearch_tpu.analysis.analyzers import (
+            Analyzer, shingle_filter, standard_tokenizer)
+        a = Analyzer("sh", standard_tokenizer, [shingle_filter])
+        assert analyze_batch(a, ["a b c"]) is None
+
+
+class TestBulkSemantics:
+    def test_duplicate_id_in_one_request(self, tmp_path):
+        n = _mk_node(tmp_path, "dup", True)
+        items = n.bulk([
+            ("index", {"_index": "t", "_id": "d"}, {"body": "first"}),
+            ("index", {"_index": "t", "_id": "d"}, {"body": "second"}),
+            ("index", {"_index": "t", "_id": "d"}, {"body": "third"}),
+        ])
+        versions = [i["index"]["_version"] for i in items]
+        assert versions == [1, 2, 3]
+        got = n.get_doc("t", "d")
+        assert got.source["body"] == "third" and got.version == 3
+        n.refresh("t")
+        assert n.search("t", {"query": {"match": {"body": "third"}}}
+                        )["hits"]["total"] == 1
+        assert n.search("t", {"query": {"match": {"body": "first"}}}
+                        )["hits"]["total"] == 0
+        n.close()
+
+    def test_mid_batch_version_conflict_409(self, tmp_path):
+        n = _mk_node(tmp_path, "conflict", True)
+        n.bulk([("index", {"_index": "t", "_id": "a"}, {"body": "v1"})])
+        items = n.bulk([
+            ("index", {"_index": "t", "_id": "b"}, {"body": "ok1"}),
+            ("create", {"_index": "t", "_id": "a"}, {"body": "clash"}),
+            ("index", {"_index": "t", "_id": "c"}, {"body": "ok2"}),
+        ])
+        statuses = [next(iter(i.values()))["status"] for i in items]
+        assert statuses == [201, 409, 201]
+        assert "conflict" in items[1]["create"]["error"]
+        # survivors indexed, the conflicting doc untouched
+        assert n.get_doc("t", "a").source["body"] == "v1"
+        assert n.get_doc("t", "b").found and n.get_doc("t", "c").found
+        n.close()
+
+    def test_per_item_400_with_survivors(self, tmp_path):
+        n = _mk_node(tmp_path, "badparse", True)
+        items = n.bulk([
+            ("index", {"_index": "t", "_id": "1"}, {"body": "fine"}),
+            ("index", {"_index": "t", "_id": "2"},
+             {"vec": [1.0, 2.0]}),                  # wrong dims -> 400
+            ("index", {"_index": "t", "_id": "3"},
+             {"when": "not-a-date"}),               # bad date -> 400
+            ("index", {"_index": "t", "_id": "4"}, {"body": "also fine"}),
+        ])
+        statuses = [next(iter(i.values()))["status"] for i in items]
+        assert statuses == [201, 400, 400, 201]
+        assert n.get_doc("t", "1").found and n.get_doc("t", "4").found
+        assert not n.get_doc("t", "2").found
+        assert not n.get_doc("t", "3").found
+        n.close()
+
+    def test_index_then_delete_same_request(self, tmp_path):
+        n = _mk_node(tmp_path, "deldup", True)
+        items = n.bulk([
+            ("index", {"_index": "t", "_id": "z"}, {"body": "here"}),
+            ("delete", {"_index": "t", "_id": "z"}, None),
+            ("delete", {"_index": "t", "_id": "ghost"}, None),
+        ])
+        assert items[0]["index"]["status"] == 201
+        assert items[1]["delete"]["status"] == 200
+        assert items[1]["delete"]["found"] is True
+        assert items[2]["delete"]["status"] == 404
+        assert not n.get_doc("t", "z").found
+        n.close()
+
+    def test_update_reads_doc_indexed_earlier_in_same_bulk(self, tmp_path):
+        n = _mk_node(tmp_path, "upd", True)
+        items = n.bulk([
+            ("index", {"_index": "t", "_id": "u"}, {"body": "base",
+                                                    "price": 1}),
+            ("update", {"_index": "t", "_id": "u"},
+             {"doc": {"price": 7}}),
+        ])
+        assert items[0]["index"]["status"] == 201
+        assert items[1]["update"]["status"] == 200
+        got = n.get_doc("t", "u")
+        assert got.source == {"body": "base", "price": 7}
+        assert got.version == 2
+        n.close()
+
+    def test_disabled_lane_same_responses(self, tmp_path):
+        ops = [
+            ("index", {"_index": "t", "_id": "a"}, {"body": "one"}),
+            ("create", {"_index": "t", "_id": "a"}, {"body": "two"}),
+            ("delete", {"_index": "t", "_id": "missing"}, None),
+            ("index", {"_index": "t", "_id": "b"},
+             {"vec": [1.0]}),                        # 400 both lanes
+        ]
+        na = _mk_node(tmp_path, "ra", True)
+        nb = _mk_node(tmp_path, "rb", False)
+        ia = na.bulk([(a, dict(m), dict(s) if s else None)
+                      for a, m, s in ops])
+        ib = nb.bulk([(a, dict(m), dict(s) if s else None)
+                      for a, m, s in ops])
+        assert ia == ib
+        na.close()
+        nb.close()
+
+
+class TestAnalysisTripwire:
+    """test_no_retrace-style counter tripwire: the vectorized lane must
+    make ZERO per-doc Analyzer.analyze calls for batchable chains."""
+
+    def test_zero_analyze_calls_on_vectorized_lane(self, tmp_path):
+        n = _mk_node(tmp_path, "trip", True)
+        n.bulk([("index", {"_index": "t", "_id": "warm"},
+                 {"body": "warm up", "en": "warmer"})])
+        before = analyze_call_count()
+        n.bulk([("index", {"_index": "t", "_id": str(i)},
+                 {"body": f"tokens here {i}", "en": f"running {i}",
+                  "price": i})
+                for i in range(50)])
+        assert analyze_call_count() == before, \
+            "vectorized bulk made per-doc Analyzer.analyze calls"
+        n.close()
+
+    def test_fallback_lane_does_analyze_per_doc(self, tmp_path):
+        n = _mk_node(tmp_path, "tripoff", False)
+        before = analyze_call_count()
+        n.bulk([("index", {"_index": "t", "_id": str(i)},
+                 {"body": f"tokens here {i}"}) for i in range(5)])
+        assert analyze_call_count() - before >= 5
+        n.close()
+
+    def test_unbatchable_analyzer_falls_back_per_value(self, tmp_path):
+        n = _mk_node(tmp_path, "tripsh", True)
+        before = analyze_call_count()
+        n.bulk([("index", {"_index": "t", "_id": str(i)},
+                 {"shingled": f"alpha beta {i}"}) for i in range(4)])
+        # shingle is not per-token: those four values analyze per value
+        assert analyze_call_count() - before == 4
+        n.close()
+
+
+class TestDurability:
+    def _count_fsyncs(self, monkeypatch):
+        calls = []
+        real = os.fsync
+
+        def counting(fd):
+            calls.append(fd)
+            return real(fd)
+        monkeypatch.setattr(os, "fsync", counting)
+        return calls
+
+    @pytest.mark.parametrize("vectorized", [True, False])
+    def test_one_fsync_per_touched_index_per_bulk(self, tmp_path,
+                                                  monkeypatch, vectorized):
+        n = NodeService(str(tmp_path / f"fs{vectorized}"))
+        for name in ("ia", "ib"):
+            n.create_index(name, settings={
+                "number_of_shards": 1,
+                "index.bulk.vectorized.enable": vectorized})
+        calls = self._count_fsyncs(monkeypatch)
+        n.bulk([("index", {"_index": "ia", "_id": str(i)},
+                 {"body": f"doc {i}"}) for i in range(40)]
+               + [("index", {"_index": "ib", "_id": str(i)},
+                   {"body": f"doc {i}"}) for i in range(40)]
+               + [("delete", {"_index": "ia", "_id": "0"}, None)])
+        assert len(calls) == 2, \
+            f"expected one fsync per touched index, saw {len(calls)}"
+        n.close()
+
+    def test_update_ops_join_the_group_commit(self, tmp_path, monkeypatch):
+        """The old bulk `update` branch fsynced per op AND missed the
+        end-of-request sync; now all three actions share the contract."""
+        n = NodeService(str(tmp_path / "fsupd"))
+        n.create_index("u", settings={"number_of_shards": 1})
+        n.bulk([("index", {"_index": "u", "_id": str(i)}, {"v": i})
+                for i in range(8)])
+        calls = self._count_fsyncs(monkeypatch)
+        n.bulk([("update", {"_index": "u", "_id": str(i)},
+                 {"doc": {"v": 100 + i}}) for i in range(8)])
+        assert len(calls) == 1, \
+            f"updates must defer to ONE request-end fsync, saw {len(calls)}"
+        assert n.get_doc("u", "3").source["v"] == 103
+        n.close()
+
+    def test_group_commit_records_recover(self, tmp_path):
+        path = str(tmp_path / "recover")
+        n = NodeService(path)
+        n.create_index("t", settings={"number_of_shards": 1})
+        n.bulk([("index", {"_index": "t", "_id": str(i)},
+                 {"body": f"durable doc {i}", "price": i})
+                for i in range(25)]
+               + [("delete", {"_index": "t", "_id": "7"}, None)])
+        # NO refresh/flush: docs exist only in buffer + translog
+        n.close()
+        n2 = NodeService(path)
+        assert n2.get_doc("t", "3").source["body"] == "durable doc 3"
+        assert not n2.get_doc("t", "7").found
+        n2.refresh("t")
+        assert n2.search("t", {"query": {"match": {"body": "durable"}}}
+                         )["hits"]["total"] == 24
+        n2.close()
+
+    def test_translog_batch_record_roundtrip(self, tmp_path):
+        from elasticsearch_tpu.index.translog import Translog
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add({"op": "index", "id": "solo", "version": 1})
+        tl.add_batch([{"op": "index", "id": f"b{i}", "version": 1}
+                      for i in range(5)], sync=True)
+        tl.add({"op": "delete", "id": "b2", "version": 2})
+        ops = list(tl.snapshot())
+        assert [o["id"] for o in ops] == \
+            ["solo", "b0", "b1", "b2", "b3", "b4", "b2"]
+        assert tl.ops_since_commit == 7
+        tl.close()
+
+
+class TestObservability:
+    def test_counters_and_sections(self, tmp_path):
+        from elasticsearch_tpu.common.metrics import (bulk_docs_histogram,
+                                                      bulk_ingest_snapshot)
+        n = _mk_node(tmp_path, "obs", True)
+        before = bulk_ingest_snapshot()
+        n.bulk([("index", {"_index": "t", "_id": str(i)},
+                 {"body": f"metric doc {i}"}) for i in range(10)])
+        after = bulk_ingest_snapshot()
+        assert after["vectorized_bulks_total"] == \
+            before["vectorized_bulks_total"] + 1
+        assert after["vectorized_docs_total"] == \
+            before["vectorized_docs_total"] + 10
+        assert bulk_docs_histogram().get(16, 0) >= 1   # pow2 bucket of 10
+        sections = n.metric_sections()
+        assert "indexing" in sections and "bulk_docs" in sections
+        label, payload = sections["indexing"]
+        assert label is None
+        assert "vectorized_bulks_total" in payload
+        assert "ingest_docs_per_sec" in payload
+        snap = n._sampler_snapshot()
+        assert "ingest_docs_per_sec" in snap
+        assert "bulk_vectorized_docs_total" in snap
+        n.close()
+
+    def test_metrics_exposition_has_indexing_family(self, tmp_path):
+        n = _mk_node(tmp_path, "scrape", True)
+        n.bulk([("index", {"_index": "t", "_id": "1"}, {"body": "x"})])
+        from elasticsearch_tpu.common.metrics import render_openmetrics
+        text = render_openmetrics(n.metric_sections())
+        assert "es_indexing_vectorized_bulks_total" in text
+        assert "es_indexing_fallback_bulks_total" in text
+        assert "es_bulk_docs_count_total" in text
+        n.close()

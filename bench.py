@@ -603,6 +603,48 @@ def run_vector_leg(tag: str) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_scale_leg(tag: str) -> dict:
+    """ISSUE 8 scale leg (opt-in: BENCH_SCALE=1): the BASELINE 10M-doc
+    tier's shapes at bench scale — config #3 aggs at BENCH_SCALE_AGG_DOCS
+    (default 4M) and config #4 vectors at BENCH_SCALE_VEC_DOCS (default
+    1M) — under the per-leg wall-clock budget. The streaming blockwise
+    dense lane keeps peak device score memory O(Q × block); the leg
+    reports peak RSS and the process-peak score-matrix gauge so the bound
+    is visible in the one-line JSON (the materializing path either trips
+    the request breaker or blows the budget at these sizes)."""
+    global AGG_DOCS, VEC_DOCS
+    import resource
+    from elasticsearch_tpu.common.metrics import peak_score_matrix_bytes
+    out: dict = {}
+    save_agg, save_vec = AGG_DOCS, VEC_DOCS
+    AGG_DOCS = int(os.environ.get("BENCH_SCALE_AGG_DOCS", str(4_000_000)))
+    VEC_DOCS = int(os.environ.get("BENCH_SCALE_VEC_DOCS", str(1_000_000)))
+    try:
+        try:
+            r = run_agg_leg(tag + "-scale")
+            out.update({"scale_agg_qps": r["agg_qps"],
+                        "scale_agg_docs": AGG_DOCS,
+                        "scale_agg_index_secs": r["agg_index_secs"]})
+        except Exception as e:  # noqa: BLE001 — legs are best-effort
+            print(f"BENCH_SCALE agg leg failed: {e}", file=sys.stderr)
+        if not _over_budget(margin=90.0):
+            _arm_leg_alarm(reserve=60.0)
+            try:
+                r = run_vector_leg(tag + "-scale")
+                out.update({"scale_knn_qps": r["knn_qps"],
+                            "scale_knn_recall": r["knn_recall"],
+                            "scale_vec_docs": VEC_DOCS,
+                            "scale_vec_index_secs": r["vec_index_secs"]})
+            except Exception as e:  # noqa: BLE001
+                print(f"BENCH_SCALE vec leg failed: {e}", file=sys.stderr)
+        out["scale_peak_rss_bytes"] = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss * 1024
+        out["scale_peak_score_matrix_bytes"] = peak_score_matrix_bytes()
+    finally:
+        AGG_DOCS, VEC_DOCS = save_agg, save_vec
+    return out
+
+
 def run_engine_leg(tag: str) -> dict:
     """Full product pipeline: index via _bulk, serve via _msearch/_search."""
     import shutil
@@ -775,10 +817,13 @@ def _run_all_legs(tag: str) -> dict:
         _FINAL_LINE["value"] = res.get("qps")
     # optional legs run only while the budget allows AND degrade to
     # absent keys on failure — the headline line always prints
-    for flag, leg in (("BENCH_AGG", run_agg_leg),
-                      ("BENCH_MULTISEG", run_multiseg_leg),
-                      ("BENCH_VEC", run_vector_leg)):
-        if os.environ.get(flag, "1") == "0":
+    for flag, default, leg in (("BENCH_AGG", "1", run_agg_leg),
+                               ("BENCH_MULTISEG", "1", run_multiseg_leg),
+                               ("BENCH_VEC", "1", run_vector_leg),
+                               # 4M-doc aggs + 1M-doc vectors: opt-in —
+                               # the scale tier only fits a long budget
+                               ("BENCH_SCALE", "0", run_scale_leg)):
+        if os.environ.get(flag, default) == "0":
             continue
         if _over_budget(margin=90.0):
             print(f"{flag} leg skipped: {_remaining():.0f}s of "
@@ -811,7 +856,7 @@ def main_engine():
     except Exception:  # noqa: BLE001
         pass
     ratio_keys = ["qps", "qps_filter", "conc_qps", "agg_qps", "knn_qps",
-                  "hybrid_qps"]
+                  "hybrid_qps", "scale_agg_qps", "scale_knn_qps"]
     if plat == "cpu":
         ratios = {k: 1.0 for k in ratio_keys if k in res}
     elif os.environ.get("BENCH_CPU", "1") != "0" and not _over_budget(60.0) \
@@ -904,6 +949,23 @@ def main_engine():
                 "fanout_fetches_per_query":
                     r2(res.get("fanout_fetches_per_query")),
                 "mesh_shards": res.get("mesh_shards")})
+    if "scale_peak_rss_bytes" in res:
+        # BENCH_SCALE leg (ISSUE 8): the 10M-doc-tier shapes, served by
+        # the blockwise lane; peak RSS + peak score-matrix residency show
+        # the O(Q × block) bound holding at 4M-doc aggs / 1M-doc vectors
+        line.update({
+            "scale_agg_qps": r2(res.get("scale_agg_qps")),
+            "vs_baseline_scale_agg": rnd(ratios.get("scale_agg_qps")),
+            "scale_agg_docs": res.get("scale_agg_docs"),
+            "scale_agg_index_secs": r2(res.get("scale_agg_index_secs")),
+            "scale_knn_qps": r2(res.get("scale_knn_qps")),
+            "vs_baseline_scale_knn": rnd(ratios.get("scale_knn_qps")),
+            "scale_knn_recall_at_10": rnd(res.get("scale_knn_recall")),
+            "scale_vec_docs": res.get("scale_vec_docs"),
+            "scale_vec_index_secs": r2(res.get("scale_vec_index_secs")),
+            "scale_peak_rss_bytes": res.get("scale_peak_rss_bytes"),
+            "scale_peak_score_matrix_bytes":
+                res.get("scale_peak_score_matrix_bytes")})
     if "knn_qps" in res:
         line.update({
             "knn_qps": round(res["knn_qps"], 2),

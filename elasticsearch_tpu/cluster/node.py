@@ -1620,8 +1620,23 @@ class ClusterNode:
         key = (tuple(s.seg_id for s in eng.segments),
                tuple(s.live_gen for s in eng.segments))
         if holder.searcher is None or holder.searcher[0] != key:
+            # per-index search-lane settings ride the cluster state
+            # (prefixed key wins, the update-settings convention) so the
+            # blockwise opt-out/block width behave like the local node's
+            meta = self.cluster.current().indices.get(index) or {}
+            settings = meta.get("settings") or {}
+
+            def get_s(k, default):
+                return settings.get(f"index.{k}", settings.get(k, default))
+            blockwise = str(get_s("search.blockwise.enable", True)) \
+                .strip().lower() not in ("false", "0", "no")
+            try:
+                block_docs = int(get_s("search.block_docs", 0)) or None
+            except (TypeError, ValueError):
+                block_docs = None
             holder.searcher = (key, ShardSearcher(
-                sid, eng.segments, self._mappers[index]))
+                sid, eng.segments, self._mappers[index],
+                blockwise=blockwise, block_docs=block_docs))
         return holder.searcher[1]
 
     @contextlib.contextmanager

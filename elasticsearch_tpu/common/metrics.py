@@ -247,6 +247,26 @@ def shard_fetch_histogram() -> dict[int, int]:
         return dict(_FETCH_HIST)
 
 
+# peak per-query score-matrix residency (ISSUE 8): what one dense query
+# phase materializes on device at most — O(Q × block) on the blockwise
+# lane vs O(Q × n_pad) on the materializing executor. A gauge, not a
+# counter: the scrape reads the process high-water mark.
+_SCORE_MATRIX_PEAK = [0]
+
+
+def record_score_matrix_bytes(n: int) -> None:
+    """One dense execution is about to materialize `n` bytes of score +
+    match state (the lane-accurate request-breaker charge)."""
+    with _DEVICE_LOCK:
+        if n > _SCORE_MATRIX_PEAK[0]:
+            _SCORE_MATRIX_PEAK[0] = int(n)
+
+
+def peak_score_matrix_bytes() -> int:
+    with _DEVICE_LOCK:
+        return _SCORE_MATRIX_PEAK[0]
+
+
 _HOST_MERGES = [0]
 
 

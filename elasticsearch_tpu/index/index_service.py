@@ -83,6 +83,21 @@ class IndexService:
         raw_mesh = get("search.mesh.enable", True)
         self._mesh_enabled = str(raw_mesh).strip().lower() \
             not in ("false", "0", "no")
+        # streaming blockwise dense execution (search/blockwise.py):
+        # segments/stacks wider than `index.search.block_docs` run the DSL
+        # tree per pow2 doc block under a running on-device top-k — peak
+        # score memory O(Q × block) instead of O(Q × n_pad). Opt out with
+        # `index.search.blockwise.enable: false` (the equivalence suite and
+        # bench use it to pin the materializing executor).
+        raw_blk = get("search.blockwise.enable", True)
+        self._blockwise_enabled = str(raw_blk).strip().lower() \
+            not in ("false", "0", "no")
+        from ..search.blockwise import DEFAULT_BLOCK_DOCS
+        raw_bd = get("search.block_docs", DEFAULT_BLOCK_DOCS)
+        try:
+            self._block_docs = int(raw_bd)
+        except (TypeError, ValueError):
+            self._block_docs = DEFAULT_BLOCK_DOCS
         # op counters surfaced by _stats (ref index/shard stats holders:
         # IndexingStats w/ per-type breakdown, SearchStats w/ groups, GetStats)
         self.indexing_stats: dict = {"index_total": 0, "delete_total": 0,
@@ -278,7 +293,11 @@ class IndexService:
                     stack_cache=self.caches.segment_stacks
                     if self.caches is not None else None,
                     index_name=self.name, incarnation=self._incarnation,
-                    stacked=self._stacked_enabled))
+                    stacked=self._stacked_enabled,
+                    blockwise=self._blockwise_enabled,
+                    block_docs=self._block_docs,
+                    request_breaker=self.breakers.breaker("request")
+                    if self.breakers is not None else None))
                 self._searcher_cache[si] = cached
             out.append(cached[1])
         return out

@@ -1018,9 +1018,10 @@ class NodeService:
             if (len(names) == 1 and len(searchers) > 1 and knn is None
                     and sort is None and search_after is None
                     and rescore_spec is None and not agg_specs):
-                mesh_reduced = self._try_mesh(
+                mesh_rows = self._try_mesh(
                     names[0], searchers, nodes_by_index[names[0]],
                     global_stats, size=size, from_=from_)
+                mesh_reduced = mesh_rows[0] if mesh_rows else None
             if mesh_reduced is not None:
                 results = []
             elif len(searchers) == 1:
@@ -1601,12 +1602,13 @@ class NodeService:
     # -- mesh-sharded query lane (parallel/mesh_exec, ISSUE 6) -------------
 
     def _try_mesh(self, name: str, searchers, node_tree, global_stats, *,
-                  size: int, from_: int):
-        """One mesh-lane attempt for an unsorted multi-shard query:
-        returns the ReducedDocs the on-device collective reduce produced,
-        or None to fall back to the PR-4 concurrent fan-out (opt-out
-        settings, joins, unsupported plan shapes, too few devices,
-        breaker-declined/oversized mesh stacks, or any execution error)."""
+                  size: int, from_: int, n_queries: int = 1):
+        """One mesh-lane attempt for an unsorted multi-shard query batch:
+        returns the per-row ReducedDocs the on-device collective reduce
+        produced (one per query row — single searches take row 0), or None
+        to fall back to the PR-4 concurrent fan-out (opt-out settings,
+        joins, unsupported plan shapes, too few devices, breaker-declined/
+        oversized mesh stacks, or any execution error)."""
         svc = self.indices[name]
         if not svc._mesh_enabled \
                 or not _mesh_enabled_setting(self.settings):
@@ -1629,8 +1631,10 @@ class NodeService:
                 return None
             with tracing.span("mesh_reduce", index=name,
                               shards=len(searchers), k=k):
-                out = mesh_exec.execute(stack, node_tree, global_stats,
-                                        k=k, Q=1)
+                out = mesh_exec.execute(
+                    stack, node_tree, global_stats, k=k, Q=n_queries,
+                    block_docs=svc._block_docs
+                    if svc._blockwise_enabled else None)
             if out is None:
                 return None     # plan has no collective form (field shapes)
         except Exception:  # noqa: BLE001 — the fan-out is always correct
@@ -1640,25 +1644,31 @@ class NodeService:
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
+        if mesh_exec.last_block_mode == "blockwise":
+            svc.search_stats["blockwise_dispatches"] = \
+                svc.search_stats.get("blockwise_dispatches", 0) + 1
         from .common.metrics import current_profiler, record_shard_fetches
         record_shard_fetches(1)     # ONE fetch served every shard
         prof = current_profiler()
         if prof is not None:
             prof.note_path("mesh")
-        row_k, row_sh, row_s = keys[0], shard_of[0], scores[0]
-        valid = row_k >= 0
-        vk, vsh, vs = row_k[valid], row_sh[valid], row_s[valid]
-        window = slice(from_, from_ + size)
         import math as _math
-        mxv = float(mx[0])
         from .search.controller import ReducedDocs
-        return ReducedDocs(
-            shard_order=[int(x) for x in vsh[window]],
-            doc_keys=[int(x) for x in vk[window]],
-            scores=[float(x) for x in vs[window]],
-            sort_values=None,
-            total_hits=int(total[0]),
-            max_score=mxv if _math.isfinite(mxv) else float("nan"))
+        window = slice(from_, from_ + size)
+        rows = []
+        for qi in range(n_queries):
+            row_k, row_sh, row_s = keys[qi], shard_of[qi], scores[qi]
+            valid = row_k >= 0
+            vk, vsh, vs = row_k[valid], row_sh[valid], row_s[valid]
+            mxv = float(mx[qi])
+            rows.append(ReducedDocs(
+                shard_order=[int(x) for x in vsh[window]],
+                doc_keys=[int(x) for x in vk[window]],
+                scores=[float(x) for x in vs[window]],
+                sort_values=None,
+                total_hits=int(total[qi]),
+                max_score=mxv if _math.isfinite(mxv) else float("nan")))
+        return rows
 
     _mesh_error_logged = 0
 
@@ -1901,6 +1911,34 @@ class NodeService:
             nodes_by_index[n].collect_terms(terms_by_field)
         global_stats = CollectionStats.from_segments(
             [seg for s in searchers for seg in s.segments], terms_by_field)
+
+        # mesh-batched lane (ISSUE 8 satellite, ROADMAP item 1 follow-up):
+        # a Q>1 plan-shaped batch over a single multi-shard index rides the
+        # mesh's "replica" axis — the whole batch's query phase AND the
+        # cross-shard merge run as ONE collective program with ONE device
+        # fetch. Aggs/knn/rescore/count-only groups keep the fan-out below
+        # (same ladder as the single-search coordinator).
+        if (len(names) == 1 and len(searchers) > 1
+                and rescore_spec0 is None and size + from_ > 0
+                and not (first_body.get("aggs")
+                         or first_body.get("aggregations"))):
+            mesh_rows = self._try_mesh(
+                names[0], searchers, nodes_by_index[names[0]],
+                global_stats, size=size, from_=from_,
+                n_queries=len(queries))
+            if mesh_rows is not None:
+                outs = self._batched_reduce(metas, searchers, index_of,
+                                            None, size, from_, None, t0,
+                                            reduced_rows=mesh_rows)
+                self.meters["search"].mark(len(metas))
+                for n in names:
+                    svc = self.indices[n]
+                    svc.query_total += len(metas)
+                    svc.search_stats["batched"] = \
+                        svc.search_stats.get("batched", 0) + len(metas)
+                    svc.meters["search"].mark(len(metas))
+                return outs
+
         aggs_body = first_body.get("aggs") or first_body.get("aggregations")
         count_only = size + from_ == 0 and rescore_spec0 is None
         seg_masks: list | None = None
@@ -2021,12 +2059,16 @@ class NodeService:
         return outs
 
     def _batched_reduce(self, metas, searchers, index_of, results,
-                        size, from_, agg_rendered, t0) -> list[dict]:
+                        size, from_, agg_rendered, t0,
+                        reduced_rows=None) -> list[dict]:
         took = int((time.perf_counter() - t0) * 1000)
         outs = []
         for qi, (_, body) in enumerate(metas):
-            reduced = controller.sort_docs(results, from_=from_, size=size,
-                                           query_row=qi)
+            # the mesh-batched lane hands per-row ReducedDocs straight from
+            # the device reduce — sort_docs (the host merge) is skipped
+            reduced = reduced_rows[qi] if reduced_rows is not None \
+                else controller.sort_docs(results, from_=from_, size=size,
+                                          query_row=qi)
             src_filter = body.get("_source")
             fields_spec = body.get("fields")
             if isinstance(fields_spec, str):
@@ -2550,7 +2592,8 @@ class NodeService:
             for pk, pv in svc.search_stats.items():
                 path_totals[pk] = path_totals.get(pk, 0) + pv
         from .common.metrics import (bulk_docs_histogram,
-                                     bulk_ingest_snapshot, host_merge_count)
+                                     bulk_ingest_snapshot, host_merge_count,
+                                     peak_score_matrix_bytes)
         search_exec = {
             "segment_dispatches_total":
                 path_totals.get("segment_dispatches", 0),
@@ -2558,6 +2601,13 @@ class NodeService:
                 path_totals.get("stacked_dispatches", 0),
             "stacked_queries_total": path_totals.get("stacked", 0),
             "stacked_errors_total": path_totals.get("stacked_errors", 0),
+            # streaming blockwise dense lane (ISSUE 8): executions that ran
+            # the tree per doc block under a running on-device top-k, plus
+            # the process-peak score-matrix residency a dense query phase
+            # materialized (O(Q×block) blockwise vs O(Q×n_pad) full)
+            "blockwise_dispatches_total":
+                path_totals.get("blockwise_dispatches", 0),
+            "peak_score_matrix_bytes": peak_score_matrix_bytes(),
             # mesh-sharded lane (ISSUE 6): whole-index collective programs
             # vs per-shard stacked/segment dispatches, plus how many
             # host-side cross-shard merges still ran (fan-out path)
@@ -2665,6 +2715,8 @@ class NodeService:
             "mesh_stack_cache_memory_bytes":
                 self.caches.mesh_stacks.cache.memory_bytes,
         }
+        from .common.metrics import peak_score_matrix_bytes
+        out["peak_score_matrix_bytes"] = peak_score_matrix_bytes()
         tr = self.tracer.stats()
         out["tracing_active_traces"] = tr["active_traces"]
         out["tracing_dropped_total"] = tr["dropped_traces_total"]

@@ -28,14 +28,41 @@ import jax.numpy as jnp
 _MATMUL_BINS = 256   # one-hot is [N, B] bf16 — cap its footprint
 
 
-def _onehot_counts(ids, valid, n_bins: int):
-    """ids i32[N], valid bool[..., N] -> f32[..., n_bins] exact counts."""
+# Above this many docs the [N, n_bins] one-hot is chunked along the doc
+# axis inside a lax.scan: bucket state accumulates PER BLOCK (the blockwise
+# lane's ring-attention discipline applied to agg collect), so a 4M+ doc
+# terms/date_histogram materializes [block, n_bins] instead of the 2 GB
+# full one-hot. Per-block counts are exact integers <= block < 2^24, so the
+# i32 accumulation is exact and results match the one-shot matmul bitwise.
+_ONEHOT_BLOCK = 65536
+
+
+def _onehot_block(ids, v2, n_bins: int):
     oh = (ids[:, None] == jnp.arange(n_bins, dtype=ids.dtype)[None, :])
-    v2 = valid[None, :] if valid.ndim == 1 else valid
-    out = jax.lax.dot_general(
+    return jax.lax.dot_general(
         v2.astype(jnp.bfloat16), oh.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+
+
+def _onehot_counts(ids, valid, n_bins: int):
+    """ids i32[N], valid bool[..., N] -> f32[..., n_bins] exact counts."""
+    v2 = valid[None, :] if valid.ndim == 1 else valid
+    N = ids.shape[0]
+    if N > _ONEHOT_BLOCK and N % _ONEHOT_BLOCK == 0:
+        nb = N // _ONEHOT_BLOCK
+        Q = v2.shape[0]
+        ids_b = ids.reshape(nb, _ONEHOT_BLOCK)
+        v_b = jnp.moveaxis(v2.reshape(Q, nb, _ONEHOT_BLOCK), 1, 0)
+
+        def body(acc, x):
+            i_blk, vb = x
+            return acc + _onehot_block(i_blk, vb, n_bins).astype(jnp.int32), None
+        acc0 = jnp.zeros((Q, n_bins), jnp.int32)
+        out, _ = jax.lax.scan(body, acc0, (ids_b, v_b))
+        out = out.astype(jnp.float32)
+    else:
+        out = _onehot_block(ids, v2, n_bins)
     return out[0] if valid.ndim == 1 else out
 
 

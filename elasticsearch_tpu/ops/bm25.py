@@ -137,6 +137,76 @@ def term_match_mask(doc_ids: jax.Array, term_starts: jax.Array,
     return hits > 0
 
 
+# -- blockwise variants (search/blockwise.py): same math, scatter into a
+# `block`-wide doc window starting at `base`. NOT jitted here — they trace
+# inside the blockwise lax.scan body, so the scan is one program. Per-block
+# CSR pointers guarantee every valid slot's doc lies inside the window, so
+# the per-doc contribution sequence is exactly the full kernel's (bitwise-
+# identical scores); padding slots add an exact 0.0 parked on the window's
+# last slot, the full kernel's own convention. ------------------------------
+
+def bm25_score_block(doc_ids: jax.Array, tf: jax.Array, doc_len: jax.Array,
+                     term_starts: jax.Array, term_lens: jax.Array,
+                     weights: jax.Array, k1, b, avgdl, base, *,
+                     W: int, block: int) -> jax.Array:
+    """Score one doc block: returns scores f32[Q, block] for docs
+    [base, base+block). term_starts/lens are PER-BLOCK CSR slices; doc_len
+    stays the full [N] column (global gather — it is already resident)."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]                                       # [Q,W] global
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    impact = bm25_impact(tfv, dl, k1, b, avgdl)
+    w = jnp.take_along_axis(weights, t_idx, axis=1)
+    contrib = jnp.where(valid, w * impact, 0.0).astype(jnp.float32)
+    loc = jnp.where(valid, doc - base, block - 1)            # window-local
+    scores = jnp.zeros((Q, block), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], loc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+def classic_score_block(doc_ids: jax.Array, tf: jax.Array,
+                        doc_len: jax.Array, term_starts: jax.Array,
+                        term_lens: jax.Array, weights: jax.Array, base, *,
+                        W: int, block: int) -> jax.Array:
+    """classic_score_batch over one doc block (see bm25_score_block)."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    impact = jnp.sqrt(tfv) / jnp.sqrt(jnp.maximum(dl, 1.0))
+    w = jnp.take_along_axis(weights, t_idx, axis=1)
+    contrib = jnp.where(valid, w * impact, 0.0).astype(jnp.float32)
+    loc = jnp.where(valid, doc - base, block - 1)
+    scores = jnp.zeros((Q, block), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], loc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+def term_match_mask_block(doc_ids: jax.Array, term_starts: jax.Array,
+                          term_lens: jax.Array, base, *,
+                          W: int, block: int) -> jax.Array:
+    """term_match_mask over one doc block (per-block CSR pointers)."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, _, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]
+    loc = jnp.where(valid, doc - base, block - 1)
+    hits = jnp.zeros((Q, block), jnp.float32)
+    hits = hits.at[jnp.arange(Q, dtype=jnp.int32)[:, None], loc].add(
+        jnp.where(valid, 1.0, 0.0), mode="drop")
+    return hits > 0
+
+
 def idf(doc_freq, doc_count) -> jax.Array:
     """Lucene BM25 idf: log(1 + (N - df + 0.5) / (df + 0.5))."""
     df = jnp.asarray(doc_freq, jnp.float32)

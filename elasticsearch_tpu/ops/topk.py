@@ -45,6 +45,19 @@ def topk_concat(all_scores: jax.Array, all_ids: jax.Array, *, k: int):
     return top, jnp.take_along_axis(all_ids, pos, axis=-1)
 
 
+def merge_running_topk(top_s: jax.Array, top_i: jax.Array,
+                       blk_s: jax.Array, blk_i: jax.Array, *, k: int):
+    """One step of a running top-k: merge the carried winner list with a
+    new block's candidates (search/blockwise.py scan body). NOT jitted —
+    traces inside the blockwise scan. Candidate order [carry, block] plus
+    lax.top_k's keep-earlier-on-ties makes the running merge reproduce a
+    full-axis top_k's exact tie order when blocks arrive in doc order."""
+    s = jnp.concatenate([top_s, blk_s], axis=-1)
+    i = jnp.concatenate([top_i, blk_i], axis=-1)
+    top, pos = jax.lax.top_k(s, k)
+    return top, jnp.take_along_axis(i, pos, axis=-1)
+
+
 @jax.jit
 def count_matches(mask: jax.Array) -> jax.Array:
     """total_hits per query: sum of the match mask (i64 to be exact)."""

@@ -79,6 +79,12 @@ _MESH_MEMO: dict[tuple[int, int], jax.sharding.Mesh] = {}
 # DistributedSearcher's step memo, bounded on the common Cache core
 _PROGRAMS = Cache("mesh_programs", max_entries=256)
 
+# score-materialization mode of the LAST mesh execution: "blockwise"
+# (search/blockwise.py scan inside the shard_map body — peak score memory
+# O(Q × block) per device) | "materialized" (full [G, Q, N] tensors).
+# Coordinator counters and tests read it after execute().
+last_block_mode: str | None = None
+
 
 def mesh_for(n_shards: int):
     """(mesh, s_pad, n_replicas) for an S-shard index, or None when this
@@ -941,15 +947,181 @@ def _build_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
                               out_specs=out_specs))
 
 
-def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1):
+def _build_blockwise_program(mesh, bplan, *, k: int, n_queries: int,
+                             kk: int, score_dtype):
+    """jit(shard_map(blockwise scan + per-shard merge + cross-shard
+    reduce)): the blockwise analog of _build_program. The scan body is
+    search/blockwise.run_scan — the per-shard running top-k — and the
+    merge tails are _build_program's verbatim, so results stay
+    bitwise-identical to the materializing mesh program."""
+    from ..search import blockwise as bw
+
+    nf = bw.n_field_arrays(bplan.field_kinds)
+    g_pad, block, nb = bplan.g_pad, bplan.block, bplan.nb
+
+    def step(live, seg_ids, *flat):
+        live = live[0]                        # [G, N]
+        seg_ids = seg_ids[0]                  # [G]
+        fields = bw.rebuild_fields(bplan.field_kinds,
+                                   [a[0] for a in flat[:nf]])
+        ops = []
+        for kind, v in zip(bplan.op_kinds, flat[nf:]):
+            ops.append(v[0] if kind in (bw.OP_X, bw.OP_SG, bw.OP_COL,
+                                        bw.OP_COLQ) else v)
+        top, gi, total, mx = bw.run_scan(
+            bplan.devfn, fields, ops, bplan.op_kinds, live, g_pad=g_pad,
+            block=block, nb=nb, n_queries=n_queries, kk=kk,
+            score_dtype=score_dtype)
+
+        # per-shard cross-segment merge — stacked_reduce's tail verbatim
+        keys = jnp.where(top > -jnp.inf,
+                         (seg_ids[:, None, None] << SEG_SHIFT)
+                         | gi.astype(jnp.int64),
+                         jnp.int64(-1))
+        Qb = top.shape[1]
+        cand_s = jnp.moveaxis(top, 0, 1).reshape(Qb, -1)
+        cand_k = jnp.moveaxis(keys, 0, 1).reshape(Qb, -1)
+        ks = min(k, cand_s.shape[1])
+        shard_s, pos = lax.top_k(cand_s, ks)
+        shard_k = jnp.take_along_axis(cand_k, pos, axis=1)
+
+        # cross-shard reduce — _build_program's tail verbatim
+        g_s = lax.all_gather(shard_s, SHARD_AXIS)
+        g_k = lax.all_gather(shard_k, SHARD_AXIS)
+        S = g_s.shape[0]
+        g_s = jnp.transpose(g_s, (1, 0, 2)).reshape(Qb, S * ks)
+        g_k = jnp.transpose(g_k, (1, 0, 2)).reshape(Qb, S * ks)
+        out_s, pos2 = lax.top_k(g_s, min(k, S * ks))
+        out_k = jnp.take_along_axis(g_k, pos2, axis=1)
+        valid = out_s > -jnp.inf
+        out_shard = jnp.where(valid, (pos2 // ks).astype(jnp.int32),
+                              jnp.int32(-1))
+        out_k = jnp.where(valid, out_k, jnp.int64(-1))
+        total_g = lax.psum(total, SHARD_AXIS)
+        mx_g = lax.pmax(mx, SHARD_AXIS)
+        return out_k, out_shard, out_s, total_g, mx_g
+
+    field_specs = []
+    for _name, kind in bplan.field_kinds:
+        field_specs.extend([P(SHARD_AXIS)] * _FIELD_TENSORS[kind])
+    op_specs = []
+    for kind in bplan.op_kinds:
+        if kind == bw.OP_X:            # [S, NB, G, Q, ...]
+            op_specs.append(P(SHARD_AXIS, None, None, REPLICA_AXIS))
+        elif kind == bw.OP_SG:         # [S, G, Q, ...]
+            op_specs.append(P(SHARD_AXIS, None, REPLICA_AXIS))
+        elif kind == bw.OP_COLQ:       # [S, G, Q, N]
+            op_specs.append(P(SHARD_AXIS, None, REPLICA_AXIS))
+        elif kind == bw.OP_COL:        # [S, G, N]
+            op_specs.append(P(SHARD_AXIS))
+        elif kind == bw.OP_Q:          # [Q, ...]
+            op_specs.append(P(REPLICA_AXIS))
+        else:                          # scalar, replicated
+            op_specs.append(P())
+    in_specs = tuple([P(SHARD_AXIS), P(SHARD_AXIS)]
+                     + field_specs + op_specs)
+    out_specs = (P(REPLICA_AXIS),) * 5
+    return jax.jit(_shard_map(step, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs))
+
+
+def _try_blockwise(stack: MeshStack, node: Node, stats, *, k: int,
+                   q_pad: int, R: int, block: int):
+    """Plan + run the blockwise mesh program, or None when the tree/shape
+    has no blockwise form (caller materializes). Output contract is
+    execute()'s device 5-tuple."""
+    from ..search import blockwise as bw
+
+    env = bw.FieldEnv(set(stack.text), set(stack.keywords),
+                      set(stack.numerics), stack.mixed,
+                      lambda f: stack.numerics[f].dtype)
+    shard_rows = tuple(tuple(seg for _i, seg in rows)
+                       for rows in stack.shard_rows)
+    bplan = bw.plan(node, shard_rows, env, g_pad=stack.g_pad,
+                    n_pad=stack.n_pad, block=block, n_queries=q_pad,
+                    stats=stats)
+    if bplan is None:
+        return None
+    # dtype probe over SHAPES only (no device work): shard-local field
+    # views are the mesh tensors minus their leading S axis
+    probe_fields = {}
+    for name, kind in bplan.field_kinds:
+        if kind == "text":
+            ft = stack.text[name]
+            probe_fields[name] = bw.BTextField(
+                jax.ShapeDtypeStruct(ft.doc_ids.shape[1:], ft.doc_ids.dtype),
+                jax.ShapeDtypeStruct(ft.tf.shape[1:], ft.tf.dtype),
+                jax.ShapeDtypeStruct(ft.doc_len.shape[1:], ft.doc_len.dtype))
+        elif kind == "keyword":
+            kw = stack.keywords[name]
+            probe_fields[name] = bw.BKeywordField(
+                jax.ShapeDtypeStruct(kw.ords.shape[1:], kw.ords.dtype))
+        else:
+            nf_ = stack.numerics[name]
+            probe_fields[name] = bw.BNumericField(
+                jax.ShapeDtypeStruct(nf_.vals.shape[1:], nf_.vals.dtype),
+                jax.ShapeDtypeStruct(nf_.missing.shape[1:],
+                                     nf_.missing.dtype))
+    score_dtype = bw.probe_score_dtype(bplan, probe_fields)
+    Qb = q_pad // R
+    kk = min(k, stack.n_pad)
+    key = ("bw", stack.s_pad, R, q_pad, k, kk, block, bplan.sig,
+           bplan.field_kinds, bplan.op_kinds, str(score_dtype))
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = _build_blockwise_program(stack.mesh, bplan, k=k,
+                                        n_queries=Qb, kk=kk,
+                                        score_dtype=score_dtype)
+        _PROGRAMS.put(key, prog, weight=1)
+    args = []
+    for name, kind in bplan.field_kinds:
+        if kind == "text":
+            ft = stack.text[name]
+            args.extend([ft.doc_ids, ft.tf, ft.doc_len])
+        elif kind == "keyword":
+            args.append(stack.keywords[name].ords)
+        else:
+            nf_ = stack.numerics[name]
+            args.extend([nf_.vals, nf_.missing])
+    args.extend(bplan.ops)
+    from ..common.metrics import note_h2d, record_score_matrix_bytes
+    note_h2d(sum(int(np.asarray(a).nbytes) for a in bplan.ops))
+    record_score_matrix_bytes(stack.g_pad * Qb * block * 5)
+    return prog(stack.live_stack(), stack.seg_ids_dev, *args)
+
+
+def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
+            block_docs: int | None = None):
     """Run the parsed tree over the mesh stack as one program.
 
     -> (doc_keys i64[Q,k'], shard i32[Q,k'], scores [Q,k'], total i64[Q],
     max f[Q]) fetched in ONE device round-trip, or None when the plan has
     no collective form (caller falls back to the fan-out). May raise on
-    execution failure — the caller degrades to the fan-out there too."""
+    execution failure — the caller degrades to the fan-out there too.
+
+    With `block_docs` set and the stack wider than one block, the DSL tree
+    runs blockwise inside the shard_map body (search/blockwise.run_scan) —
+    peak score memory O(Q × block) per device — before the same cross-shard
+    collective reduce; trees without a blockwise plan materialize."""
+    global last_block_mode
     R = stack.n_replicas
     q_pad = -(-Q // R) * R
+    last_block_mode = "materialized"
+    if block_docs and stack.n_pad > block_docs \
+            and stack.n_pad % block_docs == 0:
+        out_d = _try_blockwise(stack, node, stats, k=k, q_pad=q_pad, R=R,
+                               block=block_docs)
+        if out_d is not None:
+            last_block_mode = "blockwise"
+            from ..common.metrics import device_fetch
+            out_k, out_shard, out_s, total, mx = out_d
+            got = device_fetch({"keys": out_k, "shard": out_shard,
+                                "scores": out_s, "total": total, "mx": mx})
+            return (np.asarray(got["keys"])[:Q],
+                    np.asarray(got["shard"])[:Q],
+                    np.asarray(got["scores"])[:Q],
+                    np.asarray(got["total"])[:Q],
+                    np.asarray(got["mx"])[:Q])
     pctx = _PlanCtx(stack, q_pad, stats)
     try:
         sig, devfn = _plan_exec(node, pctx)
@@ -974,8 +1146,10 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1):
             nf = stack.numerics[name]
             args.extend([nf.vals, nf.missing])
     args.extend(a for a, _kind in pctx.ops)
-    from ..common.metrics import device_fetch, note_h2d
+    from ..common.metrics import (device_fetch, note_h2d,
+                                  record_score_matrix_bytes)
     note_h2d(sum(int(a.nbytes) for a, _kind in pctx.ops))
+    record_score_matrix_bytes(stack.g_pad * (q_pad // R) * stack.n_pad * 5)
     out_k, out_shard, out_s, total, mx = prog(
         stack.live_stack(), stack.seg_ids_dev, *args)
     # the whole multi-shard query phase comes down in this ONE fetch

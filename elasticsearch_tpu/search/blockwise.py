@@ -1,0 +1,1055 @@
+"""Streaming blockwise dense execution: running on-device top-k over doc
+blocks — the [Q, n_pad] score matrix never materializes.
+
+Every non-sparse DSL node used to produce full `[Q, n_pad]` score/match
+tensors (search/query_dsl.py), which is fine at 100k docs and fatal at the
+10M-doc BASELINE configs: a 64-query batch over 10M padded docs is ~2.5 GB
+of f32 scores PER NODE of the tree. SURVEY §5.7 names the fix — the
+per-shard score array is the "sequence", and the genuine ring-attention
+analog is chunked postings-block scoring with a running top-k.
+
+This module partitions the doc axis into pow2 blocks
+(`index.search.block_docs`, default 65536), plans the parsed DSL tree ONCE
+into per-block device operands (per-block CSR postings slices host-side,
+columnar slices on device), and executes the whole tree inside ONE jitted
+`lax.scan` over blocks, carrying
+
+    top_s  [G, Q, kk]   running per-segment top-k scores
+    top_i  [G, Q, kk]   running global doc indices
+    total  [Q]          exact match totals (i64)
+    mx     [Q]          running masked row-max
+
+so peak device score memory is O(G × Q × block) instead of O(G × Q × n_pad)
+and the shard still comes down in ONE device fetch. Results are
+bitwise-identical to the materializing executor: per-block CSR slicing
+preserves each doc's contribution order (ops/bm25.*_block), integer totals
+and float maxes are associative, and the running merge's candidate order
+(earlier blocks first + `lax.top_k`'s keep-earlier-on-ties) reproduces a
+full-axis top_k's exact tie order because blocks arrive in doc order —
+`controller.sort_docs`' tie contract holds unchanged.
+
+Three lanes share this core (the plan handlers and `run_scan` are
+lane-agnostic over the leading segment axis G):
+
+  * per-segment loop  (search/shard_searcher.py): G = 1 per segment;
+  * stacked lane      (search/stacked.py stacks feed `execute_stacked`):
+                      blocks ride under the segment axis, the cross-segment
+                      merge is the stacked_reduce tail verbatim;
+  * mesh lane         (parallel/mesh_exec.py): `run_scan` runs inside the
+                      shard_map body before the cross-shard all_gather.
+
+Single-block indices (n_pad <= block) take the identity fast path — the
+caller keeps the materializing executor, zero overhead for small corpora.
+Unsupported node types / mixed field shapes decline at plan time and fall
+down the existing ladder.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.cache import Cache
+from ..index.segment import Segment
+from ..ops import bm25
+from ..ops.topk import merge_running_topk
+from .query_dsl import (
+    BoolNode, BoostingNode, ConstantScoreNode, DisMaxNode, ExistsNode,
+    IdsNode, MatchAllNode, MatchNode, MatchNoneNode, Node, RangeNode,
+    TermFilterNode, _bisect, _coerce_to_column, _next_down, _next_up,
+    _pow2_window,
+)
+
+SEG_SHIFT = 32
+DEFAULT_BLOCK_DOCS = 65536
+
+# operand kinds: how each host-prepared array reaches the scan body.
+# Shapes below include the leading shard axis S; the stacked/loop runners
+# strip it (S=1), the mesh runner shards over it.
+OP_X = "x"          # [S, NB, G, Q, ...]  per-block scan operand
+OP_SG = "sg"        # [S, G, Q, ...]      per-shard constant
+OP_Q = "q"          # [Q, ...]            replicated constant
+OP_R = "r"          # scalar              replicated constant
+OP_COL = "col"      # [S, G, N]           doc column, sliced per block
+OP_COLQ = "colq"    # [S, G, Q, N]        per-query doc column, sliced
+
+# compiled blockwise programs keyed by plan signature — same discipline as
+# mesh_exec._PROGRAMS: refresh→query cycles inside a pow2 bucket reuse the
+# entry, zero retraces (tests/test_no_retrace.py)
+_PROGRAMS = Cache("blockwise_programs", max_entries=256)
+
+
+class _Unsupported(Exception):
+    """Node/field shape without a typed blockwise handler — the caller
+    falls back down the ladder to the materializing executor."""
+
+
+# ---------------------------------------------------------------------------
+# Field containers: shard-local [G, ...] views the devfns consume
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BTextField:
+    doc_ids: jax.Array               # i32[G, P_pad]
+    tf: jax.Array                    # f32[G, P_pad]
+    doc_len: jax.Array               # f32[G, N_pad] (full column: global
+                                     # gather — it is already resident)
+
+
+@dataclass
+class BKeywordField:
+    ords: jax.Array                  # i32[G, N_pad]
+
+
+@dataclass
+class BNumericField:
+    vals: jax.Array                  # [G, N_pad] i64 | f64
+    missing: jax.Array               # bool[G, N_pad]
+
+
+_FIELD_ARRAYS = {"text": 3, "keyword": 1, "numeric": 2}
+
+
+def n_field_arrays(field_kinds) -> int:
+    return sum(_FIELD_ARRAYS[k] for _n, k in field_kinds)
+
+
+def flatten_fields(field_kinds, fields: dict) -> list:
+    flat = []
+    for name, kind in field_kinds:
+        f = fields[name]
+        if kind == "text":
+            flat.extend([f.doc_ids, f.tf, f.doc_len])
+        elif kind == "keyword":
+            flat.append(f.ords)
+        else:
+            flat.extend([f.vals, f.missing])
+    return flat
+
+
+def rebuild_fields(field_kinds, flat) -> dict:
+    out = {}
+    i = 0
+    for name, kind in field_kinds:
+        if kind == "text":
+            out[name] = BTextField(flat[i], flat[i + 1], flat[i + 2])
+            i += 3
+        elif kind == "keyword":
+            out[name] = BKeywordField(flat[i])
+            i += 1
+        else:
+            out[name] = BNumericField(flat[i], flat[i + 1])
+            i += 2
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Plan context: one walk of the tree emits operands + a device closure
+# ---------------------------------------------------------------------------
+
+class FieldEnv:
+    """Which column kind serves each field for this lane (the stack's /
+    segment's field dictionaries + the mixed-kind exclusion set)."""
+
+    def __init__(self, text: set, keywords: set, numerics: set,
+                 mixed: frozenset, num_dtype):
+        self.text = text
+        self.keywords = keywords
+        self.numerics = numerics
+        self.mixed = mixed
+        self._num_dtype = num_dtype      # field -> "i64" | "f64"
+
+    def num_dtype(self, f: str) -> str:
+        return self._num_dtype(f)
+
+    @staticmethod
+    def from_segments(segments: Sequence[Segment]) -> "FieldEnv":
+        text, kw, num = set(), set(), set()
+        dts: dict[str, set] = {}
+        for seg in segments:
+            text.update(seg.text)
+            kw.update(seg.keywords)
+            num.update(seg.numerics)
+            for f, nc in seg.numerics.items():
+                dts.setdefault(f, set()).add(nc.dtype)
+        mixed = (text & kw) | (text & num) | (kw & num) \
+            | {f for f, d in dts.items() if len(d) > 1}
+        return FieldEnv(text, kw, num, frozenset(mixed),
+                        lambda f: next(iter(dts.get(f, {"i64"}))))
+
+
+class _PlanCtx:
+    def __init__(self, shard_rows, env: FieldEnv, *, g_pad: int, n_pad: int,
+                 block: int, n_queries: int, stats):
+        self.shard_rows = shard_rows     # tuple[tuple[Segment, ...]], len S
+        self.env = env
+        self.s = len(shard_rows)
+        self.g_pad = g_pad
+        self.n_pad = n_pad
+        self.block = block
+        self.nb = n_pad // block
+        self.Q = n_queries
+        self.stats = stats
+        self.ops: list[tuple[np.ndarray, str]] = []
+        self.fields: dict[str, str] = {}     # field -> kind, first-use order
+
+    def emit(self, arr, kind: str) -> None:
+        self.ops.append((np.asarray(arr), kind))
+
+    def use_field(self, name: str, kind: str) -> None:
+        self.fields.setdefault(name, kind)
+
+    def block_edges(self) -> np.ndarray:
+        return np.arange(self.nb + 1, dtype=np.int64) * self.block
+
+
+class _BlkCtx:
+    """One block's view inside the scan body: shard-local fields (full doc
+    axis — handlers slice what they need via `slice_docs`), the block's
+    operand values, and the traced block base."""
+
+    def __init__(self, fields: dict, ops: list, g_pad: int, block: int,
+                 n_queries: int, base):
+        self.fields = fields
+        self._ops = iter(ops)
+        self.g_pad = g_pad
+        self.block = block
+        self.Q = n_queries
+        self.base = base
+
+    def pop(self):
+        return next(self._ops)
+
+    def slice_docs(self, arr):
+        """Full-column [.., N] -> this block's [.., block] slice."""
+        return lax.dynamic_slice_in_dim(arr, self.base, self.block,
+                                        axis=arr.ndim - 1)
+
+    def zeros(self):
+        return jnp.zeros((self.g_pad, self.Q, self.block), jnp.float32)
+
+    def false(self):
+        return jnp.zeros((self.g_pad, self.Q, self.block), bool)
+
+    def true(self):
+        return jnp.ones((self.g_pad, self.Q, self.block), bool)
+
+
+# ---------------------------------------------------------------------------
+# Leaf plan handlers — mirrors of the stacked/mesh typed handlers, with
+# per-block CSR pointer slices for postings work
+# ---------------------------------------------------------------------------
+
+def _match_weights(node: MatchNode, pctx: _PlanCtx):
+    """The shared (stats-derived, segment-independent) idf weights —
+    MatchNode._host_arrays' weight arithmetic verbatim."""
+    T = max((len(t) for t in node.terms_per_query), default=1) or 1
+    weights = np.zeros((pctx.Q, T), np.float32)
+    n_terms = np.zeros((pctx.Q,), np.int32)
+    for qi, terms in enumerate(node.terms_per_query):
+        n_terms[qi] = len(terms)
+        for ti, t in enumerate(terms):
+            df = pctx.stats.df(node.field_name, t)
+            if df > 0:
+                if node.sim == "classic":
+                    idf = 1.0 + math.log(pctx.stats.doc_count / (df + 1.0))
+                    weights[qi, ti] = idf * idf * node.boost
+                else:
+                    w = math.log(
+                        1 + (pctx.stats.doc_count - df + 0.5) / (df + 0.5))
+                    weights[qi, ti] = w * (node.k1 + 1) * node.boost
+    return weights, n_terms, T
+
+
+def _match_block_csr(node: MatchNode, pctx: _PlanCtx, T: int):
+    """Per-block CSR pointer slices [S, NB, G, Q, T]: each term's sorted
+    postings run splits at the block edges via one searchsorted, so a
+    block's kernel sees exactly the postings whose docs land in it — the
+    contribution order per doc is the full kernel's."""
+    S, NB, G, Q = pctx.s, pctx.nb, pctx.g_pad, pctx.Q
+    starts = np.zeros((S, NB, G, Q, T), np.int32)
+    lens = np.zeros((S, NB, G, Q, T), np.int32)
+    edges = pctx.block_edges()
+    for si, rows in enumerate(pctx.shard_rows):
+        for gi, seg in enumerate(rows):
+            fx = seg.text.get(node.field_name)
+            if fx is None:
+                continue
+            dh = fx.doc_ids_host if fx.doc_ids_host is not None \
+                else np.asarray(fx.doc_ids)
+            for qi, terms in enumerate(node.terms_per_query):
+                for ti, t in enumerate(terms):
+                    s_, ln, _tid = fx.lookup(t)
+                    if not ln:
+                        continue
+                    cuts = np.searchsorted(dh[s_: s_ + ln], edges)
+                    starts[si, :, gi, qi, ti] = s_ + cuts[:-1]
+                    lens[si, :, gi, qi, ti] = np.diff(cuts)
+    return starts, lens
+
+
+def _p_match(node: MatchNode, pctx: _PlanCtx):
+    f = node.field_name
+    if f in pctx.env.mixed:
+        raise _Unsupported(f"mixed field [{f}]")
+    if f not in pctx.env.text:
+        return (("match_absent",), lambda d: (d.zeros(), d.false()))
+    pctx.use_field(f, "text")
+    weights, n_terms, T = _match_weights(node, pctx)
+    starts, lens = _match_block_csr(node, pctx, T)
+    W = _pow2_window(lens)
+    pctx.emit(starts, OP_X)
+    pctx.emit(lens, OP_X)
+    pctx.emit(weights, OP_Q)
+    sim, k1, b = node.sim, float(node.k1), float(node.b)
+    msm_mode = node.operator == "and" or node.minimum_should_match > 1
+    if msm_mode:
+        need = n_terms if node.operator == "and" else np.broadcast_to(
+            np.float32(max(node.minimum_should_match, 1)), (pctx.Q,))
+        pctx.emit(np.asarray(need, np.float32), OP_Q)
+    if sim != "classic":
+        pctx.emit(np.float32(pctx.stats.avgdl(f)), OP_R)
+    sig = ("match", f, sim, msm_mode, k1, b, W)
+
+    def dev(d: _BlkCtx):
+        sf = d.fields[f]
+        st, ln, w = d.pop(), d.pop(), d.pop()
+        need_b = d.pop() if msm_mode else None
+        if sim == "classic":
+            def one(di, tfv, dl, st_, ln_):
+                return bm25.classic_score_block(
+                    di, tfv, dl, st_, ln_, w, d.base, W=W, block=d.block)
+            scores = jax.vmap(one)(sf.doc_ids, sf.tf, sf.doc_len, st, ln)
+        else:
+            avgdl = d.pop()
+
+            def one(di, tfv, dl, st_, ln_):
+                return bm25.bm25_score_block(
+                    di, tfv, dl, st_, ln_, w, jnp.float32(k1),
+                    jnp.float32(b), avgdl.astype(jnp.float32), d.base,
+                    W=W, block=d.block)
+            scores = jax.vmap(one)(sf.doc_ids, sf.tf, sf.doc_len, st, ln)
+        if msm_mode:
+            ones_w = jnp.ones_like(w)
+
+            def cnt(di, tfv, dl, st_, ln_):
+                return bm25.bm25_score_block(
+                    di, jnp.ones_like(tfv), jnp.full_like(dl, 1.0),
+                    st_, ln_, ones_w, jnp.float32(0.0), jnp.float32(0.0),
+                    jnp.float32(1.0), d.base, W=W, block=d.block)
+            counts = jax.vmap(cnt)(sf.doc_ids, sf.tf, sf.doc_len, st, ln)
+            match = counts >= jnp.maximum(need_b.astype(jnp.float32),
+                                          1.0)[None, :, None]
+        else:
+            match = scores > 0
+        return jnp.where(match, scores, 0.0), match
+
+    return sig, dev
+
+
+def _pm_match(node: MatchNode, pctx: _PlanCtx):
+    """Presence-only filter mask (the term_match_mask fast path)."""
+    if node.operator == "and" or node.minimum_should_match > 1:
+        sig, dev = _p_match(node, pctx)
+        return ("m", sig), (lambda d: dev(d)[1])
+    f = node.field_name
+    if f in pctx.env.mixed:
+        raise _Unsupported(f"mixed field [{f}]")
+    if f not in pctx.env.text:
+        return (("m_match_absent",), lambda d: d.false())
+    pctx.use_field(f, "text")
+    _w, _n, T = _match_weights(node, pctx)
+    starts, lens = _match_block_csr(node, pctx, T)
+    W = _pow2_window(lens)
+    pctx.emit(starts, OP_X)
+    pctx.emit(lens, OP_X)
+    sig = ("m_match", f, W)
+
+    def dev(d: _BlkCtx):
+        sf = d.fields[f]
+        st, ln = d.pop(), d.pop()
+
+        def one(di, st_, ln_):
+            return bm25.term_match_mask_block(di, st_, ln_, d.base,
+                                              W=W, block=d.block)
+        return jax.vmap(one)(sf.doc_ids, st, ln)
+
+    return sig, dev
+
+
+def _p_term(node: TermFilterNode, pctx: _PlanCtx):
+    env, Q = pctx.env, pctx.Q
+    f = node.field_name
+    if f in env.mixed:
+        raise _Unsupported(f"mixed field [{f}]")
+    boost = float(node.boost)
+    V = max((len(v) for v in node.values_per_query), default=1) or 1
+    if f in env.keywords:
+        pctx.use_field(f, "keyword")
+        targets = np.full((pctx.s, pctx.g_pad, Q, V), -2, np.int64)
+        for si, rows in enumerate(pctx.shard_rows):
+            for gi, seg in enumerate(rows):
+                kc = seg.keywords.get(f)
+                if kc is None:
+                    continue
+                for qi, vals in enumerate(node.values_per_query):
+                    for vi, v in enumerate(vals):
+                        o = kc.ord_of(str(v))
+                        if o >= 0:
+                            targets[si, gi, qi, vi] = o
+        pctx.emit(targets, OP_SG)
+
+        def dev(d: _BlkCtx):
+            col = d.slice_docs(d.fields[f].ords).astype(jnp.int64)
+            tg = d.pop()
+            match = (col[:, None, :, None]
+                     == tg[:, :, None, :]).any(axis=3)
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("term_kw", f, boost), dev
+
+    if f in env.numerics:
+        pctx.use_field(f, "numeric")
+        if env.num_dtype(f) == "f64":
+            tf64 = np.full((Q, V), np.nan)
+            for qi, vals in enumerate(node.values_per_query):
+                for vi, v in enumerate(vals):
+                    tf64[qi, vi] = float(v)
+            pctx.emit(tf64, OP_Q)
+
+            def dev(d: _BlkCtx):
+                num = d.fields[f]
+                tq = d.pop()
+                vals_b = d.slice_docs(num.vals)
+                match = (vals_b[:, None, :, None]
+                         == tq[None, :, None, :]).any(axis=3)
+                match = match & ~d.slice_docs(num.missing)[:, None, :]
+                return jnp.where(match, boost, 0.0), match
+            return ("term_f64", f, boost), dev
+        targets = np.full((Q, V), np.iinfo(np.int64).min, np.int64)
+        for qi, vals in enumerate(node.values_per_query):
+            for vi, v in enumerate(vals):
+                targets[qi, vi] = _coerce_to_column(v, None)
+        pctx.emit(targets, OP_Q)
+
+        def dev(d: _BlkCtx):
+            num = d.fields[f]
+            tq = d.pop()
+            vals_b = d.slice_docs(num.vals)
+            match = (vals_b[:, None, :, None]
+                     == tq[None, :, None, :]).any(axis=3)
+            match = match & ~d.slice_docs(num.missing)[:, None, :]
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("term_i64", f, boost), dev
+
+    if f in env.text:
+        sub = MatchNode(boost=node.boost, field_name=f,
+                        terms_per_query=[[str(v) for v in vals]
+                                         for vals in node.values_per_query])
+        sig, dev = _p_match(sub, pctx)
+        return ("term_text", sig), dev
+    return (("term_absent",), lambda d: (d.zeros(), d.false()))
+
+
+def _p_range(node: RangeNode, pctx: _PlanCtx):
+    env, Q = pctx.env, pctx.Q
+    f = node.field_name
+    if f in env.mixed:
+        raise _Unsupported(f"mixed field [{f}]")
+    boost = float(node.boost)
+    if f in env.numerics:
+        pctx.use_field(f, "numeric")
+        if env.num_dtype(f) == "i64":
+            lo_fill, hi_fill = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+            dt = np.int64
+        else:
+            lo_fill, hi_fill = -np.inf, np.inf
+            dt = np.float64
+        los = np.full(Q, lo_fill, dt)
+        his = np.full(Q, hi_fill, dt)
+        for qi, (lo, hi, inc_lo, inc_hi) in enumerate(node.bounds_per_query):
+            if lo is not None:
+                los[qi] = lo if inc_lo else _next_up(lo, dt)
+            if hi is not None:
+                his[qi] = hi if inc_hi else _next_down(hi, dt)
+        pctx.emit(los, OP_Q)
+        pctx.emit(his, OP_Q)
+
+        def dev(d: _BlkCtx):
+            num = d.fields[f]
+            lo_b, hi_b = d.pop(), d.pop()
+            vals_b = d.slice_docs(num.vals)
+            match = (vals_b[:, None, :] >= lo_b[None, :, None]) \
+                & (vals_b[:, None, :] <= hi_b[None, :, None]) \
+                & ~d.slice_docs(num.missing)[:, None, :]
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("range_num", f, env.num_dtype(f), boost), dev
+
+    if f in env.keywords:
+        pctx.use_field(f, "keyword")
+        los = np.zeros((pctx.s, pctx.g_pad, Q), np.int32)
+        his = np.full((pctx.s, pctx.g_pad, Q), -1, np.int32)
+        for si, rows in enumerate(pctx.shard_rows):
+            for gi, seg in enumerate(rows):
+                kc = seg.keywords.get(f)
+                if kc is None:
+                    continue
+                his[si, gi, :] = len(kc.values) - 1
+                for qi, (lo, hi, inc_lo, inc_hi) \
+                        in enumerate(node.bounds_per_query):
+                    if lo is not None:
+                        i = _bisect(kc.values, str(lo), left=True)
+                        if not inc_lo and i < len(kc.values) \
+                                and kc.values[i] == str(lo):
+                            i += 1
+                        los[si, gi, qi] = i
+                    if hi is not None:
+                        i = _bisect(kc.values, str(hi), left=False) - 1
+                        if not inc_hi and i >= 0 and kc.values[i] == str(hi):
+                            i -= 1
+                        his[si, gi, qi] = i
+        pctx.emit(los, OP_SG)
+        pctx.emit(his, OP_SG)
+
+        def dev(d: _BlkCtx):
+            ords = d.slice_docs(d.fields[f].ords)
+            lo_b, hi_b = d.pop(), d.pop()
+            match = (ords[:, None, :] >= lo_b[:, :, None]) \
+                & (ords[:, None, :] <= hi_b[:, :, None]) \
+                & (ords[:, None, :] >= 0)
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("range_kw", f, boost), dev
+    return (("range_absent",), lambda d: (d.zeros(), d.false()))
+
+
+def _p_exists(node: ExistsNode, pctx: _PlanCtx):
+    env = pctx.env
+    f = node.field_name
+    if f in env.mixed:
+        raise _Unsupported(f"mixed field [{f}]")
+    boost = float(node.boost)
+    if f in env.numerics:
+        pctx.use_field(f, "numeric")
+
+        def dev(d: _BlkCtx):
+            miss = d.slice_docs(d.fields[f].missing)
+            match = jnp.broadcast_to(~miss[:, None, :],
+                                     (d.g_pad, d.Q, d.block))
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("exists_num", f, boost), dev
+    if f in env.keywords:
+        pctx.use_field(f, "keyword")
+
+        def dev(d: _BlkCtx):
+            ords = d.slice_docs(d.fields[f].ords)
+            match = jnp.broadcast_to((ords >= 0)[:, None, :],
+                                     (d.g_pad, d.Q, d.block))
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("exists_kw", f, boost), dev
+    if f in env.text:
+        # presence column built host-side once ([S, G, N] bool): a doc
+        # "has" a text field iff any posting references it — the same
+        # boolean set the device scatter produces, sliced per block
+        pres = np.zeros((pctx.s, pctx.g_pad, pctx.n_pad), bool)
+        for si, rows in enumerate(pctx.shard_rows):
+            for gi, seg in enumerate(rows):
+                fx = seg.text.get(f)
+                if fx is None or not fx.n_postings:
+                    continue
+                dh = fx.doc_ids_host if fx.doc_ids_host is not None \
+                    else np.asarray(fx.doc_ids)
+                docs = dh[: fx.n_postings]
+                pres[si, gi, docs[docs < pctx.n_pad]] = True
+        pctx.emit(pres, OP_COL)
+
+        def dev(d: _BlkCtx):
+            hits = d.pop()                      # [G, block]
+            match = jnp.broadcast_to(hits[:, None, :],
+                                     (d.g_pad, d.Q, d.block))
+            return jnp.where(match, jnp.float32(boost), 0.0), match
+        return ("exists_text", f, boost), dev
+    return (("exists_absent",), lambda d: (d.zeros(), d.false()))
+
+
+def _p_ids(node: IdsNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    mask = np.zeros((pctx.s, pctx.g_pad, pctx.Q, pctx.n_pad), bool)
+    for si, rows in enumerate(pctx.shard_rows):
+        for gi, seg in enumerate(rows):
+            for qi, ids in enumerate(node.ids_per_query):
+                for i in ids:
+                    local = seg.id_to_local.get(i)
+                    if local is not None:
+                        mask[si, gi, qi, local] = True
+    pctx.emit(mask, OP_COLQ)
+
+    def dev(d: _BlkCtx):
+        match = d.pop()
+        return jnp.where(match, jnp.float32(boost), 0.0), match
+    return ("ids", boost), dev
+
+
+def _p_match_all(node: MatchAllNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    return ("match_all", boost), (lambda d: (
+        jnp.full((d.g_pad, d.Q, d.block), boost, jnp.float32), d.true()))
+
+
+def _p_match_none(node: MatchNoneNode, pctx: _PlanCtx):
+    return ("match_none",), (lambda d: (d.zeros(), d.false()))
+
+
+# -- structural handlers -----------------------------------------------------
+
+def _p_bool(node: BoolNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    any_positive = bool(node.must or node.filter)
+    musts = [_plan_exec(n, pctx) for n in node.must]
+    # filters use the node's EXECUTE match (BoolNode.execute's contract —
+    # the mask fast path only serves filter CONTEXT via _pm_bool)
+    filters = [_plan_exec(n, pctx) for n in node.filter]
+    msm = node.minimum_should_match
+    if node.should and msm is None:
+        msm = 0 if any_positive else 1
+    shoulds = [_plan_exec(n, pctx) for n in node.should]
+    must_nots = [_plan_exec(n, pctx) for n in node.must_not]
+    sig = ("bool", boost, msm, tuple(s for s, _ in musts),
+           tuple(s for s, _ in filters), tuple(s for s, _ in shoulds),
+           tuple(s for s, _ in must_nots))
+
+    def dev(d: _BlkCtx):
+        scores = d.zeros()
+        match = d.true()
+        for _s, fn in musts:
+            s, m = fn(d)
+            scores = scores + s
+            match = match & m
+        for _s, fn in filters:
+            _, m = fn(d)
+            match = match & m
+        if shoulds:
+            should_count = jnp.zeros((d.g_pad, d.Q, d.block), jnp.int32)
+            for _s, fn in shoulds:
+                s, m = fn(d)
+                scores = scores + jnp.where(m, s, 0.0)
+                should_count = should_count + m.astype(jnp.int32)
+            if msm > 0:
+                match = match & (should_count >= msm)
+        for _s, fn in must_nots:
+            _, m = fn(d)
+            match = match & ~m
+        return jnp.where(match, scores * boost, 0.0), match
+
+    return sig, dev
+
+
+def _pm_bool(node: BoolNode, pctx: _PlanCtx):
+    pos = [_plan_match(n, pctx) for n in node.must + node.filter]
+    msm = node.minimum_should_match
+    if node.should and msm is None:
+        msm = 0 if (node.must or node.filter) else 1
+    shoulds = [_plan_match(n, pctx) for n in node.should] \
+        if node.should and msm is not None and msm >= 1 else []
+    must_nots = [_plan_match(n, pctx) for n in node.must_not]
+    sig = ("m_bool", msm, tuple(s for s, _ in pos),
+           tuple(s for s, _ in shoulds), tuple(s for s, _ in must_nots))
+
+    def dev(d: _BlkCtx):
+        match = d.true()
+        for _s, fn in pos:
+            match = match & fn(d)
+        if shoulds:
+            if msm == 1:
+                any_should = d.false()
+                for _s, fn in shoulds:
+                    any_should = any_should | fn(d)
+                match = match & any_should
+            else:
+                cnt = jnp.zeros((d.g_pad, d.Q, d.block), jnp.int32)
+                for _s, fn in shoulds:
+                    cnt = cnt + fn(d).astype(jnp.int32)
+                match = match & (cnt >= msm)
+        for _s, fn in must_nots:
+            match = match & ~fn(d)
+        return match
+
+    return sig, dev
+
+
+def _p_const(node: ConstantScoreNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    sig, fn = _plan_match(node.inner, pctx)
+
+    def dev(d: _BlkCtx):
+        m = fn(d)
+        return jnp.where(m, jnp.float32(boost), 0.0), m
+    return ("const", boost, sig), dev
+
+
+def _pm_const(node: ConstantScoreNode, pctx: _PlanCtx):
+    sig, fn = _plan_match(node.inner, pctx)
+    return ("m_const", sig), fn
+
+
+def _p_dis_max(node: DisMaxNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    tie = float(node.tie_breaker)
+    subs = [_plan_exec(n, pctx) for n in node.queries]
+    sig = ("dis_max", boost, tie, tuple(s for s, _ in subs))
+
+    def dev(d: _BlkCtx):
+        best = d.zeros()
+        total = d.zeros()
+        match = d.false()
+        for _s, fn in subs:
+            s, m = fn(d)
+            s = jnp.where(m, s, 0.0)
+            best = jnp.maximum(best, s)
+            total = total + s
+            match = match | m
+        scores = best + tie * (total - best)
+        return jnp.where(match, scores * boost, 0.0), match
+    return sig, dev
+
+
+def _p_boosting(node: BoostingNode, pctx: _PlanCtx):
+    boost = float(node.boost)
+    nb_ = float(node.negative_boost)
+    psig, pfn = _plan_exec(node.positive, pctx)
+    nsig, nfn = _plan_exec(node.negative, pctx)
+    sig = ("boosting", boost, nb_, psig, nsig)
+
+    def dev(d: _BlkCtx):
+        s, m = pfn(d)
+        _, nm = nfn(d)
+        s = jnp.where(nm, s * nb_, s)
+        return jnp.where(m, s * boost, 0.0), m
+    return sig, dev
+
+
+_P_EXEC = {
+    MatchAllNode: _p_match_all,
+    MatchNoneNode: _p_match_none,
+    MatchNode: _p_match,
+    TermFilterNode: _p_term,
+    RangeNode: _p_range,
+    ExistsNode: _p_exists,
+    IdsNode: _p_ids,
+    BoolNode: _p_bool,
+    ConstantScoreNode: _p_const,
+    DisMaxNode: _p_dis_max,
+    BoostingNode: _p_boosting,
+}
+
+_P_MATCH = {
+    MatchNode: _pm_match,
+    BoolNode: _pm_bool,
+    ConstantScoreNode: _pm_const,
+}
+
+
+def _plan_exec(node: Node, pctx: _PlanCtx):
+    h = _P_EXEC.get(type(node))
+    if h is None:
+        raise _Unsupported(type(node).__name__)
+    return h(node, pctx)
+
+
+def _plan_match(node: Node, pctx: _PlanCtx):
+    h = _P_MATCH.get(type(node))
+    if h is None:
+        sig, fn = _plan_exec(node, pctx)
+        return ("xm", sig), (lambda d: fn(d)[1])
+    return h(node, pctx)
+
+
+def plan_types_supported(node: Node) -> bool:
+    """Cheap pre-flight: every node in the tree has a typed blockwise
+    handler (field-shape checks happen at plan time)."""
+    t = type(node)
+    if t is BoolNode:
+        return all(plan_types_supported(n) for n in
+                   node.must + node.filter + node.should + node.must_not)
+    if t is ConstantScoreNode:
+        return plan_types_supported(node.inner)
+    if t is DisMaxNode:
+        return all(plan_types_supported(n) for n in node.queries)
+    if t is BoostingNode:
+        return plan_types_supported(node.positive) \
+            and plan_types_supported(node.negative)
+    return t in _P_EXEC
+
+
+# ---------------------------------------------------------------------------
+# The plan + the scan core
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BlockPlan:
+    sig: tuple
+    devfn: object
+    field_kinds: tuple               # ((name, kind), ...)
+    op_kinds: tuple
+    ops: list                        # host arrays aligned with op_kinds
+    g_pad: int
+    n_pad: int
+    block: int
+    nb: int
+    n_queries: int
+
+
+def plan(node: Node, shard_rows, env: FieldEnv, *, g_pad: int, n_pad: int,
+         block: int, n_queries: int, stats) -> BlockPlan | None:
+    """Plan the tree for blockwise execution, or None when any node/field
+    shape lacks a typed handler (callers fall back to the materializing
+    executor). Requires block | n_pad (both pow2, n_pad > block)."""
+    if n_pad <= block or n_pad % block:
+        return None
+    pctx = _PlanCtx(shard_rows, env, g_pad=g_pad, n_pad=n_pad, block=block,
+                    n_queries=n_queries, stats=stats)
+    try:
+        sig, devfn = _plan_exec(node, pctx)
+    except _Unsupported:
+        return None
+    return BlockPlan(sig=sig, devfn=devfn,
+                     field_kinds=tuple(pctx.fields.items()),
+                     op_kinds=tuple(k for _a, k in pctx.ops),
+                     ops=[a for a, _k in pctx.ops],
+                     g_pad=g_pad, n_pad=n_pad, block=block, nb=pctx.nb,
+                     n_queries=n_queries)
+
+
+def _block_ops(ops, op_kinds, xi, base, block):
+    """Resolve the operand stream for one block: OP_X entries come from the
+    scan's xs slice, OP_COL/OP_COLQ slice at the block, the rest pass."""
+    vals = []
+    for v, kind in zip(ops, op_kinds):
+        if kind == OP_X:
+            vals.append(next(xi))
+        elif kind in (OP_COL, OP_COLQ):
+            vals.append(lax.dynamic_slice_in_dim(v, base, block,
+                                                 axis=v.ndim - 1))
+        else:
+            vals.append(v)
+    return vals
+
+
+def run_scan(devfn, fields: dict, ops: list, op_kinds, live, *, g_pad: int,
+             block: int, nb: int, n_queries: int, kk: int, score_dtype,
+             want_mask: bool = False):
+    """Execute the planned tree blockwise under trace (inside an outer jit
+    or a shard_map body). `live` is bool[G, N]; `ops` are shard-local
+    values aligned with `op_kinds` (OP_X entries keep their [NB, ...]
+    leading axis — they become the scan's xs).
+
+    -> (top [G,Q,kk], idx i32[G,Q,kk] global doc indices, total i64[Q],
+    mx [Q][, mask bool[G, N] when want_mask — query row 0's gated match,
+    stacked from the per-block ys])."""
+    xs_ops = [v for v, k in zip(ops, op_kinds) if k == OP_X]
+    kb = min(kk, block)
+
+    def body(carry, x):
+        top_s, top_i, total, mx = carry
+        b_idx = x[0]
+        xi = iter(x[1:])
+        base = (b_idx * block).astype(jnp.int32)
+        vals = _block_ops(ops, op_kinds, xi, base, block)
+        d = _BlkCtx(fields, vals, g_pad, block, n_queries, base)
+        scores, match = devfn(d)
+        live_b = lax.dynamic_slice_in_dim(live, base, block, axis=1)
+        m = match & live_b[:, None, :]
+        total = total + jnp.sum(m, axis=(0, 2), dtype=jnp.int64)
+        masked = jnp.where(m, scores, -jnp.inf)
+        mx = jnp.maximum(mx, masked.max(axis=(0, 2)))
+        t, i = lax.top_k(masked, kb)
+        gi = base + i.astype(jnp.int32)
+        top_s, top_i = merge_running_topk(top_s, top_i, t, gi, k=kk)
+        return (top_s, top_i, total, mx), (m[:, 0, :] if want_mask else None)
+
+    init = (jnp.full((g_pad, n_queries, kk), -jnp.inf, score_dtype),
+            jnp.full((g_pad, n_queries, kk), -1, jnp.int32),
+            jnp.zeros((n_queries,), jnp.int64),
+            jnp.full((n_queries,), -jnp.inf, score_dtype))
+    (top_s, top_i, total, mx), ys = lax.scan(
+        body, init, (jnp.arange(nb), *xs_ops))
+    if want_mask:
+        mask = jnp.moveaxis(ys, 0, 1).reshape(g_pad, nb * block)
+        return top_s, top_i, total, mx, mask
+    return top_s, top_i, total, mx
+
+
+def probe_score_dtype(bplan: BlockPlan, fields: dict):
+    """Abstract-evaluate one block (jax.eval_shape — zero device work) to
+    learn the tree's score dtype: trees over f64 columns promote exactly
+    like the materializing executor, and the scan carry must match."""
+    flat_specs = tuple(
+        jax.ShapeDtypeStruct(a.shape, a.dtype)
+        for a in flatten_fields(bplan.field_kinds, fields))
+    op_specs = []
+    for v, kind in zip(bplan.ops, bplan.op_kinds):
+        a = np.asarray(v)
+        # shard-local, one-block shapes: drop the S axis (and the NB axis
+        # for scan operands; doc columns slice to the block width)
+        if kind == OP_X:
+            shape = a.shape[2:]
+        elif kind == OP_SG:
+            shape = a.shape[1:]
+        elif kind in (OP_COL, OP_COLQ):
+            shape = (*a.shape[1:-1], bplan.block)
+        else:
+            shape = a.shape
+        op_specs.append(jax.ShapeDtypeStruct(shape, jnp.asarray(a).dtype))
+
+    def probe(flat, vals):
+        d = _BlkCtx(rebuild_fields(bplan.field_kinds, flat), list(vals),
+                    bplan.g_pad, bplan.block, bplan.n_queries,
+                    jnp.int32(0))
+        s, _m = bplan.devfn(d)
+        return s
+
+    return jax.eval_shape(probe, flat_specs, tuple(op_specs)).dtype
+
+
+# ---------------------------------------------------------------------------
+# Lane runners: loop (G=1 per segment) and stacked (shard's SegmentStack)
+# ---------------------------------------------------------------------------
+
+def _strip_shard(ops, op_kinds):
+    """The loop/stacked runners plan with S=1 — drop the shard axis from
+    the sharded kinds so shapes match the shard-local devfns."""
+    out = []
+    for v, kind in zip(ops, op_kinds):
+        out.append(v[0] if kind in (OP_X, OP_SG, OP_COL, OP_COLQ) else v)
+    return out
+
+
+def _jit_program(devfn, field_kinds, op_kinds, *, g_pad, block, nb,
+                 n_queries, kk, k, score_dtype, encode_keys, want_mask):
+    nf = n_field_arrays(field_kinds)
+
+    def prog(live, seg_ids, *flat):
+        fields = rebuild_fields(field_kinds, flat[:nf])
+        ops = list(flat[nf:])
+        out = run_scan(devfn, fields, ops, op_kinds, live, g_pad=g_pad,
+                       block=block, nb=nb, n_queries=n_queries, kk=kk,
+                       score_dtype=score_dtype, want_mask=want_mask)
+        top_s, top_i, total, mx = out[:4]
+        extra = out[4:]
+        if not encode_keys:                  # loop lane: G == 1
+            return (top_s[0], top_i[0], total, mx, *extra)
+        # cross-segment merge — stacked.stacked_reduce's tail verbatim
+        keys = jnp.where(top_s > -jnp.inf,
+                         (seg_ids[:, None, None] << SEG_SHIFT)
+                         | top_i.astype(jnp.int64),
+                         jnp.int64(-1))
+        Qn = top_s.shape[1]
+        cand_s = jnp.moveaxis(top_s, 0, 1).reshape(Qn, -1)
+        cand_k = jnp.moveaxis(keys, 0, 1).reshape(Qn, -1)
+        best, pos = lax.top_k(cand_s, min(k, cand_s.shape[1]))
+        return (jnp.take_along_axis(cand_k, pos, axis=1), best, total, mx,
+                *extra)
+
+    return jax.jit(prog)
+
+
+def _program_for(lane: str, bplan: BlockPlan, *, k: int, kk: int,
+                 score_dtype, encode_keys: bool, want_mask: bool):
+    key = (lane, bplan.sig, bplan.field_kinds, bplan.op_kinds, bplan.g_pad,
+           bplan.n_pad, bplan.block, bplan.n_queries, k, kk,
+           str(score_dtype), encode_keys, want_mask)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = _jit_program(bplan.devfn, bplan.field_kinds, bplan.op_kinds,
+                            g_pad=bplan.g_pad, block=bplan.block,
+                            nb=bplan.nb, n_queries=bplan.n_queries, kk=kk,
+                            k=k, score_dtype=score_dtype,
+                            encode_keys=encode_keys, want_mask=want_mask)
+        _PROGRAMS.put(key, prog, weight=1)
+    return prog
+
+
+def _segment_fields(seg: Segment, field_kinds) -> dict:
+    """G=1 shard-local field views over one segment (reshapes, no copies)."""
+    out = {}
+    for name, kind in field_kinds:
+        if kind == "text":
+            fx = seg.text[name]
+            out[name] = BTextField(fx.doc_ids[None], fx.tf[None],
+                                   fx.doc_len[None])
+        elif kind == "keyword":
+            out[name] = BKeywordField(seg.keywords[name].ords[None])
+        else:
+            nc = seg.numerics[name]
+            out[name] = BNumericField(nc.vals[None], nc.missing[None])
+    return out
+
+
+def execute_loop_segment(node: Node, seg: Segment, *, n_queries: int,
+                         stats, k: int, block: int, want_mask: bool):
+    """One segment of the per-segment loop, blockwise: device values
+    (top [Q,kk], idx i32[Q,kk], total i64[Q], mx [Q][, mask bool[n_pad]])
+    — the exact values the materializing loop fetches per segment — or
+    None when the plan declines (caller materializes)."""
+    bplan = plan(node, ((seg,),), FieldEnv.from_segments([seg]),
+                 g_pad=1, n_pad=seg.n_pad, block=block,
+                 n_queries=n_queries, stats=stats)
+    if bplan is None:
+        return None
+    fields = _segment_fields(seg, bplan.field_kinds)
+    score_dtype = probe_score_dtype(bplan, fields)
+    kk = min(k, seg.n_pad)
+    prog = _program_for("loop", bplan, k=k, kk=kk, score_dtype=score_dtype,
+                        encode_keys=False, want_mask=want_mask)
+    from ..common.metrics import note_h2d
+    ops = _strip_shard(bplan.ops, bplan.op_kinds)
+    note_h2d(sum(int(np.asarray(a).nbytes) for a in ops))
+    flat = flatten_fields(bplan.field_kinds, fields)
+    out = prog(seg.live[None, :], jnp.zeros((1,), jnp.int64), *flat, *ops)
+    if want_mask:
+        top, idx, total, mx, mask = out
+        return top, idx, total, mx, mask[0]
+    return out
+
+
+def execute_stacked(stack, node: Node, *, n_queries: int, stats, k: int,
+                    block: int, want_mask: bool):
+    """The stacked lane, blockwise: same outputs as stacked.stacked_reduce
+    (keys i64[Q,k'], top [Q,k'], total i64[Q], mx [Q][, mask bool[G, N]]),
+    never materializing [G, Q, N]. None when the plan declines."""
+    env = FieldEnv(set(stack.text), set(stack.keywords),
+                   set(stack.numerics), stack.mixed,
+                   lambda f: stack.numerics[f].dtype)
+    bplan = plan(node, (stack.segments,), env, g_pad=stack.g_pad,
+                 n_pad=stack.n_pad, block=block, n_queries=n_queries,
+                 stats=stats)
+    if bplan is None:
+        return None
+    fields = {}
+    for name, kind in bplan.field_kinds:
+        if kind == "text":
+            sf = stack.text[name]
+            fields[name] = BTextField(sf.doc_ids, sf.tf, sf.doc_len)
+        elif kind == "keyword":
+            fields[name] = BKeywordField(stack.keywords[name].ords)
+        else:
+            nf = stack.numerics[name]
+            fields[name] = BNumericField(nf.vals, nf.missing)
+    score_dtype = probe_score_dtype(bplan, fields)
+    kk = min(k, stack.n_pad)
+    prog = _program_for("stacked", bplan, k=k, kk=kk,
+                        score_dtype=score_dtype, encode_keys=True,
+                        want_mask=want_mask)
+    from ..common.metrics import note_h2d
+    ops = _strip_shard(bplan.ops, bplan.op_kinds)
+    note_h2d(sum(int(np.asarray(a).nbytes) for a in ops))
+    flat = flatten_fields(bplan.field_kinds, fields)
+    return prog(stack.live_stack(), stack.seg_ids_dev, *flat, *ops)
+
+
+def program_cache_stats() -> dict:
+    return _PROGRAMS.stats()

@@ -33,6 +33,9 @@ from .query_parser import QueryParser, merge_query_batch
 
 SEG_SHIFT = 32
 LOCAL_MASK = (1 << 32) - 1
+# one dense execution's peak per-(query, doc)-slot residency: f32 scores +
+# bool match — the request-breaker charge unit for score matrices
+SCORE_SLOT_BYTES = 5
 
 
 @jax.jit
@@ -69,7 +72,9 @@ class ShardSearcher:
     def __init__(self, shard_id: int, segments: Sequence[Segment],
                  mappers: MapperService, stats: dict | None = None,
                  stack_cache=None, index_name: str | None = None,
-                 incarnation: int = 0, stacked: bool = True):
+                 incarnation: int = 0, stacked: bool = True,
+                 blockwise: bool = True, block_docs: int | None = None,
+                 request_breaker=None):
         self.shard_id = shard_id
         self.segments = list(segments)
         self.mappers = mappers
@@ -84,6 +89,10 @@ class ShardSearcher:
         self.last_query_path: str | None = None
         # dense-lane mode of the last dense query: "stacked" | "loop"
         self.last_dense_mode: str | None = None
+        # score-materialization mode of the last dense query:
+        # "blockwise" (running on-device top-k, O(Q x block) peak score
+        # memory) | "materialized" (full [Q, n_pad] tensors)
+        self.last_block_mode: str | None = None
         self.sparse_queries = 0
         self.dense_queries = 0
         self._path_stats = stats if stats is not None else {}
@@ -97,9 +106,48 @@ class ShardSearcher:
         self.index_name = index_name
         self.incarnation = incarnation
         self._stack_memo = None          # False = build declined/failed
+        # streaming blockwise dense execution (search/blockwise.py):
+        # engages per segment/stack when its doc axis exceeds one block;
+        # single-block shapes keep the materializing executor (zero
+        # overhead for small corpora)
+        from .blockwise import DEFAULT_BLOCK_DOCS
+        from ..index.segment import next_pow2
+        self.blockwise_enabled = bool(blockwise)
+        self.block_docs = next_pow2(
+            max(int(block_docs or DEFAULT_BLOCK_DOCS), 8), floor=8)
+        # lane-accurate score-matrix accounting charges here ("request"
+        # breaker): [Q, block] on the blockwise lane, [Q, n_pad] on the
+        # materializing one — charged before execution, released after
+        self.request_breaker = request_breaker
 
     def _bump(self, key: str, n: int = 1) -> None:
         self._path_stats[key] = self._path_stats.get(key, 0) + n
+
+    # -- lane-accurate score-matrix accounting (ISSUE 8 satellite) ---------
+
+    def _charge_scores(self, n_bytes: int) -> int:
+        """Charge the dense execution's peak score+match residency to the
+        `request` breaker BEFORE the device program runs: [Q, block] bytes
+        on the blockwise lane, [Q, n_pad] on the materializing one. The
+        peak gauge records either way. The request breaker is the
+        EVICTABLE tier (common/breaker.py): a breach counts a trip and
+        FORCE-charges — accounting stays truthful for the memory that is
+        about to exist — instead of failing the search; there is no
+        cheaper lane below blockwise to degrade to."""
+        from ..common.breaker import CircuitBreakingException
+        from ..common.metrics import record_score_matrix_bytes
+        record_score_matrix_bytes(n_bytes)
+        if self.request_breaker is not None:
+            try:
+                self.request_breaker.add_estimate(n_bytes)
+            except CircuitBreakingException:
+                self.request_breaker.add_estimate(n_bytes, check=False)
+            return n_bytes
+        return 0
+
+    def _release_scores(self, n_bytes: int) -> None:
+        if n_bytes and self.request_breaker is not None:
+            self.request_breaker.release(n_bytes)
 
     # -- statistics (DFS support, ref search/dfs/DfsPhase.java:57-81) ------
 
@@ -215,12 +263,25 @@ class ShardSearcher:
 
         self.last_query_path = "dense"
         self.last_dense_mode = "loop"
+        self.last_block_mode = "materialized"
         self.dense_queries += 1
         self._bump("dense")
         prof_path = current_profiler()
         if prof_path is not None:
             prof_path.note_path("dense")
         stats = self.build_stats(node, global_stats)
+
+        # streaming blockwise eligibility (search/blockwise.py): unsorted
+        # queries over segments wider than one block run the tree inside a
+        # lax.scan with a running top-k — peak score memory O(Q × block).
+        # top_hits aggs need the full per-doc score row, so they keep the
+        # materializing executor; single-block segments take the identity
+        # fast path below (n_pad <= block never plans).
+        blockwise_ok = (sort is None and self.blockwise_enabled
+                        and search_after is None)
+        if blockwise_ok and aggs is not None:
+            from .aggs.aggregators import has_top_hits
+            blockwise_ok = not has_top_hits(aggs)
 
         best_scores = np.full((Q, k), -np.inf, np.float32)
         best_keys = np.full((Q, k), -1, np.int64)
@@ -236,80 +297,125 @@ class ShardSearcher:
 
         for seg_idx, seg in self.live_segments:
             self._bump("segment_dispatches")
-            ctx = SegmentContext(seg, Q, stats)
-            scores, match = node.execute(ctx)
-            match = match & seg.live[None, :]
-            if aggs is not None:
-                agg_segments.append(seg)
-                agg_masks.append(match[0])   # stays device-resident
-                agg_scores.append(scores[0])  # top_hits ranks with these
             kk = min(k, seg.n_pad)
-            # totals/aggs reflect the full query match set — search_after
-            # narrows collection below, not the hit count (ref QueryPhase).
-            # All of this segment's device results come down in ONE fetch:
-            # a tunneled chip pays one RTT per segment, not one per array.
-            fetch: dict = {"total": topk_ops.count_matches(match)}
-            if track_scores:
-                # mask + max ON DEVICE — downloading the [Q, N] score and
-                # match matrices to host cost ~0.5 GB per 64-query batch at
-                # 1M docs over a tunneled chip (bench r5 agg leg: 0.75 QPS)
-                fetch["mx"] = _masked_rowmax(scores, match)
-            if sort is None:
-                top_d, idx_d = topk_ops.topk_scores(scores, match, k=kk)
-                fetch["top"] = top_d
-                fetch["idx"] = idx_d
-            got = device_fetch(fetch)
-            n_fetches += 1
-            total += got["total"]
-            if track_scores:
-                max_score = np.maximum(max_score, got["mx"])
-            if sort is None:
-                top, idx = got["top"], got["idx"]
-                seg_keys = np.where(top > -np.inf,
-                                    (np.int64(seg_idx) << SEG_SHIFT) | idx.astype(np.int64),
-                                    np.int64(-1))
-                merged = np.concatenate([best_scores, top], axis=1)
-                merged_keys = np.concatenate([best_keys, seg_keys], axis=1)
-                order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
-                best_scores = np.take_along_axis(merged, order, axis=1)
-                best_keys = np.take_along_axis(merged_keys, order, axis=1)
-            else:
-                # device selection: lexicographic top-k over f64 comparator
-                # keys (keyword keys = this segment's sorted ordinals)
-                keys = sort_mod.segment_keys(seg, sort, scores, Q, seg_idx,
-                                             self.shard_id)
-                if search_after is not None:
-                    match = match & sort_mod.after_mask(
-                        seg, sort, search_after, keys)
-                primary = jnp.where(match, keys[0], jnp.inf)
-                doc_idx = jnp.broadcast_to(
-                    jnp.arange(seg.n_pad, dtype=jnp.float64)[None, :],
-                    primary.shape)
-                # lexsort: LAST key is the primary; doc index breaks ties
-                order = jnp.lexsort(
-                    tuple([doc_idx] + list(reversed(keys[1:])) + [primary]))
-                # top-kk selection stays ON DEVICE: downloading the full
-                # [Q, n_pad] match/score matrices cost O(corpus) transfer
-                # per sorted batch (25 MB at 100k docs x 64 q) — gather at
-                # the winning positions first, then ONE small fetch
-                order = order[:, :kk].astype(jnp.int32)
-                sel_match_d = jnp.take_along_axis(match, order, axis=1)
-                sel_scores_d = jnp.take_along_axis(scores, order, axis=1)
-                order, sel_match, sel_scores = device_fetch(
-                    (order, sel_match_d, sel_scores_d))
+            charged = 0
+            fetch: dict = {}
+            try:
+                blk = None
+                if blockwise_ok and seg.n_pad > self.block_docs:
+                    # charge the BLOCKWISE estimate first; a declined plan
+                    # releases it and re-charges the materializing one —
+                    # accounting stays lane-accurate either way
+                    charged = self._charge_scores(
+                        Q * self.block_docs * SCORE_SLOT_BYTES)
+                    from . import blockwise as blockwise_mod
+                    blk = blockwise_mod.execute_loop_segment(
+                        node, seg, n_queries=Q, stats=stats, k=k,
+                        block=self.block_docs, want_mask=aggs is not None)
+                    if blk is None:
+                        self._release_scores(charged)
+                        charged = 0
+                if blk is not None:
+                    self.last_block_mode = "blockwise"
+                    self._bump("blockwise_dispatches")
+                    if aggs is not None:
+                        top_d, idx_d, total_d, mx_d, mask_d = blk
+                        agg_segments.append(seg)
+                        agg_masks.append(mask_d)   # row 0, liveness-gated
+                        agg_scores.append(None)    # no top_hits on blocks
+                    else:
+                        top_d, idx_d, total_d, mx_d = blk
+                    fetch = {"total": total_d, "top": top_d, "idx": idx_d}
+                    if track_scores:
+                        fetch["mx"] = mx_d
+                else:
+                    charged = charged or self._charge_scores(
+                        Q * seg.n_pad * SCORE_SLOT_BYTES)
+                    ctx = SegmentContext(seg, Q, stats)
+                    scores, match = node.execute(ctx)
+                    match = match & seg.live[None, :]
+                    if aggs is not None:
+                        agg_segments.append(seg)
+                        agg_masks.append(match[0])   # stays device-resident
+                        agg_scores.append(scores[0])  # top_hits ranks these
+                    # totals/aggs reflect the full query match set —
+                    # search_after narrows collection below, not the hit
+                    # count (ref QueryPhase). All of this segment's device
+                    # results come down in ONE fetch: a tunneled chip pays
+                    # one RTT per segment, not one per array.
+                    fetch = {"total": topk_ops.count_matches(match)}
+                    if track_scores:
+                        # mask + max ON DEVICE — downloading the [Q, N]
+                        # score and match matrices to host cost ~0.5 GB per
+                        # 64-query batch at 1M docs over a tunneled chip
+                        fetch["mx"] = _masked_rowmax(scores, match)
+                    if sort is None:
+                        top_d, idx_d = topk_ops.topk_scores(scores, match,
+                                                            k=kk)
+                        fetch["top"] = top_d
+                        fetch["idx"] = idx_d
+                got = device_fetch(fetch)
                 n_fetches += 1
-                for qi in range(Q):
-                    for j in range(kk):
-                        if not sel_match[qi, j]:
-                            continue
-                        local = int(order[qi, j])
-                        dk = (seg_idx << SEG_SHIFT) | local
-                        sc = float(sel_scores[qi, j])
-                        vals = sort_mod.materialize(seg, sort, local, sc, dk,
-                                                    self.shard_id)
-                        cands[qi].append(
-                            (sort_mod.compare_key(vals, sort),
-                             seg_idx, local, dk, sc, vals))
+                total += got["total"]
+                if track_scores:
+                    max_score = np.maximum(max_score, got["mx"])
+                if sort is None:
+                    top, idx = got["top"], got["idx"]
+                    seg_keys = np.where(
+                        top > -np.inf,
+                        (np.int64(seg_idx) << SEG_SHIFT)
+                        | idx.astype(np.int64),
+                        np.int64(-1))
+                    merged = np.concatenate([best_scores, top], axis=1)
+                    merged_keys = np.concatenate([best_keys, seg_keys],
+                                                 axis=1)
+                    order = np.argsort(-merged, axis=1, kind="stable")[:, :k]
+                    best_scores = np.take_along_axis(merged, order, axis=1)
+                    best_keys = np.take_along_axis(merged_keys, order,
+                                                   axis=1)
+                else:
+                    # device selection: lexicographic top-k over f64
+                    # comparator keys (keyword keys = this segment's
+                    # sorted ordinals)
+                    keys = sort_mod.segment_keys(seg, sort, scores, Q,
+                                                 seg_idx, self.shard_id)
+                    if search_after is not None:
+                        match = match & sort_mod.after_mask(
+                            seg, sort, search_after, keys)
+                    primary = jnp.where(match, keys[0], jnp.inf)
+                    doc_idx = jnp.broadcast_to(
+                        jnp.arange(seg.n_pad, dtype=jnp.float64)[None, :],
+                        primary.shape)
+                    # lexsort: LAST key is the primary; doc index breaks
+                    # ties
+                    order = jnp.lexsort(
+                        tuple([doc_idx] + list(reversed(keys[1:]))
+                              + [primary]))
+                    # top-kk selection stays ON DEVICE: downloading the
+                    # full [Q, n_pad] match/score matrices cost O(corpus)
+                    # transfer per sorted batch (25 MB at 100k docs x 64 q)
+                    # — gather at the winning positions first, then ONE
+                    # small fetch
+                    order = order[:, :kk].astype(jnp.int32)
+                    sel_match_d = jnp.take_along_axis(match, order, axis=1)
+                    sel_scores_d = jnp.take_along_axis(scores, order, axis=1)
+                    order, sel_match, sel_scores = device_fetch(
+                        (order, sel_match_d, sel_scores_d))
+                    n_fetches += 1
+                    for qi in range(Q):
+                        for j in range(kk):
+                            if not sel_match[qi, j]:
+                                continue
+                            local = int(order[qi, j])
+                            dk = (seg_idx << SEG_SHIFT) | local
+                            sc = float(sel_scores[qi, j])
+                            vals = sort_mod.materialize(
+                                seg, sort, local, sc, dk, self.shard_id)
+                            cands[qi].append(
+                                (sort_mod.compare_key(vals, sort),
+                                 seg_idx, local, dk, sc, vals))
+            finally:
+                self._release_scores(charged)
 
         sort_vals = None
         if sort is not None:
@@ -385,19 +491,54 @@ class ShardSearcher:
         from ..common import tracing
         from .stacked import StackedContext, execute_tree, stacked_reduce
         stats = self.build_stats(node, global_stats)
-        with tracing.span("stacked_dispatch", shard=self.shard_id,
-                          segments=len(stack.segments), k=k):
-            sctx = StackedContext(stack, Q, stats)
-            scores, match = execute_tree(node, sctx)
-            live = stack.live_stack()
-            out = stacked_reduce(scores, match, live, stack.seg_ids_dev,
-                                 k=k)
-            # per-segment totals, masked row-max and the cross-segment
-            # top-k merge all happened ON DEVICE — this is the shard's
-            # ONE fetch
-            keys_d, top_d, total_d, mx_d = out
-            got = device_fetch({"keys": keys_d, "top": top_d,
-                                "total": total_d, "mx": mx_d})
+        # blockwise eligibility mirrors the loop lane: unsorted (always
+        # true here), no top_hits aggs, stack wider than one block
+        blockwise_ok = self.blockwise_enabled \
+            and stack.n_pad > self.block_docs
+        if blockwise_ok and aggs is not None:
+            from .aggs.aggregators import has_top_hits
+            blockwise_ok = not has_top_hits(aggs)
+        self.last_block_mode = "materialized"
+        blk_mask = None
+        charged = 0
+        try:
+            with tracing.span("stacked_dispatch", shard=self.shard_id,
+                              segments=len(stack.segments), k=k):
+                out = None
+                if blockwise_ok:
+                    charged = self._charge_scores(
+                        stack.g_pad * Q * self.block_docs * SCORE_SLOT_BYTES)
+                    from . import blockwise as blockwise_mod
+                    out = blockwise_mod.execute_stacked(
+                        stack, node, n_queries=Q, stats=stats, k=k,
+                        block=self.block_docs, want_mask=aggs is not None)
+                    if out is None:
+                        self._release_scores(charged)
+                        charged = 0
+                if out is not None:
+                    self.last_block_mode = "blockwise"
+                    self._bump("blockwise_dispatches")
+                    if aggs is not None:
+                        keys_d, top_d, total_d, mx_d, blk_mask = out
+                    else:
+                        keys_d, top_d, total_d, mx_d = out
+                    live = stack.live_stack()
+                else:
+                    charged = charged or self._charge_scores(
+                        stack.g_pad * Q * stack.n_pad * SCORE_SLOT_BYTES)
+                    sctx = StackedContext(stack, Q, stats)
+                    scores, match = execute_tree(node, sctx)
+                    live = stack.live_stack()
+                    out = stacked_reduce(scores, match, live,
+                                         stack.seg_ids_dev, k=k)
+                    keys_d, top_d, total_d, mx_d = out
+                # per-segment totals, masked row-max and the cross-segment
+                # top-k merge all happened ON DEVICE — this is the shard's
+                # ONE fetch
+                got = device_fetch({"keys": keys_d, "top": top_d,
+                                    "total": total_d, "mx": mx_d})
+        finally:
+            self._release_scores(charged)
         best_keys = np.asarray(got["keys"], np.int64)
         # keep the device dtype: trees over f64 columns promote scores to
         # f64 exactly like the per-segment loop's merge does
@@ -419,8 +560,13 @@ class ShardSearcher:
             a_segs, a_masks, a_scores = [], [], []
             for gi, seg in enumerate(stack.segments):
                 a_segs.append(seg)
-                a_masks.append((match[gi, 0] & live[gi])[: seg.n_pad])
-                a_scores.append(scores[gi, 0, : seg.n_pad])
+                if blk_mask is not None:
+                    # blockwise mask rows are already liveness-gated
+                    a_masks.append(blk_mask[gi, : seg.n_pad])
+                    a_scores.append(None)    # no top_hits on blocks
+                else:
+                    a_masks.append((match[gi, 0] & live[gi])[: seg.n_pad])
+                    a_scores.append(scores[gi, 0, : seg.n_pad])
             agg_partials = collect_shard(aggs, a_segs, a_masks,
                                          query_parser=self.parser,
                                          scores=a_scores)

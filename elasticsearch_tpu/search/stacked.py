@@ -29,6 +29,14 @@ The packed stack itself is cached on the PR-3 Cache core
 (indices/cache_service.SegmentStackCache): keyed by (index, shard,
 incarnation, segment-id set), charged to the `fielddata` breaker, and
 invalidated by refresh/merge/`_cache/clear`.
+
+When the stack's doc axis exceeds `index.search.block_docs`, the searcher
+hands this SAME stack to the streaming blockwise executor
+(search/blockwise.execute_stacked): the tree then runs per doc block under
+a running on-device top-k instead of materializing `[G, Q, N]` here, and
+the cross-segment merge below (`stacked_reduce`'s tail) is reused verbatim
+inside its one jitted program — same candidate order, bitwise-identical
+results, O(Q × block) peak score memory.
 """
 
 from __future__ import annotations

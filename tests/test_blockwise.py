@@ -1,0 +1,442 @@
+"""Streaming blockwise dense execution (ISSUE 8): equivalence, breaker
+accounting, mesh integration, msearch mesh batching.
+
+The blockwise lane partitions a segment's/stack's doc axis into pow2
+blocks and executes the DSL tree inside ONE jitted lax.scan carrying a
+running top-k — peak device score memory O(Q × block) instead of
+O(Q × n_pad), still one device fetch per shard. These tests pin:
+
+  * blockwise results bitwise-identical to the materializing executor
+    across the full query-shape matrix (incl. generic-fallback nodes that
+    decline and materialize) on BOTH the per-segment loop and stacked
+    lanes — tombstones, Q>1 batches, deep pagination past one block's
+    width, aggregations collected per block;
+  * lane-accurate request-breaker accounting: the blockwise lane charges
+    [Q, block] bytes, the materializing lane [Q, n_pad], both released
+    symmetrically (the ISSUE 8 satellite bugfix);
+  * `index.search.blockwise.enable: false` pins the materializing
+    executor; `index.search.block_docs` sizes the block;
+  * the mesh lane runs the blockwise scan inside its shard_map body and
+    stays bitwise-identical to the materializing mesh program;
+  * Q>1 msearch batches ride the mesh lane's "replica" axis with rows
+    identical to solo searches, and fall back cleanly when mesh declines.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreakerService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapping.mapper import MapperService
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search.shard_searcher import (SCORE_SLOT_BYTES,
+                                                     ShardSearcher)
+
+BASE_DOCS = [
+    {"title": "the quick brown fox", "tag": "a", "n": 1, "price": 3.5},
+    {"title": "the quick red fox jumps", "tag": "b", "n": 2},
+    {"title": "lazy brown dog", "tag": "a", "n": 3, "price": 1.25},
+    {"title": "quick quick quick fox", "tag": "b", "n": 4},
+    {"title": "unrelated text entirely", "tag": "a", "n": 5, "price": 9.0},
+    {"title": "fox fox fox fox brown", "tag": "c", "n": 6},
+    {"title": "brown dog sleeps", "tag": "c", "n": 7, "price": 2.0},
+    {"title": "quick dog", "nokw": "x", "n": 8},
+    {"title": "fox and dog and fox", "tag": "a"},        # n missing
+    {"body": "different field here", "tag": "b", "n": 10},
+]
+# 40 docs -> 20/segment at 2 segments -> n_pad 32 -> 4 blocks of 8
+DOCS = [dict(d, n=i) if "n" in d else dict(d)
+        for i, d in enumerate(BASE_DOCS * 4)]
+BLOCK = 8
+
+QUERIES = [
+    {"match_all": {}},
+    {"bool": {"should": [{"match": {"title": "fox"}},
+                         {"match": {"title": "dog"}}]}},
+    {"bool": {"should": [{"match": {"title": "quick"}}],
+              "filter": [{"range": {"n": {"gte": 2, "lt": 27}}}]}},
+    {"term": {"tag": "a"}},
+    {"terms": {"tag": ["a", "c"]}},
+    {"term": {"n": 13}},
+    {"term": {"price": 2.0}},
+    {"range": {"n": {"gt": 3}}},
+    {"range": {"tag": {"gte": "a", "lte": "b"}}},
+    {"exists": {"field": "price"}},
+    {"exists": {"field": "title"}},
+    {"ids": {"values": ["1", "15", "28"]}},
+    {"constant_score": {"filter": {"term": {"tag": "b"}}, "boost": 2.5}},
+    {"dis_max": {"queries": [{"match": {"title": "fox"}},
+                             {"match": {"title": "dog"}}],
+                 "tie_breaker": 0.4}},
+    {"bool": {"must": [{"match": {"title": "fox"}}],
+              "must_not": [{"term": {"tag": "c"}}],
+              "should": [{"match": {"title": "brown"}}]}},
+    {"bool": {"should": [{"match": {"title": {"query": "fox brown",
+                                              "operator": "and"}}}]}},
+    # generic-fallback node types (no typed blockwise handler): the plan
+    # declines and the lane must fall back to the materializing executor
+    # with results still identical
+    {"prefix": {"title": "qu"}},
+    {"bool": {"should": [{"wildcard": {"title": "f*x"}}]}},
+    {"function_score": {"query": {"match": {"title": "fox"}},
+                        "field_value_factor": {"field": "n",
+                                               "missing": 1.0}}},
+]
+
+# tree shapes with a typed blockwise handler: these MUST ride blockwise
+BLOCKWISE_SHAPES = set(range(16))
+
+
+def build_searcher(n_segments=2, tombstone=None, **kw):
+    ms = MapperService()
+    mapper = ms.document_mapper("_doc")
+    builders = [SegmentBuilder(seg_id=i) for i in range(n_segments)]
+    for i, d in enumerate(DOCS):
+        builders[i % n_segments].add(mapper.parse(d, doc_id=str(i)), "_doc")
+    segs = [b.build() for b in builders]
+    if tombstone is not None:
+        for seg in segs:
+            local = seg.id_to_local.get(tombstone)
+            if local is not None:
+                seg.delete_local(local)
+    kw.setdefault("block_docs", BLOCK)
+    return ShardSearcher(0, segs, ms, **kw)
+
+
+def _run(searcher, bodies, size=10, aggs=None):
+    node = searcher.parse(bodies)
+    return searcher.execute_query_phase(node, size=size,
+                                        n_queries=len(bodies), aggs=aggs)
+
+
+def _assert_identical(a, b, q):
+    assert np.array_equal(a.doc_keys, b.doc_keys), q
+    assert a.scores.dtype == b.scores.dtype, q
+    itype = np.int64 if a.scores.dtype == np.float64 else np.int32
+    assert np.array_equal(a.scores.view(itype), b.scores.view(itype)), q
+    assert np.array_equal(a.total_hits, b.total_hits), q
+    assert np.array_equal(a.max_score.view(itype),
+                          b.max_score.view(itype)), q
+
+
+class TestBlockwiseEquivalence:
+    @pytest.mark.parametrize("qi", range(len(QUERIES)),
+                             ids=[json.dumps(q)[:48] for q in QUERIES])
+    @pytest.mark.parametrize("lane", ["stacked", "loop"])
+    def test_bitwise_identical_to_materialized(self, lane, qi):
+        q = QUERIES[qi]
+        stacked = lane == "stacked"
+        s = build_searcher(blockwise=True, stacked=stacked)
+        blk = _run(s, [q])
+        if s.last_query_path != "dense":
+            pytest.skip("query rides the sparse lane")
+        if qi in BLOCKWISE_SHAPES:
+            assert s.last_block_mode == "blockwise", q
+        else:
+            assert s.last_block_mode == "materialized", q
+        s2 = build_searcher(blockwise=False, stacked=stacked)
+        mat = _run(s2, [q])
+        assert s2.last_block_mode == "materialized"
+        _assert_identical(blk, mat, q)
+
+    @pytest.mark.parametrize("qi", range(8),
+                             ids=[json.dumps(q)[:48] for q in QUERIES[:8]])
+    def test_tombstones_identical(self, qi):
+        q = QUERIES[qi]
+        s = build_searcher(tombstone="1", blockwise=True)
+        blk = _run(s, [q])
+        if s.last_query_path != "dense":
+            pytest.skip("query rides the sparse lane")
+        s2 = build_searcher(tombstone="1", blockwise=False)
+        mat = _run(s2, [q])
+        _assert_identical(blk, mat, q)
+        keys = [int(k) for k in blk.doc_keys[0] if k >= 0]
+        hits = s.execute_fetch_phase(keys)
+        assert "1" not in [h.doc_id for h in hits]
+
+    @pytest.mark.parametrize("lane", ["stacked", "loop"])
+    def test_batched_rows_identical(self, lane):
+        """Q>1 batches: each row keeps its own terms/bounds per block."""
+        bodies = [{"bool": {"should": [{"match": {"title": "fox"}}],
+                            "filter": [{"range": {"n": {"gte": 1}}}]}},
+                  {"bool": {"should": [{"match": {"title": "dog brown"}}],
+                            "filter": [{"range": {"n": {"lte": 26}}}]}},
+                  {"bool": {"should": [{"match": {"title": "quick"}}],
+                            "filter": [{"range": {"n": {"lte": 14}}}]}}]
+        stacked = lane == "stacked"
+        s = build_searcher(blockwise=True, stacked=stacked)
+        blk = _run(s, bodies)
+        assert s.last_block_mode == "blockwise"
+        s2 = build_searcher(blockwise=False, stacked=stacked)
+        mat = _run(s2, bodies)
+        _assert_identical(blk, mat, bodies)
+
+    @pytest.mark.parametrize("lane", ["stacked", "loop"])
+    def test_deep_pagination_past_block_width(self, lane):
+        """k far above one block's width (8) must surface winners from
+        EVERY block — the running merge carries kk candidates, never
+        truncating at a block boundary."""
+        stacked = lane == "stacked"
+        s = build_searcher(blockwise=True, stacked=stacked)
+        q = {"match_all": {}}
+        blk = _run(s, [q], size=40)
+        assert s.last_block_mode == "blockwise"
+        live = sum(seg.live_count for seg in s.segments)
+        assert int((blk.doc_keys[0] >= 0).sum()) == live
+        s2 = build_searcher(blockwise=False, stacked=stacked)
+        mat = _run(s2, [q], size=40)
+        _assert_identical(blk, mat, q)
+
+    @pytest.mark.parametrize("lane", ["stacked", "loop"])
+    def test_aggregations_collected_per_block(self, lane):
+        from elasticsearch_tpu.search.aggs import (merge_shard_partials,
+                                                   parse_aggs, render)
+        specs = parse_aggs({"tags": {"terms": {"field": "tag"}},
+                            "avg_n": {"avg": {"field": "n"}}})
+        q = {"bool": {"should": [{"match": {"title": "fox"}},
+                                 {"match": {"title": "dog"}}]}}
+        stacked = lane == "stacked"
+        s = build_searcher(blockwise=True, stacked=stacked)
+        blk = _run(s, [q], aggs=specs)
+        assert s.last_block_mode == "blockwise"
+        s2 = build_searcher(blockwise=False, stacked=stacked)
+        mat = _run(s2, [q], aggs=specs)
+        out_a = render(specs, merge_shard_partials(specs, [blk.aggs]))
+        out_b = render(specs, merge_shard_partials(specs, [mat.aggs]))
+        assert out_a == out_b
+        assert out_a["tags"]["buckets"]
+        _assert_identical(blk, mat, q)
+
+    def test_top_hits_aggs_keep_materializing(self):
+        """top_hits needs per-doc score rows — blockwise must decline."""
+        from elasticsearch_tpu.search.aggs import parse_aggs
+        specs = parse_aggs({"top": {"top_hits": {"size": 2}}})
+        s = build_searcher(blockwise=True)
+        _run(s, [{"bool": {"should": [{"match": {"title": "fox"}}]}}],
+             aggs=specs)
+        assert s.last_block_mode == "materialized"
+
+    def test_single_block_identity_fast_path(self):
+        """n_pad <= block keeps the materializing executor — small corpora
+        pay zero blockwise overhead."""
+        s = build_searcher(blockwise=True, block_docs=64)   # n_pad = 32
+        _run(s, [{"bool": {"should": [{"match": {"title": "fox"}}]}}])
+        assert s.last_query_path == "dense"
+        assert s.last_block_mode == "materialized"
+
+    def test_one_fetch_per_shard_on_blockwise(self):
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        s = build_searcher(blockwise=True)
+        node = s.parse([{"bool": {"should": [
+            {"match": {"title": "fox"}}, {"match": {"title": "dog"}}]}}])
+        s.execute_query_phase(node, size=5)          # warm compiles
+        before = transfer_snapshot()["device_fetches_total"]
+        s.execute_query_phase(node, size=5)
+        assert transfer_snapshot()["device_fetches_total"] - before == 1
+        assert s.last_block_mode == "blockwise"
+
+
+class TestBreakerAccounting:
+    """ISSUE 8 satellite: the request breaker sees the LANE-ACCURATE
+    score-matrix estimate — [Q, block] blockwise, [Q, n_pad] materialized —
+    charged before execution and released symmetrically."""
+
+    Q_BODY = [{"bool": {"should": [{"match": {"title": "fox"}},
+                                   {"match": {"title": "dog"}}]}}]
+
+    def _breaker(self):
+        svc = CircuitBreakerService()
+        return svc.breaker("request")
+
+    def test_blockwise_stacked_charges_block_estimate(self):
+        br = self._breaker()
+        s = build_searcher(blockwise=True, request_breaker=br)
+        _run(s, self.Q_BODY)
+        assert s.last_block_mode == "blockwise"
+        g_pad = 2                                     # 2 live segments
+        assert br.max_used == g_pad * 1 * BLOCK * SCORE_SLOT_BYTES
+        assert br.used == 0                           # symmetric release
+
+    def test_materialized_stacked_charges_full_estimate(self):
+        br = self._breaker()
+        s = build_searcher(blockwise=False, request_breaker=br)
+        _run(s, self.Q_BODY)
+        assert s.last_block_mode == "materialized"
+        n_pad = max(seg.n_pad for seg in s.segments)
+        assert br.max_used == 2 * 1 * n_pad * SCORE_SLOT_BYTES
+        assert br.used == 0
+
+    def test_blockwise_loop_charges_block_estimate(self):
+        br = self._breaker()
+        s = build_searcher(blockwise=True, stacked=False,
+                           request_breaker=br)
+        _run(s, self.Q_BODY)
+        assert s.last_block_mode == "blockwise"
+        # per-segment charges, one at a time: peak = one segment's charge
+        assert br.max_used == 1 * BLOCK * SCORE_SLOT_BYTES
+        assert br.used == 0
+
+    def test_materialized_loop_charges_full_estimate(self):
+        br = self._breaker()
+        s = build_searcher(blockwise=False, stacked=False,
+                           request_breaker=br)
+        _run(s, self.Q_BODY)
+        n_pad = max(seg.n_pad for seg in s.segments)
+        assert br.max_used == 1 * n_pad * SCORE_SLOT_BYTES
+        assert br.used == 0
+
+    def test_breach_trips_and_degrades_not_5xx(self):
+        """The request breaker is the evictable tier: an over-limit score
+        matrix counts a trip and force-charges (truthful accounting, exact
+        high-water mark) instead of failing the search."""
+        svc = CircuitBreakerService()
+        br = svc.breaker("request")
+        br.limit = 1
+        s = build_searcher(blockwise=False, request_breaker=br)
+        out = _run(s, self.Q_BODY)
+        assert int(out.total_hits[0]) > 0          # search still served
+        assert br.tripped >= 1
+        n_pad = max(seg.n_pad for seg in s.segments)
+        assert br.max_used == 2 * 1 * n_pad * SCORE_SLOT_BYTES
+        assert br.used == 0
+
+    def test_peak_gauge_records(self):
+        from elasticsearch_tpu.common.metrics import peak_score_matrix_bytes
+        s = build_searcher(blockwise=True)
+        _run(s, self.Q_BODY)
+        assert peak_score_matrix_bytes() >= BLOCK * SCORE_SLOT_BYTES
+
+
+# -- coordinator integration: settings, mesh lane, msearch batching ---------
+
+BODY = {"size": 10, "query": {"bool": {"should": [
+    {"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+def _fill(n, name, n_docs=200, **settings):
+    n.create_index(name, settings={"number_of_shards": 4, **settings},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    for i in range(n_docs):
+        n.index_doc(name, str(i),
+                    {"body": f"quick brown fox jumps {i}", "n": i})
+    n.refresh(name)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("blockwise")))
+    _fill(n, "bw", **{"index.search.block_docs": 8})
+    _fill(n, "mat", **{"index.search.blockwise.enable": False})
+    yield n
+    n.close()
+
+
+def _hits(out):
+    return [(h["_id"], h["_score"]) for h in out["hits"]["hits"]]
+
+
+class TestMeshBlockwise:
+    def test_mesh_runs_blockwise_and_matches_materialized(self, node):
+        from elasticsearch_tpu.parallel import mesh_exec
+        out_b = node.search("bw", json.loads(json.dumps(BODY)))
+        assert node.indices["bw"].search_stats.get("mesh", 0) >= 1
+        assert mesh_exec.last_block_mode == "blockwise"
+        out_m = node.search("mat", json.loads(json.dumps(BODY)))
+        assert mesh_exec.last_block_mode == "materialized"
+        assert _hits(out_b) == _hits(out_m)
+        assert out_b["hits"]["total"] == out_m["hits"]["total"]
+        assert out_b["hits"]["max_score"] == out_m["hits"]["max_score"]
+
+    def test_blockwise_dispatch_counter_moves(self, node):
+        before = node.indices["bw"].search_stats.get(
+            "blockwise_dispatches", 0)
+        node.search("bw", json.loads(json.dumps(BODY)))
+        assert node.indices["bw"].search_stats.get(
+            "blockwise_dispatches", 0) == before + 1
+
+    def test_opt_out_setting_pins_materializing(self, node):
+        from elasticsearch_tpu.parallel import mesh_exec
+        node.search("mat", json.loads(json.dumps(BODY)))
+        assert mesh_exec.last_block_mode == "materialized"
+        assert node.indices["mat"].search_stats.get(
+            "blockwise_dispatches", 0) == 0
+
+    def test_metrics_exposition(self, node):
+        node.search("bw", json.loads(json.dumps(BODY)))
+        search = node.metric_sections()["search"][1]
+        assert search["blockwise_dispatches_total"] >= 1
+        assert search["peak_score_matrix_bytes"] > 0
+
+
+class TestMsearchMeshBatched:
+    BODIES = [{"size": 5, "query": {"bool": {"should": [
+        {"match": {"body": t}}, {"match": {"body": "jumps"}}]}}}
+        for t in ("quick", "fox", "brown")]
+
+    def _reqs(self, index):
+        return [({"index": index}, json.loads(json.dumps(b)))
+                for b in self.BODIES]
+
+    def test_batch_rides_mesh_rows_identical_to_solo(self, node):
+        before = node.indices["bw"].search_stats.get("mesh", 0)
+        out = node.msearch(self._reqs("bw"))
+        assert len(out["responses"]) == len(self.BODIES)
+        # the WHOLE batch was one mesh dispatch
+        assert node.indices["bw"].search_stats.get("mesh", 0) == before + 1
+        solo = [node.search("bw", json.loads(json.dumps(b)))
+                for b in self.BODIES]
+        for r, s in zip(out["responses"], solo):
+            assert _hits(r) == _hits(s)
+            assert r["hits"]["total"] == s["hits"]["total"]
+            assert r["hits"]["max_score"] == s["hits"]["max_score"]
+
+    def test_batch_falls_back_when_mesh_declines(self, node):
+        """index.search.mesh.enable=false: the batch must serve via the
+        per-shard fan-out with identical per-row results."""
+        _fill(n=node, name="nomesh",
+              **{"index.search.mesh.enable": False,
+                 "index.search.block_docs": 8})
+        out = node.msearch(self._reqs("nomesh"))
+        assert node.indices["nomesh"].search_stats.get("mesh", 0) == 0
+        solo = [node.search("nomesh", json.loads(json.dumps(b)))
+                for b in self.BODIES]
+        for r, s in zip(out["responses"], solo):
+            assert _hits(r) == _hits(s)
+            assert r["hits"]["total"] == s["hits"]["total"]
+
+    def test_agg_batches_keep_the_fanout(self, node):
+        """Agg bodies are mesh-ineligible: the batched agg path still
+        serves them (fallback ladder, not an error)."""
+        bodies = [dict(b, aggs={"mx": {"max": {"field": "n"}}},
+                       size=0) for b in self.BODIES]
+        reqs = [({"index": "bw"}, json.loads(json.dumps(b)))
+                for b in bodies]
+        before = node.indices["bw"].search_stats.get("mesh", 0)
+        out = node.msearch(reqs)
+        assert node.indices["bw"].search_stats.get("mesh", 0) == before
+        for r in out["responses"]:
+            assert r["aggregations"]["mx"]["value"] == 199.0
+
+
+# -- chunked agg one-hot (ops/aggs.py) --------------------------------------
+
+def test_onehot_counts_chunked_matches_oneshot():
+    """Above _ONEHOT_BLOCK docs the one-hot count matmul accumulates per
+    block inside a lax.scan; counts are exact integers, bitwise-equal to
+    the one-shot product."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops import aggs as agg_ops
+    N = agg_ops._ONEHOT_BLOCK * 2
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 17, N), jnp.int32)
+    valid = jnp.asarray(rng.random((2, N)) < 0.5)
+    chunked = np.asarray(agg_ops._onehot_counts(ids, valid, 32))
+    oneshot = np.asarray(agg_ops._onehot_block(
+        jnp.asarray(ids), jnp.asarray(valid), 32))
+    assert np.array_equal(chunked, oneshot)
+    # exactness: float products of exact small ints
+    assert chunked.sum() == float(np.asarray(valid).sum())

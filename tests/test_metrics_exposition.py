@@ -172,6 +172,19 @@ def test_every_registry_is_scraped(http):
         assert families[fam]["type"] == mtype, fam
 
 
+def test_blockwise_families_exposed(http):
+    """ISSUE 8: the blockwise dispatch counter and the peak score-matrix
+    gauge join the search section with the right metric types."""
+    node, req = http
+    families = scrape(req)
+    assert families["es_search_blockwise_dispatches_total"]["type"] \
+        == "counter"
+    assert families["es_search_peak_score_matrix_bytes"]["type"] == "gauge"
+    # the dense size=0 search in the fixture materialized SOME score state
+    (_, peak), = families["es_search_peak_score_matrix_bytes"]["samples"]
+    assert peak >= 0
+
+
 def test_new_timer_joins_the_scrape_automatically(http):
     node, req = http
     node.metrics.record("custom.drift_guard", 1.25)

@@ -190,6 +190,74 @@ def test_mesh_query_one_fetch_zero_host_merges(mesh_node):
     assert host_merge_count() - h0 == 0
 
 
+# -- streaming blockwise dense lane (ISSUE 8) -------------------------------
+
+BLOCKWISE_BODY = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+
+
+@pytest.fixture(scope="module")
+def blockwise_node(tmp_path_factory):
+    """One shard, block_docs=8, segments added in same-size refresh rounds:
+    n_pad stays inside one pow2 bucket, so the BLOCK COUNT (n_pad / block)
+    stays inside its bucket too — doc growth must compile nothing."""
+    n = NodeService(str(tmp_path_factory.mktemp("blockwise_nr")))
+    n.create_index("b", settings={"number_of_shards": 1,
+                                  "index.search.block_docs": 8,
+                                  "index.search.stacked.enable": True},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    n._doc_seq = 0
+
+    def add_segment():
+        for _ in range(40):
+            i = n._doc_seq
+            n._doc_seq += 1
+            n.index_doc("b", str(i),
+                        {"body": f"quick brown fox jumps {i}", "n": i})
+        n.refresh("b")
+    n._add_segment = add_segment
+    yield n
+    n.close()
+
+
+def test_blockwise_block_count_growth_in_bucket_zero_retraces(blockwise_node):
+    """refresh→query cycles whose stack shapes (and with them the block
+    count) stay inside one pow2 bucket must compile ZERO new programs on
+    the blockwise path."""
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = blockwise_node
+    for _ in range(5):                       # 5 segments -> G_pad = 8
+        n._add_segment()
+    _q = lambda: n.search("b", json.loads(json.dumps(BLOCKWISE_BODY)))
+    _q()                                     # warm: compiles expected
+    _q()
+    searcher = n.indices["b"].searchers()[0]
+    assert searcher.last_block_mode == "blockwise"
+    assert n.indices["b"].search_stats.get("blockwise_dispatches", 0) >= 2
+    before = device_events_snapshot()[0]
+    for _ in range(2):                       # segments 6 and 7: same bucket
+        n._add_segment()
+        _q()
+    assert device_events_snapshot()[0] == before, \
+        "refresh→query cycle inside the pow2 bucket retraced blockwise"
+
+
+def test_blockwise_single_fetch_per_shard(blockwise_node):
+    """Counter-asserted: one device_fetch per shard query holds on the
+    blockwise path."""
+    from elasticsearch_tpu.common.metrics import transfer_snapshot
+    n = blockwise_node
+    if not n.indices["b"].shards[0].segments:
+        n._add_segment()
+    n.search("b", json.loads(json.dumps(BLOCKWISE_BODY)))   # warm
+    before = transfer_snapshot()["device_fetches_total"]
+    n.search("b", json.loads(json.dumps(BLOCKWISE_BODY)))
+    assert transfer_snapshot()["device_fetches_total"] - before == 1
+    assert n.indices["b"].searchers()[0].last_block_mode == "blockwise"
+
+
 # -- span tracing overhead (ISSUE 5) ----------------------------------------
 
 def test_tracing_disabled_zero_device_overhead(tmp_path_factory):

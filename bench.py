@@ -56,7 +56,12 @@ def _over_budget(margin: float = 0.0) -> bool:
 # at rc=124): legs update _FINAL_LINE as results land, and a SIGTERM/SIGINT
 # (the harness timeout's first strike) prints whatever is measured so far
 # instead of dying silently. _emit prints at most once.
-_FINAL_LINE: dict = {"value": None, "unit": "qps"}
+# tail-latency headline keys (ISSUE 9) default to null at import time so
+# a forced timeout/bailout still emits them (the subprocess guard test
+# pins this)
+_FINAL_LINE: dict = {"value": None, "unit": "qps",
+                     "conc_p99_ms": None, "shed_429s": None,
+                     "hedged_wins": None}
 _LINE_PRINTED = False
 
 
@@ -750,13 +755,20 @@ def run_engine_leg(tag: str) -> dict:
                     "p50_ms": lat[len(lat) // 2],
                     "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
                     "conc_qps": None, "conc_p50_ms": None,
+                    "conc_p99_ms": None, "shed_429s": None,
+                    "hedged_wins": None,
                     "conc_clients": 0, "index_secs": index_secs,
                     "docs_per_sec": N_DOCS / index_secs,
                     **serving_counters()}
         import threading
-        CONC = int(os.environ.get("BENCH_CONC", "32"))
+        import urllib.error
+        # BENCH_CONC_CLIENTS (ISSUE 9) is the canonical fan-in override;
+        # BENCH_CONC stays honored for older harness configs
+        CONC = int(os.environ.get("BENCH_CONC_CLIENTS",
+                                  os.environ.get("BENCH_CONC", "32")))
         PER = 8
         conc_lat: list[float] = []
+        shed_429s = [0]
         conc_lock = threading.Lock()
 
         def client(ci: int):
@@ -765,7 +777,16 @@ def run_engine_leg(tag: str) -> dict:
                 body = json.dumps({"query": {"match": {"body": q}},
                                    "size": 10, "_source": False})
                 t2 = time.perf_counter()
-                http(port, "POST", "/bench/_search", body)
+                try:
+                    http(port, "POST", "/bench/_search", body)
+                except urllib.error.HTTPError as e:
+                    # load shedding IS the contract under overload: a 429
+                    # is counted, anything else still fails the leg
+                    if e.code != 429:
+                        raise
+                    with conc_lock:
+                        shed_429s[0] += 1
+                    continue
                 dt = (time.perf_counter() - t2) * 1000
                 with conc_lock:
                     conc_lat.append(dt)
@@ -779,6 +800,7 @@ def run_engine_leg(tag: str) -> dict:
         for t in warm_threads:
             t.join()
         conc_lat.clear()
+        shed_429s[0] = 0
         threads = [threading.Thread(target=client, args=(ci,))
                    for ci in range(CONC)]
         t1 = time.perf_counter()
@@ -788,12 +810,19 @@ def run_engine_leg(tag: str) -> dict:
             t.join()
         conc_dt = time.perf_counter() - t1
         conc_lat.sort()
+        from elasticsearch_tpu.serving.qos import hedge_snapshot
         return {"qps": qps,
                 "qps_filter": qps_filter,
                 "p50_ms": lat[len(lat) // 2],
                 "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
-                "conc_qps": CONC * PER / conc_dt,
-                "conc_p50_ms": conc_lat[len(conc_lat) // 2],
+                "conc_qps": len(conc_lat) / conc_dt,
+                "conc_p50_ms": conc_lat[len(conc_lat) // 2]
+                if conc_lat else None,
+                "conc_p99_ms": conc_lat[min(len(conc_lat) - 1,
+                                            int(len(conc_lat) * 0.99))]
+                if conc_lat else None,
+                "shed_429s": shed_429s[0],
+                "hedged_wins": hedge_snapshot()["win_backup"],
                 "conc_clients": CONC,
                 "index_secs": index_secs,
                 "docs_per_sec": N_DOCS / index_secs,
@@ -812,6 +841,8 @@ def _run_all_legs(tag: str) -> dict:
         # kill during a LATER leg still reports the measured headline
         _FINAL_LINE.update({k: res[k] for k in
                             ("qps", "qps_filter", "p50_ms", "p99_ms",
+                             "conc_qps", "conc_p50_ms", "conc_p99_ms",
+                             "shed_429s", "hedged_wins",
                              "batches", "batched_requests",
                              "search_rejected") if k in res})
         _FINAL_LINE["value"] = res.get("qps")
@@ -897,6 +928,11 @@ def main_engine():
         "conc_qps": r2(res.get("conc_qps")),
         "vs_baseline_concurrent": rnd(ratios.get("conc_qps")),
         "conc_p50_ms": r2(res.get("conc_p50_ms")),
+        # tail latency as a headline (ISSUE 9): the p99 under concurrent
+        # fan-in plus the QoS counters that explain it
+        "conc_p99_ms": r2(res.get("conc_p99_ms")),
+        "shed_429s": res.get("shed_429s"),
+        "hedged_wins": res.get("hedged_wins"),
         "conc_clients": res.get("conc_clients", 0),
         "p50_ms": r2(res.get("p50_ms")),
         "p99_ms": r2(res.get("p99_ms")),

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any
 
+from ..common import tracing
 from ..index.engine import Engine, VersionConflictException
 from ..mapping.mapper import MapperService
 from ..parallel.routing import shard_id as route_shard
@@ -163,6 +164,16 @@ class ClusterNode:
         # per-(index, shard) round-robin cursor for read copy selection
         # (ref cluster/routing/OperationRouting.java:144-154)
         self._read_rr: dict[tuple[str, int], int] = {}
+        # hedged replica reads (ISSUE 9, SURVEY §2.10.2 upgraded): per-
+        # target-node latency EWMAs arm an adaptive p99 deadline; a copy
+        # that blows it gets a backup request fired at another copy, the
+        # first answer wins and the loser is canceled. Settings
+        # (cluster.search.hedge.*) read from cluster-state settings with
+        # this overlay dict as the node-local fallback.
+        self._node_lat: dict[str, Any] = {}
+        self.hedge_settings: dict = {}
+        self.hedge_stats = {"fired": 0, "win_primary": 0,
+                            "win_backup": 0, "canceled": 0, "failed": 0}
         # shard-level pinned scroll contexts this node hosts (data-node side
         # of the distributed scroll; ref SearchService contexts + reaper)
         self._scroll_ctx: dict[str, dict] = {}
@@ -325,8 +336,15 @@ class ClusterNode:
         proc = monitor.process_stats()
         os_st = monitor.os_stats()
         load = os_st.get("load_average") or [0.0]
-        return {
+        from ..serving.qos import hedge_snapshot
+        sections = {
             "node": (None, {"docs": docs, "shards": shards}),
+            # hedged-read outcomes + per-class transport send queues
+            # (ISSUE 9): es_search_hedged_total{outcome=},
+            # es_transport_class_queue_depth{class=}
+            "search_hedged": ("outcome",
+                              {o: {"total": c}
+                               for o, c in hedge_snapshot().items()}),
             "tasks": (None, self.tasks.stats()),
             "process": (None, {
                 "resident_bytes": proc.get("mem", {})
@@ -335,6 +353,10 @@ class ClusterNode:
             "os": (None, {"load_1m": load[0],
                           "cpu_percent": os_st["cpu"]["percent"]}),
         }
+        class_stats = getattr(self.transport.network, "class_stats", None)
+        if class_stats is not None:          # TcpTransport has no classes
+            sections["transport_class"] = ("class", class_stats())
+        return sections
 
     def _on_node_metrics(self, from_id: str, req: Any) -> dict:
         return {"sections": self.metric_sections()}
@@ -1379,6 +1401,126 @@ class ClusterNode:
         # topology, not only when the shard happens to be remote
         return self.transport.send(node, action, payload)
 
+    # -- hedged replica reads (ISSUE 9) -----------------------------------
+
+    def _hedge_setting(self, key: str, default):
+        st = self.cluster.current().data.get("settings") or {}
+        return st.get(key, self.hedge_settings.get(key, default))
+
+    def _observe_node_latency(self, node: str, ms: float) -> None:
+        from ..serving.qos import Ewma
+        lat = self._node_lat.get(node)
+        if lat is None:
+            lat = self._node_lat[node] = Ewma()
+        lat.observe(ms)
+
+    def _query_with_hedge(self, state, name: str, sid: int, node: str,
+                          payload: dict):
+        """A_QUERY with an adaptive hedge (SURVEY §2.10.2's load-balanced
+        reads, upgraded to hedging): when the chosen copy's response
+        exceeds its p99-of-EWMA deadline (`cluster.search.hedge.*`), the
+        SAME query fires at another STARTED copy and the first success
+        wins; the loser's late answer is observed, discarded and counted
+        as canceled. Error semantics are unchanged — with no success the
+        primary's error raises exactly as the unhedged call would.
+        Returns (result, serving_node)."""
+        from ..serving.qos import record_hedge
+        enabled = self._hedge_setting("cluster.search.hedge.enable", True)
+        if isinstance(enabled, str):
+            enabled = enabled.strip().lower() not in ("false", "0", "no",
+                                                      "off")
+        backups = [c["node"] for c in state.started_copies(name, sid)
+                   if c["node"] != node]
+        lat = self._node_lat.get(node)
+        if not enabled or not backups or lat is None or lat.n == 0:
+            # cold copy / nothing to hedge onto: the plain synchronous
+            # call (and its latency seeds the EWMA for next time)
+            t1 = time.perf_counter()
+            r = self._shard_call(node, A_QUERY, payload)
+            self._observe_node_latency(
+                node, (time.perf_counter() - t1) * 1000)
+            return r, node
+
+        def _f(key, default):
+            try:
+                return float(self._hedge_setting(key, default))
+            except (TypeError, ValueError):
+                return default
+        min_ms = _f("cluster.search.hedge.min_ms", 50.0)
+        max_ms = _f("cluster.search.hedge.max_ms", 5000.0)
+        k = _f("cluster.search.hedge.deviations", 3.0)
+        deadline_s = min(max(lat.deadline_ms(k), min_ms), max_ms) / 1000.0
+
+        import contextvars
+        cond = threading.Condition()
+        results: list[tuple] = []
+        winner: list[str] = []
+
+        def call(target: str) -> None:
+            t1 = time.perf_counter()
+            try:
+                r = self._shard_call(target, A_QUERY, payload)
+                self._observe_node_latency(
+                    target, (time.perf_counter() - t1) * 1000)
+                out = ("ok", r, target)
+            except (ConnectTransportException,
+                    RemoteTransportException) as e:
+                out = ("err", e, target)
+            with cond:
+                results.append(out)
+                if out[0] == "ok" and winner and winner[0] != target:
+                    # the race's loser finally answered: canceled —
+                    # observed, discarded, counted
+                    record_hedge("canceled")
+                    self.hedge_stats["canceled"] += 1
+                cond.notify_all()
+
+        def _success():
+            return next((r for r in results if r[0] == "ok"), None)
+
+        launched = 1
+        ctx = contextvars.copy_context()
+        threading.Thread(target=ctx.run, args=(call, node),
+                         daemon=True).start()
+        with cond:
+            cond.wait_for(lambda: results, timeout=deadline_s)
+            lapsed = not results
+        if lapsed:
+            # deadline blown: fire the backup; the span sits under the
+            # coordinator's query span in GET /_traces
+            backup = backups[0]
+            record_hedge("fired")
+            self.hedge_stats["fired"] += 1
+            launched = 2
+            with tracing.span("hedge", index=name, shard=sid,
+                              primary=node, backup=backup):
+                ctx2 = contextvars.copy_context()
+                threading.Thread(target=ctx2.run, args=(call, backup),
+                                 daemon=True).start()
+                with cond:
+                    cond.wait_for(lambda: _success() is not None
+                                  or len(results) >= launched)
+        with cond:
+            got = _success()
+            if got is None and len(results) < launched:
+                # primary errored inside the deadline; the backup (if
+                # any) may still answer — wait it out
+                cond.wait_for(lambda: _success() is not None
+                              or len(results) >= launched)
+                got = _success()
+            if got is not None:
+                winner.append(got[2])
+        if got is not None:
+            if launched == 2:
+                outcome = "win_primary" if got[2] == node else "win_backup"
+                record_hedge(outcome)
+                self.hedge_stats[outcome] += 1
+            return got[1], got[2]
+        if launched == 2:
+            record_hedge("failed")
+            self.hedge_stats["failed"] += 1
+        raise next(r[1] for r in results if r[2] == node)
+
     def _dfs_stats(self, targets, query, names) -> dict | None:
         """All-reduce term statistics across shards (ref DfsPhase.java:57-81)
         so BM25 IDF is corpus-global. Returns a wire dict or None when the
@@ -1479,18 +1621,20 @@ class ClusterNode:
         # TransportSearchTypeAction onFirstPhaseResult failure path)
         per_shard: list[tuple[int, dict]] = []
         failures: list[dict] = []
-        for ti, (node, name, sid) in enumerate(targets):
-            payload = {"index": name, "shard": sid, "body": body,
-                       "size": size + from_, "dfs": dfs,
-                       "_task": self._task_header(task),
-                       "_trace": self._trace_header()}
-            try:
-                per_shard.append(
-                    (ti, self._shard_call(node, A_QUERY, payload)))
-            except (ConnectTransportException,
-                    RemoteTransportException) as e:
-                failures.append({"shard": sid, "index": name,
-                                 "node": node, "reason": str(e)})
+        with tracing.span("query", shards=len(targets)):
+            for ti, (node, name, sid) in enumerate(targets):
+                payload = {"index": name, "shard": sid, "body": body,
+                           "size": size + from_, "dfs": dfs,
+                           "_task": self._task_header(task),
+                           "_trace": self._trace_header()}
+                try:
+                    r, _served = self._query_with_hedge(
+                        state, name, sid, node, payload)
+                    per_shard.append((ti, r))
+                except (ConnectTransportException,
+                        RemoteTransportException) as e:
+                    failures.append({"shard": sid, "index": name,
+                                     "node": node, "reason": str(e)})
         if not per_shard and targets:
             raise UnavailableShardsException(
                 f"all shards failed for [{index}]: {failures}")

@@ -52,6 +52,42 @@ class RemoteTransportException(TransportException):
         self.error_message = message
 
 
+# -- transport traffic classes (ISSUE 9) ------------------------------------
+# The reference opens FIVE typed connection sets per node pair
+# (NettyTransport.java:180-184: recovery=2, bulk=3, reg=6, state=1, ping=1)
+# so recovery chunk streaming and bulk replication can never head-of-line-
+# block query fan-out or cluster-state publishing. Here each (sender,
+# target, class) tuple gets its own connection budget: a send first takes
+# a class connection, waits in ITS CLASS's queue when the budget is full,
+# and classes are fully isolated from each other. Same-thread nested sends
+# re-enter their held connection (the in-process transport runs handlers
+# in the caller's thread), and an implausibly-long wait fails OPEN with a
+# counter rather than deadlocking the cluster.
+
+TRAFFIC_CLASS_CONNECTIONS = {"recovery": 2, "bulk": 3, "reg": 6,
+                             "state": 1, "ping": 1}
+
+#: fail-open ceiling for a class-connection wait; a timeout means the
+#: class was saturated for this long — counted, never fatal
+CLASS_WAIT_TIMEOUT_S = 30.0
+
+
+def class_of_action(action: str) -> str:
+    """Traffic class of a named transport action (mirrors the reference's
+    ConnectionProfile mapping onto its five connection types)."""
+    if action.startswith("internal:index/shard/recovery"):
+        return "recovery"
+    if action.startswith("indices:data/write"):
+        return "bulk"
+    if action == "internal:discovery/zen/fd/ping":
+        return "ping"
+    if action.startswith(("internal:cluster", "internal:discovery",
+                          "internal:gateway", "cluster:",
+                          "indices:admin")):
+        return "state"
+    return "reg"   # search/get/stats — the latency-sensitive default
+
+
 _BYTES_TAG = "__b64__"
 _ESC_TAG = "__esc__"
 
@@ -106,10 +142,24 @@ class LocalTransport:
         # fault-injection rules: (from_id|None, to_id) pairs that fail —
         # None matches any sender (full isolation of to_id)
         self._disconnected: set[tuple[str | None, str]] = set()
+        # latency-injection rules: (to_id, action_prefix) -> seconds of
+        # added delivery delay (the slow-replica half of the
+        # MockTransportService analog; hedged-read tests use this)
+        self._delays: dict[tuple[str, str], float] = {}
         self.messages_sent = 0
         self.bytes_sent = 0
         self.max_message_bytes = 0   # largest single frame (recovery tests
                                      # assert chunking bounds this)
+        # per-(sender, target, class) connection budgets + per-class queue
+        # accounting (ISSUE 9; ref NettyTransport.java:180-184)
+        self._class_sems: dict[tuple[str, str, str],
+                               threading.Semaphore] = {}
+        self._held = threading.local()   # same-thread re-entrancy
+        self._class_stats: dict[str, dict] = {
+            c: {"sent_total": 0, "queue_depth": 0, "max_queue_depth": 0,
+                "queue_timeouts_total": 0,
+                "connections": TRAFFIC_CLASS_CONNECTIONS[c]}
+            for c in TRAFFIC_CLASS_CONNECTIONS}
 
     def register(self, service: "TransportService") -> None:
         with self._lock:
@@ -146,6 +196,73 @@ class LocalTransport:
     def heal(self) -> None:
         with self._lock:
             self._disconnected.clear()
+            self._delays.clear()
+
+    def add_delay(self, node_id: str, action_prefix: str,
+                  seconds: float) -> None:
+        """Inject delivery latency into every message TO node_id whose
+        action starts with action_prefix (slow-replica fault injection —
+        the hedged-read and traffic-class tests drive this)."""
+        with self._lock:
+            self._delays[(node_id, action_prefix)] = float(seconds)
+
+    def clear_delay(self, node_id: str, action_prefix: str) -> None:
+        with self._lock:
+            self._delays.pop((node_id, action_prefix), None)
+
+    def _delay_of(self, to_id: str, action: str) -> float:
+        with self._lock:
+            if not self._delays:
+                return 0.0
+            return max((s for (nid, pfx), s in self._delays.items()
+                        if nid == to_id and action.startswith(pfx)),
+                       default=0.0)
+
+    # -- typed connection classes (ISSUE 9) --------------------------------
+
+    def _acquire_class(self, from_id: str, to_id: str, tclass: str):
+        """Take a class connection for the (sender, target) pair, queueing
+        in the class's OWN send queue when the budget is full — classes
+        never contend with each other. Returns a release callable, or
+        None when this thread already holds a connection of the tuple
+        (nested same-pair sends re-enter; the in-process transport runs
+        handlers in the caller's thread)."""
+        key = (from_id, to_id, tclass)
+        held: dict = getattr(self._held, "keys", None) or {}
+        if held.get(key):
+            return None              # re-entrant: ride the held connection
+        with self._lock:
+            sem = self._class_sems.get(key)
+            if sem is None:
+                sem = self._class_sems[key] = threading.Semaphore(
+                    TRAFFIC_CLASS_CONNECTIONS[tclass])
+            st = self._class_stats[tclass]
+            st["queue_depth"] += 1
+            st["max_queue_depth"] = max(st["max_queue_depth"],
+                                        st["queue_depth"])
+        acquired = sem.acquire(timeout=CLASS_WAIT_TIMEOUT_S)
+        with self._lock:
+            st = self._class_stats[tclass]
+            st["queue_depth"] -= 1
+            if not acquired:
+                # fail OPEN: a class saturated past the ceiling proceeds
+                # (counted) rather than wedging the cluster
+                st["queue_timeouts_total"] += 1
+            st["sent_total"] += 1
+        held[key] = True
+        self._held.keys = held
+
+        def release():
+            held.pop(key, None)
+            if acquired:
+                sem.release()
+        return release
+
+    def class_stats(self) -> dict:
+        """{class: leaves} for the `transport_class` metric section
+        (es_transport_class_queue_depth{class=} et al.)."""
+        with self._lock:
+            return {c: dict(st) for c, st in self._class_stats.items()}
 
     # -- the wire ----------------------------------------------------------
 
@@ -156,6 +273,24 @@ class LocalTransport:
                        or (None, to_id) in self._disconnected)
             target = self._nodes.get(to_id)
         if blocked or target is None:
+            raise ConnectTransportException(to_id, action)
+        release = self._acquire_class(from_id, to_id,
+                                      class_of_action(action))
+        try:
+            delay = self._delay_of(to_id, action)
+            if delay > 0:
+                import time as _time
+                _time.sleep(delay)
+            return self._deliver_framed(from_id, to_id, action, payload)
+        finally:
+            if release is not None:
+                release()
+
+    def _deliver_framed(self, from_id: str, to_id: str, action: str,
+                        payload: Any) -> Any:
+        with self._lock:
+            target = self._nodes.get(to_id)
+        if target is None:
             raise ConnectTransportException(to_id, action)
         wire = json.dumps(_encode(payload))
         with self._lock:

@@ -625,6 +625,10 @@ def _metric_leaf(key: str) -> tuple[str, str]:
         leaf = leaf[: -len("_in_millis")] + "_millis"
     if leaf == "total_started":
         leaf = "started"          # -> *_started_total, not *_total_started_*
+    if key == "total":
+        # a leaf literally named "total" is a counter whose family name
+        # already carries the suffix (es_search_hedged_total{outcome=})
+        return "total", "counter"
     if key in _COUNTER_LEAVES or key.endswith("_total") \
             or key.endswith("time_in_millis"):
         if not leaf.endswith("_total"):

@@ -169,7 +169,16 @@ class NodeService:
         # named bounded executors (ref ThreadPool.java:116); the HTTP layer
         # routes each request class through its pool, overflow -> 429
         from .common.threadpool import ThreadPool
-        self.thread_pool = ThreadPool()
+        self.thread_pool = ThreadPool(self.settings)
+        # serving-QoS admission control (serving/qos.py, ISSUE 9): per-
+        # traffic-class load shedding in front of the pools, driven by
+        # queue depth + breaker pressure + an EWMA of request latency —
+        # the same signal the batcher's deadline-aware window and the
+        # hedged-read coordinator key off
+        from .serving.qos import QosController
+        self.qos = QosController(self.settings,
+                                 thread_pool=self.thread_pool,
+                                 breakers=self.breakers)
         # NodeEnvironment dir lock (ref env/NodeEnvironment.java:118 —
         # an flock on the node dir so two nodes can't share data paths)
         self._node_lock = open(os.path.join(data_path, "node.lock"), "w")
@@ -713,6 +722,9 @@ class NodeService:
     def _record_phase(self, phase: str, ms: float) -> None:
         self.phase_timers.record(phase, ms)
         self.metrics.record(f"search.{phase}", ms)
+        if phase == "total":
+            # feed the QoS latency EWMA: every served search, every lane
+            self.qos.record_latency(ms)
 
     def _parse_cached(self, name: str, query):
         """Parse a query through the node-level query-plan cache
@@ -875,6 +887,53 @@ class NodeService:
             except Exception:  # noqa: BLE001 — degrade to the general path
                 self._packed_error()
 
+        # coalesced general lane (serving/batcher.py, ISSUE 9): bodies the
+        # packed kernel can't serve but the batched executor can (plan-
+        # shaped queries, aggs, knn, rescore) coalesce behind a leader.
+        # The LEADER runs the ordinary solo path below — idle-path latency
+        # and solo responses are exactly the pre-QoS engine's — while
+        # requests arriving during its run queue as followers and are
+        # served as ONE Q>1 batched program riding the stacked/blockwise/
+        # mesh replica axis, bitwise-identical to solo execution
+        # (tests/test_qos.py parity matrix). Cacheable bodies skip the
+        # lane so the request cache keeps filling.
+        if (len(names) == 1 and cache_key is None
+                and not body.get("profile") and self.qos.enabled()):
+            from .common.metrics import current_profiler as _cur_prof
+            bkey = self._msearch_batch_key(names[0], body) \
+                if _cur_prof() is None else None
+            if bkey is not None:
+                from .serving.batcher import LEAD
+                got = self._batcher.join_batched(bkey, body)
+                if got is LEAD:
+                    try:
+                        return self._search_general(
+                            index, names, body, size, from_, sort,
+                            alias_flt, cache_key, t0, tns0)
+                    finally:
+                        self._batcher.drain_batched(bkey, names[0])
+                if got is not None:
+                    # follower served from the shared batch: only TOTAL is
+                    # honest (wall time includes queue wait + shared work)
+                    took = (time.perf_counter() - t0) * 1000
+                    self._record_phase("total", took)
+                    tid, oid = self._trace_ids()
+                    if self.slowlog.maybe_log(
+                            self.indices[names[0]].settings, names[0],
+                            took, body, trace_id=tid,
+                            opaque_id=oid) is not None:
+                        tracing.mark_slowlog()
+                    return got
+                # timeout/strand/unservable batch: serve solo below
+        return self._search_general(index, names, body, size, from_, sort,
+                                    alias_flt, cache_key, t0, tns0)
+
+    def _search_general(self, index, names, body, size, from_, sort,
+                        alias_flt, cache_key, t0, tns0):
+        """The general QUERY_THEN_FETCH driver (mesh -> concurrent fan-out
+        -> per-segment ladder) — everything below the fast serving lanes.
+        Split from _search_exec so a coalescing LEADER can execute it for
+        itself and drain its followers in a finally."""
         # SearchStats query_total for the general path (the packed/batcher
         # lanes and _search_batched count their own serves)
         self.meters["search"].mark()
@@ -2594,6 +2653,9 @@ class NodeService:
         from .common.metrics import (bulk_docs_histogram,
                                      bulk_ingest_snapshot, host_merge_count,
                                      peak_score_matrix_bytes)
+        from .serving.qos import hedge_snapshot
+        qos_stats = self.qos.stats()
+        qos_by_class = qos_stats.pop("by_class")
         search_exec = {
             "segment_dispatches_total":
                 path_totals.get("segment_dispatches", 0),
@@ -2650,6 +2712,14 @@ class NodeService:
                           {str(n): {"count": c}
                            for n, c in sorted(
                                bulk_docs_histogram().items())}),
+            # serving-QoS (ISSUE 9): per-class admission/shed counters +
+            # the pressure/EWMA gauges, and hedged-read outcomes
+            # (es_qos_shed_total{class=}, es_search_hedged_total{outcome=})
+            "qos": ("class", qos_by_class),
+            "qos_node": (None, qos_stats),
+            "search_hedged": ("outcome",
+                              {o: {"total": c}
+                               for o, c in hedge_snapshot().items()}),
             "jit": (None, {"compiles": compiles,
                            "compile_time_in_millis": round(compile_ms, 3)}),
             "transfer": (None, transfer_snapshot()),
@@ -2717,6 +2787,21 @@ class NodeService:
         }
         from .common.metrics import peak_score_matrix_bytes
         out["peak_score_matrix_bytes"] = peak_score_matrix_bytes()
+        # serving-QoS gauges (ISSUE 9): queue depth, shed/hedge rates —
+        # the signals a tail-latency incident inspection reaches for
+        from .serving.qos import hedge_rate, hedge_snapshot
+        qos = self.qos.stats()
+        out["qos_pressure"] = qos["pressure"]
+        out["qos_queue_depth"] = pool.get("queue", 0)
+        out["qos_shed_rate_1m"] = qos["shed_rate_1m"]
+        out["qos_shed_total"] = sum(c["shed_total"]
+                                    for c in qos["by_class"].values())
+        out["qos_degraded"] = qos["degraded"]
+        out["hedge_rate_1m"] = hedge_rate(60)
+        out["hedged_fired_total"] = hedge_snapshot()["fired"]
+        bst = batcher
+        out["batcher_stranded_total"] = bst["stranded_total"]
+        out["batcher_wait_timeouts_total"] = bst["wait_timeouts_total"]
         tr = self.tracer.stats()
         out["tracing_active_traces"] = tr["active_traces"]
         out["tracing_dropped_total"] = tr["dropped_traces_total"]

@@ -13,6 +13,7 @@ native runtime milestone; the handler table below is transport-agnostic.)
 
 from __future__ import annotations
 
+import contextlib
 import json
 import fnmatch
 import os
@@ -40,9 +41,11 @@ class RestError(Exception):
 def _status_of(e: Exception) -> int:
     from ..common.breaker import CircuitBreakingException
     from ..common.threadpool import EsRejectedExecutionException
+    from ..serving.qos import QosShedException
     if isinstance(e, RestError):
         return e.status
-    if isinstance(e, (CircuitBreakingException, EsRejectedExecutionException)):
+    if isinstance(e, (CircuitBreakingException, EsRejectedExecutionException,
+                      QosShedException)):
         return 429     # TOO_MANY_REQUESTS, ref EsRejectedExecutionException
     from ..snapshots import (RepositoryException, SnapshotException,
                              SnapshotMissingException)
@@ -2850,6 +2853,15 @@ def _parse_bulk(body: bytes, default_index: str | None) -> list:
 
 # ---------------------------------------------------------------------------
 
+# which QoS traffic class admits each pool-routed request class (the
+# reference's five connection types, NettyTransport.java:180-184 — REST
+# traffic is read (search-class) or write (bulk-class); state/ping are
+# transport-internal and never shed). Pool None (management) skips
+# admission entirely: control-plane reads must work DURING an overload.
+_TRAFFIC_CLASS_OF = {"search": "search", "get": "search",
+                     "bulk": "bulk", "index": "bulk"}
+
+
 def _pool_of(method: str, path: str) -> str | None:
     """Which named thread pool serves this request class (ref
     ThreadPool.Names mapping in each TransportAction's executor()); None =
@@ -2917,25 +2929,45 @@ class HttpServer:
                             "application/json; charset=UTF-8", method)
                         return
                 req_headers = {k.lower(): v for k, v in self.headers.items()}
+                extra_headers: dict = {}
                 try:
-                    # admission control: each request class runs on its
-                    # named bounded pool; queue overflow -> 429 before any
-                    # engine/device work (ref ThreadPool.java:116 +
-                    # EsRejectedExecutionException)
+                    # admission control (serving/qos.py, ISSUE 9): the QoS
+                    # controller sheds excess load per traffic class as
+                    # 429 + Retry-After BEFORE the pool, then each request
+                    # class runs on its named bounded pool; queue overflow
+                    # -> 429 before any engine/device work (ref
+                    # ThreadPool.java:116 + EsRejectedExecutionException)
                     pool = _pool_of(method, parsed.path)
                     tp = getattr(node, "thread_pool", None)
-                    if pool is None or tp is None:
-                        status, payload = controller.dispatch(
-                            method, parsed.path, params, body, req_headers)
-                    else:
-                        status, payload = tp.submit(
-                            pool, controller.dispatch,
-                            method, parsed.path, params, body,
-                            req_headers).result()
+                    qos = getattr(node, "qos", None)
+                    tclass = _TRAFFIC_CLASS_OF.get(pool)
+                    admission = qos.admit(tclass) \
+                        if qos is not None and tclass is not None \
+                        else contextlib.nullcontext()
+                    with admission:
+                        if pool is None or tp is None:
+                            status, payload = controller.dispatch(
+                                method, parsed.path, params, body,
+                                req_headers)
+                        else:
+                            status, payload = tp.submit(
+                                pool, controller.dispatch,
+                                method, parsed.path, params, body,
+                                req_headers).result()
                 except Exception as e:  # noqa: BLE001 — REST error contract
                     status = _status_of(e)
                     payload = {"error": f"{type(e).__name__}: {e}",
                                "status": status}
+                    if status == 429:
+                        # backpressure contract: every shed/rejection
+                        # carries a client backoff hint (never a 5xx)
+                        retry = getattr(e, "retry_after_s", None)
+                        if retry is None and getattr(node, "qos", None) \
+                                is not None:
+                            retry = node.qos.retry_after_s()
+                        import math as _math
+                        extra_headers["Retry-After"] = \
+                            str(int(_math.ceil(retry or 1.0)))
                 fmt = params.get("format", [None])[0]
                 if isinstance(payload, bytes):
                     data = payload           # pre-serialized JSON fast lane
@@ -2954,9 +2986,11 @@ class HttpServer:
                     data = json.dumps(payload).encode("utf-8")
                     ctype = "application/json; charset=UTF-8"
                 self._reply(status, data, ctype, method,
-                            opaque_id=req_headers.get("x-opaque-id"))
+                            opaque_id=req_headers.get("x-opaque-id"),
+                            extra=extra_headers)
 
-            def _reply(self, status, data, ctype, method, opaque_id=None):
+            def _reply(self, status, data, ctype, method, opaque_id=None,
+                       extra=None):
                 if method == "HEAD":
                     data = b""
                 self.send_response(status)
@@ -2965,6 +2999,8 @@ class HttpServer:
                 if opaque_id:
                     # the reference echoes X-Opaque-Id on every response
                     self.send_header("X-Opaque-Id", opaque_id)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -14,18 +14,47 @@ takes the whole accumulated queue as the next batch. Under load, batch
 size self-tunes to (arrival rate x device latency) — exactly the dynamic
 batching window, without a sleep on the idle path.
 
+Two lanes share the leader/follower core (ISSUE 9):
+
+  * the PACKED lane (`submit`) — packed-spec-eligible bodies ride the
+    packed view kernel as before;
+  * the COALESCED GENERAL lane (`join_batched`/`drain_batched`) — bodies
+    the packed kernel can't serve but `_search_batched` can (plan-shaped
+    queries, aggs, knn, rescore) coalesce onto the stacked/blockwise/mesh
+    Q>1 replica axis. The first request LEADS by running the ordinary
+    solo path (idle-path latency stays zero and solo responses are
+    byte-identical to the pre-QoS engine); requests arriving while it
+    runs queue as followers, and the leader drains them as Q>1
+    `_search_batched` batches — results bitwise-identical to solo
+    execution (tests/test_qos.py parity matrix).
+
+Followers wait under a DEADLINE-AWARE timeout (QosController.
+follower_wait_s — a multiple of the EWMA device latency, never the old
+silent hard-coded 30 s); timeouts and leader-exit strandings are counted
+and surfaced on `/_metrics` (`es_search_batcher_wait_timeouts_total`,
+`es_search_batcher_stranded_total`), and batch-execution errors are
+recorded (`run_errors_total` + `last_error`), not discarded.
+
 ref: the role of org.elasticsearch.threadpool.ThreadPool's SEARCH pool —
 but the unit of concurrency is a device batch, not a thread.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
+logger = logging.getLogger("elasticsearch_tpu.serving.batcher")
+
+#: sentinel returned by `join_batched` when the caller holds leadership —
+#: it must run the solo path itself, then call `drain_batched`.
+LEAD = object()
+
 
 class _Entry:
-    __slots__ = ("body", "spec", "event", "out", "err", "t_submit")
+    __slots__ = ("body", "spec", "event", "out", "err", "t_submit",
+                 "abandoned")
 
     def __init__(self, body, spec):
         self.body = body
@@ -34,12 +63,15 @@ class _Entry:
         self.out = None          # response dict, or None -> general path
         self.err = None
         self.t_submit = time.perf_counter()
+        self.abandoned = False   # follower timed out; don't spend a row
 
 
 class SearchBatcher:
     """Per-node coalescer for packed-eligible solo searches."""
 
     MAX_BATCH = 32               # one device batch == one warm Q bucket
+
+    _log_budget = 10             # rate-limited anomaly logging (per class)
 
     def __init__(self, node):
         self.node = node
@@ -53,12 +85,78 @@ class SearchBatcher:
         # (occupancy 1 = no coalescing happened; near MAX_BATCH = the
         # arrival rate saturates the device latency window)
         self.occupancy: dict[int, int] = {}
+        # ISSUE 9 satellite: the silent failure paths are now counted —
+        # stranded followers (leader exited with entries still queued),
+        # follower wait timeouts (the old hard 30 s fell through with no
+        # signal), and batch-execution errors (the swallowed `ex`)
+        self.stranded = 0
+        self.wait_timeouts = 0
+        self.run_errors = 0
+        self.last_error: str | None = None
+
+    # -- shared plumbing ---------------------------------------------------
+
+    def _window(self) -> int:
+        """Coalescing window: MAX_BATCH when healthy; the QoS controller
+        shrinks it under degrade pressure (smaller batches = lower
+        per-batch latency) before any request sheds."""
+        qos = getattr(self.node, "qos", None)
+        if qos is not None:
+            return qos.batch_window(self.MAX_BATCH)
+        return self.MAX_BATCH
+
+    def _wait_timeout(self) -> float:
+        qos = getattr(self.node, "qos", None)
+        if qos is not None:
+            return qos.follower_wait_s()
+        return 30.0
+
+    @classmethod
+    def _log_anomaly(cls, msg: str, *args, exc_info: bool = False) -> None:
+        if cls._log_budget > 0:
+            cls._log_budget -= 1
+            logger.warning(msg, *args, exc_info=exc_info)
+
+    def _wait(self, e: _Entry):
+        """Follower wait with the deadline-aware timeout; a timeout falls
+        to the general path, counted and logged instead of silent."""
+        if not e.event.wait(timeout=self._wait_timeout()):
+            e.abandoned = True
+            with self._lock:
+                self.wait_timeouts += 1
+            self._log_anomaly(
+                "batcher follower timed out after %.1fs waiting for its "
+                "leader; serving via the general path",
+                self._wait_timeout())
+            return None
+        if e.err is not None:
+            raise e.err
+        return e.out
+
+    def _release(self, key: tuple) -> None:
+        """Leader exit: release leadership and unblock any leftover
+        followers (they serve themselves on the general path) — counted,
+        because a nonzero rate means the leader loop exited abnormally."""
+        with self._lock:
+            self._busy.discard(key)
+            leftover = self._queues.pop(key, [])
+            self.stranded += len(leftover)
+        for x in leftover:   # no leader left: don't strand them silently
+            x.out = None
+            x.event.set()
+        if leftover:
+            self._log_anomaly(
+                "batcher leader exited with %d followers still queued; "
+                "they fall to the general path", len(leftover))
+
+    # -- the packed lane ---------------------------------------------------
 
     def submit(self, key: tuple, name: str, body: dict, spec,
                size: int, from_: int, t0: float):
         """Execute (or join) a packed batch for this request. Returns the
         response dict, or None when the request must take the general path
         (unservable batch / view refusal)."""
+        key = ("packed", *key)
         e = _Entry(body, spec)
         with self._lock:
             self._queues.setdefault(key, []).append(e)
@@ -66,28 +164,22 @@ class SearchBatcher:
             if leader:
                 self._busy.add(key)
         if not leader:
-            e.event.wait(timeout=30.0)
-            if e.err is not None:
-                raise e.err
-            return e.out
+            return self._wait(e)
 
         try:
             while True:
                 with self._lock:
                     batch = self._queues.pop(key, [])
+                    batch = [x for x in batch if not x.abandoned]
                     if not batch:
                         break
-                    if len(batch) > self.MAX_BATCH:
-                        self._queues[key] = batch[self.MAX_BATCH:]
-                        batch = batch[:self.MAX_BATCH]
+                    window = self._window()
+                    if len(batch) > window:
+                        self._queues[key] = batch[window:]
+                        batch = batch[:window]
                 self._run(key, name, batch, size, from_, t0)
         finally:
-            with self._lock:
-                self._busy.discard(key)
-                leftover = self._queues.pop(key, [])
-            for x in leftover:   # no leader left: don't strand them
-                x.out = None
-                x.event.set()
+            self._release(key)
         if e.err is not None:
             raise e.err
         return e.out
@@ -108,22 +200,101 @@ class SearchBatcher:
                 name, [x.body for x in batch], size=size, from_=from_,
                 t0=t0, specs=[x.spec for x in batch])
         except Exception as ex:  # noqa: BLE001 — degrade each to general
+            self._record_error(ex)
             self.node._packed_error()
             for x in batch:
                 x.out = None
                 x.event.set()
             return
+        self._book(batch)
+        for i, x in enumerate(batch):
+            x.out = None if outs is None else outs[i]
+            x.event.set()
+
+    # -- the coalesced general lane (ISSUE 9) ------------------------------
+
+    def join_batched(self, key: tuple, body: dict):
+        """The coalesced general lane's entry point. Returns the LEAD
+        sentinel when the caller acquired leadership — it must execute
+        the ordinary solo path for itself and call `drain_batched(key,
+        index)` when done (a try/finally at the call site). Otherwise the
+        caller is a follower: blocks until the leader serves it and
+        returns the response dict, or None when it must fall to the
+        general path (timeout / strand / unservable batch)."""
+        key = ("gen", *key)
+        with self._lock:
+            if key not in self._busy:
+                self._busy.add(key)
+                return LEAD
+            e = _Entry(body, None)
+            self._queues.setdefault(key, []).append(e)
+        return self._wait(e)
+
+    def drain_batched(self, key: tuple, index: str) -> None:
+        """Leader epilogue: serve every follower that queued behind this
+        leader's solo execution as Q>1 `_search_batched` batches, then
+        release leadership. Never raises — a failing batch degrades its
+        members to the general path."""
+        key = ("gen", *key)
+        try:
+            while True:
+                with self._lock:
+                    batch = self._queues.pop(key, [])
+                    batch = [x for x in batch if not x.abandoned]
+                    if not batch:
+                        break
+                    window = self._window()
+                    if len(batch) > window:
+                        self._queues[key] = batch[window:]
+                        batch = batch[:window]
+                self._run_batched(index, batch)
+        finally:
+            self._release(key)
+
+    def _run_batched(self, index: str, batch: list[_Entry]) -> None:
+        now = time.perf_counter()
+        metrics = getattr(self.node, "metrics", None)
+        if metrics is not None:
+            for x in batch:
+                metrics.record("batcher.queue_wait",
+                               (now - x.t_submit) * 1000)
+        try:
+            outs = self.node._search_batched(
+                [(index, x.body) for x in batch])
+        except Exception as ex:  # noqa: BLE001 — degrade each to general
+            self._record_error(ex)
+            self._log_anomaly(
+                "coalesced batch failed; members fall to the general "
+                "path", exc_info=True)
+            for x in batch:
+                x.out = None
+                x.event.set()
+            return
+        self._book(batch)
+        for x, out in zip(batch, outs):
+            x.out = out
+            x.event.set()
+
+    # -- accounting --------------------------------------------------------
+
+    def _book(self, batch: list[_Entry]) -> None:
         with self._lock:
             self.batches += 1
             self.batched_requests += len(batch)
             self.occupancy[len(batch)] = \
                 self.occupancy.get(len(batch), 0) + 1
-        for i, x in enumerate(batch):
-            x.out = None if outs is None else outs[i]
-            x.event.set()
+
+    def _record_error(self, ex: BaseException) -> None:
+        with self._lock:
+            self.run_errors += 1
+            self.last_error = f"{type(ex).__name__}: {ex}"
 
     def stats(self) -> dict:
         with self._lock:
             return {"batches": self.batches,
                     "batched_requests": self.batched_requests,
+                    "stranded_total": self.stranded,
+                    "wait_timeouts_total": self.wait_timeouts,
+                    "run_errors_total": self.run_errors,
+                    "last_error": self.last_error,
                     "occupancy": dict(sorted(self.occupancy.items()))}

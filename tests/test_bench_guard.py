@@ -63,6 +63,24 @@ def test_sigterm_first_strike_emits_json_on_hang():
     assert "terminated by signal" in line.get("error", "")
 
 
+def test_tail_latency_keys_survive_forced_timeout():
+    """ISSUE 9: the tail-latency headline keys (conc_p99_ms, shed_429s,
+    hedged_wins) are seeded into the always-emitted line at import time,
+    so a forced timeout mid-run still reports them (null, not absent)."""
+    proc = subprocess.Popen(
+        [sys.executable, BENCH],
+        env=_env(BENCH_TIME_BUDGET="600"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    time.sleep(2.0)
+    proc.send_signal(signal.SIGTERM)
+    stdout, stderr = proc.communicate(timeout=30)
+    assert proc.returncode == 0, stderr[-500:]
+    line = _json_line(stdout)
+    for key in ("conc_p99_ms", "shed_429s", "hedged_wins"):
+        assert key in line, f"[{key}] must survive a forced timeout"
+        assert line[key] is None       # nothing measured before the kill
+
+
 def test_guards_installed_before_first_leg():
     """Source-order tripwire: the bailout install happens at module scope
     (before any leg can run), not inside main_engine()."""

@@ -118,12 +118,13 @@ def test_exposition_is_valid_and_broad(http):
     families = scrape(req)
     n_series = sum(len(f["samples"]) for f in families.values())
     subsystems = {name.split("_")[1] for name in families}
-    # acceptance floor: ≥60 series (ISSUE-5 bumped it from 55 — the
-    # tracing registry adds 8 families)
-    assert n_series >= 60, f"only {n_series} series"
+    # acceptance floor: ≥200 series (ISSUE-9 re-anchored it from 60 — the
+    # fixture scrape measures ~247 once the qos/hedge/batcher registries
+    # joined; a regression that silently drops a registry lands far below)
+    assert n_series >= 200, f"only {n_series} series"
     for want in ("threadpool", "breaker", "search", "timer", "jit",
                  "transfer", "index", "tasks", "rate", "process", "os",
-                 "cache", "tracing"):
+                 "cache", "tracing", "qos"):
         assert want in subsystems, f"subsystem [{want}] missing"
     # every sample carries the node label
     for fam in families.values():
@@ -183,6 +184,27 @@ def test_blockwise_families_exposed(http):
     # the dense size=0 search in the fixture materialized SOME score state
     (_, peak), = families["es_search_peak_score_matrix_bytes"]["samples"]
     assert peak >= 0
+
+
+def test_qos_families_exposed(http):
+    """ISSUE 9: the serving-QoS registries ride the scrape — per-class
+    shed/admission counters, the pressure gauges, hedge outcomes and the
+    batcher anomaly counters, each with the right metric type."""
+    node, req = http
+    families = scrape(req)
+    for fam, mtype in (("es_qos_shed_total", "counter"),
+                       ("es_qos_admitted_total", "counter"),
+                       ("es_qos_inflight", "gauge"),
+                       ("es_qos_node_pressure", "gauge"),
+                       ("es_search_hedged_total", "counter"),
+                       ("es_search_batcher_stranded_total", "counter"),
+                       ("es_search_batcher_wait_timeouts_total", "counter"),
+                       ("es_search_batcher_run_errors_total", "counter")):
+        assert fam in families, fam
+        assert families[fam]["type"] == mtype, fam
+    classes = {lb["class"] for lb, _
+               in families["es_qos_shed_total"]["samples"]}
+    assert classes == {"search", "bulk", "recovery", "state", "ping"}
 
 
 def test_new_timer_joins_the_scrape_automatically(http):

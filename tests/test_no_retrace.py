@@ -311,3 +311,44 @@ def test_tracing_active_adds_no_device_work(stacked_node):
     assert device_events_snapshot()[0] - c0 == 0
     t = n.tracer.list()[0]
     assert t["span_count"] >= 3               # spans recorded, device idle
+
+
+# -- serving-QoS lane (ISSUE 9) ---------------------------------------------
+
+
+def test_qos_idle_adds_zero_device_work(stacked_node):
+    """With QoS on (the default) an idle-path solo search — the coalesced
+    lane's LEADER with no followers — performs exactly the same device
+    work as with the subsystem disabled: same fetch count, zero compiles,
+    zero batches consumed. QoS must be free until there is concurrency."""
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+    body = {"size": 5, "_source": False, "query": {"bool": {"should": [
+        {"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+    assert n._msearch_batch_key("s", body) is not None, \
+        "tripwire body must be coalescing-eligible"
+    n.search("s", json.loads(json.dumps(body)))           # warm
+    b0 = n._batcher.stats()
+    f0 = transfer_snapshot()["device_fetches_total"]
+    c0 = device_events_snapshot()[0]
+    n.search("s", json.loads(json.dumps(body)))           # qos ON (default)
+    f1 = transfer_snapshot()["device_fetches_total"]
+    c1 = device_events_snapshot()[0]
+    n.settings._map["node.search.qos.enable"] = False
+    try:
+        n.search("s", json.loads(json.dumps(body)))       # qos OFF
+    finally:
+        n.settings._map.pop("node.search.qos.enable", None)
+    f2 = transfer_snapshot()["device_fetches_total"]
+    c2 = device_events_snapshot()[0]
+    assert c1 - c0 == 0 and c2 - c1 == 0                  # no retrace either way
+    assert f1 - f0 == f2 - f1, \
+        "idle QoS lane must add zero device fetches"
+    b1 = n._batcher.stats()
+    assert b1["batches"] == b0["batches"], \
+        "a solo leader with no followers must not consume a device batch"
+    assert b1["wait_timeouts_total"] == b0["wait_timeouts_total"]
+    assert b1["stranded_total"] == b0["stranded_total"]

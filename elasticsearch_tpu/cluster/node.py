@@ -1608,6 +1608,11 @@ class ClusterNode:
         query = body.get("query") or {"match_all": {}}
         if body.get("knn") is not None and body.get("sort") is not None:
             raise ValueError("knn search cannot be combined with sort")
+        if body.get("rank") is not None:
+            # hybrid fusion is a single-node coordinator feature so far;
+            # silently serving the knn list alone would misrepresent it
+            raise ValueError(
+                "rank fusion is not supported on the cluster search path")
         dfs = self._dfs_stats(targets, query, names) \
             if body.get("knn") is None else None
         agg_specs = None
@@ -1778,9 +1783,13 @@ class ClusterNode:
                 block_docs = int(get_s("search.block_docs", 0)) or None
             except (TypeError, ValueError):
                 block_docs = None
+            # kNN/ANN settings ride the cluster state the same way, so
+            # cluster shard copies serve the same lane as a local node
+            from ..index.index_service import knn_options_from
             holder.searcher = (key, ShardSearcher(
                 sid, eng.segments, self._mappers[index],
-                blockwise=blockwise, block_docs=block_docs))
+                blockwise=blockwise, block_docs=block_docs,
+                knn_opts=knn_options_from(get_s)))
         return holder.searcher[1]
 
     @contextlib.contextmanager
@@ -2124,10 +2133,13 @@ def _shard_query_phase(searcher: ShardSearcher, mappers: MapperService,
 
     if knn is not None:
         fnode = searcher.parse([knn["filter"]]) if knn.get("filter") else None
+        raw_np = knn.get("nprobe")
         r = searcher.execute_knn(
             knn["field"], [knn["query_vector"]],
             k=int(knn.get("k", k)), metric=knn.get("metric", "cosine"),
-            filter_node=fnode)
+            filter_node=fnode,
+            nprobe=int(raw_np) if raw_np is not None else None,
+            exact=bool(knn.get("exact", False)))
     else:
         node = searcher.parse([body.get("query") or {"match_all": {}}])
         r = searcher.execute_query_phase(

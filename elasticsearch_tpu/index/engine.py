@@ -124,7 +124,7 @@ class Engine:
     def __init__(self, shard_path: str, mappers: MapperService,
                  type_name_default: str = "_doc", durability: str = "request",
                  breaker=None, fielddata_cache=None, index_name=None,
-                 vectorized: bool = True):
+                 vectorized: bool = True, ann_cache=None):
         self.path = shard_path
         self.mappers = mappers
         # the vectorized bulk-ingest lane (index/bulk_ingest.py): batched
@@ -139,6 +139,9 @@ class Engine:
         # when attached, built sort columns live THERE (LRU, evictable
         # under breaker pressure) instead of pinned per-segment dicts
         self.fielddata_cache = fielddata_cache
+        # node-level IVF cluster-index tier (AnnIndexCache): the ANN kNN
+        # lane's centroids + CSR live there, dying with their segment
+        self.ann_cache = ann_cache
         self.index_name = index_name
         self._blocked_reason = None
         os.makedirs(shard_path, exist_ok=True)
@@ -680,6 +683,7 @@ class Engine:
         `_cache/clear?index=` can target them)."""
         seg.breaker = self.breaker
         seg.fielddata_cache = self.fielddata_cache
+        seg.ann_cache = self.ann_cache
         seg.index_name = self.index_name
 
     def _drop_fielddata(self, sources: list[Segment]) -> None:
@@ -692,6 +696,8 @@ class Engine:
                 s.fielddata_cache.drop_segment(s)
             elif self.breaker is not None:
                 self.breaker.release(sum(s.fielddata_bytes().values()))
+            if getattr(s, "ann_cache", None) is not None:
+                s.ann_cache.drop_segment(s)
 
     def _charge_merge(self, merged: Segment, sources: list[Segment]) -> None:
         """Swap breaker accounting from the source segments to the merged

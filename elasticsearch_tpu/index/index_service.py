@@ -61,6 +61,8 @@ class IndexService:
             Engine(os.path.join(path, str(s)), self.mappers, breaker=fd,
                    fielddata_cache=caches.fielddata
                    if caches is not None else None,
+                   ann_cache=caches.ann_indexes
+                   if caches is not None else None,
                    index_name=name, vectorized=self._bulk_vectorized)
             for s in range(self.n_shards)]
         self.creation_date = None
@@ -98,6 +100,13 @@ class IndexService:
             self._block_docs = int(raw_bd)
         except (TypeError, ValueError):
             self._block_docs = DEFAULT_BLOCK_DOCS
+        # IVF-clustered ANN kNN lane (ops/ann.py): knn queries over
+        # columns past `index.knn.ivf.min_docs` route through a trained
+        # cluster index instead of the full [Q, N] matmul. Opt out with
+        # `index.knn.ivf.enable: false`; nlist/nprobe default to
+        # ~sqrt(N) / nlist/8 when 0. `index.knn.precision` pins the
+        # matmul dtype (bf16 default, f32 for exact-parity workloads).
+        self._knn_opts = knn_options_from(get)
         # op counters surfaced by _stats (ref index/shard stats holders:
         # IndexingStats w/ per-type breakdown, SearchStats w/ groups, GetStats)
         self.indexing_stats: dict = {"index_total": 0, "delete_total": 0,
@@ -276,6 +285,7 @@ class IndexService:
         if self.caches is not None:
             self.caches.segment_stacks.clear([self.name])
             self.caches.mesh_stacks.clear([self.name])
+            self.caches.ann_indexes.clear([self.name])
 
     def delete_files(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)
@@ -297,7 +307,8 @@ class IndexService:
                     blockwise=self._blockwise_enabled,
                     block_docs=self._block_docs,
                     request_breaker=self.breakers.breaker("request")
-                    if self.breakers is not None else None))
+                    if self.breakers is not None else None,
+                    knn_opts=self._knn_opts))
                 self._searcher_cache[si] = cached
             out.append(cached[1])
         return out
@@ -371,3 +382,30 @@ class IndexService:
 
     def mappings_dict(self) -> dict:
         return self.mappers.mappings_dict()
+
+
+def knn_options_from(get) -> dict:
+    """Read the kNN/ANN settings roster through an `(key, default)`
+    getter (index Settings here; cluster-state dicts in cluster/node.py
+    read the same keys for searcher parity)."""
+    def as_bool(v, default=True):
+        if v is None:
+            return default
+        return str(v).strip().lower() not in ("false", "0", "no")
+
+    def as_int(v, default=0):
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    precision = str(get("knn.precision", "bf16")).strip().lower()
+    if precision not in ("bf16", "f32"):
+        precision = "bf16"
+    return {
+        "ivf_enable": as_bool(get("knn.ivf.enable", True)),
+        "nlist": as_int(get("knn.ivf.nlist", 0)),
+        "nprobe": as_int(get("knn.ivf.nprobe", 0)),
+        "min_docs": as_int(get("knn.ivf.min_docs", 4096), 4096),
+        "precision": precision,
+    }

@@ -141,9 +141,83 @@ class NumericColumn:
 
 
 @dataclass
+class IvfData:
+    """IVF cluster layout for one vector column (ops/ann.py): k-means
+    centroids + a cluster->doc CSR in exactly the postings layout text
+    fields use — clusters are "terms", members sorted by doc id. Built
+    once per (segment, field, nlist), cached breaker-charged in
+    indices/cache_service.AnnIndexCache."""
+    centroids: jax.Array             # f32[nlist, dims]
+    starts: jax.Array                # i32[nlist]  CSR starts (device)
+    sizes: jax.Array                 # i32[nlist]  cluster sizes (device)
+    slot_docs: jax.Array             # i32[N_pad]  docs sorted by (cluster, doc)
+    norms: jax.Array                 # f32[N_pad]  per-doc L2 norms
+    sizes_desc_cum: np.ndarray       # i64[nlist]  cumsum of sizes, desc
+    nlist: int
+    n_docs: int
+    dims: int
+    nbytes: int
+
+
+@dataclass
 class VectorColumn:
     vecs: jax.Array                  # f32[N_pad, dims]
     dims: int
+
+    def build_ivf(self, n_docs: int, nlist: int | None = None, *,
+                  iters: int | None = None) -> "IvfData | None":
+        """Train k-means centroids (device Lloyd iterations over a
+        deterministic sample) and build the cluster->doc CSR with ONE
+        composite-key argsort. None when the column is too small to
+        cluster usefully (callers fall back to exact kNN)."""
+        from ..ops import ann as ann_ops
+        n_pad = int(self.vecs.shape[0])
+        if nlist is None:
+            nlist = ann_ops.auto_nlist(n_docs)
+        nlist = int(nlist)
+        if n_docs < 2 * nlist or nlist < 2:
+            return None
+        iters = int(iters or ann_ops.DEFAULT_ITERS)
+        # deterministic strided sample of real docs (no RNG: refresh→query
+        # cycles must reproduce the same clustering bit-for-bit). The
+        # sample pads to a pow2 bucket by wrapping around, so the jitted
+        # Lloyd program's shape — and its compile-cache entry — is stable
+        # across same-bucket segment sizes (test_ann retrace tripwire).
+        step = max(1, n_docs // ann_ops.TRAIN_SAMPLE_CAP)
+        sample_idx = np.arange(0, n_docs, step,
+                               dtype=np.int64)[: ann_ops.TRAIN_SAMPLE_CAP]
+        s_pad = min(next_pow2(len(sample_idx)), ann_ops.TRAIN_SAMPLE_CAP)
+        sample_idx = np.resize(sample_idx, s_pad).astype(np.int32)
+        sample = self.vecs[jnp.asarray(sample_idx)]
+        init_idx = sample_idx[:: max(1, len(sample_idx) // nlist)][:nlist]
+        if len(init_idx) < nlist:
+            return None
+        init = self.vecs[jnp.asarray(init_idx)]
+        cents = ann_ops.train_centroids(sample, init, nlist=nlist,
+                                        iters=iters)
+        blk = ann_ops.assign_block_size(n_pad)
+        assign = np.asarray(ann_ops.assign_clusters(
+            self.vecs, cents, block=blk))
+        # padding rows park in a phantom cluster `nlist` that is never
+        # probed; real docs keep their trained assignment
+        assign = assign.astype(np.int64)
+        assign[n_docs:] = nlist
+        order = np.argsort(assign * (n_pad + 1)
+                           + np.arange(n_pad, dtype=np.int64),
+                           kind="stable").astype(np.int32)
+        counts = np.bincount(assign, minlength=nlist + 1)[: nlist + 1]
+        starts = np.zeros(nlist, np.int64)
+        starts[1:] = np.cumsum(counts[: nlist - 1])
+        starts = starts.astype(np.int32)
+        sizes = counts[:nlist].astype(np.int32)
+        sizes_desc = np.sort(sizes)[::-1].astype(np.int64)
+        norms = jnp.linalg.norm(self.vecs, axis=1)
+        return IvfData(
+            centroids=cents, starts=jnp.asarray(starts),
+            sizes=jnp.asarray(sizes), slot_docs=jnp.asarray(order),
+            norms=norms, sizes_desc_cum=np.cumsum(sizes_desc),
+            nlist=nlist, n_docs=n_docs, dims=self.dims,
+            nbytes=ann_ops.ivf_nbytes(n_pad, nlist, self.dims))
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +348,18 @@ class Segment:
         if fx is None:
             return 0
         return fx.lookup(term)[1]
+
+    def total_term_freq(self, field: str, term: str) -> float:
+        """Sum of the term's frequencies across its postings (Lucene
+        totalTermFreq — the LM similarities' collection probability
+        numerator). One small device slice-sum per (term, segment)."""
+        fx = self.text.get(field)
+        if fx is None:
+            return 0.0
+        s, ln, _ = fx.lookup(term)
+        if ln == 0:
+            return 0.0
+        return float(np.asarray(fx.tf[s: s + ln]).sum())
 
     def field_stats(self, field: str) -> tuple[float, int]:
         """(sum_dl, doc_count) for avgdl computation across segments."""

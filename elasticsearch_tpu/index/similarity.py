@@ -13,6 +13,15 @@ Supported types:
                     with 1/sqrt(dl) length norm; scored by a dedicated
                     dense kernel (ops/bm25.classic_score_batch) — the
                     sparse/packed lanes decline these fields.
+  LMDirichlet     — language model with Dirichlet smoothing (`mu`,
+                    default 2000): ops/bm25.lm_dirichlet_score_batch. The
+                    collection probability p(t|C) is a precomputed
+                    per-term weight (CollectionStats.pcoll), so the device
+                    cost matches BM25's. Dense-lane only (sparse/stacked/
+                    blockwise/mesh decline — plans group by (sim, mu)).
+  LMJelinekMercer — language model with Jelinek-Mercer smoothing
+                    (`lambda`, default 0.1): ops/bm25.lm_jm_score_batch.
+                    Same lane contract as LMDirichlet.
 """
 
 from __future__ import annotations
@@ -22,13 +31,23 @@ from dataclasses import dataclass
 
 @dataclass(frozen=True)
 class Similarity:
-    type: str = "BM25"        # "BM25" | "classic"
+    type: str = "BM25"        # "BM25" | "classic" | "LMDirichlet" | "LMJelinekMercer"
     k1: float = 1.2
     b: float = 0.75
+    mu: float = 2000.0        # LMDirichlet smoothing
+    lam: float = 0.1          # LMJelinekMercer smoothing
 
 
 DEFAULT = Similarity()
 CLASSIC = Similarity(type="classic")
+LM_SIMS = ("lm_dirichlet", "lm_jm")   # MatchNode.sim tags (query_dsl)
+
+_SIM_TAG = {"LMDirichlet": "lm_dirichlet", "LMJelinekMercer": "lm_jm"}
+
+
+def sim_tag(sim: Similarity) -> str:
+    """The MatchNode.sim tag a Similarity scores under."""
+    return _SIM_TAG.get(sim.type, sim.type)
 
 
 class SimilarityService:
@@ -36,7 +55,9 @@ class SimilarityService:
 
     def __init__(self, settings=None):
         self.named: dict[str, Similarity] = {
-            "BM25": DEFAULT, "default": CLASSIC, "classic": CLASSIC}
+            "BM25": DEFAULT, "default": CLASSIC, "classic": CLASSIC,
+            "LMDirichlet": Similarity(type="LMDirichlet"),
+            "LMJelinekMercer": Similarity(type="LMJelinekMercer")}
         if settings is not None and hasattr(settings, "by_prefix"):
             for prefix in ("index.similarity.", "similarity."):
                 sims = settings.by_prefix(prefix)
@@ -46,6 +67,14 @@ class SimilarityService:
                     stype = sub.get_str("type", "BM25")
                     if stype in ("classic", "default"):
                         self.named[name] = CLASSIC
+                    elif stype == "LMDirichlet":
+                        self.named[name] = Similarity(
+                            type="LMDirichlet",
+                            mu=sub.get_float("mu", 2000.0))
+                    elif stype == "LMJelinekMercer":
+                        self.named[name] = Similarity(
+                            type="LMJelinekMercer",
+                            lam=sub.get_float("lambda", 0.1))
                     else:
                         self.named[name] = Similarity(
                             type="BM25",

@@ -277,6 +277,113 @@ class FielddataCache:
         return self.cache.stats()
 
 
+class _AnnEntry:
+    __slots__ = ("ivf", "nbytes", "breaker", "index_name", "field", "token")
+
+    def __init__(self, ivf, nbytes, breaker, index_name, field, token):
+        self.ivf = ivf
+        self.nbytes = nbytes
+        self.breaker = breaker
+        self.index_name = index_name
+        self.field = field
+        self.token = token
+
+
+class AnnIndexCache:
+    """Per-(segment, vector field, nlist) IVF cluster indexes for the ANN
+    kNN lane (ops/ann.py + index/segment.IvfData): k-means centroids + the
+    cluster->doc CSR, breaker-charged at build through `make_room`
+    admission (LRU IVF structures shed under `fielddata` pressure before
+    anything 429s), released on any removal. Entries die with their source
+    segment (Engine merge/close calls `drop_segment` — the same hook that
+    drops fielddata columns) and with `_cache/clear?query=`; vectors are
+    immutable per segment, so tombstones never invalidate an entry (the
+    query-time liveness mask handles them)."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.declined = 0                # breaker refused the build charge
+        self.cache = Cache("ann_index", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _AnnEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="ann_index",
+                              reason=reason, field=entry.field,
+                              bytes=entry.nbytes)
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+
+    def get_or_build(self, seg, field: str, nlist: int, build):
+        """The segment's IVF index for `field`, building (and charging the
+        segment's `fielddata` breaker) on first use. None when declined —
+        undersized column, build failure, or breaker pressure even after
+        shedding other entries (callers fall back to exact kNN)."""
+        token = FielddataCache.token_of(seg)
+        key = (token, field, int(nlist))
+        with tracing.span("cache.get", tier="ann_index",
+                          field=field) as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
+        if ent is not None:
+            return ent.ivf
+        from ..ops.ann import ivf_nbytes
+        vc = seg.vectors.get(field)
+        if vc is None:
+            return None
+        breaker = getattr(seg, "breaker", None)
+        est = ivf_nbytes(int(vc.vecs.shape[0]), int(nlist), vc.dims)
+        if breaker is not None:
+            try:
+                self.cache.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429 a search
+                self.declined += 1
+                return None
+        try:
+            with tracing.span("ann_ivf_build", field=field, nlist=nlist):
+                ivf = build()
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if ivf is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        nbytes = ivf.nbytes
+        if breaker is not None and nbytes != est:
+            if nbytes > est:      # true up estimate drift without re-tripping
+                breaker.add_estimate(nbytes - est, check=False)
+            else:
+                breaker.release(est - nbytes)
+        entry = _AnnEntry(ivf, nbytes, breaker,
+                          getattr(seg, "index_name", None), field, token)
+        if not self.cache.put(key, entry) and breaker is not None:
+            breaker.release(nbytes)   # refused by budget: nothing retained
+        return ivf
+
+    def drop_segment(self, seg) -> int:
+        """Invalidate every IVF index of a dead segment (merge/close) —
+        the removal listener releases the breaker charge."""
+        token = getattr(seg, "_fd_token", None)
+        if token is None:
+            return 0
+        return self.cache.invalidate_where(lambda k, _e: k[0] == token)
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(
+            lambda _k, e: e.index_name in want)
+
+    def stats(self) -> dict:
+        out = self.cache.stats()
+        out["declined"] = self.declined
+        return out
+
+
 class _StackEntry:
     __slots__ = ("stack", "nbytes", "breaker", "index_name")
 
@@ -536,6 +643,11 @@ class IndicesCacheService:
         self.mesh_stacks = MeshStackCache(
             max_bytes=parse_size(get("indices.mesh.cache.size", "10%"),
                                  total, default=total // 10))
+        # IVF cluster indexes for the ANN kNN lane (centroids + CSR ≈ 8
+        # bytes/doc + nlist*dims*4 — far below the vectors themselves)
+        self.ann_indexes = AnnIndexCache(
+            max_bytes=parse_size(get("indices.ann.cache.size", "10%"),
+                                 total, default=total // 10))
         # per-index packed-view caches (serving views) register here so
         # their byte totals surface without the service owning them
         self._registered: "weakref.WeakValueDictionary[str, Cache]" = \
@@ -593,11 +705,12 @@ class IndicesCacheService:
                 want = set(indices)
                 out["query"] = self.query_plan.invalidate_where(
                     lambda k, _v: k[0] in want)
-            # packed segment/mesh stacks are query-execution structures:
-            # they ride the `query` tier flag (removal releases their
-            # breaker charge)
+            # packed segment/mesh stacks and IVF cluster indexes are
+            # query-execution structures: they ride the `query` tier flag
+            # (removal releases their breaker charge)
             out["segment_stack"] = self.segment_stacks.clear(indices)
             out["mesh_stack"] = self.mesh_stacks.clear(indices)
+            out["ann_index"] = self.ann_indexes.clear(indices)
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
         return out
@@ -607,7 +720,8 @@ class IndicesCacheService:
                "query_plan": self.query_plan.stats(),
                "fielddata": self.fielddata.stats(),
                "segment_stack": self.segment_stacks.stats(),
-               "mesh_stack": self.mesh_stacks.stats()}
+               "mesh_stack": self.mesh_stacks.stats(),
+               "ann_index": self.ann_indexes.stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
         return out
@@ -618,3 +732,4 @@ class IndicesCacheService:
         self.fielddata.cache.clear()
         self.segment_stacks.cache.clear()
         self.mesh_stacks.cache.clear()
+        self.ann_indexes.cache.clear()

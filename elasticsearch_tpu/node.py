@@ -953,6 +953,8 @@ class NodeService:
         if _contains_mlt(query):
             query = self._expand_mlt(query, names)
         knn = body.get("knn")
+        from .search.query_parser import parse_rank
+        rank_spec = parse_rank(body.get("rank"))
         rescore_spec = body.get("rescore")
         if isinstance(rescore_spec, list):
             rescore_spec = rescore_spec[0] if rescore_spec else None
@@ -968,12 +970,29 @@ class NodeService:
         if rescore_spec is not None and sort is not None:
             # the reference's RescorePhase rejects rescore+sort outright
             raise QueryParsingException("rescore cannot be used with a sort")
+        if rank_spec is not None:
+            # hybrid fusion (ISSUE 10): both retrievers must exist, and the
+            # fused list has no sort/rescore interpretation
+            if knn is None:
+                raise QueryParsingException(
+                    "rank requires a knn section to fuse with the query")
+            if sort is not None:
+                raise QueryParsingException("rank cannot be used with a sort")
+            if rescore_spec is not None:
+                raise QueryParsingException(
+                    "rank cannot be combined with rescore")
+        rank_window = 0
+        knn_nprobe = None
+        knn_exact = False
         if knn is not None:
             if agg_specs:
                 # the knn phase computes no agg partials; silently returning
                 # empty aggregations would be a lie (advisor r1 finding)
                 raise QueryParsingException(
                     "aggregations are not supported with knn search")
+            raw_np = knn.get("nprobe")
+            knn_nprobe = int(raw_np) if raw_np is not None else None
+            knn_exact = bool(knn.get("exact", False))
             qv_single = knn.get("query_vector")
             if qv_single is None:
                 qvs = knn.get("query_vectors")
@@ -988,11 +1007,18 @@ class NodeService:
                 qv_single = qvs[0]
             if "field" not in knn:
                 raise QueryParsingException("knn requires a field")
-            # k is the user's neighbor count contract: the response carries
-            # at most min(k, size) hits (never silently raised — the reduce
-            # below shrinks size instead; k defaults to covering pagination)
-            knn_k = int(knn.get("k", size + from_))
-            size = min(size, max(knn_k - from_, 0))
+            if rank_spec is not None:
+                # fusion ranks over a per-retriever window, then returns
+                # the caller's size — knn.k defaults to the window
+                rank_window = rank_spec.window_size or max(size + from_, 10)
+                knn_k = int(knn.get("k", rank_window))
+            else:
+                # k is the user's neighbor count contract: the response
+                # carries at most min(k, size) hits (never silently raised
+                # — the reduce below shrinks size instead; k defaults to
+                # covering pagination)
+                knn_k = int(knn.get("k", size + from_))
+                size = min(size, max(knn_k - from_, 0))
 
         # index-global term statistics, shared by every shard: BOTH serving
         # lanes score with the same IDF, so packed vs fallback answers are
@@ -1000,7 +1026,7 @@ class NodeService:
         # here the default because stats are one host reduce away)
         global_stats = None
         nodes_by_index: dict[str, Any] = {}
-        if knn is None:
+        if knn is None or rank_spec is not None:
             from .search.query_dsl import CollectionStats
             terms_by_field: dict[str, set] = {}
             for n in names:
@@ -1047,7 +1073,19 @@ class NodeService:
                                           k=knn_k,
                                           metric=knn.get("metric",
                                                          "cosine"),
-                                          filter_node=fnode)
+                                          filter_node=fnode,
+                                          nprobe=knn_nprobe,
+                                          exact=knn_exact)
+                        if rank_spec is not None:
+                            # hybrid fusion: the text retriever runs in
+                            # the SAME shard pass; fuse_hybrid merges the
+                            # two global lists after the fan-out
+                            r_text = s.execute_query_phase(
+                                nodes_by_index[index_of[i]],
+                                size=rank_window, from_=0,
+                                global_stats=global_stats,
+                                track_scores=True)
+                            r = (r_text, r)
                     else:
                         r = s.execute_query_phase(
                             nodes_by_index[index_of[i]],
@@ -1126,8 +1164,10 @@ class NodeService:
                             "shard": searchers[i].shard_id,
                             "reason": f"{type(job.error).__name__}: "
                                       f"{job.error}"})
-                        results.append(_empty_shard_result(
-                            searchers[i].shard_id, sort=sort))
+                        er = _empty_shard_result(
+                            searchers[i].shard_id, sort=sort)
+                        results.append((er, er) if rank_spec is not None
+                                       else er)
                     else:
                         results.append(job.result)
                 if shard_failures == len(searchers) \
@@ -1141,10 +1181,17 @@ class NodeService:
         if prof is not None:
             prof.record_phase("query", (t_device_done - t_parse_done) * 1000)
         # the mesh lane already reduced ON DEVICE — sort_docs (the host
-        # cross-shard merge) runs only for the fan-out path
-        reduced = mesh_reduced if mesh_reduced is not None \
-            else controller.sort_docs(results, from_=from_, size=size,
-                                      sort=sort)
+        # cross-shard merge) runs only for the fan-out path; rank bodies
+        # fuse the two retrievers' GLOBAL lists on device instead
+        if mesh_reduced is not None:
+            reduced = mesh_reduced
+        elif rank_spec is not None:
+            reduced = controller.fuse_hybrid(
+                [t for t, _ in results], [v for _, v in results],
+                rank_spec, from_=from_, size=size)
+        else:
+            reduced = controller.sort_docs(results, from_=from_, size=size,
+                                           sort=sort)
         src_filter = body.get("_source")
         fields_spec = body.get("fields")
         if isinstance(fields_spec, str):
@@ -1886,10 +1933,13 @@ class NodeService:
                 qv = knn.get("query_vector")
                 if qv is None:
                     return None
+                raw_np = knn.get("nprobe")
                 return (index, int(body.get("size", 10)),
                         int(body.get("from", 0)), "knn", knn.get("field"),
                         int(knn.get("k", 10)),
-                        knn.get("metric", "cosine"), len(qv))
+                        knn.get("metric", "cosine"), len(qv),
+                        int(raw_np) if raw_np is not None else None,
+                        bool(knn.get("exact", False)))
             agg_key = None
             if aggs is not None:
                 from .search.aggs.aggregators import has_top_hits, parse_aggs
@@ -1943,9 +1993,13 @@ class NodeService:
             # batched exact kNN: one matmul per shard for the whole group
             qvs = [b["knn"]["query_vector"] for _, b in metas]
             knn_k = int(knn.get("k", 10))
+            raw_np = knn.get("nprobe")
             results = [
                 s.execute_knn(knn["field"], qvs, k=max(knn_k, size + from_),
-                              metric=knn.get("metric", "cosine"))
+                              metric=knn.get("metric", "cosine"),
+                              nprobe=int(raw_np) if raw_np is not None
+                              else None,
+                              exact=bool(knn.get("exact", False)))
                 for s in searchers]
             size = min(size, max(knn_k - from_, 0))
             return self._batched_reduce(metas, searchers, index_of, results,
@@ -2171,9 +2225,10 @@ class NodeService:
         if not names:
             raise IndexMissingException(index)
         alias_flt = self._alias_filters_by_index(index, names)
-        if any(k in body for k in ("knn", "rescore", "search_after")):
+        if any(k in body for k in ("knn", "rescore", "search_after",
+                                   "rank")):
             raise QueryParsingException(
-                "scroll does not support knn/rescore/search_after")
+                "scroll does not support knn/rescore/search_after/rank")
         from .search.sort import DOC, SCORE, SortSpec, parse_sort
         user_sort = parse_sort(body.get("sort"),
                                [self.indices[n].mappers for n in names])
@@ -2677,6 +2732,11 @@ class NodeService:
             "mesh_queries_total": path_totals.get("mesh", 0),
             "mesh_errors_total": path_totals.get("mesh_errors", 0),
             "host_merges_total": host_merge_count(),
+            # IVF-clustered ANN lane (ISSUE 10): segment executions that
+            # routed through the centroid->cluster-scan kernel vs declined
+            # builds that fell back to the exact matmul
+            "ann_dispatches_total": path_totals.get("ann_dispatches", 0),
+            "ann_fallbacks_total": path_totals.get("ann_fallbacks", 0),
             "sparse_queries_total": path_totals.get("sparse", 0),
             "dense_queries_total": path_totals.get("dense", 0),
             "packed_queries_total": path_totals.get("packed", 0),
@@ -2784,6 +2844,11 @@ class NodeService:
                 self.caches.segment_stacks.cache.memory_bytes,
             "mesh_stack_cache_memory_bytes":
                 self.caches.mesh_stacks.cache.memory_bytes,
+            # vector-serving memory + lane adoption (ISSUE 10): IVF
+            # centroid/CSR residency and how much kNN traffic the ANN
+            # lane carried
+            "ann_index_cache_memory_bytes":
+                self.caches.ann_indexes.cache.memory_bytes,
         }
         from .common.metrics import peak_score_matrix_bytes
         out["peak_score_matrix_bytes"] = peak_score_matrix_bytes()

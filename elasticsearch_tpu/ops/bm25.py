@@ -120,6 +120,70 @@ def classic_score_batch(doc_ids: jax.Array, tf: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def lm_dirichlet_score_batch(doc_ids: jax.Array, tf: jax.Array,
+                             doc_len: jax.Array, term_starts: jax.Array,
+                             term_lens: jax.Array, boosts: jax.Array,
+                             pcoll: jax.Array, mu: jax.Array, *,
+                             W: int, n_pad: int) -> jax.Array:
+    """LM with Dirichlet smoothing (ref org.apache.lucene.search.
+    similarities.LMDirichletSimilarity): per present term,
+        score_t(d) = boost * max(log(1 + tf/(mu*p(t|C))) + log(mu/(dl+mu)), 0)
+    with p(t|C) the collection probability ((ttf+1)/(sumTotalTermFreq+1)),
+    precomputed host-side into `pcoll` f32[Q, T] — the same per-term
+    weight seam the BM25/classic kernels use. Lucene clamps each term's
+    contribution at 0 so common-term penalties never outrank absence;
+    callers derive the match mask from term PRESENCE (term_match_mask),
+    not from scores > 0."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    pc = jnp.take_along_axis(pcoll, t_idx, axis=1)
+    raw = jnp.log1p(tfv / jnp.maximum(mu * pc, 1e-12)) \
+        + jnp.log(mu / (dl + mu))
+    w = jnp.take_along_axis(boosts, t_idx, axis=1)
+    contrib = jnp.where(valid, w * jnp.maximum(raw, 0.0),
+                        0.0).astype(jnp.float32)
+    doc = jnp.where(valid, doc, n_pad - 1)
+    scores = jnp.zeros((Q, n_pad), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], doc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
+def lm_jm_score_batch(doc_ids: jax.Array, tf: jax.Array,
+                      doc_len: jax.Array, term_starts: jax.Array,
+                      term_lens: jax.Array, boosts: jax.Array,
+                      pcoll: jax.Array, lam: jax.Array, *,
+                      W: int, n_pad: int) -> jax.Array:
+    """LM with Jelinek-Mercer smoothing (ref LMJelinekMercerSimilarity):
+        score_t(d) = boost * log(1 + ((1-λ) * tf/dl) / (λ * p(t|C)))
+    — strictly positive for any present term, so scores > 0 remains a
+    valid match derivation for the "or" case."""
+    Q = term_starts.shape[0]
+    P = doc_ids.shape[0]
+    idx, t_idx, valid = postings_slots(term_starts, term_lens, W)
+    idx = jnp.clip(idx, 0, P - 1)
+    doc = doc_ids[idx]
+    tfv = tf[idx]
+    dl = doc_len[doc]
+    pc = jnp.take_along_axis(pcoll, t_idx, axis=1)
+    raw = jnp.log1p(((1.0 - lam) * tfv / jnp.maximum(dl, 1.0))
+                    / jnp.maximum(lam * pc, 1e-12))
+    w = jnp.take_along_axis(boosts, t_idx, axis=1)
+    contrib = jnp.where(valid, w * raw, 0.0).astype(jnp.float32)
+    doc = jnp.where(valid, doc, n_pad - 1)
+    scores = jnp.zeros((Q, n_pad), jnp.float32)
+    scores = scores.at[jnp.arange(Q, dtype=jnp.int32)[:, None], doc].add(
+        contrib, mode="drop", unique_indices=False)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("W", "n_pad"))
 def term_match_mask(doc_ids: jax.Array, term_starts: jax.Array,
                     term_lens: jax.Array, W: int, n_pad: int) -> jax.Array:
     """Boolean [Q, n_pad]: does doc contain ANY of the given terms.

@@ -377,6 +377,9 @@ def _match_host(node: MatchNode, pctx: _PlanCtx):
 
 def _p_match(node: MatchNode, pctx: _PlanCtx):
     f = node.field_name
+    if node.sim in ("lm_dirichlet", "lm_jm"):
+        # LM similarities fall down the ladder to the fan-out/loop lanes
+        raise _Unsupported(f"lm similarity [{node.sim}]")
     if f not in pctx.stack.text:
         return (("match_absent",), lambda d: (d.zeros(), d.false()))
     pctx.use_field(f, "text")

@@ -296,6 +296,10 @@ def _match_block_csr(node: MatchNode, pctx: _PlanCtx, T: int):
 
 def _p_match(node: MatchNode, pctx: _PlanCtx):
     f = node.field_name
+    if node.sim in ("lm_dirichlet", "lm_jm"):
+        # LM similarities keep the materializing executor (the per-term
+        # collection-probability plane is not a blockwise operand yet)
+        raise _Unsupported(f"lm similarity [{node.sim}]")
     if f in pctx.env.mixed:
         raise _Unsupported(f"mixed field [{f}]")
     if f not in pctx.env.text:

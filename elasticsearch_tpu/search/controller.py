@@ -79,6 +79,73 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
         max_score=max_score if max_score > float("-inf") else float("nan"))
 
 
+def fuse_hybrid(text_results: list[QuerySearchResult],
+                knn_results: list[QuerySearchResult], spec, *,
+                from_: int, size: int, query_row: int = 0) -> ReducedDocs:
+    """First-class BM25 + vector fusion (the body's `"rank"` section,
+    search/query_parser.RankSpec): each retriever's per-shard lists merge
+    into a GLOBAL ranked list first (sort_docs — RRF ranks are global, as
+    in the reference's coordinator-level RRF), then the two lists fuse on
+    device (ops/ann.rrf_fuse / weighted_fuse) over compact candidate ids
+    and the winners come back as an ordinary ReducedDocs."""
+    import numpy as _np
+    import jax.numpy as _jnp
+
+    from ..ops import ann as ann_ops
+
+    def width(results):
+        return sum(r.doc_keys.shape[1] for r in results) or 1
+
+    text_red = sort_docs(text_results, from_=0, size=width(text_results),
+                         query_row=query_row)
+    knn_red = sort_docs(knn_results, from_=0, size=width(knn_results),
+                        query_row=query_row)
+    # compact (shard, doc_key) -> small int ids so the device kernel
+    # matches candidates with an exact integer-equality plane
+    id_of: dict[tuple[int, int], int] = {}
+
+    def ids_for(red):
+        return [id_of.setdefault((si, dk), len(id_of))
+                for si, dk in zip(red.shard_order, red.doc_keys)]
+
+    ids_a, ids_b = ids_for(text_red), ids_for(knn_red)
+    rev = {v: k for k, v in id_of.items()}
+    Ka, Kb = max(len(ids_a), 1), max(len(ids_b), 1)
+    keys_a = _np.full((1, Ka), -1, _np.int64)
+    keys_a[0, : len(ids_a)] = ids_a
+    keys_b = _np.full((1, Kb), -1, _np.int64)
+    keys_b[0, : len(ids_b)] = ids_b
+    w = _jnp.asarray([spec.query_weight, spec.knn_weight], _jnp.float32)
+    k = max(from_ + size, 1)
+    if spec.mode == "rrf":
+        top, keys = ann_ops.rrf_fuse(
+            _jnp.asarray(keys_a), _jnp.asarray(keys_b), w,
+            _jnp.float32(spec.rank_constant), k=k)
+    else:
+        sc_a = _np.full((1, Ka), -_np.inf, _np.float32)
+        sc_a[0, : len(ids_a)] = _np.nan_to_num(
+            _np.asarray(text_red.scores, _np.float32))
+        sc_b = _np.full((1, Kb), -_np.inf, _np.float32)
+        sc_b[0, : len(ids_b)] = _np.nan_to_num(
+            _np.asarray(knn_red.scores, _np.float32))
+        top, keys = ann_ops.weighted_fuse(
+            _jnp.asarray(keys_a), _jnp.asarray(sc_a),
+            _jnp.asarray(keys_b), _jnp.asarray(sc_b), w, k=k,
+            normalize=spec.normalize)
+    top = _np.asarray(top)[0]
+    keys = _np.asarray(keys)[0]
+    slots = [(rev[int(kid)], float(s))
+             for s, kid in zip(top, keys)
+             if _np.isfinite(s) and kid >= 0][from_: from_ + size]
+    return ReducedDocs(
+        shard_order=[sh for (sh, _dk), _s in slots],
+        doc_keys=[dk for (_sh, dk), _s in slots],
+        scores=[s for _key, s in slots],
+        sort_values=None,
+        total_hits=max(text_red.total_hits, knn_red.total_hits),
+        max_score=slots[0][1] if slots else float("nan"))
+
+
 def fetch_and_merge(reduced: ReducedDocs, searchers: list[ShardSearcher],
                     source_filter=None, fields_spec=None) -> list[dict]:
     """Fetch phase fan-out to winning shards only + final hit assembly

@@ -72,16 +72,44 @@ class CollectionStats:
     all-reduces them across shards before scoring."""
 
     def __init__(self, doc_count: int, field_sum_dl: dict[str, float],
-                 doc_freqs: dict[tuple[str, str], int]):
+                 doc_freqs: dict[tuple[str, str], int],
+                 segments: "Sequence[Segment] | None" = None):
         self.doc_count = max(doc_count, 1)
         self.field_sum_dl = field_sum_dl
         self.doc_freqs = doc_freqs
+        # LM similarities need totalTermFreq; it is computed LAZILY (one
+        # small device slice-sum per term) and memoized so BM25/classic
+        # traffic pays nothing for it (the per-stats dict is bounded by the
+        # request's term count). Stats built without segments (the
+        # DFS all-reduce wire shape) approximate ttf by df — documented in
+        # index/similarity.py.
+        self._segments = list(segments) if segments is not None else None
+        self._ttf_by_term: dict[tuple[str, str], float] = {}
 
     def avgdl(self, field: str) -> float:
         return max(self.field_sum_dl.get(field, 0.0), 1.0) / self.doc_count
 
     def df(self, field: str, term: str) -> int:
         return self.doc_freqs.get((field, term), 0)
+
+    def ttf(self, field: str, term: str) -> float:
+        """Collection-wide total term frequency (Lucene totalTermFreq)."""
+        key = (field, term)
+        got = self._ttf_by_term.get(key)
+        if got is None:
+            if self._segments is not None:
+                got = sum(s.total_term_freq(field, term)
+                          for s in self._segments)
+            else:
+                got = float(self.df(field, term))
+            self._ttf_by_term[key] = got
+        return got
+
+    def pcoll(self, field: str, term: str) -> float:
+        """Collection probability p(t|C) = (ttf+1)/(sumTotalTermFreq+1) —
+        the Lucene LMStats convention (+1 keeps unseen terms finite)."""
+        return (self.ttf(field, term) + 1.0) \
+            / (self.field_sum_dl.get(field, 0.0) + 1.0)
 
     @staticmethod
     def from_segments(segments: Sequence[Segment],
@@ -95,7 +123,7 @@ class CollectionStats:
         for f, terms in terms_by_field.items():
             for t in terms:
                 dfs[(f, t)] = sum(seg.doc_freq(f, t) for seg in segments)
-        return CollectionStats(doc_count, sum_dl, dfs)
+        return CollectionStats(doc_count, sum_dl, dfs, segments=segments)
 
 
 # ---------------------------------------------------------------------------
@@ -198,7 +226,10 @@ class MatchNode(Node):
     minimum_should_match: int = 0    # 0 = default by operator
     k1: float = 1.2
     b: float = 0.75
-    sim: str = "BM25"                # "BM25" | "classic" (index/similarity)
+    # "BM25" | "classic" | "lm_dirichlet" | "lm_jm" (index/similarity)
+    sim: str = "BM25"
+    mu: float = 2000.0               # lm_dirichlet smoothing
+    lam: float = 0.1                 # lm_jm smoothing
 
     def collect_terms(self, out):
         s = out.setdefault(self.field_name, set())
@@ -214,6 +245,7 @@ class MatchNode(Node):
         lens = np.zeros((Q, T), np.int32)
         weights = np.zeros((Q, T), np.float32)
         n_terms = np.zeros((Q,), np.int32)
+        lm = self.sim in ("lm_dirichlet", "lm_jm")
         for qi, terms in enumerate(self.terms_per_query):
             n_terms[qi] = len(terms)
             for ti, t in enumerate(terms):
@@ -225,7 +257,12 @@ class MatchNode(Node):
                 starts[qi, ti] = s
                 lens[qi, ti] = ln
                 if df > 0:
-                    if self.sim == "classic":
+                    if lm:
+                        # LM sims: the per-term weight slot carries the
+                        # query boost; the collection probability rides a
+                        # separate [Q, T] plane (_lm_pcoll)
+                        weights[qi, ti] = self.boost
+                    elif self.sim == "classic":
                         # ClassicSimilarity: idf^2 at the weight
                         # (query-norm omitted, like modern Lucene)
                         idf = 1.0 + math.log(
@@ -235,6 +272,16 @@ class MatchNode(Node):
                         w = math.log(1 + (ctx.stats.doc_count - df + 0.5) / (df + 0.5))
                         weights[qi, ti] = w * (self.k1 + 1) * self.boost
         return starts, lens, weights, n_terms
+
+    def _lm_pcoll(self, ctx: SegmentContext) -> np.ndarray:
+        """Precomputed per-term collection probabilities [Q, T] — the LM
+        kernels' weight-seam operand (VERDICT missing #3)."""
+        T = max((len(t) for t in self.terms_per_query), default=1) or 1
+        pc = np.full((ctx.Q, T), 1.0, np.float32)
+        for qi, terms in enumerate(self.terms_per_query):
+            for ti, t in enumerate(terms):
+                pc[qi, ti] = ctx.stats.pcoll(self.field_name, t)
+        return pc
 
     def execute(self, ctx):
         seg = ctx.segment
@@ -249,12 +296,34 @@ class MatchNode(Node):
                 fx.doc_ids, fx.tf, fx.doc_len,
                 jnp.asarray(starts), jnp.asarray(lens),
                 jnp.asarray(weights), W=W, n_pad=ctx.n_pad)
+        elif self.sim == "lm_dirichlet":
+            scores = bm25.lm_dirichlet_score_batch(
+                fx.doc_ids, fx.tf, fx.doc_len,
+                jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(weights), jnp.asarray(self._lm_pcoll(ctx)),
+                jnp.float32(self.mu), W=W, n_pad=ctx.n_pad)
+        elif self.sim == "lm_jm":
+            scores = bm25.lm_jm_score_batch(
+                fx.doc_ids, fx.tf, fx.doc_len,
+                jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(weights), jnp.asarray(self._lm_pcoll(ctx)),
+                jnp.float32(self.lam), W=W, n_pad=ctx.n_pad)
         else:
             scores = bm25.bm25_score_batch(
                 fx.doc_ids, fx.tf, fx.doc_len,
                 jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(weights),
                 jnp.float32(self.k1), jnp.float32(self.b), jnp.float32(avgdl),
                 W=W, n_pad=ctx.n_pad)
+        if self.sim == "lm_dirichlet" and not (
+                self.operator == "and" or self.minimum_should_match > 1):
+            # Dirichlet clamps common-term contributions at 0, so
+            # scores > 0 under-reports matches: derive the mask from term
+            # PRESENCE instead (the classic/BM25 fast derivation keeps
+            # its scores > 0 contract)
+            match = bm25.term_match_mask(
+                fx.doc_ids, jnp.asarray(starts), jnp.asarray(lens),
+                W=W, n_pad=ctx.n_pad)
+            return jnp.where(match, scores, 0.0), match
         if self.operator == "and" or self.minimum_should_match > 1:
             # count distinct matching terms per doc: reuse kernel with weight=1, tf→1
             need = np.maximum(self.minimum_should_match, 1) if self.operator != "and" else n_terms
@@ -288,8 +357,11 @@ class MatchNode(Node):
                                     n_pad=ctx.n_pad)
 
     def plan_key(self):
+        # plans group by the FULL similarity parameter set so fast lanes
+        # and compile caches never mix differently-parameterized scorers
         return ("match", self.field_name, self.operator,
-                self.minimum_should_match, self.sim, self.k1, self.b)
+                self.minimum_should_match, self.sim, self.k1, self.b,
+                self.mu, self.lam)
 
 
 _POS_SHIFT = 1 << 21      # doc*SHIFT + position fits i64 for 1M-token docs
@@ -717,6 +789,8 @@ class SpanNearNode(Node):
     sim: str = "BM25"
     k1: float = 1.2
     b: float = 0.75
+    mu: float = 2000.0
+    lam: float = 0.1
 
     def collect_terms(self, out):
         s = out.setdefault(self.field_name, set())
@@ -748,7 +822,7 @@ class SpanNearNode(Node):
         scorer = MatchNode(field_name=self.field_name,
                            terms_per_query=[flat] * ctx.Q,
                            boost=self.boost, sim=self.sim,
-                           k1=self.k1, b=self.b)
+                           k1=self.k1, b=self.b, mu=self.mu, lam=self.lam)
         scores, _ = scorer.execute(ctx)
         row = self._span_mask_row(ctx)
         match = jnp.broadcast_to(jnp.asarray(row)[None, :],
@@ -772,6 +846,8 @@ class SpanFirstNode(Node):
     sim: str = "BM25"
     k1: float = 1.2
     b: float = 0.75
+    mu: float = 2000.0
+    lam: float = 0.1
 
     def collect_terms(self, out):
         out.setdefault(self.field_name, set()).update(self.terms)
@@ -788,7 +864,7 @@ class SpanFirstNode(Node):
         scorer = MatchNode(field_name=self.field_name,
                            terms_per_query=[sorted(set(self.terms))] * ctx.Q,
                            boost=self.boost, sim=self.sim,
-                           k1=self.k1, b=self.b)
+                           k1=self.k1, b=self.b, mu=self.mu, lam=self.lam)
         scores, _ = scorer.execute(ctx)
         match = jnp.broadcast_to(jnp.asarray(row)[None, :],
                                  (ctx.Q, ctx.n_pad))
@@ -949,6 +1025,8 @@ class CommonTermsNode(Node):
     sim: str = "BM25"                # against the LOW-FREQ group size
     k1: float = 1.2
     b: float = 0.75
+    mu: float = 2000.0
+    lam: float = 0.1
 
     def collect_terms(self, out):
         out.setdefault(self.field_name, set()).update(self.terms)
@@ -965,7 +1043,8 @@ class CommonTermsNode(Node):
     def execute(self, ctx):
         low, high = self._split(ctx)
         kw = dict(field_name=self.field_name, sim=self.sim,
-                  k1=self.k1, b=self.b, boost=self.boost)
+                  k1=self.k1, b=self.b, mu=self.mu, lam=self.lam,
+                  boost=self.boost)
         scorer = MatchNode(terms_per_query=[self.terms], **kw)
         scores, any_match = scorer.execute(ctx)
         req = low if low else high

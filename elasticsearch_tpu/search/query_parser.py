@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import datetime as _dt
 import re
+from dataclasses import dataclass
 from typing import Any
 
 from ..mapping.mapper import MapperService, DATE, KEYWORD, TEXT, parse_date_millis
@@ -87,8 +88,10 @@ class QueryParser:
         svc = getattr(self.mappers, "similarity", None)
         if svc is None:
             return {}
+        from ..index.similarity import sim_tag
         sim = svc.for_field(self.mappers, field)
-        return {"sim": sim.type, "k1": sim.k1, "b": sim.b}
+        return {"sim": sim_tag(sim), "k1": sim.k1, "b": sim.b,
+                "mu": sim.mu, "lam": sim.lam}
 
     def parse(self, body: dict | None) -> Node:
         if body is None or body == {}:
@@ -818,6 +821,51 @@ def _edit_distance_le(a: str, b: str, k: int) -> bool:
             return False
         prev2, prev = prev, cur
     return prev[-1] <= k
+
+
+# ---------------------------------------------------------------------------
+# Hybrid ranking — the body's top-level "rank" section (first-class
+# BM25 + vector fusion; search/controller.fuse_hybrid + ops/ann kernels)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RankSpec:
+    """Parsed `"rank"` section:
+      {"rrf": {"rank_constant": 60, "window_size": 100,
+               "query_weight": 1.0, "knn_weight": 1.0}}
+      {"weighted": {"query_weight": .7, "knn_weight": .3,
+                    "normalize": "minmax" | "none", "window_size": 100}}
+    """
+    mode: str                  # "rrf" | "weighted"
+    rank_constant: float = 60.0
+    window_size: int = 0       # 0 = derived from size+from_ by the caller
+    query_weight: float = 1.0
+    knn_weight: float = 1.0
+    normalize: str = "minmax"
+
+
+def parse_rank(spec: Any) -> RankSpec | None:
+    """Parse + validate the body's `rank` section; None when absent."""
+    if spec is None:
+        return None
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise QueryParsingException(
+            'rank takes exactly one mode: {"rrf": {...}} or '
+            '{"weighted": {...}}')
+    (mode, params), = spec.items()
+    params = params or {}
+    if mode not in ("rrf", "weighted"):
+        raise QueryParsingException(f"unsupported rank mode [{mode}]")
+    norm = str(params.get("normalize", "minmax"))
+    if norm not in ("minmax", "none"):
+        raise QueryParsingException(f"unsupported rank normalize [{norm}]")
+    return RankSpec(
+        mode=mode,
+        rank_constant=float(params.get("rank_constant", 60.0)),
+        window_size=int(params.get("window_size", 0)),
+        query_weight=float(params.get("query_weight", 1.0)),
+        knn_weight=float(params.get("knn_weight", 1.0)),
+        normalize=norm)
 
 
 # ---------------------------------------------------------------------------

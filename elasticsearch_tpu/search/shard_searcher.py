@@ -74,7 +74,7 @@ class ShardSearcher:
                  stack_cache=None, index_name: str | None = None,
                  incarnation: int = 0, stacked: bool = True,
                  blockwise: bool = True, block_docs: int | None = None,
-                 request_breaker=None):
+                 request_breaker=None, knn_opts: dict | None = None):
         self.shard_id = shard_id
         self.segments = list(segments)
         self.mappers = mappers
@@ -119,6 +119,18 @@ class ShardSearcher:
         # breaker): [Q, block] on the blockwise lane, [Q, n_pad] on the
         # materializing one — charged before execution, released after
         self.request_breaker = request_breaker
+        # IVF-clustered ANN kNN lane (ops/ann.py): per-index settings
+        # roster (index/index_service.knn_options_from); cluster indexes
+        # live in the node AnnIndexCache (segment-attached) or, when no
+        # cache service is wired, in this bounded local memo — the
+        # searcher itself is rebuilt whenever the segment set changes
+        defaults = {"ivf_enable": True, "nlist": 0, "nprobe": 0,
+                    "min_docs": 4096, "precision": "bf16"}
+        self.knn_opts = {**defaults, **(knn_opts or {})}
+        from ..common.cache import Cache
+        self._ivf_local = Cache("ann_local", max_entries=32)
+        # which vector lane served the last kNN phase: "ann" | "exact"
+        self.last_knn_mode: str | None = None
 
     def _bump(self, key: str, n: int = 1) -> None:
         self._path_stats[key] = self._path_stats.get(key, 0) + n
@@ -588,16 +600,67 @@ class ShardSearcher:
             sort_values=None, total_hits=np.asarray(got["total"], np.int64),
             max_score=max_score, aggs=agg_partials)
 
-    # -- kNN (exact, MXU matmul — ops/knn.py) ------------------------------
+    # -- kNN (IVF two-stage ANN / exact MXU matmul — ops/ann.py, knn.py) ---
+
+    def _acquire_ivf(self, seg, vc, field: str, req_nprobe: int | None,
+                     exact: bool):
+        """(IvfData, effective nprobe) for one segment's vector column, or
+        (None, 0) to use the exact kernel. The fallback ladder:
+        per-request `exact`, `index.knn.ivf.enable: false`, undersized
+        columns (< max(min_docs, 2*nlist)), full-coverage requests
+        (nprobe >= nlist — the exact kernel is bitwise-identical AND
+        cheaper), breaker-declined or failed builds."""
+        from ..ops import ann as ann_ops
+        opts = self.knn_opts
+        if exact or not opts["ivf_enable"]:
+            return None, 0
+        n_docs = seg.n_docs
+        nlist = int(opts["nlist"]) or ann_ops.auto_nlist(n_docs)
+        if n_docs < max(int(opts["min_docs"]), 2 * nlist):
+            return None, 0
+        nprobe = int(req_nprobe or opts["nprobe"]
+                     or ann_ops.auto_nprobe(nlist))
+        if nprobe >= nlist:
+            return None, 0
+        try:
+            cache = getattr(seg, "ann_cache", None)
+            if cache is not None:
+                ivf = cache.get_or_build(
+                    seg, field, nlist,
+                    lambda: vc.build_ivf(n_docs, nlist))
+            else:
+                key = (seg.seg_id, field, nlist)
+                ivf = self._ivf_local.get(key)
+                if ivf is None:
+                    ivf = vc.build_ivf(n_docs, nlist)
+                    if ivf is not None:
+                        self._ivf_local.put(key, ivf, weight=ivf.nbytes)
+        except Exception:  # noqa: BLE001 — exact is always correct
+            ivf = None
+        if ivf is None:
+            self._bump("ann_fallbacks")
+            return None, 0
+        return ivf, min(nprobe, ivf.nlist)
 
     def execute_knn(self, field: str, query_vectors, *, k: int = 10,
                     metric: str = "cosine",
-                    filter_node: Node | None = None) -> QuerySearchResult:
-        """Exact kNN query phase over this shard's segments. Behaves like a
+                    filter_node: Node | None = None,
+                    nprobe: int | None = None,
+                    exact: bool = False) -> QuerySearchResult:
+        """kNN query phase over this shard's segments. Behaves like a
         query phase whose scores are vector similarities, so the controller
-        reduce and fetch phase apply unchanged."""
+        reduce and fetch phase apply unchanged.
+
+        Columns past `index.knn.ivf.min_docs` route through the IVF lane
+        (centroid route + gathered blockwise cluster scan, ops/ann.py);
+        everything else — and every rung of the fallback ladder — runs the
+        exact [Q, N] matmul (ops/knn.py). `nprobe` overrides the index
+        default per request; `exact=True` pins the exact kernel."""
+        from ..common import tracing
+        from ..ops import ann as ann_ops
         from ..ops import knn as knn_ops
 
+        precision = self.knn_opts["precision"]
         qv = jnp.asarray(np.asarray(query_vectors, np.float32))
         # query vectors are the host→device upload (process-wide transfer
         # counters + the active profiler, when one is installed)
@@ -609,24 +672,47 @@ class ShardSearcher:
         total = np.zeros((Q,), np.int64)
 
         n_fetches = 0
+        any_ann = False
         for seg_idx, seg in self.live_segments:
             vc = seg.vectors.get(field)
             if vc is None:
                 continue
             self._bump("segment_dispatches")
-            live = seg.live
-            if filter_node is not None:
+            live_1d = seg.live
+            filtered = filter_node is not None
+            if filtered:
                 stats = self.build_stats(filter_node, None)
                 _, match = filter_node.execute(SegmentContext(seg, Q, stats))
-                live = live[None, :] & match
+                live = live_1d[None, :] & match
             else:
-                live = jnp.broadcast_to(live[None, :], (Q, seg.n_pad))
-            sims = knn_ops._sim(qv, vc.vecs, metric)
-            sims = jnp.where(live, sims, -jnp.inf)
+                live = jnp.broadcast_to(live_1d[None, :], (Q, seg.n_pad))
             kk = min(k, seg.n_pad)
-            top, idx = jax.lax.top_k(sims, kk)
-            live_tot = live.sum(axis=1) if live.ndim == 2 \
-                else jnp.broadcast_to(live.sum(), (Q,))
+            ivf, nprobe_eff = self._acquire_ivf(seg, vc, field, nprobe,
+                                                exact)
+            if ivf is not None:
+                W = ann_ops.slot_budget(ivf.sizes_desc_cum, nprobe_eff,
+                                        ivf.n_docs, ivf.nlist)
+                block = ann_ops.scan_block_size(Q, vc.dims, W)
+                with tracing.span("ann_scan", shard=self.shard_id,
+                                  nprobe=nprobe_eff, nlist=ivf.nlist,
+                                  window=W):
+                    top, idx = ann_ops.ivf_search(
+                        vc.vecs, ivf.centroids, ivf.starts, ivf.sizes,
+                        ivf.slot_docs, ivf.norms,
+                        live if filtered else live_1d, qv,
+                        k=min(kk, W), metric=metric, precision=precision,
+                        nprobe=nprobe_eff, W=W, block=block,
+                        per_query_live=filtered)
+                self._bump("ann_dispatches")
+                self.last_knn_mode = "ann"
+                any_ann = True
+            else:
+                sims = knn_ops._sim(qv, vc.vecs, metric,
+                                    precision=precision)
+                sims = jnp.where(live, sims, -jnp.inf)
+                top, idx = jax.lax.top_k(sims, kk)
+                self.last_knn_mode = "exact"
+            live_tot = live.sum(axis=1)
             # ONE fetch per segment (a tunneled chip pays RTT per sync)
             top, idx, seg_tot = device_fetch((top, idx, live_tot))
             n_fetches += 1
@@ -642,8 +728,11 @@ class ShardSearcher:
 
         mx = np.where(np.isfinite(best_scores[:, 0]), best_scores[:, 0], np.nan)
         best_scores = np.where(best_keys >= 0, best_scores, np.nan)
-        from ..common.metrics import record_shard_fetches
+        from ..common.metrics import current_profiler, record_shard_fetches
         record_shard_fetches(n_fetches)
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_path("ann" if any_ann else "knn")
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=None, total_hits=total, max_score=mx)

@@ -420,6 +420,11 @@ def _match_host(node: MatchNode, ctx: StackedContext):
 
 
 def _h_match(node: MatchNode, ctx: StackedContext):
+    if node.sim in ("lm_dirichlet", "lm_jm"):
+        # LM scoring needs the per-term collection-probability plane the
+        # stacked kernels don't carry — the generic per-segment exec is
+        # the documented lane for these fields (index/similarity.py)
+        return _generic_exec(node, ctx)
     sf = ctx.stack.text.get(node.field_name)
     if sf is None:
         return _zeros(ctx), _false(ctx)

@@ -66,7 +66,12 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # seeded null at import so a forced timeout still
                      # emits them (the subprocess guard contract)
                      "knn_nprobe": None, "knn_recall_at_10": None,
-                     "ann_dispatches": None}
+                     "ann_dispatches": None,
+                     # cluster-wide collectives data plane (ISSUE 11):
+                     # seeded null at import so a forced timeout still
+                     # emits them (the subprocess guard contract)
+                     "cluster_host_reduce_qps": None,
+                     "mesh_agg_dispatches": None}
 _LINE_PRINTED = False
 
 
@@ -480,11 +485,131 @@ def run_multiseg_leg(tag: str) -> dict:
             if out.get("fanout_p50_ms") and out.get("mesh_p50_ms"):
                 out["mesh_speedup"] = (out["fanout_p50_ms"]
                                        / out["mesh_p50_ms"])
+
+            # aggs through the mesh program (ISSUE 11): terms/histogram/
+            # stats partials collect INSIDE the collective and ride the
+            # same single fetch — count the dispatches that actually
+            # took the lane
+            agg_body = json.dumps({
+                "size": 0, "query": {"match": {"body": words[0]}},
+                "aggs": {"h": {"histogram": {"field": "n",
+                                             "interval": 64}},
+                         "s": {"stats": {"field": "n"}}}})
+            agg_reps = min(reps, 30)
+            http(port, "POST", "/live_mesh/_search?request_cache=false",
+                 agg_body)                                   # warm
+            t0 = time.perf_counter()
+            agg_served = 0
+            for _ in range(agg_reps):
+                http(port, "POST",
+                     "/live_mesh/_search?request_cache=false", agg_body)
+                agg_served += 1
+                if _over_budget(margin=30.0):
+                    break
+            if agg_served:
+                out["mesh_agg_qps"] = agg_served / max(
+                    time.perf_counter() - t0, 1e-9)
+            out["mesh_agg_dispatches"] = node.indices["live_mesh"] \
+                .search_stats.get("mesh_agg_dispatches", 0)
         return out
     finally:
         server.stop()
         node.close()
         shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_cluster_leg(tag: str) -> dict:
+    """Cluster-wide collectives data plane (ISSUE 11): a 2-node cluster
+    co-hosting a 4-shard index serves the same match-query workload
+    through the node-local mesh reduce (ONE A_QUERY_HOST + one device
+    program per host per query) vs the per-shard transport fan-out —
+    `cluster_host_reduce_qps` vs `cluster_fanout_qps` on the same corpus
+    is the flat-vs-linear reduce the device wins (ROADMAP item 1)."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.cluster import TestCluster
+
+    n_docs = int(os.environ.get("BENCH_CLUSTER_DOCS", "100000"))
+    n_shards = int(os.environ.get("BENCH_CLUSTER_SHARDS", "8"))
+    reps = int(os.environ.get("BENCH_CLUSTER_REPS", "150"))
+    n_q = 64
+    tmp = tempfile.mkdtemp(prefix=f"bench-cluster-{tag}-")
+    out: dict = {}
+    cluster = TestCluster(2, tmp)
+    try:
+        client = cluster.client()
+        # 2 nodes x (n_shards/2) co-hosted shards each — the ISSUE 11
+        # acceptance config: each host reduces its 4 co-hosted shards in
+        # ONE device program per query
+        client.create_index("cdocs", {"number_of_shards": n_shards,
+                                      "number_of_replicas": 0})
+        cluster.ensure_green()
+        docs = make_corpus(n_docs, seed=11)
+        ops = []
+        for i, body in enumerate(docs):
+            ops.append(("index", {"_index": "cdocs", "_id": str(i)},
+                        {"body": body}))
+            if len(ops) >= 4000:
+                client.bulk(ops)
+                ops = []
+            if _over_budget(margin=60.0):
+                return {}        # indexing ate the slice: absent keys
+        if ops:
+            client.bulk(ops)
+        client.refresh("cdocs")
+        queries = make_queries(n_q, seed=13)
+
+        def set_setting(val):
+            master = cluster.master_node()
+
+            def task(cur):
+                st = cur.mutate()
+                st.data.setdefault("settings", {})[
+                    "cluster.search.host_reduce.enable"] = val
+                return st
+            master.cluster.submit_task("bench-host-reduce", task)
+
+        def body_of(i: int) -> dict:
+            # dense bool-should shape: the workload the collective reduce
+            # serves (match-only bodies ride the per-shard sparse kernel
+            # on the fan-out, a different lane entirely)
+            terms = queries[i % n_q].split()
+            return {"size": 10, "query": {"bool": {
+                "should": [{"match": {"body": terms[0]}},
+                           {"match": {"body": terms[1]}}]}}}
+
+        def measure():
+            for i in range(n_q):         # warm every pow2 shape bucket
+                client.search("cdocs", json.loads(json.dumps(body_of(i))))
+                if _over_budget(margin=45.0):
+                    return None
+            t0 = time.perf_counter()
+            served = 0
+            for i in range(reps):
+                client.search("cdocs", json.loads(json.dumps(body_of(i))))
+                served += 1
+                if _over_budget(margin=30.0):
+                    break
+            return served / max(time.perf_counter() - t0, 1e-9)
+
+        set_setting(True)
+        d0 = sum(n.host_reduce_stats["dispatches"]
+                 for n in cluster.nodes.values())
+        out["cluster_host_reduce_qps"] = measure()
+        out["cluster_host_reduce_dispatches"] = sum(
+            n.host_reduce_stats["dispatches"]
+            for n in cluster.nodes.values()) - d0
+        set_setting(False)
+        out["cluster_fanout_qps"] = measure()
+        out["cluster_shards"] = n_shards
+        if out.get("cluster_fanout_qps") and out.get(
+                "cluster_host_reduce_qps"):
+            out["cluster_host_speedup"] = (out["cluster_host_reduce_qps"]
+                                           / out["cluster_fanout_qps"])
+        return {k: v for k, v in out.items() if v is not None}
+    finally:
+        cluster.close()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_vector_leg(tag: str) -> dict:
@@ -897,21 +1022,37 @@ def _run_all_legs(tag: str) -> dict:
         _FINAL_LINE["value"] = res.get("qps")
     # optional legs run only while the budget allows AND degrade to
     # absent keys on failure — the headline line always prints
-    for flag, default, leg in (("BENCH_AGG", "1", run_agg_leg),
-                               ("BENCH_MULTISEG", "1", run_multiseg_leg),
-                               ("BENCH_VEC", "1", run_vector_leg),
-                               # 4M-doc aggs + 1M-doc vectors: opt-in —
-                               # the scale tier only fits a long budget
-                               ("BENCH_SCALE", "0", run_scale_leg)):
+    legs = [("BENCH_AGG", "1", run_agg_leg),
+            ("BENCH_MULTISEG", "1", run_multiseg_leg),
+            ("BENCH_VEC", "1", run_vector_leg),
+            # cluster host-reduce leg (ISSUE 11): skipped on the CPU
+            # baseline subprocess — both lanes run the same device code,
+            # so the ratio is measured once, in the main process
+            ("BENCH_CLUSTER", "1" if tag == "main" else "0",
+             run_cluster_leg),
+            # 4M-doc aggs + 1M-doc vectors: opt-in —
+            # the scale tier only fits a long budget
+            ("BENCH_SCALE", "0", run_scale_leg)]
+    for li, (flag, default, leg) in enumerate(legs):
         if os.environ.get(flag, default) == "0":
             continue
         if _over_budget(margin=90.0):
             print(f"{flag} leg skipped: {_remaining():.0f}s of "
                   f"BENCH_TIME_BUDGET left", file=sys.stderr)
             continue
-        _arm_leg_alarm(reserve=60.0)
+        # tightened per-leg slices (BENCH_r05 rc=124 hardening): each leg
+        # may consume only what's left MINUS a hold-back for every leg
+        # still queued (45s each) plus the final-print headroom — a slow
+        # leg gets _BudgetExceeded raised into it and is skipped-and-
+        # reported, it can no longer starve the legs behind it
+        later = sum(1 for f, d, _fn in legs[li + 1:]
+                    if os.environ.get(f, d) != "0")
+        _arm_leg_alarm(reserve=45.0 * later + 45.0)
         try:
             res.update(leg(tag))
+        except _BudgetExceeded as e:
+            print(f"{flag} leg over its slice, skipped: {e}",
+                  file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — legs are best-effort
             print(f"{flag} leg failed: {e}", file=sys.stderr)
     _arm_hard_alarm()
@@ -1033,7 +1174,20 @@ def main_engine():
                     r2(res.get("mesh_fetches_per_query")),
                 "fanout_fetches_per_query":
                     r2(res.get("fanout_fetches_per_query")),
-                "mesh_shards": res.get("mesh_shards")})
+                "mesh_shards": res.get("mesh_shards"),
+                # aggs through the mesh program (ISSUE 11)
+                "mesh_agg_qps": r2(res.get("mesh_agg_qps")),
+                "mesh_agg_dispatches": res.get("mesh_agg_dispatches")})
+    if "cluster_host_reduce_qps" in res:
+        # cluster-wide collectives data plane (ISSUE 11): one device
+        # program per HOST vs one transport round-trip per shard
+        line.update({
+            "cluster_host_reduce_qps": r2(res.get("cluster_host_reduce_qps")),
+            "cluster_fanout_qps": r2(res.get("cluster_fanout_qps")),
+            "cluster_host_speedup": rnd(res.get("cluster_host_speedup")),
+            "cluster_shards": res.get("cluster_shards"),
+            "cluster_host_reduce_dispatches":
+                res.get("cluster_host_reduce_dispatches")})
     if "scale_peak_rss_bytes" in res:
         # BENCH_SCALE leg (ISSUE 8): the 10M-doc-tier shapes, served by
         # the blockwise lane; peak RSS + peak score-matrix residency show

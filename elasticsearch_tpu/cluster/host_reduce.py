@@ -1,0 +1,229 @@
+"""Node-local mesh reduce for the cluster data plane (ISSUE 11 tentpole).
+
+The cluster coordinator used to pay one transport round-trip AND one host
+merge per shard — even when a data node co-hosts several shards of the
+index, which the single-process mesh lane (parallel/mesh_exec, PRs 6/8)
+already knows how to reduce in ONE device program. This module is the
+data-node side of `A_QUERY_HOST`: all STARTED shard copies a node
+co-hosts for a query execute as one `shard_map` program (blockwise scan
+inside when configured, cross-shard `all_gather`+`top_k`/`psum`/`pmax`
+reduce, agg partials and IVF kNN included), and the transport carries ONE
+pre-reduced message per host instead of one per shard.
+
+The response DECOMPOSES the merged candidate list back into per-shard
+wire results — each shard's surviving entries are a PREFIX of the top-k
+list that shard's own `_shard_query_phase` would have returned (stable
+top_k keeps same-shard candidates in rank order, and a rank-r survivor
+implies ranks < r survived), and per-shard totals/max/agg partials ride
+the program's gathered outputs. The coordinator's `_reduce` therefore
+merges host-reduced and per-shard results identically, bit-for-bit:
+"ICI collectives intra-host, DCN only between hosts" (SURVEY §5.8).
+
+Fallback ladder: anything without a single-program form — sorted bodies,
+unsupported plan/agg shapes, mixed IVF/exact vector lanes, missing DFS
+stats for term queries, undersized meshes, any execution error — returns
+a decline and the coordinator falls back to the per-shard hedged fan-out
+for that host's shards.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+
+HOST_REDUCE_SETTING = "cluster.search.host_reduce.enable"
+
+
+def body_eligible(body: dict) -> bool:
+    """Coordinator-side pre-flight: body shapes the host reduce can ever
+    serve (the data node makes the finer plan-level call)."""
+    return (body.get("sort") is None
+            and body.get("search_after") in (None, [])
+            and not body.get("rescore")
+            and not body.get("suggest")
+            and body.get("rank") is None)
+
+
+def setting_enabled(value) -> bool:
+    if isinstance(value, str):
+        return value.strip().lower() not in ("false", "0", "no", "off")
+    return value is not False
+
+
+def try_host_reduce(node, index: str, sids: list[int], body: dict,
+                    k: int, dfs: dict | None):
+    """Execute the co-hosted shards' query phase as one mesh program.
+
+    -> {"shards": {str(sid): per-shard wire result}} or (None, reason) as
+    a decline. `node` is the ClusterNode; `sids` arrive in target order
+    (ascending), which becomes the mesh shard-row order — the same
+    tie-break order the coordinator's ti-ordered merge uses."""
+    from ..parallel import mesh_exec
+    from ..search.aggs.aggregators import parse_aggs
+
+    searchers = []
+    for sid in sids:
+        holder = node._shards.get((index, sid))
+        if holder is None or holder.engine is None:
+            return None, "shard_unavailable"
+        searchers.append(node._searcher(index, sid, holder))
+    if mesh_exec.mesh_for(len(searchers)) is None:
+        return None, "no_mesh"
+
+    knn = body.get("knn")
+    agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations")) \
+        if (body.get("aggs") or body.get("aggregations")) else None
+
+    if knn is not None:
+        if agg_specs:
+            return None, "knn_aggs"
+        out = _knn_host_reduce(node, index, sids, searchers, knn, k)
+        agg_specs = None
+    else:
+        out = _query_host_reduce(node, index, sids, searchers, body,
+                                 agg_specs, k, dfs)
+    if isinstance(out, tuple) and out[0] is None:
+        return out
+    keys, shard_of, scores, totals, mxs, agg_parts = out
+    return _decompose(searchers, sids, keys, shard_of, scores, totals,
+                      mxs, agg_parts, agg_specs), None
+
+
+def _index_setting(node, index: str):
+    meta = node.cluster.current().indices.get(index) or {}
+    settings = meta.get("settings") or {}
+
+    def get_s(key, default):
+        return settings.get(f"index.{key}", settings.get(key, default))
+    return get_s
+
+
+def _mesh_group_name(index: str, sids: list[int]) -> str:
+    """Cache key prefix: the mesh stack of a shard GROUP is keyed by the
+    group, not just the index — a node may serve different subsets over
+    time as shards move."""
+    return f"{index}::{','.join(str(s) for s in sids)}"
+
+
+def _query_host_reduce(node, index, sids, searchers, body, agg_specs,
+                       k, dfs):
+    from . import node as cluster_node_mod
+    from ..parallel import mesh_exec
+    from ..search.query_dsl import contains_joins
+
+    get_s = _index_setting(node, index)
+    if not setting_enabled(get_s("search.mesh.enable", True)):
+        return None, "index_opt_out"
+    query = body.get("query") or {"match_all": {}}
+    try:
+        tree = searchers[0].parse([query])
+    except Exception:  # noqa: BLE001 — the per-shard phase will report
+        return None, "parse"
+    if contains_joins(tree):
+        return None, "joins"
+    if not mesh_exec.plan_types_supported(tree):
+        return None, "plan"
+    stats = cluster_node_mod._stats_from_wire(dfs)
+    if stats is None:
+        # a term-less tree never consults stats; term queries without a
+        # DFS round would score with host-local stats and diverge from
+        # the per-shard path (which uses its own shard-local stats)
+        terms: dict[str, set] = {}
+        tree.collect_terms(terms)
+        if any(terms.values()):
+            return None, "no_dfs"
+        from ..search.query_dsl import CollectionStats
+        stats = CollectionStats(doc_count=1, field_sum_dl={},
+                                doc_freqs={})
+    blockwise = setting_enabled(get_s("search.blockwise.enable", True))
+    try:
+        block_docs = int(get_s("search.block_docs", 0)) or None
+    except (TypeError, ValueError):
+        block_docs = None
+    from ..search.blockwise import DEFAULT_BLOCK_DOCS
+    stack = node._host_mesh_stacks.get_or_build(
+        _mesh_group_name(index, sids), 0,
+        [list(s.segments) for s in searchers])
+    if stack is None:
+        return None, "stack"
+    out = mesh_exec.execute(
+        stack, tree, stats, k=k, Q=1,
+        block_docs=(block_docs or DEFAULT_BLOCK_DOCS) if blockwise
+        else None,
+        agg_specs=agg_specs)
+    if out is None:
+        return None, "plan_shape"
+    return out
+
+
+def _knn_host_reduce(node, index, sids, searchers, knn, k):
+    from ..parallel import mesh_knn
+
+    get_s = _index_setting(node, index)
+    if not setting_enabled(get_s("search.mesh.enable", True)):
+        return None, "index_opt_out"
+    field = knn.get("field")
+    qv = knn.get("query_vector")
+    if field is None or qv is None:
+        return None, "knn_shape"
+    raw_np = knn.get("nprobe")
+    nprobe = int(raw_np) if raw_np is not None else None
+    exact = bool(knn.get("exact", False))
+    knn_k = int(knn.get("k", k))
+    vstack = node._host_vector_stacks.get_or_build(
+        _mesh_group_name(index, sids), 0, field,
+        [list(s.segments) for s in searchers])
+    if vstack is None:
+        return None, "vstack"
+    fnode = None
+    fstack = None
+    if knn.get("filter"):
+        fnode = searchers[0].parse([knn["filter"]])
+        fstack = node._host_mesh_stacks.get_or_build(
+            _mesh_group_name(index, sids), 0,
+            [list(s.segments) for s in searchers])
+        if fstack is None:
+            return None, "stack"
+    out = mesh_knn.execute(
+        vstack, [qv], k=knn_k, metric=knn.get("metric", "cosine"),
+        knn_opts=searchers[0].knn_opts, nprobe=nprobe, exact=exact,
+        acquire_ivf=lambda si, seg, vc: searchers[si]._acquire_ivf(
+            seg, vc, field, nprobe, exact),
+        filter_node=fnode, filter_stack=fstack)
+    if out is None:
+        return None, "knn_lane"
+    keys, shard_of, scores, totals, mxs, _used_ivf = out
+    return keys, shard_of, scores, totals, mxs, None
+
+
+def _decompose(searchers, sids, keys, shard_of, scores, totals, mxs,
+               agg_parts, agg_specs) -> dict:
+    """Merged device outputs -> per-shard wire results. Entries keep
+    their per-shard rank order (a prefix of each shard's own top-k), so
+    the coordinator's (score, target, pos) merge order is preserved."""
+    out: dict[str, dict] = {}
+    for pos, sid in enumerate(sids):
+        mx = float(mxs[pos, 0])
+        out[str(sid)] = {"ids": [], "scores": [], "sort": None,
+                        "total": int(totals[pos, 0]),
+                        "max_score": mx if np.isfinite(mx) else None}
+    row_k, row_sh, row_s = keys[0], shard_of[0], scores[0]
+    for j in range(row_k.shape[0]):
+        key = int(row_k[j])
+        if key < 0:
+            continue
+        pos = int(row_sh[j])
+        seg = searchers[pos].segments[key >> SEG_SHIFT]
+        wire = out[str(sids[pos])]
+        # doc IDS cross the seam, not positional keys (the same safety
+        # contract as _shard_query_phase: fetch may race a flush/merge)
+        wire["ids"].append(seg.ids[key & LOCAL_MASK])
+        sc = float(row_s[j])
+        wire["scores"].append(None if sc != sc else sc)
+    if agg_parts is not None and agg_specs is not None:
+        from ..search.aggs.wire import partials_to_wire
+        for pos, sid in enumerate(sids):
+            out[str(sid)]["aggs"] = partials_to_wire(agg_specs,
+                                                     agg_parts[pos])
+    return {"shards": out}

@@ -63,8 +63,10 @@ A_REFRESH = "indices:admin/refresh"
 A_FLUSH = "indices:admin/flush"
 A_WRITE_P = "indices:data/write/op[p]"
 A_WRITE_R = "indices:data/write/op[r]"
+A_WRITE_R_BULK = "indices:data/write/bulk[r]"
 A_GET = "indices:data/read/get"
 A_QUERY = "indices:data/read/search[phase/query]"
+A_QUERY_HOST = "indices:data/read/search[phase/query/host]"
 A_FETCH = "indices:data/read/search[phase/fetch/id]"
 A_TERM_STATS = "indices:data/read/search[phase/dfs]"
 A_SCROLL_NEXT = "indices:data/read/search[phase/scroll]"
@@ -142,7 +144,9 @@ class ClusterNode:
                 (A_REFRESH, self._on_refresh), (A_FLUSH, self._on_flush),
                 (A_WRITE_P, self._on_primary_write),
                 (A_WRITE_R, self._on_replica_write),
+                (A_WRITE_R_BULK, self._on_replica_bulk),
                 (A_GET, self._on_get), (A_QUERY, self._on_query),
+                (A_QUERY_HOST, self._on_query_host),
                 (A_FETCH, self._on_fetch),
                 (A_TERM_STATS, self._on_term_stats),
                 (A_SCROLL_NEXT, self._on_scroll_next),
@@ -179,6 +183,16 @@ class ClusterNode:
         self._scroll_ctx: dict[str, dict] = {}
         self._scroll_seq = 0
         self._scroll_lock = threading.Lock()
+        # node-local mesh reduce (ISSUE 11): the co-hosted shard groups'
+        # packed mesh stacks — one device program per host per query, the
+        # transport carries pre-reduced per-shard results. Keyed by the
+        # shard GROUP (index + sids), stale entries displaced on refresh.
+        from ..indices.cache_service import (MeshStackCache,
+                                             MeshVectorStackCache)
+        self._host_mesh_stacks = MeshStackCache(max_bytes=1 << 31)
+        self._host_vector_stacks = MeshVectorStackCache(max_bytes=1 << 31)
+        self.host_reduce_stats = {"dispatches": 0, "declined": 0,
+                                  "errors": 0, "merges": 0}
 
     # ------------------------------------------------------------------
     # membership / election (ref ZenDiscovery.java:354 innerJoinCluster)
@@ -339,6 +353,19 @@ class ClusterNode:
         from ..serving.qos import hedge_snapshot
         sections = {
             "node": (None, {"docs": docs, "shards": shards}),
+            # node-local mesh reduce (ISSUE 11): host-reduce programs this
+            # node ran (data-node side), declines down the fan-out ladder,
+            # errors, and coordinator-side pre-reduced merges —
+            # es_search_mesh_host_reduce_dispatches_total et al.
+            "search": (None, {
+                "mesh_host_reduce_dispatches_total":
+                    self.host_reduce_stats["dispatches"],
+                "mesh_host_reduce_declined_total":
+                    self.host_reduce_stats["declined"],
+                "mesh_host_reduce_errors_total":
+                    self.host_reduce_stats["errors"],
+                "mesh_host_reduce_merges_total":
+                    self.host_reduce_stats["merges"]}),
             # hedged-read outcomes + per-class transport send queues
             # (ISSUE 9): es_search_hedged_total{outcome=},
             # es_transport_class_queue_depth{class=}
@@ -1071,20 +1098,24 @@ class ClusterNode:
 
     def index_doc(self, index: str, doc_id: str | None, source: dict,
                   type_name: str = "_doc", routing: str | None = None,
-                  _local_defer: set | None = None, **kw) -> dict:
+                  _local_defer: set | None = None,
+                  _replica_defer: dict | None = None, **kw) -> dict:
         if doc_id is None:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
         return self._write_op(index, {
             "op": "index", "id": doc_id, "source": source, "type": type_name,
-            "routing": routing, **kw}, local_defer=_local_defer)
+            "routing": routing, **kw}, local_defer=_local_defer,
+            replica_defer=_replica_defer)
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: str | None = None,
-                   _local_defer: set | None = None, **kw) -> dict:
+                   _local_defer: set | None = None,
+                   _replica_defer: dict | None = None, **kw) -> dict:
         return self._write_op(index, {"op": "delete", "id": doc_id,
                                       "routing": routing, **kw},
-                              local_defer=_local_defer)
+                              local_defer=_local_defer,
+                              replica_defer=_replica_defer)
 
     def bulk(self, operations: list[tuple[str, dict, dict | None]]) -> list[dict]:
         """(action, meta, source) ops -> per-item results (ref
@@ -1094,9 +1125,18 @@ class ClusterNode:
         per-op translog fsync and every touched local engine syncs ONCE
         at the end of the request (the reference's per-request
         durability). Ops forwarded to remote primaries keep their per-op
-        durability — the remote node acks only after its own fsync."""
+        durability — the remote node acks only after its own fsync.
+
+        Replica replication batches the same way (ISSUE 11 satellite):
+        locally-held primaries append each replica op to a per-target-NODE
+        batch instead of sending one framed A_WRITE_R per op, and the
+        whole request's replication rides ONE A_WRITE_R_BULK send per
+        (node, request) on the bulk transport class — per-op apply/buffer
+        semantics on the replica and per-shard failure reporting are
+        unchanged."""
         items = []
         deferred: set = set()    # local engines written with sync=False
+        replica_defer: dict[str, list[dict]] = {}   # node -> replica ops
         try:
             for op_t in operations:
                 # (action, meta, source) or (action, meta, source, raw_len)
@@ -1112,7 +1152,8 @@ class ClusterNode:
                             or meta.get("routing"),
                             op_type="create" if action == "create"
                             else "index",
-                            _local_defer=deferred)
+                            _local_defer=deferred,
+                            _replica_defer=replica_defer)
                         items.append({action: {
                             "_index": index, "_type": type_name,
                             "_id": r["_id"], "_version": r["_version"],
@@ -1122,7 +1163,8 @@ class ClusterNode:
                             index, doc_id,
                             routing=meta.get("_routing")
                             or meta.get("routing"),
-                            _local_defer=deferred)
+                            _local_defer=deferred,
+                            _replica_defer=replica_defer)
                         items.append({"delete": {
                             "_index": index, "_type": type_name,
                             "_id": doc_id,
@@ -1140,6 +1182,9 @@ class ClusterNode:
                     items.append({action: {"_index": index, "_id": doc_id,
                                            "status": 400, "error": str(e)}})
         finally:
+            # the request's whole replication: ONE framed send per target
+            # node (bulk transport class), replicas ack before we return
+            self._flush_replica_batches(replica_defer)
             for eng in deferred:
                 try:
                     eng.translog.sync()
@@ -1147,13 +1192,61 @@ class ClusterNode:
                     pass
         return items
 
+    def _flush_replica_batches(self, replica_defer: dict) -> None:
+        """Send each target node its batched replica ops as one framed
+        A_WRITE_R_BULK message. Failure semantics match the per-op path:
+        an unreachable/erroring replica node fails its shards to the
+        master (the write itself already succeeded on the primary), and
+        per-op not-hosted errors come back in the response."""
+        for target, ops in replica_defer.items():
+            if not ops:
+                continue
+            failed_shards: list[tuple[str, int]] = []
+            try:
+                r = self.transport.send(target, A_WRITE_R_BULK,
+                                        {"ops": ops})
+                failed_shards = [(f["index"], f["shard"])
+                                 for f in r.get("failed", [])]
+            except (ConnectTransportException, RemoteTransportException):
+                failed_shards = sorted({(op["index"], op["shard"])
+                                        for op in ops})
+            for index, sid in failed_shards:
+                try:
+                    self._master_call(A_SHARD_FAILED, {
+                        "index": index, "shard": sid, "node": target})
+                except Exception:  # noqa: BLE001 — masterless interim
+                    pass
+
+    def _on_replica_bulk(self, from_id: str, req: dict) -> dict:
+        """Apply a batch of replica ops in arrival order — exactly the
+        per-op A_WRITE_R semantics (buffer during recovery, external-
+        version apply), one framed message for the whole request."""
+        applied = 0
+        failed: list[dict] = []
+        for op in req.get("ops", []):
+            holder = self._shards.get((op["index"], op["shard"]))
+            if holder is None:
+                failed.append({"index": op["index"], "shard": op["shard"]})
+                continue
+            with holder.lock:
+                if holder.recovering or holder.engine is None:
+                    holder.pending.append(op)
+                else:
+                    self._apply_replica_op(holder, op)
+            applied += 1
+        return {"applied": applied, "failed": failed}
+
     def _write_op(self, index: str, op: dict, timeout: float = 10.0,
-                  local_defer: set | None = None) -> dict:
+                  local_defer: set | None = None,
+                  replica_defer: dict | None = None) -> dict:
         """Route to the primary, retrying on stale routing / primary
         failover — the reference's retry-on-cluster-state-change loop.
         local_defer: when set and the primary is LOCAL, the engine write
         skips its per-op fsync and the engine joins the set for the
-        caller's single end-of-request sync (bulk group commit)."""
+        caller's single end-of-request sync (bulk group commit).
+        replica_defer: when set and the primary is LOCAL, replica ops
+        batch per target node instead of one framed send per op — the
+        caller flushes one A_WRITE_R_BULK per node at request end."""
         deadline = time.monotonic() + timeout
         last_err: Exception | None = None
         while time.monotonic() < deadline:
@@ -1184,7 +1277,8 @@ class ClusterNode:
                 if primary["node"] == self.node_id:
                     if local_defer is not None:
                         payload = {**payload, "sync": False}
-                    res = self._on_primary_write(self.node_id, payload)
+                    res = self._on_primary_write(self.node_id, payload,
+                                                 _replica_defer=replica_defer)
                     if local_defer is not None:
                         holder = self._shards.get((index, sid))
                         if holder is not None and holder.engine is not None:
@@ -1218,7 +1312,8 @@ class ClusterNode:
         raise UnavailableShardsException(
             f"[{index}] shard for [{op['id']}] not available: {last_err}")
 
-    def _on_primary_write(self, from_id: str, req: dict) -> dict:
+    def _on_primary_write(self, from_id: str, req: dict,
+                          _replica_defer: dict | None = None) -> dict:
         index, sid = req["index"], req["shard"]
         holder = self._shards.get((index, sid))
         state = self.cluster.current()
@@ -1262,6 +1357,11 @@ class ClusterNode:
             if c["primary"] or c["node"] in (None, self.node_id) \
                     or c["state"] not in (STARTED, INITIALIZING,
                                           RELOCATING):
+                continue
+            if _replica_defer is not None:
+                # bulk batching: this op joins its target node's batch —
+                # ONE framed send per (node, request) at request end
+                _replica_defer.setdefault(c["node"], []).append(replica_req)
                 continue
             try:
                 self.transport.send(c["node"], A_WRITE_R, replica_req)
@@ -1623,11 +1723,75 @@ class ClusterNode:
 
         # phase 1: query fan-out, partial-failure accounting (a failed
         # shard reduces coverage, never aborts the search — ref
-        # TransportSearchTypeAction onFirstPhaseResult failure path)
+        # TransportSearchTypeAction onFirstPhaseResult failure path).
+        #
+        # Node-local mesh reduce (ISSUE 11): shards co-hosted on one node
+        # group into ONE A_QUERY_HOST message — the data node runs all of
+        # them as one shard_map program (one device fetch per host) and
+        # returns pre-reduced per-shard wire results, bitwise-identical
+        # to the per-shard fan-out. Declines/errors fall back to the
+        # hedged per-shard path below.
         per_shard: list[tuple[int, dict]] = []
         failures: list[dict] = []
+        host_served: set[int] = set()
         with tracing.span("query", shards=len(targets)):
+            from .host_reduce import body_eligible
+            if body_eligible(body) and self._host_reduce_enabled():
+                groups: dict[tuple[str, str], list[int]] = {}
+                for ti, (node, name, sid) in enumerate(targets):
+                    groups.setdefault((node, name), []).append(ti)
+                host_groups = [(node, name, tis)
+                               for (node, name), tis in groups.items()
+                               if len(tis) >= 2]
+
+                def _call_host(node, name, tis, results):
+                    sids = [targets[ti][2] for ti in tis]
+                    payload = {"index": name, "shards": sids,
+                               "body": body, "size": size + from_,
+                               "dfs": dfs,
+                               "_task": self._task_header(task),
+                               "_trace": self._trace_header()}
+                    try:
+                        with tracing.span("mesh_host_reduce", index=name,
+                                          node=node, shards=len(sids)):
+                            results[(node, name)] = self._shard_call(
+                                node, A_QUERY_HOST, payload)
+                    except (ConnectTransportException,
+                            RemoteTransportException):
+                        results[(node, name)] = None
+                if host_groups:
+                    # per-HOST calls fan out concurrently (the reference's
+                    # async shard fan-out, one message per host): the
+                    # hosts' mesh programs overlap instead of serializing
+                    import contextvars
+                    results: dict = {}
+                    threads = []
+                    for node, name, tis in host_groups[1:]:
+                        ctx = contextvars.copy_context()
+                        t = threading.Thread(
+                            target=ctx.run, args=(_call_host, node, name,
+                                                  tis, results),
+                            daemon=True)
+                        t.start()
+                        threads.append(t)
+                    _call_host(*host_groups[0][:3], results)
+                    for t in threads:
+                        t.join()
+                    for node, name, tis in host_groups:
+                        r = results.get((node, name))
+                        if r is None:
+                            self.host_reduce_stats["errors"] += 1
+                            continue     # per-shard fallback below
+                        if r.get("declined") is not None:
+                            continue     # the data node counted its reason
+                        self.host_reduce_stats["merges"] += 1
+                        for ti in tis:
+                            per_shard.append((ti, r["shards"][str(
+                                targets[ti][2])]))
+                            host_served.add(ti)
             for ti, (node, name, sid) in enumerate(targets):
+                if ti in host_served:
+                    continue
                 payload = {"index": name, "shard": sid, "body": body,
                            "size": size + from_, "dfs": dfs,
                            "_task": self._task_header(task),
@@ -1640,6 +1804,9 @@ class ClusterNode:
                         RemoteTransportException) as e:
                     failures.append({"shard": sid, "index": name,
                                      "node": node, "reason": str(e)})
+        # agg/suggest partials must merge in target order regardless of
+        # which lane served each shard (float merges are order-sensitive)
+        per_shard.sort(key=lambda e: e[0])
         if not per_shard and targets:
             raise UnavailableShardsException(
                 f"all shards failed for [{index}]: {failures}")
@@ -1824,6 +1991,55 @@ class ClusterNode:
             return _shard_query_phase(searcher, self._mappers[req["index"]],
                                       body, k, req.get("dfs"),
                                       search_after=req.get("search_after"))
+
+    _host_reduce_error_logged = 0
+
+    def _host_reduce_enabled(self) -> bool:
+        """`cluster.search.host_reduce.enable` (default true) — read live
+        from cluster-state settings, like the hedge settings."""
+        from .host_reduce import HOST_REDUCE_SETTING, setting_enabled
+        st = self.cluster.current().data.get("settings") or {}
+        return setting_enabled(st.get(HOST_REDUCE_SETTING, True))
+
+    def _on_query_host(self, from_id: str, req: dict) -> dict:
+        """Data-node side of the node-local mesh reduce: run every
+        requested co-hosted shard's query phase as ONE shard_map program
+        and return pre-reduced per-shard wire results. Declines (wire
+        `{"declined": reason}`) send the coordinator down the per-shard
+        fan-out — never an error."""
+        from . import host_reduce
+        if not self._host_reduce_enabled():
+            return {"declined": "disabled"}
+        index = req["index"]
+        sids = [int(s) for s in req["shards"]]
+        desc = f"shards [{index}]{sids}"
+        with self.tasks.scope(
+                "indices:data/read/search[phase/query/host]",
+                description=desc,
+                parent_task_id=(req.get("_task") or {}).get("parent"),
+                trace_id=(req.get("_task") or {}).get("trace"),
+                opaque_id=(req.get("_task") or {}).get("opaque")):
+            with self.tracer.remote(req.get("_trace"), "mesh_host_reduce",
+                                    attrs={"description": desc,
+                                           "node": self.node_id}):
+                try:
+                    out, reason = host_reduce.try_host_reduce(
+                        self, index, sids, req.get("body") or {},
+                        int(req["size"]), req.get("dfs"))
+                except Exception:  # noqa: BLE001 — fan-out is always correct
+                    self.host_reduce_stats["errors"] += 1
+                    if ClusterNode._host_reduce_error_logged < 10:
+                        ClusterNode._host_reduce_error_logged += 1
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "host mesh reduce failed; served via the "
+                            "per-shard fan-out instead", exc_info=True)
+                    return {"declined": "error"}
+        if out is None:
+            self.host_reduce_stats["declined"] += 1
+            return {"declined": reason}
+        self.host_reduce_stats["dispatches"] += 1
+        return out
 
     def _on_fetch(self, from_id: str, req: dict) -> dict:
         holder = self._shards.get((req["index"], req["shard"]))

@@ -269,6 +269,7 @@ class IndexService:
                  for si, e in enumerate(self.shards)}
         self.caches.segment_stacks.drop_stale(self.name, valid)
         self.caches.mesh_stacks.drop_stale(self.name, valid)
+        self.caches.mesh_vector_stacks.drop_stale(self.name, valid)
 
     def _on_packed_removed(self, _key, value, _reason) -> None:
         """Packed-view cache removal: hand the view's duplicate-postings

@@ -596,6 +596,112 @@ class MeshStackCache:
         return out
 
 
+class MeshVectorStackCache:
+    """Per-(index, vector field) packed vector MESH stacks for the mesh
+    kNN lane (parallel/mesh_knn.py): every shard's vector columns one
+    level up, sharded over the device mesh's "shard" axis. Same lifecycle
+    contract as MeshStackCache — fielddata-breaker-charged at build
+    through make_room admission, released on any removal, keyed by the
+    index's FULL per-shard segment-id sets. IVF packs attach lazily to a
+    cached stack (their tensors are immutable alongside the segment set);
+    their bytes true up against the same breaker via `charge_extra` and
+    release with the entry."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.oversized = 0
+        self.declined = 0
+        self.cache = Cache("mesh_vector_stack", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _StackEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="mesh_vector_stack",
+                              reason=reason, bytes=entry.nbytes)
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+
+    def get_or_build(self, index_name, incarnation, field,
+                     per_shard_segments, breaker=None):
+        """The index's MeshVectorStack for `field`, building (and
+        breaker-charging) on first use. None when declined."""
+        from ..parallel import mesh_exec, mesh_knn
+        info = mesh_exec.mesh_for(len(per_shard_segments))
+        if info is None:
+            return None
+        mesh, s_pad, n_replicas = info
+        entries = tuple(
+            (si, tuple(s.seg_id for s in segs if s.n_docs > 0))
+            for si, segs in enumerate(per_shard_segments))
+        if not any(ids for _si, ids in entries):
+            return None
+        key = (index_name, field, incarnation, entries)
+        with tracing.span("cache.get", tier="mesh_vector_stack") as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
+        if ent is not None:
+            return ent.stack
+        est = mesh_knn.estimate_vector_stack_bytes(per_shard_segments,
+                                                   field)
+        if est == 0:
+            return None
+        if self.cache.max_bytes > 0 and est > self.cache.max_bytes:
+            self.oversized += 1
+            return None
+        if breaker is not None:
+            try:
+                self.cache.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429 a search
+                self.declined += 1
+                return None
+        try:
+            stack = mesh_knn.build_vector_stack(
+                per_shard_segments, field, mesh, s_pad, n_replicas)
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if stack is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        nbytes = stack.nbytes
+        if breaker is not None and nbytes != est:
+            if nbytes > est:
+                breaker.add_estimate(nbytes - est, check=False)
+            else:
+                breaker.release(est - nbytes)
+        entry = _StackEntry(stack, nbytes, breaker, index_name)
+        if self.cache.put(key, entry):
+            # a refresh/merge changed some shard's segment set: stale
+            # vector stacks for this (index, field) free device bytes NOW
+            self.cache.invalidate_where(
+                lambda k, _e: k[:3] == key[:3] and k != key)
+        elif breaker is not None:
+            breaker.release(nbytes)
+        return stack
+
+    def drop_stale(self, index_name: str, valid: set) -> int:
+        """Invalidate entries whose per-shard segment-id sets no longer
+        match the live ones (same refresh/flush/merge hook as the mesh
+        stack tier)."""
+        return self.cache.invalidate_where(
+            lambda k, _e: k[0] == index_name and set(k[3]) != valid)
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(lambda k, _e: k[0] in want)
+
+    def stats(self) -> dict:
+        out = self.cache.stats()
+        out["oversized"] = self.oversized
+        out["declined"] = self.declined
+        return out
+
+
 class IndicesCacheService:
     """The node's cache roster. One `stats()`/`clear()` surface over the
     three tiers; per-index packed-view caches register here so their
@@ -647,6 +753,12 @@ class IndicesCacheService:
         # bytes/doc + nlist*dims*4 — far below the vectors themselves)
         self.ann_indexes = AnnIndexCache(
             max_bytes=parse_size(get("indices.ann.cache.size", "10%"),
+                                 total, default=total // 10))
+        # packed vector mesh stacks for the mesh kNN lane duplicate the
+        # index's vector residency onto the device mesh — same budget
+        # slice as the text mesh stacks
+        self.mesh_vector_stacks = MeshVectorStackCache(
+            max_bytes=parse_size(get("indices.mesh.cache.size", "10%"),
                                  total, default=total // 10))
         # per-index packed-view caches (serving views) register here so
         # their byte totals surface without the service owning them
@@ -710,6 +822,7 @@ class IndicesCacheService:
             # (removal releases their breaker charge)
             out["segment_stack"] = self.segment_stacks.clear(indices)
             out["mesh_stack"] = self.mesh_stacks.clear(indices)
+            out["mesh_vector_stack"] = self.mesh_vector_stacks.clear(indices)
             out["ann_index"] = self.ann_indexes.clear(indices)
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
@@ -721,6 +834,7 @@ class IndicesCacheService:
                "fielddata": self.fielddata.stats(),
                "segment_stack": self.segment_stacks.stats(),
                "mesh_stack": self.mesh_stacks.stats(),
+               "mesh_vector_stack": self.mesh_vector_stacks.stats(),
                "ann_index": self.ann_indexes.stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
@@ -732,4 +846,5 @@ class IndicesCacheService:
         self.fielddata.cache.clear()
         self.segment_stacks.cache.clear()
         self.mesh_stacks.cache.clear()
+        self.mesh_vector_stacks.cache.clear()
         self.ann_indexes.cache.clear()

@@ -1104,21 +1104,35 @@ class NodeService:
         shard_failures = 0
         shard_failure_details: list[dict] = []
         mesh_reduced = None
+        mesh_aggs_merged = None
         with tracing.span("query"):
             # mesh-sharded query lane (parallel/mesh_exec): when this node
             # owns every shard and the device mesh can seat them, the
-            # whole multi-shard query phase — per-shard stacked execution
-            # AND the cross-shard merge — runs as ONE shard_map program
-            # with ONE device fetch and zero host-side per-shard merges.
-            # Sorted/search_after/knn/rescore/agg bodies, cross-host
-            # shards and unsupported plans fall through to the fan-out.
+            # whole multi-shard query phase — per-shard stacked execution,
+            # agg partial collect AND the cross-shard merge — runs as ONE
+            # shard_map program with ONE device fetch and zero host-side
+            # per-shard merges. kNN bodies ride their own mesh program
+            # (parallel/mesh_knn: exact matmul or IVF under the sharded
+            # axis). Sorted/search_after/rescore/rank bodies, cross-host
+            # shards and unsupported plan/agg shapes fall through to the
+            # fan-out.
             if (len(names) == 1 and len(searchers) > 1 and knn is None
                     and sort is None and search_after is None
-                    and rescore_spec is None and not agg_specs):
-                mesh_rows = self._try_mesh(
+                    and rescore_spec is None):
+                mesh_out = self._try_mesh(
                     names[0], searchers, nodes_by_index[names[0]],
-                    global_stats, size=size, from_=from_)
-                mesh_reduced = mesh_rows[0] if mesh_rows else None
+                    global_stats, size=size, from_=from_,
+                    agg_specs=agg_specs or None)
+                if mesh_out is not None:
+                    mesh_rows, mesh_aggs_merged = mesh_out
+                    mesh_reduced = mesh_rows[0] if mesh_rows else None
+            elif (len(names) == 1 and len(searchers) > 1
+                  and knn is not None and rank_spec is None
+                  and rescore_spec is None):
+                mesh_reduced = self._try_mesh_knn(
+                    names[0], searchers, knn, k=knn_k, qv=[qv_single],
+                    nprobe=knn_nprobe, exact=knn_exact,
+                    size=size, from_=from_)
             if mesh_reduced is not None:
                 results = []
             elif len(searchers) == 1:
@@ -1266,8 +1280,13 @@ class NodeService:
         if agg_specs:
             t_agg0 = time.perf_counter()
             with tracing.span("aggregations"):
-                merged = merge_shard_partials(
-                    agg_specs, [r.aggs for r in results if r.aggs])
+                if mesh_aggs_merged is not None:
+                    # the mesh program already collected + merged the
+                    # partials on device (parallel/mesh_aggs.py)
+                    merged = mesh_aggs_merged
+                else:
+                    merged = merge_shard_partials(
+                        agg_specs, [r.aggs for r in results if r.aggs])
                 resp["aggregations"] = render_aggs(agg_specs, merged)
             if prof is not None:
                 prof.record_phase("aggregations",
@@ -1708,13 +1727,18 @@ class NodeService:
     # -- mesh-sharded query lane (parallel/mesh_exec, ISSUE 6) -------------
 
     def _try_mesh(self, name: str, searchers, node_tree, global_stats, *,
-                  size: int, from_: int, n_queries: int = 1):
+                  size: int, from_: int, n_queries: int = 1,
+                  agg_specs=None):
         """One mesh-lane attempt for an unsorted multi-shard query batch:
-        returns the per-row ReducedDocs the on-device collective reduce
-        produced (one per query row — single searches take row 0), or None
-        to fall back to the PR-4 concurrent fan-out (opt-out settings,
-        joins, unsupported plan shapes, too few devices, breaker-declined/
-        oversized mesh stacks, or any execution error)."""
+        returns (per-row ReducedDocs list, merged agg partial | None) from
+        the on-device collective reduce (single searches take row 0), or
+        None to fall back to the PR-4 concurrent fan-out (opt-out
+        settings, joins, unsupported plan/agg shapes, too few devices,
+        breaker-declined/oversized mesh stacks, or any execution error).
+
+        With `agg_specs`, the agg tree rides the SAME program
+        (parallel/mesh_aggs.py) — the merged partial equals the fan-out's
+        per-shard collect + host merge bit-for-bit."""
         svc = self.indices[name]
         if not svc._mesh_enabled \
                 or not _mesh_enabled_setting(self.settings):
@@ -1740,16 +1764,24 @@ class NodeService:
                 out = mesh_exec.execute(
                     stack, node_tree, global_stats, k=k, Q=n_queries,
                     block_docs=svc._block_docs
-                    if svc._blockwise_enabled else None)
+                    if svc._blockwise_enabled else None,
+                    agg_specs=agg_specs)
             if out is None:
-                return None     # plan has no collective form (field shapes)
+                # plan/agg shape has no collective form (field shapes)
+                if agg_specs:
+                    svc.search_stats["mesh_agg_fallbacks"] = \
+                        svc.search_stats.get("mesh_agg_fallbacks", 0) + 1
+                return None
         except Exception:  # noqa: BLE001 — the fan-out is always correct
             self._mesh_error(svc)
             return None
-        keys, shard_of, scores, total, mx = out
+        keys, shard_of, scores, totals, mxs, agg_per_shard = out
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
+        if agg_specs:
+            svc.search_stats["mesh_agg_dispatches"] = \
+                svc.search_stats.get("mesh_agg_dispatches", 0) + 1
         if mesh_exec.last_block_mode == "blockwise":
             svc.search_stats["blockwise_dispatches"] = \
                 svc.search_stats.get("blockwise_dispatches", 0) + 1
@@ -1758,23 +1790,84 @@ class NodeService:
         prof = current_profiler()
         if prof is not None:
             prof.note_path("mesh")
-        import math as _math
-        from .search.controller import ReducedDocs
-        window = slice(from_, from_ + size)
-        rows = []
-        for qi in range(n_queries):
-            row_k, row_sh, row_s = keys[qi], shard_of[qi], scores[qi]
-            valid = row_k >= 0
-            vk, vsh, vs = row_k[valid], row_sh[valid], row_s[valid]
-            mxv = float(mx[qi])
-            rows.append(ReducedDocs(
-                shard_order=[int(x) for x in vsh[window]],
-                doc_keys=[int(x) for x in vk[window]],
-                scores=[float(x) for x in vs[window]],
-                sort_values=None,
-                total_hits=int(total[qi]),
-                max_score=mxv if _math.isfinite(mxv) else float("nan")))
-        return rows
+        rows = _mesh_rows(keys, shard_of, scores, totals, mxs,
+                          n_queries=n_queries, size=size, from_=from_)
+        agg_merged = None
+        if agg_per_shard is not None:
+            from .search.aggs.aggregators import merge_shard_partials
+            agg_merged = merge_shard_partials(agg_specs, agg_per_shard)
+        return rows, agg_merged
+
+    # -- mesh kNN lane (parallel/mesh_knn, ISSUE 11) -----------------------
+
+    def _try_mesh_knn(self, name: str, searchers, knn: dict, *, k: int,
+                      qv, nprobe, exact: bool, size: int, from_: int):
+        """One mesh attempt for a multi-shard kNN body: all co-hosted
+        shards' vector columns execute as ONE shard_map program — exact
+        matmul or the IVF centroid-route + cluster scan under the sharded
+        axis — with the cross-shard top-k reduce on device. Returns
+        ReducedDocs or None to fall back to the per-shard fan-out (mixed
+        IVF/exact segment lanes, non-uniform nlist, filter plans without a
+        mesh form, opt-outs, any error)."""
+        svc = self.indices[name]
+        if not svc._mesh_enabled \
+                or not _mesh_enabled_setting(self.settings):
+            return None
+        from .parallel import mesh_exec, mesh_knn
+        if mesh_exec.mesh_for(len(searchers)) is None:
+            return None
+        try:
+            vstack = self.caches.mesh_vector_stacks.get_or_build(
+                name, svc._incarnation, knn["field"],
+                [list(s.segments) for s in searchers],
+                breaker=self.breakers.breaker("fielddata"))
+            if vstack is None:
+                return None
+            fnode = None
+            if knn.get("filter"):
+                fnode = searchers[0].parse([knn["filter"]])
+            stack = None
+            if fnode is not None:
+                stack = self.caches.mesh_stacks.get_or_build(
+                    name, svc._incarnation,
+                    [list(s.segments) for s in searchers],
+                    breaker=self.breakers.breaker("fielddata"))
+                if stack is None:
+                    return None
+            with tracing.span("mesh_reduce", index=name,
+                              shards=len(searchers), k=k, knn=True):
+                out = mesh_knn.execute(
+                    vstack, qv, k=k,
+                    metric=knn.get("metric", "cosine"),
+                    knn_opts=searchers[0].knn_opts,
+                    nprobe=nprobe, exact=exact,
+                    acquire_ivf=lambda si, seg, vc:
+                        searchers[si]._acquire_ivf(
+                            seg, vc, knn["field"], nprobe, exact),
+                    filter_node=fnode, filter_stack=stack)
+            if out is None:
+                svc.search_stats["mesh_ann_fallbacks"] = \
+                    svc.search_stats.get("mesh_ann_fallbacks", 0) + 1
+                return None
+        except Exception:  # noqa: BLE001 — the fan-out is always correct
+            self._mesh_error(svc)
+            return None
+        keys, shard_of, scores, totals, mxs, used_ivf = out
+        svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
+        svc.search_stats["mesh_dispatches"] = \
+            svc.search_stats.get("mesh_dispatches", 0) + 1
+        svc.search_stats["mesh_ann_dispatches"] = \
+            svc.search_stats.get("mesh_ann_dispatches", 0) + 1
+        if used_ivf:
+            svc.search_stats["ann_dispatches"] = \
+                svc.search_stats.get("ann_dispatches", 0) + 1
+        from .common.metrics import current_profiler, record_shard_fetches
+        record_shard_fetches(1)
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_path("mesh")
+        return _mesh_rows(keys, shard_of, scores, totals, mxs,
+                          n_queries=1, size=size, from_=from_)[0]
 
     _mesh_error_logged = 0
 
@@ -2035,10 +2128,11 @@ class NodeService:
                 and rescore_spec0 is None and size + from_ > 0
                 and not (first_body.get("aggs")
                          or first_body.get("aggregations"))):
-            mesh_rows = self._try_mesh(
+            mesh_out = self._try_mesh(
                 names[0], searchers, nodes_by_index[names[0]],
                 global_stats, size=size, from_=from_,
                 n_queries=len(queries))
+            mesh_rows = mesh_out[0] if mesh_out is not None else None
             if mesh_rows is not None:
                 outs = self._batched_reduce(metas, searchers, index_of,
                                             None, size, from_, None, t0,
@@ -2731,6 +2825,17 @@ class NodeService:
             "mesh_dispatches_total": path_totals.get("mesh_dispatches", 0),
             "mesh_queries_total": path_totals.get("mesh", 0),
             "mesh_errors_total": path_totals.get("mesh_errors", 0),
+            # aggs + IVF kNN through the mesh program (ISSUE 11): how
+            # much of each workload rides the collective lane vs falls
+            # down the ladder to the fan-out
+            "mesh_agg_dispatches_total":
+                path_totals.get("mesh_agg_dispatches", 0),
+            "mesh_agg_fallbacks_total":
+                path_totals.get("mesh_agg_fallbacks", 0),
+            "mesh_ann_dispatches_total":
+                path_totals.get("mesh_ann_dispatches", 0),
+            "mesh_ann_fallbacks_total":
+                path_totals.get("mesh_ann_fallbacks", 0),
             "host_merges_total": host_merge_count(),
             # IVF-clustered ANN lane (ISSUE 10): segment executions that
             # routed through the centroid->cluster-scan kernel vs declined
@@ -2844,12 +2949,23 @@ class NodeService:
                 self.caches.segment_stacks.cache.memory_bytes,
             "mesh_stack_cache_memory_bytes":
                 self.caches.mesh_stacks.cache.memory_bytes,
+            # mesh vector stacks (ISSUE 11) + mesh agg/ANN lane adoption:
+            # an incident inspection sees whether agg/kNN traffic rides
+            # the collective lane or fell down the ladder
+            "mesh_vector_stack_cache_memory_bytes":
+                self.caches.mesh_vector_stacks.cache.memory_bytes,
             # vector-serving memory + lane adoption (ISSUE 10): IVF
             # centroid/CSR residency and how much kNN traffic the ANN
             # lane carried
             "ann_index_cache_memory_bytes":
                 self.caches.ann_indexes.cache.memory_bytes,
         }
+        mesh_totals = {"mesh_agg_dispatches": 0, "mesh_ann_dispatches": 0}
+        for svc in self.indices.values():
+            for mk in mesh_totals:
+                mesh_totals[mk] += svc.search_stats.get(mk, 0)
+        out["mesh_agg_dispatches_total"] = mesh_totals["mesh_agg_dispatches"]
+        out["mesh_ann_dispatches_total"] = mesh_totals["mesh_ann_dispatches"]
         from .common.metrics import peak_score_matrix_bytes
         out["peak_score_matrix_bytes"] = peak_score_matrix_bytes()
         # serving-QoS gauges (ISSUE 9): queue depth, shed/hedge rates —
@@ -2971,6 +3087,35 @@ def _contains_mlt(q) -> bool:
     if isinstance(q, list):
         return any(_contains_mlt(x) for x in q)
     return False
+
+
+def _mesh_rows(keys, shard_of, scores, totals, mxs, *, n_queries: int,
+               size: int, from_: int):
+    """Per-row ReducedDocs from a mesh program's fetched outputs. Totals/
+    max arrive PER SHARD ([S, Q]): int totals sum exactly, max over finite
+    per-shard row-maxes equals the fan-out's global max bit-for-bit."""
+    import math as _math
+
+    import numpy as np
+
+    from .search.controller import ReducedDocs
+    window = slice(from_, from_ + size)
+    rows = []
+    for qi in range(n_queries):
+        row_k, row_sh, row_s = keys[qi], shard_of[qi], scores[qi]
+        valid = row_k >= 0
+        vk, vsh, vs = row_k[valid], row_sh[valid], row_s[valid]
+        mx_col = mxs[:, qi]
+        mx_fin = mx_col[np.isfinite(mx_col)]
+        mxv = float(mx_fin.max()) if mx_fin.size else float("nan")
+        rows.append(ReducedDocs(
+            shard_order=[int(x) for x in vsh[window]],
+            doc_keys=[int(x) for x in vk[window]],
+            scores=[float(x) for x in vs[window]],
+            sort_values=None,
+            total_hits=int(totals[:, qi].sum()),
+            max_score=mxv if _math.isfinite(mxv) else float("nan")))
+    return rows
 
 
 def _mesh_enabled_setting(settings) -> bool:

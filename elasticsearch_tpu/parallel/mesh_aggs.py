@@ -1,0 +1,405 @@
+"""Aggregation partials inside the mesh program (ISSUE 11 tentpole (b)).
+
+Until now agg bodies declined the mesh lane: the coordinator fell back to
+the per-shard fan-out, paid S device fetches and merged host-side wire
+partials — exactly the flat-vs-linear reduce the device wins (ROADMAP
+item 1). This module plans the SUPPORTED slice of the agg tree into device
+closures that run inside the shard_map body of parallel/mesh_exec.py,
+right after the query mask is computed:
+
+    m = match & live                 # [G, Q, N] — the same mask the
+                                     # per-shard collect gates on
+    counts  = one-hot / affine-bucket contractions over m (exact ints)
+    metrics = fused (count, sum, sum_sq, min, max) rows per segment
+
+and `all_gather`s the per-shard partial tensors over the "shard" axis so
+they ride the SAME single device fetch as the top-k reduce. Count tensors
+are exact integers, so summing them on device (or host) reproduces the
+per-shard dict merge bit-for-bit; f64 metric rows stay per-SEGMENT in the
+gathered output and merge on host in segment order — float addition is
+not associative, and the fan-out merges in exactly that order.
+
+Supported: terms (keyword field), histogram / date_histogram (numeric,
+fixed interval), range (non-date), and the metric family min / max / sum /
+avg / value_count / stats / extended_stats (numeric) — all without
+sub-aggregations. Anything else returns None and the caller falls down
+the existing ladder (mesh -> fan-out -> per-segment loop).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# operand placement kinds — mirrors mesh_exec's _OP_S/_OP_Q/_OP_R values
+# (imported lazily there; literals here avoid a circular module import)
+_OP_S = "s"
+_OP_Q = "q"
+_OP_R = "r"
+
+# bin caps: past these the per-shard fan-out's own device/host ladder is
+# the better place to be (and the fan-out is what we decline to)
+_MAX_TERMS_BINS = 1 << 12
+_MAX_HIST_BINS = 1 << 14          # aggregators._MAX_DEVICE_BINS
+
+_METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
+                 "extended_stats"}
+
+
+class AggMeshPlan:
+    """One planned agg tree: `devfns` run inside the shard_map body (each
+    returns a [Qb, ...] tensor that the program all_gathers to [S, Qb,
+    ...]), `finish(outs, q_row)` turns the fetched host arrays back into
+    per-shard partial dicts — the exact wire shapes the fan-out's
+    `collect_shard` produces."""
+
+    def __init__(self, specs, devfns, finishers, sig):
+        self.specs = specs
+        self.devfns = devfns          # list[callable(d, m) -> tensor]
+        self.finishers = finishers    # list[callable(np_out, q) -> [dict]]
+        self.sig = sig                # static program-key component
+
+    def device_fns(self):
+        """The closures that actually run on device (absent-field specs
+        have none — their partials are constant)."""
+        return [fn for fn in self.devfns if fn is not None]
+
+    def finish(self, outs, s_count: int, q_row: int = 0) -> list[dict]:
+        """outs: fetched np arrays aligned with device_fns() -> one partial
+        dict per shard (index-aligned with the stack's shard rows)."""
+        per_shard: list[dict] = [{} for _ in range(s_count)]
+        it = iter(outs)
+        for spec, dev, fin in zip(self.specs, self.devfns, self.finishers):
+            out = next(it) if dev is not None else None
+            parts = fin(out, q_row)
+            for si in range(s_count):
+                per_shard[si][spec.name] = parts[si]
+        return per_shard
+
+
+def _supported_type(spec) -> bool:
+    return spec.type in ({"terms", "histogram", "date_histogram", "range"}
+                         | _METRIC_TYPES)
+
+
+def plan_aggs(specs, pctx) -> AggMeshPlan | None:
+    """Plan the agg list against a mesh _PlanCtx (parallel/mesh_exec). The
+    plan emits its operands through `pctx` AFTER the query tree has been
+    planned, so the device op iterator pops query ops first, agg ops
+    second. None = some spec has no mesh form -> the whole query falls
+    back to the fan-out."""
+    if not specs:
+        return None
+    devfns, finishers, sigs = [], [], []
+    for spec in specs:
+        if spec.subs or not _supported_type(spec):
+            return None
+        try:
+            if spec.type == "terms":
+                planned = _plan_terms(spec, pctx)
+            elif spec.type in ("histogram", "date_histogram"):
+                planned = _plan_histogram(spec, pctx)
+            elif spec.type == "range":
+                planned = _plan_range(spec, pctx)
+            else:
+                planned = _plan_metric(spec, pctx)
+        except _Unsupported:
+            return None
+        sig, dev, fin = planned
+        sigs.append(sig)
+        devfns.append(dev)
+        finishers.append(fin)
+    return AggMeshPlan(specs, devfns, finishers, tuple(sigs))
+
+
+class _Unsupported(Exception):
+    pass
+
+
+def _empty_terms():
+    return {"buckets": {}, "other_doc_count": 0, "error_bound": 0}
+
+
+def _plan_terms(spec, pctx):
+    """terms on a keyword field: per-(shard, segment) ordinals remap onto a
+    GLOBAL vocabulary (the host-built [S, G, Vpad] remap operand), counts
+    are one one-hot contraction per segment row summed over the segment
+    axis — exact integers, so the gathered [S, Q, n_bins] tensor equals
+    the per-shard dict merge."""
+    stack = pctx.stack
+    field = spec.params.get("field")
+    if not field or field in stack.mixed:
+        raise _Unsupported(f"terms field [{field}]")
+    if field not in stack.keywords:
+        if field in stack.text or field in stack.numerics:
+            # analyzed-text / numeric terms keep the host collect's
+            # np.unique semantics — fan-out territory
+            raise _Unsupported(f"terms over non-keyword [{field}]")
+        # absent everywhere: every shard reports the empty partial
+        sig = ("terms_absent",)
+        return (sig, None,
+                lambda out, q: [_empty_terms()
+                                for _ in range(stack.s_count)])
+    vocab: list[str] = sorted({v for rows in stack.shard_rows
+                               for _i, seg in rows
+                               for v in (seg.keywords.get(field).values
+                                         if seg.keywords.get(field)
+                                         else ())})
+    n_bins = len(vocab)
+    if n_bins == 0:
+        sig = ("terms_absent",)
+        return (sig, None,
+                lambda out, q: [_empty_terms()
+                                for _ in range(stack.s_count)])
+    if n_bins > _MAX_TERMS_BINS:
+        raise _Unsupported(f"terms vocab [{n_bins}]")
+    bin_of = {v: i for i, v in enumerate(vocab)}
+    v_pad = max(max((len(seg.keywords[field].values)
+                     for rows in stack.shard_rows for _i, seg in rows
+                     if field in seg.keywords), default=1), 1)
+    remap = np.full((stack.s_pad, stack.g_pad, v_pad), n_bins, np.int32)
+    for si, rows in enumerate(stack.shard_rows):
+        for gi, (_i, seg) in enumerate(rows):
+            kc = seg.keywords.get(field)
+            if kc is None:
+                continue
+            for o, v in enumerate(kc.values):
+                remap[si, gi, o] = bin_of[v]
+    pctx.use_field(field, "keyword")
+    pctx.emit(remap, _OP_S)
+    sig = ("terms", field, n_bins, v_pad)
+
+    def dev(d, m):
+        rmp = d.pop()                            # [G, Vpad]
+        ords = d.fields[field].ords              # [G, N]
+        gid = jnp.where(
+            ords >= 0,
+            jnp.take_along_axis(rmp, jnp.maximum(ords, 0).astype(jnp.int32),
+                                axis=1),
+            jnp.int32(n_bins))                   # [G, N]
+
+        def one(gid_g, m_g):                     # [N], [Qb, N]
+            oh = (gid_g[:, None]
+                  == jnp.arange(n_bins, dtype=jnp.int32)[None, :])
+            return jax.lax.dot_general(
+                m_g.astype(jnp.float32), oh.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        return jax.vmap(one)(gid, m).sum(axis=0).astype(jnp.int32)
+
+    from ..search.aggs.aggregators import terms_partial_from_counts
+
+    def fin(out, q):                             # out: [S, Qb, n_bins]
+        parts = []
+        for si in range(stack.s_count):
+            row = out[si, q]
+            counts = {vocab[b]: int(row[b])
+                      for b in np.nonzero(row)[0]}
+            parts.append(terms_partial_from_counts(spec, counts))
+        return parts
+
+    return sig, dev, fin
+
+
+def _plan_histogram(spec, pctx):
+    """histogram / fixed-interval date_histogram: bucket id is an affine
+    transform of the column per segment (per-segment base from the cached
+    column min — exactly `_device_histogram`'s keys), counts stay
+    per-SEGMENT in the output so each shard rebuilds the same key->count
+    dicts the per-segment device collect produced."""
+    from ..search.aggs.aggregators import (_col_minmax, _fixed_interval_ms)
+    stack = pctx.stack
+    field = spec.params.get("field")
+    if not field or field in stack.mixed:
+        raise _Unsupported(f"histogram field [{field}]")
+    if spec.type == "date_histogram":
+        interval = _fixed_interval_ms(spec.params.get("interval", "1d"))
+        if interval is None:
+            raise _Unsupported("calendar interval")
+    else:
+        interval = float(spec.params["interval"])
+    if interval <= 0:
+        raise _Unsupported("non-positive interval")
+    if field not in stack.numerics:
+        sig = ("hist_absent",)
+        return (sig, None,
+                lambda out, q: [{"buckets": {}}
+                                for _ in range(stack.s_count)])
+    pctx.use_field(field, "numeric")
+    bases = np.zeros((stack.s_pad, stack.g_pad), np.float64)
+    hvalid = np.zeros((stack.s_pad, stack.g_pad), bool)
+    n_bins = 1
+    for si, rows in enumerate(stack.shard_rows):
+        for gi, (_i, seg) in enumerate(rows):
+            nc = seg.numerics.get(field)
+            if nc is None:
+                continue
+            mn, mx = _col_minmax(seg, field, nc)
+            if not (np.isfinite(mn) and np.isfinite(mx)):
+                continue              # empty column: zero contribution
+            base = math.floor(mn / interval) * interval
+            bins = int((mx - base) // interval) + 1
+            if bins > _MAX_HIST_BINS:
+                # the fan-out's own device collect declines this too; keep
+                # the two lanes on the same ladder rung
+                raise _Unsupported(f"histogram bins [{bins}]")
+            bases[si, gi] = base
+            hvalid[si, gi] = True
+            n_bins = max(n_bins, bins)
+    pctx.emit(bases, _OP_S)
+    pctx.emit(hvalid, _OP_S)
+    sig = (spec.type, field, float(interval), n_bins)
+
+    def dev(d, m):
+        base = d.pop()                           # [G]
+        ok_g = d.pop()                           # [G]
+        num = d.fields[field]
+        idx = jnp.floor((num.vals.astype(jnp.float64)
+                         - base[:, None]) / interval).astype(jnp.int32)
+        ok = (~num.missing) & (idx >= 0) & (idx < n_bins) \
+            & ok_g[:, None]                      # [G, N]
+
+        def one(idx_g, ok_g2, m_g):              # [N], [N], [Qb, N]
+            sel = m_g & ok_g2[None, :]
+            safe = jnp.where(ok_g2, idx_g, n_bins)
+            oh = (safe[:, None]
+                  == jnp.arange(n_bins, dtype=jnp.int32)[None, :])
+            return jax.lax.dot_general(
+                sel.astype(jnp.float32), oh.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        # [G, Qb, n_bins] -> [Qb, G, n_bins]: per-SEGMENT counts survive
+        # so host keys rebuild from each segment's own base
+        return jnp.moveaxis(jax.vmap(one)(idx, ok, m), 0, 1) \
+            .astype(jnp.int32)
+
+    def fin(out, q):                             # out: [S, Qb, G, n_bins]
+        parts = []
+        for si in range(stack.s_count):
+            buckets: dict = {}
+            for gi in range(len(stack.shard_rows[si])):
+                if not hvalid[si, gi]:
+                    continue
+                row = out[si, q, gi]
+                base = bases[si, gi]
+                for i in np.nonzero(row)[0]:
+                    key = float(base + i * interval)
+                    ent = buckets.get(key)
+                    if ent is None:
+                        buckets[key] = {"doc_count": int(row[i])}
+                    else:
+                        ent["doc_count"] += int(row[i])
+            parts.append({"buckets": buckets})
+        return parts
+
+    return sig, dev, fin
+
+
+def _plan_range(spec, pctx):
+    """range (non-date): bounds are query-derived and uniform across
+    segments, so per-shard counts sum over the segment axis on device."""
+    from ..search.aggs.aggregators import _range_bounds
+    stack = pctx.stack
+    field = spec.params.get("field")
+    if not field or field in stack.mixed:
+        raise _Unsupported(f"range field [{field}]")
+    bounds = _range_bounds(spec.params, is_date=False)
+    if bounds is None:
+        raise _Unsupported("empty ranges")
+    keys, los, his = bounds
+    if field not in stack.numerics:
+        sig = ("range_absent",)
+        return (sig, None,
+                lambda out, q: [{"buckets": {}}
+                                for _ in range(stack.s_count)])
+    pctx.use_field(field, "numeric")
+    pctx.emit(los, _OP_R)   # request-global bounds: replicated operands
+    pctx.emit(his, _OP_R)
+    sig = ("range", field, len(keys))
+
+    def dev(d, m):
+        lo_b, hi_b = d.pop(), d.pop()            # [R]
+        num = d.fields[field]
+        v = num.vals.astype(jnp.float64)         # [G, N]
+        inr = (~num.missing)[:, None, :] \
+            & (v[:, None, :] >= lo_b[None, :, None]) \
+            & (v[:, None, :] < hi_b[None, :, None])        # [G, R, N]
+        # [G, Qb, R] summed over G and N -> [Qb, R]
+        return jnp.einsum("gqn,grn->qr", m.astype(jnp.int64),
+                          inr.astype(jnp.int64))
+
+    def fin(out, q):                             # out: [S, Qb, R]
+        parts = []
+        for si in range(stack.s_count):
+            row = out[si, q]
+            parts.append({"buckets": {
+                key: {"doc_count": int(row[ri]), "from": lo, "to": hi}
+                for ri, (key, lo, hi) in enumerate(keys)}})
+        return parts
+
+    return sig, dev, fin
+
+
+def _plan_metric(spec, pctx):
+    """min/max/sum/avg/value_count/stats/extended_stats on a numeric
+    column: fused per-(segment, query) 5-vectors — `masked_stats`'s exact
+    math over the mesh-padded column (appended zero padding is exact under
+    f64 accumulation) — merged on HOST in segment order, because float
+    addition is order-sensitive and the fan-out merges in that order."""
+    stack = pctx.stack
+    field = spec.params.get("field")
+    if not field or field in stack.mixed:
+        raise _Unsupported(f"metric field [{field}]")
+
+    def empty():
+        return {"count": 0, "sum": 0.0, "min": math.inf,
+                "max": -math.inf, "sum_sq": 0.0}
+
+    if field not in stack.numerics:
+        sig = ("metric_absent", spec.type)
+        return (sig, None,
+                lambda out, q: [empty() for _ in range(stack.s_count)])
+    pctx.use_field(field, "numeric")
+    sig = ("metric", field)
+
+    def dev(d, m):
+        num = d.fields[field]
+
+        def one(vals_g, miss_g, m_g):            # [N], [N], [Qb, N]
+            sel = m_g & ~miss_g[None, :]
+            v = vals_g.astype(jnp.float64)[None, :]
+            vz = jnp.where(sel, v, 0.0)
+            cnt = sel.sum(axis=1).astype(jnp.float64)
+            s = vz.sum(axis=1)
+            ss = (vz * vz).sum(axis=1)
+            mn = jnp.where(sel, v, jnp.inf).min(axis=1)
+            mx = jnp.where(sel, v, -jnp.inf).max(axis=1)
+            return jnp.stack([cnt, s, ss, mn, mx], axis=1)   # [Qb, 5]
+
+        # [G, Qb, 5] -> [Qb, G, 5]
+        return jnp.moveaxis(
+            jax.vmap(one)(num.vals, num.missing, m), 0, 1)
+
+    from ..search.aggs.aggregators import merge_partial
+
+    def fin(out, q):                             # out: [S, Qb, G, 5]
+        parts = []
+        for si in range(stack.s_count):
+            merged = None
+            for gi in range(len(stack.shard_rows[si])):
+                cnt, s, ss, mn, mx = out[si, q, gi]
+                part = {"count": int(cnt), "sum": float(s),
+                        "sum_sq": float(ss),
+                        "min": float(mn) if cnt else math.inf,
+                        "max": float(mx) if cnt else -math.inf}
+                merged = part if merged is None \
+                    else merge_partial(spec, merged, part)
+            parts.append(merged if merged is not None else empty())
+        return parts
+
+    return sig, dev, fin

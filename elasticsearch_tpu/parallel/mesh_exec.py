@@ -75,6 +75,15 @@ _OP_R = "r"        # scalar                  -> P() (replicated)
 _MESH_LOCK = threading.Lock()
 _MESH_MEMO: dict[tuple[int, int], jax.sharding.Mesh] = {}
 
+# ONE collective program in flight per process: two concurrent shard_map
+# executions on the SAME device pool can interleave their collective
+# rendezvous across devices and deadlock (observed with two cluster
+# nodes' host reduces overlapping in one test process). Real multi-host
+# deployments give each host its own devices — there this lock is
+# per-host and uncontended; in-process it serializes device execution
+# while transport/host-prep still overlaps.
+EXEC_LOCK = threading.Lock()
+
 # compiled shard_map programs keyed by plan signature — the jit analog of
 # DistributedSearcher's step memo, bounded on the common Cache core
 _PROGRAMS = Cache("mesh_programs", max_entries=256)
@@ -867,7 +876,7 @@ _FIELD_TENSORS = {"text": 3, "keyword": 1, "numeric": 2}
 
 
 def _build_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
-                   k: int, n_queries: int):
+                   k: int, n_queries: int, agg_devfns: tuple = ()):
     def step(live, seg_ids, *flat):
         live = live[0]                        # [G, N]
         seg_ids = seg_ids[0]                  # [G]
@@ -926,9 +935,18 @@ def _build_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
         out_shard = jnp.where(valid, (pos2 // ks).astype(jnp.int32),
                               jnp.int32(-1))
         out_k = jnp.where(valid, out_k, jnp.int64(-1))
-        total_g = lax.psum(total, SHARD_AXIS)
-        mx_g = lax.pmax(mx, SHARD_AXIS)
-        return out_k, out_shard, out_s, total_g, mx_g
+        # totals/max stay PER SHARD in the output (all_gather, not psum):
+        # exact-int totals sum to the same value anywhere, and the cluster
+        # host reduce decomposes the merged list back into per-shard wire
+        # results — which need each shard's own total/max
+        total_g = lax.all_gather(total, SHARD_AXIS)       # [S, Qb]
+        mx_g = lax.all_gather(mx, SHARD_AXIS)             # [S, Qb]
+        # agg partials ride the SAME program + fetch: counts reduce as
+        # exact integers; f64 metric rows merge host-side in segment
+        # order (parallel/mesh_aggs.py)
+        agg_outs = tuple(lax.all_gather(fn(d, m), SHARD_AXIS)
+                         for fn in agg_devfns)
+        return (out_k, out_shard, out_s, total_g, mx_g) + agg_outs
 
     field_specs = []
     for _name, kind in field_kinds:
@@ -945,7 +963,8 @@ def _build_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
             op_specs.append(P())
     in_specs = tuple([P(SHARD_AXIS), P(SHARD_AXIS)]
                      + field_specs + op_specs)
-    out_specs = (P(REPLICA_AXIS),) * 5
+    out_specs = (P(REPLICA_AXIS),) * 3 \
+        + (P(None, REPLICA_AXIS),) * (2 + len(agg_devfns))
     return jax.jit(_shard_map(step, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs))
 
@@ -1000,8 +1019,8 @@ def _build_blockwise_program(mesh, bplan, *, k: int, n_queries: int,
         out_shard = jnp.where(valid, (pos2 // ks).astype(jnp.int32),
                               jnp.int32(-1))
         out_k = jnp.where(valid, out_k, jnp.int64(-1))
-        total_g = lax.psum(total, SHARD_AXIS)
-        mx_g = lax.pmax(mx, SHARD_AXIS)
+        total_g = lax.all_gather(total, SHARD_AXIS)       # [S, Qb]
+        mx_g = lax.all_gather(mx, SHARD_AXIS)
         return out_k, out_shard, out_s, total_g, mx_g
 
     field_specs = []
@@ -1023,7 +1042,7 @@ def _build_blockwise_program(mesh, bplan, *, k: int, n_queries: int,
             op_specs.append(P())
     in_specs = tuple([P(SHARD_AXIS), P(SHARD_AXIS)]
                      + field_specs + op_specs)
-    out_specs = (P(REPLICA_AXIS),) * 5
+    out_specs = (P(REPLICA_AXIS),) * 3 + (P(None, REPLICA_AXIS),) * 2
     return jax.jit(_shard_map(step, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs))
 
@@ -1094,49 +1113,71 @@ def _try_blockwise(stack: MeshStack, node: Node, stats, *, k: int,
 
 
 def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
-            block_docs: int | None = None):
+            block_docs: int | None = None, agg_specs=None):
     """Run the parsed tree over the mesh stack as one program.
 
-    -> (doc_keys i64[Q,k'], shard i32[Q,k'], scores [Q,k'], total i64[Q],
-    max f[Q]) fetched in ONE device round-trip, or None when the plan has
-    no collective form (caller falls back to the fan-out). May raise on
+    -> (doc_keys i64[Q,k'], shard i32[Q,k'], scores [Q,k'],
+    totals i64[S, Q], max f[S, Q], agg_partials) fetched in ONE device
+    round-trip, or None when the plan has no collective form (caller falls
+    back to the fan-out). Totals/max come back PER SHARD — callers sum/max
+    them (exact: int totals, order-free max) or decompose them into
+    per-shard wire results (the cluster host reduce). May raise on
     execution failure — the caller degrades to the fan-out there too.
+
+    `agg_specs` (parsed AggSpec list) routes the agg tree through the same
+    program (parallel/mesh_aggs.py); `agg_partials` is then one partial
+    dict per shard — exactly the fan-out's per-shard collect output — or
+    the whole call returns None when a spec has no mesh form.
 
     With `block_docs` set and the stack wider than one block, the DSL tree
     runs blockwise inside the shard_map body (search/blockwise.run_scan) —
     peak score memory O(Q × block) per device — before the same cross-shard
-    collective reduce; trees without a blockwise plan materialize."""
+    collective reduce; trees without a blockwise plan (and agg bodies)
+    materialize."""
     global last_block_mode
     R = stack.n_replicas
     q_pad = -(-Q // R) * R
     last_block_mode = "materialized"
-    if block_docs and stack.n_pad > block_docs \
+    if not agg_specs and block_docs and stack.n_pad > block_docs \
             and stack.n_pad % block_docs == 0:
-        out_d = _try_blockwise(stack, node, stats, k=k, q_pad=q_pad, R=R,
-                               block=block_docs)
+        with EXEC_LOCK:
+            out_d = _try_blockwise(stack, node, stats, k=k, q_pad=q_pad,
+                                   R=R, block=block_docs)
+            if out_d is not None:
+                from ..common.metrics import device_fetch
+                out_k, out_shard, out_s, total, mx = out_d
+                got = device_fetch({"keys": out_k, "shard": out_shard,
+                                    "scores": out_s, "total": total,
+                                    "mx": mx})
         if out_d is not None:
             last_block_mode = "blockwise"
-            from ..common.metrics import device_fetch
-            out_k, out_shard, out_s, total, mx = out_d
-            got = device_fetch({"keys": out_k, "shard": out_shard,
-                                "scores": out_s, "total": total, "mx": mx})
             return (np.asarray(got["keys"])[:Q],
                     np.asarray(got["shard"])[:Q],
                     np.asarray(got["scores"])[:Q],
-                    np.asarray(got["total"])[:Q],
-                    np.asarray(got["mx"])[:Q])
+                    np.asarray(got["total"])[: stack.s_count, :Q],
+                    np.asarray(got["mx"])[: stack.s_count, :Q],
+                    None)
     pctx = _PlanCtx(stack, q_pad, stats)
     try:
         sig, devfn = _plan_exec(node, pctx)
     except _Unsupported:
         return None
+    agg_plan = None
+    if agg_specs:
+        from . import mesh_aggs
+        agg_plan = mesh_aggs.plan_aggs(agg_specs, pctx)
+        if agg_plan is None:
+            return None       # some agg has no mesh form -> fan-out
     field_kinds = tuple(pctx.fields.items())
     op_kinds = tuple(kind for _a, kind in pctx.ops)
-    key = (stack.s_pad, R, q_pad, k, sig, field_kinds)
+    key = (stack.s_pad, R, q_pad, k, sig, field_kinds,
+           agg_plan.sig if agg_plan is not None else None)
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = _build_program(stack.mesh, devfn, field_kinds, op_kinds,
-                              k, q_pad // R)
+        prog = _build_program(
+            stack.mesh, devfn, field_kinds, op_kinds, k, q_pad // R,
+            agg_devfns=tuple(agg_plan.device_fns())
+            if agg_plan is not None else ())
         _PROGRAMS.put(key, prog, weight=1)
     args = []
     for name, kind in field_kinds:
@@ -1153,14 +1194,24 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
                                   record_score_matrix_bytes)
     note_h2d(sum(int(a.nbytes) for a, _kind in pctx.ops))
     record_score_matrix_bytes(stack.g_pad * (q_pad // R) * stack.n_pad * 5)
-    out_k, out_shard, out_s, total, mx = prog(
-        stack.live_stack(), stack.seg_ids_dev, *args)
-    # the whole multi-shard query phase comes down in this ONE fetch
-    got = device_fetch({"keys": out_k, "shard": out_shard, "scores": out_s,
-                        "total": total, "mx": mx})
+    with EXEC_LOCK:
+        outs = prog(stack.live_stack(), stack.seg_ids_dev, *args)
+        out_k, out_shard, out_s, total, mx = outs[:5]
+        # the whole multi-shard query phase — top-k reduce AND agg
+        # partials — comes down in this ONE fetch
+        got = device_fetch({"keys": out_k, "shard": out_shard,
+                            "scores": out_s, "total": total, "mx": mx,
+                            "aggs": list(outs[5:])})
+    agg_partials = None
+    if agg_plan is not None:
+        agg_partials = agg_plan.finish(
+            [np.asarray(a)[: stack.s_count] for a in got["aggs"]],
+            stack.s_count)
     return (np.asarray(got["keys"])[:Q], np.asarray(got["shard"])[:Q],
-            np.asarray(got["scores"])[:Q], np.asarray(got["total"])[:Q],
-            np.asarray(got["mx"])[:Q])
+            np.asarray(got["scores"])[:Q],
+            np.asarray(got["total"])[: stack.s_count, :Q],
+            np.asarray(got["mx"])[: stack.s_count, :Q],
+            agg_partials)
 
 
 def program_cache_stats() -> dict:

@@ -486,6 +486,22 @@ def _collect_sig_terms_shard(spec: AggSpec, segments: list[Segment],
     return {"buckets": buckets, "fg_total": fg_total, "bg_total": bg_total}
 
 
+def terms_partial_from_counts(spec: AggSpec, counts: dict) -> dict:
+    """Shard-level terms partial from merged per-key counts: shard_size
+    truncation + other_doc_count/error_bound accounting. The ONE place the
+    truncation order lives — shared by the per-segment collect below and
+    the mesh lane's gathered count tensors (parallel/mesh_aggs.py), so the
+    two paths can never disagree on which keys a shard reports."""
+    size = int(spec.params.get("size", 10)) or len(counts) or 1
+    shard_size = int(spec.params.get("shard_size", size * 3 + 10))
+    items = sorted(counts.items(), key=lambda kv: (-kv[1], str(kv[0])))
+    top = items[:shard_size]
+    dropped = items[shard_size:]
+    return {"buckets": {key: {"doc_count": int(c)} for key, c in top},
+            "other_doc_count": int(sum(c for _, c in dropped)),
+            "error_bound": int(top[-1][1]) if dropped else 0}
+
+
 def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
                          masks: list[np.ndarray], qp,
                          scores: list | None = None) -> dict:
@@ -500,6 +516,8 @@ def _collect_terms_shard(spec: AggSpec, segments: list[Segment],
     for seg, mask in zip(segments, masks):
         for key, c in _terms_counts(spec, seg, mask).items():
             counts[key] = counts.get(key, 0) + c
+    if not spec.subs:
+        return terms_partial_from_counts(spec, counts)
     size = int(spec.params.get("size", 10)) or len(counts) or 1
     shard_size = int(spec.params.get("shard_size", size * 3 + 10))
     items = sorted(counts.items(), key=lambda kv: (-kv[1], str(kv[0])))

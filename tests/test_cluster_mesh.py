@@ -1,0 +1,296 @@
+"""Cluster-wide collectives data plane (ISSUE 11): node-local mesh
+reduce over the transport, aggs + IVF kNN through the mesh program, and
+the batched replica bulk fan-out.
+
+Contract pinned here:
+
+  * a co-hosted multi-shard cluster query executes as ONE A_QUERY_HOST
+    message + ONE device program + ONE device fetch per HOST, and the
+    response is BITWISE-identical to the per-shard transport merge —
+    across the query-shape matrix including terms/date_histogram/stats
+    aggregations and IVF kNN;
+  * the fallback ladder (sorted bodies, unsupported agg shapes, opt-out
+    settings, single-shard hosts) lands on the hedged per-shard fan-out,
+    never errors;
+  * cluster bulk replication rides ONE framed A_WRITE_R_BULK send per
+    (node, request) with per-op apply semantics unchanged;
+  * es_search_mesh_host_reduce_* counters join the cluster metric walk.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster import TestCluster
+from elasticsearch_tpu.cluster.node import A_WRITE_R, A_WRITE_R_BULK
+
+D = 8
+WORDS = ["quick", "brown", "fox", "jumps", "lazy", "dog", "sleeps",
+         "swift", "river", "stone"]
+
+
+def _set_cluster_setting(cluster, key, val):
+    master = cluster.master_node()
+
+    def task(cur):
+        st = cur.mutate()
+        st.data.setdefault("settings", {})[key] = val
+        return st
+    master.cluster.submit_task("test-setting", task)
+
+
+def _norm(resp):
+    resp.pop("took", None)
+    return resp
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """2 nodes co-hosting a 4-shard index (2 shards per host), with text,
+    keyword, numeric and vector fields; IVF pinned uniform (nlist 8,
+    f32) so the kNN mesh lane engages deterministically."""
+    rng = np.random.RandomState(5)
+    c = TestCluster(2, str(tmp_path_factory.mktemp("cmesh")))
+    client = c.client()
+    client.create_index("docs", {"number_of_shards": 4,
+                                 "number_of_replicas": 0,
+                                 "index.knn.ivf.nlist": 8,
+                                 "index.knn.ivf.min_docs": 16,
+                                 "index.knn.precision": "f32"})
+    client.put_mapping("docs", "_doc", {"properties": {
+        "body": {"type": "string"},
+        "tag": {"type": "string", "index": "not_analyzed"},
+        "n": {"type": "long"},
+        "vec": {"type": "dense_vector", "dims": D}}})
+    c.ensure_green()
+    for i in range(400):
+        client.index_doc("docs", str(i), {
+            "body": f"{WORDS[i % 10]} {WORDS[(i * 3 + 1) % 10]} x{i % 5}",
+            "tag": f"t{i % 3}", "n": i,
+            "vec": [float(x) for x in rng.randn(D)]})
+    client.refresh("docs")
+    c._qv = [float(x) for x in rng.randn(D)]
+    yield c
+    c.close()
+
+
+def _search_both(cluster, body):
+    """(host-reduced response, fan-out response, host dispatches delta)."""
+    client = cluster.client()
+    d0 = sum(n.host_reduce_stats["dispatches"]
+             for n in cluster.nodes.values())
+    got = _norm(client.search("docs", json.loads(json.dumps(body))))
+    d1 = sum(n.host_reduce_stats["dispatches"]
+             for n in cluster.nodes.values())
+    _set_cluster_setting(cluster, "cluster.search.host_reduce.enable",
+                         False)
+    want = _norm(client.search("docs", json.loads(json.dumps(body))))
+    _set_cluster_setting(cluster, "cluster.search.host_reduce.enable",
+                         True)
+    return got, want, d1 - d0
+
+
+class TestHostReduceParity:
+    """Bitwise parity vs the per-shard transport merge, one host program
+    per query."""
+
+    BODIES = [
+        {"size": 10, "query": {"match": {"body": "fox"}}},
+        {"size": 10, "query": {"bool": {
+            "should": [{"match": {"body": "quick"}},
+                       {"match": {"body": "dog"}}],
+            "filter": [{"range": {"n": {"gte": 5, "lt": 300}}}]}}},
+        {"size": 40, "from": 7, "query": {"match": {"body": "fox dog"}}},
+        {"size": 10, "query": {"bool": {
+            "must": [{"term": {"tag": "t1"}}],
+            "must_not": [{"term": {"n": 4}}]}}},
+    ]
+
+    @pytest.mark.parametrize("body", BODIES,
+                             ids=[json.dumps(b)[:48] for b in BODIES])
+    def test_query_matrix_bitwise(self, cluster, body):
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2, "each of the 2 hosts must run ONE reduce"
+        assert got == want, body
+
+    def test_aggs_ride_the_host_reduce(self, cluster):
+        body = {"size": 5, "query": {"match": {"body": "dog"}},
+                "aggs": {"tags": {"terms": {"field": "tag"}},
+                         "hist": {"date_histogram": {"field": "n",
+                                                     "interval": "1s"}},
+                         "st": {"stats": {"field": "n"}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+
+    def test_ivf_knn_rides_the_host_reduce(self, cluster):
+        body = {"size": 10, "knn": {"field": "vec",
+                                    "query_vector": cluster._qv,
+                                    "k": 10, "metric": "cosine"}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+
+    def test_filtered_knn_rides_the_host_reduce(self, cluster):
+        body = {"size": 5, "knn": {"field": "vec",
+                                   "query_vector": cluster._qv, "k": 10,
+                                   "filter": {"term": {"tag": "t1"}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+
+    def test_tombstones_identical(self, cluster):
+        client = cluster.client()
+        client.delete_doc("docs", "42")
+        client.refresh("docs")
+        body = {"size": 30, "query": {"match": {"body": "quick fox"}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+        assert "42" not in [h["_id"] for h in got["hits"]["hits"]]
+
+    def test_one_device_fetch_per_host(self, cluster):
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        client = cluster.client()
+        body = {"size": 10, "query": {"bool": {
+            "should": [{"match": {"body": "fox"}},
+                       {"match": {"body": "lazy"}}]}}}
+        client.search("docs", json.loads(json.dumps(body)))   # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        client.search("docs", json.loads(json.dumps(body)))
+        delta = transfer_snapshot()["device_fetches_total"] - f0
+        assert delta == len(cluster.nodes), \
+            f"{delta} device fetches for {len(cluster.nodes)} hosts — " \
+            "each host must pay exactly ONE"
+
+    def test_host_reduce_span_nested_under_query(self, cluster):
+        client = cluster.client()
+        body = {"size": 5, "query": {"match": {"body": "fox"}}}
+        with client.tracer.request("host-reduce-span", force=True):
+            client.search("docs", json.loads(json.dumps(body)))
+        trace = client.tracer.list()[0]
+        full = client.tracer.get(trace["trace_id"])
+        spans = {s["name"]: s for s in full["spans"]}
+        assert "mesh_host_reduce" in spans
+        assert spans["mesh_host_reduce"]["parent_id"] \
+            == spans["query"]["id"], \
+            "mesh_host_reduce must nest under the coordinator query span"
+
+
+class TestHostReduceFallbacks:
+    def test_sorted_body_falls_back(self, cluster):
+        client = cluster.client()
+        d0 = sum(n.host_reduce_stats["dispatches"]
+                 for n in cluster.nodes.values())
+        body = {"size": 10, "query": {"match_all": {}},
+                "sort": [{"n": {"order": "desc"}}]}
+        out = client.search("docs", json.loads(json.dumps(body)))
+        ids = [h["_id"] for h in out["hits"]["hits"]]
+        assert ids == sorted(ids, key=int, reverse=True)[:len(ids)]
+        assert sum(n.host_reduce_stats["dispatches"]
+                   for n in cluster.nodes.values()) == d0
+
+    def test_unsupported_agg_declines(self, cluster):
+        client = cluster.client()
+        de0 = sum(n.host_reduce_stats["declined"]
+                  for n in cluster.nodes.values())
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"card": {"cardinality": {"field": "tag"}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 0
+        assert got == want
+        assert got["aggregations"]["card"]["value"] == 3
+        assert sum(n.host_reduce_stats["declined"]
+                   for n in cluster.nodes.values()) > de0
+
+    def test_setting_opt_out(self, cluster):
+        client = cluster.client()
+        _set_cluster_setting(cluster, "cluster.search.host_reduce.enable",
+                             False)
+        try:
+            d0 = sum(n.host_reduce_stats["dispatches"]
+                     for n in cluster.nodes.values())
+            out = client.search("docs", json.loads(json.dumps(
+                {"size": 5, "query": {"match": {"body": "fox"}}})))
+            assert out["hits"]["total"] > 0
+            assert sum(n.host_reduce_stats["dispatches"]
+                       for n in cluster.nodes.values()) == d0
+        finally:
+            _set_cluster_setting(cluster,
+                                 "cluster.search.host_reduce.enable", True)
+
+    def test_single_shard_hosts_keep_the_fanout(self, tmp_path):
+        """One shard per node: no group reaches 2 — no host reduce."""
+        c = TestCluster(2, str(tmp_path / "narrow"))
+        try:
+            client = c.client()
+            client.create_index("nw", {"number_of_shards": 2,
+                                       "number_of_replicas": 0})
+            c.ensure_green()
+            for i in range(24):
+                client.index_doc("nw", str(i), {"body": f"quick fox {i}"})
+            client.refresh("nw")
+            out = client.search("nw", json.loads(json.dumps(
+                {"size": 5, "query": {"match": {"body": "fox"}}})))
+            assert out["hits"]["total"] == 24
+            assert all(n.host_reduce_stats["dispatches"] == 0
+                       for n in c.nodes.values())
+        finally:
+            c.close()
+
+    def test_metrics_exposed(self, cluster):
+        from elasticsearch_tpu.common.metrics import openmetrics_families
+        node = next(iter(cluster.nodes.values()))
+        fams = openmetrics_families(node.metric_sections(), node.node_id)
+        assert "es_search_mesh_host_reduce_dispatches_total" in fams
+        assert "es_search_mesh_host_reduce_declined_total" in fams
+        assert "es_search_mesh_host_reduce_errors_total" in fams
+
+
+class TestReplicaBulkBatching:
+    def test_one_framed_send_per_node_per_request(self, tmp_path):
+        """A bulk whose local-primary ops replicate to one peer sends ONE
+        A_WRITE_R_BULK frame to that peer — never one A_WRITE_R per op —
+        and the replica applies every op."""
+        c = TestCluster(2, str(tmp_path / "repl"))
+        try:
+            client = c.client()
+            client.create_index("r", {"number_of_shards": 2,
+                                      "number_of_replicas": 1})
+            c.ensure_green()
+            sent: list[tuple[str, str]] = []
+            orig = client.transport.send
+
+            def recording_send(node_id, action, payload=None):
+                sent.append((node_id, action))
+                return orig(node_id, action, payload)
+            client.transport.send = recording_send
+            try:
+                ops = [("index", {"_index": "r", "_id": str(i)},
+                        {"body": f"doc {i}", "n": i}) for i in range(40)]
+                items = client.bulk(ops)
+            finally:
+                client.transport.send = orig
+            assert all(next(iter(it.values()))["status"] in (200, 201)
+                       for it in items)
+            per_op_replicas = [a for _n, a in sent if a == A_WRITE_R]
+            bulk_replicas = [a for _n, a in sent if a == A_WRITE_R_BULK]
+            assert not per_op_replicas, \
+                "local-primary replication must batch, not send per op"
+            # ONE frame per target node that held replicas of local
+            # primaries (some ops may route to the REMOTE primary, whose
+            # own replication is that node's business)
+            assert len(bulk_replicas) <= len(c.nodes) - 1 + 1
+            assert bulk_replicas, "no batched replica frame was sent"
+            # the replicas actually applied: every doc is readable from
+            # every node's LOCAL copies (replicas=1 -> each node holds a
+            # copy of both shards)
+            client.refresh("r")
+            for node in c.nodes.values():
+                total = node.search("r", json.loads(json.dumps(
+                    {"size": 0, "query": {"match_all": {}}})),
+                    preference="_only_local")
+                assert total["hits"]["total"] == 40
+        finally:
+            c.close()

@@ -220,15 +220,41 @@ class TestFallbackLadder:
         assert out["hits"]["total"] > 0
         assert n.indices["m"].search_stats.get("mesh", 0) == before
 
-    def test_aggs_fall_back(self, pair):
+    def test_supported_aggs_ride_the_mesh(self, pair):
+        """ISSUE 11: terms/histogram/metric aggs no longer decline — the
+        partials collect INSIDE the mesh program and merge identically to
+        the fan-out's per-shard collect."""
+        n = pair
+        body = {"size": 5, "query": {"match_all": {}},
+                "aggs": {"tags": {"terms": {"field": "tag"}},
+                         "ns": {"histogram": {"field": "n",
+                                              "interval": 10}},
+                         "ps": {"stats": {"field": "price"}}}}
+        before = n.indices["m"].search_stats.get("mesh_agg_dispatches", 0)
+        got = n.search("m", json.loads(json.dumps(body)),
+                       request_cache=False)
+        assert n.indices["m"].search_stats.get("mesh_agg_dispatches", 0) \
+            == before + 1
+        want = n.search("f", json.loads(json.dumps(body)),
+                        request_cache=False)
+        assert got["aggregations"] == want["aggregations"]
+        assert _hits(got) == _hits(want)
+        assert got["hits"]["total"] == want["hits"]["total"]
+
+    def test_unsupported_aggs_fall_back(self, pair):
+        """Specs without a mesh form (HLL cardinality, sub-aggs) keep the
+        fan-out — counted as mesh_agg_fallbacks."""
         n = pair
         before = n.indices["m"].search_stats.get("mesh", 0)
+        fb = n.indices["m"].search_stats.get("mesh_agg_fallbacks", 0)
         body = {"size": 0, "query": {"match_all": {}},
-                "aggs": {"tags": {"terms": {"field": "tag"}}}}
+                "aggs": {"card": {"cardinality": {"field": "tag"}}}}
         out = n.search("m", json.loads(json.dumps(body)),
                        request_cache=False)
-        assert out["aggregations"]["tags"]["buckets"]
+        assert out["aggregations"]["card"]["value"] == 3
         assert n.indices["m"].search_stats.get("mesh", 0) == before
+        assert n.indices["m"].search_stats.get("mesh_agg_fallbacks", 0) \
+            == fb + 1
 
     def test_more_shards_than_devices_falls_back(self, tmp_path):
         import jax
@@ -444,3 +470,82 @@ class TestDistributedSatellites:
         s1 = ds.build_step(Wt=8, k=5)
         assert ds.build_step(Wt=8, k=5) is s1
         assert ds._step_cache.stats()["entries"] == 1
+
+
+class TestMeshKnn:
+    """IVF kNN through the mesh program (ISSUE 11): one collective
+    program + one fetch for a multi-shard kNN body, bitwise-identical to
+    the per-shard fan-out; exact/mixed lanes keep the fan-out."""
+
+    D = 8
+
+    @pytest.fixture(scope="class")
+    def knn_pair(self, tmp_path_factory):
+        n = NodeService(str(tmp_path_factory.mktemp("meshknn")))
+        mapping = {"_doc": {"properties": {
+            "body": {"type": "string"},
+            "tag": {"type": "string", "index": "not_analyzed"},
+            "vec": {"type": "dense_vector", "dims": self.D}}}}
+        base = {"number_of_shards": 4, "index.knn.ivf.nlist": 8,
+                "index.knn.ivf.min_docs": 16, "index.knn.precision": "f32"}
+        n.create_index("vm", settings=dict(base), mappings=mapping)
+        n.create_index("vf", settings={**base,
+                                       "index.search.mesh.enable": False},
+                       mappings=mapping)
+        rng = np.random.RandomState(11)
+        for i in range(360):
+            doc = {"body": f"w{i % 7}", "tag": f"t{i % 3}",
+                   "vec": [float(x) for x in rng.randn(self.D)]}
+            for name in ("vm", "vf"):
+                n.index_doc(name, str(i), dict(doc))
+        for name in ("vm", "vf"):
+            n.refresh(name)
+        n._qv = [float(x) for x in rng.randn(self.D)]
+        yield n
+        n.close()
+
+    def _both(self, n, knn, size=10):
+        body = {"size": size, "knn": knn}
+        got = n.search("vm", json.loads(json.dumps(body)))
+        want = n.search("vf", json.loads(json.dumps(body)))
+        return _hits(got), _hits(want), got, want
+
+    @pytest.mark.parametrize("metric", ["cosine", "dot", "l2"])
+    def test_ivf_knn_bitwise_identical(self, knn_pair, metric):
+        n = knn_pair
+        before = n.indices["vm"].search_stats.get("mesh_ann_dispatches", 0)
+        g, w, got, want = self._both(
+            n, {"field": "vec", "query_vector": n._qv, "k": 10,
+                "metric": metric})
+        assert n.indices["vm"].search_stats.get(
+            "mesh_ann_dispatches", 0) == before + 1
+        assert g == w
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["hits"]["max_score"] == want["hits"]["max_score"]
+
+    def test_filtered_knn_identical(self, knn_pair):
+        n = knn_pair
+        g, w, *_ = self._both(
+            n, {"field": "vec", "query_vector": n._qv, "k": 10,
+                "filter": {"term": {"tag": "t1"}}}, size=5)
+        assert g == w
+
+    def test_one_fetch_for_the_whole_index(self, knn_pair):
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        n = knn_pair
+        body = {"size": 10, "knn": {"field": "vec",
+                                    "query_vector": n._qv, "k": 10}}
+        n.search("vm", json.loads(json.dumps(body)))          # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        n.search("vm", json.loads(json.dumps(body)))
+        assert transfer_snapshot()["device_fetches_total"] - f0 == 1
+
+    def test_exact_pinned_falls_back(self, knn_pair):
+        n = knn_pair
+        fb0 = n.indices["vm"].search_stats.get("mesh_ann_fallbacks", 0)
+        g, w, *_ = self._both(
+            n, {"field": "vec", "query_vector": n._qv, "k": 10,
+                "exact": True})
+        assert g == w
+        assert n.indices["vm"].search_stats.get(
+            "mesh_ann_fallbacks", 0) == fb0 + 1

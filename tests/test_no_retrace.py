@@ -352,3 +352,47 @@ def test_qos_idle_adds_zero_device_work(stacked_node):
         "a solo leader with no followers must not consume a device batch"
     assert b1["wait_timeouts_total"] == b0["wait_timeouts_total"]
     assert b1["stranded_total"] == b0["stranded_total"]
+
+
+# -- cluster node-local mesh reduce (ISSUE 11) ------------------------------
+
+
+def test_host_reduce_refresh_cycles_within_bucket_zero_retraces(
+        tmp_path_factory):
+    """A cluster refresh→query cycle whose co-hosted shard groups stay in
+    the same pow2 buckets must compile ZERO new host-reduce programs —
+    the mesh program memo survives segment churn inside a bucket."""
+    from elasticsearch_tpu.cluster import TestCluster
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    c = TestCluster(2, str(tmp_path_factory.mktemp("hostnr")))
+    try:
+        client = c.client()
+        client.create_index("hq", {"number_of_shards": 4,
+                                   "number_of_replicas": 0})
+        c.ensure_green()
+        seq = [0]
+
+        def add_round():
+            for _ in range(16):
+                i = seq[0]
+                seq[0] += 1
+                client.index_doc("hq", str(i),
+                                 {"body": f"quick brown fox jumps {i}",
+                                  "n": i})
+            client.refresh("hq")
+        body = {"size": 5, "query": {"bool": {"should": [
+            {"match": {"body": "quick"}}, {"match": {"body": "fox"}}]}}}
+        for _ in range(3):
+            add_round()
+        _q = lambda: client.search("hq", json.loads(json.dumps(body)))  # noqa: E731
+        _q()                                  # warm: compiles expected
+        _q()
+        assert sum(n.host_reduce_stats["dispatches"]
+                   for n in c.nodes.values()) >= 4
+        before = device_events_snapshot()[0]
+        add_round()                           # same pow2 buckets
+        _q()
+        assert device_events_snapshot()[0] == before, \
+            "refresh→query inside the pow2 bucket retraced the host reduce"
+    finally:
+        c.close()

@@ -188,12 +188,15 @@ def _knn_host_reduce(node, index, sids, searchers, knn, k):
     out = mesh_knn.execute(
         vstack, [qv], k=knn_k, metric=knn.get("metric", "cosine"),
         knn_opts=searchers[0].knn_opts, nprobe=nprobe, exact=exact,
+        quantization=knn.get("quantization"),
         acquire_ivf=lambda si, seg, vc: searchers[si]._acquire_ivf(
             seg, vc, field, nprobe, exact),
+        acquire_quant=lambda si, seg, vc, ivf, mode:
+            searchers[si]._acquire_quant(seg, vc, field, ivf, mode),
         filter_node=fnode, filter_stack=fstack)
     if out is None:
         return None, "knn_lane"
-    keys, shard_of, scores, totals, mxs, _used_ivf = out
+    keys, shard_of, scores, totals, mxs, _used_ivf, _used_quant = out
     return keys, shard_of, scores, totals, mxs, None
 
 

@@ -2355,7 +2355,8 @@ def _shard_query_phase(searcher: ShardSearcher, mappers: MapperService,
             k=int(knn.get("k", k)), metric=knn.get("metric", "cosine"),
             filter_node=fnode,
             nprobe=int(raw_np) if raw_np is not None else None,
-            exact=bool(knn.get("exact", False)))
+            exact=bool(knn.get("exact", False)),
+            quantization=knn.get("quantization"))
     else:
         node = searcher.parse([body.get("query") or {"match_all": {}}])
         r = searcher.execute_query_phase(

@@ -403,10 +403,20 @@ def knn_options_from(get) -> dict:
     precision = str(get("knn.precision", "bf16")).strip().lower()
     if precision not in ("bf16", "f32"):
         precision = "bf16"
+    # quantized ANN tier (ISSUE 12): int8 / IVF-PQ cluster scan with a
+    # full-precision rescore of the top `rescore_window` survivors;
+    # anything unrecognized degrades to the f32 IVF lane
+    quant = str(get("knn.quantization", "none")).strip().lower()
+    if quant not in ("none", "int8", "pq"):
+        quant = "none"
+    from ..ops.ann import DEFAULT_PQ_M
     return {
         "ivf_enable": as_bool(get("knn.ivf.enable", True)),
         "nlist": as_int(get("knn.ivf.nlist", 0)),
         "nprobe": as_int(get("knn.ivf.nprobe", 0)),
         "min_docs": as_int(get("knn.ivf.min_docs", 4096), 4096),
         "precision": precision,
+        "quantization": quant,
+        "pq_m": as_int(get("knn.pq.m", DEFAULT_PQ_M), DEFAULT_PQ_M),
+        "rescore_window": as_int(get("knn.rescore_window", 0)),
     }

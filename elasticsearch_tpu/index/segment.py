@@ -160,6 +160,28 @@ class IvfData:
 
 
 @dataclass
+class QuantData:
+    """Quantized storage tier for one vector column's IVF cluster scan
+    (ops/ann.py, ISSUE 12): int8 per-dimension affine codes (1/4 the f32
+    bytes) or IVF-PQ residual codes (m bytes/vector, 1/(4·D/m)). Built
+    once per (segment, field, nlist, mode, m), cached breaker-charged in
+    indices/cache_service.AnnIndexCache's `ann_quant` tier — codes and
+    codebooks account as SEPARATE entries so the exposition shows both."""
+    mode: str                        # "int8" | "pq"
+    codes: jax.Array                 # i8[N_pad, D] (int8) | u8[N_pad, m] (pq)
+    scales: jax.Array | None         # f32[D]           (int8)
+    codebooks: jax.Array | None      # f32[m, 256, dsub] (pq)
+    m: int                           # subquantizers (pq; 0 for int8)
+    nlist: int                       # the IVF layout this encodes against
+    codes_nbytes: int
+    books_nbytes: int
+
+    @property
+    def nbytes(self) -> int:
+        return self.codes_nbytes + self.books_nbytes
+
+
+@dataclass
 class VectorColumn:
     vecs: jax.Array                  # f32[N_pad, dims]
     dims: int
@@ -218,6 +240,69 @@ class VectorColumn:
             norms=norms, sizes_desc_cum=np.cumsum(sizes_desc),
             nlist=nlist, n_docs=n_docs, dims=self.dims,
             nbytes=ann_ops.ivf_nbytes(n_pad, nlist, self.dims))
+
+    def build_quant(self, ivf: "IvfData", mode: str,
+                    m: int | None = None, *,
+                    iters: int | None = None) -> "QuantData | None":
+        """Quantized codes for this column against `ivf`'s cluster layout
+        (ISSUE 12 tentpole): int8 per-dimension affine scales + i8 codes,
+        or IVF-PQ codebooks trained on residuals against each doc's
+        assigned centroid + u8[N, m] codes. Deterministic throughout (the
+        same no-RNG discipline as build_ivf — refresh→query cycles must
+        reproduce the clustering AND the codes bit-for-bit). None when
+        the shape can't quantize (dims not divisible by m, too few docs
+        to train 256 codes) — callers fall back to the f32 IVF scan."""
+        from ..common import tracing
+        from ..ops import ann as ann_ops
+        n_pad = int(self.vecs.shape[0])
+        blk = ann_ops.assign_block_size(n_pad)
+        if mode == "int8":
+            scales = ann_ops.train_int8_scales(self.vecs)
+            codes = ann_ops.quantize_int8(self.vecs, scales, block=blk)
+            cb, bb = ann_ops.quant_nbytes(n_pad, self.dims, "int8", 0)
+            return QuantData(mode="int8", codes=codes, scales=scales,
+                             codebooks=None, m=0, nlist=ivf.nlist,
+                             codes_nbytes=cb, books_nbytes=bb)
+        if mode != "pq":
+            return None
+        m = int(m or ann_ops.DEFAULT_PQ_M)
+        if m < 1 or self.dims % m or ivf.n_docs < ann_ops.PQ_CODES:
+            return None
+        # recover each doc's cluster from the IVF CSR (slot_docs is docs
+        # sorted by (cluster, doc)): no second assignment pass needed
+        sizes = np.asarray(ivf.sizes)
+        slot_docs = np.asarray(ivf.slot_docs)
+        assign = np.full(n_pad, ivf.nlist - 1, np.int32)  # padding: any
+        total = int(sizes.sum())                          # real cluster —
+        assign[slot_docs[:total]] = np.repeat(            # rows are dead
+            np.arange(ivf.nlist, dtype=np.int32), sizes)
+        # deterministic strided residual sample, pow2-padded by wraparound
+        # (same discipline as the Lloyd sample above)
+        step = max(1, ivf.n_docs // ann_ops.TRAIN_SAMPLE_CAP)
+        sample_idx = np.arange(0, ivf.n_docs, step,
+                               dtype=np.int64)[: ann_ops.TRAIN_SAMPLE_CAP]
+        s_pad = min(next_pow2(len(sample_idx)), ann_ops.TRAIN_SAMPLE_CAP)
+        sample_idx = np.resize(sample_idx, s_pad).astype(np.int32)
+        sv = self.vecs[jnp.asarray(sample_idx)]
+        sa = jnp.asarray(assign[sample_idx])
+        resid = (sv - ivf.centroids[sa]).reshape(
+            s_pad, m, self.dims // m)
+        samples = jnp.moveaxis(resid, 1, 0)               # [m, S, dsub]
+        stride = max(1, s_pad // ann_ops.PQ_CODES)
+        inits = samples[:, ::stride, :][:, : ann_ops.PQ_CODES, :]
+        if inits.shape[1] < ann_ops.PQ_CODES:
+            return None
+        with tracing.span("pq_train", m=m, nlist=ivf.nlist,
+                          sample=s_pad):
+            books = ann_ops.train_pq_codebooks(
+                samples, inits,
+                iters=int(iters or ann_ops.DEFAULT_ITERS))
+        codes = ann_ops.encode_pq(self.vecs, jnp.asarray(assign),
+                                  ivf.centroids, books, block=blk)
+        cb, bb = ann_ops.quant_nbytes(n_pad, self.dims, "pq", m)
+        return QuantData(mode="pq", codes=codes, scales=None,
+                        codebooks=books, m=m, nlist=ivf.nlist,
+                        codes_nbytes=cb, books_nbytes=bb)
 
 
 # ---------------------------------------------------------------------------

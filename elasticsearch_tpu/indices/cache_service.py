@@ -289,6 +289,22 @@ class _AnnEntry:
         self.token = token
 
 
+class _QuantEntry:
+    __slots__ = ("quant", "nbytes", "breaker", "index_name", "field",
+                 "token", "mode", "kind")
+
+    def __init__(self, quant, nbytes, breaker, index_name, field, token,
+                 mode, kind):
+        self.quant = quant
+        self.nbytes = nbytes
+        self.breaker = breaker
+        self.index_name = index_name
+        self.field = field
+        self.token = token
+        self.mode = mode              # "int8" | "pq"
+        self.kind = kind              # "codes" | "books"
+
+
 class AnnIndexCache:
     """Per-(segment, vector field, nlist) IVF cluster indexes for the ANN
     kNN lane (ops/ann.py + index/segment.IvfData): k-means centroids + the
@@ -305,6 +321,18 @@ class AnnIndexCache:
         self.cache = Cache("ann_index", max_bytes=max_bytes,
                            weigher=lambda e: e.nbytes,
                            removal_listener=self._on_removal)
+        # quantized storage tier (ISSUE 12): int8 / PQ codes charged at
+        # their TRUE 1/4-1/32 bytes, codebooks as a SEPARATE accounted
+        # entry (key tail "codes" / "books") so the exposition and the
+        # sampler ring show both residencies; same lifecycle as the IVF
+        # tier — dies with the segment, rides `_cache/clear?query=`
+        self.quant_declined = 0
+        self._qlock = threading.Lock()
+        self.quant_code_bytes = 0
+        self.quant_book_bytes = 0
+        self.quant = Cache("ann_quant", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_quant_removal)
 
     def _on_removal(self, key, entry: _AnnEntry, reason: str) -> None:
         if reason == RemovalReason.EVICTED:
@@ -313,6 +341,20 @@ class AnnIndexCache:
                               bytes=entry.nbytes)
         if entry.breaker is not None:
             entry.breaker.release(entry.nbytes)
+
+    def _on_quant_removal(self, key, entry: _QuantEntry,
+                          reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="ann_quant",
+                              reason=reason, field=entry.field,
+                              bytes=entry.nbytes)
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+        with self._qlock:
+            if entry.kind == "codes":
+                self.quant_code_bytes -= entry.nbytes
+            else:
+                self.quant_book_bytes -= entry.nbytes
 
     def get_or_build(self, seg, field: str, nlist: int, build):
         """The segment's IVF index for `field`, building (and charging the
@@ -363,24 +405,100 @@ class AnnIndexCache:
             breaker.release(nbytes)   # refused by budget: nothing retained
         return ivf
 
+    def get_or_build_quant(self, seg, field: str, nlist: int, mode: str,
+                           m: int, build):
+        """The segment's quantized codes for `field` against the `nlist`
+        IVF layout, building (and charging the `fielddata` breaker at the
+        true quantized bytes) on first use. None when declined — shape
+        can't quantize, build failure, or breaker pressure even after
+        shedding (callers fall back to the f32 IVF scan)."""
+        token = FielddataCache.token_of(seg)
+        base = (token, field, int(nlist), mode, int(m))
+        with tracing.span("cache.get", tier="ann_quant",
+                          field=field) as sp:
+            ent = self.quant.get(base + ("codes",))
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
+        if ent is not None:
+            return ent.quant
+        from ..ops.ann import quant_nbytes
+        vc = seg.vectors.get(field)
+        if vc is None:
+            return None
+        breaker = getattr(seg, "breaker", None)
+        cb_est, bb_est = quant_nbytes(int(vc.vecs.shape[0]), vc.dims,
+                                      mode, m)
+        est = cb_est + bb_est
+        if breaker is not None:
+            try:
+                self.quant.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429 a search
+                self.quant_declined += 1
+                return None
+        try:
+            with tracing.span("ann_quant_build", field=field, mode=mode,
+                              m=m):
+                quant = build()
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if quant is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        if breaker is not None and quant.nbytes != est:
+            if quant.nbytes > est:   # true up drift without re-tripping
+                breaker.add_estimate(quant.nbytes - est, check=False)
+            else:
+                breaker.release(est - quant.nbytes)
+        index_name = getattr(seg, "index_name", None)
+        for kind, nbytes in (("codes", quant.codes_nbytes),
+                             ("books", quant.books_nbytes)):
+            entry = _QuantEntry(quant, nbytes, breaker, index_name, field,
+                                token, mode, kind)
+            if self.quant.put(base + (kind,), entry):
+                with self._qlock:
+                    if kind == "codes":
+                        self.quant_code_bytes += nbytes
+                    else:
+                        self.quant_book_bytes += nbytes
+            elif breaker is not None:
+                breaker.release(nbytes)  # refused by budget: not retained
+        return quant                     # the built tensors still serve
+
     def drop_segment(self, seg) -> int:
-        """Invalidate every IVF index of a dead segment (merge/close) —
-        the removal listener releases the breaker charge."""
+        """Invalidate every IVF index + quantized code set of a dead
+        segment (merge/close) — the removal listeners release the
+        breaker charges."""
         token = getattr(seg, "_fd_token", None)
         if token is None:
             return 0
-        return self.cache.invalidate_where(lambda k, _e: k[0] == token)
+        n = self.cache.invalidate_where(lambda k, _e: k[0] == token)
+        n += self.quant.invalidate_where(lambda k, _e: k[0] == token)
+        return n
 
     def clear(self, indices: list[str] | None = None) -> int:
         if indices is None:
-            return self.cache.clear()
+            return self.cache.clear() + self.quant.clear()
         want = set(indices)
-        return self.cache.invalidate_where(
+        n = self.cache.invalidate_where(
             lambda _k, e: e.index_name in want)
+        n += self.quant.invalidate_where(
+            lambda _k, e: e.index_name in want)
+        return n
 
     def stats(self) -> dict:
         out = self.cache.stats()
         out["declined"] = self.declined
+        return out
+
+    def quant_stats(self) -> dict:
+        out = self.quant.stats()
+        out["declined"] = self.quant_declined
+        with self._qlock:
+            out["code_bytes"] = max(self.quant_code_bytes, 0)
+            out["codebook_bytes"] = max(self.quant_book_bytes, 0)
         return out
 
 
@@ -823,7 +941,7 @@ class IndicesCacheService:
             out["segment_stack"] = self.segment_stacks.clear(indices)
             out["mesh_stack"] = self.mesh_stacks.clear(indices)
             out["mesh_vector_stack"] = self.mesh_vector_stacks.clear(indices)
-            out["ann_index"] = self.ann_indexes.clear(indices)
+            out["ann_index"] = self.ann_indexes.clear(indices)  # + quant
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
         return out
@@ -835,7 +953,8 @@ class IndicesCacheService:
                "segment_stack": self.segment_stacks.stats(),
                "mesh_stack": self.mesh_stacks.stats(),
                "mesh_vector_stack": self.mesh_vector_stacks.stats(),
-               "ann_index": self.ann_indexes.stats()}
+               "ann_index": self.ann_indexes.stats(),
+               "ann_quant": self.ann_indexes.quant_stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
         return out
@@ -848,3 +967,4 @@ class IndicesCacheService:
         self.mesh_stacks.cache.clear()
         self.mesh_vector_stacks.cache.clear()
         self.ann_indexes.cache.clear()
+        self.ann_indexes.quant.clear()

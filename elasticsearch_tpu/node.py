@@ -984,6 +984,7 @@ class NodeService:
         rank_window = 0
         knn_nprobe = None
         knn_exact = False
+        knn_quant = None
         if knn is not None:
             if agg_specs:
                 # the knn phase computes no agg partials; silently returning
@@ -993,6 +994,15 @@ class NodeService:
             raw_np = knn.get("nprobe")
             knn_nprobe = int(raw_np) if raw_np is not None else None
             knn_exact = bool(knn.get("exact", False))
+            # per-request quantization override (ISSUE 12): pin the int8/
+            # pq scan or force the f32 IVF lane regardless of the index
+            # default — the bench measures all three on ONE index this way
+            knn_quant = knn.get("quantization")
+            if knn_quant is not None and str(knn_quant).strip().lower() \
+                    not in ("none", "int8", "pq"):
+                raise QueryParsingException(
+                    f"knn quantization must be one of [none, int8, pq], "
+                    f"got [{knn_quant}]")
             qv_single = knn.get("query_vector")
             if qv_single is None:
                 qvs = knn.get("query_vectors")
@@ -1075,7 +1085,8 @@ class NodeService:
                                                          "cosine"),
                                           filter_node=fnode,
                                           nprobe=knn_nprobe,
-                                          exact=knn_exact)
+                                          exact=knn_exact,
+                                          quantization=knn_quant)
                         if rank_spec is not None:
                             # hybrid fusion: the text retriever runs in
                             # the SAME shard pass; fuse_hybrid merges the
@@ -1132,7 +1143,7 @@ class NodeService:
                 mesh_reduced = self._try_mesh_knn(
                     names[0], searchers, knn, k=knn_k, qv=[qv_single],
                     nprobe=knn_nprobe, exact=knn_exact,
-                    size=size, from_=from_)
+                    quantization=knn_quant, size=size, from_=from_)
             if mesh_reduced is not None:
                 results = []
             elif len(searchers) == 1:
@@ -1801,7 +1812,8 @@ class NodeService:
     # -- mesh kNN lane (parallel/mesh_knn, ISSUE 11) -----------------------
 
     def _try_mesh_knn(self, name: str, searchers, knn: dict, *, k: int,
-                      qv, nprobe, exact: bool, size: int, from_: int):
+                      qv, nprobe, exact: bool, size: int, from_: int,
+                      quantization: str | None = None):
         """One mesh attempt for a multi-shard kNN body: all co-hosted
         shards' vector columns execute as ONE shard_map program — exact
         matmul or the IVF centroid-route + cluster scan under the sharded
@@ -1841,9 +1853,13 @@ class NodeService:
                     metric=knn.get("metric", "cosine"),
                     knn_opts=searchers[0].knn_opts,
                     nprobe=nprobe, exact=exact,
+                    quantization=quantization,
                     acquire_ivf=lambda si, seg, vc:
                         searchers[si]._acquire_ivf(
                             seg, vc, knn["field"], nprobe, exact),
+                    acquire_quant=lambda si, seg, vc, ivf, mode:
+                        searchers[si]._acquire_quant(
+                            seg, vc, knn["field"], ivf, mode),
                     filter_node=fnode, filter_stack=stack)
             if out is None:
                 svc.search_stats["mesh_ann_fallbacks"] = \
@@ -1852,7 +1868,7 @@ class NodeService:
         except Exception:  # noqa: BLE001 — the fan-out is always correct
             self._mesh_error(svc)
             return None
-        keys, shard_of, scores, totals, mxs, used_ivf = out
+        keys, shard_of, scores, totals, mxs, used_ivf, used_quant = out
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
@@ -1861,6 +1877,11 @@ class NodeService:
         if used_ivf:
             svc.search_stats["ann_dispatches"] = \
                 svc.search_stats.get("ann_dispatches", 0) + 1
+        if used_quant:
+            svc.search_stats["ann_quantized_dispatches"] = \
+                svc.search_stats.get("ann_quantized_dispatches", 0) + 1
+            svc.search_stats[f"ann_quantized_{used_quant}"] = \
+                svc.search_stats.get(f"ann_quantized_{used_quant}", 0) + 1
         from .common.metrics import current_profiler, record_shard_fetches
         record_shard_fetches(1)
         prof = current_profiler()
@@ -2032,7 +2053,8 @@ class NodeService:
                         int(knn.get("k", 10)),
                         knn.get("metric", "cosine"), len(qv),
                         int(raw_np) if raw_np is not None else None,
-                        bool(knn.get("exact", False)))
+                        bool(knn.get("exact", False)),
+                        str(knn.get("quantization") or ""))
             agg_key = None
             if aggs is not None:
                 from .search.aggs.aggregators import has_top_hits, parse_aggs
@@ -2092,7 +2114,8 @@ class NodeService:
                               metric=knn.get("metric", "cosine"),
                               nprobe=int(raw_np) if raw_np is not None
                               else None,
-                              exact=bool(knn.get("exact", False)))
+                              exact=bool(knn.get("exact", False)),
+                              quantization=knn.get("quantization"))
                 for s in searchers]
             size = min(size, max(knn_k - from_, 0))
             return self._batched_reduce(metas, searchers, index_of, results,
@@ -2842,6 +2865,11 @@ class NodeService:
             # builds that fell back to the exact matmul
             "ann_dispatches_total": path_totals.get("ann_dispatches", 0),
             "ann_fallbacks_total": path_totals.get("ann_fallbacks", 0),
+            # quantized ANN tier (ISSUE 12): scans served on int8/PQ codes
+            # (the per-mode split rides the labeled search_ann_quantized
+            # section below) vs declines back to the f32 IVF scan
+            "ann_quantized_fallbacks_total":
+                path_totals.get("ann_quantized_fallbacks", 0),
             "sparse_queries_total": path_totals.get("sparse", 0),
             "dense_queries_total": path_totals.get("dense", 0),
             "packed_queries_total": path_totals.get("packed", 0),
@@ -2863,6 +2891,13 @@ class NodeService:
             # fetches-per-shard-query histogram: bucket n = a shard query
             # phase that needed n device round-trips (stacked lane: 1)
             "search": (None, search_exec),
+            # quantized-scan adoption split by mode (ISSUE 12):
+            # es_search_ann_quantized_dispatches_total{mode="int8"|"pq"}
+            "search_ann_quantized": ("mode", {
+                "int8": {"dispatches_total":
+                         path_totals.get("ann_quantized_int8", 0)},
+                "pq": {"dispatches_total":
+                       path_totals.get("ann_quantized_pq", 0)}}),
             "search_fetches": ("fetches_per_query",
                                {str(n): {"count": c}
                                 for n, c in sorted(
@@ -2959,6 +2994,15 @@ class NodeService:
             # lane carried
             "ann_index_cache_memory_bytes":
                 self.caches.ann_indexes.cache.memory_bytes,
+            # quantized tier residency split (ISSUE 12): codes at their
+            # true 1/4-1/32 bytes, codebooks separately — the incident
+            # view of what the quantized stack actually costs
+            "ann_quant_cache_memory_bytes":
+                self.caches.ann_indexes.quant.memory_bytes,
+            "ann_quant_code_bytes":
+                max(self.caches.ann_indexes.quant_code_bytes, 0),
+            "ann_quant_codebook_bytes":
+                max(self.caches.ann_indexes.quant_book_bytes, 0),
         }
         mesh_totals = {"mesh_agg_dispatches": 0, "mesh_ann_dispatches": 0}
         for svc in self.indices.values():

@@ -50,7 +50,13 @@ from .topk import merge_running_topk
 _GATHER_BUDGET_ELEMS = 1 << 24
 _ASSIGN_BLOCK = 1 << 16          # full-corpus assignment scan block (docs)
 DEFAULT_ITERS = 4
+# training-sample ceiling for BOTH the Lloyd loop and the PQ codebooks:
+# k-means cost is O(sample × nlist × D) per iteration, so an uncapped 10M-
+# vector corpus would spend the whole bench budget training (the r05
+# rc=124 lesson) — 64k vectors is plenty for 256-4096 clusters
 TRAIN_SAMPLE_CAP = 1 << 16
+PQ_CODES = 256                   # codes per subquantizer (one u8 per code)
+DEFAULT_PQ_M = 16                # subquantizers (index.knn.pq.m)
 
 
 def next_pow2(n: int, floor: int = 8) -> int:
@@ -80,13 +86,11 @@ def _cast(x, precision: str):
 # training: device Lloyd iterations over a sample
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("nlist", "iters"))
-def train_centroids(sample: jax.Array, init: jax.Array, *,
-                    nlist: int, iters: int) -> jax.Array:
-    """Lloyd k-means on device: sample f32[S, D], init f32[nlist, D].
-    Each iteration is one [S, nlist] assignment matmul (l2, via the
-    ||x||²-free argmin identity) + one segment_sum update; empty clusters
-    keep their previous centroid. Returns centroids f32[nlist, D]."""
+def _lloyd(sample: jax.Array, init: jax.Array, *,
+           nlist: int, iters: int) -> jax.Array:
+    """The Lloyd-iteration core (traced, not jitted): shared between
+    `train_centroids` and the vmapped-over-subspaces PQ codebook
+    trainer."""
 
     def step(cents, _):
         cn2 = jnp.sum(cents * cents, axis=1)                 # [nlist]
@@ -104,6 +108,16 @@ def train_centroids(sample: jax.Array, init: jax.Array, *,
 
     cents, _ = lax.scan(step, init.astype(jnp.float32), None, length=iters)
     return cents
+
+
+@functools.partial(jax.jit, static_argnames=("nlist", "iters"))
+def train_centroids(sample: jax.Array, init: jax.Array, *,
+                    nlist: int, iters: int) -> jax.Array:
+    """Lloyd k-means on device: sample f32[S, D], init f32[nlist, D].
+    Each iteration is one [S, nlist] assignment matmul (l2, via the
+    ||x||²-free argmin identity) + one segment_sum update; empty clusters
+    keep their previous centroid. Returns centroids f32[nlist, D]."""
+    return _lloyd(sample, init, nlist=nlist, iters=iters)
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -127,6 +141,104 @@ def assign_clusters(vecs: jax.Array, cents: jax.Array, *,
 
 def assign_block_size(n_pad: int) -> int:
     return min(next_pow2(n_pad, floor=8), _ASSIGN_BLOCK)
+
+
+# ---------------------------------------------------------------------------
+# quantized storage tier (ISSUE 12): int8 scalar + IVF-PQ residual codes
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def train_int8_scales(vecs: jax.Array) -> jax.Array:
+    """Per-dimension symmetric affine scales: s_d = max|x_d| / 127 over
+    the whole column (padding rows are zero — they never win the max).
+    One reduction over an already-resident tensor, no extra residency."""
+    return jnp.maximum(jnp.max(jnp.abs(vecs), axis=0), 1e-12) / 127.0
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def quantize_int8(vecs: jax.Array, scales: jax.Array, *,
+                  block: int) -> jax.Array:
+    """f32[N_pad, D] -> i8[N_pad, D], scanned in `block`-doc chunks so the
+    f32 intermediate never exceeds O(block × D)."""
+    n_pad, d = vecs.shape
+
+    def body(_, vb):
+        q = jnp.clip(jnp.round(vb / scales[None, :]), -127.0, 127.0)
+        return _, q.astype(jnp.int8)
+
+    _, out = lax.scan(body, None, vecs.reshape(n_pad // block, block, d))
+    return out.reshape(n_pad, d)
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def train_pq_codebooks(samples: jax.Array, inits: jax.Array, *,
+                       iters: int) -> jax.Array:
+    """PQ codebooks: the Lloyd core vmapped over the m subspaces.
+    samples f32[m, S, dsub] are residuals against each sample's ROUTED
+    centroid (the FAISS IVFPQ shape: codebooks are shared across
+    clusters, trained on residuals); inits f32[m, 256, dsub].
+    Returns f32[m, 256, dsub]."""
+    return jax.vmap(
+        lambda s, i: _lloyd(s, i, nlist=PQ_CODES, iters=iters))(samples,
+                                                                inits)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def encode_pq(vecs: jax.Array, assign: jax.Array, centroids: jax.Array,
+              codebooks: jax.Array, *, block: int) -> jax.Array:
+    """Encode the whole column: residual against the assigned centroid,
+    per-subspace argmin against the codebook (the ||c||²-free identity),
+    scanned in blocks. vecs f32[N_pad, D], assign i32[N_pad] (clamped to
+    a real cluster), codebooks f32[m, 256, dsub] -> u8[N_pad, m]."""
+    n_pad, d = vecs.shape
+    m = codebooks.shape[0]
+    dsub = d // m
+    cn2 = jnp.sum(codebooks * codebooks, axis=2)             # [m, 256]
+
+    def body(_, x):
+        vb, ab = x
+        r = vb - centroids[ab]                               # [B, D]
+        rsub = r.reshape(vb.shape[0], m, dsub)
+        sc = 2.0 * jnp.einsum("bmd,mjd->bmj", rsub, codebooks,
+                              preferred_element_type=jnp.float32) \
+            - cn2[None, :, :]
+        return _, jnp.argmax(sc, axis=2).astype(jnp.uint8)
+
+    _, out = lax.scan(body, None,
+                      (vecs.reshape(n_pad // block, block, d),
+                       assign.reshape(n_pad // block, block)))
+    return out.reshape(n_pad, m)
+
+
+def quant_scan_block_size(Q: int, dims: int, mode: str, m: int,
+                          W: int) -> int:
+    """Scan block for the quantized lanes: the PQ scan gathers m code
+    bytes per candidate instead of D vector elements, so its block can
+    be D/m larger under the same gather budget (fewer scan steps). The
+    int8 scan keeps the f32 sizing — its gathered element count matches
+    the f32 lane's."""
+    if mode == "pq":
+        return scan_block_size(Q, max(m, 1), W)
+    return scan_block_size(Q, dims, W)
+
+
+def rescore_width(k: int, setting: int, W: int) -> int:
+    """Full-precision rescore window (static program shape): the index's
+    `index.knn.rescore_window` when set, else 4×k (the quantize-the-scan-
+    never-the-final-ranking default), clamped into [k, W]. rw == k means
+    the rescore reorders but cannot change the retrieved SET — the
+    measurable no-rescore baseline."""
+    rw = int(setting) if int(setting) > 0 else 4 * k
+    return max(min(max(rw, k), W), 1)
+
+
+def quant_nbytes(n_pad: int, dims: int, mode: str,
+                 m: int) -> tuple[int, int]:
+    """(codes_bytes, codebook_bytes) the quantized tier is accounted at —
+    the true 1/4 (int8) or ~1/(4·D/m) (PQ) of the f32 column."""
+    if mode == "int8":
+        return n_pad * dims, dims * 4
+    return n_pad * m, PQ_CODES * dims * 4
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +335,216 @@ def ivf_search(vecs: jax.Array, centroids: jax.Array, starts: jax.Array,
     (top_s, top_i), _ = lax.scan(body, carry, (docs_s, valid_s))
     top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
     return top_s, top_i
+
+
+# ---------------------------------------------------------------------------
+# quantized query kernels: int8 GEMM / PQ ADC scan + full-precision rescore
+# ---------------------------------------------------------------------------
+
+def quantize_query_int8(qv: jax.Array, scales: jax.Array):
+    """Fold the storage tier's per-dimension scales into the query, then
+    quantize with ONE per-query scalar:
+
+        dot(q, x) ≈ Σ_d q_d · (s_d · c_d) = Σ_d (q_d s_d) c_d
+                  ≈ sq · Σ_d q8_d c_d          (pure int8×int8, i32 accum)
+
+    The per-dim scales live entirely on the query side, so the doc-side
+    GEMM stays a plain integer contraction. Returns (q8 i8[Q, D],
+    sq f32[Q, 1])."""
+    qf = qv * scales[None, :]
+    sq = jnp.maximum(jnp.max(jnp.abs(qf), axis=1, keepdims=True),
+                     1e-12) / 127.0
+    q8 = jnp.clip(jnp.round(qf / sq), -127.0, 127.0).astype(jnp.int8)
+    return q8, sq
+
+
+def rescore_topk(vecs, norms, qv, top_s, top_i, *, k: int, metric: str,
+                 precision: str):
+    """Full-precision rescore of the scan's survivors (traced, not
+    jitted — the tail of the quantized kernels and the mesh program):
+    gather the top-rw candidates' f32 vectors, score them EXACTLY like
+    the f32 IVF scan body (`index.knn.precision` matmuls, exact stored
+    norms), and keep the top k. The quantized approximation ranks the
+    scan; it never ranks the response."""
+    safe = jnp.maximum(top_i, 0)
+    cand = _cast(vecs[safe], precision)                      # [Q, rw, D]
+    qc = _cast(qv, precision)
+    sims = jnp.einsum("qd,qrd->qr", qc, cand,
+                      preferred_element_type=jnp.float32)
+    if metric == "cosine":
+        qn = jnp.linalg.norm(qv, axis=1, keepdims=True)
+        sims = sims / jnp.maximum(qn * norms[safe], 1e-12)
+    elif metric == "l2":
+        qn2 = jnp.sum(qv * qv, axis=1, keepdims=True)
+        sims = -(qn2 + jnp.square(norms[safe]) - 2.0 * sims)
+    sims = jnp.where(top_i >= 0, sims, -jnp.inf)
+    top, pos = lax.top_k(sims, min(k, sims.shape[1]))
+    idx = jnp.take_along_axis(top_i, pos, axis=1)
+    return top, jnp.where(jnp.isfinite(top), idx, -1)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "precision", "nprobe", "W", "block", "rw",
+    "per_query_live"))
+def ivf_search_int8(vecs: jax.Array, codes: jax.Array, scales: jax.Array,
+                    centroids: jax.Array, starts: jax.Array,
+                    sizes: jax.Array, slot_docs: jax.Array,
+                    norms: jax.Array, live, qv: jax.Array, *, k: int,
+                    metric: str, precision: str, nprobe: int, W: int,
+                    block: int, rw: int, per_query_live: bool):
+    """ivf_search with the cluster scan on int8: stage 1 routes at full
+    precision (centroids are tiny), stage 2 gathers i8 codes — 1/4 the
+    HBM traffic of the f32 scan — and scores them with an int8×int8 GEMM
+    accumulating in i32 (exact integer arithmetic; the only rounding is
+    the quantization itself), then the top `rw` survivors rescore at
+    full precision (rescore_topk). codes i8[N_pad, D], scales f32[D]."""
+    n_pad = vecs.shape[0]
+    Q = qv.shape[0]
+    qc = _cast(qv, precision)
+    cc = _cast(centroids, precision)
+    route = lax.dot_general(qc, cc, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if metric == "cosine":
+        cn = jnp.linalg.norm(centroids, axis=1)
+        qn = jnp.linalg.norm(qv, axis=1, keepdims=True)
+        route = route / jnp.maximum(qn * cn[None, :], 1e-12)
+    elif metric == "l2":
+        cn2 = jnp.sum(centroids * centroids, axis=1)
+        route = 2.0 * route - cn2[None, :]
+    _, probe = lax.top_k(route, nprobe)                      # [Q, nprobe]
+
+    t_starts = starts[probe]
+    t_lens = sizes[probe]
+    idx, _t, valid = bm25_ops.postings_slots(t_starts, t_lens, W)
+    idx = jnp.clip(idx, 0, n_pad - 1)
+    docs = slot_docs[idx]
+    docs = jnp.where(valid, docs, n_pad - 1)
+
+    q8, sq = quantize_query_int8(qv, scales)
+    qn_cos = jnp.linalg.norm(qv, axis=1, keepdims=True)
+    qn2 = jnp.sum(qv * qv, axis=1, keepdims=True)
+
+    nb = W // block
+    docs_s = docs.reshape(Q, nb, block).transpose(1, 0, 2)
+    valid_s = valid.reshape(Q, nb, block).transpose(1, 0, 2)
+
+    def body(carry, x):
+        top_s, top_i = carry
+        d_blk, v_blk = x                                     # [Q, B]
+        cand = codes[d_blk]                                  # [Q, B, D] i8
+        idot = jnp.einsum("qd,qbd->qb", q8, cand,
+                          preferred_element_type=jnp.int32)
+        sims = sq * idot.astype(jnp.float32)
+        if metric == "cosine":
+            sims = sims / jnp.maximum(qn_cos * norms[d_blk], 1e-12)
+        elif metric == "l2":
+            sims = -(qn2 + jnp.square(norms[d_blk]) - 2.0 * sims)
+        if per_query_live:
+            ok = v_blk & jnp.take_along_axis(live, d_blk, axis=1)
+        else:
+            ok = v_blk & live[d_blk]
+        sims = jnp.where(ok, sims, -jnp.inf)
+        top_s, top_i = merge_running_topk(top_s, top_i, sims, d_blk, k=rw)
+        return (top_s, top_i), None
+
+    carry = (jnp.full((Q, rw), -jnp.inf, jnp.float32),
+             jnp.full((Q, rw), -1, jnp.int32))
+    (top_s, top_i), _ = lax.scan(body, carry, (docs_s, valid_s))
+    top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+    return rescore_topk(vecs, norms, qv, top_s, top_i, k=k,
+                        metric=metric, precision=precision)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "metric", "precision", "nprobe", "W", "block", "rw",
+    "per_query_live"))
+def ivf_search_pq(vecs: jax.Array, codes: jax.Array, codebooks: jax.Array,
+                  centroids: jax.Array, starts: jax.Array,
+                  sizes: jax.Array, slot_docs: jax.Array,
+                  norms: jax.Array, live, qv: jax.Array, *, k: int,
+                  metric: str, precision: str, nprobe: int, W: int,
+                  block: int, rw: int, per_query_live: bool):
+    """IVF-PQ asymmetric-distance scan, one program:
+
+        dot(q, x) = dot(q, c_routed) + dot(q, residual)
+                  ≈ route_dot[q, cluster] + Σ_m LUT[q, m, code_m(x)]
+
+    The LUT ([Q, m, 256] = one einsum of the query's subvectors against
+    the shared codebooks) is cluster-INDEPENDENT because codebooks train
+    on residuals with the centroid dot folded out — so the per-candidate
+    work is m u8 gathers + adds instead of D MACs. cosine/l2 derive from
+    the same dot approximation plus the EXACT stored norms (the seam all
+    three lanes share). codes u8[N_pad, m], codebooks f32[m, 256, dsub].
+    Top `rw` survivors rescore at full precision."""
+    n_pad = vecs.shape[0]
+    Q = qv.shape[0]
+    d = qv.shape[1]
+    m = codebooks.shape[0]
+    dsub = d // m
+    qc = _cast(qv, precision)
+    cc = _cast(centroids, precision)
+    r_dot = lax.dot_general(qc, cc, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if metric == "cosine":
+        cn = jnp.linalg.norm(centroids, axis=1)
+        qn = jnp.linalg.norm(qv, axis=1, keepdims=True)
+        route = r_dot / jnp.maximum(qn * cn[None, :], 1e-12)
+    elif metric == "l2":
+        cn2 = jnp.sum(centroids * centroids, axis=1)
+        route = 2.0 * r_dot - cn2[None, :]
+    else:
+        route = r_dot
+    _, probe = lax.top_k(route, nprobe)                      # [Q, nprobe]
+
+    t_starts = starts[probe]
+    t_lens = sizes[probe]
+    idx, t_slot, valid = bm25_ops.postings_slots(t_starts, t_lens, W)
+    idx = jnp.clip(idx, 0, n_pad - 1)
+    docs = slot_docs[idx]
+    docs = jnp.where(valid, docs, n_pad - 1)
+    # which probed cluster each slot belongs to -> that cluster's RAW
+    # centroid dot (the ADC base term; invalid slots are masked below)
+    cl = jnp.take_along_axis(probe, jnp.clip(t_slot, 0, nprobe - 1),
+                             axis=1)                         # [Q, W]
+    c_dot = jnp.take_along_axis(r_dot, cl, axis=1)           # [Q, W]
+
+    qsub = _cast(qv.reshape(Q, m, dsub), precision)
+    lut = jnp.einsum("qmd,mjd->qmj", qsub, _cast(codebooks, precision),
+                     preferred_element_type=jnp.float32)     # [Q, m, 256]
+
+    qn_cos = jnp.linalg.norm(qv, axis=1, keepdims=True)
+    qn2 = jnp.sum(qv * qv, axis=1, keepdims=True)
+
+    nb = W // block
+    docs_s = docs.reshape(Q, nb, block).transpose(1, 0, 2)
+    valid_s = valid.reshape(Q, nb, block).transpose(1, 0, 2)
+    cdot_s = c_dot.reshape(Q, nb, block).transpose(1, 0, 2)
+
+    def body(carry, x):
+        top_s, top_i = carry
+        d_blk, v_blk, cd_blk = x                             # [Q, B]
+        cb = codes[d_blk]                                    # [Q, B, m] u8
+        cmb = jnp.moveaxis(cb, 2, 1).astype(jnp.int32)       # [Q, m, B]
+        vals = jnp.take_along_axis(lut, cmb, axis=2)         # [Q, m, B]
+        sims = cd_blk + jnp.sum(vals, axis=1)
+        if metric == "cosine":
+            sims = sims / jnp.maximum(qn_cos * norms[d_blk], 1e-12)
+        elif metric == "l2":
+            sims = -(qn2 + jnp.square(norms[d_blk]) - 2.0 * sims)
+        if per_query_live:
+            ok = v_blk & jnp.take_along_axis(live, d_blk, axis=1)
+        else:
+            ok = v_blk & live[d_blk]
+        sims = jnp.where(ok, sims, -jnp.inf)
+        top_s, top_i = merge_running_topk(top_s, top_i, sims, d_blk, k=rw)
+        return (top_s, top_i), None
+
+    carry = (jnp.full((Q, rw), -jnp.inf, jnp.float32),
+             jnp.full((Q, rw), -1, jnp.int32))
+    (top_s, top_i), _ = lax.scan(body, carry, (docs_s, valid_s, cdot_s))
+    top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+    return rescore_topk(vecs, norms, qv, top_s, top_i, k=k,
+                        metric=metric, precision=precision)
 
 
 # ---------------------------------------------------------------------------

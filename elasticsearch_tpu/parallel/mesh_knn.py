@@ -15,6 +15,11 @@ shard_map program with the cross-shard top-k reduce on device:
             budget W_own masks the tail, so the candidate set equals the
             per-segment kernel's exactly — postings_slots is prefix-
             stable in W)
+    int8  : the ivf scan on quantized codes (ops/ann.ivf_search_int8's
+            int8×int8 GEMM + full-precision rescore, ISSUE 12) when the
+            index or request selects `quantization: int8` and every
+            segment's QuantData is available; pq declines to the
+            per-shard fan-out
 
 Bitwise parity with the per-shard fan-out holds because per-doc
 similarities are contractions over D only (padding the doc axis never
@@ -59,6 +64,21 @@ class _IvfPack:
     norms: jax.Array                 # f32[S, G, N]
     sizes_desc_cum: list             # per (s, g): np i64[nlist] | None
     n_docs: np.ndarray               # i64[S, G]
+    nbytes: int = 0
+
+
+@dataclass
+class _QuantPack:
+    """int8 quantized codes stacked over (shard, segment) — the mesh
+    rider of the per-shard `ann_quant` tier (ISSUE 12). The scan gathers
+    these 1/4-size codes instead of the f32 stack; the rescore tail still
+    gathers f32 rows from the SAME packed vecs tensor. PQ declines to the
+    per-shard fan-out (same results, one more ladder rung): its codebook
+    operands + per-cluster ADC base terms are a larger collective surface
+    than the int8 pack and the fan-out already serves it."""
+    mode: str                        # "int8"
+    codes: jax.Array                 # i8[S, G, N, D]
+    scales: jax.Array                # f32[S, G, D]
     nbytes: int = 0
 
 
@@ -220,6 +240,39 @@ def _build_ivf_pack(vstack: MeshVectorStack, acquire_ivf) -> _IvfPack | str:
         + slot_docs.nbytes + norms.nbytes)
 
 
+def _build_quant_pack(vstack: MeshVectorStack, base: _IvfPack,
+                      acquire_ivf, acquire_quant,
+                      mode: str) -> "_QuantPack | str":
+    """Stack per-(shard, segment) int8 codes — the SAME cached QuantData
+    the per-shard lane uses (acquire_quant callback), so codes and scales
+    are bit-identical. Returns a _QuantPack, or a reason string when any
+    segment declines quantization (-> the whole mesh lane declines and
+    the per-shard fan-out honors the request's mode)."""
+    s_pad, g_pad, n_pad = vstack.s_pad, vstack.g_pad, vstack.n_pad
+    codes = np.zeros((s_pad, g_pad, n_pad, vstack.dims), np.int8)
+    scales = np.ones((s_pad, g_pad, vstack.dims), np.float32)
+    for si, rows in enumerate(vstack.shard_rows):
+        for gi, (_i, seg) in enumerate(rows):
+            vc = seg.vectors.get(vstack.field)
+            if vc is None:
+                continue
+            ivf, _np_eff = acquire_ivf(si, seg, vc)    # cache hit
+            if ivf is None:
+                return "mixed"
+            quant = acquire_quant(si, seg, vc, ivf, mode)
+            if quant is None or quant.mode != mode:
+                return "quant"
+            c = np.asarray(quant.codes)
+            codes[si, gi, : c.shape[0]] = c
+            scales[si, gi] = np.asarray(quant.scales)
+    sharding = index_sharding(vstack.mesh)
+    return _QuantPack(
+        mode=mode,
+        codes=jax.device_put(codes, sharding),
+        scales=jax.device_put(scales, sharding),
+        nbytes=codes.nbytes + scales.nbytes)
+
+
 def _plan_filter(filter_node, filter_stack, q_pad: int):
     """Mesh match plan for the kNN pre-filter over the text mesh stack.
     The match mask is stats-independent (presence booleans), so stats
@@ -243,13 +296,15 @@ def _plan_filter(filter_node, filter_stack, q_pad: int):
 
 def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
             knn_opts: dict, nprobe, exact: bool, acquire_ivf,
+            acquire_quant=None, quantization: str | None = None,
             filter_node=None, filter_stack=None):
     """Run a kNN query batch over the vector mesh stack as one program.
 
     -> (doc_keys i64[Q,k'], shard i32[Q,k'], scores f32[Q,k'],
-    totals i64[S,Q], max f32[S,Q], used_ivf) in ONE device fetch, or None
-    when the shape has no single-program form (caller fans out). May
-    raise on execution failure — callers degrade the same way."""
+    totals i64[S,Q], max f32[S,Q], used_ivf, used_quant) in ONE device
+    fetch, or None when the shape has no single-program form (caller
+    fans out). May raise on execution failure — callers degrade the
+    same way."""
     qv_np = np.asarray(query_vectors, np.float32)
     if qv_np.ndim == 1:
         qv_np = qv_np[None, :]
@@ -260,15 +315,28 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
         qv_np = np.concatenate(
             [qv_np, np.zeros((q_pad - Q, qv_np.shape[1]), np.float32)])
     precision = knn_opts["precision"]
+    qmode = (quantization if quantization is not None
+             else knn_opts.get("quantization", "none"))
+    qmode = str(qmode).strip().lower()
+    if qmode not in ("int8", "pq"):
+        qmode = "none"
+    if qmode == "pq":
+        # PQ keeps the per-shard fan-out (see _QuantPack) — declining the
+        # mesh lane honors the request's mode there
+        return None
 
     # the mesh kNN lane serves the IVF path only: the exact per-segment
     # kernel runs EAGERLY on the per-shard path, and a fused collective
     # program cannot reproduce its GEMM rounding bit-for-bit — exact and
     # mixed lanes keep the per-shard fan-out (which can)
-    pack = _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe, exact)
+    pack, qpack = _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe,
+                                     exact, qmode, acquire_quant)
     if not isinstance(pack, _IvfPack):
         return None
+    if qmode != "none" and not isinstance(qpack, _QuantPack):
+        return None                  # a segment declined: fan-out decides
     used_ivf = True
+    used_quant = qpack.mode if isinstance(qpack, _QuantPack) else None
     ivf: _IvfPack = pack
 
     nlist = ivf.nlist
@@ -304,8 +372,13 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
             return None
 
     kk = min(k, W) if used_ivf else min(k, vstack.n_pad)
+    rw = 0
+    if used_quant:
+        rw = ann_ops.rescore_width(
+            kk, int(knn_opts.get("rescore_window") or 0), W)
     key = ("knn", vstack.s_pad, R, q_pad, k, kk, vstack.n_pad, vstack.dims,
            metric, precision, used_ivf, nprobe_eff, W, block,
+           used_quant, rw,
            (fplan[0], tuple(fplan[2].fields.items()),
             tuple(kind for _a, kind in fplan[2].ops))
            if fplan is not None else None)
@@ -315,7 +388,7 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
             vstack, metric=metric, precision=precision, k=k, kk=kk,
             n_queries=q_pad // R, used_ivf=used_ivf, nprobe=nprobe_eff,
             W=W, block=block, nlist=ivf.nlist if used_ivf else 0,
-            fplan=fplan)
+            quant=used_quant, rw=rw, fplan=fplan)
         mesh_exec._PROGRAMS.put(key, prog, weight=1)
 
     args = [vstack.live_stack(), vstack.seg_ids_dev,
@@ -324,6 +397,8 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     if used_ivf:
         args.extend([ivf.centroids, ivf.starts, ivf.sizes, ivf.slot_docs,
                      ivf.norms, jnp.asarray(w_own)])
+    if used_quant:
+        args.extend([qpack.codes, qpack.scales])
     if fplan is not None:
         _fsig, _mfn, fpctx = fplan
         for name, kind in fpctx.fields.items():
@@ -348,25 +423,36 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
             np.asarray(got["scores"])[:Q],
             np.asarray(got["total"])[: vstack.s_count, :Q],
             np.asarray(got["mx"])[: vstack.s_count, :Q],
-            used_ivf)
+            used_ivf, used_quant)
 
 
-def _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe, exact):
-    """The stack's IVF pack for this request shape (memoized on the stack
-    per requested nprobe — the IVF tensors are immutable alongside the
-    segment set), or "exact"/"mixed"/"nlist". Exact-pinned requests skip
-    IVF acquisition entirely."""
+def _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe, exact,
+                       qmode: str = "none", acquire_quant=None):
+    """(ivf_pack, quant_pack) for this request shape, each memoized on
+    the stack (the tensors are immutable alongside the segment set);
+    either slot may instead hold a reason string ("exact"/"mixed"/
+    "nlist"/"quant"). Exact-pinned requests skip IVF acquisition
+    entirely."""
     if exact or not knn_opts.get("ivf_enable", True):
-        return "exact"
+        return "exact", None
     ck = ("req", nprobe)
     cached = vstack.ivf_packs.get(ck)
     if cached is None:
         cached = vstack.ivf_packs[ck] = _build_ivf_pack(vstack, acquire_ivf)
-    return cached
+    if qmode == "none" or not isinstance(cached, _IvfPack) \
+            or acquire_quant is None:
+        return cached, None
+    qk = ("quant", nprobe, qmode)
+    qp = vstack.ivf_packs.get(qk)
+    if qp is None:
+        qp = vstack.ivf_packs[qk] = _build_quant_pack(
+            vstack, cached, acquire_ivf, acquire_quant, qmode)
+    return cached, qp
 
 
 def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
-                       used_ivf, nprobe, W, block, nlist, fplan):
+                       used_ivf, nprobe, W, block, nlist, fplan,
+                       quant=None, rw=0):
     mesh = vstack.mesh
     n_pad = vstack.n_pad
     g_pad = vstack.g_pad
@@ -398,6 +484,9 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
             cents, starts, sizes, slot_docs, norms, w_own = \
                 (r[0] for r in rest[:6])
             rest = rest[6:]
+        if quant:
+            q_codes, q_scales = (r[0] for r in rest[:2])
+            rest = rest[2:]
         qv = rest[-1]                        # [Qb, D]
         Qb = qv.shape[0]
 
@@ -467,7 +556,10 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
             qn2 = jnp.sum(qv * qv, axis=1, keepdims=True)
             nb = W // block
 
-            def one(v_g, c_g, st_g, sz_g, sd_g, nm_g, w_g, live_g):
+            scan_k = rw if quant else kk
+
+            def one(v_g, c_g, st_g, sz_g, sd_g, nm_g, w_g, live_g,
+                    *qops):
                 cc = c_g.astype(dt)
                 route = lax.dot_general(
                     qc, cc, (((1,), (1,)), ((), ())),
@@ -492,14 +584,26 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                 docs = jnp.where(valid, docs, n_pad - 1)
                 docs_s = docs.reshape(-1, nb, block).transpose(1, 0, 2)
                 valid_s = valid.reshape(-1, nb, block).transpose(1, 0, 2)
+                if quant:
+                    # int8 scan + full-precision rescore: exactly
+                    # ops/ann.ivf_search_int8's stages per segment
+                    codes_g, scales_g = qops
+                    q8, sq = ann_ops.quantize_query_int8(qv, scales_g)
 
                 def body(carry, x):
                     top_s, top_i = carry
                     d_blk, v_blk = x
-                    cand = v_g[d_blk].astype(dt)             # [Qb, B, D]
-                    sims_b = jnp.einsum(
-                        "qd,qbd->qb", qc, cand,
-                        preferred_element_type=jnp.float32)
+                    if quant:
+                        cand8 = codes_g[d_blk]               # [Qb, B, D] i8
+                        idot = jnp.einsum(
+                            "qd,qbd->qb", q8, cand8,
+                            preferred_element_type=jnp.int32)
+                        sims_b = sq * idot.astype(jnp.float32)
+                    else:
+                        cand = v_g[d_blk].astype(dt)         # [Qb, B, D]
+                        sims_b = jnp.einsum(
+                            "qd,qbd->qb", qc, cand,
+                            preferred_element_type=jnp.float32)
                     if metric == "cosine":
                         cn_b = nm_g[d_blk]
                         sims_b = sims_b / jnp.maximum(qn_cos * cn_b, 1e-12)
@@ -509,16 +613,26 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                     ok = v_blk & jnp.take_along_axis(live_g, d_blk, axis=1)
                     sims_b = jnp.where(ok, sims_b, -jnp.inf)
                     return merge_running_topk(top_s, top_i, sims_b, d_blk,
-                                              k=kk), None
+                                              k=scan_k), None
 
-                carry = (jnp.full((qv.shape[0], kk), -jnp.inf, jnp.float32),
-                         jnp.full((qv.shape[0], kk), -1, jnp.int32))
+                carry = (jnp.full((qv.shape[0], scan_k), -jnp.inf,
+                                  jnp.float32),
+                         jnp.full((qv.shape[0], scan_k), -1, jnp.int32))
                 (top_s, top_i), _ = lax.scan(body, carry, (docs_s, valid_s))
                 top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+                if quant:
+                    top_s, top_i = ann_ops.rescore_topk(
+                        v_g, nm_g, qv, top_s, top_i, k=kk, metric=metric,
+                        precision=precision)
                 return top_s, top_i
 
-            top, idx = jax.vmap(one)(vecs, cents, starts, sizes, slot_docs,
-                                     norms, w_own, eff_live)
+            if quant:
+                top, idx = jax.vmap(one)(vecs, cents, starts, sizes,
+                                         slot_docs, norms, w_own, eff_live,
+                                         q_codes, q_scales)
+            else:
+                top, idx = jax.vmap(one)(vecs, cents, starts, sizes,
+                                         slot_docs, norms, w_own, eff_live)
 
         # per-shard merge in segment order (the host merge's stable
         # argsort over [prev, seg] keeps earlier on ties — so does this)
@@ -554,6 +668,8 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                 P(SHARD_AXIS)]
     if used_ivf:
         in_specs.extend([P(SHARD_AXIS)] * 6)
+    if quant:
+        in_specs.extend([P(SHARD_AXIS)] * 2)
     in_specs.extend(nf_specs)
     in_specs.extend(f_op_specs)
     in_specs.append(P(REPLICA_AXIS))         # qv

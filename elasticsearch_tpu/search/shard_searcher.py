@@ -125,12 +125,17 @@ class ShardSearcher:
         # cache service is wired, in this bounded local memo — the
         # searcher itself is rebuilt whenever the segment set changes
         defaults = {"ivf_enable": True, "nlist": 0, "nprobe": 0,
-                    "min_docs": 4096, "precision": "bf16"}
+                    "min_docs": 4096, "precision": "bf16",
+                    "quantization": "none", "pq_m": 16,
+                    "rescore_window": 0}
         self.knn_opts = {**defaults, **(knn_opts or {})}
         from ..common.cache import Cache
         self._ivf_local = Cache("ann_local", max_entries=32)
         # which vector lane served the last kNN phase: "ann" | "exact"
         self.last_knn_mode: str | None = None
+        # quantized scan mode of the last kNN phase: "int8" | "pq" | None
+        # (f32 IVF or exact)
+        self.last_quant_mode: str | None = None
 
     def _bump(self, key: str, n: int = 1) -> None:
         self._path_stats[key] = self._path_stats.get(key, 0) + n
@@ -642,25 +647,68 @@ class ShardSearcher:
             return None, 0
         return ivf, min(nprobe, ivf.nlist)
 
+    def _acquire_quant(self, seg, vc, field: str, ivf, mode: str):
+        """QuantData for one segment's IVF layout, or None to stay on the
+        f32 IVF scan. The quantized rungs of the fallback ladder: dims
+        not divisible by pq.m, columns too small to train 256 codes,
+        breaker-declined or failed builds — each counted
+        (`ann_quantized_fallbacks`) and bitwise-harmless (the f32 IVF and
+        exact kernels below are unchanged)."""
+        from ..ops import ann as ann_ops
+        m = int(self.knn_opts.get("pq_m") or ann_ops.DEFAULT_PQ_M)
+        if mode == "pq" and (m < 1 or vc.dims % m
+                             or ivf.n_docs < ann_ops.PQ_CODES):
+            self._bump("ann_quantized_fallbacks")
+            return None
+        try:
+            cache = getattr(seg, "ann_cache", None)
+            if cache is not None:
+                quant = cache.get_or_build_quant(
+                    seg, field, ivf.nlist, mode, m,
+                    lambda: vc.build_quant(ivf, mode, m))
+            else:
+                key = (seg.seg_id, field, ivf.nlist, mode, m)
+                quant = self._ivf_local.get(key)
+                if quant is None:
+                    quant = vc.build_quant(ivf, mode, m)
+                    if quant is not None:
+                        self._ivf_local.put(key, quant,
+                                            weight=quant.nbytes)
+        except Exception:  # noqa: BLE001 — the f32 scan is always correct
+            quant = None
+        if quant is None:
+            self._bump("ann_quantized_fallbacks")
+        return quant
+
     def execute_knn(self, field: str, query_vectors, *, k: int = 10,
                     metric: str = "cosine",
                     filter_node: Node | None = None,
                     nprobe: int | None = None,
-                    exact: bool = False) -> QuerySearchResult:
+                    exact: bool = False,
+                    quantization: str | None = None) -> QuerySearchResult:
         """kNN query phase over this shard's segments. Behaves like a
         query phase whose scores are vector similarities, so the controller
         reduce and fetch phase apply unchanged.
 
         Columns past `index.knn.ivf.min_docs` route through the IVF lane
         (centroid route + gathered blockwise cluster scan, ops/ann.py);
-        everything else — and every rung of the fallback ladder — runs the
-        exact [Q, N] matmul (ops/knn.py). `nprobe` overrides the index
-        default per request; `exact=True` pins the exact kernel."""
+        when `index.knn.quantization` (or the per-request `quantization`
+        override) selects int8/pq, the cluster scan runs on quantized
+        codes with a full-precision rescore of the top
+        `index.knn.rescore_window` survivors. Everything else — and every
+        rung of the fallback ladder — runs the exact [Q, N] matmul
+        (ops/knn.py). `nprobe` overrides the index default per request;
+        `exact=True` pins the exact kernel."""
         from ..common import tracing
         from ..ops import ann as ann_ops
         from ..ops import knn as knn_ops
 
         precision = self.knn_opts["precision"]
+        qmode = (quantization if quantization is not None
+                 else self.knn_opts.get("quantization", "none"))
+        qmode = str(qmode).strip().lower()
+        if qmode not in ("int8", "pq"):
+            qmode = "none"
         qv = jnp.asarray(np.asarray(query_vectors, np.float32))
         # query vectors are the host→device upload (process-wide transfer
         # counters + the active profiler, when one is installed)
@@ -673,6 +721,8 @@ class ShardSearcher:
 
         n_fetches = 0
         any_ann = False
+        any_quant = False
+        self.last_quant_mode = None
         for seg_idx, seg in self.live_segments:
             vc = seg.vectors.get(field)
             if vc is None:
@@ -689,7 +739,46 @@ class ShardSearcher:
             kk = min(k, seg.n_pad)
             ivf, nprobe_eff = self._acquire_ivf(seg, vc, field, nprobe,
                                                 exact)
-            if ivf is not None:
+            quant = None
+            if ivf is not None and qmode != "none":
+                quant = self._acquire_quant(seg, vc, field, ivf, qmode)
+            if quant is not None:
+                W = ann_ops.slot_budget(ivf.sizes_desc_cum, nprobe_eff,
+                                        ivf.n_docs, ivf.nlist)
+                block = ann_ops.quant_scan_block_size(Q, vc.dims, qmode,
+                                                      quant.m, W)
+                rw = ann_ops.rescore_width(
+                    min(kk, W), int(self.knn_opts.get("rescore_window")
+                                    or 0), W)
+                with tracing.span("quantized_scan", shard=self.shard_id,
+                                  mode=qmode, nprobe=nprobe_eff,
+                                  nlist=ivf.nlist, window=W, rescore=rw):
+                    if qmode == "int8":
+                        top, idx = ann_ops.ivf_search_int8(
+                            vc.vecs, quant.codes, quant.scales,
+                            ivf.centroids, ivf.starts, ivf.sizes,
+                            ivf.slot_docs, ivf.norms,
+                            live if filtered else live_1d, qv,
+                            k=min(kk, W), metric=metric,
+                            precision=precision, nprobe=nprobe_eff, W=W,
+                            block=block, rw=rw, per_query_live=filtered)
+                    else:
+                        top, idx = ann_ops.ivf_search_pq(
+                            vc.vecs, quant.codes, quant.codebooks,
+                            ivf.centroids, ivf.starts, ivf.sizes,
+                            ivf.slot_docs, ivf.norms,
+                            live if filtered else live_1d, qv,
+                            k=min(kk, W), metric=metric,
+                            precision=precision, nprobe=nprobe_eff, W=W,
+                            block=block, rw=rw, per_query_live=filtered)
+                self._bump("ann_dispatches")
+                self._bump("ann_quantized_dispatches")
+                self._bump(f"ann_quantized_{qmode}")
+                self.last_knn_mode = "ann"
+                self.last_quant_mode = qmode
+                any_ann = True
+                any_quant = True
+            elif ivf is not None:
                 W = ann_ops.slot_budget(ivf.sizes_desc_cum, nprobe_eff,
                                         ivf.n_docs, ivf.nlist)
                 block = ann_ops.scan_block_size(Q, vc.dims, W)
@@ -732,7 +821,8 @@ class ShardSearcher:
         record_shard_fetches(n_fetches)
         prof = current_profiler()
         if prof is not None:
-            prof.note_path("ann" if any_ann else "knn")
+            prof.note_path("ann_quantized" if any_quant
+                           else "ann" if any_ann else "knn")
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=None, total_hits=total, max_score=mx)

@@ -125,6 +125,7 @@ class QosController:
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self.latency = Ewma()
+        self._latency_at = None        # clock time of the last sample
         self._inflight = {c: 0 for c in TRAFFIC_CLASSES}
         self.admitted = {c: 0 for c in TRAFFIC_CLASSES}
         self.shed = {c: 0 for c in TRAFFIC_CLASSES}
@@ -172,6 +173,7 @@ class QosController:
         every search's device-phase wall time)."""
         with self._lock:
             self.latency.observe(ms)
+            self._latency_at = self._clock()
 
     def queue_frac(self) -> float:
         """Search-pool queue occupancy in [0, 1]."""
@@ -194,11 +196,25 @@ class QosController:
         return min(1.0, max(0.0, used / limit))
 
     def latency_frac(self) -> float:
-        """EWMA-p99 device latency relative to the shed ceiling."""
+        """EWMA-p99 device latency relative to the shed ceiling, decayed
+        with idle time. The decay breaks a shed livelock (ISSUE 12
+        satellite, found driving the quantized tier's first query): one
+        compile-heavy request can spike the EWMA past the ceiling, and
+        because SHED requests never execute, no new sample could ever
+        bring it back down — the node 429'd forever. A stale estimate is
+        a weak estimate: with no fresh device latency for a while the
+        signal halves per `node.search.qos.latency_halflife_s` (default
+        30 s, ≤0 restores the undecayed signal), so probe traffic gets
+        admitted to re-measure reality."""
         ceiling = self._threshold("shed_latency_ms", 5000.0)
         if ceiling <= 0:
             return 0.0
-        return min(1.0, self.latency.deadline_ms() / ceiling)
+        frac = min(1.0, self.latency.deadline_ms() / ceiling)
+        half_life = self._threshold("latency_halflife_s", 30.0)
+        if half_life > 0 and self._latency_at is not None:
+            idle = max(0.0, self._clock() - self._latency_at)
+            frac *= 0.5 ** (idle / half_life)
+        return frac
 
     def pressure(self) -> float:
         """The overload score in [0, 1]: the WORST of queue depth,

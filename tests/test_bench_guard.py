@@ -76,7 +76,10 @@ def test_tail_latency_keys_survive_forced_timeout():
     stdout, stderr = proc.communicate(timeout=30)
     assert proc.returncode == 0, stderr[-500:]
     line = _json_line(stdout)
-    for key in ("conc_p99_ms", "shed_429s", "hedged_wins"):
+    for key in ("conc_p99_ms", "shed_429s", "hedged_wins",
+                # quantized ANN tier (ISSUE 12): same seeded-null contract
+                "knn_int8_qps", "knn_pq_qps", "pq_recall_at_10",
+                "vector_stack_bytes_f32", "vector_stack_bytes_quantized"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

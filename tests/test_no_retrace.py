@@ -396,3 +396,46 @@ def test_host_reduce_refresh_cycles_within_bucket_zero_retraces(
             "refresh→query inside the pow2 bucket retraced the host reduce"
     finally:
         c.close()
+
+
+# -- quantized ANN tier (ISSUE 12) ------------------------------------------
+
+def test_quantized_refresh_cycles_zero_retraces(tmp_path_factory):
+    """refresh→query cycles whose segment shapes stay inside one pow2
+    bucket compile ZERO new programs on the quantized kNN lane — the
+    int8/pq plan keys (W, block, rw, nprobe) must bucket exactly like
+    the f32 IVF lane's."""
+    import numpy as np
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = NodeService(str(tmp_path_factory.mktemp("quantnr")))
+    n.create_index("qr", settings={"number_of_shards": 1,
+                                   "index.knn.ivf.nlist": 16,
+                                   "index.knn.ivf.min_docs": 128,
+                                   "index.knn.quantization": "pq",
+                                   "index.knn.pq.m": 8,
+                                   "index.knn.rescore_window": 20},
+                   mappings={"_doc": {"properties": {
+                       "vec": {"type": "dense_vector", "dims": 16}}}})
+    rng = np.random.RandomState(3)
+    vecs = rng.randn(4096, 16).astype("float32")
+
+    def add_segment(base):
+        for i in range(512):
+            n.index_doc("qr", str(base + i),
+                        {"vec": vecs[(base + i) % 4096].tolist()})
+        n.refresh("qr")
+
+    body = {"size": 5, "knn": {"field": "vec",
+                               "query_vector": vecs[0].tolist(), "k": 5}}
+    _q = lambda: n.search("qr", json.loads(json.dumps(body)))  # noqa: E731
+    add_segment(0)
+    _q()                                   # warm: compiles expected
+    _q()
+    assert n.indices["qr"].search_stats.get(
+        "ann_quantized_dispatches", 0) >= 2
+    before = device_events_snapshot()[0]
+    add_segment(10000)                     # same-size segment: same bucket
+    _q()
+    assert device_events_snapshot()[0] == before, \
+        "refresh→query inside the pow2 bucket retraced the quantized lane"
+    n.close()

@@ -214,6 +214,31 @@ class TestAdmissionControl:
         with qos.admit("ping"):
             pass
 
+    def test_stale_latency_signal_decays_and_unsheds(self):
+        """Shed livelock tripwire (ISSUE 12 satellite): one compile-heavy
+        request spikes the EWMA past the ceiling; because shed requests
+        never execute, no new sample can arrive — the stale signal must
+        DECAY with idle time so probe traffic gets admitted again."""
+        t = [0.0]
+        qos = self._controller({"node.search.qos.shed_latency_ms": 1000},
+                               clock=lambda: t[0])
+        qos.record_latency(30_000.0)       # one 30s compile+train query
+        assert qos.latency_frac() == 1.0
+        with pytest.raises(QosShedException):
+            qos.admit("search")
+        t[0] += 120.0                      # two minutes idle: 4 half-lives
+        assert qos.latency_frac() < 0.1
+        with qos.admit("search"):          # admitted: signal re-measures
+            pass
+        # <=0 half-life restores the undecayed (pre-fix) signal
+        qos2 = self._controller(
+            {"node.search.qos.shed_latency_ms": 1000,
+             "node.search.qos.latency_halflife_s": 0},
+            clock=lambda: t[0])
+        qos2.record_latency(30_000.0)
+        t[0] += 600.0
+        assert qos2.latency_frac() == 1.0
+
     def test_degrade_band_shrinks_batch_window_before_shedding(self):
         t = [0.0]
         qos = self._controller({"node.search.qos.shed_latency_ms": 1000,

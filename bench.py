@@ -77,7 +77,11 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      "knn_int8_qps": None, "knn_pq_qps": None,
                      "pq_recall_at_10": None,
                      "vector_stack_bytes_f32": None,
-                     "vector_stack_bytes_quantized": None}
+                     "vector_stack_bytes_quantized": None,
+                     # chaos harness (ISSUE 14): seeded null at import so
+                     # a forced timeout still emits them
+                     "chaos_rounds": None, "chaos_parity_checks": None,
+                     "chaos_invariant_violations": None}
 _LINE_PRINTED = False
 
 
@@ -1100,6 +1104,34 @@ def run_engine_leg(tag: str) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_chaos_leg(tag: str) -> dict:
+    """Chaos harness leg (ISSUE 14): one seeded round of the cross-lane
+    parity oracle + leak detectors in the cheap single-node mode
+    (cluster_nodes=0 — the multi-node disruption rounds live in tier-1's
+    chaos smoke; the bench leg proves the oracle runs clean on THIS
+    build and reports the counts). BENCH_CHAOS_SEED / BENCH_CHAOS_ROUNDS
+    override; a mismatch degrades to a non-zero count in the line, never
+    a failed run."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.testing.chaos import ChaosOptions, ChaosRunner
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    rounds = int(os.environ.get("BENCH_CHAOS_ROUNDS", "1"))
+    workdir = tempfile.mkdtemp(prefix=f"bench-chaos-{tag}-")
+    try:
+        report = ChaosRunner(workdir, ChaosOptions(
+            seed=seed, rounds=rounds, cluster_nodes=0,
+            raise_on_failure=False)).run()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {"chaos_seed": report.seed,
+            "chaos_rounds": report.rounds,
+            "chaos_parity_checks": report.parity_checks,
+            "chaos_mismatches": len(report.mismatches),
+            "chaos_invariant_violations":
+                len(report.invariant_violations)}
+
+
 def _run_all_legs(tag: str) -> dict:
     _arm_leg_alarm(reserve=120.0)
     res = run_engine_leg(tag)
@@ -1126,6 +1158,10 @@ def _run_all_legs(tag: str) -> dict:
             # so the ratio is measured once, in the main process
             ("BENCH_CLUSTER", "1" if tag == "main" else "0",
              run_cluster_leg),
+            # chaos parity oracle (ISSUE 14): correctness counts, not a
+            # perf ratio — measured once, in the main process
+            ("BENCH_CHAOS", "1" if tag == "main" else "0",
+             run_chaos_leg),
             # 4M-doc aggs + 1M-doc vectors: opt-in —
             # the scale tier only fits a long budget
             ("BENCH_SCALE", "0", run_scale_leg)]
@@ -1284,6 +1320,16 @@ def main_engine():
             "cluster_shards": res.get("cluster_shards"),
             "cluster_host_reduce_dispatches":
                 res.get("cluster_host_reduce_dispatches")})
+    if "chaos_rounds" in res:
+        # chaos harness (ISSUE 14): zero mismatches / zero violations is
+        # the acceptance signal; the seed makes any non-zero reproducible
+        line.update({
+            "chaos_seed": res.get("chaos_seed"),
+            "chaos_rounds": res.get("chaos_rounds"),
+            "chaos_parity_checks": res.get("chaos_parity_checks"),
+            "chaos_mismatches": res.get("chaos_mismatches"),
+            "chaos_invariant_violations":
+                res.get("chaos_invariant_violations")})
     if "scale_peak_rss_bytes" in res:
         # BENCH_SCALE leg (ISSUE 8): the 10M-doc-tier shapes, served by
         # the blockwise lane; peak RSS + peak score-matrix residency show

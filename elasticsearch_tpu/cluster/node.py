@@ -100,7 +100,14 @@ class _ShardHolder:
         self.lock = threading.RLock()
         self.recovering = False
         self.pending: list[dict] = []     # ops buffered during recovery
-        self.searcher: tuple[tuple, ShardSearcher] | None = None
+        self.searcher: tuple | None = None   # (key, ShardSearcher, handle)
+
+    def drop_searcher(self) -> None:
+        """Release the cached searcher's engine refcount (the leak
+        detector asserts the count drains at engine close)."""
+        if self.searcher is not None:
+            self.searcher[2].release()
+            self.searcher = None
 
 
 class ClusterNode:
@@ -233,8 +240,15 @@ class ClusterNode:
 
     def _on_ping(self, from_id: str, req: Any) -> dict:
         cur = self.cluster.current()
+        # `member`: whether the PINGER is in our cluster state — the
+        # MasterFaultDetection "node does not exist on master" signal. A
+        # node the master removed during a partition pings a master that
+        # still answers with the same master id, so without this bit the
+        # healed node would never learn it was dropped and never rejoin
+        # (found by the chaos harness's isolate→heal rounds).
         return {"node": self.node_id, "version": cur.version,
-                "master": cur.master_node}
+                "master": cur.master_node,
+                "member": from_id in cur.nodes or from_id == self.node_id}
 
     def _on_shard_stats(self, from_id: str, req: Any) -> dict:
         """Per-shard stats for the BROADCAST template (ref action/support/
@@ -383,6 +397,11 @@ class ClusterNode:
         class_stats = getattr(self.transport.network, "class_stats", None)
         if class_stats is not None:          # TcpTransport has no classes
             sections["transport_class"] = ("class", class_stats())
+        # fault-injection accounting (ISSUE 14): both transports count the
+        # faults they actually applied — es_transport_faults_injected_total
+        fault_stats = getattr(self.transport.network, "fault_stats", None)
+        if fault_stats is not None:
+            sections["transport"] = (None, fault_stats())
         return sections
 
     def _on_node_metrics(self, from_id: str, req: Any) -> dict:
@@ -491,6 +510,12 @@ class ClusterNode:
                     # find whoever the majority elected
                     self.cluster.reset()
                     self._masterless_round()
+                elif not resp.get("member", True):
+                    # the master dropped us while we were partitioned
+                    # away (MasterFaultDetection's node-does-not-exist
+                    # contract): reset and rejoin fresh — the master's
+                    # next publish replaces our stale state wholesale
+                    self.rejoin(state.master_node)
             except (ConnectTransportException, RemoteTransportException):
                 self._elect_after_master_loss(state)
         else:
@@ -860,6 +885,7 @@ class ClusterNode:
                         if k not in assigned or k[0] not in state.indices]:
                 holder = self._shards.pop(key)
                 if holder.engine is not None:
+                    holder.drop_searcher()
                     holder.engine.close()
                 # a CLOSED index keeps its shard data on disk (the engine
                 # shuts down, the files stay for reopen — ref
@@ -918,9 +944,9 @@ class ClusterNode:
         with holder.lock:
             holder.recovering = True
             if holder.engine is not None:
+                holder.drop_searcher()
                 holder.engine.close()
                 holder.engine = None
-                holder.searcher = None
         path = self._shard_path(index, sid)
         try:
             ok = self._recover_files_from(source_node, index, sid, path)
@@ -1936,6 +1962,7 @@ class ClusterNode:
         key = (tuple(s.seg_id for s in eng.segments),
                tuple(s.live_gen for s in eng.segments))
         if holder.searcher is None or holder.searcher[0] != key:
+            holder.drop_searcher()
             # per-index search-lane settings ride the cluster state
             # (prefixed key wins, the update-settings convention) so the
             # blockwise opt-out/block width behave like the local node's
@@ -1956,7 +1983,9 @@ class ClusterNode:
             holder.searcher = (key, ShardSearcher(
                 sid, eng.segments, self._mappers[index],
                 blockwise=blockwise, block_docs=block_docs,
-                knn_opts=knn_options_from(get_s)))
+                knn_opts=knn_options_from(get_s)),
+                eng.acquire_searcher(
+                    site=f"cluster[{index}][{sid}]/_searcher"))
         return holder.searcher[1]
 
     @contextlib.contextmanager
@@ -2264,6 +2293,7 @@ class ClusterNode:
         with self._shards_lock:
             for holder in self._shards.values():
                 if holder.engine is not None:
+                    holder.drop_searcher()
                     holder.engine.close()
 
 

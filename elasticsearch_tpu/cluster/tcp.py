@@ -176,6 +176,12 @@ class TcpTransport:
         self._addrs: dict[str, tuple[str, int]] = {}
         self._conns: dict[tuple[str, str], _Connection] = {}
         self._disconnected: set[tuple[str | None, str]] = set()
+        # fault-seam parity with LocalTransport (ISSUE 14): action-prefix
+        # drop rules, delivery delays, and the injected-faults counter, so
+        # the chaos scheme drives the TCP cluster with the same API
+        self._drop_rules: set[tuple[str | None, str, str]] = set()
+        self._delays: dict[tuple[str, str], float] = {}
+        self.faults_injected = 0
         self._seeds = list(seeds or [])
         # optional bounded executor for inbound dispatch (common.threadpool);
         # None = thread-per-request
@@ -250,6 +256,60 @@ class TcpTransport:
     def heal(self) -> None:
         with self._lock:
             self._disconnected.clear()
+            self._drop_rules.clear()
+            self._delays.clear()
+
+    def add_rule(self, node_id: str, action_prefix: str = "",
+                 from_id: str | None = None) -> None:
+        """Drop messages TO node_id whose action starts with action_prefix
+        (same contract as LocalTransport.add_rule — a scoped kill that
+        leaves the rest of the link healthy)."""
+        with self._lock:
+            self._drop_rules.add((from_id, node_id, action_prefix))
+
+    def clear_rule(self, node_id: str, action_prefix: str = "",
+                   from_id: str | None = None) -> None:
+        with self._lock:
+            self._drop_rules.discard((from_id, node_id, action_prefix))
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._drop_rules.clear()
+
+    def _rule_dropped(self, from_id: str, to_id: str, action: str) -> bool:
+        # caller holds the lock
+        if not self._drop_rules:
+            return False
+        return any(nid == to_id and (frm is None or frm == from_id)
+                   and action.startswith(pfx)
+                   for frm, nid, pfx in self._drop_rules)
+
+    def add_delay(self, node_id: str, action_prefix: str,
+                  seconds: float) -> None:
+        """Inject delivery latency into every message TO node_id whose
+        action starts with action_prefix (slow-replica injection over the
+        real wire — applied client-side, before the frame is sent)."""
+        with self._lock:
+            self._delays[(node_id, action_prefix)] = float(seconds)
+
+    def clear_delay(self, node_id: str, action_prefix: str) -> None:
+        with self._lock:
+            self._delays.pop((node_id, action_prefix), None)
+
+    def _delay_of(self, to_id: str, action: str) -> float:
+        with self._lock:
+            if not self._delays:
+                return 0.0
+            return max((s for (nid, pfx), s in self._delays.items()
+                        if nid == to_id and action.startswith(pfx)),
+                       default=0.0)
+
+    def fault_stats(self) -> dict:
+        with self._lock:
+            return {"faults_injected_total": self.faults_injected,
+                    "disconnected_links": len(self._disconnected),
+                    "drop_rules": len(self._drop_rules),
+                    "delay_rules": len(self._delays)}
 
     # -- server side -------------------------------------------------------
 
@@ -306,7 +366,10 @@ class TcpTransport:
             with self._lock:
                 ent = self._local.get(node_id)
                 blocked = ((from_id, node_id) in self._disconnected
-                           or (None, node_id) in self._disconnected)
+                           or (None, node_id) in self._disconnected
+                           or self._rule_dropped(from_id, node_id, action))
+                if blocked:
+                    self.faults_injected += 1
             if ent is None or blocked:
                 raise ConnectTransportException(node_id, action)
             if action == A_HANDSHAKE:
@@ -410,9 +473,18 @@ class TcpTransport:
                 payload: Any) -> Any:
         with self._lock:
             blocked = ((from_id, to_id) in self._disconnected
-                       or (None, to_id) in self._disconnected)
+                       or (None, to_id) in self._disconnected
+                       or self._rule_dropped(from_id, to_id, action))
+            if blocked:
+                self.faults_injected += 1
         if blocked:
             raise ConnectTransportException(to_id, action)
+        delay = self._delay_of(to_id, action)
+        if delay > 0:
+            with self._lock:
+                self.faults_injected += 1
+            import time as _time
+            _time.sleep(delay)
         try:
             conn = self._conn_for(from_id, to_id)
             status, data = conn.request(from_id, action, payload)

@@ -142,10 +142,18 @@ class LocalTransport:
         # fault-injection rules: (from_id|None, to_id) pairs that fail —
         # None matches any sender (full isolation of to_id)
         self._disconnected: set[tuple[str | None, str]] = set()
+        # action-prefix-scoped drop rules (ISSUE 14): (from_id|None, to_id,
+        # action_prefix) triples that fail — kills a single action class
+        # (e.g. only replica bulk) without severing the link, so fault
+        # detection pings keep flowing while the targeted traffic dies
+        self._drop_rules: set[tuple[str | None, str, str]] = set()
         # latency-injection rules: (to_id, action_prefix) -> seconds of
         # added delivery delay (the slow-replica half of the
         # MockTransportService analog; hedged-read tests use this)
         self._delays: dict[tuple[str, str], float] = {}
+        # es_transport_faults_injected_total: every fault this layer
+        # actually APPLIED to a delivery (blocked, rule-dropped, delayed)
+        self.faults_injected = 0
         self.messages_sent = 0
         self.bytes_sent = 0
         self.max_message_bytes = 0   # largest single frame (recovery tests
@@ -193,9 +201,46 @@ class LocalTransport:
                     self._disconnected.add((a, b))
                     self._disconnected.add((b, a))
 
+    def add_rule(self, node_id: str, action_prefix: str = "",
+                 from_id: str | None = None) -> None:
+        """Drop every message TO node_id whose action starts with
+        action_prefix ("" = every action — equivalent to disconnect), from
+        from_id or from anyone. Unlike disconnect, a scoped rule leaves the
+        rest of the link healthy: chaos can kill only bulk replication (or
+        only the query phase) while pings keep the node in the cluster."""
+        with self._lock:
+            self._drop_rules.add((from_id, node_id, action_prefix))
+
+    def clear_rule(self, node_id: str, action_prefix: str = "",
+                   from_id: str | None = None) -> None:
+        with self._lock:
+            self._drop_rules.discard((from_id, node_id, action_prefix))
+
+    def clear_rules(self) -> None:
+        with self._lock:
+            self._drop_rules.clear()
+
+    def _rule_dropped(self, from_id: str, to_id: str, action: str) -> bool:
+        # caller holds the lock
+        if not self._drop_rules:
+            return False
+        return any(nid == to_id and (frm is None or frm == from_id)
+                   and action.startswith(pfx)
+                   for frm, nid, pfx in self._drop_rules)
+
+    def fault_stats(self) -> dict:
+        """Leaves for the `transport` metric section
+        (es_transport_faults_injected_total) + active-rule gauges."""
+        with self._lock:
+            return {"faults_injected_total": self.faults_injected,
+                    "disconnected_links": len(self._disconnected),
+                    "drop_rules": len(self._drop_rules),
+                    "delay_rules": len(self._delays)}
+
     def heal(self) -> None:
         with self._lock:
             self._disconnected.clear()
+            self._drop_rules.clear()
             self._delays.clear()
 
     def add_delay(self, node_id: str, action_prefix: str,
@@ -270,7 +315,10 @@ class LocalTransport:
                 payload: Any) -> Any:
         with self._lock:
             blocked = ((from_id, to_id) in self._disconnected
-                       or (None, to_id) in self._disconnected)
+                       or (None, to_id) in self._disconnected
+                       or self._rule_dropped(from_id, to_id, action))
+            if blocked:
+                self.faults_injected += 1
             target = self._nodes.get(to_id)
         if blocked or target is None:
             raise ConnectTransportException(to_id, action)
@@ -279,6 +327,8 @@ class LocalTransport:
         try:
             delay = self._delay_of(to_id, action)
             if delay > 0:
+                with self._lock:
+                    self.faults_injected += 1
                 import time as _time
                 _time.sleep(delay)
             return self._deliver_framed(from_id, to_id, action, payload)

@@ -31,6 +31,44 @@ from .segment import Segment, SegmentBuilder, merge_segments
 from .translog import Translog
 
 
+# Leak detection (ISSUE 14, the AssertingSearcher / mock-directory
+# discipline): when armed (testing.chaos.detectors.arm(), wired into
+# tests/conftest.py for the whole suite), Engine.close() ASSERTS that every
+# acquired searcher handle was released and that every byte the engine
+# charged to its breaker was handed back — naming the acquire site of each
+# leak, plus the reproducing CHAOS_SEED when one is set.
+LEAK_CHECK = False
+
+
+def _seed_tag() -> str:
+    seed = os.environ.get("CHAOS_SEED")
+    return f" [CHAOS_SEED={seed}]" if seed else ""
+
+
+class SearcherLeakError(AssertionError):
+    """An engine closed with acquired-but-unreleased state (searcher
+    handles or breaker charges). Only raised when leak checking is armed."""
+
+
+class SearcherHandle:
+    """A refcounted searcher acquisition (ref AssertingSearcher): the
+    acquire site is recorded so a leak names the code that forgot to
+    release, not just 'something leaked'."""
+
+    __slots__ = ("engine", "site", "released")
+
+    def __init__(self, engine: "Engine", site: str):
+        self.engine = engine
+        self.site = site
+        self.released = False
+
+    def release(self) -> None:
+        if self.released:
+            return
+        self.released = True
+        self.engine._open_searchers.pop(id(self), None)
+
+
 class VersionConflictException(Exception):
     def __init__(self, doc_id: str, current: int, expected: int):
         super().__init__(
@@ -171,8 +209,58 @@ class Engine:
         self.refresh_count = 0
         self.flush_count = 0
         self.merge_count = 0
+        # leak-detector state (ISSUE 14): open searcher handles (id ->
+        # handle) and the per-site breaker ledger — net bytes this engine
+        # charged, keyed by the charge site; symmetric with every
+        # add_estimate/release pair below, so close() can assert it drains
+        self._open_searchers: dict[int, SearcherHandle] = {}
+        self._charge_sites: dict[str, int] = {}
+        self._closed = False
         self._load_commit()
         self._recover()
+
+    # -- leak-detector seams (ISSUE 14) -----------------------------------
+
+    def acquire_searcher(self, site: str = "?") -> SearcherHandle:
+        """Acquire a refcounted searcher reference. The caller MUST call
+        handle.release() when the searcher goes out of use; when leak
+        checking is armed, close() fails naming `site` for every handle
+        still open."""
+        h = SearcherHandle(self, site)
+        self._open_searchers[id(h)] = h
+        return h
+
+    def _ledger(self, site: str, delta: int) -> None:
+        """Track the engine's own breaker traffic per charge site; a site
+        that drains to zero leaves the ledger."""
+        n = self._charge_sites.get(site, 0) + delta
+        if n:
+            self._charge_sites[site] = n
+        else:
+            self._charge_sites.pop(site, None)
+
+    def _leak_check(self) -> None:
+        problems = []
+        for h in self._open_searchers.values():
+            problems.append(f"searcher acquired at [{h.site}] never "
+                            f"released")
+        for site, n in sorted(self._charge_sites.items()):
+            problems.append(f"breaker charge from [{site}] has {n} bytes "
+                            f"outstanding")
+        # cache-entry accounting: a closed engine's segments must not pin
+        # fielddata / ANN cache entries (their removal listeners hand the
+        # breaker charge back — an entry that survives leaks it forever)
+        for s in self.segments:
+            if self.fielddata_cache is not None:
+                b = self.fielddata_cache.bytes_for(s)
+                if b:
+                    problems.append(
+                        f"fielddata cache entries for segment "
+                        f"{s.seg_id} survived close: {sorted(b)}")
+        if problems:
+            raise SearcherLeakError(
+                f"engine [{self.path}] closed with leaks: "
+                + "; ".join(problems) + _seed_tag())
 
     # -- recovery (translog replay, ref InternalEngine recoverFromTranslog) --
 
@@ -191,6 +279,7 @@ class Engine:
             # refusing to boot would lose availability, not memory
             for s in segments:
                 self.breaker.add_estimate(s.memory_bytes(), check=False)
+                self._ledger(f"segment:{s.seg_id}", s.memory_bytes())
         self._next_seg_id = max((s.seg_id for s in segments), default=0) + 1
         # rebuild the LiveVersionMap: manifest order is chronological, so
         # later segments override earlier ones for re-indexed docs
@@ -580,6 +669,7 @@ class Engine:
                         in self._buffer_docs.items():
                     builder.add(parsed, tname,
                                 version=self.versions[doc_id][0])
+            site = f"segment:{self._next_seg_id}"
             if self.breaker is not None:
                 # charge BEFORE build() uploads device arrays: a tripped
                 # breaker prevents the allocation itself, not just the
@@ -590,6 +680,7 @@ class Engine:
                 except Exception as e:
                     self._blocked_reason = e
                     raise
+                self._ledger(site, est)
             try:
                 seg = builder.build()
             except BaseException:
@@ -597,6 +688,7 @@ class Engine:
                 # ratchets up on every retried refresh
                 if self.breaker is not None:
                     self.breaker.release(est)
+                    self._ledger(site, -est)
                 raise
             if self.breaker is not None:
                 # true up any estimate drift without re-tripping
@@ -605,6 +697,7 @@ class Engine:
                     self.breaker.add_estimate(drift, check=False)
                 elif drift < 0:
                     self.breaker.release(-drift)
+                self._ledger(site, drift)
             self._blocked_reason = None
             self._next_seg_id += 1
             self._adopt(seg)
@@ -626,6 +719,8 @@ class Engine:
         self.segments = [s for s in self.segments if s.live_count > 0]
         if self.breaker is not None:
             self.breaker.release(sum(s.memory_bytes() for s in dead))
+            for s in dead:
+                self._ledger(f"segment:{s.seg_id}", -s.memory_bytes())
         self._drop_fielddata(dead)
 
     def _maybe_merge(self) -> None:
@@ -707,7 +802,11 @@ class Engine:
         if self.breaker is not None:
             if merged.n_docs:
                 self.breaker.add_estimate(merged.memory_bytes(), check=False)
+                self._ledger(f"segment:{merged.seg_id}",
+                             merged.memory_bytes())
             self.breaker.release(sum(s.memory_bytes() for s in sources))
+            for s in sources:
+                self._ledger(f"segment:{s.seg_id}", -s.memory_bytes())
         self._drop_fielddata(sources)
 
     def flush(self) -> None:
@@ -750,8 +849,16 @@ class Engine:
                 "buffered_docs": len(self._buffer_docs)}
 
     def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return          # idempotent: a second close must not
+            self._closed = True  # double-release the breaker charges
         if self.breaker is not None:
             self.breaker.release(sum(s.memory_bytes()
                                      for s in self.segments))
+            for s in self.segments:
+                self._ledger(f"segment:{s.seg_id}", -s.memory_bytes())
         self._drop_fielddata(self.segments)
         self.translog.close()
+        if LEAK_CHECK:
+            self._leak_check()

@@ -280,6 +280,9 @@ class IndexService:
             self.breakers.breaker("request").release(view.memory_bytes)
 
     def close(self) -> None:
+        for cached in self._searcher_cache.values():
+            cached[2].release()     # before engine close: the leak
+        self._searcher_cache.clear()  # detector asserts refcounts drained
         for e in self.shards:
             e.close()
         self._packed_view_cache.clear()
@@ -299,6 +302,12 @@ class IndexService:
             key = tuple(s.seg_id for s in e.segments)
             cached = self._searcher_cache.get(si)
             if cached is None or cached[0] != key:
+                if cached is not None:
+                    # rotation releases the stale searcher's refcount —
+                    # the leak detector (ISSUE 14) pins this symmetry
+                    cached[2].release()
+                handle = e.acquire_searcher(
+                    site=f"index[{self.name}]/shard[{si}]/searchers")
                 cached = (key, ShardSearcher(
                     si, e.segments, self.mappers, stats=self.search_stats,
                     stack_cache=self.caches.segment_stacks
@@ -309,7 +318,7 @@ class IndexService:
                     block_docs=self._block_docs,
                     request_breaker=self.breakers.breaker("request")
                     if self.breakers is not None else None,
-                    knn_opts=self._knn_opts))
+                    knn_opts=self._knn_opts), handle)
                 self._searcher_cache[si] = cached
             out.append(cached[1])
         return out

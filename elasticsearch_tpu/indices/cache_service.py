@@ -968,3 +968,17 @@ class IndicesCacheService:
         self.mesh_vector_stacks.cache.clear()
         self.ann_indexes.cache.clear()
         self.ann_indexes.quant.clear()
+
+    def leak_report(self) -> list[str]:
+        """Cache-entry accounting for the chaos leak detector: every tier
+        whose stats expose memory bytes must drain after a full clear —
+        a non-zero residue means an entry holds breaker charge with no
+        owner left to release it."""
+        self.clear(query=True, request=True, fielddata=True)
+        problems = []
+        for tier, st in self.stats().items():
+            bytes_ = st.get("memory_size_in_bytes", 0)
+            if bytes_:
+                problems.append(
+                    f"cache tier [{tier}] holds {bytes_} bytes after clear")
+        return problems

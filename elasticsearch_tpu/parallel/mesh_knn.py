@@ -376,7 +376,12 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     if used_quant:
         rw = ann_ops.rescore_width(
             kk, int(knn_opts.get("rescore_window") or 0), W)
-    key = ("knn", vstack.s_pad, R, q_pad, k, kk, vstack.n_pad, vstack.dims,
+    # g_pad MUST key the program: it is a closure constant of step(), and
+    # a merge can take an index from g_pad=2 back to g_pad=1 while every
+    # other component matches (chaos-harness find: the cached program
+    # then broadcast-errors on the new stack and the lane falls back)
+    key = ("knn", vstack.s_pad, vstack.g_pad, R, q_pad, k, kk,
+           vstack.n_pad, vstack.dims,
            metric, precision, used_ivf, nprobe_eff, W, block,
            used_quant, rw,
            (fplan[0], tuple(fplan[2].fields.items()),

@@ -314,6 +314,14 @@ class QosController:
                         "slots": self._slots(c)}
                     for c in TRAFFIC_CLASSES}
 
+    def control_plane_shed(self) -> int:
+        """Sheds charged against never-shed classes — must stay 0 by
+        construction; the chaos invariant checker asserts it after every
+        disruption round so a regression in the admission gate is caught
+        with a reproducing seed attached."""
+        with self._lock:
+            return sum(self.shed[c] for c in _NEVER_SHED)
+
     def stats(self) -> dict:
         return {"pressure": round(self.pressure(), 4),
                 "queue_frac": round(self.queue_frac(), 4),

@@ -1,0 +1,22 @@
+"""Chaos harness (ISSUE 14): seeded randomized disruption, leak
+detectors, and a cross-lane bitwise-parity oracle.
+
+One `random.Random(seed)` drives everything — the workload, the
+disruption schedule, and the query sample — so any failure reproduces
+from the single `CHAOS_SEED` printed in its message (the
+ESIntegTestCase `REPRODUCE WITH` line, collapsed to one integer).
+
+    from elasticsearch_tpu.testing.chaos import ChaosOptions, ChaosRunner
+    report = ChaosRunner(path, ChaosOptions(seed=7)).run()
+"""
+
+from .detectors import arm, armed, breaker_problems, disarm, seed_tag
+from .runner import ChaosFailure, ChaosOptions, ChaosReport, ChaosRunner
+from .scheme import DisruptionScheme
+from .workload import SeededWorkload
+
+__all__ = [
+    "ChaosFailure", "ChaosOptions", "ChaosReport", "ChaosRunner",
+    "DisruptionScheme", "SeededWorkload",
+    "arm", "armed", "breaker_problems", "disarm", "seed_tag",
+]

@@ -1,0 +1,56 @@
+"""Leak detectors (the AssertingSearcher / MockEngine analog).
+
+`arm()` flips `index.engine.LEAK_CHECK`: every `Engine.close()` then
+asserts its searcher refcounts drained, its per-site breaker ledger is
+balanced, and no fielddata cache entries survived the engine — raising
+`SearcherLeakError` naming the acquire/charge SITE plus the
+`CHAOS_SEED` tag when one is exported. The conftest arms this for the
+whole suite, so an engine leaked by ANY test fails loudly instead of
+silently inflating the parent breaker for the tests behind it.
+
+This module owns the flag flip (rather than tests importing engine
+internals) so the engine module never imports testing code — the
+production tree stays one-directional.
+"""
+
+from __future__ import annotations
+
+from ...index import engine as _engine
+
+
+def arm() -> None:
+    _engine.LEAK_CHECK = True
+
+
+def disarm() -> None:
+    _engine.LEAK_CHECK = False
+
+
+def armed() -> bool:
+    return bool(_engine.LEAK_CHECK)
+
+
+def seed_tag() -> str:
+    """' [CHAOS_SEED=n]' when a chaos run is active, else ''."""
+    return _engine._seed_tag()
+
+
+def breaker_problems(breakers) -> list[str]:
+    """Non-drained circuit breakers: every byte charged during a run
+    must be released once the engines and caches holding it are closed —
+    a residue means an add_estimate without its release (the invariant
+    the per-site engine ledger localizes to an acquire site)."""
+    problems = []
+    for name, st in breakers.stats().items():
+        used = st.get("estimated_size_in_bytes", 0)
+        if used:
+            problems.append(
+                f"breaker [{name}] holds {used} bytes after close"
+                + seed_tag())
+    return problems
+
+
+def cache_problems(caches) -> list[str]:
+    """Cache tiers holding bytes after a full clear (see
+    IndicesCacheService.leak_report)."""
+    return [p + seed_tag() for p in caches.leak_report()]

@@ -1,0 +1,111 @@
+"""Cross-lane parity oracle + invariant checkers.
+
+Parity: the same query replayed down two lanes the engine documents as
+bitwise-identical (loop vs stacked vs blockwise vs mesh, solo vs
+msearch-batched, IVF(nprobe>=nlist) vs exact, int8-mesh vs int8-fanout,
+host-reduce vs per-shard transport merge) must produce byte-equal
+responses after canonicalization (drop `took`, neutralize the twin
+index's `_index` label). A mismatch is a real engine bug, never test
+noise — which is exactly why only documented-bitwise pairs are compared
+(quantized-vs-f32 is approximate by design and is NOT a parity pair).
+
+Invariants: error classification (a client must never see an
+unclassified 5xx — transport/unavailable errors are legitimate only
+while a disruption is live), control-plane traffic classes never shed,
+breaker accounting drains to zero at teardown, acked writes survive
+partition healing.
+"""
+
+from __future__ import annotations
+
+import copy
+
+
+def canon(resp: dict) -> dict:
+    """Canonicalize a search response for cross-lane comparison: drop
+    wall-clock fields, collapse the index name (twin indices hold the
+    same docs under different lane settings)."""
+    r = copy.deepcopy(resp)
+    r.pop("took", None)
+    for sub in r.get("responses", []):        # msearch envelope
+        if isinstance(sub, dict):
+            sub.pop("took", None)
+            for h in sub.get("hits", {}).get("hits", []):
+                h.pop("_index", None)
+    for h in r.get("hits", {}).get("hits", []):
+        h.pop("_index", None)
+    return r
+
+
+class ParityMismatch:
+    def __init__(self, label: str, body: dict, ref, got):
+        self.label = label
+        self.body = body
+        self.ref = ref
+        self.got = got
+
+    def __repr__(self) -> str:
+        return (f"parity mismatch [{self.label}] for {self.body!r}: "
+                f"expected {self.ref!r} got {self.got!r}")
+
+
+class ParityOracle:
+    """Counts comparisons and collects mismatches; `inject_fault` makes
+    the FIRST comparison fail deliberately — the harness's own tripwire
+    that a broken lane actually surfaces as a seed-stamped failure."""
+
+    def __init__(self, inject_fault: bool = False):
+        self.checks = 0
+        self.mismatches: list[ParityMismatch] = []
+        self._inject = inject_fault
+
+    def compare(self, label: str, body: dict, ref: dict, got: dict) -> bool:
+        self.checks += 1
+        a, b = canon(ref), canon(got)
+        if self._inject:
+            self._inject = False
+            b = copy.deepcopy(b)
+            b.setdefault("hits", {})["max_score"] = -1e30
+        ok = a == b
+        if not ok:
+            self.mismatches.append(ParityMismatch(label, body, a, b))
+        return ok
+
+
+# exception families a DISRUPTED cluster may legitimately surface: the
+# caller's link to a copy (or the master) is the thing being broken
+_DISRUPTION_OK = ("ConnectTransportException", "RemoteTransportException",
+                  "UnavailableShardsException", "NoMasterException",
+                  "TimeoutError")
+
+
+def classify(exc: Exception, disrupted: bool) -> str | None:
+    """None when the failure is acceptable, else a violation string.
+
+    Acceptable = anything the REST boundary maps below 500 (breaker
+    trips / sheds / rejections are 429s, validation is 4xx — the
+    'never an unclassified 5xx' contract), plus transport/availability
+    errors while a disruption is actively severing links."""
+    from ...rest.http_server import _status_of
+    if _status_of(exc) < 500:
+        return None
+    if disrupted and type(exc).__name__ in _DISRUPTION_OK:
+        return None
+    return (f"unclassified 5xx-class failure "
+            f"({type(exc).__name__}: {exc}) "
+            f"{'under disruption' if disrupted else 'with no fault active'}")
+
+
+def control_plane_violations(nodes) -> list[str]:
+    """state/ping traffic classes must never shed — overload shedding
+    that takes out the control plane turns degradation into an outage."""
+    out = []
+    for n in nodes:
+        qos = getattr(n, "qos", None)
+        if qos is None:
+            continue
+        shed = qos.control_plane_shed()
+        if shed:
+            out.append(f"control-plane class shed {shed}x on "
+                       f"[{getattr(n, 'node_id', 'node')}]")
+    return out

@@ -1,0 +1,382 @@
+"""ChaosRunner: seeded rounds of workload + disruption + parity sweep +
+invariant checks, over a single-node twin-index ladder AND a live
+multi-node cluster, with leak detectors armed throughout.
+
+Every random choice flows from `ChaosOptions.seed`; the seed is
+exported as `CHAOS_SEED` for the duration of the run so any assertion
+raised anywhere underneath (including engine leak checks) carries the
+reproducing integer in its message.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import random
+
+from ...common.settings import Settings
+from ...index.engine import SearcherLeakError
+from . import detectors
+from .oracle import ParityOracle, classify, control_plane_violations
+from .scheme import DisruptionScheme
+from .workload import SeededWorkload
+
+# the twin-index ladder: same docs under every dense-lane configuration
+# the engine documents as bitwise-equivalent (index-creation-time
+# settings are the lane toggles)
+_TWINS = [
+    ("c-loop", {"index.search.stacked.enable": False,
+                "index.search.blockwise.enable": False,
+                "index.search.mesh.enable": False}),
+    ("c-stacked", {"index.search.blockwise.enable": False,
+                   "index.search.mesh.enable": False}),
+    ("c-block", {"index.search.mesh.enable": False,
+                 "index.search.block_docs": 64}),
+    ("c-mesh", {}),
+]
+
+_KNN_SETTINGS = {"index.knn.ivf.nlist": 4, "index.knn.ivf.nprobe": 2,
+                 "index.knn.ivf.min_docs": 16, "index.knn.precision": "f32"}
+
+
+class ChaosFailure(AssertionError):
+    """Any chaos-run failure: the message leads with the reproducing
+    seed (the `REPRODUCE WITH` line of this harness)."""
+
+    def __init__(self, seed: int, problems: list):
+        detail = "\n  ".join(str(p) for p in problems)
+        super().__init__(
+            f"chaos run failed [CHAOS_SEED={seed}] — reproduce with "
+            f"CHAOS_SEED={seed}:\n  {detail}")
+        self.seed = seed
+        self.problems = problems
+
+
+class ChaosOptions:
+    __test__ = False
+
+    def __init__(self, seed: int, rounds: int = 3, docs_per_round: int = 48,
+                 dims: int = 8, cluster_nodes: int = 3, shards: int = 4,
+                 replicas: int = 1, transport: str = "local",
+                 inject_parity_fault: bool = False,
+                 raise_on_failure: bool = True):
+        self.seed = seed
+        self.rounds = rounds
+        self.docs_per_round = docs_per_round
+        self.dims = dims
+        # 0 disables the cluster half (the cheap single-node-only mode
+        # the bench leg uses)
+        self.cluster_nodes = cluster_nodes
+        self.shards = shards
+        self.replicas = replicas
+        self.transport = transport
+        self.inject_parity_fault = inject_parity_fault
+        self.raise_on_failure = raise_on_failure
+
+
+class ChaosReport:
+    __test__ = False
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rounds = 0
+        self.parity_checks = 0
+        self.mismatches: list = []
+        self.invariant_violations: list[str] = []
+        self.disruptions: list[str] = []
+        self.faults_injected = 0
+        self.acked_writes = 0
+        self.hedges_fired = 0
+
+    def ok(self) -> bool:
+        return not self.mismatches and not self.invariant_violations
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed, "rounds": self.rounds,
+                "parity_checks": self.parity_checks,
+                "mismatches": len(self.mismatches),
+                "invariant_violations": len(self.invariant_violations),
+                "disruptions": list(self.disruptions),
+                "faults_injected": self.faults_injected,
+                "acked_writes": self.acked_writes}
+
+
+class ChaosRunner:
+    __test__ = False
+
+    def __init__(self, path: str, options: ChaosOptions):
+        self.path = str(path)
+        self.opt = options
+        self.rng = random.Random(options.seed)
+        self.report = ChaosReport(options.seed)
+        self.oracle = ParityOracle(options.inject_parity_fault)
+        self.node = None
+        self.cluster = None
+        self.scheme = None
+        self._acked: list[str] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> ChaosReport:
+        prev_seed = os.environ.get("CHAOS_SEED")
+        os.environ["CHAOS_SEED"] = str(self.opt.seed)
+        detectors.arm()
+        try:
+            self._setup()
+            for _ in range(self.opt.rounds):
+                self._round()
+                self.report.rounds += 1
+            self._final_invariants()
+        except Exception as e:
+            # ANY unexpected failure must carry the reproducing seed
+            raise ChaosFailure(self.opt.seed,
+                               [f"{type(e).__name__}: {e}"]) from e
+        finally:
+            self._teardown()
+            if prev_seed is None:
+                os.environ.pop("CHAOS_SEED", None)
+            else:
+                os.environ["CHAOS_SEED"] = prev_seed
+        self.report.parity_checks = self.oracle.checks
+        self.report.mismatches = list(self.oracle.mismatches)
+        problems = self.report.mismatches + self.report.invariant_violations
+        if problems and self.opt.raise_on_failure:
+            raise ChaosFailure(self.opt.seed, problems)
+        return self.report
+
+    def _setup(self) -> None:
+        from ...node import NodeService
+        self.solo_work = SeededWorkload(
+            random.Random(self.rng.randrange(2 ** 62)), self.opt.dims)
+        self.node = NodeService(os.path.join(self.path, "solo"), Settings({
+            # the chaos corpus is tiny; a latency-EWMA spike from first
+            # compiles must not shed the parity sweep
+            "node.search.qos.shed_latency_ms": 0}))
+        mapping = self.solo_work.mapping()
+        for name, extra in _TWINS:
+            self.node.create_index(
+                name, settings={"number_of_shards": 2,
+                                **_KNN_SETTINGS, **extra},
+                mappings={"_doc": mapping})
+        if self.opt.cluster_nodes:
+            from ...cluster.harness import TestCluster
+            self.cluster_work = SeededWorkload(
+                random.Random(self.rng.randrange(2 ** 62)), self.opt.dims)
+            self.cluster = TestCluster(
+                self.opt.cluster_nodes, os.path.join(self.path, "cluster"),
+                transport=self.opt.transport)
+            client = self.cluster.client()
+            client.create_index("docs", {
+                "number_of_shards": self.opt.shards,
+                "number_of_replicas": self.opt.replicas,
+                **_KNN_SETTINGS})
+            client.put_mapping("docs", "_doc", mapping)
+            self.cluster.ensure_green()
+            self.scheme = DisruptionScheme(
+                self.cluster, random.Random(self.rng.randrange(2 ** 62)))
+
+    # -- one round ----------------------------------------------------------
+
+    def _round(self) -> None:
+        self._solo_writes()
+        if self.cluster is not None:
+            started = self.scheme.start_round()
+            self.report.disruptions.extend(started)
+            try:
+                self._cluster_traffic_under_disruption()
+            finally:
+                self.scheme.heal()
+        self._solo_parity_sweep()
+        if self.cluster is not None:
+            self._cluster_parity_sweep()
+            self._acked_write_check()
+            self.report.invariant_violations.extend(
+                control_plane_violations(
+                    [self.node, *self.cluster.nodes.values()]))
+            self.report.faults_injected = self._cluster_faults()
+
+    def _solo_writes(self) -> None:
+        w = self.solo_work
+        docs = w.next_docs(self.opt.docs_per_round)
+        victims = w.victim_ids(self.rng.randint(2, 5))
+        merge = self.rng.random() < 0.5
+        # every twin sees the identical write/delete/merge sequence — the
+        # precondition for cross-lane parity (stats included: a merge
+        # purges deletes, so it must happen on ALL twins or none)
+        for name, _ in _TWINS:
+            for doc_id, src in docs:
+                self.node.index_doc(name, doc_id, copy.deepcopy(src))
+            for doc_id in victims:
+                try:
+                    self.node.delete_doc(name, doc_id)
+                except Exception:
+                    pass        # already deleted in an earlier round
+            if merge:
+                self.node.force_merge(name)
+            self.node.refresh(name)
+
+    def _solo_parity_sweep(self) -> None:
+        texts = self.solo_work.text_queries(8)
+        for body in texts:
+            ref = self.node.search("c-loop", copy.deepcopy(body))
+            for name, _ in _TWINS[1:]:
+                got = self.node.search(name, copy.deepcopy(body))
+                self.oracle.compare(f"loop-vs-{name}", body, ref, got)
+        # batched vs solo: the msearch lane coalesces compatible plans
+        # into ONE Q>1 program; responses must equal the solo path's
+        reqs = [({"index": "c-mesh"}, copy.deepcopy(b)) for b in texts[:4]]
+        batch = self.node.msearch(reqs)
+        for body, sub in zip(texts[:4], batch["responses"]):
+            solo = self.node.search("c-mesh", copy.deepcopy(body))
+            self.oracle.compare("batched-vs-solo", body, solo, sub)
+        self._knn_parity()
+
+    def _knn_parity(self) -> None:
+        for body in self.solo_work.knn_queries(3):
+            knn = body["knn"]
+            exact = {**body, "knn": {**knn, "exact": True}}
+            ref = self.node.search("c-loop", copy.deepcopy(exact))
+            # IVF with nprobe >= nlist routes to the exact kernel —
+            # documented bitwise parity, same index
+            full = {**body, "knn": {**knn, "nprobe": 64}}
+            self.oracle.compare("ivf-full-vs-exact", body, ref,
+                                self.node.search("c-loop", full))
+            # the exact kernel across twins (mesh exact lane declines to
+            # the fan-out; either way the result is the same program)
+            self.oracle.compare("knn-exact-loop-vs-mesh", body, ref,
+                                self.node.search("c-mesh",
+                                                 copy.deepcopy(exact)))
+            # int8 through the mesh lane vs the per-shard fan-out — the
+            # documented quantized bitwise pair (f32-vs-quantized is
+            # approximate by design and is NOT compared)
+            int8 = {**body, "knn": {**knn, "quantization": "int8"}}
+            self.oracle.compare(
+                "knn-int8-loop-vs-mesh", body,
+                self.node.search("c-loop", copy.deepcopy(int8)),
+                self.node.search("c-mesh", copy.deepcopy(int8)))
+        fbody = self.solo_work.filtered_knn_query()
+        self.oracle.compare(
+            "knn-filtered-loop-vs-mesh", fbody,
+            self.node.search("c-loop", copy.deepcopy(fbody)),
+            self.node.search("c-mesh", copy.deepcopy(fbody)))
+
+    # -- cluster half -------------------------------------------------------
+
+    def _client(self):
+        return self.cluster.client()
+
+    def _cluster_traffic_under_disruption(self) -> None:
+        w = self.cluster_work
+        client = self._client()
+        # fault detection runs WITH the faults live — the master must
+        # react (remove the isolated node / step down), never crash
+        self.cluster.detect_once()
+        for doc_id, src in w.next_docs(self.opt.docs_per_round // 2):
+            try:
+                client.index_doc("docs", doc_id, src)
+                self._acked.append(doc_id)
+                self.report.acked_writes += 1
+            except Exception as e:
+                v = classify(e, disrupted=True)
+                if v:
+                    self.report.invariant_violations.append(f"write: {v}")
+        for body in w.text_queries(4):
+            try:
+                client.search("docs", body)
+            except Exception as e:
+                v = classify(e, disrupted=True)
+                if v:
+                    self.report.invariant_violations.append(f"search: {v}")
+        for doc_id in w.victim_ids(2):
+            try:
+                client.get_doc("docs", doc_id)
+            except Exception as e:
+                v = classify(e, disrupted=True)
+                if v:
+                    self.report.invariant_violations.append(f"get: {v}")
+        self.cluster.detect_once()
+
+    def _cluster_parity_sweep(self) -> None:
+        """Post-heal: host-reduce vs the per-shard transport merge on
+        the SAME queries (the cluster's lane pair), toggled live via the
+        cluster setting."""
+        client = self._client()
+        client.refresh("docs")
+        bodies = self.cluster_work.text_queries(4)
+        bodies.append({"size": 5, "knn": {
+            "field": "vec", "query_vector": self.cluster_work.vector(),
+            "k": 5}})
+        for body in bodies:
+            try:
+                got = client.search("docs", copy.deepcopy(body))
+                self._set_cluster_setting(
+                    "cluster.search.host_reduce.enable", False)
+                want = client.search("docs", copy.deepcopy(body))
+                self.oracle.compare("host-reduce-vs-fanout", body, want, got)
+            finally:
+                self._set_cluster_setting(
+                    "cluster.search.host_reduce.enable", True)
+
+    def _set_cluster_setting(self, key: str, val) -> None:
+        master = self.cluster.master_node()
+
+        def task(cur):
+            st = cur.mutate()
+            st.data.setdefault("settings", {})[key] = val
+            return st
+        master.cluster.submit_task(f"chaos-setting[{key}]", task)
+
+    def _acked_write_check(self) -> None:
+        """Every write acked on the quorum side must be retrievable
+        after the partition heals (the split-brain acked-write
+        invariant)."""
+        client = self._client()
+        sample = self._acked if len(self._acked) <= 20 \
+            else self.rng.sample(self._acked, 20)
+        for doc_id in sample:
+            try:
+                got = client.get_doc("docs", doc_id)
+                found = bool(got.get("found"))
+            except Exception as e:
+                self.report.invariant_violations.append(
+                    f"acked write [{doc_id}] unreadable after heal: {e!r}")
+                continue
+            if not found:
+                self.report.invariant_violations.append(
+                    f"acked write [{doc_id}] lost after heal")
+
+    def _cluster_faults(self) -> int:
+        fs = getattr(self.cluster.network, "fault_stats", None)
+        return fs()["faults_injected_total"] if fs else 0
+
+    # -- teardown invariants ------------------------------------------------
+
+    def _final_invariants(self) -> None:
+        if self.cluster is not None:
+            hedged = sum(n.hedge_stats.get("fired", 0)
+                         for n in self.cluster.nodes.values())
+            self.report.hedges_fired = hedged
+
+    def _teardown(self) -> None:
+        viol = self.report.invariant_violations
+        if self.cluster is not None:
+            for n in self.cluster.nodes.values():
+                try:
+                    if not n.closed:
+                        n.close()
+                except SearcherLeakError as e:
+                    viol.append(str(e))
+            if hasattr(self.cluster.network, "close"):
+                self.cluster.network.close()
+            self.cluster = None
+        if self.node is not None:
+            caches, breakers = self.node.caches, self.node.breakers
+            try:
+                self.node.close()
+            except SearcherLeakError as e:
+                viol.append(str(e))
+            # after close every cache owner is gone: residue in any tier
+            # (or any non-drained breaker) is a real leak
+            viol.extend(detectors.cache_problems(caches))
+            viol.extend(detectors.breaker_problems(breakers))
+            self.node = None

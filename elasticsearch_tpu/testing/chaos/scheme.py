@@ -1,0 +1,158 @@
+"""Seeded disruption scheme — the MockTransportService /
+ServiceDisruptionScheme analog. Picks disruptions from one
+`random.Random` and applies them through the transport fault seams both
+transports share (`partition` / `disconnect` / `add_rule` /
+`add_delay`), plus node kills through the harness.
+
+A disruption object is `start()`-ed for a round and `stop()`-ed before
+heal; `DisruptionScheme.heal()` clears every rule/link and drives fault
+detection until the cluster converges, so rounds compose without
+leaking faults into each other.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ...cluster.node import A_GET, A_QUERY, A_WRITE_R_BULK
+
+# action classes a drop rule may target: data traffic only — never the
+# discovery/ping plane, which the partition disruption owns (dropping
+# pings without a real partition would just flap fault detection)
+DROPPABLE_PREFIXES = [
+    A_WRITE_R_BULK,                       # replica bulk only
+    "indices:data/read/search",           # the whole search family
+    A_GET,                                # realtime gets
+]
+
+
+class Disruption:
+    kind = "?"
+
+    def start(self, cluster) -> None:
+        raise NotImplementedError
+
+    def stop(self, cluster) -> None:
+        """Best-effort targeted teardown; DisruptionScheme.heal() is the
+        backstop that clears everything regardless."""
+
+    def describe(self) -> str:
+        return self.kind
+
+
+class IsolateNode(Disruption):
+    """Two-way partition of one non-master node from the rest (the
+    NetworkPartition minority side). The quorum side keeps a master and
+    keeps acking writes; the isolated side must step down rather than
+    ack writes it can no longer replicate."""
+
+    kind = "isolate_node"
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def start(self, cluster) -> None:
+        others = [nid for nid in cluster.nodes
+                  if nid != self.node_id and not cluster.nodes[nid].closed]
+        cluster.network.partition([self.node_id], others)
+
+    def stop(self, cluster) -> None:
+        cluster.network.heal()
+
+    def describe(self) -> str:
+        return f"isolate_node[{self.node_id}]"
+
+
+class DropAction(Disruption):
+    """Action-prefix-scoped drop rule: kills one traffic class into one
+    node while everything else (fault-detection pings included) keeps
+    flowing — the failure mode a full partition can't produce."""
+
+    kind = "drop_action"
+
+    def __init__(self, node_id: str, prefix: str):
+        self.node_id = node_id
+        self.prefix = prefix
+
+    def start(self, cluster) -> None:
+        cluster.network.add_rule(self.node_id, self.prefix)
+
+    def stop(self, cluster) -> None:
+        cluster.network.clear_rule(self.node_id, self.prefix)
+
+    def describe(self) -> str:
+        return f"drop_action[{self.node_id}, {self.prefix}]"
+
+
+class SlowNode(Disruption):
+    """Inject per-send latency on the query action into one node — the
+    seam the hedged-read coordinator is built to cover."""
+
+    kind = "slow_node"
+
+    def __init__(self, node_id: str, delay_s: float):
+        self.node_id = node_id
+        self.delay_s = delay_s
+
+    def start(self, cluster) -> None:
+        cluster.network.add_delay(self.node_id, A_QUERY, self.delay_s)
+
+    def stop(self, cluster) -> None:
+        cluster.network.clear_delay(self.node_id, A_QUERY)
+
+    def describe(self) -> str:
+        return f"slow_node[{self.node_id}, {self.delay_s}s]"
+
+
+class DisruptionScheme:
+    def __init__(self, cluster, rng: random.Random):
+        self.cluster = cluster
+        self.rng = rng
+        self.active: list[Disruption] = []
+        self.applied: list[str] = []      # full history, for the report
+
+    def _non_master_ids(self) -> list[str]:
+        master = self.cluster.master_node()
+        mid = master.node_id if master is not None else None
+        return sorted(nid for nid, n in self.cluster.nodes.items()
+                      if not n.closed and nid != mid)
+
+    def pick(self, max_n: int = 2) -> list[Disruption]:
+        """Choose 1..max_n disruptions for a round. At most one
+        link-level disruption (isolation) per round so a quorum always
+        remains to ack writes."""
+        victims = self._non_master_ids()
+        if not victims:
+            return []
+        out: list[Disruption] = []
+        kinds = ["isolate", "drop", "slow"]
+        self.rng.shuffle(kinds)
+        for kind in kinds[:self.rng.randint(1, max_n)]:
+            victim = self.rng.choice(victims)
+            if kind == "isolate":
+                out.append(IsolateNode(victim))
+            elif kind == "drop":
+                out.append(DropAction(
+                    victim, self.rng.choice(DROPPABLE_PREFIXES)))
+            else:
+                out.append(SlowNode(victim,
+                                    round(self.rng.uniform(0.05, 0.2), 3)))
+        return out
+
+    def start_round(self, max_n: int = 2) -> list[str]:
+        assert not self.active, "previous round not healed"
+        self.active = self.pick(max_n)
+        for d in self.active:
+            d.start(self.cluster)
+            self.applied.append(d.describe())
+        return [d.describe() for d in self.active]
+
+    def heal(self, timeout: float = 20.0) -> None:
+        for d in self.active:
+            d.stop(self.cluster)
+        self.active = []
+        self.cluster.network.heal()
+        # converge: fault detection notices rejoins/step-downs, the
+        # allocator re-assigns, replicas re-sync
+        self.cluster.detect_once()
+        self.cluster.ensure_yellow_or_green(timeout)

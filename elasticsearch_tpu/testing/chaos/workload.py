@@ -1,0 +1,119 @@
+"""Seeded workload generator: reproducible docs and query bodies
+spanning every execution-ladder rung (text scoring, filters, paging,
+aggs, kNN across metrics, quantized kNN, msearch batches).
+
+Everything derives from ONE `random.Random` owned by the caller — the
+same seed replays the same docs in the same order and the same query
+sample, which is what makes a chaos failure reproducible from a single
+integer.
+"""
+
+from __future__ import annotations
+
+import random
+
+WORDS = ["quick", "brown", "fox", "jumps", "lazy", "dog", "sleeps",
+         "swift", "river", "stone", "amber", "cloud"]
+
+TAGS = ["t0", "t1", "t2"]
+
+
+class SeededWorkload:
+    __test__ = False        # not a pytest class
+
+    def __init__(self, rng: random.Random, dims: int = 8):
+        self.rng = rng
+        self.dims = dims
+        self._doc_seq = 0
+
+    def mapping(self) -> dict:
+        return {"properties": {
+            "body": {"type": "string"},
+            "tag": {"type": "string", "index": "not_analyzed"},
+            "n": {"type": "long"},
+            "price": {"type": "double"},
+            "vec": {"type": "dense_vector", "dims": self.dims}}}
+
+    # -- documents ----------------------------------------------------------
+
+    def vector(self) -> list[float]:
+        return [round(self.rng.gauss(0.0, 1.0), 6) for _ in range(self.dims)]
+
+    def next_docs(self, count: int) -> list[tuple[str, dict]]:
+        """The next `count` (doc_id, source) pairs. Ids are sequential so
+        later rounds can deterministically target earlier docs for
+        deletes/updates."""
+        out = []
+        for _ in range(count):
+            i = self._doc_seq
+            self._doc_seq += 1
+            body = " ".join(self.rng.choice(WORDS)
+                            for _ in range(self.rng.randint(3, 7)))
+            out.append((str(i), {
+                "body": body,
+                "tag": self.rng.choice(TAGS),
+                "n": i,
+                "price": round(self.rng.uniform(0.5, 99.5), 2),
+                "vec": self.vector()}))
+        return out
+
+    def victim_ids(self, count: int) -> list[str]:
+        """Previously written doc ids to delete (deterministic sample)."""
+        if self._doc_seq == 0 or count <= 0:
+            return []
+        pool = [str(i) for i in range(self._doc_seq)]
+        return self.rng.sample(pool, min(count, len(pool)))
+
+    # -- queries ------------------------------------------------------------
+
+    def text_queries(self, count: int) -> list[dict]:
+        """Bodies exercising the dense scoring ladder: match / bool /
+        filters / term / range / paging / aggs — all shapes every lane
+        (loop, stacked, blockwise, mesh) serves."""
+        out = []
+        for _ in range(count):
+            kind = self.rng.randrange(6)
+            w1, w2 = self.rng.choice(WORDS), self.rng.choice(WORDS)
+            size = self.rng.choice([5, 10, 20])
+            if kind == 0:
+                body = {"size": size, "query": {"match": {"body": w1}}}
+            elif kind == 1:
+                body = {"size": size, "query": {"bool": {
+                    "should": [{"match": {"body": w1}},
+                               {"match": {"body": w2}}]}}}
+            elif kind == 2:
+                lo = self.rng.randrange(0, 100)
+                body = {"size": size, "query": {"bool": {
+                    "should": [{"match": {"body": w1}}],
+                    "filter": [{"range": {"n": {"gte": lo,
+                                                "lt": lo + 120}}}]}}}
+            elif kind == 3:
+                body = {"size": size, "query": {"bool": {
+                    "must": [{"term": {"tag": self.rng.choice(TAGS)}}],
+                    "must_not": [{"term": {"n": self.rng.randrange(50)}}]}}}
+            elif kind == 4:
+                body = {"size": size, "from": self.rng.choice([0, 3, 7]),
+                        "query": {"match": {"body": f"{w1} {w2}"}}}
+            else:
+                body = {"size": 5, "query": {"match": {"body": w1}},
+                        "aggs": {"tags": {"terms": {"field": "tag"}},
+                                 "st": {"stats": {"field": "n"}}}}
+            out.append(body)
+        return out
+
+    def knn_queries(self, count: int) -> list[dict]:
+        """kNN bodies cycling the metric roster; `k` stays small so the
+        tiny chaos corpus keeps every candidate window meaningful."""
+        out = []
+        metrics = ["cosine", "dot", "l2"]
+        for j in range(count):
+            out.append({"size": 5, "knn": {
+                "field": "vec", "query_vector": self.vector(),
+                "k": self.rng.choice([5, 10]),
+                "metric": metrics[j % len(metrics)]}})
+        return out
+
+    def filtered_knn_query(self) -> dict:
+        return {"size": 5, "knn": {
+            "field": "vec", "query_vector": self.vector(), "k": 10,
+            "filter": {"term": {"tag": self.rng.choice(TAGS)}}}}

@@ -20,6 +20,22 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Arm the chaos leak detectors for the WHOLE suite: every Engine.close()
+# under pytest asserts searcher refcounts drained, the per-site breaker
+# ledger balanced, and no fielddata entries outliving the engine — a leak
+# anywhere fails the leaking test by name instead of silently inflating
+# the parent breaker for the tests behind it.
+from elasticsearch_tpu.testing.chaos import detectors as _chaos_detectors  # noqa: E402
+
+_chaos_detectors.arm()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: seeded randomized disruption rounds "
+        "(CHAOS_SEED / CHAOS_ROUNDS env knobs)")
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 run")
+
 
 @pytest.fixture(scope="session")
 def devices():

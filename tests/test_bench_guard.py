@@ -79,7 +79,10 @@ def test_tail_latency_keys_survive_forced_timeout():
     for key in ("conc_p99_ms", "shed_429s", "hedged_wins",
                 # quantized ANN tier (ISSUE 12): same seeded-null contract
                 "knn_int8_qps", "knn_pq_qps", "pq_recall_at_10",
-                "vector_stack_bytes_f32", "vector_stack_bytes_quantized"):
+                "vector_stack_bytes_f32", "vector_stack_bytes_quantized",
+                # chaos harness (ISSUE 14): same seeded-null contract
+                "chaos_rounds", "chaos_parity_checks",
+                "chaos_invariant_violations"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

@@ -81,7 +81,12 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # chaos harness (ISSUE 14): seeded null at import so
                      # a forced timeout still emits them
                      "chaos_rounds": None, "chaos_parity_checks": None,
-                     "chaos_invariant_violations": None}
+                     "chaos_invariant_violations": None,
+                     # rebalance-under-load (ISSUE 15): seeded null at
+                     # import so a forced timeout still emits them
+                     "rebalance_p99_ms": None, "rebalance_move_s": None,
+                     "recovery_throttle_bytes_per_sec": None,
+                     "decider_vetoes": None}
 _LINE_PRINTED = False
 
 
@@ -1132,6 +1137,138 @@ def run_chaos_leg(tag: str) -> dict:
                 len(report.invariant_violations)}
 
 
+def run_rebalance_leg(tag: str) -> dict:
+    """Multi-tenant elasticity (ISSUE 15): drain one node of a live
+    3-node cluster via an `exclude._id` filter update WHILE 32 client
+    threads keep querying it — the relocations stream through the
+    `indices.recovery.max_bytes_per_sec` token bucket and hedged reads
+    cover the moving copies. Reports the under-move p50/p99 (the SLO
+    pair: p99 must hold <= 5x p50), the drain wall time, the measured
+    recovery byte rate vs the configured throttle, and the decider veto
+    count the drain produced."""
+    import shutil
+    import tempfile
+    import threading
+    from elasticsearch_tpu.cluster import TestCluster
+    from elasticsearch_tpu.cluster.recovery import parse_bytes
+    from elasticsearch_tpu.cluster.recovery import snapshot as rec_snapshot
+    from elasticsearch_tpu.cluster.state import (INITIALIZING, RELOCATING,
+                                                 UNASSIGNED)
+
+    n_docs = int(os.environ.get("BENCH_REBAL_DOCS", "12000"))
+    n_shards = int(os.environ.get("BENCH_REBAL_SHARDS", "4"))
+    rate = os.environ.get("BENCH_REBAL_RATE", "4mb")
+    conc = int(os.environ.get("BENCH_CONC_CLIENTS",
+                              os.environ.get("BENCH_CONC", "32")))
+    tmp = tempfile.mkdtemp(prefix=f"bench-rebal-{tag}-")
+    cluster = TestCluster(3, tmp)
+    try:
+        client = cluster.client()
+        client.create_index("rdocs", {"number_of_shards": n_shards,
+                                      "number_of_replicas": 1})
+        cluster.ensure_green()
+        ops = []
+        for i, body in enumerate(make_corpus(n_docs, seed=17)):
+            ops.append(("index", {"_index": "rdocs", "_id": str(i)},
+                        {"body": body}))
+            if len(ops) >= 4000:
+                client.bulk(ops)
+                ops = []
+            if _over_budget(margin=60.0):
+                return {}        # indexing ate the slice: absent keys
+        if ops:
+            client.bulk(ops)
+        client.refresh("rdocs")
+        client.update_cluster_settings(
+            {"indices.recovery.max_bytes_per_sec": rate})
+        queries = make_queries(32, seed=19)
+
+        def body_of(i: int) -> dict:
+            return {"size": 10, "query": {
+                "match": {"body": queries[i % len(queries)]}}}
+
+        for i in range(16):        # warm the shape buckets
+            client.search("rdocs", body_of(i))
+        lats: list[float] = []
+        errors = [0]
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def qos_client(ci: int) -> None:
+            qi = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    client.search("rdocs", body_of(ci * 7 + qi))
+                except Exception:  # noqa: BLE001 — shed/transient under move
+                    with lock:
+                        errors[0] += 1
+                    continue
+                dt = (time.perf_counter() - t0) * 1000
+                with lock:
+                    lats.append(dt)
+                qi += 1
+
+        threads = [threading.Thread(target=qos_client, args=(ci,))
+                   for ci in range(conc)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)            # steady-state before the move starts
+        victim = sorted(cluster.nodes)[-1]
+        r0 = dict(rec_snapshot())
+        v0 = sum(n.deciders.veto_total() for n in cluster.nodes.values())
+        with lock:
+            lats.clear()           # measure latency UNDER the move only
+        t_move = time.perf_counter()
+        client.update_cluster_settings(
+            {"cluster.routing.allocation.exclude._id": victim})
+        deadline = time.monotonic() + max(min(_remaining() - 60.0, 120.0),
+                                          5.0)
+        moved = False
+        while time.monotonic() < deadline:
+            st = cluster.master_node().cluster.current()
+            copies = [c for cs in st.routing.get("rdocs", []) for c in cs]
+            busy = any(c["state"] in (RELOCATING, INITIALIZING)
+                       or c.get("relocation") for c in copies)
+            holds = any(c["node"] == victim and c["state"] != UNASSIGNED
+                        for c in copies)
+            if not busy and not holds:
+                moved = True
+                break
+            time.sleep(0.05)
+        move_s = time.perf_counter() - t_move
+        stop.set()
+        for t in threads:
+            t.join()
+        r1 = dict(rec_snapshot())
+        lats.sort()
+        rec_bytes = r1["bytes_total"] - r0["bytes_total"]
+        out = {
+            "rebalance_moved": moved,
+            "rebalance_move_s": move_s,
+            "rebalance_p50_ms": lats[len(lats) // 2] if lats else None,
+            "rebalance_p99_ms": lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))]
+            if lats else None,
+            "rebalance_queries": len(lats),
+            "rebalance_errors": errors[0],
+            "rebalance_recovered_bytes": rec_bytes,
+            "recovery_throttle_bytes_per_sec":
+                rec_bytes / max(move_s, 1e-9),
+            "recovery_throttle_limit_bytes_per_sec": parse_bytes(rate),
+            "recovery_throttle_waits":
+                r1["throttle_waits_total"] - r0["throttle_waits_total"],
+            "decider_vetoes":
+                sum(n.deciders.veto_total()
+                    for n in cluster.nodes.values()) - v0,
+            "hedged_moving": sum(n.hedge_stats.get("moving", 0)
+                                 for n in cluster.nodes.values())}
+        return {k: v for k, v in out.items() if v is not None}
+    finally:
+        cluster.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_all_legs(tag: str) -> dict:
     _arm_leg_alarm(reserve=120.0)
     res = run_engine_leg(tag)
@@ -1162,6 +1299,11 @@ def _run_all_legs(tag: str) -> dict:
             # perf ratio — measured once, in the main process
             ("BENCH_CHAOS", "1" if tag == "main" else "0",
              run_chaos_leg),
+            # rebalance-under-load SLO (ISSUE 15): wall-clock + SLO
+            # ratio, not a device-perf ratio — measured once, in the
+            # main process
+            ("BENCH_REBAL", "1" if tag == "main" else "0",
+             run_rebalance_leg),
             # 4M-doc aggs + 1M-doc vectors: opt-in —
             # the scale tier only fits a long budget
             ("BENCH_SCALE", "0", run_scale_leg)]
@@ -1330,6 +1472,25 @@ def main_engine():
             "chaos_mismatches": res.get("chaos_mismatches"),
             "chaos_invariant_violations":
                 res.get("chaos_invariant_violations")})
+    if "rebalance_move_s" in res:
+        # rebalance-under-load (ISSUE 15): the SLO pair under a live
+        # shard move + the throttle-compliance evidence
+        line.update({
+            "rebalance_moved": res.get("rebalance_moved"),
+            "rebalance_move_s": r2(res.get("rebalance_move_s")),
+            "rebalance_p50_ms": r2(res.get("rebalance_p50_ms")),
+            "rebalance_p99_ms": r2(res.get("rebalance_p99_ms")),
+            "rebalance_queries": res.get("rebalance_queries"),
+            "rebalance_errors": res.get("rebalance_errors"),
+            "rebalance_recovered_bytes": res.get(
+                "rebalance_recovered_bytes"),
+            "recovery_throttle_bytes_per_sec": r2(res.get(
+                "recovery_throttle_bytes_per_sec")),
+            "recovery_throttle_limit_bytes_per_sec": res.get(
+                "recovery_throttle_limit_bytes_per_sec"),
+            "recovery_throttle_waits": res.get("recovery_throttle_waits"),
+            "decider_vetoes": res.get("decider_vetoes"),
+            "hedged_moving": res.get("hedged_moving")})
     if "scale_peak_rss_bytes" in res:
         # BENCH_SCALE leg (ISSUE 8): the 10M-doc-tier shapes, served by
         # the blockwise lane; peak RSS + peak score-matrix residency show

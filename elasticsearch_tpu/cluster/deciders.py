@@ -1,0 +1,391 @@
+"""Composable allocation decider chain (ISSUE 15).
+
+The analog of the reference's decider roster under
+cluster/routing/allocation/decider/ (18 deciders chained by
+AllocationDeciders.java — the first NO wins, THROTTLE defers): each
+decider answers "may this shard copy go on / stay on this node?" with a
+verdict AND an explanation, so `/_cluster/allocation/explain` can show
+per-decider reasoning instead of a bare boolean.
+
+Deciders here are STATELESS over the cluster state they are handed —
+every setting is read live from cluster-level
+(`state.data["settings"]`) or index-level metadata, so a settings
+update changes behavior on the next allocation round with no plumbing.
+The chain keeps one mutable thing: a per-decider veto counter feeding
+`es_allocation_decider_vetoes_total{decider=}`.
+
+Roster (reference analog in parens):
+  * same_shard      — never two copies of a shard on one node
+                      (SameShardAllocationDecider; also enforced
+                      structurally by the allocator's holder set)
+  * awareness       — spread copies across node attribute values, e.g.
+                      zones (AwarenessAllocationDecider)
+  * filter          — index.routing.allocation.include/exclude/require
+                      + the cluster.routing.allocation.* forms
+                      (FilterAllocationDecider)
+  * shards_limit    — index.routing.allocation.total_shards_per_node /
+                      cluster.routing.allocation.total_shards_per_node
+                      (ShardsLimitAllocationDecider)
+  * throttling      — cluster.routing.allocation.node_concurrent_recoveries
+                      caps INITIALIZING copies per node
+                      (ThrottlingAllocationDecider — THROTTLE, not NO)
+  * disk            — the low/high watermark gate, wrapping
+                      cluster/info.DiskThresholdDecider
+                      (DiskThresholdDecider.java)
+"""
+
+from __future__ import annotations
+
+from .state import INITIALIZING, UNASSIGNED
+
+YES = "YES"
+THROTTLE = "THROTTLE"
+NO = "NO"
+
+
+class Decision:
+    """One decider's verdict. Truthy only when YES — a THROTTLE defers
+    the allocation to a later round without counting as a veto."""
+
+    __slots__ = ("verdict", "decider", "explanation")
+
+    def __init__(self, verdict: str, decider: str, explanation: str):
+        self.verdict = verdict
+        self.decider = decider
+        self.explanation = explanation
+
+    def __bool__(self) -> bool:
+        return self.verdict == YES
+
+    def __repr__(self) -> str:
+        return f"Decision({self.verdict}, {self.decider}: {self.explanation})"
+
+    def as_dict(self) -> dict:
+        return {"decider": self.decider, "decision": self.verdict,
+                "explanation": self.explanation}
+
+
+def cluster_setting(state, key: str, default=None):
+    """Cluster-level dynamic setting (state.data['settings'] — the same
+    live-read seam the hedge settings use)."""
+    return (state.data.get("settings") or {}).get(key, default)
+
+
+def index_setting(state, index: str, key: str, default=None):
+    """Index-level setting; the prefixed `index.*` key wins over the
+    bare creation-time form (repo-wide convention)."""
+    meta = state.indices.get(index) or {}
+    s = meta.get("settings") or {}
+    return s.get(f"index.{key}", s.get(key, default))
+
+
+def node_attr(state, node_id: str, key: str) -> str | None:
+    """A node's filterable attribute: `_id`/`_name` are built in, the
+    rest come from the attributes the node declared at join time."""
+    n = state.nodes.get(node_id) or {}
+    if key == "_id":
+        return n.get("id", node_id)
+    if key == "_name":
+        return n.get("name", node_id)
+    v = (n.get("attributes") or {}).get(key)
+    return None if v is None else str(v)
+
+
+def _csv(v) -> list[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [p.strip() for p in str(v).split(",") if p.strip()]
+
+
+class AllocationDecider:
+    """Base: everything is allowed. `can_allocate` gates new placements
+    (and relocation targets); `can_remain` gates whether a STARTED copy
+    may stay put — a NO there makes rebalance move it off."""
+
+    name = "base"
+
+    def can_allocate(self, state, index: str, sid: int,
+                     node_id: str) -> Decision:
+        return Decision(YES, self.name, "allowed")
+
+    def can_remain(self, state, index: str, sid: int,
+                   node_id: str) -> Decision:
+        return Decision(YES, self.name, "allowed")
+
+
+class SameShardDecider(AllocationDecider):
+    """Never two copies of one shard on one node (the invariant the
+    allocator also enforces structurally; stated here so explain output
+    shows WHY a holder node is not a candidate)."""
+
+    name = "same_shard"
+
+    def can_allocate(self, state, index, sid, node_id):
+        for c in state.routing[index][sid]:
+            if c["node"] == node_id and c["state"] != UNASSIGNED:
+                return Decision(NO, self.name,
+                                f"node [{node_id}] already holds a copy "
+                                f"of [{index}][{sid}]")
+        return Decision(YES, self.name, "no copy of this shard on node")
+
+
+class AwarenessDecider(AllocationDecider):
+    """Spread a shard's copies across the values of the awareness
+    attributes (`cluster.routing.allocation.awareness.attributes`,
+    e.g. "zone"): no attribute value may hold more than its balanced
+    share ceil(copies / distinct values) of the shard's copies."""
+
+    name = "awareness"
+
+    def can_allocate(self, state, index, sid, node_id):
+        attrs = _csv(cluster_setting(
+            state, "cluster.routing.allocation.awareness.attributes"))
+        if not attrs:
+            return Decision(YES, self.name, "no awareness attributes set")
+        copies = state.routing[index][sid]
+        for attr in attrs:
+            my_val = node_attr(state, node_id, attr)
+            if my_val is None:
+                continue        # unlabeled nodes are exempt (ref forced
+                                # awareness is opt-in; we mirror that)
+            values = {node_attr(state, n, attr) for n in state.nodes}
+            values.discard(None)
+            if len(values) <= 1:
+                continue        # one zone: nothing to spread across
+            per_val: dict[str, int] = {}
+            for c in copies:
+                if c["node"] is None or c["state"] == UNASSIGNED:
+                    continue
+                v = node_attr(state, c["node"], attr)
+                if v is not None:
+                    per_val[v] = per_val.get(v, 0) + 1
+            total = sum(per_val.values()) + 1     # + the copy being placed
+            ceiling = -(-total // len(values))    # ceil
+            if per_val.get(my_val, 0) + 1 > ceiling:
+                return Decision(
+                    NO, self.name,
+                    f"too many copies in [{attr}={my_val}] "
+                    f"({per_val.get(my_val, 0) + 1} > balanced {ceiling})")
+        return Decision(YES, self.name, "copies balanced across zones")
+
+
+class FilterDecider(AllocationDecider):
+    """index.routing.allocation.{include,exclude,require}.<attr> plus
+    the cluster.routing.allocation.* forms (FilterAllocationDecider):
+    require = every rule must match; include = at least one listed
+    value matches (when any include rule exists); exclude = no listed
+    value may match. A STARTED copy violating a filter cannot REMAIN —
+    that is what makes `exclude._id: node-1` drain a node."""
+
+    name = "filter"
+
+    _KINDS = ("require", "include", "exclude")
+
+    def _rules(self, state, index) -> dict[str, dict[str, list[str]]]:
+        out: dict[str, dict[str, list[str]]] = {k: {} for k in self._KINDS}
+        cs = state.data.get("settings") or {}
+        for key, v in cs.items():
+            for kind in self._KINDS:
+                pfx = f"cluster.routing.allocation.{kind}."
+                if key.startswith(pfx):
+                    out[kind][key[len(pfx):]] = _csv(v)
+        meta = state.indices.get(index) or {}
+        for key, v in (meta.get("settings") or {}).items():
+            bare = key[6:] if key.startswith("index.") else key
+            for kind in self._KINDS:
+                pfx = f"routing.allocation.{kind}."
+                if bare.startswith(pfx):
+                    out[kind][bare[len(pfx):]] = _csv(v)
+        return out
+
+    def _check(self, state, index, node_id) -> Decision:
+        rules = self._rules(state, index)
+        for attr, vals in rules["require"].items():
+            got = node_attr(state, node_id, attr)
+            if got not in vals:
+                return Decision(
+                    NO, self.name,
+                    f"node [{attr}={got}] does not match required "
+                    f"{vals}")
+        if rules["include"]:
+            hit = any(node_attr(state, node_id, attr) in vals
+                      for attr, vals in rules["include"].items())
+            if not hit:
+                return Decision(
+                    NO, self.name,
+                    f"node matches no include rule "
+                    f"{dict(rules['include'])}")
+        for attr, vals in rules["exclude"].items():
+            got = node_attr(state, node_id, attr)
+            if got in vals:
+                return Decision(
+                    NO, self.name,
+                    f"node [{attr}={got}] is excluded by {vals}")
+        return Decision(YES, self.name, "node passes allocation filters")
+
+    def can_allocate(self, state, index, sid, node_id):
+        return self._check(state, index, node_id)
+
+    def can_remain(self, state, index, sid, node_id):
+        return self._check(state, index, node_id)
+
+
+class ShardsLimitDecider(AllocationDecider):
+    """Per-node shard-count ceilings:
+    index.routing.allocation.total_shards_per_node counts THIS index's
+    copies on the node; cluster.routing.allocation.total_shards_per_node
+    counts all copies. Unset / <= 0 means unlimited."""
+
+    name = "shards_limit"
+
+    @staticmethod
+    def _limit(v) -> int:
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 0
+
+    def can_allocate(self, state, index, sid, node_id):
+        idx_limit = self._limit(index_setting(
+            state, index, "routing.allocation.total_shards_per_node"))
+        clu_limit = self._limit(cluster_setting(
+            state, "cluster.routing.allocation.total_shards_per_node"))
+        if idx_limit <= 0 and clu_limit <= 0:
+            return Decision(YES, self.name, "no shard-count limit set")
+        on_node = on_node_index = 0
+        for iname, shards in state.routing.items():
+            for copies in shards:
+                for c in copies:
+                    if c["node"] == node_id and c["state"] != UNASSIGNED:
+                        on_node += 1
+                        if iname == index:
+                            on_node_index += 1
+        if idx_limit > 0 and on_node_index >= idx_limit:
+            return Decision(
+                NO, self.name,
+                f"node holds {on_node_index} copies of [{index}] "
+                f">= index limit {idx_limit}")
+        if clu_limit > 0 and on_node >= clu_limit:
+            return Decision(
+                NO, self.name,
+                f"node holds {on_node} copies >= cluster limit "
+                f"{clu_limit}")
+        return Decision(YES, self.name, "below shard-count limits")
+
+
+class ConcurrentRecoveriesDecider(AllocationDecider):
+    """cluster.routing.allocation.node_concurrent_recoveries (default 2)
+    caps how many copies may be INITIALIZING on one node at once — a
+    node drinking N recovery streams has no bandwidth for an N+1th.
+    Verdict is THROTTLE, not NO: the placement retries next round."""
+
+    name = "throttling"
+
+    DEFAULT = 2
+
+    def can_allocate(self, state, index, sid, node_id):
+        try:
+            limit = int(cluster_setting(
+                state, "cluster.routing.allocation."
+                "node_concurrent_recoveries", self.DEFAULT))
+        except (TypeError, ValueError):
+            limit = self.DEFAULT
+        if limit <= 0:
+            return Decision(YES, self.name, "recovery throttling disabled")
+        active = sum(1 for _i, _s, c in state.assigned_shards(node_id)
+                     if c["state"] == INITIALIZING)
+        if active >= limit:
+            return Decision(
+                THROTTLE, self.name,
+                f"node already running {active} recoveries "
+                f">= node_concurrent_recoveries {limit}")
+        return Decision(YES, self.name,
+                        f"{active} of {limit} recovery slots in use")
+
+
+class DiskDecider(AllocationDecider):
+    """The watermark gate, wrapping cluster/info.DiskThresholdDecider:
+    over the LOW watermark a node receives nothing new; over the HIGH
+    watermark its copies cannot remain (rebalance drains it)."""
+
+    name = "disk"
+
+    def __init__(self, disk):
+        self.disk = disk          # cluster/info.DiskThresholdDecider
+
+    def can_allocate(self, state, index, sid, node_id):
+        if self.disk.can_allocate(node_id):
+            return Decision(YES, self.name, "below the low watermark")
+        u = self.disk.info.usages.get(node_id)
+        pct = f"{u.used_percent:.1f}%" if u is not None else "?"
+        return Decision(NO, self.name,
+                        f"disk {pct} used >= low watermark "
+                        f"{self.disk.low_pct}%")
+
+    def can_remain(self, state, index, sid, node_id):
+        if not self.disk.should_evacuate(node_id):
+            return Decision(YES, self.name, "below the high watermark")
+        u = self.disk.info.usages.get(node_id)
+        pct = f"{u.used_percent:.1f}%" if u is not None else "?"
+        return Decision(NO, self.name,
+                        f"disk {pct} used >= high watermark "
+                        f"{self.disk.high_pct}% — evacuate")
+
+
+class DeciderChain:
+    """The composed roster. `can_allocate_shard` / `can_remain_shard`
+    short-circuit on the first NO (counted into `vetoes`); a THROTTLE
+    survives unless a later decider says NO. `explain` runs EVERY
+    decider with no short-circuit and no veto accounting — it is the
+    read-only path behind /_cluster/allocation/explain."""
+
+    def __init__(self, deciders: list[AllocationDecider]):
+        self.deciders = list(deciders)
+        self.vetoes: dict[str, int] = {d.name: 0 for d in self.deciders}
+
+    @staticmethod
+    def default(disk=None) -> "DeciderChain":
+        roster: list[AllocationDecider] = [
+            SameShardDecider(), AwarenessDecider(), FilterDecider(),
+            ShardsLimitDecider(), ConcurrentRecoveriesDecider()]
+        if disk is not None:
+            roster.append(DiskDecider(disk))
+        return DeciderChain(roster)
+
+    def can_allocate_shard(self, state, index: str, sid: int,
+                           node_id: str) -> Decision:
+        worst = Decision(YES, "chain", "all deciders allow")
+        for d in self.deciders:
+            dec = d.can_allocate(state, index, sid, node_id)
+            if dec.verdict == NO:
+                self.vetoes[d.name] = self.vetoes.get(d.name, 0) + 1
+                return dec
+            if dec.verdict == THROTTLE:
+                worst = dec
+        return worst
+
+    def can_remain_shard(self, state, index: str, sid: int,
+                         node_id: str) -> Decision:
+        for d in self.deciders:
+            dec = d.can_remain(state, index, sid, node_id)
+            if dec.verdict == NO:
+                self.vetoes[d.name] = self.vetoes.get(d.name, 0) + 1
+                return dec
+        return Decision(YES, "chain", "all deciders allow")
+
+    def veto_total(self) -> int:
+        return sum(self.vetoes.values())
+
+    def explain(self, state, index: str, sid: int,
+                node_id: str) -> dict:
+        """Every decider's verdict for one (shard, node) pair — the
+        node_decisions entry of the explain API."""
+        decisions = [d.can_allocate(state, index, sid, node_id).as_dict()
+                     for d in self.deciders]
+        verdicts = {e["decision"] for e in decisions}
+        overall = NO if NO in verdicts else (
+            THROTTLE if THROTTLE in verdicts else YES)
+        return {"node_id": node_id, "decision": overall,
+                "deciders": decisions}

@@ -42,11 +42,12 @@ class TestCluster:
         for nid in ids[1:]:
             self.nodes[nid].join(ids[0])
 
-    def add_node(self) -> ClusterNode:
+    def add_node(self, attrs: dict | None = None) -> ClusterNode:
         self._seq += 1
         node_id = f"node-{self._seq}"
         node = ClusterNode(node_id, self.data_path, self.network,
-                           minimum_master_nodes=self.minimum_master_nodes)
+                           minimum_master_nodes=self.minimum_master_nodes,
+                           attrs=attrs)
         self.nodes[node_id] = node
         master = self.master_node()
         if master is not None and master.node_id != node_id:
@@ -81,6 +82,45 @@ class TestCluster:
         node.closed = True
         node.transport.close()
         node.cluster.close()
+
+    def restart_node(self, node_id: str) -> ClusterNode:
+        """Bring a killed node back as a fresh process on the same data path
+        and node id (ref InternalTestCluster.restartNode). The dead
+        instance's engines are closed first — kill_node() simulates abrupt
+        death and leaves them open, but a restart within one process must
+        release the old file handles and breaker charges before the new
+        instance re-opens the same directories."""
+        old = self.nodes[node_id]
+        if not old.closed:
+            self.kill_node(node_id)
+        # an in-flight recovery pull (the old applier thread) still owns
+        # the shard directory the new instance will reuse: cancel it and
+        # wait for a terminal stage before re-opening the same path
+        with old._shards_lock:
+            for holder in old._shards.values():
+                holder.cancel_recovery = True
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with old._recoveries_lock:
+                live = [r for r in old.recoveries.values()
+                        if r["stage"] not in ("done", "failed", "cancelled")]
+            if not live:
+                break
+            time.sleep(0.02)
+        with old._shards_lock:
+            for holder in old._shards.values():
+                if holder.engine is not None:
+                    holder.drop_searcher()
+                    holder.engine.close()
+                    holder.engine = None
+        node = ClusterNode(node_id, self.data_path, self.network,
+                           minimum_master_nodes=self.minimum_master_nodes,
+                           attrs=old.attrs)
+        self.nodes[node_id] = node
+        master = self.master_node()
+        if master is not None and master.node_id != node_id:
+            node.join(master.node_id)
+        return node
 
     def detect_once(self) -> None:
         """One explicit fault-detection round on every live node."""

@@ -176,6 +176,30 @@ class ClusterState:
 
 
 # -- allocation (ref AllocationService.reroute + BalancedShardsAllocator) ---
+#
+# `decider` accepts either form:
+#   * legacy single decider — can_allocate(node_id) / should_evacuate(
+#     node_id) (cluster/info.DiskThresholdDecider, kept for direct use);
+#   * a cluster/deciders.DeciderChain — can_allocate_shard(state, index,
+#     sid, node_id) / can_remain_shard(...), the composable roster with
+#     per-decider verdicts (ref AllocationDeciders.java).
+
+
+def _is_chain(decider) -> bool:
+    return hasattr(decider, "can_allocate_shard")
+
+
+def next_aid(state: ClusterState) -> int:
+    """Fresh allocation id (ref AllocationId.newInitializing): every
+    (re)assignment of a copy gets a unique id so a shard-started /
+    shard-failed report from a PREVIOUS assignment era — a restarted
+    process's stale pull, a late replication-failure notice — can never
+    act on the current assignment. The counter lives in the state itself
+    so it survives master handoff and stays strictly increasing."""
+    seq = state.data.get("aid_seq", 0) + 1
+    state.data["aid_seq"] = seq
+    return seq
+
 
 def allocate(state: ClusterState, decider=None) -> bool:
     """Assign UNASSIGNED copies to live nodes, balancing by shard count.
@@ -183,12 +207,10 @@ def allocate(state: ClusterState, decider=None) -> bool:
     Returns True if anything changed. Invariants: a node holds at most one
     copy of a given shard (SameShardAllocationDecider analog); an unassigned
     PRIMARY is only placed where it can recover (fresh index) — primaries of
-    lost shards stay unassigned (red) rather than silently reborn empty.
-    `decider`: optional object with can_allocate(node_id) — the disk
-    watermark gate (cluster/info.DiskThresholdDecider; ref
-    allocation/decider/DiskThresholdDecider.java)."""
+    lost shards stay unassigned (red) rather than silently reborn empty."""
+    chain = decider if _is_chain(decider) else None
     live = set(state.nodes)
-    if decider is not None:
+    if decider is not None and chain is None:
         live = {n for n in live if decider.can_allocate(n)}
     loads = {n: 0 for n in live}
     for index, shards in state.routing.items():
@@ -198,7 +220,7 @@ def allocate(state: ClusterState, decider=None) -> bool:
                     loads[c["node"]] += 1
     changed = False
     for index, shards in state.routing.items():
-        for copies in shards:
+        for sid, copies in enumerate(shards):
             holders = {c["node"] for c in copies
                        if c["node"] is not None and c["state"] != UNASSIGNED}
             has_started_primary = any(
@@ -216,11 +238,18 @@ def allocate(state: ClusterState, decider=None) -> bool:
                 candidates = sorted(
                     (n for n in live if n not in holders),
                     key=lambda n: (loads[n], n))
+                if chain is not None:
+                    # first candidate every decider allows; a THROTTLE
+                    # (falsy, not a veto) defers to a later round
+                    candidates = [
+                        n for n in candidates
+                        if chain.can_allocate_shard(state, index, sid, n)]
                 if not candidates:
                     continue
                 node = candidates[0]
                 c["node"] = node
                 c["state"] = INITIALIZING
+                c["aid"] = next_aid(state)
                 holders.add(node)
                 loads[node] += 1
                 changed = True
@@ -236,9 +265,13 @@ def rebalance(state: ClusterState, max_moves: int = 2,
     target reports started. Runs only on a stable table (no unassigned /
     non-relocation initializing copies) and caps moves per pass so a
     joining node fills up without a thundering herd.
-    `decider` (cluster/info.DiskThresholdDecider): nodes over the LOW
-    watermark receive no shards; nodes over the HIGH watermark count as
-    maximally loaded so their shards move off first."""
+    Legacy `decider` (cluster/info.DiskThresholdDecider): nodes over the
+    LOW watermark receive no shards; nodes over the HIGH watermark count
+    as maximally loaded so their shards move off first. A DeciderChain
+    instead drives a forced-move pass (can_remain_shard NO — filter
+    drains, disk high watermark) before the load-balance pass, with every
+    destination gated per-shard through can_allocate_shard."""
+    chain = decider if _is_chain(decider) else None
     live = set(state.nodes)
     if not live:
         return False
@@ -253,7 +286,80 @@ def rebalance(state: ClusterState, max_moves: int = 2,
                     return False      # one wave at a time
                 if c["node"] in loads:
                     loads[c["node"]] += 1
+
+    def start_move(index, sid, c, dst_node):
+        c["state"] = RELOCATING
+        c["relocating_to"] = dst_node
+        state.routing[index][sid].append({
+            "node": dst_node, "primary": False,
+            "state": INITIALIZING, "relocation": True,
+            "aid": next_aid(state),
+            "recover_from": c["node"],
+            "primary_target": c["primary"]})
+        loads[c["node"]] -= 1
+        loads[dst_node] += 1
+
     changed = False
+    moves_left = max_moves
+    if chain is not None:
+        # pass 1 — forced moves: copies a decider says cannot REMAIN
+        # (allocation filters, disk high watermark) drain to the least
+        # loaded node that accepts them, ahead of any balance moves
+        for index, shards in state.routing.items():
+            for sid, copies in enumerate(shards):
+                if moves_left <= 0:
+                    break
+                holders = {c["node"] for c in copies
+                           if c["node"] is not None}
+                for c in copies:
+                    if c["state"] != STARTED or c["node"] not in live:
+                        continue
+                    if chain.can_remain_shard(state, index, sid, c["node"]):
+                        continue
+                    dsts = sorted(
+                        (n for n in live
+                         if n not in holders
+                         and chain.can_allocate_shard(state, index, sid, n)),
+                        key=lambda n: (loads[n], n))
+                    if not dsts:
+                        continue
+                    start_move(index, sid, c, dsts[0])
+                    moves_left -= 1
+                    changed = True
+                    break
+        # pass 2 — count balance, destinations gated per shard
+        while moves_left > 0:
+            src_node = max(loads, key=lambda n: (loads[n], n))
+            moved = False
+            for index, shards in state.routing.items():
+                for sid, copies in enumerate(shards):
+                    holders = {c["node"] for c in copies
+                               if c["node"] is not None}
+                    for c in copies:
+                        if c["node"] != src_node \
+                                or c["state"] != STARTED:
+                            continue
+                        dsts = sorted(
+                            (n for n in live
+                             if n not in holders
+                             and loads[src_node] - loads[n] > 1
+                             and chain.can_allocate_shard(
+                                 state, index, sid, n)),
+                            key=lambda n: (loads[n], n))
+                        if not dsts:
+                            continue
+                        start_move(index, sid, c, dsts[0])
+                        moves_left -= 1
+                        moved = changed = True
+                        break
+                    if moved:
+                        break
+                if moved:
+                    break
+            if not moved:
+                break
+        return changed
+
     evac = {n for n in live
             if decider is not None and decider.should_evacuate(n)}
     targets = {n for n in live
@@ -272,22 +378,14 @@ def rebalance(state: ClusterState, max_moves: int = 2,
             break
         moved = False
         for index, shards in state.routing.items():
-            for copies in shards:
+            for sid, copies in enumerate(shards):
                 holders = {c["node"] for c in copies
                            if c["node"] is not None}
                 if dst_node in holders:
                     continue
                 for c in copies:
                     if c["node"] == src_node and c["state"] == STARTED:
-                        c["state"] = RELOCATING
-                        c["relocating_to"] = dst_node
-                        copies.append({
-                            "node": dst_node, "primary": False,
-                            "state": INITIALIZING, "relocation": True,
-                            "recover_from": src_node,
-                            "primary_target": c["primary"]})
-                        loads[src_node] -= 1
-                        loads[dst_node] += 1
+                        start_move(index, sid, c, dst_node)
                         moved = changed = True
                         break
                 if moved:
@@ -321,6 +419,16 @@ def finish_relocation(state: ClusterState, index: str, sid: int,
     target.pop("recover_from", None)
     if source is not None:
         copies.remove(source)
+    else:
+        # The source may have been reverted to STARTED by a concurrent
+        # cancel_relocations_for (target node died in the same tick as
+        # the finish ack) — or failed and reallocated — while still
+        # carrying the stale pointer. A STARTED copy with a dangling
+        # relocating_to would be double-counted by finish/cancel sweeps
+        # forever: clear the zombie pointer (race fix, ISSUE 15).
+        for c in copies:
+            if c is not target and c.get("relocating_to") == target_node:
+                c.pop("relocating_to", None)
     return True
 
 
@@ -342,7 +450,8 @@ def cancel_relocations_for(state: ClusterState, node_id: str) -> None:
                     c.pop("relocating_to", None)
 
 
-def remove_node(state: ClusterState, node_id: str) -> None:
+def remove_node(state: ClusterState, node_id: str,
+                decider=None) -> None:
     """Node-leave: drop it from nodes, promote replicas for its primaries,
     unassign its replicas (ref AllocationService on node departure — the
     elastic-recovery reaction in SURVEY.md §5.3)."""
@@ -369,7 +478,7 @@ def remove_node(state: ClusterState, node_id: str) -> None:
                     if c["state"] in (STARTED, RELOCATING):
                         c["primary"] = True
                         break
-    allocate(state)
+    allocate(state, decider=decider)
 
 
 def new_index_routing(n_shards: int, n_replicas: int) -> list[list[dict]]:

@@ -3024,6 +3024,13 @@ class NodeService:
         out["qos_degraded"] = qos["degraded"]
         out["hedge_rate_1m"] = hedge_rate(60)
         out["hedged_fired_total"] = hedge_snapshot()["fired"]
+        # peer-recovery stream counters (ISSUE 15): bytes moved and
+        # throttle back-pressure ride the history ring so a rebalance
+        # wave's cost is visible next to the latency gauges it protects
+        from .cluster.recovery import snapshot as recovery_snapshot
+        rec = recovery_snapshot()
+        out["recovery_bytes_total"] = rec["bytes_total"]
+        out["recovery_throttle_waits_total"] = rec["throttle_waits_total"]
         bst = batcher
         out["batcher_stranded_total"] = bst["stranded_total"]
         out["batcher_wait_timeouts_total"] = bst["wait_timeouts_total"]

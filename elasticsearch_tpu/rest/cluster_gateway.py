@@ -346,3 +346,58 @@ def register_cluster_routes(c, node: ClusterNode) -> None:
                 for nid in sorted(st.nodes)]
         return 200, "\n".join(rows) + "\n"
     c.register("GET", "/_cat/nodes", cat_nodes)
+
+    def cat_recovery(g, p, b):
+        # index shard source target stage files_total files_reused
+        # bytes_total bytes_recovered throttle_waits retries elapsed_ms
+        rows = []
+        for r in node.cat_recovery():
+            if g.get("index") and r["index"] != g["index"]:
+                continue
+            rows.append(" ".join([
+                r["index"], str(r["shard"]), str(r["source"]),
+                str(r["target"]), r["stage"], str(r["files_total"]),
+                str(r["files_reused"]), str(r["bytes_total"]),
+                str(r["bytes_recovered"]), str(r["throttle_waits"]),
+                str(r["retries"]), f"{r['elapsed_ms']:.1f}"]))
+        return 200, "\n".join(rows) + ("\n" if rows else "")
+    c.register("GET", "/_cat/recovery", cat_recovery)
+    c.register("GET", "/_cat/recovery/{index}", cat_recovery)
+
+    # -- allocation / settings (ISSUE 15) ----------------------------------
+    def allocation_explain(g, p, b):
+        body = _json_body(b) if b else {}
+        try:
+            out = node.allocation_explain(
+                index=body.get("index"),
+                shard=body.get("shard"),
+                primary=body.get("primary"))
+        except ValueError as e:
+            raise RestError(400, str(e))
+        except KeyError as e:
+            raise RestError(404, str(e))
+        return 200, out
+    c.register("POST", "/_cluster/allocation/explain", allocation_explain)
+    c.register("GET", "/_cluster/allocation/explain", allocation_explain)
+
+    def put_cluster_settings(g, p, b):
+        body = _json_body(b) if b else {}
+        # accept both the flat form and the transient/persistent wrappers
+        upd: dict = {}
+        for section in ("persistent", "transient"):
+            sec = body.get(section)
+            if isinstance(sec, dict):
+                upd.update(sec)
+        if not upd:
+            upd = {k: v for k, v in body.items()
+                   if k not in ("persistent", "transient")}
+        if not upd:
+            raise RestError(400, "no settings to update")
+        return 200, node.update_cluster_settings(upd)
+    c.register("PUT", "/_cluster/settings", put_cluster_settings)
+
+    def get_cluster_settings(g, p, b):
+        st = node.cluster.current()
+        return 200, {"persistent": {},
+                     "transient": dict(st.data.get("settings") or {})}
+    c.register("GET", "/_cluster/settings", get_cluster_settings)

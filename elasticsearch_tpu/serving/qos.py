@@ -360,7 +360,11 @@ class _Admission:
 # ---------------------------------------------------------------------------
 
 HEDGE_OUTCOMES = ("fired", "win_primary", "win_backup", "canceled",
-                  "failed")
+                  "failed", "moving")
+# "moving": the hedge armed/fired because the chosen copy is part of an
+# in-flight relocation (ISSUE 15's rebalance-under-traffic cover) — its
+# node is also streaming recovery chunks, so the deadline tightens by
+# cluster.search.hedge.moving_factor and fires even on a cold EWMA.
 
 _hedge_lock = threading.Lock()
 _hedge_counts = {o: 0 for o in HEDGE_OUTCOMES}

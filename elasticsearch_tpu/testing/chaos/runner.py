@@ -59,7 +59,8 @@ class ChaosOptions:
                  dims: int = 8, cluster_nodes: int = 3, shards: int = 4,
                  replicas: int = 1, transport: str = "local",
                  inject_parity_fault: bool = False,
-                 raise_on_failure: bool = True):
+                 raise_on_failure: bool = True,
+                 extended_roster: bool = False):
         self.seed = seed
         self.rounds = rounds
         self.docs_per_round = docs_per_round
@@ -72,6 +73,9 @@ class ChaosOptions:
         self.transport = transport
         self.inject_parity_fault = inject_parity_fault
         self.raise_on_failure = raise_on_failure
+        # opt-in kill/restart + clock-skew disruptions (scheme roster).
+        # Off by default so pinned-seed schedules stay bit-identical.
+        self.extended_roster = extended_roster
 
 
 class ChaosReport:
@@ -173,7 +177,8 @@ class ChaosRunner:
             client.put_mapping("docs", "_doc", mapping)
             self.cluster.ensure_green()
             self.scheme = DisruptionScheme(
-                self.cluster, random.Random(self.rng.randrange(2 ** 62)))
+                self.cluster, random.Random(self.rng.randrange(2 ** 62)),
+                extended_roster=self.opt.extended_roster)
 
     # -- one round ----------------------------------------------------------
 
@@ -301,6 +306,10 @@ class ChaosRunner:
         the SAME queries (the cluster's lane pair), toggled live via the
         cluster setting."""
         client = self._client()
+        # recoveries stream on background threads: wait for every copy
+        # to be STARTED before refreshing, or a replica can come up
+        # BETWEEN the two compared searches serving a pre-refresh view
+        self.cluster.ensure_green(20.0)
         client.refresh("docs")
         bodies = self.cluster_work.text_queries(4)
         bodies.append({"size": 5, "knn": {

@@ -104,10 +104,62 @@ class SlowNode(Disruption):
         return f"slow_node[{self.node_id}, {self.delay_s}s]"
 
 
+class KillRestartNode(Disruption):
+    """Abrupt process death of one non-master node for the duration of a
+    round (ref InternalTestCluster.restartRandomDataNode). The master
+    must fail the node's shards — including any recovery the node was
+    mid-stream on, source or target side — and the restart must rejoin
+    and recover without acked-write loss or leaked engines."""
+
+    kind = "kill_restart"
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def start(self, cluster) -> None:
+        cluster.kill_node(self.node_id)
+        cluster.detect_once()
+
+    def stop(self, cluster) -> None:
+        cluster.restart_node(self.node_id)
+
+    def describe(self) -> str:
+        return f"kill_restart[{self.node_id}]"
+
+
+class ClockSkew(Disruption):
+    """Skew one node's *reported* wall clock. Correctness invariant: only
+    wall-clock timestamps (e.g. _cat/recovery start_time_ms) may move —
+    durations, throttling and timeouts are monotonic-based and must be
+    unaffected, which the chaos tests assert."""
+
+    kind = "clock_skew"
+
+    def __init__(self, node_id: str, skew_s: float):
+        self.node_id = node_id
+        self.skew_s = skew_s
+
+    def start(self, cluster) -> None:
+        cluster.nodes[self.node_id].clock_skew_s = self.skew_s
+
+    def stop(self, cluster) -> None:
+        node = cluster.nodes.get(self.node_id)
+        if node is not None:
+            node.clock_skew_s = 0.0
+
+    def describe(self) -> str:
+        return f"clock_skew[{self.node_id}, {self.skew_s}s]"
+
+
 class DisruptionScheme:
-    def __init__(self, cluster, rng: random.Random):
+    def __init__(self, cluster, rng: random.Random,
+                 extended_roster: bool = False):
         self.cluster = cluster
         self.rng = rng
+        # opt-in: kill/restart + clock-skew join the draw. Default stays
+        # the original three kinds so pinned-seed schedules (the tier-1
+        # seed-1234 smoke) are bit-identical with the flag off.
+        self.extended_roster = extended_roster
         self.active: list[Disruption] = []
         self.applied: list[str] = []      # full history, for the report
 
@@ -126,11 +178,25 @@ class DisruptionScheme:
             return []
         out: list[Disruption] = []
         kinds = ["isolate", "drop", "slow"]
+        if self.extended_roster:
+            kinds += ["kill", "skew"]
         self.rng.shuffle(kinds)
+        node_level = 0      # at most one of isolate/kill per round
         for kind in kinds[:self.rng.randint(1, max_n)]:
             victim = self.rng.choice(victims)
             if kind == "isolate":
+                if node_level:
+                    continue
+                node_level += 1
                 out.append(IsolateNode(victim))
+            elif kind == "kill":
+                if node_level:
+                    continue
+                node_level += 1
+                out.append(KillRestartNode(victim))
+            elif kind == "skew":
+                out.append(ClockSkew(
+                    victim, round(self.rng.uniform(-120.0, 120.0), 1)))
             elif kind == "drop":
                 out.append(DropAction(
                     victim, self.rng.choice(DROPPABLE_PREFIXES)))
@@ -148,6 +214,10 @@ class DisruptionScheme:
         return [d.describe() for d in self.active]
 
     def heal(self, timeout: float = 20.0) -> None:
+        # clear link faults FIRST: a KillRestartNode.stop() rejoins the
+        # master over the network, which must not race a still-active
+        # partition against the same node id
+        self.cluster.network.heal()
         for d in self.active:
             d.stop(self.cluster)
         self.active = []

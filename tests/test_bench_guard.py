@@ -82,7 +82,11 @@ def test_tail_latency_keys_survive_forced_timeout():
                 "vector_stack_bytes_f32", "vector_stack_bytes_quantized",
                 # chaos harness (ISSUE 14): same seeded-null contract
                 "chaos_rounds", "chaos_parity_checks",
-                "chaos_invariant_violations"):
+                "chaos_invariant_violations",
+                # rebalance-under-load (ISSUE 15): same seeded-null
+                # contract
+                "rebalance_p99_ms", "rebalance_move_s",
+                "recovery_throttle_bytes_per_sec", "decider_vetoes"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

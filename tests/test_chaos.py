@@ -522,3 +522,134 @@ class TestChaosInvariants:
             assert control_plane_violations([node]) == []
         finally:
             node.close()
+
+
+# ---------------------------------------------------------------------------
+# extended disruption roster (ISSUE 15): kill/restart + clock skew
+# ---------------------------------------------------------------------------
+
+class TestExtendedRoster:
+
+    @pytest.mark.chaos
+    def test_extended_seed_kill_and_skew_complete_clean(self, tmp_path):
+        """Pinned extended-roster seed: the schedule draws a mid-round
+        kill/restart AND a clock skew (seed 7, rounds 2 — verified by
+        the describe() strings below), the restarted process re-recovers
+        its copies, and the post-heal parity sweep still matches the
+        fan-out bit-for-bit. This is the run that caught BOTH the stale
+        shard-started zombie (allocation-id fence, cluster/node.py
+        _on_shard_started) and the rejoin-with-stale-table reset
+        (_on_join)."""
+        report = ChaosRunner(str(tmp_path), ChaosOptions(
+            seed=7, rounds=2, extended_roster=True)).run()
+        assert report.ok(), report.as_dict()
+        kinds = " ".join(report.disruptions)
+        assert "kill_restart" in kinds, report.disruptions
+        assert "clock_skew" in kinds, report.disruptions
+
+    def test_default_roster_never_kills_or_skews(self, tmp_path):
+        """Pinned-seed contract: the tier-1 rotation seeds (1234, 7) must
+        keep drawing EXACTLY the original three disruption kinds — the
+        extended classes are opt-in so existing schedules stay
+        bit-identical."""
+        c = TestCluster(3, str(tmp_path))
+        try:
+            for seed in (1234, 7):
+                s = DisruptionScheme(c, random.Random(seed))
+                seq = [d.describe() for _ in range(12) for d in s.pick()]
+                assert seq, "schedule must draw"
+                for desc in seq:
+                    assert "kill_restart" not in desc, (seed, desc)
+                    assert "clock_skew" not in desc, (seed, desc)
+        finally:
+            c.close()
+
+    def test_same_seed_same_extended_sequence(self, tmp_path):
+        c = TestCluster(3, str(tmp_path))
+        try:
+            a = DisruptionScheme(c, random.Random(7), extended_roster=True)
+            b = DisruptionScheme(c, random.Random(7), extended_roster=True)
+            seq_a = [d.describe() for _ in range(4) for d in a.pick()]
+            seq_b = [d.describe() for _ in range(4) for d in b.pick()]
+            assert seq_a == seq_b
+            assert any("kill_restart" in d or "clock_skew" in d
+                       for d in seq_a), seq_a
+        finally:
+            c.close()
+
+    def test_clock_skew_shifts_wall_clock_not_durations(self, tmp_path):
+        """A skewed node's WALL timestamps (cat-recovery start_time_ms)
+        carry the skew; durations (elapsed_ms) are monotonic-based and
+        must stay sane — a -1h skew leaking into the duration math would
+        show up as a wildly negative or huge elapsed."""
+        import shutil
+
+        from elasticsearch_tpu.testing.chaos.scheme import ClockSkew
+        cluster = TestCluster(2, str(tmp_path))
+        try:
+            client = cluster.client()
+            client.create_index("w", {"number_of_shards": 1,
+                                      "number_of_replicas": 1})
+            cluster.ensure_green()
+            for i in range(20):
+                client.index_doc("w", str(i), {"body": f"common doc {i}"})
+            client.flush("w")
+            master = cluster.master_node()
+            st = master.cluster.current()
+            replica = next(c for c in st.shard_copies("w", 0)
+                           if not c["primary"])
+            target = cluster.nodes[replica["node"]]
+            skew = -3600.0
+            d = ClockSkew(target.node_id, skew)
+            d.start(cluster)
+            try:
+                assert abs(target._wall_ms()
+                           - (time.time() + skew) * 1000) < 5000
+                # wipe the replica and force a re-pull UNDER the skew
+                with target._shards_lock:
+                    holder = target._shards.pop(("w", 0))
+                holder.drop_searcher()
+                holder.engine.close()
+                shutil.rmtree(target._shard_path("w", 0),
+                              ignore_errors=True)
+                mark = time.monotonic()
+                wall_before = time.time()
+                master._on_shard_failed(master.node_id, {
+                    "index": "w", "shard": 0, "node": target.node_id})
+                deadline = time.monotonic() + 30.0
+                rec = None
+                while time.monotonic() < deadline:
+                    with target._recoveries_lock:
+                        r = target.recoveries.get(("w", 0))
+                        if r is not None and r["start_s"] >= mark \
+                                and r["stage"] == "done":
+                            rec = dict(r)
+                            break
+                    time.sleep(0.02)
+                assert rec is not None, "re-recovery never completed"
+                # the wall timestamp carries (most of) the -1h skew...
+                assert rec["start_time_ms"] \
+                    < (wall_before + skew + 120.0) * 1000
+                # ...the duration does not
+                assert 0 <= rec["elapsed_ms"] < 60_000
+            finally:
+                d.stop(cluster)
+            assert target.clock_skew_s == 0.0
+        finally:
+            cluster.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosSoak:
+
+    def test_extended_soak_multiple_seeds(self, tmp_path):
+        """Opt-in (-m slow) soak: several extended-roster seeds, more
+        rounds — broadens schedule coverage beyond the pinned tier-1
+        seeds without taxing the default run."""
+        for seed in (11, 23, 37):
+            report = ChaosRunner(
+                str(tmp_path / f"s{seed}"),
+                ChaosOptions(seed=seed, rounds=2,
+                             extended_roster=True)).run()
+            assert report.ok(), report.as_dict()

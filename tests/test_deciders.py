@@ -1,0 +1,363 @@
+"""Composable allocation decider chain (ISSUE 15).
+
+Per-decider matrix: every decider gets an allocate case, a
+rebalance-path case and a veto-accounting case, plus the explain()
+output shape behind /_cluster/allocation/explain.
+
+Ref: cluster/routing/allocation/decider/AllocationDeciders.java (first
+NO short-circuits, THROTTLE defers) and the individual deciders it
+chains (SameShard / Awareness / Filter / ShardsLimit / Throttling /
+DiskThreshold).
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.deciders import (NO, THROTTLE, YES,
+                                                AwarenessDecider,
+                                                ConcurrentRecoveriesDecider,
+                                                DeciderChain, DiskDecider,
+                                                FilterDecider,
+                                                SameShardDecider,
+                                                ShardsLimitDecider)
+from elasticsearch_tpu.cluster.state import (INITIALIZING, RELOCATING,
+                                             STARTED, UNASSIGNED,
+                                             ClusterState, allocate,
+                                             new_index_routing, rebalance)
+
+
+def _state(nodes: dict, settings: dict | None = None) -> ClusterState:
+    """nodes: {node_id: attributes}."""
+    st = ClusterState.empty()
+    for nid, attrs in nodes.items():
+        st.nodes[nid] = {"id": nid, "name": nid,
+                         "attributes": dict(attrs or {})}
+    if settings:
+        st.data["settings"] = dict(settings)
+    return st
+
+
+def _index(st: ClusterState, name: str, shards: int, replicas: int,
+           settings: dict | None = None) -> None:
+    st.indices[name] = {"settings": dict(settings or {}), "mappings": {}}
+    st.data["routing"][name] = new_index_routing(shards, replicas)
+
+
+def _place(st, index, sid, copy_i, node, state=STARTED) -> dict:
+    c = st.routing[index][sid][copy_i]
+    c["node"] = node
+    c["state"] = state
+    return c
+
+
+class _FakeDisk:
+    """cluster/info.DiskThresholdDecider stand-in: the exact interface
+    the DiskDecider wrapper consumes."""
+
+    def __init__(self, over_low=(), over_high=()):
+        self.over_low = set(over_low) | set(over_high)
+        self.over_high = set(over_high)
+        self.low_pct, self.high_pct = 85.0, 90.0
+
+        class _Info:
+            usages = {}
+        self.info = _Info()
+
+    def can_allocate(self, node_id):
+        return node_id not in self.over_low
+
+    def should_evacuate(self, node_id):
+        return node_id in self.over_high
+
+
+class TestSameShard:
+    def test_allocate_veto_on_holder(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "n1")
+        d = SameShardDecider()
+        assert d.can_allocate(st, "i", 0, "n1").verdict == NO
+        assert d.can_allocate(st, "i", 0, "n2").verdict == YES
+
+    def test_chain_counts_the_veto(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "n1")
+        chain = DeciderChain.default()
+        assert chain.can_allocate_shard(st, "i", 0, "n1").verdict == NO
+        assert chain.vetoes["same_shard"] == 1
+        assert chain.veto_total() == 1
+
+
+class TestAwareness:
+    SET = {"cluster.routing.allocation.awareness.attributes": "zone"}
+
+    def test_allocate_rejects_overfull_zone(self):
+        st = _state({"a1": {"zone": "a"}, "a2": {"zone": "a"},
+                     "b1": {"zone": "b"}}, self.SET)
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "a1")
+        d = AwarenessDecider()
+        # 2 copies over 2 zones: one per zone; a2 would put both in [a]
+        assert d.can_allocate(st, "i", 0, "a2").verdict == NO
+        assert d.can_allocate(st, "i", 0, "b1").verdict == YES
+
+    def test_allocate_spreads_replica_across_zones(self):
+        st = _state({"a1": {"zone": "a"}, "a2": {"zone": "a"},
+                     "b1": {"zone": "b"}}, self.SET)
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "a1")
+        assert allocate(st, decider=DeciderChain.default())
+        replica = st.routing["i"][0][1]
+        assert replica["node"] == "b1"      # a2 was the lower-id candidate
+
+    def test_unlabeled_nodes_are_exempt(self):
+        st = _state({"a1": {"zone": "a"}, "n2": {}, "b1": {"zone": "b"}},
+                    self.SET)
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "a1")
+        assert AwarenessDecider().can_allocate(
+            st, "i", 0, "n2").verdict == YES
+
+    def test_veto_counted(self):
+        st = _state({"a1": {"zone": "a"}, "a2": {"zone": "a"},
+                     "b1": {"zone": "b"}}, self.SET)
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "a1")
+        chain = DeciderChain.default()
+        assert not chain.can_allocate_shard(st, "i", 0, "a2")
+        assert chain.vetoes["awareness"] == 1
+
+
+class TestFilter:
+    def test_require(self):
+        st = _state({"n1": {"rack": "r1"}, "n2": {"rack": "r2"}},
+                    {"cluster.routing.allocation.require.rack": "r1"})
+        _index(st, "i", 1, 0)
+        d = FilterDecider()
+        assert d.can_allocate(st, "i", 0, "n1").verdict == YES
+        assert d.can_allocate(st, "i", 0, "n2").verdict == NO
+
+    def test_include_index_level(self):
+        st = _state({"n1": {}, "n2": {}, "n3": {}})
+        _index(st, "i", 1, 0,
+               {"index.routing.allocation.include._id": "n1,n2"})
+        d = FilterDecider()
+        assert d.can_allocate(st, "i", 0, "n1").verdict == YES
+        assert d.can_allocate(st, "i", 0, "n3").verdict == NO
+
+    def test_exclude_blocks_remain_and_rebalance_drains(self):
+        st = _state({"n1": {}, "n2": {}},
+                    {"cluster.routing.allocation.exclude._id": "n1"})
+        _index(st, "i", 1, 0)
+        c = _place(st, "i", 0, 0, "n1")
+        chain = DeciderChain.default()
+        assert chain.can_remain_shard(st, "i", 0, "n1").verdict == NO
+        assert rebalance(st, decider=chain)
+        assert c["state"] == RELOCATING and c["relocating_to"] == "n2"
+        tgt = st.routing["i"][0][1]
+        assert tgt["relocation"] and tgt["node"] == "n2"
+
+    def test_exclude_with_no_destination_stays_put(self):
+        st = _state({"n1": {}},
+                    {"cluster.routing.allocation.exclude._id": "n1"})
+        _index(st, "i", 1, 0)
+        c = _place(st, "i", 0, 0, "n1")
+        assert not rebalance(st, decider=DeciderChain.default())
+        assert c["state"] == STARTED     # nowhere to go: keep serving
+
+    def test_veto_counted(self):
+        st = _state({"n1": {}, "n2": {}},
+                    {"cluster.routing.allocation.exclude._id": "n2"})
+        _index(st, "i", 1, 0)
+        chain = DeciderChain.default()
+        assert not chain.can_allocate_shard(st, "i", 0, "n2")
+        assert chain.vetoes["filter"] == 1
+
+
+class TestShardsLimit:
+    def test_index_limit(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 2, 0,
+               {"index.routing.allocation.total_shards_per_node": 1})
+        _place(st, "i", 0, 0, "n1")
+        d = ShardsLimitDecider()
+        assert d.can_allocate(st, "i", 1, "n1").verdict == NO
+        assert d.can_allocate(st, "i", 1, "n2").verdict == YES
+
+    def test_cluster_limit_counts_all_indices(self):
+        st = _state({"n1": {}, "n2": {}},
+                    {"cluster.routing.allocation.total_shards_per_node": 1})
+        _index(st, "i", 1, 0)
+        _index(st, "j", 1, 0)
+        _place(st, "i", 0, 0, "n1")
+        d = ShardsLimitDecider()
+        assert d.can_allocate(st, "j", 0, "n1").verdict == NO
+        assert d.can_allocate(st, "j", 0, "n2").verdict == YES
+
+    def test_rebalance_respects_limit(self):
+        # n1 holds 4 shards, n2 none — but the cluster limit of 1 caps
+        # what balance moves may land on n2
+        st = _state({"n1": {}, "n2": {}},
+                    {"cluster.routing.allocation.total_shards_per_node": 1})
+        _index(st, "i", 4, 0)
+        for sid in range(4):
+            _place(st, "i", sid, 0, "n1")
+        assert rebalance(st, max_moves=4, decider=DeciderChain.default())
+        moving = [c for copies in st.routing["i"] for c in copies
+                  if c.get("relocation")]
+        assert len(moving) == 1 and moving[0]["node"] == "n2"
+
+    def test_veto_counted(self):
+        st = _state({"n1": {}, "n2": {}},
+                    {"cluster.routing.allocation.total_shards_per_node": 1})
+        _index(st, "i", 2, 0)
+        _place(st, "i", 0, 0, "n1")
+        chain = DeciderChain.default()
+        assert not chain.can_allocate_shard(st, "i", 1, "n1")
+        assert chain.vetoes["shards_limit"] == 1
+
+
+class TestConcurrentRecoveries:
+    def test_throttle_at_default_limit(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 3, 0)
+        _place(st, "i", 0, 0, "n1", state=INITIALIZING)
+        _place(st, "i", 1, 0, "n1", state=INITIALIZING)
+        d = ConcurrentRecoveriesDecider()
+        dec = d.can_allocate(st, "i", 2, "n1")
+        assert dec.verdict == THROTTLE and not dec
+        assert d.can_allocate(st, "i", 2, "n2").verdict == YES
+
+    def test_throttle_defers_allocation_not_vetoes(self):
+        st = _state({"n1": {}})
+        _index(st, "i", 3, 0)
+        _place(st, "i", 0, 0, "n1", state=INITIALIZING)
+        _place(st, "i", 1, 0, "n1", state=INITIALIZING)
+        st.routing["i"][2][0]["fresh"] = True      # fresh primary
+        chain = DeciderChain.default()
+        assert not allocate(st, decider=chain)     # deferred, not placed
+        assert st.routing["i"][2][0]["state"] == UNASSIGNED
+        assert chain.veto_total() == 0             # THROTTLE is no veto
+        # recoveries finish: the next round places it
+        st.routing["i"][0][0]["state"] = STARTED
+        st.routing["i"][1][0]["state"] = STARTED
+        assert allocate(st, decider=chain)
+        assert st.routing["i"][2][0]["state"] == INITIALIZING
+
+    def test_limit_setting_and_disable(self):
+        st = _state({"n1": {}}, {
+            "cluster.routing.allocation.node_concurrent_recoveries": 1})
+        _index(st, "i", 2, 0)
+        _place(st, "i", 0, 0, "n1", state=INITIALIZING)
+        d = ConcurrentRecoveriesDecider()
+        assert d.can_allocate(st, "i", 1, "n1").verdict == THROTTLE
+        st.data["settings"][
+            "cluster.routing.allocation.node_concurrent_recoveries"] = 0
+        assert d.can_allocate(st, "i", 1, "n1").verdict == YES
+
+
+class TestDisk:
+    def test_allocate_blocked_over_low_watermark(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 1, 0)
+        d = DiskDecider(_FakeDisk(over_low={"n2"}))
+        assert d.can_allocate(st, "i", 0, "n1").verdict == YES
+        assert d.can_allocate(st, "i", 0, "n2").verdict == NO
+
+    def test_high_watermark_evacuates_via_rebalance(self):
+        st = _state({"n1": {}, "n2": {}})
+        _index(st, "i", 1, 0)
+        c = _place(st, "i", 0, 0, "n1")
+        chain = DeciderChain.default(_FakeDisk(over_high={"n1"}))
+        assert chain.can_remain_shard(st, "i", 0, "n1").verdict == NO
+        assert rebalance(st, decider=chain)
+        assert c["state"] == RELOCATING and c["relocating_to"] == "n2"
+
+    def test_veto_counted(self):
+        st = _state({"n1": {}})
+        _index(st, "i", 1, 0)
+        chain = DeciderChain.default(_FakeDisk(over_low={"n1"}))
+        assert not chain.can_allocate_shard(st, "i", 0, "n1")
+        assert chain.vetoes["disk"] == 1
+
+
+class TestChainSemantics:
+    def test_first_no_short_circuits(self):
+        st = _state({"n1": {}},
+                    {"cluster.routing.allocation.exclude._id": "n1"})
+        _index(st, "i", 1, 1)
+        _place(st, "i", 0, 0, "n1")
+        chain = DeciderChain.default()
+        dec = chain.can_allocate_shard(st, "i", 0, "n1")
+        # same_shard fires before filter in roster order
+        assert dec.decider == "same_shard"
+        assert chain.vetoes["filter"] == 0
+
+    def test_explain_runs_every_decider(self):
+        st = _state({"n1": {}, "n2": {"zone": "b"}},
+                    {"cluster.routing.allocation.exclude._id": "n1"})
+        _index(st, "i", 1, 0)
+        chain = DeciderChain.default(_FakeDisk())
+        before = chain.veto_total()
+        out = chain.explain(st, "i", 0, "n1")
+        assert out["node_id"] == "n1" and out["decision"] == NO
+        names = [e["decider"] for e in out["deciders"]]
+        assert names == ["same_shard", "awareness", "filter",
+                         "shards_limit", "throttling", "disk"]
+        filt = next(e for e in out["deciders"] if e["decider"] == "filter")
+        assert filt["decision"] == NO and "excluded" in filt["explanation"]
+        assert chain.veto_total() == before    # explain never counts
+        assert chain.explain(st, "i", 0, "n2")["decision"] == YES
+
+
+class TestExplainApi:
+    def test_allocation_explain_on_live_cluster(self, tmp_path):
+        from elasticsearch_tpu.cluster import TestCluster
+        cluster = TestCluster(2, str(tmp_path))
+        try:
+            client = cluster.client()
+            # 3 copies over 2 nodes: one replica stays unassigned —
+            # exactly what explain defaults to explaining
+            client.create_index("e", {"number_of_shards": 1,
+                                      "number_of_replicas": 2})
+            cluster.ensure_yellow_or_green()
+            out = client.allocation_explain()
+            assert out["index"] == "e" and out["shard"] == 0
+            assert out["can_allocate"] == "no"
+            decisions = out["node_allocation_decisions"]
+            assert {d["node_id"] for d in decisions} == set(cluster.nodes)
+            for d in decisions:
+                assert d["decision"] == NO
+                same = next(e for e in d["deciders"]
+                            if e["decider"] == "same_shard")
+                assert same["decision"] == NO
+            # explicit (index, shard) form + the unknown-index error
+            got = client.allocation_explain(index="e", shard=0)
+            assert got["node_allocation_decisions"]
+            with pytest.raises(KeyError):
+                client.allocation_explain(index="nope", shard=0)
+        finally:
+            cluster.close()
+
+    def test_veto_metrics_exposed(self, tmp_path):
+        from elasticsearch_tpu.cluster import TestCluster
+        cluster = TestCluster(2, str(tmp_path))
+        try:
+            client = cluster.client()
+            client.create_index("m", {"number_of_shards": 2,
+                                      "number_of_replicas": 0})
+            cluster.ensure_green()
+            victim = sorted(cluster.nodes)[-1]
+            client.update_cluster_settings(
+                {"cluster.routing.allocation.exclude._id": victim})
+            total = sum(n.deciders.veto_total()
+                        for n in cluster.nodes.values())
+            assert total > 0
+            # the metric section feeding
+            # es_allocation_decider_vetoes_total{decider=}
+            sections = cluster.master_node().metric_sections()
+            label, counters = sections["allocation_decider"]
+            assert label == "decider"
+            assert counters["filter"]["vetoes_total"] > 0
+        finally:
+            cluster.close()

@@ -86,7 +86,11 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # import so a forced timeout still emits them
                      "rebalance_p99_ms": None, "rebalance_move_s": None,
                      "recovery_throttle_bytes_per_sec": None,
-                     "decider_vetoes": None}
+                     "decider_vetoes": None,
+                     # device telemetry flight recorder (ISSUE 16): seeded
+                     # null at import so a forced timeout still emits them
+                     "xla_compile_ms_total": None, "hbm_peak_bytes": None,
+                     "lane_decision_counts": None, "flight": None}
 _LINE_PRINTED = False
 
 
@@ -144,6 +148,50 @@ def _install_bailout() -> None:
         signal.alarm(max(int(BENCH_TIME_BUDGET + _ALARM_MARGIN), 1))
     except (ValueError, OSError, AttributeError):
         pass
+
+
+_FLIGHT_PREV: dict = {"invocations": 0, "device_ms": 0.0,
+                      "compile_ms": 0.0, "compiles": 0, "lanes": {}}
+
+
+def _flight_snapshot(leg: str) -> None:
+    """Flight recorder (ISSUE 16): after every leg, fold that leg's
+    device-stats DELTAS (program dispatches, compile time, lane
+    decisions, HBM high-water) into _FINAL_LINE["flight"]. The sidecar
+    updates incrementally, so a SIGALRM/rc=124 mid-leg still emits every
+    leg that finished before the kill — the same always-emit contract as
+    the headline keys."""
+    try:
+        from elasticsearch_tpu.common import device_stats
+        snap = device_stats.registry_snapshot(top_n=0, with_cost=False)
+        lanes = device_stats.lane_decisions_snapshot()
+        prev = _FLIGHT_PREV
+        entry = {
+            "invocations": snap["invocations_total"] - prev["invocations"],
+            "device_ms": round(
+                snap["device_time_in_millis"] - prev["device_ms"], 3),
+            "compile_ms": round(
+                snap["compile_time_in_millis"] - prev["compile_ms"], 3),
+            "compiles": snap["compiles_total"] - prev["compiles"],
+            "lane_decisions": {k: n - prev["lanes"].get(k, 0)
+                               for k, n in lanes.items()
+                               if n - prev["lanes"].get(k, 0)},
+            "hbm_peak_bytes": device_stats.hbm_peak_bytes()}
+        _FLIGHT_PREV.update(
+            {"invocations": snap["invocations_total"],
+             "device_ms": snap["device_time_in_millis"],
+             "compile_ms": snap["compile_time_in_millis"],
+             "compiles": snap["compiles_total"], "lanes": lanes})
+        flight = _FINAL_LINE.get("flight") or {}
+        flight[leg] = entry
+        flight["program_count"] = snap["program_count"]
+        _FINAL_LINE["flight"] = flight
+        _FINAL_LINE["xla_compile_ms_total"] = round(
+            device_stats.compile_ms_total(), 3)
+        _FINAL_LINE["hbm_peak_bytes"] = device_stats.hbm_peak_bytes()
+        _FINAL_LINE["lane_decision_counts"] = lanes
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the run
+        print(f"flight snapshot ({leg}) failed: {e}", file=sys.stderr)
 
 
 def _arm_leg_alarm(reserve: float) -> None:
@@ -1272,6 +1320,7 @@ def run_rebalance_leg(tag: str) -> dict:
 def _run_all_legs(tag: str) -> dict:
     _arm_leg_alarm(reserve=120.0)
     res = run_engine_leg(tag)
+    _flight_snapshot("engine")
     if tag == "main":
         # results land in the emergency line the moment they exist, so a
         # kill during a LATER leg still reports the measured headline
@@ -1329,6 +1378,8 @@ def _run_all_legs(tag: str) -> dict:
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — legs are best-effort
             print(f"{flag} leg failed: {e}", file=sys.stderr)
+        finally:
+            _flight_snapshot(flag.removeprefix("BENCH_").lower())
     _arm_hard_alarm()
     return res
 
@@ -1408,7 +1459,13 @@ def main_engine():
         "batched_requests": res.get("batched_requests"),
         "search_rejected": res.get("search_rejected"),
         "budget_secs_left": round(_remaining(), 1),
-        "platform": plat}
+        "platform": plat,
+        # device telemetry flight recorder (ISSUE 16): the per-leg
+        # sidecar + rollups already landed in _FINAL_LINE after each leg
+        "xla_compile_ms_total": _FINAL_LINE.get("xla_compile_ms_total"),
+        "hbm_peak_bytes": _FINAL_LINE.get("hbm_peak_bytes"),
+        "lane_decision_counts": _FINAL_LINE.get("lane_decision_counts"),
+        "flight": _FINAL_LINE.get("flight")}
     if err is not None:
         line["error"] = err
     if "agg_qps" in res:

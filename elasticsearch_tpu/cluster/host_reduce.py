@@ -59,17 +59,22 @@ def try_host_reduce(node, index: str, sids: list[int], body: dict,
     a decline. `node` is the ClusterNode; `sids` arrive in target order
     (ascending), which becomes the mesh shard-row order — the same
     tie-break order the coordinator's ti-ordered merge uses."""
+    from ..common.device_stats import lane_chosen, lane_decline
     from ..parallel import mesh_exec
     from ..search.aggs.aggregators import parse_aggs
+
+    def _declined(reason: str):
+        lane_decline("cluster_reduce", "host_reduce", reason)
+        return None, reason
 
     searchers = []
     for sid in sids:
         holder = node._shards.get((index, sid))
         if holder is None or holder.engine is None:
-            return None, "shard_unavailable"
+            return _declined("shard_unavailable")
         searchers.append(node._searcher(index, sid, holder))
     if mesh_exec.mesh_for(len(searchers)) is None:
-        return None, "no_mesh"
+        return _declined("no_mesh")
 
     knn = body.get("knn")
     agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations")) \
@@ -77,15 +82,16 @@ def try_host_reduce(node, index: str, sids: list[int], body: dict,
 
     if knn is not None:
         if agg_specs:
-            return None, "knn_aggs"
+            return _declined("knn_aggs")
         out = _knn_host_reduce(node, index, sids, searchers, knn, k)
         agg_specs = None
     else:
         out = _query_host_reduce(node, index, sids, searchers, body,
                                  agg_specs, k, dfs)
     if isinstance(out, tuple) and out[0] is None:
-        return out
+        return _declined(out[1])
     keys, shard_of, scores, totals, mxs, agg_parts = out
+    lane_chosen("cluster_reduce", "host_reduce")
     return _decompose(searchers, sids, keys, shard_of, scores, totals,
                       mxs, agg_parts, agg_specs), None
 

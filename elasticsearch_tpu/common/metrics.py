@@ -415,6 +415,9 @@ class RequestProfiler:
         self.d2h_bytes = 0
         self.h2d_bytes = 0
         self.paths: dict[str, int] = {}   # device path -> shard query count
+        # per-request program activity (common/device_stats.py wrapper):
+        # site name -> {invocations, device time} for THIS request only
+        self.programs: dict[str, dict] = {}
         self._jit0 = device_events_snapshot()
 
     @property
@@ -496,6 +499,16 @@ class RequestProfiler:
         with self._lock:
             self.paths[path] = self.paths.get(path, 0) + 1
 
+    def note_program(self, name: str, ms: float) -> None:
+        """One instrumented-program dispatch attributed to this request
+        (device_stats.InstrumentedProgram calls in)."""
+        with self._lock:
+            b = self.programs.setdefault(
+                name, {"invocations": 0, "device_time_in_millis": 0.0})
+            b["invocations"] += 1
+            b["device_time_in_millis"] = round(
+                b["device_time_in_millis"] + ms, 3)
+
     def device_section(self) -> dict:
         compiles, compile_ms = device_events_snapshot()
         misses = compiles - self._jit0[0]
@@ -505,7 +518,9 @@ class RequestProfiler:
                     compile_ms - self._jit0[1], 3),
                 "bytes_device_to_host": self.d2h_bytes,
                 "bytes_host_to_device": self.h2d_bytes,
-                "query_paths": dict(self.paths)}
+                "query_paths": dict(self.paths),
+                "programs": {k: dict(v)
+                             for k, v in self.programs.items()}}
 
     def render(self, opaque_id: str | None = None) -> dict:
         out = {"trace_id": self.trace_id,
@@ -695,9 +710,17 @@ def openmetrics_families(sections: dict, node: str,
                     continue
                 leaves = []
                 _flatten("", sub, leaves)
+                if isinstance(label_name, tuple):
+                    # multi-label registry: entry keys are value tuples
+                    # aligned with the label-name tuple
+                    # (es_search_lane_decisions_total{lane=,reason=})
+                    labels = {"node": node,
+                              **{ln: str(lv) for ln, lv in
+                                 zip(label_name, entry)}}
+                else:
+                    labels = {"node": node, label_name: str(entry)}
                 for key, v in leaves:
-                    emit(section, {"node": node, label_name: str(entry)},
-                         key, v)
+                    emit(section, labels, key, v)
     return fams
 
 

@@ -765,11 +765,15 @@ class NodeService:
                                      request_cache=request_cache)
         prof = RequestProfiler(
             trace_id=task.trace_id if task is not None else None)
-        with use_profiler(prof):
+        from .common.device_stats import record_lanes
+        with use_profiler(prof), record_lanes() as lanes:
             resp = self._search_exec(index, body, size=size, from_=from_,
                                      request_cache=False)
         resp["profile"] = prof.render(
             opaque_id=task.opaque_id if task is not None else None)
+        # the lane-decision flight record: which execution lane served each
+        # component and every (lane, reason) decline on the ladder walk
+        resp["profile"]["lanes"] = lanes.explain()
         return resp
 
     def _search_exec(self, index: str, body: dict | None = None,
@@ -857,13 +861,16 @@ class NodeService:
         # Concurrent solo requests COALESCE through the batcher: under load
         # the device serves whole queues of independent requests as one
         # program (serving/batcher.py), which is where TPU QPS comes from.
+        from .common.device_stats import lane_chosen, lane_decline
         if len(names) == 1:
             try:
                 from .search.query_parser import QueryParser
                 from .serving.executor import packed_spec_of
                 spec = packed_spec_of(
                     QueryParser(self.indices[names[0]].mappers), body)
-                if spec is not None:
+                if spec is None:
+                    lane_decline("serve", "packed", "plan_shape")
+                else:
                     key = (names[0], size, from_, spec[1], spec[2], spec[3])
                     with tracing.span("packed_batch", index=names[0]):
                         # queue wait + the shared device program of the
@@ -871,7 +878,10 @@ class NodeService:
                         # covers this request's whole stay in the lane
                         out = self._batcher.submit(key, names[0], body,
                                                    spec, size, from_, t0)
-                    if out is not None:
+                    if out is None:
+                        lane_decline("serve", "packed", "batcher_declined")
+                    else:
+                        lane_chosen("serve", "packed")
                         # batcher lane: only TOTAL is honest here — the
                         # request's wall time includes queue wait and
                         # shared-batch work, not this request's device time
@@ -885,6 +895,7 @@ class NodeService:
                             tracing.mark_slowlog()
                         return out
             except Exception:  # noqa: BLE001 — degrade to the general path
+                lane_decline("serve", "packed", "error")
                 self._packed_error()
 
         # coalesced general lane (serving/batcher.py, ISSUE 9): bodies the
@@ -915,6 +926,7 @@ class NodeService:
                 if got is not None:
                     # follower served from the shared batch: only TOTAL is
                     # honest (wall time includes queue wait + shared work)
+                    lane_chosen("serve", "batched")
                     took = (time.perf_counter() - t0) * 1000
                     self._record_phase("total", took)
                     tid, oid = self._trace_ids()
@@ -1750,18 +1762,24 @@ class NodeService:
         With `agg_specs`, the agg tree rides the SAME program
         (parallel/mesh_aggs.py) — the merged partial equals the fan-out's
         per-shard collect + host merge bit-for-bit."""
+        from .common.device_stats import lane_chosen, lane_decline
         svc = self.indices[name]
         if not svc._mesh_enabled \
                 or not _mesh_enabled_setting(self.settings):
+            lane_decline("query", "mesh", "opt_out")
             return None
         from .search.query_dsl import contains_joins
         if contains_joins(node_tree):
+            lane_decline("query", "mesh", "joins")
             return None
         from .parallel import mesh_exec
         if not mesh_exec.plan_types_supported(node_tree):
+            lane_decline("query", "mesh", "plan_unsupported")
             return None
         if mesh_exec.mesh_for(len(searchers)) is None:
-            return None     # cross-host topology / fewer devices than shards
+            # cross-host topology / fewer devices than shards
+            lane_decline("query", "mesh", "no_mesh")
+            return None
         k = max(size + from_, 1)
         try:
             stack = self.caches.mesh_stacks.get_or_build(
@@ -1769,6 +1787,7 @@ class NodeService:
                 [list(s.segments) for s in searchers],
                 breaker=self.breakers.breaker("fielddata"))
             if stack is None:
+                lane_decline("query", "mesh", "stack_declined")
                 return None
             with tracing.span("mesh_reduce", index=name,
                               shards=len(searchers), k=k):
@@ -1779,14 +1798,18 @@ class NodeService:
                     agg_specs=agg_specs)
             if out is None:
                 # plan/agg shape has no collective form (field shapes)
+                lane_decline("query", "mesh",
+                             "agg_shape" if agg_specs else "plan_shape")
                 if agg_specs:
                     svc.search_stats["mesh_agg_fallbacks"] = \
                         svc.search_stats.get("mesh_agg_fallbacks", 0) + 1
                 return None
         except Exception:  # noqa: BLE001 — the fan-out is always correct
+            lane_decline("query", "mesh", "error")
             self._mesh_error(svc)
             return None
         keys, shard_of, scores, totals, mxs, agg_per_shard = out
+        lane_chosen("query", "mesh")
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
@@ -1821,12 +1844,15 @@ class NodeService:
         ReducedDocs or None to fall back to the per-shard fan-out (mixed
         IVF/exact segment lanes, non-uniform nlist, filter plans without a
         mesh form, opt-outs, any error)."""
+        from .common.device_stats import lane_chosen, lane_decline
         svc = self.indices[name]
         if not svc._mesh_enabled \
                 or not _mesh_enabled_setting(self.settings):
+            lane_decline("knn", "mesh_knn", "opt_out")
             return None
         from .parallel import mesh_exec, mesh_knn
         if mesh_exec.mesh_for(len(searchers)) is None:
+            lane_decline("knn", "mesh_knn", "no_mesh")
             return None
         try:
             vstack = self.caches.mesh_vector_stacks.get_or_build(
@@ -1834,6 +1860,7 @@ class NodeService:
                 [list(s.segments) for s in searchers],
                 breaker=self.breakers.breaker("fielddata"))
             if vstack is None:
+                lane_decline("knn", "mesh_knn", "vstack_declined")
                 return None
             fnode = None
             if knn.get("filter"):
@@ -1845,6 +1872,7 @@ class NodeService:
                     [list(s.segments) for s in searchers],
                     breaker=self.breakers.breaker("fielddata"))
                 if stack is None:
+                    lane_decline("knn", "mesh_knn", "stack_declined")
                     return None
             with tracing.span("mesh_reduce", index=name,
                               shards=len(searchers), k=k, knn=True):
@@ -1862,13 +1890,16 @@ class NodeService:
                             seg, vc, knn["field"], ivf, mode),
                     filter_node=fnode, filter_stack=stack)
             if out is None:
+                # mesh_knn.execute noted the specific (lane, reason) itself
                 svc.search_stats["mesh_ann_fallbacks"] = \
                     svc.search_stats.get("mesh_ann_fallbacks", 0) + 1
                 return None
         except Exception:  # noqa: BLE001 — the fan-out is always correct
+            lane_decline("knn", "mesh_knn", "error")
             self._mesh_error(svc)
             return None
         keys, shard_of, scores, totals, mxs, used_ivf, used_quant = out
+        lane_chosen("knn", "mesh_knn")
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
@@ -2781,13 +2812,28 @@ class NodeService:
 
     # -- telemetry (the /_metrics exposition + stats-history sampler) ------
 
+    def device_stats_payload(self, top_n: int = 50) -> dict:
+        """`GET /_nodes/device_stats` (ISSUE 16): the per-program XLA
+        registry (compile ms, invocations, cumulative dispatch time, lazy
+        flops/bytes-accessed cost — None-safe on CPU), per-device HBM
+        stats with the process high-water mark, and the global
+        lane-decision counters. Cost analysis is forced HERE (a scrape-
+        time re-lower), never on the dispatch path."""
+        from .common import device_stats
+        return {
+            "programs": device_stats.registry_snapshot(
+                top_n=top_n, with_cost=True),
+            "hbm": device_stats.hbm_poll(),
+            "lane_decisions": device_stats.lane_decisions_snapshot(),
+        }
+
     def metric_sections(self) -> dict:
         """Every stats registry of this node as OpenMetrics walk input:
         {section: (label_name | None, payload)}. A NEW stats source joins
         the `/_metrics` scrape (and the strict-parser tripwire test) by
         adding one entry here — labeled registries (pools, breakers,
         timers, indices) pick up new entries automatically."""
-        from .common import monitor
+        from .common import device_stats, monitor
         from .common.metrics import device_events_snapshot, transfer_snapshot
         batcher = self._batcher.stats()
         occupancy = batcher.pop("occupancy", {})
@@ -2922,6 +2968,23 @@ class NodeService:
                                for o, c in hedge_snapshot().items()}),
             "jit": (None, {"compiles": compiles,
                            "compile_time_in_millis": round(compile_ms, 3)}),
+            # per-program-site XLA accounting (ISSUE 16): invocations,
+            # cumulative dispatch time, attributed compiles per site —
+            # es_xla_program_*{program=}; full per-plan-key detail + cost
+            # analysis live on GET /_nodes/device_stats
+            "xla_program": ("program", device_stats.program_metrics()),
+            # per-device HBM gauges (zeros + supported=False on CPU) —
+            # the high-water mark is the 100M-vectors budget number
+            "device_hbm": ("device", {
+                ident: {k2: v2 for k2, v2 in st.items()
+                        if k2 != "supported"}
+                for ident, st in device_stats.hbm_poll().items()}),
+            # the lane-decision counter family (ISSUE 16):
+            # es_search_lane_decisions_total{lane=,reason=} — one label
+            # pair per ladder decision; the old *_fallbacks/_errors
+            # counters above stay as aliases
+            "search_lane": (("lane", "reason"),
+                            device_stats.lane_decision_metrics()),
             "transfer": (None, transfer_snapshot()),
             "tasks": (None, self.tasks.stats()),
             # span tracer: started/retained/sampled-out trace counters,
@@ -2944,9 +3007,13 @@ class NodeService:
         """Flat gauge snapshot for the stats-history ring: the signals an
         incident inspection reaches for first (queue pressure, rejection,
         device-memory headroom, rates, batch coalescing, host health)."""
-        from .common import monitor
+        from .common import device_stats, monitor
         from .common.metrics import bulk_ingest_snapshot, device_events_snapshot
         _bulk_snap = bulk_ingest_snapshot()
+        _hbm = device_stats.hbm_poll()
+        _hbm_in_use = sum(v["bytes_in_use"] for v in _hbm.values())
+        _hbm_peak = max((v["high_water_bytes"] for v in _hbm.values()),
+                        default=0)
         pool = self.thread_pool.stats().get("search", {})
         br = self.breakers.stats()
         batcher = self._batcher.stats()
@@ -2975,6 +3042,11 @@ class NodeService:
             "docs": sum(s.doc_count() for s in self.indices.values()),
             "tasks_running": self.tasks.stats()["running"],
             "jit_compiles_total": device_events_snapshot()[0],
+            # per-device HBM residency (ISSUE 16): bytes_in_use tracks the
+            # live working set, hbm_peak the process high-water — the
+            # ring answers "what did device memory look like at 14:05"
+            "hbm_bytes_in_use": _hbm_in_use,
+            "hbm_peak_bytes": _hbm_peak,
             "request_cache_memory_bytes":
                 self.caches.request_cache.cache.memory_bytes,
             "request_cache_hits_total": self.caches.request_cache.cache.hits,

@@ -646,3 +646,14 @@ def ivf_nbytes(n_pad: int, nlist: int, dims: int) -> int:
     """Device residency estimate: centroids + CSR + norms (the cache tier's
     breaker charge)."""
     return nlist * dims * 4 + n_pad * 4 + nlist * 8 + n_pad * 4
+
+
+# dispatch accounting for the serving kernels (common/device_stats);
+# training kernels run once per build and are traced via pq_train spans
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+ivf_search = _instrument("ops:ivf_search", ivf_search)
+ivf_search_int8 = _instrument("ops:ivf_search_int8", ivf_search_int8)
+ivf_search_pq = _instrument("ops:ivf_search_pq", ivf_search_pq)
+rrf_fuse = _instrument("ops:rrf_fuse", rrf_fuse)
+weighted_fuse = _instrument("ops:weighted_fuse", weighted_fuse)

@@ -276,3 +276,15 @@ def idf(doc_freq, doc_count) -> jax.Array:
     df = jnp.asarray(doc_freq, jnp.float32)
     n = jnp.asarray(doc_count, jnp.float32)
     return jnp.log(1.0 + (n - df + 0.5) / (df + 0.5))
+
+
+# dispatch accounting (common/device_stats): these are the loop-lane scoring
+# kernels query_dsl dispatches per segment; in-trace calls pass through
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+bm25_score_batch = _instrument("ops:bm25_score_batch", bm25_score_batch)
+classic_score_batch = _instrument(
+    "ops:classic_score_batch", classic_score_batch)
+lm_dirichlet_score_batch = _instrument(
+    "ops:lm_dirichlet_score_batch", lm_dirichlet_score_batch)
+lm_jm_score_batch = _instrument("ops:lm_jm_score_batch", lm_jm_score_batch)

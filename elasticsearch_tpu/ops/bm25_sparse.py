@@ -341,3 +341,15 @@ def bm25_topk_sparse_masked(doc_ids: jax.Array, tf: jax.Array, dl: jax.Array,
                          jnp.take_along_axis(d, pos, axis=1), PAD)
     total_hits = jnp.sum(keep, axis=1, dtype=jnp.int32)
     return top, top_docs, total_hits
+
+
+# dispatch accounting: rebind the serving entry points so host-level calls
+# enter the device_stats registry (in-trace calls pass straight through)
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+bm25_topk_sparse = _instrument("ops:bm25_topk_sparse", bm25_topk_sparse)
+bm25_topk_sparse_masked = _instrument(
+    "ops:bm25_topk_sparse_masked", bm25_topk_sparse_masked)
+bm25_serve_packed = _instrument("ops:bm25_serve_packed", bm25_serve_packed)
+bm25_serve_packed_filtered = _instrument(
+    "ops:bm25_serve_packed_filtered", bm25_serve_packed_filtered)

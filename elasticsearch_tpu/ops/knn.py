@@ -104,3 +104,11 @@ def combine_scores(primary: jax.Array, secondary: jax.Array,
     if mode == "replace":
         return s
     raise ValueError(f"unknown score mode [{mode}]")
+
+
+# dispatch accounting: the module attrs callers import ARE the instrumented
+# wrappers (common/device_stats registry; in-trace calls pass through)
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+knn_topk = _instrument("ops:knn_topk", knn_topk)
+rescore_window = _instrument("ops:rescore_window", rescore_window)

@@ -152,7 +152,8 @@ class DistributedSearcher:
             fn, mesh=self.mesh,
             in_specs=(shard_specs,) * 5 + (query_specs,) * 3,
             out_specs=out_specs)
-        step = jax.jit(mapped)
+        from ..common.device_stats import instrument
+        step = instrument("dist:query_step", jax.jit(mapped), key=key)
         self._step_cache.put(key, step, weight=1)
         return step
 
@@ -189,11 +190,15 @@ class DistributedSearcher:
             out_s, pos = lax.top_k(g_s, min(k, S * kk))
             return out_s, jnp.take_along_axis(g_k, pos, axis=-1)
 
-        step = jax.jit(_shard_map(
-            knn_step, mesh=self.mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS),
-                      P(REPLICA_AXIS)),
-            out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS))))
+        from ..common.device_stats import instrument
+        step = instrument(
+            "dist:knn_step",
+            jax.jit(_shard_map(
+                knn_step, mesh=self.mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(REPLICA_AXIS),
+                          P(REPLICA_AXIS)),
+                out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)))),
+            key=key)
         self._step_cache.put(key, step, weight=1)
         return step
 

@@ -1091,9 +1091,13 @@ def _try_blockwise(stack: MeshStack, node: Node, stats, *, k: int,
            bplan.field_kinds, bplan.op_kinds, str(score_dtype))
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = _build_blockwise_program(stack.mesh, bplan, k=k,
-                                        n_queries=Qb, kk=kk,
-                                        score_dtype=score_dtype)
+        from ..common.device_stats import instrument
+        prog = instrument(
+            "mesh:blockwise",
+            _build_blockwise_program(stack.mesh, bplan, k=k,
+                                     n_queries=Qb, kk=kk,
+                                     score_dtype=score_dtype),
+            key=key)
         _PROGRAMS.put(key, prog, weight=1)
     args = []
     for name, kind in bplan.field_kinds:
@@ -1174,10 +1178,14 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
            agg_plan.sig if agg_plan is not None else None)
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = _build_program(
-            stack.mesh, devfn, field_kinds, op_kinds, k, q_pad // R,
-            agg_devfns=tuple(agg_plan.device_fns())
-            if agg_plan is not None else ())
+        from ..common.device_stats import instrument
+        prog = instrument(
+            "mesh:materialized",
+            _build_program(
+                stack.mesh, devfn, field_kinds, op_kinds, k, q_pad // R,
+                agg_devfns=tuple(agg_plan.device_fns())
+                if agg_plan is not None else ()),
+            key=key)
         _PROGRAMS.put(key, prog, weight=1)
     args = []
     for name, kind in field_kinds:

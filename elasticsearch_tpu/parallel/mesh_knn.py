@@ -320,9 +320,11 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     qmode = str(qmode).strip().lower()
     if qmode not in ("int8", "pq"):
         qmode = "none"
+    from ..common.device_stats import lane_decline
     if qmode == "pq":
         # PQ keeps the per-shard fan-out (see _QuantPack) — declining the
         # mesh lane honors the request's mode there
+        lane_decline("knn", "mesh_knn", "pq_mode")
         return None
 
     # the mesh kNN lane serves the IVF path only: the exact per-segment
@@ -332,9 +334,12 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     pack, qpack = _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe,
                                      exact, qmode, acquire_quant)
     if not isinstance(pack, _IvfPack):
+        lane_decline("knn", "mesh_knn", "knn_lane")
         return None
     if qmode != "none" and not isinstance(qpack, _QuantPack):
-        return None                  # a segment declined: fan-out decides
+        # a segment declined quantization: fan-out decides
+        lane_decline("knn", "mesh_knn", "quant_declined")
+        return None
     used_ivf = True
     used_quant = qpack.mode if isinstance(qpack, _QuantPack) else None
     ivf: _IvfPack = pack
@@ -360,6 +365,7 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     if filter_node is not None:
         fplan = _plan_filter(filter_node, filter_stack, q_pad)
         if fplan is None:
+            lane_decline("knn", "mesh_knn", "filter_shape")
             return None
         fsig, mfn, fpctx = fplan
         # the filter stack's rows must mirror the vector stack's rows so
@@ -369,6 +375,7 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
         f_ids = [[seg.seg_id for _i, seg in rows]
                  for rows in filter_stack.shard_rows]
         if v_ids != f_ids:
+            lane_decline("knn", "mesh_knn", "stack_rows_mismatch")
             return None
 
     kk = min(k, W) if used_ivf else min(k, vstack.n_pad)
@@ -389,11 +396,15 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
            if fplan is not None else None)
     prog = mesh_exec._PROGRAMS.get(key)
     if prog is None:
-        prog = _build_knn_program(
-            vstack, metric=metric, precision=precision, k=k, kk=kk,
-            n_queries=q_pad // R, used_ivf=used_ivf, nprobe=nprobe_eff,
-            W=W, block=block, nlist=ivf.nlist if used_ivf else 0,
-            quant=used_quant, rw=rw, fplan=fplan)
+        from ..common.device_stats import instrument
+        prog = instrument(
+            "mesh:knn",
+            _build_knn_program(
+                vstack, metric=metric, precision=precision, k=k, kk=kk,
+                n_queries=q_pad // R, used_ivf=used_ivf, nprobe=nprobe_eff,
+                W=W, block=block, nlist=ivf.nlist if used_ivf else 0,
+                quant=used_quant, rw=rw, fplan=fplan),
+            key=key)
         mesh_exec._PROGRAMS.put(key, prog, weight=1)
 
     args = [vstack.live_stack(), vstack.seg_ids_dev,

@@ -2656,6 +2656,20 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
                 "indexing": _filter(node.indexing_slowlog.snapshot())}}}
     c.register("GET", "/_nodes/slowlog", nodes_slowlog)
 
+    def nodes_device_stats(g, p, b):
+        # device telemetry (ISSUE 16): the per-compiled-program registry
+        # (top-N by cumulative dispatch time, with scrape-time XLA cost
+        # analysis — None fields on backends that report nothing), per-
+        # device HBM stats with the process high-water mark, and the
+        # global lane-decision counters
+        try:
+            top_n = int(p.get("top_n", [50])[0])
+        except (TypeError, ValueError):
+            top_n = 50
+        return 200, {"cluster_name": node.cluster_name, "nodes": {
+            "tpu-node-0": node.device_stats_payload(top_n=top_n)}}
+    c.register("GET", "/_nodes/device_stats", nodes_device_stats)
+
     def nodes_stats_history(g, p, b):
         # the StatsSampler ring (common/monitor.py): timestamped gauge
         # samples + min/max/avg rollups, so a spike BETWEEN two stats

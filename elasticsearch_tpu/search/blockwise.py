@@ -968,11 +968,15 @@ def _program_for(lane: str, bplan: BlockPlan, *, k: int, kk: int,
            str(score_dtype), encode_keys, want_mask)
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = _jit_program(bplan.devfn, bplan.field_kinds, bplan.op_kinds,
-                            g_pad=bplan.g_pad, block=bplan.block,
-                            nb=bplan.nb, n_queries=bplan.n_queries, kk=kk,
-                            k=k, score_dtype=score_dtype,
-                            encode_keys=encode_keys, want_mask=want_mask)
+        from ..common.device_stats import instrument
+        prog = instrument(
+            f"blockwise:{lane}",
+            _jit_program(bplan.devfn, bplan.field_kinds, bplan.op_kinds,
+                         g_pad=bplan.g_pad, block=bplan.block,
+                         nb=bplan.nb, n_queries=bplan.n_queries, kk=kk,
+                         k=k, score_dtype=score_dtype,
+                         encode_keys=encode_keys, want_mask=want_mask),
+            key=key)
         _PROGRAMS.put(key, prog, weight=1)
     return prog
 

@@ -41,8 +41,13 @@ def sort_docs(results: list[QuerySearchResult], *, from_: int, size: int,
     shard-ordinal tie-break). Field sorts compare MATERIALIZED values
     (strings/numbers), never ordinals — see search/sort.py."""
     t0 = time.perf_counter()
+    from ..common.device_stats import lane_chosen
     from ..common.metrics import record_host_merge
     record_host_merge()
+    # the fan-out's coordinator-side reduce: when the mesh lane serves, no
+    # host merge runs at all — this note marks which reduce path the
+    # request actually rode
+    lane_chosen("reduce", "host_merge")
     sort = sort_mod.normalize(sort)
     entries = []   # (primary_key, shard_idx, pos, doc_key, score, sort_val)
     total = 0

@@ -1564,3 +1564,9 @@ def _cosine_scores(vecs: jax.Array, qv: jax.Array) -> jax.Array:
     vn = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=1, keepdims=True), 1e-9)
     qn = qv / jnp.maximum(jnp.linalg.norm(qv, axis=1, keepdims=True), 1e-9)
     return qn @ vn.T
+
+
+# dispatch accounting for the script/function-score cosine kernel
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+_cosine_scores = _instrument("query:cosine_scores", _cosine_scores)

@@ -226,6 +226,8 @@ class ShardSearcher:
             track_scores = track_scores or any(
                 sp.field == sort_mod.SCORE for sp in sort)
 
+        from ..common.device_stats import lane_chosen, lane_decline
+        lane_comp = f"shard[{self.shard_id}].query"
         if sort is None and search_after is None:
             # the production fast path: sort-reduce sparse kernel
             # (ops/bm25_sparse) for the plan shapes that dominate traffic.
@@ -236,6 +238,10 @@ class ShardSearcher:
             from .sparse_exec import execute_sparse, extract_sparse_plan
             from .aggs.aggregators import has_top_hits
             plan = extract_sparse_plan(node)
+            if plan is None:
+                lane_decline(lane_comp, "sparse", "plan_shape")
+            elif aggs and has_top_hits(aggs):
+                lane_decline(lane_comp, "sparse", "top_hits")
             if plan is not None and not (aggs and has_top_hits(aggs)):
                 stats = self.build_stats(node, global_stats)
                 keys, scores, total, mx = execute_sparse(
@@ -251,6 +257,7 @@ class ShardSearcher:
                         a_masks.append(m[0])
                     agg_partials = collect_shard(aggs, a_segs, a_masks,
                                                  query_parser=self.parser)
+                lane_chosen(lane_comp, "sparse")
                 self.last_query_path = "sparse"
                 self.sparse_queries += 1
                 self._bump("sparse")
@@ -278,6 +285,10 @@ class ShardSearcher:
                 if out is not None:
                     return out
 
+        if sort is not None or search_after is not None:
+            # the device lanes above serve unsorted bodies only
+            lane_decline(lane_comp, "sparse", "sorted")
+        lane_chosen(lane_comp, "loop")
         self.last_query_path = "dense"
         self.last_dense_mode = "loop"
         self.last_block_mode = "materialized"
@@ -490,15 +501,19 @@ class ShardSearcher:
                      track_scores: bool,
                      aggs: list | None) -> QuerySearchResult | None:
         """One stacked execution attempt; None falls back to the loop."""
+        from ..common.device_stats import lane_decline
         try:
             stack = self._acquire_stack()
             if stack is None:
+                lane_decline(f"shard[{self.shard_id}].query", "stacked",
+                             "stack_declined")
                 return None
             return self._execute_stacked(stack, node, k=k, Q=Q,
                                          global_stats=global_stats,
                                          track_scores=track_scores,
                                          aggs=aggs)
         except Exception:  # noqa: BLE001 — the loop is always correct
+            lane_decline(f"shard[{self.shard_id}].query", "stacked", "error")
             self._bump("stacked_errors")
             return None
 
@@ -589,6 +604,10 @@ class ShardSearcher:
                                          scores=a_scores)
         # the stacked lane IS the dense lane (one program instead of G):
         # dense counters keep their meaning, `stacked` marks the mode
+        from ..common.device_stats import lane_chosen
+        lane_chosen(f"shard[{self.shard_id}].query",
+                    "stacked_blockwise" if self.last_block_mode == "blockwise"
+                    else "stacked")
         self.last_query_path = "dense"
         self.last_dense_mode = "stacked"
         self.dense_queries += 1
@@ -615,17 +634,23 @@ class ShardSearcher:
         columns (< max(min_docs, 2*nlist)), full-coverage requests
         (nprobe >= nlist — the exact kernel is bitwise-identical AND
         cheaper), breaker-declined or failed builds."""
+        from ..common.device_stats import lane_decline
         from ..ops import ann as ann_ops
+        comp = f"shard[{self.shard_id}].knn"
         opts = self.knn_opts
         if exact or not opts["ivf_enable"]:
+            lane_decline(comp, "ivf",
+                         "exact_requested" if exact else "ivf_disabled")
             return None, 0
         n_docs = seg.n_docs
         nlist = int(opts["nlist"]) or ann_ops.auto_nlist(n_docs)
         if n_docs < max(int(opts["min_docs"]), 2 * nlist):
+            lane_decline(comp, "ivf", "column_too_small")
             return None, 0
         nprobe = int(req_nprobe or opts["nprobe"]
                      or ann_ops.auto_nprobe(nlist))
         if nprobe >= nlist:
+            lane_decline(comp, "ivf", "full_coverage")
             return None, 0
         try:
             cache = getattr(seg, "ann_cache", None)
@@ -643,6 +668,7 @@ class ShardSearcher:
         except Exception:  # noqa: BLE001 — exact is always correct
             ivf = None
         if ivf is None:
+            lane_decline(comp, "ivf", "build_failed")
             self._bump("ann_fallbacks")
             return None, 0
         return ivf, min(nprobe, ivf.nlist)
@@ -654,10 +680,13 @@ class ShardSearcher:
         breaker-declined or failed builds — each counted
         (`ann_quantized_fallbacks`) and bitwise-harmless (the f32 IVF and
         exact kernels below are unchanged)."""
+        from ..common.device_stats import lane_decline
         from ..ops import ann as ann_ops
+        comp = f"shard[{self.shard_id}].knn"
         m = int(self.knn_opts.get("pq_m") or ann_ops.DEFAULT_PQ_M)
         if mode == "pq" and (m < 1 or vc.dims % m
                              or ivf.n_docs < ann_ops.PQ_CODES):
+            lane_decline(comp, "ann_quant", "pq_shape")
             self._bump("ann_quantized_fallbacks")
             return None
         try:
@@ -677,6 +706,7 @@ class ShardSearcher:
         except Exception:  # noqa: BLE001 — the f32 scan is always correct
             quant = None
         if quant is None:
+            lane_decline(comp, "ann_quant", "build_failed")
             self._bump("ann_quantized_fallbacks")
         return quant
 
@@ -823,6 +853,10 @@ class ShardSearcher:
         if prof is not None:
             prof.note_path("ann_quantized" if any_quant
                            else "ann" if any_ann else "knn")
+        from ..common.device_stats import lane_chosen
+        lane_chosen(f"shard[{self.shard_id}].knn",
+                    "ann_quantized" if any_quant
+                    else "ann" if any_ann else "exact")
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=None, total_hits=total, max_score=mx)
@@ -1040,3 +1074,9 @@ def _filter_source(src: dict, spec) -> dict:
             node = node.setdefault(p, {})
         node[parts[-1]] = v
     return out
+
+
+# dispatch accounting for the per-shard rowmax kernel (common/device_stats)
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+_masked_rowmax = _instrument("shard:masked_rowmax", _masked_rowmax)

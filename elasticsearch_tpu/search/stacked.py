@@ -719,3 +719,13 @@ _MATCH = {
     BoolNode: _m_bool,
     ConstantScoreNode: _m_const,
 }
+
+
+# dispatch accounting: the stacked-lane kernels enter the device_stats
+# registry (call sites resolve these module globals at call time)
+from ..common.device_stats import instrument as _instrument  # noqa: E402
+
+_bm25_stack = _instrument("stacked:bm25", _bm25_stack)
+_classic_stack = _instrument("stacked:classic", _classic_stack)
+_term_mask_stack = _instrument("stacked:term_mask", _term_mask_stack)
+stacked_reduce = _instrument("stacked:reduce", stacked_reduce)

@@ -56,6 +56,7 @@ class ParityOracle:
 
     def __init__(self, inject_fault: bool = False):
         self.checks = 0
+        self.lane_checks = 0
         self.mismatches: list[ParityMismatch] = []
         self._inject = inject_fault
 
@@ -70,6 +71,27 @@ class ParityOracle:
         if not ok:
             self.mismatches.append(ParityMismatch(label, body, a, b))
         return ok
+
+    def lane_check(self, label: str, rec, claimed) -> bool:
+        """Replay-rode-the-lane assertion (ISSUE 16): each parity label
+        CLAIMS which execution lane a side rode, and the lane-decision
+        flight recorder (common/device_stats.record_lanes) proves it.
+        This closes the oracle's silent failure mode — two replays that
+        BOTH fall back to the same ladder rung compare byte-equal while
+        the pair under test never actually ran. `claimed` is a lane name
+        or a tuple of acceptable lanes (ladders whose rung legitimately
+        depends on corpus state, e.g. quantized builds on young
+        segments)."""
+        self.lane_checks += 1
+        lanes = tuple([claimed] if isinstance(claimed, str) else claimed)
+        chosen = sorted({e["lane"] for e in rec.entries
+                         if e["reason"] == "chosen"}) if rec else []
+        if rec is not None and any(rec.chose(ln) for ln in lanes):
+            return True
+        self.mismatches.append(ParityMismatch(
+            f"lane[{label}]", {"claimed_lane": list(lanes)},
+            list(lanes), chosen))
+        return False
 
 
 # exception families a DISRUPTED cluster may legitimately surface: the

@@ -14,6 +14,7 @@ import copy
 import os
 import random
 
+from ...cluster.host_reduce import HOST_REDUCE_SETTING
 from ...common.settings import Settings
 from ...index.engine import SearcherLeakError
 from . import detectors
@@ -85,6 +86,7 @@ class ChaosReport:
         self.seed = seed
         self.rounds = 0
         self.parity_checks = 0
+        self.lane_checks = 0
         self.mismatches: list = []
         self.invariant_violations: list[str] = []
         self.disruptions: list[str] = []
@@ -98,6 +100,7 @@ class ChaosReport:
     def as_dict(self) -> dict:
         return {"seed": self.seed, "rounds": self.rounds,
                 "parity_checks": self.parity_checks,
+                "lane_checks": self.lane_checks,
                 "mismatches": len(self.mismatches),
                 "invariant_violations": len(self.invariant_violations),
                 "disruptions": list(self.disruptions),
@@ -142,6 +145,7 @@ class ChaosRunner:
             else:
                 os.environ["CHAOS_SEED"] = prev_seed
         self.report.parity_checks = self.oracle.checks
+        self.report.lane_checks = self.oracle.lane_checks
         self.report.mismatches = list(self.oracle.mismatches)
         problems = self.report.mismatches + self.report.invariant_violations
         if problems and self.opt.raise_on_failure:
@@ -220,13 +224,37 @@ class ChaosRunner:
                 self.node.force_merge(name)
             self.node.refresh(name)
 
+    def _search_lanes(self, index: str, body: dict):
+        """Search with the lane-decision flight recorder armed (ISSUE
+        16): returns (response, LaneRecorder) so the parity sweep can
+        assert the replay actually rode the lane its label claims."""
+        from ...common.device_stats import record_lanes
+        with record_lanes() as rec:
+            resp = self.node.search(index, copy.deepcopy(body))
+        return resp, rec
+
+    # lanes each twin may legitimately ride for the seeded text bodies:
+    # the packed serve lane coalesces packed-servable plans even for solo
+    # requests (on every twin), the sparse postings lane outranks the
+    # dense ladder for pure-term shapes, and blockwise only engages when
+    # the stack exceeds one block — so the claim is a set per twin, and
+    # the check still catches the real failure (a twin silently riding
+    # the LOOP lane because its configured dense lane declined)
+    _TWIN_LANES = {
+        "c-stacked": ("stacked", "stacked_blockwise", "sparse", "packed"),
+        "c-block": ("stacked", "stacked_blockwise", "sparse", "packed"),
+        "c-mesh": ("mesh", "sparse", "packed"),
+    }
+
     def _solo_parity_sweep(self) -> None:
         texts = self.solo_work.text_queries(8)
         for body in texts:
             ref = self.node.search("c-loop", copy.deepcopy(body))
             for name, _ in _TWINS[1:]:
-                got = self.node.search(name, copy.deepcopy(body))
-                self.oracle.compare(f"loop-vs-{name}", body, ref, got)
+                got, rec = self._search_lanes(name, body)
+                if self.oracle.compare(f"loop-vs-{name}", body, ref, got):
+                    self.oracle.lane_check(f"loop-vs-{name}", rec,
+                                           self._TWIN_LANES[name])
         # batched vs solo: the msearch lane coalesces compatible plans
         # into ONE Q>1 program; responses must equal the solo path's
         reqs = [({"index": "c-mesh"}, copy.deepcopy(b)) for b in texts[:4]]
@@ -240,30 +268,40 @@ class ChaosRunner:
         for body in self.solo_work.knn_queries(3):
             knn = body["knn"]
             exact = {**body, "knn": {**knn, "exact": True}}
-            ref = self.node.search("c-loop", copy.deepcopy(exact))
+            ref, ref_rec = self._search_lanes("c-loop", exact)
+            self.oracle.lane_check("knn-exact-ref", ref_rec, "exact")
             # IVF with nprobe >= nlist routes to the exact kernel —
             # documented bitwise parity, same index
             full = {**body, "knn": {**knn, "nprobe": 64}}
-            self.oracle.compare("ivf-full-vs-exact", body, ref,
-                                self.node.search("c-loop", full))
+            got, rec = self._search_lanes("c-loop", full)
+            self.oracle.compare("ivf-full-vs-exact", body, ref, got)
+            self.oracle.lane_check("ivf-full-vs-exact", rec, "exact")
             # the exact kernel across twins (mesh exact lane declines to
             # the fan-out; either way the result is the same program)
-            self.oracle.compare("knn-exact-loop-vs-mesh", body, ref,
-                                self.node.search("c-mesh",
-                                                 copy.deepcopy(exact)))
+            got, rec = self._search_lanes("c-mesh", exact)
+            self.oracle.compare("knn-exact-loop-vs-mesh", body, ref, got)
+            self.oracle.lane_check("knn-exact-loop-vs-mesh", rec, "exact")
             # int8 through the mesh lane vs the per-shard fan-out — the
             # documented quantized bitwise pair (f32-vs-quantized is
-            # approximate by design and is NOT compared)
+            # approximate by design and is NOT compared). The lane claim
+            # is conditional: whenever the fan-out side built the
+            # quantized tier, the mesh side must have rode mesh_knn —
+            # both sides quietly falling back to the same rung would
+            # pass parity without testing the pair at all
             int8 = {**body, "knn": {**knn, "quantization": "int8"}}
-            self.oracle.compare(
-                "knn-int8-loop-vs-mesh", body,
-                self.node.search("c-loop", copy.deepcopy(int8)),
-                self.node.search("c-mesh", copy.deepcopy(int8)))
+            ref8, ref8_rec = self._search_lanes("c-loop", int8)
+            got8, got8_rec = self._search_lanes("c-mesh", int8)
+            self.oracle.compare("knn-int8-loop-vs-mesh", body, ref8, got8)
+            if ref8_rec.chose("ann_quantized"):
+                self.oracle.lane_check("knn-int8-loop-vs-mesh", got8_rec,
+                                       "mesh_knn")
         fbody = self.solo_work.filtered_knn_query()
-        self.oracle.compare(
-            "knn-filtered-loop-vs-mesh", fbody,
-            self.node.search("c-loop", copy.deepcopy(fbody)),
-            self.node.search("c-mesh", copy.deepcopy(fbody)))
+        fref, fref_rec = self._search_lanes("c-loop", fbody)
+        fgot, fgot_rec = self._search_lanes("c-mesh", fbody)
+        self.oracle.compare("knn-filtered-loop-vs-mesh", fbody, fref, fgot)
+        if fref_rec.chose("ann"):
+            self.oracle.lane_check("knn-filtered-loop-vs-mesh", fgot_rec,
+                                   "mesh_knn")
 
     # -- cluster half -------------------------------------------------------
 
@@ -315,13 +353,30 @@ class ChaosRunner:
         bodies.append({"size": 5, "knn": {
             "field": "vec", "query_vector": self.cluster_work.vector(),
             "k": 5}})
+        from ...common.device_stats import record_lanes
         for body in bodies:
             try:
-                got = client.search("docs", copy.deepcopy(body))
+                with record_lanes() as got_rec:
+                    got = client.search("docs", copy.deepcopy(body))
                 self._set_cluster_setting(
                     "cluster.search.host_reduce.enable", False)
-                want = client.search("docs", copy.deepcopy(body))
+                with record_lanes() as want_rec:
+                    want = client.search("docs", copy.deepcopy(body))
                 self.oracle.compare("host-reduce-vs-fanout", body, want, got)
+                # lane claims (ISSUE 16): with the setting ON the
+                # coordinator must at least CONSULT the host-reduce
+                # ladder (a chosen lane or an explained decline —
+                # contextvars ride the per-host fan-out threads); with it
+                # OFF, riding host_reduce anyway means the toggle is dead
+                if not any(e["lane"] == "host_reduce"
+                           for e in got_rec.entries):
+                    self.report.invariant_violations.append(
+                        f"host-reduce ladder never consulted with "
+                        f"{HOST_REDUCE_SETTING}=true for {body!r}")
+                if want_rec.chose("host_reduce"):
+                    self.report.invariant_violations.append(
+                        f"host_reduce lane rode with "
+                        f"{HOST_REDUCE_SETTING}=false for {body!r}")
             finally:
                 self._set_cluster_setting(
                     "cluster.search.host_reduce.enable", True)

@@ -86,7 +86,12 @@ def test_tail_latency_keys_survive_forced_timeout():
                 # rebalance-under-load (ISSUE 15): same seeded-null
                 # contract
                 "rebalance_p99_ms", "rebalance_move_s",
-                "recovery_throttle_bytes_per_sec", "decider_vetoes"):
+                "recovery_throttle_bytes_per_sec", "decider_vetoes",
+                # device telemetry flight recorder (ISSUE 16): same
+                # seeded-null contract — the flight sidecar rides the
+                # emergency line even when a kill lands mid-leg
+                "xla_compile_ms_total", "hbm_peak_bytes",
+                "lane_decision_counts", "flight"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

@@ -439,3 +439,51 @@ def test_quantized_refresh_cycles_zero_retraces(tmp_path_factory):
     assert device_events_snapshot()[0] == before, \
         "refresh→query inside the pow2 bucket retraced the quantized lane"
     n.close()
+
+
+# -- device telemetry program registry (ISSUE 16) ---------------------------
+
+def test_program_registry_adds_zero_retraces_and_host_syncs(stacked_node):
+    """The per-program registry (common/device_stats) wraps the module-
+    level kernels and plan-cache programs in accounting shims: a warm
+    dispatch through the wrappers must compile NOTHING and perform no
+    extra device fetches (the shim is two perf_counter reads + dict
+    updates — never a host sync)."""
+    from elasticsearch_tpu.common import device_stats
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))    # warm
+    inv0 = device_stats.registry_snapshot(top_n=0)["invocations_total"]
+    c0 = device_events_snapshot()[0]
+    f0 = transfer_snapshot()["device_fetches_total"]
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))
+    assert device_events_snapshot()[0] - c0 == 0, \
+        "instrumented dispatch retraced"
+    assert transfer_snapshot()["device_fetches_total"] - f0 == \
+        len(n.indices["s"].shards), \
+        "the registry shim must not add device fetches"
+    assert device_stats.registry_snapshot(top_n=0)["invocations_total"] \
+        > inv0, "the warm dispatch must land in the program registry"
+
+
+def test_device_stats_scrape_compiles_nothing(stacked_node):
+    """A device_stats scrape WITH cost analysis re-lowers captured avals
+    — `Lowered.cost_analysis()` runs no backend compile — so the scrape
+    fires zero compile events and the next dispatch sees a warm cache."""
+    from elasticsearch_tpu.common import device_stats
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))    # warm
+    c0 = device_events_snapshot()[0]
+    snap = device_stats.registry_snapshot(top_n=50, with_cost=True)
+    assert snap["program_count"] > 0
+    assert device_events_snapshot()[0] - c0 == 0, \
+        "forcing cost analysis fired compile events"
+    n.search("s", json.loads(json.dumps(STACKED_BODY)))
+    assert device_events_snapshot()[0] - c0 == 0, \
+        "the scrape invalidated the jit cache (retrace after scrape)"

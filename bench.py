@@ -90,7 +90,13 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # device telemetry flight recorder (ISSUE 16): seeded
                      # null at import so a forced timeout still emits them
                      "xla_compile_ms_total": None, "hbm_peak_bytes": None,
-                     "lane_decision_counts": None, "flight": None}
+                     "lane_decision_counts": None, "flight": None,
+                     # log-analytics observability tier (ISSUE 17):
+                     # seeded null at import so a forced timeout still
+                     # emits them
+                     "sorted_mesh_qps": None, "sorted_fanout_qps": None,
+                     "subagg_mesh_qps": None,
+                     "monitoring_overview_p50_ms": None}
 _LINE_PRINTED = False
 
 
@@ -579,6 +585,76 @@ def run_multiseg_leg(tag: str) -> dict:
                     time.perf_counter() - t0, 1e-9)
             out["mesh_agg_dispatches"] = node.indices["live_mesh"] \
                 .search_stats.get("mesh_agg_dispatches", 0)
+
+            # sorted + 2-level sub-agg tree through the dense lanes
+            # (ISSUE 17): the log-analytics shape — newest-first sort
+            # and a histogram -> metrics tree — through the mesh
+            # program vs the thread-pool fan-out over per-shard sorted
+            # stacked programs, on the same corpus
+            sorted_body = json.dumps({
+                "size": 10, "query": {"match": {"body": words[0]}},
+                "sort": [{"n": "desc"}]})
+            subagg_body = json.dumps({
+                "size": 0, "query": {"match": {"body": words[0]}},
+                "aggs": {"h": {
+                    "histogram": {"field": "n", "interval": 64},
+                    "aggs": {"mx": {"max": {"field": "n"}},
+                             "c": {"value_count": {"field": "n"}}}}}})
+            s_reps = min(reps, 40)
+
+            def observability_qps(name: str, body: str):
+                http(port, "POST",
+                     f"/{name}/_search?request_cache=false", body)  # warm
+                t0 = time.perf_counter()
+                served = 0
+                for _ in range(s_reps):
+                    http(port, "POST",
+                         f"/{name}/_search?request_cache=false", body)
+                    served += 1
+                    if _over_budget(margin=30.0):
+                        break
+                return served / max(time.perf_counter() - t0, 1e-9)
+
+            if not _over_budget(margin=45.0):
+                out["sorted_mesh_qps"] = observability_qps(
+                    "live_mesh", sorted_body)
+                out["sorted_fanout_qps"] = observability_qps(
+                    "live_fanout", sorted_body)
+                out["subagg_mesh_qps"] = observability_qps(
+                    "live_mesh", subagg_body)
+                out["subagg_fanout_qps"] = observability_qps(
+                    "live_fanout", subagg_body)
+                out["mesh_sorted_dispatches"] = node.indices["live_mesh"] \
+                    .search_stats.get("mesh_sorted_dispatches", 0)
+                if out.get("sorted_fanout_qps"):
+                    out["sorted_mesh_speedup"] = (out["sorted_mesh_qps"]
+                                                  / out["sorted_fanout_qps"])
+                if out.get("subagg_fanout_qps"):
+                    out["subagg_mesh_speedup"] = (out["subagg_mesh_qps"]
+                                                  / out["subagg_fanout_qps"])
+
+            # the self-monitoring overview end to end (ISSUE 17
+            # tentpole (c)): sampler snapshots drain into
+            # .monitoring-es-* via the bulk lane, and GET
+            # /_monitoring/overview answers with the sorted + 2-level
+            # sub-agg body through the device lanes
+            if not _over_budget(margin=40.0):
+                from elasticsearch_tpu.common.monitoring import \
+                    MonitoringCollector
+                node.monitoring = MonitoringCollector(node, interval_s=0)
+                for _ in range(24):
+                    node.sampler.sample()
+                node.monitoring.collect_once()
+                http(port, "GET", "/_monitoring/overview")       # warm
+                lat = []
+                for _ in range(min(reps, 20)):
+                    t0 = time.perf_counter()
+                    http(port, "GET", "/_monitoring/overview")
+                    lat.append((time.perf_counter() - t0) * 1000)
+                    if _over_budget(margin=30.0):
+                        break
+                lat.sort()
+                out["monitoring_overview_p50_ms"] = lat[len(lat) // 2]
         return out
     finally:
         server.stop()

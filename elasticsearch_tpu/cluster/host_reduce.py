@@ -19,11 +19,23 @@ the program's gathered outputs. The coordinator's `_reduce` therefore
 merges host-reduced and per-shard results identically, bit-for-bit:
 "ICI collectives intra-host, DCN only between hosts" (SURVEY §5.8).
 
-Fallback ladder: anything without a single-program form — sorted bodies,
-unsupported plan/agg shapes, mixed IVF/exact vector lanes, missing DFS
-stats for term queries, undersized meshes, any execution error — returns
-a decline and the coordinator falls back to the per-shard hedged fan-out
-for that host's shards.
+SORTED bodies (ISSUE 17) ride the same seam: the data node runs the
+group's shards through `mesh_exec.execute_sorted` over the encoded key
+columns (search/sort_encode.py, cross-shard keyword vocab included) and
+decomposes the merged candidate list with MATERIALIZED per-hit `sort`
+arrays — real strings/numbers in the per-shard fan-out's wire format, so
+the coordinator's `compare_key` merge across hosts stays bitwise
+identical. Sub-agg trees flow through untouched: `mesh_aggs.plan_aggs`
+flattens them into composite bins and the agg wire codec already
+round-trips nested `subs` partials.
+
+Fallback ladder: anything without a single-program form — unsupported
+plan/agg/sort shapes (sort_encode.decline_reason, calendar-interval or
+float-keyed sub-agg trees), `_doc` sorts over a non-prefix shard group,
+mixed IVF/exact vector lanes, missing DFS stats for term queries,
+undersized meshes, any execution error — returns a decline and the
+coordinator falls back to the per-shard hedged fan-out for that host's
+shards.
 """
 
 from __future__ import annotations
@@ -37,10 +49,10 @@ HOST_REDUCE_SETTING = "cluster.search.host_reduce.enable"
 
 def body_eligible(body: dict) -> bool:
     """Coordinator-side pre-flight: body shapes the host reduce can ever
-    serve (the data node makes the finer plan-level call)."""
-    return (body.get("sort") is None
-            and body.get("search_after") in (None, [])
-            and not body.get("rescore")
+    serve (the data node makes the finer plan-level call). Sorted bodies
+    and search_after cursors are eligible since ISSUE 17 — the data node
+    declines the encodings the device sort cannot bitwise-reproduce."""
+    return (not body.get("rescore")
             and not body.get("suggest")
             and body.get("rank") is None)
 
@@ -79,21 +91,38 @@ def try_host_reduce(node, index: str, sids: list[int], body: dict,
     knn = body.get("knn")
     agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations")) \
         if (body.get("aggs") or body.get("aggregations")) else None
+    sort_specs = None
+    if body.get("sort") is not None:
+        from ..search.sort import parse_sort
+        try:
+            sort_specs = parse_sort(body["sort"],
+                                    [node._mappers[index]])
+        except Exception:  # noqa: BLE001 — the per-shard phase reports
+            return _declined("sort_parse")
+    if body.get("search_after") and sort_specs is None:
+        # the per-shard phase raises the user-facing error; keep the
+        # error on that path instead of swallowing it here
+        return _declined("search_after_no_sort")
 
     if knn is not None:
         if agg_specs:
             return _declined("knn_aggs")
+        if sort_specs is not None:
+            return _declined("knn_sort")
         out = _knn_host_reduce(node, index, sids, searchers, knn, k)
         agg_specs = None
     else:
         out = _query_host_reduce(node, index, sids, searchers, body,
-                                 agg_specs, k, dfs)
+                                 agg_specs, k, dfs, sort_specs)
     if isinstance(out, tuple) and out[0] is None:
         return _declined(out[1])
     keys, shard_of, scores, totals, mxs, agg_parts = out
     lane_chosen("cluster_reduce", "host_reduce")
+    track = bool(body.get("track_scores", False)) \
+        if sort_specs is not None else True
     return _decompose(searchers, sids, keys, shard_of, scores, totals,
-                      mxs, agg_parts, agg_specs), None
+                      mxs, agg_parts, agg_specs, sort_specs=sort_specs,
+                      track_scores=track), None
 
 
 def _index_setting(node, index: str):
@@ -113,7 +142,7 @@ def _mesh_group_name(index: str, sids: list[int]) -> str:
 
 
 def _query_host_reduce(node, index, sids, searchers, body, agg_specs,
-                       k, dfs):
+                       k, dfs, sort_specs=None):
     from . import node as cluster_node_mod
     from ..parallel import mesh_exec
     from ..search.query_dsl import contains_joins
@@ -153,6 +182,21 @@ def _query_host_reduce(node, index, sids, searchers, body, agg_specs,
         [list(s.segments) for s in searchers])
     if stack is None:
         return None, "stack"
+    if sort_specs is not None:
+        from ..search.sort import DOC
+        if any(sp.field == DOC for sp in sort_specs) \
+                and list(sids) != list(range(len(sids))):
+            # `_doc` encoded keys (and cursors) embed the mesh ROW as
+            # the shard id; rows only coincide with real shard ids when
+            # the group is exactly shards 0..n-1 of the index
+            return None, "doc_sort_rows"
+        out = mesh_exec.execute_sorted(
+            stack, tree, stats, sort_specs,
+            body.get("search_after") or None, k=k, Q=1,
+            agg_specs=agg_specs)
+        if out is None:
+            return None, "sorted_lane"
+        return out
     out = mesh_exec.execute(
         stack, tree, stats, k=k, Q=1,
         block_docs=(block_docs or DEFAULT_BLOCK_DOCS) if blockwise
@@ -207,16 +251,30 @@ def _knn_host_reduce(node, index, sids, searchers, knn, k):
 
 
 def _decompose(searchers, sids, keys, shard_of, scores, totals, mxs,
-               agg_parts, agg_specs) -> dict:
+               agg_parts, agg_specs, sort_specs=None,
+               track_scores=True) -> dict:
     """Merged device outputs -> per-shard wire results. Entries keep
     their per-shard rank order (a prefix of each shard's own top-k), so
-    the coordinator's (score, target, pos) merge order is preserved."""
+    the coordinator's (score, target, pos) merge order is preserved.
+    Sorted bodies additionally materialize each hit's user-facing `sort`
+    array (real strings/numbers, the REAL shard id for `_doc`) so the
+    coordinator's compare_key merge sees the per-shard fan-out's exact
+    wire values."""
+    from .node import _jsonval
+    from ..search import sort as sort_mod
+
     out: dict[str, dict] = {}
     for pos, sid in enumerate(sids):
         mx = float(mxs[pos, 0])
-        out[str(sid)] = {"ids": [], "scores": [], "sort": None,
+        if sort_specs is not None and not track_scores:
+            # the sorted loop reports NaN max_score unless track_scores
+            mxv = None
+        else:
+            mxv = mx if np.isfinite(mx) else None
+        out[str(sid)] = {"ids": [], "scores": [],
+                        "sort": [] if sort_specs is not None else None,
                         "total": int(totals[pos, 0]),
-                        "max_score": mx if np.isfinite(mx) else None}
+                        "max_score": mxv}
     row_k, row_sh, row_s = keys[0], shard_of[0], scores[0]
     for j in range(row_k.shape[0]):
         key = int(row_k[j])
@@ -229,6 +287,10 @@ def _decompose(searchers, sids, keys, shard_of, scores, totals, mxs,
         # contract as _shard_query_phase: fetch may race a flush/merge)
         wire["ids"].append(seg.ids[key & LOCAL_MASK])
         sc = float(row_s[j])
+        if sort_specs is not None:
+            sc = sc if track_scores else float("nan")
+            wire["sort"].append(_jsonval(sort_mod.materialize(
+                seg, sort_specs, key & LOCAL_MASK, sc, key, sids[pos])))
         wire["scores"].append(None if sc != sc else sc)
     if agg_parts is not None and agg_specs is not None:
         from ..search.aggs.wire import partials_to_wire

@@ -1,0 +1,239 @@
+"""Self-monitoring pipeline (ISSUE 17 tentpole (c)): the node observes
+itself through the exact lanes this repo builds.
+
+A `node.monitoring.enable` collector drains the StatsSampler ring
+(common/monitor.py) into rolling `.monitoring-es-YYYY.MM.DD` internal
+indices on a cadence — every snapshot becomes one document through the
+VECTORIZED bulk lane (`NodeService.bulk`, index/bulk_ingest.py), so
+monitoring ingest rides the same batched-analysis columnar path as user
+traffic. ILM-lite: the target index rolls daily (UTC) and indices older
+than `node.monitoring.retention_days` are deleted on the same tick.
+
+`overview()` serves `GET /_monitoring/overview` by issuing a REAL sorted
++ two-level sub-agg search body (`sort: @timestamp desc` +
+`date_histogram -> terms -> avg/max`) against the newest monitoring
+index — the query that exercises the ISSUE 17 sorted and sub-agg-tree
+device lanes end to end (the index is created with 2 shards so the mesh
+lane is eligible). The response carries the lane the search actually
+took via the same search_stats counters the lane recorder feeds.
+
+Leak hygiene (tier-1 contract): the collector thread is a daemon, joins
+on `close()`, and every index it creates goes through the ordinary
+IndexService lifecycle — breaker ledgers and caches drain on delete, so
+the suite-wide `leak_report()` teardown stays clean.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+INDEX_PREFIX = ".monitoring-es-"
+ENABLE_SETTING = "node.monitoring.enable"
+INTERVAL_SETTING = "node.monitoring.interval"
+RETENTION_SETTING = "node.monitoring.retention_days"
+
+# 2 shards: the overview's sorted + sub-agg body needs >1 searcher for
+# the mesh gate; snapshots are tiny, so the split costs nothing
+MONITORING_SETTINGS = {"number_of_shards": 2, "number_of_replicas": 0}
+MONITORING_MAPPING = {"_doc": {"properties": {
+    "@timestamp": {"type": "date"},
+    "node": {"type": "string", "index": "not_analyzed"},
+    "kind": {"type": "string", "index": "not_analyzed"},
+}}}
+
+
+def _enabled(settings) -> bool:
+    v = settings.get(ENABLE_SETTING, False)
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "yes", "on")
+    return bool(v)
+
+
+class MonitoringCollector:
+    """Rolling-index writer over the sampler ring + the overview query.
+
+    `clock` injects deterministic time for tests (same convention as
+    StatsSampler); `interval_s <= 0` skips the thread — tests drive
+    `collect_once()` directly."""
+
+    def __init__(self, node, interval_s: float = 10.0,
+                 retention_days: int = 3, clock=None):
+        self.node = node
+        self.interval_s = float(interval_s)
+        self.retention_days = int(retention_days)
+        self._clock = clock or time.time
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_ts = 0
+        self.stats = {"collections_total": 0, "docs_indexed_total": 0,
+                      "rollovers_total": 0, "retention_deletes_total": 0,
+                      "errors_total": 0}
+        self.current_index: str | None = None
+
+    @classmethod
+    def from_settings(cls, node):
+        """None unless `node.monitoring.enable` is set — monitoring is
+        opt-in so plain test nodes never grow internal indices."""
+        if not _enabled(node.settings):
+            return None
+        try:
+            interval = float(node.settings.get(INTERVAL_SETTING, 10))
+        except (TypeError, ValueError):
+            interval = 10.0
+        try:
+            retention = int(node.settings.get(RETENTION_SETTING, 3))
+        except (TypeError, ValueError):
+            retention = 3
+        return cls(node, interval_s=interval, retention_days=retention)
+
+    # -- naming / rollover --------------------------------------------------
+
+    def index_for(self, ts_ms: int) -> str:
+        day = time.gmtime(ts_ms / 1000.0)
+        return f"{INDEX_PREFIX}{day.tm_year:04d}." \
+               f"{day.tm_mon:02d}.{day.tm_mday:02d}"
+
+    def _day_of(self, name: str):
+        try:
+            y, m, d = name[len(INDEX_PREFIX):].split(".")
+            return (int(y), int(m), int(d))
+        except (ValueError, IndexError):
+            return None
+
+    # -- the collection tick ------------------------------------------------
+
+    def collect_once(self) -> int:
+        """Drain sampler entries newer than the last tick into today's
+        index via ONE bulk, refresh it (the overview reads its own
+        writes), roll/retire daily indices. Returns docs indexed."""
+        node = self.node
+        samples = node.sampler.history().get("samples", [])
+        fresh = [s for s in samples if s["timestamp"] > self._last_ts]
+        self.stats["collections_total"] += 1
+        if not fresh:
+            self._apply_retention()
+            return 0
+        name = self.index_for(fresh[-1]["timestamp"])
+        if name not in node.indices:
+            from ..node import IndexAlreadyExistsException
+            try:
+                node.create_index(name, dict(MONITORING_SETTINGS),
+                                  {k: dict(v) for k, v in
+                                   MONITORING_MAPPING.items()})
+            except IndexAlreadyExistsException:
+                pass
+        if self.current_index is not None and name != self.current_index:
+            self.stats["rollovers_total"] += 1
+        self.current_index = name
+        node_name = getattr(node, "node_name", "tpu-node-0")
+        ops = []
+        for s in fresh:
+            doc = {"@timestamp": int(s["timestamp"]),
+                   "node": node_name, "kind": "node_stats"}
+            doc.update(s.get("metrics") or {})
+            ops.append(("index",
+                        {"_index": name,
+                         "_id": f"{node_name}-{s['timestamp']}"},
+                        doc))
+        node.bulk(ops)
+        node.indices[name].refresh()
+        self._last_ts = fresh[-1]["timestamp"]
+        self.stats["docs_indexed_total"] += len(ops)
+        self._apply_retention()
+        return len(ops)
+
+    def _apply_retention(self) -> None:
+        """Delete monitoring indices whose UTC day is older than
+        `retention_days` days before today (daily granularity — the
+        ILM-lite delete phase)."""
+        import datetime
+        today = datetime.datetime.utcfromtimestamp(self._clock()).date()
+        cutoff = today - datetime.timedelta(days=self.retention_days)
+        for name in sorted(self.node.indices):
+            if not name.startswith(INDEX_PREFIX):
+                continue
+            day = self._day_of(name)
+            if day is None:
+                continue
+            try:
+                when = datetime.date(*day)
+            except ValueError:
+                continue
+            if when < cutoff:
+                self.node.delete_index(name)
+                self.stats["retention_deletes_total"] += 1
+
+    # -- thread lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None or self.interval_s <= 0:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.collect_once()
+                except Exception:  # noqa: BLE001 — never break serving
+                    self.stats["errors_total"] += 1
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="es[monitoring_collector]")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- GET /_monitoring/overview ------------------------------------------
+
+    OVERVIEW_METRICS = ("heap_used_bytes", "hbm_bytes_in_use")
+
+    def overview_body(self, size: int = 10,
+                      interval: str = "1m") -> dict:
+        """The canned sorted + 2-level sub-agg body: newest samples
+        first, a `date_histogram -> terms(node) -> avg/max` tree over
+        the gauges an incident inspection reads first."""
+        return {
+            "size": size,
+            "query": {"match_all": {}},
+            "sort": [{"@timestamp": "desc"}],
+            "aggs": {"over_time": {
+                "date_histogram": {"field": "@timestamp",
+                                   "interval": interval},
+                "aggs": {"by_node": {
+                    "terms": {"field": "node"},
+                    "aggs": {
+                        "avg_heap": {"avg":
+                                     {"field": "heap_used_bytes"}},
+                        "max_hbm": {"max":
+                                    {"field": "hbm_bytes_in_use"}},
+                    }}}}},
+        }
+
+    def overview(self, size: int = 10, interval: str = "1m") -> dict:
+        node = self.node
+        names = sorted(n for n in node.indices
+                       if n.startswith(INDEX_PREFIX)
+                       and self._day_of(n) is not None)
+        meta = {"enabled": True, "interval_s": self.interval_s,
+                "retention_days": self.retention_days,
+                "indices": names, "collector": dict(self.stats)}
+        if not names:
+            return {"monitoring": meta, "hits": {"total": 0,
+                                                 "max_score": None,
+                                                 "hits": []},
+                    "aggregations": {}}
+        target = names[-1]          # newest day: one index, mesh-eligible
+        svc = node.indices[target]
+        before = {k: svc.search_stats.get(k, 0)
+                  for k in ("mesh_sorted_dispatches",
+                            "mesh_agg_dispatches")}
+        resp = node.search(target, self.overview_body(size=size,
+                                                      interval=interval))
+        meta["index"] = target
+        meta["lanes"] = {k: svc.search_stats.get(k, 0) - before[k]
+                         for k in before}
+        resp["monitoring"] = meta
+        return resp

@@ -240,6 +240,14 @@ class NodeService:
         self.sampler = StatsSampler(self._sampler_snapshot,
                                     interval_s=interval)
         self.sampler.start()
+        # self-monitoring collector (ISSUE 17 tentpole (c)): opt-in
+        # sampler->`.monitoring-es-*` pipeline through the bulk lane,
+        # served back by GET /_monitoring/overview via the sorted +
+        # sub-agg device lanes (common/monitoring.py)
+        from .common.monitoring import MonitoringCollector
+        self.monitoring = MonitoringCollector.from_settings(self)
+        if self.monitoring is not None:
+            self.monitoring.start()
         self.lifecycle.move_to_started()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -1136,16 +1144,20 @@ class NodeService:
             # shard_map program with ONE device fetch and zero host-side
             # per-shard merges. kNN bodies ride their own mesh program
             # (parallel/mesh_knn: exact matmul or IVF under the sharded
-            # axis). Sorted/search_after/rescore/rank bodies, cross-host
-            # shards and unsupported plan/agg shapes fall through to the
-            # fan-out.
+            # axis). Sorted bodies ride the encoded-key sorted program
+            # (ISSUE 17, mesh_exec.execute_sorted) — ineligible encodings
+            # decline with a stable reason. Rescore/rank bodies,
+            # cross-host shards and unsupported plan/agg shapes fall
+            # through to the fan-out.
             if (len(names) == 1 and len(searchers) > 1 and knn is None
-                    and sort is None and search_after is None
                     and rescore_spec is None):
                 mesh_out = self._try_mesh(
                     names[0], searchers, nodes_by_index[names[0]],
                     global_stats, size=size, from_=from_,
-                    agg_specs=agg_specs or None)
+                    agg_specs=agg_specs or None, sort=sort,
+                    search_after=search_after,
+                    track_scores=bool(body.get("track_scores", False))
+                    if sort is not None else True)
                 if mesh_out is not None:
                     mesh_rows, mesh_aggs_merged = mesh_out
                     mesh_reduced = mesh_rows[0] if mesh_rows else None
@@ -1751,17 +1763,20 @@ class NodeService:
 
     def _try_mesh(self, name: str, searchers, node_tree, global_stats, *,
                   size: int, from_: int, n_queries: int = 1,
-                  agg_specs=None):
-        """One mesh-lane attempt for an unsorted multi-shard query batch:
-        returns (per-row ReducedDocs list, merged agg partial | None) from
-        the on-device collective reduce (single searches take row 0), or
+                  agg_specs=None, sort=None, search_after=None,
+                  track_scores: bool = True):
+        """One mesh-lane attempt for a multi-shard query batch: returns
+        (per-row ReducedDocs list, merged agg partial | None) from the
+        on-device collective reduce (single searches take row 0), or
         None to fall back to the PR-4 concurrent fan-out (opt-out
         settings, joins, unsupported plan/agg shapes, too few devices,
         breaker-declined/oversized mesh stacks, or any execution error).
 
         With `agg_specs`, the agg tree rides the SAME program
         (parallel/mesh_aggs.py) — the merged partial equals the fan-out's
-        per-shard collect + host merge bit-for-bit."""
+        per-shard collect + host merge bit-for-bit. With `sort`, the
+        encoded-key sorted program (ISSUE 17) replaces the host merge;
+        winners' user-facing sort values materialize host-side per hit."""
         from .common.device_stats import lane_chosen, lane_decline
         svc = self.indices[name]
         if not svc._mesh_enabled \
@@ -1791,13 +1806,20 @@ class NodeService:
                 return None
             with tracing.span("mesh_reduce", index=name,
                               shards=len(searchers), k=k):
-                out = mesh_exec.execute(
-                    stack, node_tree, global_stats, k=k, Q=n_queries,
-                    block_docs=svc._block_docs
-                    if svc._blockwise_enabled else None,
-                    agg_specs=agg_specs)
+                if sort is not None:
+                    out = mesh_exec.execute_sorted(
+                        stack, node_tree, global_stats, sort,
+                        search_after, k=k, Q=n_queries,
+                        agg_specs=agg_specs)
+                else:
+                    out = mesh_exec.execute(
+                        stack, node_tree, global_stats, k=k, Q=n_queries,
+                        block_docs=svc._block_docs
+                        if svc._blockwise_enabled else None,
+                        agg_specs=agg_specs)
             if out is None:
-                # plan/agg shape has no collective form (field shapes)
+                # plan/agg shape has no collective form (field shapes),
+                # or the sort encoding declined (reason already recorded)
                 lane_decline("query", "mesh",
                              "agg_shape" if agg_specs else "plan_shape")
                 if agg_specs:
@@ -1813,6 +1835,9 @@ class NodeService:
         svc.search_stats["mesh"] = svc.search_stats.get("mesh", 0) + 1
         svc.search_stats["mesh_dispatches"] = \
             svc.search_stats.get("mesh_dispatches", 0) + 1
+        if sort is not None:
+            svc.search_stats["mesh_sorted_dispatches"] = \
+                svc.search_stats.get("mesh_sorted_dispatches", 0) + 1
         if agg_specs:
             svc.search_stats["mesh_agg_dispatches"] = \
                 svc.search_stats.get("mesh_agg_dispatches", 0) + 1
@@ -1824,8 +1849,14 @@ class NodeService:
         prof = current_profiler()
         if prof is not None:
             prof.note_path("mesh")
-        rows = _mesh_rows(keys, shard_of, scores, totals, mxs,
-                          n_queries=n_queries, size=size, from_=from_)
+        if sort is not None:
+            rows = _mesh_rows_sorted(
+                keys, shard_of, scores, totals, mxs, searchers,
+                n_queries=n_queries, size=size, from_=from_, sort=sort,
+                track_scores=track_scores)
+        else:
+            rows = _mesh_rows(keys, shard_of, scores, totals, mxs,
+                              n_queries=n_queries, size=size, from_=from_)
         agg_merged = None
         if agg_per_shard is not None:
             from .search.aggs.aggregators import merge_shard_partials
@@ -2901,6 +2932,15 @@ class NodeService:
                 path_totals.get("mesh_agg_dispatches", 0),
             "mesh_agg_fallbacks_total":
                 path_totals.get("mesh_agg_fallbacks", 0),
+            # sorted queries through the dense lanes (ISSUE 17): encoded
+            # sort keys ranked on device by the per-shard stacked program
+            # vs the whole-index mesh collective — bodies that decline
+            # the encoding still ride the loop and show up in the lane
+            # decision family below, not here
+            "stacked_sorted_queries_total":
+                path_totals.get("stacked_sorted", 0),
+            "mesh_sorted_dispatches_total":
+                path_totals.get("mesh_sorted_dispatches", 0),
             "mesh_ann_dispatches_total":
                 path_totals.get("mesh_ann_dispatches", 0),
             "mesh_ann_fallbacks_total":
@@ -3117,6 +3157,8 @@ class NodeService:
         if not self.lifecycle.move_to_closed():
             return                      # idempotent double-close
         self.watcher.stop()
+        if getattr(self, "monitoring", None) is not None:
+            self.monitoring.close()     # joins the collector thread
         if getattr(self, "sampler", None) is not None:
             self.sampler.stop()
         if getattr(self, "_maint_stop", None) is not None:
@@ -3236,6 +3278,49 @@ def _mesh_rows(keys, shard_of, scores, totals, mxs, *, n_queries: int,
             doc_keys=[int(x) for x in vk[window]],
             scores=[float(x) for x in vs[window]],
             sort_values=None,
+            total_hits=int(totals[:, qi].sum()),
+            max_score=mxv if _math.isfinite(mxv) else float("nan")))
+    return rows
+
+
+def _mesh_rows_sorted(keys, shard_of, scores, totals, mxs, searchers, *,
+                      n_queries: int, size: int, from_: int, sort,
+                      track_scores: bool):
+    """Per-row ReducedDocs for a SORTED mesh program (ISSUE 17): hit
+    order arrived in encoded-key order from the device; only the winners'
+    user-facing sort values materialize here — k real values per query,
+    never a device round-trip. Scores follow the sorted-loop contract
+    (NaN unless track_scores)."""
+    import math as _math
+
+    import numpy as np
+
+    from .search import sort as sort_mod
+    from .search.controller import ReducedDocs
+    from .search.shard_searcher import LOCAL_MASK, SEG_SHIFT
+    window = slice(from_, from_ + size)
+    rows = []
+    for qi in range(n_queries):
+        valid = keys[qi] >= 0
+        vk = keys[qi][valid][window]
+        vsh = shard_of[qi][valid][window]
+        vs = scores[qi][valid][window]
+        svs, out_scores = [], []
+        for dk, sh, sc in zip(vk, vsh, vs):
+            seg = searchers[int(sh)].segments[int(dk) >> SEG_SHIFT]
+            sc = float(sc) if track_scores else float("nan")
+            out_scores.append(sc)
+            svs.append(sort_mod.materialize(
+                seg, sort, int(dk) & LOCAL_MASK, sc, int(dk), int(sh)))
+        mx_col = mxs[:, qi]
+        mx_fin = mx_col[np.isfinite(mx_col)]
+        mxv = float(mx_fin.max()) if mx_fin.size and track_scores \
+            else float("nan")
+        rows.append(ReducedDocs(
+            shard_order=[int(x) for x in vsh],
+            doc_keys=[int(x) for x in vk],
+            scores=out_scores,
+            sort_values=svs,
             total_hits=int(totals[:, qi].sum()),
             max_score=mxv if _math.isfinite(mxv) else float("nan")))
     return rows

@@ -969,6 +969,204 @@ def _build_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
                               out_specs=out_specs))
 
 
+def _build_sorted_program(mesh, devfn, field_kinds: tuple, op_kinds: tuple,
+                          nk: int, k: int, n_queries: int,
+                          agg_devfns: tuple = ()):
+    """jit(shard_map(per-shard sorted reduce + cross-shard sorted merge)):
+    the sorted analog of _build_program (ISSUE 17). Per shard it is
+    stacked.stacked_sorted_reduce's math verbatim over the encoded key
+    columns (search/sort_encode.py); the cross-shard tail all_gathers the
+    candidate operands and re-sorts with the shard index wedged between
+    the user keys and the dockey, reproducing the host merge's
+    (compare_key, shard_idx, pos) tie order bitwise."""
+    def step(live, seg_ids, sort_keys, cursor, *flat):
+        live = live[0]                        # [G, N]
+        seg_ids = seg_ids[0]                  # [G]
+        sk = sort_keys[0]                     # [nk, G, N]
+        fields = {}
+        i = 0
+        for name, kind in field_kinds:
+            if kind == "text":
+                fields[name] = MeshTextField(
+                    doc_ids=flat[i][0], tf=flat[i + 1][0],
+                    doc_len=flat[i + 2][0])
+                i += 3
+            elif kind == "keyword":
+                fields[name] = MeshKeywordField(ords=flat[i][0])
+                i += 1
+            else:
+                fields[name] = MeshNumericField(
+                    vals=flat[i][0], missing=flat[i + 1][0], dtype="")
+                i += 2
+        ops = []
+        for kind in op_kinds:
+            blk = flat[i]
+            i += 1
+            ops.append(blk[0] if kind in (_OP_S, _OP_SQ) else blk)
+        d = _DevCtx(fields, ops, live.shape[0], live.shape[1], n_queries)
+        scores, match = devfn(d)
+
+        # per-shard sorted reduce — stacked_sorted_reduce's math verbatim
+        m = match & live[:, None, :]
+        total = jnp.sum(m, axis=(0, 2), dtype=jnp.int64)          # [Qb]
+        masked = jnp.where(m, scores, -jnp.inf)
+        mx = masked.max(axis=(0, 2))                              # [Qb]
+        after = jnp.zeros(sk.shape[1:], bool)
+        for ki in range(nk - 1, -1, -1):
+            after = (sk[ki] > cursor[ki]) \
+                | ((sk[ki] == cursor[ki]) & after)
+        sel = m & after[:, None, :]
+        G, Qb, N = match.shape
+        dockey = (seg_ids[:, None] << SEG_SHIFT) \
+            | jnp.arange(N, dtype=jnp.int64)[None, :]
+
+        def flat2(x):                         # [G,Qb,N] -> [Qb,G*N]
+            return jnp.moveaxis(x, 0, 1).reshape(Qb, -1)
+
+        cand = [flat2(jnp.where(sel, sk[0][:, None, :], jnp.inf))]
+        cand += [flat2(jnp.broadcast_to(sk[ki][:, None, :], (G, Qb, N)))
+                 for ki in range(1, nk)]
+        cand.append(flat2(jnp.broadcast_to(dockey[:, None, :], (G, Qb, N))))
+        cand.append(flat2(masked))
+        ks = min(k, G * N)
+        shard_out = [o[:, :ks]
+                     for o in lax.sort(tuple(cand), num_keys=nk + 1)]
+
+        # cross-shard sorted merge: gather candidates in shard order and
+        # re-sort with the shard index as the post-keys tiebreak
+        g = [lax.all_gather(o, SHARD_AXIS) for o in shard_out]  # [S,Qb,ks]
+        S = g[0].shape[0]
+        shard_col = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int64)[:, None, None], (S, Qb, ks))
+
+        def gflat(x):                         # [S,Qb,ks] -> [Qb,S*ks]
+            return jnp.transpose(x, (1, 0, 2)).reshape(Qb, S * ks)
+
+        merged = lax.sort(
+            tuple(gflat(o) for o in g[:nk])
+            + (gflat(shard_col), gflat(g[nk]), gflat(g[nk + 1])),
+            num_keys=nk + 2)
+        kf = min(k, S * ks)
+        valid = merged[0][:, :kf] < jnp.inf
+        out_shard = jnp.where(valid, merged[nk][:, :kf].astype(jnp.int32),
+                              jnp.int32(-1))
+        out_k = jnp.where(valid, merged[nk + 1][:, :kf], jnp.int64(-1))
+        out_s = jnp.where(valid, merged[nk + 2][:, :kf], -jnp.inf)
+        total_g = lax.all_gather(total, SHARD_AXIS)       # [S, Qb]
+        mx_g = lax.all_gather(mx, SHARD_AXIS)             # [S, Qb]
+        agg_outs = tuple(lax.all_gather(fn(d, m), SHARD_AXIS)
+                         for fn in agg_devfns)
+        return (out_k, out_shard, out_s, total_g, mx_g) + agg_outs
+
+    field_specs = []
+    for _name, kind in field_kinds:
+        field_specs.extend([P(SHARD_AXIS)] * _FIELD_TENSORS[kind])
+    op_specs = []
+    for kind in op_kinds:
+        if kind == _OP_S:
+            op_specs.append(P(SHARD_AXIS))
+        elif kind == _OP_SQ:
+            op_specs.append(P(SHARD_AXIS, None, REPLICA_AXIS))
+        elif kind == _OP_Q:
+            op_specs.append(P(REPLICA_AXIS))
+        else:
+            op_specs.append(P())
+    in_specs = tuple([P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()]
+                     + field_specs + op_specs)
+    out_specs = (P(REPLICA_AXIS),) * 3 \
+        + (P(None, REPLICA_AXIS),) * (2 + len(agg_devfns))
+    return jax.jit(_shard_map(step, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs))
+
+
+def execute_sorted(stack: MeshStack, node: Node, stats, sort_specs,
+                   search_after, *, k: int, Q: int = 1, agg_specs=None):
+    """Sorted mesh execution (ISSUE 17): the whole multi-shard SORTED
+    query phase as one collective program over the encoded key columns.
+
+    -> (doc_keys i64[Q,k'], shard i32[Q,k'], scores [Q,k'],
+    totals i64[S, Q], max f[S, Q], agg_partials) — execute()'s contract;
+    hit order is the encoded-key order, bitwise-equal to the fan-out's
+    host merge. None when the tree/aggs have no collective form OR the
+    sort encoding declines (search/sort_encode.decline_reason — the
+    caller's recorder carries the reason). May raise on execution
+    failure; the caller degrades to the fan-out."""
+    from ..common.device_stats import lane_decline
+    from ..search import sort_encode
+
+    global last_block_mode
+    all_segs = [seg for rows in stack.shard_rows for _i, seg in rows]
+    reason = sort_encode.decline_reason(sort_specs, all_segs)
+    if reason is not None:
+        lane_decline("coordinator.reduce", "mesh", reason)
+        return None
+    R = stack.n_replicas
+    q_pad = -(-Q // R) * R
+    last_block_mode = "materialized"
+    pctx = _PlanCtx(stack, q_pad, stats)
+    try:
+        sig, devfn = _plan_exec(node, pctx)
+    except _Unsupported:
+        return None
+    agg_plan = None
+    if agg_specs:
+        from . import mesh_aggs
+        agg_plan = mesh_aggs.plan_aggs(agg_specs, pctx)
+        if agg_plan is None:
+            return None
+    cols_dev, vocabs = sort_encode.mesh_key_cols(stack, sort_specs)
+    cursor = sort_encode.encode_cursor(sort_specs, search_after, vocabs)
+    nk = len(sort_specs)
+    field_kinds = tuple(pctx.fields.items())
+    op_kinds = tuple(kind for _a, kind in pctx.ops)
+    key = ("sorted", stack.s_pad, R, q_pad, k, nk, sig, field_kinds,
+           agg_plan.sig if agg_plan is not None else None)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        from ..common.device_stats import instrument
+        prog = instrument(
+            "mesh:sorted",
+            _build_sorted_program(
+                stack.mesh, devfn, field_kinds, op_kinds, nk, k,
+                q_pad // R,
+                agg_devfns=tuple(agg_plan.device_fns())
+                if agg_plan is not None else ()),
+            key=key)
+        _PROGRAMS.put(key, prog, weight=1)
+    args = []
+    for name, kind in field_kinds:
+        if kind == "text":
+            ft = stack.text[name]
+            args.extend([ft.doc_ids, ft.tf, ft.doc_len])
+        elif kind == "keyword":
+            args.append(stack.keywords[name].ords)
+        else:
+            nf = stack.numerics[name]
+            args.extend([nf.vals, nf.missing])
+    args.extend(a for a, _kind in pctx.ops)
+    from ..common.metrics import (device_fetch, note_h2d,
+                                  record_score_matrix_bytes)
+    note_h2d(sum(int(a.nbytes) for a, _kind in pctx.ops) + cursor.nbytes)
+    record_score_matrix_bytes(stack.g_pad * (q_pad // R) * stack.n_pad * 5)
+    with EXEC_LOCK:
+        outs = prog(stack.live_stack(), stack.seg_ids_dev, cols_dev,
+                    jnp.asarray(cursor), *args)
+        out_k, out_shard, out_s, total, mx = outs[:5]
+        got = device_fetch({"keys": out_k, "shard": out_shard,
+                            "scores": out_s, "total": total, "mx": mx,
+                            "aggs": list(outs[5:])})
+    agg_partials = None
+    if agg_plan is not None:
+        agg_partials = agg_plan.finish(
+            [np.asarray(a)[: stack.s_count] for a in got["aggs"]],
+            stack.s_count)
+    return (np.asarray(got["keys"])[:Q], np.asarray(got["shard"])[:Q],
+            np.asarray(got["scores"])[:Q],
+            np.asarray(got["total"])[: stack.s_count, :Q],
+            np.asarray(got["mx"])[: stack.s_count, :Q],
+            agg_partials)
+
+
 def _build_blockwise_program(mesh, bplan, *, k: int, n_queries: int,
                              kk: int, score_dtype):
     """jit(shard_map(blockwise scan + per-shard merge + cross-shard

@@ -2679,6 +2679,24 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
             "tpu-node-0": node.sampler.history(sel)}}
     c.register("GET", "/_nodes/stats/history", nodes_stats_history)
 
+    def monitoring_overview(g, p, b):
+        # self-monitoring overview (ISSUE 17): a REAL sorted + 2-level
+        # sub-agg search over the `.monitoring-es-*` indices the
+        # collector fills — the node observing itself through the
+        # sorted/sub-agg device lanes this tier builds
+        mon = getattr(node, "monitoring", None)
+        if mon is None:
+            return 404, {"error": "ResourceNotFoundException: monitoring "
+                                  "is not enabled on this node (set "
+                                  "node.monitoring.enable)", "status": 404}
+        try:
+            size = int(p.get("size", [10])[0])
+        except (TypeError, ValueError):
+            size = 10
+        interval = p.get("interval", ["1m"])[0] or "1m"
+        return 200, mon.overview(size=size, interval=interval)
+    c.register("GET", "/_monitoring/overview", monitoring_overview)
+
     def metrics_exposition(g, p, b):
         # OpenMetrics text over every stats registry (common/metrics.py
         # render walk; `# TYPE`/`# HELP`, `_total`/`_bytes` conventions,

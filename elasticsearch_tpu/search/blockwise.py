@@ -887,6 +887,75 @@ def run_scan(devfn, fields: dict, ops: list, op_kinds, live, *, g_pad: int,
     return top_s, top_i, total, mx
 
 
+def run_sort_scan(devfn, fields: dict, ops: list, op_kinds, live,
+                  sort_keys, cursor, *, g_pad: int, block: int, nb: int,
+                  n_queries: int, kk: int, score_dtype,
+                  want_mask: bool = False):
+    """Sorted blockwise scan (ISSUE 17): the running carry holds each
+    segment row's best-kk candidates ORDERED BY THE ENCODED SORT KEYS
+    (search/sort_encode.py) instead of by score — per block, the carry
+    and the block's candidates merge under one variadic lexicographic
+    `lax.sort` whose final key is the global doc index, so ties keep doc
+    order exactly like the materializing sorted reduce. Totals/mx still
+    accumulate over the FULL match set; the encoded `cursor` (−inf =
+    all-pass) narrows candidate collection only.
+
+    sort_keys f64[nk, G, N] (sliced per block), cursor f64[nk]
+    -> (ck f64[nk,G,Q,kk], ci i32[G,Q,kk], cs [G,Q,kk], total i64[Q],
+    mx [Q][, mask bool[G, N] when want_mask])."""
+    xs_ops = [v for v, k in zip(ops, op_kinds) if k == OP_X]
+    nk = sort_keys.shape[0]
+
+    def body(carry, x):
+        ck, ci, cs, total, mx = carry
+        b_idx = x[0]
+        xi = iter(x[1:])
+        base = (b_idx * block).astype(jnp.int32)
+        vals = _block_ops(ops, op_kinds, xi, base, block)
+        d = _BlkCtx(fields, vals, g_pad, block, n_queries, base)
+        scores, match = devfn(d)
+        live_b = lax.dynamic_slice_in_dim(live, base, block, axis=1)
+        m = match & live_b[:, None, :]
+        total = total + jnp.sum(m, axis=(0, 2), dtype=jnp.int64)
+        masked = jnp.where(m, scores, -jnp.inf)
+        mx = jnp.maximum(mx, masked.max(axis=(0, 2)))
+        keys_b = lax.dynamic_slice_in_dim(sort_keys, base, block, axis=2)
+        after = jnp.zeros((g_pad, block), bool)
+        for i in range(nk - 1, -1, -1):
+            after = (keys_b[i] > cursor[i]) \
+                | ((keys_b[i] == cursor[i]) & after)
+        sel = m & after[:, None, :]
+        k0 = jnp.where(sel, keys_b[0][:, None, :], jnp.inf)
+        cat = [jnp.concatenate([ck[0], k0], axis=-1)]
+        for i in range(1, nk):
+            cat.append(jnp.concatenate(
+                [ck[i], jnp.broadcast_to(keys_b[i][:, None, :],
+                                         (g_pad, n_queries, block))],
+                axis=-1))
+        idx_b = jnp.broadcast_to(
+            (base + jnp.arange(block, dtype=jnp.int32))[None, None, :],
+            (g_pad, n_queries, block))
+        cat.append(jnp.concatenate([ci, idx_b], axis=-1))
+        cat.append(jnp.concatenate([cs, masked], axis=-1))
+        out = lax.sort(tuple(cat), num_keys=nk + 1)
+        ck = jnp.stack([o[..., :kk] for o in out[:nk]])
+        ci = out[nk][..., :kk]
+        cs = out[nk + 1][..., :kk]
+        return (ck, ci, cs, total, mx), (m[:, 0, :] if want_mask else None)
+
+    init = (jnp.full((nk, g_pad, n_queries, kk), jnp.inf, jnp.float64),
+            jnp.full((g_pad, n_queries, kk), -1, jnp.int32),
+            jnp.full((g_pad, n_queries, kk), -jnp.inf, score_dtype),
+            jnp.zeros((n_queries,), jnp.int64),
+            jnp.full((n_queries,), -jnp.inf, score_dtype))
+    (ck, ci, cs, total, mx), ys = lax.scan(
+        body, init, (jnp.arange(nb), *xs_ops))
+    if want_mask:
+        mask = jnp.moveaxis(ys, 0, 1).reshape(g_pad, nb * block)
+        return ck, ci, cs, total, mx, mask
+    return ck, ci, cs, total, mx
+
+
 def probe_score_dtype(bplan: BlockPlan, fields: dict):
     """Abstract-evaluate one block (jax.eval_shape — zero device work) to
     learn the tree's score dtype: trees over f64 columns promote exactly
@@ -1057,6 +1126,102 @@ def execute_stacked(stack, node: Node, *, n_queries: int, stats, k: int,
     note_h2d(sum(int(np.asarray(a).nbytes) for a in ops))
     flat = flatten_fields(bplan.field_kinds, fields)
     return prog(stack.live_stack(), stack.seg_ids_dev, *flat, *ops)
+
+
+def _jit_sorted_program(devfn, field_kinds, op_kinds, *, g_pad, block, nb,
+                        n_queries, nk, kk, k, score_dtype, want_mask):
+    nf = n_field_arrays(field_kinds)
+
+    def prog(live, seg_ids, sort_keys, cursor, *flat):
+        fields = rebuild_fields(field_kinds, flat[:nf])
+        ops = list(flat[nf:])
+        out = run_sort_scan(devfn, fields, ops, op_kinds, live, sort_keys,
+                            cursor, g_pad=g_pad, block=block, nb=nb,
+                            n_queries=n_queries, kk=kk,
+                            score_dtype=score_dtype, want_mask=want_mask)
+        ck, ci, cs, total, mx = out[:5]
+        extra = out[5:]
+        # cross-segment merge — stacked_sorted_reduce's tail over the
+        # per-row candidate sets instead of the full [G, Q, N] plane
+        dockey = (seg_ids[:, None, None] << SEG_SHIFT) \
+            | ci.astype(jnp.int64)
+        Qn = ci.shape[1]
+
+        def flat2(x):                             # [G,Q,kk] -> [Q,G*kk]
+            return jnp.moveaxis(x, 0, 1).reshape(Qn, -1)
+
+        cat = [flat2(ck[i]) for i in range(nk)]
+        cat.append(flat2(dockey))
+        cat.append(flat2(cs))
+        merged = lax.sort(tuple(cat), num_keys=nk + 1)
+        kf = min(k, g_pad * kk)
+        valid = merged[0][:, :kf] < jnp.inf
+        return (jnp.where(valid, merged[nk][:, :kf], jnp.int64(-1)),
+                jnp.where(valid, merged[nk + 1][:, :kf], -jnp.inf),
+                total, mx, *extra)
+
+    return jax.jit(prog)
+
+
+def _sorted_program_for(bplan: BlockPlan, *, nk: int, k: int, kk: int,
+                        score_dtype, want_mask: bool):
+    key = ("stacked_sorted", bplan.sig, bplan.field_kinds, bplan.op_kinds,
+           bplan.g_pad, bplan.n_pad, bplan.block, bplan.n_queries, nk, k,
+           kk, str(score_dtype), want_mask)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        from ..common.device_stats import instrument
+        prog = instrument(
+            "blockwise:stacked_sorted",
+            _jit_sorted_program(bplan.devfn, bplan.field_kinds,
+                                bplan.op_kinds, g_pad=bplan.g_pad,
+                                block=bplan.block, nb=bplan.nb,
+                                n_queries=bplan.n_queries, nk=nk, kk=kk,
+                                k=k, score_dtype=score_dtype,
+                                want_mask=want_mask),
+            key=key)
+        _PROGRAMS.put(key, prog, weight=1)
+    return prog
+
+
+def execute_stacked_sorted(stack, node: Node, sort_keys, cursor, *,
+                           n_queries: int, stats, k: int, block: int,
+                           want_mask: bool):
+    """The sorted stacked lane, blockwise (ISSUE 17): same outputs as
+    stacked.stacked_sorted_reduce (keys i64[Q,k'], top [Q,k'],
+    total i64[Q], mx [Q][, mask bool[G, N]]), scanning doc blocks instead
+    of materializing [G, Q, N]. None when the plan declines."""
+    env = FieldEnv(set(stack.text), set(stack.keywords),
+                   set(stack.numerics), stack.mixed,
+                   lambda f: stack.numerics[f].dtype)
+    bplan = plan(node, (stack.segments,), env, g_pad=stack.g_pad,
+                 n_pad=stack.n_pad, block=block, n_queries=n_queries,
+                 stats=stats)
+    if bplan is None:
+        return None
+    fields = {}
+    for name, kind in bplan.field_kinds:
+        if kind == "text":
+            sf = stack.text[name]
+            fields[name] = BTextField(sf.doc_ids, sf.tf, sf.doc_len)
+        elif kind == "keyword":
+            fields[name] = BKeywordField(stack.keywords[name].ords)
+        else:
+            nf = stack.numerics[name]
+            fields[name] = BNumericField(nf.vals, nf.missing)
+    score_dtype = probe_score_dtype(bplan, fields)
+    kk = min(k, stack.n_pad)
+    nk = int(sort_keys.shape[0])
+    prog = _sorted_program_for(bplan, nk=nk, k=k, kk=kk,
+                               score_dtype=score_dtype,
+                               want_mask=want_mask)
+    from ..common.metrics import note_h2d
+    ops = _strip_shard(bplan.ops, bplan.op_kinds)
+    note_h2d(sum(int(np.asarray(a).nbytes) for a in ops)
+             + int(np.asarray(sort_keys).nbytes))
+    flat = flatten_fields(bplan.field_kinds, fields)
+    return prog(stack.live_stack(), stack.seg_ids_dev, sort_keys, cursor,
+                *flat, *ops)
 
 
 def program_cache_stats() -> dict:

@@ -286,8 +286,25 @@ class ShardSearcher:
                     return out
 
         if sort is not None or search_after is not None:
-            # the device lanes above serve unsorted bodies only
+            # the sparse kernel serves unsorted bodies only
             lane_decline(lane_comp, "sparse", "sorted")
+        if sort is not None and self.stacked_enabled and self.live_segments:
+            # sorted stacked lane (ISSUE 17): encoded cross-segment sort
+            # keys ride the stacked/blockwise reduce — one program, one
+            # fetch. Ineligible encodings decline with a stable reason
+            # and keep the per-segment loop below.
+            from . import sort_encode
+            reason = sort_encode.decline_reason(
+                sort, [s for _, s in self.live_segments])
+            if reason is not None:
+                lane_decline(lane_comp, "stacked", reason)
+            else:
+                out = self._try_stacked_sorted(
+                    node, sort, search_after, k=k, Q=Q,
+                    global_stats=global_stats,
+                    track_scores=track_scores, aggs=aggs)
+                if out is not None:
+                    return out
         lane_chosen(lane_comp, "loop")
         self.last_query_path = "dense"
         self.last_dense_mode = "loop"
@@ -622,6 +639,154 @@ class ShardSearcher:
         return QuerySearchResult(
             shard_id=self.shard_id, doc_keys=best_keys, scores=best_scores,
             sort_values=None, total_hits=np.asarray(got["total"], np.int64),
+            max_score=max_score, aggs=agg_partials)
+
+    # -- sorted stacked lane (ISSUE 17: search/sort_encode.py) -------------
+
+    def _try_stacked_sorted(self, node: Node, sort, search_after, *,
+                            k: int, Q: int, global_stats,
+                            track_scores: bool,
+                            aggs: list | None) -> QuerySearchResult | None:
+        """One sorted stacked attempt; None falls back to the loop (the
+        loop's materialized-value merge is always correct)."""
+        from ..common.device_stats import lane_decline
+        try:
+            stack = self._acquire_stack()
+            if stack is None:
+                lane_decline(f"shard[{self.shard_id}].query", "stacked",
+                             "stack_declined")
+                return None
+            return self._execute_stacked_sorted(
+                stack, node, sort, search_after, k=k, Q=Q,
+                global_stats=global_stats, track_scores=track_scores,
+                aggs=aggs)
+        except Exception:  # noqa: BLE001 — the loop is always correct
+            lane_decline(f"shard[{self.shard_id}].query", "stacked", "error")
+            self._bump("stacked_errors")
+            return None
+
+    def _execute_stacked_sorted(self, stack, node: Node, sort,
+                                search_after, *, k: int, Q: int,
+                                global_stats, track_scores: bool,
+                                aggs: list | None) -> QuerySearchResult:
+        from ..common import tracing
+        from . import sort_encode
+        from .stacked import (StackedContext, execute_tree,
+                              stacked_sorted_reduce)
+        stats = self.build_stats(node, global_stats)
+        cols, vocabs = sort_encode.stack_key_cols(stack, sort,
+                                                  self.shard_id)
+        cursor = sort_encode.encode_cursor(sort, search_after, vocabs)
+        keys_dev = jnp.asarray(cols)
+        cursor_dev = jnp.asarray(cursor)
+        blockwise_ok = self.blockwise_enabled \
+            and stack.n_pad > self.block_docs
+        if blockwise_ok and aggs is not None:
+            from .aggs.aggregators import has_top_hits
+            blockwise_ok = not has_top_hits(aggs)
+        self.last_block_mode = "materialized"
+        blk_mask = None
+        scores = match = live = None
+        charged = 0
+        try:
+            with tracing.span("stacked_sorted_dispatch",
+                              shard=self.shard_id,
+                              segments=len(stack.segments), k=k):
+                out = None
+                if blockwise_ok:
+                    charged = self._charge_scores(
+                        stack.g_pad * Q * self.block_docs
+                        * SCORE_SLOT_BYTES)
+                    from . import blockwise as blockwise_mod
+                    out = blockwise_mod.execute_stacked_sorted(
+                        stack, node, keys_dev, cursor_dev, n_queries=Q,
+                        stats=stats, k=k, block=self.block_docs,
+                        want_mask=aggs is not None)
+                    if out is None:
+                        self._release_scores(charged)
+                        charged = 0
+                if out is not None:
+                    self.last_block_mode = "blockwise"
+                    self._bump("blockwise_dispatches")
+                    if aggs is not None:
+                        keys_d, top_d, total_d, mx_d, blk_mask = out
+                    else:
+                        keys_d, top_d, total_d, mx_d = out
+                else:
+                    charged = charged or self._charge_scores(
+                        stack.g_pad * Q * stack.n_pad * SCORE_SLOT_BYTES)
+                    sctx = StackedContext(stack, Q, stats)
+                    scores, match = execute_tree(node, sctx)
+                    live = stack.live_stack()
+                    keys_d, top_d, total_d, mx_d = stacked_sorted_reduce(
+                        scores, match, live, stack.seg_ids_dev,
+                        keys_dev, cursor_dev, k=k)
+                got = device_fetch({"keys": keys_d, "top": top_d,
+                                    "total": total_d, "mx": mx_d})
+        finally:
+            self._release_scores(charged)
+        best_keys = np.asarray(got["keys"], np.int64)
+        fetched_scores = np.asarray(got["top"])
+        if best_keys.shape[1] < k:
+            pad = k - best_keys.shape[1]
+            best_keys = np.concatenate(
+                [best_keys, np.full((Q, pad), -1, np.int64)], axis=1)
+            fetched_scores = np.concatenate(
+                [fetched_scores,
+                 np.full((Q, pad), -np.inf, fetched_scores.dtype)], axis=1)
+        # the loop's sorted contract: scores stay NaN unless tracked
+        best_scores = np.where(
+            (best_keys >= 0) & track_scores, fetched_scores, np.nan)
+        mx = np.asarray(got["mx"])
+        max_score = np.where(np.isfinite(mx), mx, np.nan) if track_scores \
+            else np.full((Q,), np.nan, mx.dtype)
+        # winners' user-facing sort values materialize host-side per hit
+        # — k real values per shard, never a device round-trip
+        sort_vals = np.empty(best_keys.shape, dtype=object)
+        for qi in range(Q):
+            for slot in range(best_keys.shape[1]):
+                dk = int(best_keys[qi, slot])
+                if dk < 0:
+                    continue
+                seg = self.segments[dk >> SEG_SHIFT]
+                sc = float(fetched_scores[qi, slot])
+                sort_vals[qi, slot] = sort_mod.materialize(
+                    seg, sort, dk & LOCAL_MASK, sc, dk, self.shard_id)
+        agg_partials = None
+        if aggs is not None:
+            from .aggs.aggregators import collect_shard
+            a_segs, a_masks, a_scores = [], [], []
+            for gi, seg in enumerate(stack.segments):
+                a_segs.append(seg)
+                if blk_mask is not None:
+                    a_masks.append(blk_mask[gi, : seg.n_pad])
+                    a_scores.append(None)
+                else:
+                    a_masks.append((match[gi, 0] & live[gi])[: seg.n_pad])
+                    a_scores.append(scores[gi, 0, : seg.n_pad])
+            agg_partials = collect_shard(aggs, a_segs, a_masks,
+                                         query_parser=self.parser,
+                                         scores=a_scores)
+        from ..common.device_stats import lane_chosen
+        lane_chosen(f"shard[{self.shard_id}].query",
+                    "stacked_blockwise"
+                    if self.last_block_mode == "blockwise" else "stacked")
+        self.last_query_path = "dense"
+        self.last_dense_mode = "stacked"
+        self.dense_queries += 1
+        self._bump("dense")
+        self._bump("stacked")
+        self._bump("stacked_sorted")
+        self._bump("stacked_dispatches")
+        from ..common.metrics import record_shard_fetches
+        record_shard_fetches(1)
+        prof = current_profiler()
+        if prof is not None:
+            prof.note_path("stacked")
+        return QuerySearchResult(
+            shard_id=self.shard_id, doc_keys=best_keys,
+            scores=best_scores, sort_values=sort_vals,
+            total_hits=np.asarray(got["total"], np.int64),
             max_score=max_score, aggs=agg_partials)
 
     # -- kNN (IVF two-stage ANN / exact MXU matmul — ops/ann.py, knn.py) ---

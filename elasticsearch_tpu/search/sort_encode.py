@@ -1,0 +1,254 @@
+"""Order-preserving encoded sort-key columns for the dense lanes.
+
+The per-segment loop sorts by MATERIALIZED values (search/sort.py): keys
+are selected on device per segment, then every cross-segment /
+cross-shard merge compares real strings/numbers host-side. That keeps
+ordinals comparable but forces the host merge — the ladder's single
+biggest decline (`reason="sorted"` in the lane recorder).
+
+This module builds f64 key columns that are comparable ACROSS segments
+(and across shards for the mesh lane), so a single variadic `lax.sort`
+over `[Q, G*N]` flattened candidates replaces the host merge entirely:
+
+- numeric/date keys: the raw f64 value (i64 exact below 2^53 — larger
+  magnitudes decline with `i64_precision`), with the loop's exact
+  missing-value discipline (numeric-literal `missing` substituted BEFORE
+  the desc negation, `_first`/`_last` filled with ±_BIG after it);
+- keyword keys: ordinals in the GLOBAL sorted vocab (union over every
+  segment in the stack — and every shard for the mesh), built with the
+  same remap-operand trick the mesh terms agg uses, so one integer space
+  is totally ordered across the whole flattened candidate axis;
+- `_doc`: `(shard << 42) + (seg << 32) + local` — the loop's tiebreak
+  key verbatim (exact in f64: shard ids stay far below 2^11).
+
+The `search_after` cursor is encoded ONCE into the same global space and
+shipped as a data operand (−inf per key when there is no cursor, so the
+cursor/no-cursor cases share one compiled program — the no-retrace
+contract). Ties beyond the user keys break on `(shard, seg, local)` via
+the dockey operand, reproducing the loop's `(sort keys, _shard, _doc)`
+cursor order bitwise even when duplicates span segment boundaries.
+
+Bodies this encoding cannot bitwise-reproduce decline with a stable
+reason (`decline_reason`): `score_sort`, `geo_sort`, `fielddata_sort`,
+`mixed_type_sort_field`, `keyword_numeric_missing`, `i64_precision`,
+`value_range` — the per-segment loop remains the documented fallback.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from .query_dsl import QueryParsingException
+from .sort import (DOC, GEO, SCORE, SortSpec, _BIG, _host_numeric,
+                   _host_ords, _is_number)
+
+# f64 can hold integers exactly only below 2^53; an i64 sort column past
+# that would tie distinct values in the encoded space
+_MAX_EXACT_I64 = float(2 ** 53)
+
+
+def decline_reason(specs, segments) -> str | None:
+    """Stable lane-decline reason when the encoded-key device sort cannot
+    bitwise-reproduce the loop's materialized-value merge, else None.
+    `segments` spans every segment the lane will flatten (all shards for
+    the mesh)."""
+    for sp in specs:
+        if sp.field == SCORE:
+            return "score_sort"
+        if sp.field == GEO:
+            return "geo_sort"
+        if sp.field == DOC:
+            continue
+        kinds = set()
+        for seg in segments:
+            nc = seg.numerics.get(sp.field)
+            if nc is not None:
+                kinds.add("num")
+                from .aggs.aggregators import _col_minmax
+                mn, mx = _col_minmax(seg, sp.field, nc)
+                if np.isfinite(mn) and np.isfinite(mx):
+                    if nc.dtype == "i64" and max(abs(mn), abs(mx)) \
+                            >= _MAX_EXACT_I64:
+                        return "i64_precision"
+                    if max(abs(mn), abs(mx)) >= _BIG:
+                        return "value_range"
+                continue
+            if sp.field in seg.keywords:
+                kinds.add("kw")
+                continue
+            if sp.field in seg.text:
+                # min/max-term fielddata sorts keep the loop (uninverted
+                # ordinals are per-segment; no global vocab is built)
+                return "fielddata_sort"
+        if len(kinds) > 1:
+            return "mixed_type_sort_field"
+        if kinds == {"kw"} and _is_number(sp.missing):
+            # the loop substitutes the numeric literal into the VALUE
+            # space (number < string under compare_key's type rank);
+            # ordinal space cannot express that
+            return "keyword_numeric_missing"
+        if _is_number(sp.missing) and abs(float(sp.missing)) >= _BIG:
+            return "value_range"
+    return None
+
+
+def global_vocab(segments, field: str) -> list[str]:
+    """Sorted union of every segment's keyword vocab for `field` — the
+    shared ordinal space the encoded columns and the cursor map into."""
+    vocab: set[str] = set()
+    for seg in segments:
+        kc = seg.keywords.get(field)
+        if kc is not None:
+            vocab.update(kc.values)
+    return sorted(vocab)
+
+
+def _spec_key(sp: SortSpec):
+    missing = sp.missing if isinstance(sp.missing, str) \
+        else float(sp.missing)
+    return (sp.field, sp.order, missing)
+
+
+def segment_col(seg, sp: SortSpec, vocab, seg_idx: int, shard_id: int,
+                n_pad: int) -> np.ndarray:
+    """One encoded f64 key column [n_pad] for one segment, ascending-
+    comparable across every segment sharing `vocab`. Mirrors
+    sort.segment_keys' fill/negate order exactly (numeric-literal missing
+    substituted BEFORE the desc negation; ±_BIG fill after it)."""
+    if sp.field == DOC:
+        base = float((shard_id << 42) + (seg_idx << 32))
+        vals = base + np.arange(n_pad, dtype=np.float64)
+        return -vals if sp.order == "desc" else vals
+    nc = seg.numerics.get(sp.field)
+    if nc is not None:
+        v, miss = _host_numeric(nc)
+        vals = v.astype(np.float64)
+        miss = miss.astype(bool)
+    else:
+        kc = seg.keywords.get(sp.field)
+        if kc is not None:
+            ords = _host_ords(kc)
+            remap = np.searchsorted(np.asarray(vocab), kc.values)
+            vals = remap[np.clip(ords, 0, None)].astype(np.float64)
+            miss = ords < 0
+        else:
+            vals = np.zeros(0, np.float64)
+            miss = np.ones(0, bool)
+    if _is_number(sp.missing) and nc is not None:
+        vals = np.where(miss, float(sp.missing), vals)
+        miss = None
+    if sp.order == "desc":
+        vals = -vals
+    if miss is not None:
+        fill = _BIG if sp.missing == "_last" else -_BIG
+        vals = np.where(miss, fill, vals)
+    out = np.zeros(n_pad, np.float64)
+    if vals.shape[0] < n_pad:
+        # absent column / short segment: every slot past the data is the
+        # missing fill (dead padding rows are masked out at reduce time)
+        fill = float(sp.missing) if _is_number(sp.missing) \
+            else (_BIG if sp.missing == "_last" else -_BIG)
+        if _is_number(sp.missing) and sp.order == "desc":
+            fill = -fill
+        out[:] = fill
+    out[: min(vals.shape[0], n_pad)] = vals[:n_pad]
+    return out
+
+
+def encode_cursor(specs, cursor, vocabs) -> np.ndarray:
+    """f64[nk] cursor in the encoded global space; −inf per key when no
+    cursor (the all-pass mask — every real key compares strictly greater,
+    so cursor/no-cursor share one compiled program)."""
+    nk = len(specs)
+    if cursor is None:
+        return np.full(nk, -np.inf)
+    if len(cursor) != nk:
+        raise QueryParsingException(
+            f"search_after must have {nk} values, one per sort key")
+    out = np.empty(nk, np.float64)
+    for i, (sp, cv) in enumerate(zip(specs, cursor)):
+        if cv is None:
+            out[i] = _BIG if sp.missing == "_last" else -_BIG
+            continue
+        vocab = vocabs.get(sp.field)
+        if vocab is not None:
+            s = str(cv)
+            pos = bisect.bisect_left(vocab, s)
+            c = float(pos) if pos < len(vocab) and vocab[pos] == s \
+                else pos - 0.5
+        else:
+            try:
+                c = float(cv)
+            except (TypeError, ValueError) as e:
+                raise QueryParsingException(
+                    f"bad search_after value {cv!r} for "
+                    f"[{sp.field}]") from e
+        out[i] = -c if sp.order == "desc" else c
+    return out
+
+
+def mesh_key_cols(stack, specs):
+    """Encoded key columns for a MeshStack: a mesh-sharded f64
+    [S_pad, nk, G_pad, N_pad] device array plus the CROSS-SHARD keyword
+    vocabs (union over every shard's segments — one ordinal space the
+    whole flattened candidate axis is totally ordered in). Memoized on
+    the stack like stack_key_cols; the device_put happens once per
+    (stack, sort spec), so repeated sorted queries ship zero key bytes."""
+    import jax
+
+    from ..parallel.mesh import index_sharding
+    cache = getattr(stack, "_sort_col_cache", None)
+    if cache is None:
+        cache = {}
+        stack._sort_col_cache = cache
+    key = tuple(_spec_key(sp) for sp in specs)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    all_segs = [seg for rows in stack.shard_rows for _i, seg in rows]
+    cols = np.zeros((stack.s_pad, len(specs), stack.g_pad, stack.n_pad),
+                    np.float64)
+    vocabs: dict[str, list[str]] = {}
+    for ki, sp in enumerate(specs):
+        vocab = None
+        if any(sp.field in s.keywords for s in all_segs):
+            vocab = global_vocab(all_segs, sp.field)
+            vocabs[sp.field] = vocab
+        for si, rows in enumerate(stack.shard_rows):
+            for gi, (orig, seg) in enumerate(rows):
+                cols[si, ki, gi] = segment_col(seg, sp, vocab, orig, si,
+                                               stack.n_pad)
+    hit = (jax.device_put(cols, index_sharding(stack.mesh)), vocabs)
+    cache[key] = hit
+    return hit
+
+
+def stack_key_cols(stack, specs, shard_id: int = 0):
+    """Encoded key columns for a SegmentStack: f64[nk, G_pad, N_pad],
+    plus the keyword vocabs the cursor must encode against. Memoized on
+    the stack (immutable; tombstones ride the live mask, not the keys)."""
+    cache = getattr(stack, "_sort_col_cache", None)
+    if cache is None:
+        cache = {}
+        stack._sort_col_cache = cache
+    key = (tuple(_spec_key(sp) for sp in specs), shard_id)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    g_pad, n_pad = stack.g_pad, stack.n_pad
+    cols = np.zeros((len(specs), g_pad, n_pad), np.float64)
+    vocabs: dict[str, list[str]] = {}
+    for ki, sp in enumerate(specs):
+        vocab = None
+        if any(sp.field in s.keywords for s in stack.segments):
+            vocab = global_vocab(stack.segments, sp.field)
+            vocabs[sp.field] = vocab
+        for gi, seg in enumerate(stack.segments):
+            cols[ki, gi] = segment_col(seg, sp, vocab,
+                                       stack.seg_indices[gi], shard_id,
+                                       n_pad)
+    hit = (cols, vocabs)
+    cache[key] = hit
+    return hit

@@ -311,6 +311,51 @@ def stacked_reduce(scores, match, live, seg_ids, *, k: int):
     return (jnp.take_along_axis(cand_k, pos, axis=1), best, total, mx)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def stacked_sorted_reduce(scores, match, live, seg_ids, keys, cursor,
+                          *, k: int):
+    """Sorted-shard reduce: the stacked lane's analog of the per-segment
+    loop's sort branch, fused into one program (ISSUE 17). The encoded
+    key columns (search/sort_encode.py) are comparable across segments,
+    so ONE variadic lexicographic `lax.sort` over the flattened [Q, G*N]
+    candidate axis replaces the host merge; the dockey operand breaks
+    ties in `(seg, local)` order — the loop's `(sort keys, _doc)` cursor
+    order bitwise. `cursor` is the encoded search_after row (−inf per
+    key = all-pass), applied AFTER totals/mx, exactly like the loop.
+
+    scores f32[G,Q,N], match bool[G,Q,N], live bool[G,N], seg_ids i64[G],
+    keys f64[nk,G,N], cursor f64[nk]
+    -> (keys i64[Q,k'], top f32[Q,k'], total i64[Q], mx f32[Q])."""
+    m = match & live[:, None, :]
+    total = jnp.sum(m, axis=(0, 2), dtype=jnp.int64)
+    masked = jnp.where(m, scores, -jnp.inf)
+    mx = masked.max(axis=(0, 2))
+    nk = keys.shape[0]
+    after = jnp.zeros(keys.shape[1:], bool)
+    for i in range(nk - 1, -1, -1):
+        after = (keys[i] > cursor[i]) | ((keys[i] == cursor[i]) & after)
+    sel = m & after[:, None, :]
+    G, Q, N = match.shape
+    dockey = (seg_ids[:, None] << SEG_SHIFT) \
+        | jnp.arange(N, dtype=jnp.int64)[None, :]
+
+    def flat(x):                                     # [G,Q,N] -> [Q,G*N]
+        return jnp.moveaxis(x, 0, 1).reshape(Q, -1)
+    # invalid rows push to the tail: the primary key becomes +inf, and
+    # every real key is finite (the largest missing fill is ±_BIG)
+    ops = [flat(jnp.where(sel, keys[0][:, None, :], jnp.inf))]
+    ops += [flat(jnp.broadcast_to(keys[i][:, None, :], (G, Q, N)))
+            for i in range(1, nk)]
+    ops.append(flat(jnp.broadcast_to(dockey[:, None, :], (G, Q, N))))
+    ops.append(flat(masked))
+    out = jax.lax.sort(tuple(ops), num_keys=nk + 1)
+    kk = min(k, G * N)
+    valid = out[0][:, :kk] < jnp.inf
+    return (jnp.where(valid, out[nk][:, :kk], jnp.int64(-1)),
+            jnp.where(valid, out[nk + 1][:, :kk], -jnp.inf),
+            total, mx)
+
+
 # ---------------------------------------------------------------------------
 # Stacked tree execution
 # ---------------------------------------------------------------------------
@@ -729,3 +774,5 @@ _bm25_stack = _instrument("stacked:bm25", _bm25_stack)
 _classic_stack = _instrument("stacked:classic", _classic_stack)
 _term_mask_stack = _instrument("stacked:term_mask", _term_mask_stack)
 stacked_reduce = _instrument("stacked:reduce", stacked_reduce)
+stacked_sorted_reduce = _instrument("stacked:sorted_reduce",
+                                    stacked_sorted_reduce)

@@ -1,7 +1,10 @@
 """Cross-lane parity oracle + invariant checkers.
 
 Parity: the same query replayed down two lanes the engine documents as
-bitwise-identical (loop vs stacked vs blockwise vs mesh, solo vs
+bitwise-identical (loop vs stacked vs blockwise vs mesh, sorted and
+search_after bodies through the encoded-key device sort vs the loop's
+materialized-value merge, sub-agg trees through the composite-bin
+device planner vs the host's recursive collect, solo vs
 msearch-batched, IVF(nprobe>=nlist) vs exact, int8-mesh vs int8-fanout,
 host-reduce vs per-shard transport merge) must produce byte-equal
 responses after canonicalization (drop `took`, neutralize the twin

@@ -262,7 +262,61 @@ class ChaosRunner:
         for body, sub in zip(texts[:4], batch["responses"]):
             solo = self.node.search("c-mesh", copy.deepcopy(body))
             self.oracle.compare("batched-vs-solo", body, solo, sub)
+        self._sorted_parity()
+        self._subagg_parity()
         self._knn_parity()
+
+    # sorted bodies ride the ISSUE 17 sorted device lanes (the sparse
+    # postings lane never serves a sorted plan); the claim catches a
+    # twin quietly answering sorted bodies through the per-segment loop
+    _SORTED_TWIN_LANES = {
+        "c-stacked": ("stacked", "stacked_blockwise", "packed"),
+        "c-block": ("stacked", "stacked_blockwise", "packed"),
+        "c-mesh": ("mesh", "packed"),
+    }
+
+    def _sorted_parity(self) -> None:
+        """Sorted-query replay pairs (ISSUE 17): the encoded-key device
+        sort on every dense twin vs the loop's materialized-value
+        merge — documented bitwise — plus a search_after page-2 replay
+        whose cursor is the reference page's last `sort`, so the
+        duplicate-key (_shard, _doc) tie-break is part of the pair."""
+        for body in self.solo_work.sorted_queries(4):
+            ref = self.node.search("c-loop", copy.deepcopy(body))
+            for name, _ in _TWINS[1:]:
+                got, rec = self._search_lanes(name, body)
+                if self.oracle.compare(f"sorted-loop-vs-{name}", body,
+                                       ref, got):
+                    self.oracle.lane_check(
+                        f"sorted-loop-vs-{name}", rec,
+                        self._SORTED_TWIN_LANES[name])
+            hits = ref["hits"]["hits"]
+            if not hits or "sort" not in hits[-1]:
+                continue
+            page2 = {**copy.deepcopy(body),
+                     "search_after": copy.deepcopy(hits[-1]["sort"])}
+            ref2 = self.node.search("c-loop", copy.deepcopy(page2))
+            for name, _ in _TWINS[1:]:
+                got, rec = self._search_lanes(name, page2)
+                if self.oracle.compare(f"search-after-loop-vs-{name}",
+                                       page2, ref2, got):
+                    self.oracle.lane_check(
+                        f"search-after-loop-vs-{name}", rec,
+                        self._SORTED_TWIN_LANES[name])
+
+    def _subagg_parity(self) -> None:
+        """Sub-agg-tree replay pairs (ISSUE 17): the composite-bin
+        device planner (histogram/terms parents, integer-exact leaf
+        metrics) vs the host's recursive per-segment collect —
+        documented bitwise on every twin."""
+        for body in self.solo_work.subagg_queries(3):
+            ref = self.node.search("c-loop", copy.deepcopy(body))
+            for name, _ in _TWINS[1:]:
+                got, rec = self._search_lanes(name, body)
+                if self.oracle.compare(f"subagg-loop-vs-{name}", body,
+                                       ref, got):
+                    self.oracle.lane_check(f"subagg-loop-vs-{name}",
+                                           rec, self._TWIN_LANES[name])
 
     def _knn_parity(self) -> None:
         for body in self.solo_work.knn_queries(3):

@@ -101,6 +101,63 @@ class SeededWorkload:
             out.append(body)
         return out
 
+    def sorted_queries(self, count: int) -> list[dict]:
+        """Sorted bodies (ISSUE 17): numeric / keyword-then-numeric /
+        duplicate-heavy keyword-only primaries, so the encoded-key
+        device sort is exercised where ties force the (_shard, _doc)
+        tie-break — the shapes the sorted lane must answer bitwise
+        like the per-segment loop's materialized-value merge."""
+        out = []
+        for j in range(count):
+            w = self.rng.choice(WORDS)
+            size = self.rng.choice([5, 10])
+            if j % 3 == 0:
+                body = {"size": size, "query": {"match": {"body": w}},
+                        "sort": [{"n": self.rng.choice(["asc", "desc"])}]}
+            elif j % 3 == 1:
+                body = {"size": size, "query": {"match_all": {}},
+                        "sort": [{"tag": "asc"}, {"n": "desc"}]}
+            else:
+                # keyword-only sort: every hit ties within a tag, so
+                # the hidden (_shard, _doc) order IS the result order
+                body = {"size": size, "query": {"match_all": {}},
+                        "sort": [{"tag": "desc"}]}
+            out.append(body)
+        return out
+
+    def subagg_queries(self, count: int) -> list[dict]:
+        """Sub-agg trees (ISSUE 17) with integer-exact leaf metrics
+        (value_count / min / max over `n`) — float SUMS are excluded
+        from the bitwise roster by design: the device's pairwise
+        reduction and the host's sequential sum differ in the last
+        ulp, which is documented, not a parity failure."""
+        out = []
+        for j in range(count):
+            w = self.rng.choice(WORDS)
+            interval = self.rng.choice([25, 50])
+            if j % 3 == 0:
+                tree = {"by_n": {
+                    "histogram": {"field": "n", "interval": interval},
+                    "aggs": {"tags": {
+                        "terms": {"field": "tag"},
+                        "aggs": {"hi": {"max": {"field": "n"}}}}}}}
+            elif j % 3 == 1:
+                tree = {"by_n": {
+                    "histogram": {"field": "n", "interval": interval},
+                    "aggs": {"lo": {"min": {"field": "n"}},
+                             "cnt": {"value_count": {"field": "n"}}}}}
+            else:
+                tree = {"tags": {
+                    "terms": {"field": "tag"},
+                    "aggs": {"by_n": {
+                        "histogram": {"field": "n",
+                                      "interval": interval},
+                        "aggs": {"cnt": {
+                            "value_count": {"field": "n"}}}}}}}
+            out.append({"size": 5, "query": {"match": {"body": w}},
+                        "aggs": tree})
+        return out
+
     def knn_queries(self, count: int) -> list[dict]:
         """kNN bodies cycling the metric roster; `k` stays small so the
         tiny chaos corpus keeps every candidate window meaningful."""

@@ -91,7 +91,11 @@ def test_tail_latency_keys_survive_forced_timeout():
                 # seeded-null contract — the flight sidecar rides the
                 # emergency line even when a kill lands mid-leg
                 "xla_compile_ms_total", "hbm_peak_bytes",
-                "lane_decision_counts", "flight"):
+                "lane_decision_counts", "flight",
+                # log-analytics observability tier (ISSUE 17): same
+                # seeded-null contract
+                "sorted_mesh_qps", "sorted_fanout_qps",
+                "subagg_mesh_qps", "monitoring_overview_p50_ms"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

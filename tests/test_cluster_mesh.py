@@ -8,10 +8,11 @@ Contract pinned here:
     message + ONE device program + ONE device fetch per HOST, and the
     response is BITWISE-identical to the per-shard transport merge —
     across the query-shape matrix including terms/date_histogram/stats
-    aggregations and IVF kNN;
-  * the fallback ladder (sorted bodies, unsupported agg shapes, opt-out
-    settings, single-shard hosts) lands on the hedged per-shard fan-out,
-    never errors;
+    aggregations, SORTED bodies with search_after cursors and sub-agg
+    TREES (ISSUE 17), and IVF kNN;
+  * the fallback ladder (unsupported agg/sort shapes, opt-out settings,
+    single-shard hosts) lands on the hedged per-shard fan-out, never
+    errors;
   * cluster bulk replication rides ONE framed A_WRITE_R_BULK send per
     (node, request) with per-op apply semantics unchanged;
   * es_search_mesh_host_reduce_* counters join the cluster metric walk.
@@ -178,18 +179,80 @@ class TestHostReduceParity:
             "mesh_host_reduce must nest under the coordinator query span"
 
 
-class TestHostReduceFallbacks:
-    def test_sorted_body_falls_back(self, cluster):
-        client = cluster.client()
-        d0 = sum(n.host_reduce_stats["dispatches"]
-                 for n in cluster.nodes.values())
+class TestHostReduceSorted:
+    """ISSUE 17: sorted bodies + sub-agg trees ride the host reduce —
+    one device program per host, materialized per-hit `sort` wire arrays,
+    bitwise-identical to the per-shard fan-out merge."""
+
+    SORTED_BODIES = [
+        {"size": 10, "query": {"match_all": {}},
+         "sort": [{"n": {"order": "desc"}}]},
+        {"size": 12, "query": {"match": {"body": "fox"}},
+         "sort": [{"tag": "asc"}, {"n": "desc"}]},
+        {"size": 10, "query": {"match_all": {}},
+         "sort": [{"n": "desc"}], "search_after": [350]},
+        {"size": 8, "query": {"match": {"body": "dog"}},
+         "sort": [{"n": "asc"}], "track_scores": True},
+    ]
+
+    @pytest.mark.parametrize("body", SORTED_BODIES,
+                             ids=["n-desc", "kw-then-n", "search-after",
+                                  "track-scores"])
+    def test_sorted_bitwise(self, cluster, body):
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2, "each of the 2 hosts must run ONE reduce"
+        assert got == want, body
+        assert all("sort" in h for h in got["hits"]["hits"])
+
+    def test_sorted_order_is_global(self, cluster):
         body = {"size": 10, "query": {"match_all": {}},
                 "sort": [{"n": {"order": "desc"}}]}
-        out = client.search("docs", json.loads(json.dumps(body)))
-        ids = [h["_id"] for h in out["hits"]["hits"]]
+        got, _want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        ids = [h["_id"] for h in got["hits"]["hits"]]
         assert ids == sorted(ids, key=int, reverse=True)[:len(ids)]
-        assert sum(n.host_reduce_stats["dispatches"]
-                   for n in cluster.nodes.values()) == d0
+
+    def test_subagg_tree_rides_the_host_reduce(self, cluster):
+        body = {"size": 5, "query": {"match_all": {}},
+                "aggs": {"hn": {
+                    "histogram": {"field": "n", "interval": 50},
+                    "aggs": {"tags": {
+                        "terms": {"field": "tag"},
+                        "aggs": {"mx": {"max": {"field": "n"}}}}}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+        buckets = got["aggregations"]["hn"]["buckets"]
+        assert len(buckets) == 8
+        assert all(len(b["tags"]["buckets"]) == 3 for b in buckets)
+
+    def test_sorted_plus_subagg_one_program(self, cluster):
+        body = {"size": 5, "query": {"match_all": {}},
+                "sort": [{"n": "desc"}],
+                "aggs": {"tags": {"terms": {"field": "tag"},
+                                  "aggs": {"mx": {"max":
+                                                  {"field": "n"}}}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 2
+        assert got == want
+
+
+class TestHostReduceFallbacks:
+    def test_calendar_interval_subagg_declines(self, cluster):
+        """Calendar-interval date_histogram parents have no exact device
+        bin form — the tree declines to the fan-out, answers identical."""
+        client = cluster.client()
+        de0 = sum(n.host_reduce_stats["declined"]
+                  for n in cluster.nodes.values())
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"over": {
+                    "date_histogram": {"field": "n", "interval": "month"},
+                    "aggs": {"mx": {"max": {"field": "n"}}}}}}
+        got, want, engaged = _search_both(cluster, body)
+        assert engaged == 0
+        assert got == want
+        assert sum(n.host_reduce_stats["declined"]
+                   for n in cluster.nodes.values()) > de0
 
     def test_unsupported_agg_declines(self, cluster):
         client = cluster.client()

@@ -203,7 +203,10 @@ class TestMeshCounters:
 
 
 class TestFallbackLadder:
-    def test_sorted_falls_back_to_fanout(self, pair):
+    def test_sorted_rides_the_mesh_but_score_sort_declines(self, pair):
+        """ISSUE 17: encoded-key sorts no longer decline the mesh — the
+        cross-shard merge ranks by key on device. Sorts the encoding
+        can't bitwise-reproduce (a `_score` key) still fall back."""
         n = pair
         before = n.indices["m"].search_stats.get("mesh", 0)
         body = {"size": 10, "query": {"match_all": {}},
@@ -211,6 +214,11 @@ class TestFallbackLadder:
         out = n.search("m", json.loads(json.dumps(body)))
         ids = [h["_id"] for h in out["hits"]["hits"]]
         assert ids == sorted(ids, key=int, reverse=True)[:len(ids)]
+        assert n.indices["m"].search_stats.get("mesh", 0) == before + 1
+        before = n.indices["m"].search_stats.get("mesh", 0)
+        declined = {"size": 10, "query": {"match": {"body": "quick"}},
+                    "sort": [{"n": "asc"}, "_score"]}
+        n.search("m", json.loads(json.dumps(declined)))
         assert n.indices["m"].search_stats.get("mesh", 0) == before
 
     def test_unsupported_plan_falls_back(self, pair):

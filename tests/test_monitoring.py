@@ -1,0 +1,189 @@
+"""Self-monitoring pipeline (ISSUE 17 tentpole (c)).
+
+The `node.monitoring.enable` collector drains StatsSampler snapshots
+into rolling `.monitoring-es-YYYY.MM.DD` internal indices through the
+vectorized bulk lane, rolls the target daily, deletes days past
+`node.monitoring.retention_days`, and serves `GET /_monitoring/overview`
+with a REAL sorted + 2-level sub-agg body through the device lanes —
+the acceptance check asserts the lane recorder saw `mesh` chosen, not
+the per-segment loop. Leak hygiene rides the suite-wide armed
+detectors: every engine the collector creates closes clean, and the
+collector thread joins on node close.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.common.monitoring import (INDEX_PREFIX,
+                                                 MonitoringCollector)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import NodeService
+
+MON_SETTINGS = {"node.monitoring.enable": True,
+                "node.monitoring.interval": 0,     # manual ticks
+                "node.monitoring.retention_days": 3,
+                "node.sampler.interval": 0}
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("monitoring")),
+                    Settings(dict(MON_SETTINGS)))
+    yield n
+    n.close()
+
+
+def test_disabled_by_default(tmp_path):
+    n = NodeService(str(tmp_path / "plain"))
+    try:
+        assert n.monitoring is None, \
+            "monitoring is opt-in; plain nodes must not grow indices"
+    finally:
+        n.close()
+
+
+def test_collector_drains_sampler_into_daily_index(node):
+    assert node.monitoring is not None
+    for _ in range(6):
+        node.sampler.sample()
+        time.sleep(0.002)       # distinct ms timestamps (doc ids)
+    count = node.monitoring.collect_once()
+    assert count >= 6
+    name = node.monitoring.current_index
+    assert name.startswith(INDEX_PREFIX) and name in node.indices
+    # idempotent tick: nothing newer than the watermark -> no docs
+    assert node.monitoring.collect_once() == 0
+    node.sampler.sample()
+    assert node.monitoring.collect_once() == 1
+    assert node.monitoring.stats["docs_indexed_total"] >= 7
+
+
+def test_rollover_counts_day_changes(node):
+    node.monitoring.current_index = f"{INDEX_PREFIX}1999.01.01"
+    before = node.monitoring.stats["rollovers_total"]
+    node.sampler.sample()
+    assert node.monitoring.collect_once() == 1
+    assert node.monitoring.stats["rollovers_total"] == before + 1
+    assert node.monitoring.current_index != f"{INDEX_PREFIX}1999.01.01"
+
+
+def test_retention_deletes_old_days(node):
+    old = f"{INDEX_PREFIX}2020.01.01"
+    node.create_index(old, {"number_of_shards": 1})
+    node.sampler.sample()
+    node.monitoring.collect_once()
+    assert old not in node.indices, \
+        "days past retention_days must be deleted (ILM-lite)"
+    assert node.monitoring.stats["retention_deletes_total"] >= 1
+    # malformed .monitoring-* names survive (never parsed as days)
+    odd = f"{INDEX_PREFIX}not.a.day"
+    node.create_index(odd, {"number_of_shards": 1})
+    node.sampler.sample()
+    node.monitoring.collect_once()
+    assert odd in node.indices
+    node.delete_index(odd)
+
+
+def test_overview_answers_through_the_device_lanes(node):
+    """THE acceptance check: the overview's sorted + 2-level sub-agg
+    body rides the mesh program over the 2-shard monitoring index —
+    the lane recorder shows `mesh` chosen, not the per-segment loop."""
+    for _ in range(8):
+        node.sampler.sample()
+        time.sleep(0.002)
+    node.monitoring.collect_once()
+    with record_lanes() as rec:
+        ov = node.monitoring.overview(size=5, interval="1s")
+    assert rec.chose("mesh"), rec.entries
+    lanes = ov["monitoring"]["lanes"]
+    assert lanes["mesh_sorted_dispatches"] == 1, lanes
+    assert lanes["mesh_agg_dispatches"] == 1, lanes
+    hits = ov["hits"]["hits"]
+    assert len(hits) == 5
+    ts = [h["sort"][0] for h in hits]
+    assert ts == sorted(ts, reverse=True), "newest-first order"
+    buckets = ov["aggregations"]["over_time"]["buckets"]
+    assert buckets, "date_histogram -> terms -> metrics tree is empty"
+    by_node = buckets[0]["by_node"]["buckets"]
+    assert by_node and by_node[0]["key"] == "tpu-node-0"
+    assert by_node[0]["avg_heap"]["value"] > 0
+    assert ov["monitoring"]["collector"]["docs_indexed_total"] >= 8
+
+
+def test_overview_body_parity_with_mesh_disabled(node):
+    """The canned overview body is an ordinary search: disabling the
+    mesh lane on the monitoring index answers byte-identically through
+    the per-shard fallback (the ISSUE 17 parity contract, dogfooded)."""
+    target = node.monitoring.current_index
+    body = node.monitoring.overview_body(size=5, interval="1s")
+    got = node.search(target, json.loads(json.dumps(body)))
+    svc_settings = node.indices[target].settings
+    svc_settings._map["index.search.mesh.enable"] = False
+    try:
+        want = node.search(target, json.loads(json.dumps(body)))
+    finally:
+        svc_settings._map.pop("index.search.mesh.enable", None)
+    for r in (got, want):
+        r.pop("took", None)
+    assert got == want
+
+
+def test_overview_with_no_indices_is_empty_stub(tmp_path):
+    n = NodeService(str(tmp_path / "fresh"), Settings(dict(MON_SETTINGS)))
+    try:
+        ov = n.monitoring.overview()
+        assert ov["hits"]["hits"] == []
+        assert ov["monitoring"]["indices"] == []
+    finally:
+        n.close()
+
+
+def test_http_route(node, tmp_path):
+    from elasticsearch_tpu.rest import HttpServer
+    srv = HttpServer(node, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/_monitoring/overview?size=3"
+        with urllib.request.urlopen(url) as resp:
+            out = json.loads(resp.read())
+        assert out["monitoring"]["enabled"] is True
+        assert len(out["hits"]["hits"]) <= 3
+    finally:
+        srv.stop()
+    plain = NodeService(str(tmp_path / "nomon"))
+    srv = HttpServer(plain, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/_monitoring/overview")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        plain.close()
+
+
+def test_collector_thread_joins_on_close(tmp_path):
+    """Leak hygiene: a ticking collector runs as a named daemon thread
+    and `NodeService.close()` joins it — no thread outlives the node
+    (the suite-wide leak detectors then see every engine drained)."""
+    n = NodeService(str(tmp_path / "ticking"),
+                    Settings({**MON_SETTINGS,
+                              "node.monitoring.interval": 0.05}))
+    t = n.monitoring._thread
+    assert t is not None and t.is_alive()
+    assert t.name == "es[monitoring_collector]"
+    deadline = time.time() + 5.0
+    while not n.monitoring.stats["collections_total"] \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert n.monitoring.stats["collections_total"] >= 1, \
+        "the interval thread never ticked"
+    n.close()
+    assert n.monitoring._thread is None
+    assert not t.is_alive(), "collector thread survived node close"
+    assert t.name not in {th.name for th in threading.enumerate()}

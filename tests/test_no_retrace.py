@@ -487,3 +487,94 @@ def test_device_stats_scrape_compiles_nothing(stacked_node):
     n.search("s", json.loads(json.dumps(STACKED_BODY)))
     assert device_events_snapshot()[0] - c0 == 0, \
         "the scrape invalidated the jit cache (retrace after scrape)"
+
+
+# -- sorted dense lanes (ISSUE 17) ------------------------------------------
+
+SORTED_NR_BODY = {"size": 5, "query": {"match": {"body": "fox"}},
+                  "sort": [{"n": "desc"}]}
+
+
+@pytest.fixture(scope="module")
+def sorted_nodes(tmp_path_factory):
+    """One 1-shard stacked index and one 4-shard mesh index; segments
+    added in same-size refresh rounds so every sorted-stack axis
+    (G_pad, N_pad, P_pad — and S_pad on the mesh) stays inside one
+    pow2 bucket."""
+    n = NodeService(str(tmp_path_factory.mktemp("sortnr")))
+    maps = {"_doc": {"properties": {"body": {"type": "string"},
+                                    "n": {"type": "long"}}}}
+    n.create_index("sn", settings={"number_of_shards": 1}, mappings=maps)
+    n.create_index("snm", settings={"number_of_shards": 4}, mappings=maps)
+    seq = {"sn": 0, "snm": 0}
+
+    def add_round(name, count=32):
+        for _ in range(count):
+            i = seq[name]
+            seq[name] += 1
+            n.index_doc(name, str(i),
+                        {"body": f"quick brown fox jumps {i}", "n": i})
+        n.refresh(name)
+    n._add_round = add_round
+    yield n
+    n.close()
+
+
+def test_sorted_refresh_cycles_within_bucket_zero_retraces(sorted_nodes):
+    """Sorted refresh→query cycles whose stack shapes stay in the same
+    pow2 bucket must compile ZERO new programs on the sorted stacked
+    path — the encoded-key columns rebuild, the program does not."""
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = sorted_nodes
+    for _ in range(3):                       # 3 segments -> G_pad = 4
+        n._add_round("sn")
+    _q = lambda: n.search("sn", json.loads(json.dumps(SORTED_NR_BODY)))
+    _q()                                     # warm: compiles expected
+    _q()
+    assert n.indices["sn"].search_stats.get("stacked_sorted", 0) >= 2
+    before = device_events_snapshot()[0]
+    n._add_round("sn")                       # 4th segment: same bucket
+    _q()
+    assert device_events_snapshot()[0] == before, \
+        "sorted refresh→query cycle inside the pow2 bucket retraced"
+
+
+def test_sorted_single_fetch_per_shard(sorted_nodes):
+    """Counter-asserted: a sorted query performs exactly one
+    device_fetch per shard on the sorted stacked path — keys, totals,
+    row-max and the top-k ride ONE transfer."""
+    from elasticsearch_tpu.common.metrics import transfer_snapshot
+    n = sorted_nodes
+    if not n.indices["sn"].shards[0].segments:
+        n._add_round("sn")
+    n.search("sn", json.loads(json.dumps(SORTED_NR_BODY)))     # warm
+    before = transfer_snapshot()["device_fetches_total"]
+    n.search("sn", json.loads(json.dumps(SORTED_NR_BODY)))
+    delta = transfer_snapshot()["device_fetches_total"] - before
+    n_shards = len(n.indices["sn"].shards)
+    assert delta == n_shards, \
+        f"{delta} device fetches for {n_shards} sorted shard(s)"
+
+
+def test_mesh_sorted_refresh_cycles_one_fetch_zero_retraces(sorted_nodes):
+    """The sorted mesh program: refresh→query cycles inside the pow2
+    bucket compile nothing new, and the whole 4-shard sorted answer
+    (global order + per-shard totals) arrives in ONE device fetch."""
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    n = sorted_nodes
+    for _ in range(3):                 # ~3 segments/shard -> G_pad = 4
+        n._add_round("snm", 16)
+    _q = lambda: n.search("snm", json.loads(json.dumps(SORTED_NR_BODY)))
+    _q()                               # warm: compiles expected
+    _q()
+    assert n.indices["snm"].search_stats.get(
+        "mesh_sorted_dispatches", 0) >= 2
+    before = device_events_snapshot()[0]
+    f0 = transfer_snapshot()["device_fetches_total"]
+    n._add_round("snm", 16)            # 4th round: same G bucket
+    _q()
+    assert device_events_snapshot()[0] == before, \
+        "sorted refresh→query cycle retraced the mesh program"
+    assert transfer_snapshot()["device_fetches_total"] - f0 == 1, \
+        "the sorted mesh lane must serve all 4 shards in one fetch"

@@ -31,7 +31,8 @@ def test_route_count_floor_and_uniqueness(controller):
     # floor, not exact: new PRs add routes; LOSING routes is the bug.
     # (252 registered at ISSUE-5 time: tracing added /_traces,
     # /_traces/{trace_id} and /_nodes/slowlog)
-    assert len(controller.routes) >= 252, len(controller.routes)
+    # re-anchored at ISSUE 17: /_monitoring/overview joined the table
+    assert len(controller.routes) >= 253, len(controller.routes)
     seen = set()
     for method, rx, _h, _s in controller.routes:
         key = (method, rx.pattern)
@@ -46,7 +47,7 @@ def test_new_observability_routes_resolve(controller):
                  "/_cache/clear", "/someindex/_cache/clear",
                  "/_cat/fielddata",
                  "/_traces", "/_traces/abcdef0123456789",
-                 "/_nodes/slowlog"):
+                 "/_nodes/slowlog", "/_monitoring/overview"):
         assert _resolves(controller, path), path
 
 

@@ -1,0 +1,316 @@
+"""ISSUE 17: bitwise-parity matrix for the sorted dense lanes and the
+on-device sub-agg trees.
+
+The same 2-shard corpus lives under four lane configurations — the
+per-segment loop (reference), stacked, stacked-blockwise, and mesh —
+and every sorted body in the matrix (asc/desc x numeric/keyword/date x
+missing _first/_last x search_after pagination, over a duplicate-heavy
+corpus with tombstones) must answer byte-identically on all four. The
+loop's materialized-value merge defines the contract; the encoded-key
+device sort must reproduce it exactly, including the (_shard, _doc)
+cursor tie-break at duplicate keys (the ISSUE 17 search_after bugfix).
+
+Sub-agg trees: 2- and 3-level `date_histogram`/`histogram`/`terms`
+parents over integer-exact leaf metrics (max/min/value_count — float
+SUMS are excluded: device pairwise reduction differs from the host's
+sequential sum in the last ulp, documented, not parity).
+
+Decline surface: bodies the encoding cannot bitwise-reproduce decline
+with the STABLE reasons `sort_encode.decline_reason` documents
+(score_sort, fielddata_sort, keyword_numeric_missing, ...) and the
+sub-agg planner's calendar_interval — pinned here by name so the
+lane-explain output stays a contract, and every declined body still
+answers bitwise through the loop fallback.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.node import NodeService
+
+TWINS = [
+    ("l-loop", {"index.search.stacked.enable": False,
+                "index.search.blockwise.enable": False,
+                "index.search.mesh.enable": False}),
+    ("l-stacked", {"index.search.blockwise.enable": False,
+                   "index.search.mesh.enable": False}),
+    ("l-block", {"index.search.mesh.enable": False,
+                 "index.search.block_docs": 32}),
+    ("l-mesh", {}),
+]
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "string"},
+    "kw": {"type": "string", "index": "not_analyzed"},
+    "n": {"type": "long"},
+    "m": {"type": "long"},
+    "ts": {"type": "date"},
+    "val": {"type": "long"}}}}
+
+BASE_TS = 1_722_470_400_000          # 2024-08-01T00:00:00Z
+N_DOCS = 180
+WORDS = ["quick", "brown", "fox", "lazy", "dog"]
+KWS = ["red", "green", "blue", "cyan"]
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("sortedlanes")))
+    for name, extra in TWINS:
+        n.create_index(name, settings={"number_of_shards": 2, **extra},
+                       mappings={k: dict(v) for k, v in MAPPING.items()})
+    for name, _ in TWINS:
+        for i in range(N_DOCS):
+            doc = {"body": f"{WORDS[i % 5]} {WORDS[(i * 3 + 1) % 5]}",
+                   "n": i % 25,                        # duplicate-heavy
+                   "ts": BASE_TS + (i % 12) * 60_000,  # duplicate dates
+                   "val": (i * 7) % 101}
+            if i % 3 != 0:
+                doc["kw"] = KWS[i % 4]                 # 1/3 missing
+            if i % 4 != 0:
+                doc["m"] = (i * 13) % 40               # 1/4 missing
+            n.index_doc(name, str(i), doc)
+            if i % 60 == 59:
+                n.refresh(name)          # multiple segments per shard
+        # tombstones: no force-merge, deletes survive as liveness masks
+        for i in range(0, N_DOCS, 17):
+            n.delete_doc(name, str(i))
+        n.refresh(name)
+    yield n
+    n.close()
+
+
+def canon(resp: dict) -> dict:
+    r = json.loads(json.dumps(resp))
+    r.pop("took", None)
+    for h in r.get("hits", {}).get("hits", []):
+        h.pop("_index", None)
+    return r
+
+
+def _ask(n, name, body):
+    return n.search(name, json.loads(json.dumps(body)))
+
+
+def _matrix(n, body) -> dict:
+    """Every dense twin must answer `body` byte-identically to the
+    loop twin. Returns the canonical reference response."""
+    ref = canon(_ask(n, "l-loop", body))
+    for name, _ in TWINS[1:]:
+        got = canon(_ask(n, name, body))
+        assert got == ref, \
+            f"[{name}] diverged from the loop for {body!r}"
+    return ref
+
+
+# -- the sort matrix ---------------------------------------------------------
+
+FIELDS = [("n", None), ("kw", None), ("ts", None),
+          ("m", "_first"), ("m", "_last"),
+          ("kw", "_first"), ("kw", "_last")]
+
+
+@pytest.mark.parametrize("order", ["asc", "desc"])
+@pytest.mark.parametrize("field,missing", FIELDS,
+                         ids=[f"{f}-{m or 'default'}" for f, m in FIELDS])
+def test_sorted_matrix_bitwise(node, field, missing, order):
+    spec = {"order": order}
+    if missing is not None:
+        spec["missing"] = missing
+    body = {"size": 12, "query": {"match_all": {}},
+            "sort": [{field: spec}, {"n": "asc"}]}
+    ref = _matrix(node, body)
+    hits = ref["hits"]["hits"]
+    assert len(hits) == 12
+    assert all("sort" in h and len(h["sort"]) == 2 for h in hits)
+    # sorted default: scores untracked — null, like the reference engine
+    assert all(h["_score"] is None for h in hits)
+
+
+def test_sorted_with_match_query_bitwise(node):
+    body = {"size": 10, "query": {"match": {"body": "fox"}},
+            "sort": [{"ts": "desc"}, {"n": "asc"}]}
+    _matrix(node, body)
+
+
+def test_sorted_track_scores_bitwise(node):
+    body = {"size": 10, "query": {"match": {"body": "quick"}},
+            "track_scores": True, "sort": [{"n": "desc"}]}
+    ref = _matrix(node, body)
+    assert all(h["_score"] is not None for h in ref["hits"]["hits"])
+
+
+def test_sorted_from_offset_bitwise(node):
+    body = {"size": 7, "from": 9, "query": {"match_all": {}},
+            "sort": [{"n": "asc"}, {"ts": "desc"}]}
+    _matrix(node, body)
+
+
+# -- search_after pagination (the duplicate-key tie-break bugfix) ------------
+
+def _live_count(node):
+    return N_DOCS - len(range(0, N_DOCS, 17))
+
+
+@pytest.mark.parametrize("sort", [
+    [{"ts": "desc"}, {"_doc": "asc"}],
+    [{"n": "asc"}, {"_doc": "asc"}],
+    [{"kw": {"order": "asc", "missing": "_last"}}, {"_doc": "asc"}],
+], ids=["date-dups", "numeric-dups", "keyword-missing"])
+def test_search_after_pages_cover_disjointly(node, sort):
+    """Page the whole corpus 10 at a time with the documented `_doc`
+    cursor tie-break: every page byte-identical across all four lanes,
+    and the page stream is a disjoint cover of the live corpus — at
+    duplicate keys a wrong tie-break either repeats or skips docs at
+    page boundaries, which is exactly what this regression pins."""
+    body = {"size": 10, "query": {"match_all": {}}, "sort": sort}
+    seen: list[str] = []
+    cursor = None
+    for _ in range(N_DOCS // 10 + 2):
+        b = json.loads(json.dumps(body))
+        if cursor is not None:
+            b["search_after"] = cursor
+        ref = _matrix(node, b)
+        hits = ref["hits"]["hits"]
+        if not hits:
+            break
+        seen.extend(h["_id"] for h in hits)
+        cursor = hits[-1]["sort"]
+    assert len(seen) == len(set(seen)), "pagination repeated a doc"
+    assert len(seen) == _live_count(node), \
+        "pagination skipped live docs (tombstones excluded)"
+
+
+def test_search_after_without_tiebreak_bitwise(node):
+    """No tie-break key: strict-after on duplicate timestamps skips the
+    remaining ties — reference semantics. Every lane must skip the SAME
+    docs (the encoded cursor filter reproduces the loop's mask)."""
+    page1 = _matrix(node, {"size": 10, "query": {"match_all": {}},
+                           "sort": [{"ts": "desc"}]})
+    cursor = page1["hits"]["hits"][-1]["sort"]
+    _matrix(node, {"size": 10, "query": {"match_all": {}},
+                   "sort": [{"ts": "desc"}], "search_after": cursor})
+
+
+# -- sub-agg trees -----------------------------------------------------------
+
+SUBAGG_BODIES = [
+    # 2-level: date_histogram -> integer-exact metrics
+    {"size": 0, "query": {"match_all": {}},
+     "aggs": {"over_time": {
+         "date_histogram": {"field": "ts", "interval": "1m"},
+         "aggs": {"mx": {"max": {"field": "val"}},
+                  "c": {"value_count": {"field": "val"}}}}}},
+    # 3-level: histogram -> terms -> metric
+    {"size": 0, "query": {"match_all": {}},
+     "aggs": {"by_n": {
+         "histogram": {"field": "n", "interval": 5},
+         "aggs": {"tags": {
+             "terms": {"field": "kw"},
+             "aggs": {"hi": {"max": {"field": "val"}}}}}}}},
+    # 3-level: terms -> date_histogram -> metric
+    {"size": 0, "query": {"match_all": {}},
+     "aggs": {"tags": {
+         "terms": {"field": "kw"},
+         "aggs": {"over_time": {
+             "date_histogram": {"field": "ts", "interval": "2m"},
+             "aggs": {"lo": {"min": {"field": "n"}}}}}}}},
+    # scored parent query + tree (hits and partials in one program)
+    {"size": 5, "query": {"match": {"body": "fox"}},
+     "aggs": {"by_n": {
+         "histogram": {"field": "n", "interval": 10},
+         "aggs": {"c": {"value_count": {"field": "m"}}}}}},
+]
+
+
+@pytest.mark.parametrize("body", SUBAGG_BODIES,
+                         ids=["date2level", "hist-terms3", "terms-date3",
+                              "scored2level"])
+def test_subagg_tree_bitwise(node, body):
+    ref = _matrix(node, body)
+    assert ref["aggregations"], "tree produced no aggregations"
+
+
+def test_sorted_plus_subagg_bitwise(node):
+    """The log-analytics shape end to end: newest-first sorted hits AND
+    a 2-level tree out of the same single program per lane."""
+    body = {"size": 8, "query": {"match_all": {}},
+            "sort": [{"ts": "desc"}, {"_doc": "asc"}],
+            "aggs": {"over_time": {
+                "date_histogram": {"field": "ts", "interval": "3m"},
+                "aggs": {"tags": {"terms": {"field": "kw"}}}}}}
+    ref = _matrix(node, body)
+    assert len(ref["hits"]["hits"]) == 8
+    assert ref["aggregations"]["over_time"]["buckets"]
+
+
+# -- lane engagement (the matrix is not vacuous) -----------------------------
+
+def test_sorted_body_rides_the_device_lanes(node):
+    body = {"size": 10, "query": {"match_all": {}},
+            "sort": [{"n": "desc"}]}
+    with record_lanes() as rec:
+        _ask(node, "l-mesh", body)
+    assert rec.chose("mesh"), rec.entries
+    with record_lanes() as rec:
+        _ask(node, "l-stacked", body)
+    assert rec.chose("stacked"), rec.entries
+    assert node.indices["l-mesh"].search_stats.get(
+        "mesh_sorted_dispatches", 0) >= 1
+
+
+def test_subagg_tree_rides_the_mesh(node):
+    # interval 4 keeps this body out of the request cache (the parity
+    # matrix already asked the interval-5 shape on this index)
+    body = json.loads(json.dumps(SUBAGG_BODIES[1]))
+    body["aggs"]["by_n"]["histogram"]["interval"] = 4
+    with record_lanes() as rec:
+        _ask(node, "l-mesh", body)
+    assert rec.chose("mesh"), rec.entries
+    assert node.indices["l-mesh"].search_stats.get(
+        "mesh_agg_dispatches", 0) >= 1
+
+
+# -- stable decline reasons (the lane-explain contract) ----------------------
+
+def _declines(rec):
+    return {(e["lane"], e["reason"]) for e in rec.entries
+            if e["reason"] != "chosen"}
+
+
+@pytest.mark.parametrize("body,reason", [
+    ({"size": 5, "query": {"match": {"body": "fox"}},
+      "sort": [{"_score": "asc"}]}, "score_sort"),
+    ({"size": 5, "query": {"match_all": {}},
+      "sort": [{"body": "asc"}]}, "fielddata_sort"),
+    ({"size": 5, "query": {"match_all": {}},
+      "sort": [{"kw": {"order": "asc", "missing": "zzz"}}]},
+     "keyword_numeric_missing"),
+], ids=["score_sort", "fielddata_sort", "keyword_numeric_missing"])
+def test_sorted_decline_reasons_are_stable(node, body, reason):
+    """Bodies the encoded-key sort cannot bitwise-reproduce decline
+    with their DOCUMENTED reason on both the mesh and stacked rungs,
+    then answer through the loop — still bitwise across twins."""
+    if reason == "keyword_numeric_missing":
+        body = json.loads(json.dumps(body))
+        body["sort"][0]["kw"]["missing"] = 0       # numeric literal
+    with record_lanes() as rec:
+        _ask(node, "l-mesh", body)
+    assert ("mesh", reason) in _declines(rec), rec.entries
+    with record_lanes() as rec:
+        _ask(node, "l-stacked", body)
+    assert ("stacked", reason) in _declines(rec), rec.entries
+    _matrix(node, body)
+
+
+def test_calendar_interval_subagg_declines_stably(node):
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"monthly": {
+                "date_histogram": {"field": "ts", "interval": "month"},
+                "aggs": {"c": {"value_count": {"field": "val"}}}}}}
+    with record_lanes() as rec:
+        _ask(node, "l-mesh", body)
+    assert ("mesh", "calendar_interval") in _declines(rec), rec.entries
+    _matrix(node, body)

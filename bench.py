@@ -96,7 +96,13 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # emits them
                      "sorted_mesh_qps": None, "sorted_fanout_qps": None,
                      "subagg_mesh_qps": None,
-                     "monitoring_overview_p50_ms": None}
+                     "monitoring_overview_p50_ms": None,
+                     # reverse search + script compiler (ISSUE 18):
+                     # seeded null at import so a forced timeout still
+                     # emits them
+                     "percolate_qps": None, "percolate_matrix_qps": None,
+                     "percolate_vs_loop": None,
+                     "script_score_qps": None, "script_vs_decline": None}
 _LINE_PRINTED = False
 
 
@@ -1261,6 +1267,158 @@ def run_chaos_leg(tag: str) -> dict:
                 len(report.invariant_violations)}
 
 
+def run_percolate_leg(tag: str) -> dict:
+    """Reverse search (ISSUE 18): register BENCH_PERCOLATE_QUERIES dense-
+    eligible queries (match / term / range / bool — the four channel
+    families of the doc×query grid), then percolate doc batches through
+    the ONE-program dense executor vs the per-doc loop rung measured on a
+    small doc subsample and extrapolated. Also times a compilable
+    script_score riding the fused device lane vs the SAME expression
+    forced onto the host evaluator (an `if true else` wrapper declines the
+    compiler but evaluates identically) — the compiled-vs-decline ratio."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.common.metrics import transfer_snapshot
+    from elasticsearch_tpu.node import NodeService
+    from elasticsearch_tpu.search import percolator as perc_mod
+    from elasticsearch_tpu.search.percolate_exec import percolate_batch
+
+    nq = int(os.environ.get("BENCH_PERCOLATE_QUERIES", "50000"))
+    batch_docs = int(os.environ.get("BENCH_PERCOLATE_BATCH", "64"))
+    reps = int(os.environ.get("BENCH_PERCOLATE_REPS", "6"))
+    loop_docs = int(os.environ.get("BENCH_PERCOLATE_LOOP_DOCS", "2"))
+    s_docs = int(os.environ.get("BENCH_SCRIPT_DOCS", "5000"))
+    s_reps = int(os.environ.get("BENCH_SCRIPT_REPS", "30"))
+    workdir = tempfile.mkdtemp(prefix=f"bench-perc-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"))
+    out: dict = {}
+    try:
+        node.create_index("perc", settings={"number_of_shards": 1},
+                          mappings={"_doc": {"properties": {
+                              "body": {"type": "string"},
+                              "tag": {"type": "string",
+                                      "index": "not_analyzed"},
+                              "n": {"type": "long"}}}})
+        tags = [f"t{i}" for i in range(16)]
+
+        def qbody(i: int) -> dict:
+            w = f"term{64 + (i * 131) % 8000:05d}"
+            kind = i % 4
+            if kind == 0:
+                return {"match": {"body": w}}
+            if kind == 1:
+                return {"term": {"tag": tags[i % len(tags)]}}
+            if kind == 2:
+                lo = (i * 37) % 5000
+                return {"range": {"n": {"gte": lo, "lt": lo + 200}}}
+            return {"bool": {"must": [{"match": {"body": w}}],
+                             "must_not": [{"term": {
+                                 "tag": tags[(i + 7) % len(tags)]}}]}}
+
+        registered = 0
+        for i in range(0, nq, 4000):
+            ops = [("index", {"_index": "perc", "_id": f"pq-{j}",
+                              "_type": ".percolator"},
+                    {"query": qbody(j)})
+                   for j in range(i, min(i + 4000, nq))]
+            node.bulk(ops)
+            registered += len(ops)
+            if _over_budget(margin=120.0):
+                break              # partial registry: ratio still holds
+        node.refresh("perc")
+        svc = node.indices["perc"]
+        rng = np.random.default_rng(29)
+        docs = [{"body": " ".join(
+                     f"term{t:05d}" for t in rng.integers(64, 8192, size=6)),
+                 "tag": tags[int(rng.integers(len(tags)))],
+                 "n": int(rng.integers(0, 5200))}
+                for _ in range(batch_docs)]
+        pairs = [(d, "_doc") for d in docs]
+        percolate_batch(svc, "perc", pairs, caches=node.caches)   # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        t0 = time.perf_counter()
+        dense_n = batches = 0
+        for _ in range(reps):
+            percolate_batch(svc, "perc", pairs, caches=node.caches)
+            dense_n += len(pairs)
+            batches += 1
+            if _over_budget(margin=90.0):
+                break
+        dense_s = time.perf_counter() - t0
+        fetches = transfer_snapshot()["device_fetches_total"] - f0
+        out.update({
+            "percolate_queries": registered,
+            "percolate_qps": dense_n / max(dense_s, 1e-9),
+            "percolate_matrix_qps":
+                dense_n * registered / max(dense_s, 1e-9),
+            "percolate_fetches_per_batch": fetches / max(batches, 1)})
+        # loop rung on a doc SUBSAMPLE, extrapolated — per-doc it re-plans
+        # and re-dispatches the whole registry, which is the point
+        registry = perc_mod.parsed_registry(svc)
+        t0 = time.perf_counter()
+        loop_n = 0
+        for doc in docs[:loop_docs]:
+            _, seg, root = perc_mod.build_doc_segment(svc, doc)
+            perc_mod.loop_match(registry, seg, root)
+            loop_n += 1
+            if _over_budget(margin=60.0):
+                break
+        loop_s = time.perf_counter() - t0
+        if loop_n:
+            loop_qps = loop_n / max(loop_s, 1e-9)
+            out["percolate_loop_qps"] = loop_qps
+            out["percolate_vs_loop"] = \
+                out["percolate_qps"] / max(loop_qps, 1e-9)
+
+        # -- script_score: compiled device lane vs forced host decline
+        node.create_index("sdocs", settings={"number_of_shards": 1},
+                          mappings={"_doc": {"properties": {
+                              "body": {"type": "string"},
+                              "n": {"type": "long"},
+                              "price": {"type": "double"}}}})
+        bodies = make_corpus(s_docs, seed=31)
+        for i in range(0, s_docs, 4000):
+            node.bulk([("index", {"_index": "sdocs", "_id": str(j)},
+                        {"body": bodies[j], "n": j,
+                         "price": float((j * 7) % 1000) / 10.0})
+                       for j in range(i, min(i + 4000, s_docs))])
+        node.refresh("sdocs")
+        expr = ("doc['n'].value * 2.0"
+                " + Math.min(doc['price'].value, params.c)")
+
+        def sbody(src: str, i: int) -> dict:
+            return {"size": 10, "query": {"function_score": {
+                "query": {"match": {"body": f"term{64 + i % 512:05d}"}},
+                "script_score": {"script": src, "params": {"c": 50.0}},
+                "boost_mode": "replace"}}}
+
+        def measure_script(src: str, max_reps: int) -> float | None:
+            node.search("sdocs", sbody(src, 0))        # warm compile
+            t0 = time.perf_counter()
+            n = 0
+            for i in range(max_reps):
+                node.search("sdocs", sbody(src, i + 1))
+                n += 1
+                if _over_budget(margin=45.0):
+                    break
+            return n / max(time.perf_counter() - t0, 1e-9) if n else None
+
+        comp = measure_script(expr, s_reps)
+        # the wrapper declines compilation (IfExp is outside the grammar)
+        # but the host evaluator computes the identical expression
+        host = measure_script(f"({expr}) if true else 0.0",
+                              max(s_reps // 6, 2))
+        if comp:
+            out["script_score_qps"] = comp
+        if comp and host:
+            out["script_host_qps"] = host
+            out["script_vs_decline"] = comp / host
+        return out
+    finally:
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def run_rebalance_leg(tag: str) -> dict:
     """Multi-tenant elasticity (ISSUE 15): drain one node of a live
     3-node cluster via an `exclude._id` filter update WHILE 32 client
@@ -1427,6 +1585,11 @@ def _run_all_legs(tag: str) -> dict:
             # rebalance-under-load SLO (ISSUE 15): wall-clock + SLO
             # ratio, not a device-perf ratio — measured once, in the
             # main process
+            # reverse-search dense-vs-loop + compiled-vs-host script
+            # ratios (ISSUE 18): both lanes run in the same process, so
+            # the ratio is measured once, in the main process
+            ("BENCH_PERCOLATE", "1" if tag == "main" else "0",
+             run_percolate_leg),
             ("BENCH_REBAL", "1" if tag == "main" else "0",
              run_rebalance_leg),
             # 4M-doc aggs + 1M-doc vectors: opt-in —
@@ -1605,6 +1768,21 @@ def main_engine():
             "chaos_mismatches": res.get("chaos_mismatches"),
             "chaos_invariant_violations":
                 res.get("chaos_invariant_violations")})
+    if "percolate_qps" in res:
+        # reverse search + script compiler (ISSUE 18): the dense-vs-loop
+        # percolate ratio at the registered-query count, the matrix cell
+        # rate, and the compiled-vs-host script_score ratio
+        line.update({
+            "percolate_queries": res.get("percolate_queries"),
+            "percolate_qps": r2(res.get("percolate_qps")),
+            "percolate_matrix_qps": r2(res.get("percolate_matrix_qps")),
+            "percolate_loop_qps": rnd(res.get("percolate_loop_qps")),
+            "percolate_vs_loop": rnd(res.get("percolate_vs_loop")),
+            "percolate_fetches_per_batch":
+                r2(res.get("percolate_fetches_per_batch")),
+            "script_score_qps": r2(res.get("script_score_qps")),
+            "script_host_qps": r2(res.get("script_host_qps")),
+            "script_vs_decline": rnd(res.get("script_vs_decline"))})
     if "rebalance_move_s" in res:
         # rebalance-under-load (ISSUE 15): the SLO pair under a live
         # shard move + the throttle-compliance evidence

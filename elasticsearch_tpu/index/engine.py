@@ -206,6 +206,14 @@ class Engine:
         # LiveVersionMap: id -> (version, deleted)
         self.versions: dict[str, tuple[int, bool]] = {}
         self._dirty = False
+        # monotonic mutation generations: `mutation_gen` bumps on EVERY
+        # accepted write/delete; `percolator_gen` only when the registered
+        # `.percolator` roster can have changed (a `.percolator` index, or
+        # any delete — deletes don't carry a type). Cache tiers key on
+        # these instead of buffer lengths, which alias across
+        # delete-then-reinsert of the same count (ISSUE 18 bugfix).
+        self.mutation_gen = 0
+        self.percolator_gen = 0
         self.refresh_count = 0
         self.flush_count = 0
         self.merge_count = 0
@@ -411,6 +419,9 @@ class Engine:
         self._buffer_bytes += est
         self.versions[doc_id] = (version, False)
         self._dirty = True
+        self.mutation_gen += 1
+        if type_name == ".percolator":
+            self.percolator_gen += 1
 
     def delete(self, doc_id: str, version: int | None = None,
                version_type: str = "internal",
@@ -430,6 +441,8 @@ class Engine:
         self._delete_everywhere(doc_id)
         self.versions[doc_id] = (version, True)
         self._dirty = True
+        self.mutation_gen += 1
+        self.percolator_gen += 1
 
     # -- batched write path (the vectorized bulk lane, ISSUE 7) ------------
 
@@ -545,6 +558,9 @@ class Engine:
                     self._buffer_bytes += est
                     self.versions[doc_id] = (nv, False)
                     self._dirty = True
+                    self.mutation_gen += 1
+                    if op.type_name == ".percolator":
+                        self.percolator_gen += 1
                     rec = {"op": "index", "id": doc_id,
                            "type": op.type_name, "source": op.source,
                            "version": nv, "routing": op.routing, "ts": ts}

@@ -820,6 +820,105 @@ class MeshVectorStackCache:
         return out
 
 
+class _PercEntry:
+    __slots__ = ("corpus", "nbytes", "breaker", "index_name")
+
+    def __init__(self, corpus, nbytes, breaker, index_name):
+        self.corpus = corpus
+        self.nbytes = nbytes
+        self.breaker = breaker
+        self.index_name = index_name
+
+
+class PercolatorRegistryCache:
+    """Per-index percolate corpora for the reverse-search lane
+    (search/percolate_exec.py): the registered `.percolator` queries
+    extracted into the dense leaf-slot grid + postings CSR. Keyed by the
+    index's monotonic per-engine percolator GENERATION — any `.percolator`
+    write or delete bumps it, so a delete-then-register of the same count
+    can never serve a stale corpus (the ISSUE 18 `_registry_key` bugfix
+    keys the same way). Entries charge the `fielddata` breaker through
+    make_room admission (LRU corpora shed under pressure; a refused build
+    returns None and percolation falls back to the index service's
+    one-slot memo — degrade, never 429), release on any removal, and the
+    stale predecessor generation dies on the next put."""
+
+    def __init__(self, max_bytes: int = 0):
+        self.declined = 0                # breaker refused the build charge
+        self.cache = Cache("percolator_registry", max_bytes=max_bytes,
+                           weigher=lambda e: e.nbytes,
+                           removal_listener=self._on_removal)
+
+    def _on_removal(self, key, entry: _PercEntry, reason: str) -> None:
+        if reason == RemovalReason.EVICTED:
+            tracing.add_event("cache.evict", tier="percolator_registry",
+                              reason=reason, bytes=entry.nbytes)
+        if entry.breaker is not None:
+            entry.breaker.release(entry.nbytes)
+
+    def get_or_build(self, svc, generation, build):
+        """The index's PercolateCorpus at `generation`, building (and
+        breaker-charging) on first use. None when declined — breaker
+        pressure even after shedding other corpora (the caller keeps a
+        plain memo so percolation still runs)."""
+        name = getattr(svc, "name", None)
+        key = (name, generation)
+        with tracing.span("cache.get", tier="percolator_registry") as sp:
+            ent = self.cache.get(key)
+            if sp is not None:
+                sp.attrs["hit"] = ent is not None
+        if ent is not None:
+            return ent.corpus
+        breakers = getattr(svc, "breakers", None)
+        breaker = breakers.breaker("fielddata") \
+            if breakers is not None else None
+        from ..search.percolator import parsed_registry
+        est = 4096 + 512 * len(parsed_registry(svc))
+        if breaker is not None:
+            try:
+                self.cache.make_room(breaker, est)
+            except Exception:  # noqa: BLE001 — degrade, never 429
+                self.declined += 1
+                return None
+        try:
+            corpus = build(svc)
+        except BaseException:
+            if breaker is not None:
+                breaker.release(est)
+            raise
+        if corpus is None:
+            if breaker is not None:
+                breaker.release(est)
+            return None
+        nbytes = corpus.nbytes
+        if breaker is not None and nbytes != est:
+            if nbytes > est:  # true up estimate drift without re-tripping
+                breaker.add_estimate(nbytes - est, check=False)
+            else:
+                breaker.release(est - nbytes)
+        entry = _PercEntry(corpus, nbytes, breaker, name)
+        if self.cache.put(key, entry):
+            # a registration/delete bumped the generation: the stale
+            # predecessor corpus frees its bytes NOW
+            self.cache.invalidate_where(
+                lambda k, _e: k[0] == key[0] and k != key)
+        elif breaker is not None:
+            breaker.release(nbytes)   # refused by budget: nothing retained
+        return corpus
+
+    def clear(self, indices: list[str] | None = None) -> int:
+        if indices is None:
+            return self.cache.clear()
+        want = set(indices)
+        return self.cache.invalidate_where(
+            lambda _k, e: e.index_name in want)
+
+    def stats(self) -> dict:
+        out = self.cache.stats()
+        out["declined"] = self.declined
+        return out
+
+
 class IndicesCacheService:
     """The node's cache roster. One `stats()`/`clear()` surface over the
     three tiers; per-index packed-view caches register here so their
@@ -878,6 +977,12 @@ class IndicesCacheService:
         self.mesh_vector_stacks = MeshVectorStackCache(
             max_bytes=parse_size(get("indices.mesh.cache.size", "10%"),
                                  total, default=total // 10))
+        # registered-query corpora for the dense percolate lane: host-side
+        # CSR + leaf grids (bytes, not device residency) — a 1% slice caps
+        # pathological registries without starving real tiers
+        self.percolator_registry = PercolatorRegistryCache(
+            max_bytes=parse_size(get("indices.percolator.cache.size", "1%"),
+                                 total, default=total // 100))
         # per-index packed-view caches (serving views) register here so
         # their byte totals surface without the service owning them
         self._registered: "weakref.WeakValueDictionary[str, Cache]" = \
@@ -942,6 +1047,8 @@ class IndicesCacheService:
             out["mesh_stack"] = self.mesh_stacks.clear(indices)
             out["mesh_vector_stack"] = self.mesh_vector_stacks.clear(indices)
             out["ann_index"] = self.ann_indexes.clear(indices)  # + quant
+            out["percolator_registry"] = \
+                self.percolator_registry.clear(indices)
         if fielddata:
             out["fielddata"] = self.fielddata.clear(indices)
         return out
@@ -954,7 +1061,8 @@ class IndicesCacheService:
                "mesh_stack": self.mesh_stacks.stats(),
                "mesh_vector_stack": self.mesh_vector_stacks.stats(),
                "ann_index": self.ann_indexes.stats(),
-               "ann_quant": self.ann_indexes.quant_stats()}
+               "ann_quant": self.ann_indexes.quant_stats(),
+               "percolator_registry": self.percolator_registry.stats()}
         for name, cache in list(self._registered.items()):
             out[name] = cache.stats()
         return out
@@ -968,6 +1076,7 @@ class IndicesCacheService:
         self.mesh_vector_stacks.cache.clear()
         self.ann_indexes.cache.clear()
         self.ann_indexes.quant.clear()
+        self.percolator_registry.cache.clear()
 
     def leak_report(self) -> list[str]:
         """Cache-entry accounting for the chaos leak detector: every tier

@@ -1520,12 +1520,28 @@ class NodeService:
             out["bool"]["must_not"] = [{"ids": {"values": exclude_ids}}]
         return out
 
+    def _percolate_filter(self, name: str, flt, out: dict) -> dict:
+        """Body filter/query restricts WHICH registered .percolator docs
+        participate, evaluated against their own indexed fields
+        (ref PercolatorService percolate-with-filter)."""
+        if flt is None or not out["matches"]:
+            return out
+        res = self.search(name, {
+            "query": {"bool": {"filter": [flt]}},
+            "size": 10_000, "_source": False})
+        allowed = {h["_id"] for h in res["hits"]["hits"]}
+        out["matches"] = [m for m in out["matches"] if m["_id"] in allowed]
+        out["total"] = len(out["matches"])
+        return out
+
     def percolate(self, index: str, body: dict,
                   type_name: str = "_doc",
                   doc_id: str | None = None) -> dict:
         """Match a doc against the index's registered queries
-        (ref percolator/PercolatorService.java:108-132)."""
-        from .search.percolator import percolate as run_percolate
+        (ref percolator/PercolatorService.java:108-132) — through the
+        dense doc×query matrix executor (search/percolate_exec.py),
+        which itself ladders down to the per-doc loop."""
+        from .search.percolate_exec import percolate_batch
         names = self._resolve(index)
         if not names:
             raise IndexMissingException(index)
@@ -1538,29 +1554,58 @@ class NodeService:
             doc = got.source
         if doc is None:
             raise QueryParsingException("percolate requires a doc")
-        # body filter/query restricts WHICH registered .percolator docs
-        # participate, evaluated against their own indexed fields
-        # (ref PercolatorService percolate-with-filter)
         flt = (body or {}).get("filter") or (body or {}).get("query")
         total = 0
         matches: list = []
-        for n in names:
-            out = run_percolate(self.indices[n], n, doc,
-                                type_name=type_name)
-            if flt is not None and out["matches"]:
-                res = self.search(n, {
-                    "query": {"bool": {"filter": [flt]}},
-                    "size": 10_000, "_source": False})
-                allowed = {h["_id"] for h in res["hits"]["hits"]}
-                out["matches"] = [m for m in out["matches"]
-                                  if m["_id"] in allowed]
-                out["total"] = len(out["matches"])
-            total += out["total"]
-            matches.extend(out["matches"])
-        return {"took": 0, "_shards": {"total": len(names),
+        from .common.device_stats import current_lanes, record_lanes
+        # reuse an active recorder (chaos parity sweeps wrap their own)
+        with record_lanes(current_lanes()) as lanes:
+            for n in names:
+                out = percolate_batch(self.indices[n], n,
+                                      [(doc, type_name)],
+                                      caches=self.caches)[0]
+                out = self._percolate_filter(n, flt, out)
+                total += out["total"]
+                matches.extend(out["matches"])
+        resp = {"took": 0, "_shards": {"total": len(names),
                                        "successful": len(names),
                                        "failed": 0},
                 "total": total, "matches": matches}
+        if (body or {}).get("profile"):
+            # the percolate ladder's explain surface: which rung carried
+            # the request (mesh / dense / loop) and why others declined
+            resp["profile"] = {"lanes": lanes.explain()}
+        return resp
+
+    def mpercolate(self, index: str, bodies: list[dict],
+                   type_name: str = "_doc") -> dict:
+        """Batched percolation: every doc becomes one row of the SAME
+        dense doc×query matrix program — the whole batch costs one device
+        dispatch per index, not one per doc (ISSUE 18 `_mpercolate`)."""
+        from .search.percolate_exec import percolate_batch
+        names = self._resolve(index)
+        if not names:
+            raise IndexMissingException(index)
+        docs: list[tuple[dict, str]] = []
+        for b in bodies:
+            doc = (b or {}).get("doc")
+            if doc is None:
+                raise QueryParsingException("percolate requires a doc")
+            docs.append((doc, (b or {}).get("type", type_name)))
+        shards = {"total": len(names), "successful": len(names),
+                  "failed": 0}
+        merged = [{"took": 0, "_shards": dict(shards),
+                   "total": 0, "matches": []} for _ in docs]
+        for n in names:
+            outs = percolate_batch(self.indices[n], n, docs,
+                                   caches=self.caches)
+            for i, out in enumerate(outs):
+                flt = (bodies[i] or {}).get("filter") \
+                    or (bodies[i] or {}).get("query")
+                out = self._percolate_filter(n, flt, out)
+                merged[i]["total"] += out["total"]
+                merged[i]["matches"].extend(out["matches"])
+        return {"responses": merged}
 
     def refresh_doc_shard(self, index: str, doc_id: str,
                           routing: str | None = None) -> None:
@@ -2902,7 +2947,17 @@ class NodeService:
         from .common.metrics import (bulk_docs_histogram,
                                      bulk_ingest_snapshot, host_merge_count,
                                      peak_score_matrix_bytes)
+        from .script.jax_compile import script_compiles_snapshot
+        from .search.percolate_exec import percolate_stats_snapshot
         from .serving.qos import hedge_snapshot
+        _perc_raw = percolate_stats_snapshot()
+        _perc_stats = {
+            "dispatches": {ln: _perc_raw[ln]
+                           for ln in ("dense", "loop", "mesh")},
+            "docs": _perc_raw["docs"],
+            "matrix_cells": _perc_raw["matrix_cells"],
+            "residual_queries": _perc_raw["residual_queries"],
+        }
         qos_stats = self.qos.stats()
         qos_by_class = qos_stats.pop("by_class")
         search_exec = {
@@ -2988,6 +3043,24 @@ class NodeService:
                                {str(n): {"count": c}
                                 for n, c in sorted(
                                     shard_fetch_histogram().items())}),
+            # reverse-search lane adoption (ISSUE 18):
+            # es_search_percolate_dispatches_total{lane=} — how many
+            # percolate dispatches the dense doc×query matrix carried vs
+            # the per-doc loop vs the mesh rung
+            "search_percolate": ("lane", {
+                lane: {"dispatches_total": n}
+                for lane, n in _perc_stats["dispatches"].items()}),
+            "percolate": (None, {
+                "docs_total": _perc_stats["docs"],
+                "matrix_cells_total": _perc_stats["matrix_cells"],
+                "residual_queries_total": _perc_stats["residual_queries"]}),
+            # expression->XLA script compiler (ISSUE 18):
+            # es_script_compiles_total{target=} counts TRUE builds only —
+            # cached template re-use with different params must not bump it
+            "script": ("target", {
+                t: {"compiles_total": n}
+                for t, n in script_compiles_snapshot().items()} or {
+                "function_score": {"compiles_total": 0}}),
             # bulk-ingest lane (ISSUE 7): vectorized vs per-doc-fallback
             # request/doc counters + ingest docs/s, and a docs-per-bulk
             # pow2 histogram (how much batching clients actually send)
@@ -3115,6 +3188,10 @@ class NodeService:
                 max(self.caches.ann_indexes.quant_code_bytes, 0),
             "ann_quant_codebook_bytes":
                 max(self.caches.ann_indexes.quant_book_bytes, 0),
+            # registered-query corpus residency (ISSUE 18): what the
+            # reverse-search registry costs in host bytes right now
+            "percolator_registry_cache_memory_bytes":
+                self.caches.percolator_registry.cache.memory_bytes,
         }
         mesh_totals = {"mesh_agg_dispatches": 0, "mesh_ann_dispatches": 0}
         for svc in self.indices.values():

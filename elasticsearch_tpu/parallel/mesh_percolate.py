@@ -1,0 +1,39 @@
+"""Multi-device percolation: the doc×query matrix sharded on the QUERY
+axis (ISSUE 18 mesh rung).
+
+The dense program scans pow2 blocks of the query axis, so the natural
+mesh decomposition is block-parallel: each device runs the SAME compiled
+program over a contiguous slice of the query-block xs (the doc batch is
+small and replicates), and the per-device stripes concatenate back into
+the full matrix. One device fetch per device — on a single-device host
+the ladder declines this rung with the stable reason "single-device"
+before dispatch (percolate_exec.percolate_batch)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def mesh_matrix(prog, operands, xs: dict, nb: int, devices) -> np.ndarray:
+    """Run `prog` (the percolate scan, xs leading axis = nb query blocks)
+    with the block axis split across `devices`. Slices stay pow2-aligned
+    (every device gets ceil-pow2-balanced runs of whole blocks) so the
+    per-device program shares ONE compile with the single-device lane
+    when the slice sizes match a cached signature."""
+    nd = min(len(devices), nb)
+    bounds = [round(i * nb / nd) for i in range(nd + 1)]
+    futures = []
+    for di in range(nd):
+        lo, hi = bounds[di], bounds[di + 1]
+        if lo == hi:
+            continue
+        dev = devices[di]
+        ops_d = [jax.device_put(jnp.asarray(a), dev) for a in operands]
+        xs_d = {k: jax.device_put(jnp.asarray(v[lo:hi]), dev)
+                for k, v in xs.items()}
+        futures.append(prog(*ops_d, xs_d))      # async dispatch per device
+    from ..common.metrics import device_fetch
+    stripes = [np.asarray(device_fetch(f)) for f in futures]
+    return np.concatenate(stripes, axis=1)

@@ -407,7 +407,7 @@ def _register_routes(c: RestController, node: NodeService) -> None:
 
     def mpercolate_api(g, p, b):
         lines = [ln for ln in b.decode("utf-8").split("\n") if ln.strip()]
-        responses = []
+        items = []   # (index, type, body, doc_id, parse_error)
         i = 0
         while i < len(lines):
             start = i
@@ -417,13 +417,43 @@ def _register_routes(c: RestController, node: NodeService) -> None:
                 body = json.loads(lines[i]) if i < len(lines) else {}
                 i += 1
                 (_kind, meta), = head.items()
-                responses.append(node.percolate(
-                    meta.get("index", g.get("index", "_all")),
-                    body, type_name=meta.get("type", "_doc"),
-                    doc_id=meta.get("id")))
+                items.append((meta.get("index", g.get("index", "_all")),
+                              meta.get("type", "_doc"), body,
+                              meta.get("id"), None))
             except Exception as e:  # noqa: BLE001 — per-item contract
                 i = start + 2   # skip the malformed header+body pair
-                responses.append({"error": f"{type(e).__name__}[{e}]"})
+                items.append((None, None, None, None,
+                              f"{type(e).__name__}[{e}]"))
+        responses: list = [None] * len(items)
+        # inline-doc items sharing an (index, type) batch into ONE dense
+        # doc×query matrix dispatch (node.mpercolate, ISSUE 18); items
+        # with an existing-doc id or a parse error run per item below
+        groups: dict = {}
+        for idx, (ix, tp, body, did, err) in enumerate(items):
+            if err is None and did is None \
+                    and isinstance(body, dict) and "doc" in body:
+                groups.setdefault((ix, tp), []).append(idx)
+        for (ix, tp), idxs in groups.items():
+            try:
+                outs = node.mpercolate(
+                    ix, [items[j][2] for j in idxs],
+                    type_name=tp)["responses"]
+                for j, out in zip(idxs, outs):
+                    responses[j] = out
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                for j in idxs:
+                    responses[j] = {"error": f"{type(e).__name__}[{e}]"}
+        for idx, (ix, tp, body, did, err) in enumerate(items):
+            if responses[idx] is not None:
+                continue
+            if err is not None:
+                responses[idx] = {"error": err}
+                continue
+            try:
+                responses[idx] = node.percolate(ix, body, type_name=tp,
+                                                doc_id=did)
+            except Exception as e:  # noqa: BLE001 — per-item contract
+                responses[idx] = {"error": f"{type(e).__name__}[{e}]"}
         return 200, {"responses": responses}
     c.register("GET", "/_mpercolate", mpercolate_api)
     c.register("POST", "/_mpercolate", mpercolate_api)
@@ -742,10 +772,26 @@ def _register_routes(c: RestController, node: NodeService) -> None:
             node.refresh_doc_shard(g["index"], res.doc_id,
                                    routing or parent)
         status = 201 if res.created else 200
-        return status, {"_index": g["index"], "_type": g.get("type", "_doc"),
-                        "_id": res.doc_id, "_version": res.version,
-                        "created": res.created,
-                        "_shards": _write_shards(node, g["index"])}
+        out = {"_index": g["index"], "_type": g.get("type", "_doc"),
+               "_id": res.doc_id, "_version": res.version,
+               "created": res.created,
+               "_shards": _write_shards(node, g["index"])}
+        # percolate-on-ingest (ref RestIndexAction ?percolate=): the just-
+        # written doc runs against the registered queries of the SAME index
+        # (or the query given in the param) through the dense matrix lane;
+        # matches ride back on the index response
+        if p.get("percolate", [None])[0] is not None:
+            praw = p["percolate"][0]
+            pbody: dict = {"doc": _json_body(b)}
+            if praw not in ("", "*", "true", "1"):
+                try:
+                    pbody.update(json.loads(praw))
+                except (ValueError, TypeError):
+                    pass
+            perc = node.percolate(g["index"], pbody,
+                                  type_name=g.get("type", "_doc"))
+            out["matches"] = perc["matches"]
+        return status, out
     c.register("PUT", "/{index}/{type}/{id}", put_doc)
     c.register("POST", "/{index}/{type}/{id}", put_doc)
     c.register("POST", "/{index}/{type}", put_doc)

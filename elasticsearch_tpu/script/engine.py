@@ -32,6 +32,26 @@ _ALLOWED_CMPOPS = (ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
 _ALLOWED_METHODS = {"append", "extend", "remove", "pop", "get", "keys",
                     "values", "items", "upper", "lower", "strip", "split"}
 
+# Math.* roster shared with the compiled script lane (script/jax_compile.py)
+# — same names, same f64 results for the exact-IEEE subset, so the host
+# evaluator is the bitwise reference the compiled lane declines to.
+import math as _math  # noqa: E402
+
+
+def _math_floor(a):
+    return float(_math.floor(a))
+
+
+def _math_ceil(a):
+    return float(_math.ceil(a))
+
+
+_MATH_METHODS = {
+    "abs": abs, "sqrt": _math.sqrt, "log": _math.log,
+    "log10": _math.log10, "exp": _math.exp, "pow": lambda a, b: a ** b,
+    "min": min, "max": max, "floor": _math_floor, "ceil": _math_ceil,
+}
+
 
 class _Env:
     def __init__(self, ctx: dict, params: dict):
@@ -187,6 +207,14 @@ def _eval(node: ast.expr, env: _Env) -> Any:
     if isinstance(node, ast.Call):
         if not isinstance(node.func, ast.Attribute):
             raise ScriptException("only method calls are allowed")
+        if (isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "Math"
+                and node.func.attr in _MATH_METHODS):
+            args = [_eval(a, env) for a in node.args]
+            try:
+                return _MATH_METHODS[node.func.attr](*args)
+            except (TypeError, ValueError, OverflowError) as e:
+                raise ScriptException(f"Math.{node.func.attr}: {e}") from e
         if node.func.attr not in _ALLOWED_METHODS:
             raise ScriptException(f"method [{node.func.attr}] not allowed")
         obj = _eval(node.func.value, env)
@@ -215,12 +243,14 @@ def doc_values_view(source: dict) -> dict:
             for f, vs in flatten(source).items()}
 
 
-def run_search_script(script, source: dict, params: dict | None = None):
+def run_search_script(script, source: dict, params: dict | None = None,
+                      extra_names: dict | None = None):
     """Evaluate a SEARCH-time expression over one doc (script_fields /
     script query; ref script/expression/ExpressionScriptEngineService —
     `doc['field'].value` accessors over doc values). Returns the value;
     numeric results coerce to float like Lucene expressions (always
-    doubles)."""
+    doubles). `extra_names` binds additional read-only names (e.g.
+    `_score` for function_score script_score)."""
     if isinstance(script, dict):
         code = script.get("inline") or script.get("source") or \
             script.get("script") or ""
@@ -233,6 +263,8 @@ def run_search_script(script, source: dict, params: dict | None = None):
     env = _Env({"_source": source}, params)
     env.names["doc"] = doc
     env.names["_source"] = source
+    if extra_names:
+        env.names.update(extra_names)
     try:
         tree = ast.parse(code, mode="eval")
     except SyntaxError as e:

@@ -1,0 +1,310 @@
+"""Restricted expression → JAX compiler (ISSUE 18 tentpole, part 2).
+
+`script_score` bodies written in the engine's expression subset compile
+to fused device ops instead of declining every dense lane (SURVEY §7 M6:
+"restricted expression→XLA compiler instead of Groovy sandbox"). The
+grammar is deliberately the intersection of what the host evaluator
+(script/engine.py) accepts and what XLA can fuse:
+
+    literals        int / float constants
+    arithmetic      + - * / // % **  and unary -
+    doc values      doc['field'].value — reads the segment's uninverted
+                    numeric column (long/integer/short/byte/double/float
+                    fields only; other types read differently from
+                    _source than from columns and decline)
+    score           _score — the inner query's score matrix
+    params          params.x / params['x'] — bound as TRACED f64 scalars,
+                    so re-running a template with different values reuses
+                    the compiled program (the no-retrace contract)
+    Math roster     Math.abs/sqrt/log/log10/exp/pow/min/max/floor/ceil
+
+Everything else (comparisons, conditionals, loops, _source reads, string
+ops) raises ScriptCompileError with a stable `script:*` reason; the
+caller declines to the host evaluator through the lane recorder — a
+decline, never an error.
+
+Numeric contract vs the host evaluator (the chaos parity pair): both
+lanes evaluate in f64 and a doc with ANY referenced field missing scores
+0.0 (the host raises on `None` arithmetic and maps ScriptException→0.0;
+the compiled lane masks on the missing column). + - * / min / max / abs
+/ floor / ceil are bitwise-identical IEEE ops on both sides. Documented
+carve-outs, excluded from the oracle's replay pair: ** and the
+transcendentals (libm vs XLA ulp), % on negative operands, division by
+zero (host exception→0.0, device ±inf), NaN propagation through min/max
+(Python min vs jnp.minimum), and integers beyond 2^53.
+
+Compile cache: keyed on (canonical AST dump, param-name tuple, target) —
+the expression's TEXT doesn't key (whitespace variants share a program),
+and `es_script_compiles_total{target=}` counts only true builds.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..common.device_stats import instrument
+
+# numeric column types whose _source values and uninverted columns agree
+# bit-for-bit in f64 (date/bool/ip columns encode differently than their
+# source form, so they decline)
+_NUMERIC_OK = ("long", "integer", "short", "byte", "double", "float")
+
+_MATH_FNS = {
+    "abs": (jnp.abs, 1), "sqrt": (jnp.sqrt, 1), "log": (jnp.log, 1),
+    "log10": (jnp.log10, 1), "exp": (jnp.exp, 1), "pow": (jnp.power, 2),
+    "min": (jnp.minimum, 2), "max": (jnp.maximum, 2),
+    "floor": (jnp.floor, 1), "ceil": (jnp.ceil, 1),
+}
+
+_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Div: lambda a, b: a / b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+
+class ScriptCompileError(Exception):
+    """Expression outside the compilable subset; `.reason` is the stable
+    lane-decline label."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _Analysis:
+    __slots__ = ("fields", "params", "uses_score")
+
+    def __init__(self):
+        self.fields: list[str] = []      # first-reference order
+        self.params: list[str] = []
+        self.uses_score = False
+
+
+def _doc_field(node: ast.AST) -> str | None:
+    """doc['field'].value -> 'field' (the only doc accessor shape)."""
+    if (isinstance(node, ast.Attribute) and node.attr == "value"
+            and isinstance(node.value, ast.Subscript)
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id == "doc"):
+        sl = node.value.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return None
+
+
+def _param_name(node: ast.AST) -> str | None:
+    """params.x or params['x'] -> 'x'."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "params"):
+        return node.attr
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "params"):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return None
+
+
+def _walk(node: ast.AST, an: _Analysis) -> None:
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(
+                node.value, (int, float)):
+            raise ScriptCompileError("script:literal-type")
+        return
+    if isinstance(node, ast.BinOp):
+        if type(node.op) not in _BINOPS:
+            raise ScriptCompileError(
+                f"script:unsupported-{type(node.op).__name__}")
+        _walk(node.left, an)
+        _walk(node.right, an)
+        return
+    if isinstance(node, ast.UnaryOp):
+        if not isinstance(node.op, (ast.USub, ast.UAdd)):
+            raise ScriptCompileError(
+                f"script:unsupported-{type(node.op).__name__}")
+        _walk(node.operand, an)
+        return
+    if isinstance(node, ast.Name):
+        if node.id == "_score":
+            an.uses_score = True
+            return
+        raise ScriptCompileError("script:unknown-name")
+    f = _doc_field(node)
+    if f is not None:
+        if f not in an.fields:
+            an.fields.append(f)
+        return
+    p = _param_name(node)
+    if p is not None:
+        if p not in an.params:
+            an.params.append(p)
+        return
+    if isinstance(node, ast.Call):
+        if (not isinstance(node.func, ast.Attribute)
+                or not isinstance(node.func.value, ast.Name)
+                or node.func.value.id != "Math"
+                or node.func.attr not in _MATH_FNS):
+            raise ScriptCompileError("script:unsupported-call")
+        _, arity = _MATH_FNS[node.func.attr]
+        if len(node.args) != arity or node.keywords:
+            raise ScriptCompileError("script:math-arity")
+        for a in node.args:
+            _walk(a, an)
+        return
+    raise ScriptCompileError(f"script:unsupported-{type(node).__name__}")
+
+
+def analyze(source: str) -> _Analysis:
+    """Parse + validate; -> referenced fields/params/score usage.
+    Raises ScriptCompileError with a stable reason."""
+    try:
+        tree = ast.parse(source, mode="eval")
+    except SyntaxError:
+        raise ScriptCompileError("script:parse-error") from None
+    an = _Analysis()
+    _walk(tree.body, an)
+    return an
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+class CompiledScript:
+    """A jitted (vals [F,N] f64, miss [F,N] bool, score [Q,N] f64,
+    params [P] f64) -> [Q,N] f64 program plus its binding metadata."""
+
+    __slots__ = ("fields", "param_names", "uses_score", "fn", "key")
+
+    def __init__(self, fields, param_names, uses_score, fn, key):
+        self.fields = fields
+        self.param_names = param_names
+        self.uses_score = uses_score
+        self.fn = fn
+        self.key = key
+
+
+_CACHE_LOCK = threading.Lock()
+_COMPILED: dict[tuple, CompiledScript] = {}
+_COMPILES_BY_TARGET: dict[str, int] = {}
+
+
+def script_compiles_snapshot() -> dict[str, int]:
+    """target -> true-build count (`es_script_compiles_total{target=}`)."""
+    with _CACHE_LOCK:
+        return dict(_COMPILES_BY_TARGET)
+
+
+def _emit(node: ast.AST, env: dict):
+    if isinstance(node, ast.Constant):
+        return jnp.float64(node.value)
+    if isinstance(node, ast.BinOp):
+        return _BINOPS[type(node.op)](_emit(node.left, env),
+                                      _emit(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        v = _emit(node.operand, env)
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.Name):                 # _score (validated)
+        return env["score"]
+    f = _doc_field(node)
+    if f is not None:
+        return env["doc"][f]
+    p = _param_name(node)
+    if p is not None:
+        return env["params"][p]
+    fn, _ = _MATH_FNS[node.func.attr]              # Call (validated)
+    return fn(*[_emit(a, env) for a in node.args])
+
+
+def compile_expression(source: str, target: str) -> CompiledScript:
+    """source text -> cached CompiledScript. The cache key is the
+    canonical AST (whitespace/formatting variants share one program) +
+    the referenced param-name tuple; only a true build bumps the
+    per-target compile counter."""
+    an = analyze(source)
+    tree = ast.parse(source, mode="eval")
+    key = (ast.dump(tree), tuple(an.params), target)
+    with _CACHE_LOCK:
+        hit = _COMPILED.get(key)
+    if hit is not None:
+        return hit
+
+    fields = tuple(an.fields)
+    param_names = tuple(an.params)
+    body = tree.body
+
+    def raw(vals, miss, score, params):
+        env = {
+            "doc": {f: vals[i][None, :] for i, f in enumerate(fields)},
+            "params": {p: params[i] for i, p in enumerate(param_names)},
+            "score": score,
+        }
+        out = _emit(body, env) + jnp.zeros_like(score)   # -> [Q, N] f64
+        if fields:
+            anymiss = miss[0]
+            for i in range(1, len(fields)):
+                anymiss = anymiss | miss[i]
+            out = jnp.where(anymiss[None, :], 0.0, out)
+        return out
+
+    compiled = CompiledScript(
+        fields, param_names, an.uses_score,
+        instrument("script:compiled", jax.jit(raw), key=key[0][:64]),
+        key)
+    with _CACHE_LOCK:
+        if key in _COMPILED:               # racing build: keep the first
+            return _COMPILED[key]
+        _COMPILED[key] = compiled
+        _COMPILES_BY_TARGET[target] = _COMPILES_BY_TARGET.get(target, 0) + 1
+    from ..common import tracing
+    tracing.add_event("script_compile", target=target,
+                      fields=len(fields), params=len(param_names))
+    return compiled
+
+
+def script_source(spec: dict) -> tuple[str | None, dict]:
+    """Extract (source, params) from the ES wire shapes: a bare string,
+    {"script": "..."} / {"inline": "..."} / {"source": "..."} or the
+    nested {"script": {"inline"/"source": ..., "params": {...}}}."""
+    if isinstance(spec, str):
+        return spec, {}
+    if not isinstance(spec, dict):
+        return None, {}
+    params = spec.get("params") or {}
+    s = spec.get("script")
+    if isinstance(s, dict):
+        inner, p2 = script_source(s)
+        return inner, {**params, **p2}
+    for k in ("script", "inline", "source"):
+        v = spec.get(k)
+        if isinstance(v, str):
+            return v, params
+    return None, params
+
+
+def validate_binding(compiled: CompiledScript, params: dict,
+                     field_types: dict) -> None:
+    """Wire-time checks the pure compiler can't do: every referenced doc
+    field must be a plain numeric column and every referenced param a
+    number. Raises ScriptCompileError (-> lane decline, host fallback)."""
+    for f in compiled.fields:
+        ft = field_types.get(f)
+        if ft is None:
+            raise ScriptCompileError("script:unmapped-field")
+        if ft not in _NUMERIC_OK:
+            raise ScriptCompileError("script:doc-field-type")
+    for p in compiled.param_names:
+        v = params.get(p)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ScriptCompileError("script:param-type")

@@ -1,0 +1,660 @@
+"""Dense reverse search: the doc×query match matrix (ISSUE 18 tentpole).
+
+The per-doc percolator (search/percolator.py) evaluates every registered
+query against a one-doc segment — fine for one alert check, hopeless for a
+`_bulk` batch against a million stored queries. SURVEY §7 M6's observation
+is that the batched problem is our existing CSR kernel TRANSPOSED: the
+registered queries become the corpus (their terms are the postings, over
+LEAF SLOTS instead of docs), the incoming document batch becomes the Q
+axis, and one blockwise jitted program emits the whole bool match matrix
+in a single device fetch.
+
+Corpus layout. Each dense-eligible query flattens to at most K leaf
+predicates laid out on a [NQ_pad, K] slot grid (K = pow2 of the deepest
+clause count, capped at 16). A leaf is one of:
+
+  kind 1  text-count   — term/terms/match clauses; the leaf's terms post
+                         into a CSR over slot ids (one posting PER TERM
+                         OCCURRENCE, preserving the loop's duplicate-term
+                         counting), and a doc matches when its deduped
+                         token overlap count reaches `need` (1 for "or",
+                         n_terms for "and", msm otherwise — exactly
+                         MatchNode.match_mask's count discipline)
+  kind 2  range-i64    — numeric/date/bool range (and single-value term
+                         equality) on an integer column, bounds adjusted
+                         with the loop's _next_up/_next_down exclusivity
+  kind 3  range-f64    — same over double columns
+  kind 4  host-bool    — predicates evaluated host-side per (doc, field)
+                         and uploaded as a bool column: exists, keyword
+                         lexicographic ranges
+  kind 5/6  const      — match_all / match_none
+
+Roles mirror BoolNode.match_mask: must(+filter)=1, should=2, must_not=3,
+with per-query minimum_should_match gating only when > 0. Query shapes
+the grid can't hold (nested bools, wildcards, scripts, geo, >K clauses,
+unmapped fields) fall to the per-doc loop as RESIDUAL queries with stable
+decline reasons through the lane recorder — the ladder is
+mesh → dense → loop and every rung is visible in `profile.lanes`.
+
+Bitwise contract: dense ∪ residual must equal the per-doc loop's sorted
+match list for every doc (the chaos oracle replays this pair).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import tracing
+from ..common.cache import Cache
+from ..common.device_stats import instrument, lane_chosen, lane_decline
+from ..common.metrics import device_fetch, note_h2d
+from ..mapping.mapper import (
+    BOOLEAN, DATE, IP, KEYWORD, TEXT, _FLOAT_TYPES, _INT_TYPES,
+)
+from .percolator import build_doc_segment, loop_match, parsed_registry
+from .percolator import _registry_key as registry_generation
+from .query_dsl import (
+    BoolNode, ConstantScoreNode, ExistsNode, MatchAllNode, MatchNode,
+    MatchNoneNode, RangeNode, TermFilterNode, _coerce_to_column,
+    _next_down, _next_up,
+)
+
+K_MAX = 16               # leaf slots per query on the dense grid
+_I64_TYPES = _INT_TYPES | {DATE, BOOLEAN, IP}
+
+# kind codes on the slot grid
+_PAD, _TEXT, _RNG_I, _RNG_F, _HOST, _TRUE, _FALSE = 0, 1, 2, 3, 4, 5, 6
+# role codes
+_MUST, _SHOULD, _NOT = 1, 2, 3
+
+_PROGRAMS = Cache("percolate_programs", max_entries=64)
+
+_STATS_LOCK = threading.Lock()
+_STATS = {"dense": 0, "loop": 0, "mesh": 0, "docs": 0, "matrix_cells": 0,
+          "residual_queries": 0}
+
+
+def percolate_stats_snapshot() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def _bump(**deltas) -> None:
+    with _STATS_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+class _Undense(Exception):
+    """Query shape the slot grid can't represent; `.reason` is the stable
+    decline label surfaced through the lane recorder."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# Query -> leaf extraction
+# ---------------------------------------------------------------------------
+
+class _Leaf:
+    __slots__ = ("kind", "role", "terms", "need", "field",
+                 "lo_i", "hi_i", "lo_f", "hi_f", "host_spec")
+
+    def __init__(self, kind: int, role: int = _MUST):
+        self.kind = kind
+        self.role = role
+        self.terms: list[tuple] = []      # vocab keys, WITH multiplicity
+        self.need = 1.0
+        self.field = ""
+        self.lo_i = np.iinfo(np.int64).min
+        self.hi_i = np.iinfo(np.int64).max
+        self.lo_f = -np.inf
+        self.hi_f = np.inf
+        self.host_spec: tuple | None = None
+
+
+def _match_leaf(node: MatchNode, ft) -> _Leaf:
+    if ft is None:
+        raise _Undense("unmapped-field")
+    leaf = _Leaf(_TEXT)
+    terms = node.terms_per_query[0] if node.terms_per_query else []
+    if ft.type != TEXT:
+        # non-text fields never materialize text postings: the loop's
+        # `seg.text.get(field) is None` rung — constant false
+        return _Leaf(_FALSE)
+    leaf.terms = [("t", node.field_name, t) for t in terms]
+    if node.operator == "and":
+        leaf.need = float(max(len(terms), 1))
+    elif node.minimum_should_match > 1:
+        leaf.need = float(max(node.minimum_should_match, 1))
+    else:
+        leaf.need = 1.0
+    return leaf
+
+
+def _term_leaf(node: TermFilterNode, ft) -> _Leaf:
+    if ft is None:
+        raise _Undense("unmapped-field")
+    vals = node.values_per_query[0] if node.values_per_query else []
+    if not vals:
+        return _Leaf(_FALSE)
+    if ft.type == KEYWORD:
+        leaf = _Leaf(_TEXT)
+        leaf.terms = [("k", node.field_name, str(v)) for v in vals]
+        return leaf
+    if ft.type == TEXT:
+        leaf = _Leaf(_TEXT)
+        leaf.terms = [("t", node.field_name, str(v)) for v in vals]
+        return leaf
+    if ft.type in _I64_TYPES:
+        # integer equality as token identity: the doc posts its column
+        # value as an ("n", field, str(v)) token, so multi-value terms
+        # stay one leaf (OR = any posting hits). _coerce_to_column keeps
+        # the loop's sentinel behavior bit-for-bit (bool→0/1, truncating
+        # int(), unparseable→i64.min)
+        leaf = _Leaf(_TEXT)
+        leaf.terms = [("n", node.field_name, str(_coerce_to_column(v, None)))
+                      for v in vals]
+        return leaf
+    if ft.type in _FLOAT_TYPES:
+        if len(vals) > 1:
+            raise _Undense("terms-f64-multi")
+        leaf = _Leaf(_RNG_F)
+        leaf.field = node.field_name
+        try:
+            v = float(vals[0])
+        except (TypeError, ValueError):
+            raise _Undense("term-f64-coerce") from None
+        leaf.lo_f = leaf.hi_f = v
+        return leaf
+    raise _Undense(f"term-type:{ft.type}")
+
+
+def _range_leaf(node: RangeNode, ft) -> _Leaf:
+    if ft is None:
+        raise _Undense("unmapped-field")
+    bounds = node.bounds_per_query[0] if node.bounds_per_query \
+        else (None, None, True, True)
+    lo, hi, inc_lo, inc_hi = bounds
+    if ft.type in _I64_TYPES or ft.type in _FLOAT_TYPES:
+        is_int = ft.type in _I64_TYPES
+        dt = np.int64 if is_int else np.float64
+        # the loop's exact fill/adjust/assign sequence (RangeNode.execute),
+        # including numpy's truncating float→int64 assignment
+        los = np.full(1, np.iinfo(np.int64).min if is_int else -np.inf, dt)
+        his = np.full(1, np.iinfo(np.int64).max if is_int else np.inf, dt)
+        if lo is not None:
+            los[0] = lo if inc_lo else _next_up(lo, dt)
+        if hi is not None:
+            his[0] = hi if inc_hi else _next_down(hi, dt)
+        leaf = _Leaf(_RNG_I if is_int else _RNG_F)
+        leaf.field = node.field_name
+        if is_int:
+            leaf.lo_i, leaf.hi_i = int(los[0]), int(his[0])
+        else:
+            leaf.lo_f, leaf.hi_f = float(los[0]), float(his[0])
+        return leaf
+    if ft.type == KEYWORD:
+        leaf = _Leaf(_HOST)
+        leaf.host_spec = ("krange", node.field_name, lo, hi, inc_lo, inc_hi)
+        return leaf
+    if ft.type == TEXT:
+        # no numeric/keyword column ever exists → the loop's final
+        # `_false` rung
+        return _Leaf(_FALSE)
+    raise _Undense(f"range-type:{ft.type}")
+
+
+def _leaf_of(node: Any, mappers) -> _Leaf:
+    """One-level leaf extraction; raises _Undense for shapes the grid
+    can't hold (the caller sends the whole query to the residual loop)."""
+    if isinstance(node, MatchAllNode):
+        return _Leaf(_TRUE)
+    if isinstance(node, MatchNoneNode):
+        return _Leaf(_FALSE)
+    if isinstance(node, ConstantScoreNode):
+        return _leaf_of(node.inner, mappers)
+    if isinstance(node, MatchNode):
+        return _match_leaf(node, mappers.field_type(node.field_name))
+    if isinstance(node, TermFilterNode):
+        return _term_leaf(node, mappers.field_type(node.field_name))
+    if isinstance(node, RangeNode):
+        return _range_leaf(node, mappers.field_type(node.field_name))
+    if isinstance(node, ExistsNode):
+        leaf = _Leaf(_HOST)
+        leaf.host_spec = ("exists", node.field_name)
+        return leaf
+    raise _Undense(f"node:{type(node).__name__}")
+
+
+def extract_plan(node: Any, mappers) -> tuple[list[_Leaf], int]:
+    """Query tree -> (leaves-with-roles, minimum_should_match)."""
+    while isinstance(node, ConstantScoreNode):
+        node = node.inner
+    if isinstance(node, BoolNode):
+        leaves: list[_Leaf] = []
+        for n in node.must + node.filter:
+            lf = _leaf_of(n, mappers)
+            lf.role = _MUST
+            leaves.append(lf)
+        for n in node.should:
+            lf = _leaf_of(n, mappers)
+            lf.role = _SHOULD
+            leaves.append(lf)
+        for n in node.must_not:
+            lf = _leaf_of(n, mappers)
+            lf.role = _NOT
+            leaves.append(lf)
+        if node.should:
+            msm = node.minimum_should_match
+            if msm is None:
+                msm = 0 if (node.must or node.filter) else 1
+        else:
+            # BoolNode.match_mask only gates when should-clauses exist
+            msm = 0
+        if len(leaves) > K_MAX:
+            raise _Undense("too-many-clauses")
+        return leaves, int(msm)
+    return [_leaf_of(node, mappers)], 0
+
+
+# ---------------------------------------------------------------------------
+# Corpus (the registered-query side, cached per registry generation)
+# ---------------------------------------------------------------------------
+
+class PercolateCorpus:
+    """Device-ready slot grid + CSR for one registry generation."""
+
+    def __init__(self, generation: tuple):
+        self.generation = generation
+        self.qids: list[str] = []            # dense queries, grid order
+        self.residual: list[tuple[str, Any]] = []
+        self.decline_reasons: dict[str, int] = {}
+        self.vocab: dict[tuple, int] = {}
+        self.ifields: list[str] = []
+        self.ffields: list[str] = []
+        self.hspecs: list[tuple] = []
+        self.nq = 0
+        self.nq_pad = 0
+        self.k = 1
+        # host arrays (built in build_corpus)
+        self.kind = self.role = self.need = self.rf = None
+        self.lo_i = self.hi_i = self.lo_f = self.hi_f = None
+        self.msm = self.live = None
+        self.term_start = self.term_len = self.slot_ids = None
+        self.nbytes = 0
+
+    def _finalize(self, plans: list[tuple[str, list[_Leaf], int]]) -> None:
+        self.nq = len(plans)
+        self.nq_pad = _pow2(self.nq, 8)
+        self.k = min(_pow2(max((len(ls) for _, ls, _ in plans), default=1)),
+                     K_MAX)
+        nq_pad, k = self.nq_pad, self.k
+        self.kind = np.zeros((nq_pad, k), np.int32)
+        self.role = np.zeros((nq_pad, k), np.int32)
+        self.need = np.ones((nq_pad, k), np.float32)
+        self.rf = np.zeros((nq_pad, k), np.int32)
+        self.lo_i = np.full((nq_pad, k), np.iinfo(np.int64).min, np.int64)
+        self.hi_i = np.full((nq_pad, k), np.iinfo(np.int64).max, np.int64)
+        self.lo_f = np.full((nq_pad, k), -np.inf, np.float64)
+        self.hi_f = np.full((nq_pad, k), np.inf, np.float64)
+        self.msm = np.zeros(nq_pad, np.int32)
+        self.live = np.zeros(nq_pad, bool)
+        ifield_ix: dict[str, int] = {}
+        ffield_ix: dict[str, int] = {}
+        hspec_ix: dict[tuple, int] = {}
+        posts: dict[int, list[int]] = {}
+        for qi, (qid, leaves, msm) in enumerate(plans):
+            self.qids.append(qid)
+            self.live[qi] = True
+            self.msm[qi] = msm
+            for li, lf in enumerate(leaves):
+                slot = qi * k + li
+                self.kind[qi, li] = lf.kind
+                self.role[qi, li] = lf.role
+                if lf.kind == _TEXT:
+                    self.need[qi, li] = lf.need
+                    for key in lf.terms:       # multiplicity preserved
+                        tid = self.vocab.setdefault(key, len(self.vocab))
+                        posts.setdefault(tid, []).append(slot)
+                elif lf.kind == _RNG_I:
+                    self.rf[qi, li] = ifield_ix.setdefault(
+                        lf.field, len(ifield_ix))
+                    self.lo_i[qi, li] = lf.lo_i
+                    self.hi_i[qi, li] = lf.hi_i
+                elif lf.kind == _RNG_F:
+                    self.rf[qi, li] = ffield_ix.setdefault(
+                        lf.field, len(ffield_ix))
+                    self.lo_f[qi, li] = lf.lo_f
+                    self.hi_f[qi, li] = lf.hi_f
+                elif lf.kind == _HOST:
+                    self.rf[qi, li] = hspec_ix.setdefault(
+                        lf.host_spec, len(hspec_ix))
+        self.ifields = [f for f, _ in sorted(ifield_ix.items(),
+                                             key=lambda kv: kv[1])]
+        self.ffields = [f for f, _ in sorted(ffield_ix.items(),
+                                             key=lambda kv: kv[1])]
+        self.hspecs = [s for s, _ in sorted(hspec_ix.items(),
+                                            key=lambda kv: kv[1])]
+        nt = len(self.vocab)
+        self.term_start = np.zeros(max(nt, 1), np.int32)
+        self.term_len = np.zeros(max(nt, 1), np.int32)
+        flat: list[int] = []
+        for tid in range(nt):
+            ps = posts.get(tid, [])
+            self.term_start[tid] = len(flat)
+            self.term_len[tid] = len(ps)
+            flat.extend(ps)
+        self.slot_ids = np.zeros(_pow2(len(flat), 8), np.int32)
+        if flat:
+            self.slot_ids[:len(flat)] = flat
+        self.nbytes = sum(a.nbytes for a in (
+            self.kind, self.role, self.need, self.rf, self.lo_i, self.hi_i,
+            self.lo_f, self.hi_f, self.msm, self.live, self.term_start,
+            self.term_len, self.slot_ids))
+        # vocab keys + qids: rough host-side dict/string overhead
+        self.nbytes += 64 * (len(self.vocab) + len(self.qids)
+                             + len(self.residual))
+
+
+def build_corpus(svc) -> PercolateCorpus:
+    """Compile the registered-query roster into the dense slot grid;
+    queries the grid can't hold land in `corpus.residual` with a counted
+    decline reason."""
+    corpus = PercolateCorpus(registry_generation(svc))
+    plans: list[tuple[str, list[_Leaf], int]] = []
+    with tracing.span("percolate_corpus_build"):
+        for qid, node in parsed_registry(svc):
+            try:
+                leaves, msm = extract_plan(node, svc.mappers)
+                plans.append((qid, leaves, msm))
+            except _Undense as e:
+                corpus.residual.append((qid, node))
+                corpus.decline_reasons[e.reason] = \
+                    corpus.decline_reasons.get(e.reason, 0) + 1
+        corpus._finalize(plans)
+        tracing.add_event("percolate_corpus", queries=corpus.nq,
+                          residual=len(corpus.residual),
+                          terms=len(corpus.vocab), bytes=corpus.nbytes)
+    return corpus
+
+
+def corpus_for(svc, caches=None) -> PercolateCorpus:
+    """Registry-generation-keyed corpus lookup: through the cache-service
+    tier when one is wired (breaker-charged, evictable), else a one-slot
+    memo on the index service."""
+    gen = registry_generation(svc)
+    tier = getattr(caches, "percolator_registry", None) \
+        if caches is not None else None
+    if tier is not None:
+        corpus = tier.get_or_build(svc, gen, build_corpus)
+        if corpus is not None:
+            return corpus                      # breaker may decline: memo
+    memo = getattr(svc, "_percolate_corpus", None)
+    if memo is not None and memo[0] == gen:
+        return memo[1]
+    corpus = build_corpus(svc)
+    svc._percolate_corpus = (gen, corpus)
+    return corpus
+
+
+# ---------------------------------------------------------------------------
+# Document side
+# ---------------------------------------------------------------------------
+
+def _doc_tokens(parsed, vocab: dict[tuple, int]) -> list[int]:
+    """Deduped corpus-vocab term ids for one parsed document (the doc's
+    CSR row: text tokens, first keyword value, integer column value)."""
+    tids: set[int] = set()
+    for f, toks in parsed.tokens.items():
+        for t in set(toks):
+            tid = vocab.get(("t", f, t))
+            if tid is not None:
+                tids.add(tid)
+    for f, vals in parsed.keywords.items():
+        if vals:
+            tid = vocab.get(("k", f, vals[0]))
+            if tid is not None:
+                tids.add(tid)
+    for f, vals in parsed.longs.items():
+        if vals:
+            tid = vocab.get(("n", f, str(int(vals[0]))))
+            if tid is not None:
+                tids.add(tid)
+    return sorted(tids)
+
+
+def _host_pred(parsed, spec: tuple) -> bool:
+    """Host-channel predicates, mirroring the loop's one-doc-segment
+    column semantics exactly (see module docstring)."""
+    if spec[0] == "exists":
+        f = spec[1]
+        return bool(parsed.longs.get(f)) or bool(parsed.numerics.get(f)) \
+            or bool(parsed.keywords.get(f)) or bool(parsed.tokens.get(f))
+    if spec[0] == "krange":
+        _, f, lo, hi, inc_lo, inc_hi = spec
+        vals = parsed.keywords.get(f)
+        if not vals:
+            return False
+        v = vals[0]
+        if lo is not None:
+            s = str(lo)
+            if not (v > s or (inc_lo and v == s)):
+                return False
+        if hi is not None:
+            s = str(hi)
+            if not (v < s or (inc_hi and v == s)):
+                return False
+        return True
+    return False
+
+
+def _doc_arrays(parsed_docs, corpus: PercolateCorpus):
+    """Batch -> host arrays (CSR rows + value/missing/host-bool columns)."""
+    b = len(parsed_docs)
+    b_pad = _pow2(b)
+    rows = [_doc_tokens(p, corpus.vocab) for p in parsed_docs]
+    t = _pow2(max((len(r) for r in rows), default=1))
+    starts = np.zeros((b_pad, t), np.int32)
+    lens = np.zeros((b_pad, t), np.int32)
+    for di, row in enumerate(rows):
+        for j, tid in enumerate(row):
+            starts[di, j] = corpus.term_start[tid]
+            lens[di, j] = corpus.term_len[tid]
+    w = _pow2(int(lens.sum(axis=1).max()) if b else 1, 8)
+    fi = max(len(corpus.ifields), 1)
+    ff = max(len(corpus.ffields), 1)
+    fh = max(len(corpus.hspecs), 1)
+    val_i = np.zeros((b_pad, fi), np.int64)
+    miss_i = np.ones((b_pad, fi), bool)
+    val_f = np.full((b_pad, ff), np.nan, np.float64)
+    miss_f = np.ones((b_pad, ff), bool)
+    hostok = np.zeros((b_pad, fh), bool)
+    for di, p in enumerate(parsed_docs):
+        for j, f in enumerate(corpus.ifields):
+            vals = p.longs.get(f)
+            if vals:
+                val_i[di, j] = int(vals[0])
+                miss_i[di, j] = False
+        for j, f in enumerate(corpus.ffields):
+            vals = p.numerics.get(f)
+            if vals:
+                val_f[di, j] = float(vals[0])
+                miss_f[di, j] = False
+        for j, spec in enumerate(corpus.hspecs):
+            hostok[di, j] = _host_pred(p, spec)
+    return starts, lens, val_i, miss_i, val_f, miss_f, hostok, t, w
+
+
+# ---------------------------------------------------------------------------
+# The jitted doc×query program
+# ---------------------------------------------------------------------------
+
+def _build_program(sig: tuple):
+    """One scan program per pow2-bucketed plan signature. Scans blocks of
+    the QUERY axis; every block re-reads the doc batch (resident on
+    device) and emits its [B_pad, block_q] match stripe; ys assemble into
+    the full matrix, fetched ONCE by the caller."""
+    (b_pad, t, w, nq_pad, k, block_q, fi, ff, fh, p_pad) = sig
+    block_slots = block_q * k
+
+    def run(slot_ids, starts, lens, val_i, miss_i, val_f, miss_f, hostok,
+            xs):
+        from ..ops.bm25 import postings_slots
+        idx, _, valid = postings_slots(starts, lens, w)
+        idx = jnp.clip(idx, 0, p_pad - 1)
+        slot = slot_ids[idx]                          # [B_pad, W] global
+        rows = jnp.arange(b_pad, dtype=jnp.int32)[:, None]
+        one = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
+
+        def step(carry, x):
+            loc = jnp.where(valid, slot - x["base"], block_slots)
+            cnt = jnp.zeros((b_pad, block_slots), jnp.float32)
+            cnt = cnt.at[rows, loc].add(one, mode="drop")
+            cnt = cnt.reshape(b_pad, block_q, k)
+            kind = x["kind"][None]
+            ok_text = cnt >= x["need"][None]
+            vi = jnp.take(val_i, jnp.clip(x["rf"], 0, fi - 1), axis=1)
+            mi = jnp.take(miss_i, jnp.clip(x["rf"], 0, fi - 1), axis=1)
+            ok_ri = (~mi) & (vi >= x["lo_i"][None]) & (vi <= x["hi_i"][None])
+            vf = jnp.take(val_f, jnp.clip(x["rf"], 0, ff - 1), axis=1)
+            mf = jnp.take(miss_f, jnp.clip(x["rf"], 0, ff - 1), axis=1)
+            ok_rf = (~mf) & (vf >= x["lo_f"][None]) & (vf <= x["hi_f"][None])
+            ok_h = jnp.take(hostok, jnp.clip(x["rf"], 0, fh - 1), axis=1)
+            ok = ((kind == _TEXT) & ok_text) | ((kind == _RNG_I) & ok_ri) \
+                | ((kind == _RNG_F) & ok_rf) | ((kind == _HOST) & ok_h) \
+                | (kind == _TRUE)
+            role = x["role"][None]
+            must_bad = jnp.any((role == _MUST) & ~ok, axis=2)
+            not_bad = jnp.any((role == _NOT) & ok, axis=2)
+            scnt = jnp.sum(((role == _SHOULD) & ok).astype(jnp.int32),
+                           axis=2)
+            msm = x["msm"][None]
+            matched = (~must_bad) & (~not_bad) \
+                & ((msm <= 0) | (scnt >= msm)) & x["live"][None]
+            return carry, matched
+
+        _, ys = lax.scan(step, 0, xs)                  # [NB, B_pad, block_q]
+        # -1, not nq_pad: the mesh rung feeds block SLICES of the xs
+        # through the same wrapper (parallel/mesh_percolate.py)
+        return jnp.transpose(ys, (1, 0, 2)).reshape(b_pad, -1)
+
+    return instrument("percolate:dense", jax.jit(run), key=sig)
+
+
+def _dense_matrix(corpus: PercolateCorpus, parsed_docs,
+                  devices=None) -> np.ndarray:
+    """Run the doc×query program -> bool [B, NQ]; exactly ONE device fetch
+    for the whole batch (per device on the mesh rung)."""
+    (starts, lens, val_i, miss_i, val_f, miss_f, hostok, t, w) = \
+        _doc_arrays(parsed_docs, corpus)
+    b = len(parsed_docs)
+    b_pad = starts.shape[0]
+    nq_pad, k = corpus.nq_pad, corpus.k
+    block_q = min(nq_pad, max(1, 8192 // k))
+    nb = nq_pad // block_q
+    p_pad = corpus.slot_ids.shape[0]
+    fi = val_i.shape[1]
+    ff = val_f.shape[1]
+    fh = hostok.shape[1]
+    sig = (b_pad, t, w, nq_pad, k, block_q, fi, ff, fh, p_pad)
+    prog = _PROGRAMS.get(sig)
+    if prog is None:
+        prog = _build_program(sig)
+        _PROGRAMS.put(sig, prog)
+
+    def bk(a):                        # [NQ_pad, K] -> xs [NB, block_q, K]
+        return a.reshape(nb, block_q, a.shape[1])
+
+    xs = {"kind": bk(corpus.kind), "role": bk(corpus.role),
+          "need": bk(corpus.need), "rf": bk(corpus.rf),
+          "lo_i": bk(corpus.lo_i), "hi_i": bk(corpus.hi_i),
+          "lo_f": bk(corpus.lo_f), "hi_f": bk(corpus.hi_f),
+          "msm": corpus.msm.reshape(nb, block_q),
+          "live": corpus.live.reshape(nb, block_q),
+          "base": (np.arange(nb, dtype=np.int32) * block_q * k)}
+    operands = (corpus.slot_ids, starts, lens, val_i, miss_i, val_f,
+                miss_f, hostok)
+    note_h2d(sum(a.nbytes for a in operands)
+             + sum(a.nbytes for a in xs.values()))
+    if devices and len(devices) > 1:
+        from ..parallel.mesh_percolate import mesh_matrix
+        mat = mesh_matrix(prog, operands, xs, nb, devices)
+    else:
+        mat = device_fetch(prog(*[jnp.asarray(a) for a in operands],
+                                {kk: jnp.asarray(v)
+                                 for kk, v in xs.items()}))
+    return np.asarray(mat)[:b, :corpus.nq]
+
+
+# ---------------------------------------------------------------------------
+# The ladder entry point
+# ---------------------------------------------------------------------------
+
+def percolate_batch(svc, index_name: str, docs: list[tuple[dict, str]],
+                    caches=None) -> list[dict]:
+    """Percolate a document batch: -> one {"total", "matches"} response
+    per (doc, type_name) pair, bitwise-identical to looping
+    percolator.percolate. Ladder: mesh → dense matrix → per-doc loop,
+    with residual (undenseable) queries riding the loop per doc."""
+    registry = parsed_registry(svc)
+    if not registry:
+        return [{"total": 0, "matches": []} for _ in docs]
+    with tracing.span("percolate", index=index_name, docs=len(docs),
+                      queries=len(registry)):
+        corpus = corpus_for(svc, caches)
+        for reason in corpus.decline_reasons:
+            lane_decline("percolate", "dense", reason)
+        if corpus.nq == 0:
+            lane_decline("percolate", "dense", "no-dense-queries")
+            lane_chosen("percolate", "loop")
+            _bump(loop=1, docs=len(docs))
+            out = []
+            for doc, type_name in docs:
+                _, seg, root = build_doc_segment(svc, doc, type_name)
+                ids = loop_match(registry, seg, root)
+                ids.sort()
+                out.append({"total": len(ids),
+                            "matches": [{"_index": index_name, "_id": i}
+                                        for i in ids]})
+            return out
+        devices = jax.devices()
+        if len(devices) > 1:
+            lane_chosen("percolate", "mesh")
+            _bump(mesh=1)
+        else:
+            lane_decline("percolate", "mesh", "single-device")
+            lane_chosen("percolate", "dense")
+        parsed_docs = []
+        for doc, type_name in docs:
+            mapper = svc.mappers.document_mapper(type_name)
+            parsed_docs.append(mapper.parse(doc, doc_id="_percolate_doc"))
+        mat = _dense_matrix(corpus, parsed_docs,
+                            devices if len(devices) > 1 else None)
+        _bump(dense=1, docs=len(docs),
+              matrix_cells=int(mat.shape[0]) * int(mat.shape[1]),
+              residual_queries=len(corpus.residual) * len(docs))
+        residual_reg = corpus.residual
+        out = []
+        for di, (doc, type_name) in enumerate(docs):
+            ids = [corpus.qids[qi] for qi in np.flatnonzero(mat[di])]
+            if residual_reg:
+                _, seg, root = build_doc_segment(svc, doc, type_name)
+                ids.extend(loop_match(residual_reg, seg, root))
+            ids.sort()
+            out.append({"total": len(ids),
+                        "matches": [{"_index": index_name, "_id": i}
+                                    for i in ids]})
+        return out

@@ -30,6 +30,10 @@ def registered_queries(svc) -> list[tuple[str, dict]]:
         with e._lock:
             buffered = list(e._buffer_docs.items())
             segments = list(e.segments)
+            # deletes are realtime for the registry (ref the reference's
+            # live percolateQueries map) even though the SEARCH tombstone
+            # defers to the next refresh
+            pending = set(e._pending_set)
         for doc_id, entry in buffered:
             src, tname = entry[0], entry[1]
             if tname == PERCOLATOR_TYPE and "query" in src:
@@ -37,7 +41,8 @@ def registered_queries(svc) -> list[tuple[str, dict]]:
                 seen.add(doc_id)
         for seg in segments:
             for local, tname in enumerate(seg.types):
-                if tname != PERCOLATOR_TYPE or not seg.live_host[local]:
+                if tname != PERCOLATOR_TYPE or not seg.live_host[local] \
+                        or (seg.seg_id, local) in pending:
                     continue
                 doc_id = seg.ids[local]
                 if doc_id in seen:
@@ -50,9 +55,12 @@ def registered_queries(svc) -> list[tuple[str, dict]]:
 
 
 def _registry_key(svc) -> tuple:
-    return tuple((id(e), tuple(s.seg_id for s in e.segments),
-                  len(e._buffer_docs), e.translog.ops_since_commit)
-                 for e in svc.shards)
+    # keyed on each engine's monotonic percolator generation — NOT on
+    # (segment ids, buffer length): a delete-then-register of the same
+    # count leaves those unchanged and served a stale registry (ISSUE 18
+    # bugfix). The generation bumps on every `.percolator` write and on
+    # every delete, and never repeats for a live engine.
+    return tuple((id(e), e.percolator_gen) for e in svc.shards)
 
 
 def parsed_registry(svc) -> list[tuple[str, Any]]:
@@ -77,29 +85,31 @@ def parsed_registry(svc) -> list[tuple[str, Any]]:
     return entries
 
 
-def percolate(svc, index_name: str, doc: dict,
-              type_name: str = "_doc") -> dict:
-    """-> {"total": N, "matches": [{"_index", "_id"}]} (ref
-    PercolatorService.percolate response shape)."""
-    import numpy as np
-
+def build_doc_segment(svc, doc: dict, type_name: str = "_doc"):
+    """Parse `doc` into a one-doc in-memory segment -> (parsed, seg, root).
+    Nested sub-docs occupy the leading rows (block-join order); the ROOT
+    row is where match columns must be read."""
     from ..index.segment import SegmentBuilder
-    from .query_dsl import CollectionStats, SegmentContext
-    from .query_parser import merge_query_batch
-
-    registry = parsed_registry(svc)
-    if not registry:
-        return {"total": 0, "matches": []}
-    kept = [qid for qid, _ in registry]
-    nodes = [node for _, node in registry]
 
     mapper = svc.mappers.document_mapper(type_name)
     parsed = mapper.parse(doc, doc_id="_percolate_doc")
     builder = SegmentBuilder(seg_id=0)
-    # nested sub-docs occupy the leading rows (block-join order); the ROOT
-    # row is where match columns must be read
     root = builder.add(parsed, type_name)
-    seg = builder.build()
+    return parsed, builder.build(), root
+
+
+def loop_match(registry: list[tuple[str, Any]], seg, root: int) -> list[str]:
+    """Evaluate (query_id, Node) pairs against a built one-doc segment,
+    returning matched query ids (UNSORTED — callers merge + sort). This is
+    the per-doc reference rung of the percolate ladder; the dense executor
+    (percolate_exec) calls it for residual queries its plan declined."""
+    import numpy as np
+
+    from .query_dsl import CollectionStats, SegmentContext
+    from .query_parser import merge_query_batch
+
+    kept = [qid for qid, _ in registry]
+    nodes = [node for _, node in registry]
     # batch per PLAN SHAPE: same-shaped registered queries stack into one
     # device program's query rows; each distinct shape costs one program
     groups: dict[Any, list[int]] = {}
@@ -131,6 +141,18 @@ def percolate(svc, index_name: str, doc: dict,
             SegmentContext(seg, len(rows), stats)))
         for qi in np.flatnonzero(match[:, root]):
             matched_ids.append(kept[rows[int(qi)]])
+    return matched_ids
+
+
+def percolate(svc, index_name: str, doc: dict,
+              type_name: str = "_doc") -> dict:
+    """-> {"total": N, "matches": [{"_index", "_id"}]} (ref
+    PercolatorService.percolate response shape)."""
+    registry = parsed_registry(svc)
+    if not registry:
+        return {"total": 0, "matches": []}
+    _, seg, root = build_doc_segment(svc, doc, type_name)
+    matched_ids = loop_match(registry, seg, root)
     matched_ids.sort()
     matches = [{"_index": index_name, "_id": mid} for mid in matched_ids]
     return {"total": len(matches), "matches": matches}

@@ -1431,11 +1431,15 @@ class FunctionScoreNode(Node):
     functions: list[dict] = dc_field(default_factory=list)   # parsed specs
     score_mode: str = "multiply"   # multiply | sum | avg | max | min | first
     boost_mode: str = "multiply"   # multiply | sum | replace | avg | max | min
+    # set by the parser so expression script_score can resolve doc-field
+    # types at execute time; deliberately NOT part of plan_key
+    mappers: Any = None
 
     def collect_terms(self, out):
         self.inner.collect_terms(out)
 
-    def _function_values(self, ctx: SegmentContext, spec: dict) -> jax.Array:
+    def _function_values(self, ctx: SegmentContext, spec: dict,
+                         score: jax.Array | None = None) -> jax.Array:
         seg = ctx.segment
         if "field_value_factor" in spec:
             p = spec["field_value_factor"]
@@ -1476,13 +1480,22 @@ class FunctionScoreNode(Node):
         if "cosine" in spec or "script_score" in spec:
             # vector similarity: {"cosine": {"field": f, "query_vectors": [[...]xQ]}}
             p = spec.get("cosine") or spec.get("script_score")
-            fname = p["field"]
-            vc = seg.vectors.get(fname)
-            if vc is None:
-                return jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32)
-            qv = jnp.asarray(np.asarray(p["query_vectors"], np.float32))  # [Q, D]
-            sims = _cosine_scores(vc.vecs, qv)
-            return sims
+            if isinstance(p, dict) and "query_vectors" in p:
+                fname = p["field"]
+                vc = seg.vectors.get(fname)
+                if vc is None:
+                    return jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32)
+                qv = jnp.asarray(np.asarray(p["query_vectors"], np.float32))  # [Q, D]
+                sims = _cosine_scores(vc.vecs, qv)
+                return sims
+            # expression script_score: {"script_score": {"script": "...",
+            # "params": {...}}} (also bare-string / inline / source shapes)
+            from ..script.jax_compile import script_source
+            src, sparams = script_source(p)
+            if src is not None:
+                return self._script_values(ctx, src, sparams, score)
+            raise QueryParsingException(
+                "script_score needs a script source or query_vectors")
         if "weight" in spec and len(spec) == 1:
             return jnp.full((ctx.Q, ctx.n_pad), float(spec["weight"]), jnp.float32)
         if "decay" in spec:
@@ -1510,13 +1523,94 @@ class FunctionScoreNode(Node):
             return jnp.broadcast_to(out[None, :], (ctx.Q, ctx.n_pad))
         raise QueryParsingException(f"unsupported function_score function: {list(spec)}")
 
+    def _script_values(self, ctx: SegmentContext, src: str, sparams: dict,
+                       score: jax.Array | None) -> jax.Array:
+        """Expression script_score (ISSUE 18 tentpole b): compile the
+        expression to a fused device op over the segment's numeric columns
+        (script/jax_compile.py); anything outside the grammar declines to
+        the per-doc host evaluator with a stable `script:*` reason. Both
+        lanes evaluate in f64 and cast to f32 at the same point, so where
+        the expression sticks to the exact-IEEE subset they are bitwise
+        identical (the chaos parity pair)."""
+        from ..common.device_stats import lane_chosen, lane_decline
+        from ..script.jax_compile import (ScriptCompileError,
+                                          compile_expression,
+                                          validate_binding)
+
+        seg = ctx.segment
+        if score is None:
+            score = jnp.zeros((ctx.Q, ctx.n_pad), jnp.float32)
+        try:
+            compiled = compile_expression(src, target="function_score")
+            ftypes: dict[str, Any] = {}
+            if compiled.fields:
+                if self.mappers is None:
+                    raise ScriptCompileError("script:no-mappers")
+                for f in compiled.fields:
+                    ft = self.mappers.field_type(f)
+                    ftypes[f] = None if ft is None else ft.type
+            validate_binding(compiled, sparams, ftypes)
+            cols_v, cols_m = [], []
+            for f in compiled.fields:
+                nc = seg.numerics.get(f)
+                if nc is None:   # mapped but absent in this segment
+                    cols_v.append(jnp.zeros((ctx.n_pad,), jnp.float64))
+                    cols_m.append(jnp.ones((ctx.n_pad,), bool))
+                else:
+                    cols_v.append(nc.vals.astype(jnp.float64))
+                    cols_m.append(nc.missing)
+            f_n = len(compiled.fields)
+            vals = (jnp.stack(cols_v) if f_n
+                    else jnp.zeros((0, ctx.n_pad), jnp.float64))
+            miss = (jnp.stack(cols_m) if f_n
+                    else jnp.zeros((0, ctx.n_pad), bool))
+            pvec = jnp.asarray(np.asarray(
+                [float(sparams[p]) for p in compiled.param_names],
+                np.float64))
+            out = compiled.fn(vals, miss, score.astype(jnp.float64), pvec)
+            lane_chosen("script", "compiled")
+            return out.astype(jnp.float32)
+        except ScriptCompileError as e:
+            lane_decline("script", "compiled", e.reason)
+        return self._script_values_host(ctx, src, sparams, score)
+
+    def _script_values_host(self, ctx: SegmentContext, src: str,
+                            sparams: dict, score: jax.Array) -> jax.Array:
+        """Per-doc host evaluation through script/engine.run_search_script
+        over stored sources — the decline target. A doc whose evaluation
+        raises (missing field, type error, unparseable script) scores 0.0,
+        never errors (ScriptException -> 0.0 contract)."""
+        from ..script.engine import run_search_script
+
+        seg = ctx.segment
+        out = np.zeros((ctx.Q, ctx.n_pad), np.float64)
+        s_np = np.asarray(score, np.float64)
+        per_query = "_score" in src   # re-evaluate per query row only if read
+        for local in range(len(seg.ids)):
+            if not bool(seg.live_host[local]):
+                continue
+            source = seg.stored[local]
+            rows = range(ctx.Q) if per_query else (0,)
+            for q in rows:
+                try:
+                    v = float(run_search_script(
+                        src, source, sparams,
+                        extra_names={"_score": float(s_np[q, local])}))
+                except Exception:  # noqa: BLE001 — ScriptException -> 0.0
+                    v = 0.0
+                if per_query:
+                    out[q, local] = v
+                else:
+                    out[:, local] = v
+        return jnp.asarray(out.astype(np.float32))
+
     def execute(self, ctx):
         s, m = self.inner.execute(ctx)
         if not self.functions:
             return s, m
         fvals = []
         for spec in self.functions:
-            v = self._function_values(ctx, spec)
+            v = self._function_values(ctx, spec, score=s)
             w = float(spec.get("weight", 1.0)) if "weight" in spec and len(spec) > 1 else 1.0
             fvals.append(v * w)
         if self.score_mode == "multiply":

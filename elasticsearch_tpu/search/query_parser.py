@@ -579,7 +579,8 @@ class QueryParser:
             inner=inner, functions=functions,
             score_mode=spec.get("score_mode", "multiply"),
             boost_mode=spec.get("boost_mode", "multiply"),
-            boost=float(spec.get("boost", 1.0)))
+            boost=float(spec.get("boost", 1.0)),
+            mappers=self.mappers)
 
     def _parse_function(self, f: dict) -> dict:
         out: dict[str, Any] = {}
@@ -605,8 +606,10 @@ class QueryParser:
         elif "random_score" in f:
             out["random_score"] = f.get("random_score") or {}
         elif "script_score" in f:
-            # restricted script: only cosine/dot-product vector scripts compile
-            # to device programs (no Groovy sandbox — SURVEY.md §7 M6)
+            # passed through raw: vector query_vectors specs ride the cosine
+            # kernel; expression bodies compile via script/jax_compile (no
+            # Groovy sandbox — SURVEY.md §7 M6), declining to the host
+            # evaluator when outside the grammar
             out["script_score"] = f["script_score"]
         elif "cosine" in f:
             out["cosine"] = f["cosine"]

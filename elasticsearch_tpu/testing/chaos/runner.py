@@ -265,6 +265,8 @@ class ChaosRunner:
         self._sorted_parity()
         self._subagg_parity()
         self._knn_parity()
+        self._percolate_parity()
+        self._script_parity()
 
     # sorted bodies ride the ISSUE 17 sorted device lanes (the sparse
     # postings lane never serves a sorted plan); the claim catches a
@@ -317,6 +319,64 @@ class ChaosRunner:
                                        ref, got):
                     self.oracle.lane_check(f"subagg-loop-vs-{name}",
                                            rec, self._TWIN_LANES[name])
+
+    def _percolate_parity(self) -> None:
+        """Reverse-search replay pairs (ISSUE 18): the dense doc×query
+        matrix executor vs the per-doc loop reference over the SAME
+        registry — documented bitwise, wildcard residuals merged through
+        the loop rung on both sides. Queries register on EVERY twin (same
+        writes on all twins is the cross-lane parity precondition — a
+        one-twin registry would skew doc counts and idf), and re-register
+        each round so the generation-keyed corpus cache turns over."""
+        from ...common.device_stats import record_lanes
+        from ...search import percolator as perc_mod
+
+        queries = self.solo_work.percolator_queries(7)
+        for name, _ in _TWINS:
+            for qi, q in enumerate(queries):
+                self.node.index_doc(name, f"pq-{qi}", {"query": q},
+                                    type_name=".percolator")
+            self.node.refresh(name)
+        name = _TWINS[1][0]
+        svc = self.node.indices[name]
+        for doc in self.solo_work.percolate_docs(4):
+            registry = perc_mod.parsed_registry(svc)
+            _, seg, root = perc_mod.build_doc_segment(
+                svc, copy.deepcopy(doc))
+            ref_ids = sorted(perc_mod.loop_match(registry, seg, root))
+            ref = {"total": len(ref_ids),
+                   "matches": [{"_index": name, "_id": i}
+                               for i in ref_ids]}
+            with record_lanes() as rec:
+                got = self.node.percolate(name, {"doc": copy.deepcopy(doc)})
+            got_c = {"total": got["total"], "matches": got["matches"]}
+            if self.oracle.compare("percolate-dense-vs-loop",
+                                   {"doc": doc}, ref, got_c):
+                self.oracle.lane_check("percolate-dense-vs-loop", rec,
+                                       ("dense", "mesh"))
+
+    def _script_parity(self) -> None:
+        """Compiled script_score vs the host evaluator (ISSUE 18): the
+        SAME expression, once compiled to the fused device op and once
+        wrapped in a host-only no-op conditional (`(e) if true else 0.0`
+        — an IfExp the compiler declines with a stable reason) so it
+        rides the per-doc host evaluator. Both lanes evaluate in f64 and
+        the expression pool sticks to the exact-IEEE subset, so scores
+        must match bitwise."""
+        for w, expr, params in self.solo_work.script_exprs(3):
+            def body(src):
+                return {"size": 10, "query": {"function_score": {
+                    "query": {"match": {"body": w}},
+                    "script_score": {"script": src,
+                                     "params": dict(params)},
+                    "boost_mode": "replace"}}}
+            ref, _ref_rec = self._search_lanes(
+                "c-stacked", body(f"({expr}) if true else 0.0"))
+            got, rec = self._search_lanes("c-stacked", body(expr))
+            if self.oracle.compare("script-compiled-vs-host",
+                                   body(expr), ref, got):
+                self.oracle.lane_check("script-compiled-vs-host", rec,
+                                       "compiled")
 
     def _knn_parity(self) -> None:
         for body in self.solo_work.knn_queries(3):

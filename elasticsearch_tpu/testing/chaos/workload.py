@@ -174,3 +174,74 @@ class SeededWorkload:
         return {"size": 5, "knn": {
             "field": "vec", "query_vector": self.vector(), "k": 10,
             "filter": {"term": {"tag": self.rng.choice(TAGS)}}}}
+
+    def percolator_queries(self, count: int) -> list[dict]:
+        """Registered-query bodies (ISSUE 18) spanning every channel of
+        the dense percolate grid — text counts (match and/or/msm), term
+        identity, numeric ranges, bool combinations, exists — plus a
+        wildcard that the dense plan declines, so the dense+residual-loop
+        merge is always part of the replay pair."""
+        out = []
+        for j in range(count):
+            w1, w2 = self.rng.choice(WORDS), self.rng.choice(WORDS)
+            kind = j % 7
+            if kind == 0:
+                out.append({"match": {"body": w1}})
+            elif kind == 1:
+                out.append({"match": {"body": {
+                    "query": f"{w1} {w2}", "operator": "and"}}})
+            elif kind == 2:
+                out.append({"term": {"tag": self.rng.choice(TAGS)}})
+            elif kind == 3:
+                lo = self.rng.randrange(0, 150)
+                out.append({"range": {"n": {"gte": lo, "lt": lo + 40}}})
+            elif kind == 4:
+                out.append({"bool": {
+                    "must": [{"match": {"body": w1}}],
+                    "must_not": [{"term": {"tag": self.rng.choice(TAGS)}}]}})
+            elif kind == 5:
+                out.append({"bool": {
+                    "should": [{"match": {"body": w1}},
+                               {"match": {"body": w2}},
+                               {"exists": {"field": "price"}}],
+                    "minimum_should_match": 2}})
+            else:
+                # residual rung: the dense plan declines term expansion
+                out.append({"wildcard": {"body": w1[:2] + "*"}})
+        return out
+
+    def percolate_docs(self, count: int) -> list[dict]:
+        """Doc sources to percolate (NOT indexed): same field roster as
+        the corpus docs, with `price` sometimes absent so the exists /
+        missing channels are live in every pair."""
+        out = []
+        for _ in range(count):
+            src = {"body": " ".join(self.rng.choice(WORDS)
+                                    for _ in range(self.rng.randint(2, 6))),
+                   "tag": self.rng.choice(TAGS),
+                   "n": self.rng.randrange(0, 200),
+                   "price": round(self.rng.uniform(0.5, 99.5), 2)}
+            if self.rng.random() < 0.3:
+                del src["price"]
+            out.append(src)
+        return out
+
+    def script_exprs(self, count: int) -> list[tuple[str, str, dict]]:
+        """(match word, expression, params) triples for the compiled-vs-
+        host script_score pair (ISSUE 18). Restricted BY DESIGN to the
+        exact-IEEE op subset (+ - * min max abs floor ceil and _score):
+        ** / transcendentals / % / division are documented carve-outs,
+        not replay-pair material."""
+        pool = [
+            ("doc['n'].value * 2.0 + 1.0", {}),
+            ("Math.max(doc['price'].value, 10.0) - doc['n'].value", {}),
+            ("Math.abs(doc['price'].value - 50.0) + _score", {}),
+            ("Math.floor(doc['price'].value)"
+             " + Math.min(doc['n'].value, params.c)", {"c": 25}),
+            ("Math.ceil(doc['price'].value) * params.w", {"w": 3}),
+        ]
+        out = []
+        for _ in range(count):
+            expr, params = pool[self.rng.randrange(len(pool))]
+            out.append((self.rng.choice(WORDS), expr, params))
+        return out

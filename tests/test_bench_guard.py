@@ -95,7 +95,12 @@ def test_tail_latency_keys_survive_forced_timeout():
                 # log-analytics observability tier (ISSUE 17): same
                 # seeded-null contract
                 "sorted_mesh_qps", "sorted_fanout_qps",
-                "subagg_mesh_qps", "monitoring_overview_p50_ms"):
+                "subagg_mesh_qps", "monitoring_overview_p50_ms",
+                # reverse search + script compiler (ISSUE 18): same
+                # seeded-null contract
+                "percolate_qps", "percolate_matrix_qps",
+                "percolate_vs_loop", "script_score_qps",
+                "script_vs_decline"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

@@ -1,0 +1,221 @@
+"""Expression→JAX script compiler (ISSUE 18 tentpole, part 2): grammar
+accept/decline with stable reasons, bitwise parity with the host
+evaluator on the exact-IEEE subset, AST-canonical compile-cache dedup,
+and the end-to-end `script_score` lane (compiled rides the dense lane,
+non-compilable declines to the host loop — never an error)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.script.engine import run_search_script
+from elasticsearch_tpu.script.jax_compile import (
+    ScriptCompileError, analyze, compile_expression,
+    script_compiles_snapshot, script_source, validate_binding)
+
+
+class TestGrammar:
+    @pytest.mark.parametrize("src", [
+        "1 + 2.5",
+        "-doc['n'].value * 3",
+        "doc['n'].value + doc['price'].value",
+        "_score * params.boost",
+        "params['w'] + params.c",
+        "Math.abs(doc['n'].value - 10)",
+        "Math.pow(2.0, 10)",
+        "Math.min(Math.max(doc['n'].value, 0.0), 100.0)",
+        "doc['n'].value // 3 % 5",
+    ])
+    def test_accepts(self, src):
+        analyze(src)
+
+    @pytest.mark.parametrize("src,reason", [
+        ("1 +", "script:parse-error"),
+        ("doc['n'].value > 3", "script:unsupported-Compare"),
+        ("1 if _score else 0", "script:unsupported-IfExp"),
+        ("'abc'", "script:literal-type"),
+        ("True", "script:literal-type"),
+        ("foo + 1", "script:unknown-name"),
+        ("len(doc)", "script:unsupported-call"),
+        ("Math.tanh(1.0)", "script:unsupported-call"),
+        ("Math.min(1.0)", "script:math-arity"),
+        ("doc['n'] + 1", "script:unsupported-Subscript"),
+        ("_source['n'] + 1", "script:unsupported-Subscript"),
+        ("not _score", "script:unsupported-Not"),
+        ("[1, 2]", "script:unsupported-List"),
+    ])
+    def test_declines_with_stable_reason(self, src, reason):
+        with pytest.raises(ScriptCompileError) as e:
+            analyze(src)
+        assert e.value.reason == reason
+
+    def test_analysis_collects_bindings_in_order(self):
+        an = analyze("doc['b'].value + params.x * doc['a'].value"
+                     " - params['y'] + _score")
+        assert an.fields == ["b", "a"]
+        assert an.params == ["x", "y"]
+        assert an.uses_score
+
+
+class TestWireShapes:
+    @pytest.mark.parametrize("spec,want", [
+        ("1 + 2", ("1 + 2", {})),
+        ({"script": "x"}, ("x", {})),
+        ({"inline": "x", "params": {"a": 1}}, ("x", {"a": 1})),
+        ({"source": "x"}, ("x", {})),
+        ({"script": {"inline": "x", "params": {"a": 1}}}, ("x", {"a": 1})),
+        ({"lang": "expression"}, (None, {})),
+        (42, (None, {})),
+    ])
+    def test_script_source(self, spec, want):
+        assert script_source(spec) == want
+
+    def test_validate_binding_reasons(self):
+        c = compile_expression("doc['n'].value + params.w", "t")
+        validate_binding(c, {"w": 2}, {"n": "long"})
+        with pytest.raises(ScriptCompileError) as e:
+            validate_binding(c, {"w": 2}, {})
+        assert e.value.reason == "script:unmapped-field"
+        with pytest.raises(ScriptCompileError) as e:
+            validate_binding(c, {"w": 2}, {"n": "date"})
+        assert e.value.reason == "script:doc-field-type"
+        with pytest.raises(ScriptCompileError) as e:
+            validate_binding(c, {"w": "big"}, {"n": "long"})
+        assert e.value.reason == "script:param-type"
+        with pytest.raises(ScriptCompileError) as e:
+            validate_binding(c, {"w": True}, {"n": "long"})
+        assert e.value.reason == "script:param-type"
+
+
+class TestCompileCache:
+    def test_whitespace_variants_share_one_program(self):
+        c0 = script_compiles_snapshot().get("cachetest", 0)
+        a = compile_expression("doc['n'].value*2 + 1", "cachetest")
+        b = compile_expression("doc['n'].value * 2+1", "cachetest")
+        c = compile_expression("doc['n'].value  *  2 + 1", "cachetest")
+        assert a is b is c
+        assert script_compiles_snapshot()["cachetest"] == c0 + 1
+
+    def test_distinct_ast_or_target_builds_again(self):
+        c0 = script_compiles_snapshot().get("cachetest2", 0)
+        compile_expression("1 + 2", "cachetest2")
+        compile_expression("1 + 3", "cachetest2")
+        compile_expression("1 + 2", "cachetest2-other")
+        assert script_compiles_snapshot()["cachetest2"] == c0 + 2
+
+
+class TestHostParity:
+    """The exact-IEEE subset scores bit-identically on both lanes."""
+
+    EXPRS = [
+        ("doc['n'].value * 2.0 + 1.0", {}),
+        ("Math.max(doc['p'].value, 10.0) - doc['n'].value", {}),
+        ("Math.abs(doc['p'].value - 50.0) + _score", {}),
+        ("Math.floor(doc['p'].value) + Math.min(doc['n'].value,"
+         " params.c)", {"c": 25.0}),
+        ("Math.ceil(doc['p'].value) * params.w", {"w": 3.0}),
+        ("-doc['n'].value + doc['p'].value - 0.5", {}),
+    ]
+
+    @pytest.mark.parametrize("expr,params", EXPRS)
+    def test_compiled_matches_host_bitwise(self, expr, params):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(11)
+        n = 16
+        nvals = rng.integers(0, 200, size=n).astype(np.float64)
+        pvals = np.round(rng.uniform(0.5, 99.5, size=n), 2)
+        score = rng.uniform(0.0, 8.0, size=(1, n))
+        c = compile_expression(expr, "parity")
+        vals = jnp.asarray(np.stack(
+            [nvals if f == "n" else pvals for f in c.fields])) \
+            if c.fields else jnp.zeros((0, n))
+        miss = jnp.zeros_like(vals, dtype=bool)
+        pvec = jnp.asarray([float(params[p]) for p in c.param_names])
+        got = np.asarray(c.fn(vals, miss, jnp.asarray(score), pvec))
+        for i in range(n):
+            ref = run_search_script(
+                expr, {"n": float(nvals[i]), "p": float(pvals[i])},
+                params=dict(params),
+                extra_names={"_score": float(score[0, i])})
+            assert float(got[0, i]) == float(ref), (expr, i)
+
+    def test_missing_field_scores_zero(self):
+        import jax.numpy as jnp
+        c = compile_expression("doc['n'].value + 5.0", "parity")
+        vals = jnp.asarray([[7.0, 0.0]])
+        miss = jnp.asarray([[False, True]])
+        got = np.asarray(c.fn(vals, miss, jnp.ones((1, 2)),
+                              jnp.zeros((0,))))
+        assert got.tolist() == [[12.0, 0.0]]
+
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"},
+    "n": {"type": "long"},
+    "price": {"type": "double"},
+    "when": {"type": "date"},
+}}}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    n.create_index("s", mappings=MAPPING)
+    for i in range(8):
+        n.index_doc("s", str(i), {
+            "body": "fox" if i % 2 else "fox dog",
+            "n": i * 10, "price": 5.5 + i,
+            "when": "2026-01-0%d" % (i + 1)})
+    n.refresh("s")
+    yield n
+    n.close()
+
+
+def _fs_body(script, params=None):
+    return {"size": 8, "query": {"function_score": {
+        "query": {"match": {"body": "fox"}},
+        "script_score": {"script": script, "params": params or {}},
+        "boost_mode": "replace"}}}
+
+
+class TestScriptScoreLane:
+    def test_compiled_rides_the_dense_lane(self, node):
+        with record_lanes() as rec:
+            out = node.search("s", _fs_body(
+                "doc['n'].value * 2.0 + params.b", {"b": 1.0}))
+        assert rec.chose("compiled")
+        scores = {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        assert scores["3"] == 61.0 and scores["0"] == 1.0
+
+    def test_decline_is_stable_and_bit_identical(self, node):
+        expr = "doc['n'].value * 2.0 + 1.0"
+        with record_lanes() as rec:
+            ref = node.search("s", _fs_body(f"({expr}) if true else 0.0"))
+        assert not rec.chose("compiled")
+        declines = [e for e in rec.entries
+                    if e["component"] == "script"
+                    and e["reason"] != "chosen"]
+        assert declines and declines[0]["reason"] == \
+            "script:unsupported-IfExp"
+        got = node.search("s", _fs_body(expr))
+        ref_h = [(h["_id"], h["_score"]) for h in ref["hits"]["hits"]]
+        got_h = [(h["_id"], h["_score"]) for h in got["hits"]["hits"]]
+        assert got_h == ref_h
+
+    def test_non_numeric_doc_field_declines_not_errors(self, node):
+        with record_lanes() as rec:
+            out = node.search("s", _fs_body("doc['when'].value + 0.0"))
+        assert not rec.chose("compiled")
+        reasons = {e["reason"] for e in rec.entries
+                   if e["component"] == "script"}
+        assert "script:doc-field-type" in reasons
+        assert len(out["hits"]["hits"]) == 8     # served, on the host lane
+
+    def test_profile_shows_the_script_ladder(self, node):
+        body = _fs_body("doc['n'].value + 1.0")
+        body["profile"] = True
+        out = node.search("s", body)
+        prof = out["profile"]["lanes"]
+        comp = {e["component"]: e for e in prof}
+        assert comp.get("script", {}).get("lane") == "compiled"

@@ -233,3 +233,36 @@ def test_aliases_and_content(http):
     searches = sum(v for _, v
                    in fams["es_index_search_total"]["samples"])
     assert searches >= 2
+
+
+def test_reverse_search_families_exposed(http):
+    """ISSUE 18: the percolate dispatch ladder, the script-compile
+    counter and the registry cache tier all join the scrape with the
+    right types — and the script family is pre-seeded so the family is
+    never declared-but-empty before the first compile."""
+    node, req = http
+    req("PUT", "/expo/.percolator/pq1",
+        {"query": {"match": {"body": "quick"}}})
+    req("POST", "/expo/_doc/_percolate", {"doc": {"body": "quick fox"}})
+    req("POST", "/expo/_search", {"query": {"function_score": {
+        "query": {"match": {"body": "fox"}},
+        "script_score": {"script": "_score * 2.0"},
+        "boost_mode": "replace"}}})
+    families = scrape(req)
+    for fam, mtype in (("es_search_percolate_dispatches_total", "counter"),
+                       ("es_percolate_docs_total", "counter"),
+                       ("es_percolate_matrix_cells_total", "counter"),
+                       ("es_percolate_residual_queries_total", "counter"),
+                       ("es_script_compiles_total", "counter")):
+        assert fam in families, fam
+        assert families[fam]["type"] == mtype, fam
+    lanes = {lb["lane"]: v for lb, v in
+             families["es_search_percolate_dispatches_total"]["samples"]}
+    assert set(lanes) == {"dense", "loop", "mesh"}
+    assert lanes["dense"] >= 1
+    targets = {lb["target"] for lb, _ in
+               families["es_script_compiles_total"]["samples"]}
+    assert "function_score" in targets
+    cache_labels = {lb["cache"] for lb, _
+                    in families["es_cache_hits_total"]["samples"]}
+    assert "percolator_registry" in cache_labels

@@ -578,3 +578,74 @@ def test_mesh_sorted_refresh_cycles_one_fetch_zero_retraces(sorted_nodes):
         "sorted refresh→query cycle retraced the mesh program"
     assert transfer_snapshot()["device_fetches_total"] - f0 == 1, \
         "the sorted mesh lane must serve all 4 shards in one fetch"
+
+
+# -- reverse search + script compiler (ISSUE 18) ----------------------------
+
+
+@pytest.fixture(scope="module")
+def perc_node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("percnr")))
+    n.create_index("p", settings={"number_of_shards": 1},
+                   mappings={"_doc": {"properties": {
+                       "body": {"type": "string"},
+                       "n": {"type": "long"}}}})
+    yield n
+    n.close()
+
+
+def test_register_percolate_cycles_within_bucket_zero_retraces(perc_node):
+    """register→percolate cycles whose query count stays inside one pow2
+    bucket (9..16 -> NQ_pad = 16) compile ZERO new programs and fetch the
+    whole doc batch in ONE device transfer per percolate."""
+    from elasticsearch_tpu.common.metrics import (device_events_snapshot,
+                                                  transfer_snapshot)
+    from elasticsearch_tpu.search.percolate_exec import percolate_batch
+    n = perc_node
+    for i in range(9):                       # 9 queries -> NQ_pad = 16
+        n.index_doc("p", f"q{i}", {"query": {"match": {"body": f"w{i}"}}},
+                    type_name=".percolator")
+    n.refresh("p")
+    svc = n.indices["p"]
+    docs = [({"body": f"w{i} w{i + 1} filler"}, "_doc") for i in range(4)]
+    percolate_batch(svc, "p", docs, caches=n.caches)   # warm: compiles
+    before = device_events_snapshot()[0]
+    f0 = transfer_snapshot()["device_fetches_total"]
+    batches = 0
+    for i in range(9, 16):                   # same NQ_pad bucket
+        n.index_doc("p", f"q{i}", {"query": {"match": {"body": f"w{i}"}}},
+                    type_name=".percolator")
+        got = percolate_batch(svc, "p", docs, caches=n.caches)
+        assert got[0]["total"] >= 1          # the matrix is really live
+        batches += 1
+    assert device_events_snapshot()[0] == before, \
+        "register→percolate cycle inside the pow2 bucket retraced"
+    assert transfer_snapshot()["device_fetches_total"] - f0 == batches, \
+        "each percolate batch must cost exactly ONE device fetch"
+
+
+def test_script_templates_with_different_params_compile_once(stacked_node):
+    """Params bind as TRACED f64 scalars: re-running a script_score
+    template with different param values reuses the compiled program."""
+    from elasticsearch_tpu.common.metrics import device_events_snapshot
+    n = stacked_node
+    if not n.indices["s"].shards[0].segments:
+        n._add_segment()
+
+    def body(w):
+        return {"size": 5, "query": {"function_score": {
+            "query": {"match": {"body": "quick"}},
+            "script_score": {"script": "doc['n'].value * params.w",
+                             "params": {"w": w}},
+            "boost_mode": "replace"}}}
+
+    first = n.search("s", body(2.0))         # warm: compiles expected
+    before = device_events_snapshot()[0]
+    outs = [n.search("s", body(w)) for w in (3.0, 0.5, 7.25)]
+    assert device_events_snapshot()[0] == before, \
+        "a param-value change retraced the compiled script program"
+    # and the program really re-ran with the new bindings
+    top = lambda o: o["hits"]["hits"][0]["_score"]
+    assert top(outs[0]) != top(first)
+    assert {round(top(o) / top(outs[0]), 6) for o in outs} == \
+        {1.0, round(0.5 / 3.0, 6), round(7.25 / 3.0, 6)}

@@ -1,0 +1,300 @@
+"""Dense reverse search (ISSUE 18): the doc×query matrix executor
+(search/percolate_exec.py) must stay bitwise-identical to the per-doc
+loop across the query-shape matrix, fetch each doc batch in ONE device
+transfer, ride the generation-keyed registry cache tier, and never serve
+a stale registry after a delete-then-register (the `_registry_key`
+regression)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.common.metrics import transfer_snapshot
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search import percolator as perc
+from elasticsearch_tpu.search.percolate_exec import (
+    percolate_batch, percolate_stats_snapshot)
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "n": {"type": "long"},
+    "price": {"type": "double"},
+    "flag": {"type": "boolean"},
+}}}
+
+# the query-shape matrix: every channel family of the slot grid (text
+# counts with or/and/msm discipline, term identity, int + float ranges,
+# host-bool exists, const, bool role combinations) PLUS residual shapes
+# the grid declines (multi-term expansion, unmapped field) so the
+# dense ∪ residual merge is always part of the parity claim
+SHAPES = [
+    {"match": {"body": "fox"}},
+    {"match": {"body": "quick fox"}},
+    {"match": {"body": {"query": "quick fox", "operator": "and"}}},
+    {"match": {"body": {"query": "quick brown fox",
+                        "minimum_should_match": 2}}},
+    {"match": {"body": "fox fox"}},           # duplicate-term counting
+    {"term": {"tag": "alert"}},
+    {"terms": {"tag": ["alert", "page"]}},
+    {"range": {"n": {"gte": 10, "lt": 20}}},
+    {"range": {"n": {"gt": 5}}},
+    {"range": {"price": {"gte": 9.5, "lte": 20.5}}},
+    {"term": {"n": 13}},
+    {"exists": {"field": "price"}},
+    {"match_all": {}},
+    {"bool": {"must": [{"match": {"body": "fox"}}],
+              "must_not": [{"term": {"tag": "mute"}}]}},
+    {"bool": {"should": [{"match": {"body": "fox"}},
+                         {"range": {"n": {"gte": 100}}},
+                         {"term": {"tag": "alert"}}],
+              "minimum_should_match": 2}},
+    {"bool": {"must": [{"range": {"n": {"lt": 50}}}],
+              "filter": [{"exists": {"field": "n"}}],
+              "should": [{"match": {"body": "brown"}}]}},
+    {"constant_score": {"filter": {"term": {"tag": "page"}}}},
+    {"wildcard": {"body": "fo*"}},                      # residual
+    {"range": {"unmapped_f": {"gte": 1}}},              # residual
+]
+
+DOCS = [
+    {"body": "quick brown fox", "tag": "alert", "n": 13, "price": 10.0,
+     "flag": True},
+    {"body": "lazy dog sleeps", "tag": "mute", "n": 150, "price": 19.99},
+    {"body": "fox fox fox", "tag": "page", "n": 7},       # no price
+    {"body": "quick quick", "n": 19, "price": 9.5},       # no tag
+    {"tag": "alert", "flag": False},                      # no text at all
+]
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = NodeService(data_path=str(tmp_path))
+    yield n
+    n.close()
+
+
+def _register(node, name, shapes, refresh=True):
+    node.create_index(name, mappings=MAPPING)
+    for i, q in enumerate(shapes):
+        node.index_doc(name, f"q{i}", {"query": q},
+                       type_name=".percolator")
+    if refresh:
+        node.refresh(name)
+    return node.indices[name]
+
+
+class TestDenseLoopParity:
+    def test_shape_matrix_bitwise_parity(self, node):
+        svc = _register(node, "px", SHAPES)
+        got = percolate_batch(svc, "px", [(d, "_doc") for d in DOCS],
+                              caches=node.caches)
+        for d, g in zip(DOCS, got):
+            ref = perc.percolate(svc, "px", d)
+            assert g == ref, f"doc {d} diverged from the loop"
+        # the matrix is not vacuous: every doc matched something and the
+        # match sets differ across docs
+        assert all(g["total"] > 0 for g in got)
+        assert len({tuple(m["_id"] for m in g["matches"])
+                    for g in got}) > 1
+
+    def test_unrefreshed_buffered_registrations_visible(self, node):
+        svc = _register(node, "rt", [{"match": {"body": "alpha"}}],
+                        refresh=False)
+        out = percolate_batch(svc, "rt", [({"body": "alpha beta"}, "_doc")],
+                              caches=node.caches)
+        assert out[0]["total"] == 1
+        # a SECOND buffered registration after a dense dispatch must turn
+        # over the generation-keyed corpus too
+        node.index_doc("rt", "q9", {"query": {"match": {"body": "beta"}}},
+                       type_name=".percolator")
+        out = percolate_batch(svc, "rt", [({"body": "alpha beta"}, "_doc")],
+                              caches=node.caches)
+        assert {m["_id"] for m in out[0]["matches"]} == {"q0", "q9"}
+
+    def test_tombstoned_registration_stops_matching(self, node):
+        svc = _register(node, "tomb", [{"match": {"body": "alpha"}},
+                                       {"match": {"body": "beta"}}])
+        node.delete_doc("tomb", "q0")
+        out = percolate_batch(svc, "tomb",
+                              [({"body": "alpha beta"}, "_doc")],
+                              caches=node.caches)
+        assert [m["_id"] for m in out[0]["matches"]] == ["q1"]
+
+    def test_stats_counters_move(self, node):
+        svc = _register(node, "st", [{"match": {"body": "fox"}},
+                                     {"wildcard": {"body": "fo*"}}])
+        s0 = percolate_stats_snapshot()
+        percolate_batch(svc, "st", [(d, "_doc") for d in DOCS[:3]],
+                        caches=node.caches)
+        s1 = percolate_stats_snapshot()
+        assert s1["dense"] == s0["dense"] + 1
+        assert s1["docs"] == s0["docs"] + 3
+        assert s1["matrix_cells"] > s0["matrix_cells"]
+        # the wildcard rode the loop for every doc of the batch
+        assert s1["residual_queries"] == s0["residual_queries"] + 3
+
+
+class TestRegistryGeneration:
+    def test_delete_then_register_never_serves_stale(self, node):
+        """The ISSUE 18 `_registry_key` regression: a delete followed by a
+        registration restores the registry's SIZE, which the old
+        segment-count key could not distinguish — the generation key
+        must."""
+        _register(node, "rg", [{"match": {"body": "alpha"}}])
+        assert node.percolate("rg", {"doc": {"body": "alpha"}})["total"] == 1
+        node.delete_doc("rg", "q0")
+        node.index_doc("rg", "q1", {"query": {"match": {"body": "beta"}}},
+                       type_name=".percolator")
+        node.refresh("rg")
+        out = node.percolate("rg", {"doc": {"body": "alpha"}})
+        assert out["total"] == 0, "stale registry served after delete"
+        out = node.percolate("rg", {"doc": {"body": "beta"}})
+        assert [m["_id"] for m in out["matches"]] == ["q1"]
+
+    def test_generation_bumps_on_every_percolator_mutation(self, node):
+        _register(node, "gen", [{"match": {"body": "a"}}])
+        svc = node.indices["gen"]
+        k0 = perc._registry_key(svc)
+        node.index_doc("gen", "q7", {"query": {"match": {"body": "b"}}},
+                       type_name=".percolator")
+        k1 = perc._registry_key(svc)
+        assert k1 != k0
+        node.delete_doc("gen", "q7")
+        k2 = perc._registry_key(svc)
+        assert k2 not in (k0, k1)
+
+
+class TestDeviceEconomy:
+    def test_one_device_fetch_per_batch(self, node):
+        # dense-only shapes: residuals would ride the loop and pay their
+        # own fetches, which is not this claim
+        svc = _register(node, "fetch", SHAPES[:17])
+        pairs = [(d, "_doc") for d in DOCS]
+        percolate_batch(svc, "fetch", pairs, caches=node.caches)  # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        for _ in range(3):
+            percolate_batch(svc, "fetch", pairs, caches=node.caches)
+        assert transfer_snapshot()["device_fetches_total"] - f0 == 3, \
+            "a percolate batch must cost exactly ONE device fetch"
+
+
+class TestRegistryCacheTier:
+    def test_generation_keyed_hits_and_turnover(self, node):
+        svc = _register(node, "ct", SHAPES[:6])
+        tier = node.caches.percolator_registry
+        s0 = tier.stats()
+        percolate_batch(svc, "ct", [(DOCS[0], "_doc")], caches=node.caches)
+        percolate_batch(svc, "ct", [(DOCS[1], "_doc")], caches=node.caches)
+        s1 = tier.stats()
+        assert s1["misses_total"] == s0["misses_total"] + 1
+        assert s1["hits_total"] >= s0["hits_total"] + 1
+        assert s1["entries"] >= 1 and s1["memory_size_in_bytes"] > 0
+        # a registration bumps the generation: rebuild, stale entry dies
+        node.index_doc("ct", "q99",
+                       {"query": {"match": {"body": "new"}}},
+                       type_name=".percolator")
+        percolate_batch(svc, "ct", [(DOCS[0], "_doc")], caches=node.caches)
+        s2 = tier.stats()
+        assert s2["misses_total"] == s1["misses_total"] + 1
+        assert s2["entries"] == s1["entries"], \
+            "stale predecessor generation must be invalidated on put"
+        assert "declined" in s2
+
+    def test_joins_cache_service_stats_and_clear(self, node):
+        svc = _register(node, "cs", SHAPES[:3])
+        percolate_batch(svc, "cs", [(DOCS[0], "_doc")], caches=node.caches)
+        assert "percolator_registry" in node.caches.stats()
+        cleared = node.caches.clear(query=True)
+        assert cleared.get("percolator_registry", 0) >= 1
+
+
+class TestLaneLadder:
+    def test_profile_lanes_show_the_percolate_ladder(self, node):
+        _register(node, "pl", [{"match": {"body": "fox"}},
+                               {"wildcard": {"body": "fo*"}}])
+        with record_lanes() as rec:
+            out = node.percolate(
+                "pl", {"doc": {"body": "quick fox"}, "profile": True})
+        assert out["total"] == 2
+        lanes = {e["component"]: e for e in out["profile"]["lanes"]}
+        assert lanes["percolate"]["lane"] in ("dense", "mesh")
+        declined = {(d["lane"], d["reason"])
+                    for d in lanes["percolate"]["declines"]}
+        assert ("dense", "node:MultiTermExpandNode") in declined
+        assert rec.chose("dense") or rec.chose("mesh")
+
+    def test_empty_registry_is_cheap_and_clean(self, node):
+        node.create_index("none", mappings=MAPPING)
+        svc = node.indices["none"]
+        with record_lanes() as rec:
+            out = percolate_batch(svc, "none", [(DOCS[0], "_doc")],
+                                  caches=node.caches)
+        assert out == [{"total": 0, "matches": []}]
+        assert rec.entries == []        # no ladder walked, nothing built
+
+
+class TestBatchApis:
+    def test_node_mpercolate_one_matrix_many_docs(self, node):
+        _register(node, "mp", SHAPES[:6])
+        out = node.mpercolate("mp", [{"doc": d} for d in DOCS[:3]])
+        assert len(out["responses"]) == 3
+        for d, r in zip(DOCS[:3], out["responses"]):
+            ref = node.percolate("mp", {"doc": d})
+            assert r["total"] == ref["total"]
+            assert r["matches"] == ref["matches"]
+            assert "_shards" in r and "took" in r
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from elasticsearch_tpu.rest import HttpServer
+    node = NodeService(str(tmp_path_factory.mktemp("percrest")))
+    srv = HttpServer(node, port=0).start()
+    yield srv
+    srv.stop()
+    node.close()
+
+
+def _req(server, method, path, data=None):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=data.encode() if isinstance(data, str) else data,
+        method=method)
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read())
+
+
+class TestReverseSearchRest:
+    def test_mpercolate_ndjson_batches_through_one_matrix(self, server):
+        _req(server, "PUT", "/ndx", json.dumps({"mappings": MAPPING}))
+        for i, q in enumerate(SHAPES[:6]):
+            _req(server, "PUT", f"/ndx/.percolator/q{i}",
+                 json.dumps({"query": q}))
+        _req(server, "POST", "/ndx/_refresh")
+        lines = []
+        for d in DOCS[:3]:
+            lines.append(json.dumps({"percolate": {"index": "ndx",
+                                                   "type": "_doc"}}))
+            lines.append(json.dumps({"doc": d}))
+        out = _req(server, "POST", "/_mpercolate",
+                   "\n".join(lines) + "\n")
+        assert len(out["responses"]) == 3
+        for d, r in zip(DOCS[:3], out["responses"]):
+            ref = _req(server, "POST", "/ndx/_doc/_percolate",
+                       json.dumps({"doc": d}))
+            assert r["total"] == ref["total"]
+            assert r["matches"] == ref["matches"]
+
+    def test_percolate_on_ingest_param(self, server):
+        _req(server, "PUT", "/ing", json.dumps({"mappings": MAPPING}))
+        _req(server, "PUT", "/ing/.percolator/alert",
+             json.dumps({"query": {"match": {"body": "fire"}}}))
+        out = _req(server, "PUT", "/ing/_doc/1?percolate=*",
+                   json.dumps({"body": "fire in the hall"}))
+        assert [m["_id"] for m in out["matches"]] == ["alert"]
+        out = _req(server, "PUT", "/ing/_doc/2?percolate=*",
+                   json.dumps({"body": "all quiet"}))
+        assert out["matches"] == []

@@ -32,7 +32,10 @@ def test_route_count_floor_and_uniqueness(controller):
     # (252 registered at ISSUE-5 time: tracing added /_traces,
     # /_traces/{trace_id} and /_nodes/slowlog)
     # re-anchored at ISSUE 17: /_monitoring/overview joined the table
-    assert len(controller.routes) >= 253, len(controller.routes)
+    # re-anchored at ISSUE 18: 254 registered — the percolate/mpercolate
+    # routes pre-existed (now served by the dense doc×query executor),
+    # so the reverse-search PR adds handlers, not patterns
+    assert len(controller.routes) >= 254, len(controller.routes)
     seen = set()
     for method, rx, _h, _s in controller.routes:
         key = (method, rx.pattern)
@@ -48,6 +51,17 @@ def test_new_observability_routes_resolve(controller):
                  "/_cat/fielddata",
                  "/_traces", "/_traces/abcdef0123456789",
                  "/_nodes/slowlog", "/_monitoring/overview"):
+        assert _resolves(controller, path), path
+
+
+def test_reverse_search_routes_resolve(controller):
+    # ISSUE 18: the reverse-search surface — single-doc, existing-doc,
+    # count variants and the multi-percolate batch endpoint
+    for path in ("/idx/_doc/_percolate", "/idx/_doc/42/_percolate",
+                 "/idx/_doc/_percolate/count",
+                 "/idx/_doc/42/_percolate/count",
+                 "/_mpercolate", "/idx/_mpercolate",
+                 "/idx/_doc/_mpercolate"):
         assert _resolves(controller, path), path
 
 

@@ -102,7 +102,12 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # emits them
                      "percolate_qps": None, "percolate_matrix_qps": None,
                      "percolate_vs_loop": None,
-                     "script_score_qps": None, "script_vs_decline": None}
+                     "script_score_qps": None, "script_vs_decline": None,
+                     # pod-scale serving (ISSUE 19): seeded null at
+                     # import so a forced timeout still emits them
+                     "pod_qps": None, "single_pool_qps": None,
+                     "pod_vs_single": None, "dcn_hops_per_query": None,
+                     "exec_lock_waits": None}
 _LINE_PRINTED = False
 
 
@@ -760,6 +765,148 @@ def run_cluster_leg(tag: str) -> dict:
     finally:
         cluster.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _pod_leg_measure(tag: str) -> dict:
+    """Pod-scale serving (ISSUE 19): 2 simulated pools — each node OWNS
+    half the devices and is its own host — vs the single shared-pool
+    cluster on the SAME corpus and workload, both driven by TWO
+    concurrent coordinators (the regime where per-pool dispatch locks
+    beat the process-wide EXEC_LOCK). `pod_vs_single` is the acceptance
+    ratio; `exec_lock_waits` must stay 0 on the per-node path;
+    `dcn_hops_per_query` counts the pre-reduced cross-host hops."""
+    import shutil
+    import tempfile
+    import threading
+    from elasticsearch_tpu.cluster import TestCluster
+    from elasticsearch_tpu.parallel.mesh_exec import (exec_lock_stats,
+                                                      reset_exec_lock_stats)
+
+    n_docs = int(os.environ.get("BENCH_POD_DOCS", "40000"))
+    n_shards = int(os.environ.get("BENCH_POD_SHARDS", "8"))
+    reps = int(os.environ.get("BENCH_POD_REPS", "120"))
+    n_q = 32
+    docs = make_corpus(n_docs, seed=17)
+    queries = make_queries(n_q, seed=19)
+
+    def body_of(i: int) -> dict:
+        terms = queries[i % n_q].split()
+        return {"size": 10, "query": {"bool": {
+            "should": [{"match": {"body": terms[0]}},
+                       {"match": {"body": terms[1]}}]}}}
+
+    def build(pods: int):
+        tmp = tempfile.mkdtemp(prefix=f"bench-pod-{tag}-{pods}-")
+        cluster = TestCluster(2, tmp, pods=pods)
+        client = cluster.client()
+        client.create_index("pdocs", {"number_of_shards": n_shards,
+                                      "number_of_replicas": 0})
+        cluster.ensure_green()
+        ops = []
+        for i, body in enumerate(docs):
+            ops.append(("index", {"_index": "pdocs", "_id": str(i)},
+                        {"body": body}))
+            if len(ops) >= 4000:
+                client.bulk(ops)
+                ops = []
+            if _over_budget(margin=60.0):
+                break
+        if ops:
+            client.bulk(ops)
+        client.refresh("pdocs")
+        return cluster, tmp
+
+    def measure(cluster):
+        # one coordinator thread per node, dispatching simultaneously
+        nodes = [cluster.nodes[nid] for nid in sorted(cluster.nodes)]
+        for i in range(n_q):             # warm every pow2 shape bucket
+            nodes[0].search("pdocs", json.loads(json.dumps(body_of(i))))
+            if _over_budget(margin=45.0):
+                return None, 0
+        served = [0] * len(nodes)
+
+        def go(ci: int, node) -> None:
+            for i in range(reps):
+                node.search("pdocs",
+                            json.loads(json.dumps(body_of(i + ci))))
+                served[ci] += 1
+                if _over_budget(margin=30.0):
+                    break
+        threads = [threading.Thread(target=go, args=(ci, n), daemon=True)
+                   for ci, n in enumerate(nodes)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = max(time.perf_counter() - t0, 1e-9)
+        total = sum(served)
+        return (total / dt if total else None), total
+
+    out: dict = {}
+    cluster, tmp = build(2)
+    try:
+        reset_exec_lock_stats()
+        # count the PRE-REDUCED query hops (one A_QUERY_HOST per remote
+        # node), not every cross-host send — fetches/pings ride the dcn
+        # transport class too but are not the reduce's hop budget
+        d0 = sum(n.host_reduce_stats["dcn_hops"]
+                 for n in cluster.nodes.values())
+        out["pod_qps"], total = measure(cluster)
+        if total:
+            hops = sum(n.host_reduce_stats["dcn_hops"]
+                       for n in cluster.nodes.values()) - d0
+            out["dcn_hops_per_query"] = round(hops / total, 3)
+        st = exec_lock_stats()
+        out["exec_lock_waits"] = st["shared_waits"] \
+            + st["shared_acquisitions"]
+        out["pod_reduce_dispatches"] = sum(
+            n.host_reduce_stats["pod_dispatches"]
+            for n in cluster.nodes.values())
+    finally:
+        cluster.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    if _over_budget(margin=60.0):
+        return {k: v for k, v in out.items() if v is not None}
+    cluster, tmp = build(0)
+    try:
+        out["single_pool_qps"], _ = measure(cluster)
+    finally:
+        cluster.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    if out.get("pod_qps") and out.get("single_pool_qps"):
+        out["pod_vs_single"] = out["pod_qps"] / out["single_pool_qps"]
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def run_pod_leg(tag: str) -> dict:
+    """Two owned pools need >= 4 devices; on smaller hosts (CPU dev
+    runs) re-exec in a child with 8 virtual host devices — the same
+    mechanism the test conftest uses — and adopt its one-line JSON."""
+    import jax
+    if len(jax.devices()) >= 4:
+        return _pod_leg_measure(tag)
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env["BENCH_POD_CHILD"] = "1"
+    env["BENCH_TIME_BUDGET"] = str(max(30.0, _remaining() - 30.0))
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True,
+            timeout=max(30.0, _remaining() - 15.0))
+        for ln in child.stdout.splitlines():
+            if ln.startswith("{"):
+                return json.loads(ln)
+        print(f"pod child produced no result (rc={child.returncode}): "
+              f"{child.stderr[-500:]}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the pod leg is best-effort
+        print(f"pod child failed: {e}", file=sys.stderr)
+    return {}
 
 
 def run_vector_leg(tag: str) -> dict:
@@ -1578,6 +1725,10 @@ def _run_all_legs(tag: str) -> dict:
             # so the ratio is measured once, in the main process
             ("BENCH_CLUSTER", "1" if tag == "main" else "0",
              run_cluster_leg),
+            # pod-scale serving (ISSUE 19): a concurrency ratio between
+            # two clusters in the same process — measured once, in the
+            # main process
+            ("BENCH_POD", "1" if tag == "main" else "0", run_pod_leg),
             # chaos parity oracle (ISSUE 14): correctness counts, not a
             # perf ratio — measured once, in the main process
             ("BENCH_CHAOS", "1" if tag == "main" else "0",
@@ -1758,6 +1909,17 @@ def main_engine():
             "cluster_shards": res.get("cluster_shards"),
             "cluster_host_reduce_dispatches":
                 res.get("cluster_host_reduce_dispatches")})
+    if "pod_qps" in res:
+        # pod-scale serving (ISSUE 19): concurrent per-pool collectives
+        # vs the shared-pool EXEC_LOCK serialization, with the DCN hop
+        # count and the shared-lock contention evidence
+        line.update({
+            "pod_qps": r2(res.get("pod_qps")),
+            "single_pool_qps": r2(res.get("single_pool_qps")),
+            "pod_vs_single": rnd(res.get("pod_vs_single")),
+            "dcn_hops_per_query": rnd(res.get("dcn_hops_per_query")),
+            "exec_lock_waits": res.get("exec_lock_waits"),
+            "pod_reduce_dispatches": res.get("pod_reduce_dispatches")})
     if "chaos_rounds" in res:
         # chaos harness (ISSUE 14): zero mismatches / zero violations is
         # the acceptance signal; the seed makes any non-zero reproducible
@@ -1951,6 +2113,10 @@ def main_kernel():
 if __name__ == "__main__":
     if "--kernel" in sys.argv:
         main_kernel()
+    elif os.environ.get("BENCH_POD_CHILD"):
+        # pod-leg child (ISSUE 19): 8 virtual host devices forced via
+        # XLA_FLAGS by run_pod_leg; print the leg's one-line JSON
+        print(json.dumps(_pod_leg_measure("pod-child")))
     elif os.environ.get("BENCH_LEG") == "cpu":
         res = _run_all_legs("cpu")
         out = {"metric": "cpu_leg", "unit": "qps"}

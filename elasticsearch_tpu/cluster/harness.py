@@ -19,7 +19,7 @@ class TestCluster:
 
     def __init__(self, n_nodes: int, data_path: str,
                  minimum_master_nodes: int | None = None,
-                 transport: str = "local"):
+                 transport: str = "local", pods: int = 0):
         if minimum_master_nodes is None:
             minimum_master_nodes = n_nodes // 2 + 1
         if transport == "tcp":
@@ -31,6 +31,8 @@ class TestCluster:
             self.network = LocalTransport()
         self.data_path = data_path
         self.minimum_master_nodes = minimum_master_nodes
+        self.pods = max(0, min(int(pods), n_nodes))
+        self._pod_split = n_nodes       # fixed denominator: disjoint slices
         self.nodes: dict[str, ClusterNode] = {}
         self._seq = 0
         for _ in range(n_nodes):
@@ -42,12 +44,25 @@ class TestCluster:
         for nid in ids[1:]:
             self.nodes[nid].join(ids[0])
 
+    def _pod_settings(self, seq: int) -> dict | None:
+        """Pod-mode node settings (ISSUE 19): every node OWNS a disjoint
+        slice of the process's devices (`node.devices: auto:i/n` — the
+        per-node-pool data plane, EXEC_LOCK-free), and nodes are spread
+        over `pods` simulated hosts so inter-pod transport rides the
+        "dcn" traffic class while intra-pod stays co-hosted."""
+        if not self.pods:
+            return None
+        i = seq - 1
+        n = max(self._pod_split, i + 1)
+        return {"node.devices": f"auto:{i}/{n}",
+                "node.host": f"pod{i * self.pods // n}"}
+
     def add_node(self, attrs: dict | None = None) -> ClusterNode:
         self._seq += 1
         node_id = f"node-{self._seq}"
         node = ClusterNode(node_id, self.data_path, self.network,
                            minimum_master_nodes=self.minimum_master_nodes,
-                           attrs=attrs)
+                           attrs=attrs, settings=self._pod_settings(self._seq))
         self.nodes[node_id] = node
         master = self.master_node()
         if master is not None and master.node_id != node_id:
@@ -115,7 +130,8 @@ class TestCluster:
                     holder.engine = None
         node = ClusterNode(node_id, self.data_path, self.network,
                            minimum_master_nodes=self.minimum_master_nodes,
-                           attrs=old.attrs)
+                           attrs=old.attrs,
+                           settings=getattr(old, "settings", None))
         self.nodes[node_id] = node
         master = self.master_node()
         if master is not None and master.node_id != node_id:

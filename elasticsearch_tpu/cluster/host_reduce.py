@@ -85,7 +85,8 @@ def try_host_reduce(node, index: str, sids: list[int], body: dict,
         if holder is None or holder.engine is None:
             return _declined("shard_unavailable")
         searchers.append(node._searcher(index, sid, holder))
-    if mesh_exec.mesh_for(len(searchers)) is None:
+    if mesh_exec.mesh_for(len(searchers),
+                          pool=getattr(node, "device_pool", None)) is None:
         return _declined("no_mesh")
 
     knn = body.get("knn")
@@ -179,7 +180,8 @@ def _query_host_reduce(node, index, sids, searchers, body, agg_specs,
     from ..search.blockwise import DEFAULT_BLOCK_DOCS
     stack = node._host_mesh_stacks.get_or_build(
         _mesh_group_name(index, sids), 0,
-        [list(s.segments) for s in searchers])
+        [list(s.segments) for s in searchers],
+        pool=getattr(node, "device_pool", None))
     if stack is None:
         return None, "stack"
     if sort_specs is not None:
@@ -223,7 +225,8 @@ def _knn_host_reduce(node, index, sids, searchers, knn, k):
     knn_k = int(knn.get("k", k))
     vstack = node._host_vector_stacks.get_or_build(
         _mesh_group_name(index, sids), 0, field,
-        [list(s.segments) for s in searchers])
+        [list(s.segments) for s in searchers],
+        pool=getattr(node, "device_pool", None))
     if vstack is None:
         return None, "vstack"
     fnode = None
@@ -232,7 +235,8 @@ def _knn_host_reduce(node, index, sids, searchers, knn, k):
         fnode = searchers[0].parse([knn["filter"]])
         fstack = node._host_mesh_stacks.get_or_build(
             _mesh_group_name(index, sids), 0,
-            [list(s.segments) for s in searchers])
+            [list(s.segments) for s in searchers],
+            pool=getattr(node, "device_pool", None))
         if fstack is None:
             return None, "stack"
     out = mesh_knn.execute(

@@ -118,7 +118,8 @@ class _ShardHolder:
 class ClusterNode:
     def __init__(self, node_id: str, data_path: str, network: LocalTransport,
                  minimum_master_nodes: int = 1,
-                 attrs: dict | None = None):
+                 attrs: dict | None = None,
+                 settings: dict | None = None):
         self.node_id = node_id
         self.data_path = os.path.join(data_path, node_id)
         os.makedirs(self.data_path, exist_ok=True)
@@ -127,6 +128,20 @@ class ClusterNode:
         # into the cluster state at join time for the awareness/filter
         # deciders (ref DiscoveryNode attributes)
         self.attrs = dict(attrs or {})
+        # node-local settings overlay (ISSUE 19): `node.devices` carves
+        # this node's disjoint device subset into an owned DevicePool (so
+        # host reduces dispatch under the pool's private lock, not the
+        # process-wide EXEC_LOCK), `node.host` names the simulated host
+        # for the transport's DCN traffic classification, and
+        # `cluster.mesh.coordinator` arms jax.distributed multi-host init.
+        self.settings = dict(settings or {})
+        from ..parallel.mesh import (maybe_init_distributed,
+                                     resolve_device_pool)
+        maybe_init_distributed(self.settings)
+        self.device_pool = resolve_device_pool(self.settings)
+        host = self.settings.get("node.host")
+        if host and hasattr(network, "set_host"):
+            network.set_host(node_id, str(host))
         self.transport = TransportService(node_id, network)
         self.cluster = ClusterService(node_id, self.transport,
                                       self._apply_cluster_state)
@@ -231,7 +246,10 @@ class ClusterNode:
         self._host_mesh_stacks = MeshStackCache(max_bytes=1 << 31)
         self._host_vector_stacks = MeshVectorStackCache(max_bytes=1 << 31)
         self.host_reduce_stats = {"dispatches": 0, "declined": 0,
-                                  "errors": 0, "merges": 0}
+                                  "errors": 0, "merges": 0,
+                                  # pod tier (ISSUE 19): cross-host
+                                  # pre-reduced merges + their DCN hops
+                                  "pod_dispatches": 0, "dcn_hops": 0}
 
     # ------------------------------------------------------------------
     # membership / election (ref ZenDiscovery.java:354 innerJoinCluster)
@@ -423,7 +441,14 @@ class ClusterNode:
                 "mesh_host_reduce_errors_total":
                     self.host_reduce_stats["errors"],
                 "mesh_host_reduce_merges_total":
-                    self.host_reduce_stats["merges"]}),
+                    self.host_reduce_stats["merges"],
+                # pod reduce (ISSUE 19): coordinator-side merges whose
+                # pre-reduced message crossed a host boundary (ONE DCN
+                # hop per remote node), and the raw cross-host hop count
+                "pod_reduce_dispatches_total":
+                    self.host_reduce_stats["pod_dispatches"],
+                "pod_reduce_dcn_hops_total":
+                    self.host_reduce_stats["dcn_hops"]}),
             # hedged-read outcomes + per-class transport send queues
             # (ISSUE 9): es_search_hedged_total{outcome=},
             # es_transport_class_queue_depth{class=}
@@ -452,6 +477,17 @@ class ClusterNode:
         class_stats = getattr(self.transport.network, "class_stats", None)
         if class_stats is not None:          # TcpTransport has no classes
             sections["transport_class"] = ("class", class_stats())
+        # per-transport-class latency EWMAs (ISSUE 19): the "dcn" class
+        # gets its own deadline so cross-host hops never poison the ICI
+        # hedge deadline — es_transport_latency_ewma_ms{class=}
+        from ..serving.qos import transport_latency_snapshot
+        lat = transport_latency_snapshot()
+        if lat:
+            sections["transport_latency"] = (
+                "class", {c: {"ewma_ms": v["ewma_ms"],
+                              "deadline_ms": v["deadline_ms"],
+                              "observations_total": v["n"]}
+                          for c, v in lat.items()})
         # fault-injection accounting (ISSUE 14): both transports count the
         # faults they actually applied — es_transport_faults_injected_total
         fault_stats = getattr(self.transport.network, "fault_stats", None)
@@ -1943,6 +1979,28 @@ class ClusterNode:
             lat = self._node_lat[node] = Ewma()
         lat.observe(ms)
 
+    def _cross_host(self, node: str) -> bool:
+        """True when `node` sits on a different (known) simulated host —
+        the hop rides DCN, not ICI (transport `set_host` topology)."""
+        host_of = getattr(self.transport.network, "host_of", None)
+        if host_of is None:
+            return False
+        mine, theirs = host_of(self.node_id), host_of(node)
+        return mine is not None and theirs is not None and mine != theirs
+
+    def _observe_host_hop(self, node: str, ms: float) -> None:
+        """Latency of one A_QUERY_HOST pre-reduced hop. Cross-host hops
+        observe into the per-transport-class "dcn" EWMA — NEVER into
+        `_node_lat`, whose per-node EWMAs arm the intra-host hedge
+        deadline (a slow DCN link must not poison the ICI deadline).
+        Co-hosted hops observe "reg"."""
+        from ..serving.qos import observe_transport_latency
+        if self._cross_host(node):
+            self.host_reduce_stats["dcn_hops"] += 1
+            observe_transport_latency("dcn", ms)
+        else:
+            observe_transport_latency("reg", ms)
+
     def _query_with_hedge(self, state, name: str, sid: int, node: str,
                           payload: dict):
         """A_QUERY with an adaptive hedge (SURVEY §2.10.2's load-balanced
@@ -2202,8 +2260,11 @@ class ClusterNode:
                     try:
                         with tracing.span("mesh_host_reduce", index=name,
                                           node=node, shards=len(sids)):
+                            t1 = time.perf_counter()
                             results[(node, name)] = self._shard_call(
                                 node, A_QUERY_HOST, payload)
+                            self._observe_host_hop(
+                                node, (time.perf_counter() - t1) * 1000.0)
                     except (ConnectTransportException,
                             RemoteTransportException):
                         results[(node, name)] = None
@@ -2233,6 +2294,12 @@ class ClusterNode:
                         if r.get("declined") is not None:
                             continue     # the data node counted its reason
                         self.host_reduce_stats["merges"] += 1
+                        if self._cross_host(node):
+                            # pod tier: a pre-reduced result crossed the
+                            # host boundary — ONE DCN hop carried the
+                            # whole host's shards, and the merge below
+                            # is the same bitwise host merge
+                            self.host_reduce_stats["pod_dispatches"] += 1
                         for ti in tis:
                             per_shard.append((ti, r["shards"][str(
                                 targets[ti][2])]))

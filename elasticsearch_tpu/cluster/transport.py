@@ -65,7 +65,17 @@ class RemoteTransportException(TransportException):
 # counter rather than deadlocking the cluster.
 
 TRAFFIC_CLASS_CONNECTIONS = {"recovery": 2, "bulk": 3, "reg": 6,
-                             "state": 1, "ping": 1}
+                             "state": 1, "ping": 1,
+                             # sixth class (ISSUE 19): latency-sensitive
+                             # traffic CROSSING a host boundary — the
+                             # pod data plane's one pre-reduced DCN hop
+                             # per host per query. Its own budget +
+                             # queue keep slow DCN links from eating the
+                             # intra-host "reg" connections, and the QoS
+                             # EWMA tier keys off the class so DCN
+                             # latency never poisons the ICI hedge
+                             # deadline.
+                             "dcn": 4}
 
 #: fail-open ceiling for a class-connection wait; a timeout means the
 #: class was saturated for this long — counted, never fatal
@@ -86,6 +96,8 @@ def class_of_action(action: str) -> str:
                           "indices:admin")):
         return "state"
     return "reg"   # search/get/stats — the latency-sensitive default
+                   # ("dcn" when the hop crosses hosts — LocalTransport
+                   # upgrades per (sender, target) host identity)
 
 
 _BYTES_TAG = "__b64__"
@@ -163,6 +175,11 @@ class LocalTransport:
         self._class_sems: dict[tuple[str, str, str],
                                threading.Semaphore] = {}
         self._held = threading.local()   # same-thread re-entrancy
+        # simulated host identity (ISSUE 19): node_id -> host name. Two
+        # nodes on DIFFERENT hosts exchange latency-sensitive traffic on
+        # the "dcn" class instead of "reg" (ICI within a host, DCN
+        # between — SURVEY §5.8). Unregistered nodes count as co-hosted.
+        self._hosts: dict[str, str] = {}
         self._class_stats: dict[str, dict] = {
             c: {"sent_total": 0, "bytes_sent_total": 0, "queue_depth": 0,
                 "max_queue_depth": 0, "queue_timeouts_total": 0,
@@ -172,6 +189,30 @@ class LocalTransport:
     def register(self, service: "TransportService") -> None:
         with self._lock:
             self._nodes[service.node_id] = service
+
+    def set_host(self, node_id: str, host: str) -> None:
+        """Pin a node to a simulated host (the pods harness's topology
+        declaration); cross-host "reg" traffic upgrades to "dcn"."""
+        with self._lock:
+            self._hosts[node_id] = str(host)
+
+    def host_of(self, node_id: str) -> str | None:
+        with self._lock:
+            return self._hosts.get(node_id)
+
+    def _class_for(self, from_id: str, to_id: str, action: str) -> str:
+        """Traffic class of this delivery: class_of_action, with "reg"
+        upgraded to "dcn" when sender and target sit on different
+        (known) hosts."""
+        tc = class_of_action(action)
+        if tc != "reg":
+            return tc
+        with self._lock:
+            fh = self._hosts.get(from_id)
+            th = self._hosts.get(to_id)
+        if fh is not None and th is not None and fh != th:
+            return "dcn"
+        return tc
 
     def unregister(self, node_id: str) -> None:
         with self._lock:
@@ -323,7 +364,8 @@ class LocalTransport:
         if blocked or target is None:
             raise ConnectTransportException(to_id, action)
         release = self._acquire_class(from_id, to_id,
-                                      class_of_action(action))
+                                      self._class_for(from_id, to_id,
+                                                      action))
         try:
             delay = self._delay_of(to_id, action)
             if delay > 0:
@@ -344,7 +386,7 @@ class LocalTransport:
             raise ConnectTransportException(to_id, action)
         # per-class byte accounting: the recovery class's counter is how
         # the bench/tests verify throttle compliance on the wire itself
-        cls_st = self._class_stats[class_of_action(action)]
+        cls_st = self._class_stats[self._class_for(from_id, to_id, action)]
         wire = json.dumps(_encode(payload))
         with self._lock:
             self.messages_sent += 1

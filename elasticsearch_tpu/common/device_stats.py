@@ -48,9 +48,12 @@ _MAX_PROGRAMS = 512
 
 class ProgramRecord:
     """One compiled program's lifetime accounting. `device_ms` is wall
-    time around dispatch — exact device time on synchronous backends
-    (CPU), enqueue-inclusive on async ones; with mesh execution
-    serialized under EXEC_LOCK the attribution stays honest either way."""
+    time around dispatch with `block_until_ready` on the program's OWN
+    outputs — so on async backends a call is charged for its own device
+    work, not for whatever an unrelated concurrent program (another
+    node's pool, since ISSUE 19) left in the queue. Program cache keys
+    carry the owning node's device set (`_mesh_devkey`), so records from
+    different pools never alias."""
 
     __slots__ = ("name", "key", "invocations", "device_ms", "compile_ms",
                  "compiles", "last_invoked", "_fn", "_avals", "_cost",
@@ -152,6 +155,14 @@ class InstrumentedProgram:
         c0, cms0 = device_events_snapshot()
         t0 = time.perf_counter()
         out = self.jit(*args, **kwargs)
+        try:
+            # charge THIS program for its own device work: without the
+            # barrier an async backend bills the next caller's wall
+            # clock for whatever this dispatch left enqueued
+            import jax
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — non-array outputs stay timed
+            pass
         dt = (time.perf_counter() - t0) * 1000.0
         c1, cms1 = device_events_snapshot()
         rec = self.record

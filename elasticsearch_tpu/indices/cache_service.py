@@ -635,13 +635,14 @@ class MeshStackCache:
             entry.breaker.release(entry.nbytes)
 
     def get_or_build(self, index_name, incarnation, per_shard_segments,
-                     breaker=None):
+                     breaker=None, pool=None):
         """The index's MeshStack, building (and breaker-charging) on first
         use. None when declined — no live docs, no mesh topology on this
-        host (fewer devices than shards), oversized, or breaker pressure
-        even after shedding other stacks."""
+        pool (fewer devices than shards), oversized, or breaker pressure
+        even after shedding other stacks. `pool` is the owning node's
+        DevicePool (None = legacy shared pool)."""
         from ..parallel import mesh_exec
-        info = mesh_exec.mesh_for(len(per_shard_segments))
+        info = mesh_exec.mesh_for(len(per_shard_segments), pool=pool)
         if info is None:
             return None
         mesh, s_pad, n_replicas = info
@@ -650,7 +651,8 @@ class MeshStackCache:
             for si, segs in enumerate(per_shard_segments))
         if not any(ids for _si, ids in entries):
             return None
-        key = (index_name, incarnation, entries)
+        key = (index_name, incarnation, entries,
+               pool.devkey if pool is not None else None)
         with tracing.span("cache.get", tier="mesh_stack") as sp:
             ent = self.cache.get(key)
             if sp is not None:
@@ -669,7 +671,7 @@ class MeshStackCache:
                 return None
         try:
             stack = mesh_exec.build_mesh_stack(per_shard_segments, mesh,
-                                               s_pad, n_replicas)
+                                               s_pad, n_replicas, pool=pool)
         except BaseException:
             if breaker is not None:
                 breaker.release(est)
@@ -740,11 +742,12 @@ class MeshVectorStackCache:
             entry.breaker.release(entry.nbytes)
 
     def get_or_build(self, index_name, incarnation, field,
-                     per_shard_segments, breaker=None):
+                     per_shard_segments, breaker=None, pool=None):
         """The index's MeshVectorStack for `field`, building (and
-        breaker-charging) on first use. None when declined."""
+        breaker-charging) on first use. None when declined. `pool` is the
+        owning node's DevicePool (None = legacy shared pool)."""
         from ..parallel import mesh_exec, mesh_knn
-        info = mesh_exec.mesh_for(len(per_shard_segments))
+        info = mesh_exec.mesh_for(len(per_shard_segments), pool=pool)
         if info is None:
             return None
         mesh, s_pad, n_replicas = info
@@ -753,7 +756,8 @@ class MeshVectorStackCache:
             for si, segs in enumerate(per_shard_segments))
         if not any(ids for _si, ids in entries):
             return None
-        key = (index_name, field, incarnation, entries)
+        key = (index_name, field, incarnation, entries,
+               pool.devkey if pool is not None else None)
         with tracing.span("cache.get", tier="mesh_vector_stack") as sp:
             ent = self.cache.get(key)
             if sp is not None:
@@ -775,7 +779,8 @@ class MeshVectorStackCache:
                 return None
         try:
             stack = mesh_knn.build_vector_stack(
-                per_shard_segments, field, mesh, s_pad, n_replicas)
+                per_shard_segments, field, mesh, s_pad, n_replicas,
+                pool=pool)
         except BaseException:
             if breaker is not None:
                 breaker.release(est)

@@ -126,6 +126,14 @@ class NodeService:
                 int(self.settings.get("node.gc.threshold2", 25)))
         from .common.breaker import CircuitBreakerService
         self.breakers = CircuitBreakerService(self.settings)
+        # device ownership (ISSUE 19): `node.devices` carves this node's
+        # disjoint device subset out of jax.devices() (DevicePool with a
+        # private dispatch lock → EXEC_LOCK off the per-node hot path);
+        # `cluster.mesh.coordinator` arms jax.distributed multi-host
+        # init. Both default off → the legacy shared pool.
+        from .parallel.mesh import maybe_init_distributed, resolve_device_pool
+        maybe_init_distributed(self.settings)
+        self.device_pool = resolve_device_pool(self.settings)
         # node-level cache subsystem (indices/cache_service.py): request
         # responses, parsed query plans, fielddata columns — byte-accounted
         # LRU tiers behind one core (ref IndicesRequestCache +
@@ -1563,7 +1571,9 @@ class NodeService:
             for n in names:
                 out = percolate_batch(self.indices[n], n,
                                       [(doc, type_name)],
-                                      caches=self.caches)[0]
+                                      caches=self.caches,
+                                      devices=self.device_pool.devices
+                                      if self.device_pool else None)[0]
                 out = self._percolate_filter(n, flt, out)
                 total += out["total"]
                 matches.extend(out["matches"])
@@ -1598,7 +1608,9 @@ class NodeService:
                    "total": 0, "matches": []} for _ in docs]
         for n in names:
             outs = percolate_batch(self.indices[n], n, docs,
-                                   caches=self.caches)
+                                   caches=self.caches,
+                                   devices=self.device_pool.devices
+                                   if self.device_pool else None)
             for i, out in enumerate(outs):
                 flt = (bodies[i] or {}).get("filter") \
                     or (bodies[i] or {}).get("query")
@@ -1836,7 +1848,8 @@ class NodeService:
         if not mesh_exec.plan_types_supported(node_tree):
             lane_decline("query", "mesh", "plan_unsupported")
             return None
-        if mesh_exec.mesh_for(len(searchers)) is None:
+        if mesh_exec.mesh_for(len(searchers),
+                              pool=self.device_pool) is None:
             # cross-host topology / fewer devices than shards
             lane_decline("query", "mesh", "no_mesh")
             return None
@@ -1845,7 +1858,8 @@ class NodeService:
             stack = self.caches.mesh_stacks.get_or_build(
                 name, svc._incarnation,
                 [list(s.segments) for s in searchers],
-                breaker=self.breakers.breaker("fielddata"))
+                breaker=self.breakers.breaker("fielddata"),
+                pool=self.device_pool)
             if stack is None:
                 lane_decline("query", "mesh", "stack_declined")
                 return None
@@ -1927,14 +1941,16 @@ class NodeService:
             lane_decline("knn", "mesh_knn", "opt_out")
             return None
         from .parallel import mesh_exec, mesh_knn
-        if mesh_exec.mesh_for(len(searchers)) is None:
+        if mesh_exec.mesh_for(len(searchers),
+                              pool=self.device_pool) is None:
             lane_decline("knn", "mesh_knn", "no_mesh")
             return None
         try:
             vstack = self.caches.mesh_vector_stacks.get_or_build(
                 name, svc._incarnation, knn["field"],
                 [list(s.segments) for s in searchers],
-                breaker=self.breakers.breaker("fielddata"))
+                breaker=self.breakers.breaker("fielddata"),
+                pool=self.device_pool)
             if vstack is None:
                 lane_decline("knn", "mesh_knn", "vstack_declined")
                 return None
@@ -1946,7 +1962,8 @@ class NodeService:
                 stack = self.caches.mesh_stacks.get_or_build(
                     name, svc._incarnation,
                     [list(s.segments) for s in searchers],
-                    breaker=self.breakers.breaker("fielddata"))
+                    breaker=self.breakers.breaker("fielddata"),
+                    pool=self.device_pool)
                 if stack is None:
                     lane_decline("knn", "mesh_knn", "stack_declined")
                     return None

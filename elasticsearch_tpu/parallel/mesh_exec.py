@@ -44,6 +44,7 @@ nothing (tests/test_no_retrace.py).
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -62,7 +63,8 @@ from ..search.query_dsl import (
     _next_up, _pow2_window,
 )
 from .distributed_search import _shard_map
-from .mesh import REPLICA_AXIS, SHARD_AXIS, index_sharding, make_mesh
+from .mesh import (REPLICA_AXIS, SHARD_AXIS, SHARED_EXEC_LOCK, index_sharding,
+                   make_mesh)
 
 SEG_SHIFT = 32
 
@@ -75,14 +77,59 @@ _OP_R = "r"        # scalar                  -> P() (replicated)
 _MESH_LOCK = threading.Lock()
 _MESH_MEMO: dict[tuple[int, int], jax.sharding.Mesh] = {}
 
-# ONE collective program in flight per process: two concurrent shard_map
-# executions on the SAME device pool can interleave their collective
-# rendezvous across devices and deadlock (observed with two cluster
-# nodes' host reduces overlapping in one test process). Real multi-host
-# deployments give each host its own devices — there this lock is
-# per-host and uncontended; in-process it serializes device execution
-# while transport/host-prep still overlaps.
-EXEC_LOCK = threading.Lock()
+# ONE collective program in flight per device POOL: two concurrent
+# shard_map executions on the SAME devices can interleave their
+# collective rendezvous across devices and deadlock (observed with two
+# cluster nodes' host reduces overlapping in one test process). Nodes
+# that OWN a disjoint device subset (parallel/mesh.DevicePool, ISSUE 19)
+# dispatch under their pool's private lock and run concurrently;
+# EXEC_LOCK is the legacy lock of the SHARED pool (all of jax.devices())
+# — the fallback when no ownership is configured. All dispatch sites go
+# through exec_guard() below, which also counts acquisitions/waits per
+# path (the bench's exec_lock_waits + the no-retrace tripwire).
+EXEC_LOCK = SHARED_EXEC_LOCK
+
+_EXEC_STATS_LOCK = threading.Lock()
+_EXEC_STATS = {"shared_acquisitions": 0, "shared_waits": 0,
+               "pool_acquisitions": 0, "pool_waits": 0}
+
+
+@contextmanager
+def exec_guard(pool=None):
+    """Serialize device dispatch per pool. pool=None (or the shared
+    pool) -> the legacy EXEC_LOCK; an owned DevicePool -> its private
+    lock, uncontended across nodes by construction. A "wait" is counted
+    only when the lock was not immediately available."""
+    lock = EXEC_LOCK if pool is None else pool.lock
+    shared = lock is EXEC_LOCK
+    if not lock.acquire(blocking=False):
+        with _EXEC_STATS_LOCK:
+            _EXEC_STATS["shared_waits" if shared else "pool_waits"] += 1
+        lock.acquire()
+    with _EXEC_STATS_LOCK:
+        _EXEC_STATS["shared_acquisitions" if shared
+                    else "pool_acquisitions"] += 1
+    try:
+        yield
+    finally:
+        lock.release()
+
+
+def exec_lock_stats() -> dict:
+    with _EXEC_STATS_LOCK:
+        return dict(_EXEC_STATS)
+
+
+def reset_exec_lock_stats() -> None:
+    with _EXEC_STATS_LOCK:
+        for k in _EXEC_STATS:
+            _EXEC_STATS[k] = 0
+
+
+def _mesh_devkey(mesh) -> tuple:
+    """Device-identity component of compiled-program cache keys: two
+    nodes with different device subsets must never share a program."""
+    return tuple(int(d.id) for d in mesh.devices.flat)
 
 # compiled shard_map programs keyed by plan signature — the jit analog of
 # DistributedSearcher's step memo, bounded on the common Cache core
@@ -95,17 +142,25 @@ _PROGRAMS = Cache("mesh_programs", max_entries=256)
 last_block_mode: str | None = None
 
 
-def mesh_for(n_shards: int):
+def mesh_for(n_shards: int, pool=None):
     """(mesh, s_pad, n_replicas) for an S-shard index, or None when this
-    host lacks the devices (fewer than S_pad): the caller falls back to
-    the thread-pool fan-out — the cross-host/undersized topology path."""
+    pool lacks the devices (fewer than S_pad): the caller falls back to
+    the thread-pool fan-out — the cross-host/undersized topology path.
+    pool=None means the legacy shared pool over all of jax.devices();
+    an owned DevicePool restricts the mesh to that node's device subset."""
     if n_shards < 1:
         return None
     s_pad = next_pow2(n_shards, floor=1)
-    n_dev = len(jax.devices())
+    devs = pool.devices if pool is not None else jax.devices()
+    n_dev = len(devs)
     if n_dev < s_pad:
         return None
     r = max(n_dev // s_pad, 1)
+    if pool is not None:
+        got = pool.mesh_for(s_pad, n_replicas=r)
+        if got is None:
+            return None
+        return got
     with _MESH_LOCK:
         mesh = _MESH_MEMO.get((r, s_pad))
         if mesh is None:
@@ -160,6 +215,7 @@ class MeshStack:
     mixed: frozenset = frozenset()
     nbytes: int = 0
     seg_ids_dev: jax.Array | None = None     # i64[S_pad, G_pad]
+    pool: object = None                      # owning DevicePool (None=shared)
 
     def __post_init__(self):
         self._live_key = None
@@ -214,7 +270,7 @@ def estimate_mesh_stack_bytes(per_shard_segments) -> int:
 
 
 def build_mesh_stack(per_shard_segments, mesh, s_pad: int,
-                     n_replicas: int) -> MeshStack | None:
+                     n_replicas: int, pool=None) -> MeshStack | None:
     """Pack every shard's live segments into mesh-sharded tensors. The
     per-shard slice mirrors search/stacked.build_stack — same fills, same
     sentinels — so per-shard scores computed over a mesh block are
@@ -225,6 +281,8 @@ def build_mesh_stack(per_shard_segments, mesh, s_pad: int,
         out = _build_mesh_stack(per_shard_segments, mesh, s_pad, n_replicas)
         if sp is not None and out is not None:
             sp.attrs["bytes"] = out.nbytes
+    if out is not None:
+        out.pool = pool
     return out
 
 
@@ -1119,7 +1177,8 @@ def execute_sorted(stack: MeshStack, node: Node, stats, sort_specs,
     nk = len(sort_specs)
     field_kinds = tuple(pctx.fields.items())
     op_kinds = tuple(kind for _a, kind in pctx.ops)
-    key = ("sorted", stack.s_pad, R, q_pad, k, nk, sig, field_kinds,
+    key = ("sorted", _mesh_devkey(stack.mesh), stack.s_pad, R, q_pad, k,
+           nk, sig, field_kinds,
            agg_plan.sig if agg_plan is not None else None)
     prog = _PROGRAMS.get(key)
     if prog is None:
@@ -1148,7 +1207,7 @@ def execute_sorted(stack: MeshStack, node: Node, stats, sort_specs,
                                   record_score_matrix_bytes)
     note_h2d(sum(int(a.nbytes) for a, _kind in pctx.ops) + cursor.nbytes)
     record_score_matrix_bytes(stack.g_pad * (q_pad // R) * stack.n_pad * 5)
-    with EXEC_LOCK:
+    with exec_guard(stack.pool):
         outs = prog(stack.live_stack(), stack.seg_ids_dev, cols_dev,
                     jnp.asarray(cursor), *args)
         out_k, out_shard, out_s, total, mx = outs[:5]
@@ -1285,8 +1344,9 @@ def _try_blockwise(stack: MeshStack, node: Node, stats, *, k: int,
     score_dtype = bw.probe_score_dtype(bplan, probe_fields)
     Qb = q_pad // R
     kk = min(k, stack.n_pad)
-    key = ("bw", stack.s_pad, R, q_pad, k, kk, block, bplan.sig,
-           bplan.field_kinds, bplan.op_kinds, str(score_dtype))
+    key = ("bw", _mesh_devkey(stack.mesh), stack.s_pad, R, q_pad, k, kk,
+           block, bplan.sig, bplan.field_kinds, bplan.op_kinds,
+           str(score_dtype))
     prog = _PROGRAMS.get(key)
     if prog is None:
         from ..common.device_stats import instrument
@@ -1342,7 +1402,7 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
     last_block_mode = "materialized"
     if not agg_specs and block_docs and stack.n_pad > block_docs \
             and stack.n_pad % block_docs == 0:
-        with EXEC_LOCK:
+        with exec_guard(stack.pool):
             out_d = _try_blockwise(stack, node, stats, k=k, q_pad=q_pad,
                                    R=R, block=block_docs)
             if out_d is not None:
@@ -1372,7 +1432,8 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
             return None       # some agg has no mesh form -> fan-out
     field_kinds = tuple(pctx.fields.items())
     op_kinds = tuple(kind for _a, kind in pctx.ops)
-    key = (stack.s_pad, R, q_pad, k, sig, field_kinds,
+    key = (_mesh_devkey(stack.mesh), stack.s_pad, R, q_pad, k, sig,
+           field_kinds,
            agg_plan.sig if agg_plan is not None else None)
     prog = _PROGRAMS.get(key)
     if prog is None:
@@ -1400,7 +1461,7 @@ def execute(stack: MeshStack, node: Node, stats, *, k: int, Q: int = 1,
                                   record_score_matrix_bytes)
     note_h2d(sum(int(a.nbytes) for a, _kind in pctx.ops))
     record_score_matrix_bytes(stack.g_pad * (q_pad // R) * stack.n_pad * 5)
-    with EXEC_LOCK:
+    with exec_guard(stack.pool):
         outs = prog(stack.live_stack(), stack.seg_ids_dev, *args)
         out_k, out_shard, out_s, total, mx = outs[:5]
         # the whole multi-shard query phase — top-k reduce AND agg

@@ -18,8 +18,12 @@ shard_map program with the cross-shard top-k reduce on device:
     int8  : the ivf scan on quantized codes (ops/ann.ivf_search_int8's
             int8×int8 GEMM + full-precision rescore, ISSUE 12) when the
             index or request selects `quantization: int8` and every
-            segment's QuantData is available; pq declines to the
-            per-shard fan-out
+            segment's QuantData is available
+    pq    : the ivf scan on u8 sub-quantizer codes (ops/ann.
+            ivf_search_pq's ADC stages, ISSUE 19): per-query LUTs built
+            in-program from the replicated query operand against the
+            shard-sharded per-segment codebooks, candidate work = m u8
+            gathers + adds, then the same full-precision rescore tail
 
 Bitwise parity with the per-shard fan-out holds because per-doc
 similarities are contractions over D only (padding the doc axis never
@@ -69,16 +73,19 @@ class _IvfPack:
 
 @dataclass
 class _QuantPack:
-    """int8 quantized codes stacked over (shard, segment) — the mesh
-    rider of the per-shard `ann_quant` tier (ISSUE 12). The scan gathers
-    these 1/4-size codes instead of the f32 stack; the rescore tail still
-    gathers f32 rows from the SAME packed vecs tensor. PQ declines to the
-    per-shard fan-out (same results, one more ladder rung): its codebook
-    operands + per-cluster ADC base terms are a larger collective surface
-    than the int8 pack and the fan-out already serves it."""
-    mode: str                        # "int8"
-    codes: jax.Array                 # i8[S, G, N, D]
-    scales: jax.Array                # f32[S, G, D]
+    """Quantized codes stacked over (shard, segment) — the mesh rider of
+    the per-shard `ann_quant` tier (ISSUE 12). int8: the scan gathers
+    1/4-size codes instead of the f32 stack. pq (ISSUE 19): u8 sub-
+    quantizer codes + per-segment codebooks ride the shard axis, and the
+    per-query ADC lookup tables are built IN-program from the replicated
+    query operand (one einsum per segment) so the collective surface
+    stays one u8 gather + adds per candidate. The rescore tail for both
+    modes gathers f32 rows from the SAME packed vecs tensor."""
+    mode: str                        # "int8" | "pq"
+    codes: jax.Array                 # i8[S, G, N, D] | u8[S, G, N, m]
+    scales: jax.Array | None = None  # f32[S, G, D]           (int8)
+    codebooks: jax.Array | None = None  # f32[S, G, m, 256, dsub] (pq)
+    m: int = 0                       # pq sub-quantizer count
     nbytes: int = 0
 
 
@@ -102,6 +109,7 @@ class MeshVectorStack:
     seg_ids_dev: jax.Array | None = None     # i64[S, G]
     nbytes: int = 0
     ivf_packs: dict = dc_field(default_factory=dict)   # nlist -> _IvfPack
+    pool: object = None              # owning DevicePool (None = shared)
 
     def __post_init__(self):
         self._live_key = None
@@ -139,7 +147,7 @@ def estimate_vector_stack_bytes(per_shard_segments, field: str) -> int:
 
 
 def build_vector_stack(per_shard_segments, field: str, mesh, s_pad: int,
-                       n_replicas: int) -> MeshVectorStack | None:
+                       n_replicas: int, pool=None) -> MeshVectorStack | None:
     """Pack every shard's live segments' `field` vector columns into
     mesh-sharded tensors. None when the field is absent everywhere or the
     columns disagree on dims (per-shard fan-out handles those)."""
@@ -178,7 +186,8 @@ def build_vector_stack(per_shard_segments, field: str, mesh, s_pad: int,
             s_count=len(per_shard_segments), s_pad=s_pad, g_pad=g_pad,
             n_pad=n_pad, dims=dims, mesh=mesh, n_replicas=n_replicas,
             vecs=jax.device_put(vecs, sharding), has_field=has_field,
-            seg_ids_dev=jax.device_put(seg_ids, sharding), nbytes=nbytes)
+            seg_ids_dev=jax.device_put(seg_ids, sharding), nbytes=nbytes,
+            pool=pool)
 
 
 def _build_ivf_pack(vstack: MeshVectorStack, acquire_ivf) -> _IvfPack | str:
@@ -243,14 +252,14 @@ def _build_ivf_pack(vstack: MeshVectorStack, acquire_ivf) -> _IvfPack | str:
 def _build_quant_pack(vstack: MeshVectorStack, base: _IvfPack,
                       acquire_ivf, acquire_quant,
                       mode: str) -> "_QuantPack | str":
-    """Stack per-(shard, segment) int8 codes — the SAME cached QuantData
-    the per-shard lane uses (acquire_quant callback), so codes and scales
-    are bit-identical. Returns a _QuantPack, or a reason string when any
-    segment declines quantization (-> the whole mesh lane declines and
+    """Stack per-(shard, segment) quantized codes — the SAME cached
+    QuantData the per-shard lane uses (acquire_quant callback), so codes,
+    scales and codebooks are bit-identical. Returns a _QuantPack, or a
+    reason string when any segment declines quantization or (pq) the
+    sub-quantizer counts disagree (-> the whole mesh lane declines and
     the per-shard fan-out honors the request's mode)."""
     s_pad, g_pad, n_pad = vstack.s_pad, vstack.g_pad, vstack.n_pad
-    codes = np.zeros((s_pad, g_pad, n_pad, vstack.dims), np.int8)
-    scales = np.ones((s_pad, g_pad, vstack.dims), np.float32)
+    per = {}
     for si, rows in enumerate(vstack.shard_rows):
         for gi, (_i, seg) in enumerate(rows):
             vc = seg.vectors.get(vstack.field)
@@ -262,15 +271,40 @@ def _build_quant_pack(vstack: MeshVectorStack, base: _IvfPack,
             quant = acquire_quant(si, seg, vc, ivf, mode)
             if quant is None or quant.mode != mode:
                 return "quant"
+            per[(si, gi)] = quant
+    sharding = index_sharding(vstack.mesh)
+    if mode == "int8":
+        codes = np.zeros((s_pad, g_pad, n_pad, vstack.dims), np.int8)
+        scales = np.ones((s_pad, g_pad, vstack.dims), np.float32)
+        for (si, gi), quant in per.items():
             c = np.asarray(quant.codes)
             codes[si, gi, : c.shape[0]] = c
             scales[si, gi] = np.asarray(quant.scales)
-    sharding = index_sharding(vstack.mesh)
+        return _QuantPack(
+            mode=mode,
+            codes=jax.device_put(codes, sharding),
+            scales=jax.device_put(scales, sharding),
+            nbytes=codes.nbytes + scales.nbytes)
+    # pq: u8 codes [N, m] + per-segment codebooks [m, 256, dsub]; the
+    # in-program ADC LUT einsum needs ONE static m across the stack
+    ms = {int(q.m) for q in per.values()}
+    if len(ms) != 1:
+        return "pq_shape"
+    m = ms.pop()
+    if m < 1 or vstack.dims % m:
+        return "pq_shape"
+    dsub = vstack.dims // m
+    codes = np.zeros((s_pad, g_pad, n_pad, m), np.uint8)
+    books = np.zeros((s_pad, g_pad, m, ann_ops.PQ_CODES, dsub), np.float32)
+    for (si, gi), quant in per.items():
+        c = np.asarray(quant.codes)
+        codes[si, gi, : c.shape[0]] = c
+        books[si, gi] = np.asarray(quant.codebooks)
     return _QuantPack(
         mode=mode,
         codes=jax.device_put(codes, sharding),
-        scales=jax.device_put(scales, sharding),
-        nbytes=codes.nbytes + scales.nbytes)
+        codebooks=jax.device_put(books, sharding),
+        m=m, nbytes=codes.nbytes + books.nbytes)
 
 
 def _plan_filter(filter_node, filter_stack, q_pad: int):
@@ -321,12 +355,6 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     if qmode not in ("int8", "pq"):
         qmode = "none"
     from ..common.device_stats import lane_decline
-    if qmode == "pq":
-        # PQ keeps the per-shard fan-out (see _QuantPack) — declining the
-        # mesh lane honors the request's mode there
-        lane_decline("knn", "mesh_knn", "pq_mode")
-        return None
-
     # the mesh kNN lane serves the IVF path only: the exact per-segment
     # kernel runs EAGERLY on the per-shard path, and a fused collective
     # program cannot reproduce its GEMM rounding bit-for-bit — exact and
@@ -387,10 +415,12 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
     # a merge can take an index from g_pad=2 back to g_pad=1 while every
     # other component matches (chaos-harness find: the cached program
     # then broadcast-errors on the new stack and the lane falls back)
-    key = ("knn", vstack.s_pad, vstack.g_pad, R, q_pad, k, kk,
+    pq_m = qpack.m if isinstance(qpack, _QuantPack) else 0
+    key = ("knn", mesh_exec._mesh_devkey(vstack.mesh),
+           vstack.s_pad, vstack.g_pad, R, q_pad, k, kk,
            vstack.n_pad, vstack.dims,
            metric, precision, used_ivf, nprobe_eff, W, block,
-           used_quant, rw,
+           used_quant, rw, pq_m,
            (fplan[0], tuple(fplan[2].fields.items()),
             tuple(kind for _a, kind in fplan[2].ops))
            if fplan is not None else None)
@@ -403,7 +433,7 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
                 vstack, metric=metric, precision=precision, k=k, kk=kk,
                 n_queries=q_pad // R, used_ivf=used_ivf, nprobe=nprobe_eff,
                 W=W, block=block, nlist=ivf.nlist if used_ivf else 0,
-                quant=used_quant, rw=rw, fplan=fplan),
+                quant=used_quant, rw=rw, pq_m=pq_m, fplan=fplan),
             key=key)
         mesh_exec._PROGRAMS.put(key, prog, weight=1)
 
@@ -414,7 +444,9 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
         args.extend([ivf.centroids, ivf.starts, ivf.sizes, ivf.slot_docs,
                      ivf.norms, jnp.asarray(w_own)])
     if used_quant:
-        args.extend([qpack.codes, qpack.scales])
+        args.extend([qpack.codes,
+                     qpack.scales if used_quant == "int8"
+                     else qpack.codebooks])
     if fplan is not None:
         _fsig, _mfn, fpctx = fplan
         for name, kind in fpctx.fields.items():
@@ -431,7 +463,7 @@ def execute(vstack: MeshVectorStack, query_vectors, *, k: int, metric: str,
 
     from ..common.metrics import device_fetch, note_h2d
     note_h2d(int(qv_np.nbytes))
-    with mesh_exec.EXEC_LOCK:
+    with mesh_exec.exec_guard(vstack.pool):
         out_k, out_shard, out_s, total, mx = prog(*args)
         got = device_fetch({"keys": out_k, "shard": out_shard,
                             "scores": out_s, "total": total, "mx": mx})
@@ -468,7 +500,7 @@ def _build_or_get_pack(vstack, acquire_ivf, knn_opts, nprobe, exact,
 
 def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                        used_ivf, nprobe, W, block, nlist, fplan,
-                       quant=None, rw=0):
+                       quant=None, rw=0, pq_m=0):
     mesh = vstack.mesh
     n_pad = vstack.n_pad
     g_pad = vstack.g_pad
@@ -577,20 +609,22 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
             def one(v_g, c_g, st_g, sz_g, sd_g, nm_g, w_g, live_g,
                     *qops):
                 cc = c_g.astype(dt)
-                route = lax.dot_general(
+                r_dot = lax.dot_general(
                     qc, cc, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)      # [Qb, nlist]
                 if metric == "cosine":
                     cn = jnp.linalg.norm(c_g, axis=1)
-                    route = route / jnp.maximum(qn_cos * cn[None, :], 1e-12)
+                    route = r_dot / jnp.maximum(qn_cos * cn[None, :], 1e-12)
                 elif metric == "l2":
                     cn2 = jnp.sum(c_g * c_g, axis=1)
-                    route = 2.0 * route - cn2[None, :]
+                    route = 2.0 * r_dot - cn2[None, :]
+                else:
+                    route = r_dot
                 _, probe = lax.top_k(route, nprobe)          # [Qb, nprobe]
                 t_starts = st_g[probe]
                 t_lens = sz_g[probe]
-                sidx, _t, valid = bm25_ops.postings_slots(t_starts, t_lens,
-                                                          W)
+                sidx, t_slot, valid = bm25_ops.postings_slots(t_starts,
+                                                              t_lens, W)
                 # the segment's OWN budget masks the tail — candidate set
                 # == the per-segment kernel's
                 valid = valid & (jnp.arange(W, dtype=jnp.int32)[None, :]
@@ -600,22 +634,47 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                 docs = jnp.where(valid, docs, n_pad - 1)
                 docs_s = docs.reshape(-1, nb, block).transpose(1, 0, 2)
                 valid_s = valid.reshape(-1, nb, block).transpose(1, 0, 2)
-                if quant:
+                xs = (docs_s, valid_s)
+                if quant == "int8":
                     # int8 scan + full-precision rescore: exactly
                     # ops/ann.ivf_search_int8's stages per segment
                     codes_g, scales_g = qops
                     q8, sq = ann_ops.quantize_query_int8(qv, scales_g)
+                elif quant == "pq":
+                    # ADC scan: exactly ops/ann.ivf_search_pq's stages
+                    # per segment — each slot's RAW centroid dot is the
+                    # base term, the per-query LUT comes from the
+                    # REPLICATED query operand against this segment's
+                    # codebooks (one einsum per segment)
+                    codes_g, books_g = qops
+                    cl = jnp.take_along_axis(
+                        probe, jnp.clip(t_slot, 0, nprobe - 1),
+                        axis=1)                              # [Qb, W]
+                    c_dot = jnp.take_along_axis(r_dot, cl, axis=1)
+                    cdot_s = c_dot.reshape(-1, nb, block).transpose(1, 0, 2)
+                    xs = (docs_s, valid_s, cdot_s)
+                    qsub = qv.reshape(qv.shape[0], pq_m, -1).astype(dt)
+                    lut = jnp.einsum(
+                        "qmd,mjd->qmj", qsub, books_g.astype(dt),
+                        preferred_element_type=jnp.float32)  # [Qb, m, 256]
 
                 def body(carry, x):
                     top_s, top_i = carry
-                    d_blk, v_blk = x
-                    if quant:
+                    if quant == "pq":
+                        d_blk, v_blk, cd_blk = x
+                        cb = codes_g[d_blk]                  # [Qb, B, m] u8
+                        cmb = jnp.moveaxis(cb, 2, 1).astype(jnp.int32)
+                        vals = jnp.take_along_axis(lut, cmb, axis=2)
+                        sims_b = cd_blk + jnp.sum(vals, axis=1)
+                    elif quant == "int8":
+                        d_blk, v_blk = x
                         cand8 = codes_g[d_blk]               # [Qb, B, D] i8
                         idot = jnp.einsum(
                             "qd,qbd->qb", q8, cand8,
                             preferred_element_type=jnp.int32)
                         sims_b = sq * idot.astype(jnp.float32)
                     else:
+                        d_blk, v_blk = x
                         cand = v_g[d_blk].astype(dt)         # [Qb, B, D]
                         sims_b = jnp.einsum(
                             "qd,qbd->qb", qc, cand,
@@ -634,7 +693,7 @@ def _build_knn_program(vstack, *, metric, precision, k, kk, n_queries,
                 carry = (jnp.full((qv.shape[0], scan_k), -jnp.inf,
                                   jnp.float32),
                          jnp.full((qv.shape[0], scan_k), -1, jnp.int32))
-                (top_s, top_i), _ = lax.scan(body, carry, (docs_s, valid_s))
+                (top_s, top_i), _ = lax.scan(body, carry, xs)
                 top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
                 if quant:
                     top_s, top_i = ann_ops.rescore_topk(

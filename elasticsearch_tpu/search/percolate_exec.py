@@ -604,11 +604,13 @@ def _dense_matrix(corpus: PercolateCorpus, parsed_docs,
 # ---------------------------------------------------------------------------
 
 def percolate_batch(svc, index_name: str, docs: list[tuple[dict, str]],
-                    caches=None) -> list[dict]:
+                    caches=None, devices=None) -> list[dict]:
     """Percolate a document batch: -> one {"total", "matches"} response
     per (doc, type_name) pair, bitwise-identical to looping
     percolator.percolate. Ladder: mesh → dense matrix → per-doc loop,
-    with residual (undenseable) queries riding the loop per doc."""
+    with residual (undenseable) queries riding the loop per doc.
+    `devices` restricts the mesh rung to the owning node's device pool
+    (ISSUE 19); None means all of jax.devices() — the shared pool."""
     registry = parsed_registry(svc)
     if not registry:
         return [{"total": 0, "matches": []} for _ in docs]
@@ -630,7 +632,7 @@ def percolate_batch(svc, index_name: str, docs: list[tuple[dict, str]],
                             "matches": [{"_index": index_name, "_id": i}
                                         for i in ids]})
             return out
-        devices = jax.devices()
+        devices = list(devices) if devices else jax.devices()
         if len(devices) > 1:
             lane_chosen("percolate", "mesh")
             _bump(mesh=1)

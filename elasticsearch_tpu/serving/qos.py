@@ -390,3 +390,39 @@ def hedge_snapshot() -> dict:
 def hedge_rate(window: int = 60) -> float:
     with _hedge_lock:
         return _hedge_meter.rate(window) if _hedge_meter is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Per-transport-class latency EWMAs (ISSUE 19 pod tier)
+# ---------------------------------------------------------------------------
+# Cross-host pre-reduced merges ride the "dcn" transport class; their
+# latencies observe HERE, never into the per-node `_node_lat` EWMAs that
+# arm the hedge deadline — a slow DCN link must not inflate the ICI
+# deadline for co-hosted copies (and vice versa). One Ewma per class,
+# same alpha/deviations math as the hedge tier, surfaced by
+# transport_latency_snapshot() for the metrics scrape and the bench.
+
+_transport_lat_lock = threading.Lock()
+_transport_lat: dict[str, Ewma] = {}
+
+
+def observe_transport_latency(tclass: str, ms: float) -> None:
+    with _transport_lat_lock:
+        lat = _transport_lat.get(tclass)
+        if lat is None:
+            lat = _transport_lat[tclass] = Ewma()
+        lat.observe(ms)
+
+
+def transport_latency_snapshot() -> dict:
+    """{class: {"ewma_ms", "deadline_ms", "n"}} for every observed
+    transport class."""
+    with _transport_lat_lock:
+        return {c: {"ewma_ms": lat.value, "deadline_ms": lat.deadline_ms(),
+                    "n": lat.n}
+                for c, lat in _transport_lat.items()}
+
+
+def reset_transport_latency() -> None:
+    with _transport_lat_lock:
+        _transport_lat.clear()

@@ -61,7 +61,7 @@ class ChaosOptions:
                  replicas: int = 1, transport: str = "local",
                  inject_parity_fault: bool = False,
                  raise_on_failure: bool = True,
-                 extended_roster: bool = False):
+                 extended_roster: bool = False, pods: int = 0):
         self.seed = seed
         self.rounds = rounds
         self.docs_per_round = docs_per_round
@@ -77,6 +77,10 @@ class ChaosOptions:
         # opt-in kill/restart + clock-skew disruptions (scheme roster).
         # Off by default so pinned-seed schedules stay bit-identical.
         self.extended_roster = extended_roster
+        # pod mode (ISSUE 19): every cluster node owns a disjoint device
+        # slice and nodes spread over `pods` simulated hosts, so the
+        # roster runs over the multi-host / per-node-pool transport
+        self.pods = pods
 
 
 class ChaosReport:
@@ -172,7 +176,7 @@ class ChaosRunner:
                 random.Random(self.rng.randrange(2 ** 62)), self.opt.dims)
             self.cluster = TestCluster(
                 self.opt.cluster_nodes, os.path.join(self.path, "cluster"),
-                transport=self.opt.transport)
+                transport=self.opt.transport, pods=self.opt.pods)
             client = self.cluster.client()
             client.create_index("docs", {
                 "number_of_shards": self.opt.shards,
@@ -198,6 +202,8 @@ class ChaosRunner:
         self._solo_parity_sweep()
         if self.cluster is not None:
             self._cluster_parity_sweep()
+            if self.opt.pods:
+                self._pod_invariants()
             self._acked_write_check()
             self.report.invariant_violations.extend(
                 control_plane_violations(
@@ -494,6 +500,58 @@ class ChaosRunner:
             finally:
                 self._set_cluster_setting(
                     "cluster.search.host_reduce.enable", True)
+
+    def _pod_invariants(self) -> None:
+        """Pod-mode invariants (ISSUE 19): every surviving node OWNS a
+        disjoint device slice; on each node co-hosting >= 2 shards the
+        host reduce rides that node's OWN mesh (a direct, deterministic
+        per-node probe — the sweep's coordinator-side copy choice is
+        adaptive); and the per-node data plane never touches the shared
+        EXEC_LOCK."""
+        from ...cluster.host_reduce import try_host_reduce
+        from ...parallel.mesh_exec import exec_lock_stats
+        viol = self.report.invariant_violations
+        live = [n for n in self.cluster.nodes.values() if not n.closed]
+        owner: dict[int, str] = {}
+        for n in live:
+            pool = getattr(n, "device_pool", None)
+            if pool is None:
+                viol.append(f"pod mode: {n.node_id} owns no device pool")
+                continue
+            for did in pool.devkey:
+                if did in owner:
+                    viol.append(f"pod mode: device {did} owned by both "
+                                f"{owner[did]} and {n.node_id}")
+                owner[did] = n.node_id
+        shared0 = exec_lock_stats()["shared_acquisitions"]
+        rode = 0
+        for n in live:
+            if getattr(n, "device_pool", None) is None:
+                continue
+            with n._shards_lock:
+                sids = sorted(sid for (ix, sid), h in n._shards.items()
+                              if ix == "docs" and h.engine is not None)
+            if len(sids) < 2:
+                continue
+            # cap the group at what the node's slice can mesh (s_pad
+            # must fit the pool) — the ride itself is what's asserted
+            cap = len(n.device_pool.devices)
+            out, reason = try_host_reduce(
+                n, "docs", sids[:cap], {"query": {"match_all": {}}},
+                10, None)
+            if out is None:
+                viol.append(f"pod mode: host reduce declined on "
+                            f"{n.node_id} ({reason})")
+            else:
+                rode += 1
+            self.oracle.lane_checks += 1
+        if live and not rode:
+            viol.append("pod mode: host reduce rode no node's mesh")
+        shared1 = exec_lock_stats()["shared_acquisitions"]
+        if shared1 != shared0:
+            viol.append(
+                f"pod mode: per-node reduce took the shared EXEC_LOCK "
+                f"{shared1 - shared0}x — pools must dispatch lock-free")
 
     def _set_cluster_setting(self, key: str, val) -> None:
         master = self.cluster.master_node()

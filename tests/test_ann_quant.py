@@ -370,7 +370,11 @@ class TestMeshQuantParity:
         n.search("vm", json.loads(json.dumps(body)))
         assert transfer_snapshot()["device_fetches_total"] - f0 == 1
 
-    def test_pq_declines_to_fanout(self, knn_pair):
+    def test_pq_undersized_declines_to_fanout(self, knn_pair):
+        """PQ rides the mesh since ISSUE 19, but only when every segment
+        built its codebook tier — 90 docs/shard is under the 256-doc
+        floor, so the lane still declines down the ladder with the
+        counter (never an error)."""
         n = knn_pair
         fb0 = n.indices["vm"].search_stats.get("mesh_ann_fallbacks", 0)
         g, w, *_ = self._both(
@@ -379,3 +383,90 @@ class TestMeshQuantParity:
         assert n.indices["vm"].search_stats.get(
             "mesh_ann_fallbacks", 0) == fb0 + 1
         assert g == w
+
+
+class TestMeshPQParity:
+    """IVF-PQ through the mesh program (ISSUE 19 satellite): the ADC
+    scan (replicated per-subspace LUT operand, in-program coarse
+    routing) is bitwise-identical to the per-shard fan-out's
+    `ivf_search_pq`, rides in ONE device fetch, and counts into
+    es_search_ann_quantized_dispatches_total{mode="pq"}."""
+
+    D = 8
+    N = 768             # ~384/shard: over the 256-doc per-segment floor
+
+    @pytest.fixture(scope="class")
+    def pq_pair(self, tmp_path_factory):
+        n = NodeService(str(tmp_path_factory.mktemp("meshpq")))
+        mapping = {"_doc": {"properties": {
+            "body": {"type": "string"},
+            "vec": {"type": "dense_vector", "dims": self.D}}}}
+        base = {"number_of_shards": 2, "index.knn.ivf.nlist": 8,
+                "index.knn.ivf.min_docs": 16,
+                "index.knn.precision": "f32",
+                "index.knn.pq.m": 4,
+                "index.knn.rescore_window": 20}
+        n.create_index("pm", settings=dict(base), mappings=mapping)
+        n.create_index("pf", settings={**base,
+                                       "index.search.mesh.enable": False},
+                       mappings=mapping)
+        rng = np.random.RandomState(11)
+        for i in range(self.N):
+            doc = {"body": f"w{i % 7}",
+                   "vec": [float(x) for x in rng.randn(self.D)]}
+            for name in ("pm", "pf"):
+                n.index_doc(name, str(i), dict(doc))
+        for name in ("pm", "pf"):
+            n.refresh(name)
+        n._qv = [float(x) for x in rng.randn(self.D)]
+        yield n
+        n.close()
+
+    def _both(self, n, knn, size=10):
+        body = {"size": size, "knn": knn}
+        got = n.search("pm", json.loads(json.dumps(body)))
+        want = n.search("pf", json.loads(json.dumps(body)))
+        hits = lambda r: [(h["_id"], h["_score"])  # noqa: E731
+                          for h in r["hits"]["hits"]]
+        return hits(got), hits(want), got, want
+
+    @pytest.mark.parametrize("metric", ["cosine", "dot", "l2"])
+    def test_pq_mesh_bitwise_identical(self, pq_pair, metric):
+        n = pq_pair
+        before = n.indices["pm"].search_stats.get("mesh_ann_dispatches", 0)
+        pq0 = n.indices["pm"].search_stats.get("ann_quantized_pq", 0)
+        g, w, got, want = self._both(
+            n, {"field": "vec", "query_vector": n._qv, "k": 10,
+                "quantization": "pq", "nprobe": 4, "metric": metric})
+        assert n.indices["pm"].search_stats.get(
+            "mesh_ann_dispatches", 0) == before + 1
+        assert n.indices["pm"].search_stats.get(
+            "ann_quantized_pq", 0) == pq0 + 1
+        assert g == w
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["hits"]["max_score"] == want["hits"]["max_score"]
+
+    def test_pq_one_fetch_for_the_whole_index(self, pq_pair):
+        from elasticsearch_tpu.common.metrics import transfer_snapshot
+        n = pq_pair
+        body = {"size": 10, "knn": {"field": "vec",
+                                    "query_vector": n._qv, "k": 10,
+                                    "quantization": "pq", "nprobe": 4}}
+        n.search("pm", json.loads(json.dumps(body)))          # warm
+        f0 = transfer_snapshot()["device_fetches_total"]
+        n.search("pm", json.loads(json.dumps(body)))
+        assert transfer_snapshot()["device_fetches_total"] - f0 == 1
+
+    def test_pq_mode_rides_metric_walk(self, pq_pair):
+        """es_search_ann_quantized_dispatches_total{mode="pq"} (ISSUE 19
+        acceptance): the labeled family reflects the mesh-lane rides."""
+        from elasticsearch_tpu.common.metrics import render_openmetrics
+        n = pq_pair
+        n.search("pm", {"size": 5, "knn": {
+            "field": "vec", "query_vector": n._qv, "k": 5,
+            "quantization": "pq", "nprobe": 4}})
+        text = render_openmetrics(n.metric_sections())
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("es_search_ann_quantized_dispatches_total")
+                and 'mode="pq"' in ln]
+        assert line and float(line[0].rsplit(" ", 1)[1]) >= 1

@@ -100,7 +100,10 @@ def test_tail_latency_keys_survive_forced_timeout():
                 # seeded-null contract
                 "percolate_qps", "percolate_matrix_qps",
                 "percolate_vs_loop", "script_score_qps",
-                "script_vs_decline"):
+                "script_vs_decline",
+                # pod-scale serving (ISSUE 19): same seeded-null contract
+                "pod_qps", "single_pool_qps", "pod_vs_single",
+                "dcn_hops_per_query", "exec_lock_waits"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

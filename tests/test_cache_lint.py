@@ -53,3 +53,43 @@ def test_no_adhoc_dict_caches():
         "ad-hoc dict-as-cache attributes found — use common.cache.Cache "
         "(bounded, byte-accounted, observable) or allowlist with a "
         "reason:\n  " + "\n  ".join(offenders))
+
+
+# -- no direct EXEC_LOCK acquisition (ISSUE 19) ------------------------------
+#
+# Per-node device pools moved mesh dispatch onto pool-private locks via
+# mesh_exec.exec_guard(pool) — which also counts acquisitions/waits into
+# exec_lock_stats(). A NEW `with EXEC_LOCK` under parallel/ or cluster/
+# would silently re-serialize every node through the process-wide lock
+# AND dodge the contention counters, so it fails here unless the
+# (file, line-content) is allowlisted as a deliberate legacy
+# shared-pool fallback.
+
+# relative path under elasticsearch_tpu/ -> why holding the shared lock
+# directly is OK there (none today: every dispatch goes through
+# exec_guard, which takes EXEC_LOCK itself only for pool-less stacks)
+EXEC_LOCK_ALLOWLIST: dict = {}
+
+_EXEC_LOCK_RX = re.compile(
+    r"with\s+(?:mesh_exec\.)?(?:SHARED_)?EXEC_LOCK\b")
+
+
+def test_no_direct_exec_lock_acquisition():
+    offenders = []
+    for sub in ("parallel", "cluster"):
+        for root, _dirs, files in os.walk(os.path.join(PKG, sub)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(path, PKG)
+                with open(path) as f:
+                    for lineno, line in enumerate(f, 1):
+                        if _EXEC_LOCK_RX.search(line) \
+                                and rel not in EXEC_LOCK_ALLOWLIST:
+                            offenders.append(f"{rel}:{lineno}")
+    assert not offenders, (
+        "direct EXEC_LOCK acquisition found — dispatch through "
+        "mesh_exec.exec_guard(pool) (per-node lock + contention "
+        "counters) or allowlist as a legacy shared-pool fallback:\n  "
+        + "\n  ".join(offenders))

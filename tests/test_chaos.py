@@ -77,6 +77,22 @@ class TestChaosSmoke:
         assert report.acked_writes > 0
 
     @pytest.mark.chaos
+    def test_pods_roster_smoke(self, tmp_path):
+        """The existing roster over the multi-host / per-node-pool
+        transport (ISSUE 19): every node owns a disjoint device slice,
+        nodes spread over 2 simulated hosts, and each round's
+        _pod_invariants probe asserts the host reduce rides each
+        surviving node's OWN mesh without ever touching the shared
+        EXEC_LOCK."""
+        report = ChaosRunner(str(tmp_path), ChaosOptions(
+            seed=int(os.environ.get("CHAOS_SEED", "77")), rounds=2,
+            pods=2)).run()
+        assert report.ok(), report.as_dict()
+        assert report.rounds == 2
+        assert report.disruptions
+        assert report.acked_writes > 0
+
+    @pytest.mark.chaos
     def test_rotation_extra_seed(self, tmp_path):
         """Second rotation seed, bounded to one round — cheap extra
         schedule coverage so the tier-1 smoke isn't wedded to a single

@@ -649,3 +649,42 @@ def test_script_templates_with_different_params_compile_once(stacked_node):
     assert top(outs[0]) != top(first)
     assert {round(top(o) / top(outs[0]), 6) for o in outs} == \
         {1.0, round(0.5 / 3.0, 6), round(7.25 / 3.0, 6)}
+
+
+# -- per-node device pools keep EXEC_LOCK off the hot path (ISSUE 19) -------
+
+
+def test_per_node_pool_path_zero_shared_exec_lock(tmp_path_factory):
+    """A node that OWNS a device slice (`node.devices`) must dispatch
+    every mesh program under its pool-private lock: ZERO shared
+    EXEC_LOCK acquisitions on the per-node path (the uncontended-pod
+    acceptance of ISSUE 19), while the pool counters account the same
+    dispatches."""
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.parallel.mesh_exec import (exec_lock_stats,
+                                                      reset_exec_lock_stats)
+    n = NodeService(str(tmp_path_factory.mktemp("poolnr")),
+                    Settings({"node.devices": "auto:0/2"}))
+    try:
+        assert n.device_pool is not None and not n.device_pool.is_shared
+        n.create_index("p", settings={"number_of_shards": 2},
+                       mappings={"_doc": {"properties": {
+                           "body": {"type": "string"}}}})
+        for i in range(32):
+            n.index_doc("p", str(i), {"body": f"quick brown fox {i}"})
+        n.refresh("p")
+        # bool/should shape: the sparse postings lane outranks the dense
+        # ladder for single pure-term bodies, so give it two clauses
+        body = {"size": 5, "query": {"bool": {
+            "should": [{"match": {"body": "quick"}},
+                       {"match": {"body": "fox"}}]}}}
+        n.search("p", json.loads(json.dumps(body)))       # warm
+        reset_exec_lock_stats()
+        n.search("p", json.loads(json.dumps(body)))
+        st = exec_lock_stats()
+        assert n.indices["p"].search_stats.get("mesh", 0) >= 1
+        assert st["shared_acquisitions"] == 0, st
+        assert st["shared_waits"] == 0, st
+        assert st["pool_acquisitions"] >= 1, st
+    finally:
+        n.close()

@@ -1,0 +1,199 @@
+"""Pod-scale serving (ISSUE 19): per-node device pools + the multi-host
+data plane.
+
+Contract pins:
+  * pod-mode TestCluster gives every node a DISJOINT device slice and a
+    simulated host label; both survive node restart;
+  * two coordinators dispatching collectives SIMULTANEOUSLY neither
+    deadlock nor touch the shared EXEC_LOCK (zero shared acquisitions,
+    zero shared waits) — the uncontended-pod acceptance;
+  * the cross-node merge is bitwise-identical to the per-shard fan-out
+    (host_reduce toggled live on the SAME cluster);
+  * inter-pod hops ride the "dcn" traffic class (sixth class) with
+    their own QoS latency EWMA, never the ICI/reg hedge signal;
+  * pod counters ride the metric walk:
+    es_search_pod_reduce_dispatches_total, es_transport_class{class="dcn"},
+    es_transport_latency_ewma_ms{class="dcn"}.
+"""
+
+import json
+import threading
+
+import pytest
+
+from elasticsearch_tpu.cluster import TestCluster
+from elasticsearch_tpu.common.metrics import openmetrics_families
+from elasticsearch_tpu.parallel.mesh_exec import (exec_lock_stats,
+                                                  reset_exec_lock_stats)
+from elasticsearch_tpu.serving.qos import (reset_transport_latency,
+                                           transport_latency_snapshot)
+
+BODY = {"size": 5, "query": {"bool": {
+    "should": [{"match": {"body": "quick"}},
+               {"match": {"body": "fox"}}]}}}
+
+
+def _hits(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+@pytest.fixture(scope="module")
+def pod2(tmp_path_factory):
+    """2 nodes x 2 pods: each node owns half the 8 test devices, each
+    node is its own simulated host — every inter-node hop is a DCN hop."""
+    reset_transport_latency()
+    c = TestCluster(2, str(tmp_path_factory.mktemp("pod2")), pods=2)
+    client = c.client()
+    client.create_index("docs", {"number_of_shards": 4,
+                                 "number_of_replicas": 0})
+    client.put_mapping("docs", "_doc", {"properties": {
+        "body": {"type": "string"}}})
+    c.ensure_green()
+    for i in range(64):
+        client.index_doc("docs", str(i),
+                         {"body": f"quick brown fox jumps {i % 5} n{i}"})
+    client.refresh("docs")
+    yield c
+    c.close()
+
+
+class TestPodTopology:
+
+    def test_disjoint_device_ownership(self, pod2):
+        owner = {}
+        for n in pod2.nodes.values():
+            assert n.device_pool is not None, n.node_id
+            assert not n.device_pool.is_shared
+            for did in n.device_pool.devkey:
+                assert did not in owner, \
+                    f"device {did}: {owner[did]} and {n.node_id}"
+                owner[did] = n.node_id
+
+    def test_hosts_registered_on_the_transport(self, pod2):
+        hosts = {pod2.network.host_of(nid) for nid in pod2.nodes}
+        assert len(hosts) == 2 and None not in hosts
+
+    def test_restart_preserves_pool_and_host(self, tmp_path):
+        """restart_node must bring the node back with the SAME owned
+        slice and host label — a restarted node silently falling back to
+        the shared pool would re-serialize the whole pod. (Own cluster:
+        the kill must not orphan the module fixture's replica-less
+        shards.)"""
+        c = TestCluster(2, str(tmp_path), pods=2)
+        try:
+            victim = [nid for nid in sorted(c.nodes)
+                      if c.master_node().node_id != nid][0]
+            before_key = c.nodes[victim].device_pool.devkey
+            before_host = c.network.host_of(victim)
+            c.kill_node(victim)
+            node = c.restart_node(victim)
+            c.ensure_green()
+            assert node.device_pool is not None
+            assert node.device_pool.devkey == before_key
+            assert c.network.host_of(victim) == before_host
+        finally:
+            c.close()
+
+
+class TestPodDataPlane:
+
+    def test_cross_node_merge_bitwise_identical(self, pod2):
+        """Pod reduce (ONE pre-reduced DCN hop per remote node) vs the
+        per-shard fan-out, same cluster, toggled live — the cross-node
+        merge is the existing bitwise host merge."""
+        client = pod2.client()
+        got = client.search("docs", json.loads(json.dumps(BODY)))
+        master = pod2.master_node()
+
+        def toggle(val):
+            def task(cur):
+                st = cur.mutate()
+                st.data.setdefault("settings", {})[
+                    "cluster.search.host_reduce.enable"] = val
+                return st
+            master.cluster.submit_task("pod-toggle", task)
+        toggle(False)
+        try:
+            want = client.search("docs", json.loads(json.dumps(BODY)))
+        finally:
+            toggle(True)
+        assert _hits(got) == _hits(want)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["hits"]["max_score"] == want["hits"]["max_score"]
+
+    def test_concurrent_collectives_no_deadlock_no_shared_lock(self, pod2):
+        """Two coordinators dispatch simultaneously: per-node pools make
+        the collectives concurrent — no deadlock, ZERO shared EXEC_LOCK
+        acquisitions/waits, and both see the same merged result."""
+        nodes = [pod2.nodes[nid] for nid in sorted(pod2.nodes)]
+        for n in nodes:                                       # warm
+            n.search("docs", json.loads(json.dumps(BODY)))
+        reset_exec_lock_stats()
+        results: dict[int, list] = {}
+        errors: list = []
+        barrier = threading.Barrier(len(nodes))
+
+        def go(idx, node):
+            try:
+                barrier.wait(timeout=30)
+                results[idx] = [
+                    _hits(node.search("docs", json.loads(json.dumps(BODY))))
+                    for _ in range(3)]
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+        threads = [threading.Thread(target=go, args=(i, n), daemon=True)
+                   for i, n in enumerate(nodes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), \
+            "concurrent collectives deadlocked"
+        assert not errors, errors
+        st = exec_lock_stats()
+        assert st["shared_acquisitions"] == 0, st
+        assert st["shared_waits"] == 0, st
+        flat = [h for hs in results.values() for h in hs]
+        assert all(h == flat[0] for h in flat)
+
+    def test_pod_reduce_dispatches_and_dcn_hops_count(self, pod2):
+        client = pod2.client()
+        before = dict(client.host_reduce_stats)
+        client.search("docs", json.loads(json.dumps(BODY)))
+        after = client.host_reduce_stats
+        assert after["pod_dispatches"] > before["pod_dispatches"]
+        assert after["dcn_hops"] > before["dcn_hops"]
+
+
+class TestDcnTrafficClass:
+
+    def test_inter_pod_sends_ride_the_dcn_class(self, pod2):
+        client = pod2.client()
+        s0 = pod2.network.class_stats()["dcn"]["sent_total"]
+        client.search("docs", json.loads(json.dumps(BODY)))
+        assert pod2.network.class_stats()["dcn"]["sent_total"] > s0
+
+    def test_dcn_latency_never_poisons_the_hedge_signal(self, pod2):
+        """The QoS EWMA keys cross-host hops under their own "dcn"
+        class: the snapshot carries separate reg/dcn deadlines, and the
+        per-node hedge latency map (the ICI deadline input) never learns
+        from a cross-host observation."""
+        client = pod2.client()
+        client.search("docs", json.loads(json.dumps(BODY)))
+        snap = transport_latency_snapshot()
+        assert "dcn" in snap and snap["dcn"]["n"] >= 1
+        assert snap["dcn"]["deadline_ms"] >= snap["dcn"]["ewma_ms"]
+
+    def test_pod_metrics_ride_the_walk(self, pod2):
+        client = pod2.client()
+        client.search("docs", json.loads(json.dumps(BODY)))
+        fams = openmetrics_families(client.metric_sections(),
+                                    client.node_id)
+        row = fams["es_search_pod_reduce_dispatches_total"]
+        assert any(v >= 1 for _labels, v in row.samples)
+        classes = {labels.get("class") for labels, _v
+                   in fams["es_transport_class_sent_total"].samples}
+        assert "dcn" in classes
+        lat = {labels.get("class") for labels, _v
+               in fams["es_transport_latency_ewma_ms"].samples}
+        assert "dcn" in lat

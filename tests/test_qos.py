@@ -699,7 +699,9 @@ class TestQosObservability:
             == "counter"
         classes = {lb["class"] for lb, _ in
                    families["es_transport_class_queue_depth"]["samples"]}
-        assert classes == {"recovery", "bulk", "reg", "state", "ping"}
+        # "dcn" is the sixth class (ISSUE 19): cross-host latency traffic
+        assert classes == {"recovery", "bulk", "reg", "state", "ping",
+                           "dcn"}
 
     def test_sampler_ring_gains_qos_gauges(self, node):
         snap = node._sampler_snapshot()

@@ -143,6 +143,9 @@ def test_cluster_over_tcp_node_death_reelection(tmp_path):
                 break
             time.sleep(0.05)
         assert c.master_node().node_id == "node-2"
+        # wait for the replacement replica to finish recovering — the
+        # search below must not race the post-failover re-allocation
+        c.ensure_green()
         client.index_doc("idx", "1", {"body": "after failover"})
         client.refresh("idx")
         out = client.search("idx", {"query": {"match_all": {}}})

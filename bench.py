@@ -107,7 +107,13 @@ _FINAL_LINE: dict = {"value": None, "unit": "qps",
                      # import so a forced timeout still emits them
                      "pod_qps": None, "single_pool_qps": None,
                      "pod_vs_single": None, "dcn_hops_per_query": None,
-                     "exec_lock_waits": None}
+                     "exec_lock_waits": None,
+                     # watcher alerting tier (ISSUE 20): seeded null at
+                     # import so a forced timeout still emits them
+                     "watcher_evals_per_sec": None,
+                     "watcher_fire_p50_ms": None,
+                     "watcher_percolate_rides": None,
+                     "composite_page_qps": None}
 _LINE_PRINTED = False
 
 
@@ -1566,6 +1572,147 @@ def run_percolate_leg(tag: str) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_watcher_leg(tag: str) -> dict:
+    """Watcher alerting tier (ISSUE 20): register BENCH_WATCHER_WATCHES
+    watches (mixed percolate/agg conditions), drive the monitoring
+    collector so document watches ride its dense percolate batch, tick
+    the scheduler over the agg watches, and page a composite agg through
+    `after`-key cursors — evals/sec, per-fire latency, ride count, and
+    composite pages/sec."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import NodeService
+
+    n_watches = int(os.environ.get("BENCH_WATCHER_WATCHES", "1000"))
+    n_agg = max(1, int(os.environ.get("BENCH_WATCHER_AGG", "50")))
+    rounds = int(os.environ.get("BENCH_WATCHER_ROUNDS", "3"))
+    fire_reps = int(os.environ.get("BENCH_WATCHER_FIRE_REPS", "20"))
+    comp_docs = int(os.environ.get("BENCH_COMPOSITE_DOCS", "20000"))
+    comp_secs = float(os.environ.get("BENCH_COMPOSITE_SECS", "5"))
+    workdir = tempfile.mkdtemp(prefix=f"bench-watch-{tag}-")
+    node = NodeService(os.path.join(workdir, "node"), Settings({
+        "node.monitoring.enable": True,
+        "node.monitoring.interval": 0,      # manual collector ticks
+        "node.sampler.interval": 0,
+        "watcher.interval": 0,              # manual scheduler ticks
+        "watcher.throttle_period": "0s"}))
+    out: dict = {}
+    try:
+        ws = node.watcher_service
+        agg_body = {"size": 0, "aggs": {"over_time": {
+            "date_histogram": {"field": "@timestamp", "interval": "1s"},
+            "aggs": {"rate": {"derivative": {"buckets_path": "_count"}}},
+        }}}
+        stride = max(1, n_watches // n_agg)
+        for i in range(n_watches):
+            if i % stride != 0 or i // stride >= n_agg:
+                # document watch: one more column of the dense matrix
+                ws.put_watch(f"doc-{i}", {"input": {"percolate": {
+                    "query": {"term": {"kind": "node_stats"}}
+                    if i % 2 else
+                    {"range": {"heap_used_bytes": {"gte": i % 97}}}}}})
+            else:
+                ws.put_watch(f"agg-{i}", {
+                    "trigger": {"schedule": {"interval": "1s"}},
+                    "input": {"search": {"request": {
+                        "index": ".monitoring-es-*", "body": agg_body}}},
+                    "condition": {"compare": {
+                        "ctx.payload.hits.total": {"gte": 0}}}})
+            if _over_budget(margin=120.0):
+                break              # partial registry: rates still hold
+        out["watcher_watches"] = len(ws.watches)
+
+        # collector ticks: every bulk percolates ALL document watches in
+        # one dense matrix program (the dogfood ride)
+        e0 = ws.stats["evaluations_total"]
+        t0 = time.perf_counter()
+        for _ in range(3):
+            for _ in range(4):
+                node.sampler.sample()
+                time.sleep(0.002)
+            node.monitoring.collect_once()
+            if _over_budget(margin=90.0):
+                break
+        # scheduler rounds over the agg watches (now_ms advances past
+        # every 1s trigger so each round evaluates the full agg set)
+        base_ms = int(time.time() * 1000)
+        for r in range(rounds):
+            ws.run_due(now_ms=base_ms + (r + 1) * 2000)
+            if _over_budget(margin=90.0):
+                break
+        eval_s = time.perf_counter() - t0
+        evals = ws.stats["evaluations_total"] - e0
+        out["watcher_evals_per_sec"] = evals / max(eval_s, 1e-9)
+        out["watcher_percolate_rides"] = ws.stats["percolate_rides_total"]
+        out["watcher_fires"] = ws.stats["fires_total"]
+
+        # per-fire latency: one always-firing watch, throttle 0 — each
+        # execute runs search + condition + alert bulk + registry persist
+        ws.put_watch("fire-probe", {
+            "input": {"search": {"request": {
+                "index": ".monitoring-es-*",
+                "body": {"size": 0, "query": {"match_all": {}}}}}},
+            "condition": {"always": {}}, "throttle_period": "0s"})
+        lat = []
+        for _ in range(fire_reps):
+            t0 = time.perf_counter()
+            res = ws.execute_watch("fire-probe")
+            lat.append((time.perf_counter() - t0) * 1000.0)
+            if not res.get("fired"):
+                break
+            if _over_budget(margin=60.0):
+                break
+        if lat:
+            lat.sort()
+            out["watcher_fire_p50_ms"] = lat[len(lat) // 2]
+
+        # composite after-key pagination: full disjoint cover of a
+        # keyword×histogram bucket space, pages/sec
+        node.create_index("comp", settings={"number_of_shards": 1},
+                          mappings={"_doc": {"properties": {
+                              "tag": {"type": "string",
+                                      "index": "not_analyzed"},
+                              "n": {"type": "long"}}}})
+        for i in range(0, comp_docs, 4000):
+            node.bulk([("index", {"_index": "comp", "_id": str(j)},
+                        {"tag": f"t{j % 40:02d}", "n": j % 500})
+                       for j in range(i, min(i + 4000, comp_docs))])
+        node.refresh("comp")
+
+        def comp_body(after):
+            b = {"size": 0, "aggs": {"pages": {"composite": {
+                "size": 50,
+                "sources": [{"tag": {"terms": {"field": "tag"}}},
+                            {"bin": {"histogram": {"field": "n",
+                                                   "interval": 100}}}]},
+            }}}
+            if after is not None:
+                b["aggs"]["pages"]["composite"]["after"] = after
+            return b
+
+        pages = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < comp_secs:
+            after = None
+            while True:
+                resp = node.search("comp", comp_body(after))
+                comp = resp["aggregations"]["pages"]
+                pages += 1
+                after = comp.get("after_key")
+                if after is None or not comp["buckets"]:
+                    break
+            if _over_budget(margin=60.0):
+                break
+        comp_s = time.perf_counter() - t0
+        out["composite_page_qps"] = pages / max(comp_s, 1e-9)
+        out["composite_pages"] = pages
+        return out
+    finally:
+        node.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def run_rebalance_leg(tag: str) -> dict:
     """Multi-tenant elasticity (ISSUE 15): drain one node of a live
     3-node cluster via an `exclude._id` filter update WHILE 32 client
@@ -1741,6 +1888,11 @@ def _run_all_legs(tag: str) -> dict:
             # the ratio is measured once, in the main process
             ("BENCH_PERCOLATE", "1" if tag == "main" else "0",
              run_percolate_leg),
+            # watcher alerting tier (ISSUE 20): scheduler/ride/pagination
+            # rates over a single self-monitoring node — measured once,
+            # in the main process
+            ("BENCH_WATCHER", "1" if tag == "main" else "0",
+             run_watcher_leg),
             ("BENCH_REBAL", "1" if tag == "main" else "0",
              run_rebalance_leg),
             # 4M-doc aggs + 1M-doc vectors: opt-in —
@@ -1945,6 +2097,18 @@ def main_engine():
             "script_score_qps": r2(res.get("script_score_qps")),
             "script_host_qps": r2(res.get("script_host_qps")),
             "script_vs_decline": rnd(res.get("script_vs_decline"))})
+    if "watcher_evals_per_sec" in res:
+        # watcher alerting tier (ISSUE 20): evaluation throughput,
+        # per-fire latency (search + condition + alert bulk + persist),
+        # the collector percolate-ride count, and composite pages/sec
+        line.update({
+            "watcher_watches": res.get("watcher_watches"),
+            "watcher_evals_per_sec": r2(res.get("watcher_evals_per_sec")),
+            "watcher_fire_p50_ms": r2(res.get("watcher_fire_p50_ms")),
+            "watcher_percolate_rides": res.get("watcher_percolate_rides"),
+            "watcher_fires": res.get("watcher_fires"),
+            "composite_page_qps": r2(res.get("composite_page_qps")),
+            "composite_pages": res.get("composite_pages")})
     if "rebalance_move_s" in res:
         # rebalance-under-load (ISSUE 15): the SLO pair under a live
         # shard move + the throttle-compliance evidence

@@ -63,6 +63,33 @@ def setting_enabled(value) -> bool:
     return value is not False
 
 
+# Process-wide mirror of the pod reduce-plane counters (ISSUE 20
+# satellite of ISSUE 19): ClusterNode keeps per-node `host_reduce_stats`,
+# but the stats-history ring samples through the single-process
+# NodeService, which can't reach the cluster coordinators — this mirror
+# aggregates every coordinator in the process so pod dispatch/DCN-hop
+# totals land in `.monitoring-es-*` and become watchable.
+import threading as _threading  # noqa: E402
+
+_POD_STATS_LOCK = _threading.Lock()
+_POD_STATS = {"pod_dispatches_total": 0, "dcn_hops_total": 0}
+
+
+def note_pod_dispatch() -> None:
+    with _POD_STATS_LOCK:
+        _POD_STATS["pod_dispatches_total"] += 1
+
+
+def note_dcn_hop() -> None:
+    with _POD_STATS_LOCK:
+        _POD_STATS["dcn_hops_total"] += 1
+
+
+def pod_reduce_snapshot() -> dict:
+    with _POD_STATS_LOCK:
+        return dict(_POD_STATS)
+
+
 def try_host_reduce(node, index: str, sids: list[int], body: dict,
                     k: int, dfs: dict | None):
     """Execute the co-hosted shards' query phase as one mesh program.

@@ -1997,6 +1997,8 @@ class ClusterNode:
         from ..serving.qos import observe_transport_latency
         if self._cross_host(node):
             self.host_reduce_stats["dcn_hops"] += 1
+            from .host_reduce import note_dcn_hop
+            note_dcn_hop()      # process-wide mirror for the sampler ring
             observe_transport_latency("dcn", ms)
         else:
             observe_transport_latency("reg", ms)
@@ -2300,6 +2302,8 @@ class ClusterNode:
                             # whole host's shards, and the merge below
                             # is the same bitwise host merge
                             self.host_reduce_stats["pod_dispatches"] += 1
+                            from .host_reduce import note_pod_dispatch
+                            note_pod_dispatch()
                         for ti in tis:
                             per_shard.append((ti, r["shards"][str(
                                 targets[ti][2])]))

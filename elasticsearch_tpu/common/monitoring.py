@@ -126,6 +126,12 @@ class MonitoringCollector:
         if self.current_index is not None and name != self.current_index:
             self.stats["rollovers_total"] += 1
         self.current_index = name
+        watcher = getattr(node, "watcher_service", None)
+        if watcher is not None:
+            # document watches compile into THIS index's percolator
+            # registry (re-armed across daily rollover) so the batch
+            # below percolates them in one dense matrix program
+            watcher.ensure_percolator_registrations(name)
         node_name = getattr(node, "node_name", "tpu-node-0")
         ops = []
         for s in fresh:
@@ -138,6 +144,10 @@ class MonitoringCollector:
                         doc))
         node.bulk(ops)
         node.indices[name].refresh()
+        if watcher is not None:
+            # the ISSUE 20 dogfood ride: the tick's own docs, percolated
+            # against every document watch as ONE doc×query matrix
+            watcher.percolate_collector_batch(name, [op[2] for op in ops])
         self._last_ts = fresh[-1]["timestamp"]
         self.stats["docs_indexed_total"] += len(ops)
         self._apply_retention()
@@ -202,14 +212,22 @@ class MonitoringCollector:
             "aggs": {"over_time": {
                 "date_histogram": {"field": "@timestamp",
                                    "interval": interval},
-                "aggs": {"by_node": {
-                    "terms": {"field": "node"},
-                    "aggs": {
-                        "avg_heap": {"avg":
-                                     {"field": "heap_used_bytes"}},
-                        "max_hbm": {"max":
-                                    {"field": "hbm_bytes_in_use"}},
-                    }}}}},
+                "aggs": {
+                    "by_node": {
+                        "terms": {"field": "node"},
+                        "aggs": {
+                            "avg_heap": {"avg":
+                                         {"field": "heap_used_bytes"}},
+                            "max_hbm": {"max":
+                                        {"field": "hbm_bytes_in_use"}},
+                        }},
+                    # sample-rate column through the new pipeline-agg
+                    # path (ISSUE 20 dogfood): Δcount per bucket,
+                    # applied host-side at render over the same
+                    # bitwise device partials
+                    "doc_rate": {"derivative":
+                                 {"buckets_path": "_count"}},
+                }}},
         }
 
     def overview(self, size: int = 10, interval: str = "1m") -> dict:
@@ -220,6 +238,23 @@ class MonitoringCollector:
         meta = {"enabled": True, "interval_s": self.interval_s,
                 "retention_days": self.retention_days,
                 "indices": names, "collector": dict(self.stats)}
+        # watcher/alert-index visibility (ISSUE 20 satellite): the
+        # overview answers "what is watching this stream and what has
+        # it filed" next to the dispatch deltas it already reports
+        watcher = getattr(node, "watcher_service", None)
+        if watcher is not None:
+            from ..watcher.service import ALERTS_PREFIX
+            meta["watcher"] = {
+                "watch_count": len(watcher.watches),
+                "execution": dict(watcher.stats),
+                "alert_indices": sorted(
+                    n for n in node.indices
+                    if n.startswith(ALERTS_PREFIX)),
+                "alerts_docs": sum(
+                    node.indices[n].doc_count()
+                    for n in node.indices
+                    if n.startswith(ALERTS_PREFIX)),
+            }
         if not names:
             return {"monitoring": meta, "hits": {"total": 0,
                                                  "max_score": None,

@@ -256,6 +256,13 @@ class NodeService:
         self.monitoring = MonitoringCollector.from_settings(self)
         if self.monitoring is not None:
             self.monitoring.start()
+        # watcher alerting tier (ISSUE 20): registry recovered from the
+        # `.watches` index; document watches ride the monitoring
+        # collector's percolate batch, aggregation watches the scheduler
+        # (watcher/service.py). `self.watcher` is the file-resource
+        # watcher above — hence `watcher_service`.
+        from .watcher.service import WatcherService
+        self.watcher_service = WatcherService.from_settings(self)
         self.lifecycle.move_to_started()
 
     # -- index management (master ops, ref MetaDataCreateIndexService) ----
@@ -3096,6 +3103,21 @@ class NodeService:
             "search_hedged": ("outcome",
                               {o: {"total": c}
                                for o, c in hedge_snapshot().items()}),
+            # watcher alerting tier (ISSUE 20): evaluation/fire/throttle
+            # counters + per-watch last-fire gauges
+            # (es_watcher_watch_*{watch=}); zeros when watcher.enable is
+            # false so the scrape shape stays stable
+            "watcher": (None, self.watcher_service.metric_totals()
+                        if getattr(self, "watcher_service", None) else
+                        {"evaluations_total": 0, "fires_total": 0,
+                         "throttled_total": 0, "errors_total": 0,
+                         "percolate_rides_total": 0,
+                         "alerts_indexed_total": 0,
+                         "retention_deletes_total": 0, "watches": 0}),
+            "watcher_watch": ("watch",
+                              self.watcher_service.metric_per_watch()
+                              if getattr(self, "watcher_service", None)
+                              else {}),
             "jit": (None, {"compiles": compiles,
                            "compile_time_in_millis": round(compile_ms, 3)}),
             # per-program-site XLA accounting (ISSUE 16): invocations,
@@ -3240,6 +3262,24 @@ class NodeService:
         bst = batcher
         out["batcher_stranded_total"] = bst["stranded_total"]
         out["batcher_wait_timeouts_total"] = bst["wait_timeouts_total"]
+        # pod-plane health (ISSUE 20 satellite of ISSUE 19): exec-lock
+        # contention, per-class transport latency EWMAs (dcn always
+        # present — a pod watch must see 0.0, not a missing field) and
+        # the process-wide pod reduce dispatch totals join the ring so
+        # watches over `.monitoring-es-*` can alert on pod health
+        from .parallel.mesh_exec import exec_lock_stats
+        els = exec_lock_stats()
+        out["exec_lock_waits"] = (els.get("shared_waits", 0)
+                                  + els.get("pool_waits", 0))
+        out["exec_lock_shared_waits"] = els.get("shared_waits", 0)
+        out["exec_lock_pool_waits"] = els.get("pool_waits", 0)
+        from .serving.qos import transport_latency_snapshot
+        tlat = transport_latency_snapshot()
+        for cls in sorted(set(tlat) | {"dcn"}):
+            out[f"transport_latency_ewma_ms_{cls}"] = \
+                tlat.get(cls, {}).get("ewma_ms", 0.0)
+        from .cluster.host_reduce import pod_reduce_snapshot
+        out.update(pod_reduce_snapshot())
         tr = self.tracer.stats()
         out["tracing_active_traces"] = tr["active_traces"]
         out["tracing_dropped_total"] = tr["dropped_traces_total"]
@@ -3251,6 +3291,8 @@ class NodeService:
         if not self.lifecycle.move_to_closed():
             return                      # idempotent double-close
         self.watcher.stop()
+        if getattr(self, "watcher_service", None) is not None:
+            self.watcher_service.close()  # joins the scheduler thread
         if getattr(self, "monitoring", None) is not None:
             self.monitoring.close()     # joins the collector thread
         if getattr(self, "sampler", None) is not None:

@@ -104,6 +104,14 @@ def plan_aggs(specs, pctx) -> AggMeshPlan | None:
     devfns, finishers, sigs = [], [], []
     for spec in specs:
         if not spec.subs and not _supported_type(spec):
+            if spec.type == "composite":
+                # composite paginates over the GLOBALLY merged bucket
+                # space — a per-shard device tensor cannot carry the
+                # after-key cursor, so the fan-out (whose host collect
+                # factorizes key tuples per segment) is the documented
+                # lane; named decline for the explain surface
+                from ..common.device_stats import lane_decline
+                lane_decline("coordinator.aggs", "mesh", "composite")
             return None
         try:
             if spec.subs:

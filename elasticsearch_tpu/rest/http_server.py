@@ -2753,6 +2753,85 @@ def _register_indices_routes(c: RestController, node: NodeService) -> None:
     c.register("GET", "/_metrics", metrics_exposition)
     c.register("GET", "/_prometheus/metrics", metrics_exposition)
 
+    # -- watcher alerting tier (ISSUE 20): watch CRUD + stats + alerts -----
+    def _watcher_service():
+        ws = getattr(node, "watcher_service", None)
+        if ws is None:
+            raise RestError(400, "watcher is not enabled on this node "
+                                 "(set watcher.enable)")
+        return ws
+
+    def put_watch(g, p, b):
+        from ..watcher.watch import WatchParsingException
+        ws = _watcher_service()
+        try:
+            out = ws.put_watch(g["watch_id"], _json_body(b))
+        except WatchParsingException as e:
+            return 400, {"error": f"WatchParsingException: {e}",
+                         "status": 400}
+        status = 201 if out["created"] else 200
+        return status, out
+    c.register("PUT", "/_watcher/watch/{watch_id}", put_watch)
+
+    def get_watch(g, p, b):
+        from ..watcher.service import WatchMissingException
+        ws = _watcher_service()
+        try:
+            return 200, ws.get_watch(g["watch_id"])
+        except WatchMissingException:
+            return 404, {"found": False, "_id": g["watch_id"],
+                         "status": 404}
+    c.register("GET", "/_watcher/watch/{watch_id}", get_watch)
+
+    def delete_watch(g, p, b):
+        from ..watcher.service import WatchMissingException
+        ws = _watcher_service()
+        try:
+            return 200, ws.delete_watch(g["watch_id"])
+        except WatchMissingException:
+            return 404, {"found": False, "_id": g["watch_id"],
+                         "status": 404}
+    c.register("DELETE", "/_watcher/watch/{watch_id}", delete_watch)
+
+    def execute_watch(g, p, b):
+        # manual evaluation outside the schedule (ref _execute): runs
+        # the input search + condition now, fires/throttles for real
+        from ..watcher.service import WatchMissingException
+        ws = _watcher_service()
+        try:
+            return 200, ws.execute_watch(g["watch_id"])
+        except WatchMissingException:
+            return 404, {"found": False, "_id": g["watch_id"],
+                         "status": 404}
+    c.register("POST", "/_watcher/watch/{watch_id}/_execute", execute_watch)
+
+    def ack_watch(g, p, b):
+        # acked watches stay quiet until the condition goes false once
+        from ..watcher.service import WatchMissingException
+        ws = _watcher_service()
+        try:
+            return 200, ws.ack_watch(g["watch_id"])
+        except WatchMissingException:
+            return 404, {"found": False, "_id": g["watch_id"],
+                         "status": 404}
+    c.register("PUT", "/_watcher/watch/{watch_id}/_ack", ack_watch)
+
+    def watcher_stats(g, p, b):
+        return 200, _watcher_service().watcher_stats()
+    c.register("GET", "/_watcher/stats", watcher_stats)
+
+    def list_alerts(g, p, b):
+        # the audit trail: newest firings across the rolling
+        # `.alerts-es-*` indices, optionally filtered per watch
+        ws = _watcher_service()
+        try:
+            size = int(p.get("size", [50])[0])
+        except (TypeError, ValueError):
+            size = 50
+        return 200, ws.alerts(size=size,
+                              watch_id=p.get("watch_id", [None])[0])
+    c.register("GET", "/_alerts", list_alerts)
+
     # -- task management (ref tasks/TaskManager + ListTasksAction:
     #    GET /_tasks, GET /_tasks/{id}, GET /_cat/tasks) -------------------
     def list_tasks_api(g, p, b):

@@ -3,7 +3,7 @@
 from .aggregators import (
     AggSpec, AggregationParsingException, parse_aggs, collect_shard,
     merge_partial, merge_shard_partials, render,
-    BUCKET_TYPES, METRIC_TYPES,
+    BUCKET_TYPES, METRIC_TYPES, PIPELINE_TYPES,
 )
 from .hll import HyperLogLog
 from .tdigest import TDigest
@@ -11,5 +11,6 @@ from .tdigest import TDigest
 __all__ = [
     "AggSpec", "AggregationParsingException", "parse_aggs", "collect_shard",
     "merge_partial", "merge_shard_partials", "render",
-    "BUCKET_TYPES", "METRIC_TYPES", "HyperLogLog", "TDigest",
+    "BUCKET_TYPES", "METRIC_TYPES", "PIPELINE_TYPES", "HyperLogLog",
+    "TDigest",
 ]

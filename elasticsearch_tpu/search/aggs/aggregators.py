@@ -42,10 +42,25 @@ from .tdigest import TDigest
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing",
                 "significant_terms", "nested", "reverse_nested", "children",
-                "geohash_grid", "geo_distance", "sampler"}
+                "geohash_grid", "geo_distance", "sampler", "composite"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                 "extended_stats", "cardinality", "percentiles", "top_hits",
                 "geo_bounds", "scripted_metric"}
+# Pipeline aggregations (ref search/aggregations/pipeline/): computed
+# HOST-SIDE at render time over the already-reduced bucket list, so every
+# serving lane (loop/stacked/blockwise/mesh/host-reduce) feeds them the
+# same merged partials and the outputs are identical by construction.
+PIPELINE_TYPES = {"derivative", "moving_avg", "cumulative_sum",
+                  "bucket_script"}
+# which parents may carry which pipelines: the sequential pipelines need
+# an ordered bucket axis (histogram family); bucket_script only needs
+# per-bucket values, so terms qualifies too
+_PIPELINE_PARENTS = {
+    "derivative": ("histogram", "date_histogram"),
+    "moving_avg": ("histogram", "date_histogram"),
+    "cumulative_sum": ("histogram", "date_histogram"),
+    "bucket_script": ("histogram", "date_histogram", "terms"),
+}
 
 
 def has_top_hits(specs: list["AggSpec"]) -> bool:
@@ -64,9 +79,13 @@ class AggSpec:
     type: str
     params: dict
     subs: list["AggSpec"] = dc_field(default_factory=list)
+    # pipeline children live OUTSIDE `subs`: they never collect per doc
+    # (render-time host math only), so a leaf parent stays eligible for
+    # the batched device collect and the mesh planner never sees them
+    pipelines: list["AggSpec"] = dc_field(default_factory=list)
 
 
-def parse_aggs(spec: dict | None) -> list[AggSpec]:
+def parse_aggs(spec: dict | None, *, _nested: bool = False) -> list[AggSpec]:
     """Parse the request's "aggs"/"aggregations" tree
     (ref search/aggregations/AggregatorParsers.java)."""
     if not spec:
@@ -78,8 +97,9 @@ def parse_aggs(spec: dict | None) -> list[AggSpec]:
         params: dict = {}
         for key, val in body.items():
             if key in ("aggs", "aggregations"):
-                subs = parse_aggs(val)
-            elif key in BUCKET_TYPES or key in METRIC_TYPES:
+                subs = parse_aggs(val, _nested=True)
+            elif key in BUCKET_TYPES or key in METRIC_TYPES \
+                    or key in PIPELINE_TYPES:
                 agg_type, params = key, (val if isinstance(val, dict) else {})
             else:
                 raise AggregationParsingException(
@@ -89,7 +109,90 @@ def parse_aggs(spec: dict | None) -> list[AggSpec]:
         if subs and agg_type in METRIC_TYPES:
             raise AggregationParsingException(
                 f"metric aggregation [{name}] cannot have sub-aggregations")
-        out.append(AggSpec(name=name, type=agg_type, params=params, subs=subs))
+        if subs and agg_type in PIPELINE_TYPES:
+            raise AggregationParsingException(
+                f"pipeline aggregation [{name}] cannot have sub-aggregations")
+        pipelines = [s for s in subs if s.type in PIPELINE_TYPES]
+        subs = [s for s in subs if s.type not in PIPELINE_TYPES]
+        if agg_type == "composite":
+            _validate_composite(name, params, subs)
+        for ps in pipelines:
+            _validate_pipeline(agg_type, ps)
+        out.append(AggSpec(name=name, type=agg_type, params=params,
+                           subs=subs, pipelines=pipelines))
+    if not _nested:
+        for s in out:
+            if s.type in PIPELINE_TYPES:
+                raise AggregationParsingException(
+                    f"pipeline aggregation [{s.name}] must be a sibling "
+                    f"inside a bucket aggregation's [aggs], not top-level")
+    return out
+
+
+def _validate_pipeline(parent_type: str, ps: "AggSpec") -> None:
+    allowed = _PIPELINE_PARENTS[ps.type]
+    if parent_type not in allowed:
+        raise AggregationParsingException(
+            f"pipeline aggregation [{ps.name}] of type [{ps.type}] requires "
+            f"a parent of type {sorted(allowed)}, got [{parent_type}]")
+    bp = ps.params.get("buckets_path")
+    if ps.type == "bucket_script":
+        if not isinstance(bp, dict) or not bp:
+            raise AggregationParsingException(
+                f"bucket_script [{ps.name}] needs a buckets_path map")
+        if not ps.params.get("script"):
+            raise AggregationParsingException(
+                f"bucket_script [{ps.name}] needs a script")
+    elif not isinstance(bp, str) or not bp:
+        raise AggregationParsingException(
+            f"pipeline aggregation [{ps.name}] needs a buckets_path string")
+
+
+def _validate_composite(name: str, params: dict, subs: list) -> None:
+    """composite scope for this tier: leaf-only (no sub-aggregations),
+    ascending sources of terms/histogram/date_histogram — the exact slice
+    the after-key disjoint-cover guarantee is proven for."""
+    if subs:
+        raise AggregationParsingException(
+            f"composite aggregation [{name}] does not support "
+            f"sub-aggregations")
+    sources = params.get("sources")
+    if not isinstance(sources, list) or not sources:
+        raise AggregationParsingException(
+            f"composite aggregation [{name}] needs a non-empty sources list")
+    for src in sources:
+        if not isinstance(src, dict) or len(src) != 1:
+            raise AggregationParsingException(
+                f"composite [{name}]: each source is one {{name: spec}}")
+        sname, sbody = next(iter(src.items()))
+        if not isinstance(sbody, dict) or len(sbody) != 1:
+            raise AggregationParsingException(
+                f"composite [{name}] source [{sname}]: one source type")
+        stype, sp = next(iter(sbody.items()))
+        if stype not in ("terms", "histogram", "date_histogram"):
+            raise AggregationParsingException(
+                f"composite [{name}] source [{sname}]: unsupported source "
+                f"type [{stype}]")
+        if not isinstance(sp, dict) or not sp.get("field"):
+            raise AggregationParsingException(
+                f"composite [{name}] source [{sname}] needs a field")
+        if str(sp.get("order", "asc")) != "asc":
+            raise AggregationParsingException(
+                f"composite [{name}] source [{sname}]: only ascending "
+                f"order is supported")
+        if stype == "histogram" and "interval" not in sp:
+            raise AggregationParsingException(
+                f"composite [{name}] source [{sname}] needs an interval")
+
+
+def _composite_sources(spec: AggSpec) -> list[tuple[str, str, dict]]:
+    """-> [(source_name, source_type, source_params)], in request order
+    (the composite key's lexicographic significance order)."""
+    out = []
+    for src in spec.params.get("sources", []):
+        sname, sbody = next(iter(src.items()))
+        stype, sp = next(iter(sbody.items()))
+        out.append((sname, stype, sp))
     return out
 
 
@@ -877,6 +980,9 @@ def _bucket_segment(spec: AggSpec, seg: Segment, mask,
 
     mask = mv.np
 
+    if t == "composite":
+        return _composite_segment(spec, seg, mask)
+
     if t == "global":   # ignores the query: all live docs (ref bucket/global/)
         live = np.asarray(seg.live)
         return {"buckets": {"_global": _bucket_entry(
@@ -1069,6 +1175,117 @@ def _bucket_entry(spec: AggSpec, seg: Segment, mask: np.ndarray, qp,
             s.name: _collect_one(s, seg, mask, qp, scores_row=scores_row)
             for s in spec.subs}
     return entry
+
+
+# -- composite agg ----------------------------------------------------------
+
+def _comp_norm(v) -> int | float:
+    """Normalize a numeric composite key element to a plain python value —
+    ints stay exact ints (snowflake ids, epoch millis), integral floats
+    collapse to int so the after-key round-trips through JSON unchanged."""
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    f = float(v)
+    return int(f) if f.is_integer() else f
+
+
+def _composite_segment(spec: AggSpec, seg: Segment, mask: np.ndarray) -> dict:
+    """composite collect over one segment (ref search/aggregations/bucket/
+    composite/CompositeAggregator, backported to the 2.0 framework): each
+    source produces a per-doc key column; docs missing ANY source value
+    drop (ES composite default); the per-source columns factorize via
+    np.unique and combine into one packed code, so the whole segment's
+    tuple counting is a single bincount — no per-bucket python loop.
+    Partial: {"buckets": {key_tuple: {"doc_count": n}}} — tuples are
+    hashable, so the generic cross-segment/shard merge applies as-is."""
+    n = seg.n_pad
+    sel = mask[:n].copy()
+    cols: list[tuple[str, np.ndarray, Any]] = []   # (kind, per-doc, vocab)
+    for _sname, stype, sp in _composite_sources(spec):
+        field = sp.get("field")
+        if stype == "terms":
+            kw = seg.keywords.get(field)
+            if kw is not None:
+                ords = np.asarray(kw.ords)[:n]
+                sel &= ords >= 0
+                cols.append(("kw", ords, kw.values))
+                continue
+        col = _numeric_column(seg, field)
+        if col is None:
+            return {"buckets": {}}
+        vals, valid = col
+        vals, valid = vals[:n], valid[:n]
+        if stype == "terms":
+            keys = vals
+        elif stype == "histogram":
+            interval = float(sp["interval"])
+            if vals.dtype.kind == "i" and interval.is_integer():
+                keys = (vals // int(interval)) * int(interval)
+            else:
+                keys = np.floor(vals.astype(np.float64)
+                                / interval) * interval
+        else:   # date_histogram
+            keys = _date_round(vals, str(sp.get("interval", "1d")))
+        sel &= valid[: len(sel)]
+        cols.append(("num", keys, None))
+    idx = np.flatnonzero(sel)
+    if not len(idx):
+        return {"buckets": {}}
+    codes = np.zeros(len(idx), np.int64)
+    uniqs: list[tuple[str, np.ndarray, Any]] = []
+    for kind, arr, vocab in cols:
+        u, inv = np.unique(arr[idx], return_inverse=True)
+        uniqs.append((kind, u, vocab))
+        codes = codes * np.int64(len(u)) + inv
+    cu, ccounts = np.unique(codes, return_counts=True)
+    buckets: dict = {}
+    for code, cnt in zip(cu, ccounts):
+        parts = []
+        c = int(code)
+        for kind, u, vocab in reversed(uniqs):
+            c, i = divmod(c, len(u))
+            v = u[i]
+            parts.append(str(vocab[int(v)]) if kind == "kw"
+                         else _comp_norm(v))
+        buckets[tuple(reversed(parts))] = {"doc_count": int(cnt)}
+    return {"buckets": buckets}
+
+
+def _comp_sort_key(key: tuple) -> tuple:
+    """Total order over composite key tuples: per element, strings sort
+    among strings and numbers among numbers (type tag first), so mixed
+    after-key inputs from JSON can never raise on comparison."""
+    return tuple(("s", v) if isinstance(v, str) else ("n", float(v))
+                 for v in key)
+
+
+def _render_composite(spec: AggSpec, p: dict) -> dict:
+    """Render after the global merge: sort the merged bucket space
+    ascending, drop everything <= `after`, truncate to `size`, and emit
+    `after_key` = the last returned bucket. Because the sort runs over the
+    FULLY merged partials (every lane funnels through the same reduce),
+    consecutive pages are a disjoint exact cover of the bucket space and
+    identical on every serving lane."""
+    names = [s[0] for s in _composite_sources(spec)]
+    size = int(spec.params.get("size", 10))
+    items = sorted(p.get("buckets", {}).items(),
+                   key=lambda kv: _comp_sort_key(kv[0]))
+    after = spec.params.get("after")
+    if after:
+        missing = [nm for nm in names if nm not in after]
+        if missing:
+            raise AggregationParsingException(
+                f"composite [{spec.name}]: after key is missing sources "
+                f"{missing}")
+        ak = _comp_sort_key(tuple(after[nm] for nm in names))
+        items = [kv for kv in items if _comp_sort_key(kv[0]) > ak]
+    page = items[:size]
+    out: dict = {"buckets": [
+        {"key": dict(zip(names, k)), "doc_count": e["doc_count"]}
+        for k, e in page]}
+    if page:
+        out["after_key"] = dict(zip(names, page[-1][0]))
+    return out
 
 
 def _filter_mask(params: dict, seg: Segment, qp) -> np.ndarray:
@@ -1283,6 +1500,9 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
     if t in METRIC_TYPES:
         return _render_metric(spec, p)
 
+    if t == "composite":
+        return _render_composite(spec, p)
+
     buckets = p.get("buckets", {})
 
     def rb(key, entry, key_field=True):
@@ -1323,7 +1543,8 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
             + p.get("other_doc_count", 0)
         return {"doc_count_error_upper_bound": p.get("error_bound", 0),
                 "sum_other_doc_count": other,
-                "buckets": [rb(k, e) for k, e in top]}
+                "buckets": _apply_pipelines(
+                    spec, [rb(k, e) for k, e in top])}
 
     if t == "significant_terms":
         # JLH score (ref bucket/significant/heuristics/JLHScore.java):
@@ -1360,7 +1581,7 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
             if fmt:
                 b["key_as_string"] = _decimal_format(fmt, k)
             out.append(b)
-        return {"buckets": out}
+        return {"buckets": _apply_pipelines(spec, out)}
 
     if t == "date_histogram":
         items = sorted(buckets.items(), key=lambda kv: kv[0])
@@ -1372,7 +1593,7 @@ def _render_one(spec: AggSpec, p: dict) -> dict:
             b = rb(int(k), e)
             b["key_as_string"] = _iso(k)
             out.append(b)
-        return {"buckets": out}
+        return {"buckets": _apply_pipelines(spec, out)}
 
     if t in ("range", "date_range"):
         ordered = []
@@ -1468,3 +1689,112 @@ def _render_metric(spec: AggSpec, p: dict) -> dict:
         base.update({"sum_of_squares": 0.0, "variance": None,
                      "std_deviation": None})
     return base
+
+
+# ---------------------------------------------------------------------------
+# Pipeline aggregations (host-side, post-reduce)
+# ---------------------------------------------------------------------------
+
+def _bucket_path_value(bucket: dict, path) -> float | None:
+    """Resolve a buckets_path against one RENDERED bucket (pipelines run
+    after sub-agg rendering, so values read from response shapes):
+    `_count` -> doc_count, `agg` -> agg.value, `agg.prop` -> that stat,
+    `a>b.prop` descends nested single-bucket aggs. None = gap."""
+    path = str(path).strip()
+    if path == "_count":
+        return float(bucket.get("doc_count", 0))
+    node: Any = bucket
+    parts = [s.strip() for s in path.split(">")]
+    for hop in parts[:-1]:
+        node = node.get(hop) if isinstance(node, dict) else None
+        if node is None:
+            return None
+    last = parts[-1]
+    if last == "_count":
+        val = node.get("doc_count") if isinstance(node, dict) else None
+    else:
+        if "." in last:
+            name, prop = last.rsplit(".", 1)
+        else:
+            name, prop = last, "value"
+        inner = node.get(name) if isinstance(node, dict) else None
+        val = inner.get(prop) if isinstance(inner, dict) else None
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        return None
+    return float(val)
+
+
+def _apply_pipelines(spec: AggSpec, buckets: list[dict]) -> list[dict]:
+    """Apply this spec's pipeline children over the final sorted bucket
+    list, in declaration order (a later pipeline may read an earlier
+    one's output through its buckets_path)."""
+    for ps in spec.pipelines:
+        _apply_one_pipeline(ps, buckets)
+    return buckets
+
+
+def _apply_one_pipeline(ps: AggSpec, buckets: list[dict]) -> None:
+    path = ps.params.get("buckets_path")
+    if ps.type == "derivative":
+        # ref pipeline/derivative/DerivativePipelineAggregator: value =
+        # current - previous; gap_policy "skip" carries the last non-null
+        # value forward, and the first bucket never emits
+        prev = None
+        for b in buckets:
+            v = _bucket_path_value(b, path)
+            if v is not None and prev is not None:
+                b[ps.name] = {"value": v - prev}
+            if v is not None:
+                prev = v
+        return
+    if ps.type == "cumulative_sum":
+        # ref pipeline/cumulativesum/: running total, gaps add 0 and the
+        # sum is emitted on EVERY bucket (insert_zeros semantics)
+        total = 0.0
+        for b in buckets:
+            v = _bucket_path_value(b, path)
+            total += v if v is not None else 0.0
+            b[ps.name] = {"value": total}
+        return
+    if ps.type == "moving_avg":
+        # ref pipeline/movavg/ simple model: trailing mean over the last
+        # `window` non-null values INCLUDING the current bucket; gaps
+        # neither emit nor perturb the window
+        window = int(ps.params.get("window", 5))
+        if window <= 0:
+            raise AggregationParsingException(
+                f"moving_avg [{ps.name}]: window must be positive")
+        ring: list[float] = []
+        for b in buckets:
+            v = _bucket_path_value(b, path)
+            if v is None:
+                continue
+            ring.append(v)
+            if len(ring) > window:
+                ring.pop(0)
+            b[ps.name] = {"value": sum(ring) / len(ring)}
+        return
+    # bucket_script (ref pipeline/bucketscript/): resolve every named
+    # path; any gap skips the bucket; the expression runs through the
+    # SAME AST-whitelisted engine as script fields — both `params.x`
+    # and bare `x` name forms resolve
+    paths: dict = ps.params.get("buckets_path") or {}
+    script = ps.params.get("script")
+    base_params = {}
+    if isinstance(script, dict):
+        base_params = dict(script.get("params") or {})
+    from ...script.engine import run_search_script
+    for b in buckets:
+        vals = {k: _bucket_path_value(b, pth) for k, pth in paths.items()}
+        if any(v is None for v in vals.values()):
+            continue
+        try:
+            out = run_search_script(script, {}, {**base_params, **vals},
+                                    extra_names=vals)
+        except AggregationParsingException:
+            raise
+        except Exception as e:  # noqa: BLE001 — surface as a 400, not a 500
+            raise AggregationParsingException(
+                f"bucket_script [{ps.name}] failed: {e}") from e
+        if isinstance(out, (int, float)) and not isinstance(out, bool):
+            b[ps.name] = {"value": float(out)}

@@ -25,6 +25,8 @@ def _key_to_wire(k):
         return float(k)
     if isinstance(k, (np.str_, np.bool_)):
         return k.item()
+    if isinstance(k, tuple):     # composite key tuples ride as JSON lists
+        return [_key_to_wire(v) for v in k]
     return k
 
 
@@ -82,6 +84,8 @@ def partial_from_wire(spec: AggSpec, w: dict) -> dict:
     out = {k: v for k, v in w.items() if k != "buckets"}
     buckets = {}
     for key, e in w.get("buckets", []):
+        if spec.type == "composite":   # JSON list -> hashable key tuple
+            key = tuple(key)
         entry = {k: v for k, v in e.items() if k != "subs"}
         if "subs" in e:
             entry["subs"] = {s.name: partial_from_wire(s, e["subs"][s.name])

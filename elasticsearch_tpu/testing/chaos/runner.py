@@ -270,6 +270,7 @@ class ChaosRunner:
             self.oracle.compare("batched-vs-solo", body, solo, sub)
         self._sorted_parity()
         self._subagg_parity()
+        self._composite_parity()
         self._knn_parity()
         self._percolate_parity()
         self._script_parity()
@@ -325,6 +326,41 @@ class ChaosRunner:
                                        ref, got):
                     self.oracle.lane_check(f"subagg-loop-vs-{name}",
                                            rec, self._TWIN_LANES[name])
+
+    def _composite_parity(self) -> None:
+        """Composite + pipeline replay pairs (ISSUE 20): the composite
+        collect and host-side pipeline render are lane-invariant by
+        construction — every twin answers byte-equal to the loop, with
+        an `after`-key page-2 replay so cursor pagination is part of
+        the pair. On the mesh twin a composite body must decline the
+        collective planner under its STABLE reason ("composite") — a
+        renamed/dropped reason breaks the explain surface's contract."""
+        for body in self.solo_work.composite_queries(3):
+            ref = self.node.search("c-loop", copy.deepcopy(body))
+            for name, _ in _TWINS[1:]:
+                got, rec = self._search_lanes(name, body)
+                self.oracle.compare(f"composite-loop-vs-{name}", body,
+                                    ref, got)
+                if name == "c-mesh" and "pages" in body["aggs"]:
+                    want = ["composite"]
+                    seen = sorted({e["reason"] for e in rec.entries
+                                   if e["component"] == "coordinator.aggs"
+                                   and e["lane"] == "mesh"
+                                   and e["reason"] != "chosen"})
+                    self.oracle.compare(
+                        f"composite-decline-reason-{name}", body,
+                        {"declines": want}, {"declines": seen})
+            comp = (ref.get("aggregations") or {}).get("pages")
+            if comp and comp.get("after_key"):
+                page2 = copy.deepcopy(body)
+                page2["aggs"]["pages"]["composite"]["after"] = \
+                    copy.deepcopy(comp["after_key"])
+                ref2 = self.node.search("c-loop", copy.deepcopy(page2))
+                for name, _ in _TWINS[1:]:
+                    got, _rec = self._search_lanes(name, page2)
+                    self.oracle.compare(
+                        f"composite-after-loop-vs-{name}", page2,
+                        ref2, got)
 
     def _percolate_parity(self) -> None:
         """Reverse-search replay pairs (ISSUE 18): the dense doc×query

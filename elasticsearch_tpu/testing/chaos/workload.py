@@ -158,6 +158,50 @@ class SeededWorkload:
                         "aggs": tree})
         return out
 
+    def composite_queries(self, count: int) -> list[dict]:
+        """Composite + pipeline bodies (ISSUE 20). The composite collect
+        is host-side on every lane and the pipeline columns are applied
+        at the central render over the bitwise device partials, so every
+        twin must answer byte-equal; the mesh planner declines composite
+        under its stable "composite" reason. Pipeline inputs stay
+        integer-exact (counts / max over `n`) — the moving_avg division
+        and bucket_script arithmetic run once, host-side, so they are
+        bitwise too."""
+        out = []
+        for j in range(count):
+            w = self.rng.choice(WORDS)
+            interval = self.rng.choice([25, 50])
+            if j % 3 == 0:
+                aggs = {"pages": {"composite": {
+                    "size": self.rng.choice([3, 5]),
+                    "sources": [
+                        {"tag": {"terms": {"field": "tag"}}},
+                        {"bin": {"histogram": {"field": "n",
+                                               "interval": interval}}}],
+                }}}
+            elif j % 3 == 1:
+                aggs = {"by_n": {
+                    "histogram": {"field": "n", "interval": interval},
+                    "aggs": {
+                        "cnt": {"value_count": {"field": "n"}},
+                        "run": {"cumulative_sum": {"buckets_path": "cnt"}},
+                        "rate": {"derivative": {"buckets_path": "_count"}},
+                    }}}
+            else:
+                aggs = {"by_n": {
+                    "histogram": {"field": "n", "interval": interval},
+                    "aggs": {
+                        "hi": {"max": {"field": "n"}},
+                        "ma": {"moving_avg": {"buckets_path": "hi",
+                                              "window": 3}},
+                        "calc": {"bucket_script": {
+                            "buckets_path": {"c": "_count", "h": "hi"},
+                            "script": "c * 2.0 + h"}},
+                    }}}
+            out.append({"size": 5, "query": {"match": {"body": w}},
+                        "aggs": aggs})
+        return out
+
     def knn_queries(self, count: int) -> list[dict]:
         """kNN bodies cycling the metric roster; `k` stays small so the
         tiny chaos corpus keeps every candidate window meaningful."""

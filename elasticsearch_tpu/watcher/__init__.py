@@ -1,0 +1,10 @@
+"""Watcher alerting tier (ISSUE 20, SURVEY §7): stored watches evaluated
+continuously against the monitoring stream."""
+
+from .watch import Watch, WatchParsingException, parse_watch, condition_met
+from .service import WatcherService, WATCHES_INDEX, ALERTS_PREFIX
+
+__all__ = [
+    "Watch", "WatchParsingException", "parse_watch", "condition_met",
+    "WatcherService", "WATCHES_INDEX", "ALERTS_PREFIX",
+]

@@ -1,0 +1,522 @@
+"""WatcherService: registry + scheduler + alert writer (ISSUE 20
+tentpole; ref Watcher's WatchStore/.watches index, ExecutionService,
+TriggeredWatchStore, SURVEY §7).
+
+Persistence: watches live in an internal single-shard `.watches` index
+(one doc per watch: the body plus its runtime status) and are re-parsed
+from it at node construction — a watch registered before a restart is
+armed after it, exactly like the reference's WatchStore recovery scan.
+
+Document watches are compiled into the PR-18 percolator registry of the
+CURRENT `.monitoring-es-*` index as `.percolator` docs with reserved
+`_watch_<id>` ids: the monitoring collector calls
+`percolate_collector_batch` with the SAME docs list it just bulked, so
+the whole tick is percolated as ONE dense doc×query matrix program —
+one extra query column per watch, one device fetch per batch, zero
+extra fetches. Registrations are re-applied when the rolling index name
+changes (daily rollover), so the ride survives ILM.
+
+Aggregation watches are evaluated by a scheduler thread (`interval_s <=
+0` skips the thread — tests drive `run_due()` directly, the same
+convention as MonitoringCollector): the stored search request runs
+through `node.search` (composite + pipeline aggs now being first-class
+there) under a `watch` tracer root, the condition is applied to the
+response, and a firing appends an alert document to the rolling
+`.alerts-es-YYYY.MM.DD` index via the vectorized bulk lane with the
+same ILM-lite rollover/retention discipline as monitoring.
+
+Throttling/ack (ref Watcher's ack/throttle): a fired watch stays quiet
+for `throttle_period` (per-watch, default `watcher.throttle_period`);
+an acked watch never fires until its condition goes false once, which
+auto-unacks it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .watch import Watch, WatchParsingException, parse_watch, condition_met
+
+WATCHES_INDEX = ".watches"
+ALERTS_PREFIX = ".alerts-es-"
+ENABLE_SETTING = "watcher.enable"
+INTERVAL_SETTING = "watcher.interval"
+THROTTLE_SETTING = "watcher.throttle_period"
+RETENTION_SETTING = "watcher.alerts.retention_days"
+
+_WATCH_DOC_PREFIX = "_watch_"       # reserved percolator-registry ids
+
+WATCHES_SETTINGS = {"number_of_shards": 1, "number_of_replicas": 0}
+ALERTS_SETTINGS = {"number_of_shards": 1, "number_of_replicas": 0}
+ALERTS_MAPPING = {"_doc": {"properties": {
+    "@timestamp": {"type": "date"},
+    "watch_id": {"type": "string", "index": "not_analyzed"},
+    "kind": {"type": "string", "index": "not_analyzed"},
+    "state": {"type": "string", "index": "not_analyzed"},
+}}}
+
+
+class WatchMissingException(Exception):
+    pass
+
+
+def _enabled(settings) -> bool:
+    v = settings.get(ENABLE_SETTING, True)
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "yes", "on")
+    return bool(v)
+
+
+class WatcherService:
+
+    def __init__(self, node, interval_s: float = 1.0,
+                 default_throttle_s: float = 10.0,
+                 retention_days: int = 3, clock=None):
+        self.node = node
+        self.interval_s = float(interval_s)
+        self.default_throttle_s = float(default_throttle_s)
+        self.retention_days = int(retention_days)
+        self._clock = clock or time.time
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.watches: dict[str, Watch] = {}
+        self.stats = {"evaluations_total": 0, "fires_total": 0,
+                      "throttled_total": 0, "errors_total": 0,
+                      "percolate_rides_total": 0, "alerts_indexed_total": 0,
+                      "retention_deletes_total": 0}
+        # monitoring-index name -> watch ids whose percolator query is
+        # registered there (rollover re-registers into the new index)
+        self._registered: dict[str, set[str]] = {}
+        self._recover()
+
+    @classmethod
+    def from_settings(cls, node):
+        """None when `watcher.enable: false` — otherwise always built
+        (the scheduler thread only starts once an aggregation watch
+        exists, so idle nodes pay nothing)."""
+        if not _enabled(node.settings):
+            return None
+
+        def _num(key, default, cast):
+            try:
+                return cast(node.settings.get(key, default))
+            except (TypeError, ValueError):
+                return cast(default)
+
+        from .watch import duration_secs
+        interval = duration_secs(node.settings.get(INTERVAL_SETTING), 1.0)
+        throttle = duration_secs(node.settings.get(THROTTLE_SETTING), 10.0)
+        retention = _num(RETENTION_SETTING, 3, int)
+        return cls(node, interval_s=interval, default_throttle_s=throttle,
+                   retention_days=retention)
+
+    # -- registry persistence / recovery ------------------------------------
+
+    def _recover(self) -> None:
+        """Re-arm watches from the `.watches` registry index (ref
+        WatchStore.start scan-and-parse)."""
+        node = self.node
+        if WATCHES_INDEX not in node.indices:
+            return
+        try:
+            node.indices[WATCHES_INDEX].refresh()
+            resp = node.search(WATCHES_INDEX,
+                               {"size": 10000,
+                                "query": {"match_all": {}}})
+        except Exception:  # noqa: BLE001 — recovery must not kill boot
+            self.stats["errors_total"] += 1
+            return
+        for hit in resp.get("hits", {}).get("hits", []):
+            src = hit.get("_source") or {}
+            body = src.get("watch")
+            if not isinstance(body, dict):
+                continue
+            try:
+                w = parse_watch(hit["_id"], body, self.default_throttle_s)
+            except WatchParsingException:
+                self.stats["errors_total"] += 1
+                continue
+            st = src.get("state") or {}
+            w.acked = bool(st.get("acked", False))
+            w.last_fire_ms = int(st.get("last_fire_ms", 0) or 0)
+            w.fires_total = int(st.get("fires_total", 0) or 0)
+            self.watches[w.watch_id] = w
+        if self.watches:
+            self._maybe_start()
+
+    def _persist(self, w: Watch) -> None:
+        node = self.node
+        if WATCHES_INDEX not in node.indices:
+            node.create_index(WATCHES_INDEX, dict(WATCHES_SETTINGS))
+        node.index_doc(WATCHES_INDEX, w.watch_id,
+                       {"watch": w.body,
+                        "state": {"acked": w.acked,
+                                  "last_fire_ms": w.last_fire_ms,
+                                  "fires_total": w.fires_total}})
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def put_watch(self, watch_id: str, body: dict) -> dict:
+        w = parse_watch(watch_id, body, self.default_throttle_s)
+        with self._lock:
+            created = watch_id not in self.watches
+            old = self.watches.get(watch_id)
+            self.watches[watch_id] = w
+            if old is not None and old.kind == "document":
+                # a replaced query must not keep matching under its old form
+                self._unregister(watch_id)
+        self._persist(w)
+        if w.kind == "document":
+            mon = getattr(self.node, "monitoring", None)
+            if mon is not None and mon.current_index:
+                self.ensure_percolator_registrations(mon.current_index)
+        self._maybe_start()
+        return {"_id": watch_id, "created": created}
+
+    def get_watch(self, watch_id: str) -> dict:
+        with self._lock:
+            w = self.watches.get(watch_id)
+        if w is None:
+            raise WatchMissingException(watch_id)
+        return {"found": True, "_id": watch_id, "watch": w.body,
+                "status": w.status()}
+
+    def delete_watch(self, watch_id: str) -> dict:
+        with self._lock:
+            w = self.watches.pop(watch_id, None)
+        if w is None:
+            raise WatchMissingException(watch_id)
+        self._unregister(watch_id)
+        if WATCHES_INDEX in self.node.indices:
+            try:
+                self.node.delete_doc(WATCHES_INDEX, watch_id)
+            except Exception:  # noqa: BLE001
+                self.stats["errors_total"] += 1
+        return {"found": True, "_id": watch_id}
+
+    def ack_watch(self, watch_id: str) -> dict:
+        with self._lock:
+            w = self.watches.get(watch_id)
+            if w is None:
+                raise WatchMissingException(watch_id)
+            w.acked = True
+        self._persist(w)
+        return {"_id": watch_id, "status": w.status()}
+
+    # -- document watches: the percolator ride ------------------------------
+
+    def _document_watches(self) -> list[Watch]:
+        with self._lock:
+            return [w for w in self.watches.values()
+                    if w.kind == "document"]
+
+    def ensure_percolator_registrations(self, index_name: str) -> int:
+        """Idempotently register every document watch's query as a
+        `.percolator` doc in `index_name`; called by the collector each
+        tick so daily rollover re-arms the dense matrix columns."""
+        node = self.node
+        if index_name not in node.indices:
+            return 0
+        reg = self._registered.setdefault(index_name, set())
+        # prune state for rolled/retired indices
+        for stale in [n for n in self._registered if n not in node.indices]:
+            self._registered.pop(stale, None)
+        added = 0
+        for w in self._document_watches():
+            if w.watch_id in reg:
+                continue
+            node.index_doc(index_name,
+                           _WATCH_DOC_PREFIX + w.watch_id,
+                           {"query": w.percolate_query},
+                           type_name=".percolator")
+            reg.add(w.watch_id)
+            added += 1
+        return added
+
+    def _unregister(self, watch_id: str) -> None:
+        node = self.node
+        for name, reg in list(self._registered.items()):
+            if watch_id not in reg:
+                continue
+            reg.discard(watch_id)
+            if name in node.indices:
+                try:
+                    node.delete_doc(name, _WATCH_DOC_PREFIX + watch_id)
+                except Exception:  # noqa: BLE001
+                    self.stats["errors_total"] += 1
+
+    def percolate_collector_batch(self, index_name: str,
+                                  docs: list[dict]) -> int:
+        """Percolate one collector bulk against every document watch in
+        ONE dense matrix program (the PR-18 lane the monitoring index
+        already rides) and fire matching watches. Returns matched-doc
+        count across watches."""
+        if not docs or not self._document_watches():
+            return 0
+        node = self.node
+        self.ensure_percolator_registrations(index_name)
+        svc = node.indices.get(index_name)
+        if svc is None:
+            return 0
+        from ..search.percolate_exec import percolate_batch
+        from ..common import tracing
+        with tracing.span("watch", kind="document", index=index_name,
+                          docs=len(docs)):
+            try:
+                outs = percolate_batch(
+                    svc, index_name, [(d, "_doc") for d in docs],
+                    caches=node.caches,
+                    devices=node.device_pool.devices
+                    if node.device_pool else None)
+            except Exception as e:  # noqa: BLE001 — never break the tick
+                self.stats["errors_total"] += 1
+                for w in self._document_watches():
+                    w.last_error = str(e)
+                return 0
+        self.stats["percolate_rides_total"] += 1
+        per_watch: dict[str, int] = {}
+        for out in outs:
+            for m in out["matches"]:
+                mid = m["_id"]
+                if mid.startswith(_WATCH_DOC_PREFIX):
+                    wid = mid[len(_WATCH_DOC_PREFIX):]
+                    per_watch[wid] = per_watch.get(wid, 0) + 1
+        now_ms = int(self._clock() * 1000)
+        matched = 0
+        for wid in sorted(per_watch):
+            with self._lock:
+                w = self.watches.get(wid)
+            if w is None:
+                continue
+            self.stats["evaluations_total"] += 1
+            w.evaluations_total += 1
+            w.last_eval_ms = now_ms
+            matched += per_watch[wid]
+            self._fire(w, now_ms, {"matched_docs": per_watch[wid],
+                                   "index": index_name})
+        return matched
+
+    # -- aggregation watches: scheduled evaluation --------------------------
+
+    def run_due(self, now_ms: int | None = None) -> int:
+        """Evaluate every aggregation watch whose interval has elapsed;
+        the scheduler tick (tests call it directly)."""
+        if now_ms is None:
+            now_ms = int(self._clock() * 1000)
+        with self._lock:
+            due = [w for w in self.watches.values()
+                   if w.kind == "aggregation"
+                   and now_ms - w.last_eval_ms >= w.interval_s * 1000.0]
+        for w in due:
+            w.last_eval_ms = now_ms
+            self.execute_watch(w.watch_id, now_ms=now_ms)
+        self._apply_retention()
+        return len(due)
+
+    def execute_watch(self, watch_id: str,
+                      now_ms: int | None = None) -> dict:
+        """One evaluation of an aggregation watch: run the stored search
+        under a `watch` tracer root, apply the condition, maybe fire."""
+        with self._lock:
+            w = self.watches.get(watch_id)
+        if w is None:
+            raise WatchMissingException(watch_id)
+        if now_ms is None:
+            now_ms = int(self._clock() * 1000)
+        if w.kind == "document":
+            return {"_id": watch_id, "kind": "document",
+                    "note": "document watches fire from the collector's "
+                            "percolate ride, not the scheduler"}
+        node = self.node
+        self.stats["evaluations_total"] += 1
+        w.evaluations_total += 1
+        req = w.search_request
+        out = {"_id": watch_id, "kind": "aggregation",
+               "condition_met": False, "fired": False, "throttled": False}
+        with node.tracer.request("watch",
+                                 attrs={"watch_id": watch_id,
+                                        "index": str(req.get("index"))}):
+            try:
+                resp = node.search(req["index"], req.get("body") or {})
+            except Exception as e:  # noqa: BLE001
+                from ..node import IndexMissingException
+                if isinstance(e, IndexMissingException):
+                    # monitoring hasn't produced its first index yet:
+                    # 'no data', not an error
+                    out["note"] = "input index missing"
+                    return out
+                self.stats["errors_total"] += 1
+                w.last_error = str(e)
+                out["error"] = str(e)
+                return out
+            w.last_error = None
+            try:
+                met = condition_met(w, resp)
+            except WatchParsingException as e:
+                self.stats["errors_total"] += 1
+                w.last_error = str(e)
+                out["error"] = str(e)
+                return out
+            out["condition_met"] = bool(met)
+            if not met:
+                if w.acked:
+                    # condition went false: auto-unack (ref ackable
+                    # actions reset on AWAITS_SUCCESSFUL_EXECUTION)
+                    w.acked = False
+                    self._persist(w)
+                return out
+            fired = self._fire(w, now_ms, {"index": str(req.get("index"))})
+            out["fired"] = fired
+            out["throttled"] = not fired
+        return out
+
+    # -- firing / throttle / alerts ILM -------------------------------------
+
+    def _fire(self, w: Watch, now_ms: int, details: dict) -> bool:
+        if w.acked:
+            self.stats["throttled_total"] += 1
+            return False
+        if w.last_fire_ms and now_ms - w.last_fire_ms \
+                < w.throttle_s * 1000.0:
+            self.stats["throttled_total"] += 1
+            return False
+        self._write_alert(w, now_ms, details)
+        w.last_fire_ms = now_ms
+        w.fires_total += 1
+        self.stats["fires_total"] += 1
+        try:
+            self._persist(w)
+        except Exception:  # noqa: BLE001
+            self.stats["errors_total"] += 1
+        return True
+
+    def alert_index_for(self, ts_ms: int) -> str:
+        day = time.gmtime(ts_ms / 1000.0)
+        return f"{ALERTS_PREFIX}{day.tm_year:04d}." \
+               f"{day.tm_mon:02d}.{day.tm_mday:02d}"
+
+    def _day_of(self, name: str):
+        try:
+            y, m, d = name[len(ALERTS_PREFIX):].split(".")
+            return (int(y), int(m), int(d))
+        except (ValueError, IndexError):
+            return None
+
+    def _write_alert(self, w: Watch, now_ms: int, details: dict) -> None:
+        """Append the firing to today's rolling alert index via the
+        vectorized bulk lane (same write path as monitoring)."""
+        node = self.node
+        name = self.alert_index_for(now_ms)
+        if name not in node.indices:
+            from ..node import IndexAlreadyExistsException
+            try:
+                node.create_index(name, dict(ALERTS_SETTINGS),
+                                  {k: dict(v) for k, v in
+                                   ALERTS_MAPPING.items()})
+            except IndexAlreadyExistsException:
+                pass
+        doc = {"@timestamp": now_ms, "watch_id": w.watch_id,
+               "kind": w.kind, "state": "fired"}
+        doc.update({k: v for k, v in details.items() if k not in doc})
+        if isinstance(w.body.get("actions"), dict):
+            doc["actions"] = sorted(w.body["actions"])
+        node.bulk([("index",
+                    {"_index": name,
+                     "_id": f"{w.watch_id}-{now_ms}"}, doc)])
+        node.indices[name].refresh()
+        self.stats["alerts_indexed_total"] += 1
+
+    def _apply_retention(self) -> None:
+        import datetime
+        today = datetime.datetime.utcfromtimestamp(self._clock()).date()
+        cutoff = today - datetime.timedelta(days=self.retention_days)
+        for name in sorted(self.node.indices):
+            if not name.startswith(ALERTS_PREFIX):
+                continue
+            day = self._day_of(name)
+            if day is None:
+                continue
+            try:
+                when = datetime.date(*day)
+            except ValueError:
+                continue
+            if when < cutoff:
+                self.node.delete_index(name)
+                self.stats["retention_deletes_total"] += 1
+
+    # -- GET /_alerts -------------------------------------------------------
+
+    def alerts(self, size: int = 50, watch_id: str | None = None) -> dict:
+        node = self.node
+        names = sorted(n for n in node.indices
+                       if n.startswith(ALERTS_PREFIX)
+                       and self._day_of(n) is not None)
+        if not names:
+            return {"total": 0, "indices": [], "alerts": []}
+        body = {"size": size, "sort": [{"@timestamp": "desc"}],
+                "query": ({"term": {"watch_id": watch_id}} if watch_id
+                          else {"match_all": {}})}
+        resp = node.search(ALERTS_PREFIX + "*", body)
+        alerts = [dict(h.get("_source") or {},
+                       _id=h["_id"], _index=h["_index"])
+                  for h in resp["hits"]["hits"]]
+        return {"total": resp["hits"]["total"], "indices": names,
+                "alerts": alerts}
+
+    # -- stats / metrics ----------------------------------------------------
+
+    def watcher_stats(self) -> dict:
+        with self._lock:
+            watches = {wid: w.status()
+                       for wid, w in sorted(self.watches.items())}
+        return {"watcher_state": ("started" if self._thread is not None
+                                  else "stopped"),
+                "watch_count": len(watches),
+                "execution": dict(self.stats),
+                "watches": watches}
+
+    def metric_totals(self) -> dict:
+        """The `es_watcher_*` family payload for /_metrics."""
+        with self._lock:
+            n = len(self.watches)
+        out = dict(self.stats)
+        out["watches"] = n
+        return out
+
+    def metric_per_watch(self) -> dict:
+        """Per-watch last-fire gauges (`es_watcher_watch_*`, one series
+        per watch id)."""
+        with self._lock:
+            return {wid: {"fires_total": w.fires_total,
+                          "last_fire_epoch_millis": w.last_fire_ms}
+                    for wid, w in sorted(self.watches.items())}
+
+    # -- thread lifecycle ---------------------------------------------------
+
+    def _maybe_start(self) -> None:
+        if self._thread is not None or self.interval_s <= 0:
+            return
+        with self._lock:
+            if not any(w.kind == "aggregation"
+                       for w in self.watches.values()):
+                return
+            if self._thread is not None:
+                return
+
+            def loop():
+                while not self._stop.wait(self.interval_s):
+                    try:
+                        self.run_due()
+                    except Exception:  # noqa: BLE001 — never break serving
+                        self.stats["errors_total"] += 1
+            self._thread = threading.Thread(target=loop, daemon=True,
+                                            name="es[watcher]")
+            self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

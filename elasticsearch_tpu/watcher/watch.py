@@ -1,0 +1,221 @@
+"""Watch model + condition evaluation (ref Watcher's Watch.java /
+CompareCondition / ScriptCondition, SURVEY §7).
+
+A watch body is JSON:
+
+    {"trigger":   {"schedule": {"interval": "10s"}},          # optional
+     "input":     {"search": {"request": {"index": ..., "body": ...}}}
+                | {"percolate": {"query": {...}}},
+     "condition": {"always": {}} | {"never": {}}
+                | {"compare": {"ctx.payload.<path>": {"gte": 10}}}
+                | {"script": {"inline"|"source": ..., "params": {...}}},
+     "actions":   {...},                                      # opaque
+     "throttle_period": "10s"}                                # optional
+
+Two flavors fall out of the input clause: a ``percolate`` input makes a
+*document watch* (the query is compiled into the PR-18 percolator
+registry and rides the monitoring collector's dense doc×query matrix —
+no scheduler involvement), a ``search`` input makes an *aggregation
+watch* (the scheduler runs the request and applies the condition to the
+response payload — ``ctx.payload`` paths walk the search response, so
+pipeline-agg values like a derivative are first-class condition inputs).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+
+
+class WatchParsingException(Exception):
+    pass
+
+
+_COMPARE_OPS = {
+    "gte": lambda a, b: a >= b,
+    "gt": lambda a, b: a > b,
+    "lte": lambda a, b: a <= b,
+    "lt": lambda a, b: a < b,
+    "eq": lambda a, b: a == b,
+    "not_eq": lambda a, b: a != b,
+}
+
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)\s*(ms|s|m|h|d)?$")
+_UNIT_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
+           None: 1.0}
+
+
+def duration_secs(value, default: float) -> float:
+    """'500ms' / '10s' / '5m' / bare number -> seconds (ref TimeValue)."""
+    if value is None:
+        return default
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    m = _DURATION.match(str(value).strip())
+    if not m:
+        return default
+    return float(m.group(1)) * _UNIT_S[m.group(2)]
+
+
+@dataclass
+class Watch:
+    watch_id: str
+    body: dict
+    kind: str                      # "document" | "aggregation"
+    interval_s: float
+    throttle_s: float
+    condition: dict
+    # runtime status (persisted back into the registry index on change)
+    acked: bool = False
+    last_eval_ms: int = 0
+    last_fire_ms: int = 0
+    fires_total: int = 0
+    evaluations_total: int = 0
+    last_error: str | None = dc_field(default=None)
+
+    @property
+    def percolate_query(self) -> dict:
+        return self.body["input"]["percolate"]["query"]
+
+    @property
+    def search_request(self) -> dict:
+        return self.body["input"]["search"]["request"]
+
+    def status(self) -> dict:
+        return {"kind": self.kind, "acked": self.acked,
+                "interval_s": self.interval_s,
+                "throttle_period_s": self.throttle_s,
+                "evaluations_total": self.evaluations_total,
+                "fires_total": self.fires_total,
+                "last_fire_epoch_millis": self.last_fire_ms,
+                "last_error": self.last_error}
+
+
+def parse_watch(watch_id: str, body, default_throttle_s: float = 10.0,
+                default_interval_s: float = 10.0) -> Watch:
+    if not watch_id or not isinstance(watch_id, str):
+        raise WatchParsingException("watch id is required")
+    if not isinstance(body, dict):
+        raise WatchParsingException("watch body must be an object")
+    inp = body.get("input")
+    if not isinstance(inp, dict) or len(inp) != 1:
+        raise WatchParsingException(
+            "watch requires exactly one input: [search] or [percolate]")
+    (itype, ival), = inp.items()
+    if itype == "percolate":
+        if not isinstance(ival, dict) \
+                or not isinstance(ival.get("query"), dict):
+            raise WatchParsingException(
+                "[percolate] input requires a [query] object")
+        kind = "document"
+    elif itype == "search":
+        req = (ival or {}).get("request") if isinstance(ival, dict) else None
+        if not isinstance(req, dict) or not req.get("index"):
+            raise WatchParsingException(
+                "[search] input requires [request.index]")
+        if not isinstance(req.get("body", {}), dict):
+            raise WatchParsingException("[search] request body must be "
+                                        "an object")
+        kind = "aggregation"
+    else:
+        raise WatchParsingException(f"unknown watch input [{itype}]")
+
+    condition = body.get("condition", {"always": {}})
+    _validate_condition(condition)
+    if kind == "document" and "condition" in body \
+            and "always" not in condition:
+        raise WatchParsingException(
+            "document (percolate) watches fire on any match; only the "
+            "[always] condition is supported")
+
+    trigger = body.get("trigger") or {}
+    sched = trigger.get("schedule") or {} if isinstance(trigger, dict) else {}
+    interval_s = duration_secs(sched.get("interval"), default_interval_s)
+    if interval_s <= 0:
+        raise WatchParsingException("trigger interval must be positive")
+    throttle_s = duration_secs(body.get("throttle_period"),
+                               default_throttle_s)
+    if "actions" in body and not isinstance(body["actions"], dict):
+        raise WatchParsingException("[actions] must be an object")
+    return Watch(watch_id=watch_id, body=body, kind=kind,
+                 interval_s=interval_s, throttle_s=throttle_s,
+                 condition=condition)
+
+
+def _validate_condition(cond) -> None:
+    if not isinstance(cond, dict) or len(cond) != 1:
+        raise WatchParsingException(
+            "condition requires exactly one of "
+            "[always|never|compare|script]")
+    (ctype, cval), = cond.items()
+    if ctype in ("always", "never"):
+        return
+    if ctype == "compare":
+        if not isinstance(cval, dict) or len(cval) != 1:
+            raise WatchParsingException(
+                "[compare] condition requires exactly one path")
+        (_, clause), = cval.items()
+        if not isinstance(clause, dict) or len(clause) != 1:
+            raise WatchParsingException(
+                "[compare] clause requires exactly one operator")
+        (op, _), = clause.items()
+        if op not in _COMPARE_OPS:
+            raise WatchParsingException(f"unknown compare operator [{op}]")
+        return
+    if ctype == "script":
+        if not isinstance(cval, (str, dict)):
+            raise WatchParsingException("[script] condition requires a "
+                                        "script")
+        return
+    raise WatchParsingException(f"unknown condition [{ctype}]")
+
+
+def resolve_payload_path(payload, path: str):
+    """Walk a `ctx.payload.`-style dotted path through the search
+    response; integer tokens (incl. negative) index lists. None on any
+    miss — a missing bucket is 'no data', not an error."""
+    for prefix in ("ctx.payload.", "payload."):
+        if path.startswith(prefix):
+            path = path[len(prefix):]
+            break
+    cur = payload
+    for tok in path.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(tok)]
+            except (ValueError, IndexError):
+                return None
+        elif isinstance(cur, dict):
+            if tok not in cur:
+                return None
+            cur = cur[tok]
+        else:
+            return None
+    return cur
+
+
+def condition_met(watch: Watch, payload: dict) -> bool:
+    """Apply the watch's condition to the input search response."""
+    (ctype, cval), = watch.condition.items()
+    if ctype == "always":
+        return True
+    if ctype == "never":
+        return False
+    if ctype == "compare":
+        (path, clause), = cval.items()
+        (op, expected), = clause.items()
+        actual = resolve_payload_path(payload, path)
+        if actual is None:
+            return False
+        try:
+            return bool(_COMPARE_OPS[op](actual, expected))
+        except TypeError:
+            return False
+    # script condition: truthy return fires; `ctx.payload` binds the
+    # search response (the script's own params clause still applies)
+    from ..script.engine import run_search_script, ScriptException
+    ctx = {"payload": payload}
+    try:
+        return bool(run_search_script(cval, {}, extra_names={"ctx": ctx}))
+    except ScriptException as e:
+        raise WatchParsingException(f"watch condition script failed: {e}")

@@ -103,7 +103,10 @@ def test_tail_latency_keys_survive_forced_timeout():
                 "script_vs_decline",
                 # pod-scale serving (ISSUE 19): same seeded-null contract
                 "pod_qps", "single_pool_qps", "pod_vs_single",
-                "dcn_hops_per_query", "exec_lock_waits"):
+                "dcn_hops_per_query", "exec_lock_waits",
+                # watcher alerting tier (ISSUE 20): same contract
+                "watcher_evals_per_sec", "watcher_fire_p50_ms",
+                "watcher_percolate_rides", "composite_page_qps"):
         assert key in line, f"[{key}] must survive a forced timeout"
         assert line[key] is None       # nothing measured before the kill
 

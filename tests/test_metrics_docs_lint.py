@@ -1,0 +1,66 @@
+"""Docs lint (ISSUE 20 satellite): every `es_*` metric family the node
+actually emits on `GET /_metrics` must appear in README.md's metric
+table — a new stats section that registers a family without documenting
+it fails here, not in a dashboard review six months later.
+
+The node under test switches on every optional subsystem that owns
+families (monitoring, watcher, percolator traffic, XLA programs via a
+real search), so the rendered exposition is a superset of what a plain
+node scrapes.
+"""
+
+import re
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.metrics import render_openmetrics
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import NodeService
+
+
+@pytest.fixture(scope="module")
+def families(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("docslint")),
+                    Settings({"node.monitoring.enable": True,
+                              "node.monitoring.interval": 0,
+                              "node.sampler.interval": 0,
+                              "watcher.interval": 0}))
+    try:
+        n.create_index("ix", {"number_of_shards": 2})
+        n.index_doc("ix", "1", {"body": "hello world"})
+        n.refresh("ix")
+        n.search("ix", {"query": {"match": {"body": "hello"}}})
+        for _ in range(2):
+            n.sampler.sample()
+            time.sleep(0.002)
+        n.monitoring.collect_once()
+        ws = n.watcher_service
+        ws.put_watch("lint-doc", {"input": {"percolate": {
+            "query": {"term": {"kind": "node_stats"}}}}})
+        ws.put_watch("lint-agg", {"input": {"search": {"request": {
+            "index": "ix", "body": {"size": 0}}}},
+            "throttle_period": "0s"})
+        n.sampler.sample()
+        n.monitoring.collect_once()     # percolate ride families
+        ws.execute_watch("lint-agg")    # fire/alert families
+        text = render_openmetrics(n.metric_sections(), node="tpu-node-0")
+    finally:
+        n.close()
+    return sorted(set(re.findall(r"^# TYPE (\S+) \S+$", text, re.M)))
+
+
+def test_exposition_is_nontrivial(families):
+    assert len(families) > 100, families
+    assert "es_watcher_fires_total" in families
+    assert "es_watcher_watch_last_fire_epoch_millis" in families
+    assert "es_percolate_docs_total" in families
+
+
+def test_every_emitted_family_has_a_readme_row(families):
+    with open("README.md", encoding="utf-8") as fh:
+        readme = fh.read()
+    missing = [f for f in families if f not in readme]
+    assert not missing, (
+        "metric families emitted on /_metrics but absent from the "
+        f"README metric table: {missing} — add a row per family")

@@ -1,0 +1,322 @@
+"""ISSUE 20: pipeline aggregations + composite pagination over the
+device lanes.
+
+Pipelines (`derivative`, `moving_avg`, `cumulative_sum`,
+`bucket_script`) are applied HOST-SIDE at the central render over the
+bitwise device partials, so the four lane twins — per-segment loop
+(reference), stacked, stacked-blockwise, mesh — must answer
+byte-identically with zero lane-specific code. The exact-math units pin
+each pipeline's arithmetic against an independent numpy reference
+(strict ==, not approx: the inputs are integer-exact counts/max values
+and each op runs once on the host).
+
+Composite: `after`-key pagination is a strict-greater cursor over the
+globally merged+sorted bucket space, so consecutive pages form a
+disjoint exact cover — paged here across all four twins page by page.
+The mesh collective planner declines composite under its STABLE
+"composite" reason (the lane-explain contract) and serves it through
+the host per-segment collect, still bitwise.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search.aggs import AggregationParsingException
+
+TWINS = [
+    ("p-loop", {"index.search.stacked.enable": False,
+                "index.search.blockwise.enable": False,
+                "index.search.mesh.enable": False}),
+    ("p-stacked", {"index.search.blockwise.enable": False,
+                   "index.search.mesh.enable": False}),
+    ("p-block", {"index.search.mesh.enable": False,
+                 "index.search.block_docs": 32}),
+    ("p-mesh", {}),
+]
+
+MAPPING = {"_doc": {"properties": {
+    "body": {"type": "string"},
+    "tag": {"type": "string", "index": "not_analyzed"},
+    "n": {"type": "long"},
+    "m": {"type": "long"},
+    "val": {"type": "long"}}}}
+
+N_DOCS = 150
+WORDS = ["quick", "brown", "fox", "lazy", "dog"]
+TAGS = ["t0", "t1", "t2"]
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("pipelanes")))
+    for name, extra in TWINS:
+        n.create_index(name, settings={"number_of_shards": 2, **extra},
+                       mappings={k: dict(v) for k, v in MAPPING.items()})
+    for name, _ in TWINS:
+        for i in range(N_DOCS):
+            doc = {"body": f"{WORDS[i % 5]} {WORDS[(i * 3 + 1) % 5]}",
+                   "tag": TAGS[i % 3],
+                   "n": i % 30,                       # bins 0/10/20 @ iv 10
+                   "val": (i * 7) % 50}
+            # `m` exists ONLY where n lands in the 0- and 20-bins: the
+            # middle histogram bucket has no m values at all, which is
+            # the gap the derivative/moving_avg gap policies must skip
+            if i % 30 < 10 or i % 30 >= 20:
+                doc["m"] = (i * 13) % 40
+            n.index_doc(name, str(i), doc)
+            if i % 50 == 49:
+                n.refresh(name)          # multiple segments per shard
+        for i in range(0, N_DOCS, 17):   # tombstones stay as masks
+            n.delete_doc(name, str(i))
+        n.refresh(name)
+    yield n
+    n.close()
+
+
+def canon(resp: dict) -> dict:
+    r = json.loads(json.dumps(resp))
+    r.pop("took", None)
+    for h in r.get("hits", {}).get("hits", []):
+        h.pop("_index", None)
+    return r
+
+
+def _ask(n, name, body):
+    return n.search(name, json.loads(json.dumps(body)))
+
+
+def _matrix(n, body) -> dict:
+    ref = canon(_ask(n, "p-loop", body))
+    for name, _ in TWINS[1:]:
+        got = canon(_ask(n, name, body))
+        assert got == ref, \
+            f"[{name}] diverged from the loop for {body!r}"
+    return ref
+
+
+def _hist_body(pipelines: dict, interval: int = 10,
+               leaves: dict | None = None) -> dict:
+    aggs = dict(leaves or {})
+    aggs.update(pipelines)
+    return {"size": 0, "query": {"match_all": {}},
+            "aggs": {"by_n": {
+                "histogram": {"field": "n", "interval": interval},
+                "aggs": aggs}}}
+
+
+# -- exact-math units vs numpy ----------------------------------------------
+
+def test_derivative_exact_vs_numpy(node):
+    ref = _matrix(node, _hist_body(
+        {"rate": {"derivative": {"buckets_path": "_count"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    counts = np.array([b["doc_count"] for b in buckets], dtype=np.float64)
+    want = np.diff(counts)
+    assert "rate" not in buckets[0], "first bucket must not emit"
+    got = np.array([b["rate"]["value"] for b in buckets[1:]])
+    assert got.tolist() == want.tolist()      # strict, not approx
+
+
+def test_cumulative_sum_exact_vs_numpy(node):
+    ref = _matrix(node, _hist_body(
+        {"run": {"cumulative_sum": {"buckets_path": "cnt"}}},
+        leaves={"cnt": {"value_count": {"field": "val"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    vals = np.array([b["cnt"]["value"] for b in buckets], dtype=np.float64)
+    want = np.cumsum(vals)
+    got = np.array([b["run"]["value"] for b in buckets])
+    assert got.tolist() == want.tolist()
+
+
+def test_moving_avg_exact_vs_numpy(node):
+    window = 3
+    ref = _matrix(node, _hist_body(
+        {"ma": {"moving_avg": {"buckets_path": "hi", "window": window}}},
+        leaves={"hi": {"max": {"field": "val"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    vals = np.array([b["hi"]["value"] for b in buckets], dtype=np.float64)
+    # trailing mean over the last `window` values incl. current bucket
+    want = [np.mean(vals[max(0, i + 1 - window):i + 1])
+            for i in range(len(vals))]
+    got = [b["ma"]["value"] for b in buckets]
+    assert got == [float(w) for w in want]
+
+
+def test_bucket_script_exact_vs_numpy(node):
+    ref = _matrix(node, _hist_body(
+        {"calc": {"bucket_script": {
+            "buckets_path": {"c": "_count", "h": "hi"},
+            "script": "c * 2.0 + h"}}},
+        leaves={"hi": {"max": {"field": "val"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    c = np.array([b["doc_count"] for b in buckets], dtype=np.float64)
+    h = np.array([b["hi"]["value"] for b in buckets], dtype=np.float64)
+    want = c * 2.0 + h
+    got = np.array([b["calc"]["value"] for b in buckets])
+    assert got.tolist() == want.tolist()
+
+
+def test_gap_policy_skips_empty_bucket(node):
+    """The middle histogram bucket has NO `m` values: derivative skips
+    it and differences across the gap (last non-null carried forward);
+    moving_avg neither emits nor lets the gap perturb its window."""
+    ref = _matrix(node, _hist_body(
+        {"d": {"derivative": {"buckets_path": "mx"}},
+         "ma": {"moving_avg": {"buckets_path": "mx", "window": 2}}},
+        leaves={"mx": {"max": {"field": "m"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    assert len(buckets) == 3
+    assert buckets[1]["mx"]["value"] is None        # the gap is real
+    assert "d" not in buckets[0] and "d" not in buckets[1]
+    assert buckets[2]["d"]["value"] == \
+        buckets[2]["mx"]["value"] - buckets[0]["mx"]["value"]
+    assert "ma" not in buckets[1]
+    assert buckets[2]["ma"]["value"] == \
+        (buckets[0]["mx"]["value"] + buckets[2]["mx"]["value"]) / 2.0
+
+
+def test_chained_pipelines_read_in_declaration_order(node):
+    """A later pipeline may read an earlier one's output: cumulative_sum
+    over the derivative column telescopes back to count - count[0]."""
+    ref = _matrix(node, _hist_body(
+        {"rate": {"derivative": {"buckets_path": "_count"}},
+         "acc": {"cumulative_sum": {"buckets_path": "rate"}}}))
+    buckets = ref["aggregations"]["by_n"]["buckets"]
+    counts = [b["doc_count"] for b in buckets]
+    got = [b["acc"]["value"] for b in buckets]
+    want = [float(c - counts[0]) for c in counts]
+    # first bucket: derivative emits nothing -> gap adds 0
+    assert got == want
+
+
+# -- lane behavior -----------------------------------------------------------
+
+def test_pipeline_body_still_rides_the_mesh(node):
+    """Pipelines live OUTSIDE the device plan (AggSpec.pipelines, not
+    subs): a histogram + derivative body keeps its mesh eligibility."""
+    body = _hist_body(
+        {"rate": {"derivative": {"buckets_path": "_count"}}}, interval=6)
+    with record_lanes() as rec:
+        _ask(node, "p-mesh", body)
+    assert rec.chose("mesh"), rec.entries
+    assert node.indices["p-mesh"].search_stats.get(
+        "mesh_agg_dispatches", 0) >= 1
+
+
+def _declines(rec):
+    return {(e["lane"], e["reason"]) for e in rec.entries
+            if e["reason"] != "chosen"}
+
+
+def test_composite_declines_mesh_stably(node):
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"pages": {"composite": {
+                "size": 4,
+                "sources": [{"tag": {"terms": {"field": "tag"}}},
+                            {"bin": {"histogram": {"field": "n",
+                                                   "interval": 10}}}]}}}}
+    with record_lanes() as rec:
+        _ask(node, "p-mesh", body)
+    assert ("mesh", "composite") in _declines(rec), rec.entries
+    assert any(e["component"] == "coordinator.aggs"
+               for e in rec.entries
+               if e["reason"] == "composite"), rec.entries
+    _matrix(node, body)
+
+
+# -- composite pagination: disjoint exact cover ------------------------------
+
+def _live_pairs(node):
+    """The full (tag, bin) bucket space of LIVE docs, from the corpus
+    definition (tombstones excluded) — the oracle the page union must
+    exactly equal."""
+    want: dict = {}
+    dead = set(range(0, N_DOCS, 17))
+    for i in range(N_DOCS):
+        if i in dead:
+            continue
+        key = (TAGS[i % 3], float((i % 30) // 10 * 10))
+        want[key] = want.get(key, 0) + 1
+    return want
+
+
+def test_composite_pages_cover_disjointly_across_lanes(node):
+    """Page the whole (tag, bin) space 4 buckets at a time: >= 3 pages,
+    every page byte-identical on all four lanes, and the union of pages
+    is a DISJOINT EXACT cover of the live bucket space."""
+    base = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"pages": {"composite": {
+                "size": 4,
+                "sources": [{"tag": {"terms": {"field": "tag"}}},
+                            {"bin": {"histogram": {"field": "n",
+                                                   "interval": 10}}}]}}}}
+    seen: dict = {}
+    pages = 0
+    cursor = None
+    for _ in range(20):
+        body = json.loads(json.dumps(base))
+        if cursor is not None:
+            body["aggs"]["pages"]["composite"]["after"] = cursor
+        ref = _matrix(node, body)
+        comp = ref["aggregations"]["pages"]
+        if not comp["buckets"]:
+            break
+        pages += 1
+        for b in comp["buckets"]:
+            key = (b["key"]["tag"], float(b["key"]["bin"]))
+            assert key not in seen, f"page overlap at {key}"
+            seen[key] = b["doc_count"]
+        cursor = comp.get("after_key")
+        if cursor is None:
+            break
+    assert pages >= 3, f"only {pages} pages — cover not exercised"
+    assert seen == _live_pairs(node), "union of pages != bucket space"
+
+
+def test_composite_after_key_is_strict_greater(node):
+    """Replaying page 1's after_key never re-emits its last bucket."""
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"pages": {"composite": {
+                "size": 3,
+                "sources": [{"tag": {"terms": {"field": "tag"}}}]}}}}
+    page1 = _matrix(node, body)["aggregations"]["pages"]
+    body2 = json.loads(json.dumps(body))
+    body2["aggs"]["pages"]["composite"]["after"] = page1["after_key"]
+    page2 = _matrix(node, body2)["aggregations"]["pages"]
+    keys1 = {json.dumps(b["key"], sort_keys=True)
+             for b in page1["buckets"]}
+    keys2 = {json.dumps(b["key"], sort_keys=True)
+             for b in page2["buckets"]}
+    assert not keys1 & keys2
+
+
+# -- validation surface ------------------------------------------------------
+
+@pytest.mark.parametrize("aggs", [
+    # derivative under an UNORDERED parent (terms)
+    {"tags": {"terms": {"field": "tag"},
+              "aggs": {"d": {"derivative": {"buckets_path": "_count"}}}}},
+    # pipeline with sub-aggs of its own
+    {"by_n": {"histogram": {"field": "n", "interval": 10},
+              "aggs": {"d": {"derivative": {"buckets_path": "_count"},
+                             "aggs": {"x": {"max": {"field": "n"}}}}}}},
+    # bucket_script without a script
+    {"by_n": {"histogram": {"field": "n", "interval": 10},
+              "aggs": {"bs": {"bucket_script": {
+                  "buckets_path": {"c": "_count"}}}}}},
+    # composite after key missing a source
+    {"pages": {"composite": {
+        "size": 3, "after": {"tag": "t0"},
+        "sources": [{"tag": {"terms": {"field": "tag"}}},
+                    {"bin": {"histogram": {"field": "n",
+                                           "interval": 10}}}]}}},
+], ids=["derivative-on-terms", "pipeline-with-subs",
+        "bucket_script-no-script", "after-missing-source"])
+def test_pipeline_parse_errors(node, aggs):
+    with pytest.raises(AggregationParsingException):
+        _ask(node, "p-loop", {"size": 0, "query": {"match_all": {}},
+                              "aggs": aggs})

@@ -1,0 +1,382 @@
+"""Watcher alerting tier (ISSUE 20 tentpole).
+
+Document watches compile into the PR-18 percolator registry of the
+rolling monitoring index and ride the collector's bulk as ONE dense
+doc×query matrix program — the `dense` percolate counter moves by
+exactly 1 per tick (fetches_per_batch 1.0), which is the acceptance
+evidence that watch evaluation added zero device fetches.
+
+Aggregation watches run their stored search (composite + pipeline
+bodies included) through the ordinary lanes — the end-to-end test here
+asserts a derivative-conditioned watch evaluates through the MESH lane
+over the 2-shard monitoring index and files its alert into the rolling
+`.alerts-es-YYYY.MM.DD` index, readable back via GET /_alerts.
+
+Ack/throttle, `.watches` restart recovery, the REST surface, and the
+es_watcher_* metric families are pinned alongside.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common.device_stats import record_lanes
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import NodeService
+from elasticsearch_tpu.search.percolate_exec import percolate_stats_snapshot
+from elasticsearch_tpu.watcher import (ALERTS_PREFIX, WATCHES_INDEX,
+                                       WatchParsingException, parse_watch)
+from elasticsearch_tpu.watcher.service import WatchMissingException
+from elasticsearch_tpu.watcher.watch import duration_secs, \
+    resolve_payload_path
+
+SETTINGS = {"node.monitoring.enable": True,
+            "node.monitoring.interval": 0,      # manual collector ticks
+            "node.sampler.interval": 0,
+            "watcher.interval": 0,              # manual run_due ticks
+            "watcher.throttle_period": "0s"}    # tests set per-watch
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = NodeService(str(tmp_path_factory.mktemp("watcher")),
+                    Settings(dict(SETTINGS)))
+    yield n
+    n.close()
+
+
+def _agg_watch(index=".monitoring-es-*", condition=None, **extra):
+    body = {"input": {"search": {"request": {
+        "index": index,
+        "body": {"size": 0, "query": {"match_all": {}},
+                 "aggs": {"over_time": {
+                     "date_histogram": {"field": "@timestamp",
+                                        "interval": "1s"},
+                     "aggs": {"rate": {"derivative":
+                                       {"buckets_path": "_count"}}}}}},
+    }}}}
+    body["condition"] = condition or {"always": {}}
+    body.update(extra)
+    return body
+
+
+def _doc_watch(query=None, **extra):
+    body = {"input": {"percolate": {
+        "query": query or {"term": {"kind": "node_stats"}}}}}
+    body.update(extra)
+    return body
+
+
+# -- parsing ----------------------------------------------------------------
+
+@pytest.mark.parametrize("body", [
+    {},                                                    # no input
+    {"input": {"search": {}, "percolate": {}}},            # two inputs
+    {"input": {"search": {"request": {}}}},                # no index
+    {"input": {"webhook": {}}},                            # unknown input
+    {"input": {"percolate": {}}},                          # no query
+    {"input": {"percolate": {"query": {"match_all": {}}}},
+     "condition": {"compare": {"ctx.payload.x": {"gte": 1}}}},  # doc+compare
+    {"input": {"search": {"request": {"index": "i"}}},
+     "condition": {"compare": {"a": {"gte": 1}}, "always": {}}},
+    {"input": {"search": {"request": {"index": "i"}}},
+     "condition": {"compare": {"a": {"between": 1}}}},     # unknown op
+    {"input": {"search": {"request": {"index": "i"}}},
+     "trigger": {"schedule": {"interval": "0s"}}},         # bad interval
+    {"input": {"search": {"request": {"index": "i"}}},
+     "actions": ["log"]},                                  # actions not dict
+], ids=["no-input", "two-inputs", "no-index", "unknown-input", "no-query",
+        "doc-compare", "two-conditions", "unknown-op", "zero-interval",
+        "actions-list"])
+def test_parse_rejects(body):
+    with pytest.raises(WatchParsingException):
+        parse_watch("w", body)
+
+
+def test_duration_secs_units():
+    assert duration_secs("500ms", 1.0) == 0.5
+    assert duration_secs("10s", 1.0) == 10.0
+    assert duration_secs("5m", 1.0) == 300.0
+    assert duration_secs("2h", 1.0) == 7200.0
+    assert duration_secs("1d", 1.0) == 86400.0
+    assert duration_secs(7, 1.0) == 7.0
+    assert duration_secs("garbage", 3.0) == 3.0
+    assert duration_secs(None, 3.0) == 3.0
+
+
+def test_resolve_payload_path_lists_and_misses():
+    payload = {"aggregations": {"t": {"buckets": [
+        {"doc_count": 2}, {"doc_count": 5, "rate": {"value": 3.0}}]}}}
+    assert resolve_payload_path(
+        payload, "ctx.payload.aggregations.t.buckets.-1.rate.value") == 3.0
+    assert resolve_payload_path(
+        payload, "aggregations.t.buckets.0.doc_count") == 2
+    assert resolve_payload_path(payload, "ctx.payload.missing.x") is None
+    assert resolve_payload_path(
+        payload, "aggregations.t.buckets.9.doc_count") is None
+
+
+# -- registry CRUD ----------------------------------------------------------
+
+def test_put_get_delete_roundtrip(node):
+    ws = node.watcher_service
+    out = ws.put_watch("crud", _agg_watch())
+    assert out == {"_id": "crud", "created": True}
+    assert ws.put_watch("crud", _agg_watch())["created"] is False
+    got = ws.get_watch("crud")
+    assert got["found"] and got["watch"]["input"]["search"]
+    assert got["status"]["kind"] == "aggregation"
+    assert WATCHES_INDEX in node.indices
+    assert ws.delete_watch("crud")["found"] is True
+    with pytest.raises(WatchMissingException):
+        ws.get_watch("crud")
+    with pytest.raises(WatchMissingException):
+        ws.delete_watch("crud")
+
+
+# -- the end-to-end acceptance pair -----------------------------------------
+
+def _tick(node, samples=3):
+    for _ in range(samples):
+        node.sampler.sample()
+        time.sleep(0.002)
+    return node.monitoring.collect_once()
+
+
+def test_agg_watch_derivative_fires_into_alert_index(node):
+    """End to end: monitoring stream -> derivative agg watch -> alert in
+    the rolling `.alerts-es-*` index via GET /_alerts — with the input
+    search riding the MESH lane of the 2-shard monitoring index."""
+    ws = node.watcher_service
+    assert _tick(node) >= 3
+    time.sleep(1.05)            # a second 1s date_histogram bucket
+    assert _tick(node) >= 3
+    cond = {"compare": {
+        "ctx.payload.aggregations.over_time.buckets.-1.rate.value":
+        {"gte": -1e9}}}          # resolvable only if the derivative ran
+    ws.put_watch("heap-rate", _agg_watch(condition=cond,
+                                         throttle_period="0s"))
+    with record_lanes() as rec:
+        out = ws.execute_watch("heap-rate")
+    assert out["condition_met"] is True, out
+    assert out["fired"] is True, out
+    assert rec.chose("mesh"), rec.entries
+    today = ws.alert_index_for(int(time.time() * 1000))
+    assert today.startswith(ALERTS_PREFIX) and today in node.indices
+    alerts = ws.alerts(watch_id="heap-rate")
+    assert alerts["total"] >= 1
+    top = alerts["alerts"][0]
+    assert top["watch_id"] == "heap-rate"
+    assert top["kind"] == "aggregation" and top["state"] == "fired"
+    assert top["_index"] == today
+    ws.delete_watch("heap-rate")
+
+
+def test_document_watch_rides_collector_bulk(node):
+    """The dogfood ride: ONE dense percolate batch per collector tick
+    (`dense` moves by exactly 1 — fetches_per_batch 1.0), the watch's
+    query registered as a `_watch_*` percolator column in the rolling
+    monitoring index itself."""
+    ws = node.watcher_service
+    ws.put_watch("doc-w", _doc_watch(throttle_period="1h"))
+    mon = node.monitoring.current_index
+    assert mon is not None
+    rides0 = ws.stats["percolate_rides_total"]
+    fires0 = ws.watches["doc-w"].fires_total
+    s0 = percolate_stats_snapshot()
+    node.sampler.sample()
+    assert node.monitoring.collect_once() >= 1
+    s1 = percolate_stats_snapshot()
+    assert s1["dense"] - s0["dense"] == 1, \
+        "a collector tick must cost exactly ONE dense percolate batch"
+    assert ws.stats["percolate_rides_total"] == rides0 + 1
+    assert ws.watches["doc-w"].fires_total == fires0 + 1
+    top = ws.alerts(watch_id="doc-w")["alerts"][0]
+    assert top["kind"] == "document" and top["matched_docs"] >= 1
+    # within throttle_period: next tick evaluates but stays quiet
+    thr0 = ws.stats["throttled_total"]
+    node.sampler.sample()
+    node.monitoring.collect_once()
+    assert ws.watches["doc-w"].fires_total == fires0 + 1
+    assert ws.stats["throttled_total"] == thr0 + 1
+    ws.delete_watch("doc-w")
+
+
+def test_run_due_respects_intervals(node):
+    ws = node.watcher_service
+    ws.put_watch("due", _agg_watch(
+        trigger={"schedule": {"interval": "10s"}},
+        throttle_period="0s"))
+    w = ws.watches["due"]
+    w.last_eval_ms = 1_000_000
+    assert ws.run_due(now_ms=1_005_000) == 0       # 5s < 10s interval
+    assert ws.run_due(now_ms=1_011_000) == 1
+    assert ws.run_due(now_ms=1_012_000) == 0       # just evaluated
+    ws.delete_watch("due")
+
+
+# -- throttle / ack ---------------------------------------------------------
+
+def test_throttle_window_and_ack_cycle(node):
+    ws = node.watcher_service
+    ws.put_watch("thr", _agg_watch(throttle_period="60s"))
+    t0 = int(time.time() * 1000)
+    assert ws.execute_watch("thr", now_ms=t0)["fired"] is True
+    out = ws.execute_watch("thr", now_ms=t0 + 1_000)
+    assert out["condition_met"] is True
+    assert out["fired"] is False and out["throttled"] is True
+    assert ws.execute_watch("thr", now_ms=t0 + 61_000)["fired"] is True
+    ws.delete_watch("thr")
+
+    # acked: quiet past any throttle window; a false condition unacks
+    cond = {"compare": {"ctx.payload.hits.total": {"gte": 10 ** 9}}}
+    ws.put_watch("ack", _agg_watch(throttle_period="0s"))
+    t1 = int(time.time() * 1000)
+    assert ws.execute_watch("ack", now_ms=t1)["fired"] is True
+    ws.ack_watch("ack")
+    out = ws.execute_watch("ack", now_ms=t1 + 10 ** 8)
+    assert out["throttled"] is True and out["fired"] is False
+    # flip the condition false once -> auto-unack
+    ws.put_watch("ack", _agg_watch(condition=cond, throttle_period="0s"))
+    ws.ack_watch("ack")
+    out = ws.execute_watch("ack", now_ms=t1 + 2 * 10 ** 8)
+    assert out["condition_met"] is False
+    assert ws.watches["ack"].acked is False, \
+        "a false condition must auto-unack (ref ackable actions)"
+    ws.delete_watch("ack")
+
+
+def test_script_condition(node):
+    ws = node.watcher_service
+    ws.put_watch("scr", _agg_watch(
+        condition={"script": {
+            "inline": "ctx.payload.hits.total >= params.floor",
+            "params": {"floor": 1}}},
+        throttle_period="0s"))
+    out = ws.execute_watch("scr")
+    assert out["condition_met"] is True and out["fired"] is True
+    ws.delete_watch("scr")
+
+
+def test_missing_input_index_is_no_data_not_error(node):
+    ws = node.watcher_service
+    ws.put_watch("gone", _agg_watch(index="no-such-index"))
+    e0 = ws.stats["errors_total"]
+    out = ws.execute_watch("gone")
+    assert out["note"] == "input index missing"
+    assert out["fired"] is False
+    assert ws.stats["errors_total"] == e0
+    ws.delete_watch("gone")
+
+
+# -- restart recovery -------------------------------------------------------
+
+def test_watches_survive_restart(tmp_path):
+    path = str(tmp_path / "restartable")
+    n1 = NodeService(path, Settings(dict(SETTINGS)))
+    try:
+        n1.watcher_service.put_watch("keep-agg", _agg_watch())
+        n1.watcher_service.put_watch("keep-doc", _doc_watch())
+        n1.watcher_service.ack_watch("keep-agg")
+        n1.watcher_service.watches["keep-agg"].fires_total = 4
+        n1.watcher_service._persist(n1.watcher_service.watches["keep-agg"])
+    finally:
+        n1.close()
+    n2 = NodeService(path, Settings(dict(SETTINGS)))
+    try:
+        ws = n2.watcher_service
+        assert set(ws.watches) == {"keep-agg", "keep-doc"}
+        assert ws.watches["keep-agg"].acked is True
+        assert ws.watches["keep-agg"].fires_total == 4
+        assert ws.watches["keep-doc"].kind == "document"
+    finally:
+        n2.close()
+
+
+def test_disabled_by_setting(tmp_path):
+    n = NodeService(str(tmp_path / "nowatch"),
+                    Settings({"watcher.enable": False}))
+    try:
+        assert n.watcher_service is None
+    finally:
+        n.close()
+
+
+# -- stats / metrics --------------------------------------------------------
+
+def test_stats_and_metric_families(node):
+    ws = node.watcher_service
+    ws.put_watch("met", _agg_watch(throttle_period="0s"))
+    ws.execute_watch("met")
+    st = ws.watcher_stats()
+    assert st["watch_count"] >= 1
+    assert st["watches"]["met"]["fires_total"] >= 1
+    assert st["execution"]["evaluations_total"] >= 1
+    from elasticsearch_tpu.common.metrics import render_openmetrics
+    text = render_openmetrics(node.metric_sections(), node="tpu-node-0")
+    assert "es_watcher_evaluations_total" in text
+    assert "es_watcher_fires_total" in text
+    assert "es_watcher_throttled_total" in text
+    assert "es_watcher_errors_total" in text
+    assert 'es_watcher_watch_last_fire_epoch_millis{' in text
+    assert 'watch="met"' in text
+    ws.delete_watch("met")
+
+
+def test_overview_reports_watcher(node):
+    ov = node.monitoring.overview(size=3)
+    w = ov["monitoring"]["watcher"]
+    assert w["execution"]["fires_total"] >= 1
+    assert any(n.startswith(ALERTS_PREFIX) for n in w["alert_indices"])
+    assert w["alerts_docs"] >= 1
+    # the dogfood pipeline column: Δcount per date_histogram bucket
+    buckets = ov["aggregations"]["over_time"]["buckets"]
+    assert any("doc_rate" in b for b in buckets[1:]) or len(buckets) == 1
+
+
+# -- REST surface -----------------------------------------------------------
+
+def _req(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_rest_surface(node):
+    from elasticsearch_tpu.rest import HttpServer
+    srv = HttpServer(node, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        st, out = _req(f"{base}/_watcher/watch/rw", "PUT",
+                       _agg_watch(throttle_period="0s"))
+        assert st == 201 and out["created"] is True
+        st, out = _req(f"{base}/_watcher/watch/rw", "PUT", _agg_watch())
+        assert st == 200 and out["created"] is False
+        st, out = _req(f"{base}/_watcher/watch/rw")
+        assert st == 200 and out["found"] is True
+        st, out = _req(f"{base}/_watcher/watch/rw/_execute", "POST")
+        assert st == 200 and out["kind"] == "aggregation"
+        st, out = _req(f"{base}/_watcher/watch/rw/_ack", "PUT")
+        assert st == 200 and out["status"]["acked"] is True
+        st, out = _req(f"{base}/_watcher/stats")
+        assert st == 200 and out["watch_count"] >= 1
+        st, out = _req(f"{base}/_alerts?size=5")
+        assert st == 200 and out["total"] >= 1
+        st, out = _req(f"{base}/_alerts?watch_id=no-such")
+        assert out["alerts"] == []
+        st, out = _req(f"{base}/_watcher/watch/rw", "DELETE")
+        assert st == 200 and out["found"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/_watcher/watch/rw")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/_watcher/watch/bad", "PUT", {"input": {}})
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
